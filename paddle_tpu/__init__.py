@@ -1,0 +1,71 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid 1.7 (reference at /root/reference), re-designed for
+JAX/XLA/Pallas/pjit: a serializable program IR lowered to single XLA modules,
+GSPMD sharding over a named-axis device mesh instead of NCCL rings, and
+functional state threading instead of in-place scope mutation.
+
+The top-level namespace mirrors `paddle.fluid`.
+"""
+from .framework.core import (  # noqa: F401
+    Program, Variable, Operator, Block, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    switch_main_program, switch_startup_program,
+    CPUPlace, CUDAPlace, TPUPlace, OpRole,
+    grad_var_name,
+)
+from .framework.executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard,
+)
+from .framework.backward import append_backward, gradients  # noqa: F401
+from .framework import initializer  # noqa: F401
+from .framework import unique_name  # noqa: F401
+from .framework.dtype import convert_dtype  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .parallel.compiler import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+)
+from . import parallel  # noqa: F401
+from .layers.tensor import data  # noqa: F401
+
+__version__ = "0.1.0"
+
+# `fluid`-style namespace alias so reference user code ports 1:1:
+#   import paddle_tpu as fluid
+fluid = None  # set below to this module
+
+
+def _install_alias():
+    import sys
+    global fluid
+    fluid = sys.modules[__name__]
+
+
+_install_alias()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def cuda_places(device_ids=None):
+    import jax
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def device_count():
+    import jax
+    return len(jax.devices())

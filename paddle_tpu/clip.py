@@ -1,0 +1,143 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+from .framework.core import OP_ROLE_KEY, OpRole, default_main_program
+from .framework import unique_name
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            ng = block.create_var(
+                name=unique_name.generate(g.name + "_clip"),
+                dtype=g.dtype, stop_gradient=True)
+            block.append_op(type="clip", inputs={"X": [g]},
+                            outputs={"Out": [ng]},
+                            attrs={"min": self.min, "max": self.max,
+                                   OP_ROLE_KEY: OpRole.Backward})
+            out.append((p, ng))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            ng = block.create_var(
+                name=unique_name.generate(g.name + "_clip"),
+                dtype=g.dtype, stop_gradient=True)
+            block.append_op(type="clip_by_norm", inputs={"X": [g]},
+                            outputs={"Out": [ng]},
+                            attrs={"max_norm": self.clip_norm,
+                                   OP_ROLE_KEY: OpRole.Backward})
+            out.append((p, ng))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Scale all grads by clip_norm / max(global_norm, clip_norm)
+    (reference clip.py:331). Emitted as graph ops so it serializes and
+    fuses into the step program."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        block = default_main_program().global_block()
+        sq_norms = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                continue
+            sq = block.create_var(
+                name=unique_name.generate(g.name + "_sq"),
+                dtype=g.dtype, stop_gradient=True)
+            block.append_op(type="squared_l2_norm", inputs={"X": [g]},
+                            outputs={"Out": [sq]},
+                            attrs={OP_ROLE_KEY: OpRole.Backward})
+            sq_norms.append(sq)
+        if not sq_norms:
+            return params_grads
+        gsum = block.create_var(name=unique_name.generate("global_norm_sq"),
+                                dtype=sq_norms[0].dtype, stop_gradient=True)
+        block.append_op(type="sum", inputs={"X": sq_norms},
+                        outputs={"Out": [gsum]},
+                        attrs={OP_ROLE_KEY: OpRole.Backward})
+        gnorm = block.create_var(name=unique_name.generate("global_norm"),
+                                 dtype=gsum.dtype, stop_gradient=True)
+        block.append_op(type="sqrt", inputs={"X": [gsum]},
+                        outputs={"Out": [gnorm]},
+                        attrs={OP_ROLE_KEY: OpRole.Backward})
+        clip_var = block.create_var(name=unique_name.generate("clip_norm"),
+                                    dtype=gnorm.dtype, stop_gradient=True)
+        block.append_op(type="fill_constant", outputs={"Out": [clip_var]},
+                        attrs={"shape": [], "value": self.clip_norm,
+                               "dtype": gnorm.dtype,
+                               OP_ROLE_KEY: OpRole.Backward},
+                        infer_shape=False)
+        denom = block.create_var(name=unique_name.generate("clip_denom"),
+                                 dtype=gnorm.dtype, stop_gradient=True)
+        block.append_op(type="elementwise_max",
+                        inputs={"X": [gnorm], "Y": [clip_var]},
+                        outputs={"Out": [denom]},
+                        attrs={OP_ROLE_KEY: OpRole.Backward})
+        scale_var = block.create_var(name=unique_name.generate("clip_scale"),
+                                     dtype=gnorm.dtype, stop_gradient=True)
+        block.append_op(type="elementwise_div",
+                        inputs={"X": [clip_var], "Y": [denom]},
+                        outputs={"Out": [scale_var]},
+                        attrs={OP_ROLE_KEY: OpRole.Backward})
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            ng = block.create_var(
+                name=unique_name.generate(g.name + "_clip"),
+                dtype=g.dtype, stop_gradient=True)
+            block.append_op(type="elementwise_mul",
+                            inputs={"X": [g], "Y": [scale_var]},
+                            outputs={"Out": [ng]},
+                            attrs={OP_ROLE_KEY: OpRole.Backward})
+            out.append((p, ng))
+        return out
+
+
+# legacy set_gradient_clip support
+_clip_attr = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    _clip_attr["clip"] = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    clip = _clip_attr.get("clip")
+    if clip is None:
+        return params_grads
+    return clip(params_grads)
+
+
+ClipGradByValue = GradientClipByValue
+ClipGradByNorm = GradientClipByNorm
+ClipGradByGlobalNorm = GradientClipByGlobalNorm

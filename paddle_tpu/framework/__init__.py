@@ -1,0 +1,1 @@
+from . import core, dtype, unique_name  # noqa: F401

@@ -1,0 +1,219 @@
+"""Program-level autodiff: append_backward.
+
+Capability parity with the reference's Python autodiff
+(/root/reference/python/paddle/fluid/backward.py:1151 append_backward;
+grad aggregation `_addup_repetitive_outputs_`; C++ grad-op makers consumed via
+core.get_grad_op_desc at backward.py:887). TPU-first: grad ops are appended to
+the same serializable program, but their lowering defaults to jax.vjp of the
+forward lowering (registry.generic_grad_lower), so backward math is derived by
+JAX instead of hand-registered kernels.
+"""
+from collections import defaultdict
+
+from .core import OP_ROLE_KEY, OpRole, Parameter, Variable, grad_var_name
+from .dtype import is_float_dtype
+from .registry import get_op_def
+
+
+def _grad_flows(block, name, no_grad):
+    if name in no_grad:
+        return False
+    try:
+        var = block.var(name)
+    except ValueError:
+        return False
+    if var.stop_gradient:
+        return False
+    return is_float_dtype(var.dtype)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops computing d loss / d params; returns [(param, grad)].
+
+    `checkpoints`: optional list of Variables; when set, activates recompute
+    semantics (reference RecomputeOptimizer optimizer.py:3854) — on TPU this
+    maps to jax.checkpoint policies at lowering time, so here we only record
+    the checkpoint names on the program for the lowering to consume.
+    """
+    block = loss.block
+    program = block.program
+    assert block.idx == 0, "append_backward expects loss in the global block"
+    no_grad = set()
+    for n in (no_grad_set or ()):
+        no_grad.add(n.name if isinstance(n, Variable) else n)
+
+    if checkpoints:
+        program._recompute_checkpoints = [
+            c.name if isinstance(c, Variable) else c for c in checkpoints]
+
+    # ---- forward pass: which vars can carry gradient flow ----
+    flows = set()
+    for op in block.ops:
+        opdef = get_op_def(op.type)
+        if opdef.grad is False:
+            continue
+        op_in_flow = any(
+            _grad_flows(block, n, no_grad) and
+            (n in flows or _is_leaf_source(block, n))
+            for n in op.input_arg_names)
+        if op_in_flow:
+            for n in op.output_arg_names:
+                if _grad_flows(block, n, no_grad):
+                    flows.add(n)
+
+    # ---- backward pass: which grads we must compute ----
+    need = {loss.name}
+    fwd_ops = list(block.ops)
+    emit_plan = []
+    for op in reversed(fwd_ops):
+        opdef = get_op_def(op.type)
+        if opdef.grad is False:
+            continue
+        if not any(n in need for n in op.output_arg_names):
+            continue
+        diff_inputs = [n for n in op.input_arg_names
+                       if _grad_flows(block, n, no_grad) and
+                       (n in flows or _is_leaf_source(block, n))]
+        if not diff_inputs:
+            continue
+        need.update(diff_inputs)
+        emit_plan.append(op)
+
+    # ---- emit grad ops ----
+    grad_map = defaultdict(list)   # var name -> partial grad names
+    loss_grad = grad_var_name(loss.name)
+    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype,
+                     stop_gradient=True)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape or ()), "value": 1.0,
+               "dtype": loss.dtype, OP_ROLE_KEY: OpRole.Backward},
+        infer_shape=False)
+    grad_map[loss.name].append(loss_grad)
+
+    def new_partial(var_name, like_var):
+        base = grad_var_name(var_name)
+        existing = grad_map[var_name]
+        name = base if not existing else f"{base}@RENAME@{len(existing)}"
+        block.create_var(name=name, shape=like_var.shape, dtype=like_var.dtype,
+                         stop_gradient=True)
+        grad_map[var_name].append(name)
+        return name
+
+    def finalize(var_name):
+        """Collapse partial grads of var into one canonical grad var."""
+        partials = grad_map[var_name]
+        if not partials:
+            return None
+        if len(partials) == 1:
+            return partials[0]
+        out = grad_var_name(var_name)
+        block.append_op(
+            type="sum", inputs={"X": list(partials)},
+            outputs={"Out": [out]},
+            attrs={OP_ROLE_KEY: OpRole.Backward})
+        grad_map[var_name] = [out]
+        return out
+
+    for op in emit_plan:
+        # upstream grads of this op's outputs (all consumers already done).
+        # A slot's grad list is pruned of missing entries; positional
+        # alignment is carried by __out_grad_mask__.
+        g_ins = {}
+        out_grad_mask = {}
+        has_any = False
+        for slot, names in op.outputs.items():
+            gs = [finalize(n) for n in names]
+            if any(g is not None for g in gs):
+                has_any = True
+                out_grad_mask[slot] = [g is not None for g in gs]
+                g_ins[slot + "@GRAD"] = [g for g in gs if g is not None]
+        if not has_any:
+            continue
+
+        grad_inputs_req = {}
+        g_outs = {}
+        for slot, names in op.inputs.items():
+            flags = []
+            outs = []
+            for n in names:
+                ok = (_grad_flows(block, n, no_grad) and
+                      (n in flows or _is_leaf_source(block, n)) and n in need)
+                flags.append(ok)
+                outs.append(new_partial(n, block.var(n)) if ok else "@EMPTY@")
+            if any(flags):
+                grad_inputs_req[slot] = flags
+                g_outs[slot + "@GRAD"] = outs
+        if not grad_inputs_req:
+            continue
+
+        # grad op inputs = forward inputs (full, for vjp primals) + upstream
+        # grads; forward *outputs* are not needed — the vjp recomputes them
+        # and XLA CSE dedupes against the forward trace.
+        inputs = {**{s: list(ns) for s, ns in op.inputs.items()}, **g_ins}
+
+        block.append_op(
+            type=op.type + "_grad",
+            inputs=inputs,
+            outputs=g_outs,
+            attrs={
+                "__fwd_op__": op.to_dict(),
+                "__grad_inputs__": grad_inputs_req,
+                "__out_grad_mask__": out_grad_mask,
+                OP_ROLE_KEY: OpRole.Backward,
+            },
+            infer_shape=False)
+
+    # ---- collect (param, grad) pairs ----
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    params_grads = []
+    for p in params:
+        g = finalize(p.name)
+        if g is None:
+            continue
+        gvar = block.var(g)
+        params_grads.append((p, gvar))
+    program._params_grads = params_grads
+    return params_grads
+
+
+def _is_leaf_source(block, name):
+    """Leaf grad sources: trainable parameters and non-stop-gradient data."""
+    try:
+        var = block.var(name)
+    except ValueError:
+        return False
+    if isinstance(var, Parameter):
+        return var.trainable
+    return not var.stop_gradient
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients parity (reference backward.py:1527): grads of targets
+    w.r.t. arbitrary inputs."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    assert len(targets) == 1, "multiple targets not yet supported"
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "gradients(target_gradients=...) custom cotangents are not "
+            "supported yet; the seed gradient is ones")
+    loss = targets[0]
+    pg = append_backward(loss, parameter_list=None, no_grad_set=no_grad_set)
+    block = loss.block
+    outs = []
+    for iv in inputs:
+        gname = grad_var_name(iv.name)
+        if block.has_var(gname):
+            outs.append(block.var(gname))
+        else:
+            outs.append(None)
+    return outs

@@ -1,0 +1,507 @@
+"""Program IR: Program / Block / Operator / Variable.
+
+Capability parity with the reference's protobuf program IR
+(/root/reference/paddle/fluid/framework/framework.proto:42-201) and its Python
+builder (/root/reference/python/paddle/fluid/framework.py:827,1815,2384,3841),
+re-designed TPU-first: the IR is a lightweight Python structure that lowers to a
+single jaxpr/StableHLO module per (program, feed-shape) key instead of being
+interpreted op-by-op. Vars may carry mesh-axis sharding annotations
+(``dist_attr``) consumed by the GSPMD lowering — the TPU replacement for the
+reference's per-device SSA graph replication.
+"""
+import copy
+import contextlib
+
+import numpy as np
+
+from . import unique_name
+from .dtype import convert_dtype
+
+# Op role attribute, mirroring the reference's OpRole
+# (/root/reference/paddle/fluid/framework/op_proto_maker.h) so program
+# transforms (clone-for-test, AMP, DP rewrites) can classify ops.
+OP_ROLE_KEY = "op_role"
+
+
+class OpRole:
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 5
+    Loss = 0x100
+    Collective = 6
+
+
+class VarType:
+    LOD_TENSOR = "dense"          # dense tensor (LoDTensor w/o lod)
+    SELECTED_ROWS = "selected_rows"  # sparse row-set (ids, rows)
+    STEP_SCOPES = "step_scopes"
+    LOD_TENSOR_ARRAY = "tensor_array"
+    READER = "reader"
+    RAW = "raw"
+
+
+class Variable:
+    """A named tensor slot in a Block (reference: framework.py:827).
+
+    ``shape`` may contain -1 for the batch / dynamic dims; concrete shapes are
+    bound at executor compile time from the feed. ``dist_attr`` optionally
+    holds a tuple of mesh-axis names (PartitionSpec-like) for GSPMD sharding.
+    """
+
+    def __init__(self, block, name, shape=None, dtype="float32",
+                 persistable=False, stop_gradient=False, is_data=False,
+                 type=VarType.LOD_TENSOR, lod_level=0, trainable=True,
+                 initializer=None, dist_attr=None, **kwargs):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.type = type
+        self.lod_level = lod_level
+        self.trainable = trainable
+        self.initializer = initializer
+        self.dist_attr = tuple(dist_attr) if dist_attr is not None else None
+        self.is_parameter = False
+
+    # ---- convenience mirrors of fluid Variable API ----
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def astype(self, dtype):
+        from ..layers import tensor as _t
+        return _t.cast(self, dtype)
+
+    def numpy(self):
+        raise RuntimeError(
+            "Variable.numpy() is only available in dygraph mode; in static "
+            "mode fetch the variable through Executor.run.")
+
+    def __repr__(self):
+        return (f"Var(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, persistable={self.persistable})")
+
+    __str__ = __repr__
+
+    def to_dict(self):
+        return {
+            "name": self.name, "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype, "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient, "is_data": self.is_data,
+            "type": self.type, "lod_level": self.lod_level,
+            "trainable": self.trainable,
+            "dist_attr": list(self.dist_attr) if self.dist_attr else None,
+            "is_parameter": self.is_parameter,
+        }
+
+
+class Parameter(Variable):
+    """A persistable, trainable Variable with an initializer and optional
+    regularizer (reference: framework.py:4944)."""
+
+    def __init__(self, block, name, shape, dtype, initializer=None,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True, **kwargs):
+        super().__init__(block, name, shape=shape, dtype=dtype,
+                         persistable=True, stop_gradient=not trainable,
+                         trainable=trainable, initializer=initializer, **kwargs)
+        self.regularizer = regularizer
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+        self.is_parameter = True
+        self.optimize_attr = {"learning_rate": kwargs.get("learning_rate", 1.0)}
+
+
+class Operator:
+    """One op invocation: type + named input/output var-name lists + attrs
+    (reference: framework.proto:164 OpDesc, framework.py:1815)."""
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        # slot name -> list[var name]
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+        self.attrs.setdefault(OP_ROLE_KEY, OpRole.Forward)
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name, val):
+        self.attrs[name] = val
+        if self.block is not None:
+            self.block.program._bump_version()
+
+    def to_dict(self):
+        def _clean_attrs(attrs):
+            out = {}
+            for k, v in attrs.items():
+                if isinstance(v, np.ndarray):
+                    v = v.tolist()
+                out[k] = v
+            return out
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": _clean_attrs(self.attrs)}
+
+    def __repr__(self):
+        return f"Op(type={self.type}, in={self.inputs}, out={self.outputs})"
+
+
+class Block:
+    """Ordered op list + var table; nested via parent_idx for control flow
+    (reference: framework.proto:173 BlockDesc, framework.py:2384)."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = {}        # name -> Variable
+        self.ops = []         # list[Operator]
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # ---- var management ----
+    def create_var(self, name=None, **kwargs):
+        name = name or unique_name.generate("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        var = Variable(self, name, **kwargs)
+        self.vars[name] = var
+        return var
+
+    def create_parameter(self, name, shape, dtype, **kwargs):
+        param = Parameter(self, name, shape, dtype, **kwargs)
+        # parameters live in the program's global (0th) block
+        gblock = self.program.global_block()
+        gblock.vars[name] = param
+        return param
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is not None:
+            return v
+        if self.parent_block is not None:
+            return self.parent_block.var(name)
+        raise ValueError(f"Variable {name!r} not found in block {self.idx}")
+
+    def has_var(self, name):
+        try:
+            self.var(name)
+            return True
+        except ValueError:
+            return False
+
+    def has_var_recursive(self, name):
+        return self.has_var(name)
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # ---- op management ----
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        inputs = _normalize_io(inputs)
+        outputs = _normalize_io(outputs)
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self._assign_rng_seed(op)
+        self.ops.append(op)
+        self.program._bump_version()
+        if infer_shape and not self.program._skip_infer_shape:
+            from .registry import infer_op_shapes
+            infer_op_shapes(self, op)
+        return op
+
+    def _insert_op(self, index, type, inputs=None, outputs=None, attrs=None,
+                   infer_shape=True):
+        inputs = _normalize_io(inputs)
+        outputs = _normalize_io(outputs)
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self._assign_rng_seed(op)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        if infer_shape and not self.program._skip_infer_shape:
+            from .registry import infer_op_shapes
+            infer_op_shapes(self, op)
+        return op
+
+    def _assign_rng_seed(self, op):
+        """Give every stochastic op a unique per-program seed so no two ops
+        (e.g. two same-shape weight inits) share a PRNG stream. Grad ops copy
+        the forward op's seed via __fwd_op__, keeping fwd/bwd masks equal."""
+        if "__rng_seed__" in op.attrs:
+            return
+        from .registry import OPS
+        opdef = OPS.get(op.type)
+        if opdef is not None and opdef.needs_rng:
+            self.program._seed_counter += 1
+            op.attrs["__rng_seed__"] = self.program._seed_counter
+
+    def _remove_op(self, index):
+        del self.ops[index]
+        self.program._bump_version()
+
+    def to_dict(self):
+        return {
+            "idx": self.idx, "parent_idx": self.parent_idx,
+            "vars": {n: v.to_dict() for n, v in self.vars.items()},
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+
+def _normalize_io(io):
+    """Accept {slot: Variable | name | list of either} -> {slot: [names]}."""
+    out = {}
+    for slot, vals in (io or {}).items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        names = []
+        for v in vals:
+            if v is None:
+                continue
+            names.append(v.name if isinstance(v, Variable) else str(v))
+        if names:
+            out[slot] = names
+    return out
+
+
+class Program:
+    """A whole computation: list of blocks; block 0 is global
+    (reference: framework.py:3841). The two-program convention (startup program
+    initializes persistables; main program trains) is preserved."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._skip_infer_shape = False
+        self._seed_counter = 0
+        # populated by append_backward / optimizer for introspection
+        self._params_grads = []
+        self._is_test = False
+
+    # ---- versioning for executor compile cache ----
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def version(self):
+        return self._version
+
+    # ---- blocks ----
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def _create_block(self, parent_idx=None):
+        parent_idx = (self.current_block_idx
+                      if parent_idx is None else parent_idx)
+        b = Block(self, len(self.blocks), parent_idx=parent_idx)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        self._bump_version()
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    # ---- introspection ----
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            yield from blk.vars.values()
+
+    # ---- cloning / pruning ----
+    def clone(self, for_test=False):
+        """Deep-copy the program. With for_test=True, keep only Forward-role
+        ops and flip is_test attrs (reference semantics: framework.py:4188
+        _inference_optimize + clone)."""
+        p = Program()
+        p.random_seed = self.random_seed
+        p.blocks = []
+        for blk in self.blocks:
+            nb = Block(p, blk.idx, blk.parent_idx)
+            for name, var in blk.vars.items():
+                nv = copy.copy(var)
+                nv.block = nb
+                nb.vars[name] = nv
+            for op in blk.ops:
+                if for_test and (op.attrs.get(OP_ROLE_KEY, 0) & 0xFF) not in (
+                        OpRole.Forward, OpRole.Dist, OpRole.Collective):
+                    continue
+                nop = Operator(nb, op.type, op.inputs, op.outputs,
+                               copy.deepcopy(op.attrs))
+                if for_test and "is_test" in nop.attrs:
+                    nop.attrs["is_test"] = True
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        p.current_block_idx = 0
+        p._is_test = for_test
+        p._bump_version()
+        return p
+
+    def _prune(self, targets):
+        """Keep only ops needed to compute `targets` (used by
+        save_inference_model; reference framework.py:4106)."""
+        if not isinstance(targets, (list, tuple)):
+            targets = [targets]
+        needed = {t.name if isinstance(t, Variable) else t for t in targets}
+        keep = []
+        blk = self.global_block()
+        for op in reversed(blk.ops):
+            if any(n in needed for n in op.output_arg_names):
+                keep.append(op)
+                needed.update(op.input_arg_names)
+        keep.reverse()
+        p = self.clone()
+        nb = p.global_block()
+        kept_ids = {id(o) for o in keep}
+        # match by position since clone preserves op order
+        src_ops = self.global_block().ops
+        nb.ops = [nop for sop, nop in zip(src_ops, nb.ops)
+                  if id(sop) in kept_ids]
+        p._bump_version()
+        return p
+
+    # ---- serialization ----
+    def to_dict(self):
+        return {"blocks": [b.to_dict() for b in self.blocks],
+                "random_seed": self.random_seed}
+
+    @staticmethod
+    def from_dict(d):
+        p = Program()
+        p.random_seed = d.get("random_seed", 0)
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            for name, vd in bd["vars"].items():
+                vd = dict(vd)
+                is_param = vd.pop("is_parameter", False)
+                if is_param:
+                    vd.pop("persistable", None)
+                    var = Parameter(b, vd.pop("name"), vd.pop("shape"),
+                                    vd.pop("dtype"),
+                                    trainable=vd.pop("trainable", True))
+                    for k, v in vd.items():
+                        setattr(var, k, v)
+                    var.dist_attr = (tuple(var.dist_attr)
+                                     if var.dist_attr else None)
+                else:
+                    var = Variable(b, **vd)
+                b.vars[name] = var
+            for od in bd["ops"]:
+                b.ops.append(Operator(b, od["type"], od["inputs"],
+                                      od["outputs"], od["attrs"]))
+            p.blocks.append(b)
+        return p
+
+    def __repr__(self):
+        lines = []
+        for blk in self.blocks:
+            lines.append(f"-- block {blk.idx} (parent {blk.parent_idx}) --")
+            for op in blk.ops:
+                lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+# ---- global default programs + guards (reference framework.py:5150-5300) ----
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program():
+    return _main_program_
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    old = _main_program_
+    _main_program_ = program
+    return old
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    old = _startup_program_
+    _startup_program_ = program
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+# ---- Places. On TPU these are labels; data placement is governed by
+# jax.sharding (reference: platform/place.h). ----
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# CUDA alias for source compatibility with reference user code
+CUDAPlace = TPUPlace
+
+
+def grad_var_name(name):
+    return name + "@GRAD"

@@ -1,0 +1,70 @@
+"""Dtype utilities.
+
+The reference encodes dtypes as protobuf enum ints
+(/root/reference/paddle/fluid/framework/framework.proto:97-116). We keep
+canonical string names ("float32", ...) in the IR and convert at the edges.
+"""
+import numpy as np
+
+_CANONICAL = {
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "float32": "float32",
+    "float64": "float64",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "uint8": "uint8",
+    "uint32": "uint32",
+    "bool": "bool",
+    # numpy aliases
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+# Paddle VarType enum values (framework.proto:97) for serialization parity.
+_PROTO_ENUM = {
+    "bool": 0, "int16": 1, "int32": 2, "int64": 3, "float16": 4,
+    "float32": 5, "float64": 6, "uint8": 20, "int8": 21, "bfloat16": 22,
+    "uint32": 23,
+}
+_ENUM_TO_NAME = {v: k for k, v in _PROTO_ENUM.items()}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, proto enum int) to a name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _CANONICAL:
+            return _CANONICAL[dtype]
+        return str(np.dtype(dtype))
+    if isinstance(dtype, int):
+        return _ENUM_TO_NAME[dtype]
+    try:
+        return str(np.dtype(dtype))
+    except TypeError:
+        # jax dtypes like jnp.bfloat16 class
+        name = getattr(dtype, "__name__", None) or getattr(dtype, "name", None)
+        if name in _CANONICAL:
+            return _CANONICAL[name]
+        raise
+
+
+def dtype_to_proto_enum(dtype):
+    return _PROTO_ENUM[convert_dtype(dtype)]
+
+
+def is_float_dtype(dtype):
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def np_dtype(dtype):
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return np.dtype(name)

@@ -1,0 +1,156 @@
+"""Program -> JAX function lowering.
+
+TPU-native replacement for the reference's executors: instead of an op-by-op
+interpreter loop (/root/reference/paddle/fluid/framework/executor.cc:471) or an
+SSA-graph thread pool (details/fast_threaded_ssa_graph_executor.cc:54), a Block
+lowers to ONE pure function over an environment of named arrays, jit-compiled
+by XLA. Sequential in-place semantics of the reference (optimizer writes, BN
+running stats) are recovered by name rebinding in the env; persistable writes
+flow back to the Scope.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import get_op_def, normalize_outs
+
+
+class LowerCtx:
+    """State threaded through op lowerings: the env, rng base key, mesh."""
+
+    def __init__(self, program, block, env, base_key, mesh=None,
+                 abstract=False):
+        self.program = program
+        self.block = block
+        self.env = env
+        self.base_key = base_key
+        self.mesh = mesh
+        self.abstract = abstract
+
+    def op_key(self, attrs):
+        """Deterministic per-op PRNG key: fold the op's build-time seed into
+        the run key. Forward and vjp-recomputed forward fold the same seed, so
+        stochastic ops (dropout) reuse identical masks in backward."""
+        seed = attrs.get("__rng_seed__", 0)
+        user_seed = attrs.get("seed", 0)
+        if self.abstract or self.base_key is None:
+            base = jax.random.PRNGKey(user_seed or 0)
+        elif user_seed:
+            base = jax.random.PRNGKey(user_seed)
+        else:
+            base = self.base_key
+        return jax.random.fold_in(base, seed)
+
+    def sub_ctx(self, block_idx, env):
+        return LowerCtx(self.program, self.program.blocks[block_idx], env,
+                        self.base_key, mesh=self.mesh, abstract=self.abstract)
+
+    def lower_block_ops(self, block_idx, env):
+        """Run a sub-block's ops over `env` (control-flow op support)."""
+        ctx = self.sub_ctx(block_idx, env)
+        run_ops(ctx)
+        return env
+
+    def lookup(self, name):
+        return self.env.get(name)
+
+
+def run_ops(ctx):
+    """Execute (trace) every op of ctx.block over ctx.env."""
+    for op in ctx.block.ops:
+        run_op(ctx, op)
+
+
+def run_op(ctx, op):
+    opdef = get_op_def(op.type)
+    ins = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = [ctx.env[n] if n in ctx.env else _missing(ctx, n, op)
+                     for n in names]
+    raw = opdef.lower(ctx, ins, op.attrs)
+    if raw is None:
+        return
+    outs = normalize_outs(op.outputs, raw)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        for n, v in zip(names, vals):
+            if v is not None:
+                ctx.env[n] = v
+
+
+def _missing(ctx, name, op):
+    raise KeyError(
+        f"var {name!r} (input of op {op.type!r}) has no value: it was neither "
+        f"fed, produced by an earlier op, nor found in the scope")
+
+
+def analyze_block_io(program, block_idx, feed_names):
+    """Which vars a block reads from outside (scope state) and which
+    persistable vars it writes (state to store back).
+
+    Mirrors the reference's unused-var/GC analysis role
+    (framework/executor_gc_helper.cc) but for functional state threading.
+    """
+    block = program.blocks[block_idx]
+    defined = set(feed_names)
+    reads = []
+    reads_set = set()
+    writes = []
+    writes_set = set()
+
+    def visit_block(bidx, local_defined):
+        blk = program.blocks[bidx]
+        for op in blk.ops:
+            for n in op.input_arg_names:
+                if n not in local_defined and n not in reads_set:
+                    reads_set.add(n)
+                    reads.append(n)
+            for sub_attr in ("sub_block", "sub_block_true", "sub_block_false"):
+                sb = op.attrs.get(sub_attr)
+                if sb is not None:
+                    visit_block(sb, set(local_defined))
+            for n in op.output_arg_names:
+                local_defined.add(n)
+                if n not in writes_set:
+                    try:
+                        var = blk.var(n)
+                        persistable = var.persistable
+                    except ValueError:
+                        persistable = False
+                    if persistable:
+                        writes_set.add(n)
+                        writes.append(n)
+
+    visit_block(block_idx, defined)
+    return reads, writes
+
+
+def build_block_fn(program, block_idx, feed_names, fetch_names, state_in,
+                   state_out, mesh=None):
+    """Return fn(state_mut, state_ro, feed, base_key) ->
+    (fetches, new_state, new_key).
+
+    `state_mut` (read-and-updated vars: params, optimizer moments, BN stats)
+    is safe to buffer-donate; `state_ro` is read-only scope state.
+    """
+    feed_names = list(feed_names)
+    fetch_names = list(fetch_names)
+
+    def fn(state_mut, state_ro, feed, base_key):
+        env = dict(state_ro)
+        env.update(state_mut)
+        env.update(feed)
+        ctx = LowerCtx(program, program.blocks[block_idx], env, base_key,
+                       mesh=mesh)
+        run_ops(ctx)
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise KeyError(f"fetch target {n!r} was never computed")
+            fetches.append(env[n])
+        new_state = {n: env[n] for n in state_out if n in env}
+        new_key = jax.random.split(base_key, 1)[0]
+        return fetches, new_state, new_key
+
+    return fn

@@ -1,0 +1,223 @@
+"""Op registry: schema + JAX lowering + grad rule per op type.
+
+TPU-native replacement for the reference's static kernel registry
+(/root/reference/paddle/fluid/framework/op_registry.h:68,
+ /root/reference/paddle/fluid/framework/operator.h:442). Instead of per-device
+C++/CUDA kernels chosen by (place, dtype, layout) at every run
+(operator.cc:1032), each op registers ONE pure-JAX lowering function; the whole
+program is traced once and compiled by XLA, which does the fusion/layout work
+the reference does by hand.
+
+Gradients: the reference registers hand-written grad kernels plus C++
+GradOpDescMakers (/root/reference/paddle/fluid/framework/grad_op_desc_maker.h).
+Here the default grad op for type T is `T_grad`, whose lowering calls
+``jax.vjp`` on T's forward lowering — the duplicated forward computation is
+deduplicated by XLA CSE, and ops that need a bespoke backward can register a
+custom grad lowering.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtype import np_dtype
+
+# Dummy dim used in place of -1 during build-time abstract shape inference.
+# Prime so products/sums involving the batch dim stay divisible by it, and
+# large so a real static dim is essentially never a multiple of it.
+_DYN = 7919
+
+
+class OpDef:
+    def __init__(self, type, lower, grad=None, infer_shape=None,
+                 needs_rng=False, custom_grad_lower=None):
+        self.type = type
+        self.lower = lower              # (ctx, ins, attrs) -> {slot: [arr]}
+        # grad: None -> generic vjp grad; False -> non-differentiable
+        self.grad = grad
+        self.infer_shape = infer_shape  # None=generic eval_shape; False=skip; callable=custom
+        self.needs_rng = needs_rng
+        self.custom_grad_lower = custom_grad_lower
+
+
+OPS = {}
+
+
+def register_op(type, grad=None, infer_shape=None, needs_rng=False):
+    """Decorator: register `fn(ctx, ins, attrs) -> {slot: array|[arrays]}`."""
+    def deco(fn):
+        OPS[type] = OpDef(type, fn, grad=grad, infer_shape=infer_shape,
+                          needs_rng=needs_rng)
+        return fn
+    return deco
+
+
+def register_grad_lower(fwd_type):
+    """Register a custom lowering for `<fwd_type>_grad` (bespoke backward,
+    e.g. flash-attention Pallas kernels with their own VJP)."""
+    def deco(fn):
+        OPS[fwd_type].custom_grad_lower = fn
+        return fn
+    return deco
+
+
+def get_op_def(type):
+    opdef = OPS.get(type)
+    if opdef is None:
+        if type.endswith("_grad") and type[:-5] in OPS:
+            return _grad_op_def(type[:-5])
+        raise NotImplementedError(f"op {type!r} is not registered")
+    return opdef
+
+
+def has_op(type):
+    return type in OPS or (type.endswith("_grad") and type[:-5] in OPS)
+
+
+def normalize_outs(op_outputs, raw):
+    """Lowering may return {slot: arr | [arrs]}; normalize to {slot: [arrs]}."""
+    out = {}
+    for slot, v in raw.items():
+        out[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+    return out
+
+
+# --------------------------------------------------------------------------
+# Generic vjp-based grad op
+# --------------------------------------------------------------------------
+
+def _grad_op_def(fwd_type):
+    fwd_def = OPS[fwd_type]
+    if fwd_def.custom_grad_lower is not None:
+        return OpDef(fwd_type + "_grad", fwd_def.custom_grad_lower,
+                     grad=False, needs_rng=fwd_def.needs_rng)
+
+    def lower(ctx, ins, attrs):
+        return generic_grad_lower(ctx, ins, attrs, fwd_def)
+
+    return OpDef(fwd_type + "_grad", lower, grad=False,
+                 needs_rng=fwd_def.needs_rng)
+
+
+def generic_grad_lower(ctx, ins, attrs, fwd_def):
+    """Backward of any op via jax.vjp over its forward lowering.
+
+    The grad op carries the forward op spec in attrs["__fwd_op__"]; forward
+    inputs arrive under their original slot names, upstream grads under
+    "<slot>@GRAD". RNG ops stay consistent because keys derive from a
+    per-op seed attr folded into the run key (same seed in fwd and grad).
+    """
+    fwd = attrs["__fwd_op__"]
+    fwd_attrs = fwd["attrs"]
+    in_slots = [s for s in fwd["inputs"] if s in ins]
+    primals = {s: ins[s] for s in in_slots}
+    # which inputs need grads
+    req = attrs["__grad_inputs__"]  # {slot: [bool per index]}
+
+    def f(p):
+        full = dict(ins)
+        full.update(p)
+        raw = fwd_def.lower(ctx, {s: full.get(s) for s in fwd["inputs"]},
+                            fwd_attrs)
+        outs = normalize_outs(fwd["outputs"], raw)
+        # only differentiate through outputs wired in the forward op
+        return {s: outs[s] for s in fwd["outputs"] if s in outs}
+
+    diff_primals = {s: [jnp.asarray(a) for a in arrs]
+                    for s, arrs in primals.items()}
+    outs, vjp_fn = jax.vjp(f, diff_primals)
+
+    out_mask = attrs.get("__out_grad_mask__", {})
+    cts = {}
+    for slot, arrs in outs.items():
+        gs = list(ins.get(slot + "@GRAD") or [])
+        mask = out_mask.get(slot)
+        it = iter(gs)
+        lst = []
+        for i, a in enumerate(arrs):
+            has = mask[i] if mask is not None and i < len(mask) else bool(gs)
+            g = next(it, None) if has else None
+            if g is None:
+                lst.append(jnp.zeros(a.shape, a.dtype))
+            else:
+                lst.append(jnp.asarray(g, a.dtype))
+        cts[slot] = lst
+    (gprimals,) = vjp_fn(cts)
+
+    result = {}
+    for slot, flags in req.items():
+        grads = gprimals.get(slot)
+        if grads is None:
+            continue
+        vals = []
+        for i, need in enumerate(flags):
+            if not need:
+                vals.append(None)
+                continue
+            g = grads[i]
+            # float0 tangents (int inputs) -> no grad
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                vals.append(None)
+            else:
+                vals.append(g)
+        result[slot + "@GRAD"] = vals
+    return result
+
+
+# --------------------------------------------------------------------------
+# Build-time shape inference via jax.eval_shape over the lowering
+# --------------------------------------------------------------------------
+
+def infer_op_shapes(block, op):
+    """Populate output VarDesc shapes/dtypes by abstractly evaluating the
+    lowering (replaces the reference's per-op InferShape functions,
+    operator.cc:966 — but at build time, once)."""
+    opdef = get_op_def(op.type)
+    if opdef.infer_shape is False:
+        return
+    if callable(opdef.infer_shape):
+        opdef.infer_shape(block, op)
+        return
+
+    ins = {}
+    had_dynamic = False
+    for slot, names in op.inputs.items():
+        arrs = []
+        for n in names:
+            v = block.var(n)
+            if v.shape is None:
+                return  # can't infer; executor will bind real shapes
+            had_dynamic = had_dynamic or any(s == -1 for s in v.shape)
+            shape = tuple(_DYN if s == -1 else s for s in v.shape)
+            arrs.append(jax.ShapeDtypeStruct(shape, np_dtype(v.dtype)))
+        ins[slot] = arrs
+
+    from .lowering import LowerCtx
+    ctx = LowerCtx(block.program, block, env=None, base_key=None,
+                   abstract=True)
+
+    def fn(ins):
+        raw = opdef.lower(ctx, dict(ins), op.attrs)
+        return normalize_outs(op.outputs, raw)
+
+    try:
+        out_shapes = jax.eval_shape(fn, ins)
+    except Exception as e:  # pragma: no cover - surfacing build-time errors
+        raise RuntimeError(
+            f"shape inference failed for op {op.type!r} "
+            f"(inputs={{{', '.join(f'{s}: {[a.shape for a in v]}' for s, v in ins.items())}}}): {e}") from e
+
+    for slot, names in op.outputs.items():
+        shapes = out_shapes.get(slot)
+        if shapes is None:
+            continue
+        for n, sd in zip(names, shapes):
+            if sd is None:
+                continue
+            var = block.vars.get(n) or block.var(n)
+            # dims that are multiples of the dummy came from a dynamic input
+            # dim (directly or via products/sums); map them back to -1.
+            var.shape = tuple(
+                -1 if (had_dynamic and d % _DYN == 0 and d > 0) else d
+                for d in sd.shape)
+            var.dtype = str(np.dtype(sd.dtype)) if sd.dtype != jnp.bfloat16 \
+                else "bfloat16"

@@ -1,0 +1,8 @@
+"""fluid.layers-compatible namespace (reference: python/paddle/fluid/layers/)."""
+from .. import ops  # noqa: F401  (registers op lowerings)
+from .nn import *          # noqa: F401,F403
+from .tensor import *      # noqa: F401,F403
+from .loss import *        # noqa: F401,F403
+from .math import *        # noqa: F401,F403
+from . import nn, tensor, loss, math  # noqa: F401
+from .collective import _allreduce, _allgather, _broadcast  # noqa: F401

@@ -1,0 +1,31 @@
+"""Collective layer wrappers (reference:
+python/paddle/fluid/layers/collective.py — _allreduce :16, _allgather,
+_broadcast; used by transpiler/collective.py and dygraph DataParallel)."""
+from .layer_helper import LayerHelper
+
+
+def _allreduce(x, out=None, reduce_type="sum", sync_mode=False, ring_id=0):
+    helper = LayerHelper("allreduce")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type=f"c_allreduce_{reduce_type}",
+                     inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"ring_id": ring_id})
+    return out
+
+
+def _allgather(x, nranks, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("allgather")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="c_allgather", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"ring_id": ring_id, "nranks": nranks})
+    return out
+
+
+def _broadcast(x, root=0, ring_id=0, use_calc_stream=False):
+    helper = LayerHelper("broadcast")
+    helper.append_op(type="c_broadcast", inputs={"X": [x]},
+                     outputs={"Out": [x]},
+                     attrs={"ring_id": ring_id, "root": root})
+    return x

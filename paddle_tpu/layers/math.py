@@ -1,0 +1,225 @@
+"""Elementwise / reduction layer wrappers + Variable operator overloading
+(reference: python/paddle/fluid/layers/nn.py reduce_*,
+python/paddle/fluid/layers/math_op_patch.py)."""
+import numpy as np
+
+from ..framework.core import Variable
+from .layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+
+def _binary(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name, act=act)
+    if np.isscalar(y):
+        y = tensor_layers.fill_constant([1], x.dtype, float(y))
+    if np.isscalar(x):
+        x = tensor_layers.fill_constant([1], y.dtype, float(x))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return helper.append_activation(out, act)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _binary("elementwise_floordiv", x, y, axis, act, name)
+
+
+def _reduce(op_type, input, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        if isinstance(dim, int):
+            dim = [dim]
+        attrs = {"dim": list(dim), "keep_dim": keep_dim,
+                 "reduce_all": False}
+    helper.append_op(type=op_type, inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", input, dim, keep_dim, name)
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def sum(x):
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(dtype=xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(xs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype=input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(input)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="scale", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out, act)
+
+
+def _cmp(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if np.isscalar(y):
+        y = tensor_layers.fill_constant([1], x.dtype, float(y))
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(
+            dtype="bool", stop_gradient=True)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [cond]})
+    return cond
+
+
+def less_than(x, y, cond=None):
+    return _cmp("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp("not_equal", x, y, cond)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp("logical_or", x, y, out)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp("logical_xor", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype="bool", stop_gradient=True)
+    helper.append_op(type="logical_not", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+# ---- Variable operator overloading (math_op_patch parity) ----
+
+def _patch_variable():
+    def _make_binop(op_type, reverse=False):
+        def impl(self, other):
+            if reverse:
+                return _binary(op_type, other, self)
+            return _binary(op_type, self, other)
+        return impl
+
+    Variable.__add__ = _make_binop("elementwise_add")
+    Variable.__radd__ = _make_binop("elementwise_add", reverse=False)
+    Variable.__sub__ = _make_binop("elementwise_sub")
+    Variable.__rsub__ = _make_binop("elementwise_sub", reverse=True)
+    Variable.__mul__ = _make_binop("elementwise_mul")
+    Variable.__rmul__ = _make_binop("elementwise_mul", reverse=False)
+    Variable.__truediv__ = _make_binop("elementwise_div")
+    Variable.__rtruediv__ = _make_binop("elementwise_div", reverse=True)
+    Variable.__pow__ = _make_binop("elementwise_pow")
+    Variable.__mod__ = _make_binop("elementwise_mod")
+    Variable.__floordiv__ = _make_binop("elementwise_floordiv")
+    Variable.__neg__ = lambda self: scale(self, scale=-1.0)
+    Variable.__lt__ = lambda self, o: _cmp("less_than", self, o)
+    Variable.__le__ = lambda self, o: _cmp("less_equal", self, o)
+    Variable.__gt__ = lambda self, o: _cmp("greater_than", self, o)
+    Variable.__ge__ = lambda self, o: _cmp("greater_equal", self, o)
+
+
+_patch_variable()

@@ -1,0 +1,562 @@
+"""fluid.layers NN surface (reference: python/paddle/fluid/layers/nn.py —
+153 layer functions emitting ops via LayerHelper.append_op)."""
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework import initializer as init_mod
+from .layer_helper import LayerHelper
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected (reference layers/nn.py fc -> mul + elementwise_add)."""
+    helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    input_shape = input.shape
+    in_features = int(np.prod(input_shape[num_flatten_dims:]))
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[in_features, size],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="mul", inputs={"X": [input], "Y": [w]},
+        outputs={"Out": [out]},
+        attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1})
+    out = helper.append_bias_op(out, dim_start=num_flatten_dims)
+    return helper.append_activation(out, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(helper.param_attr, shape=list(size),
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="lookup_table", inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+               "is_sparse": is_sparse, "is_distributed": is_distributed})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    padding, algo = _conv_padding(padding)
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+    std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(stride), "paddings": list(padding),
+               "dilations": list(dilation), "groups": groups,
+               "padding_algorithm": algo, "data_format": data_format})
+    out = _append_channel_bias(helper, out)
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    padding, algo = _conv_padding(padding)
+    if filter_size is None:
+        raise ValueError("filter_size required (output_size-only inference "
+                         "not supported)")
+    filter_size = _pair(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(stride), "paddings": list(padding),
+               "dilations": list(dilation), "groups": groups,
+               "padding_algorithm": algo})
+    out = _append_channel_bias(helper, out)
+    return helper.append_activation(out, act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": list(_pair(pool_size)),
+               "strides": list(_pair(pool_stride)),
+               "paddings": list(_pair(pool_padding)),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": list(_pair(pool_size)),
+               "adaptive": True})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               use_global_stats=False, sync=False):
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    caxis = 1 if data_layout == "NCHW" else len(input.shape) - 1
+    c = input.shape[caxis]
+    dtype = input.dtype if input.dtype != "float16" else "float32"
+    scale = helper.create_parameter(
+        helper.param_attr, shape=[c], dtype=dtype,
+        default_initializer=init_mod.ConstantInitializer(1.0))
+    bias = helper.create_parameter(helper.bias_attr, shape=[c], dtype=dtype,
+                                   is_bias=True)
+    mean = helper.create_global_variable(
+        shape=[c], dtype=dtype, name=moving_mean_name,
+        initializer=init_mod.ConstantInitializer(0.0))
+    variance = helper.create_global_variable(
+        shape=[c], dtype=dtype, name=moving_variance_name,
+        initializer=init_mod.ConstantInitializer(1.0))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    saved_m = helper.create_variable_for_type_inference(dtype=dtype,
+                                                        stop_gradient=True)
+    saved_v = helper.create_variable_for_type_inference(dtype=dtype,
+                                                        stop_gradient=True)
+    helper.append_op(
+        type="sync_batch_norm" if sync else "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_m], "SavedVariance": [saved_v]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            helper.param_attr, shape=norm_shape, dtype=input.dtype,
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(helper.bias_attr, shape=norm_shape,
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mean = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                     stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(
+        type="layer_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    helper = LayerHelper("group_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if helper.param_attr is not False:
+        s = helper.create_parameter(
+            helper.param_attr, shape=[c], dtype=input.dtype,
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, shape=[c],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mean = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                     stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                    stop_gradient=True)
+    helper.append_op(type="group_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                     stop_gradient=True)
+    helper.append_op(
+        type="dropout", inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "seed": seed or 0,
+               "dropout_implementation": dropout_implementation})
+    return out
+
+
+def softmax(input, axis=-1, use_cudnn=False, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="log_softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def relu(x, name=None):
+    return _unary("relu", x, name)
+
+
+def sigmoid(x, name=None):
+    return _unary("sigmoid", x, name)
+
+
+def tanh(x, name=None):
+    return _unary("tanh", x, name)
+
+
+def gelu(x, approximate=False, name=None):
+    return _unary("gelu", x, name, {"approximate": approximate})
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _unary("leaky_relu", x, name, {"alpha": alpha})
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _unary("relu6", x, name, {"threshold": threshold})
+
+
+def elu(x, alpha=1.0, name=None):
+    return _unary("elu", x, name, {"alpha": alpha})
+
+
+def swish(x, beta=1.0, name=None):
+    return _unary("swish", x, name, {"beta": beta})
+
+
+def hard_swish(x, name=None):
+    return _unary("hard_swish", x, name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _unary("hard_sigmoid", x, name, {"slope": slope,
+                                            "offset": offset})
+
+
+def exp(x, name=None):
+    return _unary("exp", x, name)
+
+
+def log(x, name=None):
+    return _unary("log", x, name)
+
+
+def sqrt(x, name=None):
+    return _unary("sqrt", x, name)
+
+
+def rsqrt(x, name=None):
+    return _unary("rsqrt", x, name)
+
+
+def square(x, name=None):
+    return _unary("square", x, name)
+
+
+def abs(x, name=None):
+    return _unary("abs", x, name)
+
+
+def floor(x, name=None):
+    return _unary("floor", x, name)
+
+
+def ceil(x, name=None):
+    return _unary("ceil", x, name)
+
+
+def round(x, name=None):
+    return _unary("round", x, name)
+
+
+def sign(x, name=None):
+    return _unary("sign", x, name)
+
+
+def sin(x, name=None):
+    return _unary("sin", x, name)
+
+
+def cos(x, name=None):
+    return _unary("cos", x, name)
+
+
+def erf(x, name=None):
+    return _unary("erf", x, name)
+
+
+def softplus(x, name=None):
+    return _unary("softplus", x, name)
+
+
+def softsign(x, name=None):
+    return _unary("softsign", x, name)
+
+
+def logsigmoid(x, name=None):
+    return _unary("logsigmoid", x, name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _unary("pow", x, name, {"factor": factor})
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(
+        helper.param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=init_mod.ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="mul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"x_num_col_dims": x_num_col_dims,
+               "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def bmm(x, y, name=None):
+    helper = LayerHelper("bmm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="bmm", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    idx = helper.create_variable_for_type_inference(dtype="int64",
+                                                    stop_gradient=True)
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [out], "Indices": [idx]},
+                     attrs={"k": k})
+    return out, idx
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    _, idx = topk(input, k)
+    acc = helper.create_variable_for_type_inference(dtype="float32",
+                                                    stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [input], "Indices": [idx], "Label": [label]},
+        outputs={"Accuracy": [acc], "Correct": [correct], "Total": [total]})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        shape=[num_thresholds + 1], dtype="int64",
+        initializer=init_mod.ConstantInitializer(0))
+    stat_neg = helper.create_global_variable(
+        shape=[num_thresholds + 1], dtype="int64",
+        initializer=init_mod.ConstantInitializer(0))
+    auc_out = helper.create_variable_for_type_inference(dtype="float32",
+                                                        stop_gradient=True)
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"num_thresholds": num_thresholds, "curve": curve})
+    return auc_out, auc_out, [stat_pos, stat_neg]
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    norm = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                     stop_gradient=True)
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype=label.dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"epsilon": epsilon})
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _unary("clip", x, name, {"min": min, "max": max})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _unary("clip_by_norm", x, name, {"max_norm": max_norm})
+
+
+def image_resize(input, out_shape, resample="BILINEAR", name=None):
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = "bilinear_interp" if resample.upper() == "BILINEAR" \
+        else "nearest_interp"
+    helper.append_op(type=op, inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"out_h": out_shape[0], "out_w": out_shape[1]})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _unary("pad", x, name, {"paddings": list(paddings),
+                                   "pad_value": pad_value})
+
+
+def pad2d(x, paddings, mode="constant", pad_value=0.0, name=None):
+    return _unary("pad2d", x, name, {"paddings": list(paddings),
+                                     "mode": mode, "pad_value": pad_value})
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                       stop_gradient=True)
+    helper.append_op(type="unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def squeeze(input, axes=None, name=None):
+    helper = LayerHelper("squeeze", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                       stop_gradient=True)
+    helper.append_op(type="squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes or [])})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op(type="flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    return out
+
+
+# ---- helpers ----
+
+def _unary(op_type, x, name=None, attrs=None):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs or {})
+    return out
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+def _conv_padding(padding):
+    if isinstance(padding, str):
+        return [0, 0], padding.upper()
+    return list(_pair(padding)), "EXPLICIT"
+
+
+def _append_channel_bias(helper, out):
+    bias_attr = helper.bias_attr
+    if bias_attr is False:
+        return out
+    bias = helper.create_parameter(bias_attr, shape=[out.shape[1]],
+                                   dtype=out.dtype, is_bias=True)
+    tmp = helper.create_variable_for_type_inference(dtype=out.dtype)
+    helper.append_op(type="elementwise_add",
+                     inputs={"X": [out], "Y": [bias]},
+                     outputs={"Out": [tmp]}, attrs={"axis": 1})
+    return tmp

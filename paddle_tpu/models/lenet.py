@@ -1,0 +1,35 @@
+"""LeNet-5 for MNIST — BASELINE config 1 (static single-device training;
+reference model: /root/reference/python/paddle/fluid/tests/book/
+test_recognize_digits.py convolutional_neural_network)."""
+import paddle_tpu as fluid
+
+
+def lenet(images, label, class_num=10):
+    """Returns (avg_loss, acc, prediction)."""
+    conv1 = fluid.layers.conv2d(images, num_filters=20, filter_size=5,
+                                act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=50, filter_size=5,
+                                act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    prediction = fluid.layers.fc(pool2, size=class_num, act="softmax")
+    loss = fluid.layers.cross_entropy(prediction, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(prediction, label)
+    return avg_loss, acc, prediction
+
+
+def build_lenet_train(lr=0.001, optimizer="adam"):
+    """Build (main, startup, feeds, fetches) training programs."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        images = fluid.data("img", [-1, 1, 28, 28], "float32")
+        label = fluid.data("label", [-1, 1], "int64")
+        avg_loss, acc, pred = lenet(images, label)
+        if optimizer == "adam":
+            opt = fluid.optimizer.Adam(learning_rate=lr)
+        else:
+            opt = fluid.optimizer.SGD(learning_rate=lr)
+        opt.minimize(avg_loss)
+    return main, startup, ["img", "label"], [avg_loss, acc]

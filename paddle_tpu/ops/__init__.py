@@ -1,0 +1,10 @@
+"""Op library: importing this package registers every op lowering."""
+from . import (  # noqa: F401
+    tensor_ops,
+    math_ops,
+    activation_ops,
+    nn_ops,
+    optimizer_ops,
+    metric_ops,
+    collective_ops,
+)

@@ -1,0 +1,106 @@
+"""Activation ops.
+
+TPU-native lowerings for the reference's activation functor registry
+(/root/reference/paddle/fluid/operators/activation_op.cc — dozens of
+activations registered via functors with hand-written grads). Here each is a
+one-line jnp/jax.nn expression; XLA fuses them into surrounding matmuls on the
+VPU, and backward comes from the generic vjp path.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+def _act(name, fn, grad=None):
+    @register_op(name, grad=grad)
+    def _op(ctx, ins, attrs, _fn=fn):
+        return {"Out": _fn(x_of(ins), attrs)}
+    return _op
+
+
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("log", lambda x, a: jnp.log(x))
+_act("log2", lambda x, a: jnp.log2(x))
+_act("log10", lambda x, a: jnp.log10(x))
+_act("log1p", lambda x, a: jnp.log1p(x))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_act("square", lambda x, a: jnp.square(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("floor", lambda x, a: jnp.floor(x), grad=False)
+_act("ceil", lambda x, a: jnp.ceil(x), grad=False)
+_act("round", lambda x, a: jnp.round(x), grad=False)
+_act("sign", lambda x, a: jnp.sign(x), grad=False)
+_act("sin", lambda x, a: jnp.sin(x))
+_act("cos", lambda x, a: jnp.cos(x))
+_act("tan", lambda x, a: jnp.tan(x))
+_act("asin", lambda x, a: jnp.arcsin(x))
+_act("acos", lambda x, a: jnp.arccos(x))
+_act("atan", lambda x, a: jnp.arctan(x))
+_act("sinh", lambda x, a: jnp.sinh(x))
+_act("cosh", lambda x, a: jnp.cosh(x))
+_act("erf", lambda x, a: jax.lax.erf(x))
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: jax.nn.soft_sign(x))
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_act("softshrink", lambda x, a: jnp.where(
+    x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+    jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)))
+_act("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_act("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)))
+_act("elu", lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)))
+_act("selu", lambda x, a: jax.nn.selu(x))
+_act("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate",
+                                                           False)))
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("silu", lambda x, a: jax.nn.silu(x))
+_act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_act("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_act("hard_swish", lambda x, a: x * jnp.clip(
+    x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)) /
+    a.get("scale", 6.0))
+_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                    a.get("t_max", 24.0)))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) *
+     jnp.tanh(a.get("scale_a", 0.67) * x))
+_act("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, 0.0))
+_act("expm1", lambda x, a: jnp.expm1(x))
+
+
+@register_op("pow")
+def pow_op(ctx, ins, attrs):
+    x = x_of(ins)
+    f = ins.get("FactorTensor")
+    factor = f[0] if f else attrs.get("factor", 1.0)
+    return {"Out": jnp.power(x, factor)}
+
+
+@register_op("softmax")
+def softmax(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jax.nn.softmax(x, axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def log_softmax(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jax.nn.log_softmax(x, axis=attrs.get("axis", -1))}
+
+
+@register_op("maxout")
+def maxout(ctx, ins, attrs):
+    x = x_of(ins)
+    groups = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}

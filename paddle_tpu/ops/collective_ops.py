@@ -1,0 +1,138 @@
+"""Collective ops in the program IR.
+
+Capability parity with the reference's collective operator family
+(/root/reference/paddle/fluid/operators/collective/c_allreduce_op.h:58,
+c_allgather_op.cc, c_reducescatter_op.cc, c_broadcast_op.cc). TPU-first
+re-design: NCCL rings keyed by ring_id become *mesh axis names*; inside a
+shard_map/SPMD region the ops lower to XLA collectives riding the ICI
+(lax.psum / all_gather / psum_scatter / ppermute). Outside any mapped axis
+they are identities, matching single-process semantics. Stream-sync ops
+(c_sync_calc_stream / c_sync_comm_stream, reference
+operators/collective/c_sync_*_stream_op.cc) are no-ops: XLA owns scheduling.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+def _ring_axis(ctx, attrs):
+    """Map the reference's ring_id to a mesh axis name. Explicit
+    `axis_name` attr wins; ring 0 defaults to the data-parallel axis."""
+    name = attrs.get("axis_name")
+    if name:
+        return name
+    ring = attrs.get("ring_id", 0)
+    mesh = ctx.mesh
+    if mesh is not None:
+        names = list(mesh.axis_names)
+        if ring < len(names):
+            return names[ring] if "dp" not in names else (
+                "dp" if ring == 0 else names[ring])
+    return "dp" if ring == 0 else f"ring{ring}"
+
+
+def _axis_in_scope(axis_name):
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _c_reduce(name, op):
+    @register_op(name)
+    def _impl(ctx, ins, attrs, _op=op):
+        x = x_of(ins)
+        axis = _ring_axis(ctx, attrs)
+        if not _axis_in_scope(axis):
+            return {"Out": x}
+        return {"Out": _op(x, axis)}
+    return _impl
+
+
+_c_reduce("c_allreduce_sum", lambda x, a: jax.lax.psum(x, a))
+_c_reduce("c_allreduce_max", lambda x, a: jax.lax.pmax(x, a))
+_c_reduce("c_allreduce_min", lambda x, a: jax.lax.pmin(x, a))
+_c_reduce("c_allreduce_prod",
+          lambda x, a: jnp.exp(jax.lax.psum(jnp.log(x), a)))
+_c_reduce("allreduce", lambda x, a: jax.lax.psum(x, a))
+
+
+@register_op("c_allgather")
+def c_allgather(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = _ring_axis(ctx, attrs)
+    if not _axis_in_scope(axis):
+        return {"Out": x}
+    out = jax.lax.all_gather(x, axis)          # (n, *x.shape)
+    return {"Out": out.reshape((-1,) + x.shape[1:])}
+
+
+@register_op("c_reducescatter")
+def c_reducescatter(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = _ring_axis(ctx, attrs)
+    if not _axis_in_scope(axis):
+        return {"Out": x}
+    return {"Out": jax.lax.psum_scatter(x, axis, tiled=True)}
+
+
+@register_op("c_broadcast")
+def c_broadcast(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = _ring_axis(ctx, attrs)
+    if not _axis_in_scope(axis):
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    # broadcast = all-gather, then every rank keeps the root's slice
+    gathered = jax.lax.all_gather(x, axis)
+    return {"Out": gathered[root]}
+
+
+@register_op("broadcast")
+def broadcast(ctx, ins, attrs):
+    return c_broadcast(ctx, ins, attrs)
+
+
+@register_op("alltoall")
+def alltoall(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = _ring_axis(ctx, attrs)
+    if not _axis_in_scope(axis):
+        return {"Out": x}
+    n = jax.lax.axis_size(axis)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = jax.lax.all_to_all(xs, axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+    return {"Out": out.reshape(x.shape)}
+
+
+@register_op("c_sync_calc_stream")
+def c_sync_calc_stream(ctx, ins, attrs):
+    return {"Out": x_of(ins)}  # XLA owns stream scheduling
+
+
+@register_op("c_sync_comm_stream")
+def c_sync_comm_stream(ctx, ins, attrs):
+    return {"Out": x_of(ins)}
+
+
+@register_op("c_gen_nccl_id", grad=False, infer_shape=False)
+def c_gen_nccl_id(ctx, ins, attrs):
+    """NCCL-id RPC bootstrap (reference c_gen_nccl_id_op.cc) is unnecessary:
+    jax.distributed + the mesh give deterministic rendezvous."""
+    return None
+
+
+@register_op("c_comm_init", grad=False, infer_shape=False)
+def c_comm_init(ctx, ins, attrs):
+    return None
+
+
+@register_op("c_comm_init_all", grad=False, infer_shape=False)
+def c_comm_init_all(ctx, ins, attrs):
+    return None

@@ -1,0 +1,52 @@
+"""Shared helpers for op lowerings."""
+import jax.numpy as jnp
+
+from ..framework.dtype import np_dtype
+
+
+def x_of(ins, slot="X"):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+def as_dtype(attrs, key="dtype", default="float32"):
+    return np_dtype(attrs.get(key, default))
+
+
+def bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: Y's shape matches a contiguous slice of
+    X's shape starting at `axis` (reference:
+    operators/elementwise/elementwise_op_function.h). axis=-1 means align to
+    the trailing dims (numpy broadcasting)."""
+    if x.ndim == y.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # strip trailing size-1 dims fluid allows on Y
+    yshape = list(y.shape)
+    while len(yshape) > 0 and len(yshape) + axis > x.ndim and yshape[-1] == 1:
+        yshape.pop()
+    n_trail = x.ndim - axis - len(yshape)
+    return y.reshape(tuple(yshape) + (1,) * n_trail)
+
+
+def reduce_axes(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return tuple(range(ndim)), bool(attrs.get("keep_dim", False))
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    axes = tuple(d % ndim for d in dim)
+    return axes, bool(attrs.get("keep_dim", False))
+
+
+def normalize_padding(paddings, n_spatial):
+    """[p]*n, [ph, pw], or [ph0, ph1, pw0, pw1] -> ((lo, hi), ...)."""
+    p = list(paddings)
+    if len(p) == n_spatial:
+        return tuple((q, q) for q in p)
+    if len(p) == 2 * n_spatial:
+        return tuple((p[2 * i], p[2 * i + 1]) for i in range(n_spatial))
+    if len(p) == 1:
+        return tuple((p[0], p[0]) for _ in range(n_spatial))
+    raise ValueError(f"bad paddings {paddings}")

@@ -1,0 +1,286 @@
+"""Elementwise / matmul / reduction math ops.
+
+TPU-native lowerings for the reference's elementwise family
+(/root/reference/paddle/fluid/operators/elementwise/, with fluid's `axis`
+mid-broadcast semantics), matmul/mul
+(/root/reference/paddle/fluid/operators/matmul_op.cc, mul_op.cc) and
+reductions (/root/reference/paddle/fluid/operators/reduce_ops/). Matmuls are
+emitted as single jnp.matmul/dot_general calls so XLA tiles them onto the MXU;
+bf16 inputs hit the systolic array natively.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import x_of, bcast_y, reduce_axes
+
+
+def _ew(name, fn, grad=None):
+    @register_op(name, grad=grad)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = x_of(ins)
+        y = bcast_y(x, x_of(ins, "Y"), attrs.get("axis", -1))
+        return {"Out": _fn(x, y)}
+    return _op
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod, grad=False)
+_ew("elementwise_floordiv", jnp.floor_divide, grad=False)
+
+
+@register_op("sum")
+def sum_op(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def scale(ctx, ins, attrs):
+    x = x_of(ins)
+    s = ins.get("ScaleTensor")
+    s = s[0] if s else attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * s + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * s
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("matmul")
+def matmul(ctx, ins, attrs):
+    x, y = x_of(ins), x_of(ins, "Y")
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+@register_op("matmul_v2")
+def matmul_v2(ctx, ins, attrs):
+    x, y = x_of(ins), x_of(ins, "Y")
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return {"Out": jnp.matmul(x, y)}
+
+
+@register_op("mul")
+def mul(ctx, ins, attrs):
+    """Flattening matmul (reference operators/mul_op.cc): X flattened to 2D
+    at x_num_col_dims, Y at y_num_col_dims."""
+    x, y = x_of(ins), x_of(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xshape = x.shape
+    x2 = x.reshape(int(np.prod(xshape[:xn])), -1)
+    y2 = y.reshape(int(np.prod(y.shape[:yn])), -1)
+    out = x2 @ y2
+    return {"Out": out.reshape(xshape[:xn] + y.shape[yn:])}
+
+
+@register_op("bmm")
+def bmm(ctx, ins, attrs):
+    return {"Out": jnp.matmul(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("dot")
+def dot(ctx, ins, attrs):
+    x, y = x_of(ins), x_of(ins, "Y")
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=x.ndim == 1)}
+
+
+def _reduce(name, fn, grad=None):
+    @register_op(name, grad=grad)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = x_of(ins)
+        axes, keep = reduce_axes(attrs, x.ndim)
+        return {"Out": _fn(x, axis=axes, keepdims=keep)}
+    return _op
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, grad=False)
+_reduce("reduce_any", jnp.any, grad=False)
+
+
+@register_op("logsumexp")
+def logsumexp(ctx, ins, attrs):
+    x = x_of(ins)
+    axes, keep = reduce_axes(attrs, x.ndim)
+    return {"Out": jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep)}
+
+
+@register_op("mean")
+def mean(ctx, ins, attrs):
+    return {"Out": jnp.mean(x_of(ins))}
+
+
+@register_op("clip")
+def clip(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.clip(x, attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                      1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.sum(jnp.square(x))}
+
+
+@register_op("p_norm")
+def p_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    return {"Out": out}
+
+
+@register_op("norm")
+def norm(ctx, ins, attrs):
+    """l2_normalize (reference operators/norm_op.cc)."""
+    x = x_of(ins)
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("maximum")
+def maximum(ctx, ins, attrs):
+    return {"Out": jnp.maximum(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("minimum")
+def minimum(ctx, ins, attrs):
+    return {"Out": jnp.minimum(x_of(ins), x_of(ins, "Y"))}
+
+
+def _cmp(name, fn):
+    @register_op(name, grad=False)
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = x_of(ins)
+        y = bcast_y(x, x_of(ins, "Y"), attrs.get("axis", -1))
+        return {"Out": _fn(x, y)}
+    return _op
+
+
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+
+
+@register_op("logical_and", grad=False)
+def logical_and(ctx, ins, attrs):
+    return {"Out": jnp.logical_and(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("logical_or", grad=False)
+def logical_or(ctx, ins, attrs):
+    return {"Out": jnp.logical_or(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("logical_xor", grad=False)
+def logical_xor(ctx, ins, attrs):
+    return {"Out": jnp.logical_xor(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("logical_not", grad=False)
+def logical_not(ctx, ins, attrs):
+    return {"Out": jnp.logical_not(x_of(ins))}
+
+
+@register_op("isfinite", grad=False)
+def isfinite(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.all(jnp.isfinite(x)).reshape(1)}
+
+
+@register_op("isfinite_v2", grad=False)
+def isfinite_v2(ctx, ins, attrs):
+    return {"Out": jnp.isfinite(x_of(ins))}
+
+
+@register_op("isinf_v2", grad=False)
+def isinf_v2(ctx, ins, attrs):
+    return {"Out": jnp.isinf(x_of(ins))}
+
+
+@register_op("isnan_v2", grad=False)
+def isnan_v2(ctx, ins, attrs):
+    return {"Out": jnp.isnan(x_of(ins))}
+
+
+@register_op("kron")
+def kron(ctx, ins, attrs):
+    return {"Out": jnp.kron(x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("trace")
+def trace(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    return {"Out": jnp.trace(x, offset=attrs.get("offset", 0),
+                             axis1=attrs.get("axis1", 0),
+                             axis2=attrs.get("axis2", 1))}
+
+
+@register_op("addmm")
+def addmm(ctx, ins, attrs):
+    inp = x_of(ins, "Input")
+    x, y = x_of(ins), x_of(ins, "Y")
+    return {"Out": attrs.get("Beta", 1.0) * inp +
+            attrs.get("Alpha", 1.0) * (x @ y)}
+
+
+@register_op("cholesky")
+def cholesky(ctx, ins, attrs):
+    x = x_of(ins)
+    if attrs.get("upper", False):
+        return {"Out": jnp.linalg.cholesky(x).swapaxes(-1, -2)}
+    return {"Out": jnp.linalg.cholesky(x)}
+
+
+@register_op("inverse")
+def inverse(ctx, ins, attrs):
+    return {"Output": jnp.linalg.inv(x_of(ins, "Input"))}
+
+
+@register_op("matrix_power")
+def matrix_power(ctx, ins, attrs):
+    return {"Out": jnp.linalg.matrix_power(x_of(ins), attrs["n"])}

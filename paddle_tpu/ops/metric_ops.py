@@ -1,0 +1,55 @@
+"""Metric ops (reference: /root/reference/paddle/fluid/operators/metrics/ —
+accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+@register_op("accuracy", grad=False)
+def accuracy(ctx, ins, attrs):
+    indices = x_of(ins, "Indices")
+    label = x_of(ins, "Label")
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    hit = jnp.any(indices == label[:, None], axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(label.shape[0], jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": acc.reshape(1), "Correct": correct.reshape(1),
+            "Total": total.reshape(1)}
+
+
+@register_op("auc", grad=False)
+def auc(ctx, ins, attrs):
+    """Streaming AUC: histogram state vars thread through the functional env
+    (reference metrics/auc_op.cc keeps StatPos/StatNeg buffers in scope)."""
+    predict = x_of(ins, "Predict")
+    label = x_of(ins, "Label")
+    stat_pos = x_of(ins, "StatPos")
+    stat_neg = x_of(ins, "StatNeg")
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    if label.ndim == 2:
+        label = label[:, 0]
+    pos_prob = predict[:, -1] if predict.ndim == 2 else predict
+    bins = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                    num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    pos_hist = jnp.zeros_like(stat_pos).at[bins].add(is_pos)
+    neg_hist = jnp.zeros_like(stat_neg).at[bins].add(1 - is_pos)
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # AUC over accumulated histograms (trapezoid over thresholds, high->low)
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    denom = jnp.maximum(tot_pos * tot_neg, 1.0)
+    auc_val = (area / denom).astype(jnp.float64
+                                    if new_pos.dtype == jnp.int64
+                                    else jnp.float32)
+    return {"AUC": auc_val.reshape(1), "StatPosOut": new_pos,
+            "StatNegOut": new_neg}

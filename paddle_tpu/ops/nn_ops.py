@@ -1,0 +1,573 @@
+"""Neural-net ops: conv, pool, norms, dropout, embeddings, losses.
+
+TPU-native lowerings for the reference's dense NN operators
+(/root/reference/paddle/fluid/operators/conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, lookup_table_v2_op.cc,
+softmax_with_cross_entropy_op.cc, ...). Convs lower to
+lax.conv_general_dilated so XLA maps them onto the MXU; running-stat updates
+of batch_norm use the functional env rebinding in place of the reference's
+in-place MeanOut/VarianceOut aliasing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from ..framework.dtype import np_dtype
+from .common import x_of, normalize_padding
+
+
+# ---------------------------------------------------------------------------
+# Convolutions
+# ---------------------------------------------------------------------------
+
+def _conv_nd(x, w, attrs, n_spatial, transpose=False):
+    strides = tuple(attrs.get("strides", [1] * n_spatial))
+    dilations = tuple(attrs.get("dilations", [1] * n_spatial))
+    groups = attrs.get("groups", 1)
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        padding = "SAME"
+    elif algo == "VALID":
+        padding = "VALID"
+    else:
+        padding = normalize_padding(attrs.get("paddings", [0] * n_spatial),
+                                    n_spatial)
+    spatial = "DHW"[-n_spatial:] if n_spatial <= 3 else None
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
+    if not transpose:
+        return jax.lax.conv_general_dilated(
+            x, w, strides, padding, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+    # conv_transpose: gradient of conv wrt input
+    if padding in ("SAME", "VALID"):
+        pads = None
+    else:
+        pads = padding
+    out_pad = attrs.get("output_padding", [])
+    # paddle conv2d_transpose weight layout: (C_in, C_out/groups, kh, kw)
+    return _conv_transpose(x, w, strides, pads, dilations, groups, n_spatial,
+                           padding, out_pad)
+
+
+def _conv_transpose(x, w, strides, pads, dilations, groups, n_spatial,
+                    padding, out_pad):
+    # transposed conv = lhs-dilated conv with flipped kernel
+    kh = w.shape[2:]
+    if pads is None:
+        pads = [(0, 0)] * n_spatial if padding == "VALID" else None
+        if pads is None:
+            raise NotImplementedError(
+                "SAME padding for conv_transpose not supported; use explicit")
+    tpads = []
+    for i in range(n_spatial):
+        eff_k = (kh[i] - 1) * dilations[i] + 1
+        lo = eff_k - 1 - pads[i][0]
+        hi = eff_k - 1 - pads[i][1]
+        if out_pad:
+            hi += out_pad[i]
+        tpads.append((lo, hi))
+    # w: (Cin, Cout/groups, *k) -> flip spatial, swap io -> (Cout, Cin/groups, *k)
+    wf = jnp.flip(w, axis=tuple(range(2, 2 + n_spatial)))
+    if groups == 1:
+        wt = jnp.swapaxes(wf, 0, 1)
+    else:
+        cin, cog = w.shape[0], w.shape[1]
+        wg = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = jnp.swapaxes(wg, 1, 2).reshape((groups * cog, cin // groups) +
+                                            w.shape[2:])
+    spatial = "DHW"[-n_spatial:]
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, wt.shape, (lhs_spec, rhs_spec, lhs_spec))
+    return jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1,) * n_spatial, padding=tpads,
+        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+@register_op("conv2d")
+def conv2d(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Filter")
+    return {"Output": _conv_nd(x, w, attrs, 2)}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Filter")
+    attrs = dict(attrs)
+    attrs["groups"] = x.shape[1]
+    return {"Output": _conv_nd(x, w, attrs, 2)}
+
+
+@register_op("conv3d")
+def conv3d(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Filter")
+    return {"Output": _conv_nd(x, w, attrs, 3)}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Filter")
+    return {"Output": _conv_nd(x, w, attrs, 2, transpose=True)}
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register_op("pool2d")
+def pool2d(ctx, ins, attrs):
+    x = x_of(ins)
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    exclusive = attrs.get("exclusive", True)
+    adaptive = attrs.get("adaptive", False)
+    if attrs.get("global_pooling", False) or (
+            adaptive and ksize == [1, 1]):
+        if ptype == "max":
+            return {"Out": jnp.max(x, axis=(2, 3), keepdims=True)}
+        return {"Out": jnp.mean(x, axis=(2, 3), keepdims=True)}
+    if adaptive:
+        n, c, h, w = x.shape
+        oh, ow = ksize
+        if h % oh or w % ow:
+            raise NotImplementedError(
+                "adaptive pool needs divisible spatial dims on TPU")
+        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(xr, axis=(3, 5))}
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo in ("SAME", "VALID"):
+        pads = algo
+    else:
+        pads = ((0, 0), (0, 0)) + normalize_padding(
+            attrs.get("paddings", [0, 0]), 2)
+    window = (1, 1) + tuple(ksize)
+    wstrides = (1, 1) + tuple(strides)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides,
+                                    pads)
+        return {"Out": out}
+    ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, wstrides, pads)
+    if exclusive and pads != "VALID":
+        # divide border windows by the count of real (non-padded) elements
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides,
+                                    pads)
+        return {"Out": ssum / cnt}
+    return {"Out": ssum / float(np.prod(ksize))}
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm")
+def batch_norm(ctx, ins, attrs):
+    """Reference: operators/batch_norm_op.cc. Running stats flow through the
+    functional env (MeanOut/VarianceOut rebind the Mean/Variance names)."""
+    x = x_of(ins)
+    scale = x_of(ins, "Scale")
+    bias = x_of(ins, "Bias")
+    mean = x_of(ins, "Mean")
+    var = x_of(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    use_global = attrs.get("use_global_stats", False)
+    layout = attrs.get("data_layout", "NCHW")
+    caxis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = [1] * x.ndim
+    bshape[caxis] = x.shape[caxis]
+
+    if is_test or use_global:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+        saved_m, saved_v = mean, jax.lax.rsqrt(var + eps)
+    else:
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        mean_out = mean * momentum + m.astype(mean.dtype) * (1 - momentum)
+        var_out = var * momentum + v.astype(var.dtype) * (1 - momentum)
+        saved_m, saved_v = m, jax.lax.rsqrt(v + eps)
+    xm = (x - m.reshape(bshape).astype(x.dtype)) * \
+        jax.lax.rsqrt(v.reshape(bshape).astype(x.dtype) + eps)
+    y = xm * scale.reshape(bshape).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_m, "SavedVariance": saved_v}
+
+
+@register_op("sync_batch_norm")
+def sync_batch_norm(ctx, ins, attrs):
+    """Cross-replica BN (reference: operators/sync_batch_norm_op.cu — NCCL
+    allreduce of mean/var inside the kernel). Under GSPMD the batch axis is a
+    mesh dim, so plain jnp.mean over the batch IS the cross-replica mean —
+    XLA inserts the all-reduce. Identical lowering to batch_norm."""
+    return batch_norm(ctx, ins, attrs)
+
+
+@register_op("layer_norm")
+def layer_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    scale = x_of(ins, "Scale")
+    bias = x_of(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * begin + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * begin + norm_shape)
+    lead = x.shape[:begin]
+    return {"Y": y, "Mean": m.reshape(lead), "Variance": v.reshape(lead)}
+
+
+@register_op("instance_norm")
+def instance_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    scale = x_of(ins, "Scale")
+    bias = x_of(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "SavedMean": jnp.squeeze(m),
+            "SavedVariance": jnp.squeeze(jax.lax.rsqrt(v + eps))}
+
+
+@register_op("group_norm")
+def group_norm(ctx, ins, attrs):
+    x = x_of(ins)
+    scale = x_of(ins, "Scale")
+    bias = x_of(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs.get("groups", 1)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "Mean": jnp.squeeze(m, axis=axes),
+            "Variance": jnp.squeeze(v, axis=axes)}
+
+
+# ---------------------------------------------------------------------------
+# Dropout / embeddings
+# ---------------------------------------------------------------------------
+
+@register_op("dropout", needs_rng=True)
+def dropout(ctx, ins, attrs):
+    x = x_of(ins)
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    key = ctx.op_key(attrs)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = x * mask
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("lookup_table_v2")
+def lookup_table_v2(ctx, ins, attrs):
+    w = x_of(ins, "W")
+    ids = x_of(ins, "Ids")
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return {"Out": out}
+
+
+@register_op("lookup_table")
+def lookup_table(ctx, ins, attrs):
+    """v1: ids have trailing [,1] dim (reference operators/lookup_table_op.h)."""
+    w = x_of(ins, "W")
+    ids = x_of(ins, "Ids")
+    squeeze = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids != padding_idx)[..., None], out, 0.0)
+    return {"Out": out}
+
+
+@register_op("embedding")
+def embedding(ctx, ins, attrs):
+    return lookup_table_v2(ctx, ins, attrs)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+@register_op("cross_entropy")
+def cross_entropy(ctx, ins, attrs):
+    x = x_of(ins)  # probabilities (N, C)
+    label = x_of(ins, "Label")
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1,
+                        keepdims=True)
+        return {"Y": loss}
+    if label.ndim == x.ndim:
+        label = label[..., 0]
+    picked = jnp.take_along_axis(x, label[..., None].astype(jnp.int32),
+                                 axis=-1)
+    ignore = attrs.get("ignore_index", -100)
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    loss = jnp.where(label[..., None] == ignore, 0.0, loss)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits = x_of(ins, "Logits")
+    label = x_of(ins, "Label")
+    axis = attrs.get("axis", -1)
+    soft_label = attrs.get("soft_label", False)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lbl.astype(jnp.int32), axis), axis=axis)
+        loss = -picked
+        ignore = attrs.get("ignore_index", -100)
+        if ignore >= 0:
+            loss = jnp.where(jnp.expand_dims(lbl, axis) == ignore, 0.0, loss)
+    return {"Softmax": softmax, "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x = x_of(ins)
+    label = x_of(ins, "Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        norm = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / norm
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def square_error_cost(ctx, ins, attrs):
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx, ins, attrs):
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = jnp.abs(x - y)
+    loss = jnp.where(diff < 1.0 / s2, 0.5 * s2 * jnp.square(diff),
+                     diff - 0.5 / s2)
+    return {"Out": jnp.sum(loss, axis=-1, keepdims=True),
+            "Diff": x - y}
+
+
+@register_op("huber_loss")
+def huber_loss(ctx, ins, attrs):
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    loss = jnp.where(jnp.abs(r) <= d, 0.5 * jnp.square(r),
+                     d * (jnp.abs(r) - 0.5 * d))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def log_loss(ctx, ins, attrs):
+    p = x_of(ins, "Predicted")
+    label = x_of(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": loss}
+
+
+@register_op("bce_loss")
+def bce_loss(ctx, ins, attrs):
+    x = x_of(ins)
+    label = x_of(ins, "Label")
+    loss = -(label * jnp.log(jnp.maximum(x, 1e-12)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - x, 1e-12)))
+    return {"Out": loss}
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(ctx, ins, attrs):
+    x = x_of(ins)
+    target = x_of(ins, "Target")
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Loss": jnp.mean(loss)}
+    if red == "sum":
+        return {"Loss": jnp.sum(loss)}
+    if red == "batchmean":
+        return {"Loss": jnp.sum(loss) / x.shape[0]}
+    return {"Loss": loss}
+
+
+@register_op("mse_loss")
+def mse_loss(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    label = x_of(ins, "Label")
+    return {"Out": jnp.square(x - label)}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx, ins, attrs):
+    x1 = x_of(ins, "X1")
+    x2 = x_of(ins, "X2")
+    label = x_of(ins, "Label")
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("nll_loss")
+def nll_loss(ctx, ins, attrs):
+    x = x_of(ins)  # log-probs (N, C)
+    label = x_of(ins, "Label")
+    picked = jnp.take_along_axis(x, label[:, None].astype(jnp.int32),
+                                 axis=1)[:, 0]
+    red = attrs.get("reduction", "mean")
+    loss = -picked
+    total = jnp.asarray(x.shape[0], x.dtype)
+    if red == "mean":
+        return {"Out": jnp.mean(loss), "Total_weight": total}
+    if red == "sum":
+        return {"Out": jnp.sum(loss), "Total_weight": total}
+    return {"Out": loss, "Total_weight": total}
+
+
+# ---------------------------------------------------------------------------
+# Misc NN
+# ---------------------------------------------------------------------------
+
+@register_op("label_smooth")
+def label_smooth(ctx, ins, attrs):
+    x = x_of(ins)
+    eps = attrs.get("epsilon", 0.1)
+    dist = ins.get("PriorDist")
+    if dist:
+        out = (1 - eps) * x + eps * dist[0]
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {"Out": out}
+
+
+@register_op("interp_nearest")
+def interp_nearest(ctx, ins, attrs):
+    x = x_of(ins)
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], oh, ow), method="nearest")}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx, ins, attrs):
+    x = x_of(ins)
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")}
+
+
+@register_op("nearest_interp")
+def nearest_interp(ctx, ins, attrs):
+    return interp_nearest(ctx, ins, attrs)
+
+
+@register_op("grid_sampler")
+def grid_sampler(ctx, ins, attrs):
+    raise NotImplementedError("grid_sampler: planned Pallas kernel")
+
+
+@register_op("prelu")
+def prelu(ctx, ins, attrs):
+    x = x_of(ins)
+    alpha = x_of(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(ctx, ins, attrs):
+    x = x_of(ins)
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r,
+                                                  w * r)
+    return {"Out": out}
+
+
+@register_op("temporal_shift")
+def temporal_shift(ctx, ins, attrs):
+    x = x_of(ins)
+    seg = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    xr = x.reshape(nt // seg, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pre = jnp.pad(xr[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    post = jnp.pad(xr[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                       (0, 0)))
+    rest = xr[:, :, c2:]
+    out = jnp.concatenate([pre, post, rest], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}

@@ -1,0 +1,233 @@
+"""Optimizer update ops.
+
+TPU-native lowerings for the reference's optimizer op kernels
+(/root/reference/paddle/fluid/operators/optimizers/ — sgd_op.cc,
+momentum_op.h, adam_op.h, adamw, adagrad_op.cc, rmsprop_op.cc, lamb_op.h,
+lars_momentum_op.cc, ftrl_op.h, adadelta_op.cc, adamax_op.cc, dpsgd).
+The reference updates params in place on device; here each op returns the new
+param/accumulator values, which rebind the same var names in the functional
+env and donate back to the scope (XLA reuses the buffers — same memory
+behavior, no aliasing hazards).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+def _p(ins):
+    return x_of(ins, "Param"), x_of(ins, "Grad"), x_of(ins, "LearningRate")
+
+
+@register_op("sgd", grad=False)
+def sgd(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    return {"ParamOut": (p - lr.astype(p.dtype) * g.astype(p.dtype))}
+
+
+@register_op("momentum", grad=False)
+def momentum(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    v = x_of(ins, "Velocity")
+    mu = attrs.get("mu", 0.9)
+    lr = lr.astype(p.dtype)
+    g = g.astype(p.dtype)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("lars_momentum", grad=False)
+def lars_momentum(ctx, ins, attrs):
+    """LARS (reference optimizers/lars_momentum_op.cc): layer-adaptive lr."""
+    p, g, lr = _p(ins)
+    v = x_of(ins, "Velocity")
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 1e-9)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0),
+        coeff * pn / (gn + decay * pn + eps), 1.0)
+    lr_t = lr.astype(p.dtype) * local_lr
+    v_new = mu * v + lr_t * (g + decay * p)
+    return {"ParamOut": p - v_new, "VelocityOut": v_new}
+
+
+@register_op("adam", grad=False)
+def adam(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    m1 = x_of(ins, "Moment1")
+    m2 = x_of(ins, "Moment2")
+    b1p = x_of(ins, "Beta1Pow")
+    b2p = x_of(ins, "Beta2Pow")
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(p.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr.astype(p.dtype) * jnp.sqrt(1 - b2p.astype(p.dtype)) / \
+        (1 - b1p.astype(p.dtype))
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {"ParamOut": p_new, "Moment1Out": m1n, "Moment2Out": m2n,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamw", grad=False)
+def adamw(ctx, ins, attrs):
+    p = x_of(ins, "Param")
+    lr = x_of(ins, "LearningRate")
+    coeff = attrs.get("coeff", 0.01)
+    with_decay = attrs.get("with_decay", True)
+    outs = adam(ctx, ins, attrs)
+    if with_decay:
+        outs["ParamOut"] = outs["ParamOut"] - lr.astype(p.dtype) * coeff * p
+    return outs
+
+
+@register_op("adagrad", grad=False)
+def adagrad(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    mom = x_of(ins, "Moment")
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    mom_new = mom + jnp.square(g)
+    p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": mom_new}
+
+
+@register_op("decayed_adagrad", grad=False)
+def decayed_adagrad(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    mom = x_of(ins, "Moment")
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    mom_new = decay * mom + (1 - decay) * jnp.square(g)
+    p_new = p - lr.astype(p.dtype) * g / (jnp.sqrt(mom_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": mom_new}
+
+
+@register_op("adadelta", grad=False)
+def adadelta(ctx, ins, attrs):
+    p = x_of(ins, "Param")
+    g = x_of(ins, "Grad").astype(p.dtype)
+    avg_sq_g = x_of(ins, "AvgSquaredGrad")
+    avg_sq_u = x_of(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": asg,
+            "AvgSquaredUpdateOut": asu}
+
+
+@register_op("adamax", grad=False)
+def adamax(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    m = x_of(ins, "Moment")
+    inf_norm = x_of(ins, "InfNorm")
+    b1p = x_of(ins, "Beta1Pow")
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(p.dtype)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g))
+    lr_t = lr.astype(p.dtype) / (1 - b1p.astype(p.dtype))
+    p_new = p - lr_t * m_new / (inf_new + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new, "InfNormOut": inf_new}
+
+
+@register_op("rmsprop", grad=False)
+def rmsprop(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    ms = x_of(ins, "MeanSquare")
+    mg = x_of(ins, "MeanGrad")
+    mom = x_of(ins, "Moment")
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    g = g.astype(p.dtype)
+    lr = lr.astype(p.dtype)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - jnp.square(mg_new) + eps
+    else:
+        mg_new = mg
+        denom = ms_new + eps
+    mom_new = mu * mom + lr * g * jax.lax.rsqrt(denom)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new,
+            "MeanGradOut": mg_new, "MomentOut": mom_new}
+
+
+@register_op("ftrl", grad=False)
+def ftrl(ctx, ins, attrs):
+    p, g, lr = _p(ins)
+    sq = x_of(ins, "SquaredAccumulator")
+    lin = x_of(ins, "LinearAccumulator")
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    g = g.astype(p.dtype)
+    lr = lr.astype(p.dtype)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    x = l1 * jnp.sign(new_lin) - new_lin
+    y = jnp.power(new_sq, -power) / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(new_lin) > l1, x / y, 0.0)
+    return {"ParamOut": p_new, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": new_lin}
+
+
+@register_op("lamb", grad=False)
+def lamb(ctx, ins, attrs):
+    """LAMB (reference optimizers/lamb_op.h): layer-adaptive Adam for large
+    batches."""
+    p, g, lr = _p(ins)
+    m1 = x_of(ins, "Moment1")
+    m2 = x_of(ins, "Moment2")
+    b1p = x_of(ins, "Beta1Pow")
+    b2p = x_of(ins, "Beta2Pow")
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g = g.astype(p.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1h = m1n / (1 - b1p.astype(p.dtype))
+    m2h = m2n / (1 - b2p.astype(p.dtype))
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    p_new = p - lr.astype(p.dtype) * trust * r
+    return {"ParamOut": p_new, "Moment1Out": m1n, "Moment2Out": m2n,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("dpsgd", grad=False, needs_rng=True)
+def dpsgd(ctx, ins, attrs):
+    """Differentially-private SGD (reference optimizers/dpsgd_op.h):
+    clip per-batch grad + gaussian noise."""
+    p, g, lr = _p(ins)
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    g = g.astype(p.dtype)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
+    key = ctx.op_key(attrs)
+    noise = jax.random.normal(key, g.shape, g.dtype) * sigma * clip
+    return {"ParamOut": p - lr.astype(p.dtype) * (g * scale + noise)}

@@ -1,0 +1,509 @@
+"""Tensor creation / manipulation ops.
+
+TPU-native lowerings for the reference's fill/random/shape-manipulation
+operators (/root/reference/paddle/fluid/operators/fill_constant_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc, reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, ...). RNG ops draw deterministic per-op keys from
+the run key (see framework/lowering.LowerCtx.op_key) so forward and
+vjp-recomputed backward see identical randomness.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from ..framework.dtype import np_dtype
+from .common import x_of, as_dtype
+
+
+@register_op("fill_constant", grad=False)
+def fill_constant(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    dt = as_dtype(attrs)
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("fill_constant_batch_size_like", grad=False)
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    ref = x_of(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dt = as_dtype(attrs)
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("fill_zeros_like", grad=False)
+def fill_zeros_like(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.zeros_like(x)}
+
+
+@register_op("fill_any_like", grad=False)
+def fill_any_like(ctx, ins, attrs):
+    x = x_of(ins)
+    dt = np_dtype(attrs["dtype"]) if attrs.get("dtype") else x.dtype
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("uniform_random", grad=False, needs_rng=True)
+def uniform_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dt = as_dtype(attrs)
+    key = ctx.op_key(attrs)
+    return {"Out": jax.random.uniform(
+        key, shape, dtype=dt, minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0))}
+
+
+@register_op("gaussian_random", grad=False, needs_rng=True)
+def gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dt = as_dtype(attrs)
+    key = ctx.op_key(attrs)
+    out = jax.random.normal(key, shape, dtype=dt)
+    return {"Out": out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)}
+
+
+@register_op("truncated_gaussian_random", grad=False, needs_rng=True)
+def truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dt = as_dtype(attrs)
+    key = ctx.op_key(attrs)
+    out = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=dt)
+    return {"Out": out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)}
+
+
+@register_op("randint", grad=False, needs_rng=True)
+def randint(ctx, ins, attrs):
+    shape = tuple(int(s) for s in attrs["shape"])
+    dt = as_dtype(attrs, default="int64")
+    key = ctx.op_key(attrs)
+    return {"Out": jax.random.randint(
+        key, shape, attrs.get("low", 0), attrs.get("high", 100)).astype(dt)}
+
+
+@register_op("assign")
+def assign(ctx, ins, attrs):
+    return {"Out": x_of(ins)}
+
+
+@register_op("assign_value", grad=False)
+def assign_value(ctx, ins, attrs):
+    vals = np.asarray(attrs["values"], dtype=np_dtype(attrs["dtype"]))
+    shape = attrs.get("shape")
+    if shape:
+        vals = vals.reshape([int(s) for s in shape])
+    return {"Out": jnp.asarray(vals)}
+
+
+@register_op("cast")
+def cast(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": x.astype(np_dtype(attrs["out_dtype"]))}
+
+
+@register_op("reshape2")
+def reshape2(ctx, ins, attrs):
+    x = x_of(ins)
+    shape = list(attrs["shape"])
+    # fluid semantics: 0 -> copy dim from input; single -1 inferred
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": x.reshape(tuple(shape)),
+            "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("reshape")
+def reshape(ctx, ins, attrs):
+    x = x_of(ins)
+    shape = list(attrs["shape"])
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return {"Out": x.reshape(tuple(shape))}
+
+
+@register_op("transpose2")
+def transpose2(ctx, ins, attrs):
+    x = x_of(ins)
+    perm = attrs.get("axis", attrs.get("perm"))
+    return {"Out": jnp.transpose(x, perm),
+            "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("transpose")
+def transpose(ctx, ins, attrs):
+    x = x_of(ins)
+    perm = attrs.get("axis", attrs.get("perm"))
+    return {"Out": jnp.transpose(x, perm)}
+
+
+@register_op("concat")
+def concat(ctx, ins, attrs):
+    xs = ins["X"]
+    return {"Out": jnp.concatenate(xs, axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def split(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def stack(ctx, ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def unstack(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(a, axis=axis) for a in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+@register_op("squeeze2")
+def squeeze2(ctx, ins, attrs):
+    x = x_of(ins)
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx, ins, attrs):
+    x = x_of(ins)
+    out = x
+    for a in sorted(attrs["axes"]):
+        out = jnp.expand_dims(out, axis=a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("flatten2")
+def flatten2(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    out = x.reshape(lead, -1)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("flatten_contiguous_range")
+def flatten_contiguous_range(ctx, ins, attrs):
+    x = x_of(ins)
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    mid = int(np.prod(x.shape[start:stop + 1]))
+    shape = x.shape[:start] + (mid,) + x.shape[stop + 1:]
+    return {"Out": x.reshape(shape),
+            "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("slice")
+def slice_op(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    decrease = attrs.get("decrease_axis", [])
+    if decrease:
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def strided_slice(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("expand")
+def expand(ctx, ins, attrs):
+    x = x_of(ins)
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_v2")
+def expand_v2(ctx, ins, attrs):
+    x = x_of(ins)
+    shape = list(attrs["shape"])
+    # -1 keeps the input dim
+    xshape = (1,) * (len(shape) - x.ndim) + x.shape
+    tgt = tuple(xs if s == -1 else s for s, xs in zip(shape, xshape))
+    return {"Out": jnp.broadcast_to(x.reshape(xshape), tgt)}
+
+
+@register_op("expand_as_v2")
+def expand_as_v2(ctx, ins, attrs):
+    x = x_of(ins)
+    shape = attrs.get("target_shape")
+    if shape is None:
+        shape = ins["Y"][0].shape
+    return {"Out": jnp.broadcast_to(x, tuple(shape))}
+
+
+@register_op("tile")
+def tile(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.tile(x, attrs["repeat_times"])}
+
+
+@register_op("gather")
+def gather(ctx, ins, attrs):
+    x = x_of(ins)
+    index = x_of(ins, "Index")
+    axis = attrs.get("axis", 0)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return {"Out": jnp.take(x, index, axis=axis)}
+
+
+@register_op("gather_nd")
+def gather_nd(ctx, ins, attrs):
+    x = x_of(ins)
+    index = x_of(ins, "Index")
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": x[idx]}
+
+
+@register_op("scatter")
+def scatter(ctx, ins, attrs):
+    x = x_of(ins)
+    ids = x_of(ins, "Ids")
+    updates = x_of(ins, "Updates")
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].add(updates)
+    return {"Out": out}
+
+
+@register_op("one_hot_v2", grad=False)
+def one_hot_v2(ctx, ins, attrs):
+    x = x_of(ins)
+    depth = attrs["depth"]
+    if x.ndim >= 1 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": jax.nn.one_hot(x, depth, dtype=np_dtype(
+        attrs.get("dtype", "float32")))}
+
+
+@register_op("one_hot", grad=False)
+def one_hot(ctx, ins, attrs):
+    return one_hot_v2(ctx, ins, attrs)
+
+
+@register_op("shape", grad=False)
+def shape_op(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
+
+
+@register_op("range", grad=False)
+def range_op(ctx, ins, attrs):
+    start = attrs.get("start", 0)
+    end = attrs.get("end")
+    step = attrs.get("step", 1)
+    dt = as_dtype(attrs, default="int64")
+    return {"Out": jnp.arange(start, end, step, dtype=dt)}
+
+
+@register_op("increment")
+def increment(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}
+
+
+@register_op("cumsum")
+def cumsum(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": out}
+
+
+@register_op("where")
+def where(ctx, ins, attrs):
+    cond = x_of(ins, "Condition")
+    return {"Out": jnp.where(cond, x_of(ins), x_of(ins, "Y"))}
+
+
+@register_op("arg_max", grad=False)
+def arg_max(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(as_dtype(attrs, default="int64"))}
+
+
+@register_op("arg_min", grad=False)
+def arg_min(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmin(x, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out.astype(as_dtype(attrs, default="int64"))}
+
+
+@register_op("argsort", grad=False)
+def argsort(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", -1)
+    descending = attrs.get("descending", False)
+    key = -x if descending else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2", grad=False)
+def top_k_v2(ctx, ins, attrs):
+    x = x_of(ins)
+    k = attrs["k"]
+    axis = attrs.get("axis", -1) % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(moved, k)
+    if not attrs.get("largest", True):
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k", grad=False)
+def top_k(ctx, ins, attrs):
+    x = x_of(ins)
+    vals, idx = jax.lax.top_k(x, attrs["k"])
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("index_select")
+def index_select(ctx, ins, attrs):
+    x = x_of(ins)
+    index = x_of(ins, "Index")
+    return {"Out": jnp.take(x, index, axis=attrs.get("dim", 0))}
+
+
+@register_op("roll")
+def roll(ctx, ins, attrs):
+    x = x_of(ins)
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", None)
+    return {"Out": jnp.roll(x, shifts,
+                            axis=tuple(axis) if axis else None)}
+
+
+@register_op("flip")
+def flip(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.flip(x, axis=tuple(attrs["axis"]))}
+
+
+@register_op("pad")
+def pad(ctx, ins, attrs):
+    x = x_of(ins)
+    p = attrs["paddings"]
+    widths = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, widths,
+                           constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("pad2d")
+def pad2d(ctx, ins, attrs):
+    x = x_of(ins)
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    widths = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, widths,
+                               constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, widths, mode=jmode)}
+
+
+@register_op("meshgrid")
+def meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("tril_triu")
+def tril_triu(ctx, ins, attrs):
+    x = x_of(ins)
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, k=diag)}
+    return {"Out": jnp.triu(x, k=diag)}
+
+
+@register_op("diag_v2", grad=False)
+def diag_v2(ctx, ins, attrs):
+    x = x_of(ins)
+    return {"Out": jnp.diag(x, k=attrs.get("offset", 0))}
+
+
+@register_op("unique", grad=False, infer_shape=False)
+def unique(ctx, ins, attrs):
+    raise NotImplementedError(
+        "unique has data-dependent output shape; on TPU use "
+        "paddle_tpu.layers.unique_with_fill (static-shape variant)")
+
+
+@register_op("print")
+def print_op(ctx, ins, attrs):
+    x = x_of(ins, "In")
+    jax.debug.print(attrs.get("message", "") + " {}", x)
+    return {"Out": x}
+
+
+@register_op("feed", grad=False, infer_shape=False)
+def feed(ctx, ins, attrs):
+    return None  # executor binds feeds directly into the env
+
+
+@register_op("fetch", grad=False, infer_shape=False)
+def fetch(ctx, ins, attrs):
+    return {"Out": x_of(ins)}

@@ -1,0 +1,5 @@
+from .mesh import (  # noqa: F401
+    MeshConfig, make_mesh, set_mesh, get_mesh, default_mesh, sharding_for,
+    axis_size,
+)
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401

@@ -1,0 +1,88 @@
+"""Device mesh runtime.
+
+TPU-native replacement for the reference's NCCL ring registry
+(/root/reference/paddle/fluid/platform/collective_helper.h:62 NCCLCommContext,
+nccl_helper.h:91 NCCLContextMap, nccl_helper.h:180 multi-ring/hierarchical
+NCCLCommunicator): ONE jax.sharding.Mesh with named axes replaces every ring.
+Axis names are the framework-wide contract:
+
+  dp — data parallel        tp — tensor (model) parallel
+  pp — pipeline stages      sp — sequence/context parallel
+  ep — expert parallel
+
+Intra-slice traffic rides ICI, cross-slice DCN — both chosen by XLA from the
+same named-axis collectives, which is why there is no ring bootstrap, no
+NCCL-id RPC (c_gen_nccl_id_op.cc), and no comm/calc stream split here.
+"""
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+_current_mesh = None
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def axis_sizes(self):
+        return {"pp": self.pp, "dp": self.dp, "ep": self.ep,
+                "sp": self.sp, "tp": self.tp}
+
+
+def make_mesh(config=None, devices=None, **axes):
+    """Build a Mesh. tp/sp innermost so their collectives ride the
+    fastest ICI links; pp outermost (lowest-bandwidth axis)."""
+    if config is None:
+        config = MeshConfig(**{k: v for k, v in axes.items() if v})
+    devices = devices if devices is not None else jax.devices()
+    sizes = config.axis_sizes()
+    used = [(name, sizes[name]) for name in AXIS_ORDER if sizes[name] > 1]
+    if not used:
+        used = [("dp", 1)]
+    total = math.prod(s for _, s in used)
+    if total > len(devices):
+        raise ValueError(f"mesh needs {total} devices, have {len(devices)}")
+    dev = np.asarray(devices[:total]).reshape([s for _, s in used])
+    return Mesh(dev, tuple(n for n, _ in used))
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def default_mesh(n_devices=None):
+    """All devices on one dp axis — the ParallelExecutor-equivalent default."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.asarray(devices[:n]), ("dp",))
+
+
+def sharding_for(mesh, var):
+    """NamedSharding for a Variable from its dist_attr annotation
+    (None axes replicate)."""
+    if var is None or getattr(var, "dist_attr", None) is None:
+        return NamedSharding(mesh, P())
+    spec = tuple(a if a in mesh.axis_names else None
+                 for a in var.dist_attr)
+    return NamedSharding(mesh, P(*spec))
+
+
+def axis_size(mesh, name):
+    return mesh.shape[name] if mesh is not None and name in mesh.axis_names \
+        else 1

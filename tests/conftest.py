@@ -1,0 +1,18 @@
+"""Test config: run on a virtual 8-device CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (the driver separately dry-runs
+multi-chip via __graft_entry__.dryrun_multichip).
+
+Note: this image's sitecustomize force-registers the `axon` TPU platform and
+overrides the JAX_PLATFORMS env var; jax.config.update after import is the
+reliable way to pin the cpu backend.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

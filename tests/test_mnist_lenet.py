@@ -1,0 +1,46 @@
+"""BASELINE config 1 gate: static-graph LeNet trains end-to-end
+(reference test: python/paddle/fluid/tests/book/test_recognize_digits.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.lenet import build_lenet_train
+
+
+def _synthetic_mnist(n, seed=0):
+    """Separable synthetic digits: class k lights up a distinct patch."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=(n, 1)).astype("int64")
+    imgs = rng.randn(n, 1, 28, 28).astype("float32") * 0.1
+    for i, k in enumerate(labels[:, 0]):
+        r, c = divmod(int(k), 5)
+        imgs[i, 0, r * 10:r * 10 + 8, c * 5:c * 5 + 4] += 1.0
+    return imgs, labels
+
+
+def test_lenet_trains():
+    main, startup, feeds, fetches = build_lenet_train(lr=0.01,
+                                                      optimizer="adam")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        imgs, labels = _synthetic_mnist(256)
+        first_loss = None
+        for it in range(30):
+            i0 = (it * 64) % 256
+            l, a = exe.run(main,
+                           feed={"img": imgs[i0:i0 + 64],
+                                 "label": labels[i0:i0 + 64]},
+                           fetch_list=fetches)
+            if first_loss is None:
+                first_loss = float(l)
+        assert float(l) < first_loss * 0.5, (first_loss, float(l))
+        assert float(a) > 0.5
+
+
+def test_lenet_inference_clone():
+    main, startup, feeds, fetches = build_lenet_train()
+    test_prog = main.clone(for_test=True)
+    # optimizer ops must be stripped
+    assert all(op.type not in ("adam", "sgd") for b in test_prog.blocks
+               for op in b.ops)
