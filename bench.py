@@ -1,0 +1,63 @@
+"""Flagship benchmark: BERT-base pretrain step throughput (samples/sec/chip).
+
+BASELINE.json config 3 (ERNIE/BERT-base, Fleet-collective path in the
+reference). Anchor: published BERT-base pretrain throughput on one V100
+(fp16, seq 128) ~= 200 samples/sec — the north-star asks for >= anchor/1.2
+per chip. Prints ONE JSON line.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    platform = jax.devices()[0].platform
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    on_accel = platform in ("tpu", "gpu")
+    if on_accel:
+        cfg = bert.BertConfig.base()
+        batch, seq_len, max_preds = 64, 128, 20
+        steps, warmup = 20, 3
+    else:  # CPU smoke fallback so the bench always completes
+        cfg = bert.BertConfig.tiny()
+        batch, seq_len, max_preds = 8, 32, 5
+        steps, warmup = 5, 2
+
+    main_prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = bert.bert_pretrain(cfg, batch, seq_len, max_preds)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        opt.minimize(out["loss"])
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    loss_name = out["loss"].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = bert.random_batch(cfg, batch, seq_len, max_preds)
+        for _ in range(warmup):
+            exe.run(main_prog, feed=feed, fetch_list=[loss_name])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, = exe.run(main_prog, feed=feed, fetch_list=[loss_name])
+        dt = time.perf_counter() - t0
+    assert np.isfinite(float(loss)), "loss diverged"
+
+    value = batch * steps / dt
+    anchor = 200.0  # V100 fp16 BERT-base seq128 published per-GPU anchor
+    print(json.dumps({
+        "metric": f"bert_{'base' if on_accel else 'tiny-cpu'}_pretrain_"
+                  f"samples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(value / anchor, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,12 @@ class Executor:
 
         if mesh is not None:
             feed_arrays = _shard_feed(feed_arrays, mesh, program)
+            # write resharded arrays back so later runs see them already
+            # placed (esp. read-only params of inference programs)
+            for st in (state_mut, state_ro):
+                if _shard_state(st, mesh, program):
+                    for n, a in st.items():
+                        scope.set(n, a)
 
         fetches, new_state, new_key = jitted(state_mut, state_ro,
                                              feed_arrays, base_key)
@@ -199,12 +205,29 @@ def _jit_with_mesh(fn, mesh, program):
 
 def _batch_pspec(mesh, arr):
     from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import partition_spec
     if arr.ndim == 0:
         return P()
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
-    if arr.shape[0] % mesh.shape[axis] == 0:
-        return P(axis, *([None] * (arr.ndim - 1)))
-    return P()
+    return partition_spec(mesh, (axis,), arr.shape)
+
+
+def _shard_state(state, mesh, program):
+    """Place scope state per its Variable dist_attr (params annotated for tp
+    are split across the mesh; everything else replicates). The jitted step
+    then respects these input shardings — the GSPMD replacement for the
+    reference's BCastParamsToDevices (parallel_executor.cc:739)."""
+    from ..parallel.mesh import sharding_for
+    gblock = program.global_block()
+    changed = False
+    for n, a in state.items():
+        var = gblock.vars.get(n)
+        target = sharding_for(mesh, var)
+        if isinstance(a, jax.Array) and a.sharding == target:
+            continue
+        state[n] = jax.device_put(a, target)
+        changed = True
+    return changed
 
 
 def _shard_feed(feed_arrays, mesh, program):

@@ -23,6 +23,17 @@ def _allgather(x, nranks, ring_id=0, use_calc_stream=False):
     return out
 
 
+def shard(x, *spec):
+    """Pin `x` to a mesh sharding, one axis name (or None) per dim — the
+    declarative TPU replacement for the reference's per-device graph surgery.
+    E.g. ``shard(h, "dp", "sp", None)`` for sequence parallelism."""
+    helper = LayerHelper("sharding_constraint")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="sharding_constraint", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"spec": tuple(spec)})
+    return out
+
+
 def _broadcast(x, root=0, ring_id=0, use_calc_stream=False):
     helper = LayerHelper("broadcast")
     helper.append_op(type="c_broadcast", inputs={"X": [x]},

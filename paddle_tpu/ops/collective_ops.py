@@ -111,6 +111,24 @@ def alltoall(ctx, ins, attrs):
     return {"Out": out.reshape(x.shape)}
 
 
+@register_op("sharding_constraint")
+def sharding_constraint(ctx, ins, attrs):
+    """TPU-native primitive with no reference counterpart: pins an activation
+    to a mesh sharding (PartitionSpec given as the `spec` attr, one entry per
+    dim, None = replicate). This is how sequence parallelism ("sp" on the
+    sequence dim) and activation dp sharding are declared; GSPMD propagates
+    the rest. Identity without a mesh."""
+    x = x_of(ins)
+    mesh = ctx.mesh
+    if mesh is None:
+        return {"Out": x}
+    from jax.sharding import NamedSharding
+    from ..parallel.mesh import partition_spec
+    spec = partition_spec(mesh, attrs.get("spec", ()), x.shape)
+    return {"Out": jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))}
+
+
 @register_op("c_sync_calc_stream")
 def c_sync_calc_stream(ctx, ins, attrs):
     return {"Out": x_of(ins)}  # XLA owns stream scheduling

@@ -73,14 +73,32 @@ def default_mesh(n_devices=None):
     return Mesh(np.asarray(devices[:n]), ("dp",))
 
 
+def partition_spec(mesh, spec, shape=None):
+    """Validate a raw axis-name spec against a mesh: unknown axes replicate,
+    and (when `shape` is given) axes that don't divide their dim are dropped.
+    The single source of truth for spec sanitation — used by param placement,
+    feed sharding, and the sharding_constraint op."""
+    spec = tuple(spec or ())
+    if shape is not None:
+        spec = spec[:len(shape)] + (None,) * (len(shape) - len(spec))
+    out = []
+    for i, a in enumerate(spec):
+        if a is None or a not in mesh.axis_names:
+            out.append(None)
+        elif shape is not None and shape[i] % mesh.shape[a] != 0:
+            out.append(None)
+        else:
+            out.append(a)
+    return P(*out)
+
+
 def sharding_for(mesh, var):
     """NamedSharding for a Variable from its dist_attr annotation
     (None axes replicate)."""
     if var is None or getattr(var, "dist_attr", None) is None:
         return NamedSharding(mesh, P())
-    spec = tuple(a if a in mesh.axis_names else None
-                 for a in var.dist_attr)
-    return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, partition_spec(mesh, var.dist_attr,
+                                              getattr(var, "shape", None)))
 
 
 def axis_size(mesh, name):

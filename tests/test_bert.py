@@ -1,0 +1,43 @@
+"""BERT pretrain graph: builds, trains, loss decreases (BASELINE config 3
+counterpart of the reference's ERNIE/BERT fleet path)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import bert
+
+
+def test_bert_tiny_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    cfg = bert.BertConfig.tiny()
+    cfg.hidden_dropout = 0.0
+    cfg.attn_dropout = 0.0
+    with fluid.program_guard(main, startup):
+        out = bert.bert_pretrain(cfg, batch_size=4, seq_len=16, max_preds=3)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=3e-3)
+        opt.minimize(out["loss"])
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        losses = []
+        batch = bert.random_batch(cfg, 4, 16, 3, rng)
+        for step in range(30):
+            loss, = exe.run(main, feed=batch, fetch_list=[out["loss"]])
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        # overfits a single tiny batch
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+def test_bert_tp_annotation():
+    main = fluid.Program()
+    startup = fluid.Program()
+    cfg = bert.BertConfig.tiny()
+    with fluid.program_guard(main, startup):
+        out = bert.bert_pretrain(cfg, batch_size=2, seq_len=8, max_preds=2)
+    bert.apply_tp_sharding(main, cfg)
+    w = main.global_block().var("encoder_layer_0_multi_head_att_qkv.w_0")
+    assert w.dist_attr == (None, "tp")
