@@ -128,6 +128,14 @@ _reduce("reduce_any", jnp.any, grad=False)
 @register_op("logsumexp")
 def logsumexp(ctx, ins, attrs):
     x = x_of(ins)
+    # accept both attr spellings: dim/keep_dim (reduce_* family, the
+    # reference's python/paddle/tensor/math.py logsumexp composition) and
+    # axis/keepdim (Paddle 2.x user-facing spelling)
+    attrs = dict(attrs)
+    if "axis" in attrs:
+        attrs.setdefault("dim", attrs["axis"])
+    if "keepdim" in attrs:
+        attrs.setdefault("keep_dim", attrs["keepdim"])
     axes, keep = reduce_axes(attrs, x.ndim)
     return {"Out": jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep)}
 

@@ -1,0 +1,204 @@
+"""OpTest harness — capability parity with the reference's op unit-test
+pattern (/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170):
+a test declares `op_type`, numpy inputs/attrs and numpy-computed expected
+outputs; `check_output` runs the single op through a scratch program and
+compares; `check_grad` compares analytic gradients (append_backward over a
+one-op program, op_test.py:1452 _get_gradient) against central-difference
+numeric gradients (op_test.py:57 get_numeric_gradient, delta 5e-3).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework.core import grad_var_name
+
+
+def _as_pairs(slot, value):
+    """Normalize slot value: ndarray | (name, arr) | [(name, arr), ...]."""
+    if isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], (list, tuple)):
+        return [(n, np.asarray(a)) for n, a in value]
+    if isinstance(value, (list, tuple)) and len(value) == 2 and \
+            isinstance(value[0], str):
+        return [(value[0], np.asarray(value[1]))]
+    return [(slot.lower(), np.asarray(value))]
+
+
+class OpTest:
+    """Subclass sets: self.op_type, self.inputs, self.attrs (optional),
+    self.outputs. Call check_output() / check_grad([...], "Out")."""
+
+    op_type = None
+    inputs = None
+    outputs = None
+    attrs = None
+
+    # -- internals -------------------------------------------------------
+    def _build(self, extra_fetch=(), loss_scale=None, grad_targets=()):
+        main = fluid.Program()
+        startup = fluid.Program()
+        in_pairs = {s: _as_pairs(s, v) for s, v in (self.inputs or {}).items()}
+        out_pairs = {s: _as_pairs(s, v)
+                     for s, v in (self.outputs or {}).items()}
+        feed = {}
+        with fluid.program_guard(main, startup):
+            gb = main.global_block()
+            ins = {}
+            for slot, pairs in in_pairs.items():
+                names = []
+                for name, arr in pairs:
+                    gb.create_var(name=name, shape=arr.shape,
+                                  dtype=str(arr.dtype), is_data=True)
+                    feed[name] = arr
+                    names.append(name)
+                ins[slot] = names
+            outs = {}
+            for slot, pairs in out_pairs.items():
+                names = []
+                for name, arr in pairs:
+                    gb.create_var(name=name, shape=arr.shape,
+                                  dtype=str(arr.dtype))
+                    names.append(name)
+                outs[slot] = names
+            gb.append_op(type=self.op_type, inputs=ins, outputs=outs,
+                         attrs=dict(self.attrs or {}), infer_shape=False)
+            if loss_scale is not None:
+                from paddle_tpu.layers import math as M
+                from paddle_tpu.layers import tensor as T
+                parts = []
+                for oname, w in loss_scale:
+                    ov = gb.var(oname)
+                    prod = M.elementwise_mul(ov, T.assign(w))
+                    parts.append(M.reduce_sum(prod))
+                loss = parts[0]
+                for p in parts[1:]:
+                    loss = M.elementwise_add(loss, p)
+                from paddle_tpu.framework.backward import append_backward
+                append_backward(loss)
+        return main, startup, feed, out_pairs
+
+    def _run(self, main, startup, feed, fetch_names):
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return exe.run(main, feed=feed, fetch_list=list(fetch_names))
+
+    # -- public API ------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, feed, out_pairs = self._build()
+        names, expected = [], []
+        for slot, pairs in out_pairs.items():
+            if slot in no_check_set:
+                continue
+            for name, arr in pairs:
+                names.append(name)
+                expected.append(arr)
+        got = self._run(main, startup, feed, names)
+        for name, e, g in zip(names, expected, got):
+            if e.dtype == bool:
+                np.testing.assert_array_equal(
+                    g.astype(bool), e, err_msg=f"output {name}")
+            elif np.issubdtype(e.dtype, np.integer):
+                np.testing.assert_array_equal(g, e,
+                                              err_msg=f"output {name}")
+            else:
+                np.testing.assert_allclose(
+                    g, e, atol=atol, rtol=rtol, err_msg=f"output {name}")
+
+    def check_grad(self, inputs_to_check, output_names,
+                   max_relative_error=0.005, delta=5e-3,
+                   numeric_grad_delta=None, user_defined_grads=None):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        delta = numeric_grad_delta or delta
+        # resolve output var names (slot "Out" -> declared names)
+        out_pairs = {s: _as_pairs(s, v) for s, v in self.outputs.items()}
+        loss_outputs = []
+        for want in output_names:
+            hit = None
+            for slot, pairs in out_pairs.items():
+                for name, arr in pairs:
+                    if name == want or slot == want:
+                        hit = (name, arr)
+            assert hit, f"output {want} not found"
+            loss_outputs.append(hit)
+        rng = np.random.default_rng(42)
+        # fixed random cotangent per output; loss = sum(out * w)
+        loss_scale = [(n, rng.standard_normal(a.shape).astype(a.dtype))
+                      for n, a in loss_outputs]
+
+        main, startup, feed, _ = self._build(loss_scale=loss_scale)
+        # resolve every checked entry: a slot name expands to ALL of its
+        # sub-inputs; a var name given directly resolves to that one array
+        flat_inputs = {n: a for s, v in self.inputs.items()
+                       for n, a in _as_pairs(s, v)}
+        in_names = []
+        for want in inputs_to_check:
+            if want in flat_inputs:
+                in_names.append((want, flat_inputs[want]))
+            else:
+                in_names.extend(_as_pairs(want, self.inputs[want]))
+
+        grad_names = [grad_var_name(n) for n, _ in in_names]
+        analytic = self._run(main, startup, feed, grad_names)
+
+        if user_defined_grads is not None:
+            for (n, _), a, e in zip(in_names, analytic, user_defined_grads):
+                _assert_grad_close(a, e, n, max_relative_error)
+            return
+
+        # numeric: central differences of the same scalar loss, perturbing
+        # the feed arrays directly (owned contiguous copies)
+        loss_name = _find_loss_name(main)
+        feed = {n: np.array(a, copy=True) for n, a in feed.items()}
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+
+            def loss_at():
+                out, = exe.run(main, feed=feed, fetch_list=[loss_name])
+                return float(out)
+
+            for (name, _), a_grad in zip(in_names, analytic):
+                arr = feed[name]
+                if not np.issubdtype(arr.dtype, np.floating):
+                    continue
+                num = np.zeros(arr.size, dtype=np.float64)
+                flat = arr.reshape(-1)
+                for i in range(arr.size):
+                    orig = flat[i]
+                    flat[i] = orig + delta
+                    hi = loss_at()
+                    flat[i] = orig - delta
+                    lo = loss_at()
+                    flat[i] = orig
+                    num[i] = (hi - lo) / (2 * delta)
+                _assert_grad_close(np.asarray(a_grad).reshape(-1), num,
+                                   name, max_relative_error)
+
+
+def _find_loss_name(program):
+    """The scalar loss built by _build is the input of the first grad op
+    (fill-like seeding op) — equivalently the reduce_sum chain's last out
+    before backward ops. We find the last forward op output before any
+    *_grad op."""
+    from paddle_tpu.framework.core import OP_ROLE_KEY, OpRole
+    last = None
+    for op in program.global_block().ops:
+        role = op.attrs.get(OP_ROLE_KEY, OpRole.Forward) & 0xFF
+        if role != OpRole.Forward:
+            break
+        if op.output_arg_names:
+            last = op.output_arg_names[-1]
+    return last
+
+
+def _assert_grad_close(analytic, numeric, name, max_rel):
+    analytic = np.asarray(analytic, np.float64).reshape(-1)
+    numeric = np.asarray(numeric, np.float64).reshape(-1)
+    abs_max = max(np.abs(analytic).max(), np.abs(numeric).max(), 1e-3)
+    diff = np.abs(analytic - numeric).max() / abs_max
+    assert diff <= max_rel, (
+        f"gradient of {name}: max relative diff {diff:.5f} > {max_rel} "
+        f"(analytic {analytic[:5]}, numeric {numeric[:5]})")

@@ -1,0 +1,174 @@
+"""Op unit tests: elementwise, matmul, reductions (reference pattern:
+tests/unittests/test_elementwise_add_op.py, test_matmul_op.py,
+test_reduce_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(7)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class TestElementwiseAdd(OpTest):
+    def setup(self):
+        self.op_type = "elementwise_add"
+        x, y = _f32(3, 4), _f32(3, 4)
+        self.inputs = {"X": ("x", x), "Y": ("y", y)}
+        self.outputs = {"Out": ("out", x + y)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    def test(self):
+        x, y = _f32(2, 3, 4), _f32(3)
+        self.op_type = "elementwise_add"
+        self.inputs = {"X": ("x", x), "Y": ("y", y)}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": ("out", x + y.reshape(1, 3, 1))}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+])
+def test_elementwise_family(op, fn):
+    t = OpTest()
+    x = _f32(4, 5) + 2.0
+    y = _f32(4, 5) + 4.0
+    t.op_type = op
+    t.inputs = {"X": ("x", x), "Y": ("y", y)}
+    t.outputs = {"Out": ("out", fn(x, y))}
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_elementwise_pow():
+    t = OpTest()
+    x = np.abs(_f32(3, 4)) + 1.0
+    y = np.full((3, 4), 2.0, np.float32)
+    t.op_type = "elementwise_pow"
+    t.inputs = {"X": ("x", x), "Y": ("y", y)}
+    t.outputs = {"Out": ("out", x ** y)}
+    t.check_output(rtol=1e-4)
+
+
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul(tx, ty):
+    t = OpTest()
+    a = _f32(4, 3) if tx else _f32(3, 4)
+    b = _f32(5, 4) if ty else _f32(4, 5)
+    ref = (a.T if tx else a) @ (b.T if ty else b) * 0.5
+    t.op_type = "matmul"
+    t.inputs = {"X": ("x", a), "Y": ("y", b)}
+    t.attrs = {"transpose_X": tx, "transpose_Y": ty, "alpha": 0.5}
+    t.outputs = {"Out": ("out", ref)}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_matmul_batched():
+    t = OpTest()
+    a, b = _f32(2, 3, 4), _f32(2, 4, 5)
+    t.op_type = "matmul"
+    t.inputs = {"X": ("x", a), "Y": ("y", b)}
+    t.outputs = {"Out": ("out", a @ b)}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_mul_op():
+    t = OpTest()
+    a, b = _f32(2, 3, 4), _f32(12, 5)
+    t.op_type = "mul"
+    t.inputs = {"X": ("x", a), "Y": ("y", b)}
+    t.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+    t.outputs = {"Out": ("out", a.reshape(2, 12) @ b)}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("reduce_sum", np.sum), ("reduce_mean", np.mean),
+    ("reduce_max", np.max), ("reduce_min", np.min),
+    ("reduce_prod", np.prod),
+])
+@pytest.mark.parametrize("dim,keep", [(None, False), ([1], False),
+                                      ([0, 2], True)])
+def test_reduce_family(op, fn, dim, keep):
+    t = OpTest()
+    x = _f32(2, 3, 4) + 2.0
+    axis = tuple(dim) if dim else None
+    ref = fn(x, axis=axis, keepdims=keep)
+    t.op_type = op
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"dim": dim if dim else [], "keep_dim": keep,
+               "reduce_all": dim is None}
+    t.outputs = {"Out": ("out", np.asarray(ref, np.float32))}
+    t.check_output(rtol=1e-4)
+    if op in ("reduce_sum", "reduce_mean"):
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_logsumexp():
+    from scipy.special import logsumexp as ref_lse
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "logsumexp"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axis": [-1], "keepdim": False, "reduce_all": False}
+    t.outputs = {"Out": ("out", ref_lse(x, axis=-1).astype(np.float32))}
+    t.check_output(rtol=1e-4)
+
+
+def test_scale():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "scale"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"scale": 2.0, "bias": 1.0, "bias_after_scale": True}
+    t.outputs = {"Out": ("out", x * 2.0 + 1.0)}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_sum_multi_input():
+    t = OpTest()
+    xs = [_f32(3, 4) for _ in range(3)]
+    t.op_type = "sum"
+    t.inputs = {"X": [("x0", xs[0]), ("x1", xs[1]), ("x2", xs[2])]}
+    t.outputs = {"Out": ("out", xs[0] + xs[1] + xs[2])}
+    t.check_output()
+
+
+def test_clip():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "clip"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"min": -0.5, "max": 0.5}
+    t.outputs = {"Out": ("out", np.clip(x, -0.5, 0.5))}
+    t.check_output()
+
+
+def test_squared_l2_norm():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "squared_l2_norm"
+    t.inputs = {"X": ("x", x)}
+    t.outputs = {"Out": ("out", np.asarray((x ** 2).sum(), np.float32))}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)

@@ -1,0 +1,261 @@
+"""Op unit tests: conv/pool/norm/softmax/loss/activation families
+(reference pattern: tests/unittests/test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_activation_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(3)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def _conv2d_ref(x, w, stride, pad, dilation=1, groups=1):
+    import torch
+    import torch.nn.functional as F
+    out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), None,
+                   stride=stride, padding=pad, dilation=dilation,
+                   groups=groups)
+    return out.numpy()
+
+
+@pytest.mark.parametrize("stride,pad,groups", [(1, 0, 1), (2, 1, 1),
+                                               (1, 1, 2)])
+def test_conv2d(stride, pad, groups):
+    t = OpTest()
+    x = _f32(2, 4, 8, 8)
+    w = _f32(6, 4 // groups, 3, 3)
+    t.op_type = "conv2d"
+    t.inputs = {"Input": ("x", x), "Filter": ("w", w)}
+    t.attrs = {"strides": [stride, stride], "paddings": [pad, pad],
+               "dilations": [1, 1], "groups": groups,
+               "data_format": "NCHW"}
+    t.outputs = {"Output": ("out", _conv2d_ref(x, w, stride, pad,
+                                               groups=groups))}
+    t.check_output(atol=1e-4, rtol=1e-3)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.03)
+
+
+def test_depthwise_conv2d():
+    t = OpTest()
+    x = _f32(2, 4, 8, 8)
+    w = _f32(4, 1, 3, 3)
+    t.op_type = "depthwise_conv2d"
+    t.inputs = {"Input": ("x", x), "Filter": ("w", w)}
+    t.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 4, "data_format": "NCHW"}
+    t.outputs = {"Output": ("out", _conv2d_ref(x, w, 1, 1, groups=4))}
+    t.check_output(atol=1e-4, rtol=1e-3)
+
+
+def test_conv2d_transpose():
+    import torch
+    import torch.nn.functional as F
+    t = OpTest()
+    x = _f32(2, 4, 5, 5)
+    w = _f32(4, 3, 3, 3)  # (in, out, kh, kw)
+    ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                             stride=2, padding=1).numpy()
+    t.op_type = "conv2d_transpose"
+    t.inputs = {"Input": ("x", x), "Filter": ("w", w)}
+    t.attrs = {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 1, "data_format": "NCHW"}
+    t.outputs = {"Output": ("out", ref)}
+    t.check_output(atol=1e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool2d(ptype):
+    import torch
+    import torch.nn.functional as F
+    t = OpTest()
+    x = _f32(2, 3, 8, 8)
+    tx = torch.from_numpy(x)
+    ref = (F.max_pool2d(tx, 2, 2) if ptype == "max"
+           else F.avg_pool2d(tx, 2, 2)).numpy()
+    t.op_type = "pool2d"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"pooling_type": ptype, "ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0], "global_pooling": False,
+               "adaptive": False, "exclusive": True}
+    t.outputs = {"Out": ("out", ref)}
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+def test_pool2d_global():
+    t = OpTest()
+    x = _f32(2, 3, 6, 6)
+    t.op_type = "pool2d"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"pooling_type": "avg", "ksize": [1, 1], "strides": [1, 1],
+               "paddings": [0, 0], "global_pooling": True,
+               "adaptive": False, "exclusive": True}
+    t.outputs = {"Out": ("out", x.mean(axis=(2, 3), keepdims=True))}
+    t.check_output(rtol=1e-4)
+
+
+def test_softmax():
+    t = OpTest()
+    x = _f32(3, 5)
+    e = np.exp(x - x.max(-1, keepdims=True))
+    t.op_type = "softmax"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axis": -1}
+    t.outputs = {"Out": ("out", e / e.sum(-1, keepdims=True))}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_layer_norm():
+    t = OpTest()
+    x = _f32(3, 8)
+    scale = _f32(8)
+    bias = _f32(8)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+    t.op_type = "layer_norm"
+    t.inputs = {"X": ("x", x), "Scale": ("scale", scale),
+                "Bias": ("bias", bias)}
+    t.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+    t.outputs = {"Y": ("y", ref),
+                 "Mean": ("mean", mu.reshape(3)),
+                 "Variance": ("variance", var.reshape(3))}
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.03)
+
+
+def test_batch_norm_infer():
+    t = OpTest()
+    x = _f32(2, 3, 4, 4)
+    scale, bias = _f32(3), _f32(3)
+    mean, var = _f32(3) * 0.1, np.abs(_f32(3)) + 1.0
+    ref = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-5) * scale.reshape(1, 3, 1, 1) + \
+        bias.reshape(1, 3, 1, 1)
+    t.op_type = "batch_norm"
+    t.inputs = {"X": ("x", x), "Scale": ("scale", scale),
+                "Bias": ("bias", bias), "Mean": ("mean", mean),
+                "Variance": ("variance", var)}
+    t.attrs = {"is_test": True, "epsilon": 1e-5, "momentum": 0.9,
+               "data_layout": "NCHW"}
+    t.outputs = {"Y": ("y", ref)}
+    t.check_output(atol=1e-4, rtol=1e-3, no_check_set=(
+        "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+
+
+def test_softmax_with_cross_entropy():
+    t = OpTest()
+    logits = _f32(4, 6)
+    labels = RNG.integers(0, 6, (4, 1)).astype(np.int64)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    loss = -np.log(sm[np.arange(4), labels[:, 0]] + 1e-20)[:, None]
+    t.op_type = "softmax_with_cross_entropy"
+    t.inputs = {"Logits": ("logits", logits), "Label": ("label", labels)}
+    t.outputs = {"Loss": ("loss", loss.astype(np.float32)),
+                 "Softmax": ("softmax", sm)}
+    t.check_output(atol=1e-5, rtol=1e-4)
+    t.check_grad(["Logits"], "Loss", max_relative_error=0.02)
+
+
+def test_cross_entropy():
+    t = OpTest()
+    x = np.abs(_f32(4, 5)) + 0.1
+    x /= x.sum(-1, keepdims=True)
+    labels = RNG.integers(0, 5, (4, 1)).astype(np.int64)
+    loss = -np.log(x[np.arange(4), labels[:, 0]])[:, None]
+    t.op_type = "cross_entropy"
+    t.inputs = {"X": ("x", x), "Label": ("label", labels)}
+    t.attrs = {"soft_label": False}
+    t.outputs = {"Y": ("y", loss.astype(np.float32))}
+    t.check_output(rtol=1e-4)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    t = OpTest()
+    x = _f32(4, 5)
+    label = RNG.random((4, 5)).astype(np.float32)
+    ref = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+    t.op_type = "sigmoid_cross_entropy_with_logits"
+    t.inputs = {"X": ("x", x), "Label": ("label", label)}
+    t.outputs = {"Out": ("out", ref)}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+ACT_REFS = {
+    "relu": lambda x: np.maximum(x, 0),
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "tanh": np.tanh,
+    "exp": np.exp,
+    "square": lambda x: x * x,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+    "leaky_relu": lambda x: np.where(x > 0, x, 0.02 * x),
+    "relu6": lambda x: np.clip(x, 0, 6),
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "abs": np.abs,
+    "sin": np.sin,
+    "cos": np.cos,
+}
+
+
+@pytest.mark.parametrize("act", sorted(ACT_REFS))
+def test_activation(act):
+    t = OpTest()
+    x = _f32(3, 4) * 2.0
+    t.op_type = act
+    t.inputs = {"X": ("x", x)}
+    t.outputs = {"Out": ("out", ACT_REFS[act](x).astype(np.float32))}
+    t.check_output(rtol=1e-4, atol=1e-5)
+    if act in ("sigmoid", "tanh", "square", "softplus"):
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_gelu():
+    from scipy.stats import norm
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "gelu"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"approximate": False}
+    t.outputs = {"Out": ("out", (x * norm.cdf(x)).astype(np.float32))}
+    t.check_output(rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_table_v2():
+    t = OpTest()
+    w = _f32(10, 4)
+    ids = RNG.integers(0, 10, (3, 5)).astype(np.int64)
+    t.op_type = "lookup_table_v2"
+    t.inputs = {"W": ("w", w), "Ids": ("ids", ids)}
+    t.attrs = {"padding_idx": -1}
+    t.outputs = {"Out": ("out", w[ids])}
+    t.check_output()
+    t.check_grad(["W"], "Out", max_relative_error=0.02)
+
+
+def test_dropout_stats():
+    """Statistical check (reference test_dropout_op.py checks determinism
+    + scaling): train mode zeroes ~p and upscales survivors."""
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1000], dtype="float32")
+        y = fluid.layers.dropout(x, 0.3,
+                                 dropout_implementation="upscale_in_train")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.ones(1000, np.float32)
+        out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    kept = out != 0
+    assert 0.6 < kept.mean() < 0.8
+    np.testing.assert_allclose(out[kept], 1.0 / 0.7, rtol=1e-5)

@@ -1,0 +1,205 @@
+"""Optimizer update-op tests vs numpy reference math (reference pattern:
+tests/unittests/test_adam_op.py, test_momentum_op.py, test_sgd_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(5)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_sgd():
+    t = OpTest()
+    p, g = _f32(4, 3), _f32(4, 3)
+    lr = np.array([0.1], np.float32)
+    t.op_type = "sgd"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "LearningRate": ("lr", lr)}
+    t.outputs = {"ParamOut": ("p_out", p - 0.1 * g)}
+    t.check_output(rtol=1e-5)
+
+
+def test_momentum():
+    t = OpTest()
+    p, g, v = _f32(4), _f32(4), _f32(4)
+    lr = np.array([0.01], np.float32)
+    mu = 0.9
+    v_new = mu * v + g
+    t.op_type = "momentum"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "Velocity": ("v", v), "LearningRate": ("lr", lr)}
+    t.attrs = {"mu": mu, "use_nesterov": False}
+    t.outputs = {"ParamOut": ("p_out", p - 0.01 * v_new),
+                 "VelocityOut": ("v_out", v_new)}
+    t.check_output(rtol=1e-5)
+
+
+def test_momentum_nesterov():
+    t = OpTest()
+    p, g, v = _f32(4), _f32(4), _f32(4)
+    lr = np.array([0.01], np.float32)
+    mu = 0.9
+    v_new = mu * v + g
+    t.op_type = "momentum"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "Velocity": ("v", v), "LearningRate": ("lr", lr)}
+    t.attrs = {"mu": mu, "use_nesterov": True}
+    t.outputs = {"ParamOut": ("p_out", p - (g + mu * v_new) * 0.01),
+                 "VelocityOut": ("v_out", v_new)}
+    t.check_output(rtol=1e-5)
+
+
+def _adam_ref(p, g, m1, m2, b1p, b2p, lr, b1=0.9, b2=0.999, eps=1e-8):
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+    return p - lr_t * m1n / (np.sqrt(m2n) + eps), m1n, m2n
+
+
+def test_adam():
+    t = OpTest()
+    p, g = _f32(4, 3), _f32(4, 3)
+    m1, m2 = _f32(4, 3) * 0.1, np.abs(_f32(4, 3)) * 0.1
+    lr = np.array([0.001], np.float32)
+    b1p = np.array([0.9], np.float32)
+    b2p = np.array([0.999], np.float32)
+    p_new, m1n, m2n = _adam_ref(p, g, m1, m2, b1p, b2p, 0.001)
+    t.op_type = "adam"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "Moment1": ("m1", m1), "Moment2": ("m2", m2),
+                "Beta1Pow": ("b1p", b1p), "Beta2Pow": ("b2p", b2p),
+                "LearningRate": ("lr", lr)}
+    t.attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}
+    t.outputs = {"ParamOut": ("p_out", p_new),
+                 "Moment1Out": ("m1_out", m1n),
+                 "Moment2Out": ("m2_out", m2n),
+                 "Beta1PowOut": ("b1p_out", b1p * 0.9),
+                 "Beta2PowOut": ("b2p_out", b2p * 0.999)}
+    t.check_output(rtol=1e-4, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    t = OpTest()
+    p, g = _f32(4), _f32(4)
+    m1, m2 = np.zeros(4, np.float32), np.zeros(4, np.float32)
+    lr = np.array([0.01], np.float32)
+    b1p = np.array([0.9], np.float32)
+    b2p = np.array([0.999], np.float32)
+    p_adam, m1n, m2n = _adam_ref(p, g, m1, m2, b1p, b2p, 0.01)
+    p_new = p_adam - 0.01 * 0.05 * p
+    t.op_type = "adamw"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "Moment1": ("m1", m1), "Moment2": ("m2", m2),
+                "Beta1Pow": ("b1p", b1p), "Beta2Pow": ("b2p", b2p),
+                "LearningRate": ("lr", lr)}
+    t.attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+               "coeff": 0.05, "with_decay": True}
+    t.outputs = {"ParamOut": ("p_out", p_new)}
+    t.check_output(rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad():
+    t = OpTest()
+    p, g = _f32(4), _f32(4)
+    mom = np.abs(_f32(4))
+    lr = np.array([0.1], np.float32)
+    mom_new = mom + g * g
+    t.op_type = "adagrad"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g), "Moment": ("m", mom),
+                "LearningRate": ("lr", lr)}
+    t.attrs = {"epsilon": 1e-6}
+    t.outputs = {"ParamOut": ("p_out", p - 0.1 * g / (np.sqrt(mom_new)
+                                                      + 1e-6)),
+                 "MomentOut": ("m_out", mom_new)}
+    t.check_output(rtol=1e-4)
+
+
+def test_rmsprop():
+    t = OpTest()
+    p, g = _f32(4), _f32(4)
+    ms = np.abs(_f32(4))
+    mom = _f32(4) * 0.1
+    lr = np.array([0.01], np.float32)
+    rho, eps, mu = 0.95, 1e-6, 0.9
+    ms_new = rho * ms + (1 - rho) * g * g
+    mom_new = mu * mom + 0.01 * g / np.sqrt(ms_new + eps)
+    t.op_type = "rmsprop"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "MeanSquare": ("ms", ms), "Moment": ("mom", mom),
+                "LearningRate": ("lr", lr)}
+    t.attrs = {"decay": rho, "epsilon": eps, "momentum": mu,
+               "centered": False}
+    t.outputs = {"ParamOut": ("p_out", p - mom_new),
+                 "MeanSquareOut": ("ms_out", ms_new),
+                 "MomentOut": ("mom_out", mom_new)}
+    t.check_output(rtol=1e-4)
+
+
+def test_lamb():
+    t = OpTest()
+    p = np.abs(_f32(6)) + 0.5
+    g = _f32(6)
+    m1, m2 = np.zeros(6, np.float32), np.zeros(6, np.float32)
+    lr = np.array([0.01], np.float32)
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    b1p = np.array([b1], np.float32)
+    b2p = np.array([b2], np.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (np.sqrt(m2h) + eps) + wd * p
+    pn = np.linalg.norm(p)
+    rn = np.linalg.norm(r)
+    ratio = pn / rn if pn > 0 and rn > 0 else 1.0
+    p_new = p - 0.01 * ratio * r
+    t.op_type = "lamb"
+    t.inputs = {"Param": ("p", p), "Grad": ("g", g),
+                "Moment1": ("m1", m1), "Moment2": ("m2", m2),
+                "Beta1Pow": ("b1p", b1p), "Beta2Pow": ("b2p", b2p),
+                "LearningRate": ("lr", lr)}
+    t.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps,
+               "weight_decay": wd}
+    t.outputs = {"ParamOut": ("p_out", p_new)}
+    t.check_output(rtol=1e-3, atol=1e-6)
+
+
+def test_optimizer_classes_converge():
+    """Every optimizer class drives a tiny quadratic to lower loss
+    (install_check-style)."""
+    import paddle_tpu as fluid
+    opts = [
+        fluid.optimizer.SGDOptimizer(0.1),
+        fluid.optimizer.MomentumOptimizer(0.05, momentum=0.9),
+        fluid.optimizer.AdamOptimizer(0.1),
+        fluid.optimizer.AdamWOptimizer(0.1),
+        fluid.optimizer.AdagradOptimizer(0.3),
+        fluid.optimizer.AdadeltaOptimizer(1.0),
+        fluid.optimizer.AdamaxOptimizer(0.1),
+        fluid.optimizer.RMSPropOptimizer(0.05),
+        fluid.optimizer.LambOptimizer(0.1),
+        fluid.optimizer.LarsMomentumOptimizer(0.01, momentum=0.9),
+        fluid.optimizer.FtrlOptimizer(0.5),
+        fluid.optimizer.DecayedAdagradOptimizer(0.3),
+    ]
+    for opt in opts:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4, 8], dtype="float32")
+            y = fluid.layers.fc(x, 1)
+            loss = fluid.layers.mean(fluid.layers.square(y))
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            xv = np.ones((4, 8), np.float32)
+            first = last = None
+            for _ in range(10):
+                l, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+                first = first if first is not None else float(l)
+                last = float(l)
+        assert last < first, f"{type(opt).__name__}: {first} -> {last}"

@@ -1,0 +1,274 @@
+"""Op unit tests: tensor manipulation family (reference pattern:
+tests/unittests/test_concat_op.py, test_gather_op.py, test_slice_op.py...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(11)
+
+
+def _f32(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def test_concat():
+    t = OpTest()
+    xs = [_f32(2, 3), _f32(2, 5)]
+    t.op_type = "concat"
+    t.inputs = {"X": [("x0", xs[0]), ("x1", xs[1])]}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": ("out", np.concatenate(xs, 1))}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_split():
+    t = OpTest()
+    x = _f32(4, 6)
+    t.op_type = "split"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"num": 3, "axis": 1}
+    parts = np.split(x, 3, axis=1)
+    t.outputs = {"Out": [("o0", parts[0]), ("o1", parts[1]),
+                         ("o2", parts[2])]}
+    t.check_output()
+
+
+def test_split_sections():
+    t = OpTest()
+    x = _f32(4, 6)
+    t.op_type = "split"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"sections": [1, 2, 3], "axis": 1, "num": 0}
+    t.outputs = {"Out": [("o0", x[:, :1]), ("o1", x[:, 1:3]),
+                         ("o2", x[:, 3:])]}
+    t.check_output()
+
+
+def test_stack_unstack():
+    t = OpTest()
+    xs = [_f32(3, 4) for _ in range(3)]
+    t.op_type = "stack"
+    t.inputs = {"X": [("x0", xs[0]), ("x1", xs[1]), ("x2", xs[2])]}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Y": ("y", np.stack(xs, 1))}
+    t.check_output()
+
+
+def test_transpose_reshape():
+    t = OpTest()
+    x = _f32(2, 3, 4)
+    t.op_type = "transpose2"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axis": [2, 0, 1]}
+    t.outputs = {"Out": ("out", x.transpose(2, 0, 1)),
+                 "XShape": ("xshape", np.zeros((0, 2, 3, 4), np.float32))}
+    t.check_output(no_check_set=("XShape",))
+    t.check_grad(["X"], "Out")
+
+
+def test_gather():
+    t = OpTest()
+    x = _f32(6, 3)
+    idx = np.array([0, 2, 5], np.int64)
+    t.op_type = "gather"
+    t.inputs = {"X": ("x", x), "Index": ("index", idx)}
+    t.outputs = {"Out": ("out", x[idx])}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_gather_nd():
+    t = OpTest()
+    x = _f32(3, 4, 5)
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    t.op_type = "gather_nd"
+    t.inputs = {"X": ("x", x), "Index": ("index", idx)}
+    t.outputs = {"Out": ("out", x[idx[:, 0], idx[:, 1]])}
+    t.check_output()
+
+
+def test_scatter():
+    t = OpTest()
+    x = _f32(6, 3)
+    idx = np.array([1, 4], np.int64)
+    upd = _f32(2, 3)
+    ref = x.copy()
+    ref[idx] = upd
+    t.op_type = "scatter"
+    t.inputs = {"X": ("x", x), "Ids": ("ids", idx),
+                "Updates": ("updates", upd)}
+    t.attrs = {"overwrite": True}
+    t.outputs = {"Out": ("out", ref)}
+    t.check_output()
+
+
+def test_slice():
+    t = OpTest()
+    x = _f32(4, 5, 6)
+    t.op_type = "slice"
+    t.inputs = {"Input": ("x", x)}
+    t.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [3, 5]}
+    t.outputs = {"Out": ("out", x[1:3, :, 2:5])}
+    t.check_output()
+    t.check_grad(["Input"], "Out")
+
+
+def test_strided_slice():
+    t = OpTest()
+    x = _f32(6, 8)
+    t.op_type = "strided_slice"
+    t.inputs = {"Input": ("x", x)}
+    t.attrs = {"axes": [0, 1], "starts": [0, 1], "ends": [6, 7],
+               "strides": [2, 3]}
+    t.outputs = {"Out": ("out", x[0:6:2, 1:7:3])}
+    t.check_output()
+
+
+def test_expand():
+    t = OpTest()
+    x = _f32(1, 3)
+    t.op_type = "expand"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"expand_times": [2, 2]}
+    t.outputs = {"Out": ("out", np.tile(x, (2, 2)))}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_tile():
+    t = OpTest()
+    x = _f32(2, 3)
+    t.op_type = "tile"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"repeat_times": [2, 1]}
+    t.outputs = {"Out": ("out", np.tile(x, (2, 1)))}
+    t.check_output()
+
+
+def test_pad():
+    t = OpTest()
+    x = _f32(2, 3)
+    t.op_type = "pad"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"paddings": [1, 0, 0, 2], "pad_value": 0.5}
+    t.outputs = {"Out": ("out", np.pad(
+        x, ((1, 0), (0, 2)), constant_values=0.5))}
+    t.check_output()
+    t.check_grad(["X"], "Out")
+
+
+def test_one_hot_v2():
+    t = OpTest()
+    ids = np.array([[1], [0], [3]], np.int64)
+    ref = np.eye(4, dtype=np.float32)[ids[:, 0]]
+    t.op_type = "one_hot_v2"
+    t.inputs = {"X": ("x", ids)}
+    t.attrs = {"depth": 4}
+    t.outputs = {"Out": ("out", ref)}
+    t.check_output()
+
+
+def test_where():
+    t = OpTest()
+    c = np.array([[True, False], [False, True]])
+    x, y = _f32(2, 2), _f32(2, 2)
+    t.op_type = "where"
+    t.inputs = {"Condition": ("c", c), "X": ("x", x), "Y": ("y", y)}
+    t.outputs = {"Out": ("out", np.where(c, x, y))}
+    t.check_output()
+
+
+def test_cumsum():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "cumsum"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": ("out", np.cumsum(x, 1))}
+    t.check_output(rtol=1e-4)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_top_k():
+    t = OpTest()
+    x = _f32(3, 6)
+    k = 2
+    idx = np.argsort(-x, 1)[:, :k]
+    vals = np.take_along_axis(x, idx, 1)
+    t.op_type = "top_k"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"k": k}
+    t.outputs = {"Out": ("out", vals),
+                 "Indices": ("indices", idx.astype(np.int64))}
+    t.check_output()
+
+
+def test_arg_max_min():
+    for op, fn in (("arg_max", np.argmax), ("arg_min", np.argmin)):
+        t = OpTest()
+        x = _f32(3, 5)
+        t.op_type = op
+        t.inputs = {"X": ("x", x)}
+        t.attrs = {"axis": 1}
+        t.outputs = {"Out": ("out", fn(x, 1).astype(np.int64))}
+        t.check_output()
+
+
+def test_cast():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "cast"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"in_dtype": "float32", "out_dtype": "int32"}
+    t.outputs = {"Out": ("out", x.astype(np.int32))}
+    t.check_output()
+
+
+def test_fill_constant_batch_size_like():
+    t = OpTest()
+    x = _f32(5, 3)
+    t.op_type = "fill_constant_batch_size_like"
+    t.inputs = {"Input": ("x", x)}
+    t.attrs = {"shape": [-1, 4], "value": 2.5, "dtype": "float32",
+               "input_dim_idx": 0, "output_dim_idx": 0}
+    t.outputs = {"Out": ("out", np.full((5, 4), 2.5, np.float32))}
+    t.check_output()
+
+
+def test_flip_roll():
+    t = OpTest()
+    x = _f32(3, 4)
+    t.op_type = "flip"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axis": [1]}
+    t.outputs = {"Out": ("out", np.flip(x, 1))}
+    t.check_output()
+
+    t2 = OpTest()
+    t2.op_type = "roll"
+    t2.inputs = {"X": ("x", x)}
+    t2.attrs = {"shifts": [1], "axis": [0]}
+    t2.outputs = {"Out": ("out", np.roll(x, 1, 0))}
+    t2.check_output()
+
+
+def test_squeeze_unsqueeze():
+    t = OpTest()
+    x = _f32(3, 1, 4)
+    t.op_type = "squeeze2"
+    t.inputs = {"X": ("x", x)}
+    t.attrs = {"axes": [1]}
+    t.outputs = {"Out": ("out", x.reshape(3, 4)),
+                 "XShape": ("xs", np.zeros((0, 3, 1, 4), np.float32))}
+    t.check_output(no_check_set=("XShape",))
+
+    t2 = OpTest()
+    y = _f32(3, 4)
+    t2.op_type = "unsqueeze2"
+    t2.inputs = {"X": ("x", y)}
+    t2.attrs = {"axes": [0, 2]}
+    t2.outputs = {"Out": ("out", y.reshape(1, 3, 1, 4)),
+                  "XShape": ("xs", np.zeros((0, 3, 4), np.float32))}
+    t2.check_output(no_check_set=("XShape",))
