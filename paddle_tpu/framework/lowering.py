@@ -109,7 +109,13 @@ def analyze_block_io(program, block_idx, feed_names):
             for sub_attr in ("sub_block", "sub_block_true", "sub_block_false"):
                 sb = op.attrs.get(sub_attr)
                 if sb is not None:
-                    visit_block(sb, set(local_defined))
+                    # names the op itself binds inside the sub-block (scan
+                    # slices, loop memories, branch operands) are defined
+                    # there, not read from the scope
+                    bound = set(op.attrs.get("step_input_vars", ()))
+                    bound.update(m[0] for m in op.attrs.get("memories", ()))
+                    bound.update(op.attrs.get("x_names", ()))
+                    visit_block(sb, set(local_defined) | bound)
             for n in op.output_arg_names:
                 local_defined.add(n)
                 if n not in writes_set:

@@ -4,5 +4,9 @@ from .nn import *          # noqa: F401,F403
 from .tensor import *      # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .math import *        # noqa: F401,F403
-from . import nn, tensor, loss, math  # noqa: F401
-from .collective import _allreduce, _allgather, _broadcast  # noqa: F401
+from .control_flow import (  # noqa: F401
+    While, Switch, StaticRNN, cond, create_array, array_read, array_write,
+    array_length,
+)
+from . import nn, tensor, loss, math, control_flow  # noqa: F401
+from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401

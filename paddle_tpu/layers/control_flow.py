@@ -1,0 +1,422 @@
+"""Control-flow layer builders (reference:
+python/paddle/fluid/layers/control_flow.py — While :1035, cond :1884,
+Switch :2442, StaticRNN :431, array ops :1280-1420).
+
+The builders create nested sub-blocks exactly like the reference; the ops
+they emit lower to lax.while_loop / lax.cond / lax.scan (see
+ops/control_flow_ops.py) instead of nested Executor runs.
+"""
+import contextlib
+
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.core import Variable, VarType
+from ..framework.lowering import analyze_block_io
+from .layer_helper import LayerHelper
+
+
+def _outer_reads(program, block_idx, exclude=()):
+    reads, _ = analyze_block_io(program, block_idx, list(exclude))
+    parent = program.blocks[block_idx].parent_block
+    return [n for n in reads if parent is not None and parent.has_var(n)]
+
+
+class While:
+    """fluid.layers.While loop builder.
+
+    i = fill_constant([1], 'int64', 0)
+    cond = less_than(i, n)
+    w = While(cond)
+    with w.block():
+        ...
+        increment(i)
+        less_than(i, n, cond=cond)   # rebind the condition var
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent = program.current_block()
+        sub = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        from ..ops.control_flow_ops import block_writes
+        for op in program.blocks[sub.idx].ops:
+            if op.type == "write_to_array":
+                raise ValueError(
+                    "array_write inside a While body is not supported "
+                    "(trace-time arrays cannot be loop state); collect "
+                    "per-step values with StaticRNN step outputs instead")
+        writes = [n for n in block_writes(program, sub.idx)
+                  if parent.has_var(n)]
+        reads = _outer_reads(program, sub.idx)
+        parent.append_op(
+            type="while",
+            inputs={"Condition": [self.cond_var], "X": reads},
+            outputs={"Out": writes},
+            attrs={"sub_block": sub.idx, "cond_name": self.cond_var.name},
+            infer_shape=False)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """fluid.layers.cond — returns merged branch outputs (single Variable or
+    flat list/tuple of Variables; both branches must match)."""
+    helper = LayerHelper("cond", name=name)
+    program = helper.main_program
+    parent = program.current_block()
+
+    def build(fn):
+        blk = program._create_block()
+        try:
+            out = fn() if fn is not None else None
+        finally:
+            program._rollback()
+        if out is None:
+            outs = []
+        elif isinstance(out, (list, tuple)):
+            outs = list(out)
+        else:
+            outs = [out]
+        return blk, outs
+
+    t_blk, t_outs = build(true_fn)
+    f_blk, f_outs = build(false_fn)
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches must return the same number of outputs "
+            f"({len(t_outs)} vs {len(f_outs)})")
+
+    reads = sorted(set(_outer_reads(program, t_blk.idx)) |
+                   set(_outer_reads(program, f_blk.idx)))
+    outs = []
+    for tv in t_outs:
+        outs.append(parent.create_var(
+            name=unique_name.generate(f"{helper.name}.out"),
+            shape=tv.shape, dtype=tv.dtype))
+    parent.append_op(
+        type="cond",
+        inputs={"Cond": [pred], "X": reads},
+        outputs={"Out": outs},
+        attrs={"sub_block_true": t_blk.idx, "sub_block_false": f_blk.idx,
+               "x_names": reads,
+               "true_outs": [v.name for v in t_outs],
+               "false_outs": [v.name for v in f_outs]},
+        infer_shape=False)
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else outs
+
+
+class Switch:
+    """fluid.layers.Switch — first-true-case semantics via a chain of cond
+    ops. Cases communicate by assigning to pre-existing outer variables."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.cases = []          # [(pred_var or None, block)]
+        self.inside = False
+
+    def __enter__(self):
+        self.inside = True
+        return self
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        program = self.helper.main_program
+        blk = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        self.cases.append((condition, blk))
+
+    @contextlib.contextmanager
+    def default(self):
+        program = self.helper.main_program
+        blk = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        self.cases.append((None, blk))
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.inside = False
+        if exc_type is not None:
+            return False
+        program = self.helper.main_program
+        parent = program.current_block()
+        from ..ops.control_flow_ops import block_writes
+
+        preds = [(p, b) for p, b in self.cases if p is not None]
+        defaults = [b for p, b in self.cases if p is None]
+        writes = []
+        for _, b in self.cases:
+            for n in block_writes(program, b.idx):
+                if parent.has_var(n) and n not in writes:
+                    writes.append(n)
+        reads = sorted({n for _, b in self.cases
+                        for n in _outer_reads(program, b.idx)} |
+                       set(writes))
+
+        def empty_block():
+            blk = program._create_block()
+            program._rollback()
+            return blk
+
+        # fold right: else-branch of case i is a wrapper block holding the
+        # cond op for cases i+1...
+        rest = defaults[0] if defaults else empty_block()
+        if not preds:
+            # default-only Switch: run it unconditionally
+            from . import tensor as T
+            always = T.fill_constant([1], "bool", 1.0)
+            parent.append_op(
+                type="cond",
+                inputs={"Cond": [always], "X": list(reads)},
+                outputs={"Out": list(writes)},
+                attrs={"sub_block_true": rest.idx,
+                       "sub_block_false": empty_block().idx,
+                       "x_names": list(reads),
+                       "true_outs": list(writes),
+                       "false_outs": list(writes)},
+                infer_shape=False)
+            return False
+        for i in reversed(range(len(preds))):
+            pred, blk = preds[i]
+            if i == 0:
+                # outermost: emit into the parent block
+                target = parent
+            else:
+                target = program._create_block()
+                program._rollback()
+            target.append_op(
+                type="cond",
+                inputs={"Cond": [pred], "X": list(reads)},
+                outputs={"Out": list(writes)},
+                attrs={"sub_block_true": blk.idx,
+                       "sub_block_false": rest.idx,
+                       "x_names": list(reads),
+                       "true_outs": list(writes),
+                       "false_outs": list(writes)},
+                infer_shape=False)
+            rest = target
+        return False
+
+
+class StaticRNN:
+    """fluid.layers.StaticRNN — fixed-length recurrence, lowered to ONE
+    lax.scan (reference recurrent_op.cc ran the step block T times through
+    a nested executor with step scopes).
+
+    rnn = StaticRNN()
+    with rnn.step():
+        w = rnn.step_input(x)         # x time-major [T, B, D]
+        h_prev = rnn.memory(init=h0)  # [B, H]
+        h = layers.fc(concat([w, h_prev]), H, act='tanh')
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()                        # [T, B, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._block = None
+        self._step_inputs = []    # (outer var, inner var)
+        self._memories = []       # [pre_var, post_var|None, boot_var]
+        self._step_outputs = []   # inner vars
+        self._outputs = None
+        self._final_states = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent = program.current_block()
+        self._block = program._create_block()
+        try:
+            yield
+        except BaseException:
+            program._rollback()
+            raise
+        else:
+            program._rollback()
+            self._complete()
+
+    def _in_step(self):
+        assert self._block is not None and \
+            self.helper.main_program.current_block() is self._block, \
+            "call inside `with rnn.step():`"
+
+    def step_input(self, x):
+        self._in_step()
+        assert x.shape is not None and len(x.shape) >= 1, \
+            "step_input needs a time-major var with known rank"
+        iv = self._block.create_var(
+            name=unique_name.generate(f"{self.helper.name}.step_in"),
+            shape=x.shape[1:], dtype=x.dtype)
+        self._step_inputs.append((x, iv))
+        return iv
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._in_step()
+        if init is None:
+            assert shape is not None and batch_ref is not None, \
+                "memory() needs init= or (shape=, batch_ref=)"
+            batch = (batch_ref.shape[0]
+                     if batch_ref.block is self._block
+                     else batch_ref.shape[ref_batch_dim_idx])
+            full = [batch] + [int(s) for s in shape[1:]] \
+                if len(shape) > 1 else [batch]
+            from . import tensor as T
+            # boot var lives in the parent block, before the recurrent op
+            program = self.helper.main_program
+            cur = program.current_block_idx
+            program.current_block_idx = self._parent.idx
+            try:
+                init = T.fill_constant(full, batch_ref.dtype, init_value)
+            finally:
+                program.current_block_idx = cur
+        pre = self._block.create_var(
+            name=unique_name.generate(f"{self.helper.name}.mem"),
+            shape=init.shape, dtype=init.dtype)
+        self._memories.append([pre, None, init])
+        return pre
+
+    def update_memory(self, mem, var):
+        self._in_step()
+        for rec in self._memories:
+            if rec[0] is mem:
+                rec[1] = var
+                return
+        raise ValueError("update_memory: unknown memory var")
+
+    def step_output(self, o):
+        self._in_step()
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        program = self.helper.main_program
+        parent = self._parent
+        assert self._step_inputs, "StaticRNN needs at least one step_input"
+        assert all(rec[1] is not None for rec in self._memories), \
+            "every memory() needs an update_memory()"
+        seq_len = self._step_inputs[0][0].shape[0]
+
+        exclude = [iv.name for _, iv in self._step_inputs] + \
+                  [rec[0].name for rec in self._memories]
+        reads = _outer_reads(program, self._block.idx, exclude)
+
+        outs = []
+        for o in self._step_outputs:
+            outs.append(parent.create_var(
+                name=unique_name.generate(f"{self.helper.name}.out"),
+                shape=(seq_len,) + tuple(o.shape or ()), dtype=o.dtype))
+        finals = []
+        for rec in self._memories:
+            finals.append(parent.create_var(
+                name=unique_name.generate(f"{self.helper.name}.final"),
+                shape=rec[2].shape, dtype=rec[2].dtype))
+
+        parent.append_op(
+            type="recurrent",
+            inputs={"X": [x for x, _ in self._step_inputs],
+                    "Boot": [rec[2] for rec in self._memories],
+                    "P": reads},
+            outputs={"Out": outs, "FinalStates": finals},
+            attrs={"sub_block": self._block.idx,
+                   "step_input_vars": [iv.name
+                                       for _, iv in self._step_inputs],
+                   "memories": [(rec[0].name, rec[1].name)
+                                for rec in self._memories],
+                   "p_names": reads,
+                   "step_outputs": [o.name for o in self._step_outputs],
+                   "is_reverse": False},
+            infer_shape=False)
+        self._outputs = outs
+        self._final_states = finals
+
+    def __call__(self):
+        assert self._outputs is not None, "finish `with rnn.step():` first"
+        return self._outputs[0] if len(self._outputs) == 1 \
+            else list(self._outputs)
+
+
+# ---- LoDTensorArray helpers (reference layers/control_flow.py:1280) ----
+
+def _const_index(block, i, _upto=None):
+    """Resolve an array index to a build-time int. Everything inside jit is
+    staged (no trace-time concretes), so the index subgraph (fill_constant /
+    increment / assign chains) is folded here at build time."""
+    if isinstance(i, (int, np.integer)):
+        return int(i)
+    ops = block.ops if _upto is None else block.ops[:_upto]
+    for idx in range(len(ops) - 1, -1, -1):
+        op = ops[idx]
+        if i.name not in op.output_arg_names:
+            continue
+        if op.type == "fill_constant":
+            return int(op.attrs["value"])
+        if op.type == "assign":
+            src = block.var(op.input("X")[0])
+            return _const_index(block, src, _upto=idx)
+        if op.type == "increment":
+            return _const_index(block, i, _upto=idx) + \
+                int(op.attrs.get("step", 1))
+        break
+    raise ValueError(
+        f"tensor-array index {i.name!r} is not a build-time constant "
+        f"(only fill_constant/increment/assign chains fold); inside loops "
+        f"use StaticRNN step outputs instead of arrays")
+
+
+def create_array(dtype="float32"):
+    helper = LayerHelper("array")
+    var = helper.block.create_var(
+        name=unique_name.generate("array"), dtype=dtype,
+        type=VarType.LOD_TENSOR_ARRAY)
+    return var
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    idx = _const_index(helper.block, i)
+    helper.append_op(type="write_to_array",
+                     inputs={"X": [x]},
+                     outputs={},
+                     attrs={"array_name": array.name, "index": idx},
+                     infer_shape=False)
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    idx = _const_index(helper.block, i)
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(type="read_from_array",
+                     inputs={}, outputs={"Out": [out]},
+                     attrs={"array_name": array.name, "index": idx},
+                     infer_shape=False)
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="lod_array_length",
+                     inputs={}, outputs={"Out": [out]},
+                     attrs={"array_name": array.name}, infer_shape=False)
+    return out

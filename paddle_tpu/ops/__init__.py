@@ -7,4 +7,5 @@ from . import (  # noqa: F401
     optimizer_ops,
     metric_ops,
     collective_ops,
+    control_flow_ops,
 )

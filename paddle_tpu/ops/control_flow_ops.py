@@ -1,0 +1,183 @@
+"""Control-flow ops over sub-blocks.
+
+TPU-native replacement for the reference's control-flow operators
+(/root/reference/paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc, /root/reference/paddle/fluid/operators/recurrent_op.cc).
+The reference runs sub-blocks through a nested Executor with step scopes; here
+each sub-block lowers into the SAME traced function via jax.lax structured
+control flow (while_loop / cond / scan) — no interpreter, no scope churn, and
+XLA fuses across the loop boundary. Constraints inherited from XLA: carried
+shapes/dtypes are fixed across iterations and bodies are traced once.
+
+Differentiability contract: `cond` and `recurrent` declare every outer var
+they read as a real op input (slots Cond/X/Boot/P), so program-level autodiff
+(backward.py) emits generic vjp grad ops whose primals connect through the
+lax control-flow primitives. `while` is non-differentiable (use StaticRNN /
+recurrent for differentiable recurrence).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+def block_writes(program, block_idx):
+    """Var names written by a block's ops (incl. nested sub-blocks)."""
+    names = []
+    seen = set()
+    blk = program.blocks[block_idx]
+    for op in blk.ops:
+        for n in op.output_arg_names:
+            if n not in seen:
+                seen.add(n)
+                names.append(n)
+        for key in ("sub_block", "sub_block_true", "sub_block_false"):
+            sb = op.attrs.get(key)
+            if sb is not None:
+                for n in block_writes(program, sb):
+                    if n not in seen:
+                        seen.add(n)
+                        names.append(n)
+    return names
+
+
+def _as_pred(x):
+    return jnp.reshape(x, ()).astype(bool)
+
+
+@register_op("while", grad=False, infer_shape=False)
+def while_op(ctx, ins, attrs):
+    """Carry = condition var + every var the body writes that pre-exists in
+    the env (loop state). Reference semantics: while_op.cc re-runs the block
+    until Condition is false; here it's one lax.while_loop."""
+    program = ctx.program
+    sub = attrs["sub_block"]
+    cond_name = attrs["cond_name"]
+    writes = block_writes(program, sub)
+    carried = [n for n in writes if n in ctx.env]
+    if cond_name not in carried:
+        carried.insert(0, cond_name)
+
+    outer_env = dict(ctx.env)
+
+    def cond_fn(carry):
+        return _as_pred(carry[cond_name])
+
+    def body_fn(carry):
+        env = dict(outer_env)
+        env.update(carry)
+        ctx.lower_block_ops(sub, env)
+        return {n: env[n] for n in carried}
+
+    carry0 = {n: ctx.env[n] for n in carried}
+    final = jax.lax.while_loop(cond_fn, body_fn, carry0)
+    ctx.env.update(final)
+    return None
+
+
+@register_op("cond", grad=None, infer_shape=False)
+def cond_op(ctx, ins, attrs):
+    """Two-branch conditional (fluid layers.cond; the reference builds two
+    conditional_block ops + select_input — here it's one lax.cond).
+
+    inputs: Cond=[pred], X=[outer vars read by either branch]
+    attrs: sub_block_true/false, x_names (inner names of X), true_outs,
+    false_outs (in-branch var names per output).
+    """
+    pred = _as_pred(x_of(ins, "Cond"))
+    x_vals = list(ins.get("X", []))
+    x_names = list(attrs.get("x_names", []))
+    outer_env = dict(ctx.env)
+    outer_env.update(zip(x_names, x_vals))
+
+    def branch(block_idx, out_names):
+        def fn(xs):
+            env = dict(outer_env)
+            env.update(zip(x_names, xs))
+            ctx.lower_block_ops(block_idx, env)
+            return tuple(env[n] for n in out_names)
+        return fn
+
+    res = jax.lax.cond(pred,
+                       branch(attrs["sub_block_true"],
+                              list(attrs["true_outs"])),
+                       branch(attrs["sub_block_false"],
+                              list(attrs["false_outs"])),
+                       tuple(x_vals))
+    return {"Out": list(res)}
+
+
+@register_op("recurrent", grad=None, infer_shape=False)
+def recurrent_op(ctx, ins, attrs):
+    """StaticRNN / recurrent_op as ONE lax.scan over the time dim.
+
+    inputs: X=[outer time-major sequences], Boot=[initial memory values],
+    P=[outer vars read inside the step (weights etc.)]
+    attrs: sub_block; step_input_vars (inner names for X slices); memories
+    [(pre_name, post_name)] aligned with Boot; p_names (inner names for P);
+    step_outputs (in-block names); is_reverse.
+    Outputs "Out": stacked step outputs, time-major.
+    """
+    sub = attrs["sub_block"]
+    step_in_inner = list(attrs["step_input_vars"])
+    memories = [tuple(m) for m in attrs["memories"]]
+    p_names = list(attrs.get("p_names", []))
+    step_outs = list(attrs["step_outputs"])
+    reverse = bool(attrs.get("is_reverse", False))
+
+    xs = tuple(ins.get("X", []))
+    carry0 = tuple(ins.get("Boot", []))
+    p_vals = tuple(ins.get("P", []))
+
+    outer_env = dict(ctx.env)
+
+    def body(carry, x_t):
+        env = dict(outer_env)
+        env.update(zip(p_names, p_vals))
+        env.update(zip(step_in_inner, x_t))
+        for (pre, _), c in zip(memories, carry):
+            env[pre] = c
+        ctx.lower_block_ops(sub, env)
+        new_carry = tuple(env[post] for _, post in memories)
+        ys = tuple(env[n] for n in step_outs)
+        return new_carry, ys
+
+    # lax.scan(reverse=True) already returns ys position-aligned with xs
+    final_carry, stacked = jax.lax.scan(body, carry0, xs, reverse=reverse)
+    out = {"Out": list(stacked)}
+    if memories:
+        out["FinalStates"] = list(final_carry)
+    return out
+
+
+# ---- LoDTensorArray ops ----
+# The reference's tensor-array ops (controlflow/tensor_array_read_write_op.cc)
+# mutate a vector<LoDTensor> variable. Trace-time arrays here are Python
+# lists living in the env (indices must be trace-time constants); inside
+# scan/while use the recurrent op's stacked outputs instead.
+
+@register_op("write_to_array", grad=False, infer_shape=False)
+def write_to_array(ctx, ins, attrs):
+    x = x_of(ins)
+    i = int(attrs["index"])  # folded at build time (layers.array_write)
+    name = attrs["array_name"]
+    arr = ctx.env.get(name)
+    arr = list(arr) if isinstance(arr, list) else []
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    ctx.env[name] = arr
+    return None
+
+
+@register_op("read_from_array", grad=False, infer_shape=False)
+def read_from_array(ctx, ins, attrs):
+    arr = ctx.env[attrs["array_name"]]
+    return {"Out": arr[int(attrs["index"])]}
+
+
+@register_op("lod_array_length", grad=False, infer_shape=False)
+def lod_array_length(ctx, ins, attrs):
+    arr = ctx.env.get(attrs["array_name"], [])
+    return {"Out": jnp.asarray([len(arr)], jnp.int64)}

@@ -1,0 +1,209 @@
+"""Control-flow: While, cond, Switch, StaticRNN, tensor arrays (reference
+pattern: tests/unittests/test_while_op.py, test_cond.py, test_switch.py,
+test_static_rnn*)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_sums_to_ten():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond_v = layers.less_than(i, n)
+        w = layers.While(cond_v)
+        with w.block():
+            acc2 = layers.elementwise_add(
+                acc, layers.cast(i, "float32"))
+            layers.assign(acc2, acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond_v)
+    out, = _run(main, startup, {}, [acc])
+    assert float(out) == sum(range(10))
+
+
+def test_cond_branches():
+    for flag, expected in ((1.0, 30.0), (-1.0, 8.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [1], dtype="float32")
+            zero = layers.fill_constant([1], "float32", 0.0)
+            pred = layers.greater_than(x, zero)
+            a = layers.fill_constant([1], "float32", 10.0)
+            out = layers.cond(pred,
+                              lambda: layers.scale(a, 3.0),
+                              lambda: layers.scale(a, 0.8))
+        got, = _run(main, startup,
+                    {"x": np.array([flag], np.float32)}, [out])
+        assert float(got) == expected, (flag, got)
+
+
+def test_cond_gradient_flows():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        x.stop_gradient = False
+        zero = layers.fill_constant([], "float32", 0.0)
+        pred = layers.greater_than(layers.reduce_sum(x), zero)
+        out = layers.cond(pred,
+                          lambda: layers.scale(x, 2.0),
+                          lambda: layers.scale(x, -3.0))
+        loss = layers.reduce_sum(out)
+        (gx,) = fluid.gradients(loss, [x])
+    xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    g, = _run(main, startup, {"x": xv}, [gx])
+    np.testing.assert_allclose(g, np.full(4, 2.0), rtol=1e-6)
+    g, = _run(main, startup, {"x": -xv}, [gx])
+    np.testing.assert_allclose(g, np.full(4, -3.0), rtol=1e-6)
+
+
+def test_switch_lr_schedule():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = layers.data("step", [1], dtype="float32")
+        lr = layers.fill_constant([1], "float32", 0.0)
+        b1 = layers.fill_constant([1], "float32", 100.0)
+        b2 = layers.fill_constant([1], "float32", 1000.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+            with switch.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.001),
+                              lr)
+    for sv, expected in ((50, 0.1), (500, 0.01), (5000, 0.001)):
+        out, = _run(main, startup,
+                    {"step": np.array([sv], np.float32)}, [lr])
+        np.testing.assert_allclose(float(out), expected, rtol=1e-6)
+
+
+def test_static_rnn_cumsum():
+    """RNN with identity update == cumulative sum over time."""
+    T, B, D = 5, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [T, B, D], dtype="float32")
+        h0 = layers.fill_constant([B, D], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.elementwise_add(x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    xv = np.random.default_rng(0).standard_normal((T, B, D)).astype(
+        np.float32)
+    got, = _run(main, startup, {"x": xv}, [out])
+    np.testing.assert_allclose(got, np.cumsum(xv, axis=0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_static_rnn_trains():
+    """StaticRNN with an fc step trains end-to-end (weight grads flow
+    through the scan)."""
+    T, B, D, H = 4, 3, 5, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [T, B, D], dtype="float32")
+        y = layers.data("y", [B, 1], dtype="float32")
+        h0 = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc(layers.concat([x_t, h_prev], axis=1), H,
+                          act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        seq = rnn()                                # [T,B,H]
+        last = layers.slice(seq, axes=[0], starts=[T - 1], ends=[T])
+        last = layers.reshape(last, [B, H])
+        pred = layers.fc(last, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((T, B, D)).astype(np.float32)
+    yv = rng.standard_normal((B, 1)).astype(np.float32)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_tensor_array_write_read():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 3], dtype="float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        arr = layers.array_write(x, i0)
+        layers.array_write(layers.scale(x, 2.0), i1, array=arr)
+        n = layers.array_length(arr)
+        r = layers.array_read(arr, i1)
+    xv = np.ones((2, 3), np.float32)
+    nv, rv = _run(main, startup, {"x": xv}, [n, r])
+    assert int(nv) == 2
+    np.testing.assert_allclose(rv, xv * 2.0)
+
+
+def test_switch_default_only():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = layers.fill_constant([1], "float32", 0.0)
+        with layers.Switch() as switch:
+            with switch.default():
+                layers.assign(layers.fill_constant([1], "float32", 9.0), lr)
+    out, = _run(main, startup, {}, [lr])
+    assert float(out[0]) == 9.0
+
+
+def test_while_rejects_array_write():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 3)
+        x = layers.fill_constant([2], "float32", 1.0)
+        cond_v = layers.less_than(i, n)
+        w = layers.While(cond_v)
+        try:
+            with w.block():
+                layers.array_write(x, layers.fill_constant([1], "int64", 0))
+                layers.increment(i)
+                layers.less_than(i, n, cond=cond_v)
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "StaticRNN" in str(e)
+
+
+def test_branch_exception_restores_block():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.fill_constant([1], "float32", 1.0)
+        pred = layers.greater_than(x, layers.fill_constant([1], "float32",
+                                                           0.0))
+        try:
+            layers.cond(pred, lambda: 1 / 0, lambda: x)
+        except ZeroDivisionError:
+            pass
+        assert main.current_block().idx == 0
+        # program still buildable and runnable after the failed branch
+        y = layers.scale(x, 2.0)
+    out, = _run(main, startup, {}, [y])
+    assert float(out[0]) == 2.0
