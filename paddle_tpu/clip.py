@@ -14,6 +14,12 @@ class GradientClipByValue(GradientClipBase):
         self.max = float(max)
         self.min = float(min) if min is not None else -float(max)
 
+    def _eager(self, pairs):
+        import jax.numpy as jnp
+        return [(p, jnp.clip(g, self.min, self.max)
+                 if getattr(p, "need_clip", True) else g)
+                for p, g in pairs]
+
     def __call__(self, params_grads):
         block = default_main_program().global_block()
         out = []
@@ -35,6 +41,16 @@ class GradientClipByValue(GradientClipBase):
 class GradientClipByNorm(GradientClipBase):
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
+
+    def _eager(self, pairs):
+        import jax.numpy as jnp
+        out = []
+        for p, g in pairs:
+            if getattr(p, "need_clip", True):
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                g = g * (self.clip_norm / jnp.maximum(n, self.clip_norm))
+            out.append((p, g))
+        return out
 
     def __call__(self, params_grads):
         block = default_main_program().global_block()
@@ -62,6 +78,17 @@ class GradientClipByGlobalNorm(GradientClipBase):
     def __init__(self, clip_norm, group_name="default_group"):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
+
+    def _eager(self, pairs):
+        import jax.numpy as jnp
+        sq = [jnp.sum(jnp.square(g)) for p, g in pairs
+              if getattr(p, "need_clip", True)]
+        if not sq:
+            return pairs
+        gnorm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [(p, g * scale if getattr(p, "need_clip", True) else g)
+                for p, g in pairs]
 
     def __call__(self, params_grads):
         block = default_main_program().global_block()

@@ -1,1 +1,12 @@
-
+"""fluid.dygraph — imperative mode (reference: python/paddle/fluid/dygraph/)."""
+from .base import (  # noqa: F401
+    guard, enabled, in_dygraph_mode, to_variable, no_grad, grad, VarBase,
+    Tracer, _current_tracer,
+)
+from .layers import Layer  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import (  # noqa: F401
+    Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout,
+)
+from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .parallel import DataParallel, ParallelStrategy, prepare_context, Env  # noqa: F401

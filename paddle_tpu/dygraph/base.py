@@ -1,0 +1,356 @@
+"""DyGraph core: VarBase, eager tracer, tape autograd engine.
+
+Capability parity with the reference's imperative runtime
+(/root/reference/paddle/fluid/imperative/tracer.cc:45 Tracer::TraceOp,
+imperative/layer.h VarBase/OpBase, imperative/basic_engine.cc:159 backward,
+imperative/partial_grad_engine.cc grad()). TPU-first re-design: ops execute
+eagerly as jax array ops through the SAME registered lowerings the static
+executor compiles (one op library, two execution modes — the reference shares
+its kernel registry the same way, prepared_operator.cc:148); the autograd tape
+records (op, inputs, outputs) and backward replays it reversed through
+jax.vjp. Under jax's async dispatch, "eager" ops still batch into fused XLA
+executables per op, and dygraph.jit / TracedLayer recovers full-graph
+compilation.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import unique_name
+from ..framework.dtype import convert_dtype, np_dtype
+from ..framework.registry import get_op_def, normalize_outs
+
+_tracer = None
+
+
+def enabled():
+    return _tracer is not None
+
+
+in_dygraph_mode = enabled
+
+
+def _current_tracer():
+    return _tracer
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard (reference dygraph/base.py:209)."""
+    global _tracer
+    old = _tracer
+    _tracer = Tracer()
+    try:
+        yield
+    finally:
+        _tracer = old
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording. Supports
+    @no_grad, @no_grad(), and `with no_grad():`."""
+
+    def __init__(self, func=None):
+        self._func = func
+
+    def __call__(self, *args, **kwargs):
+        if self._func is not None:
+            with no_grad():
+                return self._func(*args, **kwargs)
+        # @no_grad() usage: called with the function being decorated
+        if len(args) == 1 and callable(args[0]) and not kwargs:
+            return no_grad(args[0])
+        raise TypeError("no_grad: use as @no_grad, @no_grad(), or "
+                        "`with no_grad():`")
+
+    def __enter__(self):
+        t = _current_tracer()
+        self._old = t._no_grad if t else False
+        if t:
+            t._no_grad = True
+        return self
+
+    def __exit__(self, *a):
+        t = _current_tracer()
+        if t:
+            t._no_grad = self._old
+        return False
+
+
+class VarBase:
+    """Eager tensor: value + grad + stop_gradient (reference
+    imperative/layer.h VarBase)."""
+
+    def __init__(self, value, name=None, stop_gradient=True,
+                 persistable=False, dtype=None):
+        if dtype is not None:
+            value = jnp.asarray(value, np_dtype(convert_dtype(dtype)))
+        else:
+            value = jnp.asarray(value)
+        self.value = value
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None
+
+    # ---- introspection ----
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        d = self.value.dtype
+        return "bfloat16" if d == jnp.bfloat16 else str(d)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def astype(self, dtype):
+        from ..layers import tensor as T
+        return T.cast(self, dtype)
+
+    def backward(self, retain_graph=False):
+        t = _current_tracer()
+        assert t is not None, "backward() requires dygraph mode"
+        t.run_backward(self, retain_graph=retain_graph)
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})\n"
+                f"{self.numpy()}")
+
+    __str__ = __repr__
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+    def __bool__(self):
+        if self.value.ndim != 0 and self.value.size != 1:
+            raise ValueError(
+                "truth value of a multi-element VarBase is ambiguous")
+        return bool(np.asarray(self.value).reshape(()))
+
+    def __float__(self):
+        return float(np.asarray(self.value).reshape(()))
+
+    def __int__(self):
+        return int(np.asarray(self.value).reshape(()))
+
+
+class _EagerCtx:
+    """Minimal LowerCtx stand-in for eager op execution."""
+
+    def __init__(self, key):
+        self.program = None
+        self.block = None
+        self.env = {}
+        self.base_key = key
+        self.mesh = None
+        self.abstract = False
+
+    def op_key(self, attrs):
+        seed = attrs.get("seed", 0)
+        if seed:
+            return jax.random.PRNGKey(seed)
+        return self.base_key
+
+
+class TapeEntry:
+    __slots__ = ("op_type", "attrs", "ins", "outs", "key")
+
+    def __init__(self, op_type, attrs, ins, outs, key):
+        self.op_type = op_type
+        self.attrs = attrs
+        self.ins = ins      # {slot: [VarBase]}
+        self.outs = outs    # {slot: [VarBase]}
+        self.key = key
+
+
+class Tracer:
+    """Eager op dispatch + tape (reference imperative/tracer.cc:45-68)."""
+
+    def __init__(self, seed=0):
+        self.tape = []
+        self._no_grad = False
+        self._key = jax.random.PRNGKey(seed)
+        self._train_mode = True
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def trace_op(self, op_type, inputs, outputs, attrs=None):
+        """inputs: {slot: [VarBase]}; outputs: {slot: [VarBase placeholders]}
+        whose .value this fills. Returns outputs."""
+        attrs = dict(attrs or {})
+        opdef = get_op_def(op_type)
+        key = self.next_key() if opdef.needs_rng else None
+        ctx = _EagerCtx(key)
+        ins_arrays = {s: [v.value for v in vs] for s, vs in inputs.items()}
+        raw = opdef.lower(ctx, ins_arrays, attrs)
+        if raw is None:
+            raw = {}
+        outs = normalize_outs({s: [v.name for v in vs]
+                               for s, vs in outputs.items()}, raw)
+        requires = opdef.grad is not False and not self._no_grad and any(
+            not v.stop_gradient for vs in inputs.values() for v in vs)
+        for slot, vars_ in outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for v, val in zip(vars_, vals):
+                if val is not None:
+                    v.value = val
+                    # never un-set an explicit stop_gradient=True placeholder
+                    # (aux outputs like dropout Mask, BN running stats)
+                    if not requires:
+                        v.stop_gradient = True
+        if requires:
+            self.tape.append(TapeEntry(op_type, attrs, inputs, outputs, key))
+        return outputs
+
+    # ---- backward engine (reference imperative/basic_engine.cc) ----
+    def run_backward(self, root, retain_graph=False, seed_grad=None):
+        grads = {}  # id(VarBase) -> jnp grad
+        grads[id(root)] = (jnp.ones_like(root.value) if seed_grad is None
+                           else jnp.asarray(seed_grad, root.value.dtype))
+
+        for entry in reversed(self.tape):
+            out_vars = [v for vs in entry.outs.values() for v in vs]
+            if not any(id(v) in grads for v in out_vars):
+                continue
+            opdef = get_op_def(entry.op_type)
+            diff_ins = {
+                s: [v.value for v in vs]
+                for s, vs in entry.ins.items()
+            }
+
+            def f(primals):
+                ctx = _EagerCtx(entry.key)
+                raw = opdef.lower(ctx, primals, entry.attrs)
+                outs = normalize_outs(
+                    {s: [v.name for v in vs]
+                     for s, vs in entry.outs.items()}, raw or {})
+                return {s: outs[s] for s in entry.outs if s in outs}
+
+            outs, vjp_fn = jax.vjp(f, diff_ins)
+            cts = {}
+            for slot, arrs in outs.items():
+                vars_ = entry.outs[slot]
+                lst = []
+                for v, a in zip(vars_, arrs):
+                    g = grads.get(id(v))
+                    lst.append(jnp.zeros(a.shape, a.dtype) if g is None
+                               else jnp.asarray(g, a.dtype))
+                cts[slot] = lst
+            (gprimals,) = vjp_fn(cts)
+            for slot, vs in entry.ins.items():
+                gs = gprimals.get(slot)
+                if gs is None:
+                    continue
+                for v, g in zip(vs, gs):
+                    if v.stop_gradient or g is None:
+                        continue
+                    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                        continue
+                    prev = grads.get(id(v))
+                    grads[id(v)] = g if prev is None else prev + g
+
+        # write accumulated grads into .grad (reference GradientAccumulator
+        # semantics: repeated backward() calls sum into the same .grad)
+        touched = {}
+        for entry in self.tape:
+            for vs in list(entry.ins.values()) + list(entry.outs.values()):
+                for v in vs:
+                    touched.setdefault(id(v), v)
+        for vid, g in grads.items():
+            v = touched.get(vid)
+            if v is None and vid == id(root):
+                v = root
+            if v is None or v.stop_gradient:
+                continue
+            v._grad = g if v._grad is None else v._grad + g
+        if not retain_graph:
+            self.tape.clear()
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """numpy/list -> VarBase (reference dygraph/base.py:493)."""
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    return VarBase(arr, name=name, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """fluid.dygraph.grad — partial backward (reference
+    imperative/partial_grad_engine.cc). Computes d outputs / d inputs without
+    touching .grad accumulators."""
+    t = _current_tracer()
+    assert t is not None, "dygraph.grad requires dygraph mode"
+    if create_graph:
+        raise NotImplementedError(
+            "dygraph.grad(create_graph=True) (double backward) is not "
+            "supported yet")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs,
+                                                   (list, tuple)):
+        grad_outputs = [grad_outputs]
+    frozen = []
+    for v in (no_grad_vars or []):
+        if not v.stop_gradient:
+            v.stop_gradient = True
+            frozen.append(v)
+
+    touched = {id(v): v for e in t.tape
+               for vs in list(e.ins.values()) + list(e.outs.values())
+               for v in vs}
+    for iv in inputs:
+        touched.setdefault(id(iv), iv)
+    saved = {vid: v._grad for vid, v in touched.items()}
+    for v in touched.values():
+        v._grad = None
+    for i, root in enumerate(outputs):
+        seed = None
+        if grad_outputs is not None and i < len(grad_outputs) and \
+                grad_outputs[i] is not None:
+            gv = grad_outputs[i]
+            seed = gv.value if isinstance(gv, VarBase) else gv
+        t.run_backward(root, retain_graph=True, seed_grad=seed)
+    res = []
+    for iv in inputs:
+        g = iv._grad
+        if g is None and not allow_unused:
+            raise RuntimeError(f"input {iv.name} is unused in the graph")
+        res.append(VarBase(g, stop_gradient=True) if g is not None else None)
+    # restore accumulators + frozen flags; drop the tape unless kept
+    for vid, v in touched.items():
+        v._grad = saved[vid]
+    for v in frozen:
+        v.stop_gradient = False
+    if not retain_graph:
+        t.tape.clear()
+    return res

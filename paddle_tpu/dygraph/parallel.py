@@ -1,0 +1,86 @@
+"""dygraph.DataParallel (reference: python/paddle/fluid/dygraph/parallel.py:223).
+
+TPU-first: there is no per-process NCCL ring to bootstrap
+(imperative/nccl_context.h:61). Single-process multi-device data parallelism
+comes from the static path's mesh compiler; this wrapper exists for API
+parity and for multi-host SPMD (jax.distributed) where each process computes
+grads on its addressable shard — apply_collective_grads then averages over
+the "dp" axis via psum when inside a mapped context, and is the identity
+otherwise.
+"""
+import jax
+
+from .layers import Layer
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy=None):
+    if strategy is None:
+        strategy = ParallelStrategy()
+        strategy.nranks = jax.process_count()
+        strategy.local_rank = jax.process_index()
+    return strategy
+
+
+class Env:
+    @property
+    def nranks(self):
+        return jax.process_count()
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @property
+    def nranks(self):
+        return max(1, self._strategy.nranks)
+
+    def scale_loss(self, loss):
+        if self.nranks <= 1:
+            return loss
+        from ..layers import math as M
+        return M.scale(loss, 1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        if self.nranks <= 1:
+            return
+        import jax.numpy as jnp
+        for p in self._layers.parameters():
+            if p._grad is None:
+                continue
+            try:
+                p._grad = jax.lax.psum(p._grad, "dp") / self.nranks
+            except NameError:
+                pass  # not inside a mapped context: single-replica no-op
+
+    # delegate module API
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, include_sublayers=True, prefix=""):
+        return self._layers.named_parameters(include_sublayers, prefix)
+
+    def state_dict(self, include_sublayers=True):
+        return self._layers.state_dict(include_sublayers)
+
+    def set_dict(self, state, include_sublayers=True,
+                 use_structured_name=True):
+        return self._layers.set_dict(state, include_sublayers)
+    load_dict = set_dict

@@ -6,6 +6,20 @@ from ..framework import initializer as init_mod
 from ..param_attr import ParamAttr
 
 
+def _dygraph_io(io):
+    """{slot: VarBase | [VarBase]} -> {slot: [VarBase]}, dropping Nones."""
+    out = {}
+    for slot, vals in (io or {}).items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        vals = [v for v in vals if v is not None]
+        if vals:
+            out[slot] = vals
+    return out
+
+
 class LayerHelper:
     def __init__(self, layer_type, **kwargs):
         self.kwargs = kwargs
@@ -34,6 +48,14 @@ class LayerHelper:
         return ParamAttr._to_attr(self.kwargs.get("bias_attr"))
 
     def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        from ..dygraph import base as dy
+        if dy.enabled():
+            import numpy as np
+            from ..framework.dtype import np_dtype, convert_dtype
+            return dy.VarBase(
+                np.zeros((), np_dtype(convert_dtype(dtype))),
+                name=unique_name.generate(f"{self.name}.tmp"),
+                stop_gradient=stop_gradient)
         return self.block.create_var(
             name=unique_name.generate(f"{self.name}.tmp"),
             dtype=dtype, stop_gradient=stop_gradient)
@@ -43,6 +65,12 @@ class LayerHelper:
 
     def create_parameter(self, attr, shape, dtype="float32", is_bias=False,
                          default_initializer=None, dist_attr=None):
+        from ..dygraph import base as dy
+        if dy.enabled():
+            raise RuntimeError(
+                f"fluid.layers.{self.layer_type} creates parameters and "
+                f"cannot run in dygraph mode — use the equivalent "
+                f"fluid.dygraph.nn Layer class instead")
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
@@ -78,6 +106,14 @@ class LayerHelper:
         return var
 
     def append_op(self, **kwargs):
+        from ..dygraph import base as dy
+        if dy.enabled():
+            tracer = dy._current_tracer()
+            ins = _dygraph_io(kwargs.get("inputs"))
+            outs = _dygraph_io(kwargs.get("outputs"))
+            tracer.trace_op(kwargs["type"], ins, outs,
+                            kwargs.get("attrs"))
+            return None
         return self.block.append_op(**kwargs)
 
     def append_activation(self, out_var, act=None):

@@ -194,9 +194,11 @@ def logical_not(x, out=None, name=None):
     return out
 
 
-# ---- Variable operator overloading (math_op_patch parity) ----
+# ---- operator overloading (math_op_patch parity, one impl for both
+# static Variables and eager VarBases — the layer wrappers dispatch through
+# the dygraph-aware LayerHelper) ----
 
-def _patch_variable():
+def _install_op_overloads(cls):
     def _make_binop(op_type, reverse=False):
         def impl(self, other):
             if reverse:
@@ -204,22 +206,30 @@ def _patch_variable():
             return _binary(op_type, self, other)
         return impl
 
-    Variable.__add__ = _make_binop("elementwise_add")
-    Variable.__radd__ = _make_binop("elementwise_add", reverse=False)
-    Variable.__sub__ = _make_binop("elementwise_sub")
-    Variable.__rsub__ = _make_binop("elementwise_sub", reverse=True)
-    Variable.__mul__ = _make_binop("elementwise_mul")
-    Variable.__rmul__ = _make_binop("elementwise_mul", reverse=False)
-    Variable.__truediv__ = _make_binop("elementwise_div")
-    Variable.__rtruediv__ = _make_binop("elementwise_div", reverse=True)
-    Variable.__pow__ = _make_binop("elementwise_pow")
-    Variable.__mod__ = _make_binop("elementwise_mod")
-    Variable.__floordiv__ = _make_binop("elementwise_floordiv")
-    Variable.__neg__ = lambda self: scale(self, scale=-1.0)
-    Variable.__lt__ = lambda self, o: _cmp("less_than", self, o)
-    Variable.__le__ = lambda self, o: _cmp("less_equal", self, o)
-    Variable.__gt__ = lambda self, o: _cmp("greater_than", self, o)
-    Variable.__ge__ = lambda self, o: _cmp("greater_equal", self, o)
+    cls.__add__ = _make_binop("elementwise_add")
+    cls.__radd__ = _make_binop("elementwise_add", reverse=True)
+    cls.__sub__ = _make_binop("elementwise_sub")
+    cls.__rsub__ = _make_binop("elementwise_sub", reverse=True)
+    cls.__mul__ = _make_binop("elementwise_mul")
+    cls.__rmul__ = _make_binop("elementwise_mul", reverse=True)
+    cls.__truediv__ = _make_binop("elementwise_div")
+    cls.__rtruediv__ = _make_binop("elementwise_div", reverse=True)
+    cls.__pow__ = _make_binop("elementwise_pow")
+    cls.__mod__ = _make_binop("elementwise_mod")
+    cls.__floordiv__ = _make_binop("elementwise_floordiv")
+    cls.__neg__ = lambda self: scale(self, scale=-1.0)
+    cls.__lt__ = lambda self, o: _cmp("less_than", self, o)
+    cls.__le__ = lambda self, o: _cmp("less_equal", self, o)
+    cls.__gt__ = lambda self, o: _cmp("greater_than", self, o)
+    cls.__ge__ = lambda self, o: _cmp("greater_equal", self, o)
 
 
-_patch_variable()
+_install_op_overloads(Variable)
+
+
+def _patch_varbase():
+    from ..dygraph.base import VarBase
+    _install_op_overloads(VarBase)
+
+
+_patch_varbase()

@@ -110,6 +110,9 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .dygraph import base as dy
+        if dy.enabled():
+            return self._dygraph_minimize(parameter_list)
         from .framework.core import program_guard
         # append everything into the program that owns the loss, regardless
         # of the guard the caller is (not) inside — reference semantics
@@ -121,12 +124,96 @@ class Optimizer:
             optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
+    # ---- dygraph (eager) path: same update-op lowerings, applied to
+    # VarBase params with tape-accumulated .grad (reference shares its
+    # optimizer kernels between modes the same way) ----
+    _EAGER_SLOTS = None  # subclass: [(slot, kind)] kind in zeros|beta1|beta2
+
+    def _eager_attrs(self):
+        return {}
+
+    def _dygraph_minimize(self, parameter_list=None):
+        import jax.numpy as jnp
+        from .framework.registry import get_op_def
+        params = parameter_list or self._parameter_list
+        assert params, ("in dygraph mode construct the optimizer with "
+                        "parameter_list=model.parameters()")
+        if self._EAGER_SLOTS is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no dygraph update path yet")
+        lr = self._learning_rate
+        lr = float(lr() if callable(lr) else lr)
+        opdef = get_op_def(self.type)
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = {}
+        attrs = self._eager_attrs()
+
+        # same clip -> regularization order as apply_gradients
+        pairs = [(p, jnp.asarray(p._grad)) for p in params
+                 if p._grad is not None and getattr(p, "trainable", True)]
+        if self._grad_clip is not None:
+            pairs = self._grad_clip._eager(pairs)
+        eager_grads = {}
+        for p, g in pairs:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            eager_grads[id(p)] = g if reg is None else reg._eager(p.value, g)
+        for p in params:
+            g = eager_grads.get(id(p))
+            if g is None:
+                continue
+            st = self._eager_state.get(p.name)
+            if st is None:
+                st = {}
+                for slot, kind in self._EAGER_SLOTS:
+                    if kind == "zeros":
+                        st[slot] = jnp.zeros_like(p.value)
+                    elif kind == "beta1":
+                        st[slot] = jnp.asarray([self._beta1], p.value.dtype)
+                    elif kind == "beta2":
+                        st[slot] = jnp.asarray([self._beta2], p.value.dtype)
+                self._eager_state[p.name] = st
+            ins = {"Param": [p.value], "Grad": [jnp.asarray(g)],
+                   "LearningRate": [jnp.asarray(lr, p.value.dtype)]}
+            for slot, _ in self._EAGER_SLOTS:
+                ins[slot] = [st[slot]]
+            raw = opdef.lower(None, ins, attrs)
+            p.value = raw["ParamOut"]
+            for slot, _ in self._EAGER_SLOTS:
+                out = raw.get(slot + "Out")
+                if out is not None:
+                    st[slot] = out
+        return None, [(p, p._grad) for p in params if p._grad is not None]
+
+    def clear_gradients(self):
+        for p in (self._parameter_list or []):
+            p.clear_gradient()
+
+    def state_dict(self):
+        """Dygraph optimizer state (accumulators) for save_dygraph."""
+        from .dygraph.checkpoint import OPT_STATE_KEY
+        out = {OPT_STATE_KEY: True}
+        for pname, st in getattr(self, "_eager_state", {}).items():
+            for slot, arr in st.items():
+                out[f"{pname}.{slot}"] = np.asarray(arr)
+        return out
+
+    def set_state_dict(self, state):
+        import jax.numpy as jnp
+        self._eager_state = {}
+        for k, v in state.items():
+            if "." not in k:
+                continue
+            pname, slot = k.rsplit(".", 1)
+            self._eager_state.setdefault(pname, {})[slot] = jnp.asarray(v)
+    load_state_dict = set_state_dict
+
     def apply_optimize(self, loss, startup_program, params_grads):
         return self.apply_gradients(params_grads)
 
 
 class SGDOptimizer(Optimizer):
     type = "sgd"
+    _EAGER_SLOTS = []
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -139,6 +226,11 @@ class SGDOptimizer(Optimizer):
 
 class MomentumOptimizer(Optimizer):
     type = "momentum"
+    _EAGER_SLOTS = [("Velocity", "zeros")]
+
+    def _eager_attrs(self):
+        return {"mu": self._momentum,
+                "use_nesterov": getattr(self, "_use_nesterov", False)}
 
     def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
         super().__init__(learning_rate, **kw)
@@ -190,6 +282,12 @@ class LarsMomentumOptimizer(Optimizer):
 
 class AdamOptimizer(Optimizer):
     type = "adam"
+    _EAGER_SLOTS = [("Moment1", "zeros"), ("Moment2", "zeros"),
+                    ("Beta1Pow", "beta1"), ("Beta2Pow", "beta2")]
+
+    def _eager_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon, **self._extra_attrs()}
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, **kw):
@@ -255,6 +353,10 @@ class LambOptimizer(AdamOptimizer):
 
 class AdagradOptimizer(Optimizer):
     type = "adagrad"
+    _EAGER_SLOTS = [("Moment", "zeros")]
+
+    def _eager_attrs(self):
+        return {"epsilon": self._epsilon}
 
     def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
         super().__init__(learning_rate, **kw)

@@ -14,6 +14,9 @@ class L2DecayRegularizer(WeightDecayRegularizer):
     def __init__(self, regularization_coeff=0.0):
         self._coeff = regularization_coeff
 
+    def _eager(self, p_value, g):
+        return g + self._coeff * p_value
+
     def __call__(self, param, grad, block):
         decayed = block.create_var(
             name=unique_name.generate(param.name + "_l2_decay"),
@@ -35,6 +38,10 @@ class L2DecayRegularizer(WeightDecayRegularizer):
 class L1DecayRegularizer(WeightDecayRegularizer):
     def __init__(self, regularization_coeff=0.0):
         self._coeff = regularization_coeff
+
+    def _eager(self, p_value, g):
+        import jax.numpy as jnp
+        return g + self._coeff * jnp.sign(p_value)
 
     def __call__(self, param, grad, block):
         sign = block.create_var(

@@ -1,0 +1,236 @@
+"""DyGraph: eager ops, tape autograd, Layer system, optimizer, checkpoint
+(reference pattern: tests/unittests/test_imperative_basic.py,
+test_imperative_mnist.py, test_imperative_checkpoint.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu import layers
+
+
+def test_eager_arithmetic_and_backward():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x + 2.0 * x          # dy/dx = 2x + 2
+        loss = layers.reduce_sum(y)
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(),
+                                   2 * np.array([1, 2, 3]) + 2, rtol=1e-6)
+
+
+def test_backward_accumulates():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(3, np.float32))
+        x.stop_gradient = False
+        layers.reduce_sum(x * 2.0).backward()
+        layers.reduce_sum(x * 3.0).backward()
+        np.testing.assert_allclose(x.gradient(), np.full(3, 5.0), rtol=1e-6)
+        x.clear_gradient()
+        assert x.gradient() is None
+
+
+def test_no_grad():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(3, np.float32))
+        x.stop_gradient = False
+        with dygraph.no_grad():
+            y = x * 2.0
+        assert y.stop_gradient
+
+
+def test_dygraph_grad_api():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x * x
+        (gx,) = dygraph.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+        # .grad accumulator untouched
+        assert x.gradient() is None
+
+
+def test_linear_layer_matches_numpy():
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 3)
+        x = dygraph.to_variable(
+            np.random.default_rng(0).standard_normal((2, 4)).astype(
+                np.float32))
+        out = lin(x)
+        ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_mnist_style_convnet_trains():
+    class Net(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = dygraph.Conv2D(1, 8, 3, padding=1)
+            self.bn = dygraph.BatchNorm(8)
+            self.pool = dygraph.Pool2D(2, "max", 2)
+            self.fc = dygraph.Linear(8 * 7 * 7, 10)
+
+        def forward(self, x):
+            h = self.conv(x)
+            h = self.bn(h)
+            h = layers.relu(h)
+            h = self.pool(h)
+            h = layers.reshape(h, [-1, 8 * 7 * 7])
+            return self.fc(h)
+
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 1, 14, 14)).astype(np.float32)
+    yv = rng.integers(0, 10, (16, 1)).astype(np.int64)
+    with dygraph.guard():
+        net = Net()
+        opt = fluid.optimizer.AdamOptimizer(
+            1e-2, parameter_list=net.parameters())
+        losses = []
+        for _ in range(15):
+            logits = net(dygraph.to_variable(xv))
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                logits, dygraph.to_variable(yv)))
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_batchnorm_train_vs_eval():
+    with dygraph.guard():
+        bn = dygraph.BatchNorm(3)
+        x = dygraph.to_variable(
+            np.random.default_rng(1).standard_normal(
+                (8, 3, 4, 4)).astype(np.float32) * 3 + 1)
+        bn.train()
+        y1 = bn(x)
+        # train mode normalizes with batch stats -> ~zero mean
+        assert abs(float(np.mean(y1.numpy()))) < 0.1
+        bn.eval()
+        y2 = bn(x)
+        # eval mode uses running stats (one update of momentum .9)
+        assert abs(float(np.mean(y2.numpy()))) > 0.1
+
+
+def test_embedding_and_layernorm():
+    with dygraph.guard():
+        emb = dygraph.Embedding([10, 6])
+        ln = dygraph.LayerNorm(6)
+        ids = dygraph.to_variable(np.array([[1, 2], [3, 4]], np.int64))
+        out = ln(emb(ids))
+        assert out.shape == (2, 2, 6)
+        np.testing.assert_allclose(
+            np.mean(out.numpy(), -1), np.zeros((2, 2)), atol=1e-5)
+
+
+def test_save_load_dygraph(tmp_path):
+    with dygraph.guard():
+        net = dygraph.Linear(4, 2)
+        path = str(tmp_path / "model")
+        dygraph.save_dygraph(net.state_dict(), path)
+        w0 = net.weight.numpy().copy()
+        net.weight.value = net.weight.value * 0  # clobber
+        params, opt = dygraph.load_dygraph(path)
+        assert opt is None
+        net.set_dict(params)
+        np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_functional_layers_work_eagerly():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            np.random.default_rng(2).standard_normal((3, 4)).astype(
+                np.float32))
+        s = layers.softmax(x)
+        np.testing.assert_allclose(np.sum(s.numpy(), -1), np.ones(3),
+                                   rtol=1e-5)
+        c = layers.concat([x, x], axis=1)
+        assert c.shape == (3, 8)
+        t = layers.transpose(x, [1, 0])
+        assert t.shape == (4, 3)
+        with pytest.raises(RuntimeError):
+            layers.fc(x, 8)  # param-creating functional layer -> clear error
+
+
+def test_nested_batchnorm_state_dict_roundtrip():
+    class Net(dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = dygraph.BatchNorm(4)
+            self.fc = dygraph.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(layers.reshape(self.bn(x), [-1, 4]))
+
+    with dygraph.guard():
+        net = Net()
+        x = dygraph.to_variable(
+            np.random.default_rng(0).standard_normal(
+                (8, 4, 1, 1)).astype(np.float32) * 2 + 3)
+        net(x)  # updates running stats
+        state = net.state_dict()
+        assert "bn._mean" in state and "bn._variance" in state
+        assert abs(state["bn._mean"].mean()) > 1e-3
+        net2 = Net()
+        net2.set_dict(state)
+        np.testing.assert_allclose(net2.bn._mean.numpy(), state["bn._mean"])
+
+
+def test_trainable_false_param_frozen():
+    with dygraph.guard():
+        lin = dygraph.Linear(
+            3, 2, param_attr=fluid.ParamAttr(trainable=False))
+        w0 = lin.weight.numpy().copy()
+        opt = fluid.optimizer.SGDOptimizer(
+            0.5, parameter_list=lin.parameters())
+        x = dygraph.to_variable(np.ones((2, 3), np.float32))
+        loss = layers.reduce_sum(lin(x))
+        loss.backward()
+        opt.minimize(loss)
+        np.testing.assert_allclose(lin.weight.numpy(), w0)  # frozen
+        assert not np.allclose(lin.bias.numpy(), 0.0)       # bias trained
+
+
+def test_grad_outputs_weighting():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        w = np.array([3.0, 5.0], np.float32)
+        (gx,) = dygraph.grad(y, x, grad_outputs=[w])
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy() * w, rtol=1e-6)
+
+
+def test_no_grad_decorator_forms():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones(2, np.float32))
+        x.stop_gradient = False
+
+        @dygraph.no_grad
+        def f1(v):
+            return v * 2.0
+
+        @dygraph.no_grad()
+        def f2(v):
+            return v * 3.0
+
+        assert f1(x).stop_gradient
+        assert f2(x).stop_gradient
+        np.testing.assert_allclose(f2(x).numpy(), [3.0, 3.0])
+
+
+def test_dygraph_grad_clip_and_regularization():
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 1, bias_attr=False)
+        opt = fluid.optimizer.SGDOptimizer(
+            1.0, parameter_list=lin.parameters(),
+            grad_clip=fluid.clip.GradientClipByGlobalNorm(1e-6))
+        w0 = lin.weight.numpy().copy()
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        loss = layers.reduce_sum(lin(x))
+        loss.backward()
+        opt.minimize(loss)
+        # clipped to ~1e-6 global norm -> weight barely moves
+        assert np.abs(lin.weight.numpy() - w0).max() < 1e-5
