@@ -1,1 +1,3 @@
-
+"""Model zoo covering the BASELINE.json configs: LeNet (1), ResNet (2),
+BERT/ERNIE (3), Wide&Deep CTR (4), DyGraph Transformer (5)."""
+from . import lenet, bert, resnet, widedeep, transformer  # noqa: F401

@@ -1,0 +1,108 @@
+"""ResNet family (BASELINE config 2 — the reference trains it through
+ParallelExecutor + NCCL allreduce; here the same program data-parallels via
+the mesh compiler). Structure mirrors the classic fluid image-classification
+model zoo ResNet (conv_bn stacks + bottleneck blocks), built on the layers
+API so it exercises conv2d/batch_norm/pool2d lowerings."""
+import numpy as np
+
+from .. import layers
+from ..layers import tensor as T
+from ..layers import math as M
+from ..param_attr import ParamAttr
+from ..framework import initializer as I
+
+DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def conv_bn_layer(x, num_filters, filter_size, stride=1, groups=1, act=None,
+                  name=None, is_test=False):
+    conv = layers.conv2d(
+        x, num_filters, filter_size, stride=stride,
+        padding=(filter_size - 1) // 2, groups=groups,
+        param_attr=ParamAttr(name=name + "_weights",
+                             initializer=I.MSRAInitializer(uniform=False)),
+        bias_attr=False, name=name)
+    return layers.batch_norm(
+        conv, act=act, is_test=is_test,
+        param_attr=ParamAttr(name=name + "_bn_scale",
+                             initializer=I.Constant(1.0)),
+        bias_attr=ParamAttr(name=name + "_bn_offset",
+                            initializer=I.Constant(0.0)),
+        moving_mean_name=name + "_bn_mean",
+        moving_variance_name=name + "_bn_variance")
+
+
+def shortcut(x, ch_out, stride, name, is_test=False):
+    ch_in = x.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(x, ch_out, 1, stride, name=name,
+                             is_test=is_test)
+    return x
+
+
+def bottleneck_block(x, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(x, num_filters, 1, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride, act="relu",
+                          name=name + "_branch2b", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1,
+                          name=name + "_branch2c", is_test=is_test)
+    short = shortcut(x, num_filters * 4, stride, name=name + "_branch1",
+                     is_test=is_test)
+    return layers.relu(M.elementwise_add(short, conv2))
+
+
+def basic_block(x, num_filters, stride, name, is_test=False):
+    conv0 = conv_bn_layer(x, num_filters, 3, stride=stride, act="relu",
+                          name=name + "_branch2a", is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3,
+                          name=name + "_branch2b", is_test=is_test)
+    short = shortcut(x, num_filters, stride, name=name + "_branch1",
+                     is_test=is_test)
+    return layers.relu(M.elementwise_add(short, conv1))
+
+
+def resnet(x, depth=50, class_dim=1000, is_test=False):
+    """x: [N, 3, H, W] -> logits [N, class_dim]."""
+    block_type, counts = DEPTH_CFG[depth]
+    block_fn = bottleneck_block if block_type == "bottleneck" \
+        else basic_block
+    base_filters = [64, 128, 256, 512]
+
+    h = conv_bn_layer(x, 64, 7, stride=2, act="relu", name="conv1",
+                      is_test=is_test)
+    h = layers.pool2d(h, pool_size=3, pool_type="max", pool_stride=2,
+                      pool_padding=1)
+    for stage, count in enumerate(counts):
+        for blk in range(count):
+            name = f"res{stage + 2}{chr(ord('a') + blk)}"
+            h = block_fn(h, base_filters[stage],
+                         stride=2 if stage > 0 and blk == 0 else 1,
+                         name=name, is_test=is_test)
+    h = layers.pool2d(h, pool_type="avg", global_pooling=True)
+    h = layers.flatten(h, axis=1)
+    stdv = 1.0 / np.sqrt(h.shape[1])
+    logits = layers.fc(
+        h, class_dim,
+        param_attr=ParamAttr(name="fc_0.w_0",
+                             initializer=I.Uniform(-stdv, stdv)),
+        bias_attr=ParamAttr(name="fc_0.b_0", initializer=I.Constant(0.0)))
+    return logits
+
+
+def resnet_train_program(depth=50, class_dim=1000, image_shape=(3, 224, 224),
+                         batch_size=32, lr=0.1):
+    """Build (feeds -> loss/acc) classification training graph."""
+    img = T.data("image", [batch_size, *image_shape], dtype="float32")
+    label = T.data("label", [batch_size, 1], dtype="int64")
+    logits = resnet(img, depth=depth, class_dim=class_dim)
+    loss = M.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return {"image": img, "label": label, "loss": loss, "acc": acc,
+            "logits": logits}

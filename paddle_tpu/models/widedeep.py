@@ -1,0 +1,99 @@
+"""Wide&Deep CTR model (BASELINE config 4 — the reference serves this class
+of model through the pserver distribute_transpiler + sparse
+distributed_lookup_table; reference model shape: Wide&Deep/DeepFM over
+sparse slot ids). TPU-first: sparse slots are dense int id tensors; embedding
+gathers run as XLA dynamic-gathers (sharded over the mesh when the table
+carries a dist_attr), replacing pserver prefetch round-trips
+(operators/distributed/parameter_prefetch.cc)."""
+import numpy as np
+
+from .. import layers
+from ..layers import tensor as T
+from ..layers import math as M
+from ..param_attr import ParamAttr
+from ..framework import initializer as I
+
+
+def wide_deep(sparse_slots, dense_dim=13, num_slots=26, vocab_size=10000,
+              embed_dim=16, hidden_sizes=(400, 400, 400), batch_size=-1,
+              table_dist_attr=None):
+    """Build feeds + forward for a Criteo-style CTR model.
+
+    Returns dict(dense=, sparse=[vars], label=, predict=, loss=, auc=).
+    """
+    dense = T.data("dense_input", [batch_size, dense_dim], dtype="float32")
+    sparse = [T.data(f"C{i}", [batch_size, 1], dtype="int64")
+              for i in range(num_slots)]
+    label = T.data("label", [batch_size, 1], dtype="int64")
+
+    # ---- deep part: shared-size embeddings per slot ----
+    embs = []
+    for i, slot in enumerate(sparse):
+        emb = layers.embedding(
+            slot, size=[vocab_size, embed_dim], is_sparse=True,
+            param_attr=ParamAttr(
+                name=f"embedding_{i}.w",
+                initializer=I.Uniform(-1.0 / np.sqrt(vocab_size),
+                                      1.0 / np.sqrt(vocab_size))))
+        embs.append(layers.reshape(emb, [-1, embed_dim]))
+    deep = layers.concat(embs + [dense], axis=1)
+    for j, h in enumerate(hidden_sizes):
+        deep = layers.fc(
+            deep, h, act="relu",
+            param_attr=ParamAttr(name=f"deep_fc_{j}.w",
+                                 initializer=I.Normal(0, 1.0 / np.sqrt(h))),
+            bias_attr=ParamAttr(name=f"deep_fc_{j}.b",
+                                initializer=I.Constant(0.0)))
+
+    # ---- wide part: linear over dense + 1-dim sparse embeddings ----
+    wide_embs = []
+    for i, slot in enumerate(sparse):
+        w = layers.embedding(
+            slot, size=[vocab_size, 1], is_sparse=True,
+            param_attr=ParamAttr(name=f"wide_embedding_{i}.w",
+                                 initializer=I.Constant(0.0)))
+        wide_embs.append(layers.reshape(w, [-1, 1]))
+    wide = layers.fc(
+        dense, 1,
+        param_attr=ParamAttr(name="wide_fc.w",
+                             initializer=I.Normal(0, 0.01)),
+        bias_attr=ParamAttr(name="wide_fc.b",
+                            initializer=I.Constant(0.0)))
+    wide = M.sums([wide] + wide_embs)
+
+    logits = M.elementwise_add(
+        layers.fc(deep, 1,
+                  param_attr=ParamAttr(name="deep_out.w",
+                                       initializer=I.Normal(0, 0.01)),
+                  bias_attr=ParamAttr(name="deep_out.b",
+                                      initializer=I.Constant(0.0))),
+        wide)
+    predict = layers.sigmoid(logits)
+    loss = M.mean(layers.sigmoid_cross_entropy_with_logits(
+        logits, T.cast(label, "float32")))
+
+    if table_dist_attr is not None:
+        # shard every embedding table over the given mesh axes (the "big
+        # sparse model" capability: rows spread across devices)
+        prog = dense.block.program
+        for i in range(num_slots):
+            for prefix in ("embedding", "wide_embedding"):
+                v = prog.global_block().vars.get(f"{prefix}_{i}.w")
+                if v is not None:
+                    v.dist_attr = tuple(table_dist_attr)
+
+    return {"dense": dense, "sparse": sparse, "label": label,
+            "predict": predict, "loss": loss}
+
+
+def random_batch(batch_size, dense_dim=13, num_slots=26, vocab_size=10000,
+                 rng=None):
+    rng = rng or np.random.default_rng(0)
+    feed = {"dense_input": rng.standard_normal(
+        (batch_size, dense_dim)).astype(np.float32)}
+    for i in range(num_slots):
+        feed[f"C{i}"] = rng.integers(0, vocab_size,
+                                     (batch_size, 1)).astype(np.int64)
+    # clickthrough correlated with slot 0 parity for learnability
+    feed["label"] = (feed["C0"] % 2).astype(np.int64)
+    return feed
