@@ -30,6 +30,11 @@ from .parallel.compiler import (  # noqa: F401
 )
 from . import parallel  # noqa: F401
 from .layers.tensor import data  # noqa: F401
+from .dataio import DataLoader, PyReader, DataFeeder, DatasetFactory  # noqa: F401
+from . import dataio  # noqa: F401
+# paddle.reader-style decorator namespace + fluid.dataset module parity
+reader = dataio
+dataset = dataio
 
 __version__ = "0.1.0"
 

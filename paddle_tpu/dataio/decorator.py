@@ -1,0 +1,183 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+batch, shuffle, buffered, cache, chain, compose, map_readers, xmap_readers,
+firstn). A "reader" is a zero-arg callable returning an iterator of samples.
+"""
+import itertools
+import queue
+import random
+import threading
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    def shuffled_reader():
+        rng = random.Random(seed)
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return shuffled_reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples (reference
+    decorator.py buffered — the host-side half of double buffering)."""
+    end = object()
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # propagate into the consumer
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                if err:
+                    raise err[0]
+                return
+            yield s
+    return buffered_reader
+
+
+def cache(reader):
+    memo = []
+    done = []
+
+    def cached_reader():
+        if done:
+            yield from memo
+            return
+        for s in reader():
+            memo.append(s)
+            yield s
+        done.append(True)
+    return cached_reader
+
+
+def chain(*readers):
+    def chained_reader():
+        for r in readers:
+            yield from r()
+    return chained_reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    _end = object()
+
+    def composed_reader():
+        for outputs in itertools.zip_longest(*[r() for r in readers],
+                                             fillvalue=_end):
+            if _end in outputs:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
+            out = []
+            for o in outputs:
+                out.extend(o if isinstance(o, tuple) else (o,))
+            yield tuple(out)
+    return composed_reader
+
+
+def map_readers(func, *readers):
+    def mapped_reader():
+        for args in zip(*[r() for r in readers]):
+            yield func(*args)
+    return mapped_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Thread-pool sample mapper (reference decorator.py xmap_readers)."""
+    end = object()
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        errors = []
+
+        def feed():
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+                continue
+            pending[item[0]] = item[1]
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if errors:
+            raise errors[0]
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+    return xreader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+    return firstn_reader

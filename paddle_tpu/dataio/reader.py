@@ -1,0 +1,227 @@
+"""DataLoader / PyReader / DataFeeder.
+
+Capability parity with the reference's data-feeding stack
+(/root/reference/python/paddle/fluid/reader.py:100 DataLoader,
+:360 from_generator, :951 GeneratorLoader, :1224 PyReader;
+data_feeder.py DataFeeder; C++ double buffering
+operators/reader/buffered_reader.cc). TPU-first: the C++ blocking queue +
+read-op machinery collapses into a host prefetch thread handing numpy
+batches to the Executor, with an async jax.device_put overlapping H2D
+against the previous step's compute (jax dispatch is async, so one batch of
+lookahead achieves the reference's double buffering).
+"""
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.dtype import np_dtype
+
+
+class DataFeeder:
+    """Converts a batch of samples to a feed dict
+    (reference python/paddle/fluid/data_feeder.py)."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        batch = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            name = var.name if isinstance(var, Variable) else str(var)
+            vals = [np.asarray(sample[i]) for sample in batch]
+            arr = np.stack(vals)
+            if isinstance(var, Variable) and var.shape is not None:
+                want = tuple(s for s in var.shape)
+                # fluid convention: sample may omit trailing dims of size 1
+                if len(want) == arr.ndim + 1 and want[-1] == 1:
+                    arr = arr[..., None]
+                arr = arr.astype(np_dtype(var.dtype), copy=False)
+            out[name] = arr
+        return out
+
+
+class _QueueIterator:
+    _END = object()
+
+    def __init__(self, gen_fn, capacity, prefetch_to_device):
+        self.q = queue.Queue(maxsize=capacity)
+        self.err = []
+        self.prefetch = prefetch_to_device
+        self._pending = None
+        self._closed = threading.Event()
+        self.thread = threading.Thread(target=self._fill, args=(gen_fn,),
+                                       daemon=True)
+        self.thread.start()
+
+    def _fill(self, gen_fn):
+        try:
+            for item in gen_fn():
+                while not self._closed.is_set():
+                    try:
+                        self.q.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+                if self._closed.is_set():
+                    return
+        except BaseException as e:
+            self.err.append(e)
+        finally:
+            while not self._closed.is_set():
+                try:
+                    self.q.put(self._END, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self):
+        """Stop the producer and drop queued batches (early-exit path)."""
+        self._closed.set()
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        self._pending = None
+
+    __del__ = close
+
+    def _device_put(self, feed):
+        import jax
+        return {k: jax.device_put(v) for k, v in feed.items()}
+
+    def _take(self):
+        """Next raw item; terminal state is sticky."""
+        if self._closed.is_set():
+            return self._END
+        item = self.q.get()
+        if item is self._END:
+            self.q.put(self._END)  # stay terminal for any further call
+            return self._END
+        return self._device_put(item) if self.prefetch else item
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # one batch of lookahead already on device = double buffering
+        if self._pending is None:
+            self._pending = self._take()
+        out = self._pending
+        if out is self._END:
+            if self.err:
+                raise self.err[0]
+            raise StopIteration
+        self._pending = self._take()
+        return out
+
+
+class DataLoader:
+    """fluid.io.DataLoader.from_generator parity."""
+
+    def __init__(self, feed_list, capacity=8, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self.iterable = iterable
+        self.return_list = return_list
+        self._gen = None
+        self._it = None       # last _QueueIterator, for cleanup
+        self._started = None  # non-iterable (start/reset) mode
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=8, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return DataLoader(feed_list, capacity, use_double_buffer, iterable,
+                          return_list)
+
+    # ---- generator flavors (reference reader.py:430-520) ----
+    def set_sample_generator(self, generator, batch_size, drop_last=True,
+                             places=None):
+        from .decorator import batch as batch_dec
+        reader = batch_dec(generator, batch_size, drop_last=drop_last)
+        return self.set_sample_list_generator(reader, places)
+
+    def set_sample_list_generator(self, generator, places=None):
+        feeder = DataFeeder(self.feed_list)
+
+        def gen():
+            for samples in generator():
+                yield feeder.feed(samples)
+        self._gen = gen
+        return self
+
+    def set_batch_generator(self, generator, places=None):
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in self.feed_list]
+
+        def gen():
+            for b in generator():
+                if isinstance(b, dict):
+                    yield b
+                else:
+                    arrs = b if isinstance(b, (list, tuple)) else [b]
+                    yield {n: np.asarray(a) for n, a in zip(names, arrs)}
+        self._gen = gen
+        return self
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        assert self._gen is not None, \
+            "call set_sample_generator / set_sample_list_generator / " \
+            "set_batch_generator first"
+        if self._it is not None:
+            self._it.close()  # release a previous (possibly early-exited)
+        self._it = _QueueIterator(self._gen, self.capacity,
+                                  self.use_double_buffer)
+        if not self.return_list:
+            return self._it
+        names = [v.name if isinstance(v, Variable) else str(v)
+                 for v in self.feed_list]
+        it = self._it
+        return ([d[n] for n in names] for d in it)
+
+    # non-iterable (start/reset) mode parity
+    def start(self):
+        self._started = iter(self)
+
+    def reset(self):
+        if self._it is not None:
+            self._it.close()
+            self._it = None
+        self._started = None
+
+    def next(self):
+        if self._started is None:
+            raise RuntimeError(
+                "DataLoader is not started — call loader.start() before "
+                "next(), or iterate it directly")
+        return next(self._started)
+
+
+class PyReader(DataLoader):
+    """Legacy alias (reference reader.py:1224)."""
+
+    def __init__(self, feed_list=None, capacity=8, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, use_double_buffer, iterable,
+                         return_list)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

@@ -184,6 +184,34 @@ class Executor:
     def close(self):
         self._cache.clear()
 
+    # ---- dataset ingestion (reference executor.py:1440 train_from_dataset
+    # -> C++ trainer threads; here the host parses/batches and the compiled
+    # step consumes, with XLA overlapping H2D against compute) ----
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        assert dataset is not None, "train_from_dataset needs a dataset"
+        fetch_names = self._fetch_names(fetch_list)
+        fetch_info = fetch_info or fetch_names
+        last = None
+        for step, feed in enumerate(dataset.batch_iterator()):
+            out = self.run(program, feed=feed,
+                           fetch_list=fetch_list, scope=scope)
+            last = out
+            if fetch_names and print_period and \
+                    step % print_period == 0:
+                msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
+                                for i, v in zip(fetch_info, out))
+                print(f"step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        prog = program.clone(for_test=True) if program is not None else None
+        return self.train_from_dataset(prog, dataset, scope, thread, debug,
+                                       fetch_list, fetch_info, print_period)
+
 
 def _jit_with_mesh(fn, mesh, program):
     """Data-parallel / SPMD jit: params replicated (or sharded per their

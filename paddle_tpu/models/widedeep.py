@@ -14,12 +14,12 @@ from ..param_attr import ParamAttr
 from ..framework import initializer as I
 
 
-def wide_deep(sparse_slots, dense_dim=13, num_slots=26, vocab_size=10000,
+def wide_deep(dense_dim=13, num_slots=26, vocab_size=10000,
               embed_dim=16, hidden_sizes=(400, 400, 400), batch_size=-1,
               table_dist_attr=None):
     """Build feeds + forward for a Criteo-style CTR model.
 
-    Returns dict(dense=, sparse=[vars], label=, predict=, loss=, auc=).
+    Returns dict(dense=, sparse=[vars], label=, predict=, loss=).
     """
     dense = T.data("dense_input", [batch_size, dense_dim], dtype="float32")
     sparse = [T.data(f"C{i}", [batch_size, 1], dtype="int64")

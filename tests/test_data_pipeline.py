@@ -1,0 +1,209 @@
+"""Data pipeline: DataLoader, reader decorators, DataFeeder, Dataset
+(reference pattern: tests/unittests/test_dataloader_*.py,
+test_decorator.py, test_dataset.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataio as D
+from paddle_tpu import layers
+
+
+def test_reader_decorators():
+    def reader():
+        return iter(range(10))
+
+    batches = list(D.batch(reader, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert list(D.batch(reader, 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    shuffled = list(D.shuffle(reader, 5, seed=0)())
+    assert sorted(shuffled) == list(range(10)) and shuffled != list(range(10))
+    assert list(D.firstn(reader, 4)()) == [0, 1, 2, 3]
+    assert list(D.chain(reader, reader)()) == list(range(10)) * 2
+    assert list(D.buffered(reader, 2)()) == list(range(10))
+    assert list(D.cache(reader)()) == list(range(10))
+    doubled = list(D.map_readers(lambda x: x * 2, reader)())
+    assert doubled == [x * 2 for x in range(10)]
+    xm = sorted(D.xmap_readers(lambda x: x + 1, reader, 2, 4)())
+    assert xm == [x + 1 for x in range(10)]
+    xo = list(D.xmap_readers(lambda x: x + 1, reader, 2, 4, order=True)())
+    assert xo == [x + 1 for x in range(10)]
+
+
+def test_buffered_propagates_errors():
+    def bad_reader():
+        yield 1
+        raise ValueError("boom")
+
+    it = D.buffered(bad_reader, 2)()
+    assert next(it) == 1
+    try:
+        list(it)
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "boom" in str(e)
+
+
+def test_dataloader_trains_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 8], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        loader = fluid.DataLoader.from_generator(feed_list=[x, y],
+                                                 capacity=4)
+
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((8, 1)).astype(np.float32)
+
+    def sample_gen():
+        r = np.random.default_rng(1)
+        for _ in range(40):
+            xv = r.standard_normal(8).astype(np.float32)
+            yield xv, (xv @ w_true).astype(np.float32)
+
+    loader.set_sample_generator(sample_gen, batch_size=4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(4):
+            for feed in loader():
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_datafeeder_shapes():
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        img = fluid.data("img", [-1, 4], "float32")
+        lbl = fluid.data("lbl", [-1, 1], "int64")
+    feeder = D.DataFeeder([img, lbl])
+    feed = feeder.feed([(np.zeros(4, np.float32), 3),
+                        (np.ones(4, np.float32), 7)])
+    assert feed["img"].shape == (2, 4)
+    assert feed["lbl"].shape == (2, 1)
+    assert feed["lbl"].dtype == np.int64
+
+
+def test_queue_dataset_from_files(tmp_path):
+    f1 = tmp_path / "part-0"
+    f1.write_text("label:1 feat:0.5,0.5\nlabel:0 feat:1.0,2.0\n")
+    f2 = tmp_path / "part-1"
+    f2.write_text("label:1 feat:3.0,4.0\n")
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        label = fluid.data("label", [-1, 1], "int64")
+        feat = fluid.data("feat", [-1, 2], "float32")
+
+    ds = D.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist([str(f1), str(f2)])
+    ds.set_batch_size(2)
+    ds.set_use_var([label, feat])
+    batches = list(ds.batch_iterator())
+    assert len(batches) == 2
+    assert batches[0]["feat"].shape == (2, 2)
+    np.testing.assert_allclose(batches[1]["feat"][0], [3.0, 4.0])
+
+
+def test_inmemory_dataset_train(tmp_path):
+    rng = np.random.default_rng(0)
+    lines = []
+    w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    for _ in range(64):
+        xv = rng.standard_normal(4).astype(np.float32)
+        yv = float(xv @ w_true)
+        lines.append("y:%f x:%s" % (yv, ",".join(f"{v:f}" for v in xv)))
+    f = tmp_path / "data.txt"
+    f.write_text("\n".join(lines))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        yvar = fluid.data("y", [-1, 1], "float32")
+        xvar = fluid.data("x", [-1, 4], "float32")
+        pred = layers.fc(xvar, 1)
+        loss = layers.mean(layers.square_error_cost(pred, yvar))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+
+    ds = D.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_filelist([str(f)])
+    ds.set_batch_size(16)
+    ds.set_use_var([yvar, xvar])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 64
+    ds.local_shuffle()
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = float(exe.run(main,
+                              feed=next(iter(ds.batch_iterator())),
+                              fetch_list=[loss])[0])
+        for epoch in range(15):
+            exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                   print_period=0)
+        last = float(exe.run(main, feed=next(iter(ds.batch_iterator())),
+                             fetch_list=[loss])[0])
+    assert last < first * 0.1, (first, last)
+
+
+def test_dataloader_empty_and_early_exit():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3], "float32")
+        loader = fluid.DataLoader.from_generator(feed_list=[x], capacity=2)
+    # empty generator: StopIteration repeatedly, no hang
+    loader.set_batch_generator(lambda: iter([]))
+    it = iter(loader)
+    for _ in range(3):
+        try:
+            next(it)
+            raise AssertionError("expected StopIteration")
+        except StopIteration:
+            pass
+    # early break releases the producer; next epoch works
+    def gen():
+        for i in range(50):
+            yield {"x": np.full((2, 3), i, np.float32)}
+    loader.set_batch_generator(gen)
+    for feed in loader():
+        break
+    got = [f["x"][0, 0] for f in loader()]
+    assert len(got) == 50
+    # start/reset/next surface
+    loader.reset()
+    try:
+        loader.next()
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "start" in str(e)
+    loader.start()
+    assert float(np.asarray(loader.next()["x"][0, 0])) == 0.0
+
+
+def test_xmap_propagates_errors():
+    def reader():
+        return iter(range(5))
+    try:
+        list(D.xmap_readers(lambda x: 1 // (x - 3), reader, 2, 4)())
+        raise AssertionError("expected ZeroDivisionError")
+    except ZeroDivisionError:
+        pass
+
+
+def test_compose_alignment():
+    r10 = lambda: iter(range(10))
+    r7 = lambda: iter(range(7))
+    assert len(list(D.compose(r10, r10)())) == 10
+    try:
+        list(D.compose(r10, r7)())
+        raise AssertionError("expected ComposeNotAligned")
+    except D.decorator.ComposeNotAligned:
+        pass
+    assert len(list(D.compose(r10, r7, check_alignment=False)())) == 7

@@ -49,7 +49,7 @@ def test_resnet50_structure():
 def test_widedeep_trains():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        out = widedeep.wide_deep(None, dense_dim=4, num_slots=6,
+        out = widedeep.wide_deep(dense_dim=4, num_slots=6,
                                  vocab_size=50, embed_dim=8,
                                  hidden_sizes=(32, 16), batch_size=32)
         fluid.optimizer.AdamOptimizer(1e-2).minimize(out["loss"])
@@ -71,7 +71,7 @@ def test_widedeep_sharded_tables():
     from paddle_tpu.parallel.compiler import CompiledProgram
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        out = widedeep.wide_deep(None, dense_dim=4, num_slots=4,
+        out = widedeep.wide_deep(dense_dim=4, num_slots=4,
                                  vocab_size=64, embed_dim=8,
                                  hidden_sizes=(16,), batch_size=16,
                                  table_dist_attr=("mp", None))
