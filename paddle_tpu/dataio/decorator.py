@@ -98,7 +98,7 @@ def compose(*readers, check_alignment=True):
     def composed_reader():
         for outputs in itertools.zip_longest(*[r() for r in readers],
                                              fillvalue=_end):
-            if _end in outputs:
+            if any(o is _end for o in outputs):
                 if check_alignment:
                     raise ComposeNotAligned(
                         "composed readers have different lengths")

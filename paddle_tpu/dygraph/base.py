@@ -176,14 +176,17 @@ class _EagerCtx:
 
 
 class TapeEntry:
-    __slots__ = ("op_type", "attrs", "ins", "outs", "key")
+    __slots__ = ("op_type", "attrs", "ins", "outs", "key", "in_vals")
 
-    def __init__(self, op_type, attrs, ins, outs, key):
+    def __init__(self, op_type, attrs, ins, outs, key, in_vals):
         self.op_type = op_type
         self.attrs = attrs
         self.ins = ins      # {slot: [VarBase]}
         self.outs = outs    # {slot: [VarBase]}
         self.key = key
+        # snapshot of input arrays at trace time: a later in-place op may
+        # mutate a VarBase's .value, which must not change this op's vjp
+        self.in_vals = in_vals
 
 
 class Tracer:
@@ -226,12 +229,16 @@ class Tracer:
                     if not requires:
                         v.stop_gradient = True
         if requires:
-            self.tape.append(TapeEntry(op_type, attrs, inputs, outputs, key))
+            self.tape.append(
+                TapeEntry(op_type, attrs, inputs, outputs, key, ins_arrays))
         return outputs
 
     # ---- backward engine (reference imperative/basic_engine.cc) ----
     def run_backward(self, root, retain_graph=False, seed_grad=None):
-        grads = {}  # id(VarBase) -> jnp grad
+        grads = {}  # id(VarBase) -> jnp grad (pending: not yet consumed by
+        #             the var's producing op)
+        out_grads = {}  # id(VarBase) -> grad consumed as a cotangent (the
+        #                 var's final downstream gradient)
         grads[id(root)] = (jnp.ones_like(root.value) if seed_grad is None
                            else jnp.asarray(seed_grad, root.value.dtype))
 
@@ -240,10 +247,7 @@ class Tracer:
             if not any(id(v) in grads for v in out_vars):
                 continue
             opdef = get_op_def(entry.op_type)
-            diff_ins = {
-                s: [v.value for v in vs]
-                for s, vs in entry.ins.items()
-            }
+            diff_ins = {s: list(vals) for s, vals in entry.in_vals.items()}
 
             def f(primals):
                 ctx = _EagerCtx(entry.key)
@@ -255,14 +259,29 @@ class Tracer:
 
             outs, vjp_fn = jax.vjp(f, diff_ins)
             cts = {}
+            consumed = []
             for slot, arrs in outs.items():
                 vars_ = entry.outs[slot]
                 lst = []
                 for v, a in zip(vars_, arrs):
+                    if not jnp.issubdtype(a.dtype, jnp.inexact):
+                        # integer/bool outputs take float0 cotangents
+                        lst.append(np.zeros(a.shape, jax.dtypes.float0))
+                        continue
                     g = grads.get(id(v))
-                    lst.append(jnp.zeros(a.shape, a.dtype) if g is None
-                               else jnp.asarray(g, a.dtype))
+                    if g is None:
+                        lst.append(jnp.zeros(a.shape, a.dtype))
+                    else:
+                        lst.append(jnp.asarray(g, a.dtype))
+                        consumed.append(id(v))
                 cts[slot] = lst
+            # Consume output grads once used as cotangents: the vjp replaces
+            # an out-grad with in-grads, so for in-place/aliasing ops (an
+            # output VarBase that is also an input) leaving it in `grads`
+            # would double-count when the input grad accumulates below.
+            for vid in consumed:
+                if vid in grads:
+                    out_grads.setdefault(vid, grads.pop(vid))
             (gprimals,) = vjp_fn(cts)
             for slot, vs in entry.ins.items():
                 gs = gprimals.get(slot)
@@ -283,7 +302,13 @@ class Tracer:
             for vs in list(entry.ins.values()) + list(entry.outs.values()):
                 for v in vs:
                     touched.setdefault(id(v), v)
-        for vid, g in grads.items():
+        # pending grads (leaves + aliased-input grads) win over the consumed
+        # out-grads of the same VarBase (the input-side grad is the gradient
+        # w.r.t. the variable's original value, matching reference in-place
+        # semantics)
+        final = dict(out_grads)
+        final.update(grads)
+        for vid, g in final.items():
             v = touched.get(vid)
             if v is None and vid == id(root):
                 v = root

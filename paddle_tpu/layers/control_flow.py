@@ -415,7 +415,7 @@ def array_read(array, i):
 
 def array_length(array):
     helper = LayerHelper("array_length")
-    out = helper.create_variable_for_type_inference(dtype="int64")
+    out = helper.create_variable_for_type_inference(dtype="int32")
     helper.append_op(type="lod_array_length",
                      inputs={}, outputs={"Out": [out]},
                      attrs={"array_name": array.name}, infer_shape=False)

@@ -180,4 +180,4 @@ def read_from_array(ctx, ins, attrs):
 @register_op("lod_array_length", grad=False, infer_shape=False)
 def lod_array_length(ctx, ins, attrs):
     arr = ctx.env.get(attrs["array_name"], [])
-    return {"Out": jnp.asarray([len(arr)], jnp.int64)}
+    return {"Out": jnp.asarray([len(arr)], jnp.int32)}

@@ -234,3 +234,35 @@ def test_dygraph_grad_clip_and_regularization():
         opt.minimize(loss)
         # clipped to ~1e-6 global norm -> weight barely moves
         assert np.abs(lin.weight.numpy() - w0).max() < 1e-5
+
+
+def test_inplace_op_no_grad_double_count():
+    """In-place ops whose output VarBase aliases the input must not double
+    the gradient (the out-grad is consumed by the op's vjp, not
+    re-accumulated)."""
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        y = layers.increment(x)  # in_place=True by default
+        y.backward()
+        np.testing.assert_allclose(x.gradient(), [1.0])
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = layers.increment(x)
+        z = y * y  # d(z)/dx through the aliased var: 2*x_after = 6
+        z.backward()
+        np.testing.assert_allclose(x.gradient(), [6.0])
+
+
+def test_inplace_mutation_does_not_corrupt_earlier_vjp():
+    """A read BEFORE a later in-place mutation must use the pre-mutation
+    value in backward (tape snapshots input arrays at trace time)."""
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        w = x * x
+        layers.increment(x)
+        loss = w + x
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), [7.0])  # 2*3 + 1
