@@ -296,7 +296,13 @@ class Program:
     (reference: framework.py:3841). The two-program convention (startup program
     initializes persistables; main program trains) is preserved."""
 
+    _uid_counter = 0
+
     def __init__(self):
+        Program._uid_counter += 1
+        # monotonic uid for executor cache keys: unlike id(), never reused
+        # after garbage collection
+        self._uid = Program._uid_counter
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
@@ -375,21 +381,51 @@ class Program:
             p.blocks.append(nb)
         p.current_block_idx = 0
         p._is_test = for_test
+        if for_test:
+            # dropping Backward/Optimize-role ops orphans their vars
+            # (@GRAD, accumulators) — remove them too
+            p._drop_unreferenced_vars()
         p._bump_version()
         return p
 
-    def _prune(self, targets):
+    _SUB_BLOCK_ATTRS = ("sub_block", "sub_block_true", "sub_block_false")
+
+    def _op_reads(self, op):
+        """All var names an op (transitively, through its sub-blocks) reads
+        from its defining block's frame."""
+        reads = set(op.input_arg_names)
+        for attr in self._SUB_BLOCK_ATTRS:
+            sb = op.attrs.get(attr)
+            if sb is None:
+                continue
+            inner_defined = set(op.attrs.get("step_input_vars", ()))
+            inner_defined.update(m[0] for m in op.attrs.get("memories", ()))
+            inner_defined.update(op.attrs.get("x_names", ()))
+            for sop in self.blocks[sb].ops:
+                reads.update(n for n in self._op_reads(sop)
+                             if n not in inner_defined)
+                inner_defined.update(sop.output_arg_names)
+        return reads
+
+    def _prune(self, targets, feeds=()):
         """Keep only ops needed to compute `targets` (used by
-        save_inference_model; reference framework.py:4106)."""
+        save_inference_model; reference framework.py:4106). Walks sub-blocks
+        (a kept control-flow op keeps its whole sub-block and everything the
+        sub-block reads) and drops vars no remaining op references."""
         if not isinstance(targets, (list, tuple)):
             targets = [targets]
+        feeds_set = {f.name if isinstance(f, Variable) else f for f in feeds}
         needed = {t.name if isinstance(t, Variable) else t for t in targets}
         keep = []
         blk = self.global_block()
         for op in reversed(blk.ops):
-            if any(n in needed for n in op.output_arg_names):
+            # the graph is cut at the feed boundary: ops that (only) produce
+            # fed vars are dropped, and reads stop propagating at fed names
+            if any(n in needed and n not in feeds_set
+                   for n in op.output_arg_names):
                 keep.append(op)
-                needed.update(op.input_arg_names)
+                needed.update(n for n in self._op_reads(op)
+                              if n not in feeds_set)
         keep.reverse()
         p = self.clone()
         nb = p.global_block()
@@ -398,8 +434,26 @@ class Program:
         src_ops = self.global_block().ops
         nb.ops = [nop for sop, nop in zip(src_ops, nb.ops)
                   if id(sop) in kept_ids]
+        p._drop_unreferenced_vars(extra_keep=set(feeds) | needed)
         p._bump_version()
         return p
+
+    def _drop_unreferenced_vars(self, extra_keep=()):
+        """Remove vars no op (in any block) references. Keeps feed/target
+        names passed via extra_keep."""
+        referenced = set(extra_keep)
+        for blk in self.blocks:
+            for op in blk.ops:
+                referenced.update(op.input_arg_names)
+                referenced.update(op.output_arg_names)
+                for attr in self._SUB_BLOCK_ATTRS:
+                    if op.attrs.get(attr) is not None:
+                        for m in op.attrs.get("memories", ()):
+                            referenced.update(m)
+                        referenced.update(op.attrs.get("step_input_vars", ()))
+                        referenced.update(op.attrs.get("x_names", ()))
+        for blk in self.blocks:
+            blk.vars = {n: v for n, v in blk.vars.items() if n in referenced}
 
     # ---- serialization ----
     def to_dict(self):

@@ -133,7 +133,7 @@ class Executor:
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 
-        cache_key = (id(program), program.version, tuple(sorted(feed_sig)),
+        cache_key = (program._uid, program.version, tuple(sorted(feed_sig)),
                      tuple(fetch_names), id(mesh))
         entry = self._cache.get(cache_key) if use_program_cache else None
         if entry is None:

@@ -1,0 +1,344 @@
+"""Static-graph persistence: save/load params & persistables, inference
+model export, and the modern single-file save/load.
+
+Capability parity with /root/reference/python/paddle/fluid/io.py
+(save_params :361, save_persistables :583, load_persistables :879,
+save_inference_model :1067, load_inference_model :1274, save/load
+:1566,:1624). TPU-first re-design: the reference assembles programs of
+save/load *ops* and runs them through an executor (operators/save_op.cc) —
+with XLA owning device memory that indirection buys nothing, so persistence
+is a direct scope<->file transfer. Sharded jax Arrays are host-gathered on
+save and re-placed per their Variable ``dist_attr`` on the next mesh run
+(executor._shard_state), which is the sharded-checkpoint story. Formats:
+one ``.npy`` per var (or one ``.npz`` when ``filename`` is given) plus a
+``__meta__.json`` carrying exact dtypes (bfloat16 round-trips as raw bytes)
+and the RNG key so a resumed run continues the same random stream.
+"""
+import json
+import os
+
+import numpy as np
+
+from .framework.core import Program, Variable, Parameter
+from .framework.executor import global_scope, RNG_STATE_NAME
+from .framework.dtype import np_dtype
+
+_META_FILE = "__meta__.json"
+_MODEL_FILE = "__model__"
+
+
+def _escape(name):
+    return name.replace("/", "%2F").replace(os.sep, "%2F")
+
+
+def _to_host(value):
+    """Device (possibly sharded) array -> host numpy. np.asarray on a fully
+    addressable jax Array gathers shards to the host."""
+    return np.asarray(value)
+
+
+def _storable(arr):
+    """(array_to_store, dtype_tag). bfloat16 has no portable npy dtype —
+    store the uint16 byte view and re-view on load."""
+    dt = str(arr.dtype)
+    if dt == "bfloat16":
+        return arr.view(np.uint16), "bfloat16"
+    return arr, dt
+
+
+def _restore(arr, dtype_tag):
+    if dtype_tag == "bfloat16":
+        return arr.view(np_dtype("bfloat16"))
+    if str(arr.dtype) != dtype_tag:
+        return arr.view(np_dtype(dtype_tag)) if arr.dtype.kind == "V" \
+            else arr.astype(np_dtype(dtype_tag))
+    return arr
+
+
+def _collect_arrays(scope, var_list, extra_state=None):
+    """Gather scope values for vars (+ named extra state) into
+    ({name: storable_array}, meta)."""
+    arrays, meta = {}, {"vars": {}, "extra": {}}
+    for var in var_list:
+        val = scope.find_var(var.name)
+        if val is None:
+            raise RuntimeError(
+                f"variable {var.name!r} has no value in the scope — run the "
+                f"startup program (and any training) before saving")
+        arr, tag = _storable(_to_host(val))
+        arrays[var.name] = arr
+        meta["vars"][var.name] = {"dtype": tag, "shape": list(arr.shape)}
+    for name, val in (extra_state or {}).items():
+        arr, tag = _storable(_to_host(val))
+        arrays[name] = arr
+        meta["extra"][name] = {"dtype": tag}
+    return arrays, meta
+
+
+def _rng_extra(scope):
+    key = scope.find_var(RNG_STATE_NAME)
+    return {} if key is None else {RNG_STATE_NAME: key}
+
+
+def _restore_rng(scope, extras):
+    key = extras.get(RNG_STATE_NAME)
+    if key is not None:
+        import jax.numpy as jnp
+        scope.set(RNG_STATE_NAME, jnp.asarray(key))
+
+
+def _resolve_vars(main_program, vars=None, predicate=None):
+    if main_program is None:
+        from .framework.core import default_main_program
+        main_program = default_main_program()
+    if vars is not None:
+        out = []
+        for v in vars:
+            out.append(v if isinstance(v, Variable)
+                       else main_program.global_block().var(str(v)))
+        return main_program, out
+    pred = predicate or (lambda v: True)
+    return main_program, [v for v in main_program.list_vars() if pred(v)]
+
+
+def is_persistable(var):
+    """Reference io.py:117 — persistable and not a feed/fetch/reader slot."""
+    return bool(var.persistable) and var.type not in ("reader", "raw")
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter) or getattr(var, "is_parameter", False)
+
+
+# ---------------------------------------------------------------------------
+# save/load vars (reference io.py:161 save_vars / :661 load_vars)
+# ---------------------------------------------------------------------------
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None,
+              extra_state=None):
+    """Write the current scope values of the selected vars under `dirname`.
+
+    executor is accepted for API parity; persistence itself is host-side.
+    """
+    scope = scope or global_scope()
+    main_program, var_list = _resolve_vars(main_program, vars, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays, meta = _collect_arrays(scope, var_list, extra_state)
+    if filename is None:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, _escape(name) + ".npy"), arr,
+                    allow_pickle=False)
+    else:
+        np.savez(os.path.join(dirname, filename),
+                 **{_escape(n): a for n, a in arrays.items()})
+    with open(os.path.join(dirname, _META_FILE), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, scope=None):
+    """Read saved arrays back into the scope. Returns the extra-state dict
+    (e.g. the RNG key saved by save_persistables)."""
+    scope = scope or global_scope()
+    main_program, var_list = _resolve_vars(main_program, vars, predicate)
+    meta_path = os.path.join(dirname, _META_FILE)
+    meta = {"vars": {}, "extra": {}}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+
+    if filename is not None:
+        zpath = os.path.join(dirname, filename)
+        if not zpath.endswith(".npz") and not os.path.exists(zpath):
+            zpath = zpath + ".npz"
+        archive = np.load(zpath, allow_pickle=False)
+        def _read(name):
+            key = _escape(name)
+            return archive[key] if key in archive.files else None
+    else:
+        def _read(name):
+            p = os.path.join(dirname, _escape(name) + ".npy")
+            return np.load(p, allow_pickle=False) if os.path.exists(p) \
+                else None
+
+    for var in var_list:
+        arr = _read(var.name)
+        if arr is None:
+            raise RuntimeError(
+                f"no saved value for variable {var.name!r} in {dirname}")
+        tag = meta["vars"].get(var.name, {}).get("dtype", str(arr.dtype))
+        scope.set(var.name, _restore(arr, tag))
+    extras = {}
+    for name, info in meta.get("extra", {}).items():
+        arr = _read(name)
+        if arr is not None:
+            extras[name] = _restore(arr, info.get("dtype", str(arr.dtype)))
+    return extras
+
+
+# ---------------------------------------------------------------------------
+# params / persistables (reference io.py:361,583,879)
+# ---------------------------------------------------------------------------
+
+def save_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    save_vars(executor, dirname, main_program=main_program,
+              predicate=is_parameter, filename=filename, scope=scope)
+
+
+def load_params(executor, dirname, main_program=None, filename=None,
+                scope=None):
+    load_vars(executor, dirname, main_program=main_program,
+              predicate=is_parameter, filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    """Params + optimizer accumulators + LR/step counters + the RNG key —
+    the full training state needed for exact resume."""
+    scope = scope or global_scope()
+    save_vars(executor, dirname, main_program=main_program,
+              predicate=is_persistable, filename=filename, scope=scope,
+              extra_state=_rng_extra(scope))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope=None):
+    scope = scope or global_scope()
+    extras = load_vars(executor, dirname, main_program=main_program,
+                       predicate=is_persistable, filename=filename,
+                       scope=scope)
+    _restore_rng(scope, extras)
+
+
+# ---------------------------------------------------------------------------
+# inference model (reference io.py:1067 save_inference_model /
+# :1274 load_inference_model)
+# ---------------------------------------------------------------------------
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False, scope=None):
+    """Prune `main_program` to the subgraph producing `target_vars` from
+    `feeded_var_names`, save it (JSON program) + the params it needs.
+    Returns the list of fetch var names."""
+    if main_program is None:
+        from .framework.core import default_main_program
+        main_program = default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    target_names = [t.name if isinstance(t, Variable) else str(t)
+                    for t in target_vars]
+
+    pruned = main_program.clone(for_test=True)._prune(
+        target_names, feeds=feeded_var_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": pruned.to_dict(),
+        "feed_var_names": list(feeded_var_names),
+        "fetch_var_names": target_names,
+    }
+    model_path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    with open(model_path, "w") as f:
+        json.dump(model, f)
+    if not program_only:
+        save_vars(executor, dirname, main_program=pruned,
+                  predicate=is_persistable, filename=params_filename,
+                  scope=scope)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, scope=None):
+    """Returns (program, feed_target_names, fetch_targets); params are
+    loaded into the scope so `executor.run(program, ...)` works directly."""
+    model_path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    with open(model_path) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    program._is_test = True
+    has_persistables = any(is_persistable(v) for v in program.list_vars())
+    if has_persistables:
+        load_vars(executor, dirname, main_program=program,
+                  predicate=is_persistable, filename=params_filename,
+                  scope=scope)
+    fetch_targets = [program.global_block().var(n)
+                     for n in model["fetch_var_names"]]
+    return program, model["feed_var_names"], fetch_targets
+
+
+# ---------------------------------------------------------------------------
+# modern single-file API (reference io.py:1566 save / :1624 load)
+# ---------------------------------------------------------------------------
+
+def save(program, model_path, scope=None):
+    """program params -> {model_path}.pdparams, other persistables ->
+    {model_path}.pdopt, program IR -> {model_path}.pdmodel."""
+    scope = scope or global_scope()
+    base_dir = os.path.dirname(os.path.abspath(model_path)) or "."
+    os.makedirs(base_dir, exist_ok=True)
+
+    def _dump(vars_, path, extra=None):
+        arrays, meta = _collect_arrays(scope, vars_, extra)
+        np.savez(path, **{_escape(n): a for n, a in arrays.items()})
+        if os.path.exists(path + ".npz"):  # np.savez appends .npz
+            os.replace(path + ".npz", path)
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+
+    params = [v for v in program.list_vars() if is_parameter(v)]
+    others = [v for v in program.list_vars()
+              if is_persistable(v) and not is_parameter(v)]
+    _dump(params, model_path + ".pdparams")
+    _dump(others, model_path + ".pdopt", extra=_rng_extra(scope))
+    with open(model_path + ".pdmodel", "w") as f:
+        json.dump(program.to_dict(), f)
+
+
+def load(program, model_path, executor=None, var_list=None, scope=None):
+    """Restore {model_path}.pdparams/.pdopt into the scope for `program`."""
+    scope = scope or global_scope()
+
+    def _slurp(path, vars_):
+        if not os.path.exists(path):
+            if vars_:
+                raise RuntimeError(
+                    f"checkpoint file {path!r} does not exist but the "
+                    f"program expects {len(vars_)} saved variables "
+                    f"(e.g. {vars_[0].name!r})")
+            return {}
+        meta = {"vars": {}, "extra": {}}
+        if os.path.exists(path + ".meta.json"):
+            with open(path + ".meta.json") as f:
+                meta = json.load(f)
+        with np.load(path, allow_pickle=False) as z:
+            for v in vars_:
+                key = _escape(v.name)
+                if key not in z.files:
+                    raise RuntimeError(
+                        f"no saved value for {v.name!r} in {path}")
+                tag = meta["vars"].get(v.name, {}).get("dtype")
+                arr = z[key]
+                scope.set(v.name, _restore(arr, tag or str(arr.dtype)))
+            extras = {}
+            for name, info in meta.get("extra", {}).items():
+                key = _escape(name)
+                if key in z.files:
+                    extras[name] = _restore(z[key], info.get("dtype"))
+            return extras
+
+    params = [v for v in program.list_vars() if is_parameter(v)]
+    others = [v for v in program.list_vars()
+              if is_persistable(v) and not is_parameter(v)]
+    if var_list is not None:
+        names = {v.name if isinstance(v, Variable) else str(v)
+                 for v in var_list}
+        params = [v for v in params if v.name in names]
+        others = [v for v in others if v.name in names]
+    _slurp(model_path + ".pdparams", params)
+    extras = _slurp(model_path + ".pdopt", others)
+    _restore_rng(scope, extras)

@@ -26,6 +26,23 @@ def create_tensor(dtype, name=None, persistable=False):
                                   persistable=persistable)
 
 
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """fluid.layers.create_parameter (reference layers/tensor.py:75)."""
+    import copy as _copy
+    from ..param_attr import ParamAttr
+    if attr is None:
+        attr = ParamAttr(name=name)
+    else:
+        attr = ParamAttr._to_attr(attr)
+        if attr is not False and name is not None and attr.name is None:
+            attr = _copy.copy(attr)  # never mutate the caller's ParamAttr
+            attr.name = name
+    helper = LayerHelper("create_parameter")
+    return helper.create_parameter(attr, shape, dtype, is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
 def create_global_var(shape, value, dtype, persistable=False,
                       force_cpu=False, name=None):
     from ..framework import initializer as init_mod

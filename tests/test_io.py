@@ -1,0 +1,279 @@
+"""Static-graph persistence tests: save/load params & persistables with
+exact training resume, inference-model export/import (with pruning), and the
+modern single-file save/load. Mirrors the reference's io test intent
+(python/paddle/fluid/tests/unittests/test_io_save_load.py,
+test_inference_model_io.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_mlp(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(pred - y))
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=0.05)
+        opt.minimize(loss)
+    return main, startup, loss, pred
+
+
+def _batch(i):
+    rng = np.random.RandomState(i)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = x[:, :1] * 2.0 + 1.0
+    return {"x": x, "y": y}
+
+
+def test_save_load_persistables_exact_resume(tmp_path):
+    """Train 3 steps, checkpoint, train 3 more; a fresh process-equivalent
+    (new scope + reloaded state) must produce IDENTICAL losses for steps 4-6
+    (params + Adam moments + beta pow accumulators + RNG all round-trip)."""
+    ckpt = str(tmp_path / "ckpt")
+    main, startup, loss, _ = _build_mlp()
+    exe = fluid.Executor()
+
+    scope_a = fluid.Scope()
+    uninterrupted = []
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        for i in range(6):
+            l, = exe.run(main, feed=_batch(i), fetch_list=[loss])
+            uninterrupted.append(float(l))
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup)
+        for i in range(3):
+            exe.run(main, feed=_batch(i), fetch_list=[loss])
+        fluid.save_persistables(exe, ckpt, main_program=main)
+
+    # "new process": fresh scope, no startup run — everything from the ckpt
+    scope_c = fluid.Scope()
+    resumed = []
+    with fluid.scope_guard(scope_c):
+        fluid.load_persistables(exe, ckpt, main_program=main)
+        for i in range(3, 6):
+            l, = exe.run(main, feed=_batch(i), fetch_list=[loss])
+            resumed.append(float(l))
+    np.testing.assert_allclose(resumed, uninterrupted[3:], rtol=1e-6)
+
+
+def test_save_load_params_roundtrip(tmp_path):
+    d = str(tmp_path / "params")
+    main, startup, loss, pred = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_batch(0), fetch_list=[loss])
+        w_names = [p.name for p in main.all_parameters()]
+        before = {n: np.asarray(scope.find_var(n)) for n in w_names}
+        fluid.save_params(exe, d, main_program=main)
+        # clobber, reload, compare
+        for n in w_names:
+            scope.set(n, np.zeros_like(before[n]))
+        fluid.load_params(exe, d, main_program=main)
+        for n in w_names:
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(n)), before[n])
+
+
+def test_save_params_single_file(tmp_path):
+    d = str(tmp_path / "combined")
+    main, startup, loss, _ = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_params(exe, d, main_program=main, filename="all_params")
+        names = [p.name for p in main.all_parameters()]
+        vals = {n: np.asarray(scope.find_var(n)) for n in names}
+        for n in names:
+            scope.set(n, np.zeros_like(vals[n]))
+        fluid.load_params(exe, d, main_program=main, filename="all_params")
+        for n in names:
+            np.testing.assert_array_equal(np.asarray(scope.find_var(n)),
+                                          vals[n])
+
+
+def test_save_load_inference_model(tmp_path):
+    d = str(tmp_path / "infer")
+    main, startup, loss, pred = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    feed = _batch(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(2):
+            exe.run(main, feed=_batch(i), fetch_list=[loss])
+        test_prog = main.clone(for_test=True)
+        # the unpruned test clone still holds the loss path, so feed y too
+        ref, = exe.run(test_prog, feed=feed, fetch_list=[pred])
+        fluid.save_inference_model(d, ["x"], [pred], exe,
+                                   main_program=main)
+
+    # load into a fresh scope: program + params come from disk
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feed_names, fetch_targets = fluid.load_inference_model(d, exe)
+        assert feed_names == ["x"]
+        out, = exe.run(prog, feed={"x": feed["x"]},
+                       fetch_list=fetch_targets)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # pruning: the loss/label path and all backward/optimize state are gone
+    var_names = {v.name for v in prog.list_vars()}
+    assert not any(n.endswith("@GRAD") for n in var_names)
+    assert not any("moment" in n for n in var_names)
+    assert "y" not in var_names
+
+
+def test_prune_keeps_subblock_reads(tmp_path):
+    """A pruned program keeping a control-flow op must keep the vars its
+    sub-block reads (weak spot called out in round-1 review)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        w = layers.create_parameter([4, 4], "float32", name="w_sub")
+        cond_in = layers.reduce_sum(x)
+        zero = layers.fill_constant([1], "float32", 0.0)
+        pred_cond = layers.less_than(zero, cond_in)
+        # true branch reads parameter w through the sub-block
+        out = layers.cond(pred_cond,
+                          lambda: layers.matmul(x, w),
+                          lambda: x * 2.0)
+        unrelated = layers.fc(x, 3, act="relu")  # should be pruned away
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "cf")
+        fluid.save_inference_model(d, ["x"], [out], exe, main_program=main)
+        prog, feed_names, fetches = fluid.load_inference_model(d, exe)
+        # the sub-block's parameter must have been saved + restorable
+        assert scope.find_var("w_sub") is not None
+        xval = np.ones((2, 4), np.float32)
+        got, = exe.run(prog, feed={"x": xval}, fetch_list=fetches)
+        want = xval @ np.asarray(scope.find_var("w_sub"))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # unrelated fc was pruned
+        types = [op.type for op in prog.global_block().ops]
+        assert "relu" not in types
+
+
+def test_modern_save_load(tmp_path):
+    path = str(tmp_path / "model" / "ckpt")
+    main, startup, loss, _ = _build_mlp()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses_a = [float(exe.run(main, feed=_batch(i),
+                                  fetch_list=[loss])[0]) for i in range(4)]
+        fluid.save(main, path)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.load(main, path)
+        # params equal across scopes right after load (before any new step)
+        for p in main.all_parameters():
+            np.testing.assert_array_equal(
+                np.asarray(scope.find_var(p.name)),
+                np.asarray(scope2.find_var(p.name)))
+        l, = exe.run(main, feed=_batch(4), fetch_list=[loss])
+    assert np.isfinite(float(l))
+
+
+def test_load_missing_raises(tmp_path):
+    main, startup, loss, _ = _build_mlp()
+    exe = fluid.Executor()
+    with pytest.raises((RuntimeError, FileNotFoundError)):
+        fluid.load_persistables(exe, str(tmp_path / "nope"),
+                                main_program=main)
+
+
+def test_sharded_save_restore_resume(tmp_path):
+    """Checkpoint a tp-sharded training run (scope holds mesh-sharded jax
+    Arrays), restore into a fresh scope, keep training under the mesh —
+    losses must match the uninterrupted sharded run exactly."""
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+    from paddle_tpu.parallel.compiler import CompiledProgram
+
+    ckpt = str(tmp_path / "sharded_ckpt")
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    cfg = bert.BertConfig.tiny()
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            out = bert.bert_pretrain(cfg, 4, 16, max_preds=3)
+            bert.apply_tp_sharding(main, cfg)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(out["loss"])
+        return main, startup, out
+
+    exe = fluid.Executor()
+    main, startup, out = build()
+    compiled = CompiledProgram(main).with_data_parallel(
+        loss_name=out["loss"].name, mesh=mesh)
+    feeds = [bert.random_batch(cfg, 4, 16, 3, rng=np.random.default_rng(i))
+             for i in range(4)]
+
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        base = [float(exe.run(compiled, feed=f,
+                              fetch_list=[out["loss"]])[0]) for f in feeds]
+
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup)
+        for f in feeds[:2]:
+            exe.run(compiled, feed=f, fetch_list=[out["loss"]])
+        fluid.save_persistables(exe, ckpt, main_program=main)
+
+    scope_c = fluid.Scope()
+    with fluid.scope_guard(scope_c):
+        fluid.load_persistables(exe, ckpt, main_program=main)
+        resumed = [float(exe.run(compiled, feed=f,
+                                 fetch_list=[out["loss"]])[0])
+                   for f in feeds[2:]]
+    np.testing.assert_allclose(resumed, base[2:], rtol=1e-5)
+
+
+def test_prune_cuts_at_feed_boundary(tmp_path):
+    """Feeding an intermediate var must drop everything upstream of it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8], "float32")
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "mid")
+        fluid.save_inference_model(d, [h.name], [pred], exe,
+                                   main_program=main)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.load_inference_model(d, exe)
+        # upstream fc(x->h) gone: only the second fc's ops remain
+        assert len(prog.global_block().ops) == 2
+        hval = np.random.rand(3, 16).astype(np.float32)
+        out, = exe.run(prog, feed={feeds[0]: hval}, fetch_list=fetches)
+        assert out.shape == (3, 4)
+
+
+def test_modern_load_missing_file_raises(tmp_path):
+    main, startup, loss, _ = _build_mlp()
+    exe = fluid.Executor()
+    with pytest.raises(RuntimeError):
+        fluid.load(main, str(tmp_path / "nope" / "ckpt"))
