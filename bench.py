@@ -1,27 +1,65 @@
-"""Flagship benchmark: BERT-base pretrain step throughput (samples/sec/chip).
+"""Flagship benchmark: BERT-base pretrain step throughput, bf16 AMP.
 
-BASELINE.json config 3 (ERNIE/BERT-base, Fleet-collective path in the
-reference). Anchor: published BERT-base pretrain throughput on one V100
-(fp16, seq 128) ~= 200 samples/sec — the north-star asks for >= anchor/1.2
-per chip. Prints ONE JSON line.
+BASELINE.json config 3 (ERNIE/BERT-base, the reference's Fleet-collective
+path). The anchor is read from BASELINE.json "published" (V100 fp16 seq-128
+BERT-base pretrain throughput); the north star asks for >= anchor/1.2 per
+chip. Fresh batches stream through the DataLoader each step (no cached-feed
+flattery), precision is bf16 with fp32 master weights via
+contrib.mixed_precision, and MFU is reported against the chip's peak bf16
+FLOPs. Prints ONE JSON line.
 """
 import json
+import os
 import time
 
 import numpy as np
 
+# chip peak bf16 TFLOP/s by device_kind substring (public specs)
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+    "v6": 918.0,
+}
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, tf in _PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return None
+
+
+def _bert_train_flops_per_sample(cfg, seq_len, max_preds):
+    """Analytic matmul FLOPs (fwd), x3 for fwd+bwd. h=hidden, L=layers."""
+    h, L, ffn = cfg.hidden_size, cfg.num_layers, cfg.ffn_size
+    v = cfg.vocab_size
+    per_layer = (4 * 2 * seq_len * h * h          # q,k,v,out projections
+                 + 2 * 2 * seq_len * h * ffn      # ffn in+out
+                 + 2 * 2 * seq_len * seq_len * h)  # qk^T and attn*v
+    heads = (2 * max_preds * h * h                # mlm transform
+             + 2 * max_preds * h * v              # mlm vocab logits
+             + 2 * h * h)                         # pooler (nsp)
+    return 3 * (L * per_layer + heads)
+
 
 def main():
     import jax
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
+    from paddle_tpu.contrib import mixed_precision as mp
 
-    on_accel = platform in ("tpu", "gpu")
+    on_accel = platform in ("tpu", "gpu", "axon")
     if on_accel:
         cfg = bert.BertConfig.base()
-        batch, seq_len, max_preds = 64, 128, 20
-        steps, warmup = 20, 3
+        # per-chip batch is a free parameter of the protocol; 384 is the
+        # single-chip throughput sweet spot measured on v5e
+        batch, seq_len, max_preds = 384, 128, 20
+        steps, warmup = 30, 5
     else:  # CPU smoke fallback so the bench always completes
         cfg = bert.BertConfig.tiny()
         batch, seq_len, max_preds = 8, 32, 5
@@ -31,32 +69,67 @@ def main():
     startup = fluid.Program()
     with fluid.program_guard(main_prog, startup):
         out = bert.bert_pretrain(cfg, batch, seq_len, max_preds)
-        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-4)
+        lr = fluid.layers.noam_decay(cfg.hidden_size, 10000,
+                                     learning_rate=200.0)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=lr)
+        opt = mp.decorate(opt, init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)  # bf16: no scaling
         opt.minimize(out["loss"])
+
+    rng = np.random.default_rng(0)
+
+    def batch_gen():
+        while True:
+            yield bert.random_batch(cfg, batch, seq_len, max_preds, rng=rng)
+
+    loader = fluid.DataLoader.from_generator(capacity=4)
+    loader.set_batch_generator(batch_gen)
 
     exe = fluid.Executor()
     scope = fluid.Scope()
     loss_name = out["loss"].name
     with fluid.scope_guard(scope):
         exe.run(startup)
-        feed = bert.random_batch(cfg, batch, seq_len, max_preds)
+        it = iter(loader())
         for _ in range(warmup):
-            exe.run(main_prog, feed=feed, fetch_list=[loss_name])
+            loss, = exe.run(main_prog, feed=next(it),
+                            fetch_list=[loss_name])
+        np.asarray(loss)  # sync before timing
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss, = exe.run(main_prog, feed=feed, fetch_list=[loss_name])
+            loss, = exe.run(main_prog, feed=next(it),
+                            fetch_list=[loss_name])
+        loss = float(np.asarray(loss).reshape(()))  # fetch syncs
         dt = time.perf_counter() - t0
-    assert np.isfinite(float(loss)), "loss diverged"
+    loader.reset()
+    assert np.isfinite(loss), "loss diverged"
 
     value = batch * steps / dt
-    anchor = 200.0  # V100 fp16 BERT-base seq128 published per-GPU anchor
-    print(json.dumps({
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BASELINE.json")
+    anchor = 200.0  # fallback: published V100 fp16 BERT-base seq128 anchor
+    try:
+        with open(baseline_path) as f:
+            published = json.load(f).get("published", {})
+        anchor = float(published.get(
+            "bert_base_v100_fp16_seq128_samples_per_sec", anchor))
+    except (OSError, ValueError):
+        pass
+
+    result = {
         "metric": f"bert_{'base' if on_accel else 'tiny-cpu'}_pretrain_"
-                  f"samples_per_sec_per_chip",
+                  f"bf16_samples_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "samples/sec",
         "vs_baseline": round(value / anchor, 4),
-    }))
+    }
+    peak = _peak_flops(dev)
+    if on_accel and peak:
+        achieved = _bert_train_flops_per_sample(cfg, seq_len,
+                                                max_preds) * value
+        result["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -33,6 +33,7 @@ from .layers.tensor import data  # noqa: F401
 from .dataio import DataLoader, PyReader, DataFeeder, DatasetFactory  # noqa: F401
 from . import dataio  # noqa: F401
 from . import io  # noqa: F401
+from . import contrib  # noqa: F401
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,

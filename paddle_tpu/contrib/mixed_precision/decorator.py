@@ -1,0 +1,213 @@
+"""OptimizerWithMixedPrecision (reference:
+python/paddle/fluid/contrib/mixed_precision/decorator.py:27).
+
+minimize = rewrite program to the low-precision compute dtype -> scale loss
+-> backward (grads arrive fp32 at the master weights through the cast vjp)
+-> unscale + finite check -> dynamic loss-scale update -> optimizer ops,
+with the whole parameter/accumulator update rolled back via `where` selects
+when any grad overflowed (the reference guards updates the same way with
+check_finite_and_unscale + update_loss_scaling ops).
+
+On TPU the default compute dtype is bfloat16: same exponent range as fp32,
+so loss scaling rarely triggers — but the machinery is kept for fp16 parity
+and for exactness of the capability contract.
+"""
+import numpy as np
+
+from ...framework import unique_name
+from ...framework.core import (OpRole, op_role_guard, program_guard,
+                               default_startup_program, default_main_program)
+from ...framework.initializer import ConstantInitializer
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                 dest_dtype="bfloat16"):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._use_dynamic_loss_scaling = use_dynamic_loss_scaling
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._dest_dtype = dest_dtype
+        self._loss_scaling = None
+        self._scaled_loss = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def get_scaled_loss(self):
+        return self._scaled_loss
+
+    def _create_scale_vars(self):
+        from ...layers import tensor as T
+        self._loss_scaling = T.create_global_var(
+            shape=[1], value=self._init_loss_scaling, dtype="float32",
+            persistable=True, name=unique_name.generate("loss_scaling"))
+        if self._use_dynamic_loss_scaling:
+            self._num_good_steps = T.create_global_var(
+                shape=[1], value=0.0, dtype="float32", persistable=True,
+                name=unique_name.generate("num_good_steps"))
+            self._num_bad_steps = T.create_global_var(
+                shape=[1], value=0.0, dtype="float32", persistable=True,
+                name=unique_name.generate("num_bad_steps"))
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from ...layers import math as M
+        program = loss.block.program
+        # scale/unscale/finite-check/rollback are only needed when the loss
+        # scale can be != 1 (fp16 parity). The bf16 fast path — static scale
+        # 1.0 — is a pure dtype policy: no per-step bookkeeping at all.
+        self._needs_scaling = (self._use_dynamic_loss_scaling or
+                               self._init_loss_scaling != 1.0)
+        with program_guard(program,
+                           startup_program or default_startup_program()):
+            rewrite_program(program, self._amp_lists, self._dest_dtype)
+            self._create_scale_vars()
+            if self._needs_scaling:
+                self._scaled_loss = loss * self._loss_scaling
+            else:
+                self._scaled_loss = loss
+            params_grads = self._optimizer.backward(
+                self._scaled_loss, startup_program, parameter_list,
+                no_grad_set, callbacks)
+            if self._needs_scaling:
+                with op_role_guard(OpRole.Backward):
+                    params_grads = self._unscale_and_check(params_grads)
+        return params_grads
+
+    def _unscale_and_check(self, params_grads):
+        """grad /= loss_scaling; compute @FOUND_INF@ (bool scalar var) —
+        the reference's check_finite_and_unscale op
+        (operators/amp/check_finite_and_unscale_op.cc semantics)."""
+        from ...layers import math as M, tensor as T
+        from ...layers.layer_helper import LayerHelper
+        helper = LayerHelper("check_finite_and_unscale")
+        finites = []
+        new_pg = []
+        # divide, don't multiply by the reciprocal: 1/scale underflows to a
+        # denormal (flushed to 0) for scale near float32 max
+        for p, g in params_grads:
+            g2 = M.elementwise_div(g, self._loss_scaling)
+            if self._use_dynamic_loss_scaling:
+                fin = helper.create_variable_for_type_inference(dtype="bool")
+                helper.append_op(type="isfinite", inputs={"X": [g2]},
+                                 outputs={"Out": [fin]})
+                finites.append(fin)
+            new_pg.append((p, g2))
+        if self._use_dynamic_loss_scaling:
+            all_fin = finites[0]
+            for f in finites[1:]:
+                all_fin = M.logical_and(all_fin, f)
+            self._found_inf = M.logical_not(all_fin)
+            self._found_inf.persistable = False
+            self._update_loss_scaling()
+        return new_pg
+
+    def _update_loss_scaling(self):
+        """reference update_loss_scaling op semantics
+        (operators/amp/update_loss_scaling_op.cc): on overflow, bad+=1 and
+        after decr_every_n_nan_or_inf bad steps scale *= decr_ratio; on a
+        clean step, good+=1 and after incr_every_n_steps scale *=
+        incr_ratio. Counters reset on each scale change (and good resets on
+        any overflow)."""
+        from ...layers import tensor as T
+        scale = self._loss_scaling
+        good, bad = self._num_good_steps, self._num_bad_steps
+        inf = T.cast(self._found_inf, "float32")
+        ok = 1.0 - inf
+        good_new = (good + 1.0) * ok            # reset to 0 on overflow
+        bad_new = (bad + 1.0) * inf             # reset to 0 on clean step
+        hit_incr = T.cast(
+            good_new >= float(self._incr_every_n_steps), "float32")
+        hit_decr = T.cast(
+            bad_new >= float(self._decr_every_n_nan_or_inf), "float32")
+        factor = (1.0 + hit_incr * (self._incr_ratio - 1.0)) * \
+                 (1.0 + hit_decr * (self._decr_ratio - 1.0))
+        scale_new = scale * factor
+        # never drop below a tiny floor
+        floor = T.fill_constant([1], "float32", 1e-8)
+        from ...layers.math import elementwise_max
+        scale_new = elementwise_max(scale_new, floor)
+        T.assign(scale_new, output=scale)
+        T.assign(good_new * (1.0 - hit_incr), output=good)
+        T.assign(bad_new * (1.0 - hit_decr), output=bad)
+
+    def apply_gradients(self, params_grads):
+        from ...layers import tensor as T
+        block = default_main_program().global_block()
+        mark = len(block.ops)
+        optimize_ops = self._optimizer.apply_gradients(params_grads)
+        if not self._use_dynamic_loss_scaling:
+            return optimize_ops  # no found_inf -> no rollback machinery
+
+        # roll back every persistable the optimizer wrote if grads
+        # overflowed: backup before the update, select after it
+        written = []
+        seen = set()
+        for op in block.ops[mark:]:
+            for n in op.output_arg_names:
+                if n in seen:
+                    continue
+                try:
+                    var = block.var(n)
+                except ValueError:
+                    continue
+                if var.persistable:
+                    seen.add(n)
+                    written.append(var)
+        with op_role_guard(OpRole.Optimize):
+            insert_at = mark
+            backups = {}
+            for var in written:
+                bname = unique_name.generate(f"{var.name}.amp_backup")
+                block.create_var(name=bname, shape=var.shape,
+                                 dtype=var.dtype, stop_gradient=True)
+                block._insert_op(insert_at, type="assign",
+                                 inputs={"X": [var.name]},
+                                 outputs={"Out": [bname]},
+                                 infer_shape=False)
+                insert_at += 1
+                backups[var.name] = bname
+            for var in written:
+                block.append_op(
+                    type="where",
+                    inputs={"Condition": [self._found_inf.name],
+                            "X": [backups[var.name]],
+                            "Y": [var.name]},
+                    outputs={"Out": [var.name]}, infer_shape=False)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        with program_guard(program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    # passthroughs
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, dest_dtype="bfloat16"):
+    """Wrap an optimizer for mixed-precision training (reference
+    decorator.py:430 decorate). dest_dtype defaults to bfloat16 — the TPU
+    MXU's native low-precision type; pass "float16" for fp16 parity."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+        dest_dtype=dest_dtype)

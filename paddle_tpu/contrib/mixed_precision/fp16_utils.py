@@ -1,0 +1,106 @@
+"""Program rewrite for mixed precision (reference:
+python/paddle/fluid/contrib/mixed_precision/fp16_utils.py:137
+rewrite_program — cast-op insertion per black/white lists).
+
+TPU-first: the rewrite inserts explicit `cast` ops into the IR (XLA fuses
+them into neighboring ops, so a cast costs nothing at the fusion boundary);
+parameters stay fp32 in the scope ("master weights") and are cast at their
+use sites — their gradients come back fp32 automatically because the cast
+op's vjp casts the cotangent up again. Run BEFORE append_backward so the
+whole backward graph inherits mixed dtypes through the vjp-derived grads.
+"""
+from ...framework.core import Operator, Variable
+from ...framework.dtype import convert_dtype
+
+
+def _is_float(dtype):
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32",
+                                    "float64")
+
+
+def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
+    """Insert casts so white-list ops (and gray ops fed by them) compute in
+    `dest_dtype` while black-list ops stay fp32. Mutates main_program."""
+    block = main_program.global_block()
+    old_ops = list(block.ops)
+    new_ops = []
+    cast_cache = {}   # (var_name, dtype) -> cast var name
+    cur_dtype = {}    # var name -> current runtime dtype string
+
+    def dtype_of(name):
+        if name in cur_dtype:
+            return cur_dtype[name]
+        try:
+            return convert_dtype(block.var(name).dtype)
+        except ValueError:
+            return None
+
+    def cast_to(name, dtype):
+        """Get-or-create `name` cast to dtype; emits the cast op."""
+        key = (name, dtype)
+        if key in cast_cache:
+            return cast_cache[key]
+        cast_name = f"{name}.cast_{dtype}"
+        src_var = block.var(name)
+        block.create_var(name=cast_name, shape=src_var.shape, dtype=dtype,
+                         stop_gradient=src_var.stop_gradient)
+        op = Operator(block, "cast",
+                      inputs={"X": [name]}, outputs={"Out": [cast_name]},
+                      attrs={"in_dtype": dtype_of(name),
+                             "out_dtype": dtype})
+        new_ops.append(op)
+        cast_cache[key] = cast_name
+        return cast_name
+
+    for op in old_ops:
+        kind = amp_lists.classify(op.type)
+        if kind == "skip":
+            new_ops.append(op)
+            for n in op.output_arg_names:
+                cur_dtype.pop(n, None)
+            continue
+
+        float_in_dtypes = [dtype_of(n) for n in op.input_arg_names
+                           if dtype_of(n) in ("float32", dest_dtype)]
+        if kind == "white":
+            compute = dest_dtype
+        elif kind == "black":
+            compute = "float32"
+        else:  # gray: follow producers
+            compute = dest_dtype if dest_dtype in float_in_dtypes \
+                else "float32"
+        if any(n in amp_lists.black_varnames for n in op.input_arg_names):
+            compute = "float32"
+
+        changed = False
+        new_inputs = {}
+        for slot, names in op.inputs.items():
+            renamed = []
+            for n in names:
+                d = dtype_of(n)
+                if d in ("float32", dest_dtype) and d != compute:
+                    renamed.append(cast_to(n, compute))
+                    changed = True
+                else:
+                    renamed.append(n)
+            new_inputs[slot] = renamed
+        if changed:
+            op.inputs = new_inputs
+
+        new_ops.append(op)
+        if compute == dest_dtype:
+            for n in op.output_arg_names:
+                try:
+                    var = block.var(n)
+                except ValueError:
+                    continue
+                if _is_float(var.dtype):
+                    var.dtype = dest_dtype
+                    cur_dtype[n] = dest_dtype
+        else:
+            for n in op.output_arg_names:
+                cur_dtype.pop(n, None)
+
+    block.ops = new_ops
+    main_program._bump_version()
+    return main_program

@@ -9,4 +9,9 @@ from .nn import (  # noqa: F401
     Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout,
 )
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay,
+    LinearLrWarmup, ReduceLROnPlateau,
+)
 from .parallel import DataParallel, ParallelStrategy, prepare_context, Env  # noqa: F401

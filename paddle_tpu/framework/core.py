@@ -34,6 +34,20 @@ class OpRole:
     Collective = 6
 
 
+_op_role_stack = [OpRole.Forward]
+
+
+@contextlib.contextmanager
+def op_role_guard(role):
+    """Ops appended inside this context default to `role` (the reference
+    marks LR-scheduler ops via program._lr_schedule_guard the same way)."""
+    _op_role_stack.append(role)
+    try:
+        yield
+    finally:
+        _op_role_stack.pop()
+
+
 class VarType:
     LOD_TENSOR = "dense"          # dense tensor (LoDTensor w/o lod)
     SELECTED_ROWS = "selected_rows"  # sparse row-set (ids, rows)
@@ -129,7 +143,7 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
-        self.attrs.setdefault(OP_ROLE_KEY, OpRole.Forward)
+        self.attrs.setdefault(OP_ROLE_KEY, _op_role_stack[-1])
 
     def input(self, slot):
         return self.inputs.get(slot, [])

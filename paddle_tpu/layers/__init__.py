@@ -10,3 +10,9 @@ from .control_flow import (  # noqa: F401
 )
 from . import nn, tensor, loss, math, control_flow  # noqa: F401
 from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,
+    autoincreased_step_counter,
+)
+from . import learning_rate_scheduler  # noqa: F401

@@ -1,0 +1,152 @@
+"""In-graph learning-rate schedulers.
+
+Capability parity with
+/root/reference/python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam_decay :63, exponential_decay :113, natural_exp_decay :171,
+inverse_time_decay :229, polynomial_decay :288, piecewise_decay :358,
+cosine_decay :410, linear_lr_warmup :446). Each scheduler appends
+LRSched-role ops that read an auto-incremented persistable step counter and
+compute the LR as part of the same compiled step — one XLA module, no host
+round-trip per step, and clone(for_test) drops the whole scheduler with the
+other non-Forward roles.
+"""
+import math
+
+from ..framework.core import OpRole, op_role_guard, default_main_program
+from ..framework.initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from . import tensor
+from . import nn as nn_layers
+from .math import less_than, elementwise_min, elementwise_max
+
+LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable step counter, +`step` on every executor run of the
+    program (reference layers/nn.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or LR_COUNTER_NAME
+    gblock = default_main_program().global_block()
+    if name in gblock.vars:
+        return gblock.vars[name]
+    counter = gblock.create_var(
+        name=name, shape=[1], dtype="float32", persistable=True,
+        stop_gradient=True)
+    ConstantInitializer(float(begin - step))(counter)
+    helper.append_op(type="increment", inputs={"X": [counter]},
+                     outputs={"Out": [counter]},
+                     attrs={"step": float(step)})
+    return counter
+
+
+def _decay_step_counter(begin=0):
+    """First executor run observes `begin`, then begin+1, ... (reference
+    semantics: counter initialized to begin-1, incremented before use)."""
+    with op_role_guard(OpRole.LRSched):
+        return autoincreased_step_counter(begin=begin, step=1)
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """lr = learning_rate * d_model^-0.5 * min(step^-0.5,
+    step * warmup_steps^-1.5) — reference :63."""
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter(begin=1)
+        a = nn_layers.rsqrt(step)
+        b = step * (float(warmup_steps) ** -1.5)
+        lr = (float(learning_rate) * float(d_model) ** -0.5) * \
+            elementwise_min(a, b)
+        return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = nn_layers.floor(div)
+        rate = tensor.fill_constant([1], "float32", float(decay_rate))
+        return float(learning_rate) * (rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = nn_layers.floor(div)
+        return float(learning_rate) * nn_layers.exp(
+            div * (-float(decay_rate)))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        div = step / float(decay_steps)
+        if staircase:
+            div = nn_layers.floor(div)
+        denom = div * float(decay_rate) + 1.0
+        return float(learning_rate) / denom
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        if cycle:
+            div = nn_layers.ceil(step / float(decay_steps))
+            # at step 0, divisor must be 1 not 0
+            one = tensor.fill_constant([1], "float32", 1.0)
+            div = elementwise_max(div, one)
+            decay_var = div * float(decay_steps)
+        else:
+            decay_var = tensor.fill_constant([1], "float32",
+                                             float(decay_steps))
+            step = elementwise_min(step, decay_var)
+        one = tensor.fill_constant([1], "float32", 1.0)
+        frac = nn_layers.pow(one - step / decay_var, float(power))
+        return (float(learning_rate) - float(end_learning_rate)) * frac + \
+            float(end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """values[i] while step < boundaries[i]; values[-1] after — :358."""
+    assert len(values) == len(boundaries) + 1
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        lr = tensor.fill_constant([1], "float32", float(values[-1]))
+        for b, v in reversed(list(zip(boundaries, values[:-1]))):
+            bvar = tensor.fill_constant([1], "float32", float(b))
+            below = tensor.cast(less_than(step, bvar), "float32")
+            lr = below * float(v) + (1.0 - below) * lr
+        return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr/2 * (cos(epoch * pi / epochs) + 1) — :410."""
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        epoch = nn_layers.floor(step / float(step_each_epoch))
+        return 0.5 * float(learning_rate) * (
+            nn_layers.cos(epoch * (math.pi / float(epochs))) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Linear ramp start_lr -> end_lr over warmup_steps, then the wrapped
+    schedule (a float or an LR Variable) — :446."""
+    with op_role_guard(OpRole.LRSched):
+        step = _decay_step_counter()
+        wsteps = tensor.fill_constant([1], "float32", float(warmup_steps))
+        in_warmup = tensor.cast(less_than(step, wsteps),
+                                "float32")
+        warm = float(start_lr) + (float(end_lr) - float(start_lr)) * \
+            (step / float(warmup_steps))
+        if not isinstance(learning_rate, float):
+            base = learning_rate
+        else:
+            base = tensor.fill_constant([1], "float32",
+                                        float(learning_rate))
+        return in_warmup * warm + (1.0 - in_warmup) * base

@@ -1,0 +1,253 @@
+"""AMP (bf16 mixed precision) + learning-rate scheduler tests.
+
+Mirrors the reference's test intent
+(tests/unittests/test_fp16_utils.py-style AMP rewrite checks,
+test_learning_rate_scheduler.py numeric schedule checks)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import mixed_precision as mp
+
+
+def _mlp_with_loss():
+    x = fluid.data("x", [-1, 16], "float32")
+    y = fluid.data("y", [-1, 1], "int64")
+    h = layers.fc(x, 32, act="relu")
+    logits = layers.fc(h, 4)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    return x, y, logits, loss
+
+
+def _batch(i=0):
+    rng = np.random.default_rng(i)
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    y = x[:, :4].argmax(1)[:, None].astype(np.int64)
+    return {"x": x, "y": y}
+
+
+# ---------------------------------------------------------------------------
+# AMP
+# ---------------------------------------------------------------------------
+
+def test_amp_rewrite_casts_matmuls_to_bf16():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _mlp_with_loss()
+        opt = mp.decorate(fluid.optimizer.SGDOptimizer(0.1),
+                          init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+    blk = main.global_block()
+    # every forward mul consumes bf16 inputs now
+    muls = [op for op in blk.ops if op.type == "mul"]
+    assert muls
+    for op in muls:
+        for n in op.input_arg_names:
+            assert blk.var(n).dtype == "bfloat16", (op, n)
+    # loss stays fp32 (softmax_with_cross_entropy/mean are black)
+    assert blk.var(loss.name).dtype == "float32"
+    # params themselves stay fp32 master copies
+    for p in main.all_parameters():
+        assert p.dtype == "float32"
+
+
+def test_amp_training_converges():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _mlp_with_loss()
+        opt = mp.decorate(fluid.optimizer.AdamOptimizer(0.01))
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=_batch(), fetch_list=[loss])[0])
+                  for _ in range(40)]
+    assert losses[-1] < 0.3 * losses[0]
+
+
+def test_amp_overflow_skips_update_and_decays_scale():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _mlp_with_loss()
+        loss = loss * 100.0  # guarantee loss * 1e38 overflows float32
+        opt = mp.decorate(fluid.optimizer.SGDOptimizer(0.1),
+                          init_loss_scaling=1e38,
+                          decr_every_n_nan_or_inf=1, decr_ratio=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pname = main.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(pname)).copy()
+        _, s = exe.run(main, feed=_batch(),
+                       fetch_list=[loss, opt.get_loss_scaling()])
+        # overflow: params untouched, scale decayed
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(pname)), w0)
+        assert float(np.asarray(s).reshape(())) < 1e38
+        # once scale is finite-safe, updates resume
+        for _ in range(5):
+            exe.run(main, feed=_batch(), fetch_list=[loss])
+        assert not np.array_equal(np.asarray(scope.find_var(pname)), w0)
+
+
+def test_amp_matches_fp32_loss_roughly():
+    """bf16 AMP loss should track the fp32 loss closely for a few steps."""
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            x, y, logits, loss = _mlp_with_loss()
+            opt = fluid.optimizer.SGDOptimizer(0.05)
+            if amp:
+                opt = mp.decorate(opt, init_loss_scaling=1.0,
+                                  use_dynamic_loss_scaling=False)
+            opt.minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return [float(exe.run(main, feed=_batch(i),
+                                  fetch_list=[loss])[0]) for i in range(5)]
+    fp32 = run(False)
+    bf16 = run(True)
+    np.testing.assert_allclose(bf16, fp32, rtol=0.1, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# in-graph LR schedulers
+# ---------------------------------------------------------------------------
+
+def _run_scheduler(build_fn, steps):
+    """Build lr=build_fn() in a program, run `steps` times, return lr trace."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = build_fn()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    vals = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            v, = exe.run(main, fetch_list=[lr])
+            vals.append(float(np.asarray(v).reshape(-1)[0]))
+    return vals
+
+
+def test_noam_decay_values():
+    d_model, warmup = 64, 4
+    got = _run_scheduler(lambda: layers.noam_decay(d_model, warmup), 8)
+    want = [(d_model ** -0.5) * min(s ** -0.5, s * warmup ** -1.5)
+            for s in range(1, 9)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay_values():
+    got = _run_scheduler(
+        lambda: layers.piecewise_decay([3, 6], [1.0, 0.5, 0.1]), 8)
+    want = [1.0, 1.0, 1.0, 0.5, 0.5, 0.5, 0.1, 0.1]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_exponential_decay_values():
+    got = _run_scheduler(
+        lambda: layers.exponential_decay(0.1, 2, 0.5, staircase=True), 5)
+    want = [0.1 * 0.5 ** math.floor(s / 2) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_decay_values():
+    got = _run_scheduler(
+        lambda: layers.polynomial_decay(0.1, 4, end_learning_rate=0.01,
+                                        power=1.0), 7)
+    want = []
+    for s in range(7):
+        n = min(s, 4)
+        want.append((0.1 - 0.01) * (1 - n / 4) + 0.01)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cosine_decay_values():
+    got = _run_scheduler(lambda: layers.cosine_decay(0.1, 2, 4), 6)
+    want = [0.05 * (math.cos(math.floor(s / 2) * math.pi / 4) + 1)
+            for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_lr_warmup_then_base():
+    got = _run_scheduler(
+        lambda: layers.linear_lr_warmup(0.1, 4, 0.0, 0.1), 7)
+    want = [0.1 * s / 4 for s in range(4)] + [0.1] * 3
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_scheduler_drives_optimizer_and_survives_checkpoint(tmp_path):
+    """LR var feeds the optimizer; counter persists through save/load."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        yv = fluid.data("yv", [-1, 1], "float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(pred - yv))
+        lr = layers.piecewise_decay([2], [0.1, 0.0])  # lr -> 0 after step 2
+        fluid.optimizer.SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    feed = {"x": np.ones((4, 4), np.float32),
+            "yv": np.zeros((4, 1), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        exe.run(main, feed=feed, fetch_list=[loss])
+        ckpt = str(tmp_path / "lr_ckpt")
+        fluid.save_persistables(exe, ckpt, main_program=main)
+        pname = main.all_parameters()[0].name
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.load_persistables(exe, ckpt, main_program=main)
+        w_before = np.asarray(scope2.find_var(pname)).copy()
+        # counter resumed at 2 -> lr is 0 -> weights frozen
+        exe.run(main, feed=feed, fetch_list=[loss])
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var(pname)), w_before)
+
+
+# ---------------------------------------------------------------------------
+# dygraph schedulers
+# ---------------------------------------------------------------------------
+
+def test_dygraph_noam_matches_formula():
+    from paddle_tpu import dygraph
+    sched = dygraph.NoamDecay(64, 4)
+    got = [sched() for _ in range(6)]
+    want = [(64 ** -0.5) * min(s ** -0.5, s * 4 ** -1.5)
+            for s in range(1, 7)]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_dygraph_piecewise_in_optimizer():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 1, bias_attr=False)
+        sched = dygraph.PiecewiseDecay([1], [1000.0, 0.0], begin=0)
+        opt = fluid.optimizer.SGDOptimizer(
+            sched, parameter_list=lin.parameters())
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        w0 = lin.weight.numpy().copy()
+        loss = layers.reduce_sum(lin(x))
+        loss.backward()
+        opt.minimize(loss)      # lr=1000 -> big move
+        w1 = lin.weight.numpy().copy()
+        assert np.abs(w1 - w0).max() > 1.0
+        lin.clear_gradients()
+        loss = layers.reduce_sum(lin(x))
+        loss.backward()
+        opt.minimize(loss)      # lr=0 -> frozen
+        np.testing.assert_array_equal(lin.weight.numpy(), w1)
