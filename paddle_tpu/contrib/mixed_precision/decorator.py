@@ -12,12 +12,9 @@ On TPU the default compute dtype is bfloat16: same exponent range as fp32,
 so loss scaling rarely triggers — but the machinery is kept for fp16 parity
 and for exactness of the capability contract.
 """
-import numpy as np
-
 from ...framework import unique_name
 from ...framework.core import (OpRole, op_role_guard, program_guard,
                                default_startup_program, default_main_program)
-from ...framework.initializer import ConstantInitializer
 from .fp16_lists import AutoMixedPrecisionLists
 from .fp16_utils import rewrite_program
 
@@ -141,48 +138,14 @@ class OptimizerWithMixedPrecision:
         T.assign(bad_new * (1.0 - hit_decr), output=bad)
 
     def apply_gradients(self, params_grads):
-        from ...layers import tensor as T
+        from ...optimizer import rollback_updates_if
         block = default_main_program().global_block()
         mark = len(block.ops)
         optimize_ops = self._optimizer.apply_gradients(params_grads)
         if not self._use_dynamic_loss_scaling:
             return optimize_ops  # no found_inf -> no rollback machinery
-
-        # roll back every persistable the optimizer wrote if grads
-        # overflowed: backup before the update, select after it
-        written = []
-        seen = set()
-        for op in block.ops[mark:]:
-            for n in op.output_arg_names:
-                if n in seen:
-                    continue
-                try:
-                    var = block.var(n)
-                except ValueError:
-                    continue
-                if var.persistable:
-                    seen.add(n)
-                    written.append(var)
-        with op_role_guard(OpRole.Optimize):
-            insert_at = mark
-            backups = {}
-            for var in written:
-                bname = unique_name.generate(f"{var.name}.amp_backup")
-                block.create_var(name=bname, shape=var.shape,
-                                 dtype=var.dtype, stop_gradient=True)
-                block._insert_op(insert_at, type="assign",
-                                 inputs={"X": [var.name]},
-                                 outputs={"Out": [bname]},
-                                 infer_shape=False)
-                insert_at += 1
-                backups[var.name] = bname
-            for var in written:
-                block.append_op(
-                    type="where",
-                    inputs={"Condition": [self._found_inf.name],
-                            "X": [backups[var.name]],
-                            "Y": [var.name]},
-                    outputs={"Out": [var.name]}, infer_shape=False)
+        # roll back every persistable the optimizer wrote if grads overflowed
+        rollback_updates_if(block, mark, self._found_inf)
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

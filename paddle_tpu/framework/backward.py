@@ -32,9 +32,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     """Append grad ops computing d loss / d params; returns [(param, grad)].
 
     `checkpoints`: optional list of Variables; when set, activates recompute
-    semantics (reference RecomputeOptimizer optimizer.py:3854) — on TPU this
-    maps to jax.checkpoint policies at lowering time, so here we only record
-    the checkpoint names on the program for the lowering to consume.
+    (reference RecomputeOptimizer optimizer.py:3854): each checkpoint-
+    delimited forward segment is re-emitted just before its grad ops behind
+    a recompute_barrier (see the emission below), so the backward reads
+    recomputed activations and only checkpoints stay live across the
+    forward->backward gap.
     """
     block = loss.block
     program = block.program
@@ -43,9 +45,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     for n in (no_grad_set or ()):
         no_grad.add(n.name if isinstance(n, Variable) else n)
 
-    if checkpoints:
-        program._recompute_checkpoints = [
-            c.name if isinstance(c, Variable) else c for c in checkpoints]
+    ckpt_names = [c.name if isinstance(c, Variable) else c
+                  for c in (checkpoints or [])]
 
     # ---- forward pass: which vars can carry gradient flow ----
     flows = set()
@@ -117,7 +118,89 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         grad_map[var_name] = [out]
         return out
 
+    # ---- recompute (reference _append_backward_ops_with_checkpoints_,
+    # backward.py:629): re-emit each checkpoint-delimited forward segment
+    # just before its grad ops, reading stored checkpoints through a
+    # recompute_barrier so XLA cannot CSE the re-emission back into the
+    # original forward (which would undo the memory saving). Grad-op primal
+    # inputs are rewired onto the recomputed names; gradient names and
+    # accumulation stay on the original vars.
+    rc_map = {}          # original var name -> recomputed name
+    seg_of = {}          # id(op) -> segment index
+    seg_emitted = set()  # segments whose recompute ops are already emitted
+    segments = []        # seg idx -> list of fwd ops
+    if ckpt_names:
+        ckpt_set = set(ckpt_names)
+        seg = 0
+        cur = []
+        for op in fwd_ops:
+            cur.append(op)
+            seg_of[id(op)] = seg
+            if any(n in ckpt_set for n in op.output_arg_names):
+                segments.append(cur)
+                cur = []
+                seg += 1
+        segments.append(cur)      # trailing segment (after last checkpoint)
+        last_seg = len(segments) - 1
+        seg_emitted.add(last_seg)  # its activations are still live — reuse
+
+        def emit_recompute(seg_idx):
+            ops_in_seg = segments[seg_idx]
+            interior = set()
+            for op in ops_in_seg:
+                interior.update(op.output_arg_names)
+            # external reads: stored values (checkpoints, data, params);
+            # barrier the non-persistable ones to break CSE identity
+            external = []
+            for op in ops_in_seg:
+                for n in op.input_arg_names:
+                    if n in interior or n in rc_map or n in external:
+                        continue
+                    try:
+                        var = block.var(n)
+                    except ValueError:
+                        continue
+                    if not var.persistable:
+                        external.append(n)
+            if external:
+                bnames = []
+                for n in external:
+                    v = block.var(n)
+                    bn = f"{n}@RC_IN@{seg_idx}"
+                    block.create_var(name=bn, shape=v.shape, dtype=v.dtype,
+                                     stop_gradient=True)
+                    bnames.append(bn)
+                    rc_map[n] = bn
+                block.append_op(
+                    type="recompute_barrier",
+                    inputs={"X": list(external)}, outputs={"Out": bnames},
+                    attrs={OP_ROLE_KEY: OpRole.Backward}, infer_shape=False)
+            for op in ops_in_seg:
+                new_ins = {s: [rc_map.get(n, n) for n in ns]
+                           for s, ns in op.inputs.items()}
+                new_outs = {}
+                for s, ns in op.outputs.items():
+                    outs = []
+                    for n in ns:
+                        rn = f"{n}@RECOMPUTE"
+                        v = block.var(n)
+                        block.create_var(name=rn, shape=v.shape,
+                                         dtype=v.dtype, stop_gradient=True)
+                        rc_map[n] = rn
+                        outs.append(rn)
+                    new_outs[s] = outs
+                attrs = dict(op.attrs)
+                attrs[OP_ROLE_KEY] = OpRole.Backward
+                block.append_op(type=op.type, inputs=new_ins,
+                                outputs=new_outs, attrs=attrs,
+                                infer_shape=False)
+
     for op in emit_plan:
+        if ckpt_names:
+            seg_idx = seg_of.get(id(op))
+            if seg_idx is not None and seg_idx not in seg_emitted:
+                emit_recompute(seg_idx)
+                seg_emitted.add(seg_idx)
         # upstream grads of this op's outputs (all consumers already done).
         # A slot's grad list is pruned of missing entries; positional
         # alignment is carried by __out_grad_mask__.
@@ -151,8 +234,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
         # grad op inputs = forward inputs (full, for vjp primals) + upstream
         # grads; forward *outputs* are not needed — the vjp recomputes them
-        # and XLA CSE dedupes against the forward trace.
-        inputs = {**{s: list(ns) for s, ns in op.inputs.items()}, **g_ins}
+        # and XLA CSE dedupes against the forward trace. Under recompute the
+        # primals come from the re-emitted (barrier-pinned) segment instead.
+        inputs = {**{s: [rc_map.get(n, n) for n in ns]
+                     for s, ns in op.inputs.items()}, **g_ins}
 
         block.append_op(
             type=op.type + "_grad",

@@ -156,11 +156,13 @@ def bert_encoder(cfg, src_ids, sent_ids, pos_ids, input_mask, is_test=False,
 
     from ..layers.collective import shard
     x = emb
+    checkpoints = []
     for i in range(cfg.num_layers):
         if sp_shard:
             x = shard(x, "dp", "sp", None)
         x = encoder_layer(cfg, x, attn_bias, i, is_test)
-    return x
+        checkpoints.append(x)
+    return x, checkpoints
 
 
 def bert_pretrain(cfg, batch_size, seq_len, max_preds, is_test=False,
@@ -178,8 +180,9 @@ def bert_pretrain(cfg, batch_size, seq_len, max_preds, is_test=False,
                         dtype="int32")
     labels = T.data("labels", [batch_size, 1], dtype="int32")
 
-    enc = bert_encoder(cfg, src_ids, sent_ids, pos_ids, input_mask,
-                       is_test=is_test, sp_shard=sp_shard)     # [B,S,H]
+    enc, checkpoints = bert_encoder(cfg, src_ids, sent_ids, pos_ids,
+                                    input_mask, is_test=is_test,
+                                    sp_shard=sp_shard)          # [B,S,H]
 
     # ---- masked LM head (weight-tied to word_embedding) ----
     flat = T.reshape(enc, [-1, cfg.hidden_size])               # [B*S, H]
@@ -224,7 +227,8 @@ def bert_pretrain(cfg, batch_size, seq_len, max_preds, is_test=False,
     loss = M.elementwise_add(mlm_loss, nsp_loss)
     return {"feeds": [src_ids, sent_ids, pos_ids, input_mask, mask_pos,
                       mask_label, labels],
-            "loss": loss, "mlm_loss": mlm_loss, "nsp_acc": nsp_acc}
+            "loss": loss, "mlm_loss": mlm_loss, "nsp_acc": nsp_acc,
+            "checkpoints": checkpoints}
 
 
 # ---- tensor-parallel sharding annotation (Megatron-style over "tp") ----

@@ -507,3 +507,15 @@ def feed(ctx, ins, attrs):
 @register_op("fetch", grad=False, infer_shape=False)
 def fetch(ctx, ins, attrs):
     return {"Out": x_of(ins)}
+
+
+@register_op("recompute_barrier", grad=False, infer_shape=False)
+def recompute_barrier(ctx, ins, attrs):
+    """Identity that XLA may not optimize across: pins recomputed forward
+    segments apart from the original forward so CSE can't re-materialize the
+    activations that recompute (reference RecomputeOptimizer semantics,
+    optimizer.py:3854) is trying to free. Same mechanism jax.checkpoint uses
+    on its residuals."""
+    xs = tuple(ins["X"])
+    outs = jax.lax.optimization_barrier(xs)
+    return {"Out": list(outs)}

@@ -546,6 +546,160 @@ class DpsgdOptimizer(Optimizer):
                    "sigma": self._sigma}, infer_shape=False)
 
 
+def rollback_updates_if(block, mark, cond_var):
+    """Make the optimizer ops appended at block.ops[mark:] conditional:
+    every persistable they wrote is snapshot before the update and restored
+    via `where(cond, backup, new)` after it. Shared by AMP's overflow skip
+    and GradientMergeOptimizer's k-step gating — the TPU replacement for
+    the reference's conditional optimize blocks (XLA has no cheap dynamic
+    skip; a select over donated buffers fuses to almost nothing)."""
+    from .framework.core import op_role_guard
+    written = []
+    seen = set()
+    for op in block.ops[mark:]:
+        for n in op.output_arg_names:
+            if n in seen:
+                continue
+            try:
+                var = block.var(n)
+            except ValueError:
+                continue
+            if var.persistable:
+                seen.add(n)
+                written.append(var)
+    with op_role_guard(OpRole.Optimize):
+        insert_at = mark
+        backups = {}
+        for var in written:
+            bname = unique_name.generate(f"{var.name}.rollback")
+            block.create_var(name=bname, shape=var.shape, dtype=var.dtype,
+                             stop_gradient=True)
+            block._insert_op(insert_at, type="assign",
+                             inputs={"X": [var.name]},
+                             outputs={"Out": [bname]}, infer_shape=False)
+            insert_at += 1
+            backups[var.name] = bname
+        for var in written:
+            block.append_op(
+                type="where",
+                inputs={"Condition": [cond_var.name],
+                        "X": [backups[var.name]], "Y": [var.name]},
+                outputs={"Out": [var.name]}, infer_shape=False)
+    return written
+
+
+class RecomputeOptimizer:
+    """Activation checkpointing (reference optimizer.py:3854): backward
+    re-computes each checkpoint-delimited forward segment instead of storing
+    its activations. See framework/backward.py recompute emission."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def load(self, state):  # reference API parity (raises there too)
+        raise NotImplementedError(
+            "RecomputeOptimizer.load is not supported (matches reference)")
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        assert self._checkpoints, "call _set_checkpoints(...) first"
+        parameter_list = parameter_list or \
+            getattr(self._optimizer, "_parameter_list", None)
+        return append_backward(loss, parameter_list, no_grad_set, callbacks,
+                               checkpoints=self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            optimize_ops = self._optimizer.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation over k steps (the reference's batch-merge
+    capability, ir/multi_batch_merge_pass.cc / 2.0 GradientMergeOptimizer):
+    grads accumulate into persistable buffers every step; the wrapped
+    optimizer's update applies only on every k-th step and the buffers
+    reset. Implemented with in-graph selects, so a step is still ONE
+    compiled XLA module."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard, op_role_guard
+        from .layers import tensor as T
+        from .layers.math import equal, logical_not
+        program = loss.block.program
+        block = program.global_block()
+        with program_guard(program,
+                           startup_program or default_startup_program()):
+            params_grads = self._inner.backward(
+                loss, startup_program, parameter_list, no_grad_set)
+            with op_role_guard(OpRole.Backward):
+                # step counter modulo k
+                ctr = T.create_global_var([1], 0.0, "float32",
+                                          persistable=True,
+                                          name=unique_name.generate(
+                                              "grad_merge_step"))
+                new_ctr = ctr + 1.0
+                kconst = T.fill_constant([1], "float32", float(self.k_steps))
+                ready = equal(new_ctr, kconst)
+                T.assign(new_ctr - T.cast(ready, "float32") * kconst,
+                         output=ctr)
+                merged = []
+                accs = []
+                for p, g in params_grads:
+                    acc = block.create_var(
+                        name=unique_name.generate(f"{p.name}@GradMerge"),
+                        shape=p.shape, dtype=g.dtype, persistable=True,
+                        stop_gradient=True)
+                    from .framework.initializer import ConstantInitializer
+                    ConstantInitializer(0.0)(acc)
+                    summed = g + acc
+                    T.assign(summed, output=acc)
+                    use = summed / float(self.k_steps) if self.avg else summed
+                    merged.append((p, use))
+                    accs.append(acc)
+            mark = len(block.ops)
+            optimize_ops = self._inner.apply_gradients(merged)
+            not_ready = logical_not(ready)
+            rollback_updates_if(block, mark, not_ready)
+            with op_role_guard(OpRole.Optimize):
+                # reset accumulators after an applied update
+                for acc in accs:
+                    zeros = T.fill_constant(list(acc.shape), acc.dtype, 0.0)
+                    block.append_op(
+                        type="where",
+                        inputs={"Condition": [ready.name],
+                                "X": [zeros.name], "Y": [acc.name]},
+                        outputs={"Out": [acc.name]}, infer_shape=False)
+        return optimize_ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
 # fluid-style aliases
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer

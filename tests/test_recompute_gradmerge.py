@@ -1,0 +1,204 @@
+"""Recompute (activation checkpointing) + gradient accumulation tests.
+
+Reference intent: RecomputeOptimizer (optimizer.py:3854 +
+backward.py:629 _append_backward_ops_with_checkpoints_) and the
+batch-merge pass (ir/multi_batch_merge_pass.cc,
+test_dist_mnist_batch_merge.py)."""
+import numpy as np
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework.lowering import analyze_block_io, build_block_fn
+
+
+def _deep_mlp(use_recompute, every=2, n_layers=6, seed=1):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 64], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        h = x
+        ckpts = []
+        for _ in range(n_layers):
+            h = layers.fc(h, 64, act="relu")
+            ckpts.append(h)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(pred - y))
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+        if use_recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(opt)
+            opt._set_checkpoints(ckpts[every - 1::every])
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.randn(8, 64).astype(np.float32),
+            "y": np.zeros((8, 1), np.float32)}
+
+
+def _stablehlo(main, loss, feed, scope):
+    state = {k: v for k, v in scope.items() if not k.startswith("@")}
+    state_in, state_out = analyze_block_io(main, 0, list(feed))
+    fn = build_block_fn(main, 0, list(feed), [loss.name], state_in,
+                        state_out)
+    sos = set(state_out)
+    smut = {n: state[n] for n in state_in if n in state and n in sos}
+    sro = {n: state[n] for n in state_in if n in state and n not in sos}
+    return jax.jit(fn).lower(smut, sro, feed,
+                             jax.random.PRNGKey(0)).as_text()
+
+
+def test_recompute_exact_loss_parity():
+    feed = _feed()
+    traces = {}
+    for rc in (False, True):
+        main, startup, loss = _deep_mlp(rc)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            traces[rc] = [float(exe.run(main, feed=feed,
+                                        fetch_list=[loss])[0])
+                          for _ in range(4)]
+    np.testing.assert_allclose(traces[True], traces[False], rtol=1e-6)
+
+
+def test_recompute_reemits_segments_behind_barrier():
+    """The backward must read RE-computed activations: the emitted module
+    contains the duplicated forward matmuls pinned behind
+    optimization_barrier (the jax.checkpoint mechanism; whether a backend's
+    scheduler exploits it is XLA's concern, as with jax.checkpoint)."""
+    feed = _feed()
+    hlos = {}
+    for rc in (False, True):
+        main, startup, loss = _deep_mlp(rc)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        hlos[rc] = _stablehlo(main, loss, feed, scope)
+        if rc:
+            blk = main.global_block()
+            barriers = [op for op in blk.ops
+                        if op.type == "recompute_barrier"]
+            assert barriers, "no recompute_barrier ops emitted"
+            grad_reads = [n for op in blk.ops if op.type.endswith("_grad")
+                          for n in op.input_arg_names]
+            assert any("@RECOMPUTE" in n for n in grad_reads), \
+                "grad ops do not consume recomputed activations"
+    assert hlos[True].count("dot_general") > hlos[False].count("dot_general")
+    assert "optimization_barrier" in hlos[True]
+    assert "optimization_barrier" not in hlos[False]
+
+
+def test_recompute_with_dropout_mask_consistency():
+    """Stochastic ops re-execute with the same per-op seed, so the
+    recomputed forward sees the identical dropout mask — grads must equal
+    the non-recompute program's grads exactly."""
+    def build(rc):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 32], "float32")
+            h = layers.fc(x, 32, act="relu")
+            h = layers.dropout(h, dropout_prob=0.5, seed=123)
+            h2 = layers.fc(h, 32, act="relu")
+            loss = layers.mean(layers.square(layers.fc(h2, 1)))
+            opt = fluid.optimizer.SGDOptimizer(0.5)
+            if rc:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints([h])
+            opt.minimize(loss)
+        return main, startup, loss
+
+    feed = {"x": np.random.RandomState(3).randn(8, 32).astype(np.float32)}
+    outs = {}
+    for rc in (False, True):
+        main, startup, loss = build(rc)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            outs[rc] = [float(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0])
+                        for _ in range(3)]
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-6)
+
+
+def test_gradient_merge_applies_every_k_steps():
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 4
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 8], "float32")
+            y = fluid.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            loss = layers.mean(layers.square(pred - y))
+            opt = fluid.optimizer.GradientMergeOptimizer(
+                fluid.optimizer.SGDOptimizer(0.1), k_steps=3, avg=True)
+            opt.minimize(loss)
+        return main, startup, loss
+
+    feed = {"x": np.ones((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pname = main.all_parameters()[0].name
+        w0 = np.asarray(scope.find_var(pname)).copy()
+        exe.run(main, feed=feed, fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(scope.find_var(pname)), w0)
+        exe.run(main, feed=feed, fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(scope.find_var(pname)), w0)
+        exe.run(main, feed=feed, fetch_list=[loss])  # 3rd step: update
+        w3 = np.asarray(scope.find_var(pname))
+        assert not np.array_equal(w3, w0)
+        # next cycle gates again
+        exe.run(main, feed=feed, fetch_list=[loss])
+        np.testing.assert_array_equal(np.asarray(scope.find_var(pname)), w3)
+
+
+def test_gradient_merge_avg_matches_plain_step():
+    """k identical batches with avg=True == one plain step on that batch."""
+    def build(merge):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 4
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 8], "float32")
+            y = fluid.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            loss = layers.mean(layers.square(pred - y))
+            opt = fluid.optimizer.SGDOptimizer(0.1)
+            if merge:
+                opt = fluid.optimizer.GradientMergeOptimizer(
+                    opt, k_steps=3, avg=True)
+            opt.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(4, 8).astype(np.float32),
+            "y": rng.randn(4, 1).astype(np.float32)}
+
+    main, startup, loss = build(True)
+    exe = fluid.Executor()
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        pname = main.all_parameters()[0].name
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        w_merge = np.asarray(s1.find_var(pname)).copy()
+
+    main2, startup2, loss2 = build(False)
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        exe.run(startup2)
+        pname2 = main2.all_parameters()[0].name
+        exe.run(main2, feed=feed, fetch_list=[loss2])
+        w_plain = np.asarray(s2.find_var(pname2))
+    np.testing.assert_allclose(w_merge, w_plain, rtol=1e-5)
