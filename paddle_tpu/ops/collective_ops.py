@@ -17,20 +17,39 @@ from ..framework.registry import register_op
 from .common import x_of
 
 
+# Explicit ring_id -> mesh-axis-name registry (the TPU analog of the
+# reference's NCCLCommContext ring registry, platform/collective_helper.h:62).
+# Populated by c_comm_init (axis_name attr) or register_ring(); ring 0
+# defaults to the data-parallel axis.
+_RING_AXES = {}
+
+
+def register_ring(ring_id, axis_name):
+    """Bind a reference-style ring_id to a mesh axis name."""
+    _RING_AXES[int(ring_id)] = axis_name
+
+
 def _ring_axis(ctx, attrs):
     """Map the reference's ring_id to a mesh axis name. Explicit
-    `axis_name` attr wins; ring 0 defaults to the data-parallel axis."""
+    `axis_name` attr wins, then the ring registry; ring 0 defaults to the
+    data-parallel axis. Unregistered ring_id>0 is an error rather than a
+    silent guess."""
     name = attrs.get("axis_name")
     if name:
         return name
     ring = attrs.get("ring_id", 0)
-    mesh = ctx.mesh
-    if mesh is not None:
-        names = list(mesh.axis_names)
-        if ring < len(names):
-            return names[ring] if "dp" not in names else (
-                "dp" if ring == 0 else names[ring])
-    return "dp" if ring == 0 else f"ring{ring}"
+    if ring in _RING_AXES:
+        return _RING_AXES[ring]
+    if ring == 0:
+        mesh = ctx.mesh
+        if mesh is not None and "dp" not in mesh.axis_names:
+            return mesh.axis_names[0]
+        return "dp"
+    raise ValueError(
+        f"ring_id {ring} has no mesh axis bound — pass axis_name on the "
+        f"collective op or call paddle_tpu.ops.collective_ops.register_ring"
+        f"({ring}, '<axis>') (the reference bound rings via c_comm_init, "
+        f"operators/collective/c_comm_init_op.cc)")
 
 
 def _axis_in_scope(axis_name):
@@ -88,9 +107,12 @@ def c_broadcast(ctx, ins, attrs):
     if not _axis_in_scope(axis):
         return {"Out": x}
     root = attrs.get("root", 0)
-    # broadcast = all-gather, then every rank keeps the root's slice
-    gathered = jax.lax.all_gather(x, axis)
-    return {"Out": gathered[root]}
+    # broadcast = psum of the root's (masked) contribution: O(size) traffic
+    # over the reduction tree, vs O(N*size) for all-gather-then-index
+    idx = jax.lax.axis_index(axis)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    out = jax.lax.psum(contrib, axis)
+    return {"Out": out.astype(x.dtype)}
 
 
 @register_op("broadcast")
@@ -148,6 +170,9 @@ def c_gen_nccl_id(ctx, ins, attrs):
 
 @register_op("c_comm_init", grad=False, infer_shape=False)
 def c_comm_init(ctx, ins, attrs):
+    # ring bootstrap collapses to a registry entry: bind ring_id -> axis
+    if "axis_name" in attrs:
+        register_ring(attrs.get("ring_id", 0), attrs["axis_name"])
     return None
 
 

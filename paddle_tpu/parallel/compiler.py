@@ -70,6 +70,27 @@ class CompiledProgram:
         else:
             self.mesh = get_mesh() or default_mesh(
                 len(places) if places else None)
+        bs = self.build_strategy
+        if bs.gradient_scale_strategy != \
+                BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
+            import warnings
+            warnings.warn(
+                "gradient_scale_strategy One/Customized is not honored: "
+                "mean-loss over the globally sharded batch already yields "
+                "CoeffNumDevice semantics under GSPMD; rescale the loss in "
+                "the program instead", stacklevel=2)
+        if bs.sync_batch_norm:
+            # the reference's sync_batch_norm_pass
+            # (framework/ir/sync_batch_norm_pass.cc) rewrites batch_norm ->
+            # sync_batch_norm in the graph; same rewrite on the program IR
+            changed = False
+            for blk in self.program.blocks:
+                for op in blk.ops:
+                    if op.type == "batch_norm":
+                        op.type = "sync_batch_norm"
+                        changed = True
+            if changed:
+                self.program._bump_version()
         return self
 
     def with_inference_optimize(self, config=None):
