@@ -34,6 +34,7 @@ from .dataio import DataLoader, PyReader, DataFeeder, DatasetFactory  # noqa: F4
 from . import dataio  # noqa: F401
 from . import io  # noqa: F401
 from . import contrib  # noqa: F401
+from . import metrics  # noqa: F401
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,

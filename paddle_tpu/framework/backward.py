@@ -38,9 +38,25 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     recomputed activations and only checkpoints stay live across the
     forward->backward gap.
     """
+    return _append_backward_core(
+        [loss], [None], parameter_list=parameter_list,
+        no_grad_set=no_grad_set, checkpoints=checkpoints)
+
+
+def _append_backward_core(targets, target_gradients, parameter_list=None,
+                          no_grad_set=None, checkpoints=None,
+                          collect_params=True, finalize_names=None):
+    """Shared reverse-pass emitter behind append_backward and gradients().
+
+    `targets`: Variables to differentiate; `target_gradients`: parallel list
+    of seed-cotangent Variables (None -> ones, reference backward.py:1527
+    semantics)."""
+    loss = targets[0]
     block = loss.block
     program = block.program
     assert block.idx == 0, "append_backward expects loss in the global block"
+    for t in targets:
+        assert t.block is block, "all targets must live in the global block"
     no_grad = set()
     for n in (no_grad_set or ()):
         no_grad.add(n.name if isinstance(n, Variable) else n)
@@ -64,7 +80,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                     flows.add(n)
 
     # ---- backward pass: which grads we must compute ----
-    need = {loss.name}
+    need = {t.name for t in targets}
     fwd_ops = list(block.ops)
     emit_plan = []
     for op in reversed(fwd_ops):
@@ -81,18 +97,62 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         need.update(diff_inputs)
         emit_plan.append(op)
 
+    # ---- snapshot primals that get rebound -----------------------------
+    # Grad ops read forward primals by NAME at backward time. If an input
+    # name is rewritten by the op itself (in-place / loop state) or by any
+    # later forward op, the name then holds a newer value — the vjp would
+    # replay the forward from wrong primals. Insert `assign` saves just
+    # before each such op and point the grad op at the saved copy.
+    # (The reference sidesteps this because grad kernels read tensors saved
+    # in the scope; functional lowering must snapshot explicitly.)
+    pos_of = {id(op): i for i, op in enumerate(fwd_ops)}
+    writer_pos = defaultdict(list)
+    for i, op in enumerate(fwd_ops):
+        for n in op.output_arg_names:
+            writer_pos[n].append(i)
+    save_map = {}           # id(op) -> {name: saved name}
+    save_plan = []          # (pos, name, saved name)
+    for op in emit_plan:
+        p = pos_of[id(op)]
+        m = {}
+        for n in dict.fromkeys(op.input_arg_names):
+            if any(q >= p for q in writer_pos.get(n, ())):
+                sn = f"{n}@SAVED@{p}"
+                m[n] = sn
+                save_plan.append((p, n, sn))
+        if m:
+            save_map[id(op)] = m
+    for p, n, sn in sorted(save_plan, reverse=True):
+        v = block.var(n)
+        block.create_var(name=sn, shape=v.shape, dtype=v.dtype,
+                         stop_gradient=True)
+        block._insert_op(p, type="assign", inputs={"X": [n]},
+                         outputs={"Out": [sn]},
+                         attrs={OP_ROLE_KEY: OpRole.Backward},
+                         infer_shape=False)
+
     # ---- emit grad ops ----
     grad_map = defaultdict(list)   # var name -> partial grad names
-    loss_grad = grad_var_name(loss.name)
-    block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype,
-                     stop_gradient=True)
-    block.append_op(
-        type="fill_constant",
-        outputs={"Out": [loss_grad]},
-        attrs={"shape": list(loss.shape or ()), "value": 1.0,
-               "dtype": loss.dtype, OP_ROLE_KEY: OpRole.Backward},
-        infer_shape=False)
-    grad_map[loss.name].append(loss_grad)
+    for t, tg in zip(targets, target_gradients):
+        if tg is None:
+            seed = grad_var_name(t.name)
+            block.create_var(name=seed, shape=t.shape, dtype=t.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                type="fill_constant",
+                outputs={"Out": [seed]},
+                attrs={"shape": list(t.shape or ()), "value": 1.0,
+                       "dtype": t.dtype, OP_ROLE_KEY: OpRole.Backward},
+                infer_shape=False)
+            grad_map[t.name].append(seed)
+        else:
+            tg = block.var(tg) if isinstance(tg, str) else tg
+            if t.shape is not None and tg.shape is not None and \
+                    tuple(t.shape) != tuple(tg.shape):
+                raise ValueError(
+                    f"target_gradients[{t.name}] shape {tg.shape} does not "
+                    f"match target shape {t.shape}")
+            grad_map[t.name].append(tg.name)
 
     def new_partial(var_name, like_var):
         base = grad_var_name(var_name)
@@ -195,12 +255,29 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                                 outputs=new_outs, attrs=attrs,
                                 infer_shape=False)
 
-    for op in emit_plan:
+    emit_set = {id(op) for op in emit_plan}
+    for op in reversed(fwd_ops):
+        if id(op) not in emit_set:
+            # still the (reverse-order) live writer of its outputs: any
+            # pending upstream grads belong to the value THIS op wrote
+            # (a constant / non-diff result) and must be dropped, not left
+            # to leak into an earlier differentiable writer of the name
+            for names in op.outputs.values():
+                for n in names:
+                    if grad_map.get(n):
+                        grad_map[n] = []
+            continue
         if ckpt_names:
             seg_idx = seg_of.get(id(op))
             if seg_idx is not None and seg_idx not in seg_emitted:
                 emit_recompute(seg_idx)
                 seg_emitted.add(seg_idx)
+        if op.type == "while" and "max_trip_count" not in op.attrs:
+            raise ValueError(
+                "layers.While without max_trip_count is not differentiable "
+                "(lax.while_loop has no reverse-mode rule); build it as "
+                "While(cond, max_trip_count=N) for a bounded masked-scan "
+                "lowering, or use StaticRNN for recurrence")
         # upstream grads of this op's outputs (all consumers already done).
         # A slot's grad list is pruned of missing entries; positional
         # alignment is carried by __out_grad_mask__.
@@ -213,6 +290,14 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 has_any = True
                 out_grad_mask[slot] = [g is not None for g in gs]
                 g_ins[slot + "@GRAD"] = [g for g in gs if g is not None]
+        # this op is (in reverse program order) the live writer of its output
+        # names: their upstream grads are consumed NOW. Clear the partial
+        # lists so earlier writers of a rebound name (in-place ops, loop
+        # state, sequential name reuse) only see partials contributed by
+        # consumers of *their* value — not the grad consumed here again.
+        for names in op.outputs.values():
+            for n in names:
+                grad_map[n] = []
         if not has_any:
             continue
 
@@ -235,8 +320,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         # grad op inputs = forward inputs (full, for vjp primals) + upstream
         # grads; forward *outputs* are not needed — the vjp recomputes them
         # and XLA CSE dedupes against the forward trace. Under recompute the
-        # primals come from the re-emitted (barrier-pinned) segment instead.
-        inputs = {**{s: [rc_map.get(n, n) for n in ns]
+        # primals come from the re-emitted (barrier-pinned) segment; rebound
+        # names come from their pre-op saved copies.
+        sm = save_map.get(id(op), {})
+        inputs = {**{s: [sm.get(n) or rc_map.get(n, n) for n in ns]
                      for s, ns in op.inputs.items()}, **g_ins}
 
         block.append_op(
@@ -264,7 +351,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             continue
         gvar = block.var(g)
         params_grads.append((p, gvar))
-    program._params_grads = params_grads
+    for n in finalize_names or ():
+        finalize(n)
+    if collect_params:
+        program._params_grads = params_grads
     return params_grads
 
 
@@ -281,19 +371,25 @@ def _is_leaf_source(block, name):
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """fluid.gradients parity (reference backward.py:1527): grads of targets
-    w.r.t. arbitrary inputs."""
+    w.r.t. arbitrary inputs, with optional custom seed cotangents (grads sum
+    over targets, matching the reference's multi-target accumulation)."""
     if not isinstance(targets, (list, tuple)):
         targets = [targets]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
-    assert len(targets) == 1, "multiple targets not yet supported"
-    if target_gradients is not None:
-        raise NotImplementedError(
-            "gradients(target_gradients=...) custom cotangents are not "
-            "supported yet; the seed gradient is ones")
-    loss = targets[0]
-    pg = append_backward(loss, parameter_list=None, no_grad_set=no_grad_set)
-    block = loss.block
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    elif not isinstance(target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            f"target_gradients length {len(target_gradients)} != targets "
+            f"length {len(targets)}")
+    _append_backward_core(list(targets), list(target_gradients),
+                          parameter_list=[], no_grad_set=no_grad_set,
+                          collect_params=False,
+                          finalize_names=[iv.name for iv in inputs])
+    block = targets[0].block
     outs = []
     for iv in inputs:
         gname = grad_var_name(iv.name)

@@ -137,7 +137,11 @@ def generic_grad_lower(ctx, ins, attrs, fwd_def):
             has = mask[i] if mask is not None and i < len(mask) else bool(gs)
             g = next(it, None) if has else None
             if g is None:
-                lst.append(jnp.zeros(a.shape, a.dtype))
+                # integer/bool outputs take float0 cotangents under jax.vjp
+                if jnp.issubdtype(a.dtype, jnp.inexact):
+                    lst.append(jnp.zeros(a.shape, a.dtype))
+                else:
+                    lst.append(np.zeros(a.shape, jax.dtypes.float0))
             else:
                 lst.append(jnp.asarray(g, a.dtype))
         cts[slot] = lst

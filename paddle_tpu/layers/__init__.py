@@ -8,7 +8,13 @@ from .control_flow import (  # noqa: F401
     While, Switch, StaticRNN, cond, create_array, array_read, array_write,
     array_length,
 )
-from . import nn, tensor, loss, math, control_flow  # noqa: F401
+from .sequence_lod import (  # noqa: F401
+    sequence_pool, sequence_first_step, sequence_last_step,
+    sequence_softmax, sequence_reverse, sequence_expand_as, sequence_pad,
+    sequence_unpad, sequence_concat, sequence_slice, sequence_erase,
+    sequence_enumerate, sequence_reshape, sequence_mask, sequence_conv,
+)
+from . import nn, tensor, loss, math, control_flow, sequence_lod  # noqa: F401
 from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,

@@ -34,8 +34,14 @@ class While:
         less_than(i, n, cond=cond)   # rebind the condition var
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_trip_count=None):
+        """`max_trip_count` (TPU extension, not in the reference signature):
+        a static upper bound on iterations. Setting it makes the loop
+        reverse-mode differentiable (bounded masked-scan lowering, see
+        ops/control_flow_ops.py while_op); without it the loop lowers to
+        lax.while_loop and append_backward through it raises."""
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
         self.helper = LayerHelper("while", name=name)
 
     @contextlib.contextmanager
@@ -57,11 +63,21 @@ class While:
         writes = [n for n in block_writes(program, sub.idx)
                   if parent.has_var(n)]
         reads = _outer_reads(program, sub.idx)
+        # loop-state writes must also be op inputs: the carry is initialized
+        # from them, and grads of the initial values flow out through X@GRAD
+        x_names = list(reads)
+        for n in writes:
+            if n not in x_names and n != self.cond_var.name:
+                x_names.append(n)
+        attrs = {"sub_block": sub.idx, "cond_name": self.cond_var.name,
+                 "x_names": x_names, "out_names": writes}
+        if self.max_trip_count is not None:
+            attrs["max_trip_count"] = int(self.max_trip_count)
         parent.append_op(
             type="while",
-            inputs={"Condition": [self.cond_var], "X": reads},
+            inputs={"Condition": [self.cond_var], "X": x_names},
             outputs={"Out": writes},
-            attrs={"sub_block": sub.idx, "cond_name": self.cond_var.name},
+            attrs=attrs,
             infer_shape=False)
 
 

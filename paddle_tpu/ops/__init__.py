@@ -8,4 +8,5 @@ from . import (  # noqa: F401
     metric_ops,
     collective_ops,
     control_flow_ops,
+    sequence_ops,
 )

@@ -24,20 +24,31 @@ from .common import x_of
 _RING_AXES = {}
 
 
-def register_ring(ring_id, axis_name):
-    """Bind a reference-style ring_id to a mesh axis name."""
-    _RING_AXES[int(ring_id)] = axis_name
+def register_ring(ring_id, axis_name, program=None):
+    """Bind a reference-style ring_id to a mesh axis name. With `program`,
+    the binding is scoped to that Program (what c_comm_init does); without,
+    it is a process-wide default."""
+    if program is not None:
+        if not hasattr(program, "_ring_axes"):
+            program._ring_axes = {}
+        program._ring_axes[int(ring_id)] = axis_name
+    else:
+        _RING_AXES[int(ring_id)] = axis_name
 
 
 def _ring_axis(ctx, attrs):
     """Map the reference's ring_id to a mesh axis name. Explicit
-    `axis_name` attr wins, then the ring registry; ring 0 defaults to the
+    `axis_name` attr wins, then the program-scoped registry (c_comm_init
+    bindings), then the process-wide registry; ring 0 defaults to the
     data-parallel axis. Unregistered ring_id>0 is an error rather than a
     silent guess."""
     name = attrs.get("axis_name")
     if name:
         return name
     ring = attrs.get("ring_id", 0)
+    prog_rings = getattr(ctx.program, "_ring_axes", None)
+    if prog_rings and ring in prog_rings:
+        return prog_rings[ring]
     if ring in _RING_AXES:
         return _RING_AXES[ring]
     if ring == 0:
@@ -170,9 +181,11 @@ def c_gen_nccl_id(ctx, ins, attrs):
 
 @register_op("c_comm_init", grad=False, infer_shape=False)
 def c_comm_init(ctx, ins, attrs):
-    # ring bootstrap collapses to a registry entry: bind ring_id -> axis
+    # ring bootstrap collapses to a registry entry: bind ring_id -> axis,
+    # scoped to the program that contains the init op
     if "axis_name" in attrs:
-        register_ring(attrs.get("ring_id", 0), attrs["axis_name"])
+        register_ring(attrs.get("ring_id", 0), attrs["axis_name"],
+                      program=ctx.program)
     return None
 
 

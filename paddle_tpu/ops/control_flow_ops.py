@@ -12,8 +12,11 @@ shapes/dtypes are fixed across iterations and bodies are traced once.
 Differentiability contract: `cond` and `recurrent` declare every outer var
 they read as a real op input (slots Cond/X/Boot/P), so program-level autodiff
 (backward.py) emits generic vjp grad ops whose primals connect through the
-lax control-flow primitives. `while` is non-differentiable (use StaticRNN /
-recurrent for differentiable recurrence).
+lax control-flow primitives. `while` is differentiable only when built with
+`max_trip_count` (the loop lowers to a bounded, predicate-masked lax.scan —
+lax.while_loop itself has no reverse-mode rule); unbounded While in a grad
+path raises at append_backward time (reference while_op.cc has a grad because
+its executor re-runs blocks; XLA needs a static trip bound instead).
 """
 import jax
 import jax.numpy as jnp
@@ -46,34 +49,70 @@ def _as_pred(x):
     return jnp.reshape(x, ()).astype(bool)
 
 
-@register_op("while", grad=False, infer_shape=False)
+@register_op("while", grad=None, infer_shape=False)
 def while_op(ctx, ins, attrs):
-    """Carry = condition var + every var the body writes that pre-exists in
-    the env (loop state). Reference semantics: while_op.cc re-runs the block
-    until Condition is false; here it's one lax.while_loop."""
-    program = ctx.program
+    """Carry = condition var + every var the body writes that pre-exists
+    outside (loop state). Reference semantics: while_op.cc re-runs the block
+    until Condition is false.
+
+    Functional over ins (Condition + X) so the generic vjp grad works.
+    Two lowerings:
+      - unbounded: one lax.while_loop (forward-only);
+      - attrs["max_trip_count"]: a lax.scan of that length where each step's
+        writes are jnp.where-masked by the live predicate — semantically the
+        same loop, but reverse-mode differentiable. Finished iterations still
+        execute (masked), the price of a static trip bound on TPU.
+    """
     sub = attrs["sub_block"]
     cond_name = attrs["cond_name"]
-    writes = block_writes(program, sub)
-    carried = [n for n in writes if n in ctx.env]
+    out_names = list(attrs.get("out_names") or
+                     [n for n in block_writes(ctx.program, sub)
+                      if n in ctx.env])
+    x_names = list(attrs.get("x_names", []))
+    x_map = dict(zip(x_names, ins.get("X", [])))
+    cond0 = ins["Condition"][0]
+    x_map[cond_name] = cond0
+
+    carried = list(out_names)
     if cond_name not in carried:
         carried.insert(0, cond_name)
-
     outer_env = dict(ctx.env)
+    outer_env.update(x_map)
+    carry0 = {}
+    for n in carried:
+        if n not in outer_env:
+            raise KeyError(
+                f"While loop state {n!r} has no value before the loop; "
+                f"initialize it (e.g. fill_constant) before While.block()")
+        carry0[n] = outer_env[n]
 
-    def cond_fn(carry):
-        return _as_pred(carry[cond_name])
-
-    def body_fn(carry):
+    def run_body(carry):
         env = dict(outer_env)
         env.update(carry)
         ctx.lower_block_ops(sub, env)
         return {n: env[n] for n in carried}
 
-    carry0 = {n: ctx.env[n] for n in carried}
-    final = jax.lax.while_loop(cond_fn, body_fn, carry0)
-    ctx.env.update(final)
-    return None
+    max_trip = attrs.get("max_trip_count")
+    if max_trip is None:
+        def cond_fn(carry):
+            return _as_pred(carry[cond_name])
+
+        def body_fn(carry):
+            return run_body(carry)
+
+        final = jax.lax.while_loop(cond_fn, body_fn, carry0)
+    else:
+        def step(carry, _):
+            pred, state = carry
+            new_state = run_body(state)
+            state = {n: jnp.where(pred, new_state[n], state[n])
+                     for n in carried}
+            pred = jnp.logical_and(pred, _as_pred(state[cond_name]))
+            return (pred, state), None
+
+        (_, final), _ = jax.lax.scan(
+            step, (_as_pred(cond0), carry0), None, length=int(max_trip))
+    return {"Out": [final[n] for n in out_names]}
 
 
 @register_op("cond", grad=None, infer_shape=False)

@@ -1,5 +1,6 @@
 """Metric ops (reference: /root/reference/paddle/fluid/operators/metrics/ —
 accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
+import jax
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
@@ -18,6 +19,44 @@ def accuracy(ctx, ins, attrs):
     acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
     return {"Accuracy": acc.reshape(1), "Correct": correct.reshape(1),
             "Total": total.reshape(1)}
+
+
+@register_op("precision_recall", grad=False)
+def precision_recall(ctx, ins, attrs):
+    """Per-class TP/FP/TN/FN streaming stats + macro/micro P/R/F1
+    (reference metrics/precision_recall_op.h: the same state layout
+    [class_number, 4] and 6-element metric vectors, computed vectorized
+    via one-hot outer products instead of the per-sample loop)."""
+    idx = x_of(ins, "Indices").reshape(-1).astype(jnp.int32)
+    label = x_of(ins, "Labels").reshape(-1).astype(jnp.int32)
+    weights = x_of(ins, "Weights")
+    states = x_of(ins, "StatesInfo")
+    C = int(attrs["class_number"])
+    w = (jnp.ones(idx.shape, jnp.float32) if weights is None
+         else weights.reshape(-1).astype(jnp.float32))
+    oh_p = jax.nn.one_hot(idx, C, dtype=jnp.float32)
+    oh_l = jax.nn.one_hot(label, C, dtype=jnp.float32)
+    tp = jnp.sum(w[:, None] * oh_p * oh_l, axis=0)
+    fp = jnp.sum(w[:, None] * oh_p * (1 - oh_l), axis=0)
+    fn = jnp.sum(w[:, None] * (1 - oh_p) * oh_l, axis=0)
+    tn = jnp.sum(w[:, None] * (1 - oh_p) * (1 - oh_l), axis=0)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)        # [C, 4]
+    accum = batch if states is None else batch + states
+
+    def metrics(s):
+        tp_, fp_, fn_ = s[:, 0], s[:, 1], s[:, 3]
+        p = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        r = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12),
+                       0.0)
+        return jnp.stack([jnp.mean(p), jnp.mean(r), jnp.mean(f1), mp, mr, mf])
+
+    return {"BatchMetrics": metrics(batch), "AccumMetrics": metrics(accum),
+            "AccumStatesInfo": accum}
 
 
 @register_op("auc", grad=False)

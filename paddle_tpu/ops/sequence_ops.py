@@ -1,0 +1,289 @@
+"""Sequence (ragged) ops, masked-dense TPU design.
+
+Capability parity with the reference's LoD sequence family
+(/root/reference/paddle/fluid/operators/sequence_ops/ — 47 files). The
+reference packs variable-length sequences into one [total_tokens, ...] tensor
+plus LoD offsets and every kernel walks the offsets. XLA wants static shapes,
+so here a batch of sequences is a PADDED dense tensor [B, T, ...] plus an
+explicit `Length` [B] int vector (the representation the reference itself
+uses at the sequence_pad/unpad boundary, sequence_pad_op.h). Every op masks
+by Length; padding positions carry zeros and receive zero gradients. The
+packed<->padded converters (sequence_pad / sequence_unpad) keep a static
+[cap, ...] packed buffer whose valid prefix is sum(Length).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import as_dtype, x_of
+
+
+def _len_of(ins):
+    ln = x_of(ins, "Length")
+    if ln is None:
+        raise ValueError(
+            "sequence op needs a Length input ([B] int lengths); the "
+            "reference reads LoD offsets off the tensor, the TPU build "
+            "passes lengths explicitly (masked-dense design)")
+    return jnp.reshape(ln, (-1,)).astype(jnp.int32)
+
+
+def _time_mask(lengths, T):
+    """[B, T] bool validity mask."""
+    return jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]
+
+
+def _expand(mask, ndim):
+    """Broadcast a [B, T] mask to rank `ndim` ([B, T, 1, 1, ...])."""
+    return mask.reshape(mask.shape + (1,) * (ndim - 2))
+
+
+@register_op("sequence_mask", grad=False)
+def sequence_mask(ctx, ins, attrs):
+    """reference sequence_mask_op.h: out[.., j] = j < x[..]."""
+    x = x_of(ins).astype(jnp.int32)
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen <= 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen>0 on TPU (the reference's "
+            "maxlen=-1 derives it from data — a dynamic output shape)")
+    dt = as_dtype(attrs, "out_dtype", "int64")
+    if np.issubdtype(dt, np.signedinteger) and not jax.config.jax_enable_x64:
+        dt = np.int32  # x64 disabled: avoid jax's silent-truncation warning
+    out = (jnp.arange(maxlen, dtype=jnp.int32) < x[..., None]).astype(dt)
+    return {"Out": out}
+
+
+@register_op("sequence_pool")
+def sequence_pool(ctx, ins, attrs):
+    """reference sequence_pool_op.h pooltypes: SUM/MEAN/SQRT/MAX/MIN/FIRST/
+    LAST over the valid prefix of each row."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    ptype = attrs.get("pooltype", "SUM").upper()
+    pad_value = attrs.get("pad_value", 0.0)
+    mask = _expand(_time_mask(lengths, x.shape[1]), x.ndim)
+    n = jnp.maximum(lengths, 1).astype(x.dtype)
+    n = n.reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1)
+    elif ptype == "MEAN":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / n
+    elif ptype == "SQRT":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / jnp.sqrt(n)
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(mask, x, -jnp.inf), axis=1)
+    elif ptype == "MIN":
+        out = jnp.min(jnp.where(mask, x, jnp.inf), axis=1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1,) + (1,) * (x.ndim - 1)), axis=1)
+        out = jnp.squeeze(out, axis=1)
+    else:
+        raise ValueError(f"unknown pooltype {ptype!r}")
+    # empty rows yield pad_value (reference sequence_pool_op.h writes
+    # pad_value for zero-length sequences; also keeps -inf/garbage from the
+    # MAX/MIN/FIRST/LAST paths out of downstream math)
+    empty = (lengths == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    out = jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx, ins, attrs):
+    """Masked softmax over the time dim (reference sequence_softmax_op.h
+    softmaxes each LoD segment independently)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    mask = _expand(_time_mask(lengths, x.shape[1]), x.ndim)
+    z = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    return {"Out": jnp.where(mask, out, 0)}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse each valid prefix, keep padding in place
+    (reference sequence_reverse_op.h)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    idx = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.take_along_axis(x, idx, axis=1)}
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(ctx, ins, attrs):
+    """Tile each row over the ref row's length (reference
+    sequence_expand_as_op.h: x row i is repeated to y's i-th segment size;
+    padded form: broadcast along T and mask)."""
+    x = x_of(ins)          # [B, ...]
+    lengths = _len_of(ins)  # ref lengths
+    T = int(attrs["maxlen"]) if "maxlen" in attrs else None
+    if T is None:
+        raise ValueError("sequence_expand_as needs static attr maxlen")
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    mask = _expand(_time_mask(lengths, T), out.ndim)
+    return {"Out": jnp.where(mask, out, 0)}
+
+
+@register_op("sequence_pad")
+def sequence_pad(ctx, ins, attrs):
+    """Packed [total, ...] + lengths -> padded [B, P, ...]
+    (reference sequence_pad_op.h)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    P = int(attrs["padded_length"])
+    pad_value = attrs.get("pad_value", 0.0)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths)[:-1]])
+    t = jnp.arange(P, dtype=jnp.int32)[None, :]
+    idx = offsets[:, None] + t                       # [B, P]
+    valid = t < lengths[:, None]
+    gathered = jnp.take(x, jnp.clip(idx, 0, x.shape[0] - 1), axis=0)
+    mask = _expand(valid, gathered.ndim)
+    pv = jnp.asarray(pad_value, x.dtype)
+    return {"Out": jnp.where(mask, gathered, pv)}
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(ctx, ins, attrs):
+    """Padded [B, P, ...] + lengths -> packed [B*P, ...] buffer whose valid
+    prefix (sum of lengths) holds the tokens back to back; the tail is zero
+    (reference sequence_unpad_op.h emits a dynamically-sized LoD tensor —
+    XLA needs the static B*P cap)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    B, P = x.shape[0], x.shape[1]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths)[:-1]])
+    t = jnp.arange(P, dtype=jnp.int32)[None, :]
+    valid = t < lengths[:, None]
+    pos = jnp.where(valid, offsets[:, None] + t, B * P)   # OOB -> dropped
+    flat = x.reshape((B * P,) + x.shape[2:])
+    out = jnp.zeros_like(flat)
+    out = out.at[pos.reshape(-1)].set(flat, mode="drop")
+    return {"Out": out}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx, ins, attrs):
+    """Concatenate along time per row: out row b = x1[b,:l1] ++ x2[b,:l2] ++
+    ... with the result padded to sum(Ti) (reference sequence_concat_op.h
+    splices LoD segments)."""
+    xs = list(ins["X"])
+    lens = [jnp.reshape(v, (-1,)).astype(jnp.int32) for v in ins["Length"]]
+    B = xs[0].shape[0]
+    T_out = sum(int(v.shape[1]) for v in xs)
+    t = jnp.arange(T_out, dtype=jnp.int32)[None, :]       # [1, T_out]
+    out = jnp.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    start = jnp.zeros((B, 1), jnp.int32)
+    for x, ln in zip(xs, lens):
+        rel = t - start                                    # [B, T_out]
+        within = jnp.logical_and(rel >= 0, rel < ln[:, None])
+        relc = jnp.clip(rel, 0, x.shape[1] - 1)
+        relc = relc.reshape(relc.shape + (1,) * (x.ndim - 2))
+        g = jnp.take_along_axis(x, relc, axis=1)
+        out = jnp.where(_expand(within, out.ndim), g, out)
+        start = start + ln[:, None]
+    total = sum(lens)
+    return {"Out": out, "OutLength": total}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ctx, ins, attrs):
+    """Per-row slice [offset, offset+length) of the valid prefix
+    (reference sequence_slice_op.h)."""
+    x = x_of(ins)
+    offset = jnp.reshape(x_of(ins, "Offset"), (-1,)).astype(jnp.int32)
+    length = jnp.reshape(x_of(ins, "SliceLength"), (-1,)).astype(jnp.int32)
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(offset[:, None] + t, 0, T - 1)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(x, idx, axis=1)
+    mask = _expand(t < length[:, None], g.ndim)
+    return {"Out": jnp.where(mask, g, 0), "OutLength": length}
+
+
+@register_op("sequence_erase", grad=False)
+def sequence_erase(ctx, ins, attrs):
+    """Drop listed token ids and compact each row left
+    (reference sequence_erase_op.h)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    tokens = np.asarray(attrs.get("tokens", []), x.dtype)
+    B, T = x.shape[0], x.shape[1]
+    valid = _time_mask(lengths, T)
+    keep = valid
+    for tok in tokens:
+        keep = jnp.logical_and(keep, x != tok)
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    cols = jnp.where(keep, new_pos, T)                    # OOB -> dropped
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    out = jnp.zeros_like(x)
+    out = out.at[rows.reshape(-1), cols.reshape(-1)].set(
+        x.reshape(-1), mode="drop")
+    return {"Out": out, "OutLength": jnp.sum(keep, axis=1, dtype=jnp.int32)}
+
+
+@register_op("sequence_enumerate", grad=False)
+def sequence_enumerate(ctx, ins, attrs):
+    """Sliding win_size id windows, pad_value beyond the valid prefix
+    (reference sequence_enumerate_op.h)."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    win = int(attrs["win_size"])
+    pad_value = attrs.get("pad_value", 0)
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :, None]
+    k = jnp.arange(win, dtype=jnp.int32)[None, None, :]
+    idx = t + k                                           # [1, T, win]
+    g = jnp.take(x, jnp.clip(idx[0], 0, T - 1), axis=1)   # [B, T, win]
+    ok = idx < lengths[:, None, None]
+    return {"Out": jnp.where(ok, g, jnp.asarray(pad_value, x.dtype))}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ctx, ins, attrs):
+    """Context-window projection: im2col over time then one matmul
+    (reference sequence_conv_op.h builds the same [T, ctx*D] matrix with
+    math/context_project.h; here the unfold is gather + one MXU matmul)."""
+    x = x_of(ins)                  # [B, T, D]
+    filt = x_of(ins, "Filter")     # [ctx*D, M]
+    lengths = _len_of(ins)
+    start = int(attrs.get("contextStart", 0))
+    ctx_len = int(attrs.get("contextLength", 3))
+    mask = _time_mask(lengths, x.shape[1])
+    xm = jnp.where(mask[..., None], x, 0)
+    cols = []
+    T = x.shape[1]
+    t = jnp.arange(T, dtype=jnp.int32)
+    for k in range(ctx_len):
+        src = t + start + k
+        ok = jnp.logical_and(src >= 0, src < T)[None, :, None]
+        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
+        cols.append(jnp.where(ok, g, 0))
+    unfolded = jnp.concatenate(cols, axis=-1)             # [B, T, ctx*D]
+    out = unfolded @ filt                                 # [B, T, M]
+    return {"Out": jnp.where(mask[..., None], out, 0)}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx, ins, attrs):
+    """Change the token width D -> new_dim; row lengths rescale by D/new_dim
+    (reference sequence_reshape_op.h)."""
+    x = x_of(ins)                  # [B, T, D]
+    lengths = _len_of(ins)
+    new_dim = int(attrs["new_dim"])
+    B, T, D = x.shape
+    if (T * D) % new_dim:
+        raise ValueError(f"T*D={T*D} not divisible by new_dim={new_dim}")
+    out = x.reshape(B, (T * D) // new_dim, new_dim)
+    new_len = (lengths * D) // new_dim
+    return {"Out": out, "OutLength": new_len}

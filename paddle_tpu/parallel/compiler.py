@@ -82,14 +82,15 @@ class CompiledProgram:
         if bs.sync_batch_norm:
             # the reference's sync_batch_norm_pass
             # (framework/ir/sync_batch_norm_pass.cc) rewrites batch_norm ->
-            # sync_batch_norm in the graph; same rewrite on the program IR
-            changed = False
-            for blk in self.program.blocks:
-                for op in blk.ops:
-                    if op.type == "batch_norm":
-                        op.type = "sync_batch_norm"
-                        changed = True
-            if changed:
+            # sync_batch_norm on a graph copy owned by the executor; same
+            # here — rewrite a clone, never the user's Program
+            if any(op.type == "batch_norm"
+                   for blk in self.program.blocks for op in blk.ops):
+                self.program = self.program.clone()
+                for blk in self.program.blocks:
+                    for op in blk.ops:
+                        if op.type == "batch_norm":
+                            op.type = "sync_batch_norm"
                 self.program._bump_version()
         return self
 

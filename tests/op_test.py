@@ -84,7 +84,8 @@ class OpTest:
             return exe.run(main, feed=feed, fetch_list=list(fetch_names))
 
     # -- public API ------------------------------------------------------
-    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=(),
+                     check_dygraph=True):
         main, startup, feed, out_pairs = self._build()
         names, expected = [], []
         for slot, pairs in out_pairs.items():
@@ -104,6 +105,45 @@ class OpTest:
             else:
                 np.testing.assert_allclose(
                     g, e, atol=atol, rtol=rtol, err_msg=f"output {name}")
+        if check_dygraph:
+            self._check_dygraph(got, names, no_check_set, atol, rtol)
+
+    def _check_dygraph(self, static_outs, static_names, no_check_set,
+                       atol, rtol):
+        """Run the same single op through the eager tracer and compare with
+        the static-mode result (reference op_test.py:1327 cross-checks both
+        execution paths per op)."""
+        from paddle_tpu import dygraph
+        from paddle_tpu.dygraph.base import _current_tracer
+
+        in_pairs = {s: _as_pairs(s, v) for s, v in (self.inputs or {}).items()}
+        out_pairs = {s: _as_pairs(s, v)
+                     for s, v in (self.outputs or {}).items()}
+        with dygraph.guard():
+            tracer = _current_tracer()
+            ins = {s: [dygraph.to_variable(a) for _, a in pairs]
+                   for s, pairs in in_pairs.items()}
+            outs = {s: [dygraph.base.VarBase(np.zeros((), np.float32),
+                                             name=n)
+                        for n, _ in pairs]
+                    for s, pairs in out_pairs.items()}
+            placeholders = {v.name: v.value
+                            for vs in outs.values() for v in vs}
+            tracer.trace_op(self.op_type, ins, outs,
+                            dict(self.attrs or {}))
+            dy_by_name = {v.name: (v.numpy(), v.value)
+                          for vs in outs.values() for v in vs}
+        for name, st in zip(static_names, static_outs):
+            hit = dy_by_name.get(name)
+            if hit is None:
+                continue
+            dy, raw = hit
+            if raw is placeholders[name]:  # output not produced eagerly
+                continue
+            np.testing.assert_allclose(
+                np.asarray(st), dy, atol=max(atol, 1e-5),
+                rtol=max(rtol, 1e-5),
+                err_msg=f"dygraph vs static mismatch for output {name}")
 
     def check_grad(self, inputs_to_check, output_names,
                    max_relative_error=0.005, delta=5e-3,

@@ -207,3 +207,100 @@ def test_branch_exception_restores_block():
         y = layers.scale(x, 2.0)
     out, = _run(main, startup, {}, [y])
     assert float(out[0]) == 2.0
+
+
+def test_while_differentiable_with_max_trip_count():
+    """While(max_trip_count=K) lowers to a masked scan and is reverse-mode
+    differentiable: y = x * w^n  =>  dy/dw = n * x * w^(n-1)."""
+    n_iters = 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        w = layers.data("w", [3], dtype="float32")
+        w.stop_gradient = False
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", n_iters)
+        acc = layers.assign(x)
+        cond_v = layers.less_than(i, n)
+        loop = layers.While(cond_v, max_trip_count=8)
+        with loop.block():
+            layers.assign(layers.elementwise_mul(acc, w), acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond_v)
+        loss = layers.reduce_sum(acc)
+        gx, gw = fluid.gradients(loss, [x, w])
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.array([1.5, 0.5, 1.1], np.float32)
+    out, gxv, gwv = _run(main, startup, {"x": xv, "w": wv}, [acc, gx, gw])
+    np.testing.assert_allclose(out, xv * wv ** n_iters, rtol=1e-5)
+    np.testing.assert_allclose(gxv, wv ** n_iters, rtol=1e-5)
+    np.testing.assert_allclose(
+        gwv, n_iters * xv * wv ** (n_iters - 1), rtol=1e-5)
+
+
+def test_while_unbounded_grad_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 4)
+        acc = layers.assign(x)
+        cond_v = layers.less_than(i, n)
+        loop = layers.While(cond_v)
+        with loop.block():
+            layers.assign(layers.scale(acc, 2.0), acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond_v)
+        loss = layers.reduce_sum(acc)
+        try:
+            fluid.gradients(loss, [x])
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "max_trip_count" in str(e)
+
+
+def test_rebound_name_no_double_count():
+    """Regression: a var name written by two ops in a diff path must not
+    double-count the consumed upstream grad (t = a + b; t = t * c)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [4], dtype="float32")
+        a.stop_gradient = False
+        b = layers.data("b", [4], dtype="float32")
+        b.stop_gradient = False
+        c = layers.data("c", [4], dtype="float32")
+        c.stop_gradient = False
+        t = layers.elementwise_add(a, b)
+        block = main.global_block()
+        block.append_op(type="elementwise_mul",
+                        inputs={"X": [t], "Y": [c]},
+                        outputs={"Out": [t]}, infer_shape=False)
+        loss = layers.reduce_sum(t)
+        ga, gc = fluid.gradients(loss, [a, c])
+    rng = np.random.default_rng(0)
+    av, bv, cv = (rng.standard_normal(4).astype(np.float32)
+                  for _ in range(3))
+    gav, gcv = _run(main, startup, {"a": av, "b": bv, "c": cv}, [ga, gc])
+    np.testing.assert_allclose(gav, cv, rtol=1e-6)
+    np.testing.assert_allclose(gcv, av + bv, rtol=1e-5)
+
+
+def test_gradients_multiple_targets_and_cotangents():
+    """fluid.gradients with two targets and custom seed cotangents
+    (reference backward.py:1527 semantics: contributions sum)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        x.stop_gradient = False
+        y1 = layers.scale(x, 2.0)
+        y2 = layers.scale(x, -1.0)
+        s1 = layers.data("s1", [4], dtype="float32")
+        s2 = layers.data("s2", [4], dtype="float32")
+        (gx,) = fluid.gradients([y1, y2], [x], target_gradients=[s1, s2])
+    rng = np.random.default_rng(1)
+    xv, s1v, s2v = (rng.standard_normal(4).astype(np.float32)
+                    for _ in range(3))
+    gxv, = _run(main, startup, {"x": xv, "s1": s1v, "s2": s2v}, [gx])
+    np.testing.assert_allclose(gxv, 2.0 * s1v - s2v, rtol=1e-5)

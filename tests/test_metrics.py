@@ -1,0 +1,120 @@
+"""fluid.metrics classes + precision_recall op (reference pattern:
+tests/unittests/test_metrics.py, test_precision_recall_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def test_precision_metric():
+    m = fluid.metrics.Precision()
+    preds = np.array([[0.1], [0.7], [0.8], [0.9], [0.2],
+                      [0.2], [0.3], [0.5], [0.8], [0.6]])
+    labels = np.array([[0], [1], [1], [1], [1],
+                       [0], [0], [0], [0], [0]])
+    m.update(preds=preds, labels=labels)
+    np.testing.assert_allclose(m.eval(), 3.0 / 5.0)
+
+
+def test_recall_metric():
+    m = fluid.metrics.Recall()
+    preds = np.array([[0.9], [0.1], [0.8], [0.1]])
+    labels = np.array([[1], [1], [1], [0]])
+    m.update(preds=preds, labels=labels)
+    np.testing.assert_allclose(m.eval(), 2.0 / 3.0)
+
+
+def test_accuracy_metric_weighted():
+    m = fluid.metrics.Accuracy()
+    m.update(value=0.5, weight=2)
+    m.update(value=1.0, weight=2)
+    np.testing.assert_allclose(m.eval(), 0.75)
+    m.reset()
+    try:
+        m.eval()
+        raise AssertionError("expected ValueError after reset")
+    except ValueError:
+        pass
+
+
+def test_auc_metric_matches_sklearn_style_ref():
+    rng = np.random.default_rng(3)
+    scores = rng.uniform(size=500)
+    labels = (scores + rng.normal(0, 0.3, 500) > 0.5).astype(np.int64)
+    m = fluid.metrics.Auc(num_thresholds=4095)
+    m.update(preds=scores.reshape(-1, 1), labels=labels.reshape(-1, 1))
+    # exact pairwise AUC reference
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    ref = (np.sum(pos[:, None] > neg[None, :]) +
+           0.5 * np.sum(pos[:, None] == neg[None, :])) / (len(pos) * len(neg))
+    np.testing.assert_allclose(m.eval(), ref, atol=2e-3)
+
+
+def test_chunk_and_edit_distance_and_composite():
+    c = fluid.metrics.ChunkEvaluator()
+    c.update(10, 8, 6)
+    p, r, f1 = c.eval()
+    np.testing.assert_allclose([p, r], [0.6, 0.75])
+    np.testing.assert_allclose(f1, 2 * 0.6 * 0.75 / 1.35)
+
+    e = fluid.metrics.EditDistance()
+    e.update(np.array([0.0, 2.0, 1.0]), 3)
+    avg, err = e.eval()
+    np.testing.assert_allclose([avg, err], [1.0, 2.0 / 3.0])
+
+    comp = fluid.metrics.CompositeMetric()
+    comp.add_metric(fluid.metrics.Precision())
+    comp.add_metric(fluid.metrics.Recall())
+    comp.update(np.array([[0.9], [0.2]]), np.array([[1], [1]]))
+    np.testing.assert_allclose(comp.eval(), [1.0, 0.5])
+
+
+def _pr_ref(idx, label, C, states=None):
+    s = np.zeros((C, 4)) if states is None else states.copy()
+    for i, l in zip(idx, label):
+        for j in range(C):
+            if i == l == j:
+                s[j, 0] += 1
+            elif i == j:
+                s[j, 1] += 1
+            elif l == j:
+                s[j, 3] += 1
+            else:
+                s[j, 2] += 1
+
+    def one(s):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p = np.where(s[:, 0] + s[:, 1] > 0,
+                         s[:, 0] / np.maximum(s[:, 0] + s[:, 1], 1e-12), 0)
+            r = np.where(s[:, 0] + s[:, 3] > 0,
+                         s[:, 0] / np.maximum(s[:, 0] + s[:, 3], 1e-12), 0)
+            f = np.where(p + r > 0, 2 * p * r / np.maximum(p + r, 1e-12), 0)
+        tp, fp, fn = s[:, 0].sum(), s[:, 1].sum(), s[:, 3].sum()
+        mp = tp / (tp + fp) if tp + fp > 0 else 0.0
+        mr = tp / (tp + fn) if tp + fn > 0 else 0.0
+        mf = 2 * mp * mr / (mp + mr) if mp + mr > 0 else 0.0
+        return np.array([p.mean(), r.mean(), f.mean(), mp, mr, mf])
+
+    return one(s), s
+
+
+def test_precision_recall_op():
+    C = 4
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, C, 32).astype(np.int32)
+    label = rng.integers(0, C, 32).astype(np.int32)
+    states = rng.integers(0, 5, (C, 4)).astype(np.float32)
+    batch_m, batch_s = _pr_ref(idx, label, C)
+    accum_m, accum_s = _pr_ref(idx, label, C, states)
+
+    t = OpTest.__new__(OpTest)
+    t.op_type = "precision_recall"
+    t.inputs = {"Indices": idx, "Labels": ("labels", label),
+                "Weights": ("w", np.ones(32, np.float32)),
+                "StatesInfo": ("states", states)}
+    t.attrs = {"class_number": C}
+    t.outputs = {"BatchMetrics": batch_m.astype(np.float32),
+                 "AccumMetrics": accum_m.astype(np.float32),
+                 "AccumStatesInfo": accum_s.astype(np.float32)}
+    t.check_output(atol=1e-5)

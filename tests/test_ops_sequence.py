@@ -1,0 +1,274 @@
+"""Sequence op family vs numpy references + numeric grads (reference
+pattern: tests/unittests/test_sequence_*.py over the LoD ops in
+operators/sequence_ops/; here the masked-dense design uses explicit
+lengths)."""
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(7)
+B, T, D = 4, 6, 3
+LENGTHS = np.array([6, 3, 1, 4], np.int32)
+
+
+def _mask():
+    return (np.arange(T)[None, :] < LENGTHS[:, None])
+
+
+def _x(shape=(B, T, D)):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class SeqOpTest(OpTest):
+    def __init__(self):
+        self.attrs = {}
+
+
+def _pool_ref(x, pooltype):
+    out = np.zeros((B,) + x.shape[2:], np.float32)
+    for b in range(B):
+        seg = x[b, :LENGTHS[b]]
+        if pooltype == "SUM":
+            out[b] = seg.sum(0)
+        elif pooltype == "MEAN":
+            out[b] = seg.mean(0)
+        elif pooltype == "SQRT":
+            out[b] = seg.sum(0) / np.sqrt(len(seg))
+        elif pooltype == "MAX":
+            out[b] = seg.max(0)
+        elif pooltype == "MIN":
+            out[b] = seg.min(0)
+        elif pooltype == "FIRST":
+            out[b] = seg[0]
+        elif pooltype == "LAST":
+            out[b] = seg[-1]
+    return out
+
+
+def test_sequence_pool_all_types():
+    x = _x()
+    for pooltype in ("SUM", "MEAN", "SQRT", "MAX", "MIN", "FIRST", "LAST"):
+        t = SeqOpTest()
+        t.op_type = "sequence_pool"
+        t.inputs = {"X": x, "Length": ("length", LENGTHS)}
+        t.attrs = {"pooltype": pooltype}
+        t.outputs = {"Out": _pool_ref(x, pooltype)}
+        t.check_output()
+
+
+def test_sequence_pool_grads():
+    x = _x()
+    for pooltype in ("SUM", "MEAN", "SQRT", "MAX", "LAST"):
+        t = SeqOpTest()
+        t.op_type = "sequence_pool"
+        t.inputs = {"X": x, "Length": ("length", LENGTHS)}
+        t.attrs = {"pooltype": pooltype}
+        t.outputs = {"Out": _pool_ref(x, pooltype)}
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_softmax():
+    x = _x((B, T))
+    mask = _mask()
+    z = np.where(mask, x, -1e30)
+    e = np.exp(z - z.max(1, keepdims=True))
+    ref = np.where(mask, e / e.sum(1, keepdims=True), 0).astype(np.float32)
+    t = SeqOpTest()
+    t.op_type = "sequence_softmax"
+    t.inputs = {"X": x, "Length": ("length", LENGTHS)}
+    t.outputs = {"Out": ref}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_reverse():
+    x = _x()
+    ref = x.copy()
+    for b in range(B):
+        ref[b, :LENGTHS[b]] = x[b, :LENGTHS[b]][::-1]
+    t = SeqOpTest()
+    t.op_type = "sequence_reverse"
+    t.inputs = {"X": x, "Length": ("length", LENGTHS)}
+    t.outputs = {"Out": ref}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_expand_as():
+    x = _x((B, D))
+    ref = np.zeros((B, T, D), np.float32)
+    for b in range(B):
+        ref[b, :LENGTHS[b]] = x[b]
+    t = SeqOpTest()
+    t.op_type = "sequence_expand_as"
+    t.inputs = {"X": x, "Length": ("length", LENGTHS)}
+    t.attrs = {"maxlen": T}
+    t.outputs = {"Out": ref}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_mask():
+    t = SeqOpTest()
+    t.op_type = "sequence_mask"
+    t.inputs = {"X": LENGTHS}
+    t.attrs = {"maxlen": T, "out_dtype": "int64"}
+    t.outputs = {"Out": _mask().astype(np.int64)}
+    t.check_output()
+
+
+def test_sequence_pad_unpad_roundtrip():
+    total = int(LENGTHS.sum())
+    packed = RNG.standard_normal((total, D)).astype(np.float32)
+    offsets = np.concatenate([[0], np.cumsum(LENGTHS)[:-1]])
+    padded = np.zeros((B, T, D), np.float32)
+    for b in range(B):
+        padded[b, :LENGTHS[b]] = packed[offsets[b]:offsets[b] + LENGTHS[b]]
+
+    t = SeqOpTest()
+    t.op_type = "sequence_pad"
+    t.inputs = {"X": packed, "Length": ("length", LENGTHS)}
+    t.attrs = {"padded_length": T, "pad_value": 0.0}
+    t.outputs = {"Out": padded}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    unpacked = np.zeros((B * T, D), np.float32)
+    unpacked[:total] = packed
+    t2 = SeqOpTest()
+    t2.op_type = "sequence_unpad"
+    t2.inputs = {"X": padded, "Length": ("length", LENGTHS)}
+    t2.outputs = {"Out": unpacked}
+    t2.check_output()
+    t2.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_concat():
+    l1 = LENGTHS
+    l2 = np.array([2, 4, 3, 1], np.int32)
+    T2 = 5
+    x1, x2 = _x(), _x((B, T2, D))
+    x1 = np.where(_mask()[..., None], x1, 0).astype(np.float32)
+    m2 = np.arange(T2)[None, :] < l2[:, None]
+    x2 = np.where(m2[..., None], x2, 0).astype(np.float32)
+    ref = np.zeros((B, T + T2, D), np.float32)
+    for b in range(B):
+        ref[b, :l1[b]] = x1[b, :l1[b]]
+        ref[b, l1[b]:l1[b] + l2[b]] = x2[b, :l2[b]]
+    t = SeqOpTest()
+    t.op_type = "sequence_concat"
+    t.inputs = {"X": [("x1", x1), ("x2", x2)],
+                "Length": [("len1", l1), ("len2", l2)]}
+    t.outputs = {"Out": ref, "OutLength": (l1 + l2).astype(np.int32)}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_slice():
+    x = _x()
+    offset = np.array([1, 0, 0, 2], np.int32)
+    length = np.array([3, 2, 1, 2], np.int32)
+    ref = np.zeros_like(x)
+    for b in range(B):
+        ref[b, :length[b]] = x[b, offset[b]:offset[b] + length[b]]
+    t = SeqOpTest()
+    t.op_type = "sequence_slice"
+    t.inputs = {"X": x, "Offset": ("offset", offset),
+                "SliceLength": ("slice_len", length),
+                "Length": ("length", LENGTHS)}
+    t.outputs = {"Out": ref, "OutLength": length}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 2, 3, 0, 0],
+                  [5, 2, 2, 0, 0, 0]], np.int64)
+    lengths = np.array([4, 3], np.int32)
+    ref = np.array([[1, 3, 0, 0, 0, 0],
+                    [5, 0, 0, 0, 0, 0]], np.int64)
+    t = SeqOpTest()
+    t.op_type = "sequence_erase"
+    t.inputs = {"X": x, "Length": ("length", lengths)}
+    t.attrs = {"tokens": [2]}
+    t.outputs = {"Out": ref, "OutLength": np.array([2, 1], np.int32)}
+    t.check_output()
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4, 0, 0]], np.int64)
+    lengths = np.array([4], np.int32)
+    ref = np.array([[[1, 2], [2, 3], [3, 4], [4, 0], [0, 0], [0, 0]]],
+                   np.int64)
+    t = SeqOpTest()
+    t.op_type = "sequence_enumerate"
+    t.inputs = {"X": x, "Length": ("length", lengths)}
+    t.attrs = {"win_size": 2, "pad_value": 0}
+    t.outputs = {"Out": ref}
+    t.check_output()
+
+
+def test_sequence_reshape():
+    x = _x((2, 4, 6))
+    lengths = np.array([4, 2], np.int32)
+    x = np.where((np.arange(4)[None, :] < lengths[:, None])[..., None],
+                 x, 0).astype(np.float32)
+    new_dim = 3
+    ref = x.reshape(2, 8, 3)
+    t = SeqOpTest()
+    t.op_type = "sequence_reshape"
+    t.inputs = {"X": x, "Length": ("length", lengths)}
+    t.attrs = {"new_dim": new_dim}
+    t.outputs = {"Out": ref, "OutLength": lengths * 2}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sequence_conv():
+    x = _x()
+    x = np.where(_mask()[..., None], x, 0).astype(np.float32)
+    ctx_len, M = 3, 5
+    filt = RNG.standard_normal((ctx_len * D, M)).astype(np.float32) * 0.3
+    start = -1
+    unfolded = np.zeros((B, T, ctx_len * D), np.float32)
+    for k in range(ctx_len):
+        for t_ in range(T):
+            src = t_ + start + k
+            if 0 <= src < T:
+                unfolded[:, t_, k * D:(k + 1) * D] = x[:, src]
+    ref = (unfolded @ filt) * _mask()[..., None]
+    ref = ref.astype(np.float32)
+    t = SeqOpTest()
+    t.op_type = "sequence_conv"
+    t.inputs = {"X": x, "Filter": ("filter", filt),
+                "Length": ("length", LENGTHS)}
+    t.attrs = {"contextStart": start, "contextLength": ctx_len}
+    t.outputs = {"Out": ref}
+    t.check_output(atol=1e-4)
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.03)
+
+
+def test_sequence_layers_api():
+    """Layer wrappers build and run end-to-end."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        ln = layers.data("len", [B], dtype="int32")
+        pooled = layers.sequence_pool(x, "mean", length=ln)
+        rev = layers.sequence_reverse(x, length=ln)
+        sm = layers.sequence_softmax(layers.reduce_sum(x, dim=-1),
+                                     length=ln)
+        conv = layers.sequence_conv(x, 8, filter_size=3, length=ln)
+    xv = _x()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        p, r, s, c = exe.run(main, feed={"x": xv, "len": LENGTHS},
+                             fetch_list=[pooled, rev, sm, conv])
+    assert p.shape == (B, D) and r.shape == (B, T, D)
+    assert s.shape == (B, T) and c.shape == (B, T, 8)
+    np.testing.assert_allclose(np.asarray(s).sum(1), np.ones(B), rtol=1e-5)
