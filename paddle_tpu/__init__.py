@@ -35,6 +35,12 @@ from . import dataio  # noqa: F401
 from . import io  # noqa: F401
 from . import contrib  # noqa: F401
 from . import metrics  # noqa: F401
+from . import transpiler  # noqa: F401
+from . import incubate  # noqa: F401
+from . import distributed  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,
+)
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,

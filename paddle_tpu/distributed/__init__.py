@@ -1,1 +1,4 @@
-
+"""Distributed runtimes: host parameter-server service, RPC client,
+communicators (reference: paddle/fluid/operators/distributed/)."""
+from .ps import ParameterServer, PSClient  # noqa: F401
+from .communicator import GeoCommunicator  # noqa: F401

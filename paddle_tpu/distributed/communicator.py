@@ -1,0 +1,56 @@
+"""Host-side async communicators.
+
+Capability parity with the reference's trainer-side communicator threads
+(/root/reference/paddle/fluid/operators/distributed/communicator.h —
+AsyncCommunicator :237 merges N grads then sends, HalfAsyncCommunicator
+:299, GeoSgdCommunicator :383 pushes parameter deltas). The TPU trainer
+step is one compiled module, so the communicator hooks BETWEEN steps on the
+host instead of running a background send thread inside the step.
+"""
+import numpy as np
+
+
+class GeoCommunicator:
+    """GEO-SGD: every `push_nums` steps push (param - last_synced) deltas to
+    each param's pserver, receive the merged global table, and rebase the
+    local param on it."""
+
+    def __init__(self, epmap, push_nums=100, scope=None):
+        from ..framework.executor import global_scope
+        self.epmap = dict(epmap)
+        self.push_nums = int(push_nums)
+        self.scope = scope or global_scope()
+        self._step = 0
+        self._base = {}          # param -> last synced global value
+        self._running = False
+
+    def start(self):
+        """Snapshot the sync base (reference Communicator::Start)."""
+        from .ps import PSClient
+        cli = PSClient.instance()
+        for p, ep in self.epmap.items():
+            # rebase on the server's current table so every trainer starts
+            # from the same global params
+            global_val = np.asarray(cli.pull_dense(ep, p))
+            self.scope.set(p, global_val)
+            self._base[p] = global_val.copy()
+        self._running = True
+
+    def step(self):
+        """Call once per training step; syncs every push_nums-th call."""
+        assert self._running, "call start() first"
+        self._step += 1
+        if self._step % self.push_nums:
+            return False
+        from .ps import PSClient
+        cli = PSClient.instance()
+        for p, ep in self.epmap.items():
+            local = np.asarray(self.scope.find_var(p))
+            delta = local - self._base[p]
+            merged = np.asarray(cli.push_delta(ep, p, delta))
+            self.scope.set(p, merged)
+            self._base[p] = merged.copy()
+        return True
+
+    def stop(self):
+        self._running = False

@@ -1,0 +1,118 @@
+"""Multi-process launcher (reference:
+python/paddle/distributed/launch.py:193 — spawns one process per device,
+setting the PADDLE_* env contract; launch_ps.py for pserver clusters).
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 train.py
+    python -m paddle_tpu.distributed.launch --server_num=1 \
+        --worker_num=2 train.py            # parameter-server cluster
+
+Collective workers get PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT (trainer 0's endpoint
+doubles as the jax.distributed coordinator — fleet.init dials it).
+PS mode additionally launches PSERVER-role processes with
+PADDLE_PSERVERS_IP_PORT_LIST, exactly the env PaddleCloudRoleMaker reads.
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_ports(n, ip="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((ip, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="collective worker processes on this node")
+    p.add_argument("--node_ip", default="127.0.0.1")
+    p.add_argument("--started_port", type=int, default=None)
+    p.add_argument("--server_num", type=int, default=0,
+                   help="parameter-server processes (PS mode)")
+    p.add_argument("--worker_num", type=int, default=0,
+                   help="trainer processes (PS mode)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(cmd, env, log_dir, tag):
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"{tag}.log"), "wb")
+    else:
+        out = None
+    return subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+
+
+def launch(args):
+    cmd_base = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    procs = []
+    if args.server_num or args.worker_num:
+        # ---- PS cluster ----
+        n_servers = args.server_num or 1
+        n_workers = args.worker_num or 1
+        sports = _free_ports(n_servers, args.node_ip)
+        server_eps = ",".join(f"{args.node_ip}:{p}" for p in sports)
+        for i in range(n_servers):
+            env = dict(os.environ,
+                       TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
+                       PADDLE_CURRENT_ENDPOINT=f"{args.node_ip}:{sports[i]}",
+                       PADDLE_TRAINERS_NUM=str(n_workers))
+            procs.append(_spawn(cmd_base, env, args.log_dir, f"server.{i}"))
+        for i in range(n_workers):
+            env = dict(os.environ,
+                       TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
+                       PADDLE_TRAINER_ID=str(i),
+                       PADDLE_TRAINERS_NUM=str(n_workers))
+            procs.append(_spawn(cmd_base, env, args.log_dir, f"worker.{i}"))
+    else:
+        # ---- collective ----
+        n = args.nproc_per_node or 1
+        ports = ([args.started_port + i for i in range(n)]
+                 if args.started_port else _free_ports(n, args.node_ip))
+        eps = ",".join(f"{args.node_ip}:{p}" for p in ports)
+        for i in range(n):
+            env = dict(os.environ,
+                       TRAINING_ROLE="TRAINER",
+                       PADDLE_TRAINER_ID=str(i),
+                       PADDLE_TRAINERS_NUM=str(n),
+                       PADDLE_TRAINER_ENDPOINTS=eps,
+                       PADDLE_CURRENT_ENDPOINT=(
+                           f"{args.node_ip}:{ports[i]}"),
+                       FLAGS_selected_tpus=str(i))
+            procs.append(_spawn(cmd_base, env, args.log_dir, f"trainer.{i}"))
+
+    def _terminate(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    if rc:
+        _terminate()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch(parse_args()))

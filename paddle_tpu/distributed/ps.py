@@ -1,0 +1,359 @@
+"""Host-side parameter-server runtime.
+
+Capability parity with the reference's PS stack: `listen_and_serv` event loop
+(/root/reference/paddle/fluid/operators/distributed_ops/listen_and_serv_op.cc:333
+— RunSyncLoop :110 barriers N trainer sends, runs the per-shard optimize
+sub-blocks, releases recvs; RunAsyncLoop :226 applies per-grad on arrival),
+gRPC transport (operators/distributed/grpc/grpc_client.h:176), variable
+serialization (operators/distributed/sendrecvop_utils.cc), GEO communicator
+(operators/distributed/communicator.h:383), and sparse parameter prefetch
+(operators/distributed/parameter_prefetch.cc).
+
+TPU-native split: the device program stays ONE compiled XLA module; send/recv
+cross the host boundary as ordered `jax.experimental.io_callback`s into the
+PSClient below (ops/distributed_ops.py). The server is a plain threaded TCP
+service over length-prefixed pickles holding numpy tables — parameters never
+live on a device at the server, exactly like the reference's CPU pservers —
+and it executes the transpiled optimize sub-blocks EAGERLY through the same
+op registry the compiled trainer uses (no second optimizer implementation).
+"""
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# wire protocol: 8-byte big-endian length + pickle
+# --------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# --------------------------------------------------------------------------
+# eager block runner (pserver-side optimize sub-blocks)
+# --------------------------------------------------------------------------
+
+class _HostCtx:
+    """Minimal LowerCtx for eager host execution of optimize blocks."""
+
+    def __init__(self):
+        self.program = None
+        self.block = None
+        self.env = {}
+        self.base_key = None
+        self.mesh = None
+        self.abstract = False
+
+    def op_key(self, attrs):
+        import jax
+        return jax.random.PRNGKey(attrs.get("seed", 0))
+
+
+def run_block_eager(ops, env):
+    """Run serialized op dicts over an env of numpy/jax arrays (the
+    pserver-side analog of the reference's per-shard Executor on the
+    optimize sub-blocks, listen_and_serv_op.cc:110)."""
+    from ..framework.registry import get_op_def, normalize_outs
+
+    ctx = _HostCtx()
+    ctx.env = env
+    for op in ops:
+        opdef = get_op_def(op["type"])
+        ins = {s: [env[n] for n in ns] for s, ns in op["inputs"].items()}
+        raw = opdef.lower(ctx, ins, op["attrs"])
+        if raw is None:
+            continue
+        outs = normalize_outs(op["outputs"], raw)
+        for slot, names in op["outputs"].items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                if v is not None:
+                    env[n] = v
+    return env
+
+
+# --------------------------------------------------------------------------
+# server
+# --------------------------------------------------------------------------
+
+class ParameterServer:
+    """One pserver: hosts a subset of parameters (+ optimizer accumulator
+    state) and applies updates.
+
+    sync mode: accumulate each param's grads until `trainers` pushes arrive,
+    then run that param's optimize block on the mean grad and release the
+    barrier (reference RunSyncLoop). async mode: apply on every push
+    (HogwildWorker semantics). GEO: trainers push parameter DELTAS which are
+    added to the global table (GeoSgdCommunicator semantics).
+    Sparse tables: rows pulled by id; sparse grads applied row-wise SGD.
+    """
+
+    def __init__(self, endpoint, trainers=1, sync_mode=True):
+        host, port = endpoint.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.trainers = int(trainers)
+        self.sync_mode = bool(sync_mode)
+        self.tables = {}          # var name -> np.ndarray
+        self.optimize_blocks = {}  # param name -> [op dicts]
+        self.lr_map = {}          # param name -> {lr var name: value}
+        self.sparse_lr = {}       # sparse table name -> lr
+        self._grad_acc = {}       # param -> [grads]
+        self._round = 0
+        self._barrier_count = 0
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._sock = None
+        self._accepts = []
+
+    # -- state installation (from the transpiled pserver program) ----------
+    def host_param(self, name, value, optimize_ops=None, extra_state=None):
+        self.tables[name] = np.asarray(value)
+        if optimize_ops:
+            self.optimize_blocks[name] = optimize_ops
+        for k, v in (extra_state or {}).items():
+            self.tables[k] = np.asarray(v)
+
+    def host_sparse_table(self, name, value, lr=0.01):
+        self.tables[name] = np.asarray(value)
+        self.sparse_lr[name] = float(lr)
+
+    # -- serving -----------------------------------------------------------
+    def serve(self, ready_event=None, block=True):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(64)
+        if ready_event is not None:
+            ready_event.set()
+        if not block:
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+            return t
+        self._accept_loop()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._accepts.append(t)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _apply_update(self, pname, grad):
+        ops = self.optimize_blocks.get(pname)
+        if ops is None:
+            # bare SGD fallback when no optimize block was shipped
+            lr = self.lr_map.get(pname, {}).get("__default__", 0.01)
+            self.tables[pname] = self.tables[pname] - lr * grad
+            return
+        env = dict(self.tables)
+        env.update(self.lr_map.get(pname, {}))
+        gname = self._grad_name(pname, ops)
+        env[gname] = grad
+        run_block_eager(ops, env)
+        for op in ops:
+            for names in op["outputs"].values():
+                for n in names:
+                    if n in env:
+                        self.tables[n] = np.asarray(env[n])
+
+    @staticmethod
+    def _grad_name(pname, ops):
+        for op in ops:
+            g = op["inputs"].get("Grad")
+            if g:
+                return g[0]
+        return pname + "@GRAD"
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, EOFError):
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception:           # surface handler errors to the
+                    import traceback        # client instead of dying silently
+                    reply = ("err", traceback.format_exc())
+                _send_msg(conn, reply)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg):
+        kind = msg[0]
+        if kind == "push_dense":
+            _, name, grad = msg
+            if self.sync_mode:
+                with self._cv:
+                    self._grad_acc.setdefault(name, []).append(
+                        np.asarray(grad))
+                return ("ok",)
+            self._apply_update(name, np.asarray(grad))
+            return ("ok",)
+        if kind == "send_barrier":
+            # sync round completion: the Nth barrier applies all updates
+            with self._cv:
+                self._barrier_count += 1
+                if self._barrier_count >= self.trainers:
+                    for name, grads in self._grad_acc.items():
+                        self._apply_update(
+                            name, np.mean(np.stack(grads), axis=0)
+                            if len(grads) > 1 else grads[0])
+                    self._grad_acc.clear()
+                    self._barrier_count = 0
+                    self._round += 1
+                    self._cv.notify_all()
+                else:
+                    rnd = self._round
+                    self._cv.wait_for(
+                        lambda: self._round != rnd or self._stop.is_set(),
+                        timeout=120.0)
+            return ("ok",)
+        if kind == "pull_dense":
+            _, name = msg
+            return ("val", self.tables[name])
+        if kind == "push_delta":          # GEO-SGD
+            _, name, delta = msg
+            with self._cv:
+                self.tables[name] = self.tables[name] + np.asarray(delta)
+                return ("val", self.tables[name])
+        if kind == "pull_sparse":
+            _, name, ids = msg
+            return ("val", self.tables[name][np.asarray(ids)])
+        if kind == "push_sparse":
+            _, name, ids, rows = msg
+            ids = np.asarray(ids).reshape(-1)
+            rows = np.asarray(rows).reshape(ids.shape[0], -1)
+            with self._cv:
+                np.subtract.at(self.tables[name], ids,
+                               self.sparse_lr.get(name, 0.01) * rows)
+            return ("ok",)
+        if kind == "barrier_ping":
+            return ("ok",)
+        if kind == "stop":
+            self._stop.set()
+            with self._cv:
+                self._cv.notify_all()
+            return ("ok",)
+        return ("err", f"unknown message {kind!r}")
+
+
+# --------------------------------------------------------------------------
+# client (one per process; reference RPCClient rpc_client.h:34)
+# --------------------------------------------------------------------------
+
+class PSClient:
+    _instances = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._conns = {}
+        self._conn_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls, key="default"):
+        with cls._lock:
+            if key not in cls._instances:
+                cls._instances[key] = cls()
+            return cls._instances[key]
+
+    def _conn(self, endpoint):
+        with self._conn_lock:
+            sock = self._conns.get(endpoint)
+            if sock is None:
+                host, port = endpoint.rsplit(":", 1)
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=120.0)
+                self._conns[endpoint] = sock
+            return sock
+
+    def _call(self, endpoint, msg):
+        sock = self._conn(endpoint)
+        with self._conn_lock:
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+        if reply[0] == "err":
+            raise RuntimeError(f"pserver {endpoint}: {reply[1]}")
+        return reply[1] if reply[0] == "val" else None
+
+    # public API used by the distributed ops
+    def push_dense(self, endpoint, name, grad):
+        self._call(endpoint, ("push_dense", name, np.asarray(grad)))
+
+    def send_barrier(self, endpoints):
+        for ep in dict.fromkeys(endpoints):
+            self._call(ep, ("send_barrier",))
+
+    def pull_dense(self, endpoint, name):
+        return self._call(endpoint, ("pull_dense", name))
+
+    def push_delta(self, endpoint, name, delta):
+        return self._call(endpoint, ("push_delta", name, np.asarray(delta)))
+
+    def pull_sparse(self, endpoint, name, ids):
+        return self._call(endpoint, ("pull_sparse", name, np.asarray(ids)))
+
+    def push_sparse(self, endpoint, name, ids, rows):
+        self._call(endpoint, ("push_sparse", name, np.asarray(ids),
+                              np.asarray(rows)))
+
+    def stop_servers(self, endpoints):
+        for ep in dict.fromkeys(endpoints):
+            try:
+                self._call(ep, ("stop",))
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+
+    def wait_ports(self, endpoints, timeout=60.0):
+        """Reference get_trainer_program(wait_port=True) semantics."""
+        import time
+        for ep in dict.fromkeys(endpoints):
+            host, port = ep.rsplit(":", 1)
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=1.0)
+                    s.close()
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(f"pserver {ep} not up")
+                    time.sleep(0.1)

@@ -45,7 +45,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 def _append_backward_core(targets, target_gradients, parameter_list=None,
                           no_grad_set=None, checkpoints=None,
-                          collect_params=True, finalize_names=None):
+                          collect_params=True, finalize_names=None,
+                          finalize_out=None):
     """Shared reverse-pass emitter behind append_backward and gradients().
 
     `targets`: Variables to differentiate; `target_gradients`: parallel list
@@ -256,15 +257,29 @@ def _append_backward_core(targets, target_gradients, parameter_list=None,
                                 infer_shape=False)
 
     emit_set = {id(op) for op in emit_plan}
+    finalize_set = set(finalize_names or ())
+
+    def _record_final(var_name, grad_name):
+        """Remember the FINAL grad name of a gradients()-requested var at
+        the moment its writer consumes it — the canonical name can be a
+        custom seed cotangent's name rather than var@GRAD."""
+        if finalize_out is not None and grad_name is not None and \
+                var_name not in finalize_out:
+            finalize_out[var_name] = grad_name
     for op in reversed(fwd_ops):
         if id(op) not in emit_set:
             # still the (reverse-order) live writer of its outputs: any
             # pending upstream grads belong to the value THIS op wrote
             # (a constant / non-diff result) and must be dropped, not left
-            # to leak into an earlier differentiable writer of the name
+            # to leak into an earlier differentiable writer of the name.
+            # If gradients() asked for this var, collapse its partials into
+            # the canonical @GRAD var first — d(target)/d(var) is complete
+            # exactly when its writer is reached in the reverse walk.
             for names in op.outputs.values():
                 for n in names:
                     if grad_map.get(n):
+                        if n in finalize_set:
+                            _record_final(n, finalize(n))
                         grad_map[n] = []
             continue
         if ckpt_names:
@@ -286,6 +301,9 @@ def _append_backward_core(targets, target_gradients, parameter_list=None,
         has_any = False
         for slot, names in op.outputs.items():
             gs = [finalize(n) for n in names]
+            for n, g in zip(names, gs):
+                if n in finalize_set:
+                    _record_final(n, g)
             if any(g is not None for g in gs):
                 has_any = True
                 out_grad_mask[slot] = [g is not None for g in gs]
@@ -352,7 +370,7 @@ def _append_backward_core(targets, target_gradients, parameter_list=None,
         gvar = block.var(g)
         params_grads.append((p, gvar))
     for n in finalize_names or ():
-        finalize(n)
+        _record_final(n, finalize(n))
     if collect_params:
         program._params_grads = params_grads
     return params_grads
@@ -385,16 +403,17 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         raise ValueError(
             f"target_gradients length {len(target_gradients)} != targets "
             f"length {len(targets)}")
+    fin_map = {}
     _append_backward_core(list(targets), list(target_gradients),
                           parameter_list=[], no_grad_set=no_grad_set,
                           collect_params=False,
-                          finalize_names=[iv.name for iv in inputs])
+                          finalize_names=[iv.name for iv in inputs],
+                          finalize_out=fin_map)
     block = targets[0].block
     outs = []
     for iv in inputs:
-        gname = grad_var_name(iv.name)
-        if block.has_var(gname):
-            outs.append(block.var(gname))
-        else:
-            outs.append(None)
+        gname = fin_map.get(iv.name)
+        if gname is None and block.has_var(grad_var_name(iv.name)):
+            gname = grad_var_name(iv.name)
+        outs.append(block.var(gname) if gname is not None else None)
     return outs

@@ -394,6 +394,8 @@ class Program:
                 nb.ops.append(nop)
             p.blocks.append(nb)
         p.current_block_idx = 0
+        if hasattr(self, "_ring_axes"):
+            p._ring_axes = dict(self._ring_axes)
         p._is_test = for_test
         if for_test:
             # dropping Backward/Optimize-role ops orphans their vars

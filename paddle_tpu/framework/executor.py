@@ -119,6 +119,10 @@ class Executor:
         if program is None:
             program = default_main_program()
         scope = scope or global_scope()
+        ls_op = next((op for op in program.global_block().ops
+                      if op.type == "listen_and_serv"), None)
+        if ls_op is not None:
+            return self._run_pserver(ls_op, scope)
         feed = self._feed_dict(feed)
         fetch_names = self._fetch_names(fetch_list)
 
@@ -180,6 +184,35 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+    def _run_pserver(self, ls_op, scope):
+        """Host parameter-server event loop (reference
+        listen_and_serv_op.cc:333 RunImpl — the op IS the server). Blocks
+        until every trainer sent `stop`; the final tables are written back
+        to the scope."""
+        import numpy as np
+        from ..distributed.ps import ParameterServer
+
+        attrs = ls_op.attrs
+        server = ParameterServer(attrs["endpoint"],
+                                 trainers=int(attrs.get("Fanin", 1)),
+                                 sync_mode=bool(attrs.get("sync_mode",
+                                                          True)))
+        for name in attrs.get("hosted_vars", []):
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    f"pserver var {name!r} not initialized — run the "
+                    f"pserver startup program first (transpiler."
+                    f"get_startup_program(endpoint))")
+            server.tables[name] = np.asarray(val)
+        server.optimize_blocks = dict(attrs.get("optimize_blocks", {}))
+        for name, lr in attrs.get("sparse_tables", {}).items():
+            server.sparse_lr[name] = float(lr)
+        server.serve(block=True)
+        for name, val in server.tables.items():
+            scope.set(name, val)
+        return []
 
     def close(self):
         self._cache.clear()
@@ -244,7 +277,9 @@ def _shard_state(state, mesh, program):
     """Place scope state per its Variable dist_attr (params annotated for tp
     are split across the mesh; everything else replicates). The jitted step
     then respects these input shardings — the GSPMD replacement for the
-    reference's BCastParamsToDevices (parallel_executor.cc:739)."""
+    reference's BCastParamsToDevices (parallel_executor.cc:739). Multi-host:
+    every process holds the full value, so each assembles its addressable
+    shards via make_array_from_callback."""
     from ..parallel.mesh import sharding_for
     gblock = program.global_block()
     changed = False
@@ -253,16 +288,35 @@ def _shard_state(state, mesh, program):
         target = sharding_for(mesh, var)
         if isinstance(a, jax.Array) and a.sharding == target:
             continue
-        state[n] = jax.device_put(a, target)
+        if jax.process_count() > 1:
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                # already a distributed global array on a different
+                # sharding: reshard with a compiled identity (collectives
+                # do the cross-host movement; np.asarray would raise)
+                state[n] = jax.jit(lambda v: v, out_shardings=target)(a)
+            else:
+                arr = np.asarray(a)
+                state[n] = jax.make_array_from_callback(
+                    arr.shape, target, lambda idx, _arr=arr: _arr[idx])
+        else:
+            state[n] = jax.device_put(a, target)
         changed = True
     return changed
 
 
 def _shard_feed(feed_arrays, mesh, program):
+    """Single-process: shard the full fed batch over the mesh. Multi-host
+    (fleet): each trainer process feeds its OWN local batch (reference
+    semantics — every trainer reads its own data shard), assembled into one
+    global array along the dp axis."""
     from jax.sharding import NamedSharding
     out = {}
+    multi = jax.process_count() > 1
     for n, a in feed_arrays.items():
         arr = np.asarray(a)
-        out[n] = jax.device_put(
-            arr, NamedSharding(mesh, _batch_pspec(mesh, arr)))
+        sharding = NamedSharding(mesh, _batch_pspec(mesh, arr))
+        if multi:
+            out[n] = jax.make_array_from_process_local_data(sharding, arr)
+        else:
+            out[n] = jax.device_put(arr, sharding)
     return out

@@ -115,6 +115,8 @@ def analyze_block_io(program, block_idx, feed_names):
                     bound = set(op.attrs.get("step_input_vars", ()))
                     bound.update(m[0] for m in op.attrs.get("memories", ()))
                     bound.update(op.attrs.get("x_names", ()))
+                    if "x_name" in op.attrs:        # pipeline stage input
+                        bound.add(op.attrs["x_name"])
                     visit_block(sb, set(local_defined) | bound)
             for n in op.output_arg_names:
                 local_defined.add(n)

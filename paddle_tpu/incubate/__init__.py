@@ -1,0 +1,2 @@
+"""Incubating front-ends (reference: python/paddle/fluid/incubate/)."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,3 @@
+"""Fleet distributed-training API (reference:
+python/paddle/fluid/incubate/fleet/ — base/fleet_base.py:34)."""
+from . import base  # noqa: F401

@@ -1,0 +1,109 @@
+"""Role makers (reference:
+python/paddle/fluid/incubate/fleet/base/role_maker.py — Role :30,
+PaddleCloudRoleMaker :441 env-based, UserDefinedRoleMaker :876/:952).
+The launcher (paddle_tpu/distributed/launch.py) sets the same PADDLE_*
+environment contract the reference cloud launcher uses."""
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def get_current_endpoint(self):
+        eps = (self._worker_endpoints if self.is_worker()
+               else self._server_endpoints)
+        return eps[self._current_id] if eps else ""
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-driven (reference role_maker.py:441): TRAINING_ROLE,
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS,
+    PADDLE_PSERVERS_IP_PORT_LIST, POD_IP + PADDLE_PORT."""
+
+    def __init__(self, is_collective=False):
+        super().__init__()
+        self._is_collective = is_collective
+        self.generate_role()
+
+    def generate_role(self):
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._worker_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if e]
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        if role == "PSERVER":
+            self._role = Role.SERVER
+            cur = os.environ.get("PADDLE_CURRENT_ENDPOINT") or (
+                os.environ.get("POD_IP", "127.0.0.1") + ":" +
+                os.environ.get("PADDLE_PORT", "0"))
+            self._current_id = (self._server_endpoints.index(cur)
+                                if cur in self._server_endpoints else 0)
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                   str(max(len(self._worker_endpoints), 1))))
+            if not self._worker_endpoints:
+                self._worker_endpoints = [""] * n
+
+    def worker_num(self):
+        return int(os.environ.get(
+            "PADDLE_TRAINERS_NUM",
+            str(max(len(self._worker_endpoints), 1))))
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """reference role_maker.py:876 — explicit role wiring, no env."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, worker_endpoints=None):
+        super().__init__()
+        self._current_id = int(current_id)
+        self._role = role
+        self._server_endpoints = list(server_endpoints or [])
+        self._worker_endpoints = list(worker_endpoints or
+                                      [""] * int(worker_num))
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)

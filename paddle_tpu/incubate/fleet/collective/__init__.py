@@ -1,0 +1,146 @@
+"""Fleet collective mode (reference:
+python/paddle/fluid/incubate/fleet/collective/__init__.py — Collective
+:64, CollectiveOptimizer :384, DistributedStrategy :334; fleet_base.py:34).
+
+TPU mapping: fleet.init wires jax.distributed (coordinator = trainer 0's
+endpoint, Gloo/ICI backend chosen by jax) so every process sees the global
+device set; distributed_optimizer(...).minimize builds the program as
+usual, and fleet.main_program is a CompiledProgram over a global dp mesh —
+GSPMD emits the gradient all-reduces the reference's transpiler inserted as
+c_allreduce_sum ops (transpiler/collective.py:209). Each trainer feeds its
+local batch; the executor assembles the global array
+(framework/executor.py _shard_feed)."""
+import os
+
+from ..base.role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class DistributedStrategy:
+    """reference collective/__init__.py:334 (knobs that map to XLA are
+    honored; stream/fusion knobs are XLA's job)."""
+
+    def __init__(self):
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+
+
+class Collective:
+    def __init__(self):
+        self._role_maker = None
+        self._compiled = None
+        self._origin_program = None
+        self._strategy = None
+        self._inited = False
+
+    # -- lifecycle (fleet_base.py:34 contract) ---------------------------
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=True)
+        assert isinstance(role_maker, RoleMakerBase)
+        self._role_maker = role_maker
+        n = role_maker.worker_num()
+        if n > 1:
+            import jax
+            eps = role_maker.get_trainer_endpoints()
+            coordinator = eps[0] if eps and eps[0] else None
+            assert coordinator, \
+                "multi-process fleet needs PADDLE_TRAINER_ENDPOINTS"
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=n,
+                process_id=role_maker.worker_index())
+        self._inited = True
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        assert self._inited, "call fleet.init(role) first"
+        self._strategy = strategy or DistributedStrategy()
+        return CollectiveOptimizer(self, optimizer, self._strategy)
+
+    @property
+    def main_program(self):
+        assert self._compiled is not None, \
+            "call distributed_optimizer(...).minimize(loss) first"
+        return self._compiled
+
+    @property
+    def startup_program(self):
+        from ....framework.core import default_startup_program
+        return default_startup_program()
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+        io.save_persistables(executor, dirname,
+                             main_program or self._origin_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor,
+                                main_program or self._origin_program)
+
+
+class CollectiveOptimizer:
+    """reference CollectiveOptimizer (collective/__init__.py:384): minimize
+    + compile the program for the global mesh."""
+
+    def __init__(self, fleet_obj, inner, strategy):
+        self._fleet = fleet_obj
+        self._inner = inner
+        self._strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import jax
+        from ....optimizer import RecomputeOptimizer
+        from ....parallel.compiler import CompiledProgram
+        from ....parallel.mesh import Mesh
+        import numpy as np
+
+        inner = self._inner
+        if self._strategy.forward_recompute:
+            inner = RecomputeOptimizer(inner)
+            inner._set_checkpoints(self._strategy.recompute_checkpoints)
+        result = inner.minimize(loss, startup_program, parameter_list,
+                                no_grad_set)
+        program = loss.block.program
+        self._fleet._origin_program = program
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        self._fleet._compiled = CompiledProgram(program).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+fleet = Collective()

@@ -1,0 +1,107 @@
+"""Fleet parameter-server mode (reference:
+python/paddle/fluid/incubate/fleet/parameter_server/distribute_transpiler/
+__init__.py — fleet.init / init_server / run_server / init_worker /
+stop_worker over DistributeTranspiler). Drives the host PS runtime in
+paddle_tpu/distributed/ps.py through the same role-make/transpile flow."""
+from ..base.role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class ParameterServerFleet:
+    def __init__(self):
+        self._role_maker = None
+        self._transpiler = None
+        self._trainer_program = None
+        self._pserver_prog = None
+        self._pserver_startup = None
+
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker()
+        assert isinstance(role_maker, RoleMakerBase)
+        self._role_maker = role_maker
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return _TranspilerOptimizer(self, optimizer, strategy)
+
+    # -- server lifecycle -------------------------------------------------
+    def init_server(self, *args, **kwargs):
+        from ....framework.executor import Executor
+        t = self._transpiler
+        ep = self._role_maker.get_pserver_endpoints()[
+            self._role_maker.server_index()]
+        self._pserver_prog, self._pserver_startup = t.get_pserver_programs(
+            ep)
+        Executor().run(self._pserver_startup)
+
+    def run_server(self):
+        from ....framework.executor import Executor
+        assert self._pserver_prog is not None, "call init_server() first"
+        Executor().run(self._pserver_prog)
+
+    # -- worker lifecycle -------------------------------------------------
+    def init_worker(self):
+        from ....distributed.ps import PSClient
+        PSClient.instance().wait_ports(
+            self._role_maker.get_pserver_endpoints())
+
+    def stop_worker(self):
+        from ....distributed.ps import PSClient
+        if self._role_maker.is_first_worker():
+            PSClient.instance().stop_servers(
+                self._role_maker.get_pserver_endpoints())
+
+    @property
+    def main_program(self):
+        assert self._trainer_program is not None, \
+            "call distributed_optimizer(...).minimize(loss) first"
+        return self._trainer_program
+
+    @property
+    def startup_program(self):
+        from ....framework.core import default_startup_program
+        return default_startup_program()
+
+
+class _TranspilerOptimizer:
+    def __init__(self, fleet_obj, inner, strategy=None):
+        self._fleet = fleet_obj
+        self._inner = inner
+        self._strategy = strategy  # DistributeTranspilerConfig or None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ....transpiler import DistributeTranspiler
+        result = self._inner.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        rm = self._fleet._role_maker
+        t = DistributeTranspiler(config=self._strategy)
+        t.transpile(
+            trainer_id=rm.worker_index(),
+            program=loss.block.program,
+            pservers=",".join(rm.get_pserver_endpoints()),
+            trainers=rm.worker_num(),
+            sync_mode=getattr(self._strategy, "sync_mode", True),
+            startup_program=startup_program)
+        self._fleet._transpiler = t
+        if rm.is_worker():
+            self._fleet._trainer_program = t.get_trainer_program(
+                wait_port=False)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+fleet = ParameterServerFleet()

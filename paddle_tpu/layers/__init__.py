@@ -14,7 +14,9 @@ from .sequence_lod import (  # noqa: F401
     sequence_unpad, sequence_concat, sequence_slice, sequence_erase,
     sequence_enumerate, sequence_reshape, sequence_mask, sequence_conv,
 )
+from .pipeline import Pipeline  # noqa: F401
 from . import nn, tensor, loss, math, control_flow, sequence_lod  # noqa: F401
+from . import pipeline  # noqa: F401
 from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,

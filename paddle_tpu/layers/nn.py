@@ -40,6 +40,30 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     return out
 
 
+def distributed_embedding(input, size, table_name, endpoint, name=None):
+    """Sparse embedding served from a host parameter-server table
+    (reference distributed_lookup_table_op.cc + parameter_prefetch.cc;
+    the table lives on the pserver, only touched rows cross the host
+    boundary, and sparse grads are applied server-side on push). `size` is
+    (vocab, dim); the table must be hosted via ParameterServer.
+    host_sparse_table(table_name, ...)."""
+    from .tensor import fill_constant
+    helper = LayerHelper("distributed_embedding", name=name)
+    stub = fill_constant([1], "float32", 0.0)
+    stub.stop_gradient = False      # gives autodiff a path to the push
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="distributed_lookup_table",
+        inputs={"Ids": [input], "W": [stub]},
+        outputs={"Out": [out]},
+        attrs={"table_name": table_name, "endpoint": endpoint,
+               "emb_dim": int(size[1])},
+        infer_shape=False)
+    out.shape = tuple(input.shape or ()) + (int(size[1]),)
+    out.dtype = "float32"
+    return out
+
+
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None,
            use_cudnn=True, name=None, data_format="NCHW"):

@@ -88,6 +88,11 @@ def sequence_unpad(x, length=None, name=None):
 def sequence_concat(input, length=None, name=None):
     """input: list of padded [B, Ti, ...]; length: parallel list of [B]
     length Variables. Returns (out, out_length)."""
+    if length is None or len(length) != len(input):
+        raise ValueError(
+            "sequence_concat needs length=[len1, len2, ...] (one [B] int "
+            "Variable per input); the reference reads LoD off the inputs, "
+            "the TPU build passes lengths explicitly")
     return _seq_op("sequence_concat",
                    {"X": list(input), "Length": list(length)}, {},
                    input[0].dtype, n_outs=2,
@@ -95,10 +100,12 @@ def sequence_concat(input, length=None, name=None):
 
 
 def sequence_slice(input, offset, length, name=None, seq_length=None):
-    """Per-row [offset, offset+length) slice; `seq_length` is the input's
-    valid-length vector (unused by the kernel but kept for API parity)."""
-    ins = {"X": [input], "Offset": [offset], "SliceLength": [length],
-           "Length": [seq_length if seq_length is not None else length]}
+    """Per-row [offset, offset+length) slice; `seq_length` (the input's
+    valid-length vector) is optional — the kernel slices by Offset and
+    SliceLength alone."""
+    ins = {"X": [input], "Offset": [offset], "SliceLength": [length]}
+    if seq_length is not None:
+        ins["Length"] = [seq_length]
     return _seq_op("sequence_slice", ins, {}, input.dtype, n_outs=2,
                    out_dtypes=[input.dtype, "int32"], name=name)
 

@@ -214,5 +214,5 @@ class Auc(MetricBase):
             return 0.0
         tp0 = np.concatenate([[0.0], tp[:-1]])
         fp0 = np.concatenate([[0.0], fp[:-1]])
-        area = np.sum((fp - fp0) * (tp + tp0) / 2.0)
+        area = np.sum(self.trapezoid_area(fp0, fp, tp0, tp))
         return float(area / (tot_pos * tot_neg))

@@ -9,4 +9,6 @@ from . import (  # noqa: F401
     collective_ops,
     control_flow_ops,
     sequence_ops,
+    pipeline_ops,
+    distributed_ops,
 )

@@ -155,6 +155,10 @@ def sharding_constraint(ctx, ins, attrs):
     mesh = ctx.mesh
     if mesh is None:
         return {"Out": x}
+    # inside a shard_map region (pipeline stages) arrays are per-device and
+    # GSPMD constraints don't apply — identity there
+    if any(_axis_in_scope(a) for a in mesh.axis_names):
+        return {"Out": x}
     from jax.sharding import NamedSharding
     from ..parallel.mesh import partition_spec
     spec = partition_spec(mesh, attrs.get("spec", ()), x.shape)
@@ -181,11 +185,14 @@ def c_gen_nccl_id(ctx, ins, attrs):
 
 @register_op("c_comm_init", grad=False, infer_shape=False)
 def c_comm_init(ctx, ins, attrs):
-    # ring bootstrap collapses to a registry entry: bind ring_id -> axis,
-    # scoped to the program that contains the init op
+    # ring bootstrap collapses to a registry entry: bind ring_id -> axis.
+    # Written both program-scoped and process-wide (last-wins): init ops
+    # conventionally live in the STARTUP program while the collectives run
+    # in the main program, so the cross-program fallback is load-bearing.
     if "axis_name" in attrs:
         register_ring(attrs.get("ring_id", 0), attrs["axis_name"],
                       program=ctx.program)
+        register_ring(attrs.get("ring_id", 0), attrs["axis_name"])
     return None
 
 

@@ -1,0 +1,145 @@
+"""P2P / parameter-server ops.
+
+Capability parity with the reference's distributed op family
+(/root/reference/paddle/fluid/operators/distributed_ops/ — send_op.cc,
+recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc, listen_and_serv_op.cc,
+prefetch_op.cc, distributed_lookup_table_op.cc).
+
+TPU-native boundary: the trainer step stays one compiled XLA module; each
+send/recv is an ORDERED jax.experimental.io_callback into the host PSClient
+(distributed/ps.py), so XLA sequences RPC side effects with the token chain
+the way the reference sequences them on the RPC client. `listen_and_serv`
+is a host event loop, not device code — the Executor intercepts it and
+serves (framework/executor.py) instead of tracing.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..framework.registry import register_op, register_grad_lower
+from .common import x_of
+
+
+def _client(attrs):
+    from ..distributed.ps import PSClient
+    return PSClient.instance(attrs.get("client_key", "default"))
+
+
+@register_op("send", grad=False, infer_shape=False)
+def send_op(ctx, ins, attrs):
+    """Push grads to their pservers (reference send_op.cc). attrs:
+    send_varnames (server-side names, aligned with X), epmap."""
+    names = list(attrs["send_varnames"])
+    epmap = list(attrs["epmap"])
+    xs = list(ins.get("X", []))
+
+    def do_send(*vals):
+        cli = _client(attrs)
+        for name, ep, v in zip(names, epmap, vals):
+            cli.push_dense(ep, name, np.asarray(v))
+        return np.zeros((), np.int32)
+
+    io_callback(do_send, jax.ShapeDtypeStruct((), jnp.int32), *xs,
+                ordered=True)
+    return None
+
+
+@register_op("send_barrier", grad=False, infer_shape=False)
+def send_barrier_op(ctx, ins, attrs):
+    """Sync-round barrier: blocks until every trainer's grads of this round
+    are in and the pserver applied the updates (reference
+    send_barrier_op.cc + RunSyncLoop)."""
+    endpoints = list(attrs["endpoints"])
+
+    def do_barrier():
+        _client(attrs).send_barrier(endpoints)
+        return np.zeros((), np.int32)
+
+    io_callback(do_barrier, jax.ShapeDtypeStruct((), jnp.int32),
+                ordered=True)
+    return None
+
+
+@register_op("fetch_barrier", grad=False, infer_shape=False)
+def fetch_barrier_op(ctx, ins, attrs):
+    return None  # recv is already ordered after send_barrier's token
+
+
+@register_op("recv", grad=False, infer_shape=False)
+def recv_op(ctx, ins, attrs):
+    """Pull fresh params from their pservers (reference recv_op.cc). attrs:
+    recv_varnames (aligned with Out), epmap, shapes, dtypes."""
+    names = list(attrs["recv_varnames"])
+    epmap = list(attrs["epmap"])
+    shapes = [tuple(s) for s in attrs["shapes"]]
+    dtypes = list(attrs["dtypes"])
+
+    def do_recv():
+        cli = _client(attrs)
+        return tuple(
+            np.asarray(cli.pull_dense(ep, n), dtype=dt).reshape(shape)
+            for n, ep, shape, dt in zip(names, epmap, shapes, dtypes))
+
+    out_shapes = tuple(jax.ShapeDtypeStruct(s, np.dtype(dt))
+                       for s, dt in zip(shapes, dtypes))
+    vals = io_callback(do_recv, out_shapes, ordered=True)
+    return {"Out": list(vals)}
+
+
+@register_op("listen_and_serv", grad=False, infer_shape=False)
+def listen_and_serv_op(ctx, ins, attrs):
+    raise RuntimeError(
+        "listen_and_serv is a host event loop (reference "
+        "listen_and_serv_op.cc:333) — it cannot be traced into XLA. "
+        "Executor.run detects it and serves on the host; getting here "
+        "means the pserver program was compiled like a trainer program.")
+
+
+@register_op("distributed_lookup_table", grad=None, infer_shape=False)
+def distributed_lookup_table(ctx, ins, attrs):
+    """Sparse parameter prefetch: pull only the touched embedding rows from
+    the pserver's host table (reference parameter_prefetch.cc +
+    distributed_lookup_table_op.cc). Backward pushes row-wise sparse grads
+    (server applies SGD on arrival — async large-scale-sparse semantics).
+    The float "W" input is a local stub whose only job is to give autodiff
+    a differentiable path so the custom grad (sparse push) runs."""
+    ids = x_of(ins, "Ids")
+    table = attrs["table_name"]
+    ep = attrs["endpoint"]
+    dim = int(attrs["emb_dim"])
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+
+    def do_pull(ids_np):
+        cli = _client(attrs)
+        return np.asarray(cli.pull_sparse(ep, table, ids_np),
+                          dtype=np.float32)
+
+    rows = io_callback(
+        do_pull, jax.ShapeDtypeStruct((flat.shape[0], dim), jnp.float32),
+        flat, ordered=True)
+    return {"Out": rows.reshape(tuple(ids.shape) + (dim,))}
+
+
+@register_grad_lower("distributed_lookup_table")
+def distributed_lookup_table_grad(ctx, ins, attrs):
+    fwd = attrs["__fwd_op__"]
+    fattrs = fwd["attrs"]
+    ids = x_of(ins, "Ids")
+    g = x_of(ins, "Out@GRAD")
+    dim = int(fattrs["emb_dim"])
+    flat_ids = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    flat_g = jnp.reshape(g, (-1, dim))
+
+    def do_push(ids_np, rows_np):
+        cli = _client(fattrs)
+        cli.push_sparse(fattrs["endpoint"], fattrs["table_name"],
+                        ids_np, rows_np)
+        return np.zeros((), np.int32)
+
+    io_callback(do_push, jax.ShapeDtypeStruct((), jnp.int32),
+                flat_ids, flat_g, ordered=True)
+    # the server applied the update; only the stub's zero grad flows locally
+    w = x_of(ins, "W")
+    return {"W@GRAD": [jnp.zeros_like(w)]}

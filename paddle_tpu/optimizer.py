@@ -713,3 +713,63 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 Dpsgd = DpsgdOptimizer
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel training driver (reference optimizer.py:3554
+    PipelineOptimizer + pipeline_trainer.cc/section_worker.cc runtime).
+
+    The reference cuts the program at `cut_list` variables into sections
+    placed on `place_list` devices and streams microbatches through scope
+    queues between section-worker threads. On TPU the placement mechanism is
+    the "pp" mesh axis instead: express the repeated model segment with
+    layers.Pipeline (uniform stage sub-block, stage weights stacked over
+    pp) and the shard_map+ppermute GPipe schedule replaces the thread/queue
+    runtime — see ops/pipeline_ops.py. Microbatch gradient accumulation
+    happens inside the differentiated rotation scan, so minimize() here is
+    the plain backward+update over the pipelined program.
+
+    cut_list/place_list/concurrency_list/queue_size/sync_steps/
+    start_cpu_core_id are accepted for API parity; heterogeneous placement
+    has no TPU analog, so anything but the defaults warns.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None):
+        self._inner = optimizer
+        self.num_microbatches = num_microbatches
+        if cut_list or place_list or concurrency_list:
+            import warnings
+            warnings.warn(
+                "PipelineOptimizer cut_list/place_list/concurrency_list "
+                "describe heterogeneous device placement, which has no TPU "
+                "analog; build the repeated segment with layers.Pipeline "
+                "(pp-axis GPipe) instead — these arguments are ignored",
+                stacklevel=2)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        pipe_ops = [op for blk in program.blocks for op in blk.ops
+                    if op.type == "pipeline"]
+        if not pipe_ops:
+            import warnings
+            warnings.warn(
+                "PipelineOptimizer.minimize on a program with no "
+                "layers.Pipeline stage — training proceeds unpipelined",
+                stacklevel=2)
+        elif self.num_microbatches is not None:
+            for op in pipe_ops:
+                m = int(op.attrs.get("num_microbatches", 0))
+                if m != int(self.num_microbatches):
+                    raise ValueError(
+                        f"PipelineOptimizer(num_microbatches="
+                        f"{self.num_microbatches}) does not match "
+                        f"layers.Pipeline(num_microbatches={m}); the "
+                        f"Pipeline layer's value is the one that executes")
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)

@@ -1,0 +1,330 @@
+"""Distributed program transpilers.
+
+Capability parity with the reference front-ends
+(/root/reference/python/paddle/fluid/transpiler/distribute_transpiler.py:540
+transpile; :1011 get_trainer_program; :1146 get_pserver_program; :1448
+get_startup_program; ps_dispatcher.py RoundRobin/HashName; collective.py:36
+program rewriters; geo_sgd_transpiler.py).
+
+TPU mapping per mode:
+- "pserver": the trainer program is rewritten to recv fresh params at the
+  top of every step and send grads (+ sync barrier) at the end — the same
+  send/recv/barrier op sequence the reference emits, lowered to ordered
+  host callbacks (ops/distributed_ops.py). The pserver program is a
+  listen_and_serv op carrying each hosted param's serialized optimize
+  sub-block; Executor runs it as a host service (distributed/ps.py), the
+  server being the single source of truth for parameters.
+- "collective"/"nccl2": data-parallel stays on-device — grads are averaged
+  by GSPMD over the mesh's dp axis, so the rewrite inserts c_comm_init
+  (ring 0 -> dp) for parity and leaves math to the compiler (the
+  reference's transpiler appended c_allreduce_sum + sync-stream ops,
+  collective.py:209 — explicit streams have no XLA analog).
+- GEO (GeoSgdTranspiler): trainers keep their LOCAL optimizer; a host
+  Communicator pushes parameter deltas every N steps and pulls the merged
+  global table (reference communicator.h:383 GeoSgdCommunicator).
+"""
+import numpy as np
+
+from ..framework.core import (OP_ROLE_KEY, OpRole, Program,
+                              default_main_program,
+                              default_startup_program)
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:141."""
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "pserver"                # pserver | nccl2 | collective
+    print_log = False
+    wait_port = True
+    runtime_split_send_recv = False
+    sync_mode = True
+    half_async = False
+    completely_not_async = False
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+    nccl_comm_num = 1
+    use_hierarchical_allreduce = False
+    hierarchical_allreduce_inter_nranks = 0
+
+
+class RoundRobin:
+    """reference ps_dispatcher.py RoundRobin."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._i % len(self._eps)])
+            self._i += 1
+        return out
+
+    def reset(self):
+        self._i = 0
+
+
+class HashName:
+    """reference ps_dispatcher.py HashName (stable name-hash placement)."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        import zlib
+        return [self._eps[zlib.crc32(v.encode()) % len(self._eps)]
+                for v in varlist]
+
+    def reset(self):
+        pass
+
+
+def _optimize_groups(program):
+    """Group role-Optimize ops by the Param they update; collect every
+    non-(Param|Grad) persistable input (LR var, accumulators) as server
+    state. Returns [(param_name, grad_name, [op], [state names])]."""
+    block = program.global_block()
+    groups = {}
+    order = []
+    for op in block.ops:
+        if (op.attrs.get(OP_ROLE_KEY, 0) & 0xFF) != OpRole.Optimize:
+            continue
+        pnames = op.inputs.get("Param")
+        if not pnames:
+            continue
+        p = pnames[0]
+        if p not in groups:
+            groups[p] = {"ops": [], "grad": None, "state": []}
+            order.append(p)
+        g = groups[p]
+        g["ops"].append(op)
+        if op.inputs.get("Grad"):
+            g["grad"] = op.inputs["Grad"][0]
+        for slot, names in op.inputs.items():
+            if slot in ("Param", "Grad"):
+                continue
+            for n in names:
+                try:
+                    var = block.var(n)
+                except ValueError:
+                    continue
+                if var.persistable and n not in g["state"] and n != p:
+                    g["state"].append(n)
+        for names in op.outputs.values():
+            for n in names:
+                try:
+                    var = block.var(n)
+                except ValueError:
+                    continue
+                if var.persistable and n not in g["state"] and n != p:
+                    g["state"].append(n)
+    return [(p, groups[p]["grad"], groups[p]["ops"], groups[p]["state"])
+            for p in order]
+
+
+class DistributeTranspiler:
+    """reference distribute_transpiler.py:254."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None, current_endpoint=""):
+        self.trainer_id = int(trainer_id)
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self.trainers = int(trainers)
+        self.sync_mode = bool(sync_mode) and not self.config.half_async
+        self.current_endpoint = current_endpoint
+
+        if self.config.mode in ("collective", "nccl2"):
+            self._transpile_collective()
+            return
+        assert self.pserver_endpoints, "pserver mode needs pservers=..."
+        dispatcher = (self.config.split_method or RoundRobin)(
+            self.pserver_endpoints)
+        self.groups = _optimize_groups(self.origin_program)
+        if not self.groups:
+            raise ValueError(
+                "transpile() found no optimizer ops — call "
+                "optimizer.minimize(loss) before transpiling")
+        params = [p for p, _, _, _ in self.groups]
+        self.epmap = dict(zip(params, dispatcher.dispatch(params)))
+        self._build_trainer_program()
+
+    # -- collective mode ---------------------------------------------------
+    def _transpile_collective(self):
+        startup = self.startup_program.global_block()
+        startup.append_op(
+            type="c_comm_init",
+            attrs={"ring_id": 0, "axis_name": "dp",
+                   "nranks": self.trainers, "rank": self.trainer_id,
+                   OP_ROLE_KEY: OpRole.Forward},
+            infer_shape=False)
+        # the init op runs in the STARTUP program; collectives lower in the
+        # MAIN program — bind the ring there too so the program-scoped
+        # registry (not the process-wide fallback) resolves it
+        from ..ops.collective_ops import register_ring
+        register_ring(0, "dp", program=self.origin_program)
+        # grad averaging itself is GSPMD's job over the dp axis: run the
+        # program through CompiledProgram.with_data_parallel on a dp mesh
+        self.trainer_program = self.origin_program
+
+    # -- pserver mode ------------------------------------------------------
+    def _build_trainer_program(self):
+        prog = self.origin_program.clone()
+        block = prog.global_block()
+        # strip the optimizer: updates now happen on the pserver
+        keep = [op for op in block.ops
+                if (op.attrs.get(OP_ROLE_KEY, 0) & 0xFF) != OpRole.Optimize]
+        block.ops = keep
+
+        params, grads, eps = [], [], []
+        shapes, dtypes = [], []
+        for p, g, _, _ in self.groups:
+            v = block.var(p)
+            params.append(p)
+            grads.append(g)
+            eps.append(self.epmap[p])
+            shapes.append(list(v.shape))
+            dtypes.append(v.dtype)
+
+        # top-of-step recv: params are pulled fresh from the source of
+        # truth every iteration (reference trainer programs recv after the
+        # barrier; pulling first keeps trainer init irrelevant)
+        block._insert_op(
+            0, type="recv", inputs={},
+            outputs={"Out": params},
+            attrs={"recv_varnames": params, "epmap": eps,
+                   "shapes": shapes, "dtypes": dtypes,
+                   OP_ROLE_KEY: OpRole.Dist},
+            infer_shape=False)
+        block.append_op(
+            type="send", inputs={"X": grads}, outputs={},
+            attrs={"send_varnames": params, "epmap": eps,
+                   OP_ROLE_KEY: OpRole.Dist},
+            infer_shape=False)
+        if self.sync_mode:
+            block.append_op(
+                type="send_barrier", inputs={}, outputs={},
+                attrs={"endpoints": list(dict.fromkeys(eps)),
+                       "trainers": self.trainers,
+                       OP_ROLE_KEY: OpRole.Dist},
+                infer_shape=False)
+        prog._bump_version()
+        self.trainer_program = prog
+
+    def get_trainer_program(self, wait_port=True):
+        if self.config.mode in ("collective", "nccl2"):
+            return self.trainer_program
+        if wait_port and self.config.wait_port:
+            from ..distributed.ps import PSClient
+            PSClient.instance().wait_ports(self.pserver_endpoints)
+        return self.trainer_program
+
+    def get_pserver_program(self, endpoint):
+        """A Program whose single op is listen_and_serv carrying the
+        serialized optimize sub-blocks of the params hosted on `endpoint`
+        (reference get_pserver_program :1146)."""
+        prog = Program()
+        block = prog.global_block()
+        origin = self.origin_program.global_block()
+        hosted = [(p, g, ops, st) for p, g, ops, st in self.groups
+                  if self.epmap[p] == endpoint]
+        opt_blocks = {}
+        hosted_vars = []
+        for p, g, ops, state in hosted:
+            for n in [p] + list(state):
+                if n not in hosted_vars:
+                    hosted_vars.append(n)
+                    v = origin.var(n)
+                    block.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                                     persistable=True)
+            opt_blocks[p] = [op.to_dict() for op in ops]
+        block.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": self.sync_mode,
+                   "Fanin": self.trainers,
+                   "optimize_blocks": opt_blocks,
+                   "hosted_vars": hosted_vars,
+                   OP_ROLE_KEY: OpRole.RPC},
+            infer_shape=False)
+        return prog
+
+    def get_pserver_programs(self, endpoint):
+        pserver_prog = self.get_pserver_program(endpoint)
+        return pserver_prog, self.get_startup_program(endpoint, pserver_prog)
+
+    def get_startup_program(self, endpoint, pserver_program=None):
+        """Init ops for the vars hosted on `endpoint` only
+        (reference get_startup_program :1448)."""
+        if pserver_program is None:
+            pserver_program = self.get_pserver_program(endpoint)
+        hosted = set(pserver_program.global_block().vars)
+        prog = Program()
+        prog.random_seed = self.startup_program.random_seed
+        block = prog.global_block()
+        src = self.startup_program.global_block()
+        for name, v in src.vars.items():
+            if name in hosted:
+                block.create_var(name=name, shape=v.shape, dtype=v.dtype,
+                                 persistable=True)
+        for op in src.ops:
+            if any(n in hosted for n in op.output_arg_names):
+                block.append_op(type=op.type, inputs=op.inputs,
+                                outputs=op.outputs, attrs=dict(op.attrs),
+                                infer_shape=False)
+        return prog
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    """GEO-SGD (reference transpiler/geo_sgd_transpiler.py +
+    communicator.h:383): trainers run the UNMODIFIED local program
+    (local optimizer updates) and a host Communicator syncs parameter
+    deltas with the pservers every `geo_sgd_need_push_nums` steps."""
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=False, startup_program=None,
+                  current_endpoint=""):
+        self.trainer_id = int(trainer_id)
+        self.origin_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self.trainers = int(trainers)
+        self.sync_mode = False
+        dispatcher = (self.config.split_method or RoundRobin)(
+            self.pserver_endpoints)
+        self.groups = _optimize_groups(self.origin_program)
+        params = [p for p, _, _, _ in self.groups]
+        self.epmap = dict(zip(params, dispatcher.dispatch(params)))
+        self.trainer_program = self.origin_program
+
+    def get_pserver_program(self, endpoint):
+        """GEO pservers hold tables only — trainers own the optimizer."""
+        prog = Program()
+        block = prog.global_block()
+        origin = self.origin_program.global_block()
+        hosted_vars = [p for p, _, _, _ in self.groups
+                       if self.epmap[p] == endpoint]
+        for n in hosted_vars:
+            v = origin.var(n)
+            block.create_var(name=n, shape=v.shape, dtype=v.dtype,
+                             persistable=True)
+        block.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint, "sync_mode": False,
+                   "Fanin": self.trainers, "optimize_blocks": {},
+                   "hosted_vars": hosted_vars, OP_ROLE_KEY: OpRole.RPC},
+            infer_shape=False)
+        return prog
+
+    def make_communicator(self, scope=None):
+        from ..distributed.communicator import GeoCommunicator
+        return GeoCommunicator(
+            epmap=self.epmap,
+            push_nums=self.config.geo_sgd_need_push_nums, scope=scope)

@@ -1,0 +1,73 @@
+"""Runnable fleet-collective worker (reference pattern: test_dist_base.py
+_run_cluster_nccl2 — N trainer processes, fleet API, losses compared to a
+local run). Launched by paddle_tpu.distributed.launch or directly with the
+PADDLE_* env set.
+
+Usage: python dist_fleet_runner.py <json-args-file>
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main(args):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=1").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework.initializer import NumpyArrayInitializer
+    from paddle_tpu.incubate.fleet.collective import fleet
+
+    fleet.init()
+    rank = fleet.worker_index()
+
+    rng = np.random.default_rng(77)
+    w1 = rng.standard_normal((8, 16)).astype(np.float32) * 0.3
+    w2 = rng.standard_normal((16, 1)).astype(np.float32) * 0.3
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        h = layers.fc(x, 16, act="tanh",
+                      param_attr=fluid.ParamAttr(
+                          name="w1",
+                          initializer=NumpyArrayInitializer(w1)),
+                      bias_attr=False)
+        pred = layers.fc(h, 1,
+                         param_attr=fluid.ParamAttr(
+                             name="w2",
+                             initializer=NumpyArrayInitializer(w2)),
+                         bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fleet.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        opt.minimize(loss)
+
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(fleet.startup_program)
+            for step in range(args["steps"]):
+                # each worker feeds its OWN half of the global batch
+                brng = np.random.default_rng(500 + step)
+                xg = brng.standard_normal((8, 8)).astype(np.float32)
+                yg = (xg[:, :1] * 0.7 - 0.2).astype(np.float32)
+                lo = rank * 4
+                l, = exe.run(fleet.main_program,
+                             feed={"x": xg[lo:lo + 4], "y": yg[lo:lo + 4]},
+                             fetch_list=[loss])
+                losses.append(float(l))
+    out = args["out"].replace("%r", str(rank))
+    with open(out, "w") as f:
+        json.dump({"rank": rank, "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    with open(sys.argv[1]) as f:
+        main(json.load(f))

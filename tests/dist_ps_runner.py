@@ -1,0 +1,139 @@
+"""Runnable PS-cluster role script (reference pattern:
+tests/unittests/dist_mnist.py + test_dist_base.py TestDistRunnerBase —
+model script launched as pserver or trainer subprocess on localhost).
+
+Usage: python dist_ps_runner.py <role> <json-args-file>
+Writes results as JSON to the path in args["out"].
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def _pin_cpu():
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_mlp(lr=0.1):
+    """Deterministic-init MLP so dist losses are comparable to a local
+    run (reference dist tests fix seeds the same way)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.framework.initializer import NumpyArrayInitializer
+
+    rng = np.random.default_rng(1234)
+    w1 = rng.standard_normal((8, 16)).astype(np.float32) * 0.3
+    w2 = rng.standard_normal((16, 1)).astype(np.float32) * 0.3
+    x = layers.data("x", [-1, 8], dtype="float32")
+    y = layers.data("y", [-1, 1], dtype="float32")
+    h = layers.fc(x, 16, act="tanh",
+                  param_attr=fluid.ParamAttr(
+                      name="w1", initializer=NumpyArrayInitializer(w1)),
+                  bias_attr=fluid.ParamAttr(
+                      name="b1",
+                      initializer=fluid.initializer.ConstantInitializer(0.0)))
+    pred = layers.fc(h, 1,
+                     param_attr=fluid.ParamAttr(
+                         name="w2", initializer=NumpyArrayInitializer(w2)),
+                     bias_attr=fluid.ParamAttr(
+                         name="b2",
+                         initializer=fluid.initializer.ConstantInitializer(
+                             0.0)))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(lr).minimize(loss)
+    return loss
+
+
+def batch(trainer_id, step, n=8):
+    rng = np.random.default_rng(100 + trainer_id * 1000 + step)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = (x[:, :1] * 0.7 - 0.2).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def run_pserver(args):
+    _pin_cpu()
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        build_mlp(lr=args["lr"])
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=0, pservers=args["pservers"],
+                    trainers=args["trainers"],
+                    sync_mode=args["sync_mode"])
+        pserver_prog, pserver_startup = t.get_pserver_programs(
+            args["endpoint"])
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(pserver_startup)
+            exe.run(pserver_prog)      # blocks until trainers send stop
+            final = {n: np.asarray(scope.find_var(n)).tolist()
+                     for n in ("w1", "w2", "b1", "b2")
+                     if scope.find_var(n) is not None}
+    with open(args["out"], "w") as f:
+        json.dump({"final_params": final}, f)
+
+
+def run_trainer(args):
+    _pin_cpu()
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = build_mlp(lr=args["lr"])
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id=args["trainer_id"],
+                    pservers=args["pservers"], trainers=args["trainers"],
+                    sync_mode=args["sync_mode"])
+        trainer_prog = t.get_trainer_program()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            for step in range(args["steps"]):
+                feed = batch(args["trainer_id"] if args["diverse_data"]
+                             else 0, step)
+                l, = exe.run(trainer_prog, feed=feed, fetch_list=[loss])
+                losses.append(float(l))
+        from paddle_tpu.distributed.ps import PSClient
+        if args["trainer_id"] == 0:
+            PSClient.instance().stop_servers(
+                [e for e in args["pservers"].split(",")])
+    with open(args["out"], "w") as f:
+        json.dump({"losses": losses}, f)
+
+
+def run_local(args):
+    """Single-process baseline with the same init + data."""
+    _pin_cpu()
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        loss = build_mlp(lr=args["lr"])
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            for step in range(args["steps"]):
+                l, = exe.run(fluid.default_main_program(),
+                             feed=batch(0, step), fetch_list=[loss])
+                losses.append(float(l))
+    with open(args["out"], "w") as f:
+        json.dump({"losses": losses}, f)
+
+
+if __name__ == "__main__":
+    role = sys.argv[1]
+    with open(sys.argv[2]) as f:
+        args = json.load(f)
+    {"pserver": run_pserver, "trainer": run_trainer,
+     "local": run_local}[role](args)

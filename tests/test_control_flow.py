@@ -304,3 +304,19 @@ def test_gradients_multiple_targets_and_cotangents():
                     for _ in range(3))
     gxv, = _run(main, startup, {"x": xv, "s1": s1v, "s2": s2v}, [gx])
     np.testing.assert_allclose(gxv, 2.0 * s1v - s2v, rtol=1e-5)
+
+
+def test_gradients_of_intermediate_var_with_nondiff_producer():
+    """Regression: gradients() w.r.t. a var whose producer has no diff
+    inputs (x is stop_gradient data) must still return the full summed
+    cotangent of that var."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")  # stop_gradient=True
+        h = layers.scale(x, 2.0)
+        loss = layers.reduce_sum(layers.elementwise_mul(h, h))
+        (gh,) = fluid.gradients(loss, [h])
+        assert gh is not None
+    xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    g, = _run(main, startup, {"x": xv}, [gh])
+    np.testing.assert_allclose(g, 2 * (2 * xv), rtol=1e-6)

@@ -1,0 +1,163 @@
+"""Parameter-server capability tests: real localhost subprocess clusters
+(reference pattern: test_dist_base.py check_with_place — pserver + trainer
+subprocesses, trainer losses must match the local single-process run)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RUNNER = os.path.join(HERE, "dist_ps_runner.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(role, args):
+    fd, argpath = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    fd, outpath = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    args = dict(args, out=outpath)
+    with open(argpath, "w") as f:
+        json.dump(args, f)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.Popen([sys.executable, RUNNER, role, argpath],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+    return proc, outpath
+
+
+def _wait(proc, outpath, timeout=300):
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, \
+        f"subprocess failed:\n{stderr.decode()[-3000:]}"
+    with open(outpath) as f:
+        return json.load(f)
+
+
+def _run_cluster(trainers, sync_mode=True, steps=5, lr=0.1,
+                 diverse_data=False):
+    ep = f"127.0.0.1:{_free_port()}"
+    base = {"pservers": ep, "endpoint": ep, "trainers": trainers,
+            "sync_mode": sync_mode, "steps": steps, "lr": lr,
+            "diverse_data": diverse_data}
+    ps_proc, ps_out = _spawn("pserver", base)
+    tr = [_spawn("trainer", dict(base, trainer_id=i))
+          for i in range(trainers)]
+    results = [_wait(p, o) for p, o in tr]
+    ps_res = _wait(ps_proc, ps_out)
+    return results, ps_res
+
+
+def test_pserver_sync_matches_local():
+    """1 trainer, sync PS: per-step losses equal the local run (identical
+    init, data, and SGD updates — just applied on the server)."""
+    local_proc, local_out = _spawn("local", {"steps": 5, "lr": 0.1,
+                                             "diverse_data": False})
+    local = _wait(local_proc, local_out)
+    (dist,), _ = _run_cluster(trainers=1, sync_mode=True, steps=5)
+    np.testing.assert_allclose(dist["losses"], local["losses"],
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_pserver_sync_two_trainers():
+    """2 trainers, same data: both see identical losses (they pull the
+    same global params each round), and the loss decreases."""
+    results, _ = _run_cluster(trainers=2, sync_mode=True, steps=5)
+    a, b = results[0]["losses"], results[1]["losses"]
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+    assert a[-1] < a[0], a
+
+
+def test_pserver_async_trains():
+    """Async (Hogwild) mode: no barriers, updates on arrival; training
+    still converges."""
+    (dist,), _ = _run_cluster(trainers=1, sync_mode=False, steps=8)
+    assert dist["losses"][-1] < dist["losses"][0], dist["losses"]
+
+
+def test_geo_sgd_and_sparse_table():
+    """GEO-SGD communicator + distributed sparse embedding, in-process
+    server thread (reference test_dist_fleet_geo.py scope)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    ep = f"127.0.0.1:{_free_port()}"
+    rng = np.random.default_rng(5)
+    vocab, dim = 50, 8
+
+    server = ParameterServer(ep, trainers=1, sync_mode=False)
+    init_table = rng.standard_normal((vocab, dim)).astype(np.float32) * 0.1
+    server.host_sparse_table("emb_table", init_table.copy(), lr=0.1)
+    ready = threading.Event()
+    server.serve(ready_event=ready, block=False)
+    ready.wait(10)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [16, 4], dtype="int64")
+        y = layers.data("y", [16, 1], dtype="float32")
+        emb = fluid.layers.nn.distributed_embedding(
+            ids, (vocab, dim), table_name="emb_table", endpoint=ep)
+        feat = layers.reduce_mean(emb, dim=1)     # [16, dim]
+        pred = layers.fc(feat, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        # GEO transpiler over the dense params
+        t = fluid.GeoSgdTranspiler()
+        t.config.geo_sgd_need_push_nums = 4
+        t.transpile(trainer_id=0, pservers=ep, trainers=1)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # host the dense params on the same server for GEO sync
+        for p in t.epmap:
+            server.tables[p] = np.asarray(scope.find_var(p))
+        comm = t.make_communicator(scope)
+        comm.start()
+        ids_v = rng.integers(0, vocab, (16, 4)).astype(np.int64)
+        # target is a function of the ids, so the sparse rows must learn
+        y_v = (ids_v.mean(axis=1, keepdims=True) / vocab - 0.5).astype(
+            np.float32)
+        losses = []
+        synced = 0
+        for step in range(40):
+            l, = exe.run(main, feed={"ids": ids_v, "y": y_v},
+                         fetch_list=[loss])
+            losses.append(float(l))
+            synced += bool(comm.step())
+        comm.stop()
+    assert synced == 10, synced          # pushed every 4th of 40 steps
+    assert losses[-1] < 0.5 * losses[0], losses
+    # sparse rows actually moved on the server (and only touched ones)
+    touched = np.unique(ids_v.reshape(-1))
+    untouched = np.setdiff1d(np.arange(vocab), touched)
+    cli = PSClient.instance()
+    rows = np.asarray(cli.pull_sparse(ep, "emb_table", touched))
+    assert np.isfinite(rows).all()
+    assert np.abs(rows - init_table[touched]).max() > 1e-4
+    if len(untouched):
+        before = init_table[untouched]
+        after = np.asarray(cli.pull_sparse(ep, "emb_table", untouched))
+        np.testing.assert_array_equal(after, before)
+    cli.stop_servers([ep])
