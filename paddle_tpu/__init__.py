@@ -37,6 +37,12 @@ from . import contrib  # noqa: F401
 from . import metrics  # noqa: F401
 from . import transpiler  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
+from . import flags  # noqa: F401
+from . import debugger  # noqa: F401
+from . import install_check  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 from . import distributed  # noqa: F401
 from .transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,

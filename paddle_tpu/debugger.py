@@ -1,0 +1,63 @@
+"""Numerical debugging utilities.
+
+Capability parity with the reference's NaN/Inf scanner
+(/root/reference/paddle/fluid/framework/details/nan_inf_utils.h:33
+CheckOpHasNanOrInf — with FLAGS_check_nan_inf every op's outputs are
+scanned after it runs) and the program dumper (debugger.py/net_drawer.py).
+
+TPU split: whole-program runs get jax_debug_nans via
+FLAGS_check_nan_inf (flags.py) — XLA re-runs the failing op un-fused and
+reports it; `check_program` is the explicit per-op scan (eager interpret +
+isfinite per output) for localizing a bad op exactly like the reference's
+per-op mode, without making every normal step pay for it."""
+import numpy as np
+
+
+def check_program(program, feed, scope=None):
+    """Interpret the global block op by op; raise on the FIRST op whose
+    output contains NaN/Inf (reference CheckOpHasNanOrInf semantics).
+    Returns the list of (op_type, output_name) pairs scanned."""
+    import jax
+    from .framework.executor import global_scope
+    from .framework.lowering import LowerCtx, run_op
+
+    scope = scope or global_scope()
+    env = {}
+    for name, val in scope.items():
+        env[name] = val
+    for name, val in (feed or {}).items():
+        env[name] = np.asarray(val)
+    scanned = []
+    ctx = LowerCtx(program, program.global_block(), env,
+                   jax.random.PRNGKey(0))
+    for i, op in enumerate(program.global_block().ops):
+        run_op(ctx, op)
+        for n in op.output_arg_names:
+            v = env.get(n)
+            if v is None or not hasattr(v, "dtype"):
+                continue
+            if np.issubdtype(np.asarray(v).dtype, np.floating):
+                a = np.asarray(v)
+                if not np.isfinite(a).all():
+                    bad = "nan" if np.isnan(a).any() else "inf"
+                    raise FloatingPointError(
+                        f"op #{i} {op.type!r} produced {bad} in output "
+                        f"{n!r} (shape {a.shape}); inputs: "
+                        f"{op.input_arg_names}")
+            scanned.append((op.type, n))
+    return scanned
+
+
+def pprint_program_codes(program):
+    """Readable program dump (reference debugger.py draws graphviz; a
+    text dump serves the same inspection need)."""
+    lines = []
+    for blk in program.blocks:
+        lines.append(f"block {blk.idx} (parent {blk.parent_idx}):")
+        for i, op in enumerate(blk.ops):
+            ins = {s: ns for s, ns in op.inputs.items() if ns}
+            outs = {s: ns for s, ns in op.outputs.items() if ns}
+            lines.append(f"  [{i}] {op.type} {ins} -> {outs}")
+    text = "\n".join(lines)
+    print(text)
+    return text

@@ -105,12 +105,28 @@ def launch(args):
 
     signal.signal(signal.SIGINT, _terminate)
     signal.signal(signal.SIGTERM, _terminate)
+    # poll ALL children: the first nonzero exit tears the cluster down
+    # (a crashed trainer must not leave the launcher blocked on a pserver
+    # whose stop message will never arrive)
+    import time
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    if rc:
-        _terminate()
+    live = list(procs)
+    while live:
+        still = []
+        for p in live:
+            code = p.poll()
+            if code is None:
+                still.append(p)
+            elif code != 0:
+                rc = rc or code
+        if rc:
+            _terminate()
+            for p in procs:
+                p.wait()
+            return rc
+        live = still
+        if live:
+            time.sleep(0.2)
     return rc
 
 

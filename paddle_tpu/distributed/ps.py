@@ -242,9 +242,15 @@ class ParameterServer:
                     self._cv.notify_all()
                 else:
                     rnd = self._round
-                    self._cv.wait_for(
+                    done = self._cv.wait_for(
                         lambda: self._round != rnd or self._stop.is_set(),
                         timeout=120.0)
+                    if not done and not self._stop.is_set():
+                        raise RuntimeError(
+                            f"sync barrier timed out after 120s waiting "
+                            f"for {self.trainers} trainers "
+                            f"({self._barrier_count} arrived) — a peer "
+                            f"trainer is stuck or dead")
             return ("ok",)
         if kind == "pull_dense":
             _, name = msg

@@ -228,7 +228,7 @@ class Tracer:
                     # (aux outputs like dropout Mask, BN running stats)
                     if not requires:
                         v.stop_gradient = True
-        if requires:
+        if requires or getattr(self, "_trace_all", False):
             self.tape.append(
                 TapeEntry(op_type, attrs, inputs, outputs, key, ins_arrays))
         return outputs

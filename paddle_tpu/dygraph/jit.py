@@ -1,0 +1,139 @@
+"""Dygraph -> static export via tracing.
+
+Capability parity with the reference's TracedLayer
+(/root/reference/python/paddle/fluid/dygraph/jit.py +
+imperative/jit/program_desc_tracer.cc — run the layer once eagerly,
+record every op into a ProgramDesc, then run/save that program like any
+static model).
+
+TPU note: the eager tracer already records (op_type, attrs, ins, outs)
+per op; conversion re-emits those records into a Program whose parameters
+are initialized from the live VarBase values, so the traced program
+compiles to one XLA module and `save_inference_model` round-trips through
+the standard inference stack. Python control flow is baked at trace time
+(same caveat as the reference's TracedLayer; the AST translator is the
+reference's answer for data-dependent control flow — use layers.cond /
+layers.While in static mode for that here).
+"""
+import numpy as np
+
+
+class TracedLayer:
+    def __init__(self, program, startup, feed_names, fetch_names):
+        from ..framework.executor import Executor, Scope
+        self._program = program
+        self._startup = startup
+        self._feed_names = feed_names
+        self._fetch_names = fetch_names
+        self._exe = Executor()
+        self._scope = Scope()
+        self._initialized = False
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        """Run `layer(*inputs)` eagerly while recording, and build the
+        equivalent static Program. Returns (dygraph_outputs,
+        traced_layer)."""
+        from . import base as dy
+        from ..framework.core import Program, program_guard
+        from ..framework.initializer import NumpyArrayInitializer
+
+        assert dy.enabled(), "TracedLayer.trace must run under " \
+                             "fluid.dygraph.guard()"
+        tracer = dy._current_tracer()
+        mark = len(tracer.tape)
+        old_all = getattr(tracer, "_trace_all", False)
+        tracer._trace_all = True
+        try:
+            outputs = layer(*inputs)
+        finally:
+            tracer._trace_all = old_all
+        entries = tracer.tape[mark:]
+        out_list = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+
+        main, startup = Program(), Program()
+        gb = main.global_block()
+        known = {}
+        with program_guard(main, startup):
+            for v in inputs:
+                gb.create_var(name=v.name, shape=tuple(v.value.shape),
+                              dtype=str(np.asarray(v.value).dtype)
+                              if np.asarray(v.value).dtype.name !=
+                              "bfloat16" else "bfloat16",
+                              is_data=True)
+                known[id(v)] = v.name
+
+            def ensure_input(v):
+                if id(v) in known:
+                    return
+                arr = np.asarray(v.value)
+                # external capture: layer parameter or baked constant —
+                # both become initialized persistables of the program
+                p = gb.create_parameter(
+                    name=v.name, shape=tuple(arr.shape),
+                    dtype=str(arr.dtype),
+                    initializer=NumpyArrayInitializer(arr),
+                    trainable=not v.stop_gradient)
+                p.initializer(p)
+                known[id(v)] = v.name
+
+            for e in entries:
+                for vs in e.ins.values():
+                    for v in vs:
+                        ensure_input(v)
+                for vs in e.outs.values():
+                    for v in vs:
+                        if id(v) not in known:
+                            arr = np.asarray(v.value)
+                            gb.create_var(name=v.name,
+                                          shape=tuple(arr.shape),
+                                          dtype=str(arr.dtype))
+                            known[id(v)] = v.name
+                gb.append_op(
+                    type=e.op_type,
+                    inputs={s: [v.name for v in vs]
+                            for s, vs in e.ins.items()},
+                    outputs={s: [v.name for v in vs]
+                             for s, vs in e.outs.items()},
+                    attrs=dict(e.attrs), infer_shape=False)
+
+        traced = cls(main, startup, [v.name for v in inputs],
+                     [v.name for v in out_list])
+        return outputs, traced
+
+    @property
+    def program(self):
+        return self._program
+
+    def __call__(self, inputs):
+        """Run the traced static program on numpy inputs."""
+        from ..framework.executor import scope_guard
+        with scope_guard(self._scope):
+            if not self._initialized:
+                self._exe.run(self._startup)
+                self._initialized = True
+            return self._exe.run(
+                self._program,
+                feed=dict(zip(self._feed_names,
+                              [np.asarray(a) for a in inputs])),
+                fetch_list=list(self._fetch_names))
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        """reference TracedLayer.save_inference_model: feed/fetch are
+        INDEX lists into the traced inputs/outputs."""
+        from .. import io as fluid_io
+        from ..framework.executor import scope_guard
+        feed_names = [self._feed_names[i] for i in (
+            feed if feed is not None else range(len(self._feed_names)))]
+        fetch_names = [self._fetch_names[i] for i in (
+            fetch if fetch is not None else range(len(self._fetch_names)))]
+        with scope_guard(self._scope):
+            if not self._initialized:
+                self._exe.run(self._startup)
+                self._initialized = True
+            fetch_vars = [self._program.global_block().var(n)
+                          for n in fetch_names]
+            return fluid_io.save_inference_model(
+                dirname, feed_names, fetch_vars, self._exe,
+                main_program=self._program, scope=self._scope)

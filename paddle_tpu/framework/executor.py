@@ -141,14 +141,17 @@ class Executor:
                      tuple(fetch_names), id(mesh))
         entry = self._cache.get(cache_key) if use_program_cache else None
         if entry is None:
-            state_in, state_out = analyze_block_io(program, 0,
-                                                   list(feed_arrays.keys()))
-            fn = build_block_fn(program, 0, list(feed_arrays.keys()),
-                                fetch_names, state_in, state_out, mesh=mesh)
-            if mesh is not None:
-                jitted = _jit_with_mesh(fn, mesh, program)
-            else:
-                jitted = jax.jit(fn, donate_argnums=(0,))
+            from .. import profiler as _prof
+            with _prof.record_event(f"compile/program_{program._uid}"):
+                state_in, state_out = analyze_block_io(
+                    program, 0, list(feed_arrays.keys()))
+                fn = build_block_fn(program, 0, list(feed_arrays.keys()),
+                                    fetch_names, state_in, state_out,
+                                    mesh=mesh)
+                if mesh is not None:
+                    jitted = _jit_with_mesh(fn, mesh, program)
+                else:
+                    jitted = jax.jit(fn, donate_argnums=(0,))
             entry = (jitted, state_in, state_out)
             if use_program_cache:
                 self._cache[cache_key] = entry
@@ -175,8 +178,15 @@ class Executor:
                     for n, a in st.items():
                         scope.set(n, a)
 
-        fetches, new_state, new_key = jitted(state_mut, state_ro,
-                                             feed_arrays, base_key)
+        from .. import profiler as _prof
+        if _prof.is_profiling():
+            with _prof.record_event(f"run/program_{program._uid}"):
+                fetches, new_state, new_key = jitted(
+                    state_mut, state_ro, feed_arrays, base_key)
+                jax.block_until_ready(fetches)
+        else:
+            fetches, new_state, new_key = jitted(state_mut, state_ro,
+                                                 feed_arrays, base_key)
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set(RNG_STATE_NAME, new_key)

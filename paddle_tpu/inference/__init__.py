@@ -1,0 +1,252 @@
+"""Inference engine: AOT predictor + StableHLO export.
+
+Capability parity with the reference's inference stack
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:82
+AnalysisPredictor, analysis_predictor.cc:497 CreatePaddlePredictor,
+paddle_analysis_config.h AnalysisConfig, zero-copy tensors
+paddle_api.h ZeroCopyTensor).
+
+TPU-native mapping: the reference loads a ProgramDesc, runs ~40 analysis/
+fusion passes and executes with NaiveExecutor; here the saved (pruned)
+program lowers to ONE XLA module that is AOT-compiled per input-shape
+signature — XLA *is* the analysis pipeline, so `switch_ir_optim` etc. are
+accepted no-ops. The compiled executable can also be exported as portable
+StableHLO text (`export_stablehlo`), the TPU analog of shipping a
+TensorRT/Lite engine artifact.
+"""
+import os
+import time
+
+import numpy as np
+
+import jax
+
+
+class AnalysisConfig:
+    """reference paddle_analysis_config.h API shape."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._ir_optim = True
+        self._use_feed_fetch_ops = False
+        self._memory_optim = False
+        self._cpu_math_threads = 1
+        self._profile = False
+        self._glog_info = True
+
+    # -- model paths -----------------------------------------------------
+    def set_model(self, model_dir_or_prog, params_file=None):
+        if params_file is None:
+            self._model_dir = model_dir_or_prog
+        else:
+            self._prog_file = model_dir_or_prog
+            self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def prog_file(self):
+        return self._prog_file
+
+    def params_file(self):
+        return self._params_file
+
+    # -- optimization switches (XLA owns these; kept for API parity) -----
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def switch_use_feed_fetch_ops(self, x=True):
+        self._use_feed_fetch_ops = bool(x)
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def cpu_math_library_num_threads(self):
+        return self._cpu_math_threads
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        import warnings
+        warnings.warn("enable_use_gpu is a no-op: the device is chosen by "
+                      "the jax platform (TPU when available)", stacklevel=2)
+
+    def disable_gpu(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        import warnings
+        warnings.warn("TensorRT has no TPU analog; XLA compiles the whole "
+                      "graph — enable_tensorrt_engine is a no-op",
+                      stacklevel=2)
+
+
+class _IOTensor:
+    """Zero-copy-style handle (reference ZeroCopyTensor): the input keeps a
+    host buffer the predictor feeds from; the output exposes the last run's
+    device array."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.shape(self._value))
+
+
+class AnalysisPredictor:
+    """reference analysis_predictor.h:82 — load once, AOT-compile per input
+    signature, run many; `clone()` shares weights (clone-per-thread)."""
+
+    def __init__(self, config, _shared=None):
+        from ..framework.executor import Executor, Scope, scope_guard
+        self._config = config
+        self._exe = Executor()
+        if _shared is not None:
+            (self._scope, self._program, self._feed_names,
+             self._fetch_targets) = _shared
+        else:
+            from .. import io as fluid_io
+            self._scope = Scope()
+            model_dir = config.model_dir()
+            model_filename = params_filename = None
+            if model_dir is None:
+                model_dir = os.path.dirname(config.prog_file())
+                model_filename = os.path.basename(config.prog_file())
+                params_filename = os.path.basename(config.params_file()) \
+                    if config.params_file() else None
+            with scope_guard(self._scope):
+                (self._program, self._feed_names,
+                 self._fetch_targets) = fluid_io.load_inference_model(
+                    model_dir, self._exe, model_filename=model_filename,
+                    params_filename=params_filename)
+        self._inputs = {n: _IOTensor(n) for n in self._feed_names}
+        self._outputs = {t.name: _IOTensor(t.name)
+                         for t in self._fetch_targets}
+
+    # -- handles ---------------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [t.name for t in self._fetch_targets]
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_input_tensor(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def get_output_tensor(self, name):
+        return self._outputs[name]
+
+    # -- execution -------------------------------------------------------
+    def run(self, inputs=None):
+        """With `inputs` (list of numpy arrays, feed order): returns list
+        of numpy outputs. Without: consumes the input handles and fills the
+        output handles (zero-copy style)."""
+        from ..framework.executor import scope_guard
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        feed = {n: self._inputs[n]._value for n in self._feed_names}
+        for n, v in feed.items():
+            if v is None:
+                raise ValueError(f"input {n!r} was never set — call "
+                                 f"get_input_handle({n!r}).copy_from_cpu()")
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=[t.name
+                                             for t in self._fetch_targets],
+                                 return_numpy=False)
+        for t, v in zip(self._fetch_targets, outs):
+            self._outputs[t.name]._value = v
+        if inputs is not None:
+            return [np.asarray(v) for v in outs]
+        return True
+
+    def clone(self):
+        """Share weights/program; private executor cache (reference
+        clone-per-thread serving)."""
+        return AnalysisPredictor(
+            self._config,
+            _shared=(self._scope, self._program, self._feed_names,
+                     self._fetch_targets))
+
+    def program(self):
+        return self._program
+
+
+def create_paddle_predictor(config):
+    """reference CreatePaddlePredictor<AnalysisConfig>
+    (analysis_predictor.cc:936)."""
+    return AnalysisPredictor(config)
+
+
+create_predictor = create_paddle_predictor
+
+
+def export_stablehlo(dirname, feed_shapes, feed_dtypes=None,
+                     output_path=None, scope=None):
+    """Lower a saved inference model to portable StableHLO text — the TPU
+    artifact analog of the reference's engine-serialization paths
+    (inference/tensorrt/, inference/lite/). `feed_shapes`: {name: shape}.
+    Returns the .mlir path."""
+    from .. import io as fluid_io
+    from ..framework.executor import Executor, Scope, scope_guard
+    from ..framework.lowering import analyze_block_io, build_block_fn
+    from ..framework.dtype import np_dtype
+
+    exe = Executor()
+    scope = scope or Scope()
+    with scope_guard(scope):
+        program, feed_names, fetch_targets = fluid_io.load_inference_model(
+            dirname, exe)
+        state = {}
+        state_in, _ = analyze_block_io(program, 0, list(feed_names))
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is not None:
+                state[n] = np.asarray(v)
+    fetch_names = [t.name for t in fetch_targets]
+    fn = build_block_fn(program, 0, list(feed_names), fetch_names,
+                        state_in, [])
+
+    gb = program.global_block()
+    feed_avals = {}
+    for n in feed_names:
+        shape = tuple(feed_shapes[n])
+        dt = (feed_dtypes or {}).get(n) or np_dtype(gb.var(n).dtype)
+        feed_avals[n] = jax.ShapeDtypeStruct(shape, dt)
+
+    def infer_fn(state, feed):
+        fetches, _, _ = fn({}, state, feed, jax.random.PRNGKey(0))
+        return fetches
+
+    lowered = jax.jit(infer_fn).lower(state, feed_avals)
+    text = lowered.as_text()
+    output_path = output_path or os.path.join(dirname, "model.stablehlo.mlir")
+    with open(output_path, "w") as f:
+        f.write(text)
+    return output_path

@@ -1,0 +1,44 @@
+"""Install self-check (reference:
+python/paddle/fluid/install_check.py — run_check() trains a tiny linear
+model on 1 device and, when more are visible, on multiple devices, then
+prints success)."""
+import numpy as np
+
+
+def run_check():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    def train_once(mesh=None):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 1
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [8, 2], dtype="float32")
+            y = layers.data("y", [8, 1], dtype="float32")
+            pred = layers.fc(x, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((8, 2)).astype(np.float32)
+        yv = (xv[:, :1] * 0.5).astype(np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prog = main
+            if mesh is not None:
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name, mesh=mesh)
+            l, = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        assert np.isfinite(float(l))
+        return float(l)
+
+    train_once()
+    print("Your paddle_tpu works well on SINGLE device.")
+    n = len(jax.devices())
+    if n > 1:
+        from paddle_tpu.parallel.mesh import default_mesh
+        train_once(default_mesh(n))
+        print(f"Your paddle_tpu works well on {n} devices.")
+    print("paddle_tpu is installed successfully!")

@@ -231,3 +231,29 @@ def dpsgd(ctx, ins, attrs):
     key = ctx.op_key(attrs)
     noise = jax.random.normal(key, g.shape, g.dtype) * sigma * clip
     return {"ParamOut": p - lr.astype(p.dtype) * (g * scale + noise)}
+
+
+@register_op("dgc_sparsify", grad=False, infer_shape=False)
+def dgc_sparsify(ctx, ins, attrs):
+    """Deep Gradient Compression core (reference operators/dgc_op.cc +
+    dgc_momentum_op): momentum-correct into the local buffer U; before
+    rampup_begin_step the FULL corrected gradient is emitted (dense
+    momentum warm-up, U acts as the velocity), after it only the
+    top-(1-s) fraction of |U| is emitted (masked DENSE tensor — same
+    numerics, XLA owns comm) and the residual stays in U."""
+    u = x_of(ins, "U")
+    g = x_of(ins, "Grad")
+    step = x_of(ins, "Step")
+    s = float(attrs.get("sparsity", 0.999))
+    m = float(attrs.get("momentum", 0.9))
+    rampup = float(attrs.get("rampup_begin_step", 0))
+    u_new = m * u + g
+    flat = jnp.abs(u_new).reshape(-1)
+    k = max(int(flat.shape[0] * (1.0 - s)), 1)
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(u_new) >= thresh
+    sparse_send = jnp.where(mask, u_new, 0.0)
+    dense = jnp.reshape(step, ()) <= rampup
+    send = jnp.where(dense, u_new, sparse_send)
+    u_out = jnp.where(dense, u_new, u_new - sparse_send)
+    return {"Out": send, "UOut": u_out}

@@ -8,6 +8,8 @@ the env (framework/lowering.py) and XLA fuses the whole optimizer sweep —
 the reference needed a dedicated fuse_optimizer_ops pass
 (ir/fuse_optimizer_ops_pass/fuse_adam_op_pass.cc) for that.
 """
+import contextlib
+
 import numpy as np
 
 from .framework import unique_name
@@ -773,3 +775,342 @@ class PipelineOptimizer:
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
+
+
+
+class _ScopeSwap:
+    """Shared backup->swap->restore over the global scope (the apply/
+    restore halves of EMA and ModelAverage differ only in the value they
+    swap in)."""
+
+    def __init__(self):
+        self._backups = {}
+
+    def _swap(self, values):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        self._backups = {}
+        for pname, val in values.items():
+            cur = np.asarray(scope.find_var(pname))
+            self._backups[pname] = cur
+            scope.set(pname, np.asarray(val).astype(cur.dtype))
+
+    def restore(self, executor=None):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        for pname, val in self._backups.items():
+            scope.set(pname, val)
+        self._backups = {}
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._swap(self._apply_values())
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def _apply_values(self):
+        raise NotImplementedError
+
+
+class ExponentialMovingAverage(_ScopeSwap):
+    """EMA of parameters with bias correction (reference optimizer.py:3306):
+    update() appends in-graph shadow updates; apply()/restore() swap the
+    scope's params with the corrected EMAs around evaluation. With
+    `thres_steps` (a step-count Variable) the decay is scheduled as
+    min(decay, (1 + t) / (10 + t)) like the reference; bias correction then
+    uses the accumulated product of the actual decays."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        super().__init__()
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._name = name or "ema"
+        self._shadows = {}         # param name -> shadow var name
+        self._decay_prod_name = None
+
+    def update(self):
+        """Append shadow-update ops for every trainable parameter; call
+        after optimizer.minimize (reference applies the same ordering)."""
+        from .framework.core import default_main_program, op_role_guard
+        from .layers import tensor as T
+        from .layers import math as M
+        program = default_main_program()
+        block = program.global_block()
+        with op_role_guard(OpRole.Optimize):
+            if self._thres_steps is not None:
+                t = T.cast(self._thres_steps, "float32")
+                decay = M.elementwise_min(
+                    T.fill_constant([1], "float32", self._decay),
+                    (t + 1.0) / (t + 10.0))
+            else:
+                decay = T.fill_constant([1], "float32", self._decay)
+            prod = T.create_global_var([1], 1.0, "float32",
+                                       persistable=True,
+                                       name=unique_name.generate(
+                                           f"{self._name}.decay_prod"))
+            T.assign(M.elementwise_mul(block.var(prod.name), decay),
+                     output=prod)
+            self._decay_prod_name = prod.name
+            for p in program.all_parameters():
+                if not p.trainable:
+                    continue
+                shadow = block.create_var(
+                    name=unique_name.generate(f"{self._name}.{p.name}"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                    stop_gradient=True)
+                ConstantInitializer(0.0)(shadow)
+                one_minus = M.elementwise_sub(
+                    T.fill_constant([1], "float32", 1.0), decay)
+                new = M.elementwise_add(
+                    M.elementwise_mul(block.var(shadow.name),
+                                      T.cast(decay, p.dtype), axis=0),
+                    M.elementwise_mul(p, T.cast(one_minus, p.dtype),
+                                      axis=0))
+                T.assign(new, output=shadow)
+                self._shadows[p.name] = shadow.name
+
+    def _apply_values(self):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        prod = float(np.asarray(scope.find_var(self._decay_prod_name))[0])
+        corr = max(1.0 - prod, 1e-12)
+        return {pname: np.asarray(scope.find_var(sname)) / corr
+                for pname, sname in self._shadows.items()}
+
+
+class ModelAverage(_ScopeSwap):
+    """Sliding-window parameter averaging (reference optimizer.py:2999):
+    accumulates param sums in-graph, RESTARTING the window when
+    num_accumulates >= max(min_average_window,
+    min(max_average_window, num_updates * average_window_rate)) — the
+    reference's window condition; apply()/restore() swap the scope's
+    params with the window average for evaluation."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__()
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._name = name or "model_average"
+        self._sums = {}
+        self._num_acc_name = None
+        self._append()
+
+    def _append(self):
+        from .framework.core import default_main_program, op_role_guard
+        from .layers import tensor as T
+        from .layers import math as M
+        program = default_main_program()
+        block = program.global_block()
+        params = [p for p in program.all_parameters() if p.trainable]
+        with op_role_guard(OpRole.Optimize):
+            num_acc = T.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate(f"{self._name}.num_acc"))
+            num_upd = T.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate(f"{self._name}.num_upd"))
+            new_acc = num_acc + 1.0
+            new_upd = num_upd + 1.0
+            T.assign(new_upd, output=num_upd)
+            window = M.elementwise_max(
+                T.fill_constant([1], "float32",
+                                float(self.min_average_window)),
+                M.elementwise_min(
+                    T.fill_constant([1], "float32",
+                                    float(self.max_average_window)),
+                    M.scale(new_upd, self.average_window)))
+            restart = M.greater_equal(new_acc, window)
+            keep = T.cast(M.logical_not(restart), "float32")
+            took = T.cast(restart, "float32")
+            # the finished window rotates into the `old` bucket (reference
+            # keeps sum_1/sum_2/sum_3 so apply() never sees an empty
+            # average right after a restart)
+            old_num = T.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate(f"{self._name}.old_num"))
+            T.assign(old_num * keep + new_acc * took, output=old_num)
+            T.assign(M.elementwise_mul(new_acc, keep), output=num_acc)
+            self._num_acc_name = num_acc.name
+            self._old_num_name = old_num.name
+            self._old_sums = {}
+            for p in params:
+                s = block.create_var(
+                    name=unique_name.generate(f"{self._name}.{p.name}.sum"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                    stop_gradient=True)
+                ConstantInitializer(0.0)(s)
+                olds = block.create_var(
+                    name=unique_name.generate(f"{self._name}.{p.name}.old"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                    stop_gradient=True)
+                ConstantInitializer(0.0)(olds)
+                summed = M.elementwise_add(block.var(s.name), p)
+                T.assign(M.elementwise_add(
+                    M.elementwise_mul(block.var(olds.name),
+                                      T.cast(keep, p.dtype), axis=0),
+                    M.elementwise_mul(summed, T.cast(took, p.dtype),
+                                      axis=0)), output=olds)
+                T.assign(M.elementwise_mul(summed, T.cast(keep, p.dtype),
+                                           axis=0), output=s)
+                self._sums[p.name] = s.name
+                self._old_sums[p.name] = olds.name
+
+    def _apply_values(self):
+        from .framework.executor import global_scope
+        scope = global_scope()
+        n = float(np.asarray(scope.find_var(self._num_acc_name))[0]) + \
+            float(np.asarray(scope.find_var(self._old_num_name))[0])
+        n = max(n, 1.0)
+        return {pname: (np.asarray(scope.find_var(sname)) +
+                        np.asarray(scope.find_var(self._old_sums[pname])))
+                / n
+                for pname, sname in self._sums.items()}
+
+
+class LookaheadOptimizer:
+    """Lookahead (reference optimizer.py:4142): fast weights step every
+    iteration; every k steps slow = slow + alpha*(fast - slow), fast =
+    slow. Slow weights start EQUAL to the fast weights (the startup
+    program copies each param into its slow twin after init). In-graph
+    with a counter + where-selects (one XLA module)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0 and k >= 1
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework.core import program_guard, op_role_guard
+        from .layers import tensor as T
+        from .layers import math as M
+        from .layers.math import equal
+        program = loss.block.program
+        block = program.global_block()
+        startup = startup_program or default_startup_program()
+        with program_guard(program, startup):
+            result = self.inner_optimizer.minimize(
+                loss, startup_program, parameter_list, no_grad_set)
+            with op_role_guard(OpRole.Optimize):
+                ctr = T.create_global_var([1], 0.0, "float32",
+                                          persistable=True,
+                                          name=unique_name.generate(
+                                              "lookahead.step"))
+                new_ctr = ctr + 1.0
+                kconst = T.fill_constant([1], "float32", float(self.k))
+                sync = equal(new_ctr, kconst)
+                T.assign(new_ctr - T.cast(sync, "float32") * kconst,
+                         output=ctr)
+                for p in program.all_parameters():
+                    if not p.trainable:
+                        continue
+                    slow = block.create_var(
+                        name=unique_name.generate(f"lookahead.{p.name}"),
+                        shape=p.shape, dtype=p.dtype, persistable=True,
+                        stop_gradient=True)
+                    # slow_0 == fast_0: copy the initialized param value
+                    sblock = startup.global_block()
+                    sblock.create_var(name=slow.name, shape=p.shape,
+                                      dtype=p.dtype, persistable=True)
+                    sblock.append_op(type="assign",
+                                     inputs={"X": [p.name]},
+                                     outputs={"Out": [slow.name]},
+                                     infer_shape=False)
+                    new_slow = M.elementwise_add(
+                        M.scale(block.var(slow.name), 1.0 - self.alpha),
+                        M.scale(p, self.alpha))
+                    block.append_op(
+                        type="where",
+                        inputs={"Condition": [sync.name],
+                                "X": [new_slow.name],
+                                "Y": [slow.name]},
+                        outputs={"Out": [slow.name]}, infer_shape=False)
+                    block.append_op(
+                        type="where",
+                        inputs={"Condition": [sync.name],
+                                "X": [slow.name], "Y": [p.name]},
+                        outputs={"Out": [p.name]}, infer_shape=False)
+        return result
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression (reference optimizer.py:1075 +
+    operators/dgc_op.cc, dgc_momentum_op): momentum correction lives in
+    the local buffer U; before `rampup_begin_step` the full corrected
+    gradient applies (dense warm-up), after it only the top
+    `1 - sparsity` fraction of |U| applies and the rest stays in U as
+    residual. The applied value goes through a plain SGD step — momentum
+    is never applied twice (the reference's dgc_momentum op makes the
+    same momentum->SGD switch). On TPU the sparsification is a masked
+    dense update: DGC's NUMERICS are preserved; the comm-volume saving is
+    an NCCL-ring concern XLA's fused allreduce doesn't share."""
+    type = "sgd"
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), parameter_list=None,
+                 use_nesterov=False, num_trainers=None, regularization=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameter_list=parameter_list,
+                         regularization=regularization, grad_clip=grad_clip,
+                         name=name)
+        self._momentum = float(momentum)
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._sparsity = float(sparsity[-1] if isinstance(
+            sparsity, (list, tuple)) else sparsity)
+        self._step_name = None
+
+    def _dgc_transform(self, block, grads):
+        from .framework.core import op_role_guard
+        from .layers import tensor as T
+        with op_role_guard(OpRole.Backward):
+            step = T.create_global_var([1], 0.0, "float32",
+                                       persistable=True,
+                                       name=unique_name.generate(
+                                           "dgc.step"))
+            T.assign(step + 1.0, output=step)
+            self._step_name = step.name
+            out = []
+            for g in grads:
+                u = block.create_var(
+                    name=unique_name.generate(f"dgc.u.{g.name}"),
+                    shape=g.shape, dtype=g.dtype, persistable=True,
+                    stop_gradient=True)
+                ConstantInitializer(0.0)(u)
+                acc = block.create_var(
+                    name=unique_name.generate("dgc.acc"),
+                    shape=g.shape, dtype=g.dtype, stop_gradient=True)
+                block.append_op(
+                    type="dgc_sparsify",
+                    inputs={"U": [u.name], "Grad": [g],
+                            "Step": [step.name]},
+                    outputs={"Out": [acc.name], "UOut": [u.name]},
+                    attrs={"sparsity": self._sparsity,
+                           "momentum": self._momentum,
+                           "rampup_begin_step": self._rampup_begin_step},
+                    infer_shape=False)
+                out.append(block.var(acc.name))
+        return out
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p]}, infer_shape=False)
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        grads = self._dgc_transform(block, [g for _, g in params_grads])
+        return super().apply_gradients(
+            [(p, g) for (p, _), g in zip(params_grads, grads)])

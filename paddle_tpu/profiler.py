@@ -1,0 +1,160 @@
+"""Profiler surface (reference: python/paddle/fluid/profiler.py —
+profiler context manager :253, start_profiler :129, stop_profiler :180,
+reset_profiler :113; C++ host/device tracers platform/profiler.h:206 +
+CUPTI device_tracer.h:41, summary tables profiler_helper.h).
+
+TPU mapping: the device-side tracer is jax.profiler (XLA xplane traces,
+viewable in TensorBoard/Perfetto — the timeline.py analog); the host-side
+event table is kept here: Executor.run reports compile/execute spans per
+program, RecordEvent covers user scopes, and `profile_program` produces
+the reference-style PER-OP table by interpreting a program once with
+per-op timers (normal runs stay one fused XLA module, so op cost only
+exists when you ask for it)."""
+import contextlib
+import time
+
+import numpy as np
+
+_events = {}          # name -> [calls, total_s, max_s, min_s]
+_active = False
+_trace_dir = None
+
+
+def _record(name, seconds):
+    if not _active:
+        return
+    row = _events.setdefault(name, [0, 0.0, 0.0, float("inf")])
+    row[0] += 1
+    row[1] += seconds
+    row[2] = max(row[2], seconds)
+    row[3] = min(row[3], seconds)
+
+
+def is_profiling():
+    return _active
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII event span (reference platform::RecordEvent)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _record(name, time.perf_counter() - t0)
+
+
+def reset_profiler():
+    """reference profiler.py:113."""
+    _events.clear()
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   trace_dir=None):
+    """reference profiler.py:129. `state` kept for parity ("CPU"/"GPU"/
+    "All" pick the same path here — XLA owns the device). With trace_dir,
+    also starts a jax.profiler xplane trace."""
+    global _active, _trace_dir
+    if state not in ("CPU", "GPU", "All"):
+        raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    _active = True
+    if trace_dir:
+        import jax
+        _trace_dir = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """reference profiler.py:180: stop + print the summary table (and
+    finish the xplane trace when one was started)."""
+    global _active, _trace_dir
+    _active = False
+    if _trace_dir:
+        import jax
+        jax.profiler.stop_trace()
+        print(f"[profiler] xplane trace written to {_trace_dir} "
+              f"(load in TensorBoard / Perfetto)")
+        _trace_dir = None
+    rows = summary(sorted_key)
+    if rows:
+        print(_format_table(rows))
+    return rows
+
+
+def summary(sorted_key=None):
+    rows = [(name, c, tot, tot / c, mx, mn)
+            for name, (c, tot, mx, mn) in _events.items()]
+    key = {None: lambda r: 0, "calls": lambda r: -r[1],
+           "total": lambda r: -r[2], "ave": lambda r: -r[3],
+           "max": lambda r: -r[4], "min": lambda r: -r[5]}.get(sorted_key)
+    if key is None:
+        raise ValueError(f"unknown sorted_key {sorted_key!r}")
+    return sorted(rows, key=key)
+
+
+def _format_table(rows):
+    head = (f"{'Event':<44} {'Calls':>7} {'Total(ms)':>11} "
+            f"{'Ave(ms)':>9} {'Max(ms)':>9} {'Min(ms)':>9}")
+    lines = ["-------------------------     Profiling Report     "
+             "-------------------------", head]
+    for name, c, tot, ave, mx, mn in rows:
+        lines.append(f"{name[:44]:<44} {c:>7} {tot * 1e3:>11.3f} "
+                     f"{ave * 1e3:>9.3f} {mx * 1e3:>9.3f} "
+                     f"{mn * 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default", trace_dir=None):
+    """reference profiler.py:253 context manager."""
+    start_profiler(state, tracer_option, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):
+    """reference profiler.py:39 — CUDA-specific; no TPU analog, no-op."""
+    yield
+
+
+def profile_program(program, feed, scope=None, repeat=1, sync=True):
+    """Reference-style PER-OP cost table: interpret the global block once,
+    timing each op's lowering+execution eagerly (block_until_ready between
+    ops). Normal execution fuses everything into one XLA module, so this
+    is the explicit op-cost probe (reference pays this bookkeeping on
+    every run — profiler.cc RecordEvent around each op->Run).
+    Returns [(op_type, calls, total_s)] sorted by total."""
+    import jax
+    from .framework.executor import global_scope
+    from .framework.lowering import LowerCtx, run_op
+    from .framework.registry import get_op_def  # noqa: F401 (op check)
+
+    scope = scope or global_scope()
+    env = {}
+    for name, val in scope.items():
+        env[name] = val
+    for name, val in (feed or {}).items():
+        env[name] = np.asarray(val)
+    per_op = {}
+    for _ in range(repeat):
+        ctx = LowerCtx(program, program.global_block(), env,
+                       jax.random.PRNGKey(0))
+        for op in program.global_block().ops:
+            t0 = time.perf_counter()
+            run_op(ctx, op)
+            if sync:
+                for n in op.output_arg_names:
+                    v = env.get(n)
+                    if hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
+            dt = time.perf_counter() - t0
+            row = per_op.setdefault(op.type, [0, 0.0])
+            row[0] += 1
+            row[1] += dt
+    rows = sorted(((t, c, tot) for t, (c, tot) in per_op.items()),
+                  key=lambda r: -r[2])
+    return rows

@@ -297,9 +297,14 @@ class GeoSgdTranspiler(DistributeTranspiler):
         self.pserver_endpoints = [e for e in pservers.split(",") if e]
         self.trainers = int(trainers)
         self.sync_mode = False
+        assert self.pserver_endpoints, "GEO mode needs pservers=..."
         dispatcher = (self.config.split_method or RoundRobin)(
             self.pserver_endpoints)
         self.groups = _optimize_groups(self.origin_program)
+        if not self.groups:
+            raise ValueError(
+                "transpile() found no optimizer ops — call "
+                "optimizer.minimize(loss) before transpiling")
         params = [p for p, _, _, _ in self.groups]
         self.epmap = dict(zip(params, dispatcher.dispatch(params)))
         self.trainer_program = self.origin_program

@@ -1,0 +1,217 @@
+"""Extra optimizers (EMA/ModelAverage/Lookahead/DGC), flags facade,
+NaN debugger, install_check (reference pattern: test_ema.py,
+test_lookahead.py, test_dgc_optimizer.py, test_nan_inf.py,
+test_install_check.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _linear_program(seed=3, lr=0.1, opt=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        y = layers.data("y", [8, 1], dtype="float32")
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        (opt or fluid.optimizer.SGD(lr)).minimize(loss)
+    return main, startup, loss
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((8, 4)).astype(np.float32)
+    yv = (xv @ np.array([[0.5], [-0.3], [0.2], [0.1]],
+                        np.float32)).astype(np.float32)
+    return xv, yv
+
+
+def test_ema_apply_restore():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        y = layers.data("y", [8, 1], dtype="float32")
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="ema_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    xv, yv = _data()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        history = []
+        for _ in range(5):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            history.append(np.asarray(scope.find_var("ema_w")).copy())
+        raw = np.asarray(scope.find_var("ema_w")).copy()
+        # manual EMA over the post-update param values with bias correction
+        want = np.zeros_like(history[0])
+        for h in history:
+            want = 0.5 * want + 0.5 * h
+        want = want / (1.0 - 0.5 ** len(history))
+        with ema.apply():
+            applied = np.asarray(scope.find_var("ema_w")).copy()
+        restored = np.asarray(scope.find_var("ema_w")).copy()
+    np.testing.assert_allclose(applied, want, rtol=1e-5)
+    np.testing.assert_allclose(restored, raw, rtol=1e-6)
+
+
+def test_model_average_apply():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        y = layers.data("y", [8, 1], dtype="float32")
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="ma_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(0.15)
+    xv, yv = _data()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = []
+        for _ in range(4):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            vals.append(np.asarray(scope.find_var("ma_w")).copy())
+        with ma.apply():
+            applied = np.asarray(scope.find_var("ma_w")).copy()
+    np.testing.assert_allclose(applied, np.mean(vals, axis=0), rtol=1e-5)
+
+
+def test_lookahead_syncs_every_k():
+    """k=1, alpha=0.5: after one step param must equal
+    0.5*w0 + 0.5*sgd_step(w0) — requires slow_0 == fast_0."""
+    xv, yv = _data()
+    # plain SGD twin for the expected fast weights
+    main_s, startup_s, loss_s = _linear_program(seed=3)
+    exe = fluid.Executor()
+    scope_s = fluid.Scope()
+    with fluid.scope_guard(scope_s):
+        exe.run(startup_s)
+        wname = next(p.name for p in main_s.all_parameters())
+        w0 = np.asarray(scope_s.find_var(wname)).copy()
+        exe.run(main_s, feed={"x": xv, "y": yv}, fetch_list=[loss_s])
+        w1 = np.asarray(scope_s.find_var(wname)).copy()
+
+    opt = fluid.optimizer.LookaheadOptimizer(fluid.optimizer.SGD(0.1),
+                                             alpha=0.5, k=1)
+    main, startup, loss = _linear_program(seed=3, opt=opt)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wname2 = next(p.name for p in main.all_parameters()
+                      if not p.name.startswith("lookahead"))
+        np.testing.assert_allclose(np.asarray(scope.find_var(wname2)), w0,
+                                   rtol=1e-6)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        got = np.asarray(scope.find_var(wname2))
+    np.testing.assert_allclose(got, 0.5 * w0 + 0.5 * w1, rtol=1e-5)
+
+    # and longer training with k=3 still converges
+    opt3 = fluid.optimizer.LookaheadOptimizer(fluid.optimizer.SGD(0.1),
+                                              alpha=0.5, k=3)
+    main3, startup3, loss3 = _linear_program(opt=opt3)
+    scope3 = fluid.Scope()
+    with fluid.scope_guard(scope3):
+        exe.run(startup3)
+        losses = [float(exe.run(main3, feed={"x": xv, "y": yv},
+                                fetch_list=[loss3])[0])
+                  for _ in range(9)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_dgc_momentum_trains():
+    opt = fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, sparsity=[0.7])
+    main, startup, loss = _linear_program(opt=opt)
+    xv, yv = _data()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(25)]
+    assert losses[-1] < 0.5 * losses[0], losses[::6]
+
+
+def test_flags_facade():
+    assert fluid.get_flags("FLAGS_allocator_strategy") == {
+        "FLAGS_allocator_strategy": "auto_growth"}
+    fluid.set_flags({"FLAGS_communicator_send_queue_size": 7})
+    assert fluid.get_flags(["communicator_send_queue_size"]) == {
+        "communicator_send_queue_size": 7}
+    assert "check_nan_inf" in fluid.flags.globals_()
+    try:
+        fluid.set_flags({"FLAGS_not_a_flag": 1})
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_debugger_finds_nan_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        h = layers.log(x)          # nan for negative inputs
+        layers.reduce_sum(h)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        try:
+            fluid.debugger.check_program(
+                main, {"x": np.array([1.0, -1.0, 2.0, 3.0], np.float32)},
+                scope=scope)
+            raise AssertionError("expected FloatingPointError")
+        except FloatingPointError as e:
+            assert "log" in str(e)
+    # and the dump helper prints op lines
+    text = fluid.debugger.pprint_program_codes(main)
+    assert "log" in text and "block 0" in text
+
+
+def test_install_check():
+    fluid.install_check.run_check()
+
+
+def test_traced_layer_roundtrip():
+    """Dygraph layer -> TracedLayer -> static run == eager run; saved
+    inference model reloads through the standard stack (reference
+    dygraph/jit.py TracedLayer)."""
+    import tempfile
+
+    class Net(fluid.dygraph.Layer):
+        def __init__(self):
+            super().__init__("net")
+            self.l1 = fluid.dygraph.Linear(6, 10, act="relu")
+            self.l2 = fluid.dygraph.Linear(10, 2)
+
+        def forward(self, x):
+            return self.l2(self.l1(x))
+
+    xv = np.random.default_rng(2).standard_normal((3, 6)).astype(
+        np.float32)
+    with fluid.dygraph.guard():
+        net = Net()
+        inp = fluid.dygraph.to_variable(xv)
+        out_dy, traced = fluid.dygraph.TracedLayer.trace(net, [inp])
+        eager = out_dy.numpy()
+    static_out, = traced([xv])
+    np.testing.assert_allclose(static_out, eager, rtol=1e-5, atol=1e-6)
+
+    with tempfile.TemporaryDirectory() as d:
+        traced.save_inference_model(d, feed=[0], fetch=[0])
+        config = fluid.inference.AnalysisConfig(d)
+        pred = fluid.inference.create_paddle_predictor(config)
+        out2, = pred.run([xv])
+    np.testing.assert_allclose(out2, eager, rtol=1e-5, atol=1e-6)

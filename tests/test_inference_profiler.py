@@ -1,0 +1,97 @@
+"""Inference engine (AnalysisPredictor, StableHLO export) + profiler
+(reference pattern: inference/tests/api/analyzer_*_tester.cc,
+tests/unittests/test_profiler.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _save_model(dirname):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        h = layers.fc(x, 16, act="relu")
+        out = layers.fc(h, 3, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main, scope=scope)
+        xv = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    return xv, np.asarray(ref)
+
+
+def test_analysis_predictor_run_and_clone():
+    with tempfile.TemporaryDirectory() as d:
+        xv, ref = _save_model(d)
+        config = fluid.inference.AnalysisConfig(d)
+        pred = fluid.inference.create_paddle_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        # list-style run
+        out, = pred.run([xv])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # zero-copy-handle style run
+        pred.get_input_handle("x").copy_from_cpu(xv * 2.0)
+        pred.run()
+        out2 = pred.get_output_handle(pred.get_output_names()[0])
+        assert out2.copy_to_cpu().shape == (4, 3)
+        # clone shares weights
+        out3, = pred.clone().run([xv])
+        np.testing.assert_allclose(out3, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_stablehlo_export():
+    with tempfile.TemporaryDirectory() as d:
+        _save_model(d)
+        path = fluid.inference.export_stablehlo(d, {"x": (4, 8)})
+        assert os.path.exists(path)
+        text = open(path).read()
+        assert "stablehlo" in text or "module" in text
+        assert "dot" in text or "dot_general" in text  # the matmuls
+
+
+def test_profiler_tables():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 8], dtype="float32")
+        out = layers.fc(layers.fc(x, 32, act="relu"), 2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.default_rng(1).standard_normal((16, 8)).astype(
+        np.float32)
+    fluid.profiler.reset_profiler()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with fluid.profiler.profiler(state="All", sorted_key="total"):
+            with fluid.profiler.record_event("user_span"):
+                for _ in range(3):
+                    exe.run(main, feed={"x": xv}, fetch_list=[out])
+        rows = fluid.profiler.summary("total")
+    names = [r[0] for r in rows]
+    assert any(n.startswith("run/program_") for n in names), names
+    assert any(n.startswith("compile/program_") for n in names), names
+    assert "user_span" in names
+    run_row = next(r for r in rows if r[0].startswith("run/program_"))
+    assert run_row[1] == 3      # three recorded runs
+
+    # per-op breakdown table
+    with fluid.scope_guard(scope):
+        per_op = fluid.profiler.profile_program(main, {"x": xv},
+                                                scope=scope)
+    types = [t for t, _, _ in per_op]
+    assert "mul" in types and "relu" in types, types
+
+    # bad sorted_key raises
+    try:
+        fluid.profiler.summary("bogus")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
