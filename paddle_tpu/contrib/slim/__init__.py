@@ -1,0 +1,3 @@
+"""fluid.contrib.slim — model compression toolkit (reference:
+python/paddle/fluid/contrib/slim/)."""
+from . import quantization  # noqa: F401

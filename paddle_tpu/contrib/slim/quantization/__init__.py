@@ -1,0 +1,3 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationTransformPass, PostTrainingQuantization,
+)

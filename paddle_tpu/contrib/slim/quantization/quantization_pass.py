@@ -1,0 +1,195 @@
+"""Quantization program passes (QAT + post-training).
+
+Capability parity with the reference's slim quantization
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:147 QuantizationTransformPass — insert fake_quant on
+weights/activations feeding quantizable ops; QuantizationFreezePass — bake
+test-time scales; post_training_quantization.py — calibrate scales from
+sample batches).
+
+The reference rewrites an IrGraph; here the same rewrite runs directly on
+the Program IR: each quantizable op's float inputs are routed through
+fake-quant ops (channel-wise abs_max for weights, moving-average abs_max
+for activations, with per-input persistable scale/state vars), and the
+straight-through-estimator grads (ops/quantize_ops.py) make the rewritten
+program trainable as-is.
+"""
+import numpy as np
+
+from ....framework import unique_name
+from ....framework.core import OP_ROLE_KEY, OpRole, Parameter
+
+
+class QuantizationTransformPass:
+    """reference quantization_pass.py:147."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, activation_quantize_type="abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 window_size=10000, moving_rate=0.9,
+                 quantizable_op_type=("conv2d", "depthwise_conv2d", "mul")):
+        self._weight_bits = int(weight_bits)
+        self._activation_bits = int(activation_bits)
+        self._act_type = activation_quantize_type
+        self._weight_type = weight_quantize_type
+        self._moving_rate = float(moving_rate)
+        self._window_size = int(window_size)
+        self._quantizable = set(quantizable_op_type)
+        self._quanted = {}       # var name -> quantized var name
+
+    def apply(self, program, startup_program=None, for_test=False):
+        """Insert fake-quant ops before every quantizable op's float
+        inputs, in place (pass a clone to keep the original)."""
+        from ....framework.core import program_guard, default_startup_program
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self._quantizable or \
+                    op.attrs.get("__quanted__"):
+                i += 1
+                continue
+            op.attrs["__quanted__"] = True
+            inserted = 0
+            for slot, names in list(op.inputs.items()):
+                new_names = []
+                for n in names:
+                    try:
+                        var = block.var(n)
+                    except ValueError:
+                        new_names.append(n)
+                        continue
+                    if var.dtype not in ("float32", "float64", "bfloat16"):
+                        new_names.append(n)
+                        continue
+                    qn, k = self._insert_quant(block, i, n, var,
+                                               is_weight=isinstance(
+                                                   var, Parameter),
+                                               startup_program=
+                                               startup_program,
+                                               for_test=for_test)
+                    inserted += k
+                    i += k
+                    new_names.append(qn)
+                op.inputs[slot] = new_names
+            i += 1
+        program._bump_version()
+        return program
+
+    def _insert_quant(self, block, pos, name, var, is_weight,
+                      startup_program, for_test):
+        if name in self._quanted:
+            return self._quanted[name], 0
+        from ....framework.core import default_startup_program
+        from ....framework.initializer import ConstantInitializer
+        startup = startup_program or default_startup_program()
+        qn = f"{name}.quantized"
+        block.create_var(name=qn, shape=var.shape, dtype=var.dtype,
+                         stop_gradient=var.stop_gradient)
+
+        def persistable_state(sname, shape):
+            v = block.create_var(name=sname, shape=shape, dtype="float32",
+                                 persistable=True, stop_gradient=True)
+            sblock = startup.global_block()
+            sblock.create_var(name=sname, shape=shape, dtype="float32",
+                              persistable=True)
+            ConstantInitializer(0.0)(v, block=sblock)
+            return v
+
+        scale_name = unique_name.generate(f"{name}.scale")
+        persistable_state(scale_name, (1,))
+
+        if is_weight:
+            op_type = ("fake_channel_wise_quantize_abs_max"
+                       if self._weight_type == "channel_wise_abs_max"
+                       else "fake_quantize_abs_max")
+            block._insert_op(
+                pos, type=op_type,
+                inputs={"X": [name]},
+                outputs={"Out": [qn], "OutScale": [scale_name]},
+                attrs={"bit_length": self._weight_bits,
+                       OP_ROLE_KEY: OpRole.Forward},
+                infer_shape=False)
+            self._quanted[name] = qn
+            return qn, 1
+        if self._act_type == "moving_average_abs_max":
+            accum = unique_name.generate(f"{name}.accum")
+            state = unique_name.generate(f"{name}.state")
+            for sn in (accum, state):
+                persistable_state(sn, ())
+            block._insert_op(
+                pos, type="fake_quantize_moving_average_abs_max",
+                inputs={"X": [name], "InAccum": [accum],
+                        "InState": [state], "InScale": [scale_name]},
+                outputs={"Out": [qn], "OutScale": [scale_name],
+                         "StateOut": [state], "AccumOut": [accum]},
+                attrs={"bit_length": self._activation_bits,
+                       "moving_rate": self._moving_rate,
+                       "is_test": bool(for_test),
+                       OP_ROLE_KEY: OpRole.Forward},
+                infer_shape=False)
+        else:
+            block._insert_op(
+                pos, type="fake_quantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [qn], "OutScale": [scale_name]},
+                attrs={"bit_length": self._activation_bits,
+                       OP_ROLE_KEY: OpRole.Forward},
+                infer_shape=False)
+        self._quanted[name] = qn
+        return qn, 1
+
+
+class PostTrainingQuantization:
+    """reference post_training_quantization.py: run calibration batches
+    through the float program, record per-tensor abs-max scales, then
+    emit a quantized inference program with frozen scales."""
+
+    def __init__(self, executor, program, feed_names, fetch_targets,
+                 batch_generator, quantizable_op_type=("conv2d", "mul"),
+                 weight_bits=8, activation_bits=8, scope=None):
+        self._exe = executor
+        self._program = program
+        self._feed_names = list(feed_names)
+        self._fetch = fetch_targets
+        self._batches = batch_generator
+        self._quantizable = tuple(quantizable_op_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._scope = scope
+
+    def quantize(self):
+        # 1) calibration: track abs-max of every quantizable-op input
+        maxes = {}
+        block = self._program.global_block()
+        watch = set()
+        for op in block.ops:
+            if op.type in self._quantizable:
+                watch.update(op.input_arg_names)
+        watch = sorted(watch)
+        for feed in self._batches:
+            # fetch the watched tensors directly — feed vars, params and
+            # intermediate activations are all in the executor env
+            vals = self._exe.run(self._program, feed=feed,
+                                 fetch_list=list(watch))
+            for n, v in zip(watch, vals):
+                m = float(np.max(np.abs(np.asarray(v))))
+                maxes[n] = max(maxes.get(n, 0.0), m)
+        # 2) rewrite a test clone and FREEZE the calibrated scales into
+        # the quant ops (reference QuantizationFreezePass bakes scales the
+        # same way; without freezing, inference would re-reduce |x|max per
+        # call and out-of-range inputs would shift the quant grid)
+        quant_prog = self._program.clone(for_test=True)
+        tp = QuantizationTransformPass(
+            weight_bits=self._wbits, activation_bits=self._abits,
+            activation_quantize_type="abs_max",
+            weight_quantize_type="abs_max",
+            quantizable_op_type=self._quantizable)
+        tp.apply(quant_prog, for_test=True)
+        for op in quant_prog.global_block().ops:
+            if op.type == "fake_quantize_abs_max":
+                src = op.inputs["X"][0]
+                if src in maxes:
+                    op.attrs["frozen_scale"] = float(maxes[src])
+        self._calibration_scales = maxes
+        return quant_prog

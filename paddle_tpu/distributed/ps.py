@@ -110,11 +110,23 @@ class ParameterServer:
     Sparse tables: rows pulled by id; sparse grads applied row-wise SGD.
     """
 
-    def __init__(self, endpoint, trainers=1, sync_mode=True):
+    def __init__(self, endpoint, trainers=1, sync_mode=True,
+                 heartbeat_timeout=None):
+        """`heartbeat_timeout` (seconds) arms the HeartBeatMonitor
+        (reference operators/distributed/heart_beat_monitor.h:38): every
+        trainer message stamps a per-trainer timestamp; a monitor thread
+        EVICTS trainers silent longer than the timeout from the sync
+        barrier so one dead worker cannot hang the round forever."""
         host, port = endpoint.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.trainers = int(trainers)
         self.sync_mode = bool(sync_mode)
+        self.heartbeat_timeout = heartbeat_timeout
+        self._initial_trainers = int(trainers)
+        self._last_seen = {}      # trainer_id -> monotonic timestamp
+        self._evicted = set()
+        self._arrived = set()     # trainer ids at the barrier this round
+        self._round_wait_start = None
         self.tables = {}          # var name -> np.ndarray
         self.optimize_blocks = {}  # param name -> [op dicts]
         self.lr_map = {}          # param name -> {lr var name: value}
@@ -147,11 +159,77 @@ class ParameterServer:
         self._sock.listen(64)
         if ready_event is not None:
             ready_event.set()
+        if self.heartbeat_timeout:
+            threading.Thread(target=self._heartbeat_loop,
+                             daemon=True).start()
         if not block:
             t = threading.Thread(target=self._accept_loop, daemon=True)
             t.start()
             return t
         self._accept_loop()
+
+    def _release_round_locked(self):
+        """Apply the round's (mean) grads and release the barrier.
+        Caller holds self._cv."""
+        for name, grads in self._grad_acc.items():
+            self._apply_update(
+                name, np.mean(np.stack(grads), axis=0)
+                if len(grads) > 1 else grads[0])
+        self._grad_acc.clear()
+        self._barrier_count = 0
+        self._arrived.clear()
+        self._round_wait_start = None
+        self._round += 1
+        self._cv.notify_all()
+
+    def _heartbeat_loop(self):
+        """Evict dead trainers from sync rounds (reference
+        HeartBeatMonitor heart_beat_monitor.h:102: COMPLETED workers —
+        those already at the barrier — are exempt; only trainers the
+        round has been waiting on past the timeout are evicted)."""
+        import time
+        while not self._stop.is_set():
+            time.sleep(min(self.heartbeat_timeout / 4.0, 1.0))
+            now = time.monotonic()
+            with self._cv:
+                if self._barrier_count == 0:
+                    self._round_wait_start = None
+                    continue
+                if self._round_wait_start is None:
+                    self._round_wait_start = now
+                    continue
+                if now - self._round_wait_start <= self.heartbeat_timeout:
+                    continue
+                # the round has waited too long: evict every expected
+                # trainer that has NOT reached the barrier (arrived ones
+                # are alive-but-blocked, never evicted)
+                for tid in range(self._initial_trainers):
+                    if tid in self._arrived or tid in self._evicted:
+                        continue
+                    self._evicted.add(tid)
+                    self.trainers = max(self.trainers - 1, 1)
+                    print(f"[pserver] heartbeat: evicting trainer {tid} "
+                          f"(round waited "
+                          f"{now - self._round_wait_start:.1f}s); "
+                          f"barrier now needs {self.trainers}")
+                if self._barrier_count >= self.trainers:
+                    self._release_round_locked()
+
+    def _stamp(self, tid):
+        """Record a trainer heartbeat; a message from an evicted trainer
+        re-admits it (the recovery half of the monitor)."""
+        if tid is None:
+            return
+        import time
+        tid = int(tid)
+        self._last_seen[tid] = time.monotonic()
+        if tid in self._evicted:
+            with self._cv:
+                self._evicted.discard(tid)
+                self.trainers = min(self.trainers + 1,
+                                    self._initial_trainers)
+                print(f"[pserver] heartbeat: trainer {tid} re-admitted; "
+                      f"barrier now needs {self.trainers}")
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -219,7 +297,8 @@ class ParameterServer:
     def _handle(self, msg):
         kind = msg[0]
         if kind == "push_dense":
-            _, name, grad = msg
+            _, name, grad, *rest = msg
+            self._stamp(rest[0] if rest else None)
             if self.sync_mode:
                 with self._cv:
                     self._grad_acc.setdefault(name, []).append(
@@ -228,18 +307,15 @@ class ParameterServer:
             self._apply_update(name, np.asarray(grad))
             return ("ok",)
         if kind == "send_barrier":
+            tid = msg[1] if len(msg) > 1 else None
+            self._stamp(tid)
             # sync round completion: the Nth barrier applies all updates
             with self._cv:
                 self._barrier_count += 1
+                if tid is not None:
+                    self._arrived.add(int(tid))
                 if self._barrier_count >= self.trainers:
-                    for name, grads in self._grad_acc.items():
-                        self._apply_update(
-                            name, np.mean(np.stack(grads), axis=0)
-                            if len(grads) > 1 else grads[0])
-                    self._grad_acc.clear()
-                    self._barrier_count = 0
-                    self._round += 1
-                    self._cv.notify_all()
+                    self._release_round_locked()
                 else:
                     rnd = self._round
                     done = self._cv.wait_for(
@@ -320,12 +396,13 @@ class PSClient:
         return reply[1] if reply[0] == "val" else None
 
     # public API used by the distributed ops
-    def push_dense(self, endpoint, name, grad):
-        self._call(endpoint, ("push_dense", name, np.asarray(grad)))
+    def push_dense(self, endpoint, name, grad, trainer_id=None):
+        self._call(endpoint,
+                   ("push_dense", name, np.asarray(grad), trainer_id))
 
-    def send_barrier(self, endpoints):
+    def send_barrier(self, endpoints, trainer_id=None):
         for ep in dict.fromkeys(endpoints):
-            self._call(ep, ("send_barrier",))
+            self._call(ep, ("send_barrier", trainer_id))
 
     def pull_dense(self, endpoint, name):
         return self._call(endpoint, ("pull_dense", name))

@@ -207,7 +207,9 @@ class Executor:
         server = ParameterServer(attrs["endpoint"],
                                  trainers=int(attrs.get("Fanin", 1)),
                                  sync_mode=bool(attrs.get("sync_mode",
-                                                          True)))
+                                                          True)),
+                                 heartbeat_timeout=attrs.get(
+                                     "heartbeat_timeout"))
         for name in attrs.get("hosted_vars", []):
             val = scope.find_var(name)
             if val is None:

@@ -31,6 +31,24 @@ class DistributedStrategy:
         self.amp_loss_scaling = 2 ** 15
 
 
+class TrainStatus:
+    """reference collective/__init__.py:49 — the tiny restart token saved
+    next to a checkpoint (recovery = reload last checkpoint + status)."""
+
+    def __init__(self, epoch_no=-1):
+        self._epoch_no = int(epoch_no)
+
+    def next(self):
+        return self._epoch_no + 1
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and \
+            self._epoch_no == other._epoch_no
+
+    def __ne__(self, other):
+        return not self == other
+
+
 class Collective:
     def __init__(self):
         self._role_maker = None
@@ -99,6 +117,52 @@ class Collective:
         from .... import io
         io.save_persistables(executor, dirname,
                              main_program or self._origin_program)
+
+    # -- checkpoint-restart recovery (reference collective/__init__.py
+    # :166 save_checkpoint/load_checkpoint with TrainStatus; recovery =
+    # reload the newest checkpoint, §5.3 of the reference's failure
+    # model) --------------------------------------------------------------
+    def save_checkpoint(self, executor, path, train_status,
+                        main_program=None, fs=None, local_cache_path=None,
+                        remain_all_checkpoint=True):
+        import json
+        import os
+        from .... import io
+        nums = [int(d.split("_")[-1]) for d in os.listdir(path)
+                if d.startswith("__paddle_checkpoint__")] \
+            if os.path.isdir(path) else []
+        no = (max(nums) + 1) if nums else 0
+        ckpt = os.path.join(path, f"__paddle_checkpoint__{no}")
+        os.makedirs(ckpt, exist_ok=True)
+        io.save_persistables(executor, ckpt,
+                             main_program or self._origin_program)
+        with open(os.path.join(ckpt, "train_status.json"), "w") as f:
+            json.dump({"epoch_no": train_status._epoch_no}, f)
+        if not remain_all_checkpoint:
+            import shutil
+            for n in nums:
+                shutil.rmtree(os.path.join(
+                    path, f"__paddle_checkpoint__{n}"), ignore_errors=True)
+        return no
+
+    def load_checkpoint(self, executor, path, trainer_id=0,
+                        main_program=None, fs=None, local_cache_path=None,
+                        ignore_empty=True):
+        import json
+        import os
+        from .... import io
+        nums = [int(d.split("_")[-1]) for d in os.listdir(path)
+                if d.startswith("__paddle_checkpoint__")] \
+            if os.path.isdir(path) else []
+        if not nums:
+            if ignore_empty:
+                return TrainStatus(-1)
+            raise RuntimeError(f"no checkpoint under {path}")
+        ckpt = os.path.join(path, f"__paddle_checkpoint__{max(nums)}")
+        io.load_persistables(executor, ckpt,
+                             main_program or self._origin_program)
+        with open(os.path.join(ckpt, "train_status.json")) as f:
+            return TrainStatus(json.load(f)["epoch_no"])
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
                              target_vars, main_program=None,

@@ -11,4 +11,6 @@ from . import (  # noqa: F401
     sequence_ops,
     pipeline_ops,
     distributed_ops,
+    quantize_ops,
+    detection_ops,
 )

@@ -1,0 +1,378 @@
+"""Detection/vision ops (core of the reference's
+/root/reference/paddle/fluid/operators/detection/ family — 61 files;
+implemented here: prior_box, density_prior_box, anchor_generator,
+box_coder, iou_similarity, yolo_box, multiclass_nms, plus roi_align from
+the top-level operators).
+
+TPU design notes: everything is static-shape. multiclass_nms — which in
+the reference emits a dynamically sized LoD result — returns a PADDED
+[keep_top_k, 6] tensor per image plus a valid count (the XLA-native NMS
+shape, same scheme the sequence ops use). The NMS selection loop is a
+fixed-trip lax.fori over keep_top_k with IoU suppression masks — O(k*n)
+dense math that XLA vectorizes, instead of the reference's per-box greedy
+CPU loop (multiclass_nms_op.cc)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import x_of
+
+
+def _iou_matrix(a, b):
+    """[N,4] x [M,4] xyxy -> [N,M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@register_op("iou_similarity", grad=False)
+def iou_similarity(ctx, ins, attrs):
+    """reference detection/iou_similarity_op.h."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    return {"Out": _iou_matrix(x, y)}
+
+
+@register_op("prior_box", grad=False, infer_shape=False)
+def prior_box(ctx, ins, attrs):
+    """SSD prior boxes (reference detection/prior_box_op.h): one box per
+    (feature-map cell, aspect ratio/size combo) + per-box variances."""
+    feat = x_of(ins, "Input")   # [N, C, H, W]
+    image = x_of(ins, "Image")  # [N, C, IH, IW]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    flip = bool(attrs.get("flip", False))
+    clip = bool(attrs.get("clip", False))
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+
+    full_ratios = [1.0]
+    for r in ratios:
+        if abs(r - 1.0) < 1e-6:
+            continue
+        full_ratios.append(r)
+        if flip:
+            full_ratios.append(1.0 / r)
+
+    whs = []
+    for si, ms in enumerate(min_sizes):
+        # reference order: ratio-1 min box, then max-size box, then ratios
+        whs.append((ms, ms))
+        if max_sizes:
+            big = float(np.sqrt(ms * max_sizes[si]))
+            whs.append((big, big))
+        for r in full_ratios[1:]:
+            whs.append((ms * float(np.sqrt(r)), ms / float(np.sqrt(r))))
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)            # [H, W]
+    boxes = []
+    for w, h in whs:
+        boxes.append(jnp.stack([
+            (cxg - w / 2) / IW, (cyg - h / 2) / IH,
+            (cxg + w / 2) / IW, (cyg + h / 2) / IH], axis=-1))
+    out = jnp.stack(boxes, axis=2)             # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("density_prior_box", grad=False, infer_shape=False)
+def density_prior_box(ctx, ins, attrs):
+    """reference detection/density_prior_box_op.h: dense grid of shifted
+    fixed-size boxes per cell."""
+    feat = x_of(ins, "Input")
+    image = x_of(ins, "Image")
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs["densities"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    step_w = float(attrs.get("step_w", 0.0)) or IW / W
+    step_h = float(attrs.get("step_h", 0.0)) or IH / H
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    boxes = []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            w = size * float(np.sqrt(ratio))
+            h = size / float(np.sqrt(ratio))
+            shift = size / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    c_x = cxg + (dj + 0.5) * shift - size / 2
+                    c_y = cyg + (di + 0.5) * shift - size / 2
+                    boxes.append(jnp.stack([
+                        (c_x - w / 2) / IW, (c_y - h / 2) / IH,
+                        (c_x + w / 2) / IW, (c_y + h / 2) / IH], axis=-1))
+    out = jnp.stack(boxes, axis=2)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("anchor_generator", grad=False, infer_shape=False)
+def anchor_generator(ctx, ins, attrs):
+    """RPN anchors (reference detection/anchor_generator_op.h)."""
+    feat = x_of(ins, "Input")
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    stride = [float(s) for s in attrs["stride"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            w = s * float(np.sqrt(1.0 / r))
+            h = s * float(np.sqrt(r))
+            anchors.append(jnp.stack([
+                cxg - w / 2, cyg - h / 2, cxg + w / 2, cyg + h / 2],
+                axis=-1))
+    out = jnp.stack(anchors, axis=2)           # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Anchors": out, "Variances": var}
+
+
+@register_op("box_coder", grad=False, infer_shape=False)
+def box_coder(ctx, ins, attrs):
+    """encode_center_size / decode_center_size (reference
+    detection/box_coder_op.h)."""
+    prior = x_of(ins, "PriorBox").reshape(-1, 4)
+    pvar = ins.get("PriorBoxVar")
+    pvar = pvar[0] if pvar else None
+    tb = x_of(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = bool(attrs.get("box_normalized", True))
+    add = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + add
+    ph = prior[:, 3] - prior[:, 1] + add
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar is not None:
+        pvar = jnp.broadcast_to(jnp.reshape(pvar, (-1, 4)),
+                                prior.shape)
+    if code_type.startswith("encode"):
+        tb = tb.reshape(-1, 4)
+        tw = tb[:, 2] - tb[:, 0] + add
+        th = tb[:, 3] - tb[:, 1] + add
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph[None, :])], axis=-1)  # [T, P, 4]
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        return {"OutputBox": out}
+    # decode: tb [P, C*4] (per-prior class codes) or [T, P, 4] (dim1
+    # aligned with the priors)
+    if tb.ndim == 2:
+        d = tb.reshape(tb.shape[0], -1, 4)         # [P, C, 4]
+        if pvar is not None:
+            d = d * pvar[:, None, :]
+        dcx = d[..., 0] * pw[:, None] + pcx[:, None]
+        dcy = d[..., 1] * ph[:, None] + pcy[:, None]
+        dw = jnp.exp(d[..., 2]) * pw[:, None]
+        dh = jnp.exp(d[..., 3]) * ph[:, None]
+    else:
+        d = tb * pvar[None, :, :] if pvar is not None else tb
+        dcx = d[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = d[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(d[..., 2]) * pw[None, :]
+        dh = jnp.exp(d[..., 3]) * ph[None, :]
+    out = jnp.stack([dcx - dw / 2 + add / 2, dcy - dh / 2 + add / 2,
+                     dcx + dw / 2 - add / 2, dcy + dh / 2 - add / 2],
+                    axis=-1)
+    return {"OutputBox": out}
+
+
+@register_op("yolo_box", grad=False, infer_shape=False)
+def yolo_box(ctx, ins, attrs):
+    """YOLOv3 head decode (reference detection/yolo_box_op.h)."""
+    x = x_of(ins)               # [N, A*(5+C), H, W]
+    img_size = x_of(ins, "ImgSize")  # [N, 2] (h, w)
+    anchors = [float(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.01))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_w, in_h = W * downsample, H * downsample
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / H
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    keep = conf > conf_thresh
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    boxes = jnp.stack([(bx - bw / 2) * img_w, (by - bh / 2) * img_h,
+                       (bx + bw / 2) * img_w, (by + bh / 2) * img_h],
+                      axis=-1)                     # [N, A, H, W, 4]
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    probs = jnp.where(keep[:, :, None], probs, 0.0)
+    boxes = boxes.reshape(N, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("multiclass_nms", grad=False, infer_shape=False)
+def multiclass_nms(ctx, ins, attrs):
+    """Per-class greedy NMS + cross-class top-k (reference
+    detection/multiclass_nms_op.cc). Static-shape result: Out is
+    [N, keep_top_k, 6] = (class, score, x1, y1, x2, y2) padded with
+    class=-1 rows; NmsRoisNum gives the valid counts."""
+    bboxes = x_of(ins, "BBoxes")      # [N, M, 4]
+    scores = x_of(ins, "Scores")      # [N, C, M]
+    score_thresh = float(attrs.get("score_threshold", 0.05))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    background = int(attrs.get("background_label", 0))
+    N, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+    fg_classes = [c for c in range(C) if c != background]
+    if not fg_classes:
+        raise ValueError(
+            f"multiclass_nms: no foreground class (class_num={C}, "
+            f"background_label={background})")
+    if keep_top_k <= 0:              # reference sentinel: keep everything
+        keep_top_k = len(fg_classes) * nms_top_k
+
+    def per_image(boxes, sc):
+        # per class: take nms_top_k by score, greedy-suppress by IoU
+        all_scores = []
+        all_boxes = []
+        all_cls = []
+        for c in fg_classes:
+            s = sc[c]
+            top_s, top_i = jax.lax.top_k(s, nms_top_k)
+            b = boxes[top_i]
+            iou = _iou_matrix(b, b)
+            alive = top_s > score_thresh
+
+            def body(i, alive):
+                # suppress anything overlapping an earlier live box
+                sup = jnp.logical_and(alive[i], iou[i] > nms_thresh)
+                sup = sup.at[i].set(False)
+                later = jnp.arange(nms_top_k) > i
+                return jnp.where(jnp.logical_and(sup, later),
+                                 False, alive)
+
+            alive = jax.lax.fori_loop(0, nms_top_k, body, alive)
+            all_scores.append(jnp.where(alive, top_s, -1.0))
+            all_boxes.append(b)
+            all_cls.append(jnp.full((nms_top_k,), c, jnp.float32))
+        cat_s = jnp.concatenate(all_scores)
+        cat_b = jnp.concatenate(all_boxes, axis=0)
+        cat_c = jnp.concatenate(all_cls)
+        k = min(keep_top_k, cat_s.shape[0])
+        fin_s, fin_i = jax.lax.top_k(cat_s, k)
+        valid = fin_s > score_thresh
+        rows = jnp.concatenate([
+            jnp.where(valid, cat_c[fin_i], -1.0)[:, None],
+            jnp.where(valid, fin_s, 0.0)[:, None],
+            jnp.where(valid[:, None], cat_b[fin_i], 0.0)], axis=1)
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    rows, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": rows, "NmsRoisNum": counts}
+
+
+@register_op("roi_align", infer_shape=False)
+def roi_align(ctx, ins, attrs):
+    """ROI Align (reference operators/roi_align_op.h): bilinear-sampled
+    average pooling of each ROI; differentiable w.r.t. X."""
+    x = x_of(ins)                 # [N, C, H, W]
+    rois = x_of(ins, "ROIs")      # [R, 4] xyxy in input scale
+    pooled_h = int(attrs.get("pooled_height", 1))
+    pooled_w = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    sampling = int(attrs.get("sampling_ratio", 2))
+    sampling = max(sampling, 1)
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    if ins.get("RoisBatch"):          # explicit per-ROI image index
+        batch_idx = jnp.reshape(ins["RoisBatch"][0],
+                                (-1,)).astype(jnp.int32)
+    elif ins.get("RoisNum"):          # reference contract: counts/image
+        counts = jnp.reshape(ins["RoisNum"][0], (-1,)).astype(jnp.int32)
+        ends = jnp.cumsum(counts)
+        batch_idx = jnp.searchsorted(ends, jnp.arange(R, dtype=jnp.int32),
+                                     side="right").astype(jnp.int32)
+    else:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pooled_w
+        bin_h = rh / pooled_h
+        # sampling grid [ph, pw, s, s, 2]
+        py = jnp.arange(pooled_h, dtype=jnp.float32)
+        px = jnp.arange(pooled_w, dtype=jnp.float32)
+        sy = (jnp.arange(sampling, dtype=jnp.float32) + 0.5) / sampling
+        ys = y1 + (py[:, None] + sy[None, :]) * bin_h        # [ph, s]
+        xs = x1 + (px[:, None] + sy[None, :]) * bin_w        # [pw, s]
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            y0, x0, y1i, x1i = (a.astype(jnp.int32)
+                                for a in (y0, x0, y1i, x1i))
+            v00 = img[:, y0, x0]
+            v01 = img[:, y0, x1i]
+            v10 = img[:, y1i, x0]
+            v11 = img[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        img = x[bi]
+        yy = ys.reshape(-1)                       # [ph*s]
+        xx = xs.reshape(-1)                       # [pw*s]
+        yg, xg = jnp.meshgrid(yy, xx, indexing="ij")
+        vals = bilinear(img, yg, xg)              # [C, ph*s, pw*s]
+        vals = vals.reshape(C, pooled_h, sampling, pooled_w, sampling)
+        return vals.mean(axis=(2, 4))             # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out}

@@ -35,10 +35,12 @@ def send_op(ctx, ins, attrs):
     epmap = list(attrs["epmap"])
     xs = list(ins.get("X", []))
 
+    tid = attrs.get("trainer_id")
+
     def do_send(*vals):
         cli = _client(attrs)
         for name, ep, v in zip(names, epmap, vals):
-            cli.push_dense(ep, name, np.asarray(v))
+            cli.push_dense(ep, name, np.asarray(v), trainer_id=tid)
         return np.zeros((), np.int32)
 
     io_callback(do_send, jax.ShapeDtypeStruct((), jnp.int32), *xs,
@@ -52,9 +54,10 @@ def send_barrier_op(ctx, ins, attrs):
     are in and the pserver applied the updates (reference
     send_barrier_op.cc + RunSyncLoop)."""
     endpoints = list(attrs["endpoints"])
+    tid = attrs.get("trainer_id")
 
     def do_barrier():
-        _client(attrs).send_barrier(endpoints)
+        _client(attrs).send_barrier(endpoints, trainer_id=tid)
         return np.zeros((), np.int32)
 
     io_callback(do_barrier, jax.ShapeDtypeStruct((), jnp.int32),

@@ -1,0 +1,148 @@
+"""Fake-quantization ops (QAT/PTQ support).
+
+Capability parity with the reference's quantization operators
+(/root/reference/paddle/fluid/operators/fake_quantize_op.cc — abs_max,
+range_abs_max, moving_average_abs_max, channel_wise variants;
+fake_dequantize_op.cc). Forward simulates int-k rounding in float
+("fake" quant); backward is the straight-through estimator (identity on
+X) exactly like the reference's grad kernels, so QAT trains through the
+rounding. XLA folds the scale math into neighboring ops.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op, register_grad_lower
+from .common import x_of
+
+
+def _qmax(bits):
+    return float((1 << (int(bits) - 1)) - 1)
+
+
+def _quant(x, scale, bits):
+    q = _qmax(bits)
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * q) * s / q
+
+
+def _ste_grad(ins, attrs):
+    g = x_of(ins, "Out@GRAD")
+    return {"X@GRAD": [g]}
+
+
+@register_op("fake_quantize_abs_max", grad=None, infer_shape=False)
+def fake_quantize_abs_max(ctx, ins, attrs):
+    """attrs['frozen_scale'] (set by post-training quantization after
+    calibration) pins the scale; otherwise it is the dynamic |x|max."""
+    x = x_of(ins)
+    frozen = attrs.get("frozen_scale")
+    scale = (jnp.asarray(float(frozen), x.dtype) if frozen is not None
+             else jnp.max(jnp.abs(x)))
+    return {"Out": _quant(x, scale, attrs.get("bit_length", 8)),
+            "OutScale": scale.reshape(1)}
+
+
+register_grad_lower("fake_quantize_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_channel_wise_quantize_abs_max", grad=None,
+             infer_shape=False)
+def fake_channel_wise_quantize_abs_max(ctx, ins, attrs):
+    """Per-output-channel scales (dim 0, conv/fc weight layout)."""
+    x = x_of(ins)
+    bits = attrs.get("bit_length", 8)
+    scale = jnp.max(jnp.abs(x.reshape(x.shape[0], -1)), axis=1)
+    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    q = _qmax(bits)
+    out = jnp.round(jnp.clip(x / jnp.maximum(s, 1e-9), -1, 1) * q) * \
+        jnp.maximum(s, 1e-9) / q
+    return {"Out": out, "OutScale": scale}
+
+
+register_grad_lower("fake_channel_wise_quantize_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_quantize_moving_average_abs_max", grad=None,
+             infer_shape=False)
+def fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    """Activation quant with a moving-average scale (reference
+    fake_quantize_op.cc FakeQuantizeMovingAverageAbsMaxKernel): state
+    counts decayed steps, accum holds the decayed |x|max sum."""
+    x = x_of(ins)
+    accum = x_of(ins, "InAccum")
+    state = x_of(ins, "InState")
+    rho = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    if bool(attrs.get("is_test", False)):
+        scale = x_of(ins, "InScale").reshape(())
+        return {"Out": _quant(x, scale, attrs.get("bit_length", 8))}
+    new_state = rho * state + 1.0
+    new_accum = rho * accum + cur
+    scale = (new_accum / new_state).reshape(())
+    return {"Out": _quant(x, scale, attrs.get("bit_length", 8)),
+            "OutScale": scale.reshape(1),
+            "StateOut": new_state, "AccumOut": new_accum}
+
+
+register_grad_lower("fake_quantize_moving_average_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_quantize_range_abs_max", grad=None, infer_shape=False)
+def fake_quantize_range_abs_max(ctx, ins, attrs):
+    """Sliding-window max scale (reference FakeQuantizeRangeAbsMax):
+    scales ring-buffer keeps the last `window_size` batch maxima."""
+    x = x_of(ins)
+    iter_ = x_of(ins, "Iter")
+    scales = x_of(ins, "InScales")
+    window = scales.shape[0]
+    cur = jnp.max(jnp.abs(x))
+    if bool(attrs.get("is_test", False)):
+        scale = x_of(ins, "InScale").reshape(())
+        return {"Out": _quant(x, scale, attrs.get("bit_length", 8))}
+    idx = (iter_.reshape(()).astype(jnp.int32)) % window
+    new_scales = scales.at[idx].set(cur)
+    scale = jnp.max(new_scales)
+    return {"Out": _quant(x, scale, attrs.get("bit_length", 8)),
+            "OutScale": scale.reshape(1),
+            "OutScales": new_scales,
+            "IterOut": iter_ + 1}
+
+
+register_grad_lower("fake_quantize_range_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_quantize_dequantize_abs_max", grad=None,
+             infer_shape=False)
+def fake_quantize_dequantize_abs_max(ctx, ins, attrs):
+    x = x_of(ins)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _quant(x, scale, attrs.get("bit_length", 8)),
+            "OutScale": scale.reshape(1)}
+
+
+register_grad_lower("fake_quantize_dequantize_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_dequantize_max_abs", grad=None, infer_shape=False)
+def fake_dequantize_max_abs(ctx, ins, attrs):
+    """Out = X * Scale / max_range (reference fake_dequantize_op.cc).
+    This op is LINEAR in X (no rounding), so its grad is the scaled
+    upstream grad — not the straight-through identity the fake_quantize
+    ops use."""
+    x = x_of(ins)
+    scale = x_of(ins, "Scale").reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register_grad_lower("fake_dequantize_max_abs")
+def fake_dequantize_max_abs_grad(ctx, ins, attrs):
+    g = x_of(ins, "Out@GRAD")
+    scale = x_of(ins, "Scale").reshape(())
+    max_range = float(attrs["__fwd_op__"]["attrs"].get("max_range", 127.0))
+    return {"X@GRAD": [g * scale / max_range]}

@@ -207,6 +207,7 @@ class DistributeTranspiler:
         block.append_op(
             type="send", inputs={"X": grads}, outputs={},
             attrs={"send_varnames": params, "epmap": eps,
+                   "trainer_id": self.trainer_id,
                    OP_ROLE_KEY: OpRole.Dist},
             infer_shape=False)
         if self.sync_mode:
@@ -214,6 +215,7 @@ class DistributeTranspiler:
                 type="send_barrier", inputs={}, outputs={},
                 attrs={"endpoints": list(dict.fromkeys(eps)),
                        "trainers": self.trainers,
+                       "trainer_id": self.trainer_id,
                        OP_ROLE_KEY: OpRole.Dist},
                 infer_shape=False)
         prog._bump_version()

@@ -1,0 +1,138 @@
+"""Failure detection / checkpoint-restart recovery (reference pattern:
+heart_beat_monitor_test.cc, fleet collective save_checkpoint tests)."""
+import socket
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _free_ep():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return ep
+
+
+def test_heartbeat_evicts_dead_trainer():
+    """Sync PS expecting 2 trainers; only trainer 0 shows up. The
+    heartbeat monitor evicts the silent trainer so the round completes
+    instead of hanging (reference HeartBeatMonitor semantics)."""
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    ep = _free_ep()
+    server = ParameterServer(ep, trainers=2, sync_mode=True,
+                             heartbeat_timeout=1.5)
+    server.tables["w"] = np.zeros(4, np.float32)
+    ready = threading.Event()
+    server.serve(ready_event=ready, block=False)
+    ready.wait(10)
+
+    cli = PSClient.instance(key="hb_test")
+    t0 = time.monotonic()
+    cli.push_dense(ep, "w", np.ones(4, np.float32), trainer_id=0)
+    cli.send_barrier([ep], trainer_id=0)     # blocks until eviction
+    waited = time.monotonic() - t0
+    assert waited < 30, waited
+    # the round applied trainer 0's grad alone (bare-SGD fallback lr 0.01)
+    w = np.asarray(cli.pull_dense(ep, "w"))
+    np.testing.assert_allclose(w, -0.01 * np.ones(4), rtol=1e-6)
+    cli.stop_servers([ep])
+
+
+def test_fleet_checkpoint_restart():
+    """Kill-and-resume: save a checkpoint mid-training, 'restart' into a
+    fresh scope, load the newest checkpoint, and the loss curve
+    continues (reference TrainStatus + save/load_checkpoint)."""
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.collective import (
+        Collective, TrainStatus)
+
+    fleet_obj = Collective()
+    fleet_obj.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                        worker_num=1))
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 6], dtype="float32")
+        y = layers.data("y", [16, 1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(layers.fc(x, 8, act="tanh"), 1), y))
+        opt = fleet_obj.distributed_optimizer(fluid.optimizer.Adam(0.05))
+        opt.minimize(loss)
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 6)).astype(np.float32)
+    yv = (xv[:, :1] * 0.4).astype(np.float32)
+
+    exe = fluid.Executor()
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            pre = [float(exe.run(fleet_obj.main_program,
+                                 feed={"x": xv, "y": yv},
+                                 fetch_list=[loss])[0])
+                   for _ in range(10)]
+            no = fleet_obj.save_checkpoint(exe, ckpt_dir, TrainStatus(3),
+                                           main_program=main)
+            assert no == 0
+        # "crash": new scope, reload
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            exe.run(startup)
+            status = fleet_obj.load_checkpoint(exe, ckpt_dir,
+                                               main_program=main)
+            assert status.next() == 4
+            post = [float(exe.run(fleet_obj.main_program,
+                                  feed={"x": xv, "y": yv},
+                                  fetch_list=[loss])[0])
+                    for _ in range(5)]
+        # resumed loss continues from the checkpoint, not from scratch
+        assert post[0] < pre[0] * 0.8, (pre[0], post[0])
+        assert post[0] <= pre[-1] * 1.5, (pre[-1], post[0])
+        # empty-dir load is tolerant
+        with tempfile.TemporaryDirectory() as empty:
+            st = fleet_obj.load_checkpoint(exe, empty, main_program=main)
+            assert st.next() == 0
+
+
+def test_heartbeat_exempts_arrived_trainers():
+    """3 expected trainers: two reach the barrier, one is dead. Only the
+    dead one may be evicted; the round then releases with the two live
+    gradients averaged."""
+    from paddle_tpu.distributed import ParameterServer, PSClient
+
+    ep = _free_ep()
+    server = ParameterServer(ep, trainers=3, sync_mode=True,
+                             heartbeat_timeout=1.5)
+    server.tables["w"] = np.zeros(4, np.float32)
+    ready = threading.Event()
+    server.serve(ready_event=ready, block=False)
+    ready.wait(10)
+
+    results = {}
+
+    def trainer(tid, grad_val):
+        cli = PSClient.instance(key=f"hb3_{tid}")
+        cli.push_dense(ep, "w", np.full(4, grad_val, np.float32),
+                       trainer_id=tid)
+        t0 = time.monotonic()
+        cli.send_barrier([ep], trainer_id=tid)
+        results[tid] = time.monotonic() - t0
+
+    t1 = threading.Thread(target=trainer, args=(0, 1.0))
+    t2 = threading.Thread(target=trainer, args=(1, 3.0))
+    t1.start(); t2.start()
+    t1.join(60); t2.join(60)
+    assert results.get(0) is not None and results.get(1) is not None
+    assert server._evicted == {2}, server._evicted  # only the dead one
+    w = np.asarray(PSClient.instance(key="hb3_0").pull_dense(ep, "w"))
+    # mean of grads 1.0 and 3.0 applied with bare-SGD lr 0.01
+    np.testing.assert_allclose(w, -0.01 * 2.0 * np.ones(4), rtol=1e-6)
+    PSClient.instance(key="hb3_0").stop_servers([ep])

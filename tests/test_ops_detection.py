@@ -1,0 +1,230 @@
+"""Detection op family vs numpy references (reference pattern:
+tests/unittests/test_prior_box_op.py, test_box_coder_op.py,
+test_yolo_box_op.py, test_multiclass_nms_op.py, test_iou_similarity_op.py,
+test_roi_align_op.py, test_anchor_generator_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(11)
+
+
+def _t(op_type, inputs, attrs, outputs):
+    t = OpTest.__new__(OpTest)
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
+def _iou_ref(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, bi in enumerate(a):
+        for j, bj in enumerate(b):
+            x1, y1 = max(bi[0], bj[0]), max(bi[1], bj[1])
+            x2, y2 = min(bi[2], bj[2]), min(bi[3], bj[3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            ua = (bi[2] - bi[0]) * (bi[3] - bi[1]) + \
+                (bj[2] - bj[0]) * (bj[3] - bj[1]) - inter
+            out[i, j] = inter / max(ua, 1e-10)
+    return out
+
+
+def _rand_boxes(n, size=100.0):
+    xy = RNG.uniform(0, size * 0.7, (n, 2))
+    wh = RNG.uniform(size * 0.05, size * 0.3, (n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_iou_similarity():
+    a, b = _rand_boxes(5), _rand_boxes(7)
+    _t("iou_similarity", {"X": a, "Y": ("y", b)}, {},
+       {"Out": _iou_ref(a, b)}).check_output(atol=1e-5)
+
+
+def test_prior_box_shapes_and_values():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    t = _t("prior_box",
+           {"Input": feat, "Image": ("image", img)},
+           {"min_sizes": [16.0], "max_sizes": [32.0],
+            "aspect_ratios": [2.0], "flip": True, "clip": True,
+            "variances": [0.1, 0.1, 0.2, 0.2], "offset": 0.5},
+           {})
+    # run manually (variable #priors): build program directly
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="feat", shape=feat.shape, dtype="float32",
+                      is_data=True)
+        gb.create_var(name="image", shape=img.shape, dtype="float32",
+                      is_data=True)
+        boxes = gb.create_var(name="boxes", dtype="float32")
+        var = gb.create_var(name="vars", dtype="float32")
+        gb.append_op(type="prior_box",
+                     inputs={"Input": ["feat"], "Image": ["image"]},
+                     outputs={"Boxes": [boxes], "Variances": [var]},
+                     attrs=t.attrs, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        b, v = exe.run(main, feed={"feat": feat, "image": img},
+                       fetch_list=["boxes", "vars"])
+    b, v = np.asarray(b), np.asarray(v)
+    # min(1) + max(1) + flipped ratio-2 (2) = 4 priors per cell
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # center cell (0,0): min box is 16x16 around (8, 8) of a 64px image
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 0.25, 0.25],
+                               atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    import paddle_tpu as fluid
+    prior = _rand_boxes(6, 1.0)
+    target = _rand_boxes(6, 1.0)
+    pvar = np.full((6, 4), 0.1, np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("prior", prior), ("pvar", pvar),
+                     ("target", target)):
+            gb.create_var(name=n, shape=a.shape, dtype="float32",
+                          is_data=True)
+        enc = gb.create_var(name="enc", dtype="float32")
+        gb.append_op(type="box_coder",
+                     inputs={"PriorBox": ["prior"],
+                             "PriorBoxVar": ["pvar"],
+                             "TargetBox": ["target"]},
+                     outputs={"OutputBox": [enc]},
+                     attrs={"code_type": "encode_center_size"},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        e, = exe.run(main, feed={"prior": prior, "pvar": pvar,
+                                 "target": target}, fetch_list=["enc"])
+    e = np.asarray(e)          # [T, P, 4]
+    # decode the diagonal codes (target t encoded against prior t),
+    # laid out [1, P, 4] so dim1 aligns with the priors
+    diag = np.stack([e[t, t] for t in range(6)])[None, :, :]  # [1,6,4]
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        gb = main2.global_block()
+        for n, a in (("prior", prior), ("pvar", pvar),
+                     ("code", diag)):
+            gb.create_var(name=n, shape=a.shape, dtype="float32",
+                          is_data=True)
+        dec = gb.create_var(name="dec", dtype="float32")
+        gb.append_op(type="box_coder",
+                     inputs={"PriorBox": ["prior"],
+                             "PriorBoxVar": ["pvar"],
+                             "TargetBox": ["code"]},
+                     outputs={"OutputBox": [dec]},
+                     attrs={"code_type": "decode_center_size"},
+                     infer_shape=False)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        d, = exe.run(main2, feed={"prior": prior, "pvar": pvar,
+                                  "code": diag}, fetch_list=["dec"])
+    d = np.asarray(d)          # [1, P, 4]
+    np.testing.assert_allclose(d[0], target, rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    import paddle_tpu as fluid
+    # 4 boxes: two heavy overlaps + two separate; 1 fg class
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60], [80, 80, 90, 90]]], np.float32)
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]     # class 1; box1 overlaps box0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="b", shape=boxes.shape, dtype="float32",
+                      is_data=True)
+        gb.create_var(name="s", shape=scores.shape, dtype="float32",
+                      is_data=True)
+        out = gb.create_var(name="out", dtype="float32")
+        cnt = gb.create_var(name="cnt", dtype="int32")
+        gb.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": ["b"], "Scores": ["s"]},
+                     outputs={"Out": [out], "NmsRoisNum": [cnt]},
+                     attrs={"score_threshold": 0.1, "nms_threshold": 0.5,
+                            "keep_top_k": 4, "nms_top_k": 4,
+                            "background_label": 0},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, c = exe.run(main, feed={"b": boxes, "s": scores},
+                       fetch_list=["out", "cnt"])
+    o, c = np.asarray(o), np.asarray(c)
+    assert int(c[0]) == 2, (o, c)            # box1 suppressed, box3 below thresh
+    kept_scores = sorted(o[0, :2, 1].tolist(), reverse=True)
+    np.testing.assert_allclose(kept_scores, [0.9, 0.7], atol=1e-6)
+    assert (o[0, 2:, 0] == -1).all()         # padding rows flagged
+
+
+def test_yolo_box_decodes():
+    import paddle_tpu as fluid
+    N, A, C, H, W = 1, 2, 3, 2, 2
+    x = RNG.standard_normal((N, A * (5 + C), H, W)).astype(np.float32)
+    img = np.array([[64, 64]], np.int32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="x", shape=x.shape, dtype="float32",
+                      is_data=True)
+        gb.create_var(name="img", shape=img.shape, dtype="int32",
+                      is_data=True)
+        b = gb.create_var(name="b", dtype="float32")
+        s = gb.create_var(name="s", dtype="float32")
+        gb.append_op(type="yolo_box",
+                     inputs={"X": ["x"], "ImgSize": ["img"]},
+                     outputs={"Boxes": [b], "Scores": [s]},
+                     attrs={"anchors": [10, 13, 16, 30], "class_num": C,
+                            "conf_thresh": 0.005, "downsample_ratio": 32},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        bv, sv = exe.run(main, feed={"x": x, "img": img},
+                         fetch_list=["b", "s"])
+    bv, sv = np.asarray(bv), np.asarray(sv)
+    assert bv.shape == (N, A * H * W, 4)
+    assert sv.shape == (N, A * H * W, C)
+    assert (sv >= 0).all() and (sv <= 1).all()
+
+
+def test_roi_align_matches_manual_bilinear():
+    import paddle_tpu as fluid
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="x", shape=x.shape, dtype="float32",
+                      is_data=True)
+        gb.create_var(name="rois", shape=rois.shape, dtype="float32",
+                      is_data=True)
+        out = gb.create_var(name="out", dtype="float32")
+        gb.append_op(type="roi_align",
+                     inputs={"X": ["x"], "ROIs": ["rois"]},
+                     outputs={"Out": [out]},
+                     attrs={"pooled_height": 2, "pooled_width": 2,
+                            "spatial_scale": 1.0, "sampling_ratio": 2},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": x, "rois": rois},
+                     fetch_list=["out"])
+    o = np.asarray(o)[0, 0]
+    assert o.shape == (2, 2)
+    # averaging a linear ramp: quadrant means keep the ramp ordering
+    assert o[0, 0] < o[0, 1] < o[1, 1]
+    assert o[0, 0] < o[1, 0] < o[1, 1]

@@ -1,0 +1,137 @@
+"""Fake-quant ops + QAT/PTQ passes (reference pattern:
+tests/unittests/test_fake_quantize_op.py,
+slim/tests/test_quantization_pass.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.quantization import (
+    PostTrainingQuantization, QuantizationTransformPass)
+from op_test import OpTest
+
+
+def _fake_quant_ref(x, bits=8):
+    q = (1 << (bits - 1)) - 1
+    scale = np.abs(x).max()
+    return np.round(np.clip(x / max(scale, 1e-9), -1, 1) * q) * scale / q
+
+
+def test_fake_quantize_abs_max_op():
+    x = np.random.default_rng(0).standard_normal((8, 6)).astype(np.float32)
+    t = OpTest.__new__(OpTest)
+    t.op_type = "fake_quantize_abs_max"
+    t.inputs = {"X": x}
+    t.attrs = {"bit_length": 8}
+    t.outputs = {"Out": _fake_quant_ref(x).astype(np.float32),
+                 "OutScale": np.array([np.abs(x).max()], np.float32)}
+    t.check_output(atol=1e-6)
+
+
+def test_fake_quant_ste_gradient():
+    """STE: d(fake_quant(x))/dx == upstream grad, bit-exactly."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        x.stop_gradient = False
+        out = layers.data("unused", [1], dtype="float32")
+        gb = main.global_block()
+        q = gb.create_var(name="q", shape=(4,), dtype="float32")
+        sc = gb.create_var(name="sc", shape=(1,), dtype="float32")
+        gb.append_op(type="fake_quantize_abs_max",
+                     inputs={"X": [x]},
+                     outputs={"Out": [q], "OutScale": [sc]},
+                     attrs={"bit_length": 8}, infer_shape=False)
+        loss = layers.reduce_sum(layers.elementwise_mul(gb.var("q"),
+                                                        gb.var("q")))
+        (gx,) = fluid.gradients(loss, [x])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.array([0.3, -0.7, 0.1, 0.9], np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        gv, qv = exe.run(main, feed={"x": xv,
+                                     "unused": np.zeros(1, np.float32)},
+                         fetch_list=[gx, "q"])
+    np.testing.assert_allclose(gv, 2 * np.asarray(qv), rtol=1e-6)
+
+
+def test_qat_pass_trains_and_quantizes():
+    """QAT: transform inserts fake-quant on mul weights+activations and
+    the rewritten program still trains."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 8], dtype="float32")
+        y = layers.data("y", [16, 1], dtype="float32")
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        tp = QuantizationTransformPass(
+            activation_quantize_type="moving_average_abs_max",
+            quantizable_op_type=("mul",))
+        tp.apply(main, startup_program=startup)
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    qops = [op.type for op in main.global_block().ops
+            if op.type.startswith("fake_")]
+    assert "fake_channel_wise_quantize_abs_max" in qops, qops  # weights
+    assert "fake_quantize_moving_average_abs_max" in qops, qops  # acts
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 8)).astype(np.float32)
+    yv = (xv[:, :1] * 0.5 + 0.1).astype(np.float32)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(30)]
+    assert losses[-1] < 0.3 * losses[0], losses[::10]
+
+
+def test_post_training_quantization():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        pred = layers.fc(x, 3, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.default_rng(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        batches = [{"x": rng.standard_normal((8, 4)).astype(np.float32)}
+                   for _ in range(3)]
+        ptq = PostTrainingQuantization(
+            exe, main, ["x"], [pred], batches,
+            quantizable_op_type=("mul",), scope=scope)
+        qprog = ptq.quantize()
+        xv = batches[0]["x"]
+        ref, = exe.run(main, feed={"x": xv}, fetch_list=[pred])
+        got, = exe.run(qprog, feed={"x": xv},
+                       fetch_list=[pred.name + ""])
+    # int8-simulated inference stays close to float
+    assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) < 0.1
+    assert ptq._calibration_scales  # scales were collected
+
+
+def test_ptq_freezes_scales():
+    """PTQ must bake calibration scales into the quant ops."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        pred = layers.fc(x, 3)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.default_rng(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        batches = [{"x": rng.standard_normal((8, 4)).astype(np.float32)}]
+        ptq = PostTrainingQuantization(exe, main, ["x"], [pred], batches,
+                                       quantizable_op_type=("mul",),
+                                       scope=scope)
+        qprog = ptq.quantize()
+    frozen = [op.attrs.get("frozen_scale")
+              for op in qprog.global_block().ops
+              if op.type == "fake_quantize_abs_max"]
+    assert frozen and all(f is not None and f > 0 for f in frozen), frozen
