@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build libpaddle_tpu_capi.so (see paddle_c_api.h).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -shared -fPIC -std=c++17 paddle_c_api.cc \
+    $(python3-config --includes) \
+    $(python3-config --ldflags --embed) \
+    -o libpaddle_tpu_capi.so
+echo "built $(pwd)/libpaddle_tpu_capi.so"

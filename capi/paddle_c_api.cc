@@ -1,0 +1,242 @@
+/* C API implementation: embeds the Python runtime and drives the
+ * AnalysisPredictor (paddle_tpu/inference). See paddle_c_api.h.
+ *
+ * Mirrors the reference's C API layering (inference/capi/c_api.cc fronts
+ * the C++ AnalysisPredictor): a thin native shim over the real predictor,
+ * holding the GIL only around calls. Buffers cross the boundary through
+ * numpy arrays built from memoryviews — no serialization.
+ */
+#include "paddle_c_api.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+bool g_inited = false;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  } else {
+    g_last_error = "unknown python error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct GIL {
+  PyGILState_STATE state;
+  GIL() : state(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(this->state); }
+};
+
+}  // namespace
+
+struct PD_Predictor {
+  PyObject* predictor;                  // paddle_tpu AnalysisPredictor
+  std::vector<std::string> input_names;
+  std::vector<std::string> output_names;
+};
+
+extern "C" {
+
+int PD_Init(void) {
+  if (g_inited) return 0;
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  {
+    GIL gil;
+    PyObject* mod = PyImport_ImportModule("paddle_tpu");
+    if (mod == nullptr) {
+      set_error_from_python();
+      return 1;
+    }
+    Py_DECREF(mod);
+  }
+  if (we_initialized) {
+    // Py_InitializeEx leaves this thread holding the GIL; release it so
+    // other host threads' PyGILState_Ensure calls can acquire it
+    PyEval_SaveThread();
+  }
+  g_inited = true;
+  return 0;
+}
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+PD_Predictor* PD_NewPredictor(const char* model_dir) {
+  if (PD_Init() != 0) return nullptr;
+  GIL gil;
+  PyObject* inf = PyImport_ImportModule("paddle_tpu.inference");
+  if (inf == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* cfg = PyObject_CallMethod(inf, "AnalysisConfig", "s", model_dir);
+  PyObject* pred = cfg != nullptr
+      ? PyObject_CallMethod(inf, "create_paddle_predictor", "O", cfg)
+      : nullptr;
+  Py_XDECREF(cfg);
+  Py_DECREF(inf);
+  if (pred == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PD_Predictor* out = new PD_Predictor();
+  out->predictor = pred;
+  for (const char* which : {"get_input_names", "get_output_names"}) {
+    PyObject* names = PyObject_CallMethod(pred, which, nullptr);
+    if (names == nullptr) {
+      set_error_from_python();
+      Py_DECREF(pred);
+      delete out;
+      return nullptr;
+    }
+    auto& dst = std::strcmp(which, "get_input_names") == 0
+        ? out->input_names : out->output_names;
+    for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+      dst.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+    }
+    Py_DECREF(names);
+  }
+  return out;
+}
+
+int PD_GetInputNum(PD_Predictor* p) {
+  return static_cast<int>(p->input_names.size());
+}
+int PD_GetOutputNum(PD_Predictor* p) {
+  return static_cast<int>(p->output_names.size());
+}
+const char* PD_GetInputName(PD_Predictor* p, int i) {
+  return p->input_names[i].c_str();
+}
+const char* PD_GetOutputName(PD_Predictor* p, int i) {
+  return p->output_names[i].c_str();
+}
+
+namespace {
+
+int set_input(PD_Predictor* p, int i, const void* data, size_t itemsize,
+              const char* np_dtype, const int* shape, int ndim) {
+  GIL gil;
+  long long numel = 1;
+  for (int d = 0; d < ndim; ++d) numel *= shape[d];
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) { set_error_from_python(); return 1; }
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      numel * static_cast<long long>(itemsize), PyBUF_READ);
+  PyObject* flat = mem != nullptr
+      ? PyObject_CallMethod(np, "frombuffer", "Os", mem, np_dtype)
+      : nullptr;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int d = 0; d < ndim; ++d) {
+    PyTuple_SetItem(shp, d, PyLong_FromLong(shape[d]));
+  }
+  PyObject* arr = flat != nullptr
+      ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+  Py_XDECREF(shp);
+  Py_XDECREF(flat);
+  Py_XDECREF(mem);
+  Py_DECREF(np);
+  if (arr == nullptr) { set_error_from_python(); return 1; }
+  PyObject* handle = PyObject_CallMethod(
+      p->predictor, "get_input_handle", "s", p->input_names[i].c_str());
+  PyObject* ok = handle != nullptr
+      ? PyObject_CallMethod(handle, "copy_from_cpu", "O", arr) : nullptr;
+  Py_XDECREF(ok);
+  Py_XDECREF(handle);
+  Py_DECREF(arr);
+  if (ok == nullptr) { set_error_from_python(); return 1; }
+  return 0;
+}
+
+}  // namespace
+
+int PD_SetInputFloat(PD_Predictor* p, int i, const float* data,
+                     const int* shape, int ndim) {
+  return set_input(p, i, data, sizeof(float), "float32", shape, ndim);
+}
+
+int PD_SetInputInt64(PD_Predictor* p, int i, const long long* data,
+                     const int* shape, int ndim) {
+  return set_input(p, i, data, sizeof(long long), "int64", shape, ndim);
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  GIL gil;
+  PyObject* r = PyObject_CallMethod(p->predictor, "run", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+long long PD_GetOutputFloat(PD_Predictor* p, int i, float* buf,
+                            long long buf_len, int* shape, int* ndim_out) {
+  GIL gil;
+  PyObject* handle = PyObject_CallMethod(
+      p->predictor, "get_output_handle", "s", p->output_names[i].c_str());
+  PyObject* arr = handle != nullptr
+      ? PyObject_CallMethod(handle, "copy_to_cpu", nullptr) : nullptr;
+  Py_XDECREF(handle);
+  if (arr == nullptr) { set_error_from_python(); return -1; }
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* f32 = PyObject_CallMethod(np, "ascontiguousarray", "Os", arr,
+                                      "float32");
+  Py_DECREF(np);
+  Py_DECREF(arr);
+  if (f32 == nullptr) { set_error_from_python(); return -1; }
+  PyObject* shp = PyObject_GetAttrString(f32, "shape");
+  int nd = static_cast<int>(PyTuple_Size(shp));
+  long long numel = 1;
+  for (int d = 0; d < nd; ++d) {
+    long dim = PyLong_AsLong(PyTuple_GetItem(shp, d));
+    if (d < 8) shape[d] = static_cast<int>(dim);
+    numel *= dim;
+  }
+  *ndim_out = nd;
+  Py_DECREF(shp);
+  Py_buffer view;
+  if (PyObject_GetBuffer(f32, &view, PyBUF_CONTIG_RO) != 0) {
+    set_error_from_python();
+    Py_DECREF(f32);
+    return -1;
+  }
+  long long ncopy = numel < buf_len ? numel : buf_len;
+  std::memcpy(buf, view.buf, ncopy * sizeof(float));
+  PyBuffer_Release(&view);
+  Py_DECREF(f32);
+  return numel;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (p == nullptr) return;
+  {
+    GIL gil;
+    Py_XDECREF(p->predictor);
+  }
+  delete p;
+}
+
+}  // extern "C"

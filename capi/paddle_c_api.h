@@ -1,0 +1,62 @@
+/* C inference/train API for paddle_tpu.
+ *
+ * Capability parity with the reference's C API
+ * (/root/reference/paddle/fluid/inference/capi/ — c_api.cc, pd_config.cc,
+ * pd_predictor.cc) and the C++ train entry
+ * (/root/reference/paddle/fluid/framework/c/c_api.cc, train/demo/).
+ *
+ * The reference's C API fronts its C++ AnalysisPredictor; this one fronts
+ * the XLA-compiled predictor by embedding the Python runtime (the compute
+ * path itself is native XLA code either way). Link with:
+ *   g++ -shared -fPIC paddle_c_api.cc $(python3-config --includes) \
+ *       $(python3-config --ldflags --embed) -o libpaddle_tpu_capi.so
+ */
+#ifndef PADDLE_TPU_C_API_H_
+#define PADDLE_TPU_C_API_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+/* Initialize the runtime (idempotent). Returns 0 on success. */
+int PD_Init(void);
+
+/* Load a saved inference model directory (save_inference_model output).
+ * Returns NULL on failure; PD_GetLastError() describes it. */
+PD_Predictor* PD_NewPredictor(const char* model_dir);
+
+/* Number / names of feed inputs and fetch outputs. */
+int PD_GetInputNum(PD_Predictor* pred);
+int PD_GetOutputNum(PD_Predictor* pred);
+const char* PD_GetInputName(PD_Predictor* pred, int i);
+const char* PD_GetOutputName(PD_Predictor* pred, int i);
+
+/* Set input i from a dense float32 buffer with `ndim` dims in `shape`. */
+int PD_SetInputFloat(PD_Predictor* pred, int i, const float* data,
+                     const int* shape, int ndim);
+/* Same for int64 feeds (ids/labels). */
+int PD_SetInputInt64(PD_Predictor* pred, int i, const long long* data,
+                     const int* shape, int ndim);
+
+/* Run the compiled model over the staged inputs. Returns 0 on success. */
+int PD_PredictorRun(PD_Predictor* pred);
+
+/* Read back output i as float32. `shape`/`ndim_out` receive the result
+ * dims (shape must have room for 8 dims); returns the element count, and
+ * copies min(element_count, buf_len) values into buf. */
+long long PD_GetOutputFloat(PD_Predictor* pred, int i, float* buf,
+                            long long buf_len, int* shape, int* ndim_out);
+
+void PD_DeletePredictor(PD_Predictor* pred);
+
+/* Last error message (thread-unsafe, valid until the next API call). */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_C_API_H_ */

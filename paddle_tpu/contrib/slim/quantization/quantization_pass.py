@@ -40,8 +40,9 @@ class QuantizationTransformPass:
     def apply(self, program, startup_program=None, for_test=False):
         """Insert fake-quant ops before every quantizable op's float
         inputs, in place (pass a clone to keep the original)."""
-        from ....framework.core import program_guard, default_startup_program
         block = program.global_block()
+        self._quanted = {}      # per-apply: quantized var names are
+        #                         program-local, never reuse across programs
         i = 0
         while i < len(block.ops):
             op = block.ops[i]

@@ -202,9 +202,15 @@ class ParameterServer:
                     continue
                 # the round has waited too long: evict every expected
                 # trainer that has NOT reached the barrier (arrived ones
-                # are alive-but-blocked, never evicted)
+                # are alive-but-blocked, never evicted) AND whose own
+                # heartbeat is stale — a trainer actively pushing grads
+                # keeps its _last_seen fresh and is left alone
                 for tid in range(self._initial_trainers):
                     if tid in self._arrived or tid in self._evicted:
+                        continue
+                    seen = self._last_seen.get(tid)
+                    if seen is not None and \
+                            now - seen <= self.heartbeat_timeout:
                         continue
                     self._evicted.add(tid)
                     self.trainers = max(self.trainers - 1, 1)

@@ -226,3 +226,59 @@ class Dropout(Layer):
         return layers.dropout(x, self._p, is_test=not self.training,
                               seed=self._seed,
                               dropout_implementation=self._impl)
+
+
+class LSTMCell(Layer):
+    """reference dygraph/nn.py LSTMCell (fused-gate variant, see
+    ops/nn_ops.py lstm_cell_fused)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, forget_bias=0.0, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._hidden_size = hidden_size
+        self._forget_bias = float(forget_bias)
+        self.weight = self.create_parameter(
+            [input_size + hidden_size, 4 * hidden_size],
+            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter(
+            [4 * hidden_size], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input, pre_hidden, pre_cell):
+        c_out = VarBase(np.zeros((), np_dtype(convert_dtype(self._dtype))),
+                        stop_gradient=False)
+        h = _trace("lstm_cell_fused",
+                   {"X": [input], "HPrev": [pre_hidden],
+                    "CPrev": [pre_cell], "W": [self.weight],
+                    "B": [self.bias]},
+                   attrs={"forget_bias": self._forget_bias},
+                   out_dtype=self._dtype, out_slot="H",
+                   extra_outputs={"C": [c_out]})
+        return h, c_out
+
+
+class GRUCell(Layer):
+    """GRU step cell (reference dygraph GRUUnit; fused, see
+    ops/nn_ops.py gru_cell_fused)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight_gate = self.create_parameter(
+            [input_size + hidden_size, 2 * hidden_size],
+            attr=param_attr, dtype=dtype)
+        self.bias_gate = self.create_parameter(
+            [2 * hidden_size], attr=bias_attr, dtype=dtype, is_bias=True)
+        self.weight_cand = self.create_parameter(
+            [input_size + hidden_size, hidden_size],
+            attr=param_attr, dtype=dtype)
+        self.bias_cand = self.create_parameter(
+            [hidden_size], attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input, pre_hidden):
+        return _trace("gru_cell_fused",
+                      {"X": [input], "HPrev": [pre_hidden],
+                       "WGate": [self.weight_gate],
+                       "BGate": [self.bias_gate],
+                       "WCand": [self.weight_cand],
+                       "BCand": [self.bias_cand]},
+                      out_dtype=self._dtype, out_slot="H")

@@ -584,3 +584,95 @@ def _append_channel_bias(helper, out):
                      inputs={"X": [out], "Y": [bias]},
                      outputs={"Out": [tmp]}, attrs={"axis": 1})
     return tmp
+
+
+def switch_moe(input, num_experts, d_hidden, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Switch-style Mixture-of-Experts FFN block (north-star extra; no
+    reference counterpart — see ops/moe_ops.py). Expert weights are
+    stacked [E, ...] and sharded over the "ep" mesh axis; returns
+    (out, aux_loss) where aux_loss is the load-balance term to add to the
+    training loss."""
+    helper = LayerHelper("switch_moe", param_attr=param_attr, name=name)
+    d = int(input.shape[-1])
+    E, H = int(num_experts), int(d_hidden)
+    gate_w = helper.create_parameter(helper.param_attr, shape=[d, E],
+                                     dtype=input.dtype)
+    std1 = (2.0 / (d + H)) ** 0.5
+    w1 = helper.create_parameter(
+        helper.param_attr, shape=[E, d, H], dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, std1),
+        dist_attr=("ep",))
+    b1 = helper.create_parameter(helper.param_attr, shape=[E, H],
+                                 dtype=input.dtype, is_bias=True,
+                                 dist_attr=("ep",))
+    w2 = helper.create_parameter(
+        helper.param_attr, shape=[E, H, d], dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, std1),
+        dist_attr=("ep",))
+    b2 = helper.create_parameter(helper.param_attr, shape=[E, d],
+                                 dtype=input.dtype, is_bias=True,
+                                 dist_attr=("ep",))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    aux = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="switch_moe",
+        inputs={"X": [input], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": float(capacity_factor)},
+        infer_shape=False)
+    out.shape = tuple(input.shape or ())
+    out.dtype = input.dtype
+    aux.shape = ()
+    aux.dtype = input.dtype
+    return out, aux
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step for use inside StaticRNN (reference layers/nn.py
+    lstm_unit -> operators/lstm_unit_op.h; here the x/h projections and
+    gate math are one fused MXU-friendly op). Returns (hidden_t, cell_t)."""
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = int(x_t.shape[-1])
+    H = int(hidden_t_prev.shape[-1])
+    w = helper.create_parameter(helper.param_attr, shape=[D + H, 4 * H],
+                                dtype=x_t.dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[4 * H],
+                                dtype=x_t.dtype, is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    c = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    helper.append_op(
+        type="lstm_cell_fused",
+        inputs={"X": [x_t], "HPrev": [hidden_t_prev],
+                "CPrev": [cell_t_prev], "W": [w], "B": [b]},
+        outputs={"H": [h], "C": [c]},
+        attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size=None, param_attr=None, bias_attr=None,
+             name=None):
+    """One GRU step for use inside StaticRNN (reference layers/nn.py
+    gru_unit -> operators/gru_unit_op.h, fused). Returns hidden_t."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = int(input.shape[-1])
+    H = int(hidden.shape[-1])
+    wg = helper.create_parameter(helper.param_attr, shape=[D + H, 2 * H],
+                                 dtype=input.dtype)
+    bg = helper.create_parameter(helper.bias_attr, shape=[2 * H],
+                                 dtype=input.dtype, is_bias=True)
+    wc = helper.create_parameter(helper.param_attr, shape=[D + H, H],
+                                 dtype=input.dtype)
+    bc = helper.create_parameter(helper.bias_attr, shape=[H],
+                                 dtype=input.dtype, is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="gru_cell_fused",
+        inputs={"X": [input], "HPrev": [hidden], "WGate": [wg],
+                "BGate": [bg], "WCand": [wc], "BCand": [bc]},
+        outputs={"H": [h]}, attrs={})
+    return h

@@ -13,4 +13,5 @@ from . import (  # noqa: F401
     distributed_ops,
     quantize_ops,
     detection_ops,
+    moe_ops,
 )

@@ -68,15 +68,22 @@ def prior_box(ctx, ins, attrs):
         if flip:
             full_ratios.append(1.0 / r)
 
+    # reference prior_box_op.cc: default order is [min, ratios..., max];
+    # min_max_aspect_ratios_order=True moves max right after min
+    mm_order = bool(attrs.get("min_max_aspect_ratios_order", False))
     whs = []
     for si, ms in enumerate(min_sizes):
-        # reference order: ratio-1 min box, then max-size box, then ratios
         whs.append((ms, ms))
+        ratio_whs = [(ms * float(np.sqrt(r)), ms / float(np.sqrt(r)))
+                     for r in full_ratios[1:]]
+        max_wh = []
         if max_sizes:
             big = float(np.sqrt(ms * max_sizes[si]))
-            whs.append((big, big))
-        for r in full_ratios[1:]:
-            whs.append((ms * float(np.sqrt(r)), ms / float(np.sqrt(r))))
+            max_wh = [(big, big)]
+        if mm_order:
+            whs.extend(max_wh + ratio_whs)
+        else:
+            whs.extend(ratio_whs + max_wh)
 
     cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
     cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
@@ -322,9 +329,16 @@ def roi_align(ctx, ins, attrs):
     pooled_h = int(attrs.get("pooled_height", 1))
     pooled_w = int(attrs.get("pooled_width", 1))
     scale = float(attrs.get("spatial_scale", 1.0))
-    sampling = int(attrs.get("sampling_ratio", 2))
-    sampling = max(sampling, 1)
     N, C, H, W = x.shape
+    sampling = int(attrs.get("sampling_ratio", -1))
+    if sampling <= 0:
+        # reference adaptive default: ceil(roi_size / pooled_size) samples
+        # per bin, computed PER ROI. Static shapes need one count; use the
+        # worst case over the feature map (full-image ROI)
+        sampling = max(int(np.ceil(H / int(attrs.get("pooled_height", 1)))),
+                       int(np.ceil(W / int(attrs.get("pooled_width", 1)))),
+                       1)
+        sampling = min(sampling, 8)   # cap the static cost
     R = rois.shape[0]
     if ins.get("RoisBatch"):          # explicit per-ROI image index
         batch_idx = jnp.reshape(ins["RoisBatch"][0],

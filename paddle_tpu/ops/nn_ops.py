@@ -571,3 +571,45 @@ def temporal_shift(ctx, ins, attrs):
     rest = xr[:, :, c2:]
     out = jnp.concatenate([pre, post, rest], axis=2)
     return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("lstm_cell_fused")
+def lstm_cell_fused(ctx, ins, attrs):
+    """One LSTM step (reference operators/lstm_unit_op.h math; fused
+    x/h projection): Gates = [X, HPrev] @ W + B split into i,f,c,o."""
+    x = x_of(ins)
+    h_prev = x_of(ins, "HPrev")
+    c_prev = x_of(ins, "CPrev")
+    w = x_of(ins, "W")            # [D+H, 4H]
+    b = x_of(ins, "B")            # [4H]
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    H = h_prev.shape[-1]
+    gates = jnp.concatenate([x, h_prev], axis=-1) @ w + b
+    i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    del H
+    return {"H": h, "C": c}
+
+
+@register_op("gru_cell_fused")
+def gru_cell_fused(ctx, ins, attrs):
+    """One GRU step (reference operators/gru_unit_op.h math, fused):
+    update/reset from [X, HPrev] @ Wg; candidate from [X, r*HPrev] @ Wc."""
+    x = x_of(ins)
+    h_prev = x_of(ins, "HPrev")
+    wg = x_of(ins, "WGate")       # [D+H, 2H]
+    bg = x_of(ins, "BGate")       # [2H]
+    wc = x_of(ins, "WCand")       # [D+H, H]
+    bc = x_of(ins, "BCand")       # [H]
+    gates = jax.nn.sigmoid(jnp.concatenate([x, h_prev], axis=-1) @ wg + bg)
+    u, r = jnp.split(gates, 2, axis=-1)
+    cand = jnp.tanh(jnp.concatenate([x, r * h_prev], axis=-1) @ wc + bc)
+    # reference default (origin_mode=False, gru_unit_op.h): u gates the
+    # CANDIDATE; origin_mode=True is the u-gates-previous variant
+    if bool(attrs.get("origin_mode", False)):
+        h = u * h_prev + (1.0 - u) * cand
+    else:
+        h = u * cand + (1.0 - u) * h_prev
+    return {"H": h}

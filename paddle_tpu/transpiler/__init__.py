@@ -45,6 +45,9 @@ class DistributeTranspilerConfig:
     completely_not_async = False
     geo_sgd_mode = False
     geo_sgd_need_push_nums = 100
+    # arm the pserver HeartBeatMonitor (seconds of barrier wait before a
+    # missing trainer is evicted; None = wait forever)
+    heartbeat_timeout = None
     nccl_comm_num = 1
     use_hierarchical_allreduce = False
     hierarchical_allreduce_inter_nranks = 0
@@ -254,6 +257,7 @@ class DistributeTranspiler:
                    "Fanin": self.trainers,
                    "optimize_blocks": opt_blocks,
                    "hosted_vars": hosted_vars,
+                   "heartbeat_timeout": self.config.heartbeat_timeout,
                    OP_ROLE_KEY: OpRole.RPC},
             infer_shape=False)
         return prog

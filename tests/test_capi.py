@@ -1,0 +1,59 @@
+"""Native C API: build the shared lib + C demo, run it against a saved
+model from a pure-C process (reference pattern: inference/capi tests and
+train/demo — a non-Python entry driving the framework)."""
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CAPI = os.path.join(REPO, "capi")
+
+
+def _save_model(dirname):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        out = layers.fc(layers.fc(x, 16, act="tanh"), 3, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main, scope=scope)
+
+
+def test_c_api_end_to_end():
+    lib = os.path.join(CAPI, "libpaddle_tpu_capi.so")
+    build = subprocess.run(["sh", os.path.join(CAPI, "build.sh")],
+                           capture_output=True)
+    assert build.returncode == 0, build.stderr.decode()[-2000:]
+    assert os.path.exists(lib)
+
+    with tempfile.TemporaryDirectory() as d:
+        _save_model(d)
+        demo = os.path.join(d, "demo")
+        cc = subprocess.run(
+            ["gcc", "-O2", os.path.join(CAPI, "demo.c"),
+             f"-I{CAPI}", f"-L{CAPI}", "-lpaddle_tpu_capi",
+             f"-Wl,-rpath,{CAPI}", "-o", demo],
+            capture_output=True)
+        assert cc.returncode == 0, cc.stderr.decode()[-2000:]
+
+        env = dict(os.environ, PYTHONPATH=REPO)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["JAX_PLATFORMS"] = "cpu"
+        run = subprocess.run([demo, d, "5"], env=env, capture_output=True,
+                             timeout=300)
+        out = run.stdout.decode()
+        assert run.returncode == 0, (out, run.stderr.decode()[-2000:])
+        assert "ok rows=5 out_numel=15 ndim=2" in out, out
+        # softmax outputs: rows sum to 1 -> mean = 1/3
+        mean = float(out.strip().split("mean=")[-1])
+        np.testing.assert_allclose(mean, 1.0 / 3.0, atol=1e-5)

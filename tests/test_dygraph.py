@@ -266,3 +266,76 @@ def test_inplace_mutation_does_not_corrupt_earlier_vjp():
         loss = w + x
         loss.backward()
         np.testing.assert_allclose(x.gradient(), [7.0])  # 2*3 + 1
+
+
+def test_lstm_gru_cells_train():
+    """Dygraph LSTMCell/GRUCell: one-step cells unroll over time and
+    train (reference dygraph/nn.py LSTMCell/GRUUnit pattern)."""
+    T, B, D, H = 4, 3, 5, 6
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((T, B, D)).astype(np.float32)
+    yv = rng.standard_normal((B, 1)).astype(np.float32)
+    with fluid.dygraph.guard():
+        lstm = fluid.dygraph.LSTMCell(H, D)
+        gru = fluid.dygraph.GRUCell(H, H)
+        head = fluid.dygraph.Linear(H, 1)
+        params = (list(lstm.parameters()) + list(gru.parameters()) +
+                  list(head.parameters()))
+        opt = fluid.optimizer.AdamOptimizer(0.02, parameter_list=params)
+        losses = []
+        for _ in range(20):
+            h = fluid.dygraph.to_variable(np.zeros((B, H), np.float32))
+            c = fluid.dygraph.to_variable(np.zeros((B, H), np.float32))
+            g = fluid.dygraph.to_variable(np.zeros((B, H), np.float32))
+            for t in range(T):
+                x_t = fluid.dygraph.to_variable(xv[t])
+                h, c = lstm(x_t, h, c)
+                g = gru(h, g)
+            pred = head(g)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(pred - fluid.dygraph.to_variable(yv)))
+            loss.backward()
+            opt.minimize(loss)
+            lstm.clear_gradients(); gru.clear_gradients()
+            head.clear_gradients()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.3 * losses[0], losses[::5]
+
+
+def test_static_lstm_gru_units_in_rnn():
+    """Static lstm_unit/gru_unit inside StaticRNN train end-to-end."""
+    T, B, D, H = 4, 3, 5, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [T, B, D], dtype="float32")
+        y = fluid.layers.data("y", [B, 1], dtype="float32")
+        h0 = fluid.layers.fill_constant([B, H], "float32", 0.0)
+        c0 = fluid.layers.fill_constant([B, H], "float32", 0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            c_prev = rnn.memory(init=c0)
+            h, c = fluid.layers.nn.lstm_unit(x_t, h_prev, c_prev)
+            g = fluid.layers.nn.gru_unit(h, h_prev)
+            rnn.update_memory(h_prev, g)
+            rnn.update_memory(c_prev, c)
+            rnn.step_output(g)
+        seq = rnn()
+        last = fluid.layers.reshape(
+            fluid.layers.slice(seq, axes=[0], starts=[T - 1], ends=[T]),
+            [B, H])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(last, 1), y))
+        fluid.optimizer.AdamOptimizer(0.02).minimize(loss)
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((T, B, D)).astype(np.float32)
+    yv = rng.standard_normal((B, 1)).astype(np.float32)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(25)]
+    assert losses[-1] < 0.3 * losses[0], losses[::8]
