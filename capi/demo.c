@@ -49,9 +49,10 @@ int main(int argc, char** argv) {
     fprintf(stderr, "GetOutput failed: %s\n", PD_GetLastError());
     return 1;
   }
+  long long counted = numel < 4096 ? numel : 4096;
   double mean = 0.0;
-  for (long long i = 0; i < numel && i < 4096; ++i) mean += out[i];
-  mean /= (double)numel;
+  for (long long i = 0; i < counted; ++i) mean += out[i];
+  mean = counted > 0 ? mean / (double)counted : 0.0;
   printf("ok rows=%d out_numel=%lld ndim=%d mean=%.6f\n", rows, numel,
          ndim, mean);
   free(x);

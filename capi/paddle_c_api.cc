@@ -61,20 +61,22 @@ int PD_Init(void) {
     Py_InitializeEx(0);
     we_initialized = true;
   }
+  bool import_ok;
   {
     GIL gil;
     PyObject* mod = PyImport_ImportModule("paddle_tpu");
-    if (mod == nullptr) {
-      set_error_from_python();
-      return 1;
-    }
-    Py_DECREF(mod);
+    import_ok = mod != nullptr;
+    if (!import_ok) set_error_from_python();
+    Py_XDECREF(mod);
   }
   if (we_initialized) {
     // Py_InitializeEx leaves this thread holding the GIL; release it so
-    // other host threads' PyGILState_Ensure calls can acquire it
+    // other host threads' PyGILState_Ensure calls can acquire it —
+    // including after a failed import (the error must stay reportable,
+    // not turn into a cross-thread hang)
     PyEval_SaveThread();
   }
+  if (!import_ok) return 1;
   g_inited = true;
   return 0;
 }
@@ -151,8 +153,13 @@ int set_input(PD_Predictor* p, int i, const void* data, size_t itemsize,
   for (int d = 0; d < ndim; ++d) {
     PyTuple_SetItem(shp, d, PyLong_FromLong(shape[d]));
   }
-  PyObject* arr = flat != nullptr
+  PyObject* view_arr = flat != nullptr
       ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+  // own the data: the memoryview aliases the CALLER's buffer, which may
+  // be freed or reused before PD_PredictorRun
+  PyObject* arr = view_arr != nullptr
+      ? PyObject_CallMethod(view_arr, "copy", nullptr) : nullptr;
+  Py_XDECREF(view_arr);
   Py_XDECREF(shp);
   Py_XDECREF(flat);
   Py_XDECREF(mem);

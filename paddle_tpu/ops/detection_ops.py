@@ -335,9 +335,8 @@ def roi_align(ctx, ins, attrs):
         # reference adaptive default: ceil(roi_size / pooled_size) samples
         # per bin, computed PER ROI. Static shapes need one count; use the
         # worst case over the feature map (full-image ROI)
-        sampling = max(int(np.ceil(H / int(attrs.get("pooled_height", 1)))),
-                       int(np.ceil(W / int(attrs.get("pooled_width", 1)))),
-                       1)
+        sampling = max(int(np.ceil(H / pooled_h)),
+                       int(np.ceil(W / pooled_w)), 1)
         sampling = min(sampling, 8)   # cap the static cost
     R = rois.shape[0]
     if ins.get("RoisBatch"):          # explicit per-ROI image index

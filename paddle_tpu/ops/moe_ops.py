@@ -69,8 +69,9 @@ def switch_moe(ctx, ins, attrs):
     combine = dispatch * gate_val[:, None, None]
     out = jnp.einsum("nec,ecd->nd", combine, expert_out)    # [N, d]
 
-    # GShard load-balance aux loss
+    # GShard/Switch load-balance aux loss: E * sum_e f_e * P_e
+    # (== mean(f*P) * E^2); 1.0 at perfectly uniform routing for any E
     density = jnp.mean(onehot, axis=0)            # fraction routed / expert
     density_proxy = jnp.mean(gates, axis=0)       # mean gate prob / expert
-    aux = jnp.sum(density * density_proxy) * (E * E)
+    aux = jnp.mean(density * density_proxy) * (E * E)
     return {"Out": out, "AuxLoss": aux.reshape(())}

@@ -583,13 +583,11 @@ def lstm_cell_fused(ctx, ins, attrs):
     w = x_of(ins, "W")            # [D+H, 4H]
     b = x_of(ins, "B")            # [4H]
     forget_bias = float(attrs.get("forget_bias", 0.0))
-    H = h_prev.shape[-1]
     gates = jnp.concatenate([x, h_prev], axis=-1) @ w + b
     i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
     c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
         jax.nn.sigmoid(i) * jnp.tanh(c_hat)
     h = jax.nn.sigmoid(o) * jnp.tanh(c)
-    del H
     return {"H": h, "C": c}
 
 

@@ -57,3 +57,47 @@ def test_c_api_end_to_end():
         # softmax outputs: rows sum to 1 -> mean = 1/3
         mean = float(out.strip().split("mean=")[-1])
         np.testing.assert_allclose(mean, 1.0 / 3.0, atol=1e-5)
+
+
+def test_c_api_input_buffer_not_aliased():
+    """The staged input must be COPIED: freeing/reusing the caller buffer
+    after PD_SetInput must not corrupt the run (C API contract)."""
+    import ctypes
+
+    lib = ctypes.CDLL(os.path.join(CAPI, "libpaddle_tpu_capi.so"))
+    lib.PD_NewPredictor.restype = ctypes.c_void_p
+    lib.PD_NewPredictor.argtypes = [ctypes.c_char_p]
+    lib.PD_SetInputFloat.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.c_int]
+    lib.PD_PredictorRun.argtypes = [ctypes.c_void_p]
+    lib.PD_GetOutputFloat.restype = ctypes.c_longlong
+    lib.PD_GetOutputFloat.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_float),
+                                      ctypes.c_longlong,
+                                      ctypes.POINTER(ctypes.c_int),
+                                      ctypes.POINTER(ctypes.c_int)]
+    with tempfile.TemporaryDirectory() as d:
+        _save_model(d)
+        pred = lib.PD_NewPredictor(d.encode())
+        assert pred
+        xv = np.ones((2, 8), np.float32)
+        buf = (ctypes.c_float * 16)(*xv.reshape(-1))
+        shape = (ctypes.c_int * 2)(2, 8)
+        assert lib.PD_SetInputFloat(pred, 0, buf, shape, 2) == 0
+        # clobber the caller buffer BEFORE running — must not matter
+        for i in range(16):
+            buf[i] = float("nan")
+        assert lib.PD_PredictorRun(pred) == 0
+        out = (ctypes.c_float * 64)()
+        oshape = (ctypes.c_int * 8)()
+        ndim = ctypes.c_int()
+        n = lib.PD_GetOutputFloat(pred, 0, out, 64,
+                                  ctypes.cast(oshape,
+                                              ctypes.POINTER(ctypes.c_int)),
+                                  ctypes.byref(ndim))
+        assert n == 6 and ndim.value == 2
+        vals = np.array(out[:6]).reshape(2, 3)
+        assert np.isfinite(vals).all()
+        np.testing.assert_allclose(vals.sum(1), 1.0, atol=1e-5)  # softmax
