@@ -36,6 +36,10 @@ class BertConfig:
     hidden_dropout: float = 0.1
     attn_dropout: float = 0.1
     initializer_range: float = 0.02
+    # None = plain attention; "ring"/"ulysses" = sequence-parallel
+    # attention over the sp mesh axis (ops/ring_attention_ops.py). Both
+    # skip attention dropout (flash-style fused softmax path).
+    attn_mechanism: str = None
 
     @staticmethod
     def base():
@@ -91,13 +95,19 @@ def encoder_layer(cfg, x, attn_bias, idx, is_test):
     k = T.reshape(k, [-1, n_head, seq, d_head])
     v = T.reshape(v, [-1, n_head, seq, d_head])
 
-    scores = layers.matmul(q, k, transpose_y=True,
-                           alpha=1.0 / float(np.sqrt(d_head)))  # [B,nH,S,S]
-    scores = M.elementwise_add(scores, attn_bias)
-    probs = layers.softmax(scores)
-    probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
-                           dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(probs, v)                              # [B,nH,S,dH]
+    if cfg.attn_mechanism:
+        # sequence-parallel attention: K/V ring rotation or Ulysses
+        # all-to-all over "sp"; exact flash-style softmax, no attn dropout
+        ctx = layers.nn.ring_attention(q, k, v, attn_bias=attn_bias,
+                                       mechanism=cfg.attn_mechanism)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True,
+                               alpha=1.0 / float(np.sqrt(d_head)))
+        scores = M.elementwise_add(scores, attn_bias)
+        probs = layers.softmax(scores)
+        probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
+                               dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(probs, v)                          # [B,nH,S,dH]
     ctx = T.transpose(ctx, [0, 2, 1, 3])
     ctx = T.reshape(ctx, [0, 0, h])
     attn_out = _fc(ctx, h, f"{pre}_multi_head_att_output_fc")

@@ -14,4 +14,5 @@ from . import (  # noqa: F401
     quantize_ops,
     detection_ops,
     moe_ops,
+    ring_attention_ops,
 )

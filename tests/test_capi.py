@@ -64,7 +64,12 @@ def test_c_api_input_buffer_not_aliased():
     after PD_SetInput must not corrupt the run (C API contract)."""
     import ctypes
 
-    lib = ctypes.CDLL(os.path.join(CAPI, "libpaddle_tpu_capi.so"))
+    so = os.path.join(CAPI, "libpaddle_tpu_capi.so")
+    if not os.path.exists(so):
+        build = subprocess.run(["sh", os.path.join(CAPI, "build.sh")],
+                               capture_output=True)
+        assert build.returncode == 0, build.stderr.decode()[-2000:]
+    lib = ctypes.CDLL(so)
     lib.PD_NewPredictor.restype = ctypes.c_void_p
     lib.PD_NewPredictor.argtypes = [ctypes.c_char_p]
     lib.PD_SetInputFloat.argtypes = [ctypes.c_void_p, ctypes.c_int,
