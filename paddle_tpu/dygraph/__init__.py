@@ -16,5 +16,5 @@ from .learning_rate_scheduler import (  # noqa: F401
     LinearLrWarmup, ReduceLROnPlateau,
 )
 from .parallel import DataParallel, ParallelStrategy, prepare_context, Env  # noqa: F401
-from .jit import TracedLayer  # noqa: F401
+from .jit import TracedLayer, ProgramTranslator, declarative  # noqa: F401
 from . import jit  # noqa: F401

@@ -137,3 +137,68 @@ class TracedLayer:
             return fluid_io.save_inference_model(
                 dirname, feed_names, fetch_vars, self._exe,
                 main_program=self._program, scope=self._scope)
+
+
+class _FnLayer:
+    """Adapter: a plain function as a traceable 'layer'."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+
+class ProgramTranslator:
+    """Dygraph->static translator singleton (reference
+    dygraph_to_static/program_translator.py:247). This build translates by
+    TRACING (one concrete execution per input signature, like TracedLayer)
+    rather than AST rewriting: Python control flow is baked at trace time —
+    use layers.cond / layers.While in static programs for data-dependent
+    branches."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        self.enable_to_static = bool(enable_to_static)
+
+    def get_output(self, dygraph_func, *args):
+        outs, _ = TracedLayer.trace(_FnLayer(dygraph_func), list(args))
+        return outs
+
+    def get_program(self, dygraph_func, *args):
+        _, traced = TracedLayer.trace(_FnLayer(dygraph_func), list(args))
+        return (traced._program, traced._startup, traced._feed_names,
+                traced._fetch_names)
+
+    def get_func(self, dygraph_func):
+        return declarative(dygraph_func)
+
+
+def declarative(fn):
+    """@declarative (reference dygraph/jit.py): mark a dygraph function as
+    static-exportable. Every call traces eagerly — the outputs stay
+    connected to the autograd tape and captured parameters are read LIVE,
+    so training through a declarative function behaves exactly like the
+    plain eager call (replaying a cached static program would freeze the
+    weights at trace time and detach gradients). The latest traced
+    program is kept on `wrapper.traced_layer` for export
+    (save_inference_model / ProgramTranslator.get_program)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args):
+        from . import base as dy
+        if not ProgramTranslator().enable_to_static or not dy.enabled():
+            return fn(*args)
+        outs, traced = TracedLayer.trace(_FnLayer(fn), list(args))
+        wrapper.traced_layer = traced
+        return outs
+
+    wrapper.traced_layer = None
+    return wrapper

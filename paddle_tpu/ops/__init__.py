@@ -15,4 +15,5 @@ from . import (  # noqa: F401
     detection_ops,
     moe_ops,
     ring_attention_ops,
+    extra_ops,
 )

@@ -1,0 +1,500 @@
+"""Long-tail operator coverage.
+
+Small ops closing the remaining gaps against the reference's operator
+inventory (/root/reference/paddle/fluid/operators/*.cc): v1 alias names for
+already-implemented v2 lowerings, elementwise/loss/vision utilities, CTR
+ops (cvm, data_norm), sampling losses (nce, sample_logits), structured
+losses (warpctc via optax's CTC, linear_chain_crf via a scan over the
+forward algorithm), and the beam-search decode pair (beam_search +
+gather_tree) used by While-loop decoders.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import OPS, register_op
+from .common import bcast_y, x_of
+
+
+def _alias(new, old):
+    """Register a v1 name for an existing lowering."""
+    OPS[new] = OPS[old]
+
+
+_alias("squeeze", "squeeze2")
+_alias("unsqueeze", "unsqueeze2")
+_alias("flatten", "flatten2")
+_alias("expand_as", "expand_as_v2")
+_alias("reverse", "flip")
+_alias("depthwise_conv2d_transpose", "conv2d_transpose")
+
+
+@register_op("minus")
+def minus(ctx, ins, attrs):
+    return {"Out": x_of(ins) - x_of(ins, "Y")}
+
+
+@register_op("cos_sim")
+def cos_sim(ctx, ins, attrs):
+    """reference cos_sim_op.h: row-wise cosine similarity; Y may have one
+    row (broadcast)."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    num = jnp.sum(x * y, axis=-1, keepdims=True)
+    return {"Out": num / jnp.maximum(xn * yn, 1e-12),
+            "XNorm": xn, "YNorm": jnp.broadcast_to(yn, xn.shape)}
+
+
+@register_op("multiplex", grad=None, infer_shape=False)
+def multiplex(ctx, ins, attrs):
+    """Row-wise select among candidate tensors by index
+    (reference multiplex_op.h)."""
+    ids = x_of(ins, "Ids").reshape(-1).astype(jnp.int32)
+    xs = jnp.stack(ins["X"], axis=0)          # [C, B, ...]
+    return {"Out": jnp.take_along_axis(
+        xs, ids[None, :].reshape((1, -1) + (1,) * (xs.ndim - 2)),
+        axis=0)[0]}
+
+
+@register_op("rank_loss")
+def rank_loss(ctx, ins, attrs):
+    """reference rank_loss_op.h: RankNet pairwise loss."""
+    label = x_of(ins, "Label")
+    left = x_of(ins, "Left")
+    right = x_of(ins, "Right")
+    d = left - right
+    return {"Out": jnp.logaddexp(0.0, d) - label * d}
+
+
+@register_op("hinge_loss")
+def hinge_loss(ctx, ins, attrs):
+    """reference hinge_loss_op.h: max(0, 1 - (2y-1) * pred)."""
+    logits = x_of(ins, "Logits")
+    labels = x_of(ins, "Labels")
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)}
+
+
+@register_op("bpr_loss")
+def bpr_loss(ctx, ins, attrs):
+    """Bayesian personalized ranking (reference bpr_loss_op.h)."""
+    x = x_of(ins)                 # [B, C] scores
+    label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = pos - x                                       # [B, C]
+    lse = jnp.log1p(jnp.exp(-diff))
+    C = x.shape[1]
+    mask = jax.nn.one_hot(label, C, dtype=x.dtype)
+    return {"Y": jnp.sum(lse * (1.0 - mask), axis=1,
+                         keepdims=True) / (C - 1)}
+
+
+@register_op("l1_norm")
+def l1_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.abs(x_of(ins))).reshape(())}
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(ctx, ins, attrs):
+    from .common import reduce_axes
+    x = x_of(ins)
+    axes, keep = reduce_axes(attrs, x.ndim)
+    return {"Out": jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=keep))}
+
+
+@register_op("dist")
+def dist(ctx, ins, attrs):
+    """p-norm distance between broadcasted tensors (reference dist_op.h)."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    p = float(attrs.get("p", 2.0))
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        out = jnp.max(d)
+    elif p == 0:
+        out = jnp.sum((d != 0).astype(x.dtype))
+    else:
+        out = jnp.sum(d ** p) ** (1.0 / p)
+    return {"Out": out.reshape(())}
+
+
+@register_op("cross")
+def cross(ctx, ins, attrs):
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    axis = attrs.get("dim", -1)
+    if axis in (-1, None):
+        axis = next(i for i in range(x.ndim) if x.shape[i] == 3)
+    return {"Out": jnp.cross(x, y, axis=axis)}
+
+
+@register_op("index_sample", grad=None, infer_shape=False)
+def index_sample(ctx, ins, attrs):
+    """reference index_sample_op.h: out[b, j] = x[b, index[b, j]]."""
+    x = x_of(ins)
+    idx = x_of(ins, "Index").astype(jnp.int32)
+    return {"Out": jnp.take_along_axis(x, idx, axis=1)}
+
+
+@register_op("unfold")
+def unfold(ctx, ins, attrs):
+    """im2col (reference unfold_op.h): [N,C,H,W] ->
+    [N, C*kh*kw, L] sliding-window columns."""
+    x = x_of(ins)
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    dh, dw = attrs.get("dilations", [1, 1])
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + sh * (oh - 1) + 1:sh,
+                       j * dw:j * dw + sw * (ow - 1) + 1:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)             # [N, C, kh*kw, oh, ow]
+    return {"Y": out.reshape(N, C * kh * kw, oh * ow)}
+
+
+@register_op("space_to_depth")
+def space_to_depth(ctx, ins, attrs):
+    x = x_of(ins)
+    b = int(attrs["blocksize"])
+    N, C, H, W = x.shape
+    out = x.reshape(N, C, H // b, b, W // b, b)
+    out = out.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": out.reshape(N, C * b * b, H // b, W // b)}
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(ctx, ins, attrs):
+    x = x_of(ins)
+    g = int(attrs.get("group", 1))
+    N, C, H, W = x.shape
+    return {"Out": x.reshape(N, g, C // g, H, W).transpose(0, 2, 1, 3, 4)
+            .reshape(N, C, H, W)}
+
+
+@register_op("affine_channel")
+def affine_channel(ctx, ins, attrs):
+    x = x_of(ins)
+    scale = x_of(ins, "Scale")
+    bias = x_of(ins, "Bias")
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("lrn")
+def lrn(ctx, ins, attrs):
+    """Local response norm (reference lrn_op.h), NCHW."""
+    x = x_of(ins)
+    n = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": x / mid ** beta, "MidOut": mid}
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ctx, ins, attrs):
+    x = x_of(ins)                 # target shape donor
+    y = x_of(ins, "Y")            # tensor to pad
+    value = float(attrs.get("pad_value", 0.0))
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=value)}
+
+
+@register_op("unbind", infer_shape=False)
+def unbind(ctx, ins, attrs):
+    x = x_of(ins)
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.squeeze(s, axis=axis)
+                    for s in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("crop_tensor")
+def crop_tensor(ctx, ins, attrs):
+    x = x_of(ins)
+    offsets = attrs.get("offsets", [0] * x.ndim)
+    shape = attrs["shape"]
+    return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+_alias("crop", "crop_tensor")
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(ctx, ins, attrs):
+    x = x_of(ins)
+    index = x_of(ins, "Index").astype(jnp.int32)
+    updates = x_of(ins, "Updates")
+    idx = tuple(index[..., i] for i in range(index.shape[-1]))
+    return {"Out": x.at[idx].add(updates)}
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(ctx, ins, attrs):
+    """reference detection/sigmoid_focal_loss_op.h (per-class focal loss
+    with a background-aware one-hot; labels in [0, C], 0 = background)."""
+    x = x_of(ins)                 # [N, C] logits
+    label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
+    fg_num = jnp.maximum(x_of(ins, "FgNum").reshape(()), 1).astype(x.dtype)
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    C = x.shape[1]
+    target = jax.nn.one_hot(label - 1, C, dtype=x.dtype)  # bg -> all zeros
+    p = jax.nn.sigmoid(x)
+    ce = jnp.logaddexp(0.0, x) - x * target
+    p_t = p * target + (1 - p) * (1 - target)
+    a_t = alpha * target + (1 - alpha) * (1 - target)
+    return {"Out": a_t * ((1 - p_t) ** gamma) * ce / fg_num}
+
+
+@register_op("roi_pool", grad=False, infer_shape=False)
+def roi_pool(ctx, ins, attrs):
+    """Max ROI pooling (reference roi_pool_op.h) — the quantized
+    predecessor of roi_align."""
+    x = x_of(ins)
+    rois = x_of(ins, "ROIs")
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    if ins.get("RoisBatch"):
+        batch_idx = jnp.reshape(ins["RoisBatch"][0],
+                                (-1,)).astype(jnp.int32)
+    elif ins.get("RoisNum"):
+        counts = jnp.reshape(ins["RoisNum"][0], (-1,)).astype(jnp.int32)
+        batch_idx = jnp.searchsorted(jnp.cumsum(counts),
+                                     jnp.arange(R, dtype=jnp.int32),
+                                     side="right").astype(jnp.int32)
+    else:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+
+    def one(roi, bi):
+        x1, y1, x2, y2 = jnp.round(roi * scale).astype(jnp.int32)
+        h = jnp.maximum(y2 - y1 + 1, 1)
+        w = jnp.maximum(x2 - x1 + 1, 1)
+        ys = jnp.arange(H)[None, :]
+        xs = jnp.arange(W)[None, :]
+        out = jnp.full((C, ph, pw), -jnp.inf, x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                y_lo = y1 + (i * h) // ph
+                y_hi = y1 + ((i + 1) * h + ph - 1) // ph
+                x_lo = x1 + (j * w) // pw
+                x_hi = x1 + ((j + 1) * w + pw - 1) // pw
+                my = ((ys >= y_lo) & (ys < jnp.maximum(y_hi, y_lo + 1)))
+                mx = ((xs >= x_lo) & (xs < jnp.maximum(x_hi, x_lo + 1)))
+                m = my.reshape(1, H, 1) & mx.reshape(1, 1, W)
+                cell = jnp.where(m, x[bi], -jnp.inf)
+                out = out.at[:, i, j].set(jnp.max(cell, axis=(1, 2)))
+        return out
+
+    return {"Out": jax.vmap(one)(rois, batch_idx)}
+
+
+@register_op("cvm")
+def cvm(ctx, ins, attrs):
+    """CTR show/click feature op (reference cvm_op.h): with use_cvm keep
+    [log(show+1), log(click+1)-log(show+1)] prepended; else strip them."""
+    x = x_of(ins)                 # [B, D] (first 2 cols = show, click)
+    use_cvm = bool(attrs.get("use_cvm", True))
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return {"Y": jnp.concatenate([show, click, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+@register_op("data_norm")
+def data_norm(ctx, ins, attrs):
+    """Streaming feature normalization for CTR (reference data_norm_op.h):
+    means/scales come from accumulated batch sums, updated functionally."""
+    x = x_of(ins)
+    size = x_of(ins, "BatchSize")
+    bsum = x_of(ins, "BatchSum")
+    sqsum = x_of(ins, "BatchSquareSum")
+    eps = float(attrs.get("epsilon", 1e-4))
+    mean = bsum / jnp.maximum(size, 1.0)
+    var = sqsum / jnp.maximum(size, 1.0) - mean * mean
+    scale = 1.0 / jnp.sqrt(jnp.maximum(var, 0.0) + eps)
+    y = (x - mean) * scale
+    n = jnp.asarray(x.shape[0], x.dtype)
+    return {"Y": y, "Means": jnp.broadcast_to(mean, x.shape[-1:]),
+            "Scales": jnp.broadcast_to(scale, x.shape[-1:]),
+            "BatchSizeOut": size + n,
+            "BatchSumOut": bsum + jnp.sum(x, axis=0),
+            "BatchSquareSumOut": sqsum + jnp.sum(x * x, axis=0)}
+
+
+@register_op("nce", infer_shape=False, needs_rng=True)
+def nce(ctx, ins, attrs):
+    """Noise-contrastive estimation loss (reference nce_op.h) with uniform
+    negative sampling."""
+    x = x_of(ins, "Input")        # [B, D]
+    label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
+    w = x_of(ins, "Weight")       # [V, D]
+    b = ins.get("Bias")
+    b = b[0] if b else None
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    V = w.shape[0]
+    key = ctx.op_key(attrs)
+    B = x.shape[0]
+    neg = jax.random.randint(key, (B, num_neg), 0, V)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+neg]
+    w_s = w[ids]                                          # [B, 1+neg, D]
+    logits = jnp.einsum("bd,bkd->bk", x, w_s)
+    if b is not None:
+        logits = logits + b[ids]
+    # NCE logit correction: s - log(k * q(y)) with uniform q = 1/V
+    logits = logits - np.log(num_neg / V)
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1), x.dtype), jnp.zeros((B, num_neg), x.dtype)],
+        axis=1)
+    loss = jnp.logaddexp(0.0, logits) - logits * labels
+    return {"Cost": jnp.sum(loss, axis=1, keepdims=True),
+            "SampleLogits": logits, "SampleLabels": ids}
+
+
+@register_op("sample_logits", infer_shape=False,
+             needs_rng=True)
+def sample_logits(ctx, ins, attrs):
+    """Sampled-softmax candidate sampling (reference sample_logits_op.h):
+    gather the true-label logits plus uniform negatives."""
+    logits = x_of(ins, "Logits")  # [B, V]
+    labels = x_of(ins, "Labels").astype(jnp.int32)  # [B, T]
+    num_samples = int(attrs.get("num_samples", 10))
+    key = ctx.op_key(attrs)
+    B, V = logits.shape
+    neg = jax.random.randint(key, (B, num_samples), 0, V)
+    ids = jnp.concatenate([labels, neg], axis=1)
+    out = jnp.take_along_axis(logits, ids, axis=1)
+    return {"SampledLogits": out, "Samples": ids,
+            "SampledLabels": jnp.arange(labels.shape[1],
+                                        dtype=jnp.int32)[None, :].repeat(
+                                            B, axis=0)}
+
+
+@register_op("warpctc", grad=None, infer_shape=False)
+def warpctc(ctx, ins, attrs):
+    """CTC loss (reference warpctc_op.h wrapping warp-ctc): here optax's
+    pure-XLA CTC over padded [B, T, V] logits + label/logit lengths."""
+    import optax
+    logits = x_of(ins, "Logits")      # [B, T, V] (batch-major padded)
+    labels = x_of(ins, "Label").astype(jnp.int32)   # [B, L]
+    logit_lens = x_of(ins, "LogitsLength").reshape(-1).astype(jnp.int32)
+    label_lens = x_of(ins, "LabelLength").reshape(-1).astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    T = logits.shape[1]
+    L = labels.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= logit_lens[:, None]).astype(
+        logits.dtype)
+    label_pad = (jnp.arange(L)[None, :] >= label_lens[:, None]).astype(
+        logits.dtype)
+    loss = optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank)
+    return {"Loss": loss.reshape(-1, 1)}
+
+
+@register_op("linear_chain_crf", grad=None, infer_shape=False)
+def linear_chain_crf(ctx, ins, attrs):
+    """Linear-chain CRF negative log-likelihood (reference
+    linear_chain_crf_op.h), batched padded form: Emission [B, T, K],
+    Transition [K+2, K] (row 0 start, row 1 end), Label [B, T],
+    Length [B]. The partition function is a scan over time (the
+    forward algorithm) — XLA-friendly, no per-sequence Python loops."""
+    em = x_of(ins, "Emission")
+    trans = x_of(ins, "Transition")
+    label = x_of(ins, "Label").astype(jnp.int32)
+    lens = x_of(ins, "Length").reshape(-1).astype(jnp.int32)
+    B, T, K = em.shape
+    start, end, w = trans[0], trans[1], trans[2:]     # [K], [K], [K, K]
+
+    # log partition via forward algorithm
+    def step(alpha_t, inputs):
+        e_t, valid_t = inputs                          # [B, K], [B]
+        nxt = jax.nn.logsumexp(
+            alpha_t[:, :, None] + w[None, :, :], axis=1) + e_t
+        return jnp.where(valid_t[:, None], nxt, alpha_t), None
+
+    alpha0 = start[None, :] + em[:, 0]
+    valid = (jnp.arange(1, T)[None, :] < lens[:, None]).T   # [T-1, B]
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (em[:, 1:].transpose(1, 0, 2), valid))
+    log_z = jax.nn.logsumexp(alpha + end[None, :], axis=1)  # [B]
+
+    # gold path score
+    t_idx = jnp.arange(T)
+    emit = jnp.take_along_axis(em, label[..., None], axis=2)[..., 0]
+    emit = jnp.sum(jnp.where(t_idx[None, :] < lens[:, None], emit, 0.0),
+                   axis=1)
+    pair = w[label[:, :-1], label[:, 1:]]                   # [B, T-1]
+    pair = jnp.sum(
+        jnp.where(t_idx[None, 1:] < lens[:, None], pair, 0.0), axis=1)
+    first = start[label[:, 0]]
+    last_idx = jnp.clip(lens - 1, 0, T - 1)
+    last = end[jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]]
+    gold = emit + pair + first + last
+    # reference linear_chain_crf_op.h returns -log_likelihood (a POSITIVE
+    # value callers minimize directly)
+    return {"LogLikelihood": (log_z - gold).reshape(-1, 1)}
+
+
+@register_op("beam_search", grad=False, infer_shape=False)
+def beam_search(ctx, ins, attrs):
+    """One beam-search expansion step (reference beam_search_op.h, padded
+    form): pre_scores [B, beam], scores [B*beam, V] log-probs ->
+    top-beam continuations per batch row. Finished beams (pre_id ==
+    end_id) only propagate themselves."""
+    pre_ids = x_of(ins, "pre_ids").astype(jnp.int32)      # [B, beam]
+    pre_scores = x_of(ins, "pre_scores")                  # [B, beam]
+    scores = x_of(ins, "scores")                          # [B*beam, V]
+    beam = int(attrs["beam_size"])
+    end_id = int(attrs.get("end_id", 0))
+    B = pre_ids.shape[0]
+    V = scores.shape[-1]
+    sc = scores.reshape(B, beam, V)
+    finished = pre_ids == end_id
+    # finished beams: only the end token continues, carrying the score
+    cont = pre_scores[..., None] + sc
+    frozen = jnp.full((B, beam, V), -1e30, sc.dtype)
+    frozen = frozen.at[:, :, end_id].set(pre_scores)
+    total = jnp.where(finished[..., None], frozen, cont)  # [B, beam, V]
+    flat = total.reshape(B, beam * V)
+    top_s, top_i = jax.lax.top_k(flat, beam)
+    parent = top_i // V
+    token = top_i % V
+    return {"selected_ids": token, "selected_scores": top_s,
+            "parent_idx": parent}
+
+
+@register_op("gather_tree", grad=False, infer_shape=False)
+def gather_tree(ctx, ins, attrs):
+    """Back-trace beam parents into full sequences (reference
+    gather_tree_op.h): ids/parents [T, B, beam] -> sequences [T, B,
+    beam]."""
+    ids = x_of(ins, "Ids").astype(jnp.int32)
+    parents = x_of(ins, "Parents").astype(jnp.int32)
+    T = ids.shape[0]
+
+    def step(beam_idx, t):
+        # walking backwards from T-1
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=jnp.int32),
+                            ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return {"Out": toks[::-1]}

@@ -165,14 +165,22 @@ def ulysses_attention(ctx, ins, attrs):
         return {"Out": full_attn(q, k, v, bias)}
 
     qspec = P(None, None, "sp", None)
-    # key-position bias [B,1,1,S]: sharded on keys, all-gathered locally;
-    # full bias [B,H,S,S]: sharded on HEADS — after the all-to-all each
-    # device holds exactly its H/sp heads' mask, no gather needed
+    # bias layouts: [B,1,1,S] key mask -> sharded on keys, gathered
+    # locally; [B,H,S,S] per-head mask -> sharded on HEADS (after the
+    # all-to-all each device holds exactly its H/sp heads' mask);
+    # [B,1,S,S] head-broadcast mask (e.g. causal) -> replicated (its
+    # size-1 head axis cannot shard)
     key_bias = bias is None or (bias.shape[1] == 1 and bias.shape[2] == 1)
+    head_bcast = (bias is not None and bias.shape[1] == 1
+                  and bias.shape[2] > 1)
     if bias is None:
         bias = jnp.zeros((B, 1, 1, S), q.dtype)
-    bspec = P(None, None, None, "sp") if key_bias else \
-        P(None, "sp", None, None)
+    if key_bias:
+        bspec = P(None, None, None, "sp")
+    elif head_bcast:
+        bspec = P(None, None, None, None)
+    else:
+        bspec = P(None, "sp", None, None)
 
     def per_device(q_l, k_l, v_l, bias_l):
         def seq_to_heads(a):      # [B, H, S/sp, D] -> [B, H/sp, S, D]

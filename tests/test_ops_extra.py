@@ -1,0 +1,321 @@
+"""Long-tail op coverage: losses, vision utils, CTR ops, CTC/CRF, beam
+search (reference pattern: per-op unittests, test_warpctc_op.py,
+test_linear_chain_crf_op.py, test_beam_search_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(21)
+
+
+def _t(op_type, inputs, attrs, outputs):
+    t = OpTest.__new__(OpTest)
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
+def test_minus_and_cos_sim():
+    x = RNG.standard_normal((4, 6)).astype(np.float32)
+    y = RNG.standard_normal((4, 6)).astype(np.float32)
+    _t("minus", {"X": x, "Y": ("y", y)}, {},
+       {"Out": x - y}).check_output()
+    xn = np.linalg.norm(x, axis=1, keepdims=True)
+    yn = np.linalg.norm(y, axis=1, keepdims=True)
+    cos = (x * y).sum(1, keepdims=True) / (xn * yn)
+    t = _t("cos_sim", {"X": x, "Y": ("y", y)}, {},
+           {"Out": cos.astype(np.float32), "XNorm": xn.astype(np.float32),
+            "YNorm": yn.astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+def test_rank_hinge_bpr_losses():
+    left = RNG.standard_normal((8, 1)).astype(np.float32)
+    right = RNG.standard_normal((8, 1)).astype(np.float32)
+    label = RNG.integers(0, 2, (8, 1)).astype(np.float32)
+    ref = np.log1p(np.exp(left - right)) - label * (left - right)
+    _t("rank_loss", {"Label": ("label", label), "Left": ("left", left),
+                     "Right": ("right", right)}, {},
+       {"Out": ref.astype(np.float32)}).check_output(atol=1e-5)
+
+    logits = RNG.standard_normal((8, 1)).astype(np.float32)
+    ref = np.maximum(0.0, 1.0 - (2 * label - 1) * logits)
+    _t("hinge_loss", {"Logits": ("logits", logits),
+                      "Labels": ("labels", label)}, {},
+       {"Loss": ref.astype(np.float32)}).check_output(atol=1e-6)
+
+    x = RNG.standard_normal((4, 5)).astype(np.float32)
+    lbl = RNG.integers(0, 5, (4, 1)).astype(np.int64)
+    pos = np.take_along_axis(x, lbl, axis=1)
+    lse = np.log1p(np.exp(-(pos - x)))
+    mask = np.eye(5)[lbl[:, 0]]
+    ref = (lse * (1 - mask)).sum(1, keepdims=True) / 4
+    _t("bpr_loss", {"X": x, "Label": ("label", lbl)}, {},
+       {"Y": ref.astype(np.float32)}).check_output(atol=1e-5)
+
+
+def test_norm_dist_cross_index_sample():
+    x = RNG.standard_normal((3, 4)).astype(np.float32)
+    y = RNG.standard_normal((3, 4)).astype(np.float32)
+    _t("l1_norm", {"X": x}, {},
+       {"Out": np.float32(np.abs(x).sum())}).check_output(atol=1e-5)
+    _t("frobenius_norm", {"X": x}, {"reduce_all": True},
+       {"Out": np.float32(np.sqrt((x * x).sum()))}).check_output(atol=1e-5)
+    _t("dist", {"X": x, "Y": ("y", y)}, {"p": 2.0},
+       {"Out": np.float32(np.linalg.norm(
+           (x - y).reshape(-1)))}).check_output(atol=1e-5)
+    a = RNG.standard_normal((5, 3)).astype(np.float32)
+    b = RNG.standard_normal((5, 3)).astype(np.float32)
+    _t("cross", {"X": a, "Y": ("y", b)}, {"dim": 1},
+       {"Out": np.cross(a, b).astype(np.float32)}).check_output(atol=1e-5)
+    idx = RNG.integers(0, 4, (3, 2)).astype(np.int64)
+    _t("index_sample", {"X": x, "Index": ("idx", idx)}, {},
+       {"Out": np.take_along_axis(x, idx, axis=1)}).check_output()
+
+
+def test_vision_utils():
+    x = RNG.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    # space_to_depth inverse consistency via shape + elements preserved
+    t = _t("space_to_depth", {"X": x}, {"blocksize": 2}, {})
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="x", shape=x.shape, dtype="float32",
+                      is_data=True)
+        out = gb.create_var(name="out", dtype="float32")
+        gb.append_op(type="space_to_depth", inputs={"X": ["x"]},
+                     outputs={"Out": [out]}, attrs={"blocksize": 2},
+                     infer_shape=False)
+        out2 = gb.create_var(name="out2", dtype="float32")
+        gb.append_op(type="shuffle_channel", inputs={"X": ["x"]},
+                     outputs={"Out": [out2]}, attrs={"group": 2},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, o2 = exe.run(main, feed={"x": x}, fetch_list=["out", "out2"])
+    assert np.asarray(o).shape == (2, 16, 2, 2)
+    np.testing.assert_allclose(np.sort(np.asarray(o).ravel()),
+                               np.sort(x.ravel()))
+    assert np.asarray(o2).shape == x.shape
+
+    scale = RNG.standard_normal(4).astype(np.float32)
+    bias = RNG.standard_normal(4).astype(np.float32)
+    ref = x * scale.reshape(1, 4, 1, 1) + bias.reshape(1, 4, 1, 1)
+    t = _t("affine_channel", {"X": x, "Scale": ("scale", scale),
+                              "Bias": ("bias", bias)}, {}, {"Out": ref})
+    t.check_output(atol=1e-6)
+
+    # unfold vs manual 2x2 patches
+    u = _t("unfold", {"X": x}, {"kernel_sizes": [2, 2], "strides": [2, 2]},
+           {})
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        gb = main2.global_block()
+        gb.create_var(name="x", shape=x.shape, dtype="float32",
+                      is_data=True)
+        y = gb.create_var(name="y", dtype="float32")
+        gb.append_op(type="unfold", inputs={"X": ["x"]},
+                     outputs={"Y": [y]},
+                     attrs={"kernel_sizes": [2, 2], "strides": [2, 2]},
+                     infer_shape=False)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        yv, = exe.run(main2, feed={"x": x}, fetch_list=["y"])
+    assert np.asarray(yv).shape == (2, 16, 4)
+
+
+def test_cvm_and_data_norm():
+    x = np.abs(RNG.standard_normal((4, 6))).astype(np.float32)
+    show = np.log(x[:, 0:1] + 1)
+    click = np.log(x[:, 1:2] + 1) - show
+    ref = np.concatenate([show, click, x[:, 2:]], axis=1)
+    _t("cvm", {"X": x}, {"use_cvm": True},
+       {"Y": ref.astype(np.float32)}).check_output(atol=1e-5)
+    _t("cvm", {"X": x}, {"use_cvm": False},
+       {"Y": x[:, 2:]}).check_output()
+
+    size = np.full((6,), 10.0, np.float32)
+    bsum = RNG.standard_normal(6).astype(np.float32) * 10
+    sq = np.abs(RNG.standard_normal(6)).astype(np.float32) * 10 + 20
+    mean = bsum / 10
+    scale = 1.0 / np.sqrt(np.maximum(sq / 10 - mean * mean, 0) + 1e-4)
+    ref = (x - mean) * scale
+    _t("data_norm",
+       {"X": x, "BatchSize": ("bs", size), "BatchSum": ("bsum", bsum),
+        "BatchSquareSum": ("bsq", sq)}, {"epsilon": 1e-4},
+       {"Y": ref.astype(np.float32)}).check_output(
+           atol=1e-4, no_check_set=("Means", "Scales", "BatchSizeOut",
+                                    "BatchSumOut", "BatchSquareSumOut"))
+
+
+def test_warpctc_matches_known_value():
+    """CTC loss on a uniform distribution has a closed-form check: with
+    all-equal logits, loss = -log P(label | uniform paths)."""
+    import paddle_tpu as fluid
+    B, T, V, L = 2, 6, 5, 2
+    logits = np.zeros((B, T, V), np.float32)   # uniform after softmax
+    labels = np.array([[1, 2], [3, 3]], np.int64)
+    llen = np.array([T, T], np.int64)
+    lablen = np.array([2, 2], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("logits", logits), ("label", labels),
+                     ("llen", llen), ("lablen", lablen)):
+            gb.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                          is_data=True)
+        loss = gb.create_var(name="loss", dtype="float32")
+        gb.append_op(type="warpctc",
+                     inputs={"Logits": ["logits"], "Label": ["label"],
+                             "LogitsLength": ["llen"],
+                             "LabelLength": ["lablen"]},
+                     outputs={"Loss": [loss]}, attrs={"blank": 0},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        lv, = exe.run(main, feed={"logits": logits, "label": labels,
+                                  "llen": llen, "lablen": lablen},
+                      fetch_list=["loss"])
+    lv = np.asarray(lv)
+    assert lv.shape == (B, 1) and (lv > 0).all()
+    # distinct labels admit more alignments than a repeated label
+    assert lv[0, 0] < lv[1, 0], lv
+
+
+def test_linear_chain_crf_two_states_exact():
+    """K=2, T=2: enumerate all 4 paths by hand and compare the NLL."""
+    import paddle_tpu as fluid
+    em = RNG.standard_normal((1, 2, 2)).astype(np.float32)
+    trans = RNG.standard_normal((4, 2)).astype(np.float32)
+    label = np.array([[0, 1]], np.int64)
+    lens = np.array([2], np.int64)
+    start, end, w = trans[0], trans[1], trans[2:]
+    scores = np.array([[start[i] + em[0, 0, i] + w[i, j] + em[0, 1, j] +
+                        end[j] for j in range(2)] for i in range(2)])
+    log_z = np.log(np.exp(scores).sum())
+    gold = scores[0, 1]
+    want = log_z - gold     # reference emits the positive NLL
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("em", em), ("trans", trans), ("label", label),
+                     ("lens", lens)):
+            gb.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                          is_data=True)
+        ll = gb.create_var(name="ll", dtype="float32")
+        gb.append_op(type="linear_chain_crf",
+                     inputs={"Emission": ["em"], "Transition": ["trans"],
+                             "Label": ["label"], "Length": ["lens"]},
+                     outputs={"LogLikelihood": [ll]}, attrs={},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"em": em, "trans": trans,
+                                   "label": label, "lens": lens},
+                       fetch_list=["ll"])
+    np.testing.assert_allclose(float(np.asarray(got)[0, 0]), want,
+                               rtol=1e-4)
+
+
+def test_beam_search_and_gather_tree():
+    """One expansion step picks the right continuations; gather_tree
+    back-traces parents into sequences."""
+    import paddle_tpu as fluid
+    B, beam, V = 1, 2, 4
+    pre_ids = np.array([[1, 2]], np.int64)
+    pre_scores = np.array([[0.0, -0.1]], np.float32)
+    scores = np.log(np.array(
+        [[0.1, 0.6, 0.2, 0.1],       # beam 0 prefers token 1
+         [0.1, 0.1, 0.1, 0.7]],      # beam 1 prefers token 3
+        np.float32))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("pids", pre_ids), ("pscores", pre_scores),
+                     ("scores", scores)):
+            gb.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                          is_data=True)
+        sid = gb.create_var(name="sid", dtype="int32")
+        ssc = gb.create_var(name="ssc", dtype="float32")
+        par = gb.create_var(name="par", dtype="int32")
+        gb.append_op(type="beam_search",
+                     inputs={"pre_ids": ["pids"],
+                             "pre_scores": ["pscores"],
+                             "scores": ["scores"]},
+                     outputs={"selected_ids": [sid],
+                              "selected_scores": [ssc],
+                              "parent_idx": [par]},
+                     attrs={"beam_size": beam, "end_id": 0},
+                     infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ids, sc, parent = exe.run(
+            main, feed={"pids": pre_ids, "pscores": pre_scores,
+                        "scores": scores},
+            fetch_list=["sid", "ssc", "par"])
+    ids, parent = np.asarray(ids), np.asarray(parent)
+    # best: beam1+token3 (-0.1+log0.7=-0.457), then beam0+token1 (-0.511)
+    assert ids[0].tolist() == [3, 1], ids
+    assert parent[0].tolist() == [1, 0], parent
+
+    # gather_tree: T=2 chain
+    tids = np.array([[[1, 2]], [[3, 1]]], np.int64)      # [T, B, beam]
+    tpar = np.array([[[0, 0]], [[1, 0]]], np.int64)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        gb = main2.global_block()
+        gb.create_var(name="ids", shape=tids.shape, dtype="int64",
+                      is_data=True)
+        gb.create_var(name="par", shape=tpar.shape, dtype="int64",
+                      is_data=True)
+        o = gb.create_var(name="o", dtype="int32")
+        gb.append_op(type="gather_tree",
+                     inputs={"Ids": ["ids"], "Parents": ["par"]},
+                     outputs={"Out": [o]}, attrs={}, infer_shape=False)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        o, = exe.run(main2, feed={"ids": tids, "par": tpar},
+                     fetch_list=["o"])
+    o = np.asarray(o)
+    # final beam 0 came from parent 1 at t=1: sequence [2, 3]
+    assert o[:, 0, 0].tolist() == [2, 3], o
+    # final beam 1 came from parent 0: sequence [1, 1]
+    assert o[:, 0, 1].tolist() == [1, 1], o
+
+
+def test_nce_and_sample_logits_shapes():
+    import paddle_tpu as fluid
+    B, D, V = 4, 8, 20
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    label = RNG.integers(0, V, (B, 1)).astype(np.int64)
+    w = RNG.standard_normal((V, D)).astype(np.float32) * 0.2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("x", x), ("label", label), ("w", w)):
+            gb.create_var(name=n, shape=a.shape, dtype=str(a.dtype),
+                          is_data=True)
+        cost = gb.create_var(name="cost", dtype="float32")
+        sl = gb.create_var(name="sl", dtype="float32")
+        ss = gb.create_var(name="ss", dtype="int32")
+        gb.append_op(type="nce",
+                     inputs={"Input": ["x"], "Label": ["label"],
+                             "Weight": ["w"]},
+                     outputs={"Cost": [cost], "SampleLogits": [sl],
+                              "SampleLabels": [ss]},
+                     attrs={"num_neg_samples": 5}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        c, = exe.run(main, feed={"x": x, "label": label, "w": w},
+                     fetch_list=["cost"])
+    c = np.asarray(c)
+    assert c.shape == (B, 1) and (c > 0).all()

@@ -197,3 +197,36 @@ def test_full_bias_sharded_parity_and_divisibility_errors():
     mesh3 = make_mesh(MeshConfig(sp=8))  # S=16 ok, H=4 not divisible by 8
     with pytest.raises(Exception, match="divisible"):
         build_run("ulysses", mesh3)
+
+
+def test_head_broadcast_causal_mask_both_mechanisms():
+    """[B, 1, S, S] causal mask (broadcast over heads) under sp sharding."""
+    causal = np.triu(np.full((S, S), -1e30, np.float32), k=1)[None, None]
+    mesh = make_mesh(MeshConfig(sp=4))
+
+    def run(mech, use_mesh):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = layers.data("q", [B, H, S, D], dtype="float32")
+            k = layers.data("k", [B, H, S, D], dtype="float32")
+            v = layers.data("v", [B, H, S, D], dtype="float32")
+            bias = layers.data("cb", [B, 1, S, S], dtype="float32")
+            out = layers.nn.ring_attention(q, k, v, attn_bias=bias,
+                                           mechanism=mech)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            prog = main if not use_mesh else \
+                fluid.CompiledProgram(main).with_data_parallel(mesh=mesh)
+            f = _feed(False)
+            f["cb"] = np.broadcast_to(causal, (B, 1, S, S)).copy()
+            o, = exe.run(prog, feed=f, fetch_list=[out])
+        return np.asarray(o)
+
+    f = _feed(False)
+    ref = _naive_ref(f["q"], f["k"], f["v"], causal)
+    for mech in ("ring", "ulysses"):
+        np.testing.assert_allclose(run(mech, False), ref, rtol=2e-5,
+                                   atol=1e-5, err_msg=mech)
+        np.testing.assert_allclose(run(mech, True), ref, rtol=3e-4,
+                                   atol=1e-5, err_msg=f"{mech} sharded")
