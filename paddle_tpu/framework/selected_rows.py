@@ -1,0 +1,72 @@
+"""SelectedRows: sparse row-set gradients.
+
+Capability parity with the reference's SelectedRows
+(/root/reference/paddle/fluid/framework/selected_rows.h:32): the sparse
+(id -> row) tensor used for embedding gradients so a [vocab, dim] dense
+grad never materializes (lookup_table_op.h emits SelectedRows when
+is_sparse=True; optimizer kernels have *_sparse variants over it).
+
+TPU-first mapping: a SelectedRows value is a host-side pytree
+`SelectedRows(rows=int32[N], values=f32[N, ...])` flowing through the SAME
+functional env slots as dense arrays — XLA traces it as two arrays.
+Gradient accumulation concatenates (duplicate ids are fine: scatter-adds
+coalesce them), and optimizer lowerings apply row-wise updates via
+`.at[rows].add` (a fused TPU scatter) instead of a dense [vocab, dim] op.
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows(NamedTuple):
+    rows: jax.Array       # int32 [N] row ids (duplicates allowed)
+    values: jax.Array     # [N, ...] per-row gradient values
+
+    @property
+    def dtype(self):      # duck-type as an array where it matters
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+
+# NamedTuples are pytrees automatically — SelectedRows nests transparently
+# into jit arguments/results.
+
+
+def is_selected_rows(v):
+    return isinstance(v, SelectedRows)
+
+
+def merge(grads):
+    """Accumulate partial sparse grads: concat rows/values (scatter-adds
+    coalesce duplicates at apply time) — reference
+    merge_selected_rows_op semantics without the sort."""
+    rows = jnp.concatenate([g.rows for g in grads])
+    values = jnp.concatenate([g.values for g in grads])
+    return SelectedRows(rows, values)
+
+
+def to_dense(sr, dense_shape, dtype=None):
+    """Materialize (for parity checks / fallbacks)."""
+    out = jnp.zeros(dense_shape, dtype or sr.values.dtype)
+    return out.at[sr.rows].add(sr.values)
+
+
+def coalesce(sr):
+    """Merge duplicate row ids so each unique row appears once (the
+    reference's scatter::MergeAdd before sparse optimizer updates).
+    Static-shape form: values of later duplicates fold into the FIRST
+    occurrence's slot; duplicate slots get an out-of-range row id so
+    .at[rows] scatters with mode='drop' skip them (N stays fixed)."""
+    rows = sr.rows
+    n = rows.shape[0]
+    eq = rows[None, :] == rows[:, None]               # [N, N]
+    first = jnp.argmax(eq, axis=1).astype(jnp.int32)  # first occurrence idx
+    merged = jnp.zeros_like(sr.values).at[first].add(sr.values)
+    is_first = jnp.arange(n, dtype=jnp.int32) == first
+    big = jnp.asarray(2_147_483_647, rows.dtype)      # dropped by scatters
+    rows_eff = jnp.where(is_first, rows, big)
+    return SelectedRows(rows_eff, merged)

@@ -36,6 +36,14 @@ def send_op(ctx, ins, attrs):
     xs = list(ins.get("X", []))
 
     tid = attrs.get("trainer_id")
+    from ..framework.selected_rows import is_selected_rows
+    for v in xs:
+        if is_selected_rows(v):
+            raise ValueError(
+                "send op got a SelectedRows grad — PS mode sends dense "
+                "whole-param grads (the transpiler forces is_sparse=False "
+                "on trainer-side lookups); sparse tables go through "
+                "distributed_embedding/push_sparse instead")
 
     def do_send(*vals):
         cli = _client(attrs)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import OPS, register_op
-from .common import bcast_y, x_of
+from .common import x_of
 
 
 def _alias(new, old):
@@ -83,7 +83,7 @@ def bpr_loss(ctx, ins, attrs):
     label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
     pos = jnp.take_along_axis(x, label[:, None], axis=1)
     diff = pos - x                                       # [B, C]
-    lse = jnp.log1p(jnp.exp(-diff))
+    lse = jnp.logaddexp(0.0, -diff)   # stable for large gaps
     C = x.shape[1]
     mask = jax.nn.one_hot(label, C, dtype=x.dtype)
     return {"Y": jnp.sum(lse * (1.0 - mask), axis=1,
@@ -144,12 +144,16 @@ def unfold(ctx, ins, attrs):
     x = x_of(ins)
     kh, kw = attrs["kernel_sizes"]
     sh, sw = attrs.get("strides", [1, 1])
-    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    pads = list(attrs.get("paddings", [0, 0]))
+    if len(pads) == 2:          # symmetric [ph, pw]
+        pt, pl, pb, pr = pads[0], pads[1], pads[0], pads[1]
+    else:                       # reference order [top, left, bottom, right]
+        pt, pl, pb, pr = pads
     dh, dw = attrs.get("dilations", [1, 1])
     N, C, H, W = x.shape
-    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    oh = (H + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + pl + pr - dw * (kw - 1) - 1) // sw + 1
     cols = []
     for i in range(kh):
         for j in range(kw):

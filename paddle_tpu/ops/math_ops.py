@@ -39,6 +39,19 @@ _ew("elementwise_floordiv", jnp.floor_divide, grad=False)
 @register_op("sum")
 def sum_op(ctx, ins, attrs):
     xs = ins["X"]
+    from ..framework.selected_rows import (is_selected_rows, merge,
+                                           to_dense)
+    if any(is_selected_rows(x) for x in xs):
+        if all(is_selected_rows(x) for x in xs):
+            # sparse + sparse: keep sparse (reference sum_op SelectedRows
+            # branch); duplicates coalesce at apply time
+            return {"Out": merge(xs)}
+        dense_shape = next(x.shape for x in xs if not is_selected_rows(x))
+        out = None
+        for x in xs:
+            d = to_dense(x, dense_shape) if is_selected_rows(x) else x
+            out = d if out is None else out + d
+        return {"Out": out}
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.registry import register_op
+from ..framework.registry import register_grad_lower, register_op
 from ..framework.dtype import np_dtype
 from .common import x_of, normalize_padding
 
@@ -611,3 +611,35 @@ def gru_cell_fused(ctx, ins, attrs):
     else:
         h = u * cand + (1.0 - u) * h_prev
     return {"H": h}
+
+
+def _sparse_lookup_grad(ctx, ins, attrs):
+    """Custom backward for lookup_table(_v2) honoring is_sparse: the W
+    gradient is a SelectedRows (ids, rows) pair instead of a dense
+    [vocab, dim] scatter (reference lookup_table_op.h emits SelectedRows
+    when is_sparse=True; framework/selected_rows.py)."""
+    from ..framework.selected_rows import SelectedRows
+
+    fwd = attrs["__fwd_op__"]
+    fattrs = fwd["attrs"]
+    w = x_of(ins, "W")
+    ids = x_of(ins, "Ids")
+    g = x_of(ins, "Out@GRAD")
+    if ids.ndim >= 2 and ids.shape[-1] == 1 and g.ndim == ids.ndim:
+        ids = ids[..., 0]
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    flat_g = g.reshape(-1, w.shape[-1])
+    padding_idx = fattrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        flat_g = jnp.where((flat_ids != padding_idx)[:, None], flat_g, 0.0)
+    if fattrs.get("is_sparse", False):
+        wgrad = SelectedRows(flat_ids, flat_g)
+    else:
+        wgrad = jnp.zeros_like(w).at[flat_ids].add(
+            flat_g.astype(w.dtype))
+    return {"W@GRAD": [wgrad]}
+
+
+register_grad_lower("lookup_table")(_sparse_lookup_grad)
+register_grad_lower("lookup_table_v2")(_sparse_lookup_grad)
+register_grad_lower("embedding")(_sparse_lookup_grad)

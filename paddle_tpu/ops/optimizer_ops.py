@@ -23,6 +23,13 @@ def _p(ins):
 @register_op("sgd", grad=False)
 def sgd(ctx, ins, attrs):
     p, g, lr = _p(ins)
+    from ..framework.selected_rows import is_selected_rows
+    if is_selected_rows(g):
+        # sparse row update (reference sgd_op.h SelectedRows kernel):
+        # only touched embedding rows move; duplicates coalesce in the
+        # scatter-add
+        return {"ParamOut": p.at[g.rows].add(
+            -lr.astype(p.dtype) * g.values.astype(p.dtype))}
     return {"ParamOut": (p - lr.astype(p.dtype) * g.astype(p.dtype))}
 
 
@@ -32,6 +39,12 @@ def momentum(ctx, ins, attrs):
     v = x_of(ins, "Velocity")
     mu = attrs.get("mu", 0.9)
     lr = lr.astype(p.dtype)
+    from ..framework.selected_rows import is_selected_rows, to_dense
+    if is_selected_rows(g):
+        # momentum needs the dense velocity decay anyway (v = mu*v + g):
+        # densify the sparse grad (reference momentum SelectedRows kernel
+        # does the same math)
+        g = to_dense(g, p.shape, p.dtype)
     g = g.astype(p.dtype)
     v_new = mu * v + g
     if attrs.get("use_nesterov", False):
@@ -70,6 +83,32 @@ def adam(ctx, ins, attrs):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    from ..framework.selected_rows import is_selected_rows
+    if is_selected_rows(g) and attrs.get("lazy_mode", False):
+        # lazy sparse adam (reference adam_op.h lazy_mode): moments and
+        # params update ONLY on touched rows. Duplicate ids must merge
+        # FIRST (reference MergeAdd) — a per-occurrence read-modify-write
+        # would double-apply against stale moments.
+        from ..framework.selected_rows import coalesce
+        g = coalesce(g)
+        rows = g.rows
+        gv = g.values.astype(p.dtype)
+        m1r = b1 * m1[rows] + (1 - b1) * gv
+        m2r = b2 * m2[rows] + (1 - b2) * jnp.square(gv)
+        # beta-pow accumulators may be param-shaped; they are uniform, so
+        # a scalar view broadcasts correctly against the row slice
+        b1p_s = jnp.reshape(b1p, (-1,))[0].astype(p.dtype)
+        b2p_s = jnp.reshape(b2p, (-1,))[0].astype(p.dtype)
+        lr_t = jnp.reshape(lr, (-1,))[0].astype(p.dtype) * \
+            jnp.sqrt(1 - b2p_s) / (1 - b1p_s)
+        upd = lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        return {"ParamOut": p.at[rows].add(-upd, mode="drop"),
+                "Moment1Out": m1.at[rows].set(m1r, mode="drop"),
+                "Moment2Out": m2.at[rows].set(m2r, mode="drop"),
+                "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+    if is_selected_rows(g):
+        from ..framework.selected_rows import to_dense
+        g = to_dense(g, p.shape, p.dtype)
     g = g.astype(p.dtype)
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * jnp.square(g)

@@ -295,6 +295,7 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -321,7 +322,9 @@ class AdamOptimizer(Optimizer):
                      "Moment2Out": [m2], "Beta1PowOut": [b1p],
                      "Beta2PowOut": [b2p]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon, **self._extra_attrs()},
+                   "epsilon": self._epsilon,
+                   "lazy_mode": getattr(self, "_lazy_mode", False),
+                   **self._extra_attrs()},
             infer_shape=False)
 
     def _extra_attrs(self):

@@ -186,6 +186,21 @@ class DistributeTranspiler:
         keep = [op for op in block.ops
                 if (op.attrs.get(OP_ROLE_KEY, 0) & 0xFF) != OpRole.Optimize]
         block.ops = keep
+        # PS mode ships WHOLE-param grads over the wire, so embedding grads
+        # must be dense here (is_sparse SelectedRows pairs are for local /
+        # collective training; the pserver-side sparse path is
+        # distributed_embedding + push_sparse, parameter_prefetch.cc style)
+        lookups = ("lookup_table", "lookup_table_v2", "embedding")
+        for op in block.ops:
+            if op.type in lookups and op.attrs.get("is_sparse"):
+                op.attrs = dict(op.attrs, is_sparse=False)
+            elif op.type in tuple(t + "_grad" for t in lookups):
+                # the grad op replays the forward spec baked in __fwd_op__
+                fwd = op.attrs.get("__fwd_op__")
+                if fwd and fwd.get("attrs", {}).get("is_sparse"):
+                    fwd = dict(fwd, attrs=dict(fwd["attrs"],
+                                               is_sparse=False))
+                    op.attrs = dict(op.attrs, __fwd_op__=fwd)
 
         params, grads, eps = [], [], []
         shapes, dtypes = [], []

@@ -51,6 +51,26 @@ def build_mlp(lr=0.1):
     return loss
 
 
+def build_widedeep(lr=0.05):
+    """Small Wide&Deep (BASELINE config 4) — the PS-mode capability class
+    model, built with deterministic init for cross-process parity."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import widedeep
+
+    out = widedeep.wide_deep(dense_dim=4, num_slots=6, vocab_size=100,
+                             embed_dim=8, hidden_sizes=(32, 32),
+                             batch_size=16)
+    fluid.optimizer.SGD(lr).minimize(out["loss"])
+    return out["loss"]
+
+
+def widedeep_batch(trainer_id, step):
+    from paddle_tpu.models import widedeep
+    rng = np.random.default_rng(300 + trainer_id * 1000 + step)
+    return widedeep.random_batch(16, dense_dim=4, num_slots=6,
+                                 vocab_size=100, rng=rng)
+
+
 def batch(trainer_id, step, n=8):
     rng = np.random.default_rng(100 + trainer_id * 1000 + step)
     x = rng.standard_normal((n, 8)).astype(np.float32)
@@ -63,7 +83,12 @@ def run_pserver(args):
     import paddle_tpu as fluid
 
     with fluid.program_guard(fluid.Program(), fluid.Program()):
-        build_mlp(lr=args["lr"])
+        prog = fluid.default_main_program()
+        prog.random_seed = fluid.default_startup_program().random_seed = 42
+        if args.get("model") == "widedeep":
+            build_widedeep(lr=args["lr"])
+        else:
+            build_mlp(lr=args["lr"])
         t = fluid.DistributeTranspiler()
         t.transpile(trainer_id=0, pservers=args["pservers"],
                     trainers=args["trainers"],
@@ -76,7 +101,7 @@ def run_pserver(args):
             exe.run(pserver_startup)
             exe.run(pserver_prog)      # blocks until trainers send stop
             final = {n: np.asarray(scope.find_var(n)).tolist()
-                     for n in ("w1", "w2", "b1", "b2")
+                     for n in ("w1", "w2", "b1", "b2", "wide_fc.w")
                      if scope.find_var(n) is not None}
     with open(args["out"], "w") as f:
         json.dump({"final_params": final}, f)
@@ -87,7 +112,12 @@ def run_trainer(args):
     import paddle_tpu as fluid
 
     with fluid.program_guard(fluid.Program(), fluid.Program()):
-        loss = build_mlp(lr=args["lr"])
+        prog = fluid.default_main_program()
+        prog.random_seed = fluid.default_startup_program().random_seed = 42
+        if args.get("model") == "widedeep":
+            loss = build_widedeep(lr=args["lr"])
+        else:
+            loss = build_mlp(lr=args["lr"])
         t = fluid.DistributeTranspiler()
         t.transpile(trainer_id=args["trainer_id"],
                     pservers=args["pservers"], trainers=args["trainers"],
@@ -99,8 +129,10 @@ def run_trainer(args):
         with fluid.scope_guard(scope):
             exe.run(fluid.default_startup_program())
             for step in range(args["steps"]):
-                feed = batch(args["trainer_id"] if args["diverse_data"]
-                             else 0, step)
+                tid = args["trainer_id"] if args["diverse_data"] else 0
+                feed = (widedeep_batch(tid, step)
+                        if args.get("model") == "widedeep"
+                        else batch(tid, step))
                 l, = exe.run(trainer_prog, feed=feed, fetch_list=[loss])
                 losses.append(float(l))
         from paddle_tpu.distributed.ps import PSClient
@@ -117,15 +149,23 @@ def run_local(args):
     import paddle_tpu as fluid
 
     with fluid.program_guard(fluid.Program(), fluid.Program()):
-        loss = build_mlp(lr=args["lr"])
+        prog = fluid.default_main_program()
+        prog.random_seed = fluid.default_startup_program().random_seed = 42
+        if args.get("model") == "widedeep":
+            loss = build_widedeep(lr=args["lr"])
+        else:
+            loss = build_mlp(lr=args["lr"])
         exe = fluid.Executor()
         scope = fluid.Scope()
         losses = []
         with fluid.scope_guard(scope):
             exe.run(fluid.default_startup_program())
             for step in range(args["steps"]):
+                feed = (widedeep_batch(0, step)
+                        if args.get("model") == "widedeep"
+                        else batch(0, step))
                 l, = exe.run(fluid.default_main_program(),
-                             feed=batch(0, step), fetch_list=[loss])
+                             feed=feed, fetch_list=[loss])
                 losses.append(float(l))
     with open(args["out"], "w") as f:
         json.dump({"losses": losses}, f)

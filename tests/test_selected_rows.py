@@ -1,0 +1,155 @@
+"""SelectedRows sparse embedding gradients: is_sparse=True must train
+identically to the dense path while never materializing a [vocab, dim]
+gradient (reference pattern: test_lookup_table_op.py sparse grad checks +
+sgd/adam SelectedRows kernels)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+V, D, B = 100, 8, 16
+
+
+def _build(is_sparse, opt_factory, seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B, 1], dtype="int64")
+        y = layers.data("y", [B, 1], dtype="float32")
+        emb = layers.embedding(
+            ids, size=[V, D], is_sparse=is_sparse,
+            param_attr=fluid.ParamAttr(name="sr_emb"))
+        pred = layers.fc(layers.reshape(emb, [-1, D]), 1,
+                         param_attr=fluid.ParamAttr(name="sr_fc.w"),
+                         bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss)
+    return main, startup, loss
+
+
+def _train(is_sparse, opt_factory, steps=6):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (B, 1)).astype(np.int64)
+    yv = (ids / V - 0.5).astype(np.float32)
+    main, startup, loss = _build(is_sparse, opt_factory)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"ids": ids, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(steps)]
+        emb_final = np.asarray(scope.find_var("sr_emb")).copy()
+    return losses, emb_final, np.unique(ids)
+
+
+def test_sparse_sgd_matches_dense():
+    dl, de, touched = _train(False, lambda: fluid.optimizer.SGD(0.5))
+    sl, se, _ = _train(True, lambda: fluid.optimizer.SGD(0.5))
+    np.testing.assert_allclose(sl, dl, rtol=1e-5)
+    np.testing.assert_allclose(se, de, rtol=1e-5, atol=1e-7)
+    assert sl[-1] < sl[0]
+
+
+def test_sparse_momentum_matches_dense():
+    mk = lambda: fluid.optimizer.MomentumOptimizer(0.2, momentum=0.9)
+    dl, de, _ = _train(False, mk)
+    sl, se, _ = _train(True, mk)
+    np.testing.assert_allclose(sl, dl, rtol=1e-5)
+    np.testing.assert_allclose(se, de, rtol=1e-5, atol=1e-7)
+
+
+def test_sparse_grad_is_not_densified():
+    """The W gradient value flowing through the env must be the
+    (rows, values) pair, not a [V, D] dense array."""
+    from paddle_tpu.framework.lowering import LowerCtx, run_ops
+    from paddle_tpu.framework.selected_rows import is_selected_rows
+    import jax
+
+    main, startup, loss = _build(True, lambda: fluid.optimizer.SGD(0.1))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.default_rng(1)
+    feed = {"ids": rng.integers(0, V, (B, 1)).astype(np.int64),
+            "y": rng.standard_normal((B, 1)).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        env = {k: v for k, v in scope.items() if not k.startswith("@")}
+        env.update({k: np.asarray(v) for k, v in feed.items()})
+        ctx = LowerCtx(main, main.global_block(), env,
+                       jax.random.PRNGKey(0))
+        run_ops(ctx)
+    gname = "sr_emb@GRAD"
+    assert gname in env, sorted(k for k in env if "GRAD" in k)[:5]
+    assert is_selected_rows(env[gname]), type(env[gname])
+    assert env[gname].values.shape == (B, D)       # B rows, not V
+
+
+def test_lazy_adam_touches_only_seen_rows():
+    """adam with lazy_mode: moments of untouched rows stay zero."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [B, 1], dtype="int64")
+        y = layers.data("y", [B, 1], dtype="float32")
+        emb = layers.embedding(ids, size=[V, D], is_sparse=True,
+                               param_attr=fluid.ParamAttr(name="la_emb"))
+        pred = layers.reduce_sum(layers.reshape(emb, [-1, D]), dim=1,
+                                 keep_dim=True)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.AdamOptimizer(0.1, lazy_mode=True)
+        opt.minimize(loss)
+    rng = np.random.default_rng(3)
+    ids_v = rng.integers(0, 10, (B, 1)).astype(np.int64)  # rows 0..9 only
+    yv = rng.standard_normal((B, 1)).astype(np.float32)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        emb0 = np.asarray(scope.find_var("la_emb")).copy()
+        for _ in range(3):
+            exe.run(main, feed={"ids": ids_v, "y": yv}, fetch_list=[loss])
+        emb1 = np.asarray(scope.find_var("la_emb"))
+        m1 = next(np.asarray(scope.find_var(n))
+                  for n in scope.keys() if n.startswith("la_emb_moment1"))
+    # untouched rows: params unchanged AND moments still exactly zero
+    np.testing.assert_array_equal(emb1[10:], emb0[10:])
+    assert np.all(m1[10:] == 0.0)
+    assert np.any(m1[:10] != 0.0)
+
+
+def test_lazy_adam_duplicate_ids_match_dense_adam():
+    """Duplicate ids in one batch: lazy adam must equal dense adam
+    (requires MergeAdd-style coalescing, not per-occurrence updates)."""
+    def build(lazy, sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", [8, 1], dtype="int64")
+            y = layers.data("y", [8, 1], dtype="float32")
+            emb = layers.embedding(
+                ids, size=[20, 4], is_sparse=sparse,
+                param_attr=fluid.ParamAttr(name="dup_emb"))
+            pred = layers.reduce_sum(layers.reshape(emb, [-1, 4]),
+                                     dim=1, keep_dim=True)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.AdamOptimizer(0.1,
+                                          lazy_mode=lazy).minimize(loss)
+        return main, startup, loss
+
+    ids_v = np.array([[3], [3], [3], [5], [5], [7], [7], [7]], np.int64)
+    yv = np.linspace(-1, 1, 8, dtype=np.float32).reshape(8, 1)
+    results = []
+    for lazy, sparse in ((False, False), (True, True)):
+        main, startup, loss = build(lazy, sparse)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(4):
+                exe.run(main, feed={"ids": ids_v, "y": yv},
+                        fetch_list=[loss])
+            results.append(np.asarray(scope.find_var("dup_emb")).copy())
+    # touched rows must match dense adam exactly
+    np.testing.assert_allclose(results[1][[3, 5, 7]],
+                               results[0][[3, 5, 7]], rtol=1e-5, atol=1e-7)

@@ -161,3 +161,33 @@ def test_geo_sgd_and_sparse_table():
         after = np.asarray(cli.pull_sparse(ep, "emb_table", untouched))
         np.testing.assert_array_equal(after, before)
     cli.stop_servers([ep])
+
+
+def test_widedeep_through_transpiler_sync_and_async():
+    """The BASELINE config-4 'Done' criterion: Wide&Deep trains through
+    the DistributeTranspiler API in BOTH modes with localhost subprocess
+    clusters — sync matches the local run; async converges."""
+    base = {"steps": 5, "lr": 0.05, "diverse_data": False,
+            "model": "widedeep"}
+    local_proc, local_out = _spawn("local", base)
+    local = _wait(local_proc, local_out)
+
+    ep = f"127.0.0.1:{_free_port()}"
+    cluster = dict(base, pservers=ep, endpoint=ep, trainers=1,
+                   sync_mode=True)
+    ps_proc, ps_out = _spawn("pserver", cluster)
+    tr_proc, tr_out = _spawn("trainer", dict(cluster, trainer_id=0))
+    dist = _wait(tr_proc, tr_out)
+    ps_res = _wait(ps_proc, ps_out)
+    np.testing.assert_allclose(dist["losses"], local["losses"],
+                               rtol=5e-4, atol=1e-5)
+    assert "wide_fc.w" in ps_res["final_params"]
+
+    ep2 = f"127.0.0.1:{_free_port()}"
+    cluster2 = dict(base, pservers=ep2, endpoint=ep2, trainers=1,
+                    sync_mode=False, steps=8)
+    ps2, ps2_out = _spawn("pserver", cluster2)
+    tr2, tr2_out = _spawn("trainer", dict(cluster2, trainer_id=0))
+    dist2 = _wait(tr2, tr2_out)
+    _wait(ps2, ps2_out)
+    assert dist2["losses"][-1] < dist2["losses"][0], dist2["losses"]
