@@ -14,7 +14,7 @@ from .framework.core import (  # noqa: F401
     grad_var_name,
 )
 from .framework.executor import (  # noqa: F401
-    Executor, Scope, global_scope, scope_guard,
+    Executor, FetchHandler, Scope, global_scope, scope_guard,
 )
 from .framework.backward import append_backward, gradients  # noqa: F401
 from .framework import initializer  # noqa: F401

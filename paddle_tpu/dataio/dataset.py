@@ -122,10 +122,66 @@ class InMemoryDataset(DatasetBase):
         random.Random(self._seed).shuffle(self._samples)
         self._seed += 1
 
-    def global_shuffle(self, fleet=None, thread_num=None):
-        # every process holds its own filelist shard; a seeded local
-        # shuffle of disjoint shards is a valid global permutation
-        self.local_shuffle()
+    def global_shuffle(self, fleet=None, thread_num=None, spool_dir=None):
+        """Cross-process sample redistribution (reference GlobalShuffle,
+        data_set.h:110, shuffles over an RPC ring). With `spool_dir` (a
+        shared filesystem path) samples really MOVE between processes:
+        each worker spools its samples into per-destination files keyed by
+        a seeded hash, barriers on marker files, then loads its own
+        bucket. Without spool_dir (or single-process), a seeded local
+        shuffle of the disjoint filelist shards is the fallback — a valid
+        global permutation of assignments in which samples never cross
+        processes."""
+        import jax
+
+        n, idx = jax.process_count(), jax.process_index()
+        if spool_dir is None or n <= 1:
+            self.local_shuffle()
+            return
+        import os
+        import pickle
+        import time
+
+        os.makedirs(spool_dir, exist_ok=True)
+        # round-stamped filenames: repeated shuffles into the same spool
+        # dir must not race against the previous round's markers/shards
+        r = getattr(self, "_shuffle_round", 0)
+        rng = random.Random(self._seed)
+        buckets = [[] for _ in range(n)]
+        for s in self._samples:
+            buckets[rng.randrange(n)].append(s)
+        for dst, bucket in enumerate(buckets):
+            with open(os.path.join(
+                    spool_dir, f"r{r}_shard_{idx}_to_{dst}.pkl"),
+                    "wb") as f:
+                pickle.dump(bucket, f)
+        open(os.path.join(spool_dir, f"r{r}_done_{idx}"), "w").close()
+        deadline = time.monotonic() + 300
+        while any(not os.path.exists(
+                os.path.join(spool_dir, f"r{r}_done_{i}"))
+                for i in range(n)):
+            if time.monotonic() > deadline:
+                raise TimeoutError("global_shuffle: peers never spooled")
+            time.sleep(0.05)
+        merged = []
+        for src in range(n):
+            with open(os.path.join(
+                    spool_dir, f"r{r}_shard_{src}_to_{idx}.pkl"),
+                    "rb") as f:
+                merged.extend(pickle.load(f))
+        random.Random(self._seed + idx + 1).shuffle(merged)
+        self._samples = merged
+        self._seed += 1
+        self._shuffle_round = r + 1
+        # best-effort cleanup of the PREVIOUS round's files this process
+        # owns (every peer has passed that barrier by now)
+        if r > 0:
+            for dst in range(n):
+                try:
+                    os.remove(os.path.join(
+                        spool_dir, f"r{r-1}_shard_{idx}_to_{dst}.pkl"))
+                except OSError:
+                    pass
 
     def release_memory(self):
         self._samples = []

@@ -132,6 +132,8 @@ class ParameterServer:
         self.lr_map = {}          # param name -> {lr var name: value}
         self.sparse_lr = {}       # sparse table name -> lr
         self._grad_acc = {}       # param -> [grads]
+        self._allreduce_acc = {}      # name -> pending contributions
+        self._allreduce_result = {}   # name -> last completed sum
         self._round = 0
         self._barrier_count = 0
         self._cv = threading.Condition()
@@ -353,6 +355,31 @@ class ParameterServer:
                 np.subtract.at(self.tables[name], ids,
                                self.sparse_lr.get(name, 0.01) * rows)
             return ("ok",)
+        if kind == "allreduce":
+            # dedicated metric all-reduce channel (gloo_wrapper.h:102
+            # analog): nranks contributions sum; everyone gets the sum
+            _, name, value, nranks = msg
+            with self._cv:
+                acc = self._allreduce_acc.setdefault(name, [])
+                if not acc:
+                    # new round for this name: drop any stale result
+                    self._allreduce_result.pop(name, None)
+                acc.append(np.asarray(value, np.float64))
+                if len(acc) >= int(nranks):
+                    self._allreduce_result[name] = np.sum(
+                        np.stack(acc), axis=0)
+                    acc.clear()
+                    self._cv.notify_all()
+                else:
+                    ok = self._cv.wait_for(
+                        lambda: name in self._allreduce_result or
+                        self._stop.is_set(), timeout=120.0)
+                    if not ok and not self._stop.is_set():
+                        raise RuntimeError(
+                            f"allreduce {name!r} timed out waiting for "
+                            f"{nranks} contributions")
+                result = self._allreduce_result.get(name)
+            return ("val", result)
         if kind == "barrier_ping":
             return ("ok",)
         if kind == "stop":
@@ -412,6 +439,10 @@ class PSClient:
 
     def pull_dense(self, endpoint, name):
         return self._call(endpoint, ("pull_dense", name))
+
+    def allreduce(self, endpoint, name, value, nranks):
+        return self._call(endpoint, ("allreduce", name,
+                                     np.asarray(value), int(nranks)))
 
     def push_delta(self, endpoint, name, delta):
         return self._call(endpoint, ("push_delta", name, np.asarray(delta)))

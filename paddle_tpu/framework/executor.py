@@ -234,20 +234,30 @@ class Executor:
     # step consumes, with XLA overlapping H2D against compute) ----
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
         assert dataset is not None, "train_from_dataset needs a dataset"
         fetch_names = self._fetch_names(fetch_list)
         fetch_info = fetch_info or fetch_names
+        monitor = None
+        if fetch_handler is not None:
+            monitor = _FetchHandlerMonitor(scope or global_scope(),
+                                           fetch_handler)
+            monitor.start()
         last = None
-        for step, feed in enumerate(dataset.batch_iterator()):
-            out = self.run(program, feed=feed,
-                           fetch_list=fetch_list, scope=scope)
-            last = out
-            if fetch_names and print_period and \
-                    step % print_period == 0:
-                msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
-                                for i, v in zip(fetch_info, out))
-                print(f"step {step}: {msg}")
+        try:
+            for step, feed in enumerate(dataset.batch_iterator()):
+                out = self.run(program, feed=feed,
+                               fetch_list=fetch_list, scope=scope)
+                last = out
+                if fetch_names and print_period and \
+                        step % print_period == 0:
+                    msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
+                                    for i, v in zip(fetch_info, out))
+                    print(f"step {step}: {msg}")
+        finally:
+            if monitor is not None:
+                monitor.stop()
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -332,3 +342,58 @@ def _shard_feed(feed_arrays, mesh, program):
         else:
             out[n] = jax.device_put(arr, sharding)
     return out
+
+
+class FetchHandler:
+    """Periodic background metric reporter during dataset training
+    (reference executor.py:429 FetchHandler + the FetchHandlerMonitor
+    thread): `var_dict` maps display keys to scope var names; `handler`
+    receives {key: numpy value} every `period_secs`."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        assert var_dict is not None
+        self.var_dict = dict(var_dict)
+        self.period_secs = float(period_secs)
+
+    def handler(self, res_dict):
+        import sys
+        for key, val in res_dict.items():
+            if isinstance(val, np.ndarray) and val.size:
+                sys.stdout.write(f"{key}[0]: {val.reshape(-1)[0]} ")
+        sys.stdout.write("\n")
+
+    @staticmethod
+    def help():
+        print("FetchHandler(var_dict={key: var_or_name}, period_secs=60); "
+              "override handler(res_dict) for custom reporting")
+
+
+class _FetchHandlerMonitor:
+    def __init__(self, scope, fetch_handler):
+        import threading
+        self._scope = scope
+        self._fh = fetch_handler
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._fh.period_secs):
+            try:
+                res = {}
+                for key, var in self._fh.var_dict.items():
+                    name = var if isinstance(var, str) else var.name
+                    val = self._scope.find_var(name)
+                    if val is not None:
+                        res[key] = np.asarray(val)
+                self._fh.handler(res)
+            except Exception:
+                # racing the training step (e.g. reading a buffer the jit
+                # just donated) must not kill the monitor — skip the tick
+                continue
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

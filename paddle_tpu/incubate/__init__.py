@@ -1,2 +1,3 @@
 """Incubating front-ends (reference: python/paddle/fluid/incubate/)."""
 from . import fleet  # noqa: F401
+from . import data_generator  # noqa: F401

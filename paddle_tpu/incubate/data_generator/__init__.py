@@ -1,0 +1,97 @@
+"""Training-data generators for slot-based (CTR) text formats.
+
+Capability parity with the reference's data generator
+(/root/reference/python/paddle/fluid/incubate/data_generator/__init__.py —
+DataGenerator.run_from_stdin/run_from_memory, MultiSlotDataGenerator
+:set_batch/_gen_str): user subclasses implement `generate_sample(line)`
+yielding [(slot_name, [values]), ...] per sample; the generator serializes
+them into the slot text format the Dataset parser reads
+(`name:v1,v2,... name2:...` per line, dataio/dataset.py _parse_line).
+
+The reference emits a count-prefixed token stream for its C++
+MultiSlotDataFeed; this build's canonical on-disk format is the
+name-tagged line, so files written here feed straight into
+DatasetFactory().create_dataset(...).set_filelist(...).
+"""
+import sys
+
+
+class DataGenerator:
+    def __init__(self):
+        self._line_proc = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user hooks (reference API) --------------------------------------
+    def generate_sample(self, line):
+        """Return a generator function yielding one or more samples for
+        `line`; each sample is [(slot_name, [values]), ...]."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples):
+        """Optional batch-level hook; yields samples (default identity)."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- serialization ----------------------------------------------------
+    def _gen_str(self, sample):
+        return " ".join(
+            f"{name}:{','.join(str(v) for v in values)}"
+            for name, values in sample) + "\n"
+
+    # -- drivers -----------------------------------------------------------
+    def run_from_stdin(self):
+        """stdin lines -> serialized samples on stdout (the pipe_command
+        contract of the reference's dataset ingestion)."""
+        self._run_lines(sys.stdin, sys.stdout)
+
+    def run_from_files(self, input_files, output_file):
+        """Batch conversion: raw text files -> one slot-format file."""
+        with open(output_file, "w") as out:
+            for path in input_files:
+                with open(path) as f:
+                    self._run_lines(f, out)
+        return output_file
+
+    def run_from_memory(self, lines, output=None):
+        out = output or sys.stdout
+        self._run_lines(lines, out)
+
+    def _run_lines(self, lines, out):
+        batch = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for sample in gen():
+                if sample is None:
+                    continue
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch, out)
+                    batch = []
+        if batch:
+            self._flush(batch, out)
+
+    def _flush(self, batch, out):
+        for sample in self.generate_batch(batch)():
+            out.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots (int64 ids / float32 values) — the Criteo-style CTR
+    format (reference MultiSlotDataGenerator)."""
+
+    def _gen_str(self, sample):
+        for name, values in sample:
+            if not values:
+                raise ValueError(f"slot {name!r} has no values")
+        return super()._gen_str(sample)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String-valued slots; values pass through verbatim."""
+    pass

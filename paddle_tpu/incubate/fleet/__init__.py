@@ -1,3 +1,4 @@
 """Fleet distributed-training API (reference:
 python/paddle/fluid/incubate/fleet/ — base/fleet_base.py:34)."""
 from . import base  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,3 @@
+"""Fleet utilities (reference: python/paddle/fluid/incubate/fleet/utils/)."""
+from . import fs  # noqa: F401
+from .fleet_util import FleetUtil  # noqa: F401

@@ -1,0 +1,50 @@
+"""FleetUtil (reference: incubate/fleet/utils/fleet_util.py — cross-worker
+metric aggregation over gloo + misc helpers). The TPU analog aggregates
+via the parameter-server channel when one is up, else locally."""
+import numpy as np
+
+
+class FleetUtil:
+    def __init__(self, mode="collective"):
+        self.mode = mode
+
+    def all_reduce_sum(self, value, endpoint=None, name="fleet_util_acc",
+                       trainers=1):
+        """Sum a numpy value across workers via the pserver's dedicated
+        all-reduce channel (gloo-wrapper analog,
+        framework/fleet/gloo_wrapper.h:102) — isolated from the gradient
+        sync rounds; single-process returns the value unchanged."""
+        value = np.asarray(value, np.float64)
+        if endpoint is None or trainers <= 1:
+            return value
+        from ...distributed.ps import PSClient
+        cli = PSClient.instance(key="fleet_util")
+        return np.asarray(cli.allreduce(endpoint, name, value, trainers))
+
+    def calculate_auc(self, stat_pos, stat_neg):
+        """AUC from accumulated threshold histograms (the shape the auc op
+        and fluid.metrics.Auc keep) — reference FleetUtil.get_global_auc
+        math after aggregation."""
+        tp = np.cumsum(np.asarray(stat_pos, np.float64)[::-1])
+        fp = np.cumsum(np.asarray(stat_neg, np.float64)[::-1])
+        if tp[-1] == 0 or fp[-1] == 0:
+            return 0.0
+        tp0 = np.concatenate([[0.0], tp[:-1]])
+        fp0 = np.concatenate([[0.0], fp[:-1]])
+        area = np.sum((fp - fp0) * (tp + tp0) / 2.0)
+        return float(area / (tp[-1] * fp[-1]))
+
+    def print_global_auc(self, scope, stat_pos_name, stat_neg_name,
+                         print_prefix=""):
+        from ...framework.executor import global_scope
+        scope = scope or global_scope()
+        pos = scope.find_var(stat_pos_name)
+        neg = scope.find_var(stat_neg_name)
+        if pos is None or neg is None:
+            missing = stat_pos_name if pos is None else stat_neg_name
+            raise KeyError(
+                f"print_global_auc: stat var {missing!r} is not in the "
+                f"scope (run a step with the auc op first)")
+        auc = self.calculate_auc(np.asarray(pos), np.asarray(neg))
+        print(f"{print_prefix} global auc = {auc:.6f}")
+        return auc

@@ -1,0 +1,85 @@
+"""Filesystem abstraction for checkpoints (reference:
+incubate/fleet/utils/fs.py / hdfs.py — FS base + LocalFS + HDFSClient).
+Checkpoint-restart recovery (incubate/fleet/collective save_checkpoint)
+writes through this interface; LocalFS covers shared-filesystem (NFS/GCS
+-fuse) deployments, the standard TPU pattern. HDFS has no TPU-pod analog
+— the shim raises with guidance instead of silently no-oping."""
+import os
+import shutil
+
+
+class FS:
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py LocalFS."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for e in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, e))
+             else files).append(e)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.replace(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FileNotFoundError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"mv destination {dst!r} exists (pass overwrite=True)")
+            self.delete(dst)
+        os.replace(src, dst)
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def touch(self, path, exist_ok=True):
+        if not exist_ok and self.is_exist(path):
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+
+class HDFSClient(FS):
+    """Placeholder with guidance (the reference shells out to the hadoop
+    CLI; TPU deployments use shared/cloud filesystems via LocalFS)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(
+            "HDFS is not available in this environment; mount the store "
+            "(NFS / gcsfuse) and use LocalFS — every checkpoint API takes "
+            "an fs object, so the swap is one argument")

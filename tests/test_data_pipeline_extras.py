@@ -1,0 +1,252 @@
+"""Data-generator, real global shuffle, FetchHandler, fleet fs/util
+(reference pattern: incubate/data_generator tests, test_dataset.py,
+fleet utils tests)."""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+class _CtrGen(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def gen():
+            parts = line.strip().split()
+            if not parts:
+                return
+            yield [("label", [int(parts[0])]),
+                   ("dense", [float(p) for p in parts[1:4]]),
+                   ("C0", [int(parts[4])])]
+        return gen
+
+
+def test_data_generator_roundtrip_through_dataset():
+    """Raw text -> generator -> slot file -> Dataset batches a program
+    can train from (the CTR ingestion chain)."""
+    with tempfile.TemporaryDirectory() as d:
+        raw = os.path.join(d, "raw.txt")
+        with open(raw, "w") as f:
+            for i in range(8):
+                f.write(f"{i % 2} 0.1 0.2 0.3 {i}\n")
+        out = os.path.join(d, "slots.txt")
+        gen = _CtrGen()
+        gen.set_batch(4)
+        gen.run_from_files([raw], out)
+        first = open(out).readline().strip()
+        assert "label:0" in first and "dense:0.1,0.2,0.3" in first, first
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            label = layers.data("label", [-1, 1], dtype="int64")
+            dense = layers.data("dense", [-1, 3], dtype="float32")
+            c0 = layers.data("C0", [-1, 1], dtype="int64")
+            s = layers.reduce_sum(dense)
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_filelist([out])
+        ds.set_batch_size(4)
+        ds.set_use_var([label, dense, c0])
+        ds.load_into_memory()
+        batches = list(ds.batch_iterator())
+        assert len(batches) == 2
+        assert batches[0]["dense"].shape == (4, 3)
+        np.testing.assert_allclose(batches[0]["dense"][0],
+                                   [0.1, 0.2, 0.3], rtol=1e-6)
+
+
+def test_global_shuffle_moves_samples_across_processes():
+    """2 subprocesses + shared spool dir: after global_shuffle each
+    process holds a mix of BOTH input shards (real redistribution, not a
+    local permutation)."""
+    script = r'''
+import json, os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+sys.path.insert(0, sys.argv[5])
+import paddle_tpu as fluid
+
+class V:
+    def __init__(self, name, dtype):
+        self.name, self.dtype = name, dtype
+
+ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+ds.set_filelist([sys.argv[3] + f"/part_{i}.txt" for i in range(2)])
+ds.set_use_var([V("x", "int64")])
+ds.set_batch_size(2)
+ds.load_into_memory()
+ds.global_shuffle(spool_dir=sys.argv[3] + "/spool")
+vals = sorted(int(s[0][0]) for s in ds._samples)
+with open(sys.argv[4], "w") as f:
+    json.dump(vals, f)
+'''
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    with tempfile.TemporaryDirectory() as d:
+        # shard 0: 0..9, shard 1: 100..109 (disjoint ranges)
+        for i, lo in enumerate((0, 100)):
+            with open(os.path.join(d, f"part_{i}.txt"), "w") as f:
+                for v in range(lo, lo + 10):
+                    f.write(f"x:{v}\n")
+        sp = os.path.join(d, "runner.py")
+        open(sp, "w").write(script)
+        outs = [os.path.join(d, f"out_{i}.json") for i in range(2)]
+        procs = [subprocess.Popen(
+            [sys.executable, sp, coord, str(i), d, outs[i], REPO],
+            stderr=subprocess.PIPE) for i in range(2)]
+        for p in procs:
+            _, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err.decode()[-2000:]
+        import json
+        got = [json.load(open(o)) for o in outs]
+        allv = sorted(got[0] + got[1])
+        assert allv == sorted(list(range(10)) + list(range(100, 110)))
+        # both processes hold samples from BOTH original shards
+        for vals in got:
+            assert any(v < 100 for v in vals), got
+            assert any(v >= 100 for v in vals), got
+
+
+def test_fetch_handler_reports_periodically():
+    events = []
+
+    class H(fluid.FetchHandler):
+        def handler(self, res):
+            events.append({k: float(np.asarray(v).reshape(-1)[0])
+                           for k, v in res.items()})
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    class SlowDataset:
+        def batch_iterator(self):
+            rng = np.random.default_rng(0)
+            for _ in range(6):
+                time.sleep(0.12)
+                x = rng.standard_normal((8, 4)).astype(np.float32)
+                yield {"x": x, "y": (x[:, :1] * 0.5).astype(np.float32)}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        wname = next(p.name for p in main.all_parameters())
+        exe.train_from_dataset(
+            main, SlowDataset(), scope=scope, fetch_list=[loss],
+            print_period=0,
+            fetch_handler=H(var_dict={"w": wname}, period_secs=0.2))
+    assert events and all("w" in e for e in events), events
+
+
+def test_fleet_fs_and_util():
+    from paddle_tpu.incubate.fleet.utils import FleetUtil
+    from paddle_tpu.incubate.fleet.utils.fs import HDFSClient, LocalFS
+
+    fs = LocalFS()
+    with tempfile.TemporaryDirectory() as d:
+        sub = os.path.join(d, "a")
+        fs.mkdirs(sub)
+        fs.touch(os.path.join(sub, "f.txt"))
+        dirs, files = fs.ls_dir(d)
+        assert dirs == ["a"] and files == []
+        assert fs.is_dir(sub) and fs.is_exist(os.path.join(sub, "f.txt"))
+        fs.mv(sub, os.path.join(d, "b"))
+        assert fs.is_exist(os.path.join(d, "b", "f.txt"))
+        fs.delete(os.path.join(d, "b"))
+        assert not fs.is_exist(os.path.join(d, "b"))
+    try:
+        HDFSClient()
+        raise AssertionError("expected NotImplementedError")
+    except NotImplementedError as e:
+        assert "LocalFS" in str(e)
+
+    util = FleetUtil()
+    # single-process all-reduce is identity; auc matches metrics.Auc
+    np.testing.assert_allclose(util.all_reduce_sum(np.ones(3)), np.ones(3))
+    pos = np.zeros(128); neg = np.zeros(128)
+    pos[100] = 10; neg[20] = 10      # perfectly separated
+    assert util.calculate_auc(pos, neg) == 1.0
+
+
+def test_fleet_util_allreduce_across_processes():
+    """2 workers + pserver allreduce channel: both get the SUM."""
+    import threading
+    import socket
+
+    from paddle_tpu.distributed import ParameterServer
+    from paddle_tpu.incubate.fleet.utils import FleetUtil
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    ep = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    server = ParameterServer(ep, trainers=2, sync_mode=False)
+    ready = threading.Event()
+    server.serve(ready_event=ready, block=False)
+    ready.wait(10)
+
+    results = {}
+
+    def worker(i):
+        from paddle_tpu.distributed.ps import PSClient
+        util = FleetUtil()
+        # give each worker its own client/socket
+        import paddle_tpu.incubate.fleet.utils.fleet_util as fu
+        cli = PSClient.instance(key=f"ar_{i}")
+        val = cli.allreduce(ep, "metric", np.full(3, float(i + 1)), 2)
+        results[i] = np.asarray(val)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    np.testing.assert_allclose(results[0], np.full(3, 3.0))
+    np.testing.assert_allclose(results[1], np.full(3, 3.0))
+    # a second round must start fresh, not reuse the stale result
+    from paddle_tpu.distributed.ps import PSClient
+    r2 = {}
+    def worker2(i):
+        cli = PSClient.instance(key=f"ar_{i}")
+        r2[i] = np.asarray(cli.allreduce(ep, "metric",
+                                         np.full(3, 10.0 * (i + 1)), 2))
+    ts = [threading.Thread(target=worker2, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    np.testing.assert_allclose(r2[0], np.full(3, 30.0))
+    PSClient.instance(key="ar_0").stop_servers([ep])
+
+
+def test_localfs_mv_overwrite_guard():
+    from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    with tempfile.TemporaryDirectory() as d:
+        a, b = os.path.join(d, "a"), os.path.join(d, "b")
+        fs.touch(a)
+        fs.touch(b)
+        try:
+            fs.mv(a, b)
+            raise AssertionError("expected FileExistsError")
+        except FileExistsError:
+            pass
+        fs.mv(a, b, overwrite=True)
+        assert not fs.is_exist(a) and fs.is_exist(b)
