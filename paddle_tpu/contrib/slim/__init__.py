@@ -1,3 +1,5 @@
 """fluid.contrib.slim — model compression toolkit (reference:
 python/paddle/fluid/contrib/slim/)."""
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401

@@ -1,0 +1,1 @@
+from .distiller import fsp_loss, l2_loss, merge, soft_label_loss  # noqa: F401

@@ -1,0 +1,97 @@
+"""Knowledge distillation helpers (reference:
+python/paddle/fluid/contrib/slim/distillation/distiller.py — FSPDistiller,
+L2Distiller, SoftLabelDistiller; and the teacher/student program merge).
+
+`merge` clones the teacher program's ops/vars into the student program
+under a name prefix (teacher params become non-trainable persistables
+initialized from the teacher scope), sharing the student's data feeds; the
+loss builders then combine any teacher/student activation pair."""
+import numpy as np
+
+from .... import layers
+from ....framework.core import Parameter
+from ....layers import math as M
+from ....layers import tensor as T
+
+
+def merge(teacher_program, student_program, data_name_map, place=None,
+          scope=None, teacher_scope=None, name_prefix="teacher_"):
+    """Graft the teacher graph into the student program. `data_name_map`
+    maps teacher feed names -> student feed names (shared inputs).
+    Teacher weights are copied from `teacher_scope` into `scope` under the
+    prefix and marked non-trainable."""
+    from ....framework.executor import global_scope
+    scope = scope or global_scope()
+    teacher_scope = teacher_scope or scope
+    sblock = student_program.global_block()
+    tblock = teacher_program.global_block()
+
+    def renamed(n):
+        return data_name_map.get(n, name_prefix + n)
+
+    for name, var in tblock.vars.items():
+        if name in data_name_map:
+            continue
+        nv = sblock.create_var(name=renamed(name), shape=var.shape,
+                               dtype=var.dtype,
+                               persistable=var.persistable,
+                               stop_gradient=True)
+        if isinstance(var, Parameter) or var.persistable:
+            tv = teacher_scope.find_var(name)
+            if tv is not None:
+                scope.set(nv.name, np.asarray(tv))
+    for op in tblock.ops:
+        sblock.append_op(
+            type=op.type,
+            inputs={s: [renamed(n) for n in ns]
+                    for s, ns in op.inputs.items()},
+            outputs={s: [renamed(n) for n in ns]
+                     for s, ns in op.outputs.items()},
+            attrs=dict(op.attrs), infer_shape=False)
+    student_program._bump_version()
+
+
+def l2_loss(teacher_var_name, student_var_name, program=None):
+    """reference L2Distiller: mean squared error between activations."""
+    block = (program or _default()).global_block()
+    t = block.var(teacher_var_name)
+    s = block.var(student_var_name)
+    diff = M.elementwise_sub(s, t)
+    return layers.mean(M.elementwise_mul(diff, diff))
+
+
+def soft_label_loss(teacher_var_name, student_var_name, program=None,
+                    teacher_temperature=2.0, student_temperature=2.0):
+    """reference SoftLabelDistiller: CE between softened distributions."""
+    block = (program or _default()).global_block()
+    t = layers.softmax(M.scale(block.var(teacher_var_name),
+                               1.0 / teacher_temperature))
+    s = layers.log_softmax(M.scale(block.var(student_var_name),
+                                   1.0 / student_temperature))
+    return layers.mean(M.scale(
+        layers.reduce_sum(M.elementwise_mul(t, s), dim=-1), -1.0))
+
+
+def fsp_loss(teacher_var1_name, teacher_var2_name, student_var1_name,
+             student_var2_name, program=None):
+    """reference FSPDistiller (fsp_op.cc): match the flow-of-solution
+    Gram matrices between two feature maps [N, C, H, W]."""
+    block = (program or _default()).global_block()
+
+    def fsp(a_name, b_name):
+        a = block.var(a_name)
+        b = block.var(b_name)
+        n, c1, c2 = a.shape[0], a.shape[1], block.var(b_name).shape[1]
+        hw = int(np.prod(a.shape[2:]))
+        af = T.reshape(a, [n, c1, hw])
+        bf = T.transpose(T.reshape(b, [n, c2, hw]), [0, 2, 1])
+        return M.scale(layers.matmul(af, bf), 1.0 / hw)
+
+    diff = M.elementwise_sub(fsp(student_var1_name, student_var2_name),
+                             fsp(teacher_var1_name, teacher_var2_name))
+    return layers.mean(M.elementwise_mul(diff, diff))
+
+
+def _default():
+    from ....framework.core import default_main_program
+    return default_main_program()

@@ -1,0 +1,1 @@
+from .pruner import Pruner, save_model_masks  # noqa: F401

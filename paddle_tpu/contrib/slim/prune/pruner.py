@@ -1,0 +1,84 @@
+"""Magnitude pruning (reference:
+python/paddle/fluid/contrib/slim/prune/pruner.py + prune_walker — ratio
+pruning of conv filters / fc weights by L1 norm).
+
+TPU design: pruning is a MASK, not a shape change — XLA's static shapes
+make physical channel removal a retrace, so `prune()` computes per-param
+binary masks (elementwise magnitude or structured filter-level L1) and
+(a) applies them to the scope immediately, and (b) optionally inserts
+`elementwise_mul(param, mask)` ops after each optimizer update so the
+pruned weights stay zero through continued training (mask-retrain, the
+slim fine-tune recipe)."""
+import numpy as np
+
+
+class Pruner:
+    def __init__(self, criterion="l1_norm"):
+        assert criterion == "l1_norm"
+        self.criterion = criterion
+
+    @staticmethod
+    def _mask(value, ratio, structured_axis=None):
+        a = np.abs(np.asarray(value))
+        if structured_axis is None:
+            k = int(a.size * ratio)
+            if k <= 0:
+                return np.ones_like(a)
+            thresh = np.partition(a.reshape(-1), k - 1)[k - 1]
+            return (a > thresh).astype(a.dtype)
+        # structured: rank whole slices (e.g. conv filters on axis 0)
+        axes = tuple(i for i in range(a.ndim) if i != structured_axis)
+        norms = a.sum(axis=axes)
+        k = int(norms.size * ratio)
+        if k <= 0:
+            return np.ones_like(a)
+        thresh = np.partition(norms, k - 1)[k - 1]
+        keep = norms > thresh
+        shape = [1] * a.ndim
+        shape[structured_axis] = -1
+        return np.broadcast_to(keep.reshape(shape), a.shape).astype(a.dtype)
+
+    def prune(self, program, scope, params, ratios, place=None,
+              lazy=False, only_graph=False, param_backup=None,
+              param_shape_backup=None, structured_axis=None,
+              mask_in_graph=False):
+        """Zero the smallest-|w| fraction `ratios[i]` of each param.
+        Returns {param_name: mask}. With mask_in_graph=True, persistable
+        mask vars + re-mask ops are appended so optimizer updates cannot
+        resurrect pruned weights."""
+        masks = {}
+        for name, ratio in zip(params, ratios):
+            val = scope.find_var(name)
+            if val is None:
+                raise KeyError(f"param {name!r} not found in scope")
+            mask = self._mask(val, float(ratio), structured_axis)
+            masks[name] = mask
+            if param_backup is not None:
+                param_backup[name] = np.asarray(val).copy()
+            scope.set(name, np.asarray(val) * mask)
+        if mask_in_graph:
+            self._append_mask_ops(program, scope, masks)
+        return masks
+
+    @staticmethod
+    def _append_mask_ops(program, scope, masks):
+        from ....framework.core import OP_ROLE_KEY, OpRole
+        from ....framework import unique_name
+        block = program.global_block()
+        for name, mask in masks.items():
+            mname = unique_name.generate(f"{name}@PRUNE_MASK")
+            block.create_var(name=mname, shape=mask.shape,
+                             dtype=str(mask.dtype), persistable=True,
+                             stop_gradient=True)
+            scope.set(mname, mask)
+            block.append_op(
+                type="elementwise_mul",
+                inputs={"X": [name], "Y": [mname]},
+                outputs={"Out": [name]},
+                attrs={OP_ROLE_KEY: OpRole.Optimize}, infer_shape=False)
+        program._bump_version()
+
+
+def save_model_masks(masks, path):
+    np.savez(path, **{k.replace("/", "%2F"): v for k, v in masks.items()})
+    return path

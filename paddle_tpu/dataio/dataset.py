@@ -143,8 +143,17 @@ class InMemoryDataset(DatasetBase):
         import time
 
         os.makedirs(spool_dir, exist_ok=True)
-        # round-stamped filenames: repeated shuffles into the same spool
-        # dir must not race against the previous round's markers/shards
+        # files are namespaced by a RUN TOKEN all processes agree on (one
+        # broadcast from process 0) + a round counter — stale files from a
+        # crashed previous run sharing the spool dir can never satisfy
+        # this run's barrier
+        if not hasattr(self, "_shuffle_token"):
+            from jax.experimental import multihost_utils
+            import secrets
+            tok = np.asarray(secrets.randbits(31), np.int32)
+            self._shuffle_token = int(
+                multihost_utils.broadcast_one_to_all(tok))
+        tok = self._shuffle_token
         r = getattr(self, "_shuffle_round", 0)
         rng = random.Random(self._seed)
         buckets = [[] for _ in range(n)]
@@ -152,13 +161,13 @@ class InMemoryDataset(DatasetBase):
             buckets[rng.randrange(n)].append(s)
         for dst, bucket in enumerate(buckets):
             with open(os.path.join(
-                    spool_dir, f"r{r}_shard_{idx}_to_{dst}.pkl"),
+                    spool_dir, f"t{tok}_r{r}_shard_{idx}_to_{dst}.pkl"),
                     "wb") as f:
                 pickle.dump(bucket, f)
-        open(os.path.join(spool_dir, f"r{r}_done_{idx}"), "w").close()
+        open(os.path.join(spool_dir, f"t{tok}_r{r}_done_{idx}"), "w").close()
         deadline = time.monotonic() + 300
         while any(not os.path.exists(
-                os.path.join(spool_dir, f"r{r}_done_{i}"))
+                os.path.join(spool_dir, f"t{tok}_r{r}_done_{i}"))
                 for i in range(n)):
             if time.monotonic() > deadline:
                 raise TimeoutError("global_shuffle: peers never spooled")
@@ -166,7 +175,7 @@ class InMemoryDataset(DatasetBase):
         merged = []
         for src in range(n):
             with open(os.path.join(
-                    spool_dir, f"r{r}_shard_{src}_to_{idx}.pkl"),
+                    spool_dir, f"t{tok}_r{r}_shard_{src}_to_{idx}.pkl"),
                     "rb") as f:
                 merged.extend(pickle.load(f))
         random.Random(self._seed + idx + 1).shuffle(merged)
@@ -179,7 +188,7 @@ class InMemoryDataset(DatasetBase):
             for dst in range(n):
                 try:
                     os.remove(os.path.join(
-                        spool_dir, f"r{r-1}_shard_{idx}_to_{dst}.pkl"))
+                        spool_dir, f"t{tok}_r{r-1}_shard_{idx}_to_{dst}.pkl"))
                 except OSError:
                     pass
 

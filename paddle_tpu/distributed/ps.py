@@ -132,8 +132,7 @@ class ParameterServer:
         self.lr_map = {}          # param name -> {lr var name: value}
         self.sparse_lr = {}       # sparse table name -> lr
         self._grad_acc = {}       # param -> [grads]
-        self._allreduce_acc = {}      # name -> pending contributions
-        self._allreduce_result = {}   # name -> last completed sum
+        self._allreduce_acc = {}  # name -> {round, acc, results} state
         self._round = 0
         self._barrier_count = 0
         self._cv = threading.Condition()
@@ -357,28 +356,39 @@ class ParameterServer:
             return ("ok",)
         if kind == "allreduce":
             # dedicated metric all-reduce channel (gloo_wrapper.h:102
-            # analog): nranks contributions sum; everyone gets the sum
+            # analog). Per-name ROUND bookkeeping: each waiter reads the
+            # result of ITS round (overlapping next-round contributions
+            # cannot clobber it), results retire after nranks reads, and
+            # a timed-out round drops its partial contributions so later
+            # rounds start clean.
             _, name, value, nranks = msg
+            nranks = int(nranks)
             with self._cv:
-                acc = self._allreduce_acc.setdefault(name, [])
-                if not acc:
-                    # new round for this name: drop any stale result
-                    self._allreduce_result.pop(name, None)
-                acc.append(np.asarray(value, np.float64))
-                if len(acc) >= int(nranks):
-                    self._allreduce_result[name] = np.sum(
-                        np.stack(acc), axis=0)
-                    acc.clear()
+                st = self._allreduce_acc.setdefault(
+                    name, {"round": 0, "acc": [], "results": {}})
+                r = st["round"]
+                st["acc"].append(np.asarray(value, np.float64))
+                if len(st["acc"]) >= nranks:
+                    st["results"][r] = [np.sum(np.stack(st["acc"]),
+                                               axis=0), 0]
+                    st["acc"] = []
+                    st["round"] = r + 1
                     self._cv.notify_all()
                 else:
                     ok = self._cv.wait_for(
-                        lambda: name in self._allreduce_result or
-                        self._stop.is_set(), timeout=120.0)
+                        lambda: r in st["results"] or self._stop.is_set(),
+                        timeout=120.0)
                     if not ok and not self._stop.is_set():
+                        st["acc"] = []      # unpoison the round
                         raise RuntimeError(
                             f"allreduce {name!r} timed out waiting for "
                             f"{nranks} contributions")
-                result = self._allreduce_result.get(name)
+                entry = st["results"].get(r)
+                result = entry[0] if entry else None
+                if entry:
+                    entry[1] += 1
+                    if entry[1] >= nranks:
+                        st["results"].pop(r, None)
             return ("val", result)
         if kind == "barrier_ping":
             return ("ok",)

@@ -380,19 +380,25 @@ class _FetchHandlerMonitor:
         self._thread.start()
 
     def _loop(self):
+        import traceback
         while not self._stop.wait(self._fh.period_secs):
+            res = {}
             try:
-                res = {}
                 for key, var in self._fh.var_dict.items():
                     name = var if isinstance(var, str) else var.name
                     val = self._scope.find_var(name)
                     if val is not None:
                         res[key] = np.asarray(val)
-                self._fh.handler(res)
             except Exception:
                 # racing the training step (e.g. reading a buffer the jit
                 # just donated) must not kill the monitor — skip the tick
                 continue
+            try:
+                self._fh.handler(res)
+            except Exception:
+                # a buggy user handler must neither die silently nor kill
+                # the monitor: report it, keep ticking
+                traceback.print_exc()
 
     def stop(self):
         self._stop.set()

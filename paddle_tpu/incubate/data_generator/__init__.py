@@ -93,5 +93,18 @@ class MultiSlotDataGenerator(DataGenerator):
 
 
 class MultiSlotStringDataGenerator(DataGenerator):
-    """String-valued slots; values pass through verbatim."""
-    pass
+    """String-valued slots. The slot line format delimits values with
+    spaces/colons/commas, so values containing those characters cannot
+    round-trip — they are rejected loudly instead of corrupting the
+    file."""
+
+    def _gen_str(self, sample):
+        for name, values in sample:
+            for v in values:
+                sv = str(v)
+                if any(c in sv for c in " :,\t\n"):
+                    raise ValueError(
+                        f"slot {name!r} value {sv!r} contains a delimiter "
+                        f"(space/colon/comma); encode it first — the slot "
+                        f"line format cannot represent it")
+        return super()._gen_str(sample)

@@ -1,0 +1,131 @@
+"""slim pruning + distillation (reference pattern:
+slim/tests/test_prune*, test_distillation*)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.distillation import (l2_loss, merge,
+                                                  soft_label_loss)
+from paddle_tpu.contrib.slim.prune import Pruner
+
+
+def test_magnitude_pruning_and_mask_retrain():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [16, 8], dtype="float32")
+        y = layers.data("y", [16, 1], dtype="float32")
+        pred = layers.fc(x, 1, bias_attr=False,
+                         param_attr=fluid.ParamAttr(name="prune_w"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((16, 8)).astype(np.float32)
+    yv = (xv[:, :1] * 0.5).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        backup = {}
+        masks = Pruner().prune(main, scope, ["prune_w"], [0.5],
+                               param_backup=backup, mask_in_graph=True)
+        w = np.asarray(scope.find_var("prune_w"))
+        zeroed = int((w == 0).sum())
+        assert zeroed == 4, w                    # 50% of 8 weights
+        # retrain: pruned entries must STAY zero through updates
+        for _ in range(5):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        w2 = np.asarray(scope.find_var("prune_w"))
+        assert np.all(w2[masks["prune_w"] == 0] == 0.0)
+        assert np.any(w2[masks["prune_w"] == 1] != w[masks["prune_w"] == 1])
+        assert "prune_w" in backup and np.any(backup["prune_w"] != w)
+
+
+def test_structured_filter_pruning():
+    scope = fluid.Scope()
+    w = np.arange(2 * 3 * 4, dtype=np.float32).reshape(6, 4) + 1.0
+    scope.set("cw", w)
+    masks = Pruner().prune(fluid.Program(), scope, ["cw"], [0.34],
+                           structured_axis=0)
+    out = np.asarray(scope.find_var("cw"))
+    # whole lowest-norm rows (filters) zeroed
+    assert np.all(out[0] == 0) and np.all(out[1] == 0)
+    assert np.all(out[2:] != 0)
+    assert masks["cw"].shape == w.shape
+
+
+def test_distillation_merge_and_losses():
+    """Teacher grafted into the student program; distill losses train the
+    student toward the (frozen) teacher."""
+    teacher = fluid.Program()
+    t_startup = fluid.Program()
+    teacher.random_seed = t_startup.random_seed = 7
+    with fluid.program_guard(teacher, t_startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        t_logits = layers.fc(x, 3, name="t_fc",
+                             param_attr=fluid.ParamAttr(name="t_fc.w"),
+                             bias_attr=False)
+    t_scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(t_scope):
+        exe.run(t_startup)
+
+    student = fluid.Program()
+    s_startup = fluid.Program()
+    student.random_seed = s_startup.random_seed = 9
+    with fluid.program_guard(student, s_startup):
+        x = layers.data("x", [8, 4], dtype="float32")
+        s_logits = layers.fc(x, 3, name="s_fc",
+                             param_attr=fluid.ParamAttr(name="s_fc.w"),
+                             bias_attr=False)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(s_startup)
+        merge(teacher, student, {"x": "x"}, scope=scope,
+              teacher_scope=t_scope)
+        with fluid.program_guard(student, s_startup):
+            l2 = l2_loss("teacher_" + t_logits.name, s_logits.name,
+                         student)
+            soft = soft_label_loss("teacher_" + t_logits.name,
+                                   s_logits.name, student)
+            loss = layers.mean(layers.elementwise_add(l2, soft))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        exe.run(s_startup)  # init the optimizer accumulators added above
+        rng = np.random.default_rng(1)
+        xv = rng.standard_normal((8, 4)).astype(np.float32)
+        hist = [[float(v) for v in exe.run(student, feed={"x": xv},
+                                           fetch_list=[loss, l2])]
+                for _ in range(40)]
+    totals = [h[0] for h in hist]
+    l2s = [h[1] for h in hist]
+    # the L2 activation match goes to ~0; the soft-label CE bottoms out at
+    # the teacher's softened entropy, so assert each piece appropriately
+    assert l2s[-1] < 0.05 * l2s[0], l2s[::10]
+    assert totals[-1] < totals[0]
+    # teacher weights never trained
+    with fluid.scope_guard(scope):
+        tw = np.asarray(scope.find_var("teacher_t_fc.w"))
+        tw0 = np.asarray(t_scope.find_var("t_fc.w"))
+    np.testing.assert_array_equal(tw, tw0)
+
+
+def test_fsp_loss_zero_for_identical_maps():
+    from paddle_tpu.contrib.slim.distillation import fsp_loss
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [2, 3, 4, 4], dtype="float32")
+        b = layers.data("b", [2, 5, 4, 4], dtype="float32")
+        # teacher maps == student maps -> fsp loss exactly 0
+        loss = fsp_loss("a", "b", "a", "b", main)
+    exe = fluid.Executor()
+    rng = np.random.default_rng(2)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main,
+                       feed={"a": rng.standard_normal(
+                                 (2, 3, 4, 4)).astype(np.float32),
+                             "b": rng.standard_normal(
+                                 (2, 5, 4, 4)).astype(np.float32)},
+                       fetch_list=[loss])
+    assert float(np.asarray(out)) == 0.0
