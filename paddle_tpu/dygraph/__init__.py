@@ -7,7 +7,7 @@ from .layers import Layer  # noqa: F401
 from . import nn  # noqa: F401
 from .nn import (  # noqa: F401
     Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout,
-    LSTMCell, GRUCell,
+    LSTMCell, GRUCell, Conv2DTranspose, GroupNorm, PRelu, SpectralNorm,
 )
 from .checkpoint import save_dygraph, load_dygraph  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401

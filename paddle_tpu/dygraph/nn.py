@@ -282,3 +282,126 @@ class GRUCell(Layer):
                        "WCand": [self.weight_cand],
                        "BCand": [self.bias_cand]},
                       out_dtype=self._dtype, out_slot="H")
+
+
+class Conv2DTranspose(Layer):
+    """reference dygraph/nn.py Conv2DTranspose."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = (filter_size if isinstance(filter_size, (list, tuple))
+              else (filter_size, filter_size))
+        self._attrs = {
+            "strides": list(stride if isinstance(stride, (list, tuple))
+                            else (stride, stride)),
+            "paddings": list(padding if isinstance(padding, (list, tuple))
+                             else (padding, padding)),
+            "dilations": list(dilation if isinstance(dilation,
+                                                     (list, tuple))
+                              else (dilation, dilation)),
+            "groups": groups, "padding_algorithm": "EXPLICIT"}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + list(fs),
+            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _trace("conv2d_transpose",
+                     {"Input": [x], "Filter": [self.weight]},
+                     attrs=dict(self._attrs), out_dtype=self._dtype,
+                     out_slot="Output")
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": 1}, out_dtype=self._dtype)
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, out_dtype=self._dtype)
+        return out
+
+
+class GroupNorm(Layer):
+    """reference dygraph/nn.py GroupNorm."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._groups = groups
+        self._eps = epsilon
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr, dtype=dtype,
+            default_initializer=I.ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        return _trace("group_norm",
+                      {"X": [x], "Scale": [self.weight],
+                       "Bias": [self.bias]},
+                      attrs={"groups": self._groups, "epsilon": self._eps},
+                      out_dtype=self._dtype, out_slot="Y")
+
+
+class PRelu(Layer):
+    """reference dygraph/nn.py PRelu (mode all/channel/element)."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            assert channel, "PRelu(mode='channel') needs channel="
+            shape = [1, channel, 1, 1]
+        else:
+            assert input_shape is not None
+            shape = [1] + list(input_shape)[1:]
+        self._mode = mode
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, dtype=dtype,
+            default_initializer=I.ConstantInitializer(0.25))
+
+    def forward(self, x):
+        return _trace("prelu", {"X": [x], "Alpha": [self.weight]},
+                      attrs={"mode": self._mode}, out_dtype=self._dtype)
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py SpectralNorm — power-iteration spectral
+    weight normalization (ops/nn_ops.py spectral_norm). U/V are
+    NON-trainable power-iteration buffers that refine every forward
+    (UOut/VOut fold back, the BatchNorm running-stat pattern)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        from ..param_attr import ParamAttr
+        self._dim = dim
+        self._power_iters = max(int(power_iters), 1)
+        self._eps = eps
+        h = weight_shape[dim]
+        import numpy as _np
+        w = int(_np.prod(weight_shape)) // h
+        buf = ParamAttr(trainable=False)
+        self.weight_u = self.create_parameter(
+            [h], attr=buf, dtype=dtype,
+            default_initializer=I.NormalInitializer(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], attr=ParamAttr(trainable=False), dtype=dtype,
+            default_initializer=I.NormalInitializer(0.0, 1.0))
+
+    def forward(self, weight):
+        # the buffers themselves receive UOut/VOut, so the power
+        # iteration refines across calls
+        return _trace("spectral_norm",
+                      {"Weight": [weight], "U": [self.weight_u],
+                       "V": [self.weight_v]},
+                      attrs={"dim": self._dim,
+                             "power_iters": self._power_iters,
+                             "eps": self._eps}, out_dtype=self._dtype,
+                      extra_outputs={"UOut": [self.weight_u],
+                                     "VOut": [self.weight_v]})
+
+

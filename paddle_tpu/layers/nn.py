@@ -661,14 +661,28 @@ def gru_unit(input, hidden, size=None, param_attr=None, bias_attr=None,
                          bias_attr=bias_attr, name=name)
     D = int(input.shape[-1])
     H = int(hidden.shape[-1])
-    wg = helper.create_parameter(helper.param_attr, shape=[D + H, 2 * H],
-                                 dtype=input.dtype)
-    bg = helper.create_parameter(helper.bias_attr, shape=[2 * H],
-                                 dtype=input.dtype, is_bias=True)
-    wc = helper.create_parameter(helper.param_attr, shape=[D + H, H],
-                                 dtype=input.dtype)
-    bc = helper.create_parameter(helper.bias_attr, shape=[H],
-                                 dtype=input.dtype, is_bias=True)
+
+    def _suffixed(attr, suffix):
+        # gru_unit owns TWO weight/bias pairs; a user-fixed attr name must
+        # not collide between them
+        from ..param_attr import ParamAttr
+        attr = ParamAttr._to_attr(attr)
+        if attr and attr.name:
+            import copy as _copy
+            attr = _copy.copy(attr)
+            attr.name = attr.name + suffix
+        return attr
+
+    wg = helper.create_parameter(_suffixed(helper.param_attr, ".gate"),
+                                 shape=[D + H, 2 * H], dtype=input.dtype)
+    bg = helper.create_parameter(_suffixed(helper.bias_attr, ".gate"),
+                                 shape=[2 * H], dtype=input.dtype,
+                                 is_bias=True)
+    wc = helper.create_parameter(_suffixed(helper.param_attr, ".cand"),
+                                 shape=[D + H, H], dtype=input.dtype)
+    bc = helper.create_parameter(_suffixed(helper.bias_attr, ".cand"),
+                                 shape=[H], dtype=input.dtype,
+                                 is_bias=True)
     h = helper.create_variable_for_type_inference(dtype=input.dtype)
     helper.append_op(
         type="gru_cell_fused",

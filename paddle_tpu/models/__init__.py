@@ -1,3 +1,4 @@
 """Model zoo covering the BASELINE.json configs: LeNet (1), ResNet (2),
 BERT/ERNIE (3), Wide&Deep CTR (4), DyGraph Transformer (5)."""
 from . import lenet, bert, resnet, widedeep, transformer  # noqa: F401
+from . import seq2seq  # noqa: F401

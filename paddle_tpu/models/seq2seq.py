@@ -1,0 +1,145 @@
+"""GRU seq2seq with beam-search decoding (BASELINE machine-translation
+class; reference pattern: tests/book/test_machine_translation.py —
+encoder/decoder over recurrent ops, decode via beam_search +
+beam_search_decode ops inside a decode loop).
+
+TPU-first: training unrolls through ONE lax.scan per RNN (StaticRNN);
+beam decode is a build-time loop over the static max decode length whose
+per-step expansion is the beam_search op (top-k over beam*vocab) and
+whose parent back-trace is gather_tree — everything static-shape, one XLA
+module."""
+import numpy as np
+
+from .. import layers
+from ..layers import math as M
+from ..layers import tensor as T
+from ..param_attr import ParamAttr
+from ..framework import initializer as I
+
+
+def _emb(ids, vocab, dim, name):
+    return layers.embedding(
+        ids, size=[vocab, dim],
+        param_attr=ParamAttr(name=name,
+                             initializer=I.Uniform(-0.1, 0.1)))
+
+
+def _gru_params(prefix):
+    return dict(param_attr=ParamAttr(name=f"{prefix}.w"),
+                bias_attr=ParamAttr(name=f"{prefix}.b",
+                                    initializer=I.Constant(0.0)))
+
+
+def encoder(src_ids, vocab, emb_dim, hidden, batch):
+    """src_ids [T, B] time-major -> final hidden state [B, H]."""
+    T_src = src_ids.shape[0]
+    # explicit [T, B, 1] id layout: the v1 lookup squeezes a trailing
+    # size-1 dim, which would otherwise eat the batch dim when B == 1
+    ids3 = T.reshape(src_ids, [T_src, batch, 1])
+    emb = _emb(ids3, vocab, emb_dim, "seq2seq.src_emb")    # [T, B, E]
+    h0 = T.fill_constant([batch, hidden], "float32", 0.0)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(emb)
+        h_prev = rnn.memory(init=h0)
+        h = layers.nn.gru_unit(x_t, h_prev, **_gru_params("seq2seq.enc"))
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    seq = rnn()                                 # [T, B, H]
+    last = T.reshape(T.slice(seq, axes=[0], starts=[T_src - 1],
+                             ends=[T_src]), [batch, hidden])
+    return last
+
+
+def _dec_logits(x_t, h_prev, vocab):
+    """One decoder step: GRU + projection. Returns (h, logits)."""
+    h = layers.nn.gru_unit(x_t, h_prev, **_gru_params("seq2seq.dec"))
+    logits = layers.fc(h, vocab,
+                       param_attr=ParamAttr(name="seq2seq.out.w"),
+                       bias_attr=ParamAttr(name="seq2seq.out.b",
+                                           initializer=I.Constant(0.0)))
+    return h, logits
+
+
+def seq2seq_train(src_vocab, tgt_vocab, emb_dim, hidden, T_src, T_tgt,
+                  batch):
+    """Teacher-forced training graph. Feeds: src [T_src, B] int64,
+    tgt_in/tgt_out [T_tgt, B] int64. Returns dict(loss=...)."""
+    src = T.data("src", [T_src, batch], dtype="int64")
+    tgt_in = T.data("tgt_in", [T_tgt, batch], dtype="int64")
+    tgt_out = T.data("tgt_out", [T_tgt, batch], dtype="int64")
+
+    enc_h = encoder(src, src_vocab, emb_dim, hidden, batch)
+    dec_emb = _emb(T.reshape(tgt_in, [T_tgt, batch, 1]), tgt_vocab,
+                   emb_dim, "seq2seq.tgt_emb")
+
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(dec_emb)
+        h_prev = rnn.memory(init=enc_h)
+        h, logits = _dec_logits(x_t, h_prev, tgt_vocab)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(logits)
+    logits_seq = rnn()                          # [T_tgt, B, V]
+    flat_logits = T.reshape(logits_seq, [T_tgt * batch, tgt_vocab])
+    flat_labels = T.reshape(tgt_out, [T_tgt * batch, 1])
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(flat_logits, flat_labels))
+    return {"loss": loss, "src": src, "tgt_in": tgt_in, "tgt_out": tgt_out}
+
+
+def seq2seq_beam_decode(src_vocab, tgt_vocab, emb_dim, hidden, T_src,
+                        max_len, beam_size, bos_id=1, eos_id=2):
+    """Beam-search decode graph for ONE source sentence (B=1; the demo
+    decode shape of the reference book test). Feeds: src [T_src, 1].
+    Returns the [max_len, 1, beam] token matrix variable (best beam =
+    column 0)."""
+    src = T.data("src", [T_src, 1], dtype="int64")
+    enc_h = encoder(src, src_vocab, emb_dim, hidden, 1)
+    # replicate the encoder state across the beam
+    state = layers.concat([enc_h] * beam_size, axis=0)   # [beam, H]
+    pre_ids = T.fill_constant([1, beam_size], "int64", float(bos_id))
+    # only beam 0 is live at t=0 — identical replicated states would
+    # otherwise tie in top_k and collapse the beam to greedy search
+    pre_scores = T.assign(np.asarray(
+        [[0.0] + [-1e30] * (beam_size - 1)], np.float32))
+
+    step_ids, step_parents = [], []
+    gb = src.block
+    for t in range(max_len):
+        ids_flat = T.reshape(pre_ids, [beam_size, 1])
+        x_t = T.reshape(_emb(ids_flat, tgt_vocab, emb_dim,
+                             "seq2seq.tgt_emb"), [beam_size, emb_dim])
+        state, logits = _dec_logits(x_t, state, tgt_vocab)  # [beam, V]
+        log_probs = layers.log_softmax(logits)
+        sel_ids = gb.create_var(name=f"bs.ids.{t}", dtype="int32",
+                                shape=(1, beam_size))
+        sel_scores = gb.create_var(name=f"bs.scores.{t}", dtype="float32",
+                                   shape=(1, beam_size))
+        parents = gb.create_var(name=f"bs.parents.{t}", dtype="int32",
+                                shape=(1, beam_size))
+        gb.append_op(
+            type="beam_search",
+            inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                    "scores": [log_probs]},
+            outputs={"selected_ids": [sel_ids],
+                     "selected_scores": [sel_scores],
+                     "parent_idx": [parents]},
+            attrs={"beam_size": beam_size, "end_id": eos_id},
+            infer_shape=False)
+        # reorder beam state by parent and continue with selected tokens
+        parent_row = T.reshape(parents, [beam_size])
+        state = layers.gather(state, parent_row)
+        state.shape = (beam_size, hidden)   # gather can't infer (int idx)
+        pre_ids = T.cast(sel_ids, "int64")
+        pre_scores = sel_scores
+        step_ids.append(T.reshape(sel_ids, [1, 1, beam_size]))
+        step_parents.append(T.reshape(parents, [1, 1, beam_size]))
+
+    ids_mat = layers.concat(step_ids, axis=0)        # [T, 1, beam]
+    parents_mat = layers.concat(step_parents, axis=0)
+    out = gb.create_var(name="bs.sequences", dtype="int32")
+    gb.append_op(type="gather_tree",
+                 inputs={"Ids": [ids_mat], "Parents": [parents_mat]},
+                 outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    return {"src": src, "sequences": out, "scores": pre_scores}

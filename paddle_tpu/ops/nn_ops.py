@@ -643,3 +643,31 @@ def _sparse_lookup_grad(ctx, ins, attrs):
 register_grad_lower("lookup_table")(_sparse_lookup_grad)
 register_grad_lower("lookup_table_v2")(_sparse_lookup_grad)
 register_grad_lower("embedding")(_sparse_lookup_grad)
+
+
+@register_op("spectral_norm")
+def spectral_norm(ctx, ins, attrs):
+    """Spectral weight normalization (reference spectral_norm_op.h):
+    power-iterate the largest singular value with the carried U/V vectors,
+    return W / sigma. U/V update functionally (UOut/VOut rebind)."""
+    w = x_of(ins, "Weight")
+    u = x_of(ins, "U")
+    v = x_of(ins, "V")
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def norm(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    for _ in range(max(power_iters, 1)):
+        v = norm(mat.T @ u)
+        u = norm(mat @ v)
+    # U/V are constants for the backward (reference spectral_norm_grad
+    # does not differentiate the power iteration)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (mat @ v)
+    return {"Out": w / sigma, "UOut": u, "VOut": v}

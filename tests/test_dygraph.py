@@ -339,3 +339,30 @@ def test_static_lstm_gru_units_in_rnn():
                                 fetch_list=[loss])[0])
                   for _ in range(25)]
     assert losses[-1] < 0.3 * losses[0], losses[::8]
+
+
+def test_new_dygraph_layer_classes():
+    """Conv2DTranspose / GroupNorm / PRelu / SpectralNorm forward + train
+    (reference dygraph/nn.py classes)."""
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    with fluid.dygraph.guard():
+        deconv = fluid.dygraph.Conv2DTranspose(4, 6, 3, stride=2,
+                                               padding=1)
+        gn = fluid.dygraph.GroupNorm(channels=6, groups=2)
+        prelu = fluid.dygraph.PRelu(mode="channel", channel=6)
+        x = fluid.dygraph.to_variable(xv)
+        h = prelu(gn(deconv(x)))
+        assert h.numpy().shape == (2, 6, 15, 15)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(h))
+        loss.backward()
+        assert deconv.weight.gradient() is not None
+        assert gn.weight.gradient() is not None
+        assert prelu.weight.gradient() is not None
+
+        # conv2d_transpose weight layout: [Cin, Cout/groups, kh, kw]
+        sn = fluid.dygraph.SpectralNorm([4, 6, 3, 3], power_iters=2)
+        wn = sn(deconv.weight)
+        w = wn.numpy().reshape(4, -1)
+        # largest singular value normalized to ~1
+        assert abs(np.linalg.svd(w, compute_uv=False)[0] - 1.0) < 0.2
