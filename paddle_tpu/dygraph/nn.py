@@ -7,6 +7,7 @@ import numpy as np
 from ..framework import initializer as I
 from ..framework.dtype import np_dtype, convert_dtype
 from ..layers.layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 from .base import VarBase, _current_tracer
 from .layers import Layer
 
@@ -377,13 +378,11 @@ class SpectralNorm(Layer):
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  dtype="float32"):
         super().__init__(dtype=dtype)
-        from ..param_attr import ParamAttr
         self._dim = dim
         self._power_iters = max(int(power_iters), 1)
         self._eps = eps
         h = weight_shape[dim]
-        import numpy as _np
-        w = int(_np.prod(weight_shape)) // h
+        w = int(np.prod(weight_shape)) // h
         buf = ParamAttr(trainable=False)
         self.weight_u = self.create_parameter(
             [h], attr=buf, dtype=dtype,

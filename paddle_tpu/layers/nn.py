@@ -714,3 +714,41 @@ def ring_attention(q, k, v, attn_bias=None, scale=0.0, mechanism="ring",
     out.shape = tuple(q.shape or ())
     out.dtype = q.dtype
     return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
+                name=None):
+    """One beam expansion step (reference layers/rnn.py beam_search ->
+    beam_search_op). Returns (selected_ids [B, beam] int32,
+    selected_scores [B, beam], parent_idx [B, beam] int32)."""
+    helper = LayerHelper("beam_search", name=name)
+    B = pre_ids.shape[0] if pre_ids.shape else -1
+    outs = []
+    for suffix, dtype in (("ids", "int32"), ("scores", "float32"),
+                          ("parents", "int32")):
+        outs.append(helper.block.create_var(
+            name=f"{helper.name}.{suffix}", dtype=dtype,
+            shape=(B, beam_size)))
+    helper.append_op(
+        type="beam_search",
+        inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+                "scores": [scores]},
+        outputs={"selected_ids": [outs[0]],
+                 "selected_scores": [outs[1]],
+                 "parent_idx": [outs[2]]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id)},
+        infer_shape=False)
+    return tuple(outs)
+
+
+def gather_tree(ids, parents, name=None):
+    """Back-trace beam parents into sequences (reference
+    layers gather_tree -> gather_tree_op). ids/parents [T, B, beam]."""
+    helper = LayerHelper("gather_tree", name=name)
+    out = helper.block.create_var(name=f"{helper.name}.out",
+                                  dtype="int32",
+                                  shape=tuple(ids.shape or ()))
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": [ids], "Parents": [parents]},
+                     outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    return out

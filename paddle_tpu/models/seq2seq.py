@@ -105,32 +105,16 @@ def seq2seq_beam_decode(src_vocab, tgt_vocab, emb_dim, hidden, T_src,
         [[0.0] + [-1e30] * (beam_size - 1)], np.float32))
 
     step_ids, step_parents = [], []
-    gb = src.block
     for t in range(max_len):
         ids_flat = T.reshape(pre_ids, [beam_size, 1])
         x_t = T.reshape(_emb(ids_flat, tgt_vocab, emb_dim,
                              "seq2seq.tgt_emb"), [beam_size, emb_dim])
         state, logits = _dec_logits(x_t, state, tgt_vocab)  # [beam, V]
         log_probs = layers.log_softmax(logits)
-        sel_ids = gb.create_var(name=f"bs.ids.{t}", dtype="int32",
-                                shape=(1, beam_size))
-        sel_scores = gb.create_var(name=f"bs.scores.{t}", dtype="float32",
-                                   shape=(1, beam_size))
-        parents = gb.create_var(name=f"bs.parents.{t}", dtype="int32",
-                                shape=(1, beam_size))
-        gb.append_op(
-            type="beam_search",
-            inputs={"pre_ids": [pre_ids], "pre_scores": [pre_scores],
-                    "scores": [log_probs]},
-            outputs={"selected_ids": [sel_ids],
-                     "selected_scores": [sel_scores],
-                     "parent_idx": [parents]},
-            attrs={"beam_size": beam_size, "end_id": eos_id},
-            infer_shape=False)
+        sel_ids, sel_scores, parents = layers.nn.beam_search(
+            pre_ids, pre_scores, log_probs, beam_size, end_id=eos_id)
         # reorder beam state by parent and continue with selected tokens
-        parent_row = T.reshape(parents, [beam_size])
-        state = layers.gather(state, parent_row)
-        state.shape = (beam_size, hidden)   # gather can't infer (int idx)
+        state = layers.gather(state, T.reshape(parents, [beam_size]))
         pre_ids = T.cast(sel_ids, "int64")
         pre_scores = sel_scores
         step_ids.append(T.reshape(sel_ids, [1, 1, beam_size]))
@@ -138,8 +122,5 @@ def seq2seq_beam_decode(src_vocab, tgt_vocab, emb_dim, hidden, T_src,
 
     ids_mat = layers.concat(step_ids, axis=0)        # [T, 1, beam]
     parents_mat = layers.concat(step_parents, axis=0)
-    out = gb.create_var(name="bs.sequences", dtype="int32")
-    gb.append_op(type="gather_tree",
-                 inputs={"Ids": [ids_mat], "Parents": [parents_mat]},
-                 outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    out = layers.nn.gather_tree(ids_mat, parents_mat)
     return {"src": src, "sequences": out, "scores": pre_scores}
