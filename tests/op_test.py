@@ -23,6 +23,17 @@ def _as_pairs(slot, value):
     return [(slot.lower(), np.asarray(value))]
 
 
+def make_op_test(op_type, inputs, attrs, outputs):
+    """Build a one-off OpTest without declaring a subclass (shared by the
+    table-style op test files)."""
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
 class OpTest:
     """Subclass sets: self.op_type, self.inputs, self.attrs (optional),
     self.outputs. Call check_output() / check_grad([...], "Out")."""

@@ -3,7 +3,7 @@ tests/unittests/test_metrics.py, test_precision_recall_op.py)."""
 import numpy as np
 
 import paddle_tpu as fluid
-from op_test import OpTest
+from op_test import OpTest, make_op_test
 
 
 def test_precision_metric():
@@ -108,13 +108,13 @@ def test_precision_recall_op():
     batch_m, batch_s = _pr_ref(idx, label, C)
     accum_m, accum_s = _pr_ref(idx, label, C, states)
 
-    t = OpTest.__new__(OpTest)
-    t.op_type = "precision_recall"
-    t.inputs = {"Indices": idx, "Labels": ("labels", label),
-                "Weights": ("w", np.ones(32, np.float32)),
-                "StatesInfo": ("states", states)}
-    t.attrs = {"class_number": C}
-    t.outputs = {"BatchMetrics": batch_m.astype(np.float32),
-                 "AccumMetrics": accum_m.astype(np.float32),
-                 "AccumStatesInfo": accum_s.astype(np.float32)}
+    t = make_op_test(
+        "precision_recall",
+        {"Indices": idx, "Labels": ("labels", label),
+         "Weights": ("w", np.ones(32, np.float32)),
+         "StatesInfo": ("states", states)},
+        {"class_number": C},
+        {"BatchMetrics": batch_m.astype(np.float32),
+         "AccumMetrics": accum_m.astype(np.float32),
+         "AccumStatesInfo": accum_s.astype(np.float32)})
     t.check_output(atol=1e-5)

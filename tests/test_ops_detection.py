@@ -4,18 +4,9 @@ test_yolo_box_op.py, test_multiclass_nms_op.py, test_iou_similarity_op.py,
 test_roi_align_op.py, test_anchor_generator_op.py)."""
 import numpy as np
 
-from op_test import OpTest
+from op_test import OpTest, make_op_test as _t
 
 RNG = np.random.default_rng(11)
-
-
-def _t(op_type, inputs, attrs, outputs):
-    t = OpTest.__new__(OpTest)
-    t.op_type = op_type
-    t.inputs = inputs
-    t.attrs = attrs
-    t.outputs = outputs
-    return t
 
 
 def _iou_ref(a, b):

@@ -3,18 +3,9 @@ search (reference pattern: per-op unittests, test_warpctc_op.py,
 test_linear_chain_crf_op.py, test_beam_search_op.py)."""
 import numpy as np
 
-from op_test import OpTest
+from op_test import OpTest, make_op_test as _t
 
 RNG = np.random.default_rng(21)
-
-
-def _t(op_type, inputs, attrs, outputs):
-    t = OpTest.__new__(OpTest)
-    t.op_type = op_type
-    t.inputs = inputs
-    t.attrs = attrs
-    t.outputs = outputs
-    return t
 
 
 def test_minus_and_cos_sim():

@@ -1,0 +1,204 @@
+"""Numeric-gradient checks for newer differentiable ops (reference
+pattern: op_test.py check_grad over finite differences) — RNN cells,
+spectral norm, roi_align, MoE."""
+import numpy as np
+
+from op_test import OpTest, make_op_test as _t
+
+RNG = np.random.default_rng(33)
+
+
+def test_lstm_cell_fused_grads():
+    B, D, H = 3, 4, 5
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    h = RNG.standard_normal((B, H)).astype(np.float32) * 0.5
+    c = RNG.standard_normal((B, H)).astype(np.float32) * 0.5
+    w = RNG.standard_normal((D + H, 4 * H)).astype(np.float32) * 0.3
+    b = RNG.standard_normal(4 * H).astype(np.float32) * 0.1
+
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    gates = np.concatenate([x, h], axis=1) @ w + b
+    i, f, ch, o = np.split(gates, 4, axis=1)
+    c_new = sigmoid(f) * c + sigmoid(i) * np.tanh(ch)
+    h_new = sigmoid(o) * np.tanh(c_new)
+    t = _t("lstm_cell_fused",
+           {"X": x, "HPrev": ("hprev", h), "CPrev": ("cprev", c),
+            "W": ("w", w), "B": ("b", b)},
+           {"forget_bias": 0.0},
+           {"H": h_new.astype(np.float32), "C": c_new.astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "W"], "H", max_relative_error=0.03)
+
+
+def test_gru_cell_fused_grads():
+    B, D, H = 3, 4, 5
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    h = RNG.standard_normal((B, H)).astype(np.float32) * 0.5
+    wg = RNG.standard_normal((D + H, 2 * H)).astype(np.float32) * 0.3
+    bg = RNG.standard_normal(2 * H).astype(np.float32) * 0.1
+    wc = RNG.standard_normal((D + H, H)).astype(np.float32) * 0.3
+    bc = RNG.standard_normal(H).astype(np.float32) * 0.1
+
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    gates = sigmoid(np.concatenate([x, h], axis=1) @ wg + bg)
+    u, r = np.split(gates, 2, axis=1)
+    cand = np.tanh(np.concatenate([x, r * h], axis=1) @ wc + bc)
+    h_new = u * cand + (1 - u) * h      # reference default orientation
+    t = _t("gru_cell_fused",
+           {"X": x, "HPrev": ("hprev", h), "WGate": ("wg", wg),
+            "BGate": ("bg", bg), "WCand": ("wc", wc), "BCand": ("bc", bc)},
+           {},
+           {"H": h_new.astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "WGate", "WCand"], "H", max_relative_error=0.03)
+
+
+def test_spectral_norm_grad():
+    """W grad with U/V held constant (the op stop-gradients the power
+    iteration, as the reference grad kernel does) — so the numeric side
+    is FD of a numpy surrogate with the converged u1/v1 frozen, not FD
+    of the op itself."""
+    w = RNG.standard_normal((4, 6)).astype(np.float32)
+    u = RNG.standard_normal(4).astype(np.float32)
+    v = RNG.standard_normal(6).astype(np.float32)
+
+    def norm(x):
+        return x / (np.linalg.norm(x) + 1e-12)
+
+    v1 = norm(w.T @ u)
+    u1 = norm(w @ v1)
+
+    def f(wp):                      # surrogate: u1/v1 frozen
+        sigma = u1 @ (wp @ v1)
+        o = wp / sigma
+        return float(np.sum(o * o))
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        for n, a in (("w", w), ("u", u), ("v", v)):
+            gb.create_var(name=n, shape=a.shape, dtype="float32",
+                          is_data=True)
+        w_var = gb.var("w")
+        w_var.stop_gradient = False
+        out = gb.create_var(name="o", dtype="float32")
+        uo = gb.create_var(name="uo", dtype="float32")
+        vo = gb.create_var(name="vo", dtype="float32")
+        gb.append_op(type="spectral_norm",
+                     inputs={"Weight": ["w"], "U": ["u"], "V": ["v"]},
+                     outputs={"Out": [out], "UOut": [uo], "VOut": [vo]},
+                     attrs={"dim": 0, "power_iters": 1, "eps": 1e-12},
+                     infer_shape=False)
+        loss = layers.reduce_sum(layers.elementwise_mul(gb.var("o"),
+                                                        gb.var("o")))
+        (gw,) = fluid.gradients(loss, [w_var])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g, o_val = exe.run(main, feed={"w": w, "u": u, "v": v},
+                           fetch_list=[gw, "o"])
+    sigma = u1 @ (w @ v1)
+    np.testing.assert_allclose(np.asarray(o_val), w / sigma,
+                               rtol=1e-5, atol=1e-5)
+    g = np.asarray(g)
+    num = np.zeros_like(w)
+    eps = 1e-3
+    for i in range(w.shape[0]):
+        for j in range(w.shape[1]):
+            wp = w.copy()
+            wp[i, j] += eps
+            hi = f(wp)
+            wp[i, j] -= 2 * eps
+            lo = f(wp)
+            num[i, j] = (hi - lo) / (2 * eps)
+    np.testing.assert_allclose(g, num, rtol=0.02, atol=1e-3)
+
+
+def test_roi_align_grad():
+    x = RNG.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    rois = np.array([[0.5, 0.5, 5.0, 5.0],
+                     [1.0, 2.0, 4.0, 5.5]], np.float32)
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="x", shape=x.shape, dtype="float32",
+                      is_data=True)
+        gb.create_var(name="rois", shape=rois.shape, dtype="float32",
+                      is_data=True)
+        x_var = gb.var("x")
+        x_var.stop_gradient = False
+        out = gb.create_var(name="out", dtype="float32")
+        gb.append_op(type="roi_align",
+                     inputs={"X": ["x"], "ROIs": ["rois"]},
+                     outputs={"Out": [out]},
+                     attrs={"pooled_height": 2, "pooled_width": 2,
+                            "spatial_scale": 1.0, "sampling_ratio": 2},
+                     infer_shape=False)
+        from paddle_tpu import layers
+        loss = layers.reduce_sum(layers.elementwise_mul(gb.var("out"),
+                                                        gb.var("out")))
+        (gx,) = fluid.gradients(loss, [x_var])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        g, base = exe.run(main, feed={"x": x, "rois": rois},
+                          fetch_list=[gx, loss])
+        # numeric spot-check on 5 random coordinates
+        g = np.asarray(g)
+        rng2 = np.random.default_rng(1)
+        for _ in range(5):
+            idx = tuple(rng2.integers(0, s) for s in x.shape)
+            eps = 1e-3
+            xp = x.copy()
+            xp[idx] += eps
+            hi, = exe.run(main, feed={"x": xp, "rois": rois},
+                          fetch_list=[loss])
+            xp[idx] -= 2 * eps
+            lo, = exe.run(main, feed={"x": xp, "rois": rois},
+                          fetch_list=[loss])
+            num = (float(np.asarray(hi)) - float(np.asarray(lo))) / (2 * eps)
+            np.testing.assert_allclose(g[idx], num, rtol=0.05, atol=1e-3)
+
+
+def test_switch_moe_grads_flow_to_experts_and_gate():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    N, D, E, H = 16, 6, 4, 8
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [N, D], dtype="float32")
+        x.stop_gradient = False
+        out, aux = layers.nn.switch_moe(x, num_experts=E, d_hidden=H,
+                                        capacity_factor=2.0)
+        loss = layers.elementwise_add(
+            layers.reduce_sum(layers.elementwise_mul(out, out)),
+            layers.scale(aux, 0.1))
+        params = [p.name for p in main.all_parameters()]
+        grads = fluid.gradients(loss, [main.global_block().var(p)
+                                       for p in params])
+    assert all(g is not None for g in grads), \
+        [p for p, g in zip(params, grads) if g is None]
+    exe = fluid.Executor()
+    rng = np.random.default_rng(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        vals = exe.run(main,
+                       feed={"x": rng.standard_normal(
+                           (N, D)).astype(np.float32)},
+                       fetch_list=[g for g in grads])
+    # every expert weight, gate, and bias receives a finite gradient
+    for name, v in zip(params, vals):
+        v = np.asarray(v)
+        assert np.isfinite(v).all(), name
+    # the W1/W2 stacked expert grads are nonzero for at least one expert
+    w1_grad = next(np.asarray(v) for n, v in zip(params, vals)
+                   if ".w" in n and np.asarray(v).ndim == 3)
+    assert np.any(w1_grad != 0)

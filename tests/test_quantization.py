@@ -7,7 +7,7 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.contrib.slim.quantization import (
     PostTrainingQuantization, QuantizationTransformPass)
-from op_test import OpTest
+from op_test import OpTest, make_op_test
 
 
 def _fake_quant_ref(x, bits=8):
@@ -18,12 +18,10 @@ def _fake_quant_ref(x, bits=8):
 
 def test_fake_quantize_abs_max_op():
     x = np.random.default_rng(0).standard_normal((8, 6)).astype(np.float32)
-    t = OpTest.__new__(OpTest)
-    t.op_type = "fake_quantize_abs_max"
-    t.inputs = {"X": x}
-    t.attrs = {"bit_length": 8}
-    t.outputs = {"Out": _fake_quant_ref(x).astype(np.float32),
-                 "OutScale": np.array([np.abs(x).max()], np.float32)}
+    t = make_op_test(
+        "fake_quantize_abs_max", {"X": x}, {"bit_length": 8},
+        {"Out": _fake_quant_ref(x).astype(np.float32),
+         "OutScale": np.array([np.abs(x).max()], np.float32)})
     t.check_output(atol=1e-6)
 
 
