@@ -1,0 +1,6 @@
+"""Pallas TPU kernels: hand-written kernels for the hot ops where XLA's
+default lowering leaves performance on the table (SURVEY §7 "Pallas kernels
+only where XLA underperforms"). Each kernel ships with an XLA composite
+fallback so every op runs on any backend; the Pallas path is selected on
+TPU."""
+from .flash_attention import flash_attention  # noqa: F401

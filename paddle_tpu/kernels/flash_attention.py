@@ -1,0 +1,363 @@
+"""Flash attention as a Pallas TPU kernel (fwd + bwd), with XLA fallback.
+
+The reference's attention story is hand-fused CUDA
+(operators/fused/multihead_matmul_op.cu — QKV matmul + softmax fused for
+V100); the TPU-native equivalent is a blockwise online-softmax kernel that
+never materializes the [Sq, Sk] score matrix in HBM: scores for one
+(q-block, k-block) tile live in VMEM, folded into running (max, normalizer,
+accumulator) state — O(S) memory instead of O(S^2), and the score/softmax
+work stays fused with both matmuls on the MXU/VPU.
+
+Kernels grid over (batch, head, q-block, k-block) so Pallas's automatic
+pipelining double-buffers the K/V block DMAs against compute; the online
+state (m, l, acc) lives in VMEM scratch, carried across the innermost
+k-block grid steps and finalized on the last one.
+
+Layout: q [B, H, Sq, D], k/v [B, H, Sk, D], optional additive key-position
+bias [B, 1, 1, Sk] (the BERT padding-mask layout), optional causal masking.
+The bias is treated as a constant mask (zero cotangent) — masks are data,
+not parameters, in every caller in this framework.
+
+Backward follows the standard two-kernel flash decomposition: a dq kernel
+gridded over q-blocks (innermost: k-blocks) and a dk/dv kernel gridded over
+k-blocks (innermost: q-blocks), both recomputing p = exp(s - lse) from the
+saved log-sum-exp rather than storing probabilities.
+
+impl selection: "pallas" (TPU compiled), "interpret" (Pallas interpreter —
+exercises the real kernel on CPU, used by tests), "xla" (composite fallback,
+exact same math). Default: pallas on TPU backends, xla elsewhere.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128   # m/l scratch is stored lane-broadcast to keep the VPU happy
+
+
+def _auto_impl():
+    backend = jax.default_backend()
+    return "pallas" if backend in ("tpu", "axon") else "xla"
+
+
+def _block_sizes(sq, sk, bq, bk):
+    bq = bq or (256 if sq % 256 == 0 else (128 if sq % 128 == 0 else sq))
+    bk = bk or (512 if sk % 512 == 0 else (128 if sk % 128 == 0 else sk))
+    if sq % bq or sk % bk:
+        raise ValueError(
+            f"flash_attention: Sq={sq}/Sk={sk} must divide block sizes "
+            f"({bq}, {bk}); pad the sequence")
+    return bq, bk
+
+
+def _causal_mask(s, qi, ki, bq, bk):
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _block_live(causal, qi, ki, bq, bk):
+    """Whether k-block ki intersects the causal lower triangle of q-block
+    qi (always true without causal)."""
+    if not causal:
+        return True
+    return ki * bk <= qi * bq + bq - 1
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                m_sc, l_sc, acc_sc, *, scale, bq, bk, nk, causal):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    @pl.when(_block_live(causal, qi, ki, bq, bk))
+    def _fold():
+        q = q_ref[0, 0]                                    # [bq, D]
+        k_blk = k_ref[0, 0]                                # [bk, D]
+        v_blk = v_ref[0, 0]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk)
+        m_prev = m_sc[:, :1]                               # [bq, 1]
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_sc[:, :1]
+        out_ref[0, 0] = (acc_sc[:] / l).astype(out_ref.dtype)
+        # lse rows live on lanes ([B, H, 1, Sq] avoids the 128x lane
+        # padding a trailing-1 dim would get); (bq,1)->(1,bq) reshape
+        lse_ref[0, 0] = (m_sc[:, :1] + jnp.log(l)).reshape(1, -1)
+
+
+def _fwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _block_sizes(Sq, Sk, bq, bk)
+    nq, nk = Sq // bq, Sk // bk
+
+    body = functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk,
+                             nk=nk, causal=causal)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, j: (b, 0, 0, j)))
+        args.append(bias)
+        kern = body
+    else:
+        def kern(q_ref, k_ref, v_ref, out_ref, lse_ref, m, l, acc):
+            body(q_ref, k_ref, v_ref, None, out_ref, lse_ref, m, l, acc)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=(B, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_sc, *, scale, bq, bk, nk, causal):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    @pl.when(_block_live(causal, qi, ki, bq, bk))
+    def _fold():
+        q = q_ref[0, 0]                                    # [bq, D]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0].reshape(-1, 1)                 # [1,bq]->[bq,1]
+        delta = delta_ref[0, 0].reshape(-1, 1)
+        k_blk = k_ref[0, 0]                                # [bk, D]
+        v_blk = v_ref[0, 0]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, scale, bq, bk, nq, causal):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    @pl.when(_block_live(causal, qi, ki, bq, bk))
+    def _fold():
+        k_blk = k_ref[0, 0]                                # [bk, D]
+        v_blk = v_ref[0, 0]
+        q = q_ref[0, 0]                                    # [bq, D]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0].reshape(-1, 1)                 # [1,bq]->[bq,1]
+        delta = delta_ref[0, 0].reshape(-1, 1)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            s = _causal_mask(s, qi, ki, bq, bk)
+        p = jnp.exp(s - lse)
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret,
+                out, lse, do):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq, bk = _block_sizes(Sq, Sk, bq, bk)
+    nq, nk = Sq // bq, Sk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]                # [B, H, 1, Sq]
+
+    qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kspec_i = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    rspec = pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i))
+
+    dq_body = functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk,
+                                nk=nk, causal=causal)
+    dq_specs = [qspec, kspec_i, kspec_i]
+    dq_args = [q, k, v]
+    if bias is not None:
+        dq_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, j: (b, 0, 0, j)))
+        dq_args.append(bias)
+        dq_kern = dq_body
+    else:
+        def dq_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dq_ref, dq_sc):
+            dq_body(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+                    dq_ref, dq_sc)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(B, H, nq, nk),
+        in_specs=dq_specs + [qspec, rspec, rspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(*dq_args, do, lse, delta)
+
+    # dkv: k-block is the outer (carried) dim, q-blocks stream innermost
+    kspec_o = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    qspec_i = pl.BlockSpec((1, 1, bq, D), lambda b, h, j, i: (b, h, i, 0))
+    rspec_i = pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i))
+    dkv_body = functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk,
+                                 nq=nq, causal=causal)
+    dkv_specs = [qspec_i, kspec_o, kspec_o]
+    dkv_args = [q, k, v]
+    if bias is not None:
+        dkv_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, j, i: (b, 0, 0, j)))
+        dkv_args.append(bias)
+        dkv_kern = dkv_body
+    else:
+        def dkv_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_sc, dv_sc):
+            dkv_body(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_sc, dv_sc)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(B, H, nk, nq),
+        in_specs=dkv_specs + [qspec_i, rspec_i, rspec_i],
+        out_specs=[kspec_o, kspec_o],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(*dkv_args, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------- public entry
+
+def _xla_attention(q, k, v, bias, scale, causal):
+    """Composite fallback: identical math, materialized scores."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        # match the Pallas path's constant-mask contract (zero cotangent)
+        s = s + jax.lax.stop_gradient(bias).astype(s.dtype)
+    if causal:
+        Sq, Sk = q.shape[2], k.shape[2]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, scale, causal, bq, bk, interpret):
+    out, _ = _fwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, bq, bk, interpret):
+    out, lse = _fwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(scale, causal, bq, bk, interpret, res, do):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _bwd_pallas(q, k, v, bias, scale, causal, bq, bk,
+                             interpret, out, lse, do)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, bias=None, scale=None, causal=False,
+                    impl=None, block_q=None, block_k=None):
+    """Blockwise fused attention. q [B,H,Sq,D], k/v [B,H,Sk,D], optional
+    additive key bias [B,1,1,Sk] (constant — zero cotangent). Returns
+    [B,H,Sq,D]. impl: None (auto), "pallas", "interpret", "xla"."""
+    if scale is None or scale == 0.0:
+        scale = float(q.shape[-1]) ** -0.5
+    impl = impl or _auto_impl()
+    if bias is not None and (bias.ndim != 4 or bias.shape[1] != 1
+                             or bias.shape[2] != 1):
+        impl = "xla"   # general [B,H,Sq,Sk] bias: composite path
+    if impl == "xla":
+        return _xla_attention(q, k, v, bias, scale, causal)
+    return _flash(q, k, v, bias, float(scale), bool(causal),
+                  block_q, block_k, impl == "interpret")

@@ -716,6 +716,28 @@ def ring_attention(q, k, v, attn_bias=None, scale=0.0, mechanism="ring",
     return out
 
 
+def flash_attention(q, k, v, attn_bias=None, scale=0.0, causal=False,
+                    impl=None, name=None):
+    """Fused blockwise attention (Pallas kernel on TPU; exact XLA composite
+    elsewhere). q/k/v: [B, n_head, S, d_head]; attn_bias: optional additive
+    key mask [B, 1, 1, S] (constant — no gradient flows to it). Never
+    materializes the [S, S] score matrix in HBM on the Pallas path."""
+    helper = LayerHelper("flash_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    ins = {"Q": [q], "K": [k], "V": [v]}
+    if attn_bias is not None:
+        ins["Bias"] = [attn_bias]
+    helper.append_op(
+        type="flash_attention", inputs=ins,
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "causal": bool(causal),
+               "impl": impl or ""},
+        infer_shape=False)
+    out.shape = tuple(q.shape or ())
+    out.dtype = q.dtype
+    return out
+
+
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
                 name=None):
     """One beam expansion step (reference layers/rnn.py beam_search ->

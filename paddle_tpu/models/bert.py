@@ -36,9 +36,10 @@ class BertConfig:
     hidden_dropout: float = 0.1
     attn_dropout: float = 0.1
     initializer_range: float = 0.02
-    # None = plain attention; "ring"/"ulysses" = sequence-parallel
-    # attention over the sp mesh axis (ops/ring_attention_ops.py). Both
-    # skip attention dropout (flash-style fused softmax path).
+    # None = plain attention; "flash" = single-device Pallas flash kernel
+    # (kernels/flash_attention.py); "ring"/"ulysses" = sequence-parallel
+    # attention over the sp mesh axis (ops/ring_attention_ops.py). All
+    # three skip attention dropout (flash-style fused softmax path).
     attn_mechanism: str = None
 
     @staticmethod
@@ -95,7 +96,9 @@ def encoder_layer(cfg, x, attn_bias, idx, is_test):
     k = T.reshape(k, [-1, n_head, seq, d_head])
     v = T.reshape(v, [-1, n_head, seq, d_head])
 
-    if cfg.attn_mechanism:
+    if cfg.attn_mechanism == "flash":
+        ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias)
+    elif cfg.attn_mechanism:
         # sequence-parallel attention: K/V ring rotation or Ulysses
         # all-to-all over "sp"; exact flash-style softmax, no attn dropout
         ctx = layers.nn.ring_attention(q, k, v, attn_bias=attn_bias,

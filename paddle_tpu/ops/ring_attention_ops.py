@@ -127,6 +127,29 @@ def ring_attention(ctx, ins, attrs):
     return {"Out": mapped(q, k, v, bias)}
 
 
+@register_op("flash_attention", infer_shape=False)
+def flash_attention_op(ctx, ins, attrs):
+    """Single-device fused attention via the Pallas flash kernel
+    (kernels/flash_attention.py) — the TPU-native equivalent of the
+    reference's fused CUDA attention
+    (operators/fused/multihead_matmul_op.cu). inputs: Q, K, V
+    [B, H, S, D] (+ optional additive key Bias [B, 1, 1, S], treated as a
+    constant mask); attrs: scale (default 1/sqrt(D)), causal, impl
+    ("" = auto: Pallas on TPU, XLA composite elsewhere)."""
+    from ..kernels.flash_attention import flash_attention as _fa
+
+    q = x_of(ins, "Q")
+    k = x_of(ins, "K")
+    v = x_of(ins, "V")
+    bias = ins.get("Bias")
+    bias = bias[0] if bias else None
+    scale = float(attrs.get("scale", 0.0)) or None
+    out = _fa(q, k, v, bias, scale=scale,
+              causal=bool(attrs.get("causal", False)),
+              impl=attrs.get("impl") or None)
+    return {"Out": out}
+
+
 @register_op("ulysses_attention", infer_shape=False)
 def ulysses_attention(ctx, ins, attrs):
     """Ulysses-style sequence parallelism (the all-to-all alternative to

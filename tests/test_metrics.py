@@ -3,7 +3,7 @@ tests/unittests/test_metrics.py, test_precision_recall_op.py)."""
 import numpy as np
 
 import paddle_tpu as fluid
-from op_test import OpTest, make_op_test
+from op_test import make_op_test
 
 
 def test_precision_metric():

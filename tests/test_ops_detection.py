@@ -4,7 +4,7 @@ test_yolo_box_op.py, test_multiclass_nms_op.py, test_iou_similarity_op.py,
 test_roi_align_op.py, test_anchor_generator_op.py)."""
 import numpy as np
 
-from op_test import OpTest, make_op_test as _t
+from op_test import make_op_test as _t
 
 RNG = np.random.default_rng(11)
 

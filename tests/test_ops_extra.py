@@ -3,7 +3,7 @@ search (reference pattern: per-op unittests, test_warpctc_op.py,
 test_linear_chain_crf_op.py, test_beam_search_op.py)."""
 import numpy as np
 
-from op_test import OpTest, make_op_test as _t
+from op_test import make_op_test as _t
 
 RNG = np.random.default_rng(21)
 

@@ -3,7 +3,7 @@ pattern: op_test.py check_grad over finite differences) — RNN cells,
 spectral norm, roi_align, MoE."""
 import numpy as np
 
-from op_test import OpTest, make_op_test as _t
+from op_test import make_op_test as _t
 
 RNG = np.random.default_rng(33)
 

@@ -7,7 +7,7 @@ import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.contrib.slim.quantization import (
     PostTrainingQuantization, QuantizationTransformPass)
-from op_test import OpTest, make_op_test
+from op_test import make_op_test
 
 
 def _fake_quant_ref(x, bits=8):
