@@ -353,9 +353,15 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     [B,H,Sq,D]. impl: None (auto), "pallas", "interpret", "xla"."""
     if scale is None or scale == 0.0:
         scale = float(q.shape[-1]) ** -0.5
+    requested = impl
     impl = impl or _auto_impl()
     if bias is not None and (bias.ndim != 4 or bias.shape[1] != 1
                              or bias.shape[2] != 1):
+        if requested in ("pallas", "interpret"):
+            raise ValueError(
+                f"flash_attention impl={requested!r} supports only a "
+                f"[B, 1, 1, Sk] key bias, got {tuple(bias.shape)}; use a "
+                f"key mask (+ causal=True for causality) or impl='xla'")
         impl = "xla"   # general [B,H,Sq,Sk] bias: composite path
     if impl == "xla":
         return _xla_attention(q, k, v, bias, scale, causal)

@@ -86,15 +86,17 @@ def encoder_layer(cfg, x, attn_bias, idx, is_test):
 
     # --- self attention ---
     qkv = _fc(x, 3 * h, f"{pre}_multi_head_att_qkv")          # [B,S,3H]
-    qkv = T.reshape(qkv, [0, 0, 3, n_head, d_head])
-    qkv = T.transpose(qkv, [2, 0, 3, 1, 4])                    # [3,B,nH,S,dH]
-    q = T.slice(qkv, axes=[0], starts=[0], ends=[1])
-    k = T.slice(qkv, axes=[0], starts=[1], ends=[2])
-    v = T.slice(qkv, axes=[0], starts=[2], ends=[3])
-    seq = x.shape[1]
-    q = T.reshape(q, [-1, n_head, seq, d_head])                # drop lead 1
-    k = T.reshape(k, [-1, n_head, seq, d_head])
-    v = T.reshape(v, [-1, n_head, seq, d_head])
+    # slice q/k/v off the fused projection FIRST, then transpose each to
+    # [B,nH,S,dH]: a single transpose feeding a batched matmul folds into
+    # the dot's dimension numbers, while the 5-D stack transpose
+    # ([3,B,nH,S,dH]) materializes a full copy of all three tensors per
+    # layer (XLA cannot fold through the stack+slice)
+    q = T.slice(qkv, axes=[2], starts=[0], ends=[h])
+    k = T.slice(qkv, axes=[2], starts=[h], ends=[2 * h])
+    v = T.slice(qkv, axes=[2], starts=[2 * h], ends=[3 * h])
+    q = T.transpose(T.reshape(q, [0, 0, n_head, d_head]), [0, 2, 1, 3])
+    k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]), [0, 2, 1, 3])
+    v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]), [0, 2, 1, 3])
 
     if cfg.attn_mechanism == "flash":
         ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias)
