@@ -67,6 +67,17 @@ def eager_initialize(initializer, shape, dtype="float32"):
         f"eager init for {type(initializer).__name__}")
 
 
+class HookRemoveHelper:
+    """Handle returned by register_forward_*_hook; .remove() detaches."""
+
+    def __init__(self, store, hid):
+        self._store = store
+        self._hid = hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)
+
+
 class Layer:
     """Module base: owns parameters + sublayers, tracks train/eval mode."""
 
@@ -77,6 +88,9 @@ class Layer:
         self._parameters = {}
         self._buffers = {}       # non-trainable state (BN running stats)
         self._sub_layers = {}
+        self._forward_pre_hooks = {}
+        self._forward_post_hooks = {}
+        self._hook_counter = 0
         self.training = True
 
     def full_name(self):
@@ -197,8 +211,33 @@ class Layer:
         for p in self.parameters():
             p.clear_gradient()
 
+    # ---- forward hooks (reference dygraph/layers.py:60
+    # register_forward_pre_hook / register_forward_post_hook) ----
+    def register_forward_pre_hook(self, hook):
+        """hook(layer, inputs) -> None | new inputs (tuple or single)."""
+        return self._register_hook(self._forward_pre_hooks, hook)
+
+    def register_forward_post_hook(self, hook):
+        """hook(layer, inputs, output) -> None | new output."""
+        return self._register_hook(self._forward_post_hooks, hook)
+
+    def _register_hook(self, store, hook):
+        hid = self._hook_counter
+        self._hook_counter += 1
+        store[hid] = hook
+        return HookRemoveHelper(store, hid)
+
     def __call__(self, *args, **kwargs):
-        return self.forward(*args, **kwargs)
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

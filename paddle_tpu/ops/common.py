@@ -50,3 +50,25 @@ def normalize_padding(paddings, n_spatial):
     if len(p) == 1:
         return tuple((p[0], p[0]) for _ in range(n_spatial))
     raise ValueError(f"bad paddings {paddings}")
+
+
+def bilinear_sample(img, yy, xx):
+    """Bilinear sample img [C, H, W] at float coords yy/xx (same shape);
+    taps outside the image contribute ZERO (the convention every sampling
+    op here shares — grid_sampler, deformable_conv, prroi_pool)."""
+    H, W = img.shape[-2], img.shape[-1]
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy = yy - y0
+    wx = xx - x0
+    out = 0.0
+    for (ys, xs, wgt) in ((y0, x0, (1 - wy) * (1 - wx)),
+                          (y0, x0 + 1, (1 - wy) * wx),
+                          (y0 + 1, x0, wy * (1 - wx)),
+                          (y0 + 1, x0 + 1, wy * wx)):
+        ok = (ys >= 0) & (ys < H) & (xs >= 0) & (xs < W)
+        yi = jnp.clip(ys, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xs, 0, W - 1).astype(jnp.int32)
+        v = img[..., yi, xi]                      # [C, *coords]
+        out = out + v * (wgt * ok.astype(img.dtype))
+    return out

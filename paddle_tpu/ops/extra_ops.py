@@ -502,3 +502,284 @@ def gather_tree(ctx, ins, attrs):
                             ids.shape[1:])
     _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
     return {"Out": toks[::-1]}
+
+
+# ---------------------------------------------------------------------------
+# Knowledge-distillation / metric-learning losses
+# ---------------------------------------------------------------------------
+
+@register_op("fsp")
+def fsp(ctx, ins, attrs):
+    """FSP (flow of solution procedure) matrix between two feature maps
+    (reference fsp_op.cc): out[b, i, j] = mean_hw x[b,i,h,w] * y[b,j,h,w]."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    hw = x.shape[2] * x.shape[3]
+    return {"Out": jnp.einsum("bihw,bjhw->bij", x, y) / hw}
+
+
+@register_op("center_loss", infer_shape=False)
+def center_loss(ctx, ins, attrs):
+    """Center loss (reference center_loss_op.cc): pulls features toward a
+    running per-class center. Loss = 0.5*||x - c_label||^2; CentersOut is
+    the updated center table (c -= alpha * mean diff per class) when
+    need_update."""
+    x = x_of(ins)                      # [B, D]
+    label = x_of(ins, "Label").astype(jnp.int32).reshape(-1)
+    centers = x_of(ins, "Centers")     # [C, D]
+    rate = x_of(ins, "CenterUpdateRate")
+    alpha = (jnp.reshape(rate, (-1,))[0] if rate is not None
+             else attrs.get("alpha", 0.5))
+    picked = jnp.take(centers, label, axis=0)
+    diff = x - picked
+    loss = 0.5 * jnp.sum(diff * diff, axis=-1, keepdims=True)
+    if attrs.get("need_update", True):
+        # reference: centers[c] -= alpha * sum(diff_c) / (1 + count_c)
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        acc = jnp.zeros_like(centers).at[label].add(diff)
+        centers_out = centers - alpha * acc / (1.0 + cnt)[:, None]
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff,
+            "CentersOut": centers_out}
+
+
+@register_op("cross_entropy2")
+def cross_entropy2(ctx, ins, attrs):
+    """Hard-label CE over probabilities (reference cross_entropy_op.cc
+    cross_entropy2 variant): Loss = -log(X[label]); also returns MatchX,
+    the matched probability, which the grad kernel reuses."""
+    x = x_of(ins)
+    label = x_of(ins, "Label").astype(jnp.int32)
+    if label.ndim == x.ndim:
+        label = label[..., 0]
+    match = jnp.take_along_axis(x, label[..., None], axis=-1)
+    ignore = attrs.get("ignore_index", -100)
+    loss = -jnp.log(jnp.maximum(match, 1e-12))
+    if ignore >= 0:
+        loss = jnp.where(label[..., None] == ignore, 0.0, loss)
+    return {"Y": loss, "MatchX": match}
+
+
+# ---------------------------------------------------------------------------
+# Partial / slot-wise dense ops (CTR serving blocks)
+# ---------------------------------------------------------------------------
+
+@register_op("partial_concat")
+def partial_concat(ctx, ins, attrs):
+    """Concat a [start, start+length) column slice of every input
+    (reference partial_concat_op.cc). length=-1 means to the end."""
+    xs = list(ins["X"])
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    end = None if length < 0 else start + length
+    return {"Out": jnp.concatenate([x[:, start:end] for x in xs], axis=1)}
+
+
+@register_op("partial_sum")
+def partial_sum(ctx, ins, attrs):
+    """Sum the same column slice of every input (reference
+    partial_sum_op.cc)."""
+    xs = list(ins["X"])
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    end = None if length < 0 else start + length
+    out = xs[0][:, start:end]
+    for x in xs[1:]:
+        out = out + x[:, start:end]
+    return {"Out": out}
+
+
+@register_op("batch_fc")
+def batch_fc(ctx, ins, attrs):
+    """Per-slot batched FC (reference batch_fc_op.cc): Input [S, B, in],
+    W [S, in, out], Bias [S, 1, out] -> relu-free batched matmul."""
+    x = x_of(ins, "Input")
+    w = x_of(ins, "W")
+    b = x_of(ins, "Bias")
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if b is not None:
+        out = out + b
+    return {"Out": out}
+
+
+@register_op("shuffle_batch", infer_shape=False, needs_rng=True)
+def shuffle_batch(ctx, ins, attrs):
+    """Random row permutation (reference shuffle_batch_op.cc); emits the
+    permutation so callers can un-shuffle."""
+    x = x_of(ins)
+    key = ctx.op_key(attrs)
+    idx = jax.random.permutation(key, x.shape[0])
+    return {"Out": jnp.take(x, idx, axis=0),
+            "ShuffleIdx": idx.astype(jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# Control-flow routing + LoD split/merge (IfElse/Switch plumbing)
+# ---------------------------------------------------------------------------
+
+@register_op("select_input")
+def select_input(ctx, ins, attrs):
+    """Route one of N same-shaped inputs by a scalar index (reference
+    controlflow/select_input_op.cc, used by case/switch_case)."""
+    xs = list(ins["X"])
+    mask = jnp.reshape(x_of(ins, "Mask"), (-1,))[0].astype(jnp.int32)
+    stacked = jnp.stack(xs, axis=0)
+    return {"Out": jnp.take(stacked, jnp.clip(mask, 0, len(xs) - 1),
+                            axis=0)}
+
+
+@register_op("select_output")
+def select_output(ctx, ins, attrs):
+    """Inverse of select_input (reference select_output_op.cc): copy X to
+    output branch `mask`; other branches get zeros (the reference leaves
+    them uninitialized — zeros keep XLA shapes total)."""
+    x = x_of(ins)
+    mask = jnp.reshape(x_of(ins, "Mask"), (-1,))[0].astype(jnp.int32)
+    if "num_outputs" not in attrs:
+        raise ValueError("select_output requires attr num_outputs (the "
+                         "lowering cannot see the op's output slot count)")
+    n = int(attrs["num_outputs"])
+    outs = [jnp.where(mask == i, x, jnp.zeros_like(x)) for i in range(n)]
+    return {"Out": outs}
+
+
+@register_op("split_lod_tensor")
+def split_lod_tensor(ctx, ins, attrs):
+    """Split rows by a boolean mask into (true, false) tensors (reference
+    split_lod_tensor_op.cc, the IfElse input router). Masked-dense: both
+    outputs keep the full [B, ...] shape, compacted to their prefix, plus
+    valid counts."""
+    x = x_of(ins)
+    mask = jnp.reshape(x_of(ins, "Mask"), (-1,)).astype(bool)
+    B = x.shape[0]
+
+    def compact(keep):
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        dest = jnp.where(keep, pos, B)
+        out = jnp.zeros_like(x)
+        return out.at[dest].set(x, mode="drop"), \
+            jnp.sum(keep, dtype=jnp.int32)
+
+    out_true, n_true = compact(mask)
+    out_false, n_false = compact(~mask)
+    return {"OutTrue": out_true, "OutFalse": out_false,
+            "TrueCount": n_true.reshape(1), "FalseCount": n_false.reshape(1)}
+
+
+@register_op("merge_lod_tensor")
+def merge_lod_tensor(ctx, ins, attrs):
+    """Merge (true, false) row sets back by the same mask (reference
+    merge_lod_tensor_op.cc)."""
+    in_true = x_of(ins, "InTrue")
+    in_false = x_of(ins, "InFalse")
+    mask = jnp.reshape(x_of(ins, "Mask"), (-1,)).astype(bool)
+    pos_t = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    pos_f = jnp.cumsum((~mask).astype(jnp.int32)) - 1
+    t = jnp.take(in_true, jnp.clip(pos_t, 0, in_true.shape[0] - 1), axis=0)
+    f = jnp.take(in_false, jnp.clip(pos_f, 0, in_false.shape[0] - 1),
+                 axis=0)
+    m = mask.reshape((-1,) + (1,) * (in_true.ndim - 1))
+    return {"Out": jnp.where(m, t, f)}
+
+
+# ---------------------------------------------------------------------------
+# Shard routing + SelectedRows utilities (PS plumbing)
+# ---------------------------------------------------------------------------
+
+@register_op("split_ids", grad=False)
+def split_ids(ctx, ins, attrs):
+    """Route ids to N shards by id % N (reference
+    distributed_ops/split_ids_op.cc). Static form: each output keeps the
+    input length, compacted to a prefix, with a count vector."""
+    ids = x_of(ins, "Ids").reshape(-1).astype(jnp.int32)
+    if "num_shards" not in attrs:
+        raise ValueError("split_ids requires attr num_shards (the lowering "
+                         "cannot see the op's output slot count)")
+    n = int(attrs["num_shards"])
+    L = ids.shape[0]
+    outs, counts = [], []
+    for s in range(n):
+        keep = (ids % n) == s
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        dest = jnp.where(keep, pos, L)
+        out = jnp.zeros((L,), jnp.int32).at[dest].set(ids, mode="drop")
+        outs.append(out)
+        counts.append(jnp.sum(keep, dtype=jnp.int32))
+    return {"Out": outs, "Count": jnp.stack(counts)}
+
+
+@register_op("merge_ids", grad=False)
+def merge_ids(ctx, ins, attrs):
+    """Gather rows looked up per shard back into original id order
+    (reference distributed_ops/merge_ids_op.cc): for id i the row comes
+    from shard i % N at that shard's running position."""
+    ids = x_of(ins, "Ids").reshape(-1).astype(jnp.int32)
+    rows = list(ins["X"])               # per-shard row blocks
+    n = len(rows)
+    shard = ids % n
+    # position of each id within its shard's compacted block
+    pos = jnp.zeros_like(ids)
+    for s in range(n):
+        mine = shard == s
+        pos = jnp.where(mine, jnp.cumsum(mine.astype(jnp.int32)) - 1, pos)
+    stacked = jnp.stack(rows, axis=0)   # [n, L, D]
+    return {"Out": stacked[shard, pos]}
+
+
+@register_op("merge_selected_rows", grad=False)
+def merge_selected_rows(ctx, ins, attrs):
+    """Coalesce duplicate rows of a SelectedRows (reference
+    merge_selected_rows_op.cc -> framework/selected_rows.py coalesce)."""
+    from ..framework.selected_rows import coalesce, is_selected_rows
+    x = x_of(ins)
+    return {"Out": coalesce(x) if is_selected_rows(x) else x}
+
+
+@register_op("get_tensor_from_selected_rows", grad=False)
+def get_tensor_from_selected_rows(ctx, ins, attrs):
+    """Expose a SelectedRows' value tensor (reference
+    get_tensor_from_selected_rows_op.cc)."""
+    from ..framework.selected_rows import is_selected_rows
+    x = x_of(ins)
+    return {"Out": x.values if is_selected_rows(x) else x}
+
+
+# ---------------------------------------------------------------------------
+# py_func: user Python in the graph
+# ---------------------------------------------------------------------------
+
+PY_FUNC_REGISTRY = []
+
+
+def register_py_func(fn):
+    """Register a host callable; returns its id for the py_func op attr
+    (mirrors the reference's PythonFuncRegistry, py_func_op.cc)."""
+    PY_FUNC_REGISTRY.append(fn)
+    return len(PY_FUNC_REGISTRY) - 1
+
+
+@register_op("py_func", infer_shape=False, grad=False)
+def py_func(ctx, ins, attrs):
+    """Call registered host Python inside the compiled program via
+    jax.pure_callback (reference py_func_op.cc runs the callable on the
+    executor thread). Output shapes/dtypes must be declared statically in
+    attrs out_shapes/out_dtypes; the callable must be pure (it may be
+    re-invoked or constant-folded by XLA)."""
+    import numpy as _np
+    fn = PY_FUNC_REGISTRY[int(attrs["func_id"])]
+    xs = list(ins.get("X", []))
+    shapes = attrs["out_shapes"]
+    dtypes = attrs["out_dtypes"]
+    specs = [jax.ShapeDtypeStruct(tuple(s), _np.dtype(d))
+             for s, d in zip(shapes, dtypes)]
+
+    def host(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(_np.asarray(o, dtype=sp.dtype)
+                     for o, sp in zip(out, specs))
+
+    outs = jax.pure_callback(host, tuple(specs), *xs)
+    return {"Out": list(outs)}

@@ -14,7 +14,7 @@ import numpy as np
 
 from ..framework.registry import register_grad_lower, register_op
 from ..framework.dtype import np_dtype
-from .common import x_of, normalize_padding
+from .common import bilinear_sample, x_of, normalize_padding
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +121,84 @@ def conv2d_transpose(ctx, ins, attrs):
     return {"Output": _conv_nd(x, w, attrs, 2, transpose=True)}
 
 
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx, ins, attrs):
+    """reference conv_transpose_op.cc (3-D variant)."""
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Filter")
+    return {"Output": _conv_nd(x, w, attrs, 3, transpose=True)}
+
+
+def _deformable_conv(ctx, ins, attrs, with_mask):
+    """Deformable convolution (reference deformable_conv_op.cc — v2 with
+    modulation mask, deformable_conv_v1_op.cc without): each kernel tap
+    (u, v) samples the input at its regular location plus a learned
+    per-position offset, bilinearly; v2 scales each tap by a learned mask.
+    Layout matches the reference: Offset [B, 2*dg*kh*kw, Ho, Wo] packed
+    (dy, dx) per tap, Mask [B, dg*kh*kw, Ho, Wo], deformable_groups=dg
+    splits input channels."""
+    x = x_of(ins, "Input")             # [B, Cin, H, W]
+    offset = x_of(ins, "Offset")
+    mask = x_of(ins, "Mask") if with_mask else None
+    w = x_of(ins, "Filter")            # [Cout, Cin/g, kh, kw]
+    B, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])
+    dh, dw = attrs.get("dilations", [1, 1])
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cpg = Cin // dg                    # channels per deformable group
+
+    oy = jnp.arange(Ho, dtype=x.dtype)[:, None] * sh - ph
+    ox = jnp.arange(Wo, dtype=x.dtype)[None, :] * sw - pw
+    off = offset.reshape(B, dg, kh * kw, 2, Ho, Wo)
+    if mask is not None:
+        msk = mask.reshape(B, dg, kh * kw, Ho, Wo)
+
+    def sample(py, px, g):
+        """Bilinear sample of deformable-group g's channels at [B,Ho,Wo]
+        float coords; OOB taps contribute zero (shared bilinear_sample)."""
+        seg = x[:, g * cpg:(g + 1) * cpg]
+        return jax.vmap(bilinear_sample)(seg, py, px)
+
+    cols = []                           # per-tap sampled input
+    for u in range(kh):
+        for v in range(kw):
+            t = u * kw + v
+            per_g = []
+            for g in range(dg):
+                py = oy[None] + u * dh + off[:, g, t, 0]
+                px = ox[None] + v * dw + off[:, g, t, 1]
+                s = sample(py, px, g)
+                if mask is not None:
+                    s = s * msk[:, g, t][:, None]
+                per_g.append(s)
+            cols.append(jnp.concatenate(per_g, axis=1))  # [B, Cin, Ho, Wo]
+    col = jnp.stack(cols, axis=2)       # [B, Cin, kh*kw, Ho, Wo]
+    cpcg = Cin // groups               # conv-group input channels
+    outs = []
+    for g in range(groups):
+        cg = col[:, g * cpcg:(g + 1) * cpcg]
+        wg = w[g * (Cout // groups):(g + 1) * (Cout // groups)]
+        outs.append(jnp.einsum("bckhw,ock->bohw",
+                               cg.reshape(B, cpcg, kh * kw, Ho, Wo),
+                               wg.reshape(Cout // groups, cpcg, kh * kw)))
+    return {"Output": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("deformable_conv", infer_shape=False)
+def deformable_conv(ctx, ins, attrs):
+    return _deformable_conv(ctx, ins, attrs, with_mask=True)
+
+
+@register_op("deformable_conv_v1", infer_shape=False)
+def deformable_conv_v1(ctx, ins, attrs):
+    return _deformable_conv(ctx, ins, attrs, with_mask=False)
+
+
 # ---------------------------------------------------------------------------
 # Pooling
 # ---------------------------------------------------------------------------
@@ -169,6 +247,102 @@ def pool2d(ctx, ins, attrs):
                                     pads)
         return {"Out": ssum / cnt}
     return {"Out": ssum / float(np.prod(ksize))}
+
+
+def _max_pool_with_index(x, ksize, strides, pads, n_spatial):
+    """Max pooling that also returns each window's argmax as a flat index
+    into the spatial dims (reference pool_with_index_op.cc). Built on
+    dilated patches: [B, C*prod(k), *out] -> max + argmax per window, with
+    the patch-local argmax mapped back to global coordinates."""
+    B, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, tuple(ksize), tuple(strides), list(zip(pads, pads)))
+    out_sp = patches.shape[2:]
+    K = int(np.prod(ksize))
+    p = patches.reshape((B, C, K) + out_sp)
+    # taps that fell in the zero-padding must not win the max
+    valid = np.ones((K,) + out_sp, bool)
+    for k in range(K):
+        loc_k = np.unravel_index(k, tuple(ksize))
+        ok = np.ones(out_sp, bool)
+        for d in range(n_spatial):
+            o = np.arange(out_sp[d])
+            coord = o * strides[d] - pads[d] + loc_k[d]
+            in_range = (coord >= 0) & (coord < spatial[d])
+            shape = [1] * n_spatial
+            shape[d] = out_sp[d]
+            ok &= in_range.reshape(shape)
+        valid[k] = ok
+    p = jnp.where(jnp.asarray(valid)[None, None], p, -jnp.inf)
+    out = jnp.max(p, axis=2)
+    arg = jnp.argmax(p, axis=2).astype(jnp.int32)       # patch-local
+    # map patch-local index -> global flat spatial index
+    loc = jnp.unravel_index(arg, tuple(ksize))
+    flat = jnp.zeros_like(arg)
+    mul = 1
+    for d in reversed(range(n_spatial)):
+        o = jnp.arange(out_sp[d], dtype=jnp.int32)
+        shape = [1] * arg.ndim
+        shape[2 + d] = out_sp[d]
+        start = (o * strides[d] - pads[d]).reshape(shape)
+        flat = flat + (start + loc[d]) * mul
+        mul *= spatial[d]
+    return out, flat
+
+
+@register_op("max_pool2d_with_index", infer_shape=False)
+def max_pool2d_with_index(ctx, ins, attrs):
+    x = x_of(ins)
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    pads = list(attrs.get("paddings", [0, 0]))
+    out, idx = _max_pool_with_index(x, ksize, strides, pads, 2)
+    return {"Out": out, "Mask": idx}
+
+
+@register_op("max_pool3d_with_index", infer_shape=False)
+def max_pool3d_with_index(ctx, ins, attrs):
+    x = x_of(ins)
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    pads = list(attrs.get("paddings", [0, 0, 0]))
+    out, idx = _max_pool_with_index(x, ksize, strides, pads, 3)
+    return {"Out": out, "Mask": idx}
+
+
+@register_op("unpool", infer_shape=False)
+def unpool(ctx, ins, attrs):
+    """Max unpooling (reference unpool_op.cc): scatter x's values back to
+    the positions recorded by max_pool2d_with_index's Mask; everything else
+    zero. Output spatial size from attr unpooled_height/width (or ksize
+    inference is the caller's job)."""
+    x = x_of(ins)                      # [B, C, h, w]
+    idx = x_of(ins, "Indices").astype(jnp.int32)
+    B, C, h, w = x.shape
+    H = int(attrs["unpooled_height"])
+    W = int(attrs["unpooled_width"])
+    flat_out = jnp.zeros((B, C, H * W), x.dtype)
+    out = flat_out.at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(B, C, h * w)].add(x.reshape(B, C, h * w), mode="drop")
+    return {"Out": out.reshape(B, C, H, W)}
+
+
+@register_op("affine_grid", infer_shape=False)
+def affine_grid(ctx, ins, attrs):
+    """2-D affine sampling grid from theta [B, 2, 3] (reference
+    affine_grid_op.cc): output [B, H, W, 2] of (x, y) coords in [-1, 1]
+    space, ready for grid_sampler."""
+    theta = x_of(ins, "Theta")
+    H, W = attrs["output_shape"][-2:]
+    ys = jnp.linspace(-1.0, 1.0, H, dtype=theta.dtype)
+    xs = jnp.linspace(-1.0, 1.0, W, dtype=theta.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [H, W, 3]
+    grid = jnp.einsum("hwk,bok->bhwo", base, theta)          # [B, H, W, 2]
+    return {"Output": grid}
 
 
 # ---------------------------------------------------------------------------
@@ -530,9 +704,33 @@ def nearest_interp(ctx, ins, attrs):
     return interp_nearest(ctx, ins, attrs)
 
 
+@register_op("bicubic_interp")
+def bicubic_interp(ctx, ins, attrs):
+    x = x_of(ins)
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], oh, ow), method="bicubic")}
+
+
+@register_op("trilinear_interp")
+def trilinear_interp(ctx, ins, attrs):
+    x = x_of(ins)                       # [B, C, D, H, W]
+    od, oh, ow = attrs["out_d"], attrs["out_h"], attrs["out_w"]
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], od, oh, ow), method="trilinear")}
+
+
 @register_op("grid_sampler")
 def grid_sampler(ctx, ins, attrs):
-    raise NotImplementedError("grid_sampler: planned Pallas kernel")
+    """Bilinear sampling of x [B,C,H,W] at grid [B,Hg,Wg,2] locations in
+    [-1, 1] (reference grid_sampler_op.cc, align_corners semantics;
+    out-of-bounds reads contribute zero)."""
+    x = x_of(ins)
+    grid = x_of(ins, "Grid")
+    B, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * (W - 1) / 2.0      # [B, Hg, Wg]
+    gy = (grid[..., 1] + 1.0) * (H - 1) / 2.0
+    return {"Out": jax.vmap(bilinear_sample)(x, gy, gx)}
 
 
 @register_op("prelu")

@@ -1,0 +1,294 @@
+"""Full-sequence RNN ops and recurrence-adjacent convolutions.
+
+Capability parity with the reference's recurrent op family: lstm/lstmp
+(/root/reference/paddle/fluid/operators/lstm_op.cc, lstmp_op.cc), gru /
+gru_unit (gru_op.cc, gru_unit_op.cc), lstm_unit (lstm_unit_op.cc), row_conv
+(row_conv_op.cc), conv_shift (conv_shift_op.cc), im2sequence
+(im2sequence_op.cc). The reference walks LoD segments with hand-written
+CPU/CUDA kernels (math/detail/lstm_kernel.h); here each op is a masked-dense
+`lax.scan` over the time dim — one fused gate matmul per step on the MXU,
+padding steps carry the previous state through unchanged so arbitrary
+per-row lengths work under a static [B, T, ...] shape.
+
+Gate packing follows this framework's fused cells (nn_ops.py
+lstm_cell_fused / gru_cell_fused): LSTM gates (i, f, c_hat, o), GRU gates
+(u, r) + candidate. The reference's packed weight layout differs
+byte-for-byte (it predates these conventions); parity is semantic, verified
+against numpy references in tests/test_ops_rnn.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import x_of
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(attrs, key, default):
+    return _ACTS[attrs.get(key, default)]
+
+
+def _lengths(ins, B, T):
+    ln = x_of(ins, "Length")
+    if ln is None:
+        return jnp.full((B,), T, jnp.int32)
+    return jnp.reshape(ln, (-1,)).astype(jnp.int32)
+
+
+def _maybe_reverse(x, lengths, flag):
+    """Reverse each row's valid prefix (padding stays in place)."""
+    if not flag:
+        return x
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    idx = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+@register_op("lstm", infer_shape=False)
+def lstm(ctx, ins, attrs):
+    """Full-sequence LSTM. Input [B, T, 4H] is the pre-projected x@Wx (the
+    reference's contract too — lstm_op.cc Input); Weight [H, 4H] recurrent;
+    Bias [1, 4H], or [1, 7H] with use_peepholes (extra W_ic, W_fc, W_oc
+    diagonals); optional H0/C0 [B, H]; optional Length [B]. Outputs
+    Hidden/Cell [B, T, H]."""
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Weight")
+    bias = x_of(ins, "Bias")
+    B, T = x.shape[0], x.shape[1]
+    H = w.shape[0]
+    use_peep = bool(attrs.get("use_peepholes", False))
+    is_rev = bool(attrs.get("is_reverse", False))
+    act_g = _act(attrs, "gate_activation", "sigmoid")
+    act_c = _act(attrs, "cell_activation", "tanh")
+    act_h = _act(attrs, "candidate_activation", "tanh")
+    lengths = _lengths(ins, B, T)
+
+    b_gate = bias[:, :4 * H] if bias is not None else 0.0
+    if use_peep:
+        w_ic = bias[:, 4 * H:5 * H]
+        w_fc = bias[:, 5 * H:6 * H]
+        w_oc = bias[:, 6 * H:7 * H]
+    h0 = x_of(ins, "H0")
+    c0 = x_of(ins, "C0")
+    h = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+    xs = _maybe_reverse(x, lengths, is_rev)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt + h @ w + b_gate
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = act_g(gi)
+        f = act_g(gf)
+        c_new = f * c + i * act_h(gc)
+        o = act_g(go + c_new * w_oc) if use_peep else act_g(go)
+        h_new = o * act_c(c_new)
+        live = (t < lengths)[:, None]
+        h_new = jnp.where(live, h_new, h)
+        c_new = jnp.where(live, c_new, c)
+        return (h_new, c_new), (jnp.where(live, h_new, 0),
+                                jnp.where(live, c_new, 0))
+
+    ts = jnp.arange(T, dtype=jnp.int32)
+    (_, _), (hs, cs) = jax.lax.scan(
+        step, (h, c), (jnp.swapaxes(xs, 0, 1), ts))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    hidden = _maybe_reverse(hidden, lengths, is_rev)
+    cell = _maybe_reverse(cell, lengths, is_rev)
+    return {"Hidden": hidden, "Cell": cell}
+
+
+@register_op("lstmp", infer_shape=False)
+def lstmp(ctx, ins, attrs):
+    """LSTM with a recurrent projection (reference lstmp_op.cc): the carried
+    state is r = proj_act(h @ ProjWeight) [B, P]; Weight is [P, 4H].
+    Outputs Projection [B, T, P] and Cell [B, T, H]."""
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Weight")            # [P, 4H]
+    w_proj = x_of(ins, "ProjWeight")   # [H, P]
+    bias = x_of(ins, "Bias")
+    B, T = x.shape[0], x.shape[1]
+    H, P = w_proj.shape
+    is_rev = bool(attrs.get("is_reverse", False))
+    act_g = _act(attrs, "gate_activation", "sigmoid")
+    act_c = _act(attrs, "cell_activation", "tanh")
+    act_h = _act(attrs, "candidate_activation", "tanh")
+    act_p = _act(attrs, "proj_activation", "identity")
+    lengths = _lengths(ins, B, T)
+    b_gate = bias[:, :4 * H] if bias is not None else 0.0
+
+    h0 = x_of(ins, "H0")     # initial PROJECTED state [B, P]
+    c0 = x_of(ins, "C0")
+    r = h0 if h0 is not None else jnp.zeros((B, P), x.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, H), x.dtype)
+    xs = _maybe_reverse(x, lengths, is_rev)
+
+    def step(carry, inp):
+        r, c = carry
+        xt, t = inp
+        gates = xt + r @ w + b_gate
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        c_new = act_g(gf) * c + act_g(gi) * act_h(gc)
+        h_new = act_g(go) * act_c(c_new)
+        r_new = act_p(h_new @ w_proj)
+        live = (t < lengths)[:, None]
+        r_new = jnp.where(live, r_new, r)
+        c_new = jnp.where(live, c_new, c)
+        return (r_new, c_new), (jnp.where(live, r_new, 0),
+                                jnp.where(live, c_new, 0))
+
+    ts = jnp.arange(T, dtype=jnp.int32)
+    (_, _), (rs, cs) = jax.lax.scan(
+        step, (r, c), (jnp.swapaxes(xs, 0, 1), ts))
+    proj = _maybe_reverse(jnp.swapaxes(rs, 0, 1), lengths, is_rev)
+    cell = _maybe_reverse(jnp.swapaxes(cs, 0, 1), lengths, is_rev)
+    return {"Projection": proj, "Cell": cell}
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx, ins, attrs):
+    """One LSTM step on pre-computed gate pre-activations (reference
+    lstm_unit_op.cc): X [B, 4H] split (i, f, c_hat, o), C_prev [B, H]."""
+    x = x_of(ins)
+    c_prev = x_of(ins, "C_prev")
+    fb = float(attrs.get("forget_bias", 0.0))
+    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + fb) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+def _gru_step(xt, h, w_g, w_c, bias, act_g, act_c, origin_mode, H):
+    xg = xt[:, :2 * H] + h @ w_g
+    xc_in = xt[:, 2 * H:]
+    if bias is not None:
+        xg = xg + bias[:, :2 * H]
+    u, r = jnp.split(act_g(xg), 2, axis=-1)
+    xc = xc_in + (r * h) @ w_c
+    if bias is not None:
+        xc = xc + bias[:, 2 * H:]
+    cand = act_c(xc)
+    if origin_mode:
+        return u * h + (1.0 - u) * cand
+    return u * cand + (1.0 - u) * h
+
+
+@register_op("gru", infer_shape=False)
+def gru(ctx, ins, attrs):
+    """Full-sequence GRU (reference gru_op.cc). Input [B, T, 3H] is the
+    pre-projected x@Wx packed (u, r, c_hat); Weight [H, 3H] recurrent
+    (first 2H the u/r gates, last H the candidate); Bias [1, 3H]; optional
+    H0 [B, H], Length [B]. Output Hidden [B, T, H]."""
+    x = x_of(ins, "Input")
+    w = x_of(ins, "Weight")
+    bias = x_of(ins, "Bias")
+    B, T = x.shape[0], x.shape[1]
+    H = w.shape[0]
+    is_rev = bool(attrs.get("is_reverse", False))
+    origin = bool(attrs.get("origin_mode", False))
+    act_g = _act(attrs, "gate_activation", "sigmoid")
+    act_c = _act(attrs, "activation", "tanh")
+    lengths = _lengths(ins, B, T)
+    w_g, w_c = w[:, :2 * H], w[:, 2 * H:]
+    h0 = x_of(ins, "H0")
+    h = h0 if h0 is not None else jnp.zeros((B, H), x.dtype)
+    xs = _maybe_reverse(x, lengths, is_rev)
+
+    def step(h, inp):
+        xt, t = inp
+        h_new = _gru_step(xt, h, w_g, w_c, bias, act_g, act_c, origin, H)
+        live = (t < lengths)[:, None]
+        h_new = jnp.where(live, h_new, h)
+        return h_new, jnp.where(live, h_new, 0)
+
+    ts = jnp.arange(T, dtype=jnp.int32)
+    _, hs = jax.lax.scan(step, h, (jnp.swapaxes(xs, 0, 1), ts))
+    hidden = _maybe_reverse(jnp.swapaxes(hs, 0, 1), lengths, is_rev)
+    return {"Hidden": hidden}
+
+
+@register_op("gru_unit")
+def gru_unit(ctx, ins, attrs):
+    """One GRU step (reference gru_unit_op.cc): Input [B, 3H] pre-projected,
+    HiddenPrev [B, H], Weight [H, 3H], optional Bias [1, 3H]."""
+    x = x_of(ins, "Input")
+    h = x_of(ins, "HiddenPrev")
+    w = x_of(ins, "Weight")
+    bias = x_of(ins, "Bias")
+    H = h.shape[-1]
+    act_g = _act(attrs, "gate_activation", "sigmoid")
+    act_c = _act(attrs, "activation", "tanh")
+    origin = bool(attrs.get("origin_mode", False))
+    out = _gru_step(x, h, w[:, :2 * H], w[:, 2 * H:], bias, act_g, act_c,
+                    origin, H)
+    return {"Hidden": out}
+
+
+@register_op("row_conv")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference row_conv_op.cc, from the DS2
+    paper): out[b, t] = sum_k x[b, t+k] * filter[k], k in [0, future_ctx);
+    steps beyond each row's length contribute zero."""
+    x = x_of(ins)                      # [B, T, D]
+    filt = x_of(ins, "Filter")         # [K, D]
+    ln = x_of(ins, "Length")
+    B, T, D = x.shape
+    K = filt.shape[0]
+    lengths = (jnp.reshape(ln, (-1,)).astype(jnp.int32)
+               if ln is not None else jnp.full((B,), T, jnp.int32))
+    t = jnp.arange(T, dtype=jnp.int32)
+    out = jnp.zeros_like(x)
+    for k in range(K):
+        src = t + k
+        ok = (src[None, :] < lengths[:, None])[..., None]
+        g = jnp.take(x, jnp.clip(src, 0, T - 1), axis=1)
+        out = out + jnp.where(ok, g, 0) * filt[k]
+    mask = (t[None, :] < lengths[:, None])[..., None]
+    return {"Out": jnp.where(mask, out, 0)}
+
+
+@register_op("conv_shift")
+def conv_shift(ctx, ins, attrs):
+    """Circular correlation (reference conv_shift_op.cc, NTM-style):
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j], M odd."""
+    x = x_of(ins)                      # [B, N]
+    y = x_of(ins, "Y")                 # [B, M]
+    N, M = x.shape[1], y.shape[1]
+    i = jnp.arange(N, dtype=jnp.int32)[:, None]
+    j = jnp.arange(M, dtype=jnp.int32)[None, :]
+    idx = (i + j - M // 2) % N         # [N, M]
+    g = x[:, idx]                      # [B, N, M]
+    return {"Out": jnp.einsum("bnm,bm->bn", g, y)}
+
+
+@register_op("im2sequence", infer_shape=False)
+def im2sequence(ctx, ins, attrs):
+    """Image -> patch sequence (reference im2sequence_op.cc): x [B,C,H,W]
+    with kernels/strides/paddings unfolds to [B, oh*ow, C*kh*kw]; every row
+    has length oh*ow. Patch features are ordered (C, kh, kw)."""
+    x = x_of(ins)
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0, 0, 0])
+    pu, pl, pd, pr = (pads if len(pads) == 4 else
+                      [pads[0], pads[1], pads[0], pads[1]])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pu, pd), (pl, pr)])  # [B, C*kh*kw, oh, ow]
+    B, F = patches.shape[0], patches.shape[1]
+    oh, ow = patches.shape[2], patches.shape[3]
+    out = patches.reshape(B, F, oh * ow).transpose(0, 2, 1)
+    return {"Out": out,
+            "OutLength": jnp.full((B,), oh * ow, jnp.int32)}

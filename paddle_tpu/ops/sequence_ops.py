@@ -249,6 +249,74 @@ def sequence_enumerate(ctx, ins, attrs):
     return {"Out": jnp.where(ok, g, jnp.asarray(pad_value, x.dtype))}
 
 
+@register_op("sequence_expand", infer_shape=False)
+def sequence_expand(ctx, ins, attrs):
+    """Repeat each row by a per-row count (reference sequence_expand_op.h:
+    x's segment i is tiled to match y's ref-level segment i). Masked-dense
+    contract: X [B, T, ...] + Length [B] + RepeatTimes [B] int; attr
+    out_rows caps the static output batch. Output rows beyond
+    sum(RepeatTimes) are zero with OutLength 0."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    rep = jnp.reshape(x_of(ins, "RepeatTimes"), (-1,)).astype(jnp.int32)
+    out_rows = int(attrs["out_rows"])
+    ends = jnp.cumsum(rep)                                 # [B]
+    j = jnp.arange(out_rows, dtype=jnp.int32)
+    src = jnp.searchsorted(ends, j, side="right")          # row j <- x[src]
+    valid = j < ends[-1]
+    srcc = jnp.clip(src, 0, x.shape[0] - 1)
+    out = jnp.take(x, srcc, axis=0)
+    mask = valid.reshape((-1,) + (1,) * (x.ndim - 1))
+    out_len = jnp.where(valid, jnp.take(lengths, srcc), 0)
+    return {"Out": jnp.where(mask, out, 0), "OutLength": out_len}
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(ctx, ins, attrs):
+    """Per-row scatter-add into X (reference sequence_scatter_op.h:
+    out[b, ids[b, u]] += updates[b, u] over each Ids segment). Masked-dense:
+    X [B, D], Ids [B, U] int, Updates [B, U], UpdLength [B]."""
+    x = x_of(ins)
+    ids = x_of(ins, "Ids").astype(jnp.int32)
+    upd = x_of(ins, "Updates")
+    ln = jnp.reshape(x_of(ins, "UpdLength"), (-1,)).astype(jnp.int32)
+    B, U = ids.shape
+    valid = jnp.arange(U, dtype=jnp.int32)[None, :] < ln[:, None]
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, U))
+    cols = jnp.where(valid, ids, x.shape[1])               # OOB -> dropped
+    return {"Out": x.at[rows.reshape(-1), cols.reshape(-1)].add(
+        jnp.where(valid, upd, 0).reshape(-1), mode="drop")}
+
+
+@register_op("lod_reset")
+def lod_reset(ctx, ins, attrs):
+    """Re-segment a batch: keep the data, swap the lengths (reference
+    lod_reset_op.h replaces the LoD). New lengths come from input Y or attr
+    target_lengths; positions beyond the new length are zeroed to keep the
+    masked-dense invariant (padding carries zeros)."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    if y is not None:
+        new_len = jnp.reshape(y, (-1,)).astype(jnp.int32)
+    else:
+        new_len = jnp.asarray(attrs["target_lengths"], jnp.int32)
+    mask = _expand(_time_mask(new_len, x.shape[1]), x.ndim)
+    return {"Out": jnp.where(mask, x, 0), "OutLength": new_len}
+
+
+@register_op("shrink_rnn_memory")
+def shrink_rnn_memory(ctx, ins, attrs):
+    """Keep only rows still alive at RNN step i (reference
+    shrink_rnn_memory_op.cc drops finished rows from the batch; the
+    masked-dense form keeps the static [B, ...] shape and zeroes rows whose
+    sequence ended). X [B, ...], Length [B], attr step."""
+    x = x_of(ins)
+    lengths = _len_of(ins)
+    i = int(attrs.get("step", 0))
+    alive = (lengths > i).reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": jnp.where(alive, x, 0)}
+
+
 @register_op("sequence_conv")
 def sequence_conv(ctx, ins, attrs):
     """Context-window projection: im2col over time then one matmul

@@ -1,0 +1,149 @@
+"""Book-style end-to-end model tests (reference tests/book/: small models
+trained to a loss threshold — test_understand_sentiment.py,
+test_word2vec.py, test_recommender_system.py). These exercise the
+full-sequence RNN ops, embeddings, and multi-tower ranking models through
+the complete build->backward->optimize->run pipeline."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+import paddle_tpu.layers.tensor as T
+
+
+def _fit(main, startup, feed, loss, steps=30):
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ls = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(steps)]
+    assert np.isfinite(ls).all(), ls
+    return ls
+
+
+def test_understand_sentiment_lstm():
+    """Embedding -> full-sequence LSTM (the new `lstm` op via a projected
+    input) -> last-step pool -> binary classifier; loss must drop hard on a
+    memorizable batch (reference book/test_understand_sentiment.py)."""
+    B, Tmax, V, E, H = 8, 12, 50, 16, 16
+    rng = np.random.default_rng(0)
+    words = rng.integers(1, V, (B, Tmax)).astype(np.int64)
+    lens = rng.integers(4, Tmax + 1, (B,)).astype(np.int64)
+    label = (words[:, 0] % 2).astype(np.int64)[:, None]
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        w = layers.data("words", [B, Tmax], dtype="int64")
+        ln = layers.data("lens", [B], dtype="int64")
+        y = layers.data("label", [B, 1], dtype="int64")
+        emb = layers.embedding(w, size=[V, E])
+        proj = layers.fc(emb, 4 * H, num_flatten_dims=2)
+        gb = main.global_block()
+        weight = layers.create_parameter([H, 4 * H], "float32")
+        bias = layers.create_parameter([1, 4 * H], "float32",
+                                       default_initializer=fluid
+                                       .initializer.Constant(0.0))
+        hidden = gb.create_var(name="lstm_hidden", dtype="float32",
+                               shape=(B, Tmax, H))
+        cell = gb.create_var(name="lstm_cell", dtype="float32",
+                             shape=(B, Tmax, H))
+        gb.append_op(type="lstm",
+                     inputs={"Input": [proj.name], "Weight": [weight.name],
+                             "Bias": [bias.name], "Length": [ln.name]},
+                     outputs={"Hidden": [hidden.name],
+                              "Cell": [cell.name]},
+                     attrs={}, infer_shape=False)
+        last = layers.sequence_pool(hidden, "last", length=ln)
+        logits = layers.fc(last, 2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    ls = _fit(main, startup, {"words": words, "lens": lens,
+                              "label": label}, loss.name, steps=40)
+    assert ls[-1] < 0.35 * ls[0], (ls[0], ls[-1])
+
+
+def test_word2vec_skipgram():
+    """Skip-gram word2vec with sampled softmax-free small vocab (reference
+    book/test_word2vec.py uses hierarchical softmax; plain CE suffices for
+    the capability gate)."""
+    V, E, B = 40, 8, 32
+    rng = np.random.default_rng(1)
+    center = rng.integers(0, V, (B, 1)).astype(np.int64)
+    target = ((center + 1) % V).astype(np.int64)   # deterministic mapping
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        c = layers.data("c", [B, 1], dtype="int64")
+        t = layers.data("t", [B, 1], dtype="int64")
+        emb = layers.embedding(c, size=[V, E])
+        emb = T.reshape(emb, [B, E])
+        logits = layers.fc(emb, V)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, t))
+        fluid.optimizer.Adam(0.1).minimize(loss)
+    ls = _fit(main, startup, {"c": center, "t": target}, loss.name,
+              steps=60)
+    assert ls[-1] < 0.2 * ls[0], (ls[0], ls[-1])
+
+
+def test_recommender_two_tower():
+    """User/item two-tower dot-product ranking (reference
+    book/test_recommender_system.py shape): embeddings + fc towers, cosine
+    similarity head, square loss to ratings."""
+    U, I, E, B = 30, 40, 8, 16
+    rng = np.random.default_rng(2)
+    users = rng.integers(0, U, (B, 1)).astype(np.int64)
+    items = rng.integers(0, I, (B, 1)).astype(np.int64)
+    ratings = ((users * 7 + items * 3) % 5 / 5.0).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        u = layers.data("u", [B, 1], dtype="int64")
+        i = layers.data("i", [B, 1], dtype="int64")
+        r = layers.data("r", [B, 1], dtype="float32")
+        ue = layers.fc(T.reshape(layers.embedding(
+            u, size=[U, E]), [B, E]), E, act="relu")
+        ie = layers.fc(T.reshape(layers.embedding(
+            i, size=[I, E]), [B, E]), E, act="relu")
+        sim = layers.reduce_sum(layers.elementwise_mul(ue, ie),
+                                dim=[1], keep_dim=True)
+        loss = layers.mean(layers.square_error_cost(sim, r))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    ls = _fit(main, startup,
+              {"u": users, "i": items, "r": ratings}, loss.name, steps=60)
+    assert ls[-1] < 0.2 * ls[0], (ls[0], ls[-1])
+
+
+def test_layer_forward_hooks():
+    """dygraph Layer forward pre/post hooks (reference dygraph/layers.py
+    hook API): pre-hook rewrites inputs, post-hook rewrites outputs,
+    remove() detaches."""
+    from paddle_tpu import dygraph
+    import paddle_tpu.dygraph.nn as dnn
+
+    with dygraph.guard():
+        lin = dnn.Linear(4, 4)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        base = lin(x).numpy()
+
+        calls = []
+
+        def pre(layer, inputs):
+            calls.append("pre")
+            return (inputs[0] * 2.0,)
+
+        def post(layer, inputs, out):
+            calls.append("post")
+            return out + 100.0
+
+        h1 = lin.register_forward_pre_hook(pre)
+        h2 = lin.register_forward_post_hook(post)
+        hooked = lin(x).numpy()
+        np.testing.assert_allclose(hooked, base * 2.0 + 100.0,
+                                   rtol=1e-5, atol=1e-5)
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        np.testing.assert_allclose(lin(x).numpy(), base, rtol=1e-6,
+                                   atol=1e-6)

@@ -1,0 +1,320 @@
+"""Full-sequence RNN ops (lstm/lstmp/gru + units), recurrence-adjacent
+convs (row_conv, conv_shift, im2sequence), grid_sampler, interp variants,
+and the sequence_expand/scatter/lod_reset/shrink_rnn_memory completions —
+numpy references + numeric gradients (reference pattern: per-op unittests,
+test_lstm_op.py, test_gru_op.py, test_row_conv_op.py, test_im2sequence.py,
+test_grid_sampler_op.py, test_sequence_expand.py)."""
+import numpy as np
+
+from op_test import make_op_test as _t
+
+RNG = np.random.default_rng(7)
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------- lstm
+
+def _np_lstm(x, w, b, lengths, peep=False):
+    B, T, H4 = x.shape
+    H = H4 // 4
+    h = np.zeros((B, H)); c = np.zeros((B, H))
+    hid = np.zeros((B, T, H)); cell = np.zeros((B, T, H))
+    bg = b[:, :4 * H]
+    for t in range(T):
+        g = x[:, t] + h @ w + bg
+        gi, gf, gc, go = np.split(g, 4, axis=-1)
+        if peep:
+            gi = gi + c * b[:, 4 * H:5 * H]
+            gf = gf + c * b[:, 5 * H:6 * H]
+        cn = _sig(gf) * c + _sig(gi) * np.tanh(gc)
+        go2 = go + cn * b[:, 6 * H:7 * H] if peep else go
+        hn = _sig(go2) * np.tanh(cn)
+        live = (t < lengths)[:, None]
+        h = np.where(live, hn, h); c = np.where(live, cn, c)
+        hid[:, t] = np.where(live, h, 0); cell[:, t] = np.where(live, c, 0)
+    return hid, cell
+
+
+def test_lstm():
+    B, T, H = 3, 5, 4
+    x = RNG.standard_normal((B, T, 4 * H)).astype(np.float32)
+    w = (RNG.standard_normal((H, 4 * H)) * 0.5).astype(np.float32)
+    b = (RNG.standard_normal((1, 4 * H)) * 0.1).astype(np.float32)
+    lens = np.array([5, 3, 4], np.int32)
+    hid, cell = _np_lstm(x, w, b, lens)
+    t = _t("lstm",
+           {"Input": x, "Weight": w, "Bias": b, "Length": lens},
+           {},
+           {"Hidden": hid.astype(np.float32),
+            "Cell": cell.astype(np.float32)})
+    t.check_output(atol=1e-4, rtol=1e-4)
+    t.check_grad(["Input", "Weight"], "Hidden", max_relative_error=0.02)
+
+
+def test_lstm_peepholes():
+    B, T, H = 2, 4, 3
+    x = RNG.standard_normal((B, T, 4 * H)).astype(np.float32)
+    w = (RNG.standard_normal((H, 4 * H)) * 0.5).astype(np.float32)
+    b = (RNG.standard_normal((1, 7 * H)) * 0.1).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    hid, cell = _np_lstm(x, w, b, lens, peep=True)
+    _t("lstm", {"Input": x, "Weight": w, "Bias": b, "Length": lens},
+       {"use_peepholes": True},
+       {"Hidden": hid.astype(np.float32),
+        "Cell": cell.astype(np.float32)}).check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_reverse_matches_flipped_forward():
+    B, T, H = 2, 4, 3
+    x = RNG.standard_normal((B, T, 4 * H)).astype(np.float32)
+    w = (RNG.standard_normal((H, 4 * H)) * 0.5).astype(np.float32)
+    b = np.zeros((1, 4 * H), np.float32)
+    lens = np.array([4, 3], np.int32)
+    # reverse-LSTM == forward LSTM on per-row reversed input, re-reversed
+    xr = x.copy()
+    for i, ln in enumerate(lens):
+        xr[i, :ln] = x[i, :ln][::-1]
+    hid, cell = _np_lstm(xr, w, b, lens)
+    for i, ln in enumerate(lens):
+        hid[i, :ln] = hid[i, :ln][::-1]
+        cell[i, :ln] = cell[i, :ln][::-1]
+    _t("lstm", {"Input": x, "Weight": w, "Bias": b, "Length": lens},
+       {"is_reverse": True},
+       {"Hidden": hid.astype(np.float32),
+        "Cell": cell.astype(np.float32)}).check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_lstmp():
+    B, T, H, P = 2, 4, 3, 2
+    x = RNG.standard_normal((B, T, 4 * H)).astype(np.float32)
+    w = (RNG.standard_normal((P, 4 * H)) * 0.5).astype(np.float32)
+    wp = (RNG.standard_normal((H, P)) * 0.5).astype(np.float32)
+    b = (RNG.standard_normal((1, 4 * H)) * 0.1).astype(np.float32)
+    lens = np.array([4, 3], np.int32)
+    r = np.zeros((B, P)); c = np.zeros((B, H))
+    proj = np.zeros((B, T, P)); cell = np.zeros((B, T, H))
+    for t in range(T):
+        g = x[:, t] + r @ w + b
+        gi, gf, gc, go = np.split(g, 4, axis=-1)
+        cn = _sig(gf) * c + _sig(gi) * np.tanh(gc)
+        hn = _sig(go) * np.tanh(cn)
+        rn = hn @ wp
+        live = (t < lens)[:, None]
+        r = np.where(live, rn, r); c = np.where(live, cn, c)
+        proj[:, t] = np.where(live, r, 0); cell[:, t] = np.where(live, c, 0)
+    t_ = _t("lstmp",
+            {"Input": x, "Weight": w, "ProjWeight": wp, "Bias": b,
+             "Length": lens}, {},
+            {"Projection": proj.astype(np.float32),
+             "Cell": cell.astype(np.float32)})
+    t_.check_output(atol=1e-4, rtol=1e-4)
+    t_.check_grad(["Input", "ProjWeight"], "Projection",
+                  max_relative_error=0.02)
+
+
+def test_lstm_unit():
+    B, H = 3, 4
+    x = RNG.standard_normal((B, 4 * H)).astype(np.float32)
+    c_prev = RNG.standard_normal((B, H)).astype(np.float32)
+    i, f, ch, o = np.split(x, 4, axis=-1)
+    c = _sig(f + 0.5) * c_prev + _sig(i) * np.tanh(ch)
+    h = _sig(o) * np.tanh(c)
+    t = _t("lstm_unit", {"X": x, "C_prev": c_prev}, {"forget_bias": 0.5},
+           {"C": c.astype(np.float32), "H": h.astype(np.float32)})
+    t.check_output(atol=1e-5, rtol=1e-5)
+    t.check_grad(["X", "C_prev"], "H", max_relative_error=0.01)
+
+
+# ----------------------------------------------------------------- gru
+
+def _np_gru_step(xt, h, w, b, H, origin=False):
+    xg = xt[:, :2 * H] + h @ w[:, :2 * H] + b[:, :2 * H]
+    u, r = np.split(_sig(xg), 2, axis=-1)
+    cand = np.tanh(xt[:, 2 * H:] + (r * h) @ w[:, 2 * H:] + b[:, 2 * H:])
+    return u * h + (1 - u) * cand if origin else u * cand + (1 - u) * h
+
+
+def test_gru():
+    B, T, H = 3, 5, 4
+    x = RNG.standard_normal((B, T, 3 * H)).astype(np.float32)
+    w = (RNG.standard_normal((H, 3 * H)) * 0.5).astype(np.float32)
+    b = (RNG.standard_normal((1, 3 * H)) * 0.1).astype(np.float32)
+    lens = np.array([5, 2, 4], np.int32)
+    h = np.zeros((B, H)); hid = np.zeros((B, T, H))
+    for t in range(T):
+        hn = _np_gru_step(x[:, t], h, w, b, H)
+        live = (t < lens)[:, None]
+        h = np.where(live, hn, h)
+        hid[:, t] = np.where(live, h, 0)
+    t_ = _t("gru", {"Input": x, "Weight": w, "Bias": b, "Length": lens},
+            {}, {"Hidden": hid.astype(np.float32)})
+    t_.check_output(atol=1e-4, rtol=1e-4)
+    t_.check_grad(["Input", "Weight"], "Hidden", max_relative_error=0.02)
+
+
+def test_gru_unit_both_modes():
+    B, H = 3, 4
+    x = RNG.standard_normal((B, 3 * H)).astype(np.float32)
+    h = RNG.standard_normal((B, H)).astype(np.float32)
+    w = (RNG.standard_normal((H, 3 * H)) * 0.5).astype(np.float32)
+    b = (RNG.standard_normal((1, 3 * H)) * 0.1).astype(np.float32)
+    for origin in (False, True):
+        out = _np_gru_step(x, h, w, b, H, origin)
+        t = _t("gru_unit",
+               {"Input": x, "HiddenPrev": h, "Weight": w, "Bias": b},
+               {"origin_mode": origin},
+               {"Hidden": out.astype(np.float32)})
+        t.check_output(atol=1e-5, rtol=1e-5)
+        t.check_grad(["Input", "HiddenPrev"], "Hidden",
+                     max_relative_error=0.01)
+
+
+# ------------------------------------------------- conv-ish recurrences
+
+def test_row_conv():
+    B, T, D, K = 2, 6, 3, 3
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    filt = RNG.standard_normal((K, D)).astype(np.float32)
+    lens = np.array([6, 4], np.int32)
+    ref = np.zeros_like(x)
+    for b in range(B):
+        for t in range(lens[b]):
+            for k in range(K):
+                if t + k < lens[b]:
+                    ref[b, t] += x[b, t + k] * filt[k]
+    t = _t("row_conv", {"X": x, "Filter": filt, "Length": lens}, {},
+           {"Out": ref})
+    t.check_output(atol=1e-5, rtol=1e-5)
+    t.check_grad(["X", "Filter"], "Out", max_relative_error=0.01)
+
+
+def test_conv_shift():
+    B, N, M = 2, 7, 3
+    x = RNG.standard_normal((B, N)).astype(np.float32)
+    y = RNG.standard_normal((B, M)).astype(np.float32)
+    ref = np.zeros((B, N), np.float32)
+    for b in range(B):
+        for i in range(N):
+            for j in range(M):
+                ref[b, i] += x[b, (i + j - M // 2) % N] * y[b, j]
+    t = _t("conv_shift", {"X": x, "Y": y}, {}, {"Out": ref})
+    t.check_output(atol=1e-5, rtol=1e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+def test_im2sequence():
+    B, C, H, W = 2, 3, 5, 4
+    kh, kw, sh, sw = 2, 2, 1, 2
+    x = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    ref = np.zeros((B, oh * ow, C * kh * kw), np.float32)
+    for b in range(B):
+        p = 0
+        for i in range(oh):
+            for j in range(ow):
+                patch = x[b, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+                ref[b, p] = patch.reshape(-1)
+                p += 1
+    t = _t("im2sequence", {"X": x},
+           {"kernels": [kh, kw], "strides": [sh, sw]},
+           {"Out": ref,
+            "OutLength": np.full((B,), oh * ow, np.int32)})
+    t.check_output(atol=1e-5, rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+# -------------------------------------------- sampling / interpolation
+
+def test_grid_sampler_identity_grid():
+    B, C, H, W = 2, 3, 4, 5
+    x = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    ys, xs = np.meshgrid(np.linspace(-1, 1, H), np.linspace(-1, 1, W),
+                         indexing="ij")
+    grid = np.stack([xs, ys], axis=-1)[None].repeat(B, 0).astype(np.float32)
+    t = _t("grid_sampler", {"X": x, "Grid": grid}, {}, {"Out": x})
+    t.check_output(atol=1e-5, rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_grid_sampler_shift_half_pixel():
+    B, C, H, W = 1, 1, 1, 4
+    x = np.arange(4, dtype=np.float32).reshape(B, C, H, W)
+    # sample halfway between columns: expect midpoints
+    gx = (np.array([0.5, 1.5, 2.5]) / (W - 1)) * 2 - 1
+    grid = np.stack([gx, np.zeros(3)], -1).reshape(1, 1, 3, 2)
+    ref = np.array([[[[0.5, 1.5, 2.5]]]], np.float32)
+    _t("grid_sampler", {"X": x, "Grid": grid.astype(np.float32)}, {},
+       {"Out": ref}).check_output(atol=1e-6, rtol=1e-6)
+
+
+def test_bicubic_and_trilinear_interp():
+    x = RNG.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    # bicubic upscale matches jax.image; sanity: exact at identity size
+    _t("bicubic_interp", {"X": x}, {"out_h": 4, "out_w": 4},
+       {"Out": x}).check_output(atol=1e-5, rtol=1e-5)
+    v = RNG.standard_normal((2, 2, 3, 3, 3)).astype(np.float32)
+    _t("trilinear_interp", {"X": v},
+       {"out_d": 3, "out_h": 3, "out_w": 3},
+       {"Out": v}).check_output(atol=1e-5, rtol=1e-5)
+
+
+# ------------------------------------------------ sequence completions
+
+def test_sequence_expand():
+    B, T, D = 3, 4, 2
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    lens = np.array([4, 2, 3], np.int32)
+    rep = np.array([2, 0, 3], np.int32)
+    out_rows = 6
+    ref = np.zeros((out_rows, T, D), np.float32)
+    ref_len = np.zeros(out_rows, np.int32)
+    j = 0
+    for i in range(B):
+        for _ in range(rep[i]):
+            ref[j] = x[i]; ref_len[j] = lens[i]; j += 1
+    t = _t("sequence_expand",
+           {"X": x, "Length": lens, "RepeatTimes": rep},
+           {"out_rows": out_rows},
+           {"Out": ref, "OutLength": ref_len})
+    t.check_output(atol=1e-6, rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_sequence_scatter():
+    B, D, U = 2, 5, 3
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    ids = np.array([[0, 2, 2], [4, 1, 0]], np.int32)
+    upd = RNG.standard_normal((B, U)).astype(np.float32)
+    ln = np.array([3, 2], np.int32)
+    ref = x.copy()
+    for b in range(B):
+        for u in range(ln[b]):
+            ref[b, ids[b, u]] += upd[b, u]
+    t = _t("sequence_scatter",
+           {"X": x, "Ids": ids, "Updates": upd, "UpdLength": ln}, {},
+           {"Out": ref})
+    t.check_output(atol=1e-6, rtol=1e-6)
+    t.check_grad(["X", "Updates"], "Out", max_relative_error=0.01)
+
+
+def test_lod_reset_and_shrink_rnn_memory():
+    B, T, D = 2, 4, 3
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    new_len = np.array([2, 4], np.int32)
+    ref = x.copy()
+    ref[0, 2:] = 0
+    _t("lod_reset", {"X": x, "Y": new_len}, {},
+       {"Out": ref, "OutLength": new_len}).check_output(atol=1e-6,
+                                                        rtol=1e-6)
+    lens = np.array([3, 1], np.int32)
+    x2 = RNG.standard_normal((B, D)).astype(np.float32)
+    ref2 = x2.copy()
+    ref2[1] = 0   # row 1 (length 1) is done at step 2
+    t = _t("shrink_rnn_memory", {"X": x2, "Length": lens}, {"step": 2},
+           {"Out": ref2})
+    t.check_output(atol=1e-6, rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.01)
