@@ -101,9 +101,34 @@ class DatasetBase:
 
 
 class QueueDataset(DatasetBase):
-    """Streaming: parse + batch on the fly (reference QueueDataset)."""
+    """Streaming: parse + batch on the fly (reference QueueDataset). When
+    the native C++ feed engine is buildable and the default slot parser is
+    in use, parsing/batching runs GIL-free on `thread_num` reader threads
+    (native/datafeed.cc — the reference's MultiSlotDataFeed runtime);
+    otherwise the pure-python path is used. set_use_native(False) forces
+    python."""
+
+    def __init__(self):
+        super().__init__()
+        self._use_native = True
+
+    def set_use_native(self, flag):
+        self._use_native = bool(flag)
+
+    def _native_ok(self):
+        from . import native_feed
+        return (self._use_native and self.line_parser is None
+                and self.pipe_command is None and self.use_vars
+                and native_feed.available())
 
     def batch_iterator(self):
+        if self._native_ok():
+            from .native_feed import NativeDataFeed
+            slots = [(v.name, "int64" if "int" in v.dtype else "float32")
+                     for v in self.use_vars]
+            return iter(NativeDataFeed(
+                slots, self._shard_files(), self.batch_size,
+                threads=max(self.thread_num, 1)))
         return self._batches(self._iter_files(self._shard_files()))
 
 

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Build the native datafeed engine (no deps beyond libstdc++/pthread).
+cd "$(dirname "$0")"
+exec g++ -std=c++17 -O2 -shared -fPIC -pthread datafeed.cc \
+    -o libpaddle_datafeed.so

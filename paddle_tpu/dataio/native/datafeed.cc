@@ -1,0 +1,308 @@
+// Native multi-threaded slot-data feed engine.
+//
+// TPU-native equivalent of the reference's C++ DataFeed/Dataset ingestion
+// runtime (/root/reference/paddle/fluid/framework/data_feed.h MultiSlot*,
+// framework/data_set.h DatasetImpl, framework/channel.h): N reader threads
+// pull files off a shared list, parse slot-formatted text lines
+// ("name:v1,v2,... name2:...") into contiguous per-slot buffers, batch
+// them, and push batches through a bounded producer/consumer channel the
+// Python DataLoader drains. The GIL-free parse + batch assembly is the
+// point — the reference burns whole host cores on exactly this work per
+// trainer (hogwild_worker.cc TrainFiles' feed->Next()).
+//
+// C ABI only (consumed via ctypes from dataio/native_feed.py; this repo
+// deliberately has no pybind dependency). Build: see build.sh next to
+// this file (g++ -O2 -shared -fPIC -pthread).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::string name;
+  bool is_int = false;         // int64 vs float32
+  std::atomic<int> width{-1};  // values per sample; -1 until inferred
+
+  Slot() = default;
+  Slot(const Slot& o)
+      : name(o.name), is_int(o.is_int), width(o.width.load()) {}
+};
+
+struct Batch {
+  int rows = 0;
+  // per-slot contiguous data, rows * width elements each
+  std::vector<std::vector<float>> fdata;
+  std::vector<std::vector<int64_t>> idata;
+};
+
+struct Feed {
+  std::vector<Slot> slots;
+  std::vector<std::string> files;
+  int batch_size = 1;
+  size_t capacity = 8;
+
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::queue<Batch*> channel;
+  std::atomic<size_t> next_file{0};
+  std::atomic<int> live_readers{0};
+  std::atomic<long long> dropped{0};   // malformed/ragged lines skipped
+  std::mutex width_mu;                 // serializes first-width inference
+  std::atomic<bool> stopping{false};
+  std::vector<std::thread> threads;
+  std::string error;
+
+  ~Feed() { stop(); }
+
+  void stop() {
+    stopping = true;
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+    threads.clear();
+    std::lock_guard<std::mutex> g(mu);
+    while (!channel.empty()) {
+      delete channel.front();
+      channel.pop();
+    }
+  }
+
+  void fail(const std::string& msg) {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (error.empty()) error = msg;
+    }
+    stopping = true;
+    cv_push.notify_all();
+    cv_pop.notify_all();
+  }
+
+  void push(Batch* b) {
+    std::unique_lock<std::mutex> g(mu);
+    cv_push.wait(g, [&] { return channel.size() < capacity || stopping; });
+    if (stopping) {
+      delete b;
+      return;
+    }
+    channel.push(b);
+    cv_pop.notify_one();
+  }
+
+  // nullptr => end of data (all readers done, channel drained) or error
+  Batch* pop() {
+    std::unique_lock<std::mutex> g(mu);
+    cv_pop.wait(g, [&] {
+      return !channel.empty() || live_readers.load() == 0 || stopping;
+    });
+    if (!channel.empty()) {
+      Batch* b = channel.front();
+      channel.pop();
+      cv_push.notify_one();
+      return b;
+    }
+    return nullptr;
+  }
+
+  bool parse_line(const std::string& line, Batch* batch) {
+    // find each slot's "name:" group; groups may appear in any order
+    size_t nslots = slots.size();
+    std::vector<const char*> starts(nslots, nullptr);
+    std::vector<size_t> lens(nslots, 0);
+    const char* p = line.c_str();
+    while (*p) {
+      while (*p == ' ' || *p == '\t') ++p;
+      if (!*p) break;
+      const char* tok = p;
+      while (*p && *p != ' ' && *p != '\t') ++p;
+      const char* colon =
+          static_cast<const char*>(memchr(tok, ':', p - tok));
+      if (!colon) continue;
+      for (size_t s = 0; s < nslots; ++s) {
+        if (slots[s].name.size() == static_cast<size_t>(colon - tok) &&
+            memcmp(slots[s].name.data(), tok, colon - tok) == 0) {
+          starts[s] = colon + 1;
+          lens[s] = p - colon - 1;
+          break;
+        }
+      }
+    }
+    for (size_t s = 0; s < nslots; ++s) {
+      if (!starts[s]) return false;  // missing slot -> drop line
+      // count values
+      int n = 1;
+      for (size_t i = 0; i < lens[s]; ++i)
+        if (starts[s][i] == ',') ++n;
+      int w = slots[s].width.load(std::memory_order_acquire);
+      if (w < 0) {
+        // first width observation: serialize so every thread/batch agrees
+        std::lock_guard<std::mutex> g(width_mu);
+        w = slots[s].width.load(std::memory_order_relaxed);
+        if (w < 0) {
+          slots[s].width.store(n, std::memory_order_release);
+          w = n;
+        }
+      }
+      if (n != w) return false;  // ragged -> drop line
+    }
+    // parse with rollback: a malformed token (non-numeric, trailing
+    // comma) must not leave a partial row behind — the buffers would
+    // silently misalign every following sample in the batch
+    for (size_t s = 0; s < nslots; ++s) {
+      const char* q = starts[s];
+      const char* end = starts[s] + lens[s];
+      int w = slots[s].width.load(std::memory_order_relaxed);
+      size_t before =
+          slots[s].is_int ? batch->idata[s].size() : batch->fdata[s].size();
+      bool bad = false;
+      while (q < end && !bad) {
+        char* next;
+        if (slots[s].is_int)
+          batch->idata[s].push_back(strtoll(q, &next, 10));
+        else
+          batch->fdata[s].push_back(strtof(q, &next));
+        if (next == q) bad = true;          // no progress: garbage token
+        q = (*next == ',') ? next + 1 : next;
+      }
+      size_t added = (slots[s].is_int ? batch->idata[s].size()
+                                      : batch->fdata[s].size()) - before;
+      if (bad || added != static_cast<size_t>(w)) {
+        for (size_t r = 0; r <= s; ++r) {   // roll back this line fully
+          auto trim = [&](auto& vec) {
+            int wr = slots[r].width.load(std::memory_order_relaxed);
+            size_t keep = static_cast<size_t>(batch->rows) *
+                          (wr < 0 ? 0 : wr);
+            if (vec.size() > keep) vec.resize(keep);
+          };
+          if (slots[r].is_int) trim(batch->idata[r]);
+          else trim(batch->fdata[r]);
+        }
+        return false;
+      }
+    }
+    batch->rows += 1;
+    return true;
+  }
+
+  Batch* new_batch() {
+    Batch* b = new Batch();
+    b->fdata.resize(slots.size());
+    b->idata.resize(slots.size());
+    return b;
+  }
+
+  void reader_main() {
+    Batch* batch = new_batch();
+    while (!stopping) {
+      size_t fi = next_file.fetch_add(1);
+      if (fi >= files.size()) break;
+      std::ifstream in(files[fi]);
+      if (!in) {
+        delete batch;
+        fail("datafeed: cannot open file " + files[fi]);
+        live_readers.fetch_sub(1);
+        cv_pop.notify_all();
+        return;
+      }
+      std::string line;
+      while (!stopping && std::getline(in, line)) {
+        if (line.empty()) continue;
+        if (!parse_line(line, batch)) dropped.fetch_add(1);
+        if (batch->rows == batch_size) {
+          push(batch);
+          batch = new_batch();
+        }
+      }
+    }
+    if (batch->rows > 0 && !stopping)
+      push(batch);
+    else
+      delete batch;
+    live_readers.fetch_sub(1);
+    cv_pop.notify_all();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* df_create(const char* slot_names, const char* slot_is_int,
+                int batch_size, int capacity) {
+  auto* f = new Feed();
+  std::stringstream names(slot_names), kinds(slot_is_int);
+  std::string n, k;
+  while (std::getline(names, n, ',') && std::getline(kinds, k, ',')) {
+    Slot s;
+    s.name = n;
+    s.is_int = (k == "1");
+    f->slots.push_back(s);
+  }
+  f->batch_size = batch_size > 0 ? batch_size : 1;
+  f->capacity = capacity > 0 ? capacity : 8;
+  return f;
+}
+
+int df_set_filelist(void* h, const char** paths, int n) {
+  auto* f = static_cast<Feed*>(h);
+  f->files.assign(paths, paths + n);
+  return 0;
+}
+
+int df_start(void* h, int threads) {
+  auto* f = static_cast<Feed*>(h);
+  if (threads < 1) threads = 1;
+  f->stopping = false;
+  f->next_file = 0;
+  f->live_readers = threads;
+  for (int i = 0; i < threads; ++i)
+    f->threads.emplace_back([f] { f->reader_main(); });
+  return 0;
+}
+
+// Returns a batch handle, or NULL at end-of-data / error.
+void* df_next(void* h) { return static_cast<Feed*>(h)->pop(); }
+
+const char* df_error(void* h) {
+  auto* f = static_cast<Feed*>(h);
+  std::lock_guard<std::mutex> g(f->mu);
+  return f->error.empty() ? nullptr : f->error.c_str();
+}
+
+int df_batch_rows(void* b) { return static_cast<Batch*>(b)->rows; }
+
+// Slot width as inferred from data (valid once any batch was produced).
+int df_slot_width(void* h, int slot) {
+  return static_cast<Feed*>(h)->slots[slot].width.load();
+}
+
+long long df_dropped(void* h) {
+  return static_cast<Feed*>(h)->dropped.load();
+}
+
+const float* df_batch_fdata(void* b, int slot) {
+  return static_cast<Batch*>(b)->fdata[slot].data();
+}
+
+const int64_t* df_batch_idata(void* b, int slot) {
+  return static_cast<Batch*>(b)->idata[slot].data();
+}
+
+void df_batch_free(void* b) { delete static_cast<Batch*>(b); }
+
+void df_stop(void* h) { static_cast<Feed*>(h)->stop(); }
+
+void df_free(void* h) { delete static_cast<Feed*>(h); }
+
+}  // extern "C"

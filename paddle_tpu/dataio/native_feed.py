@@ -1,0 +1,144 @@
+"""ctypes front for the native C++ datafeed engine (native/datafeed.cc).
+
+The reference parses slot data with C++ DataFeed threads per trainer
+(framework/data_feed.h, hogwild_worker.cc feed->Next()); the Python
+dataset's pure-python parser is the portable fallback. This wrapper
+builds/loads the shared library on demand and exposes the batches as the
+same {name: np.ndarray} dicts the Python path yields, so Dataset can swap
+engines transparently (dataset.py use_native)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_LIB_ERR = None
+
+
+def _lib():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+    so = os.path.join(here, "libpaddle_datafeed.so")
+    src = os.path.join(here, "datafeed.cc")
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            subprocess.run(
+                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+                 "-pthread", src, "-o", so],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+    except Exception as e:  # no compiler / load failure -> python path
+        _LIB_ERR = e
+        return None
+    lib.df_create.restype = ctypes.c_void_p
+    lib.df_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_int]
+    lib.df_set_filelist.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.df_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_next.restype = ctypes.c_void_p
+    lib.df_next.argtypes = [ctypes.c_void_p]
+    lib.df_error.restype = ctypes.c_char_p
+    lib.df_error.argtypes = [ctypes.c_void_p]
+    lib.df_batch_rows.restype = ctypes.c_int
+    lib.df_batch_rows.argtypes = [ctypes.c_void_p]
+    lib.df_slot_width.restype = ctypes.c_int
+    lib.df_slot_width.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_batch_fdata.restype = ctypes.POINTER(ctypes.c_float)
+    lib.df_batch_fdata.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_batch_idata.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.df_batch_idata.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.df_batch_free.argtypes = [ctypes.c_void_p]
+    lib.df_dropped.restype = ctypes.c_longlong
+    lib.df_dropped.argtypes = [ctypes.c_void_p]
+    lib.df_stop.argtypes = [ctypes.c_void_p]
+    lib.df_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def available():
+    return _lib() is not None
+
+
+class NativeDataFeed:
+    """Iterate {slot_name: array[batch, width]} batches parsed by the C++
+    engine. slots: [(name, dtype)] with dtype 'int64'/'float32'."""
+
+    def __init__(self, slots, files, batch_size, threads=2, capacity=8,
+                 allow_malformed=False):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError(f"native datafeed unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self._slots = [(n, np.dtype(d)) for n, d in slots]
+        names = ",".join(n for n, _ in self._slots).encode()
+        kinds = ",".join(
+            "1" if np.issubdtype(d, np.integer) else "0"
+            for _, d in self._slots).encode()
+        self._h = lib.df_create(names, kinds, batch_size, capacity)
+        arr = (ctypes.c_char_p * len(files))(
+            *[str(f).encode() for f in files])
+        lib.df_set_filelist(self._h, arr, len(files))
+        self._threads = threads
+        self._started = False
+        self._allow_malformed = allow_malformed
+
+    def __iter__(self):
+        lib = self._lib
+        if self._started:
+            raise RuntimeError("NativeDataFeed is single-pass; build a "
+                               "new one per epoch")
+        self._started = True
+        lib.df_start(self._h, self._threads)
+        try:
+            while True:
+                b = lib.df_next(self._h)
+                if not b:
+                    err = lib.df_error(self._h)
+                    if err:
+                        raise RuntimeError(err.decode())
+                    n_drop = lib.df_dropped(self._h)
+                    if n_drop and not self._allow_malformed:
+                        # the pure-python parser raises on the same input;
+                        # a silent sample-count difference between engines
+                        # would corrupt experiments invisibly
+                        raise RuntimeError(
+                            f"native datafeed dropped {n_drop} malformed/"
+                            f"ragged lines (missing slot, bad token, or "
+                            f"inconsistent width); fix the data or pass "
+                            f"allow_malformed=True")
+                    return
+                rows = lib.df_batch_rows(b)
+                out = {}
+                for i, (name, dt) in enumerate(self._slots):
+                    w = lib.df_slot_width(self._h, i)
+                    n = rows * w
+                    if np.issubdtype(dt, np.integer):
+                        ptr = lib.df_batch_idata(b, i)
+                        a = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    else:
+                        ptr = lib.df_batch_fdata(b, i)
+                        a = np.ctypeslib.as_array(ptr, (n,)).copy()
+                    out[name] = a.astype(dt).reshape(rows, w)
+                lib.df_batch_free(b)
+                yield out
+        finally:
+            lib.df_stop(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.df_stop(self._h)
+            self._lib.df_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

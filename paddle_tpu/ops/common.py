@@ -72,3 +72,22 @@ def bilinear_sample(img, yy, xx):
         v = img[..., yi, xi]                      # [C, *coords]
         out = out + v * (wgt * ok.astype(img.dtype))
     return out
+
+
+def compact_rows(x, keep):
+    """Compact kept rows to a zero-padded prefix (masked-dense idiom shared
+    by split_lod_tensor, split_ids, sequence_erase): returns
+    (out_like_x, count) where out[:count] are x's rows with keep==True in
+    order and the tail is zero."""
+    keep = keep.astype(bool)
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    dest = jnp.where(keep, pos, x.shape[0])
+    out = jnp.zeros_like(x).at[dest].set(x, mode="drop")
+    return out, jnp.sum(keep, dtype=jnp.int32)
+
+
+def sigmoid_bce(logit, label):
+    """Numerically stable sigmoid binary cross-entropy (shared by
+    sigmoid_cross_entropy_with_logits and yolov3_loss)."""
+    return (jnp.maximum(logit, 0) - logit * label
+            + jnp.log1p(jnp.exp(-jnp.abs(logit))))

@@ -537,9 +537,7 @@ def yolov3_loss(ctx, ins, attrs):
                    axis=1)
     noobj = best < ignore
 
-    def bce(logit, label):
-        return jnp.maximum(logit, 0) - logit * label + \
-            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    from .common import sigmoid_bce as bce
 
     # per-gt assignment: responsible anchor = best shape-IoU anchor at the
     # gt's cell, restricted to this head's anchor_mask. lax.scan over the

@@ -553,11 +553,13 @@ def cross_entropy2(ctx, ins, attrs):
     label = x_of(ins, "Label").astype(jnp.int32)
     if label.ndim == x.ndim:
         label = label[..., 0]
-    match = jnp.take_along_axis(x, label[..., None], axis=-1)
     ignore = attrs.get("ignore_index", -100)
+    safe = jnp.clip(label, 0, x.shape[-1] - 1)
+    match = jnp.take_along_axis(x, safe[..., None], axis=-1)
     loss = -jnp.log(jnp.maximum(match, 1e-12))
-    if ignore >= 0:
-        loss = jnp.where(label[..., None] == ignore, 0.0, loss)
+    # reference zeroes the loss wherever label == ignore_index, whatever
+    # its sign (the default sentinel is -100)
+    loss = jnp.where(label[..., None] == ignore, 0.0, loss)
     return {"Y": loss, "MatchX": match}
 
 
@@ -650,19 +652,11 @@ def split_lod_tensor(ctx, ins, attrs):
     split_lod_tensor_op.cc, the IfElse input router). Masked-dense: both
     outputs keep the full [B, ...] shape, compacted to their prefix, plus
     valid counts."""
+    from .common import compact_rows
     x = x_of(ins)
     mask = jnp.reshape(x_of(ins, "Mask"), (-1,)).astype(bool)
-    B = x.shape[0]
-
-    def compact(keep):
-        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        dest = jnp.where(keep, pos, B)
-        out = jnp.zeros_like(x)
-        return out.at[dest].set(x, mode="drop"), \
-            jnp.sum(keep, dtype=jnp.int32)
-
-    out_true, n_true = compact(mask)
-    out_false, n_false = compact(~mask)
+    out_true, n_true = compact_rows(x, mask)
+    out_false, n_false = compact_rows(x, ~mask)
     return {"OutTrue": out_true, "OutFalse": out_false,
             "TrueCount": n_true.reshape(1), "FalseCount": n_false.reshape(1)}
 
@@ -696,16 +690,13 @@ def split_ids(ctx, ins, attrs):
     if "num_shards" not in attrs:
         raise ValueError("split_ids requires attr num_shards (the lowering "
                          "cannot see the op's output slot count)")
+    from .common import compact_rows
     n = int(attrs["num_shards"])
-    L = ids.shape[0]
     outs, counts = [], []
     for s in range(n):
-        keep = (ids % n) == s
-        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        dest = jnp.where(keep, pos, L)
-        out = jnp.zeros((L,), jnp.int32).at[dest].set(ids, mode="drop")
+        out, cnt = compact_rows(ids, (ids % n) == s)
         outs.append(out)
-        counts.append(jnp.sum(keep, dtype=jnp.int32))
+        counts.append(cnt)
     return {"Out": outs, "Count": jnp.stack(counts)}
 
 

@@ -559,9 +559,10 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
 
 @register_op("sigmoid_cross_entropy_with_logits")
 def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    from .common import sigmoid_bce
     x = x_of(ins)
     label = x_of(ins, "Label")
-    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    loss = sigmoid_bce(x, label)
     ignore = attrs.get("ignore_index", -100)
     loss = jnp.where(label == ignore, 0.0, loss)
     if attrs.get("normalize", False):

@@ -281,6 +281,13 @@ def test_cross_entropy2():
            {"Y": -np.log(match), "MatchX": match})
     t.check_output(atol=1e-5, rtol=1e-5)
     t.check_grad(["X"], "Y", max_relative_error=0.01)
+    # default -100 sentinel zeroes the loss (reference semantics)
+    label2 = np.array([[2], [-100], [5], [-100]], np.int32)
+    ref = -np.log(np.take_along_axis(p, np.clip(label2, 0, C - 1), -1))
+    ref[1] = ref[3] = 0.0
+    _t("cross_entropy2", {"X": p, "Label": label2}, {},
+       {"Y": ref.astype(np.float32)}).check_output(
+        no_check_set=("MatchX",), atol=1e-5, rtol=1e-5)
 
 
 def test_partial_concat_and_sum():
