@@ -1,12 +1,23 @@
-"""Flagship benchmark: BERT-base pretrain step throughput, bf16 AMP.
+"""Benchmarks for the BASELINE.json config matrix. Prints ONE JSON line.
 
-BASELINE.json config 3 (ERNIE/BERT-base, the reference's Fleet-collective
-path). The anchor is read from BASELINE.json "published" (V100 fp16 seq-128
-BERT-base pretrain throughput); the north star asks for >= anchor/1.2 per
-chip. Fresh batches stream through the DataLoader each step (no cached-feed
-flattery), precision is bf16 with fp32 master weights via
-contrib.mixed_precision, and MFU is reported against the chip's peak bf16
-FLOPs. Prints ONE JSON line.
+Default (no args): config 3 — BERT-base pretrain step throughput, bf16
+AMP (the reference's Fleet-collective path). The anchor is read from
+BASELINE.json "published" (V100 fp16 seq-128 BERT-base pretrain
+throughput); the north star asks for >= anchor/1.2 per chip. Fresh batches
+stream through the DataLoader each step (no cached-feed flattery),
+precision is bf16 with fp32 master weights via contrib.mixed_precision,
+steps dispatch asynchronously with a hard fetch per timing window, and MFU
+is reported against the chip's peak bf16 FLOPs.
+
+--config selects the other BASELINE configs (same protocol; absolute
+throughput, vs_baseline only where BASELINE.json stores an anchor):
+  mnist               config 1: static LeNet, single-device Executor.run
+  resnet50            config 2: ResNet-50 ImageNet shapes, bf16 AMP
+  bert                config 3: the default flagship
+  widedeep            config 4: Wide&Deep CTR, sparse embeddings
+  dygraph_transformer config 5: Transformer-base MT, eager tracer
+  bert_long           extra: BERT + Pallas flash attention at seq 2048
+                      (the long-context capability the reference lacks)
 """
 import json
 import os
@@ -158,5 +169,214 @@ def main():
     print(json.dumps(result))
 
 
+def _device_pool(pool):
+    """Pre-stage a rotating feed pool on device and return a feed_fn
+    cycling through it. On this harness the chip sits behind a network
+    tunnel (~8 MB/s host->device), which would make large-feed benchmarks
+    measure the tunnel, not the framework; a real TPU host feeds over
+    local DMA with the DataLoader double-buffering transfers behind the
+    step (dataio/reader.py). Device-resident feeds model that overlap
+    honestly. Completion is forced by a device-side reduction fetched as
+    one scalar (block_until_ready is unreliable on this runtime, and a
+    full np.asarray would copy every batch back through the tunnel)."""
+    import itertools
+    import jax
+    import jax.numpy as jnp
+    staged = [{k: jax.device_put(v) for k, v in b.items()} for b in pool]
+    for b in staged:
+        for v in b.values():
+            float(jnp.sum(v.astype(jnp.float32)))
+    it = itertools.cycle(staged)
+    return lambda: next(it)
+
+
+def _time_static(exe, scope, prog, feed_fn, loss_name, steps, warmup,
+                 batch):
+    """Shared async-window timing loop (median window)."""
+    import paddle_tpu as fluid
+    with fluid.scope_guard(scope):
+        for _ in range(warmup):
+            loss, = exe.run(prog, feed=feed_fn(), fetch_list=[loss_name],
+                            return_numpy=False)
+        float(np.asarray(loss).reshape(()))
+        window = max(steps // 2, 1)
+        dts = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(window):
+                loss, = exe.run(prog, feed=feed_fn(),
+                                fetch_list=[loss_name],
+                                return_numpy=False)
+            lv = float(np.asarray(loss).reshape(()))
+            dts.append(time.perf_counter() - t0)
+    assert np.isfinite(lv), lv
+    return batch * window / float(np.median(dts))
+
+
+def bench_mnist():
+    import paddle_tpu as fluid
+    from paddle_tpu.models.lenet import build_lenet_train
+    main_prog, startup, feeds, fetches = build_lenet_train()
+    batch = 512
+    rng = np.random.default_rng(0)
+    feed_fn = _device_pool(
+        [{"img": rng.standard_normal(
+              (batch, 1, 28, 28)).astype(np.float32),
+          "label": rng.integers(0, 10, (batch, 1)).astype(np.int64)}
+         for _ in range(2)])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    v = _time_static(exe, scope, main_prog, feed_fn, fetches[0].name,
+                     40, 5, batch)
+    print(json.dumps({"metric": "mnist_lenet_samples_per_sec",
+                      "value": round(v, 1), "unit": "samples/sec",
+                      "vs_baseline": None}))
+
+
+def bench_resnet50():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as fluid
+    from paddle_tpu.models.resnet import resnet_train_program
+    from paddle_tpu.contrib import mixed_precision as mp
+    batch = 128
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = resnet_train_program(depth=50, batch_size=batch)
+        opt = fluid.optimizer.Momentum(0.1, 0.9)
+        opt = mp.decorate(opt, init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)
+        opt.minimize(out["loss"])
+    rng = np.random.default_rng(0)
+    feed_fn = _device_pool(
+        [{"image": rng.standard_normal(
+              (batch, 3, 224, 224)).astype(np.float32),
+          "label": rng.integers(0, 1000, (batch, 1)).astype(np.int64)}
+         for _ in range(2)])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
+                     20, 5, batch)
+    print(json.dumps({"metric": "resnet50_bf16_images_per_sec_per_chip",
+                      "value": round(v, 1), "unit": "images/sec",
+                      "vs_baseline": None}))
+
+
+def bench_widedeep():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import widedeep
+    batch = 4096
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = widedeep.wide_deep(batch_size=batch)
+        fluid.optimizer.Adam(1e-3).minimize(out["loss"])
+    rng = np.random.default_rng(0)
+    feed_fn = _device_pool(
+        [widedeep.random_batch(batch, rng=rng) for _ in range(2)])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
+                     40, 5, batch)
+    print(json.dumps({"metric": "widedeep_ctr_samples_per_sec_per_chip",
+                      "value": round(v, 1), "unit": "samples/sec",
+                      "vs_baseline": None}))
+
+
+def bench_dygraph_transformer():
+    """Eager tracer dispatch (BASELINE config 5). NOTE: on this harness
+    every eager primitive dispatch pays the device tunnel's round trip
+    (~15-20 ms x ~4k ops/step), so the absolute number reflects harness
+    latency, not tracer overhead — batch size is nearly free, so a large
+    batch is used; see BENCHMARKS.md."""
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+    from paddle_tpu.models import transformer
+    batch, src_len, tgt_len = 64, 32, 32
+    vocab = 8000
+    rng = np.random.default_rng(0)
+    with dygraph.guard():
+        model = transformer.Transformer(vocab, vocab, max_len=64)
+        opt = fluid.optimizer.Adam(1e-4,
+                                   parameter_list=model.parameters())
+        feed = transformer.random_batch(batch, src_len, tgt_len,
+                                        vocab, vocab, rng=rng)
+        fv = {k: dygraph.to_variable(v) for k, v in feed.items()}
+
+        def step():
+            loss = model(fv["src_ids"], fv["src_mask"], fv["tgt_ids"],
+                         fv["labels"], fv["label_mask"])
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            return float(loss.numpy().reshape(-1)[0])
+        # warmup compiles every unique eager-op shape (slow on a
+        # remote-compile harness); steady state is dispatch-bound
+        step()
+        t0 = time.perf_counter()
+        n = 3
+        for _ in range(n):
+            step()
+        dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "dygraph_transformer_base_samples_per_sec",
+        "value": round(batch * n / dt, 1), "unit": "samples/sec",
+        "vs_baseline": None}))
+
+
+def bench_bert_long():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.contrib import mixed_precision as mp
+    cfg = bert.BertConfig.base()
+    cfg.attn_mechanism = "flash"     # Pallas kernel: no [S,S] in HBM
+    batch, seq_len, max_preds = 16, 2048, 64
+    cfg.max_position = seq_len
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = bert.bert_pretrain(cfg, batch, seq_len, max_preds)
+        opt = fluid.optimizer.AdamOptimizer(
+            fluid.layers.noam_decay(cfg.hidden_size, 10000, 200.0))
+        opt = mp.decorate(opt, init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)
+        opt.minimize(out["loss"])
+    rng = np.random.default_rng(0)
+    feed_fn = _device_pool(
+        [bert.random_batch(cfg, batch, seq_len, max_preds, rng=rng)
+         for _ in range(2)])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
+                     10, 3, batch)
+    print(json.dumps({
+        "metric": "bert_base_seq2048_flash_bf16_samples_per_sec",
+        "value": round(v, 2), "unit": "samples/sec",
+        "tokens_per_sec": round(v * seq_len, 0),
+        "vs_baseline": None}))
+
+
+_CONFIGS = {
+    "bert": main,
+    "mnist": bench_mnist,
+    "resnet50": bench_resnet50,
+    "widedeep": bench_widedeep,
+    "dygraph_transformer": bench_dygraph_transformer,
+    "bert_long": bench_bert_long,
+}
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="bert", choices=sorted(_CONFIGS))
+    args = ap.parse_args()
+    _CONFIGS[args.config]()

@@ -66,7 +66,13 @@ struct Feed {
   ~Feed() { stop(); }
 
   void stop() {
-    stopping = true;
+    {
+      // the flag flip must be ordered with waiters' predicate checks:
+      // an unlocked store+notify can fire between a waiter's check and
+      // its wait(), losing the wakeup forever
+      std::lock_guard<std::mutex> g(mu);
+      stopping = true;
+    }
     cv_push.notify_all();
     cv_pop.notify_all();
     for (auto& t : threads)
@@ -83,8 +89,8 @@ struct Feed {
     {
       std::lock_guard<std::mutex> g(mu);
       if (error.empty()) error = msg;
+      stopping = true;
     }
-    stopping = true;
     cv_push.notify_all();
     cv_pop.notify_all();
   }
@@ -211,7 +217,10 @@ struct Feed {
       if (!in) {
         delete batch;
         fail("datafeed: cannot open file " + files[fi]);
-        live_readers.fetch_sub(1);
+        {
+          std::lock_guard<std::mutex> g(mu);
+          live_readers.fetch_sub(1);
+        }
         cv_pop.notify_all();
         return;
       }
@@ -229,7 +238,10 @@ struct Feed {
       push(batch);
     else
       delete batch;
-    live_readers.fetch_sub(1);
+    {
+      std::lock_guard<std::mutex> g(mu);
+      live_readers.fetch_sub(1);
+    }
     cv_pop.notify_all();
   }
 };

@@ -27,10 +27,9 @@ def _lib():
     try:
         if (not os.path.exists(so)
                 or os.path.getmtime(so) < os.path.getmtime(src)):
-            subprocess.run(
-                ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
-                 "-pthread", src, "-o", so],
-                check=True, capture_output=True)
+            # build.sh is the single source of truth for compile flags
+            subprocess.run(["sh", os.path.join(here, "build.sh")],
+                           check=True, capture_output=True)
         lib = ctypes.CDLL(so)
     except Exception as e:  # no compiler / load failure -> python path
         _LIB_ERR = e

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..framework import unique_name
 from ..framework.dtype import convert_dtype, np_dtype
-from ..framework.registry import get_op_def, normalize_outs
+from ..framework.registry import get_op_def, normalize_outs, register_op
 
 _tracer = None
 
@@ -202,14 +202,21 @@ class Tracer:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def trace_op(self, op_type, inputs, outputs, attrs=None):
+    def trace_op(self, op_type, inputs, outputs, attrs=None,
+                 in_vals_override=None):
         """inputs: {slot: [VarBase]}; outputs: {slot: [VarBase placeholders]}
-        whose .value this fills. Returns outputs."""
+        whose .value this fills. Returns outputs. in_vals_override replaces
+        specific slots' arrays (run_backward_traced feeds the tape's
+        forward-value SNAPSHOTS so in-place mutations after the forward
+        don't corrupt the recorded vjp)."""
         attrs = dict(attrs or {})
         opdef = get_op_def(op_type)
         key = self.next_key() if opdef.needs_rng else None
         ctx = _EagerCtx(key)
         ins_arrays = {s: [v.value for v in vs] for s, vs in inputs.items()}
+        if in_vals_override:
+            ins_arrays.update(
+                {s: list(a) for s, a in in_vals_override.items()})
         raw = opdef.lower(ctx, ins_arrays, attrs)
         if raw is None:
             raw = {}
@@ -295,6 +302,7 @@ class Tracer:
                     prev = grads.get(id(v))
                     grads[id(v)] = g if prev is None else prev + g
 
+        # (traced variant below re-runs this walk through trace_op)
         # write accumulated grads into .grad (reference GradientAccumulator
         # semantics: repeated backward() calls sum into the same .grad)
         touched = {}
@@ -318,6 +326,142 @@ class Tracer:
         if not retain_graph:
             self.tape.clear()
 
+    def run_backward_traced(self, root, seed_grad=None):
+        """Backward pass executed THROUGH trace_op so the gradient
+        computation lands on the tape and can itself be differentiated
+        (dygraph.grad(create_graph=True) — the reference's
+        partial_grad_engine higher-order path). Returns
+        {id(VarBase): grad VarBase} without touching .grad accumulators."""
+        tape_snapshot = list(self.tape)   # new entries are appended live
+        grads = {}      # id(VarBase) -> grad VarBase (pending)
+        out_grads = {}
+        if seed_grad is None:
+            seed = VarBase(jnp.ones_like(root.value))
+        else:
+            seed = (seed_grad if isinstance(seed_grad, VarBase)
+                    else VarBase(jnp.asarray(seed_grad, root.value.dtype)))
+        grads[id(root)] = seed
+
+        for entry in reversed(tape_snapshot):
+            out_vars = [v for vs in entry.outs.values() for v in vs]
+            if not any(id(v) in grads for v in out_vars):
+                continue
+            ins = {s: list(vs) for s, vs in entry.ins.items()}
+            consumed = []
+            for slot, vs in entry.outs.items():
+                cts = []
+                for v in vs:
+                    g = grads.get(id(v))
+                    if g is None:
+                        g = VarBase(jnp.zeros_like(v.value))
+                    else:
+                        consumed.append(id(v))
+                    cts.append(g)
+                ins[slot + "@CT"] = cts
+            if entry.key is not None:
+                ins["__Key__"] = [VarBase(entry.key)]
+            for vid in consumed:
+                if vid in grads:
+                    out_grads.setdefault(vid, grads.pop(vid))
+            attrs = {
+                "fwd_type": entry.op_type,
+                "fwd_attrs": entry.attrs,
+                "in_slots": [(s, len(vs)) for s, vs in entry.ins.items()],
+                "out_slots": [(s, len(vs))
+                              for s, vs in entry.outs.items()],
+                "needs": {s: [not v.stop_gradient for v in vs]
+                          for s, vs in entry.ins.items()},
+            }
+            outs = {s + "@GRAD": [VarBase(np.zeros((), np.float32),
+                                          stop_gradient=False)
+                                  for _ in vs]
+                    for s, vs in entry.ins.items()}
+            placeholders = {gv: gv.value
+                            for gvs in outs.values() for gv in gvs}
+            self.trace_op("__tape_vjp__", ins, outs, attrs,
+                          in_vals_override=entry.in_vals)
+            for slot, vs in entry.ins.items():
+                for v, gv in zip(vs, outs[slot + "@GRAD"]):
+                    if v.stop_gradient:
+                        continue
+                    if gv.value is placeholders[gv]:
+                        continue          # lowering produced no grad
+                    prev = grads.get(id(v))
+                    grads[id(v)] = gv if prev is None else prev + gv
+        final = dict(out_grads)
+        final.update(grads)
+        return final
+
+
+@register_op("__tape_vjp__", infer_shape=False)
+def _tape_vjp_lower(ctx, ins, attrs):
+    """One tape entry's backward as a REGULAR (differentiable) op: given
+    the entry's forward inputs (original slots) and output cotangents
+    ("<slot>@CT"), return "<slot>@GRAD" input gradients via jax.vjp over
+    the forward lowering. Because this is itself a registered lowering,
+    recording it on the tape makes the backward pass differentiable —
+    the double-backward mechanism (reference
+    imperative/partial_grad_engine.cc higher-order path)."""
+    fwd_def = get_op_def(attrs["fwd_type"])
+    fattrs = attrs["fwd_attrs"]
+    in_slots = [tuple(p) for p in attrs["in_slots"]]    # [(slot, n)]
+    out_slots = [tuple(p) for p in attrs["out_slots"]]
+    needs = attrs.get("needs", {})       # {slot: [bool per var]}
+    key = ins["__Key__"][0] if "__Key__" in ins else None
+
+    def _need(s, i):
+        flags = needs.get(s)
+        return True if flags is None else bool(flags[i])
+
+    # differentiate ONLY the inputs that need grads: un-needed primal
+    # cotangents can be ill-defined (e.g. d pow/d exponent = x^y*log(x)
+    # NaNs for x<0) and must never enter the graph, or a second
+    # differentiation of this op propagates the NaN
+    primals = {f"{s}#{i}": jnp.asarray(ins[s][i])
+               for s, n in in_slots for i in range(n) if _need(s, i)}
+
+    def f(p):
+        full = {s: [p[f"{s}#{i}"] if f"{s}#{i}" in p
+                    else jnp.asarray(ins[s][i]) for i in range(n)]
+                for s, n in in_slots}
+        ectx = _EagerCtx(key)
+        raw = fwd_def.lower(ectx, full, fattrs)
+        outs = normalize_outs({}, raw or {})
+        return {s: outs[s] for s, _ in out_slots if s in outs}
+
+    outs, vjp_fn = jax.vjp(f, primals)
+    cts = {}
+    for s, n in out_slots:
+        arrs = outs.get(s)
+        if arrs is None:
+            continue
+        cvs = ins.get(s + "@CT") or []
+        lst = []
+        for i, a in enumerate(arrs):
+            if not jnp.issubdtype(a.dtype, jnp.inexact):
+                lst.append(np.zeros(a.shape, jax.dtypes.float0))
+                continue
+            g = cvs[i] if i < len(cvs) else None
+            lst.append(jnp.zeros(a.shape, a.dtype) if g is None
+                       else jnp.asarray(g, a.dtype))
+        cts[s] = lst
+    (gp,) = vjp_fn(cts)
+    result = {}
+    for s, n in in_slots:
+        vals = []
+        any_g = False
+        for i in range(n):
+            g = gp.get(f"{s}#{i}")
+            if g is None or (hasattr(g, "dtype")
+                             and g.dtype == jax.dtypes.float0):
+                vals.append(None)
+            else:
+                vals.append(g)
+                any_g = True
+        if any_g:
+            result[s + "@GRAD"] = vals
+    return result
+
 
 def to_variable(value, name=None, zero_copy=None):
     """numpy/list -> VarBase (reference dygraph/base.py:493)."""
@@ -335,10 +479,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     touching .grad accumulators."""
     t = _current_tracer()
     assert t is not None, "dygraph.grad requires dygraph mode"
-    if create_graph:
-        raise NotImplementedError(
-            "dygraph.grad(create_graph=True) (double backward) is not "
-            "supported yet")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs,
@@ -349,6 +489,30 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         if not v.stop_gradient:
             v.stop_gradient = True
             frozen.append(v)
+
+    if create_graph:
+        # traced backward: gradient ops land on the tape, so the returned
+        # grads are differentiable (double backward)
+        acc = {}
+        for i, root in enumerate(outputs):
+            seed = None
+            if grad_outputs is not None and i < len(grad_outputs) and \
+                    grad_outputs[i] is not None:
+                seed = grad_outputs[i]
+            for vid, g in t.run_backward_traced(root,
+                                                seed_grad=seed).items():
+                prev = acc.get(vid)
+                acc[vid] = g if prev is None else prev + g
+        res = []
+        for iv in inputs:
+            g = acc.get(id(iv))
+            if g is None and not allow_unused:
+                raise RuntimeError(f"input {iv.name} is unused in the "
+                                   f"graph")
+            res.append(g)
+        for v in frozen:
+            v.stop_gradient = False
+        return res
 
     touched = {id(v): v for e in t.tape
                for vs in list(e.ins.values()) + list(e.outs.values())

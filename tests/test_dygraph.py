@@ -366,3 +366,70 @@ def test_new_dygraph_layer_classes():
         w = wn.numpy().reshape(4, -1)
         # largest singular value normalized to ~1
         assert abs(np.linalg.svd(w, compute_uv=False)[0] - 1.0) < 0.2
+
+
+def test_double_backward_polynomial():
+    """dygraph.grad(create_graph=True): the returned grads are
+    differentiable (reference imperative/partial_grad_engine.cc
+    higher-order path). d2/dx2 sum(x^3) = 6x; triple: d3 sum(x^4) = 24x."""
+    with dygraph.guard():
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        x = dygraph.to_variable(xv)
+        x.stop_gradient = False
+        s = fluid.layers.reduce_sum(x * x * x)
+        (g1,) = dygraph.grad(s, [x], create_graph=True)
+        np.testing.assert_allclose(g1.numpy(), 3 * xv ** 2, rtol=1e-5)
+        (g2,) = dygraph.grad(fluid.layers.reduce_sum(g1), [x])
+        np.testing.assert_allclose(g2.numpy(), 6 * xv, rtol=1e-5)
+
+    with dygraph.guard():
+        xv = np.array([2.0], np.float32)
+        x = dygraph.to_variable(xv)
+        x.stop_gradient = False
+        s = fluid.layers.reduce_sum(x * x * x * x)
+        (g1,) = dygraph.grad(s, [x], create_graph=True)
+        (g2,) = dygraph.grad(fluid.layers.reduce_sum(g1), [x],
+                             create_graph=True)
+        (g3,) = dygraph.grad(fluid.layers.reduce_sum(g2), [x])
+        np.testing.assert_allclose(g3.numpy(), 24 * xv, rtol=1e-5)
+
+
+def test_gradient_penalty_reaches_weights():
+    """WGAN-GP style: backward through a gradient — the second-order path
+    must reach the layer weights, including through elementwise_pow whose
+    exponent-branch vjp is NaN-producing (d pow/d exponent needs log(x))
+    and must stay out of the graph."""
+    import paddle_tpu.dygraph.nn as dnn
+
+    with dygraph.guard():
+        lin = dnn.Linear(3, 1)
+        x = dygraph.to_variable(np.array([[1., 2., 3.]], np.float32))
+        x.stop_gradient = False
+        out = fluid.layers.reduce_sum(lin(x) ** 2.0)
+        (gx,) = dygraph.grad(out, [x], create_graph=True)
+        gp = fluid.layers.reduce_sum(gx * gx)
+        gp.backward()
+        wv = np.asarray(lin.weight.value).ravel()
+        bv = float(np.asarray(lin.bias.value).reshape(()))
+        xv = np.array([1., 2., 3.])
+        a = wv @ xv + bv
+        # gp = 4(wx+b)^2|w|^2 -> d/dw = 8a|w|^2 x + 8a^2 w
+        ref = 8 * a * (wv @ wv) * xv + 8 * a * a * wv
+        np.testing.assert_allclose(lin.weight.gradient().ravel(), ref,
+                                   rtol=1e-4)
+
+
+def test_create_graph_respects_no_grad_vars_and_seed():
+    with dygraph.guard():
+        xv = np.array([1.0, 4.0], np.float32)
+        x = dygraph.to_variable(xv)
+        x.stop_gradient = False
+        y = x * x
+        seed = dygraph.to_variable(np.array([2.0, 0.5], np.float32))
+        (g,) = dygraph.grad(y, [x], grad_outputs=[seed],
+                            create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 2 * xv * seed.numpy(),
+                                   rtol=1e-5)
+        (g2,) = dygraph.grad(fluid.layers.reduce_sum(g), [x])
+        np.testing.assert_allclose(g2.numpy(), 2 * seed.numpy(),
+                                   rtol=1e-5)
