@@ -118,30 +118,11 @@ def main():
     loss_name = out["loss"].name
     with fluid.scope_guard(scope):
         exe.run(startup)
-        it = iter(loader())
-        for _ in range(warmup):
-            loss, = exe.run(main_prog, feed=next(it),
-                            fetch_list=[loss_name], return_numpy=False)
-        float(np.asarray(loss).reshape(()))  # sync before timing
-        # steps dispatch asynchronously (a real training loop logs the
-        # loss every N steps, not per step — per-step host syncs serialize
-        # the device against the host round-trip); each window ends with a
-        # hard fetch. Median window: robust to interference spikes on a
-        # shared chip without cherry-picking the single fastest window.
-        window = min(10, steps)
-        dts = []
-        for _ in range(steps // window):
-            t0 = time.perf_counter()
-            for _ in range(window):
-                loss, = exe.run(main_prog, feed=next(it),
-                                fetch_list=[loss_name],
-                                return_numpy=False)
-            loss = float(np.asarray(loss).reshape(()))  # fetch syncs
-            dts.append(time.perf_counter() - t0)
+    it = iter(loader())
+    value = _time_static(exe, scope, main_prog, lambda: next(it),
+                         loss_name, steps, warmup, batch,
+                         window=min(10, steps))
     loader.reset()
-    assert np.isfinite(loss), "loss diverged"
-
-    value = batch * window / float(np.median(dts))
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "BASELINE.json")
@@ -191,17 +172,22 @@ def _device_pool(pool):
 
 
 def _time_static(exe, scope, prog, feed_fn, loss_name, steps, warmup,
-                 batch):
-    """Shared async-window timing loop (median window)."""
+                 batch, window=None):
+    """Shared protocol for every config: steps dispatch asynchronously (a
+    real training loop logs the loss every N steps, not per step — a
+    per-step host sync would serialize the device against the host round
+    trip); each window ends with a hard fetch; the MEDIAN window is
+    reported — robust to interference spikes on a shared chip without
+    cherry-picking the single fastest window."""
     import paddle_tpu as fluid
     with fluid.scope_guard(scope):
         for _ in range(warmup):
             loss, = exe.run(prog, feed=feed_fn(), fetch_list=[loss_name],
                             return_numpy=False)
         float(np.asarray(loss).reshape(()))
-        window = max(steps // 2, 1)
+        window = window or max(steps // 2, 1)
         dts = []
-        for _ in range(2):
+        for _ in range(max(steps // window, 2)):
             t0 = time.perf_counter()
             for _ in range(window):
                 loss, = exe.run(prog, feed=feed_fn(),

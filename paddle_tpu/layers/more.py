@@ -1,0 +1,631 @@
+"""Remaining fluid.layers surface: thin wrappers over registered ops plus
+small composites (reference python/paddle/fluid/layers/{nn,detection,
+tensor,loss}.py signatures). Everything here emits ops through
+LayerHelper so both static programs and the eager tracer work."""
+import numpy as np
+
+from .layer_helper import LayerHelper
+from . import math as M
+from . import tensor as T
+from . import loss as L
+
+
+def _single(op_type, ins, attrs, dtype, out_slot="Out", name=None,
+            infer_shape=False, shape=None, stop_gradient=False):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=stop_gradient)
+    helper.append_op(type=op_type, inputs=ins, attrs=attrs or {},
+                     outputs={out_slot: [out]}, infer_shape=infer_shape)
+    if shape is not None and getattr(out, "shape", None) in (None, ()):
+        out.shape = tuple(shape)
+    return out
+
+
+def _multi(op_type, ins, attrs, outs_spec, name=None, infer_shape=False):
+    """outs_spec: [(slot, dtype)] -> tuple of vars in that order."""
+    helper = LayerHelper(op_type, name=name)
+    outs = {s: [helper.create_variable_for_type_inference(d)]
+            for s, d in outs_spec}
+    helper.append_op(type=op_type, inputs=ins, attrs=attrs or {},
+                     outputs=outs, infer_shape=infer_shape)
+    vals = tuple(outs[s][0] for s, _ in outs_spec)
+    return vals if len(vals) > 1 else vals[0]
+
+
+# --------------------------------------------------------------- RNN API
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", length=None, name=None):
+    """reference layers/nn.py dynamic_lstm -> lstm op. input [B, T, 4H]
+    (pre-projected); size = 4H. Returns (hidden, cell) [B, T, H]."""
+    H = size // 4
+    helper = LayerHelper("dynamic_lstm", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    weight = helper.create_parameter(param_attr, [H, 4 * H], input.dtype)
+    bias_w = 7 * H if use_peepholes else 4 * H
+    bias = helper.create_parameter(bias_attr, [1, bias_w], input.dtype,
+                                   is_bias=True)
+    ins = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    if length is not None:
+        ins["Length"] = [length]
+    hidden, cell = _multi(
+        "lstm", ins,
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation},
+        [("Hidden", input.dtype), ("Cell", input.dtype)], name=name)
+    B, Tm = input.shape[0], input.shape[1]
+    for v in (hidden, cell):
+        v.shape = (B, Tm, H)
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  length=None, name=None):
+    """reference dynamic_lstmp -> lstmp op. Returns (projection, cell)."""
+    H = size // 4
+    helper = LayerHelper("dynamic_lstmp", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    weight = helper.create_parameter(param_attr, [proj_size, 4 * H],
+                                     input.dtype)
+    proj_w = helper.create_parameter(param_attr, [H, proj_size],
+                                     input.dtype)
+    bias = helper.create_parameter(bias_attr, [1, 4 * H], input.dtype,
+                                   is_bias=True)
+    ins = {"Input": [input], "Weight": [weight], "ProjWeight": [proj_w],
+           "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    if length is not None:
+        ins["Length"] = [length]
+    proj, cell = _multi(
+        "lstmp", ins,
+        {"is_reverse": is_reverse, "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation,
+         "proj_activation": proj_activation},
+        [("Projection", input.dtype), ("Cell", input.dtype)], name=name)
+    B, Tm = input.shape[0], input.shape[1]
+    proj.shape = (B, Tm, proj_size)
+    cell.shape = (B, Tm, H)
+    return proj, cell
+
+
+def dynamic_gru(input, size, h_0=None, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", origin_mode=False,
+                length=None, name=None):
+    """reference dynamic_gru -> gru op. input [B, T, 3H]; size = H."""
+    helper = LayerHelper("dynamic_gru", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    weight = helper.create_parameter(param_attr, [size, 3 * size],
+                                     input.dtype)
+    bias = helper.create_parameter(bias_attr, [1, 3 * size], input.dtype,
+                                   is_bias=True)
+    ins = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if length is not None:
+        ins["Length"] = [length]
+    out = _single("gru", ins,
+                  {"is_reverse": is_reverse, "origin_mode": origin_mode,
+                   "gate_activation": gate_activation,
+                   "activation": candidate_activation},
+                  input.dtype, out_slot="Hidden", name=name)
+    out.shape = (input.shape[0], input.shape[1], size)
+    return out
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers=1,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference layers/nn.py lstm (the cudnn_lstm front). This build maps
+    it onto stacked `lstm` ops with an in-graph input projection per
+    layer/direction (same capability; the cudnn packed-weight blob is a
+    GPU-only artifact). Returns (out [B,T,H*dirs], last_h, last_c) where
+    the last states are the FINAL layer's last valid step, shaped
+    [1, B, H*dirs] (the reference stacks all layers — documented
+    divergence)."""
+    from . import nn as nn_mod
+    x = input
+    dirs = 2 if is_bidirec else 1
+    Tm = input.shape[1]
+
+    def _at(v, t):
+        sl = T.slice(v, axes=[1], starts=[t], ends=[t + 1])
+        return T.transpose(sl, [1, 0, 2])          # [1, B, H]
+
+    last_h = last_c = None
+    for layer in range(num_layers):
+        per_dir, last_hs, last_cs = [], [], []
+        for d in range(dirs):
+            proj = nn_mod.fc(x, 4 * hidden_size, num_flatten_dims=2,
+                             bias_attr=False)
+            hidden, cell = dynamic_lstm(
+                proj, 4 * hidden_size, use_peepholes=False,
+                is_reverse=(d == 1))
+            per_dir.append(hidden)
+            # the reverse direction processes t=Tm-1 FIRST; its final
+            # state lives at t=0
+            t_last = 0 if d == 1 else Tm - 1
+            last_hs.append(_at(hidden, t_last))
+            last_cs.append(_at(cell, t_last))
+        x = per_dir[0] if dirs == 1 else T.concat(per_dir, axis=2)
+        last_h = last_hs[0] if dirs == 1 else T.concat(last_hs, axis=2)
+        last_c = last_cs[0] if dirs == 1 else T.concat(last_cs, axis=2)
+        if dropout_prob and not is_test and layer < num_layers - 1:
+            # cudnn semantics: dropout BETWEEN layers, never after the top
+            x = nn_mod.dropout(x, dropout_prob)
+    return x, last_h, last_c
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             length=None, name=None):
+    helper = LayerHelper("row_conv", name=name, param_attr=param_attr)
+    filt = helper.create_parameter(
+        param_attr, [future_context_size, input.shape[-1]], input.dtype)
+    ins = {"X": [input], "Filter": [filt]}
+    if length is not None:
+        ins["Length"] = [length]
+    out = _single("row_conv", ins, {}, input.dtype, name=name,
+                  shape=input.shape)
+    return helper.append_activation(out, act)
+
+
+# ---------------------------------------------------- vision / sampling
+
+def affine_grid(theta, out_shape, name=None):
+    return _single("affine_grid", {"Theta": [theta]},
+                   {"output_shape": list(out_shape)}, theta.dtype,
+                   out_slot="Output", name=name)
+
+
+def grid_sampler(x, grid, name=None):
+    return _single("grid_sampler", {"X": [x], "Grid": [grid]}, {},
+                   x.dtype, name=name, shape=x.shape)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """reference layers/nn.py deformable_conv."""
+    helper = LayerHelper("deformable_conv", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    k = filter_size if isinstance(filter_size, (list, tuple)) else \
+        (filter_size, filter_size)
+    cin = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, [num_filters, cin // groups, k[0], k[1]], input.dtype)
+    ins = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    op = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = [mask]
+    two = lambda v: list(v) if isinstance(v, (list, tuple)) else [v, v]
+    out = _single(op, ins,
+                  {"strides": two(stride), "paddings": two(padding),
+                   "dilations": two(dilation), "groups": groups,
+                   "deformable_groups": deformable_groups},
+                  input.dtype, out_slot="Output", name=name)
+    bias = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                   is_bias=True)
+    if bias is not None:
+        out = M.elementwise_add(out, T.reshape(bias, [1, -1, 1, 1]))
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale,
+               pooled_height, pooled_width, rois_batch=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    return _single("psroi_pool", ins,
+                   {"output_channels": output_channels,
+                    "spatial_scale": spatial_scale,
+                    "pooled_height": pooled_height,
+                    "pooled_width": pooled_width}, input.dtype, name=name)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, rois_batch=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    return _single("prroi_pool", ins,
+                   {"spatial_scale": spatial_scale,
+                    "pooled_height": pooled_height,
+                    "pooled_width": pooled_width}, input.dtype, name=name)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, gt_count=None):
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_count is not None:
+        ins["GTCount"] = [gt_count]
+    return _single("yolov3_loss", ins,
+                   {"anchors": list(anchors),
+                    "anchor_mask": list(anchor_mask),
+                    "class_num": class_num,
+                    "ignore_thresh": ignore_thresh,
+                    "downsample_ratio": downsample_ratio},
+                   x.dtype, out_slot="Loss", name=name)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    two = lambda v: list(v) if isinstance(v, (list, tuple)) else [v, v]
+    return _multi("im2sequence", {"X": [input]},
+                  {"kernels": two(filter_size), "strides": two(stride),
+                   "paddings": two(padding) * 2},
+                  [("Out", input.dtype), ("OutLength", "int32")],
+                  name=name)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    three = lambda v: list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    return _single("pool3d", {"X": [input]},
+                   {"ksize": three(pool_size), "pooling_type": pool_type,
+                    "strides": three(pool_stride),
+                    "paddings": three(pool_padding),
+                    "global_pooling": global_pooling,
+                    "exclusive": exclusive}, input.dtype, name=name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    three = lambda v: list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    return _single("pool3d", {"X": [input]},
+                   {"ksize": three(pool_size), "pooling_type": pool_type,
+                    "adaptive": True}, input.dtype, name=name)
+
+
+def random_crop(x, shape, seed=0, name=None):
+    return _single("random_crop", {"X": [x]},
+                   {"shape": list(shape), "seed": int(seed)}, x.dtype,
+                   name=name)
+
+
+# -------------------------------------------------------------- losses
+
+def cos_sim(X, Y, name=None):
+    return _single("cos_sim", {"X": [X], "Y": [Y]}, {}, X.dtype,
+                   name=name)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference layers/nn.py dice_loss (nn.py:6870): one_hot the integer
+    label to input's class dim, per-SAMPLE dice over all non-batch dims,
+    mean over the batch."""
+    from . import nn as nn_mod
+    lbl = T.one_hot(nn_mod.squeeze(T.cast(label, "int64"), axes=[-1]),
+                    depth=input.shape[-1]) \
+        if int(label.shape[-1]) == 1 else T.cast(label, input.dtype)
+    lbl = T.cast(lbl, input.dtype)
+    dims = list(range(1, len(input.shape)))
+    inse = M.reduce_sum(M.elementwise_mul(input, lbl), dim=dims)
+    denom = M.elementwise_add(M.reduce_sum(input, dim=dims),
+                              M.reduce_sum(lbl, dim=dims))
+    dice = M.elementwise_div(
+        M.scale(inse, 2.0), M.scale(denom, 1.0, bias=float(epsilon)))
+    return M.reduce_mean(M.scale(dice, -1.0, bias=1.0))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference layers/loss.py:1618 npair_loss: l2loss * Beta(0.25) *
+    l2_reg + reduce_mean(reduce_sum(labels * softmax_ce, 0))."""
+    from . import nn as nn_mod
+    sim = nn_mod.matmul(anchor, positive, transpose_y=True)
+    lbl = T.reshape(labels, [-1, 1])
+    same = T.cast(M.equal(lbl, T.transpose(lbl, [1, 0])), anchor.dtype)
+    tgt = M.elementwise_div(
+        same, M.reduce_sum(same, dim=[1], keep_dim=True))
+    ce = L.softmax_with_cross_entropy(sim, tgt, soft_label=True)
+    celoss = M.reduce_mean(
+        M.reduce_sum(M.elementwise_mul(tgt, ce), dim=[0]))
+    l2loss = M.scale(M.elementwise_add(
+        M.reduce_mean(M.reduce_sum(M.elementwise_mul(anchor, anchor),
+                                   dim=[1])),
+        M.reduce_mean(M.reduce_sum(M.elementwise_mul(positive, positive),
+                                   dim=[1]))), 0.25 * float(l2_reg))
+    return M.elementwise_add(celoss, l2loss)
+
+
+def rank_loss(label, left, right, name=None):
+    return _single("rank_loss",
+                   {"Label": [label], "Left": [left], "Right": [right]},
+                   {}, left.dtype, name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _single("margin_rank_loss",
+                   {"Label": [label], "X1": [left], "X2": [right]},
+                   {"margin": float(margin)}, left.dtype, name=name)
+
+
+def bpr_loss(input, label, name=None):
+    return _single("bpr_loss", {"X": [input], "Label": [label]}, {},
+                   input.dtype, name=name)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25,
+                       name=None):
+    return _single("sigmoid_focal_loss",
+                   {"X": [x], "Label": [label], "FgNum": [fg_num]},
+                   {"gamma": float(gamma), "alpha": float(alpha)},
+                   x.dtype, name=name)
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference loss.py teacher_student_sigmoid_loss (composite):
+    z = clip(x); loss = log(1 + exp(z)) - z * label... using the stable
+    softplus form."""
+    from . import nn as nn_mod
+    z = nn_mod.clip(input, soft_max_lower_bound, soft_max_up_bound)
+    softplus = nn_mod.softplus(z)
+    return M.elementwise_sub(softplus,
+                             M.elementwise_mul(z, T.cast(label, z.dtype)))
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True, name=None):
+    helper = LayerHelper("center_loss", name=name, param_attr=param_attr)
+    centers = helper.create_parameter(
+        param_attr, [num_classes, input.shape[-1]], input.dtype)
+    centers.stop_gradient = True
+    rate = T.fill_constant([1], "float32", float(alpha))
+    loss, diff, centers_out = _multi(
+        "center_loss",
+        {"X": [input], "Label": [label], "Centers": [centers],
+         "CenterUpdateRate": [rate]},
+        {"need_update": update_center},
+        [("Loss", input.dtype), ("SampleCenterDiff", input.dtype),
+         ("CentersOut", input.dtype)], name=name)
+    return loss
+
+
+def cross_entropy2(input, label, ignore_index=-100):
+    return _multi("cross_entropy2", {"X": [input], "Label": [label]},
+                  {"ignore_index": ignore_index},
+                  [("Y", input.dtype), ("MatchX", input.dtype)])[0]
+
+
+# ------------------------------------------------------- decode / metric
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    return _multi("edit_distance", ins, {"normalized": normalized},
+                  [("Out", "float32"), ("SequenceNum", "int32")],
+                  name=name)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """reference ctc_greedy_decoder: argmax over classes then ctc_align
+    (collapse repeats, drop blanks)."""
+    ids = T.cast(T.argmax(input, axis=-1), "int32")
+    ins = {"X": [ids]}
+    if input_length is not None:
+        ins["Length"] = [input_length]
+    else:
+        B, Tm = input.shape[0], input.shape[1]
+        ins["Length"] = [T.fill_constant([B], "int32", Tm)]
+    return _multi("ctc_align", ins, {"blank": blank},
+                  [("Output", "int32"), ("OutputLength", "int32")],
+                  name=name)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     name=None):
+    """reference layers/nn.py linear_chain_crf -> per-sequence negative
+    log-likelihood [B, 1]. The Transition parameter ([C+2, C]: start row,
+    stop row, pairwise rows) is shared with crf_decoding via param_attr
+    name."""
+    helper = LayerHelper("linear_chain_crf", name=name,
+                         param_attr=param_attr)
+    transition = helper.create_parameter(
+        param_attr, [input.shape[-1] + 2, input.shape[-1]], input.dtype)
+    ins = {"Emission": [input], "Transition": [transition],
+           "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    out = _single("linear_chain_crf", ins, {}, input.dtype,
+                  out_slot="LogLikelihood", name=name)
+    out.shape = (input.shape[0], 1)
+    return out
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    helper = LayerHelper("crf_decoding", name=name, param_attr=param_attr)
+    # reuse the SAME transition parameter as linear_chain_crf by name
+    transition = helper.create_parameter(
+        param_attr, [input.shape[-1] + 2, input.shape[-1]], input.dtype)
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    return _single("crf_decoding", ins, {}, "int32",
+                   out_slot="ViterbiPath", name=name, stop_gradient=True)
+
+
+def mean_iou(input, label, num_classes):
+    return _multi("mean_iou",
+                  {"Predictions": [input], "Labels": [label]},
+                  {"num_classes": num_classes},
+                  [("OutMeanIou", "float32"), ("OutWrong", "int32"),
+                   ("OutCorrect", "int32")])
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    helper = LayerHelper("hsigmoid", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(param_attr,
+                                [num_classes - 1, input.shape[-1]],
+                                input.dtype)
+    bias = helper.create_parameter(bias_attr, [num_classes - 1],
+                                   input.dtype, is_bias=True)
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return _single("hsigmoid", ins, {"num_classes": num_classes},
+                   input.dtype, name=name)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    return _multi("bipartite_match", {"DistMat": [dist_matrix]}, {},
+                  [("ColToRowMatchIndices", "int32"),
+                   ("ColToRowMatchDist", "float32")], name=name)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    return _single("sampling_id", {"X": [x]}, {"seed": int(seed)},
+                   "int32", stop_gradient=True)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _single("shard_index", {"X": [input]},
+                   {"index_num": index_num, "nshards": nshards,
+                    "shard_id": shard_id, "ignore_value": ignore_value},
+                   input.dtype, stop_gradient=True)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _single("hash", {"X": [input]},
+                   {"mod_by": hash_size, "num_hash": num_hash}, "int32",
+                   name=name, stop_gradient=True)
+
+
+# ------------------------------------------------------ tensor utility
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32",
+        name=None):
+    out = _single("eye", {}, {"num_rows": num_rows,
+                              "num_columns": num_columns or -1,
+                              "dtype": dtype}, dtype,
+                  stop_gradient=True)
+    out.shape = (num_rows, num_columns or num_rows)
+    if batch_shape:
+        for _ in batch_shape:
+            from . import nn as nn_mod
+            out = nn_mod.unsqueeze(out, axes=[0])
+        out = T.expand(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def size(input):
+    return _single("size", {"Input": [input]}, {}, "int32",
+                   stop_gradient=True)
+
+
+def rank(input):
+    return T.fill_constant([1], "int32", len(input.shape or ()))
+
+
+def _isnan(x):
+    return _single("isnan_v2", {"X": [x]}, {}, "bool",
+                   stop_gradient=True, shape=x.shape)
+
+
+def _isfinite_elem(x):
+    return _single("isfinite_v2", {"X": [x]}, {}, "bool",
+                   stop_gradient=True, shape=x.shape)
+
+
+def has_nan(x):
+    return M.reduce_any(_isnan(x))
+
+
+def has_inf(x):
+    # inf = not finite and not nan
+    bad = M.logical_and(M.logical_not(_isfinite_elem(x)),
+                        M.logical_not(_isnan(x)))
+    return M.reduce_any(bad)
+
+
+def isfinite(x):
+    return M.reduce_all(_isfinite_elem(x))
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _single("add_position_encoding", {"X": [input]},
+                   {"alpha": float(alpha), "beta": float(beta)},
+                   input.dtype, name=name, shape=input.shape)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    w = helper.create_parameter(
+        param_attr, [size, x.shape[-1], y.shape[-1]], x.dtype)
+    bias = helper.create_parameter(bias_attr, [1, size], x.dtype,
+                                   is_bias=True)
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    out = _single("bilinear_tensor_product", ins, {}, x.dtype, name=name)
+    return helper.append_activation(out, act)
+
+
+def box_clip(input, im_info, name=None):
+    return _single("box_clip", {"Input": [input], "ImInfo": [im_info]},
+                   {}, input.dtype, out_slot="Output", name=name,
+                   shape=input.shape)
+
+
+def polygon_box_transform(input, name=None):
+    return _single("polygon_box_transform", {"X": [input]}, {},
+                   input.dtype, out_slot="Output", name=name,
+                   shape=input.shape)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _single("scatter_nd", {"Index": [index], "Updates": [updates]},
+                   {"shape": list(shape)}, updates.dtype, name=name,
+                   shape=shape)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _single("soft_relu", {"X": [x]},
+                   {"threshold": float(threshold)}, x.dtype, name=name,
+                   shape=x.shape)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """reference layers/control_flow.py while_loop: functional While."""
+    from .control_flow import While
+    from . import tensor as T_
+
+    c = cond(*loop_vars)
+    w = While(c)
+    vars_ = list(loop_vars)
+    with w.block():
+        new_vars = body(*vars_)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        for old, new in zip(vars_, new_vars):
+            T_.assign(new, output=old)
+        T_.assign(cond(*vars_), output=c)
+    return vars_

@@ -421,8 +421,10 @@ def linear_chain_crf(ctx, ins, attrs):
     em = x_of(ins, "Emission")
     trans = x_of(ins, "Transition")
     label = x_of(ins, "Label").astype(jnp.int32)
-    lens = x_of(ins, "Length").reshape(-1).astype(jnp.int32)
     B, T, K = em.shape
+    ln_in = x_of(ins, "Length")
+    lens = (ln_in.reshape(-1).astype(jnp.int32)
+            if ln_in is not None else jnp.full((B,), T, jnp.int32))
     start, end, w = trans[0], trans[1], trans[2:]     # [K], [K], [K, K]
 
     # log partition via forward algorithm

@@ -1,0 +1,405 @@
+"""Miscellaneous ops completing the fluid.layers surface.
+
+Small lowerings for reference ops that had no counterpart yet: 3-D
+pooling (pool_op.cc), eye/size/shard_index/sampling_id/hash utility ops,
+sequence-decode ops (edit_distance_op.cc, crf_decoding_op.cc,
+ctc_align_op.cc), hierarchical sigmoid (hierarchical_sigmoid_op.cc),
+detection helpers (bipartite_match_op.cc, box_clip_op.cc,
+polygon_box_transform_op.cc), mean_iou_op.cc, add_position_encoding_op.cc,
+bilinear_tensor_product_op.cc, random_crop_op.cc, scatter_nd, soft_relu.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import x_of, normalize_padding
+
+
+@register_op("pool3d")
+def pool3d(ctx, ins, attrs):
+    """reference pool_op.cc 3-D variant: max/avg over [kd, kh, kw]."""
+    x = x_of(ins)
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2, 2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    if attrs.get("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(x, axis=(2, 3, 4), keepdims=True)}
+    if attrs.get("adaptive", False):
+        n, c, d, h, w = x.shape
+        od, oh, ow = ksize
+        if d % od or h % oh or w % ow:
+            raise NotImplementedError(
+                "adaptive pool3d needs divisible spatial dims on TPU")
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": red(xr, axis=(3, 5, 7))}
+    pads = ((0, 0), (0, 0)) + normalize_padding(
+        attrs.get("paddings", [0, 0, 0]), 3)
+    window = (1, 1) + tuple(ksize)
+    wstrides = (1, 1) + tuple(strides)
+    if ptype == "max":
+        return {"Out": jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, window, wstrides, pads)}
+    ssum = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, wstrides,
+                                 pads)
+    if attrs.get("exclusive", True):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    window, wstrides, pads)
+        return {"Out": ssum / cnt}
+    return {"Out": ssum / float(np.prod(ksize))}
+
+
+@register_op("eye", grad=False, infer_shape=False)
+def eye(ctx, ins, attrs):
+    from ..framework.dtype import np_dtype
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", -1))
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.eye(n, m if m > 0 else n, dtype=dt)}
+
+
+@register_op("size", grad=False)
+def size(ctx, ins, attrs):
+    x = x_of(ins, "Input")
+    return {"Out": jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                               jnp.int32)}
+
+
+@register_op("shard_index", grad=False)
+def shard_index(ctx, ins, attrs):
+    """reference shard_index_op.cc: local-ize global ids onto a shard."""
+    x = x_of(ins)
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = attrs.get("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    mine = (x // shard_size) == shard_id
+    return {"Out": jnp.where(mine, x % shard_size,
+                             jnp.asarray(ignore, x.dtype))}
+
+
+@register_op("sampling_id", grad=False, needs_rng=True,
+             infer_shape=False)
+def sampling_id(ctx, ins, attrs):
+    """reference sampling_id_op.cc: sample one category id per row from a
+    probability matrix."""
+    x = x_of(ins)
+    key = ctx.op_key(attrs)
+    return {"Out": jax.random.categorical(
+        key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1).astype(jnp.int32)}
+
+
+@register_op("hash", grad=False, infer_shape=False)
+def hash_op(ctx, ins, attrs):
+    """reference hash_op.cc: num_hash hashed views of an id tensor into
+    [0, mod_by). The reference uses xxhash over the byte string; this
+    lowering uses a Knuth multiplicative hash per hash index — same
+    capability (bucketized multi-hash embedding keys), different hash
+    family (documented divergence)."""
+    x = x_of(ins).astype(jnp.uint32)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs["mod_by"])
+    ks = jnp.arange(1, num_hash + 1, dtype=jnp.uint32)[:, None]
+    flat = x.reshape(1, -1)
+    h = (flat * ks * np.uint32(2654435761)) % np.uint32(mod_by)
+    return {"Out": h.astype(jnp.int32).reshape(
+        (x.shape[0], num_hash) + tuple(x.shape[1:]))}
+
+
+@register_op("edit_distance", grad=False, infer_shape=False)
+def edit_distance(ctx, ins, attrs):
+    """reference edit_distance_op.cc: Levenshtein distance per (hyp, ref)
+    row pair; masked-dense with explicit lengths; optionally normalized by
+    the reference length."""
+    hyp = x_of(ins, "Hyps").astype(jnp.int32)
+    ref = x_of(ins, "Refs").astype(jnp.int32)
+    B, T1 = hyp.shape[0], hyp.shape[1]
+    T2 = ref.shape[1]
+    hl_in, rl_in = x_of(ins, "HypsLength"), x_of(ins, "RefsLength")
+    hlen = (jnp.reshape(hl_in, (-1,)).astype(jnp.int32)
+            if hl_in is not None else jnp.full((B,), T1, jnp.int32))
+    rlen = (jnp.reshape(rl_in, (-1,)).astype(jnp.int32)
+            if rl_in is not None else jnp.full((B,), T2, jnp.int32))
+    normalized = bool(attrs.get("normalized", False))
+
+    js = jnp.arange(T2 + 1, dtype=jnp.float32)
+
+    def per_pair(h, r, hl, rl):
+        row0 = js                                   # D[0, j] = j
+        def step(row, i):
+            # D[i, 0] = i
+            def inner(carry, j):
+                prev_diag, cur_row = carry
+                cost = jnp.where(h[i - 1] == r[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(
+                    row[j] + 1.0,                   # delete
+                    cur_row[j - 1] + 1.0),          # insert
+                    prev_diag + cost)               # substitute
+                return (row[j], cur_row.at[j].set(val)), None
+            cur = jnp.zeros(T2 + 1).at[0].set(i.astype(jnp.float32))
+            (_, cur), _ = jax.lax.scan(
+                inner, (row[0], cur), jnp.arange(1, T2 + 1))
+            return cur, cur
+
+        # stack every DP row so D[hl, rl] can be gathered afterwards
+        _, rows = jax.lax.scan(step, row0, jnp.arange(1, T1 + 1))
+        table = jnp.concatenate([row0[None], rows], axis=0)  # [T1+1,T2+1]
+        return table[hl, rl]
+
+    d = jax.vmap(per_pair)(hyp, ref, hlen, rlen)
+    if normalized:
+        d = d / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": d[:, None],
+            "SequenceNum": jnp.asarray([B], jnp.int32)}
+
+
+@register_op("crf_decoding", grad=False, infer_shape=False)
+def crf_decoding(ctx, ins, attrs):
+    """reference crf_decoding_op.cc: Viterbi decode under the
+    linear_chain_crf transition convention (Transition [C+2, C]: row 0
+    start scores, row 1 stop scores, rows 2.. pairwise). Emission
+    [B, T, C] + Length [B]; returns the best path [B, T] (padding 0) —
+    with Label given, returns per-position correctness instead."""
+    em = x_of(ins, "Emission")
+    trans = x_of(ins, "Transition")
+    label = ins.get("Label")
+    label = label[0] if label else None
+    B, T, C = em.shape
+    ln_in = x_of(ins, "Length")
+    lengths = (jnp.reshape(ln_in, (-1,)).astype(jnp.int32)
+               if ln_in is not None else jnp.full((B,), T, jnp.int32))
+    start, stop, pair = trans[0], trans[1], trans[2:]
+
+    def decode(e, ln):
+        alpha0 = start + e[0]
+
+        def fwd(alpha, t):
+            scores = alpha[:, None] + pair          # [C, C]
+            best = jnp.max(scores, axis=0) + e[t]
+            arg = jnp.argmax(scores, axis=0)
+            live = t < ln
+            return jnp.where(live, best, alpha), \
+                jnp.where(live, arg, -1)
+
+        alphaN, back = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+        last = jnp.argmax(alphaN + stop)
+
+        def bwd(tag, t):
+            bp = back[t - 1]                        # [C]
+            prev = jnp.where(t < ln, bp[tag], tag)
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(bwd, last, jnp.arange(T - 1, 0, -1))
+        path = jnp.concatenate(
+            [path_rev[::-1], jnp.asarray([last])]).astype(jnp.int32)
+        mask = jnp.arange(T) < ln
+        return jnp.where(mask, path, 0)
+
+    paths = jax.vmap(decode)(em, lengths)
+    if label is not None:
+        lbl = label[..., 0] if label.ndim == 3 else label
+        mask = jnp.arange(T)[None] < lengths[:, None]
+        return {"ViterbiPath": jnp.where(
+            mask, (paths == lbl.astype(jnp.int32)).astype(jnp.int32), 0)}
+    return {"ViterbiPath": paths}
+
+
+@register_op("hsigmoid", infer_shape=False)
+def hsigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hierarchical_sigmoid_op.cc): leaf c's path is the binary
+    expansion of c + num_classes below its MSB; internal node k uses
+    W[k-1]. loss[b] = sum_path -log sigmoid(sign * (w·x + bias))."""
+    x = x_of(ins)                       # [B, D]
+    w = x_of(ins, "W")                  # [num_classes - 1, D]
+    label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
+    bias = ins.get("Bias")
+    bias = bias[0].reshape(-1) if bias else None
+    num_classes = int(attrs["num_classes"])
+    depth = int(np.ceil(np.log2(num_classes)))
+    code = label + num_classes          # [B]
+    logits = x @ w.T                    # [B, num_classes-1]
+    if bias is not None:
+        logits = logits + bias
+    loss = jnp.zeros(x.shape[0], x.dtype)
+    for d in range(depth, 0, -1):
+        node = code >> d                # internal node id (1-rooted)
+        bit = (code >> (d - 1)) & 1     # next step: 0=left, 1=right
+        valid = node >= 1
+        idx = jnp.clip(node - 1, 0, num_classes - 2)
+        z = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        sign = 1.0 - 2.0 * bit.astype(x.dtype)   # bit0 -> +1, bit1 -> -1
+        step_loss = jnp.logaddexp(0.0, -sign * z)
+        loss = loss + jnp.where(valid, step_loss, 0.0)
+    return {"Out": loss[:, None]}
+
+
+@register_op("bipartite_match", grad=False, infer_shape=False)
+def bipartite_match(ctx, ins, attrs):
+    """reference detection/bipartite_match_op.cc (greedy max matching):
+    DistMat [B, N, M] (N gt rows, M priors); repeatedly take the global
+    argmax, bind that (row, col), mask both out. Outputs
+    ColToRowMatchIndices [B, M] (-1 unmatched) and the matched distance."""
+    dist = x_of(ins, "DistMat")
+    B, N, M = dist.shape
+    steps = min(N, M)
+
+    def one(dm):
+        def body(carry, _):
+            d, match, mdist = carry
+            flat = jnp.argmax(d)
+            i, j = flat // M, flat % M
+            ok = d[i, j] > 0
+            match = jnp.where(ok, match.at[j].set(i.astype(jnp.int32)),
+                              match)
+            mdist = jnp.where(ok, mdist.at[j].set(d[i, j]), mdist)
+            d = jnp.where(ok, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+            return (d, match, mdist), None
+
+        init = (dm, jnp.full((M,), -1, jnp.int32), jnp.zeros((M,)))
+        (d, match, mdist), _ = jax.lax.scan(body, init, None,
+                                            length=steps)
+        return match, mdist
+
+    match, mdist = jax.vmap(one)(dist.astype(jnp.float32))
+    return {"ColToRowMatchIndices": match, "ColToRowMatchDist": mdist}
+
+
+@register_op("mean_iou", grad=False, infer_shape=False)
+def mean_iou(ctx, ins, attrs):
+    """reference mean_iou_op.cc: mean IoU over classes present."""
+    pred = x_of(ins, "Predictions").reshape(-1).astype(jnp.int32)
+    label = x_of(ins, "Labels").reshape(-1).astype(jnp.int32)
+    C = int(attrs["num_classes"])
+    conf = jnp.zeros((C, C), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diagonal(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    return {"OutMeanIou": miou,
+            "OutWrong": (conf.sum(1) - inter).astype(jnp.int32),
+            "OutCorrect": inter.astype(jnp.int32)}
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ctx, ins, attrs):
+    """reference add_position_encoding_op.cc: out = alpha*x + beta*PE."""
+    x = x_of(ins)                       # [B, T, D]
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    half = D // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                         axis=1)
+    return {"Out": alpha * x + beta * pe[None].astype(x.dtype)}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """reference bilinear_tensor_product_op.cc:
+    out[b, k] = x[b] . W[k] . y[b] (+ bias)."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    w = x_of(ins, "Weight")             # [K, dx, dy]
+    bias = ins.get("Bias")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias:
+        out = out + bias[0]
+    return {"Out": out}
+
+
+@register_op("box_clip", grad=False)
+def box_clip(ctx, ins, attrs):
+    """reference detection/box_clip_op.cc: clip xyxy boxes into the
+    image. Input [B, N, 4], ImInfo [B, 3] (h, w, scale)."""
+    boxes = x_of(ins, "Input")
+    im = x_of(ins, "ImInfo")
+    h = (im[:, 0] / im[:, 2] - 1.0)[:, None]
+    w = (im[:, 1] / im[:, 2] - 1.0)[:, None]
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return {"Output": jnp.stack([x1, y1, x2, y2], axis=-1)}
+
+
+@register_op("polygon_box_transform", grad=False)
+def polygon_box_transform(ctx, ins, attrs):
+    """reference detection/polygon_box_transform_op.cc (EAST-style): even
+    channels hold x offsets, odd channels y offsets; output is the
+    absolute quad coordinate 4*grid_index - offset."""
+    x = x_of(ins)                       # [B, 2K, H, W]
+    B, C, H, W = x.shape
+    idx_w = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    idx_h = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    even = jnp.arange(C) % 2 == 0
+    grid = jnp.where(even[None, :, None, None],
+                     jnp.broadcast_to(idx_w, x.shape),
+                     jnp.broadcast_to(idx_h, x.shape))
+    return {"Output": 4.0 * grid - x}
+
+
+@register_op("random_crop", grad=False, needs_rng=True,
+             infer_shape=False)
+def random_crop(ctx, ins, attrs):
+    """reference random_crop_op.cc: random spatial crop of the trailing
+    dims to attr shape, same offset across leading dims per sample."""
+    x = x_of(ins)
+    shape = list(attrs["shape"])
+    key = ctx.op_key(attrs)
+    nlead = x.ndim - len(shape)
+    maxs = [x.shape[nlead + i] - shape[i] for i in range(len(shape))]
+    offs = [jax.random.randint(jax.random.fold_in(key, i), (), 0, m + 1)
+            for i, m in enumerate(maxs)]
+    starts = [0] * nlead + [o for o in offs]
+    sizes = list(x.shape[:nlead]) + shape
+    return {"Out": jax.lax.dynamic_slice(x, starts, sizes)}
+
+
+@register_op("scatter_nd", grad=False, infer_shape=False)
+def scatter_nd(ctx, ins, attrs):
+    """reference scatter_nd_op: zeros(shape) with updates added at
+    index."""
+    index = x_of(ins, "Index").astype(jnp.int32)
+    updates = x_of(ins, "Updates")
+    shape = tuple(attrs["shape"])
+    out = jnp.zeros(shape, updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": out.at[idx].add(updates)}
+
+
+@register_op("soft_relu")
+def soft_relu(ctx, ins, attrs):
+    t = float(attrs.get("threshold", 40.0))
+    x = jnp.clip(x_of(ins), -t, t)
+    return {"Out": jnp.log1p(jnp.exp(x))}
+
+
+@register_op("ctc_align", grad=False)
+def ctc_align(ctx, ins, attrs):
+    """reference ctc_align_op.cc (the op under ctc_greedy_decoder):
+    collapse repeats then drop blanks; masked-dense output padded with
+    -1 plus per-row output lengths."""
+    x = x_of(ins).astype(jnp.int32)     # [B, T] token ids
+    lengths = jnp.reshape(x_of(ins, "Length"), (-1,)).astype(jnp.int32)
+    blank = int(attrs.get("blank", 0))
+    B, T = x.shape
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = t < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), x[:, :-1]],
+                           axis=1)
+    keep = valid & (x != blank) & (x != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    cols = jnp.where(keep, pos, T)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    out = jnp.full((B, T), -1, jnp.int32).at[
+        rows.reshape(-1), cols.reshape(-1)].set(x.reshape(-1),
+                                                mode="drop")
+    return {"Output": out,
+            "OutputLength": jnp.sum(keep, axis=1, dtype=jnp.int32)}

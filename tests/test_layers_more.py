@@ -1,0 +1,283 @@
+"""The completed fluid.layers surface (layers/more.py + ops/misc_ops.py):
+RNN layer API, decode/metric ops, tensor utilities, detection helpers —
+numpy-referenced (reference pattern: per-layer unittests test_layers.py,
+test_edit_distance_op.py, test_crf_decoding_op.py, test_hsigmoid_op.py,
+test_mean_iou.py, test_bipartite_match_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+RNG = np.random.default_rng(3)
+
+
+def _run(build, feed, n_fetch=1, steps=1, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        fetches = build()
+        if not isinstance(fetches, (list, tuple)):
+            fetches = [fetches]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in out]
+
+
+def test_dynamic_lstm_gru_layers_train():
+    B, T, D, H = 4, 6, 8, 5
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    y = RNG.standard_normal((B, T, H)).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = layers.data("x", [B, T, D], dtype="float32")
+        yin = layers.data("y", [B, T, H], dtype="float32")
+        hid, cell = layers.dynamic_lstm(
+            layers.fc(xin, 4 * H, num_flatten_dims=2), 4 * H,
+            use_peepholes=False)
+        gru_out = layers.dynamic_gru(
+            layers.fc(xin, 3 * H, num_flatten_dims=2), H)
+        loss = layers.mean(layers.square_error_cost(
+            layers.elementwise_add(hid, gru_out), yin))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ls = [float(exe.run(main, feed={"x": x, "y": y},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert ls[-1] < 0.5 * ls[0], (ls[0], ls[-1])
+
+
+def test_lstm_cudnn_front():
+    B, T, D, H = 2, 5, 4, 3
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    out = _run(lambda: layers.lstm(
+        layers.data("x", [B, T, D], dtype="float32"), None, None, T, H,
+        is_bidirec=True)[0], {"x": x})
+    assert out[0].shape == (B, T, 2 * H)
+
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0], [1, 1, 0, 0]], np.int64)
+    ref = np.array([[1, 3, 3, 2], [1, 0, 0, 0]], np.int64)
+    hl = np.array([3, 2], np.int64)
+    rl = np.array([4, 1], np.int64)
+    out = _run(lambda: layers.edit_distance(
+        layers.data("h", [2, 4], dtype="int64"),
+        layers.data("r", [2, 4], dtype="int64"), normalized=False,
+        input_length=layers.data("hl", [2], dtype="int64"),
+        label_length=layers.data("rl", [2], dtype="int64"))[0],
+        {"h": hyp, "r": ref, "hl": hl, "rl": rl})
+    # d([1,2,3],[1,3,3,2]) = 2 ; d([1,1],[1]) = 1
+    np.testing.assert_allclose(out[0].ravel(), [2.0, 1.0])
+
+
+def test_ctc_greedy_decoder():
+    # argmax ids over T=5: [b, 1, 1, b, 2] -> [1, 2]
+    probs = np.zeros((1, 5, 4), np.float32)
+    for t, c in enumerate([0, 1, 1, 0, 2]):
+        probs[0, t, c] = 1.0
+    ids, lens = _run(lambda: layers.ctc_greedy_decoder(
+        layers.data("p", [1, 5, 4], dtype="float32"), blank=0),
+        {"p": probs}, n_fetch=2)
+    assert lens[0] == 2
+    np.testing.assert_array_equal(ids[0, :2], [1, 2])
+
+
+def test_crf_decoding_matches_brute_force():
+    B, T, C = 2, 4, 3
+    em = RNG.standard_normal((B, T, C)).astype(np.float32)
+    trans = RNG.standard_normal((C + 2, C)).astype(np.float32)
+    lens = np.array([4, 3], np.int64)
+    import itertools
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        e = layers.data("e", [B, T, C], dtype="float32")
+        ln = layers.data("ln", [B], dtype="int64")
+        path = layers.crf_decoding(
+            e, param_attr=fluid.ParamAttr(name="crfw_dec"), length=ln)
+    exe = fluid.Executor()
+    sc = fluid.Scope()
+    with fluid.scope_guard(sc):
+        exe.run(startup)
+        sc.set("crfw_dec", trans)
+        got, = exe.run(main, feed={"e": em, "ln": lens},
+                       fetch_list=[path])
+    got = np.asarray(got)
+    for b in range(B):
+        L = lens[b]
+        best, best_s = None, -1e30
+        for seq in itertools.product(range(C), repeat=int(L)):
+            s = trans[0, seq[0]] + em[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[2 + seq[t-1], seq[t]] + em[b, t, seq[t]]
+            s += trans[1, seq[-1]]
+            if s > best_s:
+                best_s, best = s, seq
+        np.testing.assert_array_equal(got[b, :L], best)
+        assert (got[b, L:] == 0).all()
+
+
+def test_hsigmoid_trains():
+    B, D, C = 8, 6, 5
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    label = RNG.integers(0, C, (B, 1)).astype(np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        xin = layers.data("x", [B, D], dtype="float32")
+        yin = layers.data("y", [B, 1], dtype="int64")
+        loss = layers.mean(layers.hsigmoid(xin, yin, C))
+        fluid.optimizer.Adam(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ls = [float(exe.run(main, feed={"x": x, "y": label},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert ls[-1] < 0.5 * ls[0], (ls[0], ls[-1])
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], np.int64)
+    lab = np.array([0, 1, 2, 2], np.int64)
+    miou, wrong, correct = _run(lambda: layers.mean_iou(
+        layers.data("p", [4], dtype="int64"),
+        layers.data("l", [4], dtype="int64"), 3), {"p": pred, "l": lab},
+        n_fetch=3)
+    # class0 iou 1, class1 iou .5, class2 iou .5
+    np.testing.assert_allclose(miou, (1 + 0.5 + 0.5) / 3, rtol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    d = np.array([[[0.9, 0.2, 0.1],
+                   [0.5, 0.8, 0.3]]], np.float32)   # [1, 2 gt, 3 prior]
+    idx, dist = _run(lambda: layers.bipartite_match(
+        layers.data("d", [1, 2, 3], dtype="float32")), {"d": d},
+        n_fetch=2)
+    np.testing.assert_array_equal(idx[0], [0, 1, -1])
+    np.testing.assert_allclose(dist[0], [0.9, 0.8, 0.0], rtol=1e-6)
+
+
+def test_eye_size_shard_index_hash():
+    out = _run(lambda: layers.eye(3, 4), {})
+    np.testing.assert_array_equal(out[0], np.eye(3, 4))
+    s = _run(lambda: layers.size(
+        layers.data("x", [2, 5], dtype="float32")),
+        {"x": np.zeros((2, 5), np.float32)})
+    assert int(s[0]) == 10
+    ids = np.array([[1], [7], [14]], np.int64)
+    sh = _run(lambda: layers.shard_index(
+        layers.data("i", [3, 1], dtype="int64"), 20, 2, 1), {"i": ids})
+    # shard_size 10: ids 1,7 -> other shard (-1); 14 -> 4
+    np.testing.assert_array_equal(sh[0].ravel(), [-1, -1, 4])
+    h = _run(lambda: layers.hash(
+        layers.data("i", [3, 1], dtype="int64"), hash_size=100,
+        num_hash=2), {"i": ids})
+    assert h[0].shape == (3, 2, 1) and (h[0] >= 0).all() and \
+        (h[0] < 100).all()
+
+
+def test_add_position_encoding_and_bilinear():
+    B, T, D = 2, 3, 8
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+    out = _run(lambda: layers.add_position_encoding(
+        layers.data("x", [B, T, D], dtype="float32"), 1.0, 1.0),
+        {"x": x})
+    pos = np.arange(T, dtype=np.float32)[:, None]
+    half = D // 2
+    div = np.power(10000.0, np.arange(half, dtype=np.float32) / half)
+    pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], axis=1)
+    np.testing.assert_allclose(out[0], x + pe[None], rtol=1e-5,
+                               atol=1e-5)
+
+    xb = RNG.standard_normal((2, 3)).astype(np.float32)
+    yb = RNG.standard_normal((2, 4)).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xi = layers.data("x", [2, 3], dtype="float32")
+        yi = layers.data("y", [2, 4], dtype="float32")
+        out = layers.bilinear_tensor_product(xi, yi, 5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[out])
+    assert np.asarray(o).shape == (2, 5)
+
+
+def test_box_clip_polygon_scatter_nd():
+    boxes = np.array([[[-5.0, 2.0, 30.0, 40.0]]], np.float32)
+    im = np.array([[20.0, 25.0, 1.0]], np.float32)   # h=20, w=25
+    out = _run(lambda: layers.box_clip(
+        layers.data("b", [1, 1, 4], dtype="float32"),
+        layers.data("im", [1, 3], dtype="float32")),
+        {"b": boxes, "im": im})
+    np.testing.assert_allclose(out[0][0, 0], [0, 2, 24, 19])
+
+    idx = np.array([[0, 1], [2, 0]], np.int64)
+    upd = np.array([5.0, 7.0], np.float32)
+    out = _run(lambda: layers.scatter_nd(
+        layers.data("i", [2, 2], dtype="int64"),
+        layers.data("u", [2], dtype="float32"), [3, 3]),
+        {"i": idx, "u": upd})
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[2, 0] = 5.0, 7.0
+    np.testing.assert_allclose(out[0], ref)
+
+    x = RNG.standard_normal((1, 2, 2, 2)).astype(np.float32)
+    out = _run(lambda: layers.polygon_box_transform(
+        layers.data("x", [1, 2, 2, 2], dtype="float32")), {"x": x})
+    iw = np.arange(2)[None, None, None, :]
+    ih = np.arange(2)[None, None, :, None]
+    ref = np.where(np.arange(2)[None, :, None, None] % 2 == 0,
+                   4.0 * iw - x, 4.0 * ih - x)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-6)
+
+
+def test_pool3d_and_losses_and_utils():
+    x = RNG.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+    out = _run(lambda: layers.pool3d(
+        layers.data("x", [1, 2, 4, 4, 4], dtype="float32"),
+        pool_size=2, pool_stride=2), {"x": x})
+    ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+    np.testing.assert_allclose(out[0], ref, rtol=1e-6)
+
+    # reference nn.py:6870 semantics: one_hot int label, PER-SAMPLE dice
+    # over non-batch dims, mean over batch (non-uniform magnitudes so the
+    # global-dice formula would differ)
+    p = np.array([[[0.3, 0.7], [0.6, 0.4]],
+                  [[30., 70.], [60., 40.]]], np.float32)   # [2, 2, 2]
+    lab = np.array([[[1], [0]], [[0], [1]]], np.int64)     # [2, 2, 1]
+    out = _run(lambda: layers.dice_loss(
+        layers.data("p", [2, 2, 2], dtype="float32"),
+        layers.data("l", [2, 2, 1], dtype="int64")), {"p": p, "l": lab})
+    oh = np.eye(2, dtype=np.float32)[lab[..., 0]]
+    inse = (p * oh).sum(axis=(1, 2))
+    denom = p.sum(axis=(1, 2)) + oh.sum(axis=(1, 2))
+    ref = (1 - 2 * inse / (denom + 1e-5)).mean()
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+    v = np.array([[1.0, np.inf], [0.0, 2.0]], np.float32)
+    hi, hn = _run(lambda: (layers.has_inf(
+        layers.data("v", [2, 2], dtype="float32")), layers.has_nan(
+        layers.data("v", [2, 2], dtype="float32"))), {"v": v}, n_fetch=2)
+    assert bool(hi) and not bool(hn)
+
+    x1 = RNG.standard_normal((3, 4)).astype(np.float32)
+    out = _run(lambda: layers.soft_relu(
+        layers.data("x", [3, 4], dtype="float32")), {"x": x1})
+    np.testing.assert_allclose(out[0], np.log1p(np.exp(x1)), rtol=1e-5)
+
+
+def test_sampling_id_and_random_crop():
+    p = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+    out = _run(lambda: layers.sampling_id(
+        layers.data("p", [2, 3], dtype="float32")), {"p": p})
+    np.testing.assert_array_equal(out[0], [1, 0])
+
+    x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out = _run(lambda: layers.random_crop(
+        layers.data("x", [2, 3, 8, 8], dtype="float32"), [5, 5]),
+        {"x": x})
+    assert out[0].shape == (2, 3, 5, 5)
