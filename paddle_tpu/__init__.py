@@ -42,6 +42,8 @@ from . import profiler  # noqa: F401
 from . import flags  # noqa: F401
 from . import debugger  # noqa: F401
 from . import install_check  # noqa: F401
+from . import nn  # noqa: F401  (2.0-preview namespace)
+from . import tensor  # noqa: F401  (2.0-preview namespace)
 from .flags import get_flags, set_flags  # noqa: F401
 from . import distributed  # noqa: F401
 from .transpiler import (  # noqa: F401

@@ -1,0 +1,16 @@
+"""paddle.nn 2.0-preview namespace (reference python/paddle/nn/__init__.py
+— DEFINE_ALIAS re-exports over the fluid surface; the reference ships the
+same thin aliases). Layer classes come from the dygraph library, functional
+ops from fluid.layers."""
+from ..dygraph.nn import (  # noqa: F401
+    Linear, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout,
+    LSTMCell, GRUCell, Conv2DTranspose, GroupNorm, PRelu, SpectralNorm,
+)
+from ..dygraph.layers import Layer  # noqa: F401
+from ..clip import (  # noqa: F401
+    GradientClipByGlobalNorm, GradientClipByNorm, GradientClipByValue,
+)
+from ..layers.control_flow import cond  # noqa: F401
+from ..layers.more import while_loop  # noqa: F401
+from ..layers.nn import clip  # noqa: F401
+from . import functional  # noqa: F401

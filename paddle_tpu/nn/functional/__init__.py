@@ -1,0 +1,17 @@
+"""paddle.nn.functional 2.0-preview namespace (reference
+python/paddle/nn/functional/__init__.py — DEFINE_ALIAS re-exports)."""
+from ...layers.nn import (  # noqa: F401
+    conv2d, pool2d, batch_norm, layer_norm, dropout, softmax,
+    relu, sigmoid, tanh, log_softmax, elu, gelu, leaky_relu, softplus,
+    softsign, hard_sigmoid, prelu, pad, embedding,
+)
+from ...layers.tensor import one_hot  # noqa: F401
+from ...layers.more import (  # noqa: F401
+    affine_grid, add_position_encoding, bilinear_tensor_product,
+    cos_sim, dice_loss, npair_loss, sigmoid_focal_loss, soft_relu,
+    pool3d, adaptive_pool3d, hsigmoid, row_conv, grid_sampler,
+)
+from ...layers.loss import (  # noqa: F401
+    softmax_with_cross_entropy, cross_entropy, square_error_cost,
+)
+from ...layers.math import elementwise_add as add  # noqa: F401

@@ -1,0 +1,17 @@
+"""paddle.tensor 2.0-preview namespace (reference python/paddle/tensor/
+— DEFINE_ALIAS re-exports over fluid tensor/math functions)."""
+from .layers.tensor import (  # noqa: F401
+    concat, cast, reshape, transpose, slice, split, stack, unstack,
+    gather, argmax, argmin, argsort, assign, fill_constant, zeros, ones,
+    zeros_like, ones_like, one_hot, range, linspace, expand, shape,
+    gather_nd, where, diag,
+)
+from .layers.nn import squeeze, flatten  # noqa: F401
+from .layers.math import (  # noqa: F401
+    elementwise_add as add, elementwise_sub as subtract,
+    elementwise_mul as multiply, elementwise_div as divide,
+    reduce_sum as sum, reduce_mean as mean, reduce_max as max,
+    reduce_min as min, reduce_prod as prod, equal, logical_and,
+    logical_or, logical_not, scale,
+)
+from .layers.more import eye, size  # noqa: F401

@@ -1,0 +1,40 @@
+"""2.0-preview namespaces (reference python/paddle/{nn,tensor}/ —
+DEFINE_ALIAS re-exports): models build through paddle.nn / functional /
+paddle.tensor in both modes."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.tensor as pt
+from paddle_tpu import dygraph
+
+
+def test_nn_layers_namespace_dygraph():
+    with dygraph.guard():
+        model = nn.Linear(4, 2)
+        assert isinstance(model, nn.Layer)
+        x = dygraph.to_variable(np.ones((3, 4), np.float32))
+        y = F.relu(model(x))
+        assert y.shape == (3, 2)
+
+
+def test_functional_and_tensor_namespace_static():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 3], "float32")
+        h = F.softmax(pt.add(x, pt.ones([2, 3], "float32")))
+        s = pt.sum(h)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.zeros((2, 3), np.float32)},
+                       fetch_list=[s])
+    np.testing.assert_allclose(float(np.asarray(out)), 2.0, rtol=1e-6)
+
+
+def test_clip_and_while_loop_reexports():
+    assert nn.GradientClipByGlobalNorm is not None
+    assert callable(nn.while_loop) and callable(nn.cond)
+    e = pt.eye(2)
+    assert e is not None
