@@ -69,11 +69,13 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
 
 
 def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
-                  param_attr=None, bias_attr=None, is_reverse=False,
-                  gate_activation="sigmoid", cell_activation="tanh",
-                  candidate_activation="tanh", proj_activation="tanh",
-                  length=None, name=None):
-    """reference dynamic_lstmp -> lstmp op. Returns (projection, cell)."""
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", length=None, name=None):
+    """reference dynamic_lstmp -> lstmp op. Returns (projection, cell).
+    use_peepholes=True (the reference default) sizes Bias [1, 7H] with the
+    peephole diagonals in columns 4H:7H."""
     H = size // 4
     helper = LayerHelper("dynamic_lstmp", name=name,
                          param_attr=param_attr, bias_attr=bias_attr)
@@ -81,7 +83,8 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
                                      input.dtype)
     proj_w = helper.create_parameter(param_attr, [H, proj_size],
                                      input.dtype)
-    bias = helper.create_parameter(bias_attr, [1, 4 * H], input.dtype,
+    bias_w = 7 * H if use_peepholes else 4 * H
+    bias = helper.create_parameter(bias_attr, [1, bias_w], input.dtype,
                                    is_bias=True)
     ins = {"Input": [input], "Weight": [weight], "ProjWeight": [proj_w],
            "Bias": [bias]}
@@ -93,7 +96,8 @@ def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
         ins["Length"] = [length]
     proj, cell = _multi(
         "lstmp", ins,
-        {"is_reverse": is_reverse, "gate_activation": gate_activation,
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
          "cell_activation": cell_activation,
          "candidate_activation": candidate_activation,
          "proj_activation": proj_activation},
@@ -389,13 +393,18 @@ def center_loss(input, label, num_classes, alpha, param_attr=None,
         param_attr, [num_classes, input.shape[-1]], input.dtype)
     centers.stop_gradient = True
     rate = T.fill_constant([1], "float32", float(alpha))
-    loss, diff, centers_out = _multi(
-        "center_loss",
-        {"X": [input], "Label": [label], "Centers": [centers],
-         "CenterUpdateRate": [rate]},
-        {"need_update": update_center},
-        [("Loss", input.dtype), ("SampleCenterDiff", input.dtype),
-         ("CentersOut", input.dtype)], name=name)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    # CentersOut aliases the centers parameter (reference loss.py:141 wires
+    # 'CentersOut': [centers_param]) so the in-place center update persists,
+    # matching the batch_norm MeanOut/VarianceOut pattern above.
+    helper.append_op(
+        type="center_loss",
+        inputs={"X": [input], "Label": [label], "Centers": [centers],
+                "CenterUpdateRate": [rate]},
+        outputs={"Loss": [loss], "SampleCenterDiff": [diff],
+                 "CentersOut": [centers]},
+        attrs={"need_update": update_center})
     return loss
 
 

@@ -115,13 +115,16 @@ def lstm(ctx, ins, attrs):
 def lstmp(ctx, ins, attrs):
     """LSTM with a recurrent projection (reference lstmp_op.cc): the carried
     state is r = proj_act(h @ ProjWeight) [B, P]; Weight is [P, 4H].
-    Outputs Projection [B, T, P] and Cell [B, T, H]."""
+    Bias [1, 4H], or [1, 7H] with use_peepholes (W_ic, W_fc, W_oc diagonals
+    over the cell state, as in the lstm op). Outputs Projection [B, T, P]
+    and Cell [B, T, H]."""
     x = x_of(ins, "Input")
     w = x_of(ins, "Weight")            # [P, 4H]
     w_proj = x_of(ins, "ProjWeight")   # [H, P]
     bias = x_of(ins, "Bias")
     B, T = x.shape[0], x.shape[1]
     H, P = w_proj.shape
+    use_peep = bool(attrs.get("use_peepholes", False))
     is_rev = bool(attrs.get("is_reverse", False))
     act_g = _act(attrs, "gate_activation", "sigmoid")
     act_c = _act(attrs, "cell_activation", "tanh")
@@ -129,6 +132,10 @@ def lstmp(ctx, ins, attrs):
     act_p = _act(attrs, "proj_activation", "identity")
     lengths = _lengths(ins, B, T)
     b_gate = bias[:, :4 * H] if bias is not None else 0.0
+    if use_peep:
+        w_ic = bias[:, 4 * H:5 * H]
+        w_fc = bias[:, 5 * H:6 * H]
+        w_oc = bias[:, 6 * H:7 * H]
 
     h0 = x_of(ins, "H0")     # initial PROJECTED state [B, P]
     c0 = x_of(ins, "C0")
@@ -141,8 +148,12 @@ def lstmp(ctx, ins, attrs):
         xt, t = inp
         gates = xt + r @ w + b_gate
         gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
         c_new = act_g(gf) * c + act_g(gi) * act_h(gc)
-        h_new = act_g(go) * act_c(c_new)
+        o = act_g(go + c_new * w_oc) if use_peep else act_g(go)
+        h_new = o * act_c(c_new)
         r_new = act_p(h_new @ w_proj)
         live = (t < lengths)[:, None]
         r_new = jnp.where(live, r_new, r)

@@ -281,3 +281,48 @@ def test_sampling_id_and_random_crop():
         layers.data("x", [2, 3, 8, 8], dtype="float32"), [5, 5]),
         {"x": x})
     assert out[0].shape == (2, 3, 5, 5)
+
+
+def test_center_loss_updates_centers():
+    """update_center=True must persist CentersOut into the centers
+    parameter across runs (reference loss.py:141 aliases the output)."""
+    B, D, C = 4, 3, 5
+    x = RNG.standard_normal((B, D)).astype(np.float32)
+    lab = np.array([[1], [3], [1], [0]], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = layers.data("x", [B, D], dtype="float32")
+        lin = layers.data("l", [B, 1], dtype="int64")
+        loss = layers.center_loss(xin, lin, C, alpha=0.5)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        cname = [v for v in main.global_block().vars
+                 if "center" in v.lower()]
+        assert cname, list(main.global_block().vars)
+        before = np.asarray(scope.find_var(cname[0])).copy()
+        exe.run(main, feed={"x": x, "l": lab}, fetch_list=[loss])
+        after = np.asarray(scope.find_var(cname[0]))
+    assert not np.allclose(before, after), "centers never updated"
+
+
+def test_dynamic_lstmp_peepholes():
+    """use_peepholes defaults True (reference): bias is [1, 7H] and the
+    peephole path must change the output vs use_peepholes=False."""
+    B, T, D, H, P = 3, 5, 4, 6, 2
+    x = RNG.standard_normal((B, T, D)).astype(np.float32)
+
+    def build(peep):
+        xin = layers.data("x", [B, T, D], dtype="float32")
+        proj, cell = layers.dynamic_lstmp(
+            layers.fc(xin, 4 * H, num_flatten_dims=2), 4 * H, P,
+            use_peepholes=peep,
+            bias_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.ConstantInitializer(0.3)))
+        return proj
+
+    out_p = _run(lambda: build(True), {"x": x}, seed=11)[0]
+    out_np = _run(lambda: build(False), {"x": x}, seed=11)[0]
+    assert out_p.shape == (B, T, P)
+    assert not np.allclose(out_p, out_np)
