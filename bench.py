@@ -352,29 +352,18 @@ def bench_bert_long():
         "vs_baseline": None}))
 
 
+# one table drives everything: insertion order is the default run order
+# (flagship first — its line is the headline metric the driver records);
+# the metric name keeps error lines correlatable with success-line keys
 _CONFIGS = {
-    "bert": main,
-    "mnist": bench_mnist,
-    "resnet50": bench_resnet50,
-    "widedeep": bench_widedeep,
-    "dygraph_transformer": bench_dygraph_transformer,
-    "bert_long": bench_bert_long,
-}
-
-# default order: the flagship first (its line is the headline metric the
-# driver records), then the rest of the BASELINE config matrix
-_ALL_ORDER = ["bert", "mnist", "resnet50", "widedeep",
-              "dygraph_transformer", "bert_long"]
-
-# canonical metric name per config, so error lines stay correlatable with
-# the success-line metric keys recorded in BENCH_r*.json
-_METRIC_NAMES = {
-    "bert": "bert_base_pretrain_bf16_samples_per_sec_per_chip",
-    "mnist": "mnist_lenet_samples_per_sec",
-    "resnet50": "resnet50_bf16_images_per_sec_per_chip",
-    "widedeep": "widedeep_ctr_samples_per_sec_per_chip",
-    "dygraph_transformer": "dygraph_transformer_base_samples_per_sec",
-    "bert_long": "bert_base_seq2048_flash_bf16_samples_per_sec",
+    "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
+    "mnist": (bench_mnist, "mnist_lenet_samples_per_sec"),
+    "resnet50": (bench_resnet50, "resnet50_bf16_images_per_sec_per_chip"),
+    "widedeep": (bench_widedeep, "widedeep_ctr_samples_per_sec_per_chip"),
+    "dygraph_transformer": (bench_dygraph_transformer,
+                            "dygraph_transformer_base_samples_per_sec"),
+    "bert_long": (bench_bert_long,
+                  "bert_base_seq2048_flash_bf16_samples_per_sec"),
 }
 
 
@@ -384,14 +373,14 @@ def run_all():
     import gc
     import sys
     import traceback
-    for name in _ALL_ORDER:
+    for name, (fn, metric) in _CONFIGS.items():
         try:
-            _CONFIGS[name]()
+            fn()
         except Exception:  # noqa: BLE001 — keep the matrix going
             traceback.print_exc(file=sys.stderr)
-            print(json.dumps({"metric": _METRIC_NAMES[name],
-                              "config": name, "value": None,
-                              "unit": "error", "vs_baseline": None}))
+            print(json.dumps({"metric": metric, "config": name,
+                              "value": None, "unit": "error",
+                              "vs_baseline": None}))
         gc.collect()  # drop the previous config's device buffers
 
 
@@ -404,4 +393,4 @@ if __name__ == "__main__":
     if args.config == "all":
         run_all()
     else:
-        _CONFIGS[args.config]()
+        _CONFIGS[args.config][0]()

@@ -20,6 +20,8 @@ from . import pipeline  # noqa: F401
 from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401
 from .more import *       # noqa: F401,F403
 from . import more         # noqa: F401
+from .detection import *   # noqa: F401,F403
+from . import detection    # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,

@@ -1,0 +1,526 @@
+"""fluid.layers.detection parity (reference
+python/paddle/fluid/layers/detection.py). Wrappers emit the padded-form
+detection ops (see ops/detection_ops.py, ops/detection_rcnn_ops.py for
+the static-shape contracts: variable-length results come back padded
+with a count output instead of LoD)."""
+import numpy as np
+
+from .layer_helper import LayerHelper
+from .more import _multi, _single
+
+
+__all__ = [
+    "prior_box", "density_prior_box", "anchor_generator", "iou_similarity",
+    "box_coder", "yolo_box", "multiclass_nms", "locality_aware_nms",
+    "detection_output", "detection_map", "target_assign", "ssd_loss",
+    "mine_hard_examples", "multi_box_head", "rpn_target_assign",
+    "retinanet_target_assign", "retinanet_detection_output",
+    "generate_proposals", "generate_proposal_labels",
+    "generate_mask_labels", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "box_decoder_and_assign",
+    "roi_perspective_transform",
+]
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _single("iou_similarity", {"X": [x], "Y": [y]},
+                   {"box_normalized": box_normalized}, x.dtype, name=name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    attrs = {"min_sizes": list(min_sizes),
+             "max_sizes": list(max_sizes or []),
+             "aspect_ratios": list(aspect_ratios),
+             "variances": list(variance), "flip": flip, "clip": clip,
+             "step_w": steps[0], "step_h": steps[1], "offset": offset,
+             "min_max_aspect_ratios_order": min_max_aspect_ratios_order}
+    return _multi("prior_box", {"Input": [input], "Image": [image]}, attrs,
+                  [("Boxes", input.dtype), ("Variances", input.dtype)],
+                  name=name)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    attrs = {"densities": list(densities),
+             "fixed_sizes": list(fixed_sizes),
+             "fixed_ratios": list(fixed_ratios),
+             "variances": list(variance), "clip": clip,
+             "step_w": steps[0], "step_h": steps[1], "offset": offset,
+             "flatten_to_2d": flatten_to_2d}
+    return _multi("density_prior_box", {"Input": [input], "Image": [image]},
+                  attrs, [("Boxes", input.dtype),
+                          ("Variances", input.dtype)], name=name)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    attrs = {"anchor_sizes": list(anchor_sizes),
+             "aspect_ratios": list(aspect_ratios),
+             "variances": list(variance),
+             "stride": list(stride or [16.0, 16.0]), "offset": offset}
+    return _multi("anchor_generator", {"Input": [input]}, attrs,
+                  [("Anchors", input.dtype), ("Variances", input.dtype)],
+                  name=name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    return _single("box_coder", ins, attrs, target_box.dtype, name=name,
+                   out_slot="OutputBox")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None):
+    return _multi("yolo_box", {"X": [x], "ImgSize": [img_size]},
+                  {"anchors": list(anchors), "class_num": class_num,
+                   "conf_thresh": conf_thresh,
+                   "downsample_ratio": downsample_ratio,
+                   "clip_bbox": clip_bbox},
+                  [("Boxes", x.dtype), ("Scores", x.dtype)], name=name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Padded result: Out [N, keep_top_k, 6] + NmsRoisNum counts."""
+    return _multi("multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+                  {"score_threshold": score_threshold,
+                   "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                   "nms_threshold": nms_threshold, "normalized": normalized,
+                   "nms_eta": nms_eta, "background_label": background_label},
+                  [("Out", bboxes.dtype), ("NmsRoisNum", "int32")],
+                  name=name)[0]
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    return _multi("locality_aware_nms",
+                  {"BBoxes": [bboxes], "Scores": [scores]},
+                  {"score_threshold": score_threshold,
+                   "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                   "nms_threshold": nms_threshold, "normalized": normalized,
+                   "nms_eta": nms_eta, "background_label": background_label},
+                  [("Out", bboxes.dtype), ("NmsRoisNum", "int32")],
+                  name=name)[0]
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """reference detection.py detection_output: decode loc against priors
+    then run multiclass NMS. loc [N, M, 4], scores [N, M, C] (post-
+    softmax), priors [M, 4]."""
+    from paddle_tpu import layers as L
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = L.transpose(scores, [0, 2, 1])            # [N, C, M]
+    return multiclass_nms(decoded, scores_t, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=nms_threshold,
+                          nms_eta=nms_eta,
+                          background_label=background_label, name=name)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", gt_count=None, difficult=None):
+    """Padded form: detect_res [B, D, 6]; label splits into GtLabel
+    [B, G] + GtBox [B, G, 4] when passed as a tuple (gt_label, gt_box);
+    streaming states are host-side (metrics.DetectionMAP)."""
+    if isinstance(label, (list, tuple)):
+        gt_label, gt_box = label
+    else:
+        raise ValueError(
+            "detection_map needs label=(gt_label [B,G], gt_box [B,G,4]) "
+            "in the padded design (the reference packs both in one LoD "
+            "tensor)")
+    ins = {"DetectRes": [detect_res], "GtLabel": [gt_label],
+           "GtBox": [gt_box]}
+    if gt_count is not None:
+        ins["GtCount"] = [gt_count]
+    if difficult is not None:
+        ins["GtDifficult"] = [difficult]
+    B, D = detect_res.shape[0], detect_res.shape[1]
+    return _multi("detection_map", ins,
+                  {"class_num": class_num,
+                   "background_label": background_label,
+                   "overlap_threshold": overlap_threshold,
+                   "evaluate_difficult": evaluate_difficult,
+                   "ap_type": ap_version},
+                  [("MAP", "float32"), ("AccumPosCount", "int32"),
+                   ("AccumTruePos", "float32"),
+                   ("AccumFalsePos", "float32")])[0]
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    return _multi("target_assign", ins,
+                  {"mismatch_value": mismatch_value},
+                  [("Out", input.dtype), ("OutWeight", input.dtype)],
+                  name=name)
+
+
+def mine_hard_examples(cls_loss, loc_loss, match_indices, match_dist,
+                       neg_pos_ratio=1.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative",
+                       name=None):
+    ins = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+           "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        ins["LocLoss"] = [loc_loss]
+    return _multi("mine_hard_examples", ins,
+                  {"neg_pos_ratio": neg_pos_ratio,
+                   "neg_dist_threshold": neg_dist_threshold,
+                   "sample_size": sample_size, "mining_type": mining_type},
+                  [("NegIndices", "int32"), ("NegCount", "int32"),
+                   ("UpdatedMatchIndices", "int32")], name=name)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             name=None):
+    """SSD multibox loss (reference detection.py ssd_loss — the same
+    composite: match -> mine -> target_assign -> smooth_l1 + softmax CE).
+    Padded form: location [N, M, 4], confidence [N, M, C], gt_box
+    [N, G, 4], gt_label [N, G, 1]."""
+    from paddle_tpu import layers as L
+
+    if mining_type != "max_negative":
+        raise NotImplementedError("ssd_loss: only max_negative mining")
+    N, M_, C = confidence.shape
+    G = gt_box.shape[1]
+
+    # 1. match priors to gts per image (bipartite_match is 2-D, so loop
+    # the static batch)
+    matches, dists = [], []
+    for i in range(N):
+        g = L.slice(gt_box, axes=[0], starts=[i], ends=[i + 1])
+        g = L.reshape(g, [G, 4])
+        sim = iou_similarity(g, prior_box)               # [G, M]
+        m, d = _multi("bipartite_match", {"DistMat": [sim]},
+                      {"match_type": match_type,
+                       "dist_threshold": overlap_threshold},
+                      [("ColToRowMatchIndices", "int32"),
+                       ("ColToRowMatchDist", "float32")])
+        matches.append(L.reshape(m, [1, M_]))
+        dists.append(L.reshape(d, [1, M_]))
+    match_idx = L.concat(matches, axis=0)                # [N, M]
+    match_dist = L.concat(dists, axis=0)
+
+    # 2. conf loss per prior for mining
+    gt_lbl3 = L.reshape(L.cast(gt_label, "float32"), [N, G, 1])
+    tgt_lbl, _ = target_assign(gt_lbl3, match_idx,
+                               mismatch_value=background_label)
+    tgt_lbl_i = L.cast(tgt_lbl, "int64")                 # [N, M, 1]
+    conf_loss = L.softmax_with_cross_entropy(confidence, tgt_lbl_i)
+    conf_loss2d = L.reshape(conf_loss, [N, M_])
+
+    # 3. mine negatives
+    neg_idx, _, upd_match = mine_hard_examples(
+        conf_loss2d, None, match_idx, match_dist,
+        neg_pos_ratio=neg_pos_ratio, neg_dist_threshold=neg_overlap,
+        sample_size=sample_size or 0, mining_type=mining_type)
+
+    # 4. location targets (encode gt against priors) + weights
+    enc = box_coder(prior_box, prior_box_var, gt_box,
+                    code_type="encode_center_size")  # [N*G, M, 4]
+    enc = L.reshape(enc, [N, G, M_, 4])
+    tgt_loc, tgt_loc_wt = target_assign(enc, upd_match)
+    loc_diff = L.smooth_l1(L.reshape(location, [N * M_, 4]),
+                           L.reshape(tgt_loc, [N * M_, 4]))
+    loc_l = L.elementwise_mul(L.reshape(loc_diff, [N, M_]),
+                              L.reshape(tgt_loc_wt, [N, M_]))
+
+    # 5. conf target weights: positives + mined negatives
+    _, conf_wt = target_assign(gt_lbl3, upd_match,
+                               negative_indices=neg_idx,
+                               mismatch_value=background_label)
+    conf_l = L.elementwise_mul(conf_loss2d, L.reshape(conf_wt, [N, M_]))
+
+    total = L.elementwise_add(L.scale(loc_l, loc_loss_weight),
+                              L.scale(conf_l, conf_loss_weight))
+    if normalize:
+        n_pos = L.reduce_sum(L.reshape(tgt_loc_wt, [N * M_]))
+        total = L.elementwise_div(
+            total, L.reshape(
+                L.elementwise_max(
+                    n_pos, L.fill_constant([1], "float32", 1.0)), [1]))
+    return total
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference detection.py multi_box_head): per
+    feature map emit priors + conv loc/conf predictions, concat across
+    maps. Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes
+    [P, 4], variances [P, 4])."""
+    from paddle_tpu import layers as L
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio)
+                            / max(n_layer - 2, 1)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        step_pair = (steps[i] if steps else
+                     (step_w[i] if step_w else 0.0,
+                      step_h[i] if step_h else 0.0))
+        if not isinstance(step_pair, (list, tuple)):
+            step_pair = (step_pair, step_pair)
+        mins_l = [mins] if not isinstance(mins, list) else mins
+        maxs_l = ([maxs] if maxs and not isinstance(maxs, list)
+                  else (maxs or []))
+        ar_l = list(ar) if isinstance(ar, (list, tuple)) else [ar]
+        box, var = prior_box(
+            x, image, mins_l, maxs_l, ar_l, list(variance), flip, clip,
+            step_pair, offset, min_max_aspect_ratios_order)
+        # priors per cell (mirrors the prior_box op's wh enumeration)
+        n_extra = sum(2 if flip and abs(r - 1.0) > 1e-6 else
+                      (0 if abs(r - 1.0) <= 1e-6 else 1) for r in ar_l)
+        num_priors_per_cell = len(mins_l) * (
+            1 + n_extra + (1 if maxs_l else 0))
+        # conv heads
+        loc = L.conv2d(x, num_priors_per_cell * 4, kernel_size,
+                            padding=pad, stride=stride)
+        loc = L.transpose(loc, [0, 2, 3, 1])
+        loc = L.reshape(loc, [loc.shape[0], -1, 4])
+        conf = L.conv2d(x, num_priors_per_cell * num_classes,
+                             kernel_size, padding=pad, stride=stride)
+        conf = L.transpose(conf, [0, 2, 3, 1])
+        conf = L.reshape(conf, [conf.shape[0], -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(L.reshape(box, [-1, 4]))
+        vars_all.append(L.reshape(var, [-1, 4]))
+    mbox_locs = L.concat(locs, axis=1)
+    mbox_confs = L.concat(confs, axis=1)
+    boxes = L.concat(boxes_all, axis=0)
+    variances = L.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_count=None):
+    """Padded outputs (see ops/detection_rcnn_ops.py): score/loc index
+    tensors [B, S] with counts; predicted score/loc gathers are left to
+    the caller (the reference gathers here — with padded indices the
+    caller masks by count)."""
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if gt_count is not None:
+        ins["GtCount"] = [gt_count]
+    return _multi(
+        "rpn_target_assign", ins,
+        {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+         "rpn_straddle_thresh": rpn_straddle_thresh,
+         "rpn_fg_fraction": rpn_fg_fraction,
+         "rpn_positive_overlap": rpn_positive_overlap,
+         "rpn_negative_overlap": rpn_negative_overlap,
+         "use_random": use_random},
+        [("LocationIndex", "int32"), ("LocCount", "int32"),
+         ("ScoreIndex", "int32"), ("ScoreCount", "int32"),
+         ("TargetLabel", "int32"), ("TargetBBox", "float32"),
+         ("BBoxInsideWeight", "float32")])
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            gt_count=None):
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "GtLabels": [gt_labels], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if gt_count is not None:
+        ins["GtCount"] = [gt_count]
+    return _multi(
+        "retinanet_target_assign", ins,
+        {"positive_overlap": positive_overlap,
+         "negative_overlap": negative_overlap},
+        [("LocationIndex", "int32"), ("LocCount", "int32"),
+         ("ScoreIndex", "int32"), ("ScoreCount", "int32"),
+         ("TargetLabel", "int32"), ("TargetBBox", "float32"),
+         ("BBoxInsideWeight", "float32"), ("ForegroundNumber", "int32")])
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """bboxes/scores/anchors are per-FPN-level lists."""
+    return _multi(
+        "retinanet_detection_output",
+        {"BBoxes": list(bboxes), "Scores": list(scores),
+         "Anchors": list(anchors), "ImInfo": [im_info]},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "nms_eta": nms_eta},
+        [("Out", "float32"), ("NmsRoisNum", "int32")])[0]
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    rois, probs, num = _multi(
+        "generate_proposals",
+        {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+         "ImInfo": [im_info], "Anchors": [anchors],
+         "Variances": [variances]},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+        [("RpnRois", scores.dtype), ("RpnRoiProbs", scores.dtype),
+         ("RpnRoisLod", "int32")], name=name)
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             rois_num=None, gt_count=None):
+    ins = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+           "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if rois_num is not None:
+        ins["RpnRoisLod"] = [rois_num]
+    if gt_count is not None:
+        ins["GtCount"] = [gt_count]
+    return _multi(
+        "generate_proposal_labels", ins,
+        {"batch_size_per_im": batch_size_per_im,
+         "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+         "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+         "bbox_reg_weights": list(bbox_reg_weights),
+         "class_nums": class_nums, "use_random": use_random,
+         "is_cls_agnostic": is_cls_agnostic,
+         "is_cascade_rcnn": is_cascade_rcnn},
+        [("Rois", "float32"), ("LabelsInt32", "int32"),
+         ("BboxTargets", "float32"), ("BboxInsideWeights", "float32"),
+         ("BboxOutsideWeights", "float32"), ("RoisNum", "int32")])
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_segm_lens=None, gt_count=None):
+    ins = {"Rois": [rois], "LabelsInt32": [labels_int32],
+           "GtSegms": [gt_segms], "GtClasses": [gt_classes]}
+    if gt_segm_lens is not None:
+        ins["GtSegmLens"] = [gt_segm_lens]
+    if gt_count is not None:
+        ins["GtCount"] = [gt_count]
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    return _multi(
+        "generate_mask_labels", ins,
+        {"num_classes": num_classes, "resolution": resolution},
+        [("MaskRois", "float32"), ("RoiHasMaskInt32", "int32"),
+         ("MaskInt32", "int32"), ("MaskNum", "int32")])[:3]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_level = max_level - min_level + 1
+    ins = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_level)]
+    nums = [helper.create_variable_for_type_inference("int32")
+            for _ in range(n_level)]
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="distribute_fpn_proposals", inputs=ins,
+        outputs={"MultiFpnRois": outs, "MultiLevelRoisNum": nums,
+                 "RestoreIndex": [restore]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale},
+        infer_shape=False)
+    return outs, restore, nums
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    ins = {"MultiLevelRois": list(multi_rois),
+           "MultiLevelScores": list(multi_scores)}
+    if rois_num_per_level is not None:
+        ins["MultiLevelRoisNum"] = list(rois_num_per_level)
+    return _multi("collect_fpn_proposals", ins,
+                  {"post_nms_topN": post_nms_top_n},
+                  [("FpnRois", "float32"), ("RoisNum", "int32")],
+                  name=name)[0]
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    return _multi("box_decoder_and_assign",
+                  {"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                   "TargetBox": [target_box], "BoxScore": [box_score]},
+                  {"box_clip": box_clip},
+                  [("DecodeBox", target_box.dtype),
+                   ("OutputAssignBox", target_box.dtype)], name=name)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        ins["RoisBatch"] = [rois_batch]
+    return _multi("roi_perspective_transform", ins,
+                  {"transformed_height": transformed_height,
+                   "transformed_width": transformed_width,
+                   "spatial_scale": spatial_scale},
+                  [("Out", input.dtype), ("Mask", "int32"),
+                   ("TransformMatrix", input.dtype)], name=name)

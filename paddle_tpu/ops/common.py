@@ -91,3 +91,20 @@ def sigmoid_bce(logit, label):
     sigmoid_cross_entropy_with_logits and yolov3_loss)."""
     return (jnp.maximum(logit, 0) - logit * label
             + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+
+def roi_batch_indices(ins, n_rois):
+    """Per-ROI image index from the optional RoisBatch ([R] explicit) or
+    RoisNum ([B] counts) inputs; all-zero when neither is given. Shared
+    by every roi-consuming op (roi_align, psroi family, perspective
+    transform, roi_pool)."""
+    import jax.numpy as jnp
+    if ins.get("RoisBatch"):
+        return jnp.reshape(ins["RoisBatch"][0], (-1,)).astype(jnp.int32)
+    if ins.get("RoisNum"):
+        counts = jnp.reshape(ins["RoisNum"][0], (-1,)).astype(jnp.int32)
+        ends = jnp.cumsum(counts)
+        return jnp.searchsorted(
+            ends, jnp.arange(n_rois, dtype=jnp.int32),
+            side="right").astype(jnp.int32)
+    return jnp.zeros((n_rois,), jnp.int32)

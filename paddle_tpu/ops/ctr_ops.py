@@ -1,0 +1,330 @@
+"""CTR / tree-index / text-matching ops (reference: tree_conv_op.h +
+math/tree2col.cc, tdm_child_op.h, tdm_sampler_op.h, pyramid_hash_op.cc,
+match_matrix_tensor_op.cc, var_conv_2d_op.cc, filter_by_instag_op.h,
+rank_attention_op.cc + rank_attention.cu.h).
+
+TPU design notes: the reference walks trees/LoD rows on the host; here
+tree reachability is computed by max_depth boolean matmul hops (a tree
+has unique paths, so depth masks are exact), LoD text pairs come in
+padded [B, ...] + length vectors, and dynamically-sized filters return
+padded rows + counts, like the rest of this op library."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import as_dtype, x_of
+
+
+@register_op("tree_conv", infer_shape=False)
+def tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (reference tree_conv_op.h; patch math
+    math/tree2col.cc). NodesVector [B, N, F] (nodes 1-indexed, row v-1
+    holds node v), EdgeSet [B, E, 2] int (zero rows pad), Filter
+    [F, 3, out_size, num_filters]. Out [B, N, out_size, num_filters].
+    Per root u: patch = sum over nodes v within depth < max_depth of
+    (eta_l, eta_r, eta_t)(v) * feat[v]; Out[u] = patch @ Filter."""
+    feats = x_of(ins, "NodesVector")
+    edges = x_of(ins, "EdgeSet").astype(jnp.int32)
+    filt = x_of(ins, "Filter")
+    max_depth = int(attrs.get("max_depth", 2))
+    B, N, F = feats.shape
+    Fdim, three, out_size, nf = filt.shape
+    w2d = filt.reshape(F * 3, out_size * nf)
+
+    def one_tree(feat, edge):
+        u, v = edge[:, 0], edge[:, 1]
+        ok = (u != 0) & (v != 0)
+        # child adjacency over 1-indexed nodes (slot 0 unused)
+        adj = jnp.zeros((N + 1, N + 1), jnp.float32)
+        adj = adj.at[jnp.where(ok, u, 0), jnp.where(ok, v, 0)].max(
+            ok.astype(jnp.float32))
+        adj = adj.at[0, :].set(0.0).at[:, 0].set(0.0)
+        # per-node child position (1-based, edge order) + sibling count
+        E = u.shape[0]
+        same_parent = (u[:, None] == u[None, :]) & ok[None, :] & ok[:, None]
+        earlier = jnp.arange(E)[None, :] <= jnp.arange(E)[:, None]
+        order = jnp.sum((same_parent & earlier).astype(jnp.float32),
+                        axis=1)                            # [E]
+        idx_of = jnp.zeros((N + 1,), jnp.float32).at[
+            jnp.where(ok, v, 0)].max(jnp.where(ok, order, 0.0))
+        n_child = jnp.zeros((N + 1,), jnp.float32).at[
+            jnp.where(ok, u, 0)].add(ok.astype(jnp.float32))
+        parent = jnp.zeros((N + 1,), jnp.int32).at[
+            jnp.where(ok, v, 0)].max(jnp.where(ok, u, 0))
+        sibs = n_child[parent]                    # pclen per node v
+
+        def coeffs(depth, is_root):
+            eta_t = jnp.full((N + 1,), (max_depth - depth) / max_depth)
+            temp = jnp.where(is_root | (sibs <= 1), 0.5,
+                             (idx_of - 1.0)
+                             / jnp.maximum(sibs - 1.0, 1.0))
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            return eta_l, eta_r, eta_t            # each [N+1]
+
+        feat1 = jnp.concatenate(
+            [jnp.zeros((1, F), feats.dtype), feat], axis=0)  # node-id rows
+        patch = jnp.zeros((N + 1, F, 3), jnp.float32)
+        reach = jnp.eye(N + 1, dtype=jnp.float32)
+        for d in range(max_depth):
+            el, er, et = coeffs(float(d), d == 0)
+            contrib = jnp.stack([el[:, None] * feat1,
+                                 er[:, None] * feat1,
+                                 et[:, None] * feat1], axis=-1)
+            patch = patch + jnp.einsum("uv,vfk->ufk", reach, contrib)
+            reach = jnp.minimum(reach @ adj, 1.0)
+        out = patch.reshape(N + 1, F * 3) @ w2d   # [N+1, out*nf]
+        # only nodes that exist (appear in an edge or are node 1) emit
+        exists = jnp.zeros((N + 1,), bool).at[
+            jnp.where(ok, u, 0)].max(ok).at[
+            jnp.where(ok, v, 0)].max(ok).at[1].set(True).at[0].set(False)
+        out = jnp.where(exists[:, None], out, 0.0)
+        return out[1:].reshape(N, out_size, nf)
+
+    return {"Out": jax.vmap(one_tree)(feats, edges)}
+
+
+@register_op("tdm_child", grad=False, infer_shape=False)
+def tdm_child(ctx, ins, attrs):
+    """reference tdm_child_op.h: look up each node id's children in
+    TreeInfo (row per node id: [item_id, layer_id, ancestor_id,
+    child_0..child_n-1] — item_id at column 0, children from column 3).
+    X [..., 1] ids -> Child [..., child_nums], LeafMask (child is a
+    leaf iff its item_id != 0)."""
+    x = x_of(ins).astype(jnp.int32)
+    info = x_of(ins, "TreeInfo").astype(jnp.int32)
+    child_nums = int(attrs["child_nums"])
+    dt = as_dtype(attrs, default="int32")
+    flat = x.reshape(-1)
+    has_child = (flat != 0) & (info[flat, 3] != 0)
+    kids = info[flat][:, 3:3 + child_nums]                # [M, child_nums]
+    kids = jnp.where(has_child[:, None], kids, 0)
+    leaf = jnp.where(has_child[:, None] & (kids != 0),
+                     (info[kids, 0] != 0).astype(jnp.int32), 0)
+    shape = x.shape[:-1] + (child_nums,)
+    return {"Child": kids.reshape(shape).astype(dt),
+            "LeafMask": leaf.reshape(shape).astype(dt)}
+
+
+@register_op("tdm_sampler", grad=False, infer_shape=False, needs_rng=True)
+def tdm_sampler(ctx, ins, attrs):
+    """reference tdm_sampler_op.h: per input item, walk its Travel path
+    and draw negatives from each tree layer. Travel [N, L] (0 pads an
+    absent layer), Layer [total_nodes] flat with layer_offset_lod.
+    Out/Labels/Mask [N, sum(neg_nums_i + output_positive)].
+    Divergence (documented): a colliding negative is shifted to the
+    next layer slot instead of reject-resampled."""
+    x = x_of(ins).astype(jnp.int32).reshape(-1)
+    travel = x_of(ins, "Travel").astype(jnp.int32)
+    layer = x_of(ins, "Layer").astype(jnp.int32).reshape(-1)
+    neg_nums = [int(n) for n in attrs["neg_samples_num_list"]]
+    offsets = [int(o) for o in attrs["layer_offset_lod"]]
+    out_pos = bool(attrs.get("output_positive", True))
+    dt = as_dtype(attrs, default="int32")
+    key = ctx.op_key(attrs)
+    N = x.shape[0]
+    L = len(neg_nums)
+    per_layer = [n + (1 if out_pos else 0) for n in neg_nums]
+    total = sum(per_layer)
+
+    outs, labels, masks = [], [], []
+    for li in range(L):
+        start, end = offsets[li], offsets[li + 1]
+        size = max(end - start, 1)
+        pos = travel[jnp.maximum(x, 0), li]               # [N]
+        live = pos != 0
+        if out_pos:
+            outs.append(jnp.where(live, pos, 0)[:, None])
+            labels.append(jnp.where(live, 1, 0)[:, None])
+            masks.append(live.astype(jnp.int32)[:, None])
+        k = jax.random.fold_in(key, li)
+        draw = jax.random.randint(k, (N, neg_nums[li]), 0, size)
+        cand = layer[start + draw]
+        # shift collisions with the positive to the next node in layer
+        coll = cand == pos[:, None]
+        alt = layer[start + (draw + 1) % size]
+        cand = jnp.where(coll, alt, cand)
+        outs.append(jnp.where(live[:, None], cand, 0))
+        labels.append(jnp.zeros((N, neg_nums[li]), jnp.int32))
+        masks.append(jnp.broadcast_to(live[:, None].astype(jnp.int32),
+                                      (N, neg_nums[li])))
+    out = jnp.concatenate(outs, axis=1)
+    assert out.shape[1] == total
+    return {"Out": out.astype(dt),
+            "Labels": jnp.concatenate(labels, axis=1).astype(dt),
+            "Mask": jnp.concatenate(masks, axis=1).astype(dt)}
+
+
+@register_op("pyramid_hash", infer_shape=False)
+def pyramid_hash(ctx, ins, attrs):
+    """Pyramid hashing embedding for text (reference pyramid_hash_op.cc):
+    every n-gram (2..max_pyramid+1 tokens) hashes to `num_hash` rows of
+    the compressed table W [space_len, 1] viewed as a flat parameter;
+    the n-gram embedding is the mean of its hashed rows; a sequence's
+    output is the sum over its n-grams. Padded form: X [B, T] ids +
+    Length [B]. Out [B, rand_len].
+    Divergence (documented): the reference uses xxHash on raw bytes;
+    here a fixed-coefficient polynomial hash keeps the op jittable —
+    same capability (hash-bucketed n-gram embeddings), different
+    bucketing."""
+    x = x_of(ins).astype(jnp.int32)
+    w = x_of(ins, "W").reshape(-1)
+    lens = ins.get("Length")
+    B, T = x.shape
+    if lens:
+        lengths = jnp.reshape(lens[0], (-1,)).astype(jnp.int32)
+    else:
+        lengths = jnp.full((B,), T, jnp.int32)
+    num_hash = int(attrs.get("num_hash", 1))
+    rand_len = int(attrs.get("rand_len", 16))
+    max_pyr = int(attrs.get("max_pyramid", 2))
+    space = max(int(w.shape[0]) - rand_len, 1)
+
+    def h(ids, salt):
+        # polynomial hash of the n-gram window, salted per hash fn
+        acc = jnp.zeros(ids.shape[:-1], jnp.uint32) + jnp.uint32(
+            2166136261 + 1013904223 * salt)
+        for j in range(ids.shape[-1]):
+            acc = acc * jnp.uint32(16777619) ^ ids[..., j].astype(
+                jnp.uint32)
+        return (acc % jnp.uint32(space)).astype(jnp.int32)
+
+    out = jnp.zeros((B, rand_len), w.dtype)
+    pos = jnp.arange(T)
+    for n in range(2, max_pyr + 2):
+        if n > T:
+            break
+        grams = jnp.stack([x[:, i:T - n + 1 + i] for i in range(n)],
+                          axis=-1)                        # [B, T-n+1, n]
+        valid = (pos[None, :T - n + 1] + n) <= lengths[:, None]
+        emb = jnp.zeros((B, T - n + 1, rand_len), w.dtype)
+        for s in range(num_hash):
+            start = h(grams, s)                           # [B, T-n+1]
+            rows = start[..., None] + jnp.arange(rand_len)
+            emb = emb + w[rows]
+        emb = emb / num_hash
+        out = out + jnp.sum(
+            jnp.where(valid[..., None], emb, 0.0), axis=1)
+    return {"Out": out}
+
+
+@register_op("match_matrix_tensor", infer_shape=False)
+def match_matrix_tensor(ctx, ins, attrs):
+    """Bilinear text-pair match matrix (reference
+    match_matrix_tensor_op.cc): out[b, t, i, j] = x_i' W_t y_j. Padded
+    form: X [B, Lx, D], Y [B, Ly, D] (+ XLength/YLength), W
+    [D, dim_t, D]. Out [B, dim_t, Lx, Ly] (pads zero), Tmp [B, Lx,
+    dim_t, D] (the x'W intermediate the reference stores for grad)."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    w = x_of(ins, "W")
+    B, Lx, D = x.shape
+    Ly = y.shape[1]
+    xl = ins.get("XLength")
+    yl = ins.get("YLength")
+    tmp = jnp.einsum("bxd,dte->bxte", x, w)
+    out = jnp.einsum("bxte,bye->btxy", tmp, y)
+    if xl:
+        xm = jnp.arange(Lx)[None, :] < jnp.reshape(
+            xl[0], (-1,)).astype(jnp.int32)[:, None]
+        out = jnp.where(xm[:, None, :, None], out, 0.0)
+    if yl:
+        ym = jnp.arange(Ly)[None, :] < jnp.reshape(
+            yl[0], (-1,)).astype(jnp.int32)[:, None]
+        out = jnp.where(ym[:, None, None, :], out, 0.0)
+    return {"Out": out, "Tmp": tmp}
+
+
+@register_op("var_conv_2d", infer_shape=False)
+def var_conv_2d(ctx, ins, attrs):
+    """Conv over per-sample-sized 2D maps (reference var_conv_2d_op.cc):
+    X [B, C_in, H, W] padded with per-sample valid sizes ROW [B] /
+    COLUMN [B]; W [out_c, in_c*kh*kw]. SAME-center padding, stride
+    (sh, sw); out size per sample = (dim-1)//stride + 1, zeros beyond.
+    Out [B, out_c, H', W'] with H' = (H-1)//sh + 1."""
+    x = x_of(ins)
+    w = x_of(ins, "W")
+    rows = jnp.reshape(x_of(ins, "ROW"), (-1,)).astype(jnp.int32)
+    cols = jnp.reshape(x_of(ins, "COLUMN"), (-1,)).astype(jnp.int32)
+    kh = int(attrs.get("KernelH", 1))
+    kw = int(attrs.get("KernelW", 1))
+    sh = int(attrs.get("StrideH", 1))
+    sw = int(attrs.get("StrideW", 1))
+    out_c = int(attrs.get("OutputChannel", w.shape[0]))
+    B, C, H, W = x.shape
+    # zero out padding beyond each sample's valid region first
+    hm = jnp.arange(H)[None, :] < rows[:, None]
+    wm = jnp.arange(W)[None, :] < cols[:, None]
+    xm = x * hm[:, None, :, None] * wm[:, None, None, :]
+    filt = w.reshape(out_c, C, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        xm, filt, (sh, sw),
+        [((kh - 1) // 2, kh - 1 - (kh - 1) // 2),
+         ((kw - 1) // 2, kw - 1 - (kw - 1) // 2)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    Ho, Wo = out.shape[2], out.shape[3]
+    oh = (rows - 1) // sh + 1
+    ow = (cols - 1) // sw + 1
+    ohm = jnp.arange(Ho)[None, :] < oh[:, None]
+    owm = jnp.arange(Wo)[None, :] < ow[:, None]
+    out = out * ohm[:, None, :, None] * owm[:, None, None, :]
+    return {"Out": out, "Col": jnp.zeros((1,), x.dtype)}
+
+
+@register_op("filter_by_instag", grad=False, infer_shape=False)
+def filter_by_instag(ctx, ins, attrs):
+    """reference filter_by_instag_op.h: keep rows whose tag set
+    intersects Filter_tag. Padded form: Ins [N, D], Ins_tag [N, Tmax]
+    (-1 pads), Filter_tag [K]. Out [N, D] (kept rows compacted,
+    zero pad), LossWeight [N, 1], IndexMap [N, 2] (out row -> in row),
+    OutCount [1]."""
+    rows = x_of(ins, "Ins")
+    tags = x_of(ins, "Ins_tag").astype(jnp.int64)
+    filt = x_of(ins, "Filter_tag").astype(jnp.int64).reshape(-1)
+    is_lod = bool(attrs.get("is_lod", True))  # noqa: F841 (API parity)
+    N = rows.shape[0]
+    hit = jnp.any((tags[:, :, None] == filt[None, None, :])
+                  & (tags[:, :, None] >= 0), axis=(1, 2))
+    order = jnp.argsort(jnp.where(hit, jnp.arange(N), N + jnp.arange(N)))
+    cnt = jnp.sum(hit.astype(jnp.int32))
+    live = jnp.arange(N) < cnt
+    out = jnp.where(live[:, None], rows[order], 0.0)
+    idx_map = jnp.stack(
+        [jnp.arange(N, dtype=jnp.int32),
+         jnp.where(live, order, -1).astype(jnp.int32)], axis=1)
+    return {"Out": out,
+            "LossWeight": live.astype(rows.dtype)[:, None],
+            "IndexMap": idx_map,
+            "OutCount": cnt.reshape(1)}
+
+
+@register_op("rank_attention", infer_shape=False)
+def rank_attention(ctx, ins, attrs):
+    """reference rank_attention_op.cc (+ rank_attention.cu.h): per-ins
+    rank-conditioned attention for CTR. X [N, D]; RankOffset
+    [N, 1 + 2*max_rank] int — col 0 is the ins rank (1-based, 0 =
+    none), then (rank_flag_k, row_index_k) pairs; RankParam
+    [max_rank*max_rank*D, p]. InputHelp [N, max_rank*D] gathers the
+    flagged rows; the per-ins parameter block selects rows by
+    (ins_rank, k); Out [N, p] = InputHelp @ param_ins."""
+    x = x_of(ins)
+    offset = x_of(ins, "RankOffset").astype(jnp.int32)
+    param = x_of(ins, "RankParam")
+    max_rank = int(attrs.get("MaxRank", 3))
+    N, D = x.shape
+    p = param.shape[1]
+    lower = offset[:, 0] - 1                              # [N]
+    flags = offset[:, 1::2] - 1                           # [N, max_rank]
+    index = offset[:, 2::2]                               # [N, max_rank]
+    ok = (lower[:, None] >= 0) & (flags >= 0)
+    gathered = x[jnp.maximum(index, 0)]                   # [N, K, D]
+    help_ = jnp.where(ok[:, :, None], gathered, 0.0)
+    # param rows for ins i, block k, feature f:
+    #   (lower*max_rank + k)*D + f
+    par3 = param.reshape(max_rank, max_rank, D, p)
+    par_ins = par3[jnp.maximum(lower, 0)]                 # [N, K, D, p]
+    par_ins = jnp.where(ok[:, :, None, None], par_ins, 0.0)
+    out = jnp.einsum("nkd,nkdp->np", help_, par_ins)
+    return {"Out": out,
+            "InputHelp": help_.reshape(N, max_rank * D),
+            "InsRank": lower.astype(x.dtype)[:, None] + 1}

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import bilinear_sample, x_of
+from .common import bilinear_sample, roi_batch_indices, x_of
 
 
 def _iou_matrix(a, b):
@@ -339,16 +339,7 @@ def roi_align(ctx, ins, attrs):
                        int(np.ceil(W / pooled_w)), 1)
         sampling = min(sampling, 8)   # cap the static cost
     R = rois.shape[0]
-    if ins.get("RoisBatch"):          # explicit per-ROI image index
-        batch_idx = jnp.reshape(ins["RoisBatch"][0],
-                                (-1,)).astype(jnp.int32)
-    elif ins.get("RoisNum"):          # reference contract: counts/image
-        counts = jnp.reshape(ins["RoisNum"][0], (-1,)).astype(jnp.int32)
-        ends = jnp.cumsum(counts)
-        batch_idx = jnp.searchsorted(ends, jnp.arange(R, dtype=jnp.int32),
-                                     side="right").astype(jnp.int32)
-    else:
-        batch_idx = jnp.zeros((R,), jnp.int32)
+    batch_idx = roi_batch_indices(ins, R)
 
     def one_roi(roi, bi):
         x1, y1, x2, y2 = roi * scale
@@ -592,3 +583,461 @@ def yolov3_loss(ctx, ins, attrs):
     l_noobj = jnp.sum(
         bce(tobj, 0.0) * noobj * (1.0 - obj_target), axis=(1, 2, 3))
     return {"Loss": loss + l_noobj}
+
+
+# ---------------------------------------------------------------------------
+# SSD target machinery + evaluation (reference detection/target_assign_op.cc,
+# mine_hard_examples_op.cc, detection_map_op.cc, locality_aware_nms_op.cc,
+# box_decoder_and_assign companion ops live in detection_rcnn_ops.py)
+# ---------------------------------------------------------------------------
+
+@register_op("target_assign", grad=False, infer_shape=False)
+def target_assign(ctx, ins, attrs):
+    """reference detection/target_assign_op.h: scatter per-gt rows onto
+    prior positions by MatchIndices. Padded form: X [B, G, P, K] (the
+    reference's LoD rows, per image), MatchIndices [B, M] (-1 =
+    mismatch), optional NegIndices [B, Q] padded -1. Out [B, M, K],
+    OutWeight [B, M, 1]."""
+    x = x_of(ins)
+    match = x_of(ins, "MatchIndices").astype(jnp.int32)
+    mismatch = float(attrs.get("mismatch_value", 0))
+    B, M = match.shape
+    if x.ndim == 3:                   # [B, G, K] -> P=1
+        x = x[:, :, None, :]
+    G, P, K = x.shape[1], x.shape[2], x.shape[3]
+
+    m_pos = jnp.arange(M) % P
+    matched = match >= 0
+    safe = jnp.maximum(match, 0)
+    gathered = x[jnp.arange(B)[:, None], safe, m_pos[None, :], :]
+    out = jnp.where(matched[:, :, None], gathered, mismatch)
+    wt = matched.astype(x.dtype)[:, :, None]
+    neg = ins.get("NegIndices")
+    if neg:
+        ni = jnp.asarray(neg[0]).reshape(B, -1).astype(jnp.int32)
+        neg_mask = jnp.zeros((B, M), bool)
+        neg_mask = neg_mask.at[jnp.arange(B)[:, None],
+                               jnp.maximum(ni, 0)].max(ni >= 0)
+        wt = jnp.maximum(wt, neg_mask.astype(x.dtype)[:, :, None])
+    return {"Out": out, "OutWeight": wt}
+
+
+@register_op("mine_hard_examples", grad=False, infer_shape=False)
+def mine_hard_examples(ctx, ins, attrs):
+    """reference detection/mine_hard_examples_op.cc. ClsLoss/LocLoss
+    [B, M], MatchIndices [B, M], MatchDist [B, M]. NegIndices comes back
+    padded [B, M] (-1 pad, ascending order per image — the reference's
+    std::set) + NegCount [B]; UpdatedMatchIndices [B, M]."""
+    cls_loss = x_of(ins, "ClsLoss")
+    match = x_of(ins, "MatchIndices").astype(jnp.int32)
+    dist = x_of(ins, "MatchDist")
+    loc = ins.get("LocLoss")
+    mining = attrs.get("mining_type", "max_negative")
+    neg_ratio = float(attrs.get("neg_pos_ratio", 1.0))
+    neg_dist_thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    sample_size = int(attrs.get("sample_size", 0))
+    B, M = match.shape
+    loss = cls_loss
+    if mining == "hard_example" and loc:
+        loss = cls_loss + jnp.asarray(loc[0]).reshape(B, M)
+
+    def one_image(loss_b, match_b, dist_b):
+        if mining == "max_negative":
+            elig = (match_b == -1) & (dist_b < neg_dist_thresh)
+            n_pos = jnp.sum((match_b != -1).astype(jnp.int32))
+            cap = jnp.minimum((n_pos.astype(jnp.float32)
+                               * neg_ratio).astype(jnp.int32),
+                              jnp.sum(elig.astype(jnp.int32)))
+        else:                          # hard_example
+            elig = jnp.ones((M,), bool)
+            cap = jnp.minimum(sample_size,
+                              jnp.sum(elig.astype(jnp.int32)))
+        # top-cap by loss among eligible
+        key = jnp.where(elig, loss_b, -jnp.inf)
+        order = jnp.argsort(-key)
+        rank_of = jnp.zeros((M,), jnp.int32).at[order].set(
+            jnp.arange(M, dtype=jnp.int32))
+        sel = elig & (rank_of < cap)
+        if mining == "hard_example":
+            upd = jnp.where((match_b > -1) & ~sel, -1, match_b)
+            neg_sel = sel & (match_b <= -1)
+        else:
+            upd = match_b
+            neg_sel = sel
+        # ascending index order (reference std::set), padded -1
+        idx = jnp.where(neg_sel, jnp.arange(M), M + jnp.arange(M))
+        srt = jnp.sort(idx)
+        n_neg = jnp.sum(neg_sel.astype(jnp.int32))
+        neg = jnp.where(jnp.arange(M) < n_neg, srt, -1)
+        return neg.astype(jnp.int32), n_neg, upd
+
+    neg, n_neg, upd = jax.vmap(one_image)(loss, match, dist)
+    return {"NegIndices": neg, "NegCount": n_neg,
+            "UpdatedMatchIndices": upd}
+
+
+@register_op("locality_aware_nms", grad=False, infer_shape=False)
+def locality_aware_nms(ctx, ins, attrs):
+    """reference detection/locality_aware_nms_op.cc (EAST-style): first a
+    locality pass merges consecutive same-class boxes with IoU >
+    nms_threshold by score-weighted averaging, then standard per-class
+    NMS + cross-class top-k. Single-class input in practice. BBoxes
+    [N, M, 4], Scores [N, C, M] -> Out [N, keep_top_k, 6] + counts."""
+    bboxes = x_of(ins, "BBoxes")
+    scores = x_of(ins, "Scores")
+    score_thresh = float(attrs.get("score_threshold", 0.05))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    background = int(attrs.get("background_label", -1))
+    N, C, M = scores.shape
+    nms_top_k = min(nms_top_k if nms_top_k > 0 else M, M)
+    fg = [c for c in range(C) if c != background]
+    if keep_top_k <= 0:
+        keep_top_k = len(fg) * nms_top_k
+
+    def merge_pass(boxes, sc):
+        """Sequential merge over input order (the locality pass)."""
+        def step(carry, inp):
+            cur_box, cur_sc, valid = carry
+            b, s = inp
+            iou = _iou_matrix(cur_box[None], b[None])[0, 0]
+            do_merge = valid & (iou > nms_thresh) & (s > score_thresh)
+            wsum = cur_sc + s
+            merged = (cur_box * cur_sc + b * s) / jnp.maximum(wsum, 1e-10)
+            emit_box = jnp.where(do_merge, jnp.zeros(4), cur_box)
+            emit_sc = jnp.where(do_merge, -1.0, cur_sc)
+            emit_valid = valid & ~do_merge
+            new_box = jnp.where(do_merge, merged, b)
+            new_sc = jnp.where(do_merge, wsum, s)
+            live = s > score_thresh
+            new_valid = do_merge | live
+            # when current emits, the incoming box starts a new group
+            return ((new_box, jnp.where(live | do_merge, new_sc, -1.0),
+                     new_valid),
+                    (emit_box, jnp.where(emit_valid, emit_sc, -1.0)))
+
+        init = (jnp.zeros(4), jnp.asarray(-1.0), jnp.asarray(False))
+        (last_b, last_s, last_v), (out_b, out_s) = jax.lax.scan(
+            step, init, (boxes, sc))
+        out_b = jnp.concatenate([out_b, last_b[None]], axis=0)
+        out_s = jnp.concatenate(
+            [out_s, jnp.where(last_v, last_s, -1.0)[None]], axis=0)
+        return out_b, out_s
+
+    def per_image(boxes, sc):
+        all_s, all_b, all_c = [], [], []
+        for c in fg:
+            mb, ms = merge_pass(boxes, sc[c])
+            k = min(nms_top_k, ms.shape[0])
+            top_s, top_i = jax.lax.top_k(ms, k)
+            b = mb[top_i]
+            iou = _iou_matrix(b, b)
+            alive = top_s > score_thresh
+
+            def body(i, alive):
+                sup = jnp.logical_and(alive[i], iou[i] > nms_thresh)
+                sup = sup.at[i].set(False)
+                later = jnp.arange(k) > i
+                return jnp.where(jnp.logical_and(sup, later), False,
+                                 alive)
+
+            alive = jax.lax.fori_loop(0, k, body, alive)
+            all_s.append(jnp.where(alive, top_s, -1.0))
+            all_b.append(b)
+            all_c.append(jnp.full((k,), c, jnp.float32))
+        cat_s = jnp.concatenate(all_s)
+        cat_b = jnp.concatenate(all_b, axis=0)
+        cat_c = jnp.concatenate(all_c)
+        kk = min(keep_top_k, cat_s.shape[0])
+        fin_s, fin_i = jax.lax.top_k(cat_s, kk)
+        valid = fin_s > score_thresh
+        rows = jnp.concatenate([
+            jnp.where(valid, cat_c[fin_i], -1.0)[:, None],
+            jnp.where(valid, fin_s, 0.0)[:, None],
+            jnp.where(valid[:, None], cat_b[fin_i], 0.0)], axis=1)
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    rows, counts = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": rows, "NmsRoisNum": counts}
+
+
+@register_op("detection_map", grad=False, infer_shape=False)
+def detection_map(ctx, ins, attrs):
+    """mean Average Precision (reference detection/detection_map_op.h).
+    Padded one-shot form: DetectRes [B, D, 6] (label, score, box; label
+    -1 pads), GtLabel [B, G], GtBox [B, G, 4] (+ GtCount [B], optional
+    GtDifficult [B, G]). Emits MAP [1]. Divergence (documented): the
+    reference's streaming accumulator inputs/outputs (PosCount/TruePos/
+    FalsePos LoD states) are not consumed; fluid.metrics.DetectionMAP
+    accumulates MAP host-side instead."""
+    det = x_of(ins, "DetectRes")
+    gt_label = x_of(ins, "GtLabel")
+    gt_box = x_of(ins, "GtBox")
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs["class_num"])
+    eval_difficult = bool(attrs.get("evaluate_difficult", True))
+    B, D = det.shape[0], det.shape[1]
+    G = gt_box.shape[1]
+    gt_label = gt_label.reshape(B, G)
+    cnt = ins.get("GtCount")
+    gt_valid = jnp.ones((B, G), bool)
+    if cnt:
+        counts = jnp.reshape(cnt[0], (-1,)).astype(jnp.int32)
+        gt_valid = jnp.arange(G)[None, :] < counts[:, None]
+    difficult = ins.get("GtDifficult")
+    if difficult:
+        diff = jnp.reshape(difficult[0], (B, G)) != 0
+    else:
+        diff = jnp.zeros((B, G), bool)
+
+    det_label = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    det_box = det[:, :, 2:6]
+    det_valid = det_label >= 0
+    # IoU between each image's detections and gts (normalized convention
+    # follows the SSD pipeline's iou_similarity)
+    iou = jax.vmap(_iou_matrix)(det_box, gt_box)          # [B, D, G]
+
+    background = int(attrs.get("background_label", 0))
+    aps = []
+    n_classes_with_gt = []
+    for c in range(class_num):
+        if c == background:
+            continue
+        gt_c = gt_valid & (gt_label == c)
+        count_gt = jnp.sum(
+            (gt_c & (eval_difficult | ~diff)).astype(jnp.int32))
+        det_c = det_valid & (det_label == c)
+        score_c = jnp.where(det_c, det_score, -jnp.inf)
+        flat_score = score_c.reshape(-1)                   # [B*D]
+        order = jnp.argsort(-flat_score)                   # global desc
+
+        def match_step(i, carry):
+            matched, tp, fp = carry
+            fi = order[i]
+            b, d = fi // D, fi % D
+            live = flat_score[fi] > -jnp.inf
+            row = jnp.where(gt_c[b], iou[b, d], -1.0)      # [G]
+            best = jnp.argmax(row)
+            best_iou = row[best]
+            hit = live & (best_iou > thresh)
+            is_diff = diff[b, best]
+            fresh = hit & ~matched[b, best]
+            # difficult gts are ignored unless evaluate_difficult
+            if eval_difficult:
+                counts_tp = fresh
+                ignore = jnp.asarray(False)
+            else:
+                counts_tp = fresh & ~is_diff
+                ignore = hit & is_diff
+            tp = tp.at[i].set(jnp.where(counts_tp, 1.0, 0.0))
+            fp = fp.at[i].set(
+                jnp.where(live & ~counts_tp & ~ignore, 1.0, 0.0))
+            matched = matched.at[b, best].max(hit)
+            return matched, tp, fp
+
+        n = B * D
+        matched0 = jnp.zeros((B, G), bool)
+        _, tp, fp = jax.lax.fori_loop(
+            0, n, match_step,
+            (matched0, jnp.zeros((n,)), jnp.zeros((n,))))
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        recall = ctp / jnp.maximum(count_gt, 1)
+        has_det = (tp + fp) > 0
+        if ap_type == "11point":
+            pts = []
+            for t in range(11):
+                ok = has_det & (recall >= t / 10.0)
+                pts.append(jnp.max(jnp.where(ok, precision, 0.0)))
+            ap = jnp.sum(jnp.stack(pts)) / 11.0
+        else:
+            prev_rec = jnp.concatenate([jnp.zeros(1), recall[:-1]])
+            ap = jnp.sum(jnp.where(has_det,
+                                   (recall - prev_rec) * precision, 0.0))
+        aps.append(jnp.where(count_gt > 0, ap, 0.0))
+        n_classes_with_gt.append((count_gt > 0).astype(jnp.float32))
+    total = jnp.sum(jnp.stack(n_classes_with_gt))
+    m_ap = jnp.sum(jnp.stack(aps)) / jnp.maximum(total, 1.0)
+    return {"MAP": m_ap.astype(jnp.float32).reshape(1),
+            "AccumPosCount": jnp.zeros((class_num, 1), jnp.int32),
+            "AccumTruePos": jnp.zeros((class_num, B * D, 2)),
+            "AccumFalsePos": jnp.zeros((class_num, B * D, 2))}
+
+
+@register_op("deformable_psroi_pooling", infer_shape=False)
+def deformable_psroi_pooling(ctx, ins, attrs):
+    """reference deformable_psroi_pooling_op.h: position-sensitive RoI
+    pooling with learned per-part offsets (Trans [R, 2*num_classes,
+    part_h, part_w] scaled by trans_std). Output [R, output_dim, ph, pw]
+    + TopCount (valid sample counts per bin)."""
+    x = x_of(ins, "Input")
+    rois = x_of(ins, "ROIs")
+    trans = x_of(ins, "Trans")
+    no_trans = bool(attrs.get("no_trans", False))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs["output_dim"])
+    group = attrs.get("group_size", [1, 1])
+    gh, gw = int(group[0]), int(group[1])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    part = attrs.get("part_size", [ph, pw])
+    part_h, part_w = int(part[0]), int(part[1])
+    spp = int(attrs.get("sample_per_part", 1))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    num_classes = 1 if no_trans else max(trans.shape[1] // 2, 1)
+    ch_per_class = max(out_dim // num_classes, 1)
+    batch_idx = roi_batch_indices(ins, R)
+
+    def one_roi(roi, tr, bi):
+        x1 = jnp.round(roi[0]) * scale - 0.5
+        y1 = jnp.round(roi[1]) * scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        sub_h, sub_w = bin_h / spp, bin_w / spp
+        img = x[bi]
+
+        def one_cell(ct, py, px):
+            pt_h = jnp.floor(py.astype(jnp.float32) / ph
+                             * part_h).astype(jnp.int32)
+            pt_w = jnp.floor(px.astype(jnp.float32) / pw
+                             * part_w).astype(jnp.int32)
+            cls = ct // ch_per_class
+            if no_trans:
+                tx = ty = 0.0
+            else:
+                tx = tr[cls * 2, pt_h, pt_w] * trans_std
+                ty = tr[cls * 2 + 1, pt_h, pt_w] * trans_std
+            wstart = px * bin_w + x1 + tx * rw
+            hstart = py * bin_h + y1 + ty * rh
+            g_w = jnp.clip(jnp.floor(px.astype(jnp.float32) * gw / pw),
+                           0, gw - 1).astype(jnp.int32)
+            g_h = jnp.clip(jnp.floor(py.astype(jnp.float32) * gh / ph),
+                           0, gh - 1).astype(jnp.int32)
+            c_in = (ct * gh + g_h) * gw + g_w
+            iw = jnp.arange(spp, dtype=jnp.float32)
+            ww = wstart + iw * sub_w                       # [spp]
+            hh = hstart + iw * sub_h                       # [spp]
+            wg, hg = jnp.meshgrid(ww, hh)                  # [spp, spp]
+            ok = ((wg >= -0.5) & (wg <= W - 0.5)
+                  & (hg >= -0.5) & (hg <= H - 0.5))
+            wc = jnp.clip(wg, 0.0, W - 1.0)
+            hc = jnp.clip(hg, 0.0, H - 1.0)
+            plane = img[c_in]
+            x0 = jnp.floor(wc)
+            y0 = jnp.floor(hc)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            dx = wc - x0
+            dy = hc - y0
+            val = (plane[y0i, x0i] * (1 - dy) * (1 - dx)
+                   + plane[y0i, x1i] * (1 - dy) * dx
+                   + plane[y1i, x0i] * dy * (1 - dx)
+                   + plane[y1i, x1i] * dy * dx)
+            cnt = jnp.sum(ok.astype(jnp.float32))
+            s = jnp.sum(jnp.where(ok, val, 0.0))
+            return jnp.where(cnt > 0, s / cnt, 0.0), cnt
+
+        cts = jnp.arange(out_dim)
+        pys = jnp.arange(ph)
+        pxs = jnp.arange(pw)
+        f = jax.vmap(jax.vmap(jax.vmap(one_cell, (None, None, 0)),
+                              (None, 0, None)), (0, None, None))
+        return f(cts, pys, pxs)
+
+    out, cnt = jax.vmap(one_roi)(rois, trans, batch_idx)
+    return {"Output": out, "TopCount": cnt}
+
+
+@register_op("roi_perspective_transform", infer_shape=False)
+def roi_perspective_transform(ctx, ins, attrs):
+    """reference detection/roi_perspective_transform_op.cc: warp each
+    quad ROI ([R, 8] corner coords) to a [transformed_h, transformed_w]
+    patch by the estimated perspective matrix. Outputs Out
+    [R, C, th, tw], Mask [R, 1, th, tw], TransformMatrix [R, 9]."""
+    x = x_of(ins)
+    rois = x_of(ins, "ROIs")
+    scale = float(attrs.get("spatial_scale", 1.0))
+    th = int(attrs["transformed_height"])
+    tw = int(attrs["transformed_width"])
+    N, C, H, W = x.shape
+    R = rois.shape[0]
+    batch_idx = roi_batch_indices(ins, R)
+
+    def one_roi(roi, bi):
+        rx = roi[0::2] * scale                             # [4]
+        ry = roi[1::2] * scale
+        x0, x1b, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1b, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1b) ** 2 + (y0 - y1b) ** 2)
+        len2 = jnp.sqrt((x1b - x2) ** 2 + (y1b - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = max(2, th)
+        nw_f = jnp.round(est_w * (nh - 1)
+                         / jnp.maximum(est_h, 1e-5)) + 1
+        nw = jnp.clip(nw_f, 2, tw)
+        dx1, dx2, dx3 = x1b - x2, x3 - x2, x0 - x1b + x2 - x3
+        dy1, dy2, dy3 = y1b - y2, y3 - y2, y0 - y1b + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+        m3 = (y1b - y0 + m6 * (nw - 1) * y1b) / (nw - 1)
+        m4 = (y3 - y0 + m7 * (nh - 1) * y3) / (nh - 1)
+        m0 = (x1b - x0 + m6 * (nw - 1) * x1b) / (nw - 1)
+        m1 = (x3 - x0 + m7 * (nh - 1) * x3) / (nh - 1)
+        mat = jnp.stack([m0, m1, x0, m3, m4, y0, m6, m7,
+                         jnp.asarray(1.0)])
+        ow = jnp.arange(tw, dtype=jnp.float32)
+        oh = jnp.arange(th, dtype=jnp.float32)
+        og_w, og_h = jnp.meshgrid(ow, oh)                  # [th, tw]
+        wden = m6 * og_w + m7 * og_h + 1.0
+        in_w = (m0 * og_w + m1 * og_h + x0) / wden
+        in_h = (m3 * og_w + m4 * og_h + y0) / wden
+
+        # point-in-quad test (even-odd over the 4 edges)
+        qx = jnp.stack([rx[0], rx[1], rx[2], rx[3]])
+        qy = jnp.stack([ry[0], ry[1], ry[2], ry[3]])
+        nxt = jnp.array([1, 2, 3, 0])
+        xi, yi = qx[:, None, None], qy[:, None, None]
+        xj, yj = qx[nxt][:, None, None], qy[nxt][:, None, None]
+        cond = (yi > in_h[None]) != (yj > in_h[None])
+        xc = xi + (in_h[None] - yi) / jnp.where(
+            jnp.abs(yj - yi) < 1e-12, 1e-12, yj - yi) * (xj - xi)
+        inside_quad = (jnp.sum((cond & (in_w[None] < xc)).astype(
+            jnp.int32), axis=0) % 2) == 1
+        in_range = ((in_w > -0.5) & (in_w < W - 0.5)
+                    & (in_h > -0.5) & (in_h < H - 0.5))
+        ok = inside_quad & in_range
+        wc = jnp.clip(in_w, 0.0, W - 1.0)
+        hc = jnp.clip(in_h, 0.0, H - 1.0)
+        img = x[bi]
+        x0f = jnp.floor(wc)
+        y0f = jnp.floor(hc)
+        x1i = jnp.clip(x0f + 1, 0, W - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0f + 1, 0, H - 1).astype(jnp.int32)
+        x0i = x0f.astype(jnp.int32)
+        y0i = y0f.astype(jnp.int32)
+        dx = wc - x0f
+        dy = hc - y0f
+        val = (img[:, y0i, x0i] * (1 - dy) * (1 - dx)
+               + img[:, y0i, x1i] * (1 - dy) * dx
+               + img[:, y1i, x0i] * dy * (1 - dx)
+               + img[:, y1i, x1i] * dy * dx)                # [C, th, tw]
+        out = jnp.where(ok[None], val, 0.0)
+        return out, ok.astype(jnp.int32)[None], mat
+
+    out, mask, mats = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out, "Mask": mask, "TransformMatrix": mats}

@@ -1,0 +1,708 @@
+"""Faster/Mask R-CNN + RetinaNet + FPN detection ops (reference
+/root/reference/paddle/fluid/operators/detection/: generate_proposals_op.cc,
+rpn_target_assign_op.cc, generate_proposal_labels_op.cc,
+generate_mask_labels_op.cc, distribute_fpn_proposals_op.h,
+collect_fpn_proposals_op.h, box_decoder_and_assign_op.h,
+retinanet_detection_output_op.cc).
+
+TPU design notes: the reference's kernels are CPU loops emitting
+dynamically-sized LoD tensors. Here every op is dense/static-shape:
+variable-length results come back PADDED with an explicit count (the
+multiclass_nms / sequence-op scheme), selection loops become sort-keys +
+masks, and greedy NMS is the same fixed-trip fori pattern
+detection_ops.py uses. Sampling ops implement the reference's
+use_random=False path (first-k in index order) so results are
+deterministic and testable; the random path falls back to it
+(documented divergence — stateless per-step sampling would need the op
+key plumbed per image).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+from .detection_ops import _iou_matrix
+
+_BBOX_CLIP = float(jnp.log(1000.0 / 16.0))
+
+
+def _iou_plus1(a, b):
+    """Pixel-coordinate IoU with the +1 width convention the R-CNN family
+    uses (reference bbox_util.h BboxOverlaps, normalized=false)."""
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + 1, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def _box_to_delta(ex, gt, weights=None):
+    """reference bbox_util.h BoxToDelta (normalized=false: +1 widths)."""
+    ex_w = ex[:, 2] - ex[:, 0] + 1
+    ex_h = ex[:, 3] - ex[:, 1] + 1
+    ex_cx = ex[:, 0] + 0.5 * ex_w
+    ex_cy = ex[:, 1] + 0.5 * ex_h
+    gt_w = gt[:, 2] - gt[:, 0] + 1
+    gt_h = gt[:, 3] - gt[:, 1] + 1
+    gt_cx = gt[:, 0] + 0.5 * gt_w
+    gt_cy = gt[:, 1] + 0.5 * gt_h
+    d = jnp.stack([(gt_cx - ex_cx) / ex_w, (gt_cy - ex_cy) / ex_h,
+                   jnp.log(jnp.maximum(gt_w / ex_w, 1e-10)),
+                   jnp.log(jnp.maximum(gt_h / ex_h, 1e-10))], axis=-1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)
+    return d
+
+
+def _decode_boxes(anchors, deltas, variances=None):
+    """reference generate_proposals_op.cc BoxCoder: anchors/deltas [M, 4]
+    -> proposals [M, 4] (pixel convention, dw/dh clipped)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    if variances is not None:
+        dx = variances[:, 0] * deltas[:, 0]
+        dy = variances[:, 1] * deltas[:, 1]
+        dw = variances[:, 2] * deltas[:, 2]
+        dh = variances[:, 3] * deltas[:, 3]
+    else:
+        dx, dy, dw, dh = (deltas[:, i] for i in range(4))
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(jnp.minimum(dw, _BBOX_CLIP)) * aw
+    h = jnp.exp(jnp.minimum(dh, _BBOX_CLIP)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+
+
+def _greedy_nms_mask(boxes, order_scores, nms_thresh, eta=1.0,
+                     iou_fn=_iou_plus1):
+    """Greedy suppression over boxes already sorted by descending score;
+    returns the alive mask. eta < 1 shrinks the threshold after each kept
+    box while it stays > 0.5 (reference NMS `adaptive_threshold *= eta`)."""
+    n = boxes.shape[0]
+    iou = iou_fn(boxes, boxes)
+    alive = order_scores > -jnp.inf
+
+    def body(i, carry):
+        alive, thresh = carry
+        sup = jnp.logical_and(alive[i], iou[i] > thresh)
+        sup = sup.at[i].set(False)
+        later = jnp.arange(n) > i
+        alive = jnp.where(jnp.logical_and(sup, later), False, alive)
+        thresh = jnp.where(jnp.logical_and(alive[i], thresh > 0.5),
+                           thresh * eta, thresh)
+        return alive, thresh
+
+    alive, _ = jax.lax.fori_loop(
+        0, n, body, (alive, jnp.asarray(nms_thresh, boxes.dtype)))
+    return alive
+
+
+def _first_k_mask(mask, k):
+    """Keep the first k True positions (the use_random=False reservoir)."""
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    return mask & (rank < k)
+
+
+def _compact(values, keep, fill):
+    """Stable-compact rows where keep is True to the front; pad with fill.
+    Returns (compacted values, count)."""
+    n = keep.shape[0]
+    order = jnp.argsort(jnp.where(keep, jnp.arange(n), n + jnp.arange(n)))
+    taken = jnp.take(values, order, axis=0)
+    count = jnp.sum(keep.astype(jnp.int32))
+    idx = jnp.arange(n)
+    shape = (n,) + (1,) * (taken.ndim - 1)
+    return jnp.where(idx.reshape(shape) < count, taken, fill), count
+
+
+@register_op("generate_proposals", grad=False, infer_shape=False)
+def generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    Scores [N, A, H, W], BboxDeltas [N, 4A, H, W], ImInfo [N, 3],
+    Anchors [H, W, A, 4], Variances same. Padded outputs: RpnRois
+    [N, post_nms_topN, 4], RpnRoiProbs [N, post_nms_topN, 1], RpnRoisLod
+    [N] valid counts (the reference's dispensable lod output)."""
+    scores = x_of(ins, "Scores")
+    deltas = x_of(ins, "BboxDeltas")
+    im_info = x_of(ins, "ImInfo")
+    anchors = x_of(ins, "Anchors").reshape(-1, 4)
+    variances = x_of(ins, "Variances")
+    variances = (variances.reshape(-1, 4)
+                 if variances is not None else None)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = max(float(attrs.get("min_size", 0.1)), 1.0)
+    eta = float(attrs.get("eta", 1.0))
+    N, A, H, W = scores.shape
+    M = A * H * W
+    pre_n = min(pre_n if pre_n > 0 else M, M)
+
+    def one_image(sc, dl, info):
+        # layout: [A, H, W] -> [H, W, A] flattened (kernel's Transpose)
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)          # [M]
+        d = jnp.transpose(dl.reshape(A, 4, H, W),
+                          (2, 3, 0, 1)).reshape(-1, 4)        # [M, 4]
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        props = _decode_boxes(anchors[top_i], d[top_i],
+                              None if variances is None
+                              else variances[top_i])
+        # clip to image
+        h_im, w_im, scale = info[0], info[1], info[2]
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, w_im - 1),
+            jnp.clip(props[:, 1], 0, h_im - 1),
+            jnp.clip(props[:, 2], 0, w_im - 1),
+            jnp.clip(props[:, 3], 0, h_im - 1)], axis=-1)
+        ws = (props[:, 2] - props[:, 0]) / scale + 1
+        hs = (props[:, 3] - props[:, 1]) / scale + 1
+        cx = props[:, 0] + (props[:, 2] - props[:, 0] + 1) / 2
+        cy = props[:, 1] + (props[:, 3] - props[:, 1] + 1) / 2
+        keep = ((ws >= min_size) & (hs >= min_size)
+                & (cx <= w_im) & (cy <= h_im))
+        s_kept = jnp.where(keep, top_s, -jnp.inf)
+        # keep-order compaction so NMS sees score-descending valid boxes
+        order = jnp.argsort(-s_kept)
+        props = props[order]
+        s_kept = s_kept[order]
+        alive = _greedy_nms_mask(props, s_kept, nms_thresh, eta)
+        alive = _first_k_mask(alive, post_n)
+        rois, cnt = _compact(props, alive, 0.0)
+        probs, _ = _compact(s_kept, alive, 0.0)
+        return (rois[:post_n], probs[:post_n, None],
+                jnp.minimum(cnt, post_n))
+
+    rois, probs, counts = jax.vmap(one_image)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs,
+            "RpnRoisLod": counts.astype(jnp.int32)}
+
+
+def _gt_valid_mask(ins, B, G):
+    """Valid (non-pad, non-crowd) gt mask from optional GtCount/IsCrowd."""
+    valid = jnp.ones((B, G), bool)
+    cnt = ins.get("GtCount")
+    if cnt:
+        counts = jnp.reshape(cnt[0], (-1,)).astype(jnp.int32)
+        valid = valid & (jnp.arange(G)[None, :] < counts[:, None])
+    crowd = ins.get("IsCrowd")
+    if crowd:
+        valid = valid & (jnp.reshape(crowd[0], (B, G)) == 0)
+    return valid
+
+
+@register_op("rpn_target_assign", grad=False, infer_shape=False)
+def rpn_target_assign(ctx, ins, attrs):
+    """RPN anchor labeling (reference rpn_target_assign_op.cc). Inputs:
+    Anchor [A, 4]; GtBoxes [B, G, 4] padded (+ optional GtCount [B],
+    IsCrowd [B, G]); ImInfo [B, 3]. S = rpn_batch_size_per_im. Padded
+    outputs per image: LocationIndex [B, S] (-1 pad) + LocCount [B],
+    ScoreIndex [B, S] + ScoreCount [B], TargetLabel [B, S, 1] aligned
+    with ScoreIndex, TargetBBox [B, S, 4] + BBoxInsideWeight [B, S, 4]
+    aligned with LocationIndex. use_random=False semantics (first-k)."""
+    anchors = x_of(ins, "Anchor")
+    gt = x_of(ins, "GtBoxes")
+    im_info = x_of(ins, "ImInfo")
+    S = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    A = anchors.shape[0]
+    B, G = gt.shape[0], gt.shape[1]
+    gt_valid = _gt_valid_mask(ins, B, G)
+    fg_cap = int(fg_frac * S) if fg_frac > 0 and S > 0 else A
+
+    def one_image(gt_b, valid_b, info):
+        if straddle >= 0:
+            inside = ((anchors[:, 0] >= -straddle)
+                      & (anchors[:, 1] >= -straddle)
+                      & (anchors[:, 2] < info[1] + straddle)
+                      & (anchors[:, 3] < info[0] + straddle))
+        else:
+            inside = jnp.ones((A,), bool)
+        iou = _iou_plus1(anchors, gt_b)                      # [A, G]
+        iou = jnp.where(valid_b[None, :], iou, -1.0)
+        iou = jnp.where(inside[:, None], iou, -1.0)
+        a2g_max = jnp.max(iou, axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        g2a_max = jnp.max(iou, axis=0)                       # [G]
+        is_best = jnp.any(
+            (jnp.abs(iou - g2a_max[None, :]) < 1e-5) & valid_b[None, :]
+            & (iou >= 0), axis=1)
+        elig_fg = inside & (is_best | (a2g_max >= pos_ov))
+        fg_sel = _first_k_mask(elig_fg, fg_cap)
+        n_fg_sel = jnp.sum(fg_sel.astype(jnp.int32))
+        elig_bg = inside & (a2g_max < neg_ov)
+        bg_sel = _first_k_mask(elig_bg, S - n_fg_sel)
+        fake = bg_sel & fg_sel          # demoted to bg, fake loc entry
+        real_fg = fg_sel & ~bg_sel
+        first_fg = jnp.argmax(fg_sel)   # fg_inds_fake[0]
+
+        # loc entries: fakes (index = first fg) first, then real fgs —
+        # the reference's emplace order
+        loc_idx_fake = jnp.where(fake, first_fg, -1)
+        fake_rows, n_fake = _compact(loc_idx_fake, fake, -1)
+        real_rows, n_real = _compact(jnp.arange(A), real_fg, -1)
+        loc_idx = jnp.where(
+            jnp.arange(A) < n_fake, fake_rows,
+            jnp.take(real_rows,
+                     jnp.maximum(jnp.arange(A) - n_fake, 0), axis=0))
+        n_loc = n_fake + n_real
+        loc_idx = jnp.where(jnp.arange(A) < n_loc, loc_idx, -1)[:S]
+        safe_loc = jnp.maximum(loc_idx, 0)
+        tgt_gt = jnp.take(a2g_arg, safe_loc)
+        tgt_bbox = _box_to_delta(anchors[safe_loc],
+                                 gt_b[jnp.maximum(tgt_gt, 0)])
+        live = (jnp.arange(S) < n_loc)
+        tgt_bbox = jnp.where(live[:, None], tgt_bbox, 0.0)
+        inw = jnp.where(
+            (jnp.arange(S) < n_fake)[:, None], 0.0,
+            jnp.where(live[:, None], 1.0, 0.0))
+
+        # score entries: real fgs then bgs
+        fg_rows, n_f = _compact(jnp.arange(A), real_fg, -1)
+        bg_rows, n_b = _compact(jnp.arange(A), bg_sel, -1)
+        sc_idx = jnp.where(
+            jnp.arange(A) < n_f, fg_rows,
+            jnp.take(bg_rows, jnp.maximum(jnp.arange(A) - n_f, 0),
+                     axis=0))
+        n_sc = n_f + n_b
+        sc_idx = jnp.where(jnp.arange(A) < n_sc, sc_idx, -1)[:S]
+        lbl = jnp.where(jnp.arange(S) < n_f, 1,
+                        jnp.where(jnp.arange(S) < n_sc, 0, -1))
+        return (loc_idx.astype(jnp.int32), jnp.minimum(n_loc, S),
+                sc_idx.astype(jnp.int32), jnp.minimum(n_sc, S),
+                lbl.astype(jnp.int32)[:, None], tgt_bbox, inw)
+
+    (loc, locn, sci, scn, lbl, tb, inw) = jax.vmap(one_image)(
+        gt, gt_valid, im_info)
+    return {"LocationIndex": loc, "LocCount": locn,
+            "ScoreIndex": sci, "ScoreCount": scn,
+            "TargetLabel": lbl, "TargetBBox": tb,
+            "BBoxInsideWeight": inw}
+
+
+@register_op("retinanet_target_assign", grad=False, infer_shape=False)
+def retinanet_target_assign(ctx, ins, attrs):
+    """RetinaNet anchor labeling (reference rpn_target_assign_op.cc
+    RetinanetTargetAssignOp): like rpn_target_assign but no subsampling,
+    fg label comes from GtLabels, and ForegroundNumber is emitted.
+    Outputs padded to A anchors per image."""
+    anchors = x_of(ins, "Anchor")
+    gt = x_of(ins, "GtBoxes")
+    gt_labels = x_of(ins, "GtLabels")
+    im_info = x_of(ins, "ImInfo")
+    pos_ov = float(attrs.get("positive_overlap", 0.5))
+    neg_ov = float(attrs.get("negative_overlap", 0.4))
+    A = anchors.shape[0]
+    B, G = gt.shape[0], gt.shape[1]
+    gt_valid = _gt_valid_mask(ins, B, G)
+    gt_labels = gt_labels.reshape(B, G)
+
+    def one_image(gt_b, glbl, valid_b, info):
+        iou = _iou_plus1(anchors, gt_b)
+        iou = jnp.where(valid_b[None, :], iou, -1.0)
+        a2g_max = jnp.max(iou, axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        g2a_max = jnp.max(iou, axis=0)
+        is_best = jnp.any(
+            (jnp.abs(iou - g2a_max[None, :]) < 1e-5) & valid_b[None, :]
+            & (iou >= 0), axis=1)
+        fg = is_best | (a2g_max >= pos_ov)
+        bg = ~fg & (a2g_max < neg_ov) & (a2g_max >= 0)
+        loc_idx, n_loc = _compact(jnp.arange(A), fg, -1)
+        sel = fg | bg
+        sc_idx, n_sc = _compact(jnp.arange(A), sel, -1)
+        lbl_all = jnp.where(fg, jnp.take(glbl, a2g_arg), 0)
+        lbl, _ = _compact(lbl_all, sel, -1)
+        tgt = _box_to_delta(anchors[jnp.maximum(loc_idx, 0)],
+                            gt_b[a2g_arg[jnp.maximum(loc_idx, 0)]])
+        live = (jnp.arange(A) < n_loc)[:, None]
+        return (loc_idx.astype(jnp.int32), n_loc,
+                sc_idx.astype(jnp.int32), n_sc,
+                lbl.astype(jnp.int32)[:, None],
+                jnp.where(live, tgt, 0.0),
+                jnp.where(live, 1.0, 0.0) * jnp.ones((A, 4)),
+                n_loc.astype(jnp.int32).reshape(1))
+
+    (loc, locn, sci, scn, lbl, tb, inw, fgn) = jax.vmap(one_image)(
+        gt, gt_labels, gt_valid, im_info)
+    return {"LocationIndex": loc, "LocCount": locn,
+            "ScoreIndex": sci, "ScoreCount": scn,
+            "TargetLabel": lbl, "TargetBBox": tb,
+            "BBoxInsideWeight": inw, "ForegroundNumber": fgn}
+
+
+@register_op("generate_proposal_labels", grad=False, infer_shape=False)
+def generate_proposal_labels(ctx, ins, attrs):
+    """Sample RoIs for the bbox head (reference
+    generate_proposal_labels_op.cc SampleRoisForOneImage,
+    use_random=False). Inputs: RpnRois [B, R, 4] (+ RpnRoisLod [B]),
+    GtClasses [B, G], IsCrowd [B, G], GtBoxes [B, G, 4], ImInfo [B, 3]
+    (+ GtCount [B]). S = batch_size_per_im. Outputs padded per image:
+    Rois [B, S, 4], LabelsInt32 [B, S, 1], BboxTargets [B, S, 4C],
+    BboxInsideWeights / BboxOutsideWeights [B, S, 4C], RoisNum [B]."""
+    rois_in = x_of(ins, "RpnRois")
+    gt_classes = x_of(ins, "GtClasses")
+    gt_boxes = x_of(ins, "GtBoxes")
+    im_info = x_of(ins, "ImInfo")
+    S = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = [float(w) for w in attrs.get("bbox_reg_weights",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    C = int(attrs["class_nums"])
+    B, R = rois_in.shape[0], rois_in.shape[1]
+    G = gt_boxes.shape[1]
+    gt_valid = _gt_valid_mask(ins, B, G)
+    gt_classes = gt_classes.reshape(B, G)
+    roi_cnt = ins.get("RpnRoisLod")
+    roi_valid = jnp.ones((B, R), bool)
+    if roi_cnt:
+        counts = jnp.reshape(roi_cnt[0], (-1,)).astype(jnp.int32)
+        roi_valid = jnp.arange(R)[None, :] < counts[:, None]
+    fg_cap = int(round(fg_frac * S))
+
+    def one_image(rois_b, rvalid, gt_b, gcls, gvalid, info):
+        # reference SampleRoisForOneImage: rois arrive in scaled-image
+        # coords; divide by im_scale so they match the gt boxes before
+        # appending the gts themselves as candidates
+        scale = info[2]
+        rois_b = rois_b / scale
+        cand = jnp.concatenate([rois_b, gt_b], axis=0)       # [R+G, 4]
+        cvalid = jnp.concatenate([rvalid, gvalid], axis=0)
+        iou = _iou_plus1(cand, gt_b)
+        iou = jnp.where(gvalid[None, :], iou, -1.0)
+        iou = jnp.where(cvalid[:, None], iou, -1.0)
+        max_ov = jnp.max(iou, axis=1)
+        argmax_ov = jnp.argmax(iou, axis=1)
+        fg = cvalid & (max_ov >= fg_thresh)
+        fg_sel = _first_k_mask(fg, fg_cap)
+        n_fg = jnp.sum(fg_sel.astype(jnp.int32))
+        bg = cvalid & (max_ov < bg_hi) & (max_ov >= bg_lo)
+        bg_sel = _first_k_mask(bg, S - n_fg)
+        n_bg = jnp.sum(bg_sel.astype(jnp.int32))
+
+        n = cand.shape[0]
+        fg_rows, _ = _compact(jnp.arange(n), fg_sel, 0)
+        bg_rows, _ = _compact(jnp.arange(n), bg_sel, 0)
+        pick = jnp.where(jnp.arange(n) < n_fg, fg_rows,
+                         jnp.take(bg_rows,
+                                  jnp.maximum(jnp.arange(n) - n_fg, 0)))
+        pick = pick[:S]
+        n_tot = jnp.minimum(n_fg + n_bg, S)
+        live = jnp.arange(S) < n_tot
+        is_fg = jnp.arange(S) < n_fg
+        sel_rois = jnp.where(live[:, None], cand[pick], 0.0)
+        sel_gt = argmax_ov[pick]
+        labels = jnp.where(is_fg, jnp.take(gcls, sel_gt), 0)
+        labels = jnp.where(live, labels, -1)
+        deltas = _box_to_delta(sel_rois, gt_b[sel_gt], weights)
+        # per-class expansion: write deltas into the label's 4-col slot
+        cls = jnp.maximum(labels, 0)
+        onehot = jax.nn.one_hot(cls, C, dtype=deltas.dtype)  # [S, C]
+        wmask = onehot[:, :, None] * is_fg[:, None, None]    # [S, C, 1]
+        tgt = (wmask * deltas[:, None, :]).reshape(S, 4 * C)
+        inw = jnp.broadcast_to(wmask, (S, C, 4)).reshape(S, 4 * C)
+        return (sel_rois, labels.astype(jnp.int32)[:, None],
+                tgt, inw, inw, n_tot.astype(jnp.int32))
+
+    (rois, lbl, tgt, inw, outw, num) = jax.vmap(one_image)(
+        rois_in, roi_valid, gt_boxes, gt_classes, gt_valid, im_info)
+    return {"Rois": rois, "LabelsInt32": lbl, "BboxTargets": tgt,
+            "BboxInsideWeights": inw, "BboxOutsideWeights": outw,
+            "RoisNum": num}
+
+
+@register_op("generate_mask_labels", grad=False, infer_shape=False)
+def generate_mask_labels(ctx, ins, attrs):
+    """Mask head targets (reference generate_mask_labels_op.cc). Inputs:
+    Rois [B, S, 4] + LabelsInt32 [B, S, 1] (the generate_proposal_labels
+    outputs), GtClasses [B, G], GtSegms [B, G, P, 2] polygon vertices
+    (+ GtSegmLens [B, G] valid vertex counts, GtCount [B]), ImInfo.
+    M = resolution. Outputs: MaskRois [B, S, 4], RoiHasMaskInt32
+    [B, S, 1], MaskInt32 [B, S, C*M*M] (-1 outside the roi's class
+    slot, Detectron convention), MaskNum [B].
+
+    Divergence (documented): the reference rasterizes COCO polygons via
+    its own polygon utils on the host; here each gt carries ONE polygon
+    rasterized on-device by an even-odd point-in-polygon test over the
+    M x M grid of roi-local pixel centers."""
+    rois = x_of(ins, "Rois")
+    labels = x_of(ins, "LabelsInt32")
+    gt_segms = x_of(ins, "GtSegms")
+    M = int(attrs["resolution"])
+    C = int(attrs["num_classes"])
+    B, S = rois.shape[0], rois.shape[1]
+    G, P = gt_segms.shape[1], gt_segms.shape[2]
+    labels = labels.reshape(B, S)
+    seg_lens = ins.get("GtSegmLens")
+    if seg_lens:
+        seg_len = jnp.reshape(seg_lens[0], (B, G)).astype(jnp.int32)
+    else:
+        seg_len = jnp.full((B, G), P, jnp.int32)
+    gt_classes = x_of(ins, "GtClasses").reshape(B, G)
+    gt_valid = _gt_valid_mask(ins, B, G)
+
+    def poly_bbox(poly, n_pts):
+        big = 1e30
+        msk = jnp.arange(P) < n_pts
+        xs = jnp.where(msk, poly[:, 0], big)
+        ys = jnp.where(msk, poly[:, 1], big)
+        x0, y0 = jnp.min(xs), jnp.min(ys)
+        xs = jnp.where(msk, poly[:, 0], -big)
+        ys = jnp.where(msk, poly[:, 1], -big)
+        return jnp.stack([x0, y0, jnp.max(xs), jnp.max(ys)])
+
+    def rasterize(poly, n_pts, roi):
+        # pixel centers of the M x M grid inside the roi
+        x0, y0, x1, y1 = roi[0], roi[1], roi[2], roi[3]
+        w = jnp.maximum(x1 - x0, 1e-3)
+        h = jnp.maximum(y1 - y0, 1e-3)
+        gx = x0 + (jnp.arange(M) + 0.5) / M * w
+        gy = y0 + (jnp.arange(M) + 0.5) / M * h
+        px, py = jnp.meshgrid(gx, gy)                       # [M, M]
+        # even-odd rule over the polygon's valid edges
+        idx = jnp.arange(P)
+        nxt = jnp.where(idx + 1 < n_pts, idx + 1, 0)
+        xi, yi = poly[:, 0], poly[:, 1]
+        xj, yj = poly[nxt, 0], poly[nxt, 1]
+        valid_e = idx < n_pts
+        yi_ = yi[:, None, None]
+        yj_ = yj[:, None, None]
+        xi_ = xi[:, None, None]
+        xj_ = xj[:, None, None]
+        cond = (yi_ > py[None]) != (yj_ > py[None])
+        xcross = xi_ + (py[None] - yi_) / jnp.where(
+            jnp.abs(yj_ - yi_) < 1e-12, 1e-12, yj_ - yi_) * (xj_ - xi_)
+        hit = cond & (px[None] < xcross) & valid_e[:, None, None]
+        return (jnp.sum(hit.astype(jnp.int32), axis=0) % 2) == 1
+
+    def one_image(rois_b, lbl_b, segs, slens, gcls, gvalid):
+        gt_bb = jax.vmap(poly_bbox)(segs, slens)            # [G, 4]
+        has = lbl_b > 0
+
+        def one_roi(roi, lab):
+            iou = _iou_plus1(roi[None, :], gt_bb)[0]
+            iou = jnp.where(gvalid, iou, -1.0)
+            g = jnp.argmax(iou)
+            mask = rasterize(segs[g], slens[g], roi)        # [M, M]
+            cls_slot = jax.nn.one_hot(jnp.maximum(lab, 0), C,
+                                      dtype=jnp.int32)
+            flat = mask.astype(jnp.int32).reshape(-1)       # [M*M]
+            out = jnp.where(cls_slot[:, None] > 0, flat[None, :], -1)
+            return out.reshape(-1)                          # [C*M*M]
+
+        masks = jax.vmap(one_roi)(rois_b, lbl_b)
+        masks = jnp.where(has[:, None], masks, -1)
+        return (rois_b, has.astype(jnp.int32)[:, None], masks,
+                jnp.sum(has.astype(jnp.int32)))
+
+    mr, hm, mi, num = jax.vmap(one_image)(
+        rois, labels, gt_segms, seg_len, gt_classes, gt_valid)
+    return {"MaskRois": mr, "RoiHasMaskInt32": hm, "MaskInt32": mi,
+            "MaskNum": num}
+
+
+@register_op("distribute_fpn_proposals", grad=False, infer_shape=False)
+def distribute_fpn_proposals(ctx, ins, attrs):
+    """Route RoIs to FPN levels (reference
+    distribute_fpn_proposals_op.h): level = floor(log2(sqrt(area) /
+    refer_scale + 1e-6)) + refer_level, clipped. FpnRois [B, R, 4]
+    (+ RoisNum [B]) -> per level: MultiFpnRois[l] [B, R, 4] padded +
+    MultiLevelRoisNum[l] [B]; RestoreIndex [B, R, 1] maps each
+    original roi to its (level-major) position."""
+    rois = x_of(ins, "FpnRois")
+    min_l = int(attrs["min_level"])
+    max_l = int(attrs["max_level"])
+    refer_l = int(attrs["refer_level"])
+    refer_s = int(attrs["refer_scale"])
+    n_level = max_l - min_l + 1
+    B, R = rois.shape[0], rois.shape[1]
+    cnt = ins.get("RoisNum")
+    valid = jnp.ones((B, R), bool)
+    if cnt:
+        counts = jnp.reshape(cnt[0], (-1,)).astype(jnp.int32)
+        valid = jnp.arange(R)[None, :] < counts[:, None]
+
+    def one_image(rois_b, valid_b):
+        w = rois_b[:, 2] - rois_b[:, 0]
+        h = rois_b[:, 3] - rois_b[:, 1]
+        bad = (w < 0) | (h < 0)
+        area = jnp.where(bad, 0.0, (w + 1) * (h + 1))
+        scale = jnp.sqrt(area)
+        lvl = jnp.floor(jnp.log2(scale / refer_s + 1e-6)) + refer_l
+        lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+        lvl = jnp.where(valid_b, lvl, max_l + 1)            # pad -> none
+        outs, counts, pos_in_level = [], [], []
+        base = jnp.zeros((), jnp.int32)
+        for li, level in enumerate(range(min_l, max_l + 1)):
+            m = lvl == level
+            o, c = _compact(rois_b, m, 0.0)
+            outs.append(o)
+            counts.append(c)
+            rank = jnp.cumsum(m.astype(jnp.int32)) - 1
+            pos_in_level.append(jnp.where(m, base + rank, -1))
+            base = base + c
+        # RestoreIndex[orig] = the roi's position in the level-major
+        # concatenation (reference: restore_index_data[orig] = concat pos)
+        pos = jnp.stack(pos_in_level).max(axis=0)           # [R]
+        return outs, counts, pos.astype(jnp.int32)[:, None]
+
+    outs, counts, restore = jax.vmap(one_image)(rois, valid)
+    return {"RestoreIndex": restore,
+            "MultiFpnRois": list(outs),
+            "MultiLevelRoisNum": [c.astype(jnp.int32) for c in counts]}
+
+
+@register_op("collect_fpn_proposals", grad=False, infer_shape=False)
+def collect_fpn_proposals(ctx, ins, attrs):
+    """Merge per-level RoIs back, keeping the top post_nms_topN by score
+    (reference collect_fpn_proposals_op.h). Inputs MultiLevelRois
+    (multi-slot) [B, Rl, 4] and MultiLevelScores [B, Rl] (+ optional
+    per-level counts MultiLevelRoisNum). Output FpnRois [B, topN, 4] +
+    RoisNum [B]. Divergence: the reference applies one global topN over
+    the whole batch; the padded form keeps topN PER IMAGE."""
+    rois_list = [jnp.asarray(v) for v in ins["MultiLevelRois"]]
+    score_list = [jnp.asarray(v) for v in ins["MultiLevelScores"]]
+    topn = int(attrs.get("post_nms_topN", 100))
+    B = rois_list[0].shape[0]
+    cnts = ins.get("MultiLevelRoisNum")
+    valids = []
+    for li, r in enumerate(rois_list):
+        R = r.shape[1]
+        if cnts:
+            c = jnp.reshape(cnts[li], (-1,)).astype(jnp.int32)
+            valids.append(jnp.arange(R)[None, :] < c[:, None])
+        else:
+            valids.append(jnp.ones((B, R), bool))
+    rois = jnp.concatenate(rois_list, axis=1)
+    scores = jnp.concatenate(
+        [s.reshape(B, -1) for s in score_list], axis=1)
+    valid = jnp.concatenate(valids, axis=1)
+    scores = jnp.where(valid, scores, -jnp.inf)
+    k = min(topn, scores.shape[1])
+    top_s, top_i = jax.lax.top_k(scores, k)
+    sel = jnp.take_along_axis(rois, top_i[:, :, None], axis=1)
+    n_valid = jnp.sum((top_s > -jnp.inf).astype(jnp.int32), axis=1)
+    live = jnp.arange(k)[None, :] < n_valid[:, None]
+    return {"FpnRois": jnp.where(live[:, :, None], sel, 0.0),
+            "RoisNum": n_valid}
+
+
+@register_op("box_decoder_and_assign", grad=False, infer_shape=False)
+def box_decoder_and_assign(ctx, ins, attrs):
+    """reference box_decoder_and_assign_op.h: decode per-class deltas
+    against prior boxes, then pick each roi's best non-background class
+    box. PriorBox [M, 4], PriorBoxVar [4], TargetBox [M, 4C],
+    BoxScore [M, C] -> DecodeBox [M, 4C], OutputAssignBox [M, 4]."""
+    prior = x_of(ins, "PriorBox")
+    pvar = jnp.reshape(x_of(ins, "PriorBoxVar"), (-1,))[:4]
+    tbox = x_of(ins, "TargetBox")
+    score = x_of(ins, "BoxScore")
+    clip = float(attrs.get("box_clip", _BBOX_CLIP))
+    M, C = score.shape
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    d = tbox.reshape(M, C, 4)
+    dw = jnp.minimum(pvar[2] * d[:, :, 2], clip)
+    dh = jnp.minimum(pvar[3] * d[:, :, 3], clip)
+    cx = pvar[0] * d[:, :, 0] * pw[:, None] + pcx[:, None]
+    cy = pvar[1] * d[:, :, 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+    # best non-background class (j > 0)
+    sc = score.at[:, 0].set(-jnp.inf) if C > 0 else score
+    best = jnp.argmax(sc, axis=1)
+    has_fg = jnp.max(sc, axis=1) > -jnp.inf
+    assign = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    assign = jnp.where(has_fg[:, None] & (best > 0)[:, None],
+                       assign, prior[:, :4])
+    return {"DecodeBox": decoded.reshape(M, C * 4),
+            "OutputAssignBox": assign}
+
+
+@register_op("retinanet_detection_output", grad=False, infer_shape=False)
+def retinanet_detection_output(ctx, ins, attrs):
+    """reference retinanet_detection_output_op.cc: per FPN level decode +
+    threshold + top-k, merge levels, per-class NMS. Multi-slot inputs:
+    BBoxes[l] [B, Al, 4] deltas, Scores[l] [B, Al, C], Anchors[l]
+    [Al, 4]; ImInfo [B, 3]. Out [B, keep_top_k, 6] padded
+    (class, score, box) + NmsRoisNum [B]."""
+    bbox_list = [jnp.asarray(v) for v in ins["BBoxes"]]
+    score_list = [jnp.asarray(v) for v in ins["Scores"]]
+    anchor_list = [jnp.asarray(v) for v in ins["Anchors"]]
+    im_info = x_of(ins, "ImInfo")
+    score_thresh = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 100))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    B = bbox_list[0].shape[0]
+    C = score_list[0].shape[-1]
+
+    def one_image(args):
+        deltas, scores, info = args
+        lvl_boxes, lvl_scores = [], []
+        for dl, sc, an in zip(deltas, scores, anchor_list):
+            k = min(nms_top_k, sc.shape[0] * C)
+            top_s, top_i = jax.lax.top_k(sc.reshape(-1), k)
+            a_idx = top_i // C
+            c_idx = top_i % C
+            boxes = _decode_boxes(an[a_idx], dl[a_idx])
+            boxes = jnp.stack([
+                jnp.clip(boxes[:, 0], 0, info[1] / info[2] - 1),
+                jnp.clip(boxes[:, 1], 0, info[0] / info[2] - 1),
+                jnp.clip(boxes[:, 2], 0, info[1] / info[2] - 1),
+                jnp.clip(boxes[:, 3], 0, info[0] / info[2] - 1)],
+                axis=-1)
+            keep = top_s > score_thresh
+            lvl_boxes.append(jnp.where(keep[:, None], boxes, 0.0))
+            lvl_scores.append(
+                jnp.stack([jnp.where(keep, top_s, -jnp.inf),
+                           c_idx.astype(jnp.float32)], axis=-1))
+        allb = jnp.concatenate(lvl_boxes, axis=0)
+        alls = jnp.concatenate(lvl_scores, axis=0)
+        # per-class greedy NMS over the merged set
+        n = allb.shape[0]
+        order = jnp.argsort(-alls[:, 0])
+        allb, alls = allb[order], alls[order]
+        iou = _iou_plus1(allb, allb)
+        same_cls = alls[:, 1][None, :] == alls[:, 1][:, None]
+        alive = alls[:, 0] > -jnp.inf
+
+        def body(i, alive):
+            sup = alive[i] & (iou[i] > nms_thresh) & same_cls[i]
+            sup = sup.at[i].set(False)
+            later = jnp.arange(n) > i
+            return jnp.where(sup & later, False, alive)
+
+        alive = jax.lax.fori_loop(0, n, body, alive)
+        k = min(keep_top_k, n)
+        fin_s = jnp.where(alive, alls[:, 0], -jnp.inf)
+        top_s, top_i = jax.lax.top_k(fin_s, k)
+        valid = top_s > -jnp.inf
+        rows = jnp.concatenate([
+            jnp.where(valid, alls[top_i, 1], -1.0)[:, None],
+            jnp.where(valid, top_s, 0.0)[:, None],
+            jnp.where(valid[:, None], allb[top_i], 0.0)], axis=1)
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    def wrapped(deltas_tuple, scores_tuple, info):
+        return one_image((list(deltas_tuple), list(scores_tuple), info))
+
+    rows, counts = jax.vmap(wrapped)(
+        tuple(bbox_list), tuple(score_list), im_info)
+    return {"Out": rows, "NmsRoisNum": counts}

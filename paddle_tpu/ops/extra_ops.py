@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import OPS, register_op
-from .common import x_of
+from .common import roi_batch_indices, x_of
 
 
 def _alias(new, old):
@@ -274,16 +274,7 @@ def roi_pool(ctx, ins, attrs):
     scale = float(attrs.get("spatial_scale", 1.0))
     N, C, H, W = x.shape
     R = rois.shape[0]
-    if ins.get("RoisBatch"):
-        batch_idx = jnp.reshape(ins["RoisBatch"][0],
-                                (-1,)).astype(jnp.int32)
-    elif ins.get("RoisNum"):
-        counts = jnp.reshape(ins["RoisNum"][0], (-1,)).astype(jnp.int32)
-        batch_idx = jnp.searchsorted(jnp.cumsum(counts),
-                                     jnp.arange(R, dtype=jnp.int32),
-                                     side="right").astype(jnp.int32)
-    else:
-        batch_idx = jnp.zeros((R,), jnp.int32)
+    batch_idx = roi_batch_indices(ins, R)
 
     def one(roi, bi):
         x1, y1, x2, y2 = jnp.round(roi * scale).astype(jnp.int32)

@@ -240,10 +240,18 @@ def hsigmoid(ctx, ins, attrs):
 @register_op("bipartite_match", grad=False, infer_shape=False)
 def bipartite_match(ctx, ins, attrs):
     """reference detection/bipartite_match_op.cc (greedy max matching):
-    DistMat [B, N, M] (N gt rows, M priors); repeatedly take the global
-    argmax, bind that (row, col), mask both out. Outputs
+    DistMat [B, N, M] (N gt rows, M priors; a 2-D [N, M] input is one
+    image); repeatedly take the global argmax, bind that (row, col),
+    mask both out. match_type='per_prediction' additionally matches any
+    still-unmatched column to its argmax row when that distance >=
+    dist_threshold (reference ArgMaxMatch). Outputs
     ColToRowMatchIndices [B, M] (-1 unmatched) and the matched distance."""
     dist = x_of(ins, "DistMat")
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    per_pred = attrs.get("match_type") == "per_prediction"
+    thresh = float(attrs.get("dist_threshold", 0.5))
     B, N, M = dist.shape
     steps = min(N, M)
 
@@ -265,6 +273,14 @@ def bipartite_match(ctx, ins, attrs):
         return match, mdist
 
     match, mdist = jax.vmap(one)(dist.astype(jnp.float32))
+    if per_pred:
+        best = jnp.argmax(dist, axis=1).astype(jnp.int32)   # [B, M]
+        best_d = jnp.max(dist, axis=1)
+        extra = (match == -1) & (best_d >= thresh)
+        match = jnp.where(extra, best, match)
+        mdist = jnp.where(extra, best_d.astype(mdist.dtype), mdist)
+    if squeeze:
+        match, mdist = match[0], mdist[0]
     return {"ColToRowMatchIndices": match, "ColToRowMatchDist": mdist}
 
 

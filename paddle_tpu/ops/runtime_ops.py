@@ -1,0 +1,158 @@
+"""Runtime/bridge ops: TensorArray<->tensor bridges, SelectedRows
+splitting, gradient-buffer coalescing, mkldnn-class int8 scale ops, the
+fused in-place ABN, and run_program (the dygraph->static execution
+bridge). Reference: lod_tensor_to_array_op.cc, array_to_lod_tensor_op.cc,
+split_selected_rows_op.h, split_byref_op.h, coalesce_tensor_op.cc,
+quantize_op.cc / dequantize_op.cc / requantize_op.cc, inplace_abn_op.cc,
+run_program_op.h."""
+import jax.numpy as jnp
+
+from ..framework.registry import OPS, register_op
+from .common import x_of
+
+
+@register_op("lod_tensor_to_array", grad=False, infer_shape=False)
+def lod_tensor_to_array(ctx, ins, attrs):
+    """reference lod_tensor_to_array_op.cc: split X into a TensorArray.
+    The reference splits by a rank table (dynamic-RNN machinery that the
+    recurrent op subsumes here); the padded bridge splits axis 0 into T
+    single-step entries stored in the env-backed array."""
+    x = x_of(ins)
+    name = attrs["array_name"]
+    ctx.env[name] = [x[i] for i in range(x.shape[0])]
+    return None
+
+
+@register_op("array_to_lod_tensor", grad=False, infer_shape=False)
+def array_to_lod_tensor(ctx, ins, attrs):
+    """reference array_to_lod_tensor_op.cc: stack the TensorArray back
+    into one tensor along axis 0."""
+    arr = ctx.env[attrs["array_name"]]
+    return {"Out": jnp.stack(arr, axis=0)}
+
+
+@register_op("split_selected_rows", grad=False, infer_shape=False)
+def split_selected_rows(ctx, ins, attrs):
+    """reference split_selected_rows_op.h: route rows to per-section
+    outputs by global row id (height_sections give each shard's height).
+    Padded: every output keeps the input's [N] slots; out-of-section
+    slots get row id -1 and zero values."""
+    from ..framework.selected_rows import SelectedRows, is_selected_rows
+    x = ins["X"][0]
+    if not is_selected_rows(x):
+        raise ValueError("split_selected_rows expects a SelectedRows input")
+    sections = [int(s) for s in attrs["height_sections"]]
+    outs = []
+    lo = 0
+    for h in sections:
+        hi = lo + h
+        keep = (x.rows >= lo) & (x.rows < hi)
+        rows = jnp.where(keep, x.rows - lo, -1)
+        vals = jnp.where(
+            keep.reshape((-1,) + (1,) * (x.values.ndim - 1)),
+            x.values, 0)
+        outs.append(SelectedRows(rows=rows.astype(jnp.int32),
+                                 values=vals))
+        lo = hi
+    return {"Out": outs}
+
+
+@register_op("split_byref", grad=False, infer_shape=False)
+def split_byref(ctx, ins, attrs):
+    """reference split_byref_op.h: split axis 0 by sections (the PS
+    transpiler's zero-copy split; a real split here — XLA owns memory)."""
+    x = x_of(ins)
+    sections = attrs.get("sections")
+    if sections:
+        sizes = [int(s) for s in sections]
+    else:
+        n = int(attrs.get("num", 1))
+        sizes = [x.shape[0] // n] * n
+    outs, off = [], 0
+    for s in sizes:
+        outs.append(x[off:off + s])
+        off += s
+    return {"Out": outs}
+
+
+@register_op("coalesce_tensor", grad=False, infer_shape=False)
+def coalesce_tensor(ctx, ins, attrs):
+    """reference coalesce_tensor_op.cc: pack a var list into one
+    contiguous buffer (gradient-fusion machinery). XLA owns layout, so
+    FusedOutput is a real concat of the flattened inputs and Output
+    passes the inputs through (set_constant fills both)."""
+    xs = [jnp.asarray(v) for v in ins["Input"]]
+    if bool(attrs.get("set_constant", False)):
+        c = float(attrs.get("constant", 0.0))
+        xs = [jnp.full_like(v, c) for v in xs]
+    fused = jnp.concatenate([v.reshape(-1) for v in xs])
+    return {"Output": xs, "FusedOutput": fused}
+
+
+@register_op("quantize", grad=False, infer_shape=False)
+def quantize(ctx, ins, attrs):
+    """reference quantize_op.cc (mkldnn int8 entry): out = round(x *
+    Scale), saturated to int8 (uint8 when is_negative_input=False)."""
+    x = x_of(ins, "Input")
+    scale = float(attrs.get("Scale", 1.0))
+    signed = bool(attrs.get("is_negative_input", True))
+    y = jnp.round(x * scale)
+    if signed:
+        return {"Output": jnp.clip(y, -128, 127).astype(jnp.int8)}
+    return {"Output": jnp.clip(y, 0, 255).astype(jnp.uint8)}
+
+
+@register_op("dequantize", grad=False, infer_shape=False)
+def dequantize(ctx, ins, attrs):
+    """reference dequantize_op.cc: out = x / Scale as float32."""
+    x = x_of(ins, "Input")
+    scale = float(attrs.get("Scale", 1.0))
+    return {"Output": x.astype(jnp.float32) / scale}
+
+
+@register_op("requantize", grad=False, infer_shape=False)
+def requantize(ctx, ins, attrs):
+    """reference requantize_op.cc: rescale int8 by Scale_out/Scale_in."""
+    x = x_of(ins, "Input")
+    s_in = float(attrs.get("Scale_in", 1.0))
+    s_out = float(attrs.get("Scale_out", 1.0))
+    y = jnp.round(x.astype(jnp.float32) * (s_out / s_in))
+    return {"Output": jnp.clip(y, -128, 127).astype(jnp.int8)}
+
+
+@register_op("inplace_abn", infer_shape=False)
+def inplace_abn(ctx, ins, attrs):
+    """reference inplace_abn_op.cc: batch_norm fused with its activation
+    (identity/leaky_relu/elu). In-place-ness is XLA's concern (buffer
+    donation); numerically it is batch_norm + activation."""
+    out = OPS["batch_norm"].lower(ctx, ins, attrs)
+    act = attrs.get("activation", "identity")
+    y = out["Y"]
+    if act == "leaky_relu":
+        alpha = float(attrs.get("alpha", 0.01))
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        alpha = float(attrs.get("alpha", 1.0))
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif act not in ("identity", ""):
+        raise NotImplementedError(f"inplace_abn activation {act!r}")
+    out["Y"] = y
+    return out
+
+
+@register_op("run_program", grad=False, infer_shape=False)
+def run_program(ctx, ins, attrs):
+    """reference run_program_op.h (the @declarative/dygraph->static
+    bridge): execute a sub-block against the current env. Inputs X bind
+    to attrs['x_names']; Params are already in the env by name; outputs
+    listed in attrs['out_names'] come back in order."""
+    sub = attrs["sub_block"]
+    x_names = list(attrs.get("x_names", []))
+    out_names = list(attrs.get("out_names", []))
+    env = dict(ctx.env)
+    env.update(dict(zip(x_names, ins.get("X", []))))
+    for name, v in zip(attrs.get("param_names", []),
+                       ins.get("Params", [])):
+        env[name] = v
+    ctx.lower_block_ops(sub, env)
+    return {"Out": [env[n] for n in out_names]}

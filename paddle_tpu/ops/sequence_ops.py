@@ -355,3 +355,45 @@ def sequence_reshape(ctx, ins, attrs):
     out = x.reshape(B, (T * D) // new_dim, new_dim)
     new_len = (lengths * D) // new_dim
     return {"Out": out, "OutLength": new_len}
+
+
+@register_op("sequence_topk_avg_pooling", infer_shape=False)
+def sequence_topk_avg_pooling(ctx, ins, attrs):
+    """reference sequence_ops/sequence_topk_avg_pooling_op.h (text
+    matching): X is a per-pair match-matrix stack; for every (row,
+    channel) take the top-k column values and emit the running-average
+    at each k in `topks`. Padded form: X [B, C, R, Cmax] with ROW [B] /
+    COLUMN [B] valid sizes. Out [B, R, C * len(topks)] (reference row
+    layout: channel-major per row), pos [B, R, C, max_k] top indices
+    (-1 where fewer than k valid columns)."""
+    x = x_of(ins)
+    rows = jnp.reshape(x_of(ins, "ROW"), (-1,)).astype(jnp.int32)
+    cols = jnp.reshape(x_of(ins, "COLUMN"), (-1,)).astype(jnp.int32)
+    topks = [int(k) for k in attrs["topks"]]
+    max_k = topks[-1]
+    B, C, R, Cm = x.shape
+
+    def one(xb, nrow, ncol):
+        valid_c = jnp.arange(Cm) < ncol                  # [Cm]
+        masked = jnp.where(valid_c[None, None, :], xb, -jnp.inf)
+        top_v, top_i = jax.lax.top_k(masked, min(max_k, Cm))  # [C,R,k]
+        k_live = jnp.arange(top_v.shape[-1]) < ncol
+        pos = jnp.where(k_live[None, None, :] , top_i, -1)
+        vals = jnp.where(k_live[None, None, :], top_v, 0.0)
+        csum = jnp.cumsum(vals, axis=-1)                 # [C, R, k]
+        outs = []
+        for k in topks:
+            kk = min(k, csum.shape[-1])
+            outs.append(csum[..., kk - 1] / k)           # [C, R]
+        out = jnp.stack(outs, axis=-1)                   # [C, R, k_num]
+        out = jnp.transpose(out, (1, 0, 2)).reshape(R, -1)
+        row_live = (jnp.arange(R) < nrow)[:, None]
+        if pos.shape[-1] < max_k:
+            pos = jnp.pad(pos, ((0, 0), (0, 0),
+                                (0, max_k - pos.shape[-1])),
+                          constant_values=-1)
+        return (jnp.where(row_live, out, 0.0),
+                jnp.transpose(pos, (1, 0, 2)))           # [R, C, max_k]
+
+    out, pos = jax.vmap(one)(x, rows, cols)
+    return {"Out": out, "pos": pos.astype(jnp.int32)}

@@ -1,0 +1,428 @@
+"""Long-tail utility ops (reference top-level operators/*.cc family):
+tensor factories (linspace, randperm, diag), predicates (allclose,
+is_empty, where_index, unique_with_counts), losses (squared_l2_distance,
+modified_huber_loss), spatial pyramid pooling, proximal optimizers,
+ModelAverage accumulators, sequence-tagging chunk evaluation, and the
+beam-search decode pair's final gather.
+
+TPU design notes: ops whose reference output is dynamically sized
+(where_index, unique_with_counts) return PADDED static-shape tensors plus
+a valid count, the same scheme the sequence and NMS ops use. chunk_eval
+— a per-sequence C++ state machine in the reference
+(chunk_eval_op.h GetSegments) — is re-derived here as vectorized
+begin/end masks: a chunk begins/ends at a position purely as a function
+of the (prev, cur) / (cur, next) tag pairs, so segment matching becomes
+dense boolean algebra XLA can fuse, instead of a host loop.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import as_dtype, normalize_padding, x_of
+
+
+# --------------------------------------------------------------- factories
+
+@register_op("linspace", grad=False, infer_shape=False)
+def linspace(ctx, ins, attrs):
+    """reference linspace_op.cc: evenly spaced values in [start, stop].
+    Num must be a build-time constant on TPU (static shapes); the layer
+    wrapper folds Python ints into the `num` attr."""
+    start = jnp.reshape(x_of(ins, "Start"), ())
+    stop = jnp.reshape(x_of(ins, "Stop"), ())
+    if "num" in attrs:
+        num = int(attrs["num"])
+    else:
+        num = int(ins["Num"][0])  # concrete only outside jit
+    dtype = start.dtype
+    if num == 1:
+        return {"Out": jnp.reshape(stop, (1,)).astype(dtype)}
+    i = jnp.arange(num, dtype=jnp.float32)
+    step = (stop.astype(jnp.float32) - start.astype(jnp.float32)) / (num - 1)
+    out = start.astype(jnp.float32) + i * step
+    # reference writes stop exactly into the last slot
+    out = out.at[-1].set(stop.astype(jnp.float32))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randperm", grad=False, infer_shape=False, needs_rng=True)
+def randperm(ctx, ins, attrs):
+    """reference randperm_op.cc: random permutation of [0, n)."""
+    n = int(attrs["n"])
+    key = ctx.op_key(attrs)
+    perm = jax.random.permutation(key, n)
+    return {"Out": perm.astype(as_dtype(attrs, default="int64"))}
+
+
+@register_op("diag", grad=False, infer_shape=False)
+def diag(ctx, ins, attrs):
+    """reference diag_op.cc (v1): vector [N] -> diagonal matrix [N, N]."""
+    d = x_of(ins, "Diagonal")
+    return {"Out": jnp.diag(jnp.reshape(d, (-1,)))}
+
+
+# -------------------------------------------------------------- predicates
+
+@register_op("allclose", grad=False, infer_shape=False)
+def allclose(ctx, ins, attrs):
+    """reference allclose_op.cc: elementwise |a-b| <= atol + rtol*|b|,
+    reduced to one bool."""
+    a = x_of(ins, "Input")
+    b = x_of(ins, "Other")
+    rtol = float(attrs.get("rtol", 1e-5))
+    atol = float(attrs.get("atol", 1e-8))
+    equal_nan = bool(attrs.get("equal_nan", False))
+    close = jnp.abs(a - b) <= atol + rtol * jnp.abs(b)
+    if equal_nan:
+        close = close | (jnp.isnan(a) & jnp.isnan(b))
+    else:
+        close = close & ~(jnp.isnan(a) | jnp.isnan(b))
+    return {"Out": jnp.all(close)}
+
+
+@register_op("is_empty", grad=False, infer_shape=False)
+def is_empty(ctx, ins, attrs):
+    """reference is_empty_op.cc: numel(X) == 0 (a compile-time constant
+    here — shapes are static)."""
+    x = x_of(ins)
+    return {"Out": jnp.asarray(x.size == 0)}
+
+
+@register_op("where_index", grad=False, infer_shape=False)
+def where_index(ctx, ins, attrs):
+    """reference where_index_op.cc (`layers.where`): coordinates of
+    nonzero elements. Dynamic [num_true, rank] in the reference; here a
+    padded [numel, rank] int64 (pad rows -1) plus Count [1]."""
+    cond = x_of(ins, "Condition")
+    n = cond.size
+    idxs = jnp.nonzero(cond.reshape(-1), size=n, fill_value=-1)[0]
+    valid = idxs >= 0
+    coords = jnp.stack(
+        jnp.unravel_index(jnp.maximum(idxs, 0), cond.shape), axis=-1)
+    coords = jnp.where(valid[:, None], coords, -1)
+    return {"Out": coords.astype(jnp.int64),
+            "Count": jnp.sum(valid).astype(jnp.int64).reshape(1)}
+
+
+@register_op("unique_with_counts", grad=False, infer_shape=False)
+def unique_with_counts(ctx, ins, attrs):
+    """reference unique_with_counts_op.cc: first-occurrence-ordered unique
+    values (tf.unique semantics). Out/Count are padded to [N] (valid
+    prefix length = max(Index)+1); Index [N] maps each element to its
+    unique slot."""
+    x = jnp.reshape(x_of(ins), (-1,))
+    n = x.shape[0]
+    eq = x[None, :] == x[:, None]                      # [N, N]
+    first = jnp.argmax(eq, axis=1)                     # first j: x[j]==x[i]
+    is_first = first == jnp.arange(n)
+    rank = jnp.cumsum(is_first) - 1                    # unique slot per pos
+    index = rank[first]
+    out = jnp.zeros((n,), x.dtype).at[index].set(x)
+    counts = jnp.zeros((n,), jnp.int64).at[index].add(1)
+    itype = as_dtype(attrs, default="int32")
+    return {"Out": out, "Index": index.astype(itype),
+            "Count": counts.astype(itype)}
+
+
+# ------------------------------------------------------------------ losses
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx, ins, attrs):
+    """reference squared_l2_distance_op.h: row-wise ||x - y||^2; Y may be
+    a single row broadcast over X's rows."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    sub = x - y                                        # [B, D]
+    out = jnp.sum(sub * sub, axis=-1, keepdims=True)
+    return {"sub_result": sub, "Out": out}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ctx, ins, attrs):
+    """reference modified_huber_loss_op.h: v = (2y-1)*x with y in {0,1};
+    loss = -4v if v < -1, (1-v)^2 if -1 <= v < 1, else 0."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    v = (2.0 * y - 1.0) * x
+    loss = jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, (1.0 - v) ** 2, 0.0))
+    return {"IntermediateVal": v, "Out": loss.astype(x.dtype)}
+
+
+@register_op("spp", infer_shape=False)
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference spp_op.h): level p pools with
+    bins=2^p per dim, kernel=ceil(dim/bins), stride=kernel,
+    pad=(kernel*bins-dim+1)//2; levels flattened and concatenated to
+    [N, C * sum(4^p)]."""
+    x = x_of(ins)
+    n, c, h, w = x.shape
+    height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    outs = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        pad = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+               (pw, kw * bins - w - pw))
+        if ptype == "max":
+            xp = jnp.pad(x, pad, constant_values=-jnp.inf)
+            red = jax.lax.reduce_window(
+                xp, -jnp.inf, jax.lax.max,
+                (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+            red = jnp.where(jnp.isneginf(red), 0.0, red)
+        else:
+            # reference AvgPool divides by the FULL kernel size
+            # (exclusive=false): padded zeros count in the denominator
+            xp = jnp.pad(x, pad)
+            red = jax.lax.reduce_window(
+                xp, 0.0, jax.lax.add,
+                (1, 1, kh, kw), (1, 1, kh, kw), "VALID") / (kh * kw)
+        outs.append(red.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=-1).astype(x.dtype)}
+
+
+# ---------------------------------------------------- proximal optimizers
+
+def _prox(prox_param, lr, l1, l2):
+    if l1 > 0:
+        return (jnp.sign(prox_param)
+                * jnp.maximum(jnp.abs(prox_param) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox_param / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd", grad=False)
+def proximal_gd(ctx, ins, attrs):
+    """reference optimizers/proximal_gd_op.h."""
+    p = x_of(ins, "Param")
+    g = x_of(ins, "Grad")
+    lr = jnp.reshape(x_of(ins, "LearningRate"), ())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    return {"ParamOut": _prox(p - lr * g, lr, l1, l2).astype(p.dtype)}
+
+
+@register_op("proximal_adagrad", grad=False)
+def proximal_adagrad(ctx, ins, attrs):
+    """reference optimizers/proximal_adagrad_op.h."""
+    p = x_of(ins, "Param")
+    m = x_of(ins, "Moment")
+    g = x_of(ins, "Grad")
+    lr = jnp.reshape(x_of(ins, "LearningRate"), ())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    m_out = m + g * g
+    prox_param = p - lr * g / jnp.sqrt(m_out)
+    return {"ParamOut": _prox(prox_param, lr, l1, l2).astype(p.dtype),
+            "MomentOut": m_out.astype(m.dtype)}
+
+
+@register_op("average_accumulates", grad=False, infer_shape=False)
+def average_accumulates(ctx, ins, attrs):
+    """ModelAverage accumulator update (reference
+    average_accumulates_op.h). Scalar state rides as [1] int64 tensors;
+    the reference's host-side branches become jnp.where so the op stays
+    jittable."""
+    k_max = 16384  # kMaxNumAccumulates
+    param = x_of(ins, "param")
+    s1 = x_of(ins, "in_sum_1")
+    s2 = x_of(ins, "in_sum_2")
+    s3 = x_of(ins, "in_sum_3")
+    num_acc = jnp.reshape(x_of(ins, "in_num_accumulates"), ()).astype(
+        jnp.int64)
+    old_num = jnp.reshape(x_of(ins, "in_old_num_accumulates"), ()).astype(
+        jnp.int64)
+    num_upd = jnp.reshape(x_of(ins, "in_num_updates"), ()).astype(jnp.int64)
+    avg_win = float(attrs.get("average_window", 0.0))
+    # clamp to int32 range: jax runs x32 by default and the reference's
+    # INT64_MAX sentinel would overflow
+    max_win = min(int(attrs.get("max_average_window", 1 << 62)), 2**31 - 1)
+    min_win = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    o1 = s1 + param
+    o2 = s2
+    o3 = s3
+    spill = num_upd % k_max == 0
+    o2 = jnp.where(spill, o2 + o1, o2)
+    o1 = jnp.where(spill, jnp.zeros_like(o1), o1)
+    window = jnp.minimum(
+        jnp.asarray(max_win, jnp.int64),
+        (num_upd.astype(jnp.float32) * avg_win).astype(jnp.int64))
+    roll = (num_acc >= min_win) & (num_acc >= window)
+    o3 = jnp.where(roll, o1 + o2, o3)
+    o1 = jnp.where(roll, jnp.zeros_like(o1), o1)
+    o2 = jnp.where(roll, jnp.zeros_like(o2), o2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": o1, "out_sum_2": o2, "out_sum_3": o3,
+            "out_num_accumulates": num_acc.reshape(1),
+            "out_old_num_accumulates": old_num.reshape(1),
+            "out_num_updates": num_upd.reshape(1)}
+
+
+# ----------------------------------------------------------- tensor array
+
+@register_op("tensor_array_to_tensor", grad=False, infer_shape=False)
+def tensor_array_to_tensor(ctx, ins, attrs):
+    """reference tensor_array_to_tensor_op.cc: concat (or stack, with
+    use_stack) a LoDTensorArray along `axis`; OutIndex records each
+    entry's size along that axis."""
+    arr = ctx.env[attrs["array_name"]]
+    axis = int(attrs.get("axis", 0))
+    if bool(attrs.get("use_stack", False)):
+        out = jnp.stack(arr, axis=axis)
+        sizes = [1] * len(arr)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+        sizes = [int(a.shape[axis]) for a in arr]
+    return {"Out": out, "OutIndex": jnp.asarray(sizes, jnp.int32)}
+
+
+# ----------------------------------------------------- sequence tagging
+
+_CHUNK_SCHEMES = {
+    # scheme -> (num_tag_types, begin, inside, end, single); -1 = absent
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_masks(labels, lengths, num_types, scheme):
+    """Vectorized GetSegments (reference chunk_eval_op.h): returns
+    (begin[B,T], end[B,T], type[B,T]). A chunk is open after position i
+    iff type[i] != Other, so begin/end reduce to pairwise tag tests."""
+    n_tag, t_beg, t_in, t_end, t_sgl = _CHUNK_SCHEMES[scheme]
+    other = num_types
+    tag = labels % n_tag
+    typ = labels // n_tag
+    B, T = labels.shape
+    pos = jnp.arange(T)
+    valid = pos[None, :] < lengths[:, None]
+    typ = jnp.where(valid, typ, other)  # pad acts like Other
+
+    # prev arrays (initial state: tag=-1, type=Other)
+    ptag = jnp.concatenate(
+        [jnp.full((B, 1), -1, tag.dtype), tag[:, :-1]], axis=1)
+    ptyp = jnp.concatenate(
+        [jnp.full((B, 1), other, typ.dtype), typ[:, :-1]], axis=1)
+    # next arrays (final state: type=Other ends any open chunk)
+    ntag = jnp.concatenate(
+        [tag[:, 1:], jnp.full((B, 1), -1, tag.dtype)], axis=1)
+    ntyp = jnp.concatenate(
+        [typ[:, 1:], jnp.full((B, 1), other, typ.dtype)], axis=1)
+
+    def chunk_begin(pt, pty, t, ty):
+        in_prev = pty != other
+        cur = ty != other
+        tagged = ((t == t_beg) | (t == t_sgl)
+                  | ((t == t_in) & ((pt == t_end) | (pt == t_sgl)))
+                  | ((t == t_end) & ((pt == t_end) | (pt == t_sgl))))
+        return cur & (~in_prev | (ty != pty) | tagged)
+
+    begin = chunk_begin(ptag, ptyp, tag, typ)
+    # chunk ends at i iff one begins at i+1's "end test": symmetric —
+    # a chunk open at i ends at i iff position i+1 is not a continuation
+    def chunk_end(t, ty, nt, nty):
+        opened = ty != other
+        nxt_other = nty != ty
+        tagged = ((nt == t_beg) | (nt == t_sgl)
+                  | (t == t_end) | (t == t_sgl))
+        return opened & (nxt_other | tagged)
+
+    end = chunk_end(tag, typ, ntag, ntyp)
+    return begin & valid, end & valid, typ
+
+
+@register_op("chunk_eval", grad=False, infer_shape=False)
+def chunk_eval(ctx, ins, attrs):
+    """reference chunk_eval_op.h over padded [B, T] + SeqLength [B]
+    batches (the reference's own padding path). Matching: an inference
+    chunk is correct iff a label chunk begins at the same position with
+    the same type and ends at the same position."""
+    inference = x_of(ins, "Inference").reshape(
+        ins["Inference"][0].shape[0], -1).astype(jnp.int64)
+    label = x_of(ins, "Label").reshape(
+        ins["Label"][0].shape[0], -1).astype(jnp.int64)
+    seq_len = ins.get("SeqLength")
+    B, T = label.shape
+    if seq_len:
+        lengths = jnp.reshape(seq_len[0], (-1,)).astype(jnp.int32)
+    else:
+        lengths = jnp.full((B,), T, jnp.int32)
+    num_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = [int(e) for e in attrs.get("excluded_chunk_types", [])]
+
+    ib, ie, ityp = _chunk_masks(inference, lengths, num_types, scheme)
+    lb, le, ltyp = _chunk_masks(label, lengths, num_types, scheme)
+
+    def next_end(end):
+        # for each position, the index of the first end >= that position
+        T_ = end.shape[1]
+        idx = jnp.where(end, jnp.arange(T_)[None, :], T_ * 2)
+        # reverse cumulative minimum
+        rev = jnp.flip(idx, axis=1)
+        run = jax.lax.associative_scan(jnp.minimum, rev, axis=1)
+        return jnp.flip(run, axis=1)
+
+    i_end = next_end(ie)
+    l_end = next_end(le)
+
+    def count(begin, typ):
+        keep = begin
+        for e in excluded:
+            keep = keep & (typ != e)
+        return keep
+
+    ikeep = count(ib, ityp)
+    lkeep = count(lb, ltyp)
+    correct = (ikeep & lkeep & (ityp == ltyp) & (i_end == l_end))
+    n_inf = jnp.sum(ikeep).astype(jnp.int64)
+    n_lab = jnp.sum(lkeep).astype(jnp.int64)
+    n_cor = jnp.sum(correct).astype(jnp.int64)
+    prec = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0)
+    rec = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0)
+    f1 = jnp.where(n_cor > 0, 2 * prec * rec /
+                   jnp.maximum(prec + rec, 1e-38), 0.0)
+    return {"Precision": prec.astype(jnp.float32).reshape(1),
+            "Recall": rec.astype(jnp.float32).reshape(1),
+            "F1-Score": f1.astype(jnp.float32).reshape(1),
+            "NumInferChunks": n_inf.reshape(1),
+            "NumLabelChunks": n_lab.reshape(1),
+            "NumCorrectChunks": n_cor.reshape(1)}
+
+
+# -------------------------------------------------------- beam decode
+
+@register_op("beam_search_decode", grad=False, infer_shape=False)
+def beam_search_decode(ctx, ins, attrs):
+    """Final gather of a beam search (reference
+    beam_search_decode_op.cc). The reference walks LoD parent links over
+    TensorArrays; here the padded form takes the per-step stacks the
+    beam_search op emits — Ids/ParentIdx [T, B, beam] and Scores
+    [T, B, beam] — and backtraces to SentenceIds [B, beam, T] +
+    SentenceScores [B, beam] (the final cumulative log-prob per beam)."""
+    ids = x_of(ins, "Ids").astype(jnp.int32)
+    parents = x_of(ins, "ParentIdx").astype(jnp.int32)
+    scores = x_of(ins, "Scores")
+    T = ids.shape[0]
+
+    def step(beam_idx, t):
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        parent = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return parent, tok
+
+    init = jnp.broadcast_to(
+        jnp.arange(ids.shape[2], dtype=jnp.int32), ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    sent = jnp.flip(toks, axis=0)                      # [T, B, beam]
+    return {"SentenceIds": jnp.transpose(sent, (1, 2, 0)),
+            "SentenceScores": scores[-1]}

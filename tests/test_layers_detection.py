@@ -1,0 +1,142 @@
+"""Layer-level detection API tests (reference
+python/paddle/fluid/tests/unittests/test_layers.py detection section +
+test_ssd_loss.py, test_detection_map_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+RNG = np.random.default_rng(66)
+
+
+def _run(build, feed, fetch_n=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        res = exe.run(main, feed=feed, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_detection_output_composite():
+    N, M, C = 1, 4, 3
+    loc = RNG.standard_normal((N, M, 4)).astype(np.float32) * 0.1
+    scores = np.abs(RNG.standard_normal((N, M, C))).astype(np.float32)
+    scores /= scores.sum(-1, keepdims=True)
+    priors = np.array([[0.1, 0.1, 0.3, 0.3], [0.4, 0.4, 0.6, 0.6],
+                       [0.2, 0.2, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]],
+                      np.float32)
+    pvar = np.full((4, 4), 0.1, np.float32)
+
+    def build():
+        l_ = layers.data("loc", [N, M, 4], dtype="float32")
+        s_ = layers.data("sc", [N, M, C], dtype="float32")
+        p_ = layers.data("pb", [M, 4], dtype="float32")
+        v_ = layers.data("pv", [M, 4], dtype="float32")
+        return layers.detection_output(l_, s_, p_, v_,
+                                       score_threshold=0.01,
+                                       nms_top_k=4, keep_top_k=4)
+
+    out, = _run(build, {"loc": loc, "sc": scores, "pb": priors,
+                        "pv": pvar})
+    assert out.shape == (N, 4, 6)
+    # at least one valid detection, classes in range, scores descending
+    valid = out[0][out[0, :, 0] >= 0]
+    assert len(valid) >= 1
+    assert np.all(valid[:, 0] < C)
+    assert np.all(np.diff(valid[:, 1]) <= 1e-6)
+
+
+def test_ssd_loss_trains():
+    N, M, C, G = 2, 8, 4, 3
+    priors = RNG.random((M, 4)).astype(np.float32) * 0.4
+    priors[:, 2:] = priors[:, :2] + 0.3
+    pvar = np.full((M, 4), 0.1, np.float32)
+    gt_box = RNG.random((N, G, 4)).astype(np.float32) * 0.4
+    gt_box[:, :, 2:] = gt_box[:, :, :2] + 0.3
+    gt_label = RNG.integers(1, C, (N, G, 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = layers.data("feat", [N, 16], dtype="float32")
+        loc = layers.reshape(layers.fc(feat, M * 4), [N, M, 4])
+        conf = layers.reshape(layers.fc(feat, M * C), [N, M, C])
+        gb_ = layers.data("gtb", [N, G, 4], dtype="float32")
+        gl_ = layers.data("gtl", [N, G, 1], dtype="int64")
+        pb_ = layers.data("pb", [M, 4], dtype="float32")
+        pv_ = layers.data("pv", [M, 4], dtype="float32")
+        loss = layers.mean(layers.ssd_loss(loc, conf, gb_, gl_, pb_, pv_))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    feed = {"feat": RNG.standard_normal((N, 16)).astype(np.float32),
+            "gtb": gt_box, "gtl": gt_label, "pb": priors, "pv": pvar}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ls = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(25)]
+    assert np.isfinite(ls).all()
+    assert ls[-1] < 0.7 * ls[0], (ls[0], ls[-1])
+
+
+def test_generate_proposals_wrapper_and_fpn_roundtrip():
+    N, A, H, W = 1, 2, 3, 3
+    feed = {
+        "sc": RNG.random((N, A, H, W)).astype(np.float32),
+        "dl": (RNG.standard_normal((N, A * 4, H, W)) * 0.1).astype(
+            np.float32),
+        "ii": np.array([[64, 64, 1.0]], np.float32),
+        "an": (RNG.random((H, W, A, 4)) * 20).astype(np.float32),
+        "va": np.ones((H, W, A, 4), np.float32),
+    }
+    feed["an"][..., 2:] += 24
+
+    def build():
+        sc = layers.data("sc", [N, A, H, W], dtype="float32")
+        dl = layers.data("dl", [N, A * 4, H, W], dtype="float32")
+        ii = layers.data("ii", [N, 3], dtype="float32")
+        an = layers.data("an", [H, W, A, 4], dtype="float32")
+        va = layers.data("va", [H, W, A, 4], dtype="float32")
+        rois, probs, num = layers.generate_proposals(
+            sc, dl, ii, an, va, pre_nms_top_n=10, post_nms_top_n=5,
+            return_rois_num=True)
+        rois1 = layers.reshape(rois, [5, 4])
+        multi, restore, nums = layers.distribute_fpn_proposals(
+            layers.reshape(rois, [N, 5, 4]), 2, 5, 4, 224,
+            rois_num=num)
+        return [rois, probs, num] + multi
+
+    outs = _run(build, feed)
+    rois, probs, num = outs[0], outs[1], outs[2]
+    assert rois.shape == (1, 5, 4) and num[0] >= 1
+    # every valid roi lands on exactly one level
+    lvl_counts = sum(int((o[0] != 0).any(axis=-1).sum()) for o in outs[3:])
+    assert lvl_counts >= 1
+
+
+def test_multi_box_head_shapes():
+    N = 1
+    feed = {"img": RNG.standard_normal((N, 3, 32, 32)).astype(np.float32),
+            "f1": RNG.standard_normal((N, 8, 8, 8)).astype(np.float32),
+            "f2": RNG.standard_normal((N, 8, 4, 4)).astype(np.float32)}
+
+    def build():
+        img = layers.data("img", [N, 3, 32, 32], dtype="float32")
+        f1 = layers.data("f1", [N, 8, 8, 8], dtype="float32")
+        f2 = layers.data("f2", [N, 8, 4, 4], dtype="float32")
+        locs, confs, boxes, vars_ = layers.multi_box_head(
+            [f1, f2], img, base_size=32, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+            flip=True, clip=True)
+        return [locs, confs, boxes, vars_]
+
+    locs, confs, boxes, vars_ = _run(build, feed)
+    P = boxes.shape[0]
+    assert boxes.shape == (P, 4) and vars_.shape == (P, 4)
+    assert locs.shape == (N, P, 4)
+    assert confs.shape == (N, P, 3)
+    # priors are normalized and clipped
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0

@@ -1,0 +1,386 @@
+"""OpTests for the CTR/tree/text-matching batch and the runtime bridge
+batch (reference pattern: test_tree_conv_op.py, test_tdm_child_op.py,
+test_tdm_sampler_op.py, test_pyramid_hash_op.py,
+test_match_matrix_tensor_op.py, test_var_conv_2d.py,
+test_filter_by_instag_op.py, test_rank_attention_op.py,
+test_split_selected_rows_op.py, test_coalesce_tensor_op.py,
+test_sequence_topk_avg_pooling.py, test_lod_tensor_array_ops.py)."""
+import numpy as np
+import paddle_tpu as fluid
+
+from op_test import make_op_test as _t
+from test_ops_detection2 import _run_op
+
+RNG = np.random.default_rng(55)
+
+
+def test_tree_conv():
+    # tree: 1 -> {2, 3}, 2 -> {4}; nodes 1-indexed, features row v-1
+    N, F, out_size, nf = 5, 3, 2, 2
+    feats = RNG.standard_normal((1, N, F)).astype(np.float32)
+    edges = np.zeros((1, 6, 2), np.int32)
+    edges[0, :3] = [[1, 2], [1, 3], [2, 4]]
+    filt = RNG.standard_normal((F, 3, out_size, nf)).astype(np.float32)
+    max_depth = 2
+
+    # numpy oracle: port of tree2col.cc construct_patch + patch math
+    tr = {1: [2, 3], 2: [4], 3: [], 4: []}
+
+    def eta(depth, idx, pclen):
+        et = (max_depth - depth) / max_depth
+        temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+        el = (1 - et) * temp
+        er = (1 - et) * (1 - el)
+        return el, er, et
+
+    w2d = filt.reshape(F * 3, out_size * nf)
+    expect = np.zeros((N, out_size * nf), np.float32)
+    for root in [1, 2, 3, 4]:
+        patch = np.zeros((F, 3), np.float32)
+        # depth 0: root itself (index 1, pclen 1)
+        items = [(root, 1, 1, 0)]
+        # depth 1 (< max_depth): children with 1-based index
+        for i, v in enumerate(tr[root]):
+            items.append((v, i + 1, len(tr[root]), 1))
+        for (v, idx, pclen, depth) in items:
+            el, er, et = eta(depth, idx, pclen)
+            f = feats[0, v - 1]
+            patch[:, 0] += el * f
+            patch[:, 1] += er * f
+            patch[:, 2] += et * f
+        expect[root - 1] = patch.reshape(-1) @ w2d
+    expect = expect.reshape(1, N, out_size, nf)
+    t = _t("tree_conv",
+           {"NodesVector": ("tc_f", feats), "EdgeSet": ("tc_e", edges),
+            "Filter": ("tc_w", filt)},
+           {"max_depth": max_depth}, {"Out": expect})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_tdm_child():
+    # TreeInfo columns: item_id, layer_id, ancestor, child0, child1
+    info = np.array([
+        [0, 0, 0, 0, 0],      # node 0: null
+        [0, 0, 0, 3, 4],      # node 1: internal, children 3,4
+        [0, 0, 0, 0, 0],      # node 2: no children
+        [7, 1, 1, 0, 0],      # node 3: leaf (item 7)
+        [0, 1, 1, 5, 0],      # node 4: internal child 5
+        [9, 2, 4, 0, 0],      # node 5: leaf
+    ], np.int32)
+    x = np.array([[1], [2], [4]], np.int32)
+    child = np.array([[3, 4], [0, 0], [5, 0]], np.int32)
+    mask = np.array([[1, 0], [0, 0], [1, 0]], np.int32)
+    _t("tdm_child", {"X": x, "TreeInfo": ("ti", info)},
+       {"child_nums": 2, "dtype": "int32"},
+       {"Child": child, "LeafMask": mask}).check_output()
+
+
+def test_tdm_sampler():
+    # 2-layer tree; travel paths per item; layer node lists
+    travel = np.array([[1, 3], [2, 5]], np.int32)
+    layer = np.array([1, 2, 3, 4, 5, 6], np.int32)  # lod [0, 2, 6]
+    x = np.array([0, 1], np.int32)
+    outs = _run_op(
+        "tdm_sampler",
+        {"X": [("ts_x", x)], "Travel": [("ts_t", travel)],
+         "Layer": [("ts_l", layer)]},
+        {"neg_samples_num_list": [1, 2], "layer_offset_lod": [0, 2, 6],
+         "output_positive": True, "dtype": "int32", "seed": 3},
+        {"Out": ((2, 5), "int32"), "Labels": ((2, 5), "int32"),
+         "Mask": ((2, 5), "int32")})
+    out, labels, mask = outs
+    np.testing.assert_array_equal(labels,
+                                  [[1, 0, 1, 0, 0], [1, 0, 1, 0, 0]])
+    np.testing.assert_array_equal(mask, 1)
+    # positives in the right slots, negatives from the right layer
+    assert out[0, 0] == 1 and out[1, 0] == 2
+    assert out[0, 2] == 3 and out[1, 2] == 5
+    assert out[0, 1] in (1, 2) and out[0, 1] != 1 or out[0, 1] == 2
+    for v in out[0, 3:]:
+        assert v in (4, 5, 6) and v != 3
+    for v in out[1, 3:]:
+        assert v in (3, 4, 6)
+
+
+def test_pyramid_hash():
+    B, T = 2, 5
+    x = RNG.integers(1, 50, (B, T)).astype(np.int32)
+    lens = np.array([5, 3], np.int32)
+    space, rand_len = 64, 8
+    w = RNG.standard_normal((space + rand_len,)).astype(np.float32)
+    outs = _run_op(
+        "pyramid_hash",
+        {"X": [("ph_x", x)], "W": [("ph_w", w)],
+         "Length": [("ph_l", lens)]},
+        {"num_hash": 2, "rand_len": rand_len, "max_pyramid": 2},
+        {"Out": ((B, rand_len), "float32")})
+    out = outs[0]
+
+    def poly_hash(ids, salt):
+        acc = np.uint32(2166136261 + 1013904223 * salt)
+        for j in ids:
+            acc = np.uint32(acc * np.uint32(16777619)) ^ np.uint32(j)
+        return int(acc % np.uint32(space))
+
+    expect = np.zeros((B, rand_len), np.float32)
+    for b in range(B):
+        for n in (2, 3):
+            for i in range(T - n + 1):
+                if i + n > lens[b]:
+                    continue
+                emb = np.zeros(rand_len, np.float32)
+                for s in range(2):
+                    h = poly_hash(x[b, i:i + n], s)
+                    emb += w[h:h + rand_len]
+                expect[b] += emb / 2
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_match_matrix_tensor():
+    B, Lx, Ly, D, T = 2, 3, 4, 5, 2
+    x = RNG.standard_normal((B, Lx, D)).astype(np.float32)
+    y = RNG.standard_normal((B, Ly, D)).astype(np.float32)
+    w = RNG.standard_normal((D, T, D)).astype(np.float32)
+    xl = np.array([3, 2], np.int32)
+    yl = np.array([4, 2], np.int32)
+    out = np.einsum("bxd,dte,bye->btxy", x, w, y)
+    for b in range(B):
+        out[b, :, xl[b]:, :] = 0
+        out[b, :, :, yl[b]:] = 0
+    tmp = np.einsum("bxd,dte->bxte", x, w)
+    t = _t("match_matrix_tensor",
+           {"X": ("mm_x", x), "Y": ("mm_y", y), "W": ("mm_w", w),
+            "XLength": ("mm_xl", xl), "YLength": ("mm_yl", yl)},
+           {"dim_t": T},
+           {"Out": out.astype(np.float32), "Tmp": tmp.astype(np.float32)})
+    t.check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_var_conv_2d():
+    B, C, H, W = 2, 2, 6, 6
+    out_c, kh, kw = 3, 3, 3
+    x = RNG.standard_normal((B, C, H, W)).astype(np.float32)
+    w = RNG.standard_normal((out_c, C * kh * kw)).astype(np.float32)
+    rows = np.array([6, 4], np.int32)
+    cols = np.array([6, 3], np.int32)
+    outs = _run_op(
+        "var_conv_2d",
+        {"X": [("vc_x", x)], "W": [("vc_w", w)],
+         "ROW": [("vc_r", rows)], "COLUMN": [("vc_c", cols)]},
+        {"InputChannel": C, "OutputChannel": out_c, "KernelH": kh,
+         "KernelW": kw, "StrideH": 1, "StrideW": 1},
+        {"Out": ((B, out_c, H, W), "float32"), "Col": ((1,), "float32")})
+    out = outs[0]
+    # numpy SAME conv on the masked input
+    filt = w.reshape(out_c, C, kh, kw)
+    for b in range(B):
+        xm = x[b].copy()
+        xm[:, rows[b]:, :] = 0
+        xm[:, :, cols[b]:] = 0
+        pad = np.pad(xm, ((0, 0), (1, 1), (1, 1)))
+        for o in range(out_c):
+            for i in range(rows[b]):
+                for j in range(cols[b]):
+                    ref = np.sum(pad[:, i:i + kh, j:j + kw] * filt[o])
+                    np.testing.assert_allclose(out[b, o, i, j], ref,
+                                               rtol=1e-4, atol=1e-4)
+        assert np.all(out[b, :, rows[b]:, :] == 0)
+        assert np.all(out[b, :, :, cols[b]:] == 0)
+
+
+def test_filter_by_instag():
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, -1]], np.int64)
+    filt = np.array([3], np.int64)
+    outs = _run_op(
+        "filter_by_instag",
+        {"Ins": [("fi_r", rows)], "Ins_tag": [("fi_t", tags)],
+         "Filter_tag": [("fi_f", filt)]},
+        {"is_lod": True},
+        {"Out": ((4, 3), "float32"), "LossWeight": ((4, 1), "float32"),
+         "IndexMap": ((4, 2), "int32"), "OutCount": ((1,), "int32")})
+    out, lw, idx, cnt = outs
+    assert cnt[0] == 2
+    np.testing.assert_allclose(out[:2], rows[[1, 3]])
+    np.testing.assert_allclose(out[2:], 0.0)
+    np.testing.assert_allclose(lw[:, 0], [1, 1, 0, 0])
+
+
+def test_rank_attention():
+    N, D, max_rank, p = 3, 2, 2, 4
+    x = RNG.standard_normal((N, D)).astype(np.float32)
+    # ins 0: rank 1, blocks k=0 (rank1, row0), k=1 (rank2, row1)
+    # ins 1: rank 2, block k=0 only (rank1, row2)
+    # ins 2: no rank -> zero output
+    offset = np.array([
+        [1, 1, 0, 2, 1],
+        [2, 1, 2, 0, 0],
+        [0, 0, 0, 0, 0]], np.int32)
+    param = RNG.standard_normal((max_rank * max_rank * D, p)).astype(
+        np.float32)
+    par4 = param.reshape(max_rank, max_rank, D, p)
+    expect = np.zeros((N, p), np.float32)
+    helpx = np.zeros((N, max_rank * D), np.float32)
+    # ins 0
+    helpx[0, :D] = x[0]
+    helpx[0, D:] = x[1]
+    expect[0] = x[0] @ par4[0, 0] + x[1] @ par4[0, 1]
+    # ins 1
+    helpx[1, :D] = x[2]
+    expect[1] = x[2] @ par4[1, 0]
+    t = _t("rank_attention",
+           {"X": ("ra_x", x), "RankOffset": ("ra_o", offset),
+            "RankParam": ("ra_p", param)},
+           {"MaxRank": max_rank, "MaxSize": 0},
+           {"Out": expect, "InputHelp": helpx,
+            "InsRank": np.array([[1], [2], [0]], np.float32)})
+    t.check_output(atol=1e-5, rtol=1e-5)
+
+
+def test_sequence_topk_avg_pooling():
+    B, C, R, Cm = 2, 2, 3, 5
+    x = RNG.standard_normal((B, C, R, Cm)).astype(np.float32)
+    rows = np.array([3, 2], np.int32)
+    cols = np.array([5, 3], np.int32)
+    topks = [1, 3]
+    outs = _run_op(
+        "sequence_topk_avg_pooling",
+        {"X": [("st_x", x)], "ROW": [("st_r", rows)],
+         "COLUMN": [("st_c", cols)]},
+        {"topks": topks, "channel_num": C},
+        {"Out": ((B, R, C * len(topks)), "float32"),
+         "pos": ((B, R, C, 3), "int32")})
+    out = outs[0]
+    for b in range(B):
+        for r in range(R):
+            for c in range(C):
+                vals = np.sort(x[b, c, r, :cols[b]])[::-1]
+                for ki, k in enumerate(topks):
+                    kk = min(k, cols[b])
+                    ref = vals[:kk].sum() / k
+                    if r < rows[b]:
+                        np.testing.assert_allclose(
+                            out[b, r, c * len(topks) + ki], ref,
+                            rtol=1e-4, atol=1e-5)
+                    else:
+                        assert out[b, r, c * len(topks) + ki] == 0
+
+
+def test_tensor_array_bridges():
+    from paddle_tpu import layers
+    x = RNG.standard_normal((3, 2, 4)).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = layers.data("x", [3, 2, 4], dtype="float32")
+        gb = main.global_block()
+        gb.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [xin.name]}, outputs={},
+                     attrs={"array_name": "arr0"}, infer_shape=False)
+        gb.create_var(name="restacked", shape=[3, 2, 4], dtype="float32")
+        gb.append_op(type="array_to_lod_tensor", inputs={},
+                     outputs={"Out": ["restacked"]},
+                     attrs={"array_name": "arr0"}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": x}, fetch_list=["restacked"])
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_split_selected_rows_and_byref():
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    import jax.numpy as jnp
+    from paddle_tpu.framework.registry import OPS
+    sr = SelectedRows(rows=jnp.asarray([0, 5, 9, 14], jnp.int32),
+                      values=jnp.asarray(
+                          RNG.standard_normal((4, 2)).astype(np.float32)))
+    res = OPS["split_selected_rows"].lower(
+        None, {"X": [sr]}, {"height_sections": [10, 10]})
+    a, b = res["Out"]
+    np.testing.assert_array_equal(np.asarray(a.rows), [0, 5, 9, -1])
+    np.testing.assert_array_equal(np.asarray(b.rows), [-1, -1, -1, 4])
+    assert np.all(np.asarray(b.values)[:3] == 0)
+
+    x = RNG.standard_normal((6, 3)).astype(np.float32)
+    res = OPS["split_byref"].lower(None, {"X": [jnp.asarray(x)]},
+                                {"sections": [2, 4]})
+    np.testing.assert_allclose(np.asarray(res["Out"][0]), x[:2])
+    np.testing.assert_allclose(np.asarray(res["Out"][1]), x[2:])
+
+
+def test_coalesce_tensor():
+    import jax.numpy as jnp
+    from paddle_tpu.framework.registry import OPS
+    a = RNG.standard_normal((2, 3)).astype(np.float32)
+    b = RNG.standard_normal((4,)).astype(np.float32)
+    res = OPS["coalesce_tensor"].lower(
+        None, {"Input": [jnp.asarray(a), jnp.asarray(b)]}, {})
+    np.testing.assert_allclose(np.asarray(res["FusedOutput"]),
+                               np.concatenate([a.reshape(-1), b]))
+    np.testing.assert_allclose(np.asarray(res["Output"][0]), a)
+
+
+def test_quantize_family():
+    x = np.array([[0.4, -0.6, 2.0]], np.float32)
+    _t("quantize", {"Input": ("q_x", x)},
+       {"Scale": 100.0, "is_negative_input": True},
+       {"Output": np.array([[40, -60, 127]], np.int8)}).check_output()
+    xi = np.array([[40, -60, 127]], np.int8)
+    _t("dequantize", {"Input": ("dq_x", xi)}, {"Scale": 100.0},
+       {"Output": np.array([[0.4, -0.6, 1.27]],
+                           np.float32)}).check_output(atol=1e-6)
+    _t("requantize", {"Input": ("rq_x", xi)},
+       {"Scale_in": 100.0, "Scale_out": 50.0},
+       {"Output": np.array([[20, -30, 64]], np.int8)}).check_output()
+
+
+def test_inplace_abn():
+    B, C = 4, 3
+    x = RNG.standard_normal((B, C, 2, 2)).astype(np.float32)
+    outs = _run_op(
+        "inplace_abn",
+        {"X": [("abn_x", x)],
+         "Scale": [("abn_s", np.ones(C, np.float32))],
+         "Bias": [("abn_b", np.zeros(C, np.float32))],
+         "Mean": [("abn_m", np.zeros(C, np.float32))],
+         "Variance": [("abn_v", np.ones(C, np.float32))]},
+        {"activation": "leaky_relu", "alpha": 0.1, "epsilon": 1e-5,
+         "is_test": False, "momentum": 0.9, "data_layout": "NCHW"},
+        {"Y": ((B, C, 2, 2), "float32"), "MeanOut": ((C,), "float32"),
+         "VarianceOut": ((C,), "float32"),
+         "SavedMean": ((C,), "float32"),
+         "SavedVariance": ((C,), "float32")})
+    y = outs[0]
+    mu = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = x.var(axis=(0, 2, 3), keepdims=True)
+    norm = (x - mu) / np.sqrt(var + 1e-5)
+    ref = np.where(norm >= 0, norm, 0.1 * norm)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_run_program():
+    # build a sub-block computing z = x * 2 + 1, run it via run_program
+    from paddle_tpu import layers
+    main, startup = fluid.Program(), fluid.Program()
+    x = RNG.standard_normal((2, 3)).astype(np.float32)
+    with fluid.program_guard(main, startup):
+        xin = layers.data("x", [2, 3], dtype="float32")
+        gb = main.global_block()
+        sub = main._create_block()
+        with fluid.program_guard(main, startup):
+            two = layers.fill_constant([2, 3], "float32", 2.0)
+            z = layers.elementwise_add(
+                layers.elementwise_mul(xin, two),
+                layers.fill_constant([2, 3], "float32", 1.0))
+        main._rollback()
+        gb.create_var(name="rp_out", shape=[2, 3], dtype="float32")
+        gb.append_op(type="run_program", inputs={"X": [xin.name]},
+                     outputs={"Out": ["rp_out"]},
+                     attrs={"sub_block": sub.idx,
+                            "x_names": [xin.name],
+                            "out_names": [z.name]}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": x}, fetch_list=["rp_out"])
+    np.testing.assert_allclose(np.asarray(out), x * 2 + 1, rtol=1e-5)

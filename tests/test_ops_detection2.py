@@ -1,0 +1,539 @@
+"""OpTests for the R-CNN/RetinaNet/FPN + SSD-target detection batch
+(reference pattern: test_generate_proposals_op.py,
+test_rpn_target_assign_op.py, test_generate_proposal_labels_op.py,
+test_distribute_fpn_proposals_op.py, test_collect_fpn_proposals_op.py,
+test_box_decoder_and_assign_op.py, test_target_assign_op.py,
+test_mine_hard_examples_op.py, test_detection_map_op.py,
+test_locality_aware_nms_op.py, test_deformable_psroi_pooling.py,
+test_roi_perspective_transform_op.py)."""
+import numpy as np
+import paddle_tpu as fluid  # noqa: F401  (registers ops)
+
+from op_test import make_op_test as _t
+
+RNG = np.random.default_rng(44)
+BBOX_CLIP = np.log(1000.0 / 16.0)
+
+
+def _run_op(op_type, ins, attrs, out_specs):
+    """Run a single op; out_specs: {slot: (shape, dtype)} or
+    {slot: [(shape, dtype), ...]} for multi-var slots."""
+    main, startup = fluid.Program(), fluid.Program()
+    feed = {}
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        in_map = {}
+        for slot, pairs in ins.items():
+            names = []
+            for name, arr in pairs:
+                gb.create_var(name=name, shape=arr.shape,
+                              dtype=str(arr.dtype), is_data=True)
+                feed[name] = arr
+                names.append(name)
+            in_map[slot] = names
+        out_map = {}
+        fetch = []
+        for slot, specs in out_specs.items():
+            if not isinstance(specs, list):
+                specs = [specs]
+            names = []
+            for i, (shape, dtype) in enumerate(specs):
+                nm = f"{op_type}_{slot}_{i}"
+                gb.create_var(name=nm, shape=shape, dtype=dtype)
+                names.append(nm)
+                fetch.append(nm)
+            out_map[slot] = names
+        gb.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                     attrs=attrs, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+def _iou(a, b, plus1=False):
+    off = 1.0 if plus1 else 0.0
+    area_a = np.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        np.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        np.maximum(b[:, 3] - b[:, 1] + off, 0)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter,
+                              1e-10)
+
+
+def test_target_assign():
+    B, G, M, K = 2, 3, 5, 4
+    x = RNG.standard_normal((B, G, 1, K)).astype(np.float32)
+    match = np.array([[0, -1, 2, 1, -1], [1, 1, -1, 0, 2]], np.int32)
+    neg = np.array([[1, 4, -1], [2, -1, -1]], np.int32)
+    out = np.full((B, M, K), 7.0, np.float32)
+    wt = np.zeros((B, M, 1), np.float32)
+    for b in range(B):
+        for m in range(M):
+            if match[b, m] >= 0:
+                out[b, m] = x[b, match[b, m], 0]
+                wt[b, m] = 1.0
+        for q in neg[b]:
+            if q >= 0:
+                wt[b, q] = 1.0
+    _t("target_assign",
+       {"X": ("ta_x", x), "MatchIndices": ("ta_mi", match),
+        "NegIndices": ("ta_ni", neg)},
+       {"mismatch_value": 7},
+       {"Out": out, "OutWeight": wt}).check_output(atol=1e-6)
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 image, 6 priors, 2 positives -> neg cap = 2 * ratio 1.0
+    cls_loss = np.array([[0.1, 0.9, 0.3, 0.8, 0.2, 0.7]], np.float32)
+    match = np.array([[0, -1, 1, -1, -1, -1]], np.int32)
+    dist = np.array([[0.8, 0.1, 0.9, 0.2, 0.6, 0.1]], np.float32)
+    # eligible: idx 1, 3, 5 (match -1, dist < 0.5); top-2 loss: 1, 3
+    _t("mine_hard_examples",
+       {"ClsLoss": ("mh_cl", cls_loss), "MatchIndices": ("mh_mi", match),
+        "MatchDist": ("mh_md", dist)},
+       {"mining_type": "max_negative", "neg_pos_ratio": 1.0,
+        "neg_dist_threshold": 0.5},
+       {"NegIndices": np.array([[1, 3, -1, -1, -1, -1]], np.int32),
+        "NegCount": np.array([2], np.int32),
+        "UpdatedMatchIndices": match}).check_output()
+
+
+def test_mine_hard_examples_hard_example():
+    cls_loss = np.array([[0.1, 0.9, 0.3, 0.8, 0.2, 0.7]], np.float32)
+    loc_loss = np.array([[0.0, 0.0, 0.6, 0.0, 0.0, 0.0]], np.float32)
+    match = np.array([[0, -1, 1, -1, -1, -1]], np.int32)
+    dist = np.zeros((1, 6), np.float32)
+    # total loss: [.1, .9, .9, .8, .2, .7]; sample_size=3 -> top 3 =
+    # {1, 2, 3}; pos 2 stays matched; pos 0 demoted; negs = {1, 3}
+    _t("mine_hard_examples",
+       {"ClsLoss": ("mh2_cl", cls_loss), "LocLoss": ("mh2_ll", loc_loss),
+        "MatchIndices": ("mh2_mi", match), "MatchDist": ("mh2_md", dist)},
+       {"mining_type": "hard_example", "sample_size": 3},
+       {"NegIndices": np.array([[1, 3, -1, -1, -1, -1]], np.int32),
+        "NegCount": np.array([2], np.int32),
+        "UpdatedMatchIndices": np.array([[-1, -1, 1, -1, -1, -1]],
+                                        np.int32)}).check_output()
+
+
+def test_box_decoder_and_assign():
+    M, C = 4, 3
+    prior = np.abs(RNG.standard_normal((M, 4))).astype(np.float32)
+    prior[:, 2:] = prior[:, :2] + 4.0 + np.abs(
+        RNG.standard_normal((M, 2))).astype(np.float32)
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    tbox = RNG.standard_normal((M, 4 * C)).astype(np.float32) * 0.3
+    score = RNG.random((M, C)).astype(np.float32)
+    clip = float(BBOX_CLIP)
+    dec = np.zeros((M, 4 * C), np.float32)
+    assign = np.zeros((M, 4), np.float32)
+    for i in range(M):
+        pw = prior[i, 2] - prior[i, 0] + 1
+        ph = prior[i, 3] - prior[i, 1] + 1
+        pcx = prior[i, 0] + pw / 2
+        pcy = prior[i, 1] + ph / 2
+        for j in range(C):
+            o = j * 4
+            dw = min(pvar[2] * tbox[i, o + 2], clip)
+            dh = min(pvar[3] * tbox[i, o + 3], clip)
+            cx = pvar[0] * tbox[i, o] * pw + pcx
+            cy = pvar[1] * tbox[i, o + 1] * ph + pcy
+            w = np.exp(dw) * pw
+            h = np.exp(dh) * ph
+            dec[i, o:o + 4] = [cx - w / 2, cy - h / 2,
+                               cx + w / 2 - 1, cy + h / 2 - 1]
+        best, best_s = -1, -1.0
+        for j in range(1, C):
+            if score[i, j] > best_s:
+                best, best_s = j, score[i, j]
+        assign[i] = dec[i, best * 4:best * 4 + 4] if best > 0 \
+            else prior[i, :4]
+    _t("box_decoder_and_assign",
+       {"PriorBox": ("bda_p", prior), "PriorBoxVar": ("bda_v", pvar),
+        "TargetBox": ("bda_t", tbox), "BoxScore": ("bda_s", score)},
+       {"box_clip": clip},
+       {"DecodeBox": dec, "OutputAssignBox": assign}).check_output(
+        atol=1e-4, rtol=1e-4)
+
+
+def _np_generate_proposals(scores, deltas, im_info, anchors, variances,
+                           pre_n, post_n, nms_thresh, min_size, eta):
+    """Numpy oracle: direct port of generate_proposals_op.cc."""
+    N, A, H, W = scores.shape
+    min_size = max(min_size, 1.0)
+    all_rois, all_probs, counts = [], [], []
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    for n in range(N):
+        s = scores[n].transpose(1, 2, 0).reshape(-1)
+        d = deltas[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1)
+        d = d.reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")[:pre_n]
+        s_sel, d_sel = s[order], d[order]
+        a_sel, v_sel = anc[order], var[order]
+        aw = a_sel[:, 2] - a_sel[:, 0] + 1
+        ah = a_sel[:, 3] - a_sel[:, 1] + 1
+        acx = a_sel[:, 0] + aw / 2
+        acy = a_sel[:, 1] + ah / 2
+        cx = v_sel[:, 0] * d_sel[:, 0] * aw + acx
+        cy = v_sel[:, 1] * d_sel[:, 1] * ah + acy
+        w = np.exp(np.minimum(v_sel[:, 2] * d_sel[:, 2], BBOX_CLIP)) * aw
+        h = np.exp(np.minimum(v_sel[:, 3] * d_sel[:, 3], BBOX_CLIP)) * ah
+        props = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - 1, cy + h / 2 - 1], -1)
+        hi, wi, sc = im_info[n]
+        props[:, 0] = np.clip(props[:, 0], 0, wi - 1)
+        props[:, 1] = np.clip(props[:, 1], 0, hi - 1)
+        props[:, 2] = np.clip(props[:, 2], 0, wi - 1)
+        props[:, 3] = np.clip(props[:, 3], 0, hi - 1)
+        ws = (props[:, 2] - props[:, 0]) / sc + 1
+        hs = (props[:, 3] - props[:, 1]) / sc + 1
+        cxs = props[:, 0] + (props[:, 2] - props[:, 0] + 1) / 2
+        cys = props[:, 1] + (props[:, 3] - props[:, 1] + 1) / 2
+        keep = (ws >= min_size) & (hs >= min_size) & (cxs <= wi) & \
+            (cys <= hi)
+        props, s_keep = props[keep], s_sel[keep]
+        order = np.argsort(-s_keep, kind="stable")
+        props, s_keep = props[order], s_keep[order]
+        sel, thresh = [], nms_thresh
+        for i in range(len(props)):
+            ok = True
+            for j in sel:
+                if _iou(props[i:i + 1], props[j:j + 1],
+                        plus1=True)[0, 0] > thresh:
+                    ok = False
+                    break
+            if ok:
+                sel.append(i)
+                if thresh > 0.5:
+                    thresh *= eta
+            if len(sel) >= post_n:
+                break
+        rois = np.zeros((post_n, 4), np.float32)
+        probs = np.zeros((post_n, 1), np.float32)
+        rois[:len(sel)] = props[sel]
+        probs[:len(sel), 0] = s_keep[sel]
+        all_rois.append(rois)
+        all_probs.append(probs)
+        counts.append(len(sel))
+    return (np.stack(all_rois), np.stack(all_probs),
+            np.array(counts, np.int32))
+
+
+def test_generate_proposals():
+    N, A, H, W = 2, 3, 4, 4
+    scores = RNG.random((N, A, H, W)).astype(np.float32)
+    deltas = (RNG.standard_normal((N, A * 4, H, W)) * 0.2).astype(
+        np.float32)
+    im_info = np.array([[32, 32, 1.0], [32, 32, 2.0]], np.float32)
+    base = np.array([0, 0, 7, 7], np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            for a in range(A):
+                sz = (a + 1) * 3.0
+                anchors[y, x, a] = [x * 8, y * 8, x * 8 + sz, y * 8 + sz]
+    variances = np.ones((H, W, A, 4), np.float32)
+    args = dict(pre_n=20, post_n=6, nms_thresh=0.6, min_size=2.0,
+                eta=1.0)
+    rois, probs, cnt = _np_generate_proposals(
+        scores, deltas, im_info, anchors, variances, **args)
+    _t("generate_proposals",
+       {"Scores": ("gp_s", scores), "BboxDeltas": ("gp_d", deltas),
+        "ImInfo": ("gp_i", im_info), "Anchors": ("gp_a", anchors),
+        "Variances": ("gp_v", variances)},
+       {"pre_nms_topN": 20, "post_nms_topN": 6, "nms_thresh": 0.6,
+        "min_size": 2.0, "eta": 1.0},
+       {"RpnRois": rois, "RpnRoiProbs": probs,
+        "RpnRoisLod": cnt}).check_output(atol=1e-4, rtol=1e-4)
+
+
+def test_rpn_target_assign():
+    # 6 anchors, 2 gts; deterministic first-k sampling
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19], [0, 0, 4, 4],
+                        [20, 20, 29, 29], [8, 8, 17, 17], [2, 2, 11, 11]],
+                       np.float32)
+    gt = np.array([[[0, 0, 9, 9], [10, 10, 19, 19]]], np.float32)
+    im_info = np.array([[40, 40, 1.0]], np.float32)
+    outs = _run_op(
+        "rpn_target_assign",
+        {"Anchor": [("rta_a", anchors)], "GtBoxes": [("rta_g", gt)],
+         "ImInfo": [("rta_i", im_info)]},
+        {"rpn_batch_size_per_im": 4, "rpn_positive_overlap": 0.7,
+         "rpn_negative_overlap": 0.3, "rpn_fg_fraction": 0.5,
+         "rpn_straddle_thresh": 0.0, "use_random": False},
+        {"LocationIndex": ((1, 4), "int32"), "LocCount": ((1,), "int32"),
+         "ScoreIndex": ((1, 4), "int32"), "ScoreCount": ((1,), "int32"),
+         "TargetLabel": ((1, 4, 1), "int32"),
+         "TargetBBox": ((1, 4, 4), "float32"),
+         "BBoxInsideWeight": ((1, 4, 4), "float32")})
+    loc, locn, sci, scn, lbl, tb, inw = outs
+    # anchors 0 and 1 match gts exactly (IoU 1.0 -> fg); cap = 2
+    assert locn[0] == 2 and set(loc[0][:2].tolist()) == {0, 1}
+    # bgs: anchors with max IoU < 0.3 among eligible, first 2 of {2?,3,..}
+    assert scn[0] == 4
+    assert lbl[0, :2, 0].tolist() == [1, 1]
+    assert lbl[0, 2:, 0].tolist() == [0, 0]
+    # fg deltas are zero (perfect match), weights 1
+    np.testing.assert_allclose(tb[0, :2], 0.0, atol=1e-5)
+    np.testing.assert_allclose(inw[0, :2], 1.0)
+
+
+def test_retinanet_target_assign():
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19],
+                        [30, 30, 39, 39]], np.float32)
+    gt = np.array([[[0, 0, 9, 9], [11, 11, 20, 20]]], np.float32)
+    gt_labels = np.array([[3, 7]], np.int32)
+    im_info = np.array([[40, 40, 1.0]], np.float32)
+    outs = _run_op(
+        "retinanet_target_assign",
+        {"Anchor": [("rt2_a", anchors)], "GtBoxes": [("rt2_g", gt)],
+         "GtLabels": [("rt2_l", gt_labels)],
+         "ImInfo": [("rt2_i", im_info)]},
+        {"positive_overlap": 0.5, "negative_overlap": 0.4},
+        {"LocationIndex": ((1, 3), "int32"), "LocCount": ((1,), "int32"),
+         "ScoreIndex": ((1, 3), "int32"), "ScoreCount": ((1,), "int32"),
+         "TargetLabel": ((1, 3, 1), "int32"),
+         "TargetBBox": ((1, 3, 4), "float32"),
+         "BBoxInsideWeight": ((1, 3, 4), "float32"),
+         "ForegroundNumber": ((1, 1), "int32")})
+    loc, locn, sci, scn, lbl, tb, inw, fgn = outs
+    assert locn[0] == 2 and fgn[0, 0] == 2
+    # anchor 0 -> gt0 (label 3), anchor 1 -> gt1 (label 7), anchor 2 bg
+    assert lbl[0, 0, 0] == 3 and lbl[0, 1, 0] == 7 and lbl[0, 2, 0] == 0
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[[0, 0, 9, 9], [10, 10, 19, 19], [20, 20, 29, 29],
+                      [1, 1, 8, 8]]], np.float32)
+    gt = np.array([[[0, 0, 9, 9], [10, 10, 19, 19]]], np.float32)
+    gt_cls = np.array([[2, 5]], np.int32)
+    im_info = np.array([[40, 40, 1.0]], np.float32)
+    S, C = 4, 6
+    outs = _run_op(
+        "generate_proposal_labels",
+        {"RpnRois": [("gpl_r", rois)], "GtBoxes": [("gpl_g", gt)],
+         "GtClasses": [("gpl_c", gt_cls)], "ImInfo": [("gpl_i", im_info)]},
+        {"batch_size_per_im": S, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": C,
+         "bbox_reg_weights": [1.0, 1.0, 1.0, 1.0], "use_random": False},
+        {"Rois": ((1, S, 4), "float32"),
+         "LabelsInt32": ((1, S, 1), "int32"),
+         "BboxTargets": ((1, S, 4 * C), "float32"),
+         "BboxInsideWeights": ((1, S, 4 * C), "float32"),
+         "BboxOutsideWeights": ((1, S, 4 * C), "float32"),
+         "RoisNum": ((1,), "int32")})
+    srois, lbl, tgt, inw, outw, num = outs
+    # fg candidates (IoU >= .5): roi0 (gt0), roi1 (gt1), roi3 (gt0),
+    # gt0, gt1 appended -> fg cap 2 picks roi0, roi1; bg: roi2
+    assert num[0] == 3
+    assert lbl[0, 0, 0] == 2 and lbl[0, 1, 0] == 5 and lbl[0, 2, 0] == 0
+    # perfect matches -> zero deltas in the class slots, weight 1 there
+    assert np.allclose(tgt[0, 0], 0.0, atol=1e-5)
+    assert inw[0, 0, 2 * 4:2 * 4 + 4].tolist() == [1, 1, 1, 1]
+    assert inw[0, 1, 5 * 4:5 * 4 + 4].tolist() == [1, 1, 1, 1]
+    assert np.all(inw[0, 2] == 0)
+
+
+def test_generate_mask_labels():
+    # one fg roi, square polygon covering the left half of the roi
+    rois = np.array([[[0, 0, 10, 10], [12, 12, 20, 20]]], np.float32)
+    labels = np.array([[[2], [0]]], np.int32)
+    poly = np.zeros((1, 1, 8, 2), np.float32)
+    poly[0, 0, :4] = [[0, 0], [5, 0], [5, 10], [0, 10]]
+    seg_lens = np.array([[4]], np.int32)
+    gt_cls = np.array([[2]], np.int32)
+    M, C = 8, 4
+    outs = _run_op(
+        "generate_mask_labels",
+        {"Rois": [("gml_r", rois)], "LabelsInt32": [("gml_l", labels)],
+         "GtSegms": [("gml_s", poly)], "GtSegmLens": [("gml_sl", seg_lens)],
+         "GtClasses": [("gml_c", gt_cls)]},
+        {"resolution": M, "num_classes": C},
+        {"MaskRois": ((1, 2, 4), "float32"),
+         "RoiHasMaskInt32": ((1, 2, 1), "int32"),
+         "MaskInt32": ((1, 2, C * M * M), "int32"),
+         "MaskNum": ((1,), "int32")})
+    mrois, has, masks, num = outs
+    assert num[0] == 1 and has[0, 0, 0] == 1 and has[0, 1, 0] == 0
+    m = masks[0, 0].reshape(C, M, M)
+    # class-2 slot holds the rasterized mask: left half ~1, right ~0
+    assert m[2, :, :3].mean() > 0.9
+    assert m[2, :, 5:].mean() < 0.1
+    # other class slots are -1
+    assert np.all(m[0] == -1) and np.all(m[3] == -1)
+    assert np.all(masks[0, 1] == -1)
+
+
+def test_distribute_fpn_proposals():
+    # areas chosen to land on specific levels (refer: level 4, scale 224)
+    rois = np.array([[[0, 0, 111, 111],      # sqrt(112*112)=112 -> lvl 3
+                      [0, 0, 223, 223],      # 224 -> lvl 4
+                      [0, 0, 447, 447],      # 448 -> lvl 5
+                      [0, 0, 55, 55],        # 56 -> lvl 2
+                      [0, 0, 223, 223]]], np.float32)  # lvl 4
+    outs = _run_op(
+        "distribute_fpn_proposals",
+        {"FpnRois": [("dfp_r", rois)]},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224},
+        {"MultiFpnRois": [((1, 5, 4), "float32")] * 4,
+         "MultiLevelRoisNum": [((1,), "int32")] * 4,
+         "RestoreIndex": ((1, 5, 1), "int32")})
+    l2, l3, l4, l5, n2, n3, n4, n5, restore = outs
+    assert [int(n2[0]), int(n3[0]), int(n4[0]), int(n5[0])] == \
+        [1, 1, 2, 1]
+    np.testing.assert_allclose(l2[0, 0], rois[0, 3])
+    np.testing.assert_allclose(l3[0, 0], rois[0, 0])
+    np.testing.assert_allclose(l4[0, :2], rois[0, [1, 4]])
+    np.testing.assert_allclose(l5[0, 0], rois[0, 2])
+    # concat order: [roi3, roi0, roi1, roi4, roi2]
+    assert restore[0, :, 0].tolist() == [1, 2, 4, 0, 3]
+
+
+def test_collect_fpn_proposals():
+    r1 = np.array([[[0, 0, 10, 10], [0, 0, 20, 20]]], np.float32)
+    r2 = np.array([[[0, 0, 30, 30], [0, 0, 40, 40]]], np.float32)
+    s1 = np.array([[0.9, 0.2]], np.float32)
+    s2 = np.array([[0.5, 0.7]], np.float32)
+    outs = _run_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": [("cfp_r1", r1), ("cfp_r2", r2)],
+         "MultiLevelScores": [("cfp_s1", s1), ("cfp_s2", s2)]},
+        {"post_nms_topN": 3},
+        {"FpnRois": ((1, 3, 4), "float32"), "RoisNum": ((1,), "int32")})
+    rois, num = outs
+    assert num[0] == 3
+    np.testing.assert_allclose(
+        rois[0], [[0, 0, 10, 10], [0, 0, 40, 40], [0, 0, 30, 30]])
+
+
+def test_detection_map():
+    # 1 image, 2 classes, hand-computable AP
+    det = np.array([[[1, 0.9, 0, 0, 10, 10],     # matches gt0 (tp)
+                     [1, 0.8, 50, 50, 60, 60],   # no gt overlap (fp)
+                     [2, 0.7, 20, 20, 30, 30]]], np.float32)  # tp
+    gt_label = np.array([[1, 2]], np.int32)
+    gt_box = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    # class 1: tp=[1,0] fp=[0,1] n_gt=1 -> prec [1, .5], rec [1, 1]
+    #   integral AP = (1-0)*1 = 1.0
+    # class 2: AP = 1.0 -> mAP = 1.0
+    outs = _run_op(
+        "detection_map",
+        {"DetectRes": [("dm_d", det)], "GtLabel": [("dm_l", gt_label)],
+         "GtBox": [("dm_b", gt_box)]},
+        {"class_num": 3, "overlap_threshold": 0.5,
+         "ap_type": "integral"},
+        {"MAP": ((1,), "float32"),
+         "AccumPosCount": ((3, 1), "int32"),
+         "AccumTruePos": ((3, 3, 2), "float32"),
+         "AccumFalsePos": ((3, 3, 2), "float32")})
+    np.testing.assert_allclose(outs[0][0], 1.0, atol=1e-5)
+
+    # shift the class-1 fp above the tp: prec [0, .5], rec [0, 1]
+    det2 = det.copy()
+    det2[0, 1, 1] = 0.95
+    outs = _run_op(
+        "detection_map",
+        {"DetectRes": [("dm2_d", det2)], "GtLabel": [("dm2_l", gt_label)],
+         "GtBox": [("dm2_b", gt_box)]},
+        {"class_num": 3, "overlap_threshold": 0.5,
+         "ap_type": "integral"},
+        {"MAP": ((1,), "float32"),
+         "AccumPosCount": ((3, 1), "int32"),
+         "AccumTruePos": ((3, 3, 2), "float32"),
+         "AccumFalsePos": ((3, 3, 2), "float32")})
+    np.testing.assert_allclose(outs[0][0], 0.75, atol=1e-5)  # (.5+1)/2
+
+
+def test_locality_aware_nms():
+    # two heavily overlapping boxes merge into a weighted average
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                       [30, 30, 40, 40]]], np.float32)
+    scores = np.array([[[0.6, 0.4, 0.9]]], np.float32)  # [N, C=1, M]
+    outs = _run_op(
+        "locality_aware_nms",
+        {"BBoxes": [("lan_b", boxes)], "Scores": [("lan_s", scores)]},
+        {"score_threshold": 0.1, "nms_threshold": 0.5, "nms_top_k": 4,
+         "keep_top_k": 4, "background_label": -1},
+        {"Out": ((1, 4, 6), "float32"), "NmsRoisNum": ((1,), "int32")})
+    rows, num = outs
+    assert num[0] == 2
+    # merged pair carries the SUMMED score (0.6+0.4) so it ranks first,
+    # then the isolated box
+    merged = (boxes[0, 0] * 0.6 + boxes[0, 1] * 0.4)
+    np.testing.assert_allclose(rows[0, 0, 1], 1.0, atol=1e-5)
+    np.testing.assert_allclose(rows[0, 0, 2:], merged, atol=1e-4)
+    np.testing.assert_allclose(rows[0, 1, 2:], [30, 30, 40, 40])
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32)
+    deltas = np.zeros((1, 2, 4), np.float32)
+    scores = np.array([[[0.9, 0.1], [0.2, 0.8]]], np.float32)
+    im_info = np.array([[40, 40, 1.0]], np.float32)
+    outs = _run_op(
+        "retinanet_detection_output",
+        {"BBoxes": [("rdo_b", deltas)], "Scores": [("rdo_s", scores)],
+         "Anchors": [("rdo_a", anchors)], "ImInfo": [("rdo_i", im_info)]},
+        {"score_threshold": 0.3, "nms_top_k": 4, "keep_top_k": 4,
+         "nms_threshold": 0.5},
+        {"Out": ((1, 4, 6), "float32"), "NmsRoisNum": ((1,), "int32")})
+    rows, num = outs
+    assert num[0] == 2
+    assert rows[0, 0, 0] == 0 and abs(rows[0, 0, 1] - 0.9) < 1e-5
+    assert rows[0, 1, 0] == 1 and abs(rows[0, 1, 1] - 0.8) < 1e-5
+    np.testing.assert_allclose(rows[0, 0, 2:], [0, 0, 9, 9], atol=1e-4)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    # no_trans + group 1x1 + output_dim == C behaves like average
+    # pooling of each bin
+    N, C, H, W = 1, 2, 8, 8
+    x = RNG.standard_normal((N, C, H, W)).astype(np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    outs = _run_op(
+        "deformable_psroi_pooling",
+        {"Input": [("dpp_x", x)], "ROIs": [("dpp_r", rois)],
+         "Trans": [("dpp_t", trans)]},
+        {"no_trans": True, "spatial_scale": 1.0, "output_dim": C,
+         "group_size": [1, 1], "pooled_height": 2, "pooled_width": 2,
+         "part_size": [2, 2], "sample_per_part": 4, "trans_std": 0.0},
+        {"Output": ((1, C, 2, 2), "float32"),
+         "TopCount": ((1, C, 2, 2), "float32")})
+    out, cnt = outs
+    assert out.shape == (1, C, 2, 2)
+    assert np.all(cnt > 0)
+    # with group 1x1 every bin samples channel c of the input; the bin
+    # average must lie within the channel's value range
+    for c in range(C):
+        assert out[0, c].min() >= x[0, c].min() - 1e-4
+        assert out[0, c].max() <= x[0, c].max() + 1e-4
+
+
+def test_roi_perspective_transform():
+    # axis-aligned square ROI: warp = near-identity resample
+    N, C, H, W = 1, 1, 10, 10
+    x = np.arange(H * W, dtype=np.float32).reshape(N, C, H, W)
+    rois = np.array([[1, 1, 8, 1, 8, 8, 1, 8]], np.float32)  # quad corners
+    th = tw = 8
+    outs = _run_op(
+        "roi_perspective_transform",
+        {"X": [("rpt_x", x)], "ROIs": [("rpt_r", rois)]},
+        {"spatial_scale": 1.0, "transformed_height": th,
+         "transformed_width": tw},
+        {"Out": ((1, C, th, tw), "float32"),
+         "Mask": ((1, 1, th, tw), "int32"),
+         "TransformMatrix": ((1, 9), "float32")})
+    out, mask, mat = outs
+    # interior is sampled (mask mostly 1) and increases along both axes
+    assert mask.mean() > 0.5
+    inner = out[0, 0][2:6, 2:6]
+    assert np.all(np.diff(inner, axis=0) > 0)
+    assert np.all(np.diff(inner, axis=1) > 0)

@@ -1,0 +1,391 @@
+"""OpTests for the long-tail utility ops (reference pattern:
+test_linspace.py, test_randperm_op.py, test_allclose_op.py,
+test_is_empty_op.py, test_where_index.py, test_unique_with_counts.py,
+test_diag.py, test_squared_l2_distance_op.py,
+test_modified_huber_loss_op.py, test_spp_op.py, test_proximal_*_op.py,
+test_average_accumulates_op.py, test_chunk_eval_op.py,
+test_beam_search_decode_op.py, test_tensor_array_to_tensor.py)."""
+import numpy as np
+
+from op_test import make_op_test as _t
+
+RNG = np.random.default_rng(33)
+
+
+def test_linspace():
+    ref = np.linspace(2.0, 10.0, 17).astype(np.float32)
+    _t("linspace",
+       {"Start": ("start", np.array([2.0], np.float32)),
+        "Stop": ("stop", np.array([10.0], np.float32)),
+        "Num": ("num", np.array([17], np.int32))},
+       {"num": 17}, {"Out": ref}).check_output(atol=1e-6)
+    # num == 1 -> just stop
+    _t("linspace",
+       {"Start": ("s2", np.array([3.0], np.float32)),
+        "Stop": ("e2", np.array([7.0], np.float32)),
+        "Num": ("n2", np.array([1], np.int32))},
+       {"num": 1}, {"Out": np.array([7.0], np.float32)}).check_output()
+
+
+def test_randperm():
+    import paddle_tpu as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        gb.create_var(name="perm", shape=[32], dtype="int64")
+        gb.append_op(type="randperm", inputs={}, outputs={"Out": ["perm"]},
+                     attrs={"n": 32, "dtype": "int64"}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, fetch_list=["perm"])
+    np.testing.assert_array_equal(np.sort(np.asarray(out)), np.arange(32))
+
+
+def test_allclose():
+    a = RNG.standard_normal((3, 4)).astype(np.float32)
+    b = a + 1e-7
+    _t("allclose", {"Input": ("a", a), "Other": ("b", b)},
+       {"rtol": 1e-5, "atol": 1e-6},
+       {"Out": np.array(True)}).check_output()
+    _t("allclose", {"Input": ("a2", a), "Other": ("b2", a + 1.0)},
+       {"rtol": 1e-5, "atol": 1e-6},
+       {"Out": np.array(False)}).check_output()
+    nan = np.array([np.nan], np.float32)
+    _t("allclose", {"Input": ("a3", nan), "Other": ("b3", nan)},
+       {"equal_nan": True}, {"Out": np.array(True)}).check_output()
+    _t("allclose", {"Input": ("a4", nan), "Other": ("b4", nan)},
+       {"equal_nan": False}, {"Out": np.array(False)}).check_output()
+
+
+def test_is_empty():
+    x = np.zeros((0, 3), np.float32)
+    _t("is_empty", {"X": x}, {}, {"Out": np.array(True)}).check_output()
+    y = np.zeros((2, 3), np.float32)
+    _t("is_empty", {"X": ("y", y)}, {},
+       {"Out": np.array(False)}).check_output()
+
+
+def test_where_index():
+    cond = np.array([[True, False, True], [False, True, False]])
+    ref = np.full((6, 2), -1, np.int64)
+    nz = np.stack(np.nonzero(cond), axis=-1)
+    ref[:len(nz)] = nz
+    _t("where_index", {"Condition": ("c", cond)}, {},
+       {"Out": ref, "Count": np.array([3], np.int64)}).check_output()
+
+
+def test_unique_with_counts():
+    x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+    # first-occurrence order: [2, 3, 1, 5]; padded to len 6
+    out = np.array([2, 3, 1, 5, 0, 0], np.int64)
+    index = np.array([0, 1, 1, 2, 3, 1], np.int32)
+    count = np.array([1, 3, 1, 1, 0, 0], np.int32)
+    _t("unique_with_counts", {"X": x}, {"dtype": "int32"},
+       {"Out": out, "Index": index, "Count": count}).check_output()
+
+
+def test_diag():
+    d = np.array([1.0, 2.0, 3.0], np.float32)
+    _t("diag", {"Diagonal": ("d", d)}, {},
+       {"Out": np.diag(d)}).check_output()
+
+
+def test_squared_l2_distance():
+    x = RNG.standard_normal((5, 4)).astype(np.float32)
+    y = RNG.standard_normal((5, 4)).astype(np.float32)
+    sub = x - y
+    t = _t("squared_l2_distance", {"X": x, "Y": ("y", y)}, {},
+           {"sub_result": sub,
+            "Out": (sub ** 2).sum(-1, keepdims=True).astype(np.float32)})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+    # broadcast: Y one row
+    y1 = RNG.standard_normal((1, 4)).astype(np.float32)
+    sub = x - y1
+    _t("squared_l2_distance", {"X": ("x2", x), "Y": ("y2", y1)}, {},
+       {"sub_result": sub,
+        "Out": (sub ** 2).sum(-1, keepdims=True).astype(np.float32)}
+       ).check_output(atol=1e-5)
+
+
+def test_modified_huber_loss():
+    x = RNG.standard_normal((8, 1)).astype(np.float32) * 2
+    y = RNG.integers(0, 2, (8, 1)).astype(np.float32)
+    v = (2 * y - 1) * x
+    loss = np.where(v < -1, -4 * v, np.where(v < 1, (1 - v) ** 2, 0.0))
+    t = _t("modified_huber_loss", {"X": x, "Y": ("y", y)}, {},
+           {"IntermediateVal": v.astype(np.float32),
+            "Out": loss.astype(np.float32)})
+    t.check_output(atol=1e-5)
+
+
+def _np_spp(x, height, ptype):
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(height):
+        bins = 2 ** p
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+        lvl = np.zeros((n, c, bins, bins), np.float64)
+        for i in range(bins):
+            for j in range(bins):
+                y0, x0 = i * kh - ph, j * kw - pw
+                ys = slice(max(y0, 0), min(y0 + kh, h))
+                xs = slice(max(x0, 0), min(x0 + kw, w))
+                patch = x[:, :, ys, xs]
+                if ptype == "max":
+                    lvl[:, :, i, j] = patch.max((2, 3)) \
+                        if patch.size else 0.0
+                else:
+                    lvl[:, :, i, j] = patch.sum((2, 3)) / (kh * kw)
+        outs.append(lvl.reshape(n, -1))
+    return np.concatenate(outs, -1).astype(np.float32)
+
+
+def test_spp():
+    x = RNG.standard_normal((2, 3, 7, 5)).astype(np.float32)
+    for ptype in ("max", "avg"):
+        t = _t("spp", {"X": x},
+               {"pyramid_height": 3, "pooling_type": ptype},
+               {"Out": _np_spp(x, 3, ptype)})
+        t.check_output(atol=1e-5)
+
+
+def test_proximal_gd():
+    p = RNG.standard_normal((6,)).astype(np.float32)
+    g = RNG.standard_normal((6,)).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    l1, l2 = 0.05, 0.02
+    w = p - 0.1 * g
+    ref = np.sign(w) * np.maximum(np.abs(w) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    _t("proximal_gd",
+       {"Param": ("p", p), "Grad": ("g", g), "LearningRate": ("lr", lr)},
+       {"l1": l1, "l2": l2},
+       {"ParamOut": ref.astype(np.float32)}).check_output(atol=1e-6)
+
+
+def test_proximal_adagrad():
+    p = RNG.standard_normal((6,)).astype(np.float32)
+    m = RNG.random((6,)).astype(np.float32) + 0.1
+    g = RNG.standard_normal((6,)).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    l1, l2 = 0.05, 0.02
+    m_out = m + g * g
+    w = p - 0.1 * g / np.sqrt(m_out)
+    ref = np.sign(w) * np.maximum(np.abs(w) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    _t("proximal_adagrad",
+       {"Param": ("p", p), "Moment": ("m", m), "Grad": ("g", g),
+        "LearningRate": ("lr", lr)},
+       {"l1": l1, "l2": l2},
+       {"ParamOut": ref.astype(np.float32),
+        "MomentOut": m_out.astype(np.float32)}).check_output(atol=1e-6)
+
+
+def test_average_accumulates():
+    shape = (4,)
+    param = RNG.standard_normal(shape).astype(np.float32)
+    s1 = RNG.standard_normal(shape).astype(np.float32)
+    s2 = RNG.standard_normal(shape).astype(np.float32)
+    s3 = np.zeros(shape, np.float32)
+
+    def run(num_acc, old_num, num_upd, min_win, max_win, avg_win):
+        ins = {"param": ("param", param), "in_sum_1": ("s1", s1),
+               "in_sum_2": ("s2", s2), "in_sum_3": ("s3", s3),
+               "in_num_accumulates": ("na", np.array([num_acc], np.int64)),
+               "in_old_num_accumulates": ("ona",
+                                          np.array([old_num], np.int64)),
+               "in_num_updates": ("nu", np.array([num_upd], np.int64))}
+        # numpy reference (average_accumulates_op.h)
+        nu, na, ona = num_upd + 1, num_acc + 1, old_num
+        o1, o2, o3 = s1 + param, s2.copy(), s3.copy()
+        if nu % 16384 == 0:
+            o2, o1 = o2 + o1, np.zeros_like(o1)
+        if na >= min_win and na >= min(max_win, int(nu * avg_win)):
+            o3 = o1 + o2
+            o1, o2 = np.zeros_like(o1), np.zeros_like(o2)
+            ona, na = na, 0
+        return ins, {"out_sum_1": o1, "out_sum_2": o2, "out_sum_3": o3,
+                     "out_num_accumulates": np.array([na], np.int64),
+                     "out_old_num_accumulates": np.array([ona], np.int64),
+                     "out_num_updates": np.array([nu], np.int64)}
+
+    # plain accumulate (window not reached)
+    ins, outs = run(3, 0, 10, 100, 10000, 0.15)
+    _t("average_accumulates", ins,
+       {"average_window": 0.15, "max_average_window": 10000,
+        "min_average_window": 100}, outs).check_output(atol=1e-6)
+    # window rollover
+    ins, outs = run(200, 0, 1000, 100, 150, 0.15)
+    _t("average_accumulates", ins,
+       {"average_window": 0.15, "max_average_window": 150,
+        "min_average_window": 100}, outs).check_output(atol=1e-6)
+
+
+# ------------------------------------------------------------- chunk_eval
+
+_SCHEMES = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+            "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+
+
+def _np_segments(labels, length, num_types, scheme):
+    """Direct port of the reference state machine (chunk_eval_op.h
+    GetSegments) as the independent numpy oracle."""
+    n_tag, t_beg, t_in, t_end, t_sgl = _SCHEMES[scheme]
+    other = num_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other:
+            return True
+        if ty != pty:
+            return True
+        if pt == t_beg:
+            return t in (t_beg, t_sgl)
+        if pt == t_in:
+            return t in (t_beg, t_sgl)
+        return pt in (t_end, t_sgl)
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == t_beg:
+            return True
+        if t == t_in:
+            return pt in (t_end, t_sgl)
+        if t == t_end:
+            return pt in (t_end, t_sgl)
+        return t == t_sgl
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i in range(length):
+        pt, pty = tag, typ
+        tag, typ = labels[i] % n_tag, labels[i] // n_tag
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, length - 1, typ))
+    return segs
+
+
+def _np_chunk_eval(inf, lab, lens, num_types, scheme, excluded=()):
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        si = [s for s in _np_segments(inf[b], lens[b], num_types, scheme)
+              if s[2] not in excluded]
+        sl = [s for s in _np_segments(lab[b], lens[b], num_types, scheme)
+              if s[2] not in excluded]
+        n_inf += len(si)
+        n_lab += len(sl)
+        n_cor += len(set(si) & set(sl))
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if n_cor else 0.0
+    return p, r, f1, n_inf, n_lab, n_cor
+
+
+def test_chunk_eval():
+    for scheme, num_types in (("IOB", 3), ("IOE", 3), ("IOBES", 2),
+                              ("plain", 4)):
+        n_tag = _SCHEMES[scheme][0]
+        B, T = 4, 12
+        hi = num_types * n_tag + 1  # includes the Other label
+        inf = RNG.integers(0, hi, (B, T)).astype(np.int64)
+        lab = RNG.integers(0, hi, (B, T)).astype(np.int64)
+        # make some agreement so correct > 0 usually
+        agree = RNG.random((B, T)) < 0.5
+        lab = np.where(agree, inf, lab)
+        lens = np.array([12, 9, 5, 1], np.int64)
+        p, r, f1, ni, nl, nc = _np_chunk_eval(inf, lab, lens, num_types,
+                                              scheme)
+        _t("chunk_eval",
+           {"Inference": ("inf", inf), "Label": ("lab", lab),
+            "SeqLength": ("len", lens)},
+           {"num_chunk_types": num_types, "chunk_scheme": scheme},
+           {"Precision": np.array([p], np.float32),
+            "Recall": np.array([r], np.float32),
+            "F1-Score": np.array([f1], np.float32),
+            "NumInferChunks": np.array([ni], np.int64),
+            "NumLabelChunks": np.array([nl], np.int64),
+            "NumCorrectChunks": np.array([nc], np.int64)}
+           ).check_output(atol=1e-5)
+
+
+def test_chunk_eval_excluded():
+    B, T = 2, 8
+    inf = RNG.integers(0, 7, (B, T)).astype(np.int64)
+    lab = np.where(RNG.random((B, T)) < 0.6, inf,
+                   RNG.integers(0, 7, (B, T))).astype(np.int64)
+    lens = np.array([8, 6], np.int64)
+    p, r, f1, ni, nl, nc = _np_chunk_eval(inf, lab, lens, 3, "IOB",
+                                          excluded=(1,))
+    _t("chunk_eval",
+       {"Inference": ("inf", inf), "Label": ("lab", lab),
+        "SeqLength": ("len", lens)},
+       {"num_chunk_types": 3, "chunk_scheme": "IOB",
+        "excluded_chunk_types": [1]},
+       {"Precision": np.array([p], np.float32),
+        "Recall": np.array([r], np.float32),
+        "F1-Score": np.array([f1], np.float32),
+        "NumInferChunks": np.array([ni], np.int64),
+        "NumLabelChunks": np.array([nl], np.int64),
+        "NumCorrectChunks": np.array([nc], np.int64)}).check_output(
+        atol=1e-5)
+
+
+def test_beam_search_decode():
+    T, B, K = 4, 2, 3
+    ids = RNG.integers(1, 9, (T, B, K)).astype(np.int64)
+    parents = RNG.integers(0, K, (T, B, K)).astype(np.int64)
+    scores = RNG.standard_normal((T, B, K)).astype(np.float32)
+    # numpy backtrace
+    sent = np.zeros((B, K, T), np.int32)
+    for b in range(B):
+        for k in range(K):
+            beam = k
+            for t in range(T - 1, -1, -1):
+                sent[b, k, t] = ids[t, b, beam]
+                beam = parents[t, b, beam]
+    _t("beam_search_decode",
+       {"Ids": ("ids", ids), "ParentIdx": ("par", parents),
+        "Scores": ("sc", scores)}, {},
+       {"SentenceIds": sent,
+        "SentenceScores": scores[-1]}).check_output()
+
+
+def test_tensor_array_to_tensor():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    xs = [RNG.standard_normal((2, 3)).astype(np.float32) for _ in range(3)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        arr = layers.create_array("float32")
+        for i, x in enumerate(xs):
+            layers.array_write(layers.assign(
+                layers.data(f"x{i}", [2, 3], dtype="float32")),
+                fluid.layers.fill_constant([1], "int64", i), arr)
+        gb = main.global_block()
+        gb.create_var(name="stacked", shape=[2, 9], dtype="float32")
+        gb.create_var(name="oidx", shape=[3], dtype="int32")
+        gb.append_op(type="tensor_array_to_tensor", inputs={},
+                     outputs={"Out": ["stacked"], "OutIndex": ["oidx"]},
+                     attrs={"array_name": arr.name, "axis": 1,
+                            "use_stack": False}, infer_shape=False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, oidx = exe.run(
+            main, feed={f"x{i}": x for i, x in enumerate(xs)},
+            fetch_list=["stacked", "oidx"])
+    np.testing.assert_allclose(np.asarray(out),
+                               np.concatenate(xs, axis=1), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(oidx), [3, 3, 3])
