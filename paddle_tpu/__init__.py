@@ -24,6 +24,8 @@ from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import nets  # noqa: F401
+from . import dataset  # noqa: F401
 from . import clip  # noqa: F401
 from .parallel.compiler import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,

@@ -1,0 +1,8 @@
+"""slim NAS (reference contrib/slim/nas/: light_nas_strategy.py,
+controller_server.py, search_space.py + slim/searcher/controller.py
+SAController): simulated-annealing architecture search with an optional
+TCP controller server so distributed clients share one controller."""
+from .controller import EvolutionaryController, SAController  # noqa: F401
+from .controller_server import ControllerServer, ControllerClient  # noqa: F401
+from .search_space import SearchSpace  # noqa: F401
+from .light_nas_strategy import LightNASStrategy  # noqa: F401

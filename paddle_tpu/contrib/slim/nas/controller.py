@@ -1,0 +1,80 @@
+"""Token-space controllers (reference
+contrib/slim/searcher/controller.py:59 SAController)."""
+import math
+
+import numpy as np
+
+
+class EvolutionaryController:
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self, control_token=None):
+        raise NotImplementedError
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing over integer token vectors: accept a worse
+    reward with prob exp((r - r_cur)/T), T decaying by reduce_rate per
+    iteration (reference controller.py:105-150)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = float(reduce_rate)
+        self._init_temperature = float(init_temperature)
+        self._max_iter_number = int(max_iter_number)
+        self._rng = np.random.default_rng(seed)
+        self._constrain_func = None
+        self._reward = -1.0
+        self._max_reward = -1.0
+        self._tokens = None
+        self._best_tokens = None
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temp = self._init_temperature * self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random() <= math.exp(
+                min((reward - self._reward) / max(temp, 1e-9), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token) if control_token else \
+            list(self._tokens)
+        new_tokens = list(tokens)
+        idx = int(self._rng.integers(0, len(self._range_table)))
+        span = max(self._range_table[idx], 2)
+        new_tokens[idx] = (new_tokens[idx]
+                           + int(self._rng.integers(1, span))) % span
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                break
+            idx = int(self._rng.integers(0, len(self._range_table)))
+            new_tokens = list(tokens)
+            new_tokens[idx] = int(self._rng.integers(
+                0, self._range_table[idx]))
+        return new_tokens

@@ -1,0 +1,111 @@
+"""Controller server/client (reference
+contrib/slim/nas/controller_server.py + search_agent.py): one process
+hosts the SA controller; distributed search clients request next_tokens
+and report rewards over TCP (json lines)."""
+import json
+import socket
+import threading
+
+
+class ControllerServer:
+    def __init__(self, controller, address=("127.0.0.1", 0),
+                 max_client_num=64, search_steps=None):
+        self._controller = controller
+        self._address = address
+        self._max_clients = max_client_num
+        self._search_steps = search_steps
+        self._sock = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._address)
+        self._sock.listen(self._max_clients)
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self._sock.getsockname()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self, conn):
+        with conn:
+            buf = b""
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(65536)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    msg = json.loads(line)
+                    with self._lock:
+                        if msg["cmd"] == "next_tokens":
+                            out = {"tokens": self._controller.next_tokens(
+                                msg.get("tokens"))}
+                        elif msg["cmd"] == "update":
+                            self._controller.update(msg["tokens"],
+                                                    float(msg["reward"]))
+                            out = {"ok": True,
+                                   "best": self._controller.best_tokens,
+                                   "max_reward":
+                                       self._controller.max_reward}
+                        elif msg["cmd"] == "stop":
+                            self._stop.set()
+                            out = {"ok": True}
+                        else:
+                            out = {"err": f"unknown {msg['cmd']!r}"}
+                    conn.sendall((json.dumps(out) + "\n").encode())
+
+    def close(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class ControllerClient:
+    def __init__(self, address):
+        self._address = tuple(address)
+
+    def _call(self, msg):
+        with socket.create_connection(self._address, timeout=30) as s:
+            s.sendall((json.dumps(msg) + "\n").encode())
+            buf = b""
+            while b"\n" not in buf:
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise ConnectionError("controller server closed")
+                buf += chunk
+        return json.loads(buf.split(b"\n", 1)[0])
+
+    def next_tokens(self, tokens=None):
+        return self._call({"cmd": "next_tokens", "tokens": tokens})["tokens"]
+
+    def update(self, tokens, reward):
+        return self._call({"cmd": "update", "tokens": list(tokens),
+                           "reward": float(reward)})
+
+    def stop(self):
+        try:
+            self._call({"cmd": "stop"})
+        except (ConnectionError, OSError):
+            pass

@@ -1,0 +1,43 @@
+"""Light-NAS search driver (reference
+contrib/slim/nas/light_nas_strategy.py): wraps a SearchSpace + SA
+controller; each search step proposes tokens, the caller's eval_func
+trains/evaluates the candidate and returns a reward (optionally
+penalized by a latency/flops constraint)."""
+from .controller import SAController
+from .controller_server import ControllerClient, ControllerServer
+
+
+class LightNASStrategy:
+    def __init__(self, search_space, eval_func, search_steps=50,
+                 reduce_rate=0.85, init_temperature=1024,
+                 server_address=None, constrain_func=None, seed=None):
+        """eval_func(tokens) -> reward (higher is better)."""
+        self._space = search_space
+        self._eval = eval_func
+        self._steps = int(search_steps)
+        self._controller = SAController(
+            reduce_rate=reduce_rate, init_temperature=init_temperature,
+            seed=seed)
+        self._controller.reset(search_space.range_table(),
+                               search_space.init_tokens(),
+                               constrain_func)
+        self._server = None
+        self._client = None
+        if server_address is not None:
+            self._server = ControllerServer(self._controller,
+                                            address=server_address)
+            addr = self._server.start()
+            self._client = ControllerClient(addr)
+
+    def search(self):
+        """Run the SA loop; returns (best_tokens, max_reward)."""
+        ctrl = self._client or self._controller
+        try:
+            for _ in range(self._steps):
+                tokens = ctrl.next_tokens()
+                reward = float(self._eval(tokens))
+                ctrl.update(tokens, reward)
+        finally:
+            if self._server is not None:
+                self._server.close()
+        return self._controller.best_tokens, self._controller.max_reward

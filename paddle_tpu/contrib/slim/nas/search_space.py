@@ -1,0 +1,17 @@
+"""Search-space contract (reference contrib/slim/nas/search_space.py):
+a space exposes init_tokens / range_table / create_net(tokens)."""
+
+
+class SearchSpace:
+    def init_tokens(self):
+        """Initial token vector."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-position token range: tokens[i] in [0, range_table()[i])."""
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """Build (startup_program, train_program, eval_program, ...) or
+        any model handle for the given tokens."""
+        raise NotImplementedError

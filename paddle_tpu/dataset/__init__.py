@@ -1,0 +1,16 @@
+"""paddle.dataset — canned dataset reader creators (reference
+python/paddle/dataset/: mnist.py, cifar.py, imdb.py, imikolov.py,
+uci_housing.py — each module exposes train()/test() returning reader
+creators that yield one sample tuple per next()).
+
+Offline note (documented divergence): the reference downloads from
+dataset mirrors at import time; this environment has no egress, so each
+module first looks for a local copy under $PADDLE_TPU_DATA_HOME (same
+file formats as the reference's cache dir) and otherwise serves a
+DETERMINISTIC SYNTHETIC sample stream with the real dataset's shapes,
+dtypes, vocabulary sizes and label ranges — enough for the book tests'
+convergence gates and any pipeline code, clearly not for real accuracy
+numbers."""
+from . import mnist, cifar, imdb, imikolov, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing"]

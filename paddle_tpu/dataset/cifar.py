@@ -1,0 +1,71 @@
+"""CIFAR reader creators (reference python/paddle/dataset/cifar.py:
+train10()/test10() yield (image float32 [3072] in [0, 1], label int);
+train100()/test100() likewise over 100 classes). Local python-pickle
+batches under DATA_HOME/cifar are used when present; else a
+deterministic synthetic class-separable stream."""
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+_TRAIN_N, _TEST_N = 4096, 512
+
+
+def _local_reader(tar_path, sub_name):
+    def reader():
+        with tarfile.open(tar_path, mode="r") as f:
+            names = [n for n in f.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                data = batch[b"data"]
+                labels = batch.get(b"labels", batch.get(b"fine_labels"))
+                for i in range(len(labels)):
+                    yield (data[i].astype(np.float32) / 255.0,
+                           int(labels[i]))
+    return reader
+
+
+def _synthetic_reader(split, n, num_classes):
+    def reader():
+        rng = common.synthetic_rng(f"cifar{num_classes}", split)
+        for _ in range(n):
+            label = int(rng.integers(0, num_classes))
+            img = rng.random(3072).astype(np.float32) * 0.3
+            ch = label % 3
+            blk = label % 16
+            view = img.reshape(3, 32, 32)
+            r, c = divmod(blk, 4)
+            view[ch, r * 8:r * 8 + 8, c * 8:c * 8 + 8] += 0.7
+            yield np.clip(img, 0.0, 1.0), label
+    return reader
+
+
+def train10():
+    p = common.data_path("cifar", "cifar-10-python.tar.gz")
+    if os.path.exists(p):
+        return _local_reader(p, "data_batch")
+    return _synthetic_reader("train", _TRAIN_N, 10)
+
+
+def test10():
+    p = common.data_path("cifar", "cifar-10-python.tar.gz")
+    if os.path.exists(p):
+        return _local_reader(p, "test_batch")
+    return _synthetic_reader("test", _TEST_N, 10)
+
+
+def train100():
+    p = common.data_path("cifar", "cifar-100-python.tar.gz")
+    if os.path.exists(p):
+        return _local_reader(p, "train")
+    return _synthetic_reader("train", _TRAIN_N, 100)
+
+
+def test100():
+    p = common.data_path("cifar", "cifar-100-python.tar.gz")
+    if os.path.exists(p):
+        return _local_reader(p, "test")
+    return _synthetic_reader("test", _TEST_N, 100)

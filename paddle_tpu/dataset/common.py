@@ -1,0 +1,24 @@
+"""Shared dataset plumbing (reference python/paddle/dataset/common.py:
+DATA_HOME + download cache). No egress here: data_home() resolves the
+local cache; synthetic() builds the deterministic fallback RNG."""
+import os
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def data_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def have_local(*parts):
+    return os.path.exists(data_path(*parts))
+
+
+def synthetic_rng(name, split):
+    """Deterministic per-(dataset, split) generator."""
+    seed = abs(hash((name, split))) % (2 ** 31)
+    return np.random.default_rng(seed)

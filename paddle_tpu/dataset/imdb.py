@@ -1,0 +1,39 @@
+"""IMDB sentiment reader creators (reference
+python/paddle/dataset/imdb.py: word_dict(), train(word_dict),
+test(word_dict) yield ([word ids], label 0/1)). Synthetic fallback:
+sentiment is carried by disjoint positive/negative token ranges so
+bag-of-words models converge."""
+import numpy as np
+
+from . import common
+
+_VOCAB = 5149          # reference's imdb.word_dict() size ballpark
+_TRAIN_N, _TEST_N = 2048, 256
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic_reader(split, n):
+    def reader():
+        rng = common.synthetic_rng("imdb", split)
+        for _ in range(n):
+            label = int(rng.integers(0, 2))
+            ln = int(rng.integers(8, 64))
+            base = rng.integers(0, _VOCAB, ln)
+            # sentiment tokens: ids [100, 400) positive, [400, 700) neg
+            sent = rng.integers(100, 400, max(ln // 4, 1)) \
+                if label else rng.integers(400, 700, max(ln // 4, 1))
+            ids = np.concatenate([base, sent])
+            rng.shuffle(ids)
+            yield [int(i) for i in ids], label
+    return reader
+
+
+def train(word_dict=None):
+    return _synthetic_reader("train", _TRAIN_N)
+
+
+def test(word_dict=None):
+    return _synthetic_reader("test", _TEST_N)

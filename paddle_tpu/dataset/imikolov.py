@@ -1,0 +1,46 @@
+"""PTB language-model reader creators (reference
+python/paddle/dataset/imikolov.py: build_dict(), train(word_dict, n)
+yields n-gram tuples). Synthetic fallback: sequences from a fixed
+first-order Markov chain, so n-gram models have real structure to
+learn."""
+import numpy as np
+
+from . import common
+
+_VOCAB = 2073      # reference build_dict default min-freq vocab ballpark
+_TRAIN_N, _TEST_N = 4096, 512
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _chain(rng):
+    # deterministic sparse transition structure: w -> (3w+1) % V mostly
+    def step(w):
+        if rng.random() < 0.8:
+            return (3 * w + 1) % _VOCAB
+        return int(rng.integers(0, _VOCAB))
+    return step
+
+
+def _synthetic_reader(split, total, n):
+    def reader():
+        rng = common.synthetic_rng("imikolov", split)
+        step = _chain(rng)
+        w = int(rng.integers(0, _VOCAB))
+        for _ in range(total):
+            gram = [w]
+            for _ in range(n - 1):
+                w = step(w)
+                gram.append(w)
+            yield tuple(gram)
+    return reader
+
+
+def train(word_dict=None, n=5):
+    return _synthetic_reader("train", _TRAIN_N, n)
+
+
+def test(word_dict=None, n=5):
+    return _synthetic_reader("test", _TEST_N, n)

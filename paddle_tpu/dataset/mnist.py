@@ -1,0 +1,64 @@
+"""MNIST reader creators (reference python/paddle/dataset/mnist.py:
+train()/test() yield (image float32 [784] scaled to [-1, 1], label
+int64 in [0, 10))). Local idx-format files are used when present under
+DATA_HOME/mnist; otherwise a deterministic synthetic stream of
+class-separable images (each class lights a distinct block) so LeNet
+book runs still converge."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from . import common
+
+_TRAIN_N, _TEST_N = 8192, 1024
+
+
+def _local_reader(images_path, labels_path, limit=None):
+    def reader():
+        with gzip.open(labels_path, "rb") as lf:
+            magic, n = struct.unpack(">II", lf.read(8))
+            labels = np.frombuffer(lf.read(), dtype=np.uint8)
+        with gzip.open(images_path, "rb") as imf:
+            magic, n, rows, cols = struct.unpack(">IIII", imf.read(16))
+            images = np.frombuffer(imf.read(), dtype=np.uint8)
+            images = images.reshape(n, rows * cols)
+        count = n if limit is None else min(n, limit)
+        for i in range(count):
+            img = images[i].astype(np.float32) / 127.5 - 1.0
+            yield img, int(labels[i])
+    return reader
+
+
+def _synthetic_reader(split, n):
+    def reader():
+        rng = common.synthetic_rng("mnist", split)
+        for _ in range(n):
+            label = int(rng.integers(0, 10))
+            img = rng.normal(-0.8, 0.15, 784).astype(np.float32)
+            # light up a label-specific 8x8 block: linearly separable
+            r, c = divmod(label, 4)
+            block = np.zeros((28, 28), np.float32)
+            block[r * 9:r * 9 + 8, c * 7:c * 7 + 7] = 1.6
+            img = np.clip(img + block.reshape(-1)
+                          + rng.normal(0, 0.1, 784).astype(np.float32),
+                          -1.0, 1.0).astype(np.float32)
+            yield img, label
+    return reader
+
+
+def train():
+    ip = common.data_path("mnist", "train-images-idx3-ubyte.gz")
+    lp = common.data_path("mnist", "train-labels-idx1-ubyte.gz")
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _local_reader(ip, lp)
+    return _synthetic_reader("train", _TRAIN_N)
+
+
+def test():
+    ip = common.data_path("mnist", "t10k-images-idx3-ubyte.gz")
+    lp = common.data_path("mnist", "t10k-labels-idx1-ubyte.gz")
+    if os.path.exists(ip) and os.path.exists(lp):
+        return _local_reader(ip, lp)
+    return _synthetic_reader("test", _TEST_N)

@@ -1,0 +1,37 @@
+"""UCI housing reader creators (reference
+python/paddle/dataset/uci_housing.py: train()/test() yield (features
+float32 [13] normalized, [price])). Synthetic fallback: a fixed linear
+ground truth + noise, so fit_a_line converges to low loss."""
+import numpy as np
+
+from . import common
+
+_TRAIN_N, _TEST_N = 404, 102
+_W = None
+
+
+def _true_w(rng):
+    global _W
+    if _W is None:
+        _W = rng.standard_normal(13).astype(np.float32)
+    return _W
+
+
+def _synthetic_reader(split, n):
+    def reader():
+        rng = common.synthetic_rng("uci_housing", "w")
+        w = _true_w(rng)
+        rng = common.synthetic_rng("uci_housing", split)
+        for _ in range(n):
+            x = rng.standard_normal(13).astype(np.float32)
+            y = float(x @ w + 0.1 * rng.standard_normal())
+            yield x, np.array([y], np.float32)
+    return reader
+
+
+def train():
+    return _synthetic_reader("train", _TRAIN_N)
+
+
+def test():
+    return _synthetic_reader("test", _TEST_N)

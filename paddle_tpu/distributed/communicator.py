@@ -54,3 +54,132 @@ class GeoCommunicator:
 
     def stop(self):
         self._running = False
+
+
+class AsyncCommunicator:
+    """Merge-N-grads-then-send communicator (reference
+    operators/distributed/communicator.h:237 AsyncCommunicator::MergeVars
+    + send thread over bounded per-varname queues). The trainer calls
+    `push(name, grad)` after each step; a background thread drains each
+    var's queue, AVERAGES up to `max_merge_var_num` pending grads into
+    one send, and periodically refreshes params from the pserver."""
+
+    def __init__(self, epmap, max_merge_var_num=20, send_queue_size=20,
+                 recv_steps=100, scope=None):
+        import queue
+        import threading
+        from ..framework.executor import global_scope
+        self.epmap = dict(epmap)       # grad/param name -> endpoint
+        self.max_merge = int(max_merge_var_num)
+        self.recv_steps = int(recv_steps)
+        self.scope = scope or global_scope()
+        self._queues = {p: queue.Queue(maxsize=int(send_queue_size))
+                        for p in self.epmap}
+        self._threading = threading
+        self._stop = threading.Event()
+        self._threads = []
+        self._inflight = 0           # grads popped but not yet sent
+        self._inflight_cv = threading.Condition()
+
+    # -- trainer-facing ---------------------------------------------------
+    def push(self, name, grad):
+        """Blocks when the var's queue is full (the reference's bounded
+        BlockingQueue backpressure)."""
+        self._queues[name].put(np.asarray(grad))
+
+    def recv(self):
+        """Pull fresh params into the scope (reference RecvByCommunicator)."""
+        from .ps import PSClient
+        cli = PSClient.instance()
+        for p, ep in self.epmap.items():
+            self.scope.set(p, np.asarray(cli.pull_dense(ep, p)))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        from .ps import PSClient
+        self._stop.clear()
+
+        def send_loop(name, ep):
+            cli = PSClient.instance()
+            q = self._queues[name]
+            import queue as _q
+            while not self._stop.is_set():
+                try:
+                    first = q.get(timeout=0.05)
+                except _q.Empty:
+                    continue
+                with self._inflight_cv:
+                    self._inflight += 1
+                try:
+                    merged = [first]
+                    while len(merged) < self.max_merge:
+                        try:
+                            merged.append(q.get_nowait())
+                        except _q.Empty:
+                            break
+                    # MergeVars: average the pending grads into one send
+                    grad = np.mean(np.stack(merged), axis=0)
+                    cli.push_dense(ep, name, grad)
+                finally:
+                    with self._inflight_cv:
+                        self._inflight -= 1
+                        self._inflight_cv.notify_all()
+
+        for p, ep in self.epmap.items():
+            t = self._threading.Thread(target=send_loop, args=(p, ep),
+                                       daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def flush(self):
+        """Drain every queue AND wait for in-flight sends to land on the
+        pserver (the barrier/sync contracts need the updates applied, not
+        merely dequeued)."""
+        import time
+        while any(not q.empty() for q in self._queues.values()):
+            if self._stop.is_set():
+                break
+            time.sleep(0.01)
+        with self._inflight_cv:
+            self._inflight_cv.wait_for(
+                lambda: self._inflight == 0 or self._stop.is_set(),
+                timeout=120.0)
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+
+class HalfAsyncCommunicator(AsyncCommunicator):
+    """reference communicator.h:299: async sends, but `barrier()` blocks
+    until every queued grad is merged+sent and fresh params are pulled —
+    the trainer's half-async consistency point (used each epoch/eval)."""
+
+    def barrier(self):
+        self.flush()
+        self.recv()
+
+
+class SyncCommunicator(AsyncCommunicator):
+    """reference communicator.h:365: per-step send + wait. `step(grads)`
+    pushes this step's grads, waits for the sends, and pulls fresh
+    params — no background staleness."""
+
+    def __init__(self, epmap, trainers=1, trainer_id=0, scope=None):
+        super().__init__(epmap, max_merge_var_num=1, send_queue_size=2,
+                         scope=scope)
+        self.trainers = int(trainers)
+        self.trainer_id = int(trainer_id)
+
+    def step(self, grads):
+        from .ps import PSClient
+        cli = PSClient.instance()
+        for name, g in grads.items():
+            self.push(name, g)
+        self.flush()
+        cli.send_barrier(sorted(set(self.epmap.values())),
+                         trainer_id=self.trainer_id)
+        self.recv()

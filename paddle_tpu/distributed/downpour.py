@@ -1,0 +1,184 @@
+"""Downpour-class async CTR runtime (reference
+/root/reference/paddle/fluid/framework/fleet/fleet_wrapper.h:59
+FleetWrapper — PullSparseVarsSync :86, PushSparseVarsWithLabelAsync :158
+— and framework/downpour_worker.cc:760 DownpourWorker::TrainFiles).
+
+TPU-native shape: the dense model step is one compiled XLA module; the
+sparse side stays a host runtime — per-slot feature tables live on the
+pservers (accessor rows with show/click stats, created on demand), the
+trainer pulls embeddings for a batch on the host, feeds them as dense
+inputs, and pushes gradients + label stats back asynchronously on a
+thread pool, overlapping RPC with the next step's compute the way
+DownpourWorker overlaps pull/train/push."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .ps import PSClient
+
+
+class DownpourTableConfig:
+    """Per-table accessor config (the pslib table proto's knobs)."""
+
+    def __init__(self, table_id, emb_dim, slots, lr=0.05, init_range=0.01,
+                 optimizer="sgd", nonclk_coeff=0.1, clk_coeff=1.0):
+        self.table_id = int(table_id)
+        self.emb_dim = int(emb_dim)
+        self.slots = list(slots)        # feed var names of the id slots
+        self.accessor = {"lr": lr, "init_range": init_range,
+                         "optimizer": optimizer,
+                         "nonclk_coeff": nonclk_coeff,
+                         "clk_coeff": clk_coeff}
+
+
+class FleetWrapper:
+    """Client-side pull/push batching over the PS shards (reference
+    fleet_wrapper.h). Feature ids shard to servers by id % n_servers;
+    one RPC per (server, table) per call, duplicate ids pulled once."""
+
+    def __init__(self, endpoints, async_push=True, max_pending=8):
+        self.endpoints = list(endpoints)
+        self.cli = PSClient.instance("downpour")
+        self._pool = (ThreadPoolExecutor(max_workers=len(endpoints))
+                      if async_push else None)
+        self._pending = []
+        self._pending_lock = threading.Lock()
+        self._max_pending = int(max_pending)
+
+    def _shard(self, fid):
+        return int(fid) % len(self.endpoints)
+
+    def pull_sparse(self, table_id, ids):
+        """ids: int array (any shape) -> embeddings [ids.size, dim].
+        Duplicates resolved client-side — each unique id crosses the wire
+        once (reference PullSparseVarsSync dedups the same way)."""
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        shards = [self._shard(f) for f in uniq]
+        rows = [None] * len(uniq)
+        for s, ep in enumerate(self.endpoints):
+            sel = [i for i, sh in enumerate(shards) if sh == s]
+            if not sel:
+                continue
+            got = self.cli.dp_pull(ep, table_id, uniq[sel])
+            for i, r in zip(sel, np.asarray(got)):
+                rows[i] = r
+        table = np.stack(rows) if rows else np.zeros((0, 0), np.float32)
+        return table[inverse]
+
+    def push_sparse_with_label(self, table_id, ids, grads, labels):
+        """Async push of per-occurrence grads + show/click stats derived
+        from the batch labels (reference PushSparseVarsWithLabelAsync):
+        every occurrence counts show += 1, click += label. Client-side
+        merge: duplicate ids sum their grads before the RPC."""
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads).reshape(len(flat), -1)
+        labels = np.asarray(labels).reshape(-1)
+        if labels.size != len(flat):
+            if len(flat) % labels.size:
+                raise ValueError(
+                    f"push_sparse_with_label: {len(flat)} id occurrences "
+                    f"vs {labels.size} labels (need per-occurrence labels "
+                    f"or a per-sample vector tiling evenly over slots)")
+            # ids are slot-major concat of per-sample slots: tile labels
+            labels = np.tile(labels, len(flat) // labels.size)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        g_sum = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(g_sum, inverse, grads)
+        shows = np.zeros(len(uniq), np.float32)
+        clicks = np.zeros(len(uniq), np.float32)
+        np.add.at(shows, inverse, 1.0)
+        np.add.at(clicks, inverse, labels.astype(np.float32))
+
+        def do_push(ep, sel):
+            self.cli.dp_push(ep, table_id, uniq[sel], g_sum[sel],
+                             shows[sel], clicks[sel])
+
+        shards = np.array([self._shard(f) for f in uniq])
+        for s, ep in enumerate(self.endpoints):
+            sel = np.nonzero(shards == s)[0]
+            if not len(sel):
+                continue
+            if self._pool is None:
+                do_push(ep, sel)
+            else:
+                with self._pending_lock:
+                    if len(self._pending) >= self._max_pending:
+                        self._drain_locked()
+                    self._pending.append(
+                        self._pool.submit(do_push, ep, sel))
+
+    def _drain_locked(self):
+        for f in self._pending:
+            f.result()
+        self._pending.clear()
+
+    def flush(self):
+        """Barrier for outstanding async pushes (reference
+        FleetWrapper's per-batch push-future wait)."""
+        with self._pending_lock:
+            self._drain_locked()
+
+    def table_stat(self, table_id):
+        """Aggregated (rows, show, click) across shards."""
+        tot = {"rows": 0, "show": 0.0, "click": 0.0}
+        for ep in self.endpoints:
+            st = self.cli.dp_stat(ep, table_id)
+            for k in tot:
+                tot[k] += st[k]
+        return tot
+
+
+class DownpourWorker:
+    """Async ingest-train loop (reference downpour_worker.cc:760
+    TrainFiles): for each batch — pull sparse embeddings (prefetched on a
+    background thread while the previous step computes), run the dense
+    step, push grads + label stats async."""
+
+    def __init__(self, fleet, table, step_fn, id_slots, label_key):
+        """step_fn(batch, emb [N, dim]) -> (loss, emb_grads [N, dim]);
+        id_slots: batch keys holding feature ids; label_key: batch key
+        with the 0/1 click labels."""
+        self.fleet = fleet
+        self.table = table
+        self.step_fn = step_fn
+        self.id_slots = list(id_slots)
+        self.label_key = label_key
+
+    def _ids_of(self, batch):
+        return np.concatenate(
+            [np.asarray(batch[s]).reshape(-1) for s in self.id_slots])
+
+    def train(self, batches):
+        """Run the loop over an iterable of feed dicts; returns the loss
+        history. Pull(i+1) overlaps step(i) via a prefetch thread."""
+        losses = []
+        it = iter(batches)
+        try:
+            batch = next(it)
+        except StopIteration:
+            return losses
+        pulled = self.fleet.pull_sparse(self.table.table_id,
+                                        self._ids_of(batch))
+        pool = ThreadPoolExecutor(max_workers=1)
+        while True:
+            try:
+                nxt = next(it)
+            except StopIteration:
+                nxt = None
+            fut = None
+            if nxt is not None:
+                fut = pool.submit(self.fleet.pull_sparse,
+                                  self.table.table_id, self._ids_of(nxt))
+            loss, emb_grads = self.step_fn(batch, pulled)
+            losses.append(float(loss))
+            self.fleet.push_sparse_with_label(
+                self.table.table_id, self._ids_of(batch), emb_grads,
+                batch[self.label_key])
+            if nxt is None:
+                break
+            batch, pulled = nxt, fut.result()
+        pool.shutdown()
+        self.fleet.flush()
+        return losses

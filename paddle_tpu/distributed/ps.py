@@ -128,6 +128,7 @@ class ParameterServer:
         self._arrived = set()     # trainer ids at the barrier this round
         self._round_wait_start = None
         self.tables = {}          # var name -> np.ndarray
+        self.downpour_tables = {}  # table id -> accessor table
         self.optimize_blocks = {}  # param name -> [op dicts]
         self.lr_map = {}          # param name -> {lr var name: value}
         self.sparse_lr = {}       # sparse table name -> lr
@@ -151,6 +152,37 @@ class ParameterServer:
     def host_sparse_table(self, name, value, lr=0.01):
         self.tables[name] = np.asarray(value)
         self.sparse_lr[name] = float(lr)
+
+    def host_downpour_table(self, table_id, emb_dim, accessor=None):
+        """Production CTR sparse table (reference
+        framework/fleet/fleet_wrapper.h:59 + the pslib DownpourCtrAccessor
+        semantics): feature rows are created ON DEMAND at first pull; each
+        row carries (show, click, embedding[emb_dim]) plus per-row
+        optimizer state. accessor keys: lr, init_range, optimizer
+        ("sgd"|"adagrad"), nonclk_coeff/clk_coeff (show/click weighting,
+        kept for stat parity)."""
+        acc = dict(accessor or {})
+        acc.setdefault("lr", 0.05)
+        acc.setdefault("init_range", 0.01)
+        acc.setdefault("optimizer", "sgd")
+        acc.setdefault("nonclk_coeff", 0.1)
+        acc.setdefault("clk_coeff", 1.0)
+        self.downpour_tables[int(table_id)] = {
+            "dim": int(emb_dim), "accessor": acc,
+            "rows": {},          # feature id -> row dict
+            "rng": np.random.default_rng(int(table_id) + 17),
+        }
+
+    def _dp_row(self, tbl, fid):
+        row = tbl["rows"].get(int(fid))
+        if row is None:
+            rng, dim = tbl["rng"], tbl["dim"]
+            init = tbl["accessor"]["init_range"]
+            row = {"show": 0.0, "click": 0.0,
+                   "emb": rng.uniform(-init, init, dim).astype(np.float32),
+                   "g2": np.zeros(dim, np.float32)}
+            tbl["rows"][int(fid)] = row
+        return row
 
     # -- serving -----------------------------------------------------------
     def serve(self, ready_event=None, block=True):
@@ -390,6 +422,49 @@ class ParameterServer:
                     if entry[1] >= nranks:
                         st["results"].pop(r, None)
             return ("val", result)
+        if kind == "dp_pull":
+            # batched downpour pull: rows auto-create (accessor behavior)
+            _, table_id, ids = msg
+            tbl = self.downpour_tables[int(table_id)]
+            flat = np.asarray(ids).reshape(-1)
+            with self._cv:
+                if len(flat):
+                    out = np.stack([self._dp_row(tbl, f)["emb"]
+                                    for f in flat])
+                else:
+                    out = np.zeros((0, tbl["dim"]), np.float32)
+            return ("val", out)
+        if kind == "dp_push":
+            # grads + show/click stats in one message (reference
+            # PushSparseVarsWithLabelAsync fleet_wrapper.h:158)
+            _, table_id, ids, grads, shows, clicks = msg
+            tbl = self.downpour_tables[int(table_id)]
+            acc = tbl["accessor"]
+            lr = acc["lr"]
+            ids = np.asarray(ids).reshape(-1)
+            grads = np.asarray(grads).reshape(len(ids), -1)
+            shows = np.asarray(shows).reshape(-1)
+            clicks = np.asarray(clicks).reshape(-1)
+            with self._cv:
+                for f, g, s, c in zip(ids, grads, shows, clicks):
+                    row = self._dp_row(tbl, f)
+                    row["show"] += float(s)
+                    row["click"] += float(c)
+                    if acc["optimizer"] == "adagrad":
+                        row["g2"] += g * g
+                        row["emb"] -= lr * g / np.sqrt(row["g2"] + 1e-6)
+                    else:
+                        row["emb"] -= lr * g
+            return ("ok",)
+        if kind == "dp_stat":
+            _, table_id = msg
+            tbl = self.downpour_tables[int(table_id)]
+            with self._cv:
+                n = len(tbl["rows"])
+                show = float(sum(r["show"] for r in tbl["rows"].values()))
+                click = float(sum(r["click"]
+                                  for r in tbl["rows"].values()))
+            return ("val", {"rows": n, "show": show, "click": click})
         if kind == "barrier_ping":
             return ("ok",)
         if kind == "stop":
@@ -463,6 +538,18 @@ class PSClient:
     def push_sparse(self, endpoint, name, ids, rows):
         self._call(endpoint, ("push_sparse", name, np.asarray(ids),
                               np.asarray(rows)))
+
+    def dp_pull(self, endpoint, table_id, ids):
+        return self._call(endpoint, ("dp_pull", int(table_id),
+                                     np.asarray(ids)))
+
+    def dp_push(self, endpoint, table_id, ids, grads, shows, clicks):
+        self._call(endpoint, ("dp_push", int(table_id), np.asarray(ids),
+                              np.asarray(grads), np.asarray(shows),
+                              np.asarray(clicks)))
+
+    def dp_stat(self, endpoint, table_id):
+        return self._call(endpoint, ("dp_stat", int(table_id)))
 
     def stop_servers(self, endpoints):
         for ep in dict.fromkeys(endpoints):

@@ -16,5 +16,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     LinearLrWarmup, ReduceLROnPlateau,
 )
 from .parallel import DataParallel, ParallelStrategy, prepare_context, Env  # noqa: F401
-from .jit import TracedLayer, ProgramTranslator, declarative  # noqa: F401
+from .jit import (  # noqa: F401
+    TracedLayer, ProgramTranslator, declarative, jit_step, CompiledStep,
+)
 from . import jit  # noqa: F401

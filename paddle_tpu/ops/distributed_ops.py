@@ -154,3 +154,81 @@ def distributed_lookup_table_grad(ctx, ins, attrs):
     # the server applied the update; only the stub's zero grad flows locally
     w = x_of(ins, "W")
     return {"W@GRAD": [jnp.zeros_like(w)]}
+
+
+# ---- pslib/Downpour sparse ops (reference operators/pull_sparse_op.cc,
+# push_sparse ops generated alongside; the host runtime lives in
+# distributed/downpour.py) ----
+
+def _fleet_of(attrs):
+    from ..distributed.downpour import FleetWrapper
+    eps = list(attrs["endpoints"])
+    key = tuple(eps)
+    cache = _fleet_of.__dict__.setdefault("_cache", {})
+    fw = cache.get(key)
+    if fw is None:
+        fw = FleetWrapper(eps, async_push=False)
+        cache[key] = fw
+    return fw
+
+
+@register_op("pull_sparse", grad=False, infer_shape=False)
+def pull_sparse_op(ctx, ins, attrs):
+    """Pull downpour rows for each Ids input -> Out embeddings
+    [..., emb_dim] (reference pull_sparse_op.cc; v2 shares the
+    lowering)."""
+    dim = int(attrs["EmbeddingDim"])
+    table = int(attrs.get("TableId", 0))
+    ids_list = [jnp.asarray(v) for v in ins["Ids"]]
+
+    def do_pull(*ids_arrs):
+        fw = _fleet_of(attrs)
+        outs = []
+        for a in ids_arrs:
+            a = np.asarray(a)
+            emb = fw.pull_sparse(table, a).astype(np.float32)
+            outs.append(emb.reshape(a.shape + (dim,)))
+        return tuple(outs)
+
+    shapes = tuple(jax.ShapeDtypeStruct(tuple(a.shape) + (dim,),
+                                        jnp.float32) for a in ids_list)
+    outs = io_callback(do_pull, shapes, *ids_list, ordered=True)
+    return {"Out": list(outs)}
+
+
+@register_op("pull_sparse_v2", grad=False, infer_shape=False)
+def pull_sparse_v2_op(ctx, ins, attrs):
+    return pull_sparse_op(ctx, ins, attrs)
+
+
+@register_op("push_sparse", grad=False, infer_shape=False)
+def push_sparse_op(ctx, ins, attrs):
+    """Push grads + show/click stats for each Ids/Grads pair (reference
+    push_sparse semantics of pull_sparse_op.cc's grad)."""
+    table = int(attrs.get("TableId", 0))
+    ids_list = [jnp.asarray(v) for v in ins["Ids"]]
+    grad_list = [jnp.asarray(v) for v in ins["Grads"]]
+    labels = ins.get("Labels")
+    lab = (jnp.asarray(labels[0]) if labels
+           else jnp.zeros((1,), jnp.float32))
+
+    def do_push(lab_a, *flat):
+        fw = _fleet_of(attrs)
+        n = len(flat) // 2
+        for a, g in zip(flat[:n], flat[n:]):
+            a = np.asarray(a)
+            g = np.asarray(g).reshape(a.size, -1)
+            lv = np.asarray(lab_a)
+            if lv.size <= 1:
+                lv = np.zeros(a.size, np.float32)
+            fw.push_sparse_with_label(table, a, g, lv)
+        return np.zeros((), np.int32)
+
+    io_callback(do_push, jax.ShapeDtypeStruct((), jnp.int32), lab,
+                *ids_list, *grad_list, ordered=True)
+    return None
+
+
+@register_op("push_sparse_v2", grad=False, infer_shape=False)
+def push_sparse_v2_op(ctx, ins, attrs):
+    return push_sparse_op(ctx, ins, attrs)

@@ -305,3 +305,13 @@ def inverse(ctx, ins, attrs):
 @register_op("matrix_power")
 def matrix_power(ctx, ins, attrs):
     return {"Out": jnp.linalg.matrix_power(x_of(ins), attrs["n"])}
+
+
+@register_op("einsum")
+def einsum(ctx, ins, attrs):
+    """Einstein summation over the Operands list (paddle 2.x einsum API;
+    also the internal attention path's way to express head-split matmuls
+    without materializing transposed copies — XLA folds the permutations
+    into the dot's dimension numbers)."""
+    ops = [jnp.asarray(v) for v in ins["Operands"]]
+    return {"Out": jnp.einsum(attrs["equation"], *ops)}

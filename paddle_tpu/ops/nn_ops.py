@@ -397,22 +397,30 @@ def sync_batch_norm(ctx, ins, attrs):
 
 @register_op("layer_norm")
 def layer_norm(ctx, ins, attrs):
+    """Stats always accumulate in fp32 (in-register — XLA fuses the
+    upcast into the reduction), output in the input dtype. This makes
+    bf16-resident layer_norm numerically safe, so AMP can keep LN
+    activations in bf16 instead of spilling fp32 copies to HBM."""
     x = x_of(ins)
     scale = x_of(ins, "Scale")
     bias = x_of(ins, "Bias")
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    m = jnp.mean(x, axis=axes, keepdims=True)
-    v = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - m) * jax.lax.rsqrt(v + eps)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
     norm_shape = x.shape[begin:]
     if scale is not None:
-        y = y * scale.reshape((1,) * begin + norm_shape)
+        y = y * scale.astype(jnp.float32).reshape(
+            (1,) * begin + norm_shape)
     if bias is not None:
-        y = y + bias.reshape((1,) * begin + norm_shape)
+        y = y + bias.astype(jnp.float32).reshape(
+            (1,) * begin + norm_shape)
     lead = x.shape[:begin]
-    return {"Y": y, "Mean": m.reshape(lead), "Variance": v.reshape(lead)}
+    return {"Y": y.astype(x.dtype), "Mean": m.reshape(lead),
+            "Variance": v.reshape(lead)}
 
 
 @register_op("instance_norm")
@@ -466,6 +474,8 @@ def dropout(ctx, ins, attrs):
     p = attrs.get("dropout_prob", 0.5)
     is_test = attrs.get("is_test", False)
     impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if p == 0.0:                    # identity: skip mask generation
+        return {"Out": x, "Mask": jnp.ones_like(x)}
     if is_test:
         if impl == "upscale_in_train":
             return {"Out": x, "Mask": jnp.ones_like(x)}

@@ -171,11 +171,13 @@ def test_fleet_fs_and_util():
         assert fs.is_exist(os.path.join(d, "b", "f.txt"))
         fs.delete(os.path.join(d, "b"))
         assert not fs.is_exist(os.path.join(d, "b"))
-    try:
-        HDFSClient()
-        raise AssertionError("expected NotImplementedError")
-    except NotImplementedError as e:
-        assert "LocalFS" in str(e)
+    # HDFSClient now degrades to a LocalFS sandbox when no hadoop CLI
+    # exists (round 3); full behavior covered by test_communicators
+    import tempfile as _tf
+    with _tf.TemporaryDirectory() as hd:
+        h = HDFSClient(local_root=hd)
+        h.mkdirs("/x")
+        assert h.is_exist("/x")
 
     util = FleetUtil()
     # single-process all-reduce is identity; auc matches metrics.Auc

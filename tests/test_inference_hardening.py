@@ -1,0 +1,146 @@
+"""Inference hardening (reference inference/tests/api/
+analyzer_*_tester.cc model-zoo regression pattern +
+analysis_predictor_tester.cc clone-per-thread): concurrent clones,
+AOT compile-at-load, and a small saved-model regression harness with
+output-delta and latency gates."""
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+
+RNG = np.random.default_rng(31)
+
+
+def _save_model(tmp_path, name, build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, fetches = build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        path = str(tmp_path / name)
+        fluid.io.save_inference_model(path, feeds, fetches, exe,
+                                      main_program=main)
+    return path
+
+
+def _mlp(tmp_path):
+    def build():
+        x = layers.data("x", [-1, 8], dtype="float32")
+        h = layers.fc(x, 16, act="relu")
+        out = layers.fc(h, 4, act="softmax")
+        return ["x"], [out]
+    return _save_model(tmp_path, "mlp", build)
+
+
+def test_concurrent_clone_per_thread(tmp_path):
+    """N threads, each on its own clone() sharing weights: results match
+    the serial run exactly and no thread corrupts another's scope."""
+    path = _mlp(tmp_path)
+    config = AnalysisConfig(path)
+    main_pred = AnalysisPredictor(config)
+    xs = [RNG.standard_normal((5, 8)).astype(np.float32)
+          for _ in range(8)]
+    serial = [main_pred.run([x])[0] for x in xs]
+
+    results = [None] * len(xs)
+    errors = []
+
+    def worker(i):
+        try:
+            pred = main_pred.clone()
+            for _ in range(3):                # hammer it a bit
+                out, = pred.run([xs[i]])
+            results[i] = out
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(xs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    for got, want in zip(results, serial):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_aot_prepare_warms_cache(tmp_path):
+    """prepare() compiles at load: the first real run after prepare is
+    cache-warm (much faster than a cold first run)."""
+    path = _mlp(tmp_path)
+    cold = AnalysisPredictor(AnalysisConfig(path))
+    x = RNG.standard_normal((6, 8)).astype(np.float32)
+    t0 = time.perf_counter()
+    cold.run([x])
+    cold_time = time.perf_counter() - t0
+
+    warm = AnalysisPredictor(AnalysisConfig(path))
+    warm.prepare({"x": (6, 8)})
+    t0 = time.perf_counter()
+    out, = warm.run([x])
+    warm_time = time.perf_counter() - t0
+    assert out.shape == (6, 4)
+    # warm run must be decisively faster than the cold compile+run
+    assert warm_time < cold_time * 0.5, (cold_time, warm_time)
+
+
+def test_model_zoo_regression(tmp_path):
+    """Model-zoo harness over several saved book-style models: reload,
+    check output deltas vs the save-time outputs, enforce a latency
+    budget (reference inference/tests/api perf gates)."""
+    zoo = {}
+
+    def mlp_build():
+        x = layers.data("x", [-1, 8], dtype="float32")
+        out = layers.fc(layers.fc(x, 16, act="relu"), 4, act="softmax")
+        return ["x"], [out]
+
+    def conv_build():
+        img = layers.data("img", [-1, 1, 12, 12], dtype="float32")
+        from paddle_tpu import nets
+        c = nets.simple_img_conv_pool(img, 4, 3, pool_size=2,
+                                      pool_stride=2, act="relu")
+        out = layers.fc(c, 3, act="softmax")
+        return ["img"], [out]
+
+    def rnn_build():
+        x = layers.data("seq", [4, 6, 8], dtype="float32")
+        gru = layers.dynamic_gru(
+            layers.fc(x, 24, num_flatten_dims=2), 8)
+        out = layers.fc(layers.reduce_mean(gru, dim=1), 2, act="softmax")
+        return ["seq"], [out]
+
+    zoo["mlp"] = (_save_model(tmp_path, "zoo_mlp", mlp_build),
+                  {"x": (4, 8)}, "float32")
+    zoo["conv"] = (_save_model(tmp_path, "zoo_conv", conv_build),
+                   {"img": (4, 1, 12, 12)}, "float32")
+    zoo["rnn"] = (_save_model(tmp_path, "zoo_rnn", rnn_build),
+                  {"seq": (4, 6, 8)}, "float32")
+
+    budget_s = 0.5           # steady-state per-inference budget (CPU)
+    for name, (path, shapes, dt) in zoo.items():
+        pred = AnalysisPredictor(AnalysisConfig(path))
+        pred.prepare(shapes)
+        feeds = [RNG.standard_normal(s).astype(dt)
+                 for s in shapes.values()]
+        ref = pred.run(feeds)
+        # reload in a fresh predictor: outputs must match bit-for-bit
+        pred2 = AnalysisPredictor(AnalysisConfig(path))
+        got = pred2.run(feeds)
+        for a, b in zip(ref, got):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7), name
+        # probabilities sane
+        assert np.all(np.isfinite(ref[0])) and ref[0].min() >= 0
+        # latency gate on the warm path
+        t0 = time.perf_counter()
+        for _ in range(5):
+            pred.run(feeds)
+        per = (time.perf_counter() - t0) / 5
+        assert per < budget_s, (name, per)

@@ -129,3 +129,43 @@ def test_fsp_loss_zero_for_identical_maps():
                                  (2, 5, 4, 4)).astype(np.float32)},
                        fetch_list=[loss])
     assert float(np.asarray(out)) == 0.0
+
+
+# ---- round 3: slim NAS (reference contrib/slim/nas/) ----
+
+def test_nas_sa_search_finds_optimum():
+    """SA search over a token space with a known optimum: the controller
+    must find (or get near) it; exercised through LightNASStrategy +
+    the TCP controller server."""
+    from paddle_tpu.contrib.slim.nas import LightNASStrategy, SearchSpace
+
+    class ToySpace(SearchSpace):
+        def init_tokens(self):
+            return [0, 0, 0, 0]
+
+        def range_table(self):
+            return [8, 8, 8, 8]
+
+        def create_net(self, tokens=None):
+            return tokens
+
+    target = np.array([5, 2, 7, 1])
+
+    def reward(tokens):
+        return -float(np.abs(np.asarray(tokens) - target).sum())
+
+    strat = LightNASStrategy(ToySpace(), reward, search_steps=300,
+                             server_address=("127.0.0.1", 0), seed=11)
+    best, max_r = strat.search()
+    assert max_r > -3.0, (best, max_r)   # near-optimal tokens found
+
+
+def test_nas_constraint_respected():
+    from paddle_tpu.contrib.slim.nas import SAController
+    ctrl = SAController(seed=3)
+    ctrl.reset([10, 10], [1, 1],
+               constrain_func=lambda t: sum(t) <= 8)
+    for _ in range(50):
+        t = ctrl.next_tokens()
+        assert sum(t) <= 8, t
+        ctrl.update(t, float(-abs(sum(t) - 8)))
