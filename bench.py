@@ -1,15 +1,18 @@
 """Benchmarks for the BASELINE.json config matrix. Prints one JSON line
-per config; the FIRST line is the headline metric.
+per config as it completes; the LAST line is the headline summary — the
+flagship metric (config 3) with a "configs" field aggregating every
+config's {value, unit, mfu, vs_baseline}. The driver records the last
+JSON line, so the headline must be emitted last.
 
-Default (no args): every BASELINE config, flagship first — config 3,
-BERT-base pretrain step throughput, bf16 AMP (the reference's
-Fleet-collective path). The anchor is read from BASELINE.json "published"
-(V100 fp16 seq-128 BERT-base pretrain throughput); the north star asks
-for >= anchor/1.2 per chip. Fresh batches stream through the DataLoader
-each step (no cached-feed flattery), precision is bf16 with fp32 master
-weights via contrib.mixed_precision, steps dispatch asynchronously with a
-hard fetch per timing window, and MFU is reported against the chip's peak
-bf16 FLOPs.
+Flagship: config 3, BERT-base pretrain step throughput, bf16 AMP (the
+reference's Fleet-collective path). The anchor is read from BASELINE.json
+"published" (V100 fp16 seq-128 BERT-base pretrain throughput); the north
+star asks for >= anchor/1.2 per chip. Fresh batches stream through the
+DataLoader each step (no cached-feed flattery), precision is bf16 with
+fp32 master weights via contrib.mixed_precision, steps dispatch
+asynchronously with a hard fetch per timing window, and MFU is reported
+against the chip's peak bf16 FLOPs using XLA's own cost analysis of the
+compiled step (fallback: analytic matmul FLOPs).
 
 --config selects a single config (same protocol; absolute
 throughput, vs_baseline only where BASELINE.json stores an anchor):
@@ -42,6 +45,83 @@ def _peak_flops(device):
     for key, tf in _PEAK_TFLOPS.items():
         if key in kind:
             return tf * 1e12
+    return None
+
+
+def _step_cost(exe, scope, feed, prog):
+    """XLA cost analysis of the compiled train step sitting in the
+    executor's program cache: {flops, bytes} per step. Reconstructs the
+    jitted callable's argument binding the way Executor.run does, lowers,
+    and reads compiled.cost_analysis() — the same measurement the
+    flagship roofline in BENCHMARKS.md uses. Returns None where the
+    backend can't report costs."""
+    from paddle_tpu.framework.executor import RNG_STATE_NAME
+    try:
+        jitted, state_in, state_out = next(
+            v for k, v in exe._cache.items() if k[0] == prog._uid)
+        state_out_set = set(state_out)
+        state_mut, state_ro = {}, {}
+        for n in state_in:
+            v = scope.find_var(n)
+            (state_mut if n in state_out_set else state_ro)[n] = v
+        key = scope.find_var(RNG_STATE_NAME)
+        compiled = jitted.lower(state_mut, state_ro, feed, key).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
+        if flops <= 0:
+            return None
+        return {"flops": flops, "bytes": nbytes}
+    except Exception:
+        return None
+
+
+def _attach_roofline(result, dev, samples_per_sec, batch, cost,
+                     analytic_flops_per_sample=None):
+    """Add mfu (+ roofline fields when XLA costs are available) to a
+    config's result line. MFU against peak bf16; fp32 configs say so in
+    their metric name."""
+    peak = _peak_flops(dev)
+    if peak is None:
+        return result
+    if cost is not None:
+        flops = cost["flops"]
+        if analytic_flops_per_sample:
+            # XLA cost analysis can miss FLOPs inside Pallas custom calls
+            # (flash attention) — take the larger of measured vs analytic
+            flops = max(flops, analytic_flops_per_sample * batch)
+        achieved = flops * samples_per_sec / batch
+        result["mfu"] = round(achieved / peak, 4)
+        result["flops_per_step"] = round(flops / 1e9, 2)  # GFLOP
+        hbm_peak = _hbm_peak(dev)
+        if cost["bytes"] and hbm_peak:
+            bw = cost["bytes"] * samples_per_sec / batch
+            result["hbm_gb_per_step"] = round(cost["bytes"] / 1e9, 2)
+            result["hbm_bw_util"] = round(bw / hbm_peak, 4)
+            result["arith_intensity"] = round(flops / cost["bytes"], 1)
+    elif analytic_flops_per_sample:
+        result["mfu"] = round(
+            analytic_flops_per_sample * samples_per_sec / peak, 4)
+    return result
+
+
+# chip HBM peak bytes/s by device_kind substring (public specs)
+_HBM_PEAK = {
+    "v5 lite": 819e9, "v5e": 819e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+    "v6": 1638e9,
+}
+
+
+def _hbm_peak(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, b in _HBM_PEAK.items():
+        if key in kind:
+            return b
     return None
 
 
@@ -146,12 +226,12 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(value / anchor, 4),
     }
-    peak = _peak_flops(dev)
-    if on_accel and peak:
-        achieved = _bert_train_flops_per_sample(cfg, seq_len,
-                                                max_preds) * value
-        result["mfu"] = round(achieved / peak, 4)
-    print(json.dumps(result))
+    if on_accel:
+        cost = _step_cost(exe, scope, pool[0], main_prog)
+        _attach_roofline(result, dev, value, batch, cost,
+                         _bert_train_flops_per_sample(cfg, seq_len,
+                                                      max_preds))
+    return result
 
 
 def _device_pool(pool):
@@ -204,25 +284,28 @@ def _time_static(exe, scope, prog, feed_fn, loss_name, steps, warmup,
 
 
 def bench_mnist():
+    import jax
     import paddle_tpu as fluid
     from paddle_tpu.models.lenet import build_lenet_train
     main_prog, startup, feeds, fetches = build_lenet_train()
     batch = 512
     rng = np.random.default_rng(0)
-    feed_fn = _device_pool(
-        [{"img": rng.standard_normal(
-              (batch, 1, 28, 28)).astype(np.float32),
-          "label": rng.integers(0, 10, (batch, 1)).astype(np.int64)}
-         for _ in range(2)])
+    pool = [{"img": rng.standard_normal(
+                 (batch, 1, 28, 28)).astype(np.float32),
+             "label": rng.integers(0, 10, (batch, 1)).astype(np.int64)}
+            for _ in range(2)]
+    feed_fn = _device_pool(pool)
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
     v = _time_static(exe, scope, main_prog, feed_fn, fetches[0].name,
                      40, 5, batch)
-    print(json.dumps({"metric": "mnist_lenet_samples_per_sec",
-                      "value": round(v, 1), "unit": "samples/sec",
-                      "vs_baseline": None}))
+    result = {"metric": "mnist_lenet_samples_per_sec",
+              "value": round(v, 1), "unit": "samples/sec",
+              "vs_baseline": None}
+    return _attach_roofline(result, jax.devices()[0], v, batch,
+                            _step_cost(exe, scope, pool[0], main_prog))
 
 
 def bench_resnet50():
@@ -240,23 +323,26 @@ def bench_resnet50():
                           use_dynamic_loss_scaling=False)
         opt.minimize(out["loss"])
     rng = np.random.default_rng(0)
-    feed_fn = _device_pool(
-        [{"image": rng.standard_normal(
-              (batch, 3, 224, 224)).astype(np.float32),
-          "label": rng.integers(0, 1000, (batch, 1)).astype(np.int64)}
-         for _ in range(2)])
+    pool = [{"image": rng.standard_normal(
+                 (batch, 3, 224, 224)).astype(np.float32),
+             "label": rng.integers(0, 1000, (batch, 1)).astype(np.int64)}
+            for _ in range(2)]
+    feed_fn = _device_pool(pool)
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
     v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
                      20, 5, batch)
-    print(json.dumps({"metric": "resnet50_bf16_images_per_sec_per_chip",
-                      "value": round(v, 1), "unit": "images/sec",
-                      "vs_baseline": None}))
+    result = {"metric": "resnet50_bf16_images_per_sec_per_chip",
+              "value": round(v, 1), "unit": "images/sec",
+              "vs_baseline": None}
+    return _attach_roofline(result, jax.devices()[0], v, batch,
+                            _step_cost(exe, scope, pool[0], main_prog))
 
 
 def bench_widedeep():
+    import jax
     import paddle_tpu as fluid
     from paddle_tpu.models import widedeep
     batch = 4096
@@ -265,17 +351,19 @@ def bench_widedeep():
         out = widedeep.wide_deep(batch_size=batch)
         fluid.optimizer.Adam(1e-3).minimize(out["loss"])
     rng = np.random.default_rng(0)
-    feed_fn = _device_pool(
-        [widedeep.random_batch(batch, rng=rng) for _ in range(2)])
+    pool = [widedeep.random_batch(batch, rng=rng) for _ in range(2)]
+    feed_fn = _device_pool(pool)
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
     v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
                      40, 5, batch)
-    print(json.dumps({"metric": "widedeep_ctr_samples_per_sec_per_chip",
-                      "value": round(v, 1), "unit": "samples/sec",
-                      "vs_baseline": None}))
+    result = {"metric": "widedeep_ctr_samples_per_sec_per_chip",
+              "value": round(v, 1), "unit": "samples/sec",
+              "vs_baseline": None}
+    return _attach_roofline(result, jax.devices()[0], v, batch,
+                            _step_cost(exe, scope, pool[0], main_prog))
 
 
 def bench_dygraph_transformer():
@@ -331,11 +419,42 @@ def bench_dygraph_transformer():
             last = run(i)
         lv = float(last.numpy().reshape(-1)[0])   # hard sync
         dt = time.perf_counter() - t0
+        cost = _jit_step_cost(step, staged[0])
     assert np.isfinite(lv), lv
-    print(json.dumps({
+    v = batch * n / dt
+    result = {
         "metric": "dygraph_transformer_base_samples_per_sec",
-        "value": round(batch * n / dt, 1), "unit": "samples/sec",
-        "vs_baseline": None}))
+        "value": round(v, 1), "unit": "samples/sec",
+        "vs_baseline": None}
+    return _attach_roofline(result, jax.devices()[0], v, batch, cost)
+
+
+def _jit_step_cost(step, big_batch):
+    """Cost-analyze the jit_step executable captured at the REAL batch:
+    rebind the cached pure function's current argument values and lower."""
+    import jax
+    try:
+        entry = next(iter(step._compiled_step._cache.values()))
+        jitted, mut_vars, ro_vars, opt_binding, _ = entry
+        key = jax.random.PRNGKey(0)
+        mut_vals = [v.value for v in mut_vars]
+        ro_vals = [v.value for v in ro_vars]
+        opt_vals = [o._eager_state[pn][slot]
+                    for o, pn, slot in opt_binding]
+        arg_vals = [big_batch[k] for k in ("src_ids", "src_mask",
+                                           "tgt_ids", "labels",
+                                           "label_mask")]
+        ca = jitted.lower(key, mut_vals, ro_vals, opt_vals,
+                          arg_vals).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        if flops <= 0:
+            return None
+        return {"flops": flops, "bytes": float(ca.get("bytes accessed",
+                                                      0.0))}
+    except Exception:
+        return None
 
 
 def bench_bert_long():
@@ -357,27 +476,31 @@ def bench_bert_long():
                           use_dynamic_loss_scaling=False)
         opt.minimize(out["loss"])
     rng = np.random.default_rng(0)
-    feed_fn = _device_pool(
-        [bert.random_batch(cfg, batch, seq_len, max_preds, rng=rng)
-         for _ in range(2)])
+    pool = [bert.random_batch(cfg, batch, seq_len, max_preds, rng=rng)
+            for _ in range(2)]
+    feed_fn = _device_pool(pool)
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
     v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
                      10, 3, batch)
-    print(json.dumps({
+    result = {
         "metric": "bert_base_seq2048_flash_bf16_samples_per_sec",
         "value": round(v, 2), "unit": "samples/sec",
         "tokens_per_sec": round(v * seq_len, 0),
-        "vs_baseline": None}))
+        "vs_baseline": None}
+    return _attach_roofline(result, jax.devices()[0], v, batch,
+                            _step_cost(exe, scope, pool[0], main_prog),
+                            _bert_train_flops_per_sample(cfg, seq_len,
+                                                         max_preds))
 
 
-# one table drives everything: insertion order is the default run order
-# (flagship first — its line is the headline metric the driver records);
-# the metric name keeps error lines correlatable with success-line keys
+# one table drives everything: insertion order is the default run order.
+# The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
+# of the output tail, so the headline metric must be the final thing
+# printed. The metric name keeps error lines correlatable.
 _CONFIGS = {
-    "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
     "mnist": (bench_mnist, "mnist_lenet_samples_per_sec"),
     "resnet50": (bench_resnet50, "resnet50_bf16_images_per_sec_per_chip"),
     "widedeep": (bench_widedeep, "widedeep_ctr_samples_per_sec_per_chip"),
@@ -385,24 +508,39 @@ _CONFIGS = {
                             "dygraph_transformer_base_samples_per_sec"),
     "bert_long": (bench_bert_long,
                   "bert_base_seq2048_flash_bf16_samples_per_sec"),
+    "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 
 
 def run_all():
-    """Emit one JSON line per BASELINE config. A failing config emits an
-    error line instead of killing the remaining configs."""
+    """Emit one JSON line per BASELINE config as it completes, then a
+    FINAL summary line: the flagship record plus a "configs" map with
+    every config's {value, unit, mfu, vs_baseline}. The summary is last
+    so the driver's last-line parse captures the flagship AND the whole
+    matrix. A failing config emits an error line and a null summary
+    entry instead of killing the run."""
     import gc
     import sys
     import traceback
+    results = {}
     for name, (fn, metric) in _CONFIGS.items():
         try:
-            fn()
+            results[name] = fn()
         except Exception:  # noqa: BLE001 — keep the matrix going
             traceback.print_exc(file=sys.stderr)
-            print(json.dumps({"metric": metric, "config": name,
-                              "value": None, "unit": "error",
-                              "vs_baseline": None}))
+            results[name] = {"metric": metric, "value": None,
+                             "unit": "error", "vs_baseline": None}
+        print(json.dumps(dict(results[name], config=name)), flush=True)
         gc.collect()  # drop the previous config's device buffers
+    flagship = results.get("bert") or {
+        "metric": "bert_base_pretrain_bf16_samples_per_sec_per_chip",
+        "value": None, "unit": "error", "vs_baseline": None}
+    summary = dict(flagship)
+    summary["configs"] = {
+        name: {k: r.get(k) for k in ("value", "unit", "mfu",
+                                     "vs_baseline") if k in r}
+        for name, r in results.items()}
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":
@@ -414,4 +552,4 @@ if __name__ == "__main__":
     if args.config == "all":
         run_all()
     else:
-        _CONFIGS[args.config][0]()
+        print(json.dumps(_CONFIGS[args.config][0]()), flush=True)

@@ -63,18 +63,25 @@ class SAController(EvolutionaryController):
     def next_tokens(self, control_token=None):
         tokens = list(control_token) if control_token else \
             list(self._tokens)
+        # only positions with >=2 choices can mutate; a range-1 position
+        # has exactly one legal token and must stay inside [0, range)
+        movable = [i for i, r in enumerate(self._range_table) if r >= 2]
+        if not movable:
+            return list(tokens)
         new_tokens = list(tokens)
-        idx = int(self._rng.integers(0, len(self._range_table)))
-        span = max(self._range_table[idx], 2)
+        idx = movable[int(self._rng.integers(0, len(movable)))]
+        span = self._range_table[idx]
         new_tokens[idx] = (new_tokens[idx]
                            + int(self._rng.integers(1, span))) % span
         if self._constrain_func is None:
             return new_tokens
         for _ in range(self._max_iter_number):
             if self._constrain_func(new_tokens):
-                break
-            idx = int(self._rng.integers(0, len(self._range_table)))
+                return new_tokens
+            idx = movable[int(self._rng.integers(0, len(movable)))]
             new_tokens = list(tokens)
             new_tokens[idx] = int(self._rng.integers(
                 0, self._range_table[idx]))
-        return new_tokens
+        # no feasible mutation found: fall back to the last feasible
+        # vector rather than returning a constraint-violating one
+        return list(tokens)

@@ -2,6 +2,7 @@
 DATA_HOME + download cache). No egress here: data_home() resolves the
 local cache; synthetic() builds the deterministic fallback RNG."""
 import os
+import zlib
 
 import numpy as np
 
@@ -19,6 +20,8 @@ def have_local(*parts):
 
 
 def synthetic_rng(name, split):
-    """Deterministic per-(dataset, split) generator."""
-    seed = abs(hash((name, split))) % (2 ** 31)
+    """Deterministic per-(dataset, split) generator. crc32, not hash():
+    builtin str hashing is salted per process, which would break the
+    'deterministic synthetic streams' promise across runs."""
+    seed = zlib.crc32(f"{name}/{split}".encode())
     return np.random.default_rng(seed)

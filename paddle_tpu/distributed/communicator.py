@@ -78,14 +78,27 @@ class AsyncCommunicator:
         self._threading = threading
         self._stop = threading.Event()
         self._threads = []
-        self._inflight = 0           # grads popped but not yet sent
-        self._inflight_cv = threading.Condition()
+        # one counter covers queued AND popped-but-unsent grads: a grad is
+        # pending from push() until its send lands, so flush() can never
+        # observe "empty queues + nothing inflight" while a popped grad is
+        # still unsent (the race a separate inflight counter allowed)
+        self._pending = 0
+        self._pending_cv = threading.Condition()
 
     # -- trainer-facing ---------------------------------------------------
     def push(self, name, grad):
         """Blocks when the var's queue is full (the reference's bounded
         BlockingQueue backpressure)."""
-        self._queues[name].put(np.asarray(grad))
+        grad = np.asarray(grad)
+        with self._pending_cv:
+            self._pending += 1
+        try:
+            self._queues[name].put(grad)
+        except BaseException:
+            with self._pending_cv:
+                self._pending -= 1
+                self._pending_cv.notify_all()
+            raise
 
     def recv(self):
         """Pull fresh params into the scope (reference RecvByCommunicator)."""
@@ -108,10 +121,8 @@ class AsyncCommunicator:
                     first = q.get(timeout=0.05)
                 except _q.Empty:
                     continue
-                with self._inflight_cv:
-                    self._inflight += 1
+                merged = [first]
                 try:
-                    merged = [first]
                     while len(merged) < self.max_merge:
                         try:
                             merged.append(q.get_nowait())
@@ -120,10 +131,15 @@ class AsyncCommunicator:
                     # MergeVars: average the pending grads into one send
                     grad = np.mean(np.stack(merged), axis=0)
                     cli.push_dense(ep, name, grad)
+                except Exception:
+                    # a transient RPC failure must not kill the channel:
+                    # the popped grads are lost (logged), the loop lives
+                    import traceback
+                    traceback.print_exc()
                 finally:
-                    with self._inflight_cv:
-                        self._inflight -= 1
-                        self._inflight_cv.notify_all()
+                    with self._pending_cv:
+                        self._pending -= len(merged)
+                        self._pending_cv.notify_all()
 
         for p, ep in self.epmap.items():
             t = self._threading.Thread(target=send_loop, args=(p, ep),
@@ -132,17 +148,12 @@ class AsyncCommunicator:
             self._threads.append(t)
 
     def flush(self):
-        """Drain every queue AND wait for in-flight sends to land on the
-        pserver (the barrier/sync contracts need the updates applied, not
-        merely dequeued)."""
-        import time
-        while any(not q.empty() for q in self._queues.values()):
-            if self._stop.is_set():
-                break
-            time.sleep(0.01)
-        with self._inflight_cv:
-            self._inflight_cv.wait_for(
-                lambda: self._inflight == 0 or self._stop.is_set(),
+        """Wait until every pushed grad has LANDED on the pserver (the
+        barrier/sync contracts need the updates applied, not merely
+        dequeued — pending counts queued + popped-but-unsent)."""
+        with self._pending_cv:
+            self._pending_cv.wait_for(
+                lambda: self._pending == 0 or self._stop.is_set(),
                 timeout=120.0)
 
     def stop(self):

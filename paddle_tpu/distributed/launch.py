@@ -43,9 +43,25 @@ def parse_args(argv=None):
     p.add_argument("--worker_num", type=int, default=0,
                    help="trainer processes (PS mode)")
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--device", default=None,
+                   help="pin the JAX platform for children (cpu/tpu/...). "
+                        "The launcher owns platform hygiene: children must "
+                        "not inherit a JAX_PLATFORMS that names a backend "
+                        "their environment can't provide.")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
+
+
+def _child_env(args, **overrides):
+    """Child env = parent env + PADDLE_* contract, with the launcher owning
+    platform hygiene: --device pins JAX_PLATFORMS so children never inherit
+    a backend name their own environment can't provide (reference launcher
+    env plumbing: python/paddle/distributed/launch.py:193)."""
+    env = dict(os.environ, **{k: str(v) for k, v in overrides.items()})
+    if args.device:
+        env["JAX_PLATFORMS"] = args.device
+    return env
 
 
 def _spawn(cmd, env, log_dir, tag):
@@ -68,18 +84,20 @@ def launch(args):
         sports = _free_ports(n_servers, args.node_ip)
         server_eps = ",".join(f"{args.node_ip}:{p}" for p in sports)
         for i in range(n_servers):
-            env = dict(os.environ,
-                       TRAINING_ROLE="PSERVER",
-                       PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
-                       PADDLE_CURRENT_ENDPOINT=f"{args.node_ip}:{sports[i]}",
-                       PADDLE_TRAINERS_NUM=str(n_workers))
+            env = _child_env(
+                args,
+                TRAINING_ROLE="PSERVER",
+                PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
+                PADDLE_CURRENT_ENDPOINT=f"{args.node_ip}:{sports[i]}",
+                PADDLE_TRAINERS_NUM=n_workers)
             procs.append(_spawn(cmd_base, env, args.log_dir, f"server.{i}"))
         for i in range(n_workers):
-            env = dict(os.environ,
-                       TRAINING_ROLE="TRAINER",
-                       PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
-                       PADDLE_TRAINER_ID=str(i),
-                       PADDLE_TRAINERS_NUM=str(n_workers))
+            env = _child_env(
+                args,
+                TRAINING_ROLE="TRAINER",
+                PADDLE_PSERVERS_IP_PORT_LIST=server_eps,
+                PADDLE_TRAINER_ID=i,
+                PADDLE_TRAINERS_NUM=n_workers)
             procs.append(_spawn(cmd_base, env, args.log_dir, f"worker.{i}"))
     else:
         # ---- collective ----
@@ -88,14 +106,14 @@ def launch(args):
                  if args.started_port else _free_ports(n, args.node_ip))
         eps = ",".join(f"{args.node_ip}:{p}" for p in ports)
         for i in range(n):
-            env = dict(os.environ,
-                       TRAINING_ROLE="TRAINER",
-                       PADDLE_TRAINER_ID=str(i),
-                       PADDLE_TRAINERS_NUM=str(n),
-                       PADDLE_TRAINER_ENDPOINTS=eps,
-                       PADDLE_CURRENT_ENDPOINT=(
-                           f"{args.node_ip}:{ports[i]}"),
-                       FLAGS_selected_tpus=str(i))
+            env = _child_env(
+                args,
+                TRAINING_ROLE="TRAINER",
+                PADDLE_TRAINER_ID=i,
+                PADDLE_TRAINERS_NUM=n,
+                PADDLE_TRAINER_ENDPOINTS=eps,
+                PADDLE_CURRENT_ENDPOINT=f"{args.node_ip}:{ports[i]}",
+                FLAGS_selected_tpus=i)
             procs.append(_spawn(cmd_base, env, args.log_dir, f"trainer.{i}"))
 
     def _terminate(signum=None, frame=None):

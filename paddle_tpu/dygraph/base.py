@@ -195,10 +195,18 @@ class Tracer:
     def __init__(self, seed=0):
         self.tape = []
         self._no_grad = False
+        self._seed = seed
         self._key = jax.random.PRNGKey(seed)
         self._train_mode = True
 
     def next_key(self):
+        from ..framework.executor import _key_impl_mismatch
+        if not isinstance(self._key, jax.core.Tracer) and \
+                _key_impl_mismatch(self._key):
+            # default PRNG impl changed since this tracer was created
+            # (raw threefry keys are rejected under rbg): re-seed under
+            # the current impl rather than crash mid-step
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 

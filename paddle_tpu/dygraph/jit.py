@@ -392,9 +392,20 @@ class CompiledStep:
                         v.value, v._grad = val, g
 
             # discovery pass (abstract): fills seen/ext_vars/opts with
-            # pre-values snapshotted at first sight (note_ext/spy_minimize)
-            jax.eval_shape(discover, jax.ShapeDtypeStruct((2,),
-                                                          jnp.uint32),
+            # pre-values snapshotted at first sight (note_ext/spy_minimize).
+            # The key aval must mirror the LIVE key — its shape depends on
+            # the active PRNG impl (threefry (2,), rbg (4,), typed ()).
+            # A stale raw key (impl changed since the tracer was created)
+            # must be re-seeded HERE: inside eval_shape the key is a
+            # Tracer, so next_key()'s own mismatch guard can't fire.
+            from ..framework.executor import _key_impl_mismatch
+            if not isinstance(tracer._key, jax.core.Tracer) and \
+                    _key_impl_mismatch(tracer._key):
+                tracer._key = jax.random.PRNGKey(tracer._seed)
+            live_key = tracer._key
+            jax.eval_shape(discover,
+                           jax.ShapeDtypeStruct(live_key.shape,
+                                                live_key.dtype),
                            arg_shapes)
             # externals whose value the step replaced are the WRITTEN
             # (mutable) set — only their buffers may be donated; then

@@ -75,6 +75,36 @@ def scope_guard(scope):
     return _scope_guard(scope)
 
 
+_RAW_KEY_SHAPES = {"threefry2x32": (2,), "rbg": (4,), "unsafe_rbg": (4,)}
+
+
+def _key_impl_mismatch(key):
+    """True when a RAW uint32 key's shape doesn't match the current
+    default PRNG impl (typed keys carry their impl in the dtype and
+    never mismatch)."""
+    if jnp.issubdtype(getattr(key, "dtype", None), jax.dtypes.prng_key):
+        return False
+    expect = _RAW_KEY_SHAPES.get(jax.config.jax_default_prng_impl)
+    return expect is not None and tuple(key.shape) != expect
+
+
+def _check_int64_feed(name, arr):
+    """Int64 policy (PARITY.md): with jax_enable_x64 off (the default)
+    int64 device tensors are stored int32. A fed value outside int32
+    range would silently wrap on device (the reference's kernels are true
+    int64, e.g. operators/lookup_table_op.h) — validate at the feed
+    boundary and raise instead."""
+    if arr.dtype == np.int64 and arr.size \
+            and not jax.config.jax_enable_x64:
+        lo, hi = arr.min(), arr.max()
+        if lo < -2**31 or hi >= 2**31:
+            raise ValueError(
+                f"feed {name!r} holds int64 values outside int32 range "
+                f"([{lo}, {hi}]); TPU tensors are 32-bit by default — "
+                f"enable jax_enable_x64 for true int64 (PARITY.md "
+                f"int64 policy)")
+
+
 class Executor:
     """Compile-and-run executor with a program cache
     (the reference caches prepared contexts at executor.py:1169; we cache
@@ -102,7 +132,11 @@ class Executor:
 
     def _ensure_rng(self, scope, program):
         key = scope.find_var(RNG_STATE_NAME)
-        if key is None:
+        if key is None or _key_impl_mismatch(key):
+            # (re-)seed under the CURRENT default PRNG impl: a raw key
+            # minted under threefry (shape (2,)) is rejected by
+            # split/fold_in once the app switches to rbg (shape (4,)) —
+            # e.g. bench.py enables rbg after tests populated the scope
             seed = program.random_seed or 0
             key = jax.random.PRNGKey(seed)
             scope.set(RNG_STATE_NAME, key)
@@ -134,6 +168,7 @@ class Executor:
                 var = program.global_block().vars.get(name)
                 if var is not None and arr.dtype != np_dtype(var.dtype):
                     arr = arr.astype(np_dtype(var.dtype))
+                _check_int64_feed(name, arr)
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 

@@ -9,8 +9,32 @@ def x_of(ins, slot="X"):
     return v[0] if v else None
 
 
+def int64_t():
+    """Canonical device dtype for a fluid `int64` tensor.
+
+    Int64 policy (see PARITY.md): TPU vector units are 32-bit; with
+    jax_enable_x64 off (the default) int64 device tensors are stored
+    int32 — deliberately and silently HERE (values are op-internal
+    indices/counts that provably fit), while user-fed int64 data is
+    validated at the executor feed boundary and raises on overflow
+    instead of wrapping (framework/executor.py). Enabling
+    jax_enable_x64 restores true int64 end to end."""
+    import jax
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def as_dtype(attrs, key="dtype", default="float32"):
-    return np_dtype(attrs.get(key, default))
+    """Resolve an op's dtype attr to the device dtype. Int64 policy
+    (PARITY.md): with x64 off, attr-requested (u)int64 storage maps to
+    32-bit — op outputs are indices/counts that fit; user-fed int64 is
+    range-checked at the executor feed boundary instead."""
+    dt = np_dtype(attrs.get(key, default))
+    import numpy as np
+    if dt in (np.int64, np.uint64):
+        import jax
+        if not jax.config.jax_enable_x64:
+            return np.int32 if dt == np.int64 else np.uint32
+    return dt
 
 
 def bcast_y(x, y, axis):

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
-from .common import as_dtype, x_of
+from .common import as_dtype, int64_t, x_of
 
 
 @register_op("tree_conv", infer_shape=False)
@@ -271,16 +271,18 @@ def var_conv_2d(ctx, ins, attrs):
     return {"Out": out, "Col": jnp.zeros((1,), x.dtype)}
 
 
-@register_op("filter_by_instag", grad=False, infer_shape=False)
+@register_op("filter_by_instag", infer_shape=False)
 def filter_by_instag(ctx, ins, attrs):
     """reference filter_by_instag_op.h: keep rows whose tag set
     intersects Filter_tag. Padded form: Ins [N, D], Ins_tag [N, Tmax]
     (-1 pads), Filter_tag [K]. Out [N, D] (kept rows compacted,
     zero pad), LossWeight [N, 1], IndexMap [N, 2] (out row -> in row),
-    OutCount [1]."""
+    OutCount [1]. Differentiable: Out is a masked gather of Ins, so the
+    generic vjp scatters Out@GRAD back through the gather (zero for
+    filtered rows) — the reference's FilterByInstagGrad kernel."""
     rows = x_of(ins, "Ins")
-    tags = x_of(ins, "Ins_tag").astype(jnp.int64)
-    filt = x_of(ins, "Filter_tag").astype(jnp.int64).reshape(-1)
+    tags = x_of(ins, "Ins_tag").astype(int64_t())
+    filt = x_of(ins, "Filter_tag").astype(int64_t()).reshape(-1)
     is_lod = bool(attrs.get("is_lod", True))  # noqa: F841 (API parity)
     N = rows.shape[0]
     hit = jnp.any((tags[:, :, None] == filt[None, None, :])

@@ -16,6 +16,8 @@ deterministic and testable; the random path falls back to it
 (documented divergence — stateless per-step sampling would need the op
 key plumbed per image).
 """
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -23,7 +25,7 @@ from ..framework.registry import register_op
 from .common import x_of
 from .detection_ops import _iou_matrix
 
-_BBOX_CLIP = float(jnp.log(1000.0 / 16.0))
+_BBOX_CLIP = float(math.log(1000.0 / 16.0))
 
 
 def _iou_plus1(a, b):

@@ -13,7 +13,7 @@ import numpy as np
 
 from ..framework.registry import register_op
 from ..framework.dtype import np_dtype
-from .common import x_of, as_dtype
+from .common import as_dtype, int64_t, x_of
 
 
 @register_op("fill_constant", grad=False)
@@ -395,7 +395,7 @@ def argsort(ctx, ins, attrs):
     key = -x if descending else x
     idx = jnp.argsort(key, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+    return {"Out": out, "Indices": idx.astype(int64_t())}
 
 
 @register_op("top_k_v2", grad=False)
@@ -410,14 +410,14 @@ def top_k_v2(ctx, ins, attrs):
         vals = -vals
     vals = jnp.moveaxis(vals, -1, axis)
     idx = jnp.moveaxis(idx, -1, axis)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(int64_t())}
 
 
 @register_op("top_k", grad=False)
 def top_k(ctx, ins, attrs):
     x = x_of(ins)
     vals, idx = jax.lax.top_k(x, attrs["k"])
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(int64_t())}
 
 
 @register_op("index_select")

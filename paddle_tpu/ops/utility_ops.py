@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.registry import register_op
-from .common import as_dtype, normalize_padding, x_of
+from .common import as_dtype, int64_t, normalize_padding, x_of
 
 
 # --------------------------------------------------------------- factories
@@ -36,7 +36,8 @@ def linspace(ctx, ins, attrs):
         num = int(ins["Num"][0])  # concrete only outside jit
     dtype = start.dtype
     if num == 1:
-        return {"Out": jnp.reshape(stop, (1,)).astype(dtype)}
+        # reference linspace_op.h: step=0, out[0]=start (numpy semantics)
+        return {"Out": jnp.reshape(start, (1,)).astype(dtype)}
     i = jnp.arange(num, dtype=jnp.float32)
     step = (stop.astype(jnp.float32) - start.astype(jnp.float32)) / (num - 1)
     out = start.astype(jnp.float32) + i * step
@@ -100,8 +101,8 @@ def where_index(ctx, ins, attrs):
     coords = jnp.stack(
         jnp.unravel_index(jnp.maximum(idxs, 0), cond.shape), axis=-1)
     coords = jnp.where(valid[:, None], coords, -1)
-    return {"Out": coords.astype(jnp.int64),
-            "Count": jnp.sum(valid).astype(jnp.int64).reshape(1)}
+    return {"Out": coords.astype(int64_t()),
+            "Count": jnp.sum(valid).astype(int64_t()).reshape(1)}
 
 
 @register_op("unique_with_counts", grad=False, infer_shape=False)
@@ -118,7 +119,7 @@ def unique_with_counts(ctx, ins, attrs):
     rank = jnp.cumsum(is_first) - 1                    # unique slot per pos
     index = rank[first]
     out = jnp.zeros((n,), x.dtype).at[index].set(x)
-    counts = jnp.zeros((n,), jnp.int64).at[index].add(1)
+    counts = jnp.zeros((n,), int64_t()).at[index].add(1)
     itype = as_dtype(attrs, default="int32")
     return {"Out": out, "Index": index.astype(itype),
             "Count": counts.astype(itype)}
@@ -233,10 +234,10 @@ def average_accumulates(ctx, ins, attrs):
     s2 = x_of(ins, "in_sum_2")
     s3 = x_of(ins, "in_sum_3")
     num_acc = jnp.reshape(x_of(ins, "in_num_accumulates"), ()).astype(
-        jnp.int64)
+        int64_t())
     old_num = jnp.reshape(x_of(ins, "in_old_num_accumulates"), ()).astype(
-        jnp.int64)
-    num_upd = jnp.reshape(x_of(ins, "in_num_updates"), ()).astype(jnp.int64)
+        int64_t())
+    num_upd = jnp.reshape(x_of(ins, "in_num_updates"), ()).astype(int64_t())
     avg_win = float(attrs.get("average_window", 0.0))
     # clamp to int32 range: jax runs x32 by default and the reference's
     # INT64_MAX sentinel would overflow
@@ -252,8 +253,8 @@ def average_accumulates(ctx, ins, attrs):
     o2 = jnp.where(spill, o2 + o1, o2)
     o1 = jnp.where(spill, jnp.zeros_like(o1), o1)
     window = jnp.minimum(
-        jnp.asarray(max_win, jnp.int64),
-        (num_upd.astype(jnp.float32) * avg_win).astype(jnp.int64))
+        jnp.asarray(max_win, int64_t()),
+        (num_upd.astype(jnp.float32) * avg_win).astype(int64_t()))
     roll = (num_acc >= min_win) & (num_acc >= window)
     o3 = jnp.where(roll, o1 + o2, o3)
     o1 = jnp.where(roll, jnp.zeros_like(o1), o1)
@@ -348,9 +349,9 @@ def chunk_eval(ctx, ins, attrs):
     chunk is correct iff a label chunk begins at the same position with
     the same type and ends at the same position."""
     inference = x_of(ins, "Inference").reshape(
-        ins["Inference"][0].shape[0], -1).astype(jnp.int64)
+        ins["Inference"][0].shape[0], -1).astype(int64_t())
     label = x_of(ins, "Label").reshape(
-        ins["Label"][0].shape[0], -1).astype(jnp.int64)
+        ins["Label"][0].shape[0], -1).astype(int64_t())
     seq_len = ins.get("SeqLength")
     B, T = label.shape
     if seq_len:
@@ -385,9 +386,9 @@ def chunk_eval(ctx, ins, attrs):
     ikeep = count(ib, ityp)
     lkeep = count(lb, ltyp)
     correct = (ikeep & lkeep & (ityp == ltyp) & (i_end == l_end))
-    n_inf = jnp.sum(ikeep).astype(jnp.int64)
-    n_lab = jnp.sum(lkeep).astype(jnp.int64)
-    n_cor = jnp.sum(correct).astype(jnp.int64)
+    n_inf = jnp.sum(ikeep).astype(int64_t())
+    n_lab = jnp.sum(lkeep).astype(int64_t())
+    n_cor = jnp.sum(correct).astype(int64_t())
     prec = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0)
     rec = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0)
     f1 = jnp.where(n_cor > 0, 2 * prec * rec /

@@ -276,3 +276,24 @@ def test_declarative_and_program_translator():
             assert float(out.numpy()[0]) == 5.0
     finally:
         ProgramTranslator().enable(True)
+
+
+def test_int64_feed_policy():
+    """Int64 policy (PARITY.md): int64 feeds whose values fit int32 pass;
+    values outside int32 range raise at the feed boundary instead of
+    silently wrapping on the 32-bit device path."""
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", [4, 1], dtype="int64")
+        emb = layers.embedding(ids, size=[100, 8])
+        out = layers.mean(emb)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ok = np.array([[1], [2], [3], [99]], np.int64)
+        exe.run(main, feed={"ids": ok}, fetch_list=[out])
+        bad = np.array([[1], [2], [3], [2**31]], np.int64)
+        with pytest.raises(ValueError, match="int32 range"):
+            exe.run(main, feed={"ids": bad}, fetch_list=[out])

@@ -50,11 +50,15 @@ def test_fleet_collective_two_process_parity():
     steps = 4
     with open(argpath, "w") as f:
         json.dump({"steps": steps, "out": outpat}, f)
-    env = dict(os.environ, PYTHONPATH=REPO)
+    pp = [REPO] + ([os.environ["PYTHONPATH"]]
+                   if os.environ.get("PYTHONPATH") else [])
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(pp))
     env.pop("XLA_FLAGS", None)   # children provision their own 1-dev cpu
+    # --device=cpu: launcher owns platform hygiene — children must not
+    # inherit JAX_PLATFORMS=axon/tpu they can't (or shouldn't) initialize
     rc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node=2",
+         "--nproc_per_node=2", "--device=cpu",
          os.path.join(HERE, "dist_fleet_runner.py"), argpath],
         env=env, capture_output=True, timeout=420)
     assert rc.returncode == 0, rc.stderr.decode()[-3000:]

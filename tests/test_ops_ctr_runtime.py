@@ -206,6 +206,24 @@ def test_filter_by_instag():
     np.testing.assert_allclose(lw[:, 0], [1, 1, 0, 0])
 
 
+def test_filter_by_instag_grad():
+    """Out@GRAD scatters back through IndexMap: kept rows receive their
+    grad at the original position, filtered rows get zero (reference
+    FilterByInstagGrad, filter_by_instag_op.h)."""
+    rows = RNG.standard_normal((4, 3)).astype(np.float32)
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, -1]], np.int64)
+    filt = np.array([3], np.int64)
+    t = _t("filter_by_instag",
+           {"Ins": ("fig_r", rows), "Ins_tag": ("fig_t", tags),
+            "Filter_tag": ("fig_f", filt)},
+           {"is_lod": True},
+           {"Out": np.zeros((4, 3), np.float32),
+            "LossWeight": np.zeros((4, 1), np.float32),
+            "IndexMap": np.zeros((4, 2), np.int32),
+            "OutCount": np.zeros((1,), np.int32)})
+    t.check_grad(["Ins"], "Out")
+
+
 def test_rank_attention():
     N, D, max_rank, p = 3, 2, 2, 4
     x = RNG.standard_normal((N, D)).astype(np.float32)

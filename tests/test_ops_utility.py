@@ -19,12 +19,12 @@ def test_linspace():
         "Stop": ("stop", np.array([10.0], np.float32)),
         "Num": ("num", np.array([17], np.int32))},
        {"num": 17}, {"Out": ref}).check_output(atol=1e-6)
-    # num == 1 -> just stop
+    # num == 1 -> just start (reference linspace_op.h / numpy semantics)
     _t("linspace",
        {"Start": ("s2", np.array([3.0], np.float32)),
         "Stop": ("e2", np.array([7.0], np.float32)),
         "Num": ("n2", np.array([1], np.int32))},
-       {"num": 1}, {"Out": np.array([7.0], np.float32)}).check_output()
+       {"num": 1}, {"Out": np.array([3.0], np.float32)}).check_output()
 
 
 def test_randperm():

@@ -1,0 +1,98 @@
+"""PRNG-impl portability: the framework must run under any default PRNG
+implementation (threefry key shape (2,), rbg key shape (4,)).
+
+bench.py enables jax_default_prng_impl=rbg for throughput (hardware RNG on
+TPU); round 3's dygraph.jit_step hardcoded the threefry key shape in its
+discovery pass and crashed the whole DyGraph bench config. These tests pin
+the contract (reference perf path: pybind/op_function_generator.cc's
+dygraph fastpath must work regardless of device RNG backend).
+"""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+
+@pytest.fixture
+def rbg_prng():
+    old = jax.config.jax_default_prng_impl
+    jax.config.update("jax_default_prng_impl", "rbg")
+    try:
+        yield
+    finally:
+        jax.config.update("jax_default_prng_impl", old)
+
+
+def test_jit_step_under_rbg(rbg_prng):
+    """jit_step with an RNG op (dropout) inside: the discovery pass must
+    build its key aval from the live key, not a hardcoded threefry shape."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((8, 6)).astype("float32")
+    with dygraph.guard():
+        m = dygraph.Linear(6, 4)
+        o = fluid.optimizer.SGD(0.1, parameter_list=m.parameters())
+
+        @dygraph.jit_step
+        def step(x):
+            h = fluid.layers.dropout(m(x), dropout_prob=0.3)
+            loss = fluid.layers.mean(h)
+            loss.backward()
+            o.minimize(loss)
+            m.clear_gradients()
+            return loss
+
+        for _ in range(3):
+            l = step(dygraph.to_variable(X))
+            assert np.isfinite(float(l.numpy().reshape(-1)[0]))
+        assert len(step._compiled_step._cache) == 1
+
+
+def test_eager_dygraph_under_rbg(rbg_prng):
+    with dygraph.guard():
+        x = dygraph.to_variable(np.ones((4, 5), dtype=np.float32))
+        y = fluid.layers.dropout(x, dropout_prob=0.5)
+        assert y.numpy().shape == (4, 5)
+
+
+def test_impl_switch_with_stale_scope_key(rbg_prng):
+    """A scope whose RNG key was minted under threefry must survive a
+    switch to rbg: the executor re-seeds instead of crashing on the
+    stale (2,)-shaped raw key (the bench.py-enables-rbg-late hazard)."""
+    old = jax.config.jax_default_prng_impl
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2, 4], dtype="float32")
+        h = fluid.layers.dropout(fluid.layers.fc(x, 4), dropout_prob=0.2)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    X = np.ones((2, 4), np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": X}, fetch_list=[h])   # threefry key stored
+        jax.config.update("jax_default_prng_impl", "rbg")
+        out = exe.run(main, feed={"x": X}, fetch_list=[h])
+    jax.config.update("jax_default_prng_impl", old)
+    assert np.asarray(out[0]).shape == (2, 4)
+
+
+def test_static_executor_step_under_rbg(rbg_prng):
+    """One static-graph executor step with an RNG op under rbg."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8, 6], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        loss = fluid.layers.mean(h)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main,
+                  feed={"x": np.ones((8, 6), dtype=np.float32)},
+                  fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0]).reshape(-1)[0])
