@@ -58,6 +58,19 @@ def _assigned_names(nodes):
     return names
 
 
+import re
+
+_TEMP_RE = re.compile(r"^__pt_\d+_in\d+$")
+
+
+def _real_writes(names):
+    """Drop the transformer's own capture temporaries (__pt_N_inI): they
+    are (re)bound immediately before each convert call and must not
+    become loop state (undefined before the loop). Flags (_brk/_cnt)
+    and the induction var (_i) stay — they ARE loop state."""
+    return [n for n in names if not _TEMP_RE.match(n)]
+
+
 def _load(name):
     return ast.Name(id=name, ctx=ast.Load())
 
@@ -119,16 +132,152 @@ def _convert_call(kind, extra_args, writes, prefix):
     return ast.Expr(value=call)
 
 
+def _jst_call(attr, args):
+    return ast.Call(
+        func=ast.Attribute(value=_load("_paddle_tpu_jst"), attr=attr,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _thunk(expr):
+    """lambda: <expr>"""
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _has_break_continue(stmts):
+    """Shallow scan: break/continue bound to THIS loop (not nested
+    loops/defs)."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_For(self, node):
+            pass
+
+        def visit_While(self, node):
+            pass
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Break(self, node):
+            found[0] = True
+
+        def visit_Continue(self, node):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+class _BreakContinueRewriter(ast.NodeTransformer):
+    """Replace this loop's break/continue with flag assignments
+    (reference break_continue_transformer.py, flag-variable scheme):
+    `break` -> `<brk> = True`; `continue` -> `<cnt> = True`; every
+    statement after a possible flag-raise is guarded by
+    `if not (<brk> or <cnt>):` (synthesized as plain ast — the main
+    transformer then converts those ifs with everything else)."""
+
+    def __init__(self, brk, cnt):
+        self.brk = brk
+        self.cnt = cnt
+
+    # do not descend into nested loops/defs: their break/continue binds
+    # to them (the main transformer recurses separately)
+    def visit_For(self, node):
+        return node
+
+    def visit_While(self, node):
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Break(self, node):
+        return ast.Assign(targets=[_store(self.brk)],
+                          value=ast.Constant(value=True))
+
+    def visit_Continue(self, node):
+        return ast.Assign(targets=[_store(self.cnt)],
+                          value=ast.Constant(value=True))
+
+    def rewrite_block(self, stmts):
+        out = []
+        for idx, s in enumerate(stmts):
+            raised = _has_break_continue([s])
+            if isinstance(s, ast.If):
+                s = ast.If(test=s.test,
+                           body=self.rewrite_block(s.body),
+                           orelse=self.rewrite_block(s.orelse))
+            elif isinstance(s, ast.With):
+                s = ast.With(items=s.items,
+                             body=self.rewrite_block(s.body))
+            elif isinstance(s, ast.Try):
+                s = ast.Try(body=self.rewrite_block(s.body),
+                            handlers=[
+                                ast.ExceptHandler(
+                                    type=h.type, name=h.name,
+                                    body=self.rewrite_block(h.body))
+                                for h in s.handlers],
+                            orelse=self.rewrite_block(s.orelse),
+                            finalbody=self.rewrite_block(s.finalbody))
+            else:
+                s = self.visit(s)
+            out.append(s)
+            rest = stmts[idx + 1:]
+            if raised and rest:
+                # guard the remaining statements on "no flag raised"
+                guard = _jst_call("convert_logical_not", [
+                    _jst_call("convert_logical_or",
+                              [_thunk(_load(self.brk)),
+                               _thunk(_load(self.cnt))])])
+                out.append(ast.If(test=guard,
+                                  body=self.rewrite_block(rest),
+                                  orelse=[]))
+                break
+        return out
+
+
 class DygraphToStaticAst(ast.NodeTransformer):
     def _fresh(self):
         _COUNTER[0] += 1
         return f"__pt_{_COUNTER[0]}"
 
+    def _false_assign(self, name):
+        return ast.Assign(targets=[_store(name)],
+                          value=ast.Constant(value=False))
+
+    def _rewrite_break_continue(self, node, p):
+        """Lower this loop's break/continue into <p>_brk / <p>_cnt flag
+        variables inside the body; returns the new body. The caller
+        folds `not brk` into the loop test and seeds both flags."""
+        rw = _BreakContinueRewriter(f"{p}_brk", f"{p}_cnt")
+        body = rw.rewrite_block(list(node.body))
+        # reset the continue flag at the top of every iteration
+        return [self._false_assign(f"{p}_cnt")] + body
+
     def visit_If(self, node):
         self.generic_visit(node)
         p = self._fresh()
-        writes = sorted(set(_assigned_names(node.body)
-                            + _assigned_names(node.orelse)))
+        writes = sorted(set(_real_writes(
+            _assigned_names(node.body)
+            + _assigned_names(node.orelse))))
         tfn = _branch_fn(f"{p}_true", writes, node.body)
         ffn = _branch_fn(f"{p}_false", writes,
                          node.orelse or [ast.Pass()])
@@ -140,30 +289,79 @@ class DygraphToStaticAst(ast.NodeTransformer):
         return stmts
 
     def visit_While(self, node):
-        self.generic_visit(node)
         if node.orelse:
+            self.generic_visit(node)
             return node  # while/else: leave to Python
         p = self._fresh()
-        writes = sorted(set(_assigned_names(node.body)))
+        pre = []
+        has_bc = _has_break_continue(node.body)
+        if has_bc:
+            # break/continue become flag variables; the loop test gains
+            # `and not <brk>` (reference break_continue_transformer)
+            node = ast.While(
+                test=node.test,
+                body=self._rewrite_break_continue(node, p), orelse=[])
+            pre.append(self._false_assign(f"{p}_brk"))
+            pre.append(self._false_assign(f"{p}_cnt"))
+        # transform children FIRST so the captured test is the
+        # post-transform expression (a BoolOp/not test must become
+        # convert_logical_* before it's compiled into the test fn)
+        self.generic_visit(node)
+        test = node.test
+        if has_bc:
+            test = _jst_call("convert_logical_and", [
+                _thunk(test),
+                _thunk(_jst_call("convert_logical_not",
+                                 [_load(f"{p}_brk")]))])
+        writes = sorted(set(_real_writes(_assigned_names(node.body))))
         test_fn = _branch_fn(f"{p}_test", writes, [])
-        test_fn.body = [ast.Return(value=node.test)]
+        test_fn.body = [ast.Return(value=test)]
         body_fn = _branch_fn(f"{p}_body", writes, node.body)
-        stmts = [test_fn, body_fn] + _init_stmts(writes, p)
+        stmts = pre + [test_fn, body_fn] + _init_stmts(writes, p)
         stmts.append(_convert_call(
             "convert_while", [_load(f"{p}_test"), _load(f"{p}_body")],
             writes, p))
         return stmts
 
     def visit_For(self, node):
-        self.generic_visit(node)
         # only `for NAME in range(...)`
         if (node.orelse or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range" or node.iter.keywords):
+            self.generic_visit(node)
             return node
+        if _has_break_continue(node.body):
+            # rewrite to the equivalent while (induction var explicit)
+            # and let visit_While's break/continue machinery handle it
+            p = self._fresh()
+            rargs = list(node.iter.args)
+            lo = rargs[0] if len(rargs) >= 2 else ast.Constant(value=0)
+            hi = rargs[1] if len(rargs) >= 2 else rargs[0]
+            step = rargs[2] if len(rargs) == 3 else ast.Constant(value=1)
+            ivar = f"{p}_i"
+            init = ast.Assign(targets=[_store(ivar)], value=lo)
+            test = _jst_call("convert_lt", [_load(ivar), hi])
+            bump = ast.Assign(
+                targets=[_store(ivar)],
+                value=_jst_call("convert_add", [_load(ivar), step]))
+            bind = ast.Assign(targets=[_store(node.target.id)],
+                              value=_load(ivar))
+            # bump BEFORE the body: a `continue` must not skip the
+            # induction-variable increment (the body reads the bound
+            # target, not the induction var)
+            loop = ast.While(test=test,
+                             body=[bind, bump] + list(node.body),
+                             orelse=[])
+            # seed the target before the loop: it's loop state (rebound
+            # every iteration) and static conversion needs it defined
+            bind0 = ast.Assign(targets=[_store(node.target.id)],
+                               value=_load(ivar))
+            out = [init, bind0] + self.visit_While(loop)
+            return out
+        self.generic_visit(node)
         p = self._fresh()
-        writes = sorted(set(_assigned_names(node.body))
+        writes = sorted(set(_real_writes(_assigned_names(node.body)))
                         - {node.target.id})
         body_fn = _branch_fn(f"{p}_body", [node.target.id] + writes,
                              node.body)
@@ -178,6 +376,42 @@ class DygraphToStaticAst(ast.NodeTransformer):
              _load(f"{p}_body")],
             writes, p))
         return stmts
+
+
+    # ---- expression transformers ----
+
+    def visit_BoolOp(self, node):
+        """a and b / a or b -> convert_logical_{and,or} with lambda
+        operands (reference logical_transformer): python short-circuit
+        preserved for concrete values, layers.logical_* for Variables
+        (whose __bool__ raises under `and`/`or`)."""
+        self.generic_visit(node)
+        kind = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = _jst_call(kind, [_thunk(expr), _thunk(rhs)])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    def visit_Call(self, node):
+        """foo(...) -> convert_call(foo)(...) for plain-name callees
+        (reference call_transformer): user functions get AST-converted
+        too; library/builtin callables pass through untouched. print()
+        routes to convert_print."""
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "print" and not node.keywords:
+                return _jst_call("convert_print", list(node.args))
+            if node.func.id in ("range", "len", "_paddle_tpu_jst"):
+                return node
+            node.func = _jst_call("convert_call", [node.func])
+        return node
 
 
 def convert_to_static(fn):

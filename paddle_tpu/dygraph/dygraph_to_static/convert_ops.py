@@ -44,9 +44,20 @@ def convert_ifelse(pred, true_fn, false_fn, init, names):
         from ... import layers
         # UNDEFINED inits are fine when BOTH branches assign the name
         # before reading it; a branch that leaks UNDEFINED into its
-        # return fails inside layers.cond with a shape/type error
-        outs = layers.cond(pred, lambda: list(true_fn(*init)),
-                           lambda: list(false_fn(*init)))
+        # return fails inside layers.cond with a shape/type error.
+        # Python scalars a branch writes (e.g. the synthesized
+        # break/continue flags: `brk = True`) promote to fill_constant
+        # INSIDE the branch so the op lands in that sub-block.
+
+        def run(fn):
+            outs = []
+            for v, n in zip(fn(*init), names):
+                outs.append(v if _static_var(v) or v is UNDEFINED
+                            else _promote_scalar(v, n, layers))
+            return outs
+
+        outs = layers.cond(pred, lambda: run(true_fn),
+                           lambda: run(false_fn))
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
         return tuple(outs)
@@ -64,14 +75,15 @@ def convert_while(test_fn, body_fn, init, names):
     if _static_var(probe):
         from ... import layers
         _check_defined(init, names, "while")
-        # loop state must be program Variables assign can write into
+        # loop state must be program Variables assign can write into;
+        # python scalars (e.g. the break/continue flags the transformer
+        # synthesizes, or counters initialized to 0) are PROMOTED to
+        # fill_constant Variables (reference loop_transformer's
+        # to_static_variable)
         state = []
         for v, n in zip(init, names):
             if not _static_var(v):
-                raise ValueError(
-                    f"dygraph_to_static: while-loop variable {n!r} must "
-                    f"be a Variable before a data-dependent loop "
-                    f"(got {type(v).__name__})")
+                v = _promote_scalar(v, n, layers)
             state.append(v)
         cond_var = layers.logical_and(probe, probe) \
             if probe.dtype != "bool" else layers.assign(probe)
@@ -80,7 +92,11 @@ def convert_while(test_fn, body_fn, init, names):
             new_vals = body_fn(*state)
             if not isinstance(new_vals, (list, tuple)):
                 new_vals = [new_vals]
-            for var, nv in zip(state, new_vals):
+            for var, nv, n in zip(state, new_vals, names):
+                if not _static_var(nv):
+                    # python scalar write (e.g. the continue flag's
+                    # per-iteration reset) -> keep the carry's [1] shape
+                    nv = _promote_scalar(nv, n, layers)
                 layers.assign(nv, output=var)
             layers.assign(test_fn(*state), output=cond_var)
         return tuple(state)
@@ -95,6 +111,153 @@ def convert_while(test_fn, body_fn, init, names):
             break
         vals = tuple(body_fn(*vals))
     return vals
+
+
+def _promote_scalar(v, n, layers):
+    """Python bool/int/float loop state -> fill_constant Variable."""
+    if isinstance(v, bool):
+        return layers.fill_constant([1], "bool", v)
+    if isinstance(v, int):
+        return layers.fill_constant([1], "int64", v)
+    if isinstance(v, float):
+        return layers.fill_constant([1], "float32", v)
+    raise ValueError(
+        f"dygraph_to_static: while-loop variable {n!r} must be a "
+        f"Variable or a python scalar before a data-dependent loop "
+        f"(got {type(v).__name__})")
+
+
+def convert_logical_and(x_fn, y_fn):
+    """`a and b` (reference logical_transformer convert_logical_and):
+    lambdas preserve python short-circuit when the lhs is concrete, and
+    python value semantics (`a and b` returns a/b, not bool) hold."""
+    x = x_fn()
+    if _static_var(x):
+        from ... import layers
+        y = y_fn()
+        if not _static_var(y):
+            # concrete rhs folds: `x and falsy` == falsy; `x and truthy`
+            # keeps the (unknown-truth) lhs predicate
+            return x if y else y
+        return layers.logical_and(_as_bool_var(x), _as_bool_var(y))
+    truthy = bool(_concrete_bool(x)) if _eager_var(x) else bool(x)
+    return y_fn() if truthy else x
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _static_var(x):
+        from ... import layers
+        y = y_fn()
+        if not _static_var(y):
+            return x if not y else y
+        return layers.logical_or(_as_bool_var(x), _as_bool_var(y))
+    truthy = bool(_concrete_bool(x)) if _eager_var(x) else bool(x)
+    return x if truthy else y_fn()
+
+
+def _concrete_bool(v):
+    import numpy as np
+    return bool(np.asarray(v.value).reshape(-1)[0])
+
+
+def convert_logical_not(x):
+    if _static_var(x):
+        from ... import layers
+        return layers.logical_not(_as_bool_var(x))
+    if _eager_var(x):
+        return not _concrete_bool(x)
+    return not x
+
+
+def _as_bool_var(x):
+    from ... import layers
+    return x if x.dtype == "bool" else layers.cast(x, "bool")
+
+
+_CONVERTED_CACHE = {}
+
+
+def convert_call(fn):
+    """reference call_transformer convert_call: user functions called
+    from converted code are themselves AST-converted (cached), so their
+    control flow converts too; library/builtin callables pass through."""
+    import builtins
+    import inspect
+    if not inspect.isfunction(fn):
+        return fn
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.startswith(("paddle_tpu", "numpy", "jax")) or \
+            mod in ("builtins",) or fn.__name__ == "<lambda>":
+        return fn
+    if getattr(builtins, fn.__name__, None) is fn:
+        return fn
+    key = getattr(fn, "__wrapped__", fn)
+    cached = _CONVERTED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    try:
+        from .ast_transformer import convert_to_static
+        conv = convert_to_static(fn)
+    except (OSError, TypeError, SyntaxError):
+        conv = fn   # un-getsource-able: run as-is
+    _CONVERTED_CACHE[key] = conv
+    return conv
+
+
+def convert_print(*args):
+    """print(x) with a static Variable argument records a print op (the
+    reference's print_transformer -> layers.Print); otherwise python
+    print."""
+    if any(_static_var(a) for a in args):
+        from ...layers.layer_helper import LayerHelper
+        msg = " ".join(str(a) for a in args if not _static_var(a))
+        for a in args:
+            if _static_var(a):
+                helper = LayerHelper("print")
+                out = helper.create_variable_for_type_inference(a.dtype)
+                helper.append_op(type="print", inputs={"In": [a]},
+                                 outputs={"Out": [out]},
+                                 attrs={"message": msg},
+                                 infer_shape=False)
+        return None
+    print(*[a.numpy() if _eager_var(a) else a for a in args])
+
+
+def _to_int_var(v, layers):
+    if _static_var(v) or _eager_var(v):
+        return layers.cast(v, "int64") if v.dtype != "int64" else v
+    return layers.fill_constant([1], "int64", int(v))
+
+
+def convert_lt(a, b):
+    """a < b for the synthesized for->while induction test."""
+    if _static_var(a) or _static_var(b):
+        from ... import layers
+        return layers.less_than(_to_int_var(a, layers),
+                                _to_int_var(b, layers))
+    if _eager_var(a):
+        import numpy as np
+        a = int(np.asarray(a.value).reshape(-1)[0])
+    if _eager_var(b):
+        import numpy as np
+        b = int(np.asarray(b.value).reshape(-1)[0])
+    return a < b
+
+
+def convert_add(a, b):
+    if _static_var(a) or _static_var(b):
+        from ... import layers
+        return layers.elementwise_add(_to_int_var(a, layers),
+                                      _to_int_var(b, layers))
+    if _eager_var(a) or _eager_var(b):
+        import numpy as np
+        av = int(np.asarray(a.value).reshape(-1)[0]) if _eager_var(a) \
+            else int(a)
+        bv = int(np.asarray(b.value).reshape(-1)[0]) if _eager_var(b) \
+            else int(b)
+        return av + bv
+    return a + b
 
 
 def convert_for_range(range_args, body_fn, init, names):

@@ -44,8 +44,17 @@ def _auto_impl():
 
 
 def _block_sizes(sq, sk, bq, bk):
-    bq = bq or (256 if sq % 256 == 0 else (128 if sq % 128 == 0 else sq))
-    bk = bk or (512 if sk % 512 == 0 else (128 if sk % 128 == 0 else sk))
+    # large q/k tiles amortize the per-tile online-softmax state updates
+    # and keep the MXU fed: 1024x1024 measured 1.6x faster than 256x512
+    # at S=2048/D=64 on v5e (r4); smaller tiles only when S doesn't
+    # divide.
+    def auto(s):
+        for cand in (1024, 512, 256, 128):
+            if s % cand == 0:
+                return cand
+        return s
+    bq = bq or auto(sq)
+    bk = bk or auto(sk)
     if sq % bq or sk % bk:
         raise ValueError(
             f"flash_attention: Sq={sq}/Sk={sk} must divide block sizes "

@@ -22,6 +22,135 @@ def _outer_reads(program, block_idx, exclude=()):
     return [n for n in reads if parent is not None and parent.has_var(n)]
 
 
+def _defining_op(block, name, stop_op=None):
+    """Last op in `block` (or an ancestor) writing `name`, looking only
+    at ops BEFORE `stop_op` when given (the while op itself rewrites its
+    loop state, so post-hoc re-derivation must not see it); returns
+    (op, block) or (None, None)."""
+    b = block
+    while b is not None:
+        found = None
+        for op in b.ops:
+            if stop_op is not None and op is stop_op:
+                break
+            if any(name in ns for ns in op.outputs.values()):
+                found = op
+        if found is not None:
+            return found, b
+        b = b.parent_block
+    return None, None
+
+
+def _const_scalar(block, name, stop_op=None):
+    op, _ = _defining_op(block, name, stop_op)
+    if op is not None and op.type == "fill_constant":
+        try:
+            return float(op.attrs.get("value", 0.0))
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def _other_writers(block, name, keep_op, skip_op=None):
+    """Any op (in `block` or an ancestor) besides keep_op/skip_op that
+    writes `name` — an outer loop body mutating a bound constant after
+    the inner loop makes the derived trip count unsound."""
+    b = block
+    while b is not None:
+        for op in b.ops:
+            if op is keep_op or op is skip_op:
+                continue
+            if any(name in ns for ns in op.outputs.values()):
+                return True
+        b = b.parent_block
+    return False
+
+
+def _counter_step(sub, parent, ivar):
+    """Constant positive per-iteration increment of `ivar` inside the
+    loop body, or None. Recognizes increment(i) and i = i + const."""
+    writers = [op for op in sub.ops
+               if any(ivar in ns for ns in op.outputs.values())]
+    if len(writers) != 1:
+        return None
+    op = writers[0]
+    if op.type == "increment":
+        step = float(op.attrs.get("step", 1.0))
+        return step if step > 0 else None
+    if op.type == "elementwise_add":
+        xs = op.inputs.get("X", [])
+        ys = op.inputs.get("Y", [])
+        for a, b in ((xs, ys), (ys, xs)):
+            if a and a[0] == ivar and b:
+                c = _const_scalar(sub, b[0])
+                if c is None:
+                    c = _const_scalar(parent, b[0])
+                if c is not None and c > 0:
+                    return c
+    return None
+
+
+def _infer_max_trip(program, parent, sub, cond_name, stop_op=None):
+    """Static trip bound for the reference decoder idiom: the rebound
+    loop condition is less_than/less_equal(i, n) (possibly under
+    logical_and, e.g. dygraph_to_static's synthesized `and not brk`)
+    with n a build-time constant and i a constant-initialized counter
+    incremented by a constant step in the body. Returns int or None.
+    The bound stays valid when other conjuncts end the loop earlier —
+    the masked-scan lowering handles early exit exactly
+    (reference while_op.cc needs no bound; this recovers its
+    differentiability on TPU's static-shape terms)."""
+    import math
+
+    def bound_of(name, depth):
+        if depth > 4:
+            return None
+        op, _ = _defining_op(sub, name)
+        if op is None:
+            op, _ = _defining_op(parent, name, stop_op)
+        if op is None:
+            return None
+        if op.type in ("logical_and", "assign"):
+            cands = [bound_of(ns[0], depth + 1)
+                     for s, ns in op.inputs.items() if ns]
+            cands = [c for c in cands if c is not None]
+            return min(cands) if cands else None
+        if op.type not in ("less_than", "less_equal"):
+            return None
+        xs, ys = op.inputs.get("X", []), op.inputs.get("Y", [])
+        if not xs or not ys:
+            return None
+        ivar, nvar = xs[0], ys[0]
+        n_op, n_blk = _defining_op(sub, nvar)
+        if n_op is None:
+            n_op, n_blk = _defining_op(parent, nvar, stop_op)
+        if n_op is None or n_op.type != "fill_constant":
+            return None
+        try:
+            n_val = float(n_op.attrs.get("value", 0.0))
+        except (TypeError, ValueError):
+            return None
+        # the bound must be a true constant: no OTHER writer anywhere in
+        # the loop body or the enclosing block chain (an outer loop
+        # mutating it after this loop would re-execute that write)
+        if _other_writers(sub, nvar, n_op) or \
+                _other_writers(parent, nvar, n_op, skip_op=stop_op):
+            return None
+        i0_op, i0_blk = _defining_op(parent, ivar, stop_op)
+        if i0_op is None or i0_op.type != "fill_constant":
+            return None
+        i0 = float(i0_op.attrs.get("value", 0.0))
+        step = _counter_step(sub, parent, ivar)
+        if step is None:
+            return None
+        span = n_val - i0 + (1.0 if op.type == "less_equal" else 0.0)
+        if span <= 0:
+            return 0
+        return int(math.ceil(span / step))
+
+    return bound_of(cond_name, 0)
+
+
 class While:
     """fluid.layers.While loop builder.
 
@@ -38,8 +167,11 @@ class While:
         """`max_trip_count` (TPU extension, not in the reference signature):
         a static upper bound on iterations. Setting it makes the loop
         reverse-mode differentiable (bounded masked-scan lowering, see
-        ops/control_flow_ops.py while_op); without it the loop lowers to
-        lax.while_loop and append_backward through it raises."""
+        ops/control_flow_ops.py while_op); without it the bound is
+        AUTO-DERIVED from counter-vs-constant loop conditions
+        (_infer_max_trip) — reference-style decoder loops differentiate
+        with no extra kwarg, matching while_op.cc's boundless grad.
+        Underivable loops lower to lax.while_loop (forward-only)."""
         self.cond_var = cond
         self.max_trip_count = max_trip_count
         self.helper = LayerHelper("while", name=name)
@@ -69,10 +201,23 @@ class While:
         for n in writes:
             if n not in x_names and n != self.cond_var.name:
                 x_names.append(n)
+        max_trip = self.max_trip_count
+        auto = False
+        if max_trip is None:
+            max_trip = _infer_max_trip(program, parent,
+                                       program.blocks[sub.idx],
+                                       self.cond_var.name)
+            auto = max_trip is not None
         attrs = {"sub_block": sub.idx, "cond_name": self.cond_var.name,
                  "x_names": x_names, "out_names": writes}
-        if self.max_trip_count is not None:
-            attrs["max_trip_count"] = int(self.max_trip_count)
+        if max_trip is not None:
+            attrs["max_trip_count"] = int(max_trip)
+            if auto:
+                # re-validated at lowering time when the program is
+                # FINAL: ops appended after this point (e.g. an outer
+                # loop mutating the bound) could invalidate the
+                # derivation (ops/control_flow_ops.py while_op)
+                attrs["max_trip_count_auto"] = True
         parent.append_op(
             type="while",
             inputs={"Condition": [self.cond_var], "X": x_names},

@@ -222,6 +222,13 @@ def _install_op_overloads(cls):
     cls.__le__ = lambda self, o: _cmp("less_equal", self, o)
     cls.__gt__ = lambda self, o: _cmp("greater_than", self, o)
     cls.__ge__ = lambda self, o: _cmp("greater_equal", self, o)
+    # reference math_op_patch.py:278 patches __eq__/__ne__ to equal/
+    # not_equal ops on both static and dygraph vars. Defining __eq__
+    # would drop the inherited __hash__ — restore identity hashing
+    # (Variables are dict keys, e.g. executor feed dicts).
+    cls.__eq__ = lambda self, o: _cmp("equal", self, o)
+    cls.__ne__ = lambda self, o: _cmp("not_equal", self, o)
+    cls.__hash__ = object.__hash__
 
 
 _install_op_overloads(Variable)

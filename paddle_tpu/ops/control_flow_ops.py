@@ -93,6 +93,29 @@ def while_op(ctx, ins, attrs):
         return {n: env[n] for n in carried}
 
     max_trip = attrs.get("max_trip_count")
+    if max_trip is not None and attrs.get("max_trip_count_auto"):
+        # the bound was auto-derived at build time; re-derive against
+        # the FINAL program (ops appended after the While block — e.g.
+        # an outer loop mutating the bound constant — could invalidate
+        # it, which must be an error, not silent truncation)
+        from ..layers.control_flow import _infer_max_trip
+        sub_blk = ctx.program.blocks[sub]
+        parent_blk = sub_blk.parent_block
+        # find the forward while op by its (unique) sub-block index —
+        # attrs may be a copy here (grad lowering re-enters with the
+        # fwd spec), so identity comparison would miss
+        this_op = next((op for op in parent_blk.ops
+                        if op.type == "while"
+                        and op.attrs.get("sub_block") == sub), None)
+        now = _infer_max_trip(ctx.program, parent_blk, sub_blk,
+                              cond_name, stop_op=this_op)
+        if now != int(max_trip):
+            raise ValueError(
+                f"While: the auto-derived max_trip_count "
+                f"({max_trip}) is no longer valid in the final program "
+                f"(re-derivation gives {now}); the loop bound is "
+                f"mutated after the loop was built — pass "
+                f"max_trip_count explicitly")
     if max_trip is None:
         def cond_fn(carry):
             return _as_pred(carry[cond_name])

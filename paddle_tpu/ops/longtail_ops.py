@@ -1,0 +1,353 @@
+"""Residual long-tail op types (round-4 registry-diff closure).
+
+References:
+- teacher_student_sigmoid_loss_op.{cc,h} — distillation CTR loss
+- positive_negative_pair_op.h — ranking pair metric
+- similarity_focus_op.h — greedy row/col focus mask
+- diag_embed_op.h — batched diagonal embed
+- fill_op.{cc,h} — fill from a flat value list
+- fill_zeros_like_op.cc (fill_zeros_like2: dtype-attr variant)
+- uniform_random_batch_size_like_op.cc / gaussian_random_batch_size_like
+  (batch_size_like.h shape contract)
+- lookup_table_dequant_op.{cc,h} — uint8-packed quantized embedding
+- dequantize_abs_max_op.cc, dequantize_log_op.cc — int8 dequant
+- seed_op.{cc,h} — RNG seed materialization
+- attention_lstm_op.cc — fused attention + LSTM CPU kernel
+
+TPU design notes: sequence ops take the padded [B, T, ...] + Length
+masked-dense form; the greedy CPU loops (similarity_focus) become
+fixed-trip lax.fori with mask state; attention_lstm is one lax.scan over
+time with a masked softmax over the full padded sequence per step.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.registry import register_op
+from .common import as_dtype, x_of
+
+
+@register_op("teacher_student_sigmoid_loss", infer_shape=False)
+def teacher_student_sigmoid_loss(ctx, ins, attrs):
+    """reference teacher_student_sigmoid_loss_op.h: label encodes
+    (teacher score z', click z): -2 = no-z' noclick, -1 = no-z' click,
+    [0,1) = z' noclick, [1,2] = 1 + z' click. Loss is the click BCE
+    term plus (when z' exists) a distillation BCE against z'."""
+    x = jnp.reshape(x_of(ins), (-1,))
+    label = jnp.reshape(x_of(ins, "Label"), (-1,)).astype(x.dtype)
+    relu_x = jnp.maximum(x, 0.0)
+    softplus = jnp.log1p(jnp.exp(-jnp.abs(x)))
+    bce0 = relu_x + softplus              # -log sigmoid(-x): z = 0
+    bce1 = relu_x - x + softplus          # -log sigmoid(x):  z = 1
+    zprime = jnp.where(label < 1.0, label, label - 1.0)
+    distill = relu_x - x * zprime + softplus
+    y = jnp.where(label < -1.0, bce0,
+                  jnp.where(label < 0.0, bce1,
+                            jnp.where(label < 1.0, bce0 + distill,
+                                      bce1 + distill)))
+    return {"Y": y.reshape(-1, 1)}
+
+
+@register_op("positive_negative_pair", grad=False, infer_shape=False)
+def positive_negative_pair(ctx, ins, attrs):
+    """reference positive_negative_pair_op.h: within each QueryID group,
+    count ordered pairs whose score ranking agrees (positive) /
+    disagrees (negative) with the label ranking; equal scores with
+    different labels are neutral. Pair weight = mean of the two
+    instance weights. O(N^2) pair masks replace the host hash-map."""
+    score = x_of(ins, "Score")
+    col = int(attrs.get("column", -1))
+    s = score[:, col] if score.ndim == 2 else jnp.reshape(score, (-1,))
+    label = jnp.reshape(x_of(ins, "Label"), (-1,)).astype(jnp.float32)
+    query = jnp.reshape(x_of(ins, "QueryID"), (-1,))
+    w_in = ins.get("Weight")
+    w = (jnp.reshape(w_in[0], (-1,)).astype(jnp.float32) if w_in
+         else jnp.ones_like(label))
+    s = s.astype(jnp.float32)
+    n = s.shape[0]
+    same_q = query[:, None] == query[None, :]
+    upper = jnp.arange(n)[:, None] < jnp.arange(n)[None, :]
+    diff_label = label[:, None] != label[None, :]
+    pair = same_q & upper & diff_label
+    pw = 0.5 * (w[:, None] + w[None, :])
+    prod = (s[:, None] - s[None, :]) * (label[:, None] - label[None, :])
+    tie = s[:, None] == s[None, :]
+    pos = jnp.sum(jnp.where(pair & (prod > 0), pw, 0.0))
+    neg = jnp.sum(jnp.where(pair & ~(prod > 0), pw, 0.0))
+    neu = jnp.sum(jnp.where(pair & tie, pw, 0.0))
+    if ins.get("AccumulatePositivePair"):
+        pos = pos + jnp.reshape(ins["AccumulatePositivePair"][0], ())
+        neg = neg + jnp.reshape(ins["AccumulateNegativePair"][0], ())
+        neu = neu + jnp.reshape(ins["AccumulateNeutralPair"][0], ())
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}
+
+
+@register_op("similarity_focus", grad=False, infer_shape=False)
+def similarity_focus(ctx, ins, attrs):
+    """reference similarity_focus_op.h: X [B, d1, d2, d3]; for each
+    `index` slice along `axis`, greedily pick the largest entries of the
+    2D slice whose row AND column are untagged (one per row/col, like
+    greedy bipartite matching), and set 1 across the whole `axis` dim at
+    each picked (row, col). The host sort+scan loop becomes a
+    fixed-trip argmax/mask fori."""
+    x = x_of(ins)
+    axis = int(attrs["axis"])
+    indexes = [int(i) for i in attrs["indexes"]]
+    B = x.shape[0]
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus: axis must be 1..3, got {axis}")
+    # move `axis` to position 1: slices are [B, dA, dR, dC]
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = jnp.transpose(x, perm)
+    _, dA, dR, dC = xt.shape
+    npick = min(dR, dC)
+    out_t = jnp.zeros(xt.shape, x.dtype)
+
+    def one_index(out_t, index):
+        sl = xt[:, index]                                  # [B, dR, dC]
+
+        def body(t, st):
+            rtag, ctag, mask = st
+            live = (~rtag[:, :, None]) & (~ctag[:, None, :])
+            masked = jnp.where(live, sl, -jnp.inf)
+            flat = masked.reshape(B, -1)
+            best = jnp.argmax(flat, axis=1)                # [B]
+            r, c = best // dC, best % dC
+            ok = jnp.take_along_axis(
+                flat, best[:, None], axis=1)[:, 0] > -jnp.inf
+            rtag = rtag.at[jnp.arange(B), r].set(
+                rtag[jnp.arange(B), r] | ok)
+            ctag = ctag.at[jnp.arange(B), c].set(
+                ctag[jnp.arange(B), c] | ok)
+            mask = mask.at[jnp.arange(B), r, c].set(
+                jnp.where(ok, 1.0, mask[jnp.arange(B), r, c]))
+            return rtag, ctag, mask
+
+        rtag = jnp.zeros((B, dR), bool)
+        ctag = jnp.zeros((B, dC), bool)
+        mask = jnp.zeros((B, dR, dC), jnp.float32)
+        _, _, mask = jax.lax.fori_loop(0, npick, body, (rtag, ctag, mask))
+        # set 1 across the whole axis dim at the picked positions
+        return jnp.maximum(out_t, mask[:, None, :, :].astype(x.dtype))
+
+    for index in indexes:
+        out_t = one_index(out_t, index)
+    inv = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 2, 3, 1)}[axis]
+    return {"Out": jnp.transpose(out_t, inv)}
+
+
+@register_op("diag_embed", grad=None, infer_shape=False)
+def diag_embed(ctx, ins, attrs):
+    """reference diag_embed_op.h: embed the last dim of X as a diagonal
+    of a new 2D tail (dims dim1/dim2 of the output, offset off the main
+    diagonal)."""
+    x = x_of(ins, "Input")
+    if x is None:
+        x = x_of(ins)
+    offset = int(attrs.get("offset", 0))
+    dim1 = int(attrs.get("dim1", -2))
+    dim2 = int(attrs.get("dim2", -1))
+    n = x.shape[-1]
+    size = n + abs(offset)
+    eye = jnp.eye(size, k=offset, dtype=x.dtype)
+    diag_rows = jnp.arange(n) + max(-offset, 0)
+    # out2d[..., i + max(-off,0), :] gets x[..., i] at col i + max(off, 0)
+    out = jnp.zeros(x.shape[:-1] + (size, size), x.dtype)
+    out = out.at[..., diag_rows, diag_rows + offset].set(x)
+    nd = out.ndim
+    dim1 = dim1 % nd
+    dim2 = dim2 % nd
+    # move the two trailing (row, col) dims to (dim1, dim2)
+    rest = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+    perm = [None] * nd
+    perm[dim1] = nd - 2
+    perm[dim2] = nd - 1
+    ri = iter(rest)
+    for i in range(nd):
+        if perm[i] is None:
+            perm[i] = next(ri)
+    return {"Out": jnp.transpose(out, perm)}
+
+
+@register_op("fill", grad=False, infer_shape=False)
+def fill(ctx, ins, attrs):
+    """reference fill_op.h: materialize attr `value` (flat row-major
+    float list) into shape/dtype."""
+    shape = tuple(int(s) for s in attrs["shape"])
+    dt = as_dtype(attrs)
+    vals = np.asarray([float(v) for v in attrs["value"]],
+                      np.float64).reshape(shape)
+    return {"Out": jnp.asarray(vals.astype(dt))}
+
+
+@register_op("fill_zeros_like2", grad=False, infer_shape=False)
+def fill_zeros_like2(ctx, ins, attrs):
+    """fill_zeros_like with an explicit dtype attr (reference
+    fill_zeros_like_op.cc FillZerosLike2)."""
+    x = x_of(ins)
+    dt = as_dtype(attrs) if attrs.get("dtype") is not None else x.dtype
+    return {"Out": jnp.zeros(x.shape, dt)}
+
+
+def _batch_size_like_shape(ins, attrs):
+    ref = x_of(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    return tuple(shape)
+
+
+@register_op("uniform_random_batch_size_like", grad=False,
+             infer_shape=False, needs_rng=True)
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    """reference uniform_random_batch_size_like_op.cc: uniform_random
+    whose shape[output_dim_idx] copies Input.shape[input_dim_idx]."""
+    shape = _batch_size_like_shape(ins, attrs)
+    dt = as_dtype(attrs)
+    key = ctx.op_key(attrs)
+    return {"Out": jax.random.uniform(
+        key, shape, dtype=dt, minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0))}
+
+
+@register_op("gaussian_random_batch_size_like", grad=False,
+             infer_shape=False, needs_rng=True)
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    shape = _batch_size_like_shape(ins, attrs)
+    dt = as_dtype(attrs)
+    key = ctx.op_key(attrs)
+    out = jax.random.normal(key, shape, dtype=dt)
+    return {"Out": out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)}
+
+
+@register_op("seed", grad=False, infer_shape=False, needs_rng=True)
+def seed(ctx, ins, attrs):
+    """reference seed_op.h: emit attr seed if nonzero, else a random
+    one (drawn from the op key here — no host RNG on device)."""
+    user_seed = int(attrs.get("seed", 0))
+    if user_seed != 0:
+        return {"Out": jnp.full((1,), user_seed, jnp.int32)}
+    key = ctx.op_key(attrs)
+    return {"Out": jax.random.randint(key, (1,), 1, 2**31 - 1,
+                                      dtype=jnp.int32)}
+
+
+# ------------------------------------------------------- int8 dequant trio
+
+@register_op("dequantize_abs_max", grad=False, infer_shape=False)
+def dequantize_abs_max(ctx, ins, attrs):
+    """reference dequantize_abs_max_op.cc: out = scale * int8_x /
+    max_range."""
+    x = x_of(ins).astype(jnp.float32)
+    scale = jnp.reshape(x_of(ins, "Scale"), ()).astype(jnp.float32)
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": scale * x / max_range}
+
+
+@register_op("dequantize_log", grad=False, infer_shape=False)
+def dequantize_log(ctx, ins, attrs):
+    """reference dequantize_log_op.cc: int8 codes index a 128-entry
+    log2 dictionary; negative codes mirror with sign (code < 0 ->
+    -2^dict[code + 128], else 2^dict[code])."""
+    x = x_of(ins).astype(jnp.int32)
+    dict_ = jnp.reshape(x_of(ins, "Dict"), (-1,)).astype(jnp.float32)
+    idx = jnp.where(x < 0, x + 128, x)
+    mag = jnp.exp2(dict_[idx])
+    return {"Out": jnp.where(x < 0, -mag, mag)}
+
+
+@register_op("lookup_table_dequant", infer_shape=False)
+def lookup_table_dequant(ctx, ins, attrs):
+    """reference lookup_table_dequant_op.h: W rows are [min, max,
+    packed...] float32 where each packed float carries 4 uint8 codes;
+    out[id] = (max-min)/256 * code + min, row width (cols-2)*4.
+    padding_idx rows emit zeros. Differentiable w.r.t. nothing useful
+    (the table is quantized storage), but Ids flow is index-only —
+    registered with default grad so graphs containing it still build;
+    the W cotangent is zero by construction (bitcast is int)."""
+    ids = x_of(ins, "Ids").astype(jnp.int32).reshape(-1)
+    w = x_of(ins, "W")
+    padding_idx = int(attrs.get("padding_idx", -1))
+    mins = w[:, 0]
+    maxs = w[:, 1]
+    packed = w[:, 2:]
+    # float32 -> 4x uint8 codes, little-endian byte order (the CPU
+    # kernel reinterprets the row buffer as unsigned char*)
+    codes = jax.lax.bitcast_convert_type(packed, jnp.uint8)  # [R, C-2, 4]
+    codes = codes.reshape(w.shape[0], -1).astype(jnp.float32)
+    scale = (maxs - mins) / 256.0
+    table = codes * scale[:, None] + mins[:, None]           # [R, width]
+    out = table[ids]
+    if padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[:, None], 0.0, out)
+    orig = x_of(ins, "Ids").shape
+    return {"Out": out.reshape(tuple(orig[:-1]) + (out.shape[-1],))}
+
+
+# ---------------------------------------------------------- attention_lstm
+
+@register_op("attention_lstm", infer_shape=False)
+def attention_lstm(ctx, ins, attrs):
+    """reference attention_lstm_op.cc: per step t, attention scores over
+    the whole (padded) sequence from concat(x, prev_cell) through a
+    (M+D)x1 fc (+bias, relu), optional scalar rescale (+bias, relu),
+    masked softmax; the pooled x feeds one LSTM step with gate order
+    [forget, input, output, candidate].
+
+    Padded form: X [B, T, M] (+ Length [B]), C0 [B, D], H0 [B, D].
+    LSTMWeight [(M+D), 4D], LSTMBias [1, 4D], AttentionWeight [(M+D), 1].
+    Outputs Hidden/Cell [B, T, D] (zeros past each row's length)."""
+    x = x_of(ins)
+    c0 = x_of(ins, "C0")
+    h0_in = ins.get("H0")
+    aw = x_of(ins, "AttentionWeight")
+    ab = ins.get("AttentionBias")
+    ascal = ins.get("AttentionScalar")
+    ascal_b = ins.get("AttentionScalarBias")
+    lw = x_of(ins, "LSTMWeight")
+    lb = x_of(ins, "LSTMBias").reshape(-1)
+    B, T, M = x.shape
+    D = c0.shape[1]
+    lens = ins.get("Length")
+    length = (jnp.reshape(lens[0], (-1,)).astype(jnp.int32) if lens
+              else jnp.full((B,), T, jnp.int32))
+    valid = jnp.arange(T)[None, :] < length[:, None]         # [B, T]
+    h0 = h0_in[0] if h0_in else jnp.zeros_like(c0)
+    aw_x, aw_c = aw[:M, 0], aw[M:, 0]                        # [M], [D]
+    atted_x = x @ aw_x                                       # [B, T]
+    if ab:
+        atted_x = atted_x + jnp.reshape(ab[0], ())
+    wx, wh = lw[:M], lw[M:]                                  # [M,4D],[D,4D]
+
+    def step(carry, t):
+        h_prev, c_prev = carry
+        cell_bias = c_prev @ aw_c                            # [B]
+        fc = jax.nn.relu(atted_x + cell_bias[:, None])       # [B, T]
+        if ascal:
+            fc = fc * jnp.reshape(ascal[0], ())
+            if ascal_b:
+                fc = fc + jnp.reshape(ascal_b[0], ())
+            fc = jax.nn.relu(fc)
+        fc = jnp.where(valid, fc, -jnp.inf)
+        probs = jax.nn.softmax(fc, axis=1)                   # [B, T]
+        lstm_x = jnp.einsum("bt,btm->bm", probs, x)          # [B, M]
+        gates = lstm_x @ wx + h_prev @ wh + lb               # [B, 4D]
+        f = jax.nn.sigmoid(gates[:, :D])
+        i = jax.nn.sigmoid(gates[:, D:2 * D])
+        o = jax.nn.sigmoid(gates[:, 2 * D:3 * D])
+        cand = jnp.tanh(gates[:, 3 * D:])
+        c_new = f * c_prev + i * cand
+        h_new = jnp.tanh(c_new) * o
+        live = valid[:, t][:, None]
+        c_new = jnp.where(live, c_new, c_prev)
+        h_new = jnp.where(live, h_new, h_prev)
+        out_h = jnp.where(live, h_new, 0.0)
+        out_c = jnp.where(live, c_new, 0.0)
+        return (h_new, c_new), (out_h, out_c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    hidden = jnp.transpose(hs, (1, 0, 2))                    # [B, T, D]
+    cell = jnp.transpose(cs, (1, 0, 2))
+    return {"Hidden": hidden, "Cell": cell}

@@ -128,6 +128,89 @@ register_grad_lower("fake_quantize_dequantize_abs_max")(
     lambda ctx, ins, attrs: _ste_grad(ins, attrs))
 
 
+@register_op("fake_quantize_dequantize_moving_average_abs_max", grad=None,
+             infer_shape=False)
+def fake_quantize_dequantize_moving_average_abs_max(ctx, ins, attrs):
+    """Quant-dequant variant of the moving-average scale op (reference
+    fake_quantize_op.cc FakeQuantizeDequantizeMovingAverageAbsMax) —
+    identical float simulation + STE grad."""
+    return fake_quantize_moving_average_abs_max(ctx, ins, attrs)
+
+
+register_grad_lower("fake_quantize_dequantize_moving_average_abs_max")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("moving_average_abs_max_scale", grad=None, infer_shape=False)
+def moving_average_abs_max_scale(ctx, ins, attrs):
+    """Scale OBSERVER only (reference fake_quantize_op.h
+    MovingAverageAbsMaxScaleKernel): Out = X unchanged; the moving
+    |x|max statistics update exactly like the quantizing variant."""
+    x = x_of(ins)
+    if bool(attrs.get("is_test", False)):
+        return {"Out": x}
+    accum = x_of(ins, "InAccum")
+    state = x_of(ins, "InState")
+    rho = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    new_state = rho * state + 1.0
+    new_accum = rho * accum + cur
+    return {"Out": x, "OutScale": (new_accum / new_state).reshape(1),
+            "StateOut": new_state, "AccumOut": new_accum}
+
+
+register_grad_lower("moving_average_abs_max_scale")(
+    lambda ctx, ins, attrs: _ste_grad(ins, attrs))
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", grad=None,
+             infer_shape=False)
+def fake_channel_wise_dequantize_max_abs(ctx, ins, attrs):
+    """reference fake_dequantize_op.h
+    FakeChannelWiseDequantizeMaxAbsKernel: one scale tensor -> per-dim-0
+    channel scales; two -> per-dim-1 channel scales times a scalar
+    activation scale; max_range multiplies (2^(bits_i - 1) - 1)."""
+    x = x_of(ins)
+    scales = ins["Scales"]
+    bits = [int(b) for b in attrs.get("quant_bits", [])]
+    bits += [8] * (len(scales) - len(bits))   # reference default: 8 per scale
+    max_range = 1.0
+    for i in range(len(scales)):
+        max_range *= float((1 << (bits[i] - 1)) - 1)
+    if len(scales) == 1:
+        s = jnp.reshape(scales[0], (-1,))
+        s = s.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+        out = x * s / max_range
+    else:
+        s0 = jnp.reshape(scales[0], (-1,))
+        s1 = jnp.reshape(scales[1], ())
+        s = s0.reshape((1, x.shape[1]) + (1,) * (x.ndim - 2))
+        out = x * (s * s1) / max_range
+    return {"Out": out}
+
+
+@register_grad_lower("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs_grad(ctx, ins, attrs):
+    # linear in X, like fake_dequantize_max_abs
+    g = x_of(ins, "Out@GRAD")
+    x = x_of(ins)
+    scales = ins["Scales"]
+    fattrs = attrs["__fwd_op__"]["attrs"]
+    bits = [int(b) for b in fattrs.get("quant_bits", [])]
+    bits += [8] * (len(scales) - len(bits))
+    max_range = 1.0
+    for i in range(len(scales)):
+        max_range *= float((1 << (bits[i] - 1)) - 1)
+    if len(scales) == 1:
+        s = jnp.reshape(scales[0], (-1,)).reshape(
+            (x.shape[0],) + (1,) * (x.ndim - 1))
+    else:
+        s = jnp.reshape(scales[0], (-1,)).reshape(
+            (1, x.shape[1]) + (1,) * (x.ndim - 2)) * \
+            jnp.reshape(scales[1], ())
+    return {"X@GRAD": [g * s / max_range]}
+
+
 @register_op("fake_dequantize_max_abs", grad=None, infer_shape=False)
 def fake_dequantize_max_abs(ctx, ins, attrs):
     """Out = X * Scale / max_range (reference fake_dequantize_op.cc).

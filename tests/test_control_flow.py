@@ -239,7 +239,10 @@ def test_while_differentiable_with_max_trip_count():
         gwv, n_iters * xv * wv ** (n_iters - 1), rtol=1e-5)
 
 
-def test_while_unbounded_grad_raises():
+def test_while_auto_bound_differentiates():
+    """The reference decoder idiom — less_than(i, n) with constant n and
+    increment(i) — differentiates with NO max_trip_count kwarg: the
+    bound is auto-derived (while_op.cc's grad needs no bound either)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = layers.data("x", [3], dtype="float32")
@@ -253,6 +256,32 @@ def test_while_unbounded_grad_raises():
             layers.assign(layers.scale(acc, 2.0), acc)
             layers.increment(i, value=1)
             layers.less_than(i, n, cond=cond_v)
+        loss = layers.reduce_sum(acc)
+        gx, = fluid.gradients(loss, [x])
+    # derived bound recorded on the op
+    w_op = next(op for op in main.global_block().ops
+                if op.type == "while")
+    assert w_op.attrs.get("max_trip_count") == 4, w_op.attrs
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    out, gxv = _run(main, startup, {"x": xv}, [acc, gx])
+    np.testing.assert_allclose(out, xv * 16.0, rtol=1e-5)
+    np.testing.assert_allclose(gxv, np.full(3, 16.0), rtol=1e-5)
+
+
+def test_while_data_dependent_grad_raises():
+    """A condition on DATA VALUES (not a counter) has no derivable
+    bound: the loop stays a lax.while_loop and grad still raises."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        hundred = layers.fill_constant([1], "float32", 100.0)
+        acc = layers.assign(x)
+        cond_v = layers.less_than(layers.reduce_sum(acc), hundred)
+        loop = layers.While(cond_v)
+        with loop.block():
+            layers.assign(layers.scale(acc, 2.0), acc)
+            layers.less_than(layers.reduce_sum(acc), hundred, cond=cond_v)
         loss = layers.reduce_sum(acc)
         try:
             fluid.gradients(loss, [x])
@@ -320,3 +349,40 @@ def test_gradients_of_intermediate_var_with_nondiff_producer():
     xv = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
     g, = _run(main, startup, {"x": xv}, [gh])
     np.testing.assert_allclose(g, 2 * (2 * xv), rtol=1e-6)
+
+
+def test_while_auto_bound_rejects_mutated_bound():
+    """An outer loop mutating the inner loop's bound AFTER the inner
+    While was built invalidates the auto-derived trip count: lowering
+    re-validates against the final program and raises instead of
+    silently truncating iterations."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        oi = layers.fill_constant([1], "int64", 0)
+        on = layers.fill_constant([1], "int64", 3)
+        n = layers.fill_constant([1], "int64", 2)   # inner bound (mutated!)
+        acc = layers.assign(x)
+        ocond = layers.less_than(oi, on)
+        outer = layers.While(ocond)
+        with outer.block():
+            i = layers.fill_constant([1], "int64", 0)
+            icond = layers.less_than(i, n)
+            inner = layers.While(icond)
+            with inner.block():
+                layers.assign(layers.scale(acc, 2.0), acc)
+                layers.increment(i, value=1)
+                layers.less_than(i, n, cond=icond)
+            layers.increment(n, value=1)            # bound grows each pass
+            layers.increment(oi, value=1)
+            layers.less_than(oi, on, cond=ocond)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        try:
+            exe.run(main, feed={"x": np.ones(3, np.float32)},
+                    fetch_list=[acc])
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "no longer valid" in str(e) or \
+                "max_trip_count" in str(e), e

@@ -140,3 +140,149 @@ def test_fallback_to_trace():
         out, = exe.run(main, feed={feeds[0]: np.ones((2,), np.float32)},
                        fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(out), [3.0, 3.0])
+
+
+def test_break_continue_python():
+    """break/continue lower to flag variables; plain-python semantics
+    must be exactly preserved (reference break_continue_transformer)."""
+    def fn(x, n):
+        acc = 0.0
+        for i in range(n):
+            if i == 2:
+                continue
+            if i == 5:
+                break
+            acc = acc + x
+        k = 0
+        while k < 10:
+            k = k + 1
+            if k > 4:
+                break
+        return acc + k
+
+    conv = convert_to_static(fn)
+    for n in (0, 2, 4, 9):
+        assert conv(1.5, n) == fn(1.5, n), n
+
+
+def test_break_in_static_while():
+    """A data-dependent while with break converts to a program whose
+    loop carries the break flag (both control paths recorded)."""
+    def fn(x):
+        hundred = layers.fill_constant([1], "float32", 100.0)
+        ten = layers.fill_constant([1], "float32", 10.0)
+        while layers.less_than(layers.reduce_sum(x), hundred):
+            x = layers.scale(x, scale=2.0)
+            if layers.greater_than(layers.reduce_sum(x), ten):
+                break
+        return x
+
+    pt = dygraph.ProgramTranslator()
+    x = np.full((2, 2), 1.0, np.float32)
+    main, startup, feeds, fetches = pt.get_program(fn, x)
+    assert "while" in _op_types(main)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={feeds[0]: x}, fetch_list=fetches)
+    # sums: 4 -> 8 -> 16: first sum > 10 stops the loop
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 2), 4.0))
+
+
+def test_continue_in_for_range_tensor_bound():
+    def fn(x, n):
+        for i in range(n):
+            if i == 1:
+                continue
+            x = layers.scale(x, scale=2.0)
+        return x
+
+    pt = dygraph.ProgramTranslator()
+    main, startup, feeds, fetches = pt.get_program(
+        fn, np.ones((2,), np.float32), np.array([3], np.int64))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main,
+                       feed={feeds[0]: np.ones((2,), np.float32),
+                             feeds[1]: np.array([3], np.int64)},
+                       fetch_list=fetches)
+    # i=0 and i=2 double; i=1 skipped -> x * 4
+    np.testing.assert_allclose(np.asarray(out), [4.0, 4.0])
+
+
+def test_logical_ops_convert():
+    """`and`/`or`/`not` on Variables route through layers.logical_*
+    (python's `and` would call Variable.__bool__ and fail)."""
+    def fn(x):
+        s = layers.reduce_sum(x)
+        zero = layers.fill_constant([1], "float32", 0.0)
+        ten = layers.fill_constant([1], "float32", 10.0)
+        pred = layers.greater_than(s, zero) and layers.less_than(s, ten)
+        if pred:
+            y = layers.scale(x, scale=2.0)
+        else:
+            y = layers.scale(x, scale=-1.0)
+        return y
+
+    pt = dygraph.ProgramTranslator()
+    x = np.ones((2, 2), np.float32)
+    main, startup, feeds, fetches = pt.get_program(fn, x)
+    assert "logical_and" in _op_types(main)
+    exe = fluid.Executor()
+    for xv, factor in ((np.ones((2, 2), np.float32), 2.0),
+                       (np.full((2, 2), 9.0, np.float32), -1.0)):
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={feeds[0]: xv}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out), xv * factor)
+
+
+def _double(v):
+    if layers.greater_than(layers.reduce_sum(v),
+                           layers.fill_constant([1], "float32", 0.0)):
+        v = layers.scale(v, scale=2.0)
+    else:
+        v = layers.scale(v, scale=0.5)
+    return v
+
+
+def test_call_transformer_converts_nested_functions():
+    """A user helper called from converted code is AST-converted too:
+    its data-dependent `if` must appear as a cond op in the program
+    (reference call_transformer)."""
+    def fn(x):
+        y = _double(x)
+        return layers.scale(y, scale=1.0)
+
+    pt = dygraph.ProgramTranslator()
+    x = np.ones((2, 2), np.float32)
+    main, startup, feeds, fetches = pt.get_program(fn, x)
+    assert "cond" in _op_types(main), _op_types(main)
+    exe = fluid.Executor()
+    for sign, factor in ((1.0, 2.0), (-1.0, 0.5)):
+        xv = np.ones((2, 2), np.float32) * sign
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={feeds[0]: xv}, fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out), xv * factor)
+
+
+def test_list_append_in_converted_code():
+    """Python list appends survive conversion (plain-python loops and
+    eager mode collect Variables exactly like undecorated code)."""
+    def fn(x):
+        outs = []
+        for i in range(3):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return layers.sums(outs)
+
+    pt = dygraph.ProgramTranslator()
+    x = np.ones((2,), np.float32)
+    main, startup, feeds, fetches = pt.get_program(fn, x)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={feeds[0]: x}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(out), [14.0, 14.0])

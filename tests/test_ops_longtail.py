@@ -1,0 +1,351 @@
+"""OpTests for the round-4 long-tail closure (reference pattern:
+test_teacher_student_sigmoid_loss_op.py, test_positive_negative_pair_op.py,
+test_similarity_focus_op.py, test_diag_embed.py, test_fill_op.py,
+test_uniform_random_batch_size_like_op.py, test_lookup_table_dequant_op.py,
+test_fake_dequantize_op.py, test_fake_quantize_op.py, test_seed_op.py,
+test_attention_lstm_op.py)."""
+import numpy as np
+import paddle_tpu as fluid
+
+from op_test import make_op_test as _t
+from test_ops_detection2 import _run_op
+
+RNG = np.random.default_rng(77)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_teacher_student_sigmoid_loss():
+    x = RNG.standard_normal((8, 1)).astype(np.float32)
+    # labels covering all four branches
+    label = np.array([[-2.0], [-1.0], [0.3], [1.7],
+                      [-2.0], [0.9], [1.0], [-1.0]], np.float32)
+
+    def branch(xi, li):
+        softplus = np.log1p(np.exp(-abs(xi)))
+        relu = max(xi, 0.0)
+        if li < -1.0:
+            return relu + softplus
+        if li < 0.0:
+            return relu - xi + softplus
+        if li < 1.0:
+            return (relu + softplus) + (relu - xi * li + softplus)
+        return (relu - xi + softplus) + (relu - xi * (li - 1.0) + softplus)
+
+    ref = np.array([[branch(float(x[i, 0]), float(label[i, 0]))]
+                    for i in range(8)], np.float32)
+    t = _t("teacher_student_sigmoid_loss",
+           {"X": ("tss_x", x), "Label": ("tss_l", label)},
+           {}, {"Y": ref})
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Y")
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.8], [0.2], [0.5], [0.5], [0.9]], np.float32)
+    label = np.array([[1.0], [0.0], [1.0], [0.0], [1.0]], np.float32)
+    query = np.array([[7], [7], [7], [7], [9]], np.int64)
+    # query 7: pairs with different labels:
+    #   (0,1): (0.8-0.2)*(1-0) > 0 -> pos
+    #   (0,3): (0.8-0.5)*1 > 0 -> pos
+    #   (1,2): (0.2-0.5)*(0-1) > 0 -> pos
+    #   (2,3): scores equal -> neu AND neg (reference falls through)
+    # query 9: single instance, no pairs
+    outs = _run_op(
+        "positive_negative_pair",
+        {"Score": [("pnp_s", score)], "Label": [("pnp_l", label)],
+         "QueryID": [("pnp_q", query)]},
+        {"column": -1},
+        {"PositivePair": ((1,), "float32"),
+         "NegativePair": ((1,), "float32"),
+         "NeutralPair": ((1,), "float32")})
+    pos, neg, neu = [float(o[0]) for o in outs]
+    assert pos == 3.0 and neg == 1.0 and neu == 1.0, (pos, neg, neu)
+
+    # accumulate path
+    outs = _run_op(
+        "positive_negative_pair",
+        {"Score": [("pnp_s2", score)], "Label": [("pnp_l2", label)],
+         "QueryID": [("pnp_q2", query)],
+         "AccumulatePositivePair": [("pnp_ap", np.array([10.0],
+                                                        np.float32))],
+         "AccumulateNegativePair": [("pnp_an", np.array([20.0],
+                                                        np.float32))],
+         "AccumulateNeutralPair": [("pnp_au", np.array([30.0],
+                                                       np.float32))]},
+        {"column": -1},
+        {"PositivePair": ((1,), "float32"),
+         "NegativePair": ((1,), "float32"),
+         "NeutralPair": ((1,), "float32")})
+    assert [float(o[0]) for o in outs] == [13.0, 21.0, 31.0]
+
+
+def test_similarity_focus():
+    # reference similarity_focus_op.h greedy oracle, axis=1
+    B, d1, d2, d3 = 2, 3, 4, 5
+    x = RNG.standard_normal((B, d1, d2, d3)).astype(np.float32)
+    axis, indexes = 1, [0, 2]
+    expect = np.zeros_like(x)
+    for b in range(B):
+        for index in indexes:
+            sl = x[b, index]                       # [d2, d3]
+            order = np.argsort(-sl, axis=None)
+            tag2 = np.zeros(d2, bool)
+            tag3 = np.zeros(d3, bool)
+            picked = 0
+            for flat in order:
+                i2, i3 = flat // d3, flat % d3
+                if tag2[i2] or tag3[i3]:
+                    continue
+                tag2[i2] = tag3[i3] = True
+                expect[b, :, i2, i3] = 1.0
+                picked += 1
+                if picked == min(d2, d3):
+                    break
+    outs = _run_op("similarity_focus",
+                   {"X": [("sf_x", x)]},
+                   {"axis": axis, "indexes": indexes},
+                   {"Out": ((B, d1, d2, d3), "float32")})
+    np.testing.assert_allclose(outs[0], expect)
+
+
+def test_diag_embed():
+    x = RNG.standard_normal((2, 3)).astype(np.float32)
+    for offset in (0, 1, -2):
+        outs = _run_op("diag_embed", {"Input": [("de_x", x)]},
+                       {"offset": offset, "dim1": -2, "dim2": -1},
+                       {"Out": ((2, 3 + abs(offset), 3 + abs(offset)),
+                                "float32")})
+        expect = np.stack([np.diag(row, k=offset) for row in x])
+        np.testing.assert_allclose(outs[0], expect)
+    # non-default dims
+    outs = _run_op("diag_embed", {"Input": [("de_x2", x)]},
+                   {"offset": 0, "dim1": 0, "dim2": 2},
+                   {"Out": ((3, 2, 3), "float32")})
+    expect = np.transpose(np.stack([np.diag(r) for r in x]), (1, 0, 2))
+    np.testing.assert_allclose(outs[0], expect)
+
+
+def test_fill_and_fill_zeros_like2():
+    vals = [1.5, -2.0, 3.0, 4.5, 0.0, 9.0]
+    outs = _run_op("fill", {}, {"shape": [2, 3], "value": vals,
+                                "dtype": "float32"},
+                   {"Out": ((2, 3), "float32")})
+    np.testing.assert_allclose(
+        outs[0], np.asarray(vals, np.float32).reshape(2, 3))
+
+    x = RNG.standard_normal((3, 2)).astype(np.float32)
+    outs = _run_op("fill_zeros_like2", {"X": [("fzl2_x", x)]},
+                   {"dtype": "float32"}, {"Out": ((3, 2), "float32")})
+    np.testing.assert_allclose(outs[0], np.zeros((3, 2), np.float32))
+
+
+def test_random_batch_size_like():
+    ref = np.zeros((5, 7), np.float32)
+    outs = _run_op("uniform_random_batch_size_like",
+                   {"Input": [("ur_in", ref)]},
+                   {"shape": [-1, 4], "input_dim_idx": 0,
+                    "output_dim_idx": 0, "min": 0.0, "max": 1.0,
+                    "dtype": "float32"},
+                   {"Out": ((5, 4), "float32")})
+    assert outs[0].shape == (5, 4)
+    assert (outs[0] >= 0.0).all() and (outs[0] <= 1.0).all()
+
+    outs = _run_op("gaussian_random_batch_size_like",
+                   {"Input": [("gr_in", ref)]},
+                   {"shape": [-1, 64], "input_dim_idx": 0,
+                    "output_dim_idx": 0, "mean": 2.0, "std": 0.1,
+                    "dtype": "float32"},
+                   {"Out": ((5, 64), "float32")})
+    assert abs(float(outs[0].mean()) - 2.0) < 0.1
+
+
+def test_seed_op():
+    outs = _run_op("seed", {}, {"seed": 42}, {"Out": ((1,), "int32")})
+    assert outs[0][0] == 42
+    outs = _run_op("seed", {}, {"seed": 0}, {"Out": ((1,), "int32")})
+    assert outs[0][0] > 0
+
+
+def test_dequantize_abs_max():
+    x = RNG.integers(-127, 128, (4, 5)).astype(np.int8)
+    scale = np.array([3.5], np.float32)
+    outs = _run_op("dequantize_abs_max",
+                   {"X": [("dam_x", x)], "Scale": [("dam_s", scale)]},
+                   {"max_range": 127.0}, {"Out": ((4, 5), "float32")})
+    np.testing.assert_allclose(outs[0],
+                               3.5 * x.astype(np.float32) / 127.0,
+                               rtol=1e-6)
+
+
+def test_dequantize_log():
+    dict_ = RNG.standard_normal(128).astype(np.float32)
+    x = np.array([[-3, 0, 5], [127, -128, 1]], np.int8)
+    outs = _run_op("dequantize_log",
+                   {"X": [("dl_x", x)], "Dict": [("dl_d", dict_)]},
+                   {}, {"Out": ((2, 3), "float32")})
+    xi = x.astype(np.int32)
+    neg_idx = np.where(xi < 0, xi + 128, 0)
+    pos_idx = np.maximum(xi, 0)
+    expect = np.where(xi < 0, -np.exp2(dict_[neg_idx]),
+                      np.exp2(dict_[pos_idx]))
+    np.testing.assert_allclose(outs[0], expect, rtol=1e-6)
+
+
+def test_lookup_table_dequant():
+    rows, cols = 6, 4                      # row: [min, max, 2 packed]
+    width = (cols - 2) * 4
+    table = np.zeros((rows, cols), np.float32)
+    codes = RNG.integers(0, 256, (rows, width)).astype(np.uint8)
+    for r in range(rows):
+        table[r, 0] = -1.0 + 0.1 * r       # min
+        table[r, 1] = 2.0 + 0.2 * r        # max
+        table[r, 2:] = codes[r].view(np.float32)
+    ids = np.array([[1], [4], [0]], np.int64)
+    outs = _run_op("lookup_table_dequant",
+                   {"Ids": [("ltd_ids", ids)], "W": [("ltd_w", table)]},
+                   {"padding_idx": -1}, {"Out": ((3, width), "float32")})
+    for j, rid in enumerate([1, 4, 0]):
+        mn, mx = table[rid, 0], table[rid, 1]
+        scale = (mx - mn) / 256.0
+        expect = scale * codes[rid].astype(np.float32) + mn
+        np.testing.assert_allclose(outs[0][j], expect, rtol=1e-5,
+                                   atol=1e-6)
+    # padding_idx zeros the row
+    outs = _run_op("lookup_table_dequant",
+                   {"Ids": [("ltd_ids2", ids)], "W": [("ltd_w2", table)]},
+                   {"padding_idx": 4}, {"Out": ((3, width), "float32")})
+    assert (outs[0][1] == 0).all()
+
+
+def test_fake_channel_wise_dequantize_max_abs():
+    x = RNG.standard_normal((3, 4, 2)).astype(np.float32)
+    s0 = np.abs(RNG.standard_normal(3)).astype(np.float32) + 0.5
+    outs = _run_op("fake_channel_wise_dequantize_max_abs",
+                   {"X": [("fcd_x", x)], "Scales": [("fcd_s0", s0)]},
+                   {"quant_bits": [8]}, {"Out": ((3, 4, 2), "float32")})
+    np.testing.assert_allclose(outs[0], x * s0[:, None, None] / 127.0,
+                               rtol=1e-5)
+    # two-scale form: per-dim-1 channel + scalar
+    s1 = np.abs(RNG.standard_normal(4)).astype(np.float32) + 0.5
+    s2 = np.array([1.75], np.float32)
+    outs = _run_op("fake_channel_wise_dequantize_max_abs",
+                   {"X": [("fcd_x2", x)],
+                    "Scales": [("fcd_sa", s1), ("fcd_sb", s2)]},
+                   {"quant_bits": [8, 8]},
+                   {"Out": ((3, 4, 2), "float32")})
+    np.testing.assert_allclose(
+        outs[0], x * (s1[None, :, None] * 1.75) / (127.0 * 127.0),
+        rtol=1e-5)
+
+
+def test_fake_quantize_dequantize_moving_average_and_scale_observer():
+    x = RNG.standard_normal((4, 4)).astype(np.float32) * 3.0
+    accum = np.array([1.0], np.float32)
+    state = np.array([1.0], np.float32)
+    in_scale = np.array([1.0], np.float32)
+    outs = _run_op(
+        "fake_quantize_dequantize_moving_average_abs_max",
+        {"X": [("fqd_x", x)], "InAccum": [("fqd_a", accum)],
+         "InState": [("fqd_s", state)], "InScale": [("fqd_is", in_scale)]},
+        {"moving_rate": 0.9, "bit_length": 8},
+        {"Out": ((4, 4), "float32"), "OutScale": ((1,), "float32"),
+         "StateOut": ((1,), "float32"), "AccumOut": ((1,), "float32")})
+    cur = np.abs(x).max()
+    new_state = 0.9 * 1.0 + 1.0
+    new_accum = 0.9 * 1.0 + cur
+    scale = new_accum / new_state
+    q = 127.0
+    expect = np.round(np.clip(x / scale, -1, 1) * q) * scale / q
+    np.testing.assert_allclose(outs[0], expect, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[1][0], scale, rtol=1e-6)
+
+    # observer: Out is X untouched, stats update identically
+    outs = _run_op(
+        "moving_average_abs_max_scale",
+        {"X": [("mas_x", x)], "InAccum": [("mas_a", accum)],
+         "InState": [("mas_s", state)]},
+        {"moving_rate": 0.9},
+        {"Out": ((4, 4), "float32"), "OutScale": ((1,), "float32"),
+         "StateOut": ((1,), "float32"), "AccumOut": ((1,), "float32")})
+    np.testing.assert_allclose(outs[0], x)
+    np.testing.assert_allclose(outs[1][0], scale, rtol=1e-6)
+
+
+def test_attention_lstm():
+    """Numpy oracle ported from attention_lstm_op.cc (gate order
+    [forget, input, output, candidate], per-step masked softmax
+    attention over the sequence)."""
+    B, T, M, D = 2, 4, 3, 2
+    x = RNG.standard_normal((B, T, M)).astype(np.float32) * 0.5
+    length = np.array([4, 2], np.int64)
+    c0 = RNG.standard_normal((B, D)).astype(np.float32) * 0.3
+    h0 = RNG.standard_normal((B, D)).astype(np.float32) * 0.3
+    aw = RNG.standard_normal((M + D, 1)).astype(np.float32) * 0.5
+    ab = np.array([0.1], np.float32)
+    ascal = np.array([1.3], np.float32)
+    ascal_b = np.array([-0.05], np.float32)
+    lw = RNG.standard_normal((M + D, 4 * D)).astype(np.float32) * 0.5
+    lb = RNG.standard_normal((1, 4 * D)).astype(np.float32) * 0.1
+
+    def np_relu(v):
+        return np.maximum(v, 0.0)
+
+    hidden_ref = np.zeros((B, T, D), np.float32)
+    cell_ref = np.zeros((B, T, D), np.float32)
+    for b in range(B):
+        L = int(length[b])
+        h_prev, c_prev = h0[b].copy(), c0[b].copy()
+        atted = (x[b, :L] @ aw[:M, 0]) + ab[0]            # [L]
+        for t in range(L):
+            fc = np_relu(atted + float(c_prev @ aw[M:, 0]))
+            fc = np_relu(fc * ascal[0] + ascal_b[0])
+            e = np.exp(fc - fc.max())
+            probs = e / e.sum()
+            lstm_x = probs @ x[b, :L]                     # [M]
+            gates = lstm_x @ lw[:M] + h_prev @ lw[M:] + lb[0]
+            f = _sigmoid(gates[:D])
+            i = _sigmoid(gates[D:2 * D])
+            o = _sigmoid(gates[2 * D:3 * D])
+            cand = np.tanh(gates[3 * D:])
+            c_prev = f * c_prev + i * cand
+            h_prev = np.tanh(c_prev) * o
+            hidden_ref[b, t] = h_prev
+            cell_ref[b, t] = c_prev
+
+    outs = _run_op(
+        "attention_lstm",
+        {"X": [("al_x", x)], "Length": [("al_len", length)],
+         "C0": [("al_c0", c0)], "H0": [("al_h0", h0)],
+         "AttentionWeight": [("al_aw", aw)],
+         "AttentionBias": [("al_ab", ab)],
+         "AttentionScalar": [("al_as", ascal)],
+         "AttentionScalarBias": [("al_asb", ascal_b)],
+         "LSTMWeight": [("al_lw", lw)], "LSTMBias": [("al_lb", lb)]},
+        {"gate_activation": "sigmoid", "cell_activation": "tanh",
+         "candidate_activation": "tanh"},
+        {"Hidden": ((B, T, D), "float32"), "Cell": ((B, T, D), "float32")})
+    np.testing.assert_allclose(outs[0], hidden_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs[1], cell_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_attention_lstm_grad_flows():
+    """attention_lstm differentiates through scan (grad wrt LSTMWeight)."""
+    B, T, M, D = 2, 3, 3, 2
+    x = RNG.standard_normal((B, T, M)).astype(np.float32) * 0.5
+    c0 = np.zeros((B, D), np.float32)
+    aw = RNG.standard_normal((M + D, 1)).astype(np.float32) * 0.5
+    lw = RNG.standard_normal((M + D, 4 * D)).astype(np.float32) * 0.5
+    lb = np.zeros((1, 4 * D), np.float32)
+    t = _t("attention_lstm",
+           {"X": ("alg_x", x), "C0": ("alg_c0", c0),
+            "AttentionWeight": ("alg_aw", aw),
+            "LSTMWeight": ("alg_lw", lw), "LSTMBias": ("alg_lb", lb)},
+           {"gate_activation": "sigmoid", "cell_activation": "tanh",
+            "candidate_activation": "tanh"},
+           {"Hidden": np.zeros((B, T, D), np.float32),
+            "Cell": np.zeros((B, T, D), np.float32)})
+    t.check_grad(["X", "LSTMWeight"], "Hidden",
+                 max_relative_error=0.01)

@@ -58,3 +58,59 @@ def test_seq2seq_copy_task_and_beam_decode():
     expected = src1[:, 0].tolist()
     # the copy task is learned: the best beam reproduces the source
     assert decoded == expected, (decoded, expected)
+
+
+def test_while_decoder_trains_without_max_trip_count():
+    """A teacher-forced decoder written as a layers.While loop (the
+    reference DynamicRNN/while_op idiom) TRAINS — backward through the
+    loop with no TPU-only max_trip_count kwarg, thanks to the
+    auto-derived trip bound (while_op.cc's grad needs no bound)."""
+    from paddle_tpu import layers
+    T, Bd, Hd = 5, 8, 32
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        xs = layers.data("xs", [T, Bd, Hd], dtype="float32")   # inputs
+        ys = layers.data("ys", [T, Bd, 1], dtype="float32")    # targets
+        h = layers.fill_constant([Bd, Hd], "float32", 0.0)
+        h.stop_gradient = False
+        loss_acc = layers.fill_constant([1], "float32", 0.0)
+        loss_acc.stop_gradient = False
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", T)
+        cond_v = layers.less_than(i, n)
+        w = layers.While(cond_v)                # <- no max_trip_count
+        with w.block():
+            x_t = layers.squeeze(layers.gather(xs, i), [0])
+            y_t = layers.squeeze(layers.gather(ys, i), [0])
+            h_new = layers.fc(
+                layers.concat([x_t, h], axis=1), Hd, act="tanh",
+                param_attr=fluid.ParamAttr(name="dec.w"),
+                bias_attr=fluid.ParamAttr(name="dec.b"))
+            pred = layers.fc(h_new, 1,
+                             param_attr=fluid.ParamAttr(name="out.w"),
+                             bias_attr=False)
+            step_loss = layers.reduce_mean(
+                layers.square(layers.elementwise_sub(pred, y_t)))
+            layers.assign(h_new, h)
+            layers.assign(layers.elementwise_add(
+                loss_acc, layers.reshape(step_loss, [1])), loss_acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond_v)
+        loss = layers.reduce_sum(loss_acc)
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    w_op = next(op for op in main.global_block().ops
+                if op.type == "while")
+    assert w_op.attrs.get("max_trip_count") == T, w_op.attrs
+
+    rng = np.random.default_rng(5)
+    xv = rng.standard_normal((T, Bd, Hd)).astype(np.float32)
+    yv = np.tanh(xv.sum(axis=2, keepdims=True) * 0.1).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"xs": xv, "ys": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(60)]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
