@@ -231,7 +231,11 @@ long long PD_GetOutputFloat(PD_Predictor* p, int i, float* buf,
     return -1;
   }
   long long ncopy = numel < buf_len ? numel : buf_len;
-  std::memcpy(buf, view.buf, ncopy * sizeof(float));
+  if (ncopy > 0 && buf != nullptr) {
+    // size-only probes pass buf=NULL/buf_len=0 (the Go client sizes
+    // the slice first) — memcpy with a null dest is UB even at n=0
+    std::memcpy(buf, view.buf, ncopy * sizeof(float));
+  }
   PyBuffer_Release(&view);
   Py_DECREF(f32);
   return numel;

@@ -1,0 +1,3 @@
+module github.com/paddle-tpu/go
+
+go 1.19

@@ -1,0 +1,131 @@
+// Go inference client for paddle_tpu over the C API
+// (capability parity with the reference Go predictor,
+// /root/reference/go/paddle/predictor.go, which fronts the C++
+// AnalysisPredictor; this one fronts the XLA-compiled predictor via
+// capi/libpaddle_tpu_capi.so).
+//
+// Build: with the shared library built (capi/build.sh),
+//
+//	CGO_CFLAGS="-I${REPO}/capi" \
+//	CGO_LDFLAGS="-L${REPO}/capi -lpaddle_tpu_capi" \
+//	go build ./...
+package paddle
+
+// #cgo CFLAGS: -I${SRCDIR}/../../capi
+// #cgo LDFLAGS: -L${SRCDIR}/../../capi -lpaddle_tpu_capi
+// #include <stdlib.h>
+// #include "paddle_c_api.h"
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Predictor wraps a PD_Predictor handle. Create with NewPredictor; the
+// finalizer releases the handle, or call Delete explicitly.
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor loads a save_inference_model directory.
+func NewPredictor(modelDir string) (*Predictor, error) {
+	if rc := C.PD_Init(); rc != 0 {
+		return nil, lastError("PD_Init")
+	}
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	h := C.PD_NewPredictor(cdir)
+	if h == nil {
+		return nil, lastError("PD_NewPredictor")
+	}
+	p := &Predictor{c: h}
+	runtime.SetFinalizer(p, (*Predictor).Delete)
+	return p, nil
+}
+
+// Delete releases the native handle (idempotent).
+func (p *Predictor) Delete() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+	runtime.SetFinalizer(p, nil)
+}
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
+}
+
+// SetInputFloat stages input i from a dense float32 buffer.
+func (p *Predictor) SetInputFloat(i int, data []float32, shape []int32) error {
+	if len(data) == 0 {
+		return errors.New("paddle: empty input buffer")
+	}
+	rc := C.PD_SetInputFloat(p.c, C.int(i),
+		(*C.float)(unsafe.Pointer(&data[0])),
+		(*C.int)(unsafe.Pointer(&shape[0])), C.int(len(shape)))
+	if rc != 0 {
+		return lastError("PD_SetInputFloat")
+	}
+	return nil
+}
+
+// SetInputInt64 stages input i from a dense int64 buffer (ids/labels).
+func (p *Predictor) SetInputInt64(i int, data []int64, shape []int32) error {
+	if len(data) == 0 {
+		return errors.New("paddle: empty input buffer")
+	}
+	rc := C.PD_SetInputInt64(p.c, C.int(i),
+		(*C.longlong)(unsafe.Pointer(&data[0])),
+		(*C.int)(unsafe.Pointer(&shape[0])), C.int(len(shape)))
+	if rc != 0 {
+		return lastError("PD_SetInputInt64")
+	}
+	return nil
+}
+
+// Run executes the compiled model over the staged inputs.
+func (p *Predictor) Run() error {
+	if rc := C.PD_PredictorRun(p.c); rc != 0 {
+		return lastError("PD_PredictorRun")
+	}
+	return nil
+}
+
+// GetOutputFloat reads back output i as float32 with its shape.
+func (p *Predictor) GetOutputFloat(i int) ([]float32, []int32, error) {
+	var shape [8]C.int
+	var ndim C.int
+	// first call sizes the result (zero-length buffer)
+	n := C.PD_GetOutputFloat(p.c, C.int(i), nil, 0, &shape[0], &ndim)
+	if n < 0 {
+		return nil, nil, lastError("PD_GetOutputFloat")
+	}
+	buf := make([]float32, int(n))
+	if n > 0 {
+		n = C.PD_GetOutputFloat(p.c, C.int(i),
+			(*C.float)(unsafe.Pointer(&buf[0])), C.longlong(len(buf)),
+			&shape[0], &ndim)
+		if n < 0 {
+			return nil, nil, lastError("PD_GetOutputFloat")
+		}
+	}
+	dims := make([]int32, int(ndim))
+	for d := 0; d < int(ndim); d++ {
+		dims[d] = int32(shape[d])
+	}
+	return buf, dims, nil
+}
+
+func lastError(op string) error {
+	return errors.New("paddle: " + op + ": " + C.GoString(C.PD_GetLastError()))
+}

@@ -1,0 +1,48 @@
+// Smoke test for the Go predictor (reference pattern:
+// /root/reference/go/paddle/*_test shape). Needs a model directory:
+//
+//	python -c "import tests.make_capi_model as m; m.main('/tmp/capi_model')"
+//	PADDLE_TPU_TEST_MODEL=/tmp/capi_model go test ./...
+//
+// Skips when the env var is unset so `go test` works standalone.
+package paddle
+
+import (
+	"os"
+	"testing"
+)
+
+func TestPredictorSmoke(t *testing.T) {
+	dir := os.Getenv("PADDLE_TPU_TEST_MODEL")
+	if dir == "" {
+		t.Skip("PADDLE_TPU_TEST_MODEL not set")
+	}
+	p, err := NewPredictor(dir)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer p.Delete()
+	if p.GetInputNum() < 1 || p.GetOutputNum() < 1 {
+		t.Fatalf("bad io counts: %d in, %d out",
+			p.GetInputNum(), p.GetOutputNum())
+	}
+	shape := []int32{4, 16}
+	data := make([]float32, 64)
+	for i := range data {
+		data[i] = 1.0
+	}
+	if err := p.SetInputFloat(0, data, shape); err != nil {
+		t.Fatal(err)
+	}
+	if err := p.Run(); err != nil {
+		t.Fatal(err)
+	}
+	out, dims, err := p.GetOutputFloat(0)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(out) == 0 || len(dims) == 0 {
+		t.Fatalf("empty output: %v %v", out, dims)
+	}
+	t.Logf("output %v values %v...", dims, out[0])
+}

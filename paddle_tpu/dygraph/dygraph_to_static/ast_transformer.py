@@ -340,11 +340,20 @@ class DygraphToStaticAst(ast.NodeTransformer):
             hi = rargs[1] if len(rargs) >= 2 else rargs[0]
             step = rargs[2] if len(rargs) == 3 else ast.Constant(value=1)
             ivar = f"{p}_i"
-            init = ast.Assign(targets=[_store(ivar)], value=lo)
-            test = _jst_call("convert_lt", [_load(ivar), hi])
+            hivar, stepvar = f"{p}_hi", f"{p}_step"
+            # snapshot bounds ONCE (python range() fixes the trip count
+            # at entry; re-evaluating the bound expression per iteration
+            # would diverge for growing containers / side effects)
+            init = [ast.Assign(targets=[_store(ivar)], value=lo),
+                    ast.Assign(targets=[_store(hivar)], value=hi),
+                    ast.Assign(targets=[_store(stepvar)], value=step)]
+            # sign-aware test: range(5, 0, -1) iterates while i > hi
+            test = _jst_call("convert_range_cmp",
+                             [_load(ivar), _load(hivar), _load(stepvar)])
             bump = ast.Assign(
                 targets=[_store(ivar)],
-                value=_jst_call("convert_add", [_load(ivar), step]))
+                value=_jst_call("convert_add",
+                                [_load(ivar), _load(stepvar)]))
             bind = ast.Assign(targets=[_store(node.target.id)],
                               value=_load(ivar))
             # bump BEFORE the body: a `continue` must not skip the
@@ -357,7 +366,7 @@ class DygraphToStaticAst(ast.NodeTransformer):
             # every iteration) and static conversion needs it defined
             bind0 = ast.Assign(targets=[_store(node.target.id)],
                                value=_load(ivar))
-            out = [init, bind0] + self.visit_While(loop)
+            out = init + [bind0] + self.visit_While(loop)
             return out
         self.generic_visit(node)
         p = self._fresh()

@@ -245,6 +245,35 @@ def convert_lt(a, b):
     return a < b
 
 
+def convert_range_cmp(i, hi, step):
+    """Loop test for the synthesized for->while rewrite: `i < hi` for
+    positive steps, `i > hi` for negative (python range semantics)."""
+    from ... import layers
+    if not (_static_var(step) or _eager_var(step)):
+        step_pos = step > 0
+    elif _eager_var(step):
+        import numpy as np
+        step_pos = int(np.asarray(step.value).reshape(-1)[0]) > 0
+    else:
+        # static Variable step of unknown sign: build both arms
+        iv, hv = _to_int_var(i, layers), _to_int_var(hi, layers)
+        sv = _to_int_var(step, layers)
+        zero = layers.fill_constant([1], "int64", 0)
+        return layers.logical_or(
+            layers.logical_and(layers.greater_than(sv, zero),
+                               layers.less_than(iv, hv)),
+            layers.logical_and(layers.less_than(sv, zero),
+                               layers.greater_than(iv, hv)))
+    if _static_var(i) or _static_var(hi):
+        iv, hv = _to_int_var(i, layers), _to_int_var(hi, layers)
+        return layers.less_than(iv, hv) if step_pos \
+            else layers.greater_than(iv, hv)
+    import numpy as np
+    iv = int(np.asarray(i.value).reshape(-1)[0]) if _eager_var(i) else i
+    hv = int(np.asarray(hi.value).reshape(-1)[0]) if _eager_var(hi) else hi
+    return iv < hv if step_pos else iv > hv
+
+
 def convert_add(a, b):
     if _static_var(a) or _static_var(b):
         from ... import layers

@@ -110,12 +110,23 @@ def while_op(ctx, ins, attrs):
         now = _infer_max_trip(ctx.program, parent_blk, sub_blk,
                               cond_name, stop_op=this_op)
         if now != int(max_trip):
-            raise ValueError(
-                f"While: the auto-derived max_trip_count "
-                f"({max_trip}) is no longer valid in the final program "
-                f"(re-derivation gives {now}); the loop bound is "
-                f"mutated after the loop was built — pass "
-                f"max_trip_count explicitly")
+            # the bound is consumed only by the differentiable (scan)
+            # lowering: in a program with a backward pass an invalid
+            # bound must be an ERROR (silent truncation corrupts
+            # training, and a nested loop may be differentiated
+            # implicitly through an enclosing while_grad); forward-only
+            # programs just fall back to the unbounded while_loop
+            has_grad = any(
+                op.type.endswith("_grad")
+                for blk in ctx.program.blocks for op in blk.ops)
+            if has_grad:
+                raise ValueError(
+                    f"While: the auto-derived max_trip_count "
+                    f"({max_trip}) is no longer valid in the final "
+                    f"program (re-derivation gives {now}); the loop "
+                    f"bound is mutated after the loop was built — pass "
+                    f"max_trip_count explicitly")
+            max_trip = None
     if max_trip is None:
         def cond_fn(carry):
             return _as_pred(carry[cond_name])

@@ -56,7 +56,7 @@ def positive_negative_pair(ctx, ins, attrs):
     different labels are neutral. Pair weight = mean of the two
     instance weights. O(N^2) pair masks replace the host hash-map."""
     score = x_of(ins, "Score")
-    col = int(attrs.get("column", -1))
+    col = int(attrs.get("column", 0))   # reference SetDefault(0)
     s = score[:, col] if score.ndim == 2 else jnp.reshape(score, (-1,))
     label = jnp.reshape(x_of(ins, "Label"), (-1,)).astype(jnp.float32)
     query = jnp.reshape(x_of(ins, "QueryID"), (-1,))

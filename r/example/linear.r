@@ -1,0 +1,36 @@
+#!/usr/bin/env Rscript
+# R inference client for paddle_tpu via reticulate (capability parity
+# with the reference R example, /root/reference/r/example/mobilenet.r,
+# which drives paddle.fluid.core the same way).
+#
+# Usage: Rscript linear.r <model_dir>
+#   model_dir: a fluid.io.save_inference_model output directory.
+
+library(reticulate)
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+args <- commandArgs(trailingOnly = TRUE)
+model_dir <- ifelse(length(args) >= 1, args[1], "data/model")
+
+config <- inference$AnalysisConfig(model_dir)
+config$switch_use_feed_fetch_ops(FALSE)
+config$switch_specify_input_names(TRUE)
+
+predictor <- inference$create_paddle_predictor(config)
+
+input_names <- predictor$get_input_names()
+input_tensor <- predictor$get_input_handle(input_names[[1]])
+
+x <- np$ones(c(4L, 16L), dtype = "float32")
+input_tensor$copy_from_cpu(x)
+
+predictor$run()
+
+output_names <- predictor$get_output_names()
+output_tensor <- predictor$get_output_handle(output_names[[1]])
+result <- output_tensor$copy_to_cpu()
+
+cat("output shape:", paste(dim(result), collapse = "x"), "\n")
+cat("output[1,1]:", result[1, 1], "\n")

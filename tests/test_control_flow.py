@@ -351,14 +351,11 @@ def test_gradients_of_intermediate_var_with_nondiff_producer():
     np.testing.assert_allclose(g, 2 * (2 * xv), rtol=1e-6)
 
 
-def test_while_auto_bound_rejects_mutated_bound():
-    """An outer loop mutating the inner loop's bound AFTER the inner
-    While was built invalidates the auto-derived trip count: lowering
-    re-validates against the final program and raises instead of
-    silently truncating iterations."""
+def _nested_mutated_bound_program(with_grad):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
         oi = layers.fill_constant([1], "int64", 0)
         on = layers.fill_constant([1], "int64", 3)
         n = layers.fill_constant([1], "int64", 2)   # inner bound (mutated!)
@@ -376,13 +373,37 @@ def test_while_auto_bound_rejects_mutated_bound():
             layers.increment(n, value=1)            # bound grows each pass
             layers.increment(oi, value=1)
             layers.less_than(oi, on, cond=ocond)
+        g = None
+        if with_grad:
+            g, = fluid.gradients(layers.reduce_sum(acc), [x])
+    return main, startup, acc, g
+
+
+def test_while_auto_bound_mutated_forward_falls_back():
+    """An outer loop mutating the inner loop's bound AFTER the inner
+    While was built invalidates the auto-derived trip count. Forward-
+    only programs downgrade to the unbounded lax.while_loop lowering
+    and still compute the right answer."""
+    main, startup, acc, _ = _nested_mutated_bound_program(False)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                       fetch_list=[acc])
+    # inner trips per outer pass: 2, 3, 4 doublings -> x * 2^9
+    np.testing.assert_allclose(np.asarray(out), np.full(3, 512.0))
+
+
+def test_while_auto_bound_mutated_grad_raises():
+    """...but with a grad attached, silent truncation would corrupt
+    training — lowering re-validates and raises."""
+    main, startup, acc, g = _nested_mutated_bound_program(True)
     exe = fluid.Executor()
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
         try:
             exe.run(main, feed={"x": np.ones(3, np.float32)},
-                    fetch_list=[acc])
+                    fetch_list=[g])
             raise AssertionError("expected ValueError")
         except ValueError as e:
-            assert "no longer valid" in str(e) or \
-                "max_trip_count" in str(e), e
+            assert "no longer valid" in str(e), e

@@ -286,3 +286,23 @@ def test_list_append_in_converted_code():
         exe.run(startup)
         out, = exe.run(main, feed={feeds[0]: x}, fetch_list=fetches)
     np.testing.assert_allclose(np.asarray(out), [14.0, 14.0])
+
+
+def test_break_continue_negative_step_range():
+    """range() with a negative step + break/continue: the for->while
+    rewrite must use a sign-aware test and snapshot the bounds once."""
+    def fn(x, lst):
+        acc = 0.0
+        for i in range(5, 0, -1):
+            if i == 3:
+                continue
+            acc = acc + x
+        # bound snapshotted at entry: appends inside must not extend it
+        for j in range(len(lst)):
+            lst.append(j)
+            if j > 10:
+                break
+        return acc + len(lst)
+
+    conv = convert_to_static(fn)
+    assert conv(1.0, [0, 0]) == fn(1.0, [0, 0])
