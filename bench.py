@@ -319,7 +319,13 @@ def bench_resnet50():
     with fluid.program_guard(main_prog, startup):
         out = resnet_train_program(depth=50, batch_size=batch)
         opt = fluid.optimizer.Momentum(0.1, 0.9)
-        opt = mp.decorate(opt, init_loss_scaling=1.0,
+        # batch_norm whitelisted: the op accumulates statistics in fp32
+        # internally (ops/nn_ops.py), so bf16 activations through BN are
+        # numerically safe — and the fp32 cast round-trip between convs
+        # was the dominant HBM cost (bandwidth-bound at 96% util, r4)
+        amp_lists = mp.AutoMixedPrecisionLists(
+            custom_white_list={"batch_norm"})
+        opt = mp.decorate(opt, amp_lists=amp_lists, init_loss_scaling=1.0,
                           use_dynamic_loss_scaling=False)
         opt.minimize(out["loss"])
     rng = np.random.default_rng(0)
@@ -524,12 +530,21 @@ def run_all():
     import traceback
     results = {}
     for name, (fn, metric) in _CONFIGS.items():
-        try:
-            results[name] = fn()
-        except Exception:  # noqa: BLE001 — keep the matrix going
-            traceback.print_exc(file=sys.stderr)
-            results[name] = {"metric": metric, "value": None,
-                             "unit": "error", "vs_baseline": None}
+        for attempt in (0, 1):
+            try:
+                results[name] = fn()
+                break
+            except Exception:  # noqa: BLE001 — keep the matrix going
+                traceback.print_exc(file=sys.stderr)
+                results[name] = {"metric": metric, "value": None,
+                                 "unit": "error", "vs_baseline": None}
+                gc.collect()
+                if attempt == 0:
+                    # the remote-compile tunnel throws transient HTTP
+                    # errors under load — one retry rescues the config
+                    print(f"# retrying {name} after error",
+                          file=sys.stderr, flush=True)
+                    time.sleep(5)
         print(json.dumps(dict(results[name], config=name)), flush=True)
         gc.collect()  # drop the previous config's device buffers
     flagship = results.get("bert") or {

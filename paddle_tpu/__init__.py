@@ -19,6 +19,7 @@ from .framework.executor import (  # noqa: F401
 from .framework.backward import append_backward, gradients  # noqa: F401
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
+from .framework import passes  # noqa: F401  (Pass/register_pass/apply_passes)
 from .framework.dtype import convert_dtype  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from . import layers  # noqa: F401

@@ -18,6 +18,21 @@ def _is_float(dtype):
                                     "float64")
 
 
+# Per-op-type slots holding persistent STATE (running statistics, affine
+# params) that must stay fp32 even when the op itself computes in the
+# low-precision dtype: the BN running-mean EMA accumulated in bf16 drifts
+# (8-bit mantissa) and the checkpointed stats degrade eval-mode
+# normalization. The op lowerings cast these per-use internally.
+_FP32_STATE_SLOTS = {
+    "batch_norm": (
+        {"Scale", "Bias", "Mean", "Variance"},
+        {"MeanOut", "VarianceOut", "SavedMean", "SavedVariance"}),
+    "sync_batch_norm": (
+        {"Scale", "Bias", "Mean", "Variance"},
+        {"MeanOut", "VarianceOut", "SavedMean", "SavedVariance"}),
+}
+
+
 def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
     """Insert casts so white-list ops (and gray ops fed by them) compute in
     `dest_dtype` while black-list ops stay fp32. Mutates main_program."""
@@ -72,9 +87,15 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
         if any(n in amp_lists.black_varnames for n in op.input_arg_names):
             compute = "float32"
 
+        state_in, state_out = _FP32_STATE_SLOTS.get(op.type,
+                                                    (frozenset(),
+                                                     frozenset()))
         changed = False
         new_inputs = {}
         for slot, names in op.inputs.items():
+            if slot in state_in:
+                new_inputs[slot] = list(names)   # fp32 state: never cast
+                continue
             renamed = []
             for n in names:
                 d = dtype_of(n)
@@ -89,14 +110,17 @@ def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
 
         new_ops.append(op)
         if compute == dest_dtype:
-            for n in op.output_arg_names:
-                try:
-                    var = block.var(n)
-                except ValueError:
-                    continue
-                if _is_float(var.dtype):
-                    var.dtype = dest_dtype
-                    cur_dtype[n] = dest_dtype
+            for slot, names in op.outputs.items():
+                if slot in state_out:
+                    continue                     # fp32 state: keep dtype
+                for n in names:
+                    try:
+                        var = block.var(n)
+                    except ValueError:
+                        continue
+                    if _is_float(var.dtype):
+                        var.dtype = dest_dtype
+                        cur_dtype[n] = dest_dtype
         else:
             for n in op.output_arg_names:
                 cur_dtype.pop(n, None)

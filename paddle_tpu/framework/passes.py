@@ -1,0 +1,136 @@
+"""Program-pass framework: registry + ordered application.
+
+Capability parity with the reference's IR pass infrastructure
+(/root/reference/paddle/fluid/framework/ir/pass.h — Pass::Apply over a
+Graph, REGISTER_PASS, and PassBuilder ordering in
+paddle/fluid/framework/details/build_strategy.cc). The reference's passes
+mutate a C++ graph; here a pass is a callable over the Program IR
+(framework/core.py), the same structure every existing rewrite (AMP cast
+insertion, QAT instrumentation, sync-BN substitution) already walks by
+hand. Registering them gives users the reference's extension point: write
+a Pass subclass, `register_pass` it, and `apply_passes(program, [...])`
+runs an ordered pipeline.
+"""
+
+
+class Pass:
+    """Base pass: override apply(program) and mutate in place (return
+    the program for chaining). `name` defaults to the class name
+    de-camelized; attrs passed at construction are available on self."""
+
+    name = None
+
+    def __init__(self, **attrs):
+        for k, v in attrs.items():
+            setattr(self, k, v)
+
+    def apply(self, program):
+        raise NotImplementedError
+
+    def __call__(self, program):
+        out = self.apply(program)
+        out = program if out is None else out
+        # the executor caches compiled programs on (uid, version): a
+        # mutation-only pass must invalidate that cache or it silently
+        # no-ops on an already-executed program
+        bump = getattr(out, "_bump_version", None)
+        if bump is not None:
+            bump()
+        return out
+
+
+_PASSES = {}
+
+
+def register_pass(name):
+    """Decorator: register a Pass subclass (or factory) under `name`
+    (reference REGISTER_PASS(name, class))."""
+    def deco(cls):
+        _PASSES[name] = cls
+        if getattr(cls, "name", None) is None:
+            try:
+                cls.name = name
+            except (AttributeError, TypeError):
+                pass
+        return cls
+    return deco
+
+
+def get_pass(name, **attrs):
+    cls = _PASSES.get(name)
+    if cls is None:
+        raise KeyError(
+            f"pass {name!r} is not registered; known: {sorted(_PASSES)}")
+    return cls(**attrs)
+
+
+def has_pass(name):
+    return name in _PASSES
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_passes(program, names, **common_attrs):
+    """Run passes in the given order (reference PassBuilder::Build).
+    `names` entries are either a registered name or an instantiated
+    Pass/callable."""
+    for n in names:
+        p = get_pass(n, **common_attrs) if isinstance(n, str) else n
+        program = p(program) or program
+    return program
+
+
+# ---------------------------------------------------------------------------
+# Built-in passes wrapping the existing hand-rolled program rewrites, so
+# the standard transforms are discoverable/orderable through the registry
+# like the reference's default pass pipeline (build_strategy.cc).
+# ---------------------------------------------------------------------------
+
+@register_pass("amp_bf16")
+class AmpBf16Pass(Pass):
+    """bf16 mixed-precision cast insertion (contrib.mixed_precision.
+    fp16_utils.rewrite_program; reference ir/fp16 pass family). attrs:
+    amp_lists (AutoMixedPrecisionLists), dest_dtype."""
+
+    amp_lists = None
+    dest_dtype = "bfloat16"
+
+    def apply(self, program):
+        from ..contrib.mixed_precision.fp16_lists import (
+            AutoMixedPrecisionLists)
+        from ..contrib.mixed_precision.fp16_utils import rewrite_program
+        rewrite_program(program,
+                        self.amp_lists or AutoMixedPrecisionLists(),
+                        dest_dtype=self.dest_dtype)
+
+
+@register_pass("sync_batch_norm")
+class SyncBatchNormPass(Pass):
+    """batch_norm -> sync_batch_norm substitution (reference
+    framework/ir/sync_batch_norm_pass.cc; the CompiledProgram build
+    strategy applies it via this registry)."""
+
+    def apply(self, program):
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type == "batch_norm":
+                    op.type = "sync_batch_norm"
+
+
+@register_pass("quant_aware")
+class QuantAwarePass(Pass):
+    """QAT fake-quant instrumentation (reference contrib/slim
+    QuantizationTransformPass, exposed here as a registered program
+    pass). attrs forwarded to the slim implementation."""
+
+    weight_bits = 8
+    activation_bits = 8
+
+    def apply(self, program):
+        from ..contrib.slim.quantization.quantization_pass import (
+            QuantizationTransformPass)
+        QuantizationTransformPass(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits).apply(program)

@@ -717,7 +717,7 @@ def ring_attention(q, k, v, attn_bias=None, scale=0.0, mechanism="ring",
 
 
 def flash_attention(q, k, v, attn_bias=None, scale=0.0, causal=False,
-                    impl=None, name=None):
+                    impl=None, block_q=None, block_k=None, name=None):
     """Fused blockwise attention (Pallas kernel on TPU; exact XLA composite
     elsewhere). q/k/v: [B, n_head, S, d_head]; attn_bias: optional additive
     key mask [B, 1, 1, S] (constant — no gradient flows to it). Never
@@ -731,7 +731,8 @@ def flash_attention(q, k, v, attn_bias=None, scale=0.0, causal=False,
         type="flash_attention", inputs=ins,
         outputs={"Out": [out]},
         attrs={"scale": float(scale), "causal": bool(causal),
-               "impl": impl or ""},
+               "impl": impl or "",
+               "block_q": int(block_q or 0), "block_k": int(block_k or 0)},
         infer_shape=False)
     out.shape = tuple(q.shape or ())
     out.dtype = q.dtype

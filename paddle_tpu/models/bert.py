@@ -41,6 +41,10 @@ class BertConfig:
     # attention over the sp mesh axis (ops/ring_attention_ops.py). All
     # three skip attention dropout (flash-style fused softmax path).
     attn_mechanism: str = None
+    # flash kernel tile overrides (None = kernel auto; big q tiles win
+    # at long seq — see kernels/flash_attention.py _block_sizes)
+    flash_block_q: int = None
+    flash_block_k: int = None
 
     @staticmethod
     def base():
@@ -101,7 +105,9 @@ def encoder_layer(cfg, x, attn_bias, idx, is_test):
         v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]),
                         [0, 2, 1, 3])
         if cfg.attn_mechanism == "flash":
-            ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias)
+            ctx = layers.nn.flash_attention(q, k, v, attn_bias=attn_bias,
+                                            block_q=cfg.flash_block_q,
+                                            block_k=cfg.flash_block_k)
         else:
             # K/V ring rotation or Ulysses all-to-all over "sp"; exact
             # flash-style softmax, no attn dropout

@@ -373,13 +373,21 @@ def batch_norm(ctx, ins, attrs):
         mean_out, var_out = mean, var
         saved_m, saved_v = mean, jax.lax.rsqrt(var + eps)
     else:
-        m = jnp.mean(x, axis=axes)
-        v = jnp.var(x, axis=axes)
+        # statistics ALWAYS accumulate in fp32 (a bf16 mean over a
+        # 224x224 batch loses whole digits) — the normalize stays in
+        # x.dtype, so bf16 AMP can whitelist batch_norm and keep
+        # activation traffic half-width (the BN-between-convs cast
+        # round-trip is the dominant HBM cost of AMP resnet otherwise)
+        xs = x.astype(jnp.float32)
+        m = jnp.mean(xs, axis=axes)
+        v = jnp.var(xs, axis=axes)
         mean_out = mean * momentum + m.astype(mean.dtype) * (1 - momentum)
         var_out = var * momentum + v.astype(var.dtype) * (1 - momentum)
         saved_m, saved_v = m, jax.lax.rsqrt(v + eps)
+    # normalize with the fp32 rsqrt already in saved_v (downcasting v to
+    # bf16 before rsqrt would throw away the fp32-stats precision)
     xm = (x - m.reshape(bshape).astype(x.dtype)) * \
-        jax.lax.rsqrt(v.reshape(bshape).astype(x.dtype) + eps)
+        saved_v.reshape(bshape).astype(x.dtype)
     y = xm * scale.reshape(bshape).astype(x.dtype) + \
         bias.reshape(bshape).astype(x.dtype)
     return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,

@@ -146,7 +146,9 @@ def flash_attention_op(ctx, ins, attrs):
     scale = float(attrs.get("scale", 0.0)) or None
     out = _fa(q, k, v, bias, scale=scale,
               causal=bool(attrs.get("causal", False)),
-              impl=attrs.get("impl") or None)
+              impl=attrs.get("impl") or None,
+              block_q=int(attrs.get("block_q", 0)) or None,
+              block_k=int(attrs.get("block_k", 0)) or None)
     return {"Out": out}
 
 

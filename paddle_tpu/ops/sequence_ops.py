@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import as_dtype, x_of
+from .common import as_dtype, int64_t, x_of
 
 
 def _len_of(ins):
@@ -397,3 +397,50 @@ def sequence_topk_avg_pooling(ctx, ins, attrs):
 
     out, pos = jax.vmap(one)(x, rows, cols)
     return {"Out": out, "pos": pos.astype(jnp.int32)}
+
+
+# ------------------------------------------------------- DynamicRNN support
+# (reference lod_rank_table_op.cc / max_sequence_len_op.cc /
+# reorder_lod_tensor_by_rank_op.cc / rnn_memory_helper_op.cc — the LoD
+# machinery behind DynamicRNN decoders. Masked-dense form: the rank table
+# is a descending-stable argsort of the Length vector; "reorder by rank"
+# is a row gather; memory helper is the identity whose grad zero-fills.)
+
+@register_op("lod_rank_table", grad=False, infer_shape=False)
+def lod_rank_table(ctx, ins, attrs):
+    """Index + length of each sequence, sorted by length DESCENDING with
+    original order preserved among equals (reference
+    framework/lod_rank_table.cc Reset — std::stable_sort). Out: Index
+    [B] int64 (original row of rank r), Length [B] int64 (sorted)."""
+    lengths = _len_of(ins)
+    # jnp.argsort is STABLE (lowers to sort with is_stable=True), so
+    # sorting on -length alone preserves original order among equals
+    order = jnp.argsort(-lengths)
+    out_idx = order.astype(int64_t())
+    return {"Index": out_idx,
+            "Length": lengths[order].astype(int64_t())}
+
+
+@register_op("max_sequence_len", grad=False, infer_shape=False)
+def max_sequence_len(ctx, ins, attrs):
+    """reference max_sequence_len_op.cc: longest sequence in the batch
+    (reads the rank table's first entry; here max of Length)."""
+    lengths = _len_of(ins)
+    return {"Out": jnp.max(lengths).astype(int64_t()).reshape(1)}
+
+
+@register_op("reorder_lod_tensor_by_rank", infer_shape=False)
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """reference reorder_lod_tensor_by_rank_op.cc: permute batch rows by
+    the rank table (X [B, ...], RankTable Index [B])."""
+    x = x_of(ins)
+    idx = jnp.reshape(x_of(ins, "RankTable"), (-1,)).astype(jnp.int32)
+    return {"Out": x[idx]}
+
+
+@register_op("rnn_memory_helper", infer_shape=False)
+def rnn_memory_helper(ctx, ins, attrs):
+    """reference rnn_memory_helper_op.cc: identity used to thread RNN
+    memory through blocks; its grad zero-fills where upstream is
+    absent (the generic vjp provides exactly that)."""
+    return {"Out": x_of(ins)}

@@ -83,15 +83,13 @@ class CompiledProgram:
             # the reference's sync_batch_norm_pass
             # (framework/ir/sync_batch_norm_pass.cc) rewrites batch_norm ->
             # sync_batch_norm on a graph copy owned by the executor; same
-            # here — rewrite a clone, never the user's Program
+            # here — apply the registered pass to a clone, never the
+            # user's Program (framework/passes.py registry)
             if any(op.type == "batch_norm"
                    for blk in self.program.blocks for op in blk.ops):
+                from ..framework.passes import apply_passes
                 self.program = self.program.clone()
-                for blk in self.program.blocks:
-                    for op in blk.ops:
-                        if op.type == "batch_norm":
-                            op.type = "sync_batch_norm"
-                self.program._bump_version()
+                apply_passes(self.program, ["sync_batch_norm"])
         return self
 
     def with_inference_optimize(self, config=None):

@@ -251,3 +251,45 @@ def test_dygraph_piecewise_in_optimizer():
         loss.backward()
         opt.minimize(loss)      # lr=0 -> frozen
         np.testing.assert_array_equal(lin.weight.numpy(), w1)
+
+
+def test_amp_whitelisted_batch_norm_keeps_fp32_state():
+    """Whitelisting batch_norm computes activations in bf16 but the
+    running Mean/Variance (and Scale/Bias) must STAY fp32 — a bf16 EMA
+    drifts and degrades eval-mode normalization
+    (_FP32_STATE_SLOTS in fp16_utils; BN stats accumulate fp32 in-op)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8, 4, 6, 6], dtype="float32")
+        h = layers.conv2d(x, 4, 3, padding=1)
+        h = layers.batch_norm(h)
+        loss = layers.mean(h)
+        amp_lists = mp.AutoMixedPrecisionLists(
+            custom_white_list={"batch_norm"})
+        opt = mp.decorate(fluid.optimizer.SGD(0.1), amp_lists=amp_lists,
+                          init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)
+        opt.minimize(loss)
+    gb = main.global_block()
+    bn = next(op for op in gb.ops if op.type == "batch_norm")
+    # activations bf16, state fp32
+    for slot in ("Mean", "Variance", "Scale", "Bias"):
+        for n in bn.inputs.get(slot, []):
+            assert str(gb.var(n).dtype) == "float32", (slot, n)
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        for n in bn.outputs.get(slot, []):
+            assert str(gb.var(n).dtype) == "float32", (slot, n)
+    y_name = bn.outputs["Y"][0]
+    assert str(gb.var(y_name).dtype) == "bfloat16", gb.var(y_name).dtype
+    # and the program trains with finite running stats
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.default_rng(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            xv = rng.standard_normal((8, 4, 6, 6)).astype(np.float32)
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        mean_name = bn.inputs["Mean"][0]
+        mval = np.asarray(scope.find_var(mean_name))
+        assert mval.dtype == np.float32 and np.isfinite(mval).all()

@@ -1,0 +1,78 @@
+"""Program-pass registry (reference framework/ir/pass.h REGISTER_PASS +
+PassBuilder) and the DynamicRNN LoD machinery in masked-dense form
+(reference lod_rank_table_op.cc, max_sequence_len_op.cc,
+reorder_lod_tensor_by_rank_op.cc, rnn_memory_helper_op.cc)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework.passes import (Pass, apply_passes, get_pass,
+                                         has_pass, list_passes,
+                                         register_pass)
+
+from test_ops_detection2 import _run_op
+
+
+def test_pass_registry_and_custom_pass():
+    assert has_pass("sync_batch_norm") and has_pass("amp_bf16") \
+        and has_pass("quant_aware"), list_passes()
+
+    @register_pass("test_scale_doubler")
+    class ScaleDoubler(Pass):
+        def apply(self, program):
+            for blk in program.blocks:
+                for op in blk.ops:
+                    if op.type == "scale":
+                        op.attrs["scale"] = float(
+                            op.attrs.get("scale", 1.0)) * 2.0
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        y = layers.scale(x, scale=3.0)
+    apply_passes(main, ["test_scale_doubler"])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                       fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(out), np.full(3, 6.0))
+
+
+def test_sync_bn_pass_via_registry():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 3, 8, 8], dtype="float32")
+        y = layers.batch_norm(x)
+    p = get_pass("sync_batch_norm")
+    p(main)
+    types = [op.type for op in main.global_block().ops]
+    assert "sync_batch_norm" in types and "batch_norm" not in types
+
+
+def test_lod_rank_table_and_friends():
+    lengths = np.array([3, 5, 5, 2], np.int64)
+    outs = _run_op("lod_rank_table",
+                   {"Length": [("lrt_len", lengths)]}, {},
+                   {"Index": ((4,), "int32"), "Length": ((4,), "int32")})
+    idx, slen = outs
+    # descending by length, stable among equals (rows 1,2 tie)
+    np.testing.assert_array_equal(idx, [1, 2, 0, 3])
+    np.testing.assert_array_equal(slen, [5, 5, 3, 2])
+
+    outs = _run_op("max_sequence_len",
+                   {"Length": [("msl_len", lengths)]}, {},
+                   {"Out": ((1,), "int32")})
+    assert outs[0][0] == 5
+
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    outs = _run_op("reorder_lod_tensor_by_rank",
+                   {"X": [("rlt_x", x)],
+                    "RankTable": [("rlt_rt", np.array([1, 2, 0, 3],
+                                                      np.int64))]},
+                   {}, {"Out": ((4, 2), "float32")})
+    np.testing.assert_allclose(outs[0], x[[1, 2, 0, 3]])
+
+    outs = _run_op("rnn_memory_helper", {"X": [("rmh_x", x)]}, {},
+                   {"Out": ((4, 2), "float32")})
+    np.testing.assert_allclose(outs[0], x)
