@@ -382,6 +382,8 @@ def bench_dygraph_transformer():
     import paddle_tpu as fluid
     from paddle_tpu import dygraph
     from paddle_tpu.models import transformer
+    # batch sweep (r4): 256 → 4,753 samples/s (twice), 512 → 4,944/4,497
+    # (run-to-run tunnel variance swamps the difference) — keep 256
     batch, src_len, tgt_len = 256, 32, 32
     vocab = 8000
     rng = np.random.default_rng(0)

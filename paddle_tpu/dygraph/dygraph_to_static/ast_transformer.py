@@ -180,6 +180,155 @@ def _has_break_continue(stmts):
     return found[0]
 
 
+def _has_return(stmts):
+    """Shallow scan for Return bound to THIS function (not nested
+    defs/lambdas)."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Return(self, node):
+            found[0] = True
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+class _ReturnRewriter:
+    """Lower `return` statements into a (flag, value) pair so returns
+    inside converted control flow work (reference
+    dygraph_to_static/return_transformer.py): `return e` becomes
+    `<flag> = True; <val> = e`, every statement after a possible return
+    is guarded by `if not <flag>:`, while-loop tests gain
+    `and not <flag>`, for-loop bodies are wrapped in the same guard
+    (later iterations must not clobber the captured value), and the
+    function ends with `return <val>`. Dispatch is manual in
+    rewrite_block — nested defs/lambdas are left untouched by the
+    passthrough else-branch."""
+
+    FLAG, VAL = "__pt_ret", "__pt_ret_val"
+
+    def _lower_return(self, node):
+        val = node.value if node.value is not None \
+            else ast.Constant(value=None)
+        return [ast.Assign(targets=[_store(self.FLAG)],
+                           value=ast.Constant(value=True)),
+                ast.Assign(targets=[_store(self.VAL)], value=val)]
+
+    @staticmethod
+    def _always_returns(stmts):
+        """Every path through stmts ends in a Return (structural)."""
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, ast.Return):
+            return True
+        if isinstance(last, ast.If):
+            return _ReturnRewriter._always_returns(last.body) and \
+                _ReturnRewriter._always_returns(last.orelse)
+        return False
+
+    def rewrite_block(self, stmts):
+        out = []
+        for idx, s in enumerate(stmts):
+            returned = _has_return([s])
+            rest0 = stmts[idx + 1:]
+            if isinstance(s, ast.If) and rest0 and \
+                    self._always_returns(s.body):
+                # `if p: return a` followed by more code: fold the tail
+                # into the ELSE branch (reference ifelse_transformer's
+                # early-return hoist) so a static cond merges REAL
+                # values on both sides instead of a None placeholder
+                merged = ast.If(test=s.test,
+                                body=self.rewrite_block(s.body),
+                                orelse=self.rewrite_block(
+                                    list(s.orelse) + list(rest0)))
+                out.append(merged)
+                return out
+            if isinstance(s, ast.Return):
+                out.extend(self._lower_return(s))
+            elif isinstance(s, ast.If):
+                s = ast.If(test=s.test,
+                           body=self.rewrite_block(s.body),
+                           orelse=self.rewrite_block(s.orelse))
+                out.append(s)
+            elif isinstance(s, ast.While):
+                # the loop may only exit via return: fold `not flag`
+                # into the test (plain python ops — the logical
+                # transformer converts them later)
+                body = self.rewrite_block(s.body)
+                test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[s.test,
+                            ast.UnaryOp(op=ast.Not(),
+                                        operand=_load(self.FLAG))]) \
+                    if returned else s.test
+                out.append(ast.While(test=test, body=body,
+                                     orelse=s.orelse))
+            elif isinstance(s, ast.For):
+                body = self.rewrite_block(s.body)
+                if returned:
+                    # guard the WHOLE body: after a return fires, later
+                    # iterations must neither mutate state nor re-set
+                    # the return value
+                    body = [ast.If(
+                        test=ast.UnaryOp(op=ast.Not(),
+                                         operand=_load(self.FLAG)),
+                        body=body, orelse=[])]
+                out.append(ast.For(target=s.target, iter=s.iter,
+                                   body=body, orelse=s.orelse))
+            elif isinstance(s, ast.With):
+                out.append(ast.With(items=s.items,
+                                    body=self.rewrite_block(s.body)))
+            elif isinstance(s, ast.Try):
+                out.append(ast.Try(
+                    body=self.rewrite_block(s.body),
+                    handlers=[ast.ExceptHandler(
+                        type=h.type, name=h.name,
+                        body=self.rewrite_block(h.body))
+                        for h in s.handlers],
+                    orelse=self.rewrite_block(s.orelse),
+                    finalbody=self.rewrite_block(s.finalbody)))
+            else:
+                out.append(s)
+            rest = stmts[idx + 1:]
+            if returned and rest:
+                guard = ast.UnaryOp(op=ast.Not(), operand=_load(self.FLAG))
+                out.append(ast.If(test=guard,
+                                  body=self.rewrite_block(rest),
+                                  orelse=[]))
+                break
+        return out
+
+    @classmethod
+    def rewrite_function(cls, fdef):
+        """Apply when any return sits inside control flow; a single
+        trailing top-level return needs no lowering."""
+        non_trailing = list(fdef.body)
+        if non_trailing and isinstance(non_trailing[-1], ast.Return):
+            non_trailing = non_trailing[:-1]
+        if not _has_return(non_trailing):
+            return fdef
+        rw = cls()
+        body = [ast.Assign(targets=[_store(cls.FLAG)],
+                           value=ast.Constant(value=False)),
+                ast.Assign(targets=[_store(cls.VAL)],
+                           value=ast.Constant(value=None))]
+        body += rw.rewrite_block(fdef.body)
+        body.append(ast.Return(value=_load(cls.VAL)))
+        fdef.body = body
+        return fdef
+
+
 class _BreakContinueRewriter(ast.NodeTransformer):
     """Replace this loop's break/continue with flag assignments
     (reference break_continue_transformer.py, flag-variable scheme):
@@ -432,6 +581,9 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     # strip decorators so compiling doesn't recurse through @declarative
     fdef.decorator_list = []
+    # returns inside control flow lower to a (flag, value) pair BEFORE
+    # the control-flow conversion (reference return_transformer.py)
+    _ReturnRewriter.rewrite_function(fdef)
     new_tree = DygraphToStaticAst().visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dygraph_to_static:{fn.__name__}>",

@@ -306,3 +306,67 @@ def test_break_continue_negative_step_range():
 
     conv = convert_to_static(fn)
     assert conv(1.0, [0, 0]) == fn(1.0, [0, 0])
+
+
+def test_return_inside_control_flow():
+    """`return` inside converted control flow lowers to a (flag, value)
+    pair (reference return_transformer.py): early returns work in
+    python, eager, and static modes."""
+    def fn(x, n):
+        for i in range(n):
+            if i == 2:
+                return x * 10.0
+            x = x + 1.0
+        while x < 100.0:
+            if x > 50.0:
+                return -x
+            x = x * 3.0
+        return x
+
+    conv = convert_to_static(fn)
+    for args in ((1.0, 5), (1.0, 2), (1.0, 0), (40.0, 0)):
+        assert conv(*args) == fn(*args), args
+
+    # predicates that stay true on later iterations must not clobber
+    # the captured value, and pre-return state mutation must stop
+    def first_i(x):
+        for i in range(3):
+            if x > 0:
+                return i
+        return -1
+
+    def count_to(x, n):
+        for i in range(n):
+            x = x + 1
+            if x >= 3:
+                return x
+        return x
+
+    for f, args, want in ((first_i, (1.0,), 0), (first_i, (-1.0,), -1),
+                          (count_to, (0, 5), 3), (count_to, (0, 2), 2)):
+        got = convert_to_static(f)(*args)
+        assert got == want == f(*args), (f.__name__, args, got)
+
+
+def test_return_in_static_branch():
+    """Early return from a data-dependent static `if`: both branches
+    recorded, the right value merges out of cond."""
+    def fn(x):
+        s = layers.reduce_sum(x)
+        if layers.greater_than(s, layers.fill_constant([1], "float32",
+                                                       0.0)):
+            return layers.scale(x, scale=2.0)
+        return layers.scale(x, scale=-5.0)
+
+    pt = dygraph.ProgramTranslator()
+    xv = np.ones((2, 2), np.float32)
+    main, startup, feeds, fetches = pt.get_program(fn, xv)
+    assert "cond" in _op_types(main)
+    exe = fluid.Executor()
+    for sign, factor in ((1.0, 2.0), (-1.0, -5.0)):
+        arr = np.ones((2, 2), np.float32) * sign
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={feeds[0]: arr},
+                           fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out), arr * factor)
