@@ -5,7 +5,8 @@ from .tensor import *      # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .math import *        # noqa: F401,F403
 from .control_flow import (  # noqa: F401
-    While, Switch, StaticRNN, cond, create_array, array_read, array_write,
+    While, Switch, StaticRNN, DynamicRNN, cond, create_array, array_read,
+    array_write,
     array_length,
 )
 from .sequence_lod import (  # noqa: F401

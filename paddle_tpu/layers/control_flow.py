@@ -581,3 +581,136 @@ def array_length(array):
                      inputs={}, outputs={"Out": [out]},
                      attrs={"array_name": array.name}, infer_shape=False)
     return out
+
+
+class DynamicRNN:
+    """fluid.layers.DynamicRNN (reference layers/control_flow.py:2768) in
+    masked-dense form. The reference sorts sequences by length
+    (lod_rank_table), shrinks the live batch every step, and re-scatters
+    outputs; on TPU the batch stays static and a per-step validity mask
+    freezes finished rows' memories and zeros their outputs — identical
+    results, one lax.scan.
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths)   # x [B, T, D] padded
+            h = drnn.memory(shape=[H], value=0.0)
+            nh = layers.fc(layers.concat([x_t, h], 1), H, act="tanh")
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()                             # [B, T, H] (zeros padded)
+    """
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name)
+        self._mask_t = None          # [B, 1] float validity, per step
+        self._lengths = None
+        self._batch = None
+
+    def block(self):
+        return self._rnn.step()
+
+    def step_input(self, x, lengths=None, level=0):
+        """x: [B, T, ...] padded batch-major + lengths [B] (the
+        masked-dense stand-in for the reference's LoD input; `level` is
+        accepted for API parity). Additional step inputs share the first
+        one's lengths — passing a different lengths var raises."""
+        from . import tensor as T
+        from .sequence_lod import sequence_mask
+        assert x.shape is not None and len(x.shape) >= 2, \
+            "step_input needs [B, T, ...] with known rank"
+        if self._mask_t is not None and lengths is not None \
+                and lengths is not self._lengths:
+            raise ValueError(
+                "DynamicRNN: every step_input shares the FIRST one's "
+                "lengths; a second lengths= would be silently wrong")
+        ndim = len(x.shape)
+        # the transpose/mask prep must run BEFORE the recurrent op:
+        # emit into the parent block (same trick StaticRNN.memory uses
+        # for boot vars)
+        program = self._rnn.helper.main_program
+        cur = program.current_block_idx
+        program.current_block_idx = self._rnn._parent.idx
+        try:
+            # time-major for the scan: [T, B, ...]
+            xt = T.transpose(x, [1, 0] + list(range(2, ndim)))
+            mask_in = None
+            if self._mask_t is None:
+                if lengths is None:
+                    raise ValueError(
+                        "the FIRST DynamicRNN.step_input needs lengths= "
+                        "([B] int sequence lengths; masked-dense design)")
+                self._lengths = lengths
+                self._batch = int(x.shape[0])
+                maxlen = int(x.shape[1])
+                mask = sequence_mask(lengths, maxlen=maxlen,
+                                     dtype="float32")       # [B, T]
+                mask_tm = T.transpose(mask, [1, 0])          # [T, B]
+                mask_in = T.reshape(mask_tm, [maxlen, -1, 1])
+        finally:
+            program.current_block_idx = cur
+        iv = self._rnn.step_input(xt)
+        if mask_in is not None:
+            self._mask_t = self._rnn.step_input(mask_in)     # [B, 1]
+        return iv
+
+    def static_input(self, x):
+        """Whole-sequence (non-stepped) input: visible unchanged every
+        step (the recurrent lowering threads outer reads through)."""
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0,
+               need_reorder=False, dtype="float32"):
+        """Reference signature (layers/control_flow.py:3184): `shape`
+        EXCLUDES the batch dim; `value`/`dtype` set the boot constant.
+        need_reorder is a no-op — masked-dense never sorts the batch."""
+        assert self._mask_t is not None, \
+            "call step_input() before memory() (the mask drives updates)"
+        if init is None:
+            assert shape is not None, "memory() needs init= or shape="
+            from . import tensor as T
+            program = self._rnn.helper.main_program
+            cur = program.current_block_idx
+            program.current_block_idx = self._rnn._parent.idx
+            try:
+                init = T.fill_constant(
+                    [self._batch] + [int(s) for s in shape], dtype,
+                    value)
+            finally:
+                program.current_block_idx = cur
+        return self._rnn.memory(init=init)
+
+    def _mask_like(self, var):
+        """[B, 1] mask broadcast-shaped for `var`'s rank."""
+        rank = len(var.shape)
+        if rank <= 2:
+            return self._mask_t
+        from . import tensor as T
+        return T.reshape(self._mask_t, [-1] + [1] * (rank - 1))
+
+    def update_memory(self, ex_mem, new_mem):
+        """Finished rows (mask 0) keep their memory — the reference
+        achieves this by shrinking the live batch instead."""
+        from . import math as M
+        masked = M.elementwise_add(
+            ex_mem,
+            M.elementwise_mul(M.elementwise_sub(new_mem, ex_mem),
+                              self._mask_like(new_mem)))
+        self._rnn.update_memory(ex_mem, masked)
+
+    def output(self, *outputs):
+        from . import math as M
+        for o in outputs:
+            self._rnn.step_output(
+                M.elementwise_mul(o, self._mask_like(o)))
+
+    def __call__(self):
+        from . import tensor as T
+        outs = self._rnn()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        back = []
+        for o in outs:
+            nd = len(o.shape)
+            back.append(T.transpose(o, [1, 0] + list(range(2, nd))))
+        return back[0] if len(back) == 1 else back

@@ -407,3 +407,99 @@ def test_while_auto_bound_mutated_grad_raises():
             raise AssertionError("expected ValueError")
         except ValueError as e:
             assert "no longer valid" in str(e), e
+
+
+def test_dynamic_rnn_masked_dense():
+    """DynamicRNN (reference layers/control_flow.py:2768) in masked-dense
+    form: finished rows freeze their memory and output zeros; results
+    match a per-row python recurrence."""
+    B, T, D, H = 3, 5, 4, 6
+    lengths_np = np.array([5, 2, 4], np.int64)
+    rng = np.random.default_rng(9)
+    xv = rng.standard_normal((B, T, D)).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        x.stop_gradient = False
+        lens = layers.data("lens", [B], dtype="int64")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=lens)
+            h = drnn.memory(shape=[H], value=0.0)
+            nh = layers.fc(layers.concat([x_t, h], axis=1), H, act="tanh",
+                           param_attr=fluid.ParamAttr(name="drnn.w"),
+                           bias_attr=fluid.ParamAttr(name="drnn.b"))
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()                                   # [B, T, H]
+        loss = layers.reduce_sum(out)
+        (gx,) = fluid.gradients(loss, [x])
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ov, gv = exe.run(main, feed={"x": xv, "lens": lengths_np},
+                         fetch_list=[out, gx])
+        w = np.asarray(scope.find_var("drnn.w"))
+        b = np.asarray(scope.find_var("drnn.b"))
+
+    ov = np.asarray(ov)
+    # python oracle per row
+    for r in range(B):
+        h = np.zeros(H, np.float32)
+        for t in range(T):
+            if t < lengths_np[r]:
+                h = np.tanh(np.concatenate([xv[r, t], h]) @ w + b)
+                np.testing.assert_allclose(ov[r, t], h, rtol=1e-4,
+                                           atol=1e-5)
+            else:
+                np.testing.assert_allclose(ov[r, t], 0.0, atol=1e-6)
+    # grads: padding steps contribute nothing
+    gv = np.asarray(gv)
+    assert np.all(gv[1, 2:] == 0.0), gv[1]
+    assert np.any(gv[0, 4] != 0.0)
+
+
+def test_dynamic_rnn_rank3_memory_and_second_lengths_raise():
+    B, T, D = 2, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        lens = layers.data("lens", [B], dtype="int64")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=lens)
+            m = drnn.memory(shape=[2, 3], value=0.5)   # rank-3 memory
+            nm = layers.elementwise_add(
+                m, layers.reshape(
+                    layers.fc(x_t, 6,
+                              param_attr=fluid.ParamAttr(name="r3.w"),
+                              bias_attr=False), [-1, 2, 3]))
+            drnn.update_memory(m, nm)
+            drnn.output(nm)
+        out = drnn()                                   # [B, T, 2, 3]
+    xv = np.ones((B, T, D), np.float32)
+    lv = np.array([3, 1], np.int64)
+    ov, = _run(main, startup, {"x": xv, "lens": lv}, [out])
+    ov = np.asarray(ov)
+    assert ov.shape == (B, T, 2, 3)
+    # row 1 finished after step 0: steps 1-2 output zeros
+    assert np.all(ov[1, 1:] == 0.0) and np.any(ov[1, 0] != 0.0)
+
+    # a second DIFFERENT lengths var must raise
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = layers.data("x2", [B, T, D], dtype="float32")
+        l1 = layers.data("l1", [B], dtype="int64")
+        l2 = layers.data("l2", [B], dtype="int64")
+        drnn2 = layers.DynamicRNN()
+        try:
+            with drnn2.block():
+                drnn2.step_input(x2, lengths=l1)
+                drnn2.step_input(x2, lengths=l2)
+                raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "lengths" in str(e)
