@@ -232,3 +232,27 @@ def push_sparse_op(ctx, ins, attrs):
 @register_op("push_sparse_v2", grad=False, infer_shape=False)
 def push_sparse_v2_op(ctx, ins, attrs):
     return push_sparse_op(ctx, ins, attrs)
+
+
+@register_op("pull_box_sparse", grad=False, infer_shape=False)
+def pull_box_sparse_op(ctx, ins, attrs):
+    """BoxPS embedding pull (reference pull_box_sparse_op.cc — the
+    PaddleBox GPU-KV service front). The service itself is proprietary
+    hardware infra; capability-wise it is the downpour sparse table,
+    so this lowers to the same FleetWrapper pull (attr `size` is the
+    reference's embedding dim name)."""
+    a = dict(attrs)
+    a.setdefault("EmbeddingDim", int(attrs.get("size", 1)))
+    return pull_sparse_op(ctx, ins, a)
+
+
+@register_op("push_box_sparse", grad=False, infer_shape=False)
+def push_box_sparse_op(ctx, ins, attrs):
+    """BoxPS embedding push (reference push_box_sparse kernel in
+    pull_box_sparse_op.cc) — downpour push, see pull_box_sparse.
+    Grad-op wiring feeds the upstream grads as Out@GRAD; push_sparse
+    expects them under Grads."""
+    ins = dict(ins)
+    if "Out@GRAD" in ins and "Grads" not in ins:
+        ins["Grads"] = ins["Out@GRAD"]
+    return push_sparse_op(ctx, ins, attrs)

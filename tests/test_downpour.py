@@ -256,3 +256,31 @@ def test_pull_push_sparse_ops():
             out2[0, 0], out1[0, 0] * 0.0, atol=1e-5)
     finally:
         _stop([ep])
+
+
+def test_box_sparse_ops_alias_downpour():
+    """pull/push_box_sparse (reference pull_box_sparse_op.cc — the
+    PaddleBox GPU-KV front) lower to the same downpour sparse tables:
+    a pull returns rows, a push with +1 grads moves them by -lr."""
+    from test_ops_detection2 import _run_op
+    srv, ep = _start_server(emb_dim=4, lr=0.5)
+    try:
+        ids = np.array([[1], [2], [3]], np.int64)
+        attrs = {"size": 4, "endpoints": [ep], "TableId": 0}
+        out0, = _run_op("pull_box_sparse",
+                        {"Ids": [("bs_ids", ids)]}, attrs,
+                        {"Out": ((3, 1, 4), "float32")})
+        grads = np.ones((3, 1, 4), np.float32)
+        # feed grads under Out@GRAD: the slot a grad-op wiring uses
+        # (push_box_sparse remaps it to push_sparse's Grads)
+        _run_op("push_box_sparse",
+                {"Ids": [("bs_ids2", ids)],
+                 "Out@GRAD": [("bs_g", grads)]}, attrs, {})
+        out1, = _run_op("pull_box_sparse",
+                        {"Ids": [("bs_ids3", ids)]}, attrs,
+                        {"Out": ((3, 1, 4), "float32")})
+        np.testing.assert_allclose(np.asarray(out1),
+                                   np.asarray(out0) - 0.5,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        _stop([ep])
