@@ -173,6 +173,83 @@ class ParameterServer:
             "rng": np.random.default_rng(int(table_id) + 17),
         }
 
+    # -- persistence (reference fluid/io.py _save_distributed_persistables
+    # + __save_distributed_lookup_tables: the SERVER side owns the
+    # authoritative tables, so saving happens there — trainers just RPC) --
+    def save_tables(self, dirname):
+        """Write every hosted table (dense + sparse + downpour rows with
+        their show/click/optimizer state) under dirname, sharded by this
+        server's endpoint so multi-server clusters don't collide."""
+        import os
+        tag = f"{self.host}_{self.port}"
+        os.makedirs(dirname, exist_ok=True)
+
+        def atomic_savez(path, **arrs):
+            # a crash mid-save must not destroy the previous good
+            # checkpoint: write aside, then rename into place.
+            # (np.savez appends ".npz" to names not ending in it, so
+            # the temp name must keep the suffix)
+            tmp = path[:-len(".npz")] + ".tmp.npz"
+            np.savez(tmp, **arrs)
+            os.replace(tmp, path)
+
+        dense = {n: np.asarray(v) for n, v in self.tables.items()}
+        atomic_savez(os.path.join(dirname, f"ps_dense.{tag}.npz"),
+                     **dense)
+        for tid, tbl in self.downpour_tables.items():
+            rows = tbl["rows"]
+            fids = np.asarray(sorted(rows), np.int64)
+            payload = {
+                "fids": fids,
+                "emb": np.stack([rows[int(f)]["emb"] for f in fids])
+                if len(fids) else np.zeros((0, tbl["dim"]), np.float32),
+                "show": np.asarray([rows[int(f)]["show"] for f in fids],
+                                   np.float64),
+                "click": np.asarray([rows[int(f)]["click"] for f in fids],
+                                    np.float64),
+            }
+            if len(fids) and "g2" in rows[int(fids[0])]:
+                payload["g2"] = np.stack([rows[int(f)]["g2"]
+                                          for f in fids])
+            atomic_savez(os.path.join(dirname,
+                                      f"ps_downpour.{tid}.{tag}.npz"),
+                         **payload)
+
+    def load_tables(self, dirname):
+        """Restore tables written by save_tables (this server's shard)."""
+        import os
+        tag = f"{self.host}_{self.port}"
+        found = 0
+        dense_path = os.path.join(dirname, f"ps_dense.{tag}.npz")
+        if os.path.exists(dense_path):
+            found += 1
+            with np.load(dense_path) as z:
+                for n in z.files:
+                    self.tables[n] = z[n]
+        for tid, tbl in self.downpour_tables.items():
+            p = os.path.join(dirname, f"ps_downpour.{tid}.{tag}.npz")
+            if not os.path.exists(p):
+                continue
+            found += 1
+            with np.load(p) as z:
+                tbl["rows"].clear()
+                has_g2 = "g2" in z.files
+                for i, f in enumerate(z["fids"]):
+                    row = {"emb": z["emb"][i].copy(),
+                           "show": float(z["show"][i]),
+                           "click": float(z["click"][i])}
+                    if has_g2:
+                        row["g2"] = z["g2"][i].copy()
+                    tbl["rows"][int(f)] = row
+        if found == 0:
+            # a silent no-op restore (wrong dirname, or the server moved
+            # to a different endpoint so the shard tag changed) would
+            # resume training from fresh tables — fail loudly instead
+            raise FileNotFoundError(
+                f"load_tables: no checkpoint files for shard {tag!r} "
+                f"under {dirname!r} (expected ps_dense.{tag}.npz / "
+                f"ps_downpour.<id>.{tag}.npz)")
+
     def _dp_row(self, tbl, fid):
         row = tbl["rows"].get(int(fid))
         if row is None:
@@ -467,6 +544,16 @@ class ParameterServer:
             return ("val", {"rows": n, "show": show, "click": click})
         if kind == "barrier_ping":
             return ("ok",)
+        if kind == "save_persistables":
+            _, dirname = msg
+            with self._cv:
+                self.save_tables(dirname)
+            return ("ok",)
+        if kind == "load_persistables":
+            _, dirname = msg
+            with self._cv:
+                self.load_tables(dirname)
+            return ("ok",)
         if kind == "stop":
             self._stop.set()
             with self._cv:
@@ -550,6 +637,16 @@ class PSClient:
 
     def dp_stat(self, endpoint, table_id):
         return self._call(endpoint, ("dp_stat", int(table_id)))
+
+    def save_persistables(self, endpoints, dirname):
+        """Ask every pserver to save its hosted tables (reference
+        fluid/io.py _save_distributed_persistables — server-side save)."""
+        for ep in dict.fromkeys(endpoints):
+            self._call(ep, ("save_persistables", dirname))
+
+    def load_persistables(self, endpoints, dirname):
+        for ep in dict.fromkeys(endpoints):
+            self._call(ep, ("load_persistables", dirname))
 
     def stop_servers(self, endpoints):
         for ep in dict.fromkeys(endpoints):

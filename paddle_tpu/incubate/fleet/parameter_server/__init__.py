@@ -62,6 +62,23 @@ class ParameterServerFleet:
             PSClient.instance().stop_servers(
                 self._role_maker.get_pserver_endpoints())
 
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None):
+        """Server-side save of the PS-hosted tables (reference
+        fluid/io.py _save_distributed_persistables via fleet): every
+        pserver writes its shard under `dirname`."""
+        from ....distributed.ps import PSClient
+        assert dirname, "save_persistables needs dirname"
+        PSClient.instance().save_persistables(
+            self._role_maker.get_pserver_endpoints(), dirname)
+
+    def load_persistables(self, executor=None, dirname=None,
+                          main_program=None):
+        from ....distributed.ps import PSClient
+        assert dirname, "load_persistables needs dirname"
+        PSClient.instance().load_persistables(
+            self._role_maker.get_pserver_endpoints(), dirname)
+
     @property
     def main_program(self):
         assert self._trainer_program is not None, \
