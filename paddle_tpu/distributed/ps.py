@@ -226,9 +226,14 @@ class ParameterServer:
             with np.load(dense_path) as z:
                 for n in z.files:
                     self.tables[n] = z[n]
+        missing_dp = []
         for tid, tbl in self.downpour_tables.items():
             p = os.path.join(dirname, f"ps_downpour.{tid}.{tag}.npz")
             if not os.path.exists(p):
+                # a CONFIGURED table with no shard file means the
+                # checkpoint doesn't cover it — resuming its sparse
+                # embeddings from scratch must be loud, not silent
+                missing_dp.append(tid)
                 continue
             found += 1
             with np.load(p) as z:
@@ -241,14 +246,18 @@ class ParameterServer:
                     if has_g2:
                         row["g2"] = z["g2"][i].copy()
                     tbl["rows"][int(f)] = row
-        if found == 0:
-            # a silent no-op restore (wrong dirname, or the server moved
-            # to a different endpoint so the shard tag changed) would
-            # resume training from fresh tables — fail loudly instead
+        if found == 0 or missing_dp:
+            # a silent partial/no-op restore (wrong dirname, moved
+            # endpoint so the shard tag changed, or a deleted table
+            # file) would resume training from fresh tables — fail
+            # loudly instead
             raise FileNotFoundError(
-                f"load_tables: no checkpoint files for shard {tag!r} "
-                f"under {dirname!r} (expected ps_dense.{tag}.npz / "
-                f"ps_downpour.<id>.{tag}.npz)")
+                f"load_tables: checkpoint under {dirname!r} does not "
+                f"cover shard {tag!r}"
+                + (f" (downpour tables {missing_dp} have no file)"
+                   if missing_dp else
+                   f" (expected ps_dense.{tag}.npz / "
+                   f"ps_downpour.<id>.{tag}.npz)"))
 
     def _dp_row(self, tbl, fid):
         row = tbl["rows"].get(int(fid))

@@ -775,3 +775,218 @@ def gather_tree(ids, parents, name=None):
                      inputs={"Ids": [ids], "Parents": [parents]},
                      outputs={"Out": [out]}, attrs={}, infer_shape=False)
     return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """Run user Python inside the program (reference layers/nn.py:12799
+    py_func + py_func_op.cc). `func(*numpy_inputs)` fills `out` (pre-made
+    Variable(s) carrying the static shape/dtype the TPU program needs);
+    `backward_func(*inputs, *outputs, *out_grads)` returns per-input
+    grads (None allowed). Both must be PURE — the compiled program may
+    re-invoke them (jax.pure_callback semantics).
+    `skip_vars_in_backward_input` is accepted for API parity; the
+    backward here always receives the full (inputs, outputs, grads)
+    tuple and may ignore entries."""
+    from ..framework.core import Variable
+    from ..ops.extra_ops import register_py_func
+    helper = LayerHelper("py_func", name=name)
+    xs = [x] if isinstance(x, Variable) else list(x)
+    outs = [out] if isinstance(out, Variable) else list(out)
+    for v in outs:
+        if v.shape is None or any(s is None or s < 0 for s in v.shape):
+            raise ValueError(
+                f"py_func out {v.name!r} needs a fully static shape "
+                f"(got {v.shape}) — XLA compiles the callback's result "
+                f"buffer ahead of time")
+    attrs = {"func_id": register_py_func(func),
+             "out_shapes": [list(v.shape) for v in outs],
+             "out_dtypes": [str(v.dtype) for v in outs]}
+    if backward_func is not None:
+        attrs["bwd_func_id"] = register_py_func(backward_func)
+    helper.append_op(type="py_func", inputs={"X": xs},
+                     outputs={"Out": outs}, attrs=attrs,
+                     infer_shape=False)
+    return out
+
+
+# ---- round-4 layer-surface wrappers over existing op lowerings ----
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", name=name)
+    out = helper.create_variable_for_type_inference(dtype=ref.dtype)
+    helper.append_op(type="scatter_nd_add",
+                     inputs={"X": [ref], "Index": [index],
+                             "Updates": [updates]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    helper = LayerHelper("strided_slice", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="strided_slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends),
+                            "strides": list(strides)},
+                     infer_shape=False)
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+
+    def _pair2(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    helper.append_op(type="unfold", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"kernel_sizes": _pair2(kernel_sizes),
+                            "strides": _pair2(strides),
+                            "paddings": (list(paddings)
+                                         if isinstance(paddings,
+                                                       (list, tuple))
+                                         else [paddings] * 4),
+                            "dilations": _pair2(dilations)},
+                     infer_shape=False)
+    return out
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    return _unary("pixel_shuffle", x, name=name,
+                  attrs={"upscale_factor": int(upscale_factor)})
+
+
+def shuffle_channel(x, group, name=None):
+    return _unary("shuffle_channel", x, name=name,
+                  attrs={"group": int(group)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _unary("temporal_shift", x, name=name,
+                  attrs={"seg_num": int(seg_num),
+                         "shift_ratio": float(shift_ratio)})
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype=y.dtype)
+    helper.append_op(type="pad_constant_like",
+                     inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"pad_value": float(pad_value)},
+                     infer_shape=False)
+    return out
+
+
+def _crop_impl(op_type, x, shape, offsets, name):
+    from ..framework.core import Variable
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    ins = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        raise ValueError(
+            f"{op_type}: a tensor `shape` is a dynamic output shape — "
+            f"pass a static list on TPU (offsets MAY be a tensor)")
+    if shape is not None:
+        attrs["shape"] = list(shape)
+    if isinstance(offsets, Variable):
+        ins["Offsets"] = [offsets]      # runtime offsets: dynamic_slice
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op(type=op_type, inputs=ins, outputs={"Out": [out]},
+                     attrs=attrs, infer_shape=False)
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _crop_impl("crop", x, shape, offsets, name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return _crop_impl("crop_tensor", x, shape, offsets, name)
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="expand_as",
+                     inputs={"X": [x], "target_tensor": [target_tensor]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    helper = LayerHelper("gaussian_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type="gaussian_random", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": float(mean),
+                            "std": float(std), "seed": int(seed),
+                            "dtype": dtype},
+                     infer_shape=False)
+    return out
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _unary("maxout", x, name=name,
+                  attrs={"groups": int(groups), "axis": int(axis)})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _unary("space_to_depth", x, name=name,
+                  attrs={"blocksize": int(blocksize)})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None, act=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    ins = {"X": [x]}
+    if scale is not None:
+        ins["Scale"] = [scale]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    helper.append_op(type="affine_channel", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"data_layout": data_layout},
+                     infer_shape=False)
+    return helper.append_activation(out, act)
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    index = helper.create_variable_for_type_inference(dtype=dtype)
+    count = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type="unique_with_counts", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index],
+                              "Count": [count]},
+                     attrs={"dtype": dtype}, infer_shape=False)
+    return out, index, count
+
+
+def fsp_matrix(x, y, name=None):
+    """FSP matrix for distillation (reference layers/nn.py fsp_matrix /
+    fsp_op.h)."""
+    helper = LayerHelper("fsp", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="fsp", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True, name=None):
+    """CVM op for CTR (reference layers/nn.py continuous_value_model /
+    cvm_op.h)."""
+    helper = LayerHelper("cvm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="cvm",
+                     inputs={"X": [input], "CVM": [cvm]},
+                     outputs={"Y": [out]},
+                     attrs={"use_cvm": bool(use_cvm)},
+                     infer_shape=False)
+    return out

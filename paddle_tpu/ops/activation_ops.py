@@ -100,7 +100,12 @@ def log_softmax(ctx, ins, attrs):
 
 @register_op("maxout")
 def maxout(ctx, ins, attrs):
+    """reference maxout_op.h: channel groups along `axis` (1=NCHW,
+    -1/3=NHWC)."""
     x = x_of(ins)
     groups = attrs["groups"]
-    n, c, h, w = x.shape
-    return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}
+    axis = int(attrs.get("axis", 1)) % x.ndim
+    c = x.shape[axis]
+    shape = (x.shape[:axis] + (c // groups, groups) +
+             x.shape[axis + 1:])
+    return {"Out": x.reshape(shape).max(axis=axis + 1)}

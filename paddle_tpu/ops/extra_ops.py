@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.registry import OPS, register_op
+from ..framework.registry import OPS, register_grad_lower, register_op
 from .common import roi_batch_indices, x_of
 
 
@@ -185,11 +185,21 @@ def shuffle_channel(ctx, ins, attrs):
 
 @register_op("affine_channel")
 def affine_channel(ctx, ins, attrs):
+    """reference affine_channel_op.cc: per-channel scale/bias, NCHW or
+    NHWC; absent Scale/Bias default to identity (1/0)."""
     x = x_of(ins)
     scale = x_of(ins, "Scale")
     bias = x_of(ins, "Bias")
-    shape = (1, -1) + (1,) * (x.ndim - 2)
-    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+    layout = attrs.get("data_layout", "NCHW")
+    caxis = 1 if layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[caxis] = -1
+    out = x
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return {"Out": out}
 
 
 @register_op("lrn")
@@ -227,8 +237,15 @@ def unbind(ctx, ins, attrs):
 
 @register_op("crop_tensor")
 def crop_tensor(ctx, ins, attrs):
+    """reference crop_tensor_op.h: Offsets may be a runtime TENSOR
+    (dynamic_slice handles it); the output `shape` must be static."""
     x = x_of(ins)
-    offsets = attrs.get("offsets", [0] * x.ndim)
+    off_in = ins.get("Offsets")
+    if off_in:
+        off = jnp.reshape(off_in[0], (-1,)).astype(jnp.int32)
+        offsets = [off[i] for i in range(x.ndim)]
+    else:
+        offsets = attrs.get("offsets", [0] * x.ndim)
     shape = attrs["shape"]
     return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
 
@@ -743,7 +760,7 @@ def register_py_func(fn):
     return len(PY_FUNC_REGISTRY) - 1
 
 
-@register_op("py_func", infer_shape=False, grad=False)
+@register_op("py_func", infer_shape=False)
 def py_func(ctx, ins, attrs):
     """Call registered host Python inside the compiled program via
     jax.pure_callback (reference py_func_op.cc runs the callable on the
@@ -767,3 +784,105 @@ def py_func(ctx, ins, attrs):
 
     outs = jax.pure_callback(host, tuple(specs), *xs)
     return {"Out": list(outs)}
+
+
+@register_grad_lower("py_func")
+def py_func_grad(ctx, ins, attrs):
+    """User-supplied backward (reference py_func_op.cc backward_func):
+    called with (inputs..., outputs..., out_grads...) numpy arrays and
+    returns per-input grads (None allowed). The forward callable is
+    re-invoked to produce outputs — both must be pure (declared contract
+    of the op). Without a backward_func, inputs get no grads."""
+    import numpy as _np
+    fattrs = attrs["__fwd_op__"]["attrs"]
+    bid = fattrs.get("bwd_func_id")
+    xs = list(ins.get("X", []))
+    if bid is None:
+        return {"X@GRAD": [None] * len(xs)}
+    fwd = PY_FUNC_REGISTRY[int(fattrs["func_id"])]
+    bwd = PY_FUNC_REGISTRY[int(bid)]
+    gs = list(ins.get("Out@GRAD", []))
+    gs = [g for g in gs if g is not None]
+    # the backward builder COMPACTS Out@GRAD to present entries and
+    # records which outputs have one (__out_grad_mask__) — realign so
+    # bwd always receives one grad per declared output (zeros when the
+    # output is unused downstream)
+    n_out = len(fattrs["out_shapes"])
+    mask = (attrs.get("__out_grad_mask__") or {}).get("Out")
+    if mask is None:
+        mask = [True] * len(gs) + [False] * (n_out - len(gs))
+
+    def host(*arrays):
+        n = len(xs)
+        x_np = tuple(_np.asarray(a) for a in arrays[:n])
+        present = list(arrays[n:])
+        out = fwd(*x_np)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        g_np, gi = [], 0
+        for i in range(n_out):
+            if i < len(mask) and mask[i]:
+                g_np.append(_np.asarray(present[gi]))
+                gi += 1
+            else:
+                g_np.append(_np.zeros(tuple(fattrs["out_shapes"][i]),
+                                      _np.dtype(fattrs["out_dtypes"][i])))
+        grads = bwd(*x_np, *tuple(_np.asarray(o) for o in out), *g_np)
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        return tuple(
+            _np.zeros(x.shape, _np.asarray(x).dtype) if g is None
+            else _np.asarray(g, _np.asarray(x).dtype)
+            for x, g in zip(x_np, grads))
+
+    specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                  for x in xs)
+    outs = jax.pure_callback(host, specs, *xs, *gs)
+    return {"X@GRAD": list(outs)}
+
+
+@register_op("fsp", infer_shape=False)
+def fsp(ctx, ins, attrs):
+    """Flow-of-solution-procedure matrix for distillation (reference
+    fsp_op.h): Out[b] = X[b].reshape(C1, HW) @ Y[b].reshape(C2, HW)^T
+    / (H*W). X [B,C1,H,W], Y [B,C2,H,W] -> [B,C1,C2]."""
+    x = x_of(ins)
+    y = x_of(ins, "Y")
+    B, C1, H, W = x.shape
+    C2 = y.shape[1]
+    xm = x.reshape(B, C1, H * W)
+    ym = y.reshape(B, C2, H * W)
+    return {"Out": jnp.einsum("bcx,bdx->bcd", xm, ym) / float(H * W)}
+
+
+@register_op("cvm", infer_shape=False)
+def cvm(ctx, ins, attrs):
+    """Continuous-value model op for CTR (reference cvm_op.h): X rows
+    lead with (show, click); use_cvm=True keeps the width and rewrites
+    col0=log(show+1), col1=log(click+1)-log(show+1); use_cvm=False
+    strips the two lead columns."""
+    x = x_of(ins)
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if not use_cvm:
+        return {"Y": x[:, 2:]}
+    c0 = jnp.log(x[:, 0] + 1.0)
+    c1 = jnp.log(x[:, 1] + 1.0) - c0
+    return {"Y": jnp.concatenate([c0[:, None], c1[:, None], x[:, 2:]],
+                                 axis=1)}
+
+
+@register_grad_lower("cvm")
+def cvm_grad(ctx, ins, attrs):
+    """reference CvmGradComputeKernel: DY copies back at the offset and
+    the two lead grad columns are OVERWRITTEN with the CVM input values
+    (show/click) — the reference's exact, if unusual, contract."""
+    fattrs = attrs["__fwd_op__"]["attrs"]
+    use_cvm = bool(fattrs.get("use_cvm", True))
+    x = x_of(ins)
+    g = x_of(ins, "Y@GRAD")
+    cvm_in = ins.get("CVM")
+    lead = (jnp.asarray(cvm_in[0])[:, :2] if cvm_in
+            else jnp.zeros((x.shape[0], 2), x.dtype))
+    body = g[:, 2:] if use_cvm else g
+    return {"X@GRAD": [jnp.concatenate([lead.astype(x.dtype), body],
+                                       axis=1)]}

@@ -264,11 +264,24 @@ def expand_v2(ctx, ins, attrs):
 
 @register_op("expand_as_v2")
 def expand_as_v2(ctx, ins, attrs):
+    """fluid expand_as TILES x so each target dim is an integer multiple
+    of x's dim (reference expand_as_op.cc: expand_times = y_dim/x_dim);
+    plain broadcasting is the special case of 1-sized dims."""
     x = x_of(ins)
     shape = attrs.get("target_shape")
     if shape is None:
-        shape = ins["Y"][0].shape
-    return {"Out": jnp.broadcast_to(x, tuple(shape))}
+        # v2 names the target "Y"; fluid 1.x expand_as names it
+        # "target_tensor" (reference expand_as_op.cc)
+        tgt = ins.get("Y") or ins["target_tensor"]
+        shape = tgt[0].shape
+    shape = tuple(int(s) for s in shape)
+    xshape = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    if any(t % xs for t, xs in zip(shape, xshape)):
+        raise ValueError(
+            f"expand_as: target {shape} must be integer multiples of "
+            f"input {tuple(x.shape)} per dim")
+    reps = tuple(t // xs for t, xs in zip(shape, xshape))
+    return {"Out": jnp.tile(x.reshape(xshape), reps)}
 
 
 @register_op("tile")

@@ -326,3 +326,112 @@ def test_dynamic_lstmp_peepholes():
     out_np = _run(lambda: build(False), {"x": x}, seed=11)[0]
     assert out_p.shape == (B, T, P)
     assert not np.allclose(out_p, out_np)
+
+
+def test_round4_layer_surface_wrappers():
+    """Thin wrappers over existing op lowerings (reference layers/nn.py
+    surface: scatter_nd_add, strided_slice, unfold, pixel_shuffle,
+    shuffle_channel, temporal_shift, pad_constant_like, crop_tensor,
+    expand_as, gaussian_random, maxout, space_to_depth, affine_channel,
+    unique_with_counts) and the new fsp/cvm ops."""
+    rng = np.random.default_rng(4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x4 = layers.data("x4", [2, 4, 4, 4], dtype="float32")
+        # pixel_shuffle: C=4, r=2 -> [2,1,8,8]
+        ps = layers.pixel_shuffle(x4, 2)
+        sc = layers.shuffle_channel(x4, group=2)
+        ts = layers.temporal_shift(x4, seg_num=2, shift_ratio=0.25)
+        sd = layers.space_to_depth(x4, 2)
+        mo = layers.maxout(x4, groups=2)
+        scale = layers.fill_constant([4], "float32", 2.0)
+        bias = layers.fill_constant([4], "float32", 1.0)
+        ac = layers.affine_channel(x4, scale=scale, bias=bias)
+        g = layers.gaussian_random([3, 5], mean=1.0, std=0.5, seed=7)
+        xf = layers.data("xf", [6], dtype="float32")
+        ss = layers.strided_slice(xf, axes=[0], starts=[0], ends=[6],
+                                  strides=[2])
+        fspm = layers.fsp_matrix(x4, x4)
+        cvm_in = layers.data("cvm_x", [3, 5], dtype="float32")
+        cvm_s = layers.data("cvm_s", [3, 2], dtype="float32")
+        cv = layers.continuous_value_model(cvm_in, cvm_s, use_cvm=True)
+        cv2 = layers.continuous_value_model(cvm_in, cvm_s, use_cvm=False)
+    exe = fluid.Executor()
+    feed = {"x4": rng.standard_normal((2, 4, 4, 4)).astype(np.float32),
+            "xf": np.arange(6, dtype=np.float32),
+            "cvm_x": np.abs(rng.standard_normal((3, 5))).astype(np.float32),
+            "cvm_s": np.ones((3, 2), np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[ps, sc, ts, sd, mo, ac, g, ss, fspm,
+                                   cv, cv2])
+    ps_v, sc_v, ts_v, sd_v, mo_v, ac_v, g_v, ss_v, fsp_v, cv_v, cv2_v = \
+        [np.asarray(o) for o in outs]
+    assert ps_v.shape == (2, 1, 8, 8)
+    assert sc_v.shape == (2, 4, 4, 4)
+    assert ts_v.shape == (2, 4, 4, 4)
+    assert sd_v.shape == (2, 16, 2, 2)
+    assert mo_v.shape == (2, 2, 4, 4)
+    np.testing.assert_allclose(ac_v, feed["x4"] * 2.0 + 1.0, rtol=1e-6)
+    assert g_v.shape == (3, 5) and abs(g_v.mean() - 1.0) < 0.5
+    np.testing.assert_allclose(ss_v, [0.0, 2.0, 4.0])
+    # fsp oracle
+    xm = feed["x4"].reshape(2, 4, 16)
+    np.testing.assert_allclose(
+        fsp_v, np.einsum("bcx,bdx->bcd", xm, xm) / 16.0, rtol=1e-4)
+    # cvm oracle
+    xc = feed["cvm_x"]
+    c0 = np.log(xc[:, 0] + 1)
+    c1 = np.log(xc[:, 1] + 1) - c0
+    np.testing.assert_allclose(
+        cv_v, np.concatenate([c0[:, None], c1[:, None], xc[:, 2:]], 1),
+        rtol=1e-5)
+    np.testing.assert_allclose(cv2_v, xc[:, 2:], rtol=1e-6)
+
+
+def test_round4_layer_surface_wrappers_2():
+    rng = np.random.default_rng(6)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ref = layers.data("ref", [4, 3], dtype="float32")
+        idx = layers.data("idx", [2, 1], dtype="int64")
+        upd = layers.data("upd", [2, 3], dtype="float32")
+        sna = layers.scatter_nd_add(ref, idx, upd)
+        xim = layers.data("xim", [1, 1, 4, 4], dtype="float32")
+        uf = layers.unfold(xim, kernel_sizes=2, strides=2)
+        xs = layers.data("xs", [2, 2], dtype="float32")
+        yb = layers.data("yb", [3, 4], dtype="float32")
+        pcl = layers.pad_constant_like(yb, xs, pad_value=9.0)
+        cr = layers.crop_tensor(yb, shape=[2, 2], offsets=[1, 1])
+        yt = layers.data("yt", [4, 6], dtype="float32")
+        ea = layers.expand_as(xs, yt)
+        ux = layers.data("ux", [6], dtype="float32")
+        u, ui, uc = layers.unique_with_counts(ux)
+    exe = fluid.Executor()
+    feed = {"ref": np.zeros((4, 3), np.float32),
+            "idx": np.array([[1], [1]], np.int64),
+            "upd": np.ones((2, 3), np.float32),
+            "xim": np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4),
+            "xs": np.ones((2, 2), np.float32),
+            "yb": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "yt": np.zeros((4, 6), np.float32),
+            "ux": np.array([2, 3, 2, 5, 3, 3], np.float32)}
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[sna, uf, pcl, cr, ea, u, ui, uc])
+    sna_v, uf_v, pcl_v, cr_v, ea_v, u_v, ui_v, uc_v = \
+        [np.asarray(o) for o in outs]
+    expect = np.zeros((4, 3), np.float32)
+    expect[1] = 2.0
+    np.testing.assert_allclose(sna_v, expect)
+    assert uf_v.shape == (1, 4, 4)      # [N, C*kh*kw, L]
+    assert pcl_v.shape == (3, 4)
+    np.testing.assert_allclose(pcl_v[:2, :2], 1.0)
+    np.testing.assert_allclose(pcl_v[2, :], 9.0)
+    np.testing.assert_allclose(cr_v, feed["yb"][1:3, 1:3])
+    assert ea_v.shape == (4, 6)
+    np.testing.assert_allclose(ea_v, np.tile(feed["xs"], (2, 3)))
+    np.testing.assert_allclose(u_v[:3], [2, 3, 5])   # first-occurrence
+    np.testing.assert_allclose(uc_v[:3], [2, 3, 1])

@@ -404,3 +404,68 @@ def test_py_func():
         "out_dtypes": ["float32", "float32"]},
        {"Out": [("o1", x * 2 + y), ("o2", x - y)]}).check_output(
         atol=1e-6, rtol=1e-6)
+
+
+def test_py_func_layer_with_backward():
+    """layers.py_func with backward_func (reference nn.py:12799): the
+    custom backward supplies input grads through the compiled program."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    def fwd(a):
+        return a * a + 1.0
+
+    def bwd(a, out, gout):
+        return 2.0 * a * gout
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        x.stop_gradient = False
+        y = main.global_block().create_var(
+            name="pyf_out", shape=(4,), dtype="float32")
+        layers.py_func(fwd, x, y, backward_func=bwd)
+        loss = layers.reduce_sum(y)
+        gx, = fluid.gradients(loss, [x])
+    xv = np.array([1.0, 2.0, -3.0, 0.5], np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        yv, gv = exe.run(main, feed={"x": xv}, fetch_list=[y, gx])
+    np.testing.assert_allclose(np.asarray(yv), xv * xv + 1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gv), 2.0 * xv, rtol=1e-6)
+
+
+def test_py_func_partial_output_grad_alignment():
+    """Multi-output py_func where only ONE output feeds the loss: the
+    backward must receive a grad per DECLARED output (zeros for the
+    unused one), realigned via __out_grad_mask__."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    seen = {}
+
+    def fwd(a):
+        return a * 2.0, a * 3.0
+
+    def bwd(a, o1, o2, g1, g2):
+        seen["g2_zero"] = bool(np.all(np.asarray(g2) == 0.0))
+        return 2.0 * g1 + 3.0 * g2
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        o1 = main.global_block().create_var(name="pyo1", shape=(3,),
+                                            dtype="float32")
+        o2 = main.global_block().create_var(name="pyo2", shape=(3,),
+                                            dtype="float32")
+        layers.py_func(fwd, x, [o1, o2], backward_func=bwd)
+        loss = layers.reduce_sum(o1)       # o2 unused downstream
+        gx, = fluid.gradients(loss, [x])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        gv, = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                      fetch_list=[gx])
+    np.testing.assert_allclose(np.asarray(gv), 2.0, rtol=1e-6)
+    assert seen.get("g2_zero") is True
