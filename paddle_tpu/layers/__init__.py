@@ -5,12 +5,13 @@ from .tensor import *      # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .math import *        # noqa: F401,F403
 from .control_flow import (  # noqa: F401
-    While, Switch, StaticRNN, DynamicRNN, cond, create_array, array_read,
-    array_write,
+    While, Switch, StaticRNN, DynamicRNN, IfElse, Print, case,
+    switch_case, cond, create_array, array_read, array_write,
     array_length,
 )
 from .sequence_lod import (  # noqa: F401
     sequence_pool, sequence_first_step, sequence_last_step,
+    sequence_expand, sequence_scatter, lod_reset, lod_append,
     sequence_softmax, sequence_reverse, sequence_expand_as, sequence_pad,
     sequence_unpad, sequence_concat, sequence_slice, sequence_erase,
     sequence_enumerate, sequence_reshape, sequence_mask, sequence_conv,

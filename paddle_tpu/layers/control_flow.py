@@ -714,3 +714,116 @@ class DynamicRNN:
             nd = len(o.shape)
             back.append(T.transpose(o, [1, 0] + list(range(2, nd))))
         return back[0] if len(back) == 1 else back
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """fluid.layers.Print (reference control_flow.py Print /
+    print_op.cc): records a print op; the value flows through."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or ""},
+                     infer_shape=False)
+    return out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid.layers.case (reference control_flow.py:3204): first-true
+    semantics via a chain of conds."""
+    assert pred_fn_pairs, "case needs at least one (pred, fn) pair"
+
+    def chain(pairs):
+        (pred, fn) = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                # reference: with no default the last fn runs
+                # unconditionally — trace it ONCE (two cond branches
+                # would duplicate any parameters it creates)
+                return fn()
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: chain(rest))
+
+    return chain(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """fluid.layers.switch_case (reference control_flow.py:3073):
+    dispatch on an integer index."""
+    from . import math as M
+    from . import tensor as T
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    pairs = []
+    for idx, fn in items:
+        idx_c = T.fill_constant([1], "int64", int(idx))
+        pairs.append((M.equal(T.cast(branch_index, "int64"), idx_c), fn))
+    if default is None:
+        default = items[-1][1]    # reference: last branch is default
+    return case(pairs, default=default, name=name)
+
+
+class IfElse:
+    """Old-style fluid.layers.IfElse (reference control_flow.py:1851).
+    The reference gathers true/false rows into sub-scopes and merges;
+    masked-dense TPU form: both branches compute on the FULL batch and
+    outputs merge per-row by the condition mask.
+
+        ie = layers.IfElse(cond_rows)        # cond_rows: [B, 1] bool
+        with ie.true_block():
+            ie.output(f(x))
+        with ie.false_block():
+            ie.output(g(x))
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._outs = {True: [], False: []}
+        self._in_branch = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_branch = True
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_branch = False
+        try:
+            yield
+        finally:
+            self._in_branch = None
+
+    def input(self, x):
+        """The reference slices x to the branch's rows; masked-dense
+        keeps the full batch (outputs merge by mask)."""
+        assert self._in_branch is not None, \
+            "IfElse.input() must be called inside a branch block"
+        return x
+
+    def output(self, *outs):
+        assert self._in_branch is not None, \
+            "IfElse.output() must be called inside a branch block"
+        self._outs[self._in_branch].extend(outs)
+
+    def __call__(self):
+        from . import tensor as T
+        t_outs = self._outs[True]
+        f_outs = self._outs[False]
+        assert len(t_outs) == len(f_outs), \
+            "both IfElse branches must output the same number of vars"
+        cond_b = T.cast(self._cond, "bool")
+        merged = []
+        for tv, fv in zip(t_outs, f_outs):
+            merged.append(T.where(cond_b, tv, fv))
+        return merged

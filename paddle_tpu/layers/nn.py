@@ -5,6 +5,7 @@ import numpy as np
 from ..framework.core import Variable
 from ..framework import initializer as init_mod
 from .layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
@@ -990,3 +991,486 @@ def continuous_value_model(input, cvm, use_cvm=True, name=None):
                      attrs={"use_cvm": bool(use_cvm)},
                      infer_shape=False)
     return out
+
+
+# ---- round-4 batch 2: remaining fluid.layers surface ----
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _unary("brelu", x, name=name,
+                  attrs={"t_min": float(t_min), "t_max": float(t_max)})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772,
+         name=None):
+    return _unary("selu", x, name=name,
+                  attrs={"scale": float(scale), "alpha": float(alpha)})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary("stanh", x, name=name,
+                  attrs={"scale_a": float(scale_a),
+                         "scale_b": float(scale_b)})
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v, v)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, use_cudnn=True, name=None, data_format="NCDHW"):
+    helper = LayerHelper("conv3d", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    filter_size = _triple(filter_size)
+    stride = _triple(stride)
+    dilation = _triple(dilation)
+    if isinstance(padding, str):
+        paddings, algo = [0, 0, 0], padding.upper()
+    else:
+        paddings, algo = list(_triple(padding)), "EXPLICIT"
+    filter_shape = [num_filters, num_channels // groups] + \
+        list(filter_size)
+    fan = filter_size[0] * filter_size[1] * filter_size[2] * num_channels
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=input.dtype,
+        default_initializer=init_mod.NormalInitializer(
+            0.0, (2.0 / fan) ** 0.5))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(stride), "paddings": paddings,
+               "dilations": list(dilation), "groups": groups,
+               "padding_algorithm": algo, "data_format": data_format})
+    out = _append_channel_bias(helper, out)
+    return helper.append_activation(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    if filter_size is None:
+        raise ValueError("filter_size required")
+    filter_size = _triple(filter_size)
+    stride = _triple(stride)
+    dilation = _triple(dilation)
+    if isinstance(padding, str):
+        paddings, algo = [0, 0, 0], padding.upper()
+    else:
+        paddings, algo = list(_triple(padding)), "EXPLICIT"
+    filter_shape = [num_channels, num_filters // groups] + \
+        list(filter_size)
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": list(stride), "paddings": paddings,
+               "dilations": list(dilation), "groups": groups,
+               "padding_algorithm": algo})
+    out = _append_channel_bias(helper, out)
+    return helper.append_activation(out, act)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return _unary("lrn", input, name=name,
+                  attrs={"n": int(n), "k": float(k),
+                         "alpha": float(alpha), "beta": float(beta)})
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    C = input.shape[1]
+    ins = {"X": [input]}
+    if param_attr is not False:
+        scale = helper.create_parameter(
+            helper.param_attr, shape=[C], dtype=input.dtype,
+            default_initializer=init_mod.ConstantInitializer(1.0))
+        ins["Scale"] = [scale]
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            helper.bias_attr, shape=[C], dtype=input.dtype,
+            default_initializer=init_mod.ConstantInitializer(0.0))
+        ins["Bias"] = [bias]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="instance_norm", inputs=ins,
+                     outputs={"Y": [out]},
+                     attrs={"epsilon": float(epsilon)},
+                     infer_shape=False)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Streaming feature normalization (reference layers/nn.py data_norm
+    / data_norm_op.h): batch-count/sum/square-sum accumulators are
+    persistable parameters updated functionally every step."""
+    helper = LayerHelper("data_norm", param_attr=param_attr, name=name)
+    D = input.shape[-1]
+    dtype = input.dtype
+    # reference contract (layers/nn.py:3245): param_attr keys
+    # batch_size/batch_sum/batch_square hold the accumulators' INITIAL
+    # VALUES
+    pa = param_attr if isinstance(param_attr, dict) else {}
+    size = helper.create_parameter(
+        ParamAttr(), shape=[D], dtype=dtype,
+        default_initializer=init_mod.ConstantInitializer(
+            float(pa.get("batch_size", 1e4))))
+    bsum = helper.create_parameter(
+        ParamAttr(), shape=[D], dtype=dtype,
+        default_initializer=init_mod.ConstantInitializer(
+            float(pa.get("batch_sum", 0.0))))
+    sqsum = helper.create_parameter(
+        ParamAttr(), shape=[D], dtype=dtype,
+        default_initializer=init_mod.ConstantInitializer(
+            float(pa.get("batch_square", 1e4))))
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    means = helper.create_variable_for_type_inference(dtype=dtype)
+    scales = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [size], "BatchSum": [bsum],
+                "BatchSquareSum": [sqsum]},
+        outputs={"Y": [out], "Means": [means], "Scales": [scales],
+                 "BatchSizeOut": [size], "BatchSumOut": [bsum],
+                 "BatchSquareSumOut": [sqsum]},
+        attrs={"epsilon": float(epsilon)},
+        infer_shape=False)
+    return helper.append_activation(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    w_shape = list(weight.shape)
+    h = w_shape[dim]
+    wdim = 1
+    for i, s in enumerate(w_shape):
+        if i != dim:
+            wdim *= s
+    u = helper.create_parameter(
+        ParamAttr(name=None, trainable=False), shape=[h],
+        dtype=weight.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, 1.0))
+    v = helper.create_parameter(
+        ParamAttr(name=None, trainable=False), shape=[wdim],
+        dtype=weight.dtype,
+        default_initializer=init_mod.NormalInitializer(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(dtype=weight.dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out], "UOut": [u], "VOut": [v]},
+        attrs={"dim": int(dim), "power_iters": int(power_iters),
+               "eps": float(eps)},
+        infer_shape=False)
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    helper = LayerHelper("multiplex", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _unary("reverse", x, name=name, attrs={"axis": list(axis)})
+
+
+def is_empty(x, cond=None, name=None):
+    helper = LayerHelper("is_empty", name=name)
+    out = cond or helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    helper = LayerHelper("chunk_eval")
+    outs = [helper.create_variable_for_type_inference(dtype=d)
+            for d in ("float32", "float32", "float32", "int64", "int64",
+                      "int64")]
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=ins,
+        outputs={"Precision": [outs[0]], "Recall": [outs[1]],
+                 "F1-Score": [outs[2]], "NumInferChunks": [outs[3]],
+                 "NumLabelChunks": [outs[4]],
+                 "NumCorrectChunks": [outs[5]]},
+        attrs={"num_chunk_types": int(num_chunk_types),
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+        infer_shape=False)
+    return tuple(outs)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op(type="roi_align", inputs=ins,
+                     outputs={"Out": [out]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale),
+                            "sampling_ratio": int(sampling_ratio)},
+                     infer_shape=False)
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    argmax = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op(type="roi_pool", inputs=ins,
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "spatial_scale": float(spatial_scale)},
+                     infer_shape=False)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    if out_shape is None and scale is not None:
+        out_shape = [int(input.shape[2] * scale),
+                     int(input.shape[3] * scale)]
+    return image_resize(input, out_shape, resample="BILINEAR", name=name)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    if out_shape is None and scale is not None:
+        out_shape = [int(input.shape[2] * scale),
+                     int(input.shape[3] * scale)]
+    return image_resize(input, out_shape, resample="NEAREST", name=name)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    if out_shape is None and scale is not None:
+        out_shape = [int(s * scale) for s in input.shape[2:]]
+    d, h, w = [int(v) for v in out_shape]
+    helper = LayerHelper("trilinear_interp", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="trilinear_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"out_d": d, "out_h": h, "out_w": w,
+                            "align_corners": bool(align_corners),
+                            "align_mode": int(align_mode)},
+                     infer_shape=False)
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT side equals out_short_len, keeping aspect
+    (reference layers/nn.py image_resize_short)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = out_short_len / float(short)
+    out_shape = ([out_short_len, int(w * ratio)] if h < w
+                 else [int(h * ratio), out_short_len])
+    return image_resize(input, out_shape, resample=resample)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference warpctc_op.h). Masked-dense layout: Logits
+    [B, T, V] batch-major padded + input_length/label_length (the
+    reference's LoD form is time-major packed)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=ins,
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": int(blank),
+                            "norm_by_times": bool(norm_by_times)},
+                     infer_shape=False)
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    helper = LayerHelper("nce", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, D],
+                                dtype=input.dtype)
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[num_total_classes],
+            dtype=input.dtype,
+            default_initializer=init_mod.ConstantInitializer(0.0))
+        ins["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="nce", inputs=ins,
+                     outputs={"Cost": [cost]},
+                     attrs={"num_total_classes": int(num_total_classes),
+                            "num_neg_samples": int(num_neg_samples or 10),
+                            "seed": int(seed)},
+                     infer_shape=False)
+    return cost
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _unary("similarity_focus", input, name=name,
+                  attrs={"axis": int(axis),
+                         "indexes": [int(i) for i in indexes]})
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(dtype=ins.dtype)
+    loss_weight = helper.create_variable_for_type_inference(
+        dtype="float32")
+    index_map = helper.create_variable_for_type_inference(dtype="int32")
+    out_count = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="filter_by_instag",
+        inputs={"Ins": [ins], "Ins_tag": [ins_tag],
+                "Filter_tag": [filter_tag]},
+        outputs={"Out": [out], "LossWeight": [loss_weight],
+                 "IndexMap": [index_map], "OutCount": [out_count]},
+        attrs={"is_lod": bool(is_lod)},
+        infer_shape=False)
+    return out, loss_weight, index_map
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type="uniform_random", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "min": float(min),
+                            "max": float(max), "seed": int(seed),
+                            "dtype": dtype},
+                     infer_shape=False)
+    return out
+
+
+def _random_batch_size_like(op_type, input, shape, input_dim_idx,
+                            output_dim_idx, dtype, extra):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type=op_type, inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs=dict(extra, shape=list(shape),
+                                input_dim_idx=int(input_dim_idx),
+                                output_dim_idx=int(output_dim_idx),
+                                dtype=dtype),
+                     infer_shape=False)
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _random_batch_size_like(
+        "uniform_random_batch_size_like", input, shape, input_dim_idx,
+        output_dim_idx, dtype,
+        {"min": float(min), "max": float(max), "seed": int(seed)})
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return _random_batch_size_like(
+        "gaussian_random_batch_size_like", input, shape, input_dim_idx,
+        output_dim_idx, dtype,
+        {"mean": float(mean), "std": float(std), "seed": int(seed)})
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9,
+                epsilon=1e-5, param_attr=None, bias_attr=None,
+                data_layout="NCHW", name=None, **kwargs):
+    """Inplace activated batch norm (reference inplace_abn_op.cc) — on
+    TPU 'inplace' is XLA's buffer planning; this is batch_norm + act."""
+    return batch_norm(input, act=act, is_test=is_test, momentum=momentum,
+                      epsilon=epsilon, param_attr=param_attr,
+                      bias_attr=bias_attr, data_layout=data_layout,
+                      name=name)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           name=None):
+    helper = LayerHelper("deformable_psroi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    top_count = helper.create_variable_for_type_inference(dtype="int32")
+    part = part_size or (pooled_height, pooled_width)
+    helper.append_op(
+        type="deformable_psroi_pooling",
+        inputs={"Input": [input], "ROIs": [rois], "Trans": [trans]},
+        outputs={"Output": [out], "TopCount": [top_count]},
+        attrs={"no_trans": bool(no_trans),
+               "spatial_scale": float(spatial_scale),
+               "output_dim": int(input.shape[1]) // (
+                   int(group_size[0]) * int(group_size[1]))
+               if position_sensitive else int(input.shape[1]),
+               "group_size": [int(g) for g in group_size],
+               "pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "part_size": [int(p) for p in part],
+               "sample_per_part": int(sample_per_part),
+               "trans_std": float(trans_std)},
+        infer_shape=False)
+    return out
+
+
+def unique(x, dtype="int32"):
+    """TPU divergence (PARITY.md): `unique` has a data-dependent output
+    shape; use unique_with_counts (padded + count)."""
+    raise NotImplementedError(
+        "unique has a data-dependent output shape on TPU; use "
+        "layers.unique_with_counts (first-occurrence order, padded "
+        "with a Count output) instead")

@@ -164,3 +164,65 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                "contextLength": int(filter_size), "contextStride": 1})
     out = helper.append_bias_op(out, dim_start=2)
     return helper.append_activation(out, act)
+
+
+def sequence_expand(x, y=None, ref_level=-1, length=None,
+                    repeat_times=None, out_rows=None, name=None):
+    """Masked-dense sequence_expand (reference sequence_expand_op.h):
+    row i of x repeats repeat_times[i] times into a static out_rows
+    buffer (padded; OutLength carries per-row lengths)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out_len = helper.create_variable_for_type_inference(dtype="int32")
+    if repeat_times is None or out_rows is None or length is None:
+        raise ValueError(
+            "masked-dense sequence_expand needs length= ([B] int row "
+            "lengths), repeat_times= ([B] int), and out_rows= (static "
+            "output capacity); the reference derives these from LoD")
+    ins = {"X": [x], "RepeatTimes": [repeat_times]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="sequence_expand", inputs=ins,
+                     outputs={"Out": [out], "OutLength": [out_len]},
+                     attrs={"out_rows": int(out_rows)},
+                     infer_shape=False)
+    return out
+
+
+def sequence_scatter(input, index, updates, upd_length=None, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if upd_length is not None:
+        ins["UpdLength"] = [upd_length]
+    helper.append_op(type="sequence_scatter", inputs=ins,
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Masked-dense lod_reset (reference lod_reset_op.h): re-mask x by
+    new lengths (y: [B] lengths tensor, or target_lod: static list)."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out_len = helper.create_variable_for_type_inference(dtype="int32")
+    if y is None:
+        if target_lod is None:
+            raise ValueError("lod_reset needs y= or target_lod=")
+        from . import tensor as T
+        import numpy as _np
+        y = T.assign(_np.asarray(target_lod, _np.int32))
+    helper.append_op(type="lod_reset", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out], "OutLength": [out_len]},
+                     infer_shape=False)
+    return out
+
+
+def lod_append(x, level):
+    """reference lod_append (layers/nn.py): append a lod level. The
+    masked-dense design carries ONE explicit length vector, so
+    appending a level == re-masking by it (lod_reset)."""
+    return lod_reset(x, y=level if not isinstance(level, (list, tuple))
+                     else None,
+                     target_lod=level if isinstance(level, (list, tuple))
+                     else None)

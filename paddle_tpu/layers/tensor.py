@@ -364,3 +364,49 @@ def triu(x, diagonal=0, name=None):
                      outputs={"Out": [out]},
                      attrs={"diagonal": diagonal, "lower": False})
     return out
+
+
+def merge_selected_rows(x, name=None):
+    """reference merge_selected_rows_op.cc via layers surface."""
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="merge_selected_rows", inputs={"X": [x]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="get_tensor_from_selected_rows",
+                     inputs={"X": [x]}, outputs={"Out": [out]},
+                     infer_shape=False)
+    return out
+
+
+def beam_search_decode(ids, scores, beam_size=None, end_id=None,
+                       parent_idx=None, name=None):
+    """reference beam_search_decode_op.cc: walk ParentIdx back to full
+    sentences. Padded form: Ids/ParentIdx [T, B, beam], Scores
+    [B, beam]."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference(dtype=ids.dtype)
+    sent_scores = helper.create_variable_for_type_inference(
+        dtype=scores.dtype)
+    ins = {"Ids": [ids], "Scores": [scores]}
+    if parent_idx is not None:
+        ins["ParentIdx"] = [parent_idx]
+    helper.append_op(type="beam_search_decode", inputs=ins,
+                     outputs={"SentenceIds": [sent_ids],
+                              "SentenceScores": [sent_scores]},
+                     attrs={}, infer_shape=False)
+    return sent_ids, sent_scores
+
+
+def reorder_lod_tensor_by_rank(x, rank_table, name=None):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    return out

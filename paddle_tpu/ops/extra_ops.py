@@ -405,8 +405,13 @@ def warpctc(ctx, ins, attrs):
     import optax
     logits = x_of(ins, "Logits")      # [B, T, V] (batch-major padded)
     labels = x_of(ins, "Label").astype(jnp.int32)   # [B, L]
-    logit_lens = x_of(ins, "LogitsLength").reshape(-1).astype(jnp.int32)
-    label_lens = x_of(ins, "LabelLength").reshape(-1).astype(jnp.int32)
+    ll_in = x_of(ins, "LogitsLength")
+    bl_in = x_of(ins, "LabelLength")
+    B = logits.shape[0]
+    logit_lens = (ll_in.reshape(-1).astype(jnp.int32) if ll_in is not None
+                  else jnp.full((B,), logits.shape[1], jnp.int32))
+    label_lens = (bl_in.reshape(-1).astype(jnp.int32) if bl_in is not None
+                  else jnp.full((B,), labels.shape[1], jnp.int32))
     blank = int(attrs.get("blank", 0))
     T = logits.shape[1]
     L = labels.shape[1]

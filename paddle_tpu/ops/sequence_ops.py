@@ -279,8 +279,10 @@ def sequence_scatter(ctx, ins, attrs):
     x = x_of(ins)
     ids = x_of(ins, "Ids").astype(jnp.int32)
     upd = x_of(ins, "Updates")
-    ln = jnp.reshape(x_of(ins, "UpdLength"), (-1,)).astype(jnp.int32)
     B, U = ids.shape
+    ln_in = x_of(ins, "UpdLength")
+    ln = (jnp.reshape(ln_in, (-1,)).astype(jnp.int32) if ln_in is not None
+          else jnp.full((B,), U, jnp.int32))   # absent: all updates valid
     valid = jnp.arange(U, dtype=jnp.int32)[None, :] < ln[:, None]
     rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, U))
     cols = jnp.where(valid, ids, x.shape[1])               # OOB -> dropped

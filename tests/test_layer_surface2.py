@@ -1,0 +1,118 @@
+"""Round-4 batch-2 layer-surface wrappers: every remaining
+fluid.layers name builds AND runs against its op lowering (reference:
+the fluid.layers __all__ surface; see PARITY.md §2.5)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_layer_surface_batch2_builds_and_runs():
+    
+    rng = np.random.default_rng(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2, 3, 8, 8], dtype="float32")
+        outs = {}
+        outs["brelu"] = layers.brelu(x, 0.0, 1.0)
+        outs["selu"] = layers.selu(x)
+        outs["stanh"] = layers.stanh(x)
+        outs["lrn"] = layers.lrn(x)
+        outs["inorm"] = layers.instance_norm(x)
+        outs["rev"] = layers.reverse(x, [2])
+        x5 = layers.data("x5", [1, 2, 4, 6, 6], dtype="float32")
+        outs["c3"] = layers.conv3d(x5, 3, 3, padding=1)
+        outs["c3t"] = layers.conv3d_transpose(x5, 2, filter_size=2, stride=2)
+        idx = layers.data("idx", [2], dtype="int32")
+        a1 = layers.data("a1", [2, 4], dtype="float32")
+        a2 = layers.data("a2", [2, 4], dtype="float32")
+        outs["mux"] = layers.multiplex([a1, a2], idx)
+        outs["empty"] = layers.is_empty(a1)
+        rois = layers.data("rois", [2, 4], dtype="float32")
+        outs["ra"] = layers.roi_align(x, rois, 2, 2)
+        outs["rp"] = layers.roi_pool(x, rois, 2, 2)
+        outs["rb"] = layers.resize_bilinear(x, [4, 4])
+        outs["rn"] = layers.resize_nearest(x, [4, 4])
+        outs["short"] = layers.image_resize_short(x, 6)
+        outs["ur"] = layers.uniform_random([3, 2], seed=3)
+        outs["urb"] = layers.uniform_random_batch_size_like(a1, [-1, 5])
+        outs["grb"] = layers.gaussian_random_batch_size_like(a1, [-1, 5])
+        outs["sf"] = layers.similarity_focus(x, 1, [0])
+        w = layers.data("w", [6, 4], dtype="float32")
+        outs["sn"] = layers.spectral_norm(w, power_iters=2)
+        dn_x = layers.data("dnx", [4, 6], dtype="float32")
+        outs["dn"] = layers.data_norm(dn_x)
+        outs["abn"] = layers.inplace_abn(x, act="relu")
+        seq = layers.data("seq", [3, 5, 2], dtype="float32")
+        sl = layers.data("sl", [3], dtype="int32")
+        outs["lr_"] = layers.lod_reset(seq, sl)
+        xs2 = layers.data("xs2", [2, 5], dtype="float32")
+        ids2 = layers.data("ids2", [2, 3], dtype="int32")
+        upd2 = layers.data("upd2", [2, 3], dtype="float32")
+        outs["ss"] = layers.sequence_scatter(xs2, ids2, upd2)
+        rep = layers.data("rep", [2], dtype="int32")
+        sl2 = layers.data("sl2", [2], dtype="int32")
+        outs["se"] = layers.sequence_expand(xs2, length=sl2, repeat_times=rep, out_rows=6)
+        outs["pr"] = layers.Print(a1, message="dbg")
+        # case / switch_case
+        p1 = layers.greater_than(layers.reduce_sum(a1),
+                                 layers.fill_constant([1], "float32", 0.0))
+        outs["case"] = layers.case([(p1, lambda: layers.scale(a1, 2.0))],
+                                   default=lambda: layers.scale(a1, -1.0))
+        bi = layers.fill_constant([1], "int64", 1)
+        outs["swc"] = layers.switch_case(bi, {0: lambda: layers.scale(a1, 0.0),
+                                              1: lambda: layers.scale(a1, 5.0)})
+        # IfElse
+        cond_rows = layers.data("cr", [2, 1], dtype="float32")
+        ie = layers.IfElse(cond_rows)
+        with ie.true_block():
+            ie.output(layers.scale(a1, 2.0))
+        with ie.false_block():
+            ie.output(layers.scale(a1, -1.0))
+        outs["ie"] = ie()[0]
+        lbl = layers.data("lbl", [4, 1], dtype="int64")
+        feats = layers.data("feats", [4, 6], dtype="float32")
+        outs["nce"] = layers.nce(feats, lbl, 20, num_neg_samples=3)
+        logits = layers.data("lg", [2, 5, 7], dtype="float32")
+        lab = layers.data("lab", [2, 3], dtype="int32")
+        llen = layers.data("llen", [2], dtype="int64")
+        lablen = layers.data("lablen", [2], dtype="int64")
+        outs["ctc"] = layers.warpctc(logits, lab, input_length=llen,
+                                     label_length=lablen)
+        inf = layers.data("inf", [2, 6], dtype="int64")
+        labc = layers.data("labc", [2, 6], dtype="int64")
+        outs["ce0"] = layers.chunk_eval(inf, labc, "IOB", 3)[0]
+    
+    feed = {"x": rng.standard_normal((2, 3, 8, 8)).astype(np.float32),
+            "x5": rng.standard_normal((1, 2, 4, 6, 6)).astype(np.float32),
+            "idx": np.array([0, 1], np.int32),
+            "a1": rng.standard_normal((2, 4)).astype(np.float32),
+            "a2": rng.standard_normal((2, 4)).astype(np.float32),
+            "rois": np.array([[0, 0, 4, 4], [1, 1, 6, 6]], np.float32),
+            "w": rng.standard_normal((6, 4)).astype(np.float32),
+            "dnx": np.abs(rng.standard_normal((4, 6))).astype(np.float32),
+            "seq": rng.standard_normal((3, 5, 2)).astype(np.float32),
+            "sl": np.array([2, 5, 1], np.int32),
+            "xs2": rng.standard_normal((2, 5)).astype(np.float32),
+            "ids2": np.array([[0, 1, 2], [3, 4, 0]], np.int32),
+            "upd2": rng.standard_normal((2, 3)).astype(np.float32),
+            "rep": np.array([2, 1], np.int32),
+            "sl2": np.array([5, 3], np.int32),
+            "cr": np.array([[1.0], [0.0]], np.float32),
+            "lbl": np.array([[1], [2], [3], [4]], np.int64),
+            "feats": rng.standard_normal((4, 6)).astype(np.float32),
+            "lg": rng.standard_normal((2, 5, 7)).astype(np.float32),
+            "lab": np.array([[1, 2, 0], [3, 0, 0]], np.int32),
+            "llen": np.array([5, 4], np.int64),
+            "lablen": np.array([2, 1], np.int64),
+            "inf": np.zeros((2, 6), np.int64),
+            "labc": np.zeros((2, 6), np.int64)}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        names = list(outs)
+        vals = exe.run(main, feed=feed, fetch_list=[outs[n] for n in names])
+    for n, v in zip(names, vals):
+        arr = np.asarray(v)
+        assert np.all(np.isfinite(arr.astype(np.float64))) or arr.dtype == bool, n
+    print("ALL", len(names), "wrappers run ok")
