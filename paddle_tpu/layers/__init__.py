@@ -17,6 +17,14 @@ from .sequence_lod import (  # noqa: F401
     sequence_enumerate, sequence_reshape, sequence_mask, sequence_conv,
 )
 from .pipeline import Pipeline  # noqa: F401
+from .rnn_api import (  # noqa: F401
+    RNNCell, LSTMCell, GRUCell, rnn, Decoder, BasicDecoder,
+    BeamSearchDecoder, dynamic_decode, DecodeHelper,
+    TrainingHelper, GreedyEmbeddingHelper, SampleEmbeddingHelper)
+from . import rnn_api  # noqa: F401
+from .distributions import (  # noqa: F401
+    Uniform, Normal, Categorical, MultivariateNormalDiag)
+from . import distributions  # noqa: F401
 from . import nn, tensor, loss, math, control_flow, sequence_lod  # noqa: F401
 from . import pipeline  # noqa: F401
 from .collective import _allreduce, _allgather, _broadcast, shard  # noqa: F401

@@ -1,0 +1,190 @@
+"""fluid.layers.distributions (reference
+python/paddle/fluid/layers/distributions.py — Uniform :115, Normal :260,
+Categorical :424, MultivariateNormalDiag :530). Each method BUILDS graph
+ops (static mode) exactly like the reference; math composed from the
+existing layer surface."""
+import math
+
+import numpy as np
+
+from ..framework.core import Variable
+from . import math as M
+from . import tensor as T
+from .nn import gaussian_random_batch_size_like, uniform_random, \
+    uniform_random_batch_size_like
+from .layer_helper import LayerHelper
+
+
+def _L():
+    # activation-style fns (log/exp) live on the package
+    # namespace; import lazily to avoid a circular import
+    from .. import layers
+    return layers
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(v, ref=None, dtype="float32"):
+    if isinstance(v, Variable):
+        return v
+    arr = np.asarray(v, np.float32)
+    return T.assign(arr.reshape(arr.shape or (1,)))
+
+
+class Distribution:
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distributions.py:115)."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = uniform_random(list(shape) + list(self.low.shape),
+                           min=0.0, max=1.0, seed=seed)
+        return M.elementwise_add(
+            self.low, M.elementwise_mul(
+                u, M.elementwise_sub(self.high, self.low)))
+
+    def log_prob(self, value):
+        span = M.elementwise_sub(self.high, self.low)
+        lb = T.cast(M.less_than(self.low, value), "float32")
+        ub = T.cast(M.less_than(value, self.high), "float32")
+        return M.elementwise_sub(
+            _L().log(M.elementwise_mul(lb, ub)), _L().log(span))
+
+    def entropy(self):
+        return _L().log(M.elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distributions.py:260)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        from .nn import gaussian_random
+        z = gaussian_random(list(shape) + list(self.loc.shape),
+                            mean=0.0, std=1.0, seed=seed)
+        return M.elementwise_add(
+            self.loc, M.elementwise_mul(z, self.scale))
+
+    def entropy(self):
+        c = 0.5 + 0.5 * math.log(2.0 * math.pi)
+        return M.elementwise_add(
+            T.fill_constant(self.scale.shape or [1], "float32", c),
+            _L().log(self.scale))
+
+    def log_prob(self, value):
+        var = M.elementwise_mul(self.scale, self.scale)
+        d = M.elementwise_sub(value, self.loc)
+        quad = M.elementwise_div(M.elementwise_mul(d, d),
+                                 M.scale(var, 2.0))
+        return M.elementwise_sub(
+            M.scale(quad, -1.0),
+            M.elementwise_add(
+                _L().log(self.scale),
+                T.fill_constant(self.scale.shape or [1], "float32",
+                                0.5 * math.log(2.0 * math.pi))))
+
+    def kl_divergence(self, other):
+        """KL(self || other), both Normal (reference :404)."""
+        var_ratio = M.elementwise_div(self.scale, other.scale)
+        var_ratio = M.elementwise_mul(var_ratio, var_ratio)
+        d = M.elementwise_div(M.elementwise_sub(self.loc, other.loc),
+                              other.scale)
+        t1 = M.elementwise_mul(d, d)
+        return M.scale(
+            M.elementwise_sub(
+                M.elementwise_add(var_ratio, t1),
+                M.elementwise_add(
+                    T.ones_like(var_ratio), _L().log(var_ratio))),
+            0.5)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference :424)."""
+
+    def __init__(self, logits):
+        self.logits = logits
+
+    def _log_norm(self):
+        # log softmax pieces via existing ops
+        e = _L().exp(M.elementwise_sub(
+            self.logits, M.reduce_max(self.logits, dim=[-1],
+                                      keep_dim=True)))
+        z = M.reduce_sum(e, dim=[-1], keep_dim=True)
+        return e, z
+
+    def entropy(self):
+        e, z = self._log_norm()
+        p = M.elementwise_div(e, z)
+        logp = _L().log(p)
+        return M.scale(M.reduce_sum(M.elementwise_mul(p, logp),
+                                    dim=[-1]), -1.0)
+
+    def kl_divergence(self, other):
+        e, z = self._log_norm()
+        oe, oz = other._log_norm()
+        p = M.elementwise_div(e, z)
+        return M.reduce_sum(
+            M.elementwise_mul(
+                p, M.elementwise_sub(
+                    _L().log(M.elementwise_div(e, z)),
+                    _L().log(M.elementwise_div(oe, oz)))),
+            dim=[-1])
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) (reference :530)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)        # [D]
+        self.scale = _to_var(scale)    # [D, D] diagonal matrix
+
+    def _diag(self):
+        # extract the diagonal via elementwise mask (eye)
+        D = int(self.scale.shape[0])
+        eye = T.assign(np.eye(D, dtype=np.float32))
+        return M.reduce_sum(M.elementwise_mul(self.scale, eye), dim=[-1])
+
+    def entropy(self):
+        D = int(self.scale.shape[0])
+        c = 0.5 * D * (1.0 + math.log(2.0 * math.pi))
+        logdet = M.reduce_sum(_L().log(self._diag()))
+        return M.elementwise_add(
+            T.fill_constant([1], "float32", c),
+            M.scale(logdet, 1.0))
+
+    def kl_divergence(self, other):
+        """KL between diagonal Gaussians (reference :645)."""
+        s1 = self._diag()
+        s2 = other._diag()
+        var1 = M.elementwise_mul(s1, s1)
+        var2 = M.elementwise_mul(s2, s2)
+        d = M.elementwise_sub(self.loc, other.loc)
+        quad = M.elementwise_div(M.elementwise_mul(d, d), var2)
+        ratio = M.elementwise_div(var1, var2)
+        D = int(self.scale.shape[0])
+        return M.scale(
+            M.elementwise_sub(
+                M.reduce_sum(M.elementwise_add(ratio, quad)),
+                M.elementwise_add(
+                    T.fill_constant([1], "float32", float(D)),
+                    M.reduce_sum(_L().log(ratio)))),
+            0.5)

@@ -1474,3 +1474,188 @@ def unique(x, dtype="int32"):
         "unique has a data-dependent output shape on TPU; use "
         "layers.unique_with_counts (first-occurrence order, padded "
         "with a Count output) instead")
+
+
+# ---- layer_function_generator parity (reference
+# python/paddle/fluid/layers/layer_function_generator.py) ----
+
+def templatedoc(op_type=None):
+    """Doc-templating decorator (reference layer_function_generator.py
+    templatedoc): docs are authored directly here, so it is identity."""
+    def deco(fn):
+        return fn
+    return deco
+
+
+def autodoc(comment=""):
+    def deco(fn):
+        fn.__doc__ = comment + (fn.__doc__ or "")
+        return fn
+    return deco
+
+
+def deprecated(since=None, instead=None, reason=""):
+    """Mark a layer deprecated (reference annotations): warns on call."""
+    def deco(fn):
+        import functools
+        import warnings
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated"
+                + (f" since {since}" if since else "")
+                + (f"; use {instead}" if instead else "")
+                + (f" ({reason})" if reason else ""),
+                DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def generate_layer_fn(op_type):
+    """Build a layer fn for a registered op type (reference
+    layer_function_generator.py generate_layer_fn): inputs by slot
+    kwargs, single Out."""
+    def fn(*args, **kwargs):
+        helper = LayerHelper(op_type, name=kwargs.pop("name", None))
+        ins = {}
+        first = None
+        for slot in list(kwargs):
+            v = kwargs[slot]
+            if isinstance(v, Variable):
+                ins[slot] = [kwargs.pop(slot)]
+                first = first or v
+            elif isinstance(v, (list, tuple)) and v and \
+                    all(isinstance(e, Variable) for e in v):
+                ins[slot] = list(kwargs.pop(slot))
+                first = first or v[0]
+        if len(args) == 1:
+            ins["X"] = [args[0]]
+        elif len(args) == 2:
+            ins["X"], ins["Y"] = [args[0]], [args[1]]
+        elif len(args) > 2:
+            ins["X"] = list(args)       # variadic ops (sum/concat style)
+        if args:
+            first = first or args[0]
+        out = helper.create_variable_for_type_inference(
+            dtype=first.dtype if first is not None else "float32")
+        helper.append_op(type=op_type, inputs=ins,
+                         outputs={"Out": [out]}, attrs=dict(kwargs),
+                         infer_shape=False)
+        return out
+    fn.__name__ = op_type
+    return fn
+
+
+def generate_activation_fn(op_type):
+    def fn(x, name=None):
+        return _unary(op_type, x, name=name)
+    fn.__name__ = op_type
+    return fn
+
+
+# ---- reader plumbing (by-design divergence, PARITY.md: the host
+# DataLoader owns async feeding; these names guide users there) ----
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    raise NotImplementedError(
+        "py_reader's feed-queue ops are replaced by the host DataLoader "
+        "on TPU (by-design, PARITY.md): use "
+        "fluid.io.PyReader(feed_list=..., capacity=...) or "
+        "fluid.io.DataLoader.from_generator(...) — same capability, "
+        "host-side double buffering")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    from ..dataio.reader import PyReader as _PyReader
+    return _PyReader(feed_list=feed_list, capacity=capacity,
+                     use_double_buffer=use_double_buffer)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Identity: the DataLoader double-buffers host-side (by design)."""
+    return reader
+
+
+def read_file(reader):
+    raise NotImplementedError(
+        "read_file consumes py_reader's queue vars; on TPU feed through "
+        "the DataLoader's batch dicts instead (PARITY.md reader-ops row)")
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference layers/io.py load / load_op.cc: fill `out` from a saved
+    .npy file at EXECUTION time (host callback). When `out` carries no
+    static shape (create_tensor), the shape/dtype come from the file
+    HEADER at build time (mmap — no data read)."""
+    import numpy as _np
+    helper = LayerHelper("load")
+    shape, dtype = out.shape, out.dtype
+    if shape is None or any(s is None or s < 0 for s in shape):
+        probe = _np.load(file_path, mmap_mode="r", allow_pickle=False)
+        shape = probe.shape
+        dtype = str(probe.dtype)
+        out.shape = tuple(shape)
+        out.dtype = dtype
+    if load_as_fp16:
+        dtype = "float16"
+        out.dtype = dtype
+
+    def _read():
+        arr = _np.load(file_path, allow_pickle=False)
+        return arr.astype(_np.float16) if load_as_fp16 else arr
+
+    from ..ops.extra_ops import register_py_func
+    helper.append_op(
+        type="py_func", inputs={"X": []}, outputs={"Out": [out]},
+        attrs={"func_id": register_py_func(_read),
+               "out_shapes": [list(shape)],
+               "out_dtypes": [str(dtype)]},
+        infer_shape=False)
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference layers/nn.py sampled_softmax_with_cross_entropy /
+    sample_logits_op.cc (uniform sampler). Unsupported parity args
+    raise rather than silently change semantics."""
+    if use_customized_samples or customized_samples is not None:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: customized samplers "
+            "are not supported on TPU (uniform sampler only); pass "
+            "use_customized_samples=False")
+    if num_true != 1:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: num_true must be 1")
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op(
+        type="sampled_softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Loss": [loss]},
+        attrs={"num_samples": int(num_samples), "seed": int(seed),
+               "remove_accidental_hits": bool(remove_accidental_hits)},
+        infer_shape=False)
+    return loss
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """reference tensor_array_to_tensor (layers/tensor.py): concat or
+    stack a tensor array's entries."""
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_index = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(type="tensor_array_to_tensor", inputs={},
+                     outputs={"Out": [out], "OutIndex": [out_index]},
+                     attrs={"array_name": input.name, "axis": int(axis),
+                            "use_stack": bool(use_stack)},
+                     infer_shape=False)
+    return out, out_index

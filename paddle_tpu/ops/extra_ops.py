@@ -891,3 +891,34 @@ def cvm_grad(ctx, ins, attrs):
     body = g[:, 2:] if use_cvm else g
     return {"X@GRAD": [jnp.concatenate([lead.astype(x.dtype), body],
                                        axis=1)]}
+
+
+@register_op("sampled_softmax_with_cross_entropy", infer_shape=False,
+             needs_rng=True)
+def sampled_softmax_with_cross_entropy(ctx, ins, attrs):
+    """Sampled softmax CE (reference sample_logits_op.cc behind
+    layers/nn.py sampled_softmax_with_cross_entropy): draw num_samples
+    uniform negatives per row, build logits over [true, samples] with
+    the -log(q) correction, and return full-softmax-CE over that
+    subset. Differentiable w.r.t. Logits via the gather."""
+    logits = x_of(ins, "Logits")                  # [B, V]
+    label = x_of(ins, "Label").reshape(-1).astype(jnp.int32)
+    S = int(attrs.get("num_samples", 5))
+    V = logits.shape[-1]
+    B = logits.shape[0]
+    key = ctx.op_key(attrs)
+    neg = jax.random.randint(key, (B, S), 0, V)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)   # [B, 1+S]
+    picked = jnp.take_along_axis(logits, ids, axis=1)
+    # uniform proposal q = 1/V for negatives; true class not corrected
+    # (reference: remove_accidental_hits + log-q subtraction)
+    corr = jnp.concatenate(
+        [jnp.zeros((B, 1), logits.dtype),
+         jnp.full((B, S), np.log(S / V), logits.dtype)], axis=1)
+    adj = picked - corr
+    if bool(attrs.get("remove_accidental_hits", True)):
+        # a sampled negative equal to the label is masked out
+        hit = ids[:, 1:] == label[:, None]
+        adj = adj.at[:, 1:].add(jnp.where(hit, -1e30, 0.0))
+    lse = jax.nn.logsumexp(adj, axis=1)
+    return {"Loss": (lse - adj[:, 0]).reshape(-1, 1)}

@@ -1,0 +1,42 @@
+"""fluid.layers.distributions (reference distributions.py test pattern:
+    test_distributions.py — analytic entropies/KLs as oracles)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_distributions_analytic_oracles():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        n1 = layers.Normal(0.0, 1.0)
+        n2 = layers.Normal(1.0, 2.0)
+        s = n1.sample([1000], seed=3)
+        ent = n1.entropy()
+        lp = n1.log_prob(layers.fill_constant([1], "float32", 0.0))
+        kl = n1.kl_divergence(n2)
+        u = layers.Uniform(0.0, 2.0)
+        us = u.sample([1000], seed=4)
+        uent = u.entropy()
+        logits = layers.fill_constant([1, 4], "float32", 0.0)
+        cat = layers.Categorical(logits)
+        cent = cat.entropy()
+        mvn1 = layers.MultivariateNormalDiag(np.zeros(2, np.float32),
+                                             np.eye(2, dtype=np.float32))
+        mvn2 = layers.MultivariateNormalDiag(np.ones(2, np.float32),
+                                             2 * np.eye(2, dtype=np.float32))
+        mkl = mvn1.kl_divergence(mvn2)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sv, ev, lv, kv, usv, uev, cev, mkv = exe.run(
+            main, feed={}, fetch_list=[s, ent, lp, kl, us, uent, cent, mkl])
+    assert abs(float(np.asarray(sv).mean())) < 0.15
+    assert abs(float(np.asarray(ev)[0]) - 1.4189) < 1e-3   # 0.5+0.5*log(2pi)
+    assert abs(float(np.asarray(lv)[0]) + 0.9189) < 1e-3   # -log sqrt(2pi)
+    # KL(N(0,1)||N(1,2)) = log(2) - 0.5 + (1 + 1)/(2*4) = 0.4431
+    assert abs(float(np.asarray(kv)[0]) - 0.4431) < 1e-3, kv
+    assert 0.9 < float(np.asarray(usv).mean()) < 1.1
+    assert abs(float(np.asarray(uev)[0]) - np.log(2.0)) < 1e-5
+    assert abs(float(np.asarray(cev)[0]) - np.log(4.0)) < 1e-4
+    print("distributions ok; mvn kl:", float(np.asarray(mkv)[0]))
