@@ -124,30 +124,35 @@ class Categorical(Distribution):
         self.logits = logits
 
     def _log_norm(self):
-        # log softmax pieces via existing ops
-        e = _L().exp(M.elementwise_sub(
+        # log softmax pieces via existing ops; keep the shifted logits so
+        # log-probabilities are formed as shifted - log(z) (finite even
+        # where exp underflows p to 0, matching the reference's
+        # prob*(logits - log z) formulation at :521-527)
+        shifted = M.elementwise_sub(
             self.logits, M.reduce_max(self.logits, dim=[-1],
-                                      keep_dim=True)))
+                                      keep_dim=True))
+        e = _L().exp(shifted)
         z = M.reduce_sum(e, dim=[-1], keep_dim=True)
-        return e, z
+        return shifted, e, z
 
     def entropy(self):
-        e, z = self._log_norm()
+        # keep_dim=True matches the reference's [..., 1] output shape
+        # (reference :524)
+        shifted, e, z = self._log_norm()
         p = M.elementwise_div(e, z)
-        logp = _L().log(p)
+        logp = M.elementwise_sub(shifted, _L().log(z))
         return M.scale(M.reduce_sum(M.elementwise_mul(p, logp),
-                                    dim=[-1]), -1.0)
+                                    dim=[-1], keep_dim=True), -1.0)
 
     def kl_divergence(self, other):
-        e, z = self._log_norm()
-        oe, oz = other._log_norm()
+        shifted, e, z = self._log_norm()
+        oshifted, oe, oz = other._log_norm()
         p = M.elementwise_div(e, z)
+        logp = M.elementwise_sub(shifted, _L().log(z))
+        ologp = M.elementwise_sub(oshifted, _L().log(oz))
         return M.reduce_sum(
-            M.elementwise_mul(
-                p, M.elementwise_sub(
-                    _L().log(M.elementwise_div(e, z)),
-                    _L().log(M.elementwise_div(oe, oz)))),
-            dim=[-1])
+            M.elementwise_mul(p, M.elementwise_sub(logp, ologp)),
+            dim=[-1], keep_dim=True)
 
 
 class MultivariateNormalDiag(Distribution):
@@ -164,22 +169,25 @@ class MultivariateNormalDiag(Distribution):
         return M.reduce_sum(M.elementwise_mul(self.scale, eye), dim=[-1])
 
     def entropy(self):
+        """entropy = 0.5*(k*(1+log 2pi) + log det(scale)); scale is the
+        diagonal COVARIANCE matrix (reference :635 and its documented
+        examples: diag [0.4, 0.5] -> 2.033158)."""
         D = int(self.scale.shape[0])
         c = 0.5 * D * (1.0 + math.log(2.0 * math.pi))
         logdet = M.reduce_sum(_L().log(self._diag()))
         return M.elementwise_add(
             T.fill_constant([1], "float32", c),
-            M.scale(logdet, 1.0))
+            M.scale(logdet, 0.5))
 
     def kl_divergence(self, other):
-        """KL between diagonal Gaussians (reference :645)."""
+        """KL between diagonal Gaussians (reference :645); the diagonal
+        entries of scale are used as variances directly (the reference's
+        _inv(other.scale) * self.scale trace term)."""
         s1 = self._diag()
         s2 = other._diag()
-        var1 = M.elementwise_mul(s1, s1)
-        var2 = M.elementwise_mul(s2, s2)
         d = M.elementwise_sub(self.loc, other.loc)
-        quad = M.elementwise_div(M.elementwise_mul(d, d), var2)
-        ratio = M.elementwise_div(var1, var2)
+        quad = M.elementwise_div(M.elementwise_mul(d, d), s2)
+        ratio = M.elementwise_div(s1, s2)
         D = int(self.scale.shape[0])
         return M.scale(
             M.elementwise_sub(

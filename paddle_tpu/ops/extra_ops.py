@@ -815,7 +815,14 @@ def py_func_grad(ctx, ins, attrs):
     n_out = len(fattrs["out_shapes"])
     mask = (attrs.get("__out_grad_mask__") or {}).get("Out")
     if mask is None:
-        mask = [True] * len(gs) + [False] * (n_out - len(gs))
+        # without the mask, partial grads cannot be aligned to outputs —
+        # guessing "first len(gs) outputs" would hand bwd grads for the
+        # wrong slots when an earlier output is unused downstream
+        if len(gs) != n_out:
+            raise ValueError(
+                "py_func backward: %d of %d output grads present but no "
+                "__out_grad_mask__ to align them" % (len(gs), n_out))
+        mask = [True] * n_out
 
     def host(*arrays):
         n = len(xs)

@@ -297,7 +297,10 @@ def attention_lstm(ctx, ins, attrs):
     [forget, input, output, candidate].
 
     Padded form: X [B, T, M] (+ Length [B]), C0 [B, D], H0 [B, D].
-    LSTMWeight [(M+D), 4D], LSTMBias [1, 4D], AttentionWeight [(M+D), 1].
+    LSTMWeight [(D+M), 4D] with the HIDDEN rows first (rows [0:D] are the
+    recurrent weights, rows [D:D+M] the x weights — attention_lstm_op.cc
+    reads the x GEMM from lstm_w_data + D*4D), LSTMBias [1, 4D],
+    AttentionWeight [(M+D), 1] (x rows first).
     Outputs Hidden/Cell [B, T, D] (zeros past each row's length)."""
     x = x_of(ins)
     c0 = x_of(ins, "C0")
@@ -319,7 +322,10 @@ def attention_lstm(ctx, ins, attrs):
     atted_x = x @ aw_x                                       # [B, T]
     if ab:
         atted_x = atted_x + jnp.reshape(ab[0], ())
-    wx, wh = lw[:M], lw[M:]                                  # [M,4D],[D,4D]
+    # reference attention_lstm_op.cc:406-410 reads the x GEMM from
+    # lstm_w_data + D*D4 and the hidden GEMM from lstm_w_data — i.e. the
+    # first D rows are the hidden weights, the next M rows the x weights.
+    wh, wx = lw[:D], lw[D:]                                  # [D,4D],[M,4D]
 
     def step(carry, t):
         h_prev, c_prev = carry

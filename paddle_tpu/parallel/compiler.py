@@ -79,6 +79,32 @@ class CompiledProgram:
                 "mean-loss over the globally sharded batch already yields "
                 "CoeffNumDevice semantics under GSPMD; rescale the loss in "
                 "the program instead", stacklevel=2)
+        # knobs whose job XLA/GSPMD owns: accepted for parity, but a user
+        # who CHANGES one from its default gets a signal, not silence
+        _xla_owned = {
+            "reduce_strategy": (
+                BuildStrategy.ReduceStrategy.AllReduce,
+                "GSPMD always emits all-reduce collectives; Reduce-mode "
+                "parameter placement does not exist on a TPU mesh"),
+            "fuse_all_reduce_ops": (
+                True, "XLA fuses/schedules collectives itself"),
+            "fuse_all_optimizer_ops": (
+                False, "the whole step is one XLA computation; optimizer "
+                "ops are already fused by the compiler"),
+            "fuse_elewise_add_act_ops": (
+                False, "XLA elementwise fusion subsumes this pass"),
+            "enable_inplace": (
+                True, "buffer reuse is the XLA allocator's decision; "
+                "donated inputs are already updated in place"),
+            "memory_optimize": (
+                True, "XLA owns buffer lifetimes/rematerialization"),
+        }
+        for knob, (default, why) in _xla_owned.items():
+            if getattr(bs, knob, default) != default:
+                import warnings
+                warnings.warn(
+                    "BuildStrategy.%s=%r has no effect: %s"
+                    % (knob, getattr(bs, knob), why), stacklevel=2)
         if bs.sync_batch_norm:
             # the reference's sync_batch_norm_pass
             # (framework/ir/sync_batch_norm_pass.cc) rewrites batch_norm ->

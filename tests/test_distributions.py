@@ -21,16 +21,24 @@ def test_distributions_analytic_oracles():
         logits = layers.fill_constant([1, 4], "float32", 0.0)
         cat = layers.Categorical(logits)
         cent = cat.entropy()
-        mvn1 = layers.MultivariateNormalDiag(np.zeros(2, np.float32),
-                                             np.eye(2, dtype=np.float32))
-        mvn2 = layers.MultivariateNormalDiag(np.ones(2, np.float32),
-                                             2 * np.eye(2, dtype=np.float32))
+        # the reference's own documented example values
+        # (distributions.py:589-595): entropy(a)=2.033158,
+        # entropy(b)=1.7777451, kl(a||b)=0.06542051
+        mvn1 = layers.MultivariateNormalDiag(
+            np.array([0.3, 0.5], np.float32),
+            np.diag([0.4, 0.5]).astype(np.float32))
+        mvn2 = layers.MultivariateNormalDiag(
+            np.array([0.2, 0.4], np.float32),
+            np.diag([0.3, 0.4]).astype(np.float32))
+        ment1 = mvn1.entropy()
+        ment2 = mvn2.entropy()
         mkl = mvn1.kl_divergence(mvn2)
     exe = fluid.Executor()
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
-        sv, ev, lv, kv, usv, uev, cev, mkv = exe.run(
-            main, feed={}, fetch_list=[s, ent, lp, kl, us, uent, cent, mkl])
+        sv, ev, lv, kv, usv, uev, cev, me1, me2, mkv = exe.run(
+            main, feed={},
+            fetch_list=[s, ent, lp, kl, us, uent, cent, ment1, ment2, mkl])
     assert abs(float(np.asarray(sv).mean())) < 0.15
     assert abs(float(np.asarray(ev)[0]) - 1.4189) < 1e-3   # 0.5+0.5*log(2pi)
     assert abs(float(np.asarray(lv)[0]) + 0.9189) < 1e-3   # -log sqrt(2pi)
@@ -38,5 +46,8 @@ def test_distributions_analytic_oracles():
     assert abs(float(np.asarray(kv)[0]) - 0.4431) < 1e-3, kv
     assert 0.9 < float(np.asarray(usv).mean()) < 1.1
     assert abs(float(np.asarray(uev)[0]) - np.log(2.0)) < 1e-5
-    assert abs(float(np.asarray(cev)[0]) - np.log(4.0)) < 1e-4
-    print("distributions ok; mvn kl:", float(np.asarray(mkv)[0]))
+    assert np.asarray(cev).shape == (1, 1)   # keep_dim parity (ref :524)
+    assert abs(float(np.asarray(cev).ravel()[0]) - np.log(4.0)) < 1e-4
+    assert abs(float(np.asarray(me1).ravel()[0]) - 2.033158) < 1e-4
+    assert abs(float(np.asarray(me2).ravel()[0]) - 1.7777451) < 1e-4
+    assert abs(float(np.asarray(mkv).ravel()[0]) - 0.06542051) < 1e-4

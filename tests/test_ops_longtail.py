@@ -305,7 +305,9 @@ def test_attention_lstm():
             e = np.exp(fc - fc.max())
             probs = e / e.sum()
             lstm_x = probs @ x[b, :L]                     # [M]
-            gates = lstm_x @ lw[:M] + h_prev @ lw[M:] + lb[0]
+            # hidden rows first (attention_lstm_op.cc:406 reads the x GEMM
+            # weights from lstm_w_data + D*D4)
+            gates = lstm_x @ lw[D:] + h_prev @ lw[:D] + lb[0]
             f = _sigmoid(gates[:D])
             i = _sigmoid(gates[D:2 * D])
             o = _sigmoid(gates[2 * D:3 * D])
