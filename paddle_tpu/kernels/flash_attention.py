@@ -2,26 +2,44 @@
 
 The reference's attention story is hand-fused CUDA
 (operators/fused/multihead_matmul_op.cu — QKV matmul + softmax fused for
-V100); the TPU-native equivalent is a blockwise online-softmax kernel that
-never materializes the [Sq, Sk] score matrix in HBM: scores for one
-(q-block, k-block) tile live in VMEM, folded into running (max, normalizer,
-accumulator) state — O(S) memory instead of O(S^2), and the score/softmax
-work stays fused with both matmuls on the MXU/VPU.
+V100); the TPU-native equivalent is a blockwise softmax kernel that never
+materializes the [Sq, Sk] score matrix in HBM.
 
-Kernels grid over (batch, head, q-block, k-block) so Pallas's automatic
-pipelining double-buffers the K/V block DMAs against compute; the online
-state (m, l, acc) lives in VMEM scratch, carried across the innermost
-k-block grid steps and finalized on the last one.
+The kernel was VPU-bound in its first form (r4: ~25µs/tile of softmax VPU
+passes vs ~5µs of MXU work — neither roofline binding). This version cuts
+the VPU work per [bq, Sk] tile to two passes (max + a single fused
+exp chain) via:
+
+- base-2 softmax: `scale * log2(e)` is folded into the q tile (a [bq, D]
+  multiply instead of a [bq, Sk] one) and `exp2` replaces `exp`; the saved
+  log-sum-exp is base-2 as well.
+- the additive key bias joins INSIDE the exp chain; the row max is taken
+  over unbiased scores. A too-large max only underflows masked entries —
+  never overflows — so the extra [bq, Sk] bias pass before the max is
+  unnecessary.
+- the softmax normalizer rides the MXU for free: D=64 values occupy half
+  of a 128-lane tile, so V is staged into a [bk, 128] VMEM scratch with
+  ones in lane D, and `p @ v_aug` yields both `p @ v` and the row sums in
+  one matmul — the cross-lane sum reduction pass disappears.
+- `p` is cast to the value dtype inside the same fused chain (one store).
+
+Two forward kernels share those tricks:
+- single-block (Sk fits one VMEM tile, the common case up to ~4k): no
+  online-softmax state at all — one max, one exp chain, one matmul.
+- online (long Sk): running (m, acc_aug) state where acc_aug's lane D IS
+  the normalizer, so the rescale correction covers acc and l in one
+  [bq, 128] multiply.
+
+Backward: when Sk fits one tile, a single combined kernel grids over
+q-blocks, recomputes p once, and produces dq (streamed) plus dk/dv
+(accumulated in VMEM scratch) — five matmuls, two VPU chains. For long
+Sk the classic two-kernel (dq; dk/dv) decomposition remains, updated to
+the same base-2/fused-chain scheme.
 
 Layout: q [B, H, Sq, D], k/v [B, H, Sk, D], optional additive key-position
 bias [B, 1, 1, Sk] (the BERT padding-mask layout), optional causal masking.
 The bias is treated as a constant mask (zero cotangent) — masks are data,
 not parameters, in every caller in this framework.
-
-Backward follows the standard two-kernel flash decomposition: a dq kernel
-gridded over q-blocks (innermost: k-blocks) and a dk/dv kernel gridded over
-k-blocks (innermost: q-blocks), both recomputing p = exp(s - lse) from the
-saved log-sum-exp rather than storing probabilities.
 
 impl selection: "pallas" (TPU compiled), "interpret" (Pallas interpreter —
 exercises the real kernel on CPU, used by tests), "xla" (composite fallback,
@@ -35,7 +53,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-_LANES = 128   # m/l scratch is stored lane-broadcast to keep the VPU happy
+_LANES = 128
+_LOG2E = 1.4426950408889634   # log2(e); folded into q so exp2 == exp
+
+# VMEM working-set budget for auto block sizing (the chip has ~16 MB;
+# leave headroom for Pallas double-buffering of the streamed operands)
+_VMEM_BUDGET = 10 * 1024 * 1024
+_SINGLE_BLOCK_MAX_SK = 4096
 
 
 def _auto_impl():
@@ -43,18 +67,23 @@ def _auto_impl():
     return "pallas" if backend in ("tpu", "axon") else "xla"
 
 
-def _block_sizes(sq, sk, bq, bk):
-    # large q/k tiles amortize the per-tile online-softmax state updates
-    # and keep the MXU fed: 1024x1024 measured 1.6x faster than 256x512
-    # at S=2048/D=64 on v5e (r4); smaller tiles only when S doesn't
-    # divide.
-    def auto(s):
-        for cand in (1024, 512, 256, 128):
-            if s % cand == 0:
-                return cand
-        return s
-    bq = bq or auto(sq)
-    bk = bk or auto(sk)
+def _auto_bq(sq, sk, per_elem_bytes):
+    """Largest power-of-two q block that divides Sq and keeps the
+    [bq, Sk]-class intermediates inside the VMEM budget."""
+    for cand in (1024, 512, 256, 128):
+        if sq % cand == 0 and cand * sk * per_elem_bytes <= _VMEM_BUDGET:
+            return cand
+    return sq if sq <= 128 else None
+
+
+def _block_sizes(sq, sk, bq, bk, per_elem_bytes=6):
+    """Resolve (bq, bk). bk == sk selects the single-block kernels."""
+    if bk is None:
+        bk = sk if sk <= _SINGLE_BLOCK_MAX_SK else (
+            1024 if sk % 1024 == 0 else 512 if sk % 512 == 0
+            else 256 if sk % 256 == 0 else 128 if sk % 128 == 0 else sk)
+    if bq is None:
+        bq = _auto_bq(sq, bk, per_elem_bytes) or sq
     if sq % bq or sk % bk:
         raise ValueError(
             f"flash_attention: Sq={sq}/Sk={sk} must divide block sizes "
@@ -76,97 +105,253 @@ def _block_live(causal, qi, ki, bq, bk):
     return ki * bk <= qi * bq + bq - 1
 
 
+def _bias2(bias_ref):
+    """Key bias as a base-2 row [1, bk] (constant-mask contract)."""
+    return (bias_ref[0, 0, 0, :].astype(jnp.float32) * _LOG2E)[None, :]
+
+
+# The augmented-V normalizer trick only pays when D < 128 (the ones
+# column rides the tile padding the MXU computes anyway); for D >= 128
+# heads the kernels fall back to an explicit cross-lane sum and use the
+# V block directly — still O(S) memory, one extra VPU reduce pass.
+
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-                m_sc, l_sc, acc_sc, *, scale, bq, bk, nk, causal):
+def _fwd_single_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                       v_sc, *, scale, bq, causal, nq):
+    """Whole Sk in one tile: no online state. Grid (B, H, nq)."""
+    b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    d = q_ref.shape[-1]
+    aug = v_sc is not None
+
+    if aug:
+        @pl.when((b == 0) & (h == 0) & (i == 0))
+        def _once():
+            # zeros in lanes d+1.. and ones in lane d never change
+            v_sc[:] = jnp.zeros_like(v_sc)
+            v_sc[:, d:d + 1] = jnp.ones((v_sc.shape[0], 1), v_sc.dtype)
+
+        @pl.when(i == 0)
+        def _stage_v():
+            # the V block is constant across i: staged once per (b, h)
+            v_sc[:, :d] = v_ref[0, 0].astype(v_sc.dtype)
+
+    q = (q_ref[0, 0].astype(jnp.float32) * (scale * _LOG2E)).astype(
+        q_ref.dtype)                                        # [bq, D] tiny
+    s2 = jax.lax.dot_general(
+        q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [bq, Sk]
+    if causal:
+        s2 = _causal_mask(s2, i, 0, bq, k_ref.shape[2])
+    m2 = jnp.max(s2, axis=-1, keepdims=True)                # [bq, 1]
+    arg = s2 - m2
+    if bias_ref is not None:
+        arg = arg + _bias2(bias_ref)
+    if aug:
+        p = jnp.exp2(arg).astype(v_sc.dtype)                # fused chain
+        acc = jax.lax.dot_general(
+            p, v_sc[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, 128]
+        l = acc[:, d:d + 1]
+    else:
+        p = jnp.exp2(arg).astype(v_ref.dtype)
+        acc = jax.lax.dot_general(
+            p, v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, D]
+        l = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out_ref[0, 0] = (acc[:, :d] / l).astype(out_ref.dtype)
+    # lse rows live on lanes ([B, H, 1, Sq] avoids the 128x lane padding
+    # a trailing-1 dim would get); base-2: lse2 = m2 + log2(l)
+    lse_ref[0, 0] = (m2 + jnp.log2(l)).reshape(1, -1)
+
+
+def _fwd_online_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                       m_sc, acc_sc, l_sc, v_sc, *, scale, bq, bk, nk,
+                       causal):
+    """Running (m, acc_aug) state; acc_aug lane D is the normalizer, so
+    the rescale correction covers acc and l in one [bq, 128] multiply.
+    Grid (B, H, nq, nk)."""
+    b, h = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
+    d = q_ref.shape[-1]
+    aug = v_sc is not None
+
+    if aug:
+        @pl.when((b == 0) & (h == 0) & (qi == 0) & (ki == 0))
+        def _once():
+            v_sc[:] = jnp.zeros_like(v_sc)
+            v_sc[:, d:d + 1] = jnp.ones((v_sc.shape[0], 1), v_sc.dtype)
 
     @pl.when(ki == 0)
     def _init():
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
-        l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
+        if not aug:
+            l_sc[:] = jnp.zeros_like(l_sc)
 
     @pl.when(_block_live(causal, qi, ki, bq, bk))
     def _fold():
-        q = q_ref[0, 0]                                    # [bq, D]
-        k_blk = k_ref[0, 0]                                # [bk, D]
-        v_blk = v_ref[0, 0]
-        s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bq, bk]
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
+        q = (q_ref[0, 0].astype(jnp.float32) * (scale * _LOG2E)).astype(
+            q_ref.dtype)
+        s2 = jax.lax.dot_general(
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [bq, bk]
         if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
-        m_prev = m_sc[:, :1]                               # [bq, 1]
-        l_prev = l_sc[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
+            s2 = _causal_mask(s2, qi, ki, bq, bk)
+        m_prev = m_sc[:, :1]                                # [bq, 1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
-        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        corr = jnp.exp2(m_prev - m_new)
+        arg = s2 - m_new
+        if bias_ref is not None:
+            arg = arg + _bias2(bias_ref)
+        m_sc[:, :1] = m_new
+        if aug:
+            v_sc[:, :d] = v_ref[0, 0].astype(v_sc.dtype)
+            p = jnp.exp2(arg).astype(v_sc.dtype)
+            acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+                p, v_sc[:], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            p = jnp.exp2(arg).astype(v_ref.dtype)
+            acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+                p, v_ref[0, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            l_sc[:, :1] = l_sc[:, :1] * corr + jnp.sum(
+                p.astype(jnp.float32), axis=-1, keepdims=True)
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_sc[:, :1]
-        out_ref[0, 0] = (acc_sc[:] / l).astype(out_ref.dtype)
-        # lse rows live on lanes ([B, H, 1, Sq] avoids the 128x lane
-        # padding a trailing-1 dim would get); (bq,1)->(1,bq) reshape
-        lse_ref[0, 0] = (m_sc[:, :1] + jnp.log(l)).reshape(1, -1)
+        l = acc_sc[:, d:d + 1] if aug else l_sc[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_sc[:, :d] / l).astype(out_ref.dtype)
+        lse_ref[0, 0] = (m_sc[:, :1] + jnp.log2(l)).reshape(1, -1)
 
 
 def _fwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = _block_sizes(Sq, Sk, bq, bk)
+    aug = D < _LANES
+    bq, bk = _block_sizes(Sq, Sk, bq, bk, per_elem_bytes=6)
     nq, nk = Sq // bq, Sk // bk
+    single = nk == 1
 
-    body = functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk,
-                             nk=nk, causal=causal)
     in_specs = [
-        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *j: (b, h, j[0], 0)
+                     if j else (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, i, *j: (b, h, j[0], 0)
+                     if j else (b, h, 0, 0)),
     ]
     args = [q, k, v]
     if bias is not None:
         in_specs.append(
-            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, j: (b, 0, 0, j)))
+            pl.BlockSpec((1, 1, 1, bk), lambda b, h, i, *j:
+                         (b, 0, 0, j[0]) if j else (b, 0, 0, 0)))
         args.append(bias)
-        kern = body
+
+    if single:
+        body = functools.partial(_fwd_single_kernel, scale=scale, bq=bq,
+                                 causal=causal, nq=nq)
+        grid = (B, H, nq)
+        scratch = [pltpu.VMEM((bk, _LANES), v.dtype)] if aug else []
+        n_sc = len(scratch)
+
+        def kern(q_ref, k_ref, v_ref, *rest):
+            bias_ref, t = (rest[0], rest[1:]) if bias is not None \
+                else (None, rest)
+            out_ref, lse_ref = t[0], t[1]
+            v_sc = t[2] if n_sc else None
+            body(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref, v_sc)
     else:
-        def kern(q_ref, k_ref, v_ref, out_ref, lse_ref, m, l, acc):
-            body(q_ref, k_ref, v_ref, None, out_ref, lse_ref, m, l, acc)
+        body = functools.partial(_fwd_online_kernel, scale=scale, bq=bq,
+                                 bk=bk, nk=nk, causal=causal)
+        grid = (B, H, nq, nk)
+        scratch = [
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES if aug else D), jnp.float32),
+        ]
+        if aug:
+            scratch.append(pltpu.VMEM((bk, _LANES), v.dtype))
+        else:
+            scratch.append(pltpu.VMEM((bq, _LANES), jnp.float32))
+
+        def kern(q_ref, k_ref, v_ref, *rest):
+            bias_ref, t = (rest[0], rest[1:]) if bias is not None \
+                else (None, rest)
+            out_ref, lse_ref, m_sc, acc_sc, third = t
+            l_sc, v_sc = (None, third) if aug else (third, None)
+            body(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+                 m_sc, acc_sc, l_sc, v_sc)
     out, lse = pl.pallas_call(
         kern,
-        grid=(B, H, nq, nk),
+        grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, *j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, *j: (b, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((B, H, 1, Sq), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-            pltpu.VMEM((bq, _LANES), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(*args)
     return out, lse
 
 
 # --------------------------------------------------------------- backward
+
+def _bwd_single_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                       delta_ref, dq_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+                       *, scale, bq, causal, nq):
+    """Combined dq/dk/dv when Sk fits one tile: p recomputed once, dq
+    streamed per q-block, dk/dv accumulated in VMEM. Grid (B, H, nq)."""
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_raw = q_ref[0, 0]                                     # [bq, D]
+    k_blk = k_ref[0, 0]                                     # [Sk, D]
+    v_blk = v_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0].reshape(-1, 1)                      # [bq, 1]
+    delta = delta_ref[0, 0].reshape(-1, 1)
+    q2 = (q_raw.astype(jnp.float32) * (scale * _LOG2E)).astype(q_raw.dtype)
+    s2 = jax.lax.dot_general(
+        q2, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [bq, Sk]
+    if causal:
+        s2 = _causal_mask(s2, i, 0, bq, k_ref.shape[2])
+    arg = s2 - lse
+    if bias_ref is not None:
+        arg = arg + _bias2(bias_ref)
+    p = jnp.exp2(arg)                                       # [bq, Sk] f32
+    pb = p.astype(do.dtype)
+    dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+        pb, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [Sk, D]
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [bq, Sk]
+    ds = (p * (dp - delta) * scale).astype(k_blk.dtype)     # fused chain
+    dq_ref[0, 0] = jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+        ds, q_raw, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [Sk, D]
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_sc[:].astype(dv_ref.dtype)
+
 
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
                dq_ref, dq_sc, *, scale, bq, bk, nk, causal):
@@ -178,26 +363,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_block_live(causal, qi, ki, bq, bk))
     def _fold():
-        q = q_ref[0, 0]                                    # [bq, D]
+        q_raw = q_ref[0, 0]                                # [bq, D]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0].reshape(-1, 1)                 # [1,bq]->[bq,1]
         delta = delta_ref[0, 0].reshape(-1, 1)
         k_blk = k_ref[0, 0]                                # [bk, D]
         v_blk = v_ref[0, 0]
-        s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+        q2 = (q_raw.astype(jnp.float32) * (scale * _LOG2E)).astype(
+            q_raw.dtype)
+        s2 = jax.lax.dot_general(
+            q2, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
         if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
-        p = jnp.exp(s - lse)
+            s2 = _causal_mask(s2, qi, ki, bq, bk)
+        arg = s2 - lse
+        if bias_ref is not None:
+            arg = arg + _bias2(bias_ref)
+        p = jnp.exp2(arg)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
-            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -218,27 +406,30 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
     def _fold():
         k_blk = k_ref[0, 0]                                # [bk, D]
         v_blk = v_ref[0, 0]
-        q = q_ref[0, 0]                                    # [bq, D]
+        q_raw = q_ref[0, 0]                                # [bq, D]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0].reshape(-1, 1)                 # [1,bq]->[bq,1]
         delta = delta_ref[0, 0].reshape(-1, 1)
-        s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
+        q2 = (q_raw.astype(jnp.float32) * (scale * _LOG2E)).astype(
+            q_raw.dtype)
+        s2 = jax.lax.dot_general(
+            q2, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, 0, :].astype(jnp.float32)[None, :]
         if causal:
-            s = _causal_mask(s, qi, ki, bq, bk)
-        p = jnp.exp(s - lse)
+            s2 = _causal_mask(s2, qi, ki, bq, bk)
+        arg = s2 - lse
+        if bias_ref is not None:
+            arg = arg + _bias2(bias_ref)
+        p = jnp.exp2(arg)
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q_raw.dtype)
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds, q_raw, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
@@ -251,10 +442,44 @@ def _bwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret,
                 out, lse, do):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    bq, bk = _block_sizes(Sq, Sk, bq, bk)
+    # the backward holds ~2x the [bq, Sk]-class intermediates of the
+    # forward (s, p, dp, ds): budget with 12 bytes/elem
+    bq, bk = _block_sizes(Sq, Sk, bq, bk, per_elem_bytes=12)
     nq, nk = Sq // bq, Sk // bk
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, :, None, :]                # [B, H, 1, Sq]
+
+    if nk == 1:
+        qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0))
+        kspec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i: (b, h, 0, 0))
+        rspec = pl.BlockSpec((1, 1, 1, bq), lambda b, h, i: (b, h, 0, i))
+        body = functools.partial(_bwd_single_kernel, scale=scale, bq=bq,
+                                 causal=causal, nq=nq)
+        specs = [qspec, kspec, kspec]
+        args = [q, k, v]
+        if bias is not None:
+            specs.append(
+                pl.BlockSpec((1, 1, 1, bk), lambda b, h, i: (b, 0, 0, 0)))
+            args.append(bias)
+            kern = body
+        else:
+            def kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dk_ref, dv_ref, dk_sc, dv_sc):
+                body(q_ref, k_ref, v_ref, None, do_ref, lse_ref,
+                     delta_ref, dq_ref, dk_ref, dv_ref, dk_sc, dv_sc)
+        dq, dk, dv = pl.pallas_call(
+            kern,
+            grid=(B, H, nq),
+            in_specs=specs + [qspec, rspec, rspec],
+            out_specs=[qspec, kspec, kspec],
+            out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                       jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+            interpret=interpret,
+        )(*args, do, lse, delta)
+        return dq, dk, dv
 
     qspec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kspec_i = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))

@@ -40,17 +40,21 @@ def _inputs(with_bias, seed=0):
     return q, k, v, bias
 
 
+# block_k=8 exercises the online-softmax kernel (4 k-blocks); block_k=None
+# (-> Sk in one tile) exercises the single-block kernel
 @pytest.mark.parametrize("with_bias", [False, True])
 @pytest.mark.parametrize("causal", [False, True])
-def test_kernel_matches_naive(with_bias, causal):
+@pytest.mark.parametrize("block_k", [8, None])
+def test_kernel_matches_naive(with_bias, causal, block_k):
     q, k, v, bias = _inputs(with_bias)
     out = flash_attention(q, k, v, bias, causal=causal, impl="interpret",
-                          block_q=8, block_k=8)
+                          block_q=8, block_k=block_k)
     ref = _naive(q, k, v, bias, causal=causal)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
 
 
-def test_kernel_grads_match_xla_composite():
+@pytest.mark.parametrize("block_k", [16, None])
+def test_kernel_grads_match_xla_composite(block_k):
     import jax
 
     q, k, v, bias = _inputs(True)
@@ -58,7 +62,7 @@ def test_kernel_grads_match_xla_composite():
     def loss(impl):
         def f(q, k, v):
             o = flash_attention(q, k, v, bias, impl=impl, block_q=8,
-                                block_k=16)
+                                block_k=block_k)
             return (o.astype("float32") ** 2).sum()
         return f
 
@@ -69,7 +73,8 @@ def test_kernel_grads_match_xla_composite():
                                    rtol=2e-4, atol=2e-4, err_msg=name)
 
 
-def test_causal_grads_match_xla_composite():
+@pytest.mark.parametrize("block_k", [8, None])
+def test_causal_grads_match_xla_composite(block_k):
     import jax
 
     q, k, v, _ = _inputs(False)
@@ -77,7 +82,7 @@ def test_causal_grads_match_xla_composite():
     def loss(impl):
         def f(q, k, v):
             o = flash_attention(q, k, v, causal=True, impl=impl,
-                                block_q=8, block_k=8)
+                                block_q=8, block_k=block_k)
             return (o.astype("float32") ** 2).sum()
         return f
 
@@ -86,6 +91,30 @@ def test_causal_grads_match_xla_composite():
     for name, a, b in zip("qkv", g_ref, g_ker):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("block_k", [16, None])
+def test_wide_head_dim_128(block_k):
+    """D >= 128 heads: the augmented-V normalizer cannot ride the tile
+    padding, so the kernels use an explicit row-sum — still O(S) memory."""
+    rng = np.random.default_rng(3)
+    Dw = 128
+    q, k, v = (rng.standard_normal((2, 2, S, Dw)).astype(np.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, impl="interpret", block_q=8,
+                          block_k=block_k)
+    ref = _naive(q, k, v, scale=Dw ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_single_block_path():
+    """bf16 operands through the single-block kernel (the bench dtype)."""
+    q, k, v, bias = _inputs(True)
+    qb, kb, vb = (x.astype("bfloat16") for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, bias, impl="interpret")
+    ref = _naive(q, k, v, bias)
+    np.testing.assert_allclose(
+        np.asarray(out).astype(np.float32), ref, rtol=0.05, atol=0.05)
 
 
 def test_uneven_blocks_rejected():
