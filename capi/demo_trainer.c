@@ -1,0 +1,64 @@
+/* End-to-end training from C — no Python in the loop.
+ *
+ * The paddle_tpu analog of the reference's
+ * /root/reference/paddle/fluid/train/demo/demo_trainer.cc: load the
+ * (main, startup) program pair a Python build script saved with
+ * paddle_tpu.capi_train.save_train_model, then feed synthetic linear
+ * data and step the whole compiled train program (fwd + bwd + SGD),
+ * printing the first and last loss.
+ *
+ * Usage: demo_trainer <model_dir> <steps>
+ * Exit code 0 iff the final loss improved on the first by 10x.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <steps>\n", argv[0]);
+    return 2;
+  }
+  const int steps = atoi(argv[2]);
+
+  PD_Trainer* t = PD_NewTrainer(argv[1]);
+  if (t == NULL) {
+    fprintf(stderr, "PD_NewTrainer: %s\n", PD_GetLastError());
+    return 1;
+  }
+
+  /* y = x @ [2, -3.4] + 4.2 + noise-free target: 64 samples, 2 feats */
+  enum { N = 64, F = 2 };
+  static float x[N * F], y[N];
+  unsigned rng = 12345;
+  for (int i = 0; i < N; ++i) {
+    for (int f = 0; f < F; ++f) {
+      rng = rng * 1103515245u + 12345u;
+      x[i * F + f] = ((rng >> 16) % 2000) / 1000.0f - 1.0f;
+    }
+    y[i] = 2.0f * x[i * F] - 3.4f * x[i * F + 1] + 4.2f;
+  }
+  const int xshape[2] = {N, F};
+  const int yshape[2] = {N, 1};
+
+  float first = 0.0f, loss = 0.0f;
+  for (int s = 0; s < steps; ++s) {
+    if (PD_TrainerFeedFloat(t, "x", x, xshape, 2) != 0 ||
+        PD_TrainerFeedFloat(t, "y", y, yshape, 2) != 0) {
+      fprintf(stderr, "feed: %s\n", PD_GetLastError());
+      PD_DeleteTrainer(t);
+      return 1;
+    }
+    if (PD_TrainerRunStep(t, "loss", &loss, 1) < 0) {
+      fprintf(stderr, "step: %s\n", PD_GetLastError());
+      PD_DeleteTrainer(t);
+      return 1;
+    }
+    if (s == 0) first = loss;
+  }
+  printf("first_loss=%g last_loss=%g\n", first, loss);
+
+  PD_DeleteTrainer(t);
+  return loss < first / 10.0f ? 0 : 3;
+}

@@ -251,3 +251,142 @@ void PD_DeletePredictor(PD_Predictor* p) {
 }
 
 }  // extern "C"
+
+/* ---- C-native training (see paddle_c_api.h): fronts
+ * paddle_tpu.capi_train.CTrainerSession the same way PD_Predictor fronts
+ * the AnalysisPredictor. ---- */
+
+struct PD_Trainer {
+  PyObject* session;  // paddle_tpu.capi_train.CTrainerSession
+};
+
+namespace {
+
+/* Build an owned numpy array from a raw buffer (same contract as
+ * set_input: the caller's buffer is copied, not aliased). */
+PyObject* np_array_copy(const void* data, size_t itemsize,
+                        const char* np_dtype, const int* shape, int ndim) {
+  long long numel = 1;
+  for (int d = 0; d < ndim; ++d) numel *= shape[d];
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np == nullptr) return nullptr;
+  PyObject* mem = PyMemoryView_FromMemory(
+      const_cast<char*>(static_cast<const char*>(data)),
+      numel * static_cast<long long>(itemsize), PyBUF_READ);
+  PyObject* flat = mem != nullptr
+      ? PyObject_CallMethod(np, "frombuffer", "Os", mem, np_dtype)
+      : nullptr;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int d = 0; d < ndim; ++d) {
+    PyTuple_SetItem(shp, d, PyLong_FromLong(shape[d]));
+  }
+  PyObject* view_arr = flat != nullptr
+      ? PyObject_CallMethod(flat, "reshape", "O", shp) : nullptr;
+  PyObject* arr = view_arr != nullptr
+      ? PyObject_CallMethod(view_arr, "copy", nullptr) : nullptr;
+  Py_XDECREF(view_arr);
+  Py_XDECREF(shp);
+  Py_XDECREF(flat);
+  Py_XDECREF(mem);
+  Py_DECREF(np);
+  return arr;
+}
+
+int trainer_feed(PD_Trainer* t, const char* name, const void* data,
+                 size_t itemsize, const char* np_dtype, const int* shape,
+                 int ndim) {
+  GIL gil;
+  PyObject* arr = np_array_copy(data, itemsize, np_dtype, shape, ndim);
+  if (arr == nullptr) { set_error_from_python(); return 1; }
+  PyObject* ok = PyObject_CallMethod(t->session, "feed", "sO", name, arr);
+  Py_DECREF(arr);
+  if (ok == nullptr) { set_error_from_python(); return 1; }
+  Py_DECREF(ok);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+PD_Trainer* PD_NewTrainer(const char* model_dir) {
+  if (PD_Init() != 0) return nullptr;
+  GIL gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_train");
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* sess =
+      PyObject_CallMethod(mod, "CTrainerSession", "s", model_dir);
+  Py_DECREF(mod);
+  if (sess == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PD_Trainer* t = new PD_Trainer();
+  t->session = sess;
+  return t;
+}
+
+int PD_TrainerFeedFloat(PD_Trainer* t, const char* name, const float* data,
+                        const int* shape, int ndim) {
+  return trainer_feed(t, name, data, sizeof(float), "float32", shape, ndim);
+}
+
+int PD_TrainerFeedInt64(PD_Trainer* t, const char* name,
+                        const long long* data, const int* shape, int ndim) {
+  return trainer_feed(t, name, data, sizeof(long long), "int64", shape,
+                      ndim);
+}
+
+long long PD_TrainerRunStep(PD_Trainer* t, const char* fetch_name,
+                            float* buf, long long buf_len) {
+  GIL gil;
+  PyObject* arr =
+      PyObject_CallMethod(t->session, "run_step", "s", fetch_name);
+  if (arr == nullptr) { set_error_from_python(); return -1; }
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+    set_error_from_python();
+    Py_DECREF(arr);
+    return -1;
+  }
+  long long numel = static_cast<long long>(view.len / sizeof(float));
+  long long ncopy = numel < buf_len ? numel : buf_len;
+  if (ncopy > 0 && buf != nullptr) {
+    std::memcpy(buf, view.buf, ncopy * sizeof(float));
+  }
+  PyBuffer_Release(&view);
+  Py_DECREF(arr);
+  return numel;
+}
+
+int PD_TrainerSaveParams(PD_Trainer* t, const char* model_path) {
+  GIL gil;
+  PyObject* ok =
+      PyObject_CallMethod(t->session, "save_params", "s", model_path);
+  if (ok == nullptr) { set_error_from_python(); return 1; }
+  Py_DECREF(ok);
+  return 0;
+}
+
+int PD_TrainerLoadParams(PD_Trainer* t, const char* model_path) {
+  GIL gil;
+  PyObject* ok =
+      PyObject_CallMethod(t->session, "load_params", "s", model_path);
+  if (ok == nullptr) { set_error_from_python(); return 1; }
+  Py_DECREF(ok);
+  return 0;
+}
+
+void PD_DeleteTrainer(PD_Trainer* t) {
+  if (t == nullptr) return;
+  {
+    GIL gil;
+    Py_XDECREF(t->session);
+  }
+  delete t;
+}
+
+}  // extern "C"

@@ -53,6 +53,35 @@ long long PD_GetOutputFloat(PD_Predictor* pred, int i, float* buf,
 
 void PD_DeletePredictor(PD_Predictor* pred);
 
+/* ---- C-native training (reference train/demo/demo_trainer.cc +
+ * framework/c/c_api.cc): load a (main, startup) program pair saved by
+ * paddle_tpu.capi_train.save_train_model, run startup, then drive the
+ * train loop entirely from C. ---- */
+typedef struct PD_Trainer PD_Trainer;
+
+/* Load the saved train model dir and run its startup program.
+ * Returns NULL on failure (PD_GetLastError). */
+PD_Trainer* PD_NewTrainer(const char* model_dir);
+
+/* Stage a feed tensor by variable name (copied; reusable buffer). */
+int PD_TrainerFeedFloat(PD_Trainer* t, const char* name, const float* data,
+                        const int* shape, int ndim);
+int PD_TrainerFeedInt64(PD_Trainer* t, const char* name,
+                        const long long* data, const int* shape, int ndim);
+
+/* Run ONE training step (forward + backward + optimizer — the whole
+ * compiled step) over the staged feeds and fetch `fetch_name` as
+ * float32. Returns the element count (copies min(count, buf_len) into
+ * buf), or -1 on failure. */
+long long PD_TrainerRunStep(PD_Trainer* t, const char* fetch_name,
+                            float* buf, long long buf_len);
+
+/* Persist / restore the trained parameters (io.save/io.load layout). */
+int PD_TrainerSaveParams(PD_Trainer* t, const char* model_path);
+int PD_TrainerLoadParams(PD_Trainer* t, const char* model_path);
+
+void PD_DeleteTrainer(PD_Trainer* t);
+
 /* Last error message (thread-unsafe, valid until the next API call). */
 const char* PD_GetLastError(void);
 

@@ -45,6 +45,9 @@ from . import profiler  # noqa: F401
 from . import flags  # noqa: F401
 from . import debugger  # noqa: F401
 from . import install_check  # noqa: F401
+from . import capi_train  # noqa: F401  (C-native training entry backing)
+from .framework.registry import (  # noqa: F401  (custom-op extension point)
+    load_op_library, register_grad_lower, register_op)
 from . import nn  # noqa: F401  (2.0-preview namespace)
 from . import tensor  # noqa: F401  (2.0-preview namespace)
 from .flags import get_flags, set_flags  # noqa: F401

@@ -1,0 +1,101 @@
+"""Python backing for the C-native training entry (capi/).
+
+Capability parity with the reference's C++ train path: demo_trainer.cc
+loads a ProgramDesc pair saved from Python, runs the startup program
+once, then drives the executor loop feeding tensors and fetching the
+loss with no Python anywhere in the loop
+(/root/reference/paddle/fluid/train/demo/demo_trainer.cc:63 — LoadProgram
++ Executor::Run; the C wrapper is framework/c/c_api.cc). Here the same
+contract holds at the C ABI: `capi/paddle_c_api.h` PD_Trainer* fronts
+this session object; the compute is the XLA-compiled step either way.
+
+Save side (from a Python build script, the reference's
+`save_checkpoint`/program-serialization step):
+
+    fluid.capi_train.save_train_model(dirname, main, startup)
+
+writes `main_program.json` + `startup_program.json` (Program.to_dict
+IR). The C program then owns the whole training run.
+"""
+import json
+import os
+
+import numpy as np
+
+
+def save_train_model(dirname, main_program=None, startup_program=None,
+                     fetch_vars=None):
+    """Serialize a trainable (main, startup) program pair for the C
+    trainer. The main program must already contain the optimizer ops
+    (minimize() called) — the C side only feeds and steps.
+
+    `fetch_vars` maps stable C-side aliases to Variables (or names), so
+    C code can fetch "loss" regardless of the auto-generated var name."""
+    from .framework.core import default_main_program, \
+        default_startup_program
+    main = main_program or default_main_program()
+    startup = startup_program or default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    for stem, prog in (("main_program", main),
+                       ("startup_program", startup)):
+        with open(os.path.join(dirname, stem + ".json"), "w") as f:
+            json.dump(prog.to_dict(), f)
+    aliases = {alias: getattr(v, "name", v)
+               for alias, v in (fetch_vars or {}).items()}
+    with open(os.path.join(dirname, "fetch_map.json"), "w") as f:
+        json.dump(aliases, f)
+
+
+class CTrainerSession:
+    """One training session driven from C: owns program, scope, executor.
+
+    The C shim calls: feed(name, array) for each input, then
+    run_step(fetch_name) -> float32 ndarray. Matches the reference
+    demo_trainer loop (feed_targets/fetch_targets + Executor::Run)."""
+
+    def __init__(self, model_dir):
+        import paddle_tpu as fluid
+        from .framework.core import Program
+
+        def _load(stem):
+            path = os.path.join(model_dir, stem + ".json")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"{path} not found — save the train model with "
+                    f"paddle_tpu.capi_train.save_train_model(dirname)")
+            with open(path) as f:
+                return Program.from_dict(json.load(f))
+
+        self.main = _load("main_program")
+        self.startup = _load("startup_program")
+        self._fetch_map = {}
+        fm = os.path.join(model_dir, "fetch_map.json")
+        if os.path.exists(fm):
+            with open(fm) as f:
+                self._fetch_map = json.load(f)
+        self.scope = fluid.Scope()
+        self.exe = fluid.Executor()
+        self._guard = fluid
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+        self._feeds = {}
+
+    def feed(self, name, arr):
+        self._feeds[name] = np.asarray(arr)
+
+    def run_step(self, fetch_name):
+        name = self._fetch_map.get(fetch_name, fetch_name)
+        with self._guard.scope_guard(self.scope):
+            out, = self.exe.run(self.main, feed=dict(self._feeds),
+                                fetch_list=[name])
+        return np.ascontiguousarray(np.asarray(out), dtype=np.float32)
+
+    def save_params(self, model_path):
+        from . import io
+        with self._guard.scope_guard(self.scope):
+            io.save(self.main, model_path, scope=self.scope)
+
+    def load_params(self, model_path):
+        from . import io
+        with self._guard.scope_guard(self.scope):
+            io.load(self.main, model_path, scope=self.scope)

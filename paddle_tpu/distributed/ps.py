@@ -12,41 +12,19 @@ serialization (operators/distributed/sendrecvop_utils.cc), GEO communicator
 TPU-native split: the device program stays ONE compiled XLA module; send/recv
 cross the host boundary as ordered `jax.experimental.io_callback`s into the
 PSClient below (ops/distributed_ops.py). The server is a plain threaded TCP
-service over length-prefixed pickles holding numpy tables — parameters never
+service speaking the typed frame protocol in `wire.py` (the analog of the
+reference's send_recv.proto VariableMessage — data only, never executable),
+optionally HMAC-authenticated via PADDLE_PS_AUTH_KEY. Parameters never
 live on a device at the server, exactly like the reference's CPU pservers —
 and it executes the transpiled optimize sub-blocks EAGERLY through the same
 op registry the compiled trainer uses (no second optimizer implementation).
 """
-import pickle
 import socket
-import struct
 import threading
 
 import numpy as np
 
-
-# --------------------------------------------------------------------------
-# wire protocol: 8-byte big-endian length + pickle
-# --------------------------------------------------------------------------
-
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack(">Q", len(payload)) + payload)
-
-
-def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
-
-
-def _recv_msg(sock):
-    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+from .wire import WireError, default_key, recv_frame, send_frame
 
 
 # --------------------------------------------------------------------------
@@ -111,14 +89,23 @@ class ParameterServer:
     """
 
     def __init__(self, endpoint, trainers=1, sync_mode=True,
-                 heartbeat_timeout=None):
+                 heartbeat_timeout=None, auth_key=None,
+                 allow_insecure=False):
         """`heartbeat_timeout` (seconds) arms the HeartBeatMonitor
         (reference operators/distributed/heart_beat_monitor.h:38): every
         trainer message stamps a per-trainer timestamp; a monitor thread
         EVICTS trainers silent longer than the timeout from the sync
-        barrier so one dead worker cannot hang the round forever."""
+        barrier so one dead worker cannot hang the round forever.
+
+        `auth_key` (or env PADDLE_PS_AUTH_KEY) arms HMAC frame
+        authentication; without a key the server only binds loopback
+        unless `allow_insecure=True` is explicit."""
         host, port = endpoint.rsplit(":", 1)
         self.host, self.port = host, int(port)
+        if isinstance(auth_key, str):
+            auth_key = auth_key.encode()
+        self._key = auth_key or default_key()
+        self._allow_insecure = bool(allow_insecure)
         self.trainers = int(trainers)
         self.sync_mode = bool(sync_mode)
         self.heartbeat_timeout = heartbeat_timeout
@@ -272,6 +259,14 @@ class ParameterServer:
 
     # -- serving -----------------------------------------------------------
     def serve(self, ready_event=None, block=True):
+        loopback = (self.host.startswith("127.")
+                    or self.host in ("localhost", "::1"))
+        if not loopback and self._key is None and not self._allow_insecure:
+            raise PermissionError(
+                f"refusing to bind pserver on non-loopback "
+                f"{self.host}:{self.port} without authentication — set "
+                f"PADDLE_PS_AUTH_KEY (both ends) or pass "
+                f"allow_insecure=True")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((self.host, self.port))
@@ -404,15 +399,20 @@ class ParameterServer:
         try:
             while not self._stop.is_set():
                 try:
-                    msg = _recv_msg(conn)
+                    msg = recv_frame(conn, self._key)
                 except (ConnectionError, EOFError):
+                    return
+                except WireError:
+                    # unauthenticated or malformed frame: drop the
+                    # connection without answering (nothing to negotiate
+                    # with a peer that cannot speak the protocol)
                     return
                 try:
                     reply = self._handle(msg)
                 except Exception:           # surface handler errors to the
                     import traceback        # client instead of dying silently
                     reply = ("err", traceback.format_exc())
-                _send_msg(conn, reply)
+                send_frame(conn, reply, self._key)
         finally:
             try:
                 conn.close()
@@ -579,9 +579,12 @@ class PSClient:
     _instances = {}
     _lock = threading.Lock()
 
-    def __init__(self):
+    def __init__(self, auth_key=None):
         self._conns = {}
         self._conn_lock = threading.Lock()
+        if isinstance(auth_key, str):
+            auth_key = auth_key.encode()
+        self._key = auth_key or default_key()
 
     @classmethod
     def instance(cls, key="default"):
@@ -603,8 +606,8 @@ class PSClient:
     def _call(self, endpoint, msg):
         sock = self._conn(endpoint)
         with self._conn_lock:
-            _send_msg(sock, msg)
-            reply = _recv_msg(sock)
+            send_frame(sock, msg, self._key)
+            reply = recv_frame(sock, self._key)
         if reply[0] == "err":
             raise RuntimeError(f"pserver {endpoint}: {reply[1]}")
         return reply[1] if reply[0] == "val" else None

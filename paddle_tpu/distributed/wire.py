@@ -1,0 +1,242 @@
+"""Typed, non-executable PS wire protocol.
+
+The reference serializes PS traffic as a typed proto over gRPC
+(/root/reference/paddle/fluid/operators/distributed/send_recv.proto.in:
+VariableMessage carries a type enum, dims and a raw tensor buffer;
+sendrecvop_utils.cc packs it). The first version of this runtime shipped
+pickled tuples instead — unpickling network bytes is arbitrary code
+execution for anyone who can reach the port. This module replaces it
+with the same idea as the reference's proto: a closed, typed value
+universe decoded by a tiny recursive reader that can only ever produce
+data.
+
+Value universe (everything the PS messages use): None, bool, int, float,
+str, numeric numpy arrays, and flat tuples/lists/dicts of those. Object/
+string-dtype arrays are rejected on both ends.
+
+Frame layout:
+    magic  b"PT01"                       (4 bytes)
+    mac    HMAC-SHA256(key, payload)     (32 bytes; zeros when no key)
+    len    big-endian u64                (8 bytes)
+    payload                              (typed encoding below)
+
+Authentication: set ``PADDLE_PS_AUTH_KEY`` (or pass ``auth_key=``) on
+BOTH ends. A keyed server rejects frames whose MAC does not verify
+(constant-time compare) — see tests/test_ps_wire.py. Without a key the
+MAC field is zeros; the server refuses to bind non-loopback interfaces
+unless the key is set or ``allow_insecure=True`` is explicit.
+"""
+import hmac
+import hashlib
+import os
+import struct
+
+import numpy as np
+
+MAGIC = b"PT01"
+MAC_LEN = 32
+# hard cap on a single frame: a hostile length prefix must not make the
+# server allocate unbounded memory
+MAX_FRAME = 2 << 30
+
+_ALLOWED_KINDS = frozenset("biufc")   # bool/int/uint/float/complex
+
+
+class WireError(ValueError):
+    pass
+
+
+def default_key():
+    k = os.environ.get("PADDLE_PS_AUTH_KEY", "")
+    return k.encode() if k else None
+
+
+# ----------------------------------------------------------------- encode
+
+def _enc_str(out, s):
+    # bare length-prefixed utf-8 (no tag): used inside A/D records and
+    # after the "S" tag for top-level strings — mirrored by _dec_str
+    b = s.encode("utf-8")
+    out.append(struct.pack(">I", len(b)))
+    out.append(b)
+
+
+def _encode(out, v):
+    if v is None:
+        out.append(b"N")
+    elif v is True:
+        out.append(b"t")
+    elif v is False:
+        out.append(b"f")
+    elif isinstance(v, (int, np.integer)):
+        out.append(struct.pack(">Bq", ord("I"), int(v)))
+    elif isinstance(v, (float, np.floating)):
+        out.append(struct.pack(">Bd", ord("F"), float(v)))
+    elif isinstance(v, str):
+        out.append(b"S")
+        _enc_str(out, v)
+    elif isinstance(v, np.ndarray):
+        if v.dtype.kind not in _ALLOWED_KINDS:
+            raise WireError(f"non-numeric array dtype {v.dtype} refused")
+        dt = v.dtype.str                     # e.g. "<f4" — parseable, closed
+        buf = np.ascontiguousarray(v).tobytes()
+        out.append(struct.pack(">B", ord("A")))
+        _enc_str(out, dt)
+        out.append(struct.pack(">B", v.ndim))
+        out.append(struct.pack(f">{v.ndim}q", *v.shape))
+        out.append(struct.pack(">Q", len(buf)))
+        out.append(buf)
+    elif isinstance(v, (tuple, list)):
+        out.append(struct.pack(">BI", ord("T"), len(v)))
+        for item in v:
+            _encode(out, item)
+    elif isinstance(v, dict):
+        out.append(struct.pack(">BI", ord("D"), len(v)))
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise WireError(f"dict keys must be str, got {type(k)}")
+            _enc_str(out, k)
+            _encode(out, item)
+    else:
+        raise WireError(f"type {type(v).__name__} is not wire-encodable")
+
+
+def encode(v):
+    out = []
+    _encode(out, v)
+    return b"".join(out)
+
+
+# ----------------------------------------------------------------- decode
+
+# bound on T/D nesting so a hand-crafted deep frame cannot blow the
+# decoder's recursion; real PS messages nest 2-3 levels
+_MAX_DEPTH = 32
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+        self.depth = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.buf):
+            raise WireError("truncated frame")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def unpack(self, fmt):
+        return struct.unpack(fmt, self.take(struct.calcsize(fmt)))
+
+
+def _dec_str(r):
+    (n,) = r.unpack(">I")
+    return r.take(n).decode("utf-8")
+
+
+def _decode(r):
+    tag = r.take(1)
+    if tag == b"N":
+        return None
+    if tag == b"t":
+        return True
+    if tag == b"f":
+        return False
+    if tag == b"I":
+        return r.unpack(">q")[0]
+    if tag == b"F":
+        return r.unpack(">d")[0]
+    if tag == b"S":
+        return _dec_str(r)
+    if tag == b"A":
+        try:
+            dt = np.dtype(_dec_str(r))
+        except TypeError as e:
+            raise WireError(f"bad dtype string: {e}")
+        if dt.kind not in _ALLOWED_KINDS:
+            raise WireError(f"non-numeric array dtype {dt} refused")
+        (ndim,) = r.unpack(">B")
+        shape = r.unpack(f">{ndim}q") if ndim else ()
+        (nbytes,) = r.unpack(">Q")
+        # Python-int product: a hostile shape must not wrap int64 into
+        # passing the byte-count check
+        n_expect = dt.itemsize
+        for d in shape:
+            if d < 0:
+                raise WireError(f"negative array dim {d}")
+            n_expect *= d
+        if nbytes != n_expect or nbytes > MAX_FRAME:
+            raise WireError(
+                f"array byte count {nbytes} != shape/dtype {n_expect}")
+        arr = np.frombuffer(r.take(nbytes), dtype=dt)
+        return arr.reshape(shape).copy()
+    if tag == b"T":
+        (n,) = r.unpack(">I")
+        r.depth += 1
+        if r.depth > _MAX_DEPTH:
+            raise WireError("nesting too deep")
+        v = tuple(_decode(r) for _ in range(n))
+        r.depth -= 1
+        return v
+    if tag == b"D":
+        (n,) = r.unpack(">I")
+        r.depth += 1
+        if r.depth > _MAX_DEPTH:
+            raise WireError("nesting too deep")
+        v = {_dec_str(r): _decode(r) for _ in range(n)}
+        r.depth -= 1
+        return v
+    raise WireError(f"unknown wire tag {tag!r}")
+
+
+def decode(buf):
+    r = _Reader(buf)
+    try:
+        v = _decode(r)
+    except WireError:
+        raise
+    except Exception as e:
+        # the contract is "data or WireError" — no hostile payload may
+        # surface any other exception type to the server loop
+        raise WireError(f"malformed frame: {type(e).__name__}: {e}")
+    if r.pos != len(buf):
+        raise WireError("trailing bytes after value")
+    return v
+
+
+# ------------------------------------------------------------------ frame
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def send_frame(sock, obj, key=None):
+    payload = encode(obj)
+    mac = hmac.new(key, payload, hashlib.sha256).digest() if key \
+        else b"\x00" * MAC_LEN
+    sock.sendall(MAGIC + mac + struct.pack(">Q", len(payload)) + payload)
+
+
+def recv_frame(sock, key=None):
+    head = _recv_exact(sock, len(MAGIC) + MAC_LEN + 8)
+    if head[:len(MAGIC)] != MAGIC:
+        raise WireError("bad magic — not a paddle_tpu PS frame")
+    mac = head[len(MAGIC):len(MAGIC) + MAC_LEN]
+    (n,) = struct.unpack(">Q", head[len(MAGIC) + MAC_LEN:])
+    if n > MAX_FRAME:
+        raise WireError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
+    payload = _recv_exact(sock, n)
+    if key is not None:
+        want = hmac.new(key, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise WireError("HMAC verification failed — unauthenticated "
+                            "frame rejected")
+    return decode(payload)

@@ -60,6 +60,68 @@ def register_grad_lower(fwd_type):
     return deco
 
 
+def load_op_library(lib):
+    """Load an out-of-tree op library and register its ops.
+
+    The public custom-op extension point (reference
+    /root/reference/python/paddle/fluid/framework.py:5365
+    ``fluid.load_op_library('custom_op.so')`` + the build story under
+    tests/custom_op/). The reference's "op library" is a compiled C++
+    kernel .so; the TPU-native equivalent is a Python module whose
+    import-time side effect is calling :func:`register_op` /
+    :func:`register_grad_lower` — the lowering is a pure JAX function
+    (optionally a Pallas kernel), so there is nothing to compile ahead
+    of time: XLA compiles it with the rest of the program.
+
+    `lib` may be:
+      - a path to a ``.py`` file (imported under a synthetic module name),
+      - a dotted module name on sys.path.
+
+    Contract for the module: for each op, call
+
+        @register_op("my_op")                 # generic vjp backward
+        def my_op(ctx, ins, attrs):
+            x = ins["X"][0]
+            return {"Out": <jax expression>}
+
+    Input slots arrive as {slot: [jax arrays]}; return {slot: array or
+    [arrays]}. Build-time shapes are inferred by jax.eval_shape over the
+    lowering — no InferShape function to write. A bespoke backward (when
+    the vjp of the forward is not what you want) registers
+    ``@register_grad_lower("my_op")`` receiving forward inputs plus
+    ``Out@GRAD`` and returning ``{"X@GRAD": [...]}``. Ops become usable
+    from programs immediately — e.g. via ``fluid.layers.custom_op`` or a
+    LayerHelper wrapper — in both static graph and dygraph.
+
+    Returns the imported module.
+    """
+    import importlib
+    import importlib.util
+    import os
+    import sys
+
+    before = set(OPS)
+    if os.path.sep in str(lib) or str(lib).endswith(".py"):
+        path = os.path.abspath(lib)
+        name = "paddle_tpu_oplib_" + \
+            os.path.splitext(os.path.basename(path))[0]
+        spec = importlib.util.spec_from_file_location(name, path)
+        if spec is None:
+            raise ImportError(f"cannot load op library from {path!r}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(str(lib))
+    added = sorted(set(OPS) - before)
+    if not added:
+        import warnings
+        warnings.warn(
+            f"load_op_library({lib!r}): module imported but registered "
+            f"no new ops (did it call register_op?)", stacklevel=2)
+    return mod
+
+
 def get_op_def(type):
     opdef = OPS.get(type)
     if opdef is None:

@@ -13,10 +13,10 @@ exp chain) via:
 - base-2 softmax: `scale * log2(e)` is folded into the q tile (a [bq, D]
   multiply instead of a [bq, Sk] one) and `exp2` replaces `exp`; the saved
   log-sum-exp is base-2 as well.
-- the additive key bias joins INSIDE the exp chain; the row max is taken
-  over unbiased scores. A too-large max only underflows masked entries —
-  never overflows — so the extra [bq, Sk] bias pass before the max is
-  unnecessary.
+- the additive key bias is fused into BOTH the max-reduction pass and the
+  exp chain (Mosaic folds the broadcast add into each loop over s2) — no
+  separate materialized biased-score tile, and the row max is exact, so a
+  bias-masked key can never underflow the real keys' probabilities.
 - the softmax normalizer rides the MXU for free: D=64 values occupy half
   of a 128-lane tile, so V is staged into a [bk, 128] VMEM scratch with
   ones in lane D, and `p @ v_aug` yields both `p @ v` and the row sums in
@@ -118,7 +118,7 @@ def _bias2(bias_ref):
 # ---------------------------------------------------------------- forward
 
 def _fwd_single_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-                       v_sc, *, scale, bq, causal, nq):
+                       v_sc, *, scale, bq, causal):
     """Whole Sk in one tile: no online state. Grid (B, H, nq)."""
     b, h, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     d = q_ref.shape[-1]
@@ -143,10 +143,13 @@ def _fwd_single_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
         preferred_element_type=jnp.float32)                 # [bq, Sk]
     if causal:
         s2 = _causal_mask(s2, i, 0, bq, k_ref.shape[2])
+    if bias_ref is not None:
+        # the broadcast add fuses into both s2 passes (same VMEM
+        # traffic); an unbiased max could underflow every real key when
+        # a masked key's raw score dominates
+        s2 = s2 + _bias2(bias_ref)
     m2 = jnp.max(s2, axis=-1, keepdims=True)                # [bq, 1]
     arg = s2 - m2
-    if bias_ref is not None:
-        arg = arg + _bias2(bias_ref)
     if aug:
         p = jnp.exp2(arg).astype(v_sc.dtype)                # fused chain
         acc = jax.lax.dot_general(
@@ -199,13 +202,13 @@ def _fwd_online_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
             preferred_element_type=jnp.float32)             # [bq, bk]
         if causal:
             s2 = _causal_mask(s2, qi, ki, bq, bk)
+        if bias_ref is not None:
+            s2 = s2 + _bias2(bias_ref)
         m_prev = m_sc[:, :1]                                # [bq, 1]
         m_cur = jnp.max(s2, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         corr = jnp.exp2(m_prev - m_new)
         arg = s2 - m_new
-        if bias_ref is not None:
-            arg = arg + _bias2(bias_ref)
         m_sc[:, :1] = m_new
         if aug:
             v_sc[:, :d] = v_ref[0, 0].astype(v_sc.dtype)
@@ -253,7 +256,7 @@ def _fwd_pallas(q, k, v, bias, scale, causal, bq, bk, interpret):
 
     if single:
         body = functools.partial(_fwd_single_kernel, scale=scale, bq=bq,
-                                 causal=causal, nq=nq)
+                                 causal=causal)
         grid = (B, H, nq)
         scratch = [pltpu.VMEM((bk, _LANES), v.dtype)] if aug else []
         n_sc = len(scratch)

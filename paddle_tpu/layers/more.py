@@ -622,6 +622,49 @@ def soft_relu(x, threshold=40.0, name=None):
                    shape=x.shape)
 
 
+def custom_op(op_type, inputs=None, attrs=None, outputs=None, name=None):
+    """Emit any registered op — including user ops loaded with
+    ``fluid.load_op_library`` — into the current program (static graph)
+    or the eager tracer (dygraph).
+
+    The generic layers wrapper of the custom-op story (the reference's
+    equivalent is writing a python wrapper over a loaded .so op —
+    tests/custom_op/test_custom_op.py); here one function serves every
+    op because the registry carries build-time shape inference.
+
+    inputs: {slot: Variable | [Variables]}; outputs: {slot: count}
+    (default {"Out": 1}) or {slot: (count, dtype)} — dtype defaults to
+    the first input's. Returns one Variable, a list (count > 1), or a
+    dict when multiple output slots are requested."""
+    from ..framework.registry import has_op
+    if not has_op(op_type):
+        raise NotImplementedError(
+            f"custom_op: op {op_type!r} is not registered — register it "
+            f"with paddle_tpu.register_op or load its module via "
+            f"paddle_tpu.load_op_library")
+    helper = LayerHelper(op_type, name=name)
+    ins = {}
+    first_dtype = "float32"
+    for slot, vs in (inputs or {}).items():
+        vs = list(vs) if isinstance(vs, (list, tuple)) else [vs]
+        if vs and first_dtype == "float32":
+            first_dtype = getattr(vs[0], "dtype", "float32")
+        ins[slot] = vs
+    out_spec = outputs or {"Out": 1}
+    out_vars = {}
+    for slot, spec in out_spec.items():
+        n, dt = spec if isinstance(spec, (list, tuple)) else (spec,
+                                                              first_dtype)
+        out_vars[slot] = [helper.create_variable_for_type_inference(dt)
+                          for _ in range(int(n))]
+    helper.append_op(type=op_type, inputs=ins, attrs=attrs or {},
+                     outputs=out_vars)
+    if list(out_spec) == ["Out"]:
+        vals = out_vars["Out"]
+        return vals[0] if len(vals) == 1 else vals
+    return out_vars
+
+
 def while_loop(cond, body, loop_vars, is_test=False, name=None):
     """reference layers/control_flow.py while_loop: functional While."""
     from .control_flow import While

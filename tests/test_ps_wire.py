@@ -1,0 +1,172 @@
+"""Typed PS wire protocol (distributed/wire.py): codec round-trips, the
+closed value universe (no code execution — the reference used a typed
+proto, send_recv.proto.in), frame hardening, and HMAC authentication."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import wire
+from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+# ------------------------------------------------------------------ codec
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, -7, 2 ** 40, 3.5, float("inf"), "", "héllo",
+    ("push_dense", "w", None, 3),
+    {"rows": 2, "show": 1.5, "click": 0.0},
+    ((1, 2), {"a": (None, "b")}, 4.0),
+])
+def test_roundtrip_scalars(value):
+    assert wire.decode(wire.encode(value)) == value
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "int64",
+                                   "uint8", "bool", "complex64"])
+def test_roundtrip_arrays(dtype):
+    rng = np.random.default_rng(0)
+    a = (rng.standard_normal((3, 4)) * 5).astype(dtype)
+    b = wire.decode(wire.encode(a))
+    assert b.dtype == a.dtype and b.shape == a.shape
+    np.testing.assert_array_equal(a, b)
+    # scalar (0-d) and empty arrays too
+    for a in (np.float32(2.5) * np.ones(()), np.zeros((0, 7), dtype)):
+        b = wire.decode(wire.encode(np.asarray(a)))
+        assert b.shape == np.asarray(a).shape
+
+
+def test_object_arrays_refused_both_ends():
+    import struct
+    with pytest.raises(wire.WireError, match="refused"):
+        wire.encode(np.array([object()]))
+    # hand-craft a frame claiming an object dtype: decoder must refuse
+    payload = b"A" + struct.pack(">I", 3) + b"|O8"
+    with pytest.raises(wire.WireError):
+        wire.decode(payload)
+
+
+def test_malformed_frames_rejected():
+    with pytest.raises(wire.WireError):
+        wire.decode(b"Zgarbage")             # unknown tag
+    with pytest.raises(wire.WireError):
+        wire.decode(wire.encode(5) + b"x")   # trailing bytes
+    with pytest.raises(wire.WireError):
+        wire.decode(wire.encode(5)[:-1])     # truncated
+    # array whose byte count disagrees with its shape
+    good = wire.encode(np.zeros((2, 2), np.float32))
+    bad = bytearray(good)
+    bad[-17] ^= 1   # flip a bit in the length field region
+    with pytest.raises(wire.WireError):
+        wire.decode(bytes(bad))
+    with pytest.raises(wire.WireError):
+        wire.encode({1: "non-str key"})
+    with pytest.raises(wire.WireError):
+        wire.encode(lambda: None)            # not in the value universe
+
+
+def test_hostile_frames_stay_wireerror():
+    """The decoder's contract is data-or-WireError: overflowing shapes
+    and deep nesting must not surface ValueError/RecursionError."""
+    import struct
+    # shape whose int64 product wraps to 0 must not pass the byte check
+    payload = (b"A" + struct.pack(">I", 3) + b"<f4"
+               + struct.pack(">B", 2)
+               + struct.pack(">2q", 2 ** 32, 2 ** 32)
+               + struct.pack(">Q", 0))
+    with pytest.raises(wire.WireError):
+        wire.decode(payload)
+    # 5000 nested tuples: bounded, not RecursionError
+    deep = b"T" + struct.pack(">I", 1)
+    payload = deep * 5000 + b"N"
+    with pytest.raises(wire.WireError, match="nesting"):
+        wire.decode(payload)
+
+
+def test_no_pickle_on_the_wire():
+    """The module-level guarantee the verdict asked for: nothing in
+    distributed/ unpickles network bytes."""
+    import pathlib
+    root = pathlib.Path(wire.__file__).parent
+    for p in root.glob("*.py"):
+        text = p.read_text()
+        assert "import pickle" not in text, p
+        assert "pickle.loads" not in text, p
+
+
+# ------------------------------------------------------------- live server
+
+def _start(ep, **kw):
+    srv = ParameterServer(ep, trainers=1, sync_mode=False, **kw)
+    srv.host_param("w", np.arange(6, dtype=np.float32).reshape(2, 3))
+    ev = threading.Event()
+    srv.serve(ready_event=ev, block=False)
+    ev.wait(5)
+    return srv
+
+
+def test_push_pull_over_typed_wire():
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = _start(ep)
+    cli = PSClient()
+    try:
+        val = cli.pull_dense(ep, "w")
+        np.testing.assert_allclose(val, np.arange(6).reshape(2, 3))
+        cli.push_dense(ep, "w", np.ones((2, 3), np.float32), trainer_id=0)
+        after = cli.pull_dense(ep, "w")
+        assert not np.allclose(after, val)   # sgd applied
+    finally:
+        cli.stop_servers([ep])
+
+
+def test_hmac_rejects_unauthenticated_and_wrong_key():
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = _start(ep, auth_key="sekrit")
+    try:
+        # right key: works
+        good = PSClient(auth_key="sekrit")
+        np.testing.assert_allclose(good.pull_dense(ep, "w"),
+                                   np.arange(6).reshape(2, 3))
+        # no key: server drops the connection without replying
+        bad = PSClient(auth_key=None)
+        bad._key = None      # defeat any env default
+        with pytest.raises((ConnectionError, OSError)):
+            bad.pull_dense(ep, "w")
+        # wrong key: same
+        worse = PSClient(auth_key="wrong")
+        with pytest.raises((ConnectionError, OSError)):
+            worse.pull_dense(ep, "w")
+        # raw pickle bytes thrown at the port: dropped, server healthy
+        host, port = ep.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(b"\x80\x04\x95garbage-pickle-bytes")
+        s.close()
+        np.testing.assert_allclose(good.pull_dense(ep, "w"),
+                                   np.arange(6).reshape(2, 3))
+    finally:
+        PSClient(auth_key="sekrit").stop_servers([ep])
+
+
+def test_nonloopback_bind_refused_without_key(monkeypatch):
+    monkeypatch.delenv("PADDLE_PS_AUTH_KEY", raising=False)
+    srv = ParameterServer("0.0.0.0:1", trainers=1)
+    with pytest.raises(PermissionError, match="PADDLE_PS_AUTH_KEY"):
+        srv.serve(block=False)
+    # explicit opt-out or a key lifts the guard (bind check only — use a
+    # real free port and shut down immediately)
+    ep_port = _free_port()
+    srv2 = ParameterServer(f"0.0.0.0:{ep_port}", trainers=1,
+                           auth_key="k")
+    ev = threading.Event()
+    srv2.serve(ready_event=ev, block=False)
+    assert ev.wait(5)
+    PSClient(auth_key="k").stop_servers([f"127.0.0.1:{ep_port}"])
