@@ -587,10 +587,18 @@ class PSClient:
         self._key = auth_key or default_key()
 
     @classmethod
-    def instance(cls, key="default"):
+    def instance(cls, key="default", auth_key=None):
+        """Singleton used by the distributed ops. `auth_key` (first call
+        wins, else PADDLE_PS_AUTH_KEY env) arms frame authentication for
+        the whole op-layer client path."""
         with cls._lock:
             if key not in cls._instances:
-                cls._instances[key] = cls()
+                cls._instances[key] = cls(auth_key=auth_key)
+            elif auth_key is not None:
+                inst = cls._instances[key]
+                if inst._key is None:
+                    inst._key = (auth_key.encode()
+                                 if isinstance(auth_key, str) else auth_key)
             return cls._instances[key]
 
     def _conn(self, endpoint):

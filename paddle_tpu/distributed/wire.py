@@ -25,6 +25,14 @@ BOTH ends. A keyed server rejects frames whose MAC does not verify
 (constant-time compare) — see tests/test_ps_wire.py. Without a key the
 MAC field is zeros; the server refuses to bind non-loopback interfaces
 unless the key is set or ``allow_insecure=True`` is explicit.
+
+Threat model: the MAC provides ORIGIN authentication (only key holders
+can speak), not confidentiality or replay protection — a recorded
+frame verifies again if resent, the same trust level the reference's
+unauthenticated gRPC transport gave inside a private cluster network.
+Deploy pservers on an isolated network segment as the reference did;
+the key guards against the "anyone who can reach the port" class, not
+an on-path recorder.
 """
 import hmac
 import hashlib
@@ -69,7 +77,10 @@ def _encode(out, v):
     elif v is False:
         out.append(b"f")
     elif isinstance(v, (int, np.integer)):
-        out.append(struct.pack(">Bq", ord("I"), int(v)))
+        i = int(v)
+        if not -(2 ** 63) <= i < 2 ** 63:
+            raise WireError(f"int {i} outside the wire's 64-bit range")
+        out.append(struct.pack(">Bq", ord("I"), i))
     elif isinstance(v, (float, np.floating)):
         out.append(struct.pack(">Bd", ord("F"), float(v)))
     elif isinstance(v, str):

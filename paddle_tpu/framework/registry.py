@@ -100,7 +100,9 @@ def load_op_library(lib):
     import os
     import sys
 
-    before = set(OPS)
+    # snapshot op types AND grad-lowering identities: a library whose
+    # only side effect is register_grad_lower on existing ops is valid
+    before = {(t, id(d.custom_grad_lower)) for t, d in OPS.items()}
     if os.path.sep in str(lib) or str(lib).endswith(".py"):
         path = os.path.abspath(lib)
         name = "paddle_tpu_oplib_" + \
@@ -113,8 +115,8 @@ def load_op_library(lib):
         spec.loader.exec_module(mod)
     else:
         mod = importlib.import_module(str(lib))
-    added = sorted(set(OPS) - before)
-    if not added:
+    after = {(t, id(d.custom_grad_lower)) for t, d in OPS.items()}
+    if after == before:
         import warnings
         warnings.warn(
             f"load_op_library({lib!r}): module imported but registered "

@@ -644,12 +644,13 @@ def custom_op(op_type, inputs=None, attrs=None, outputs=None, name=None):
             f"paddle_tpu.load_op_library")
     helper = LayerHelper(op_type, name=name)
     ins = {}
-    first_dtype = "float32"
+    first_dtype = None
     for slot, vs in (inputs or {}).items():
         vs = list(vs) if isinstance(vs, (list, tuple)) else [vs]
-        if vs and first_dtype == "float32":
-            first_dtype = getattr(vs[0], "dtype", "float32")
+        if vs and first_dtype is None:
+            first_dtype = getattr(vs[0], "dtype", None)
         ins[slot] = vs
+    first_dtype = first_dtype or "float32"
     out_spec = outputs or {"Out": 1}
     out_vars = {}
     for slot, spec in out_spec.items():
