@@ -596,9 +596,18 @@ class PSClient:
                 cls._instances[key] = cls(auth_key=auth_key)
             elif auth_key is not None:
                 inst = cls._instances[key]
+                wanted = (auth_key.encode()
+                          if isinstance(auth_key, str) else auth_key)
                 if inst._key is None:
-                    inst._key = (auth_key.encode()
-                                 if isinstance(auth_key, str) else auth_key)
+                    inst._key = wanted
+                elif inst._key != wanted:
+                    import warnings
+                    warnings.warn(
+                        "PSClient.instance(): singleton already armed "
+                        "with a different auth key — keeping the "
+                        "existing one (frames signed with it will be "
+                        "rejected by servers keyed otherwise)",
+                        stacklevel=2)
             return cls._instances[key]
 
     def _conn(self, endpoint):

@@ -100,9 +100,13 @@ def load_op_library(lib):
     import os
     import sys
 
-    # snapshot op types AND grad-lowering identities: a library whose
-    # only side effect is register_grad_lower on existing ops is valid
-    before = {(t, id(d.custom_grad_lower)) for t, d in OPS.items()}
+    # snapshot op types AND lowering identities: a library whose only
+    # side effect is register_grad_lower on (or re-registration of)
+    # existing ops is valid
+    def _snapshot():
+        return {(t, id(d.lower), id(d.custom_grad_lower))
+                for t, d in OPS.items()}
+    before = _snapshot()
     if os.path.sep in str(lib) or str(lib).endswith(".py"):
         path = os.path.abspath(lib)
         name = "paddle_tpu_oplib_" + \
@@ -115,7 +119,7 @@ def load_op_library(lib):
         spec.loader.exec_module(mod)
     else:
         mod = importlib.import_module(str(lib))
-    after = {(t, id(d.custom_grad_lower)) for t, d in OPS.items()}
+    after = _snapshot()
     if after == before:
         import warnings
         warnings.warn(

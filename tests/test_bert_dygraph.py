@@ -1,0 +1,67 @@
+"""DyGraph BERT (models/bert_dygraph.py) — the same-math twin of the
+static bert.bert_pretrain used by the dygraph-vs-static A/B
+(tools/bench_dygraph_ab.py, BENCHMARKS.md r5)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.models import bert, bert_dygraph
+
+
+def _args(feed):
+    return [dygraph.to_variable(feed[k]) for k in
+            ("src_ids", "sent_ids", "pos_ids", "input_mask",
+             "mask_pos", "mask_label", "labels")]
+
+
+def test_eager_trains():
+    cfg = bert.BertConfig.tiny()
+    feed = bert.random_batch(cfg, 4, 16, 3)
+    with dygraph.guard():
+        model = bert_dygraph.BertPretrainDy(cfg)
+        opt = fluid.optimizer.Adam(1e-3,
+                                   parameter_list=model.parameters())
+        losses = []
+        for _ in range(6):
+            loss = model(*_args(feed))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            losses.append(float(loss.numpy().reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_jit_step_trains_and_matches_param_count():
+    cfg = bert.BertConfig.tiny()
+    feed = bert.random_batch(cfg, 4, 16, 3)
+    with dygraph.guard():
+        model = bert_dygraph.BertPretrainDy(cfg)
+        opt = fluid.optimizer.Adam(1e-3,
+                                   parameter_list=model.parameters())
+
+        @dygraph.jit_step
+        def step(*args):
+            loss = model(*args)
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            return loss
+
+        l0 = float(step(*_args(feed)).numpy().reshape(-1)[0])
+        for _ in range(5):
+            last = float(step(*_args(feed)).numpy().reshape(-1)[0])
+        assert np.isfinite(last) and last < l0, (l0, last)
+
+    # parameter census matches the static graph's (same architecture):
+    # embeddings (3), pre-LN (2), per layer qkv/out/2ln/2ffn (4 w + 4 b
+    # + 4 ln) = 12, mlm trans + ln + bias (5), pooled + nsp (4)
+    static_main, static_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(static_main, static_start):
+        bert.bert_pretrain(cfg, 4, 16, 3)
+    n_static = sum(1 for v in static_main.list_vars()
+                   if getattr(v, "is_parameter", False))
+    with dygraph.guard():
+        model2 = bert_dygraph.BertPretrainDy(cfg)
+        n_dy = len(model2.parameters())
+    assert n_dy == n_static, (n_dy, n_static)

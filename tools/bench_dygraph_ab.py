@@ -1,0 +1,89 @@
+"""Dygraph-vs-static A/B: BERT-base, fp32, batch 64, seq 128 — the only
+variable is the execution path (Executor.run over the static program vs
+dygraph.jit_step whole-step capture of models/bert_dygraph.py, the same
+math). Measures steady-state step time (best of 3 windows) and XLA
+cost_analysis of both executables; results table in BENCHMARKS.md
+"Dygraph-vs-static A/B". Run on the TPU host: python tools/bench_dygraph_ab.py
+"""
+import os
+import sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+jax.config.update("jax_default_prng_impl", "rbg")
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+from paddle_tpu.models import bert, bert_dygraph
+
+cfg = bert.BertConfig.base()
+batch, seq, preds = 64, 128, 20
+rng = np.random.default_rng(0)
+pool = [bert.random_batch(cfg, batch, seq, preds, rng=rng) for _ in range(2)]
+N = 20
+
+# ---------------- static path ----------------
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    out = bert.bert_pretrain(cfg, batch, seq, preds)
+    fluid.optimizer.Adam(1e-4).minimize(out["loss"])
+exe = fluid.Executor()
+scope = fluid.Scope()
+staged = [{k: jax.device_put(v) for k, v in b.items()} for b in pool]
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    for i in range(3):
+        exe.run(main, feed=staged[i % 2], fetch_list=[out["loss"].name])
+    best = 1e9
+    for _r in range(3):
+        t0 = time.perf_counter()
+        for i in range(N):
+            exe.run(main, feed=staged[i % 2], fetch_list=[])
+        l, = exe.run(main, feed=staged[0], fetch_list=[out["loss"].name])
+        float(np.asarray(l).reshape(()))
+        best = min(best, (time.perf_counter() - t0) / (N + 1))
+    import bench
+    cost_s = bench._step_cost(exe, scope, pool[0], main)
+print(f"static:  {best*1e3:8.2f} ms/step  {batch/best:8.1f} samples/s  "
+      f"flops {cost_s['flops']/1e9:.1f}G bytes {cost_s['bytes']/1e9:.1f}G")
+t_static = best
+
+# ---------------- dygraph path ----------------
+with dygraph.guard():
+    model = bert_dygraph.BertPretrainDy(cfg)
+    opt = dygraph_opt = fluid.optimizer.Adam(1e-4,
+                                             parameter_list=model.parameters())
+    @dygraph.jit_step
+    def step(*args):
+        loss = model(*args)
+        loss.backward()
+        opt.minimize(loss)
+        model.clear_gradients()
+        return loss
+
+    keys = ("src_ids", "sent_ids", "pos_ids", "input_mask",
+            "mask_pos", "mask_label", "labels")
+    dstaged = [[jax.device_put(b[k]) for k in keys] for b in pool]
+    # eager warmup small batch
+    small = [v[:4] if getattr(v, "ndim", 0) else v
+             for v in [pool[0][k] for k in keys]]
+    small[4] = pool[0]["mask_pos"][:4 * preds]
+    small[5] = pool[0]["mask_label"][:4 * preds]
+    step(*[dygraph.to_variable(np.asarray(v)) for v in small])
+    vb = [dygraph.to_variable(v) for v in dstaged[0]]
+    vb2 = [dygraph.to_variable(v) for v in dstaged[1]]
+    step(*vb)                       # capture at full batch
+    float(step(*vb2).numpy().reshape(-1)[0])
+    best = 1e9
+    for _r in range(3):
+        t0 = time.perf_counter()
+        last = None
+        for i in range(N):
+            last = step(*(vb if i % 2 == 0 else vb2))
+        float(last.numpy().reshape(-1)[0])
+        best = min(best, (time.perf_counter() - t0) / N)
+    import bench
+    cost_d = bench._jit_step_cost(step, dstaged[0])
+print(f"dygraph: {best*1e3:8.2f} ms/step  {batch/best:8.1f} samples/s  "
+      + (f"flops {cost_d['flops']/1e9:.1f}G bytes {cost_d['bytes']/1e9:.1f}G"
+         if cost_d else "no cost"))
+print(f"ratio dygraph/static samples/s: {t_static/best:.3f}")
