@@ -11,7 +11,7 @@ from .framework.core import (  # noqa: F401
     default_main_program, default_startup_program, program_guard,
     switch_main_program, switch_startup_program,
     CPUPlace, CUDAPlace, TPUPlace, OpRole,
-    grad_var_name,
+    grad_var_name, ComplexVariable,
 )
 from .framework.executor import (  # noqa: F401
     Executor, FetchHandler, Scope, global_scope, scope_guard,
@@ -48,6 +48,7 @@ from . import install_check  # noqa: F401
 from . import capi_train  # noqa: F401  (C-native training entry backing)
 from .framework.registry import (  # noqa: F401  (custom-op extension point)
     load_op_library, register_grad_lower, register_op)
+from . import complex  # noqa: F401  (2.0-preview complex namespace)
 from . import nn  # noqa: F401  (2.0-preview namespace)
 from . import tensor  # noqa: F401  (2.0-preview namespace)
 from .flags import get_flags, set_flags  # noqa: F401

@@ -1,0 +1,11 @@
+"""paddle.complex — ops over ComplexVariable (reference
+python/paddle/complex/: tensor.math elementwise_add/sub/mul/div + kron,
+helper.is_complex/is_real; ComplexVariable itself lives in
+framework.py:1683). Implemented over (real, imag) Variable pairs through
+the ordinary op surface, so everything compiles into the same XLA
+program — plus matmul/reshape/transpose from the 2.0-preview surface."""
+from . import tensor
+from .helper import is_complex, is_real  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+
+__all__ = tensor.__all__ + []

@@ -1,0 +1,26 @@
+"""Helpers (reference python/paddle/complex/helper.py)."""
+from ..framework.core import ComplexVariable, Variable
+
+
+def is_complex(x):
+    """True if x is a ComplexVariable."""
+    return isinstance(x, ComplexVariable)
+
+
+def is_real(x):
+    """True if x is a real-number Variable (or dygraph VarBase)."""
+    if isinstance(x, Variable):
+        return True
+    from ..dygraph.base import VarBase
+    return isinstance(x, VarBase)
+
+
+def complex_variable_exists(inputs, layer_name):
+    for inp in inputs:
+        if is_complex(inp):
+            return
+    err_msg = "At least one inputs of layer complex." if len(inputs) > 1 \
+        else "The input of layer complex."
+    raise ValueError(err_msg + layer_name +
+                     "() must be ComplexVariable, please "
+                     "use the layer for real number instead.")

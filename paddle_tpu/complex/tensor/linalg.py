@@ -1,0 +1,43 @@
+"""Complex linear algebra (2.0-preview surface: matmul)."""
+from ...framework.core import ComplexVariable
+from ...layers import math as M
+from ...layers import nn as _nn
+from .. import helper
+from ..helper import complex_variable_exists
+
+__all__ = ["matmul"]
+
+
+def _mm(a, b, tx, ty):
+    return _nn.matmul(a, b, transpose_x=tx, transpose_y=ty)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    """Complex matmul: (ar@br - ai@bi) + (ar@bi + ai@br) i. NOTE: a
+    transposed complex operand is the plain transpose, not the conjugate
+    transpose (matching the reference's real-pair decomposition)."""
+    complex_variable_exists([x, y], "matmul")
+    if helper.is_complex(x):
+        xr, xi = x.real, x.imag
+    else:
+        xr, xi = x, None
+    if helper.is_complex(y):
+        yr, yi = y.real, y.imag
+    else:
+        yr, yi = y, None
+    if xi is None:
+        real = _mm(xr, yr, transpose_x, transpose_y)
+        imag = _mm(xr, yi, transpose_x, transpose_y)
+    elif yi is None:
+        real = _mm(xr, yr, transpose_x, transpose_y)
+        imag = _mm(xi, yr, transpose_x, transpose_y)
+    else:
+        real = M.elementwise_sub(_mm(xr, yr, transpose_x, transpose_y),
+                                 _mm(xi, yi, transpose_x, transpose_y))
+        imag = M.elementwise_add(_mm(xr, yi, transpose_x, transpose_y),
+                                 _mm(xi, yr, transpose_x, transpose_y))
+    if alpha != 1.0:
+        real = M.scale(real, float(alpha))
+        imag = M.scale(imag, float(alpha))
+    return ComplexVariable(real, imag)

@@ -1,0 +1,19 @@
+"""Complex shape manipulation (2.0-preview surface: reshape,
+transpose) — applied to both parts."""
+from ...framework.core import ComplexVariable
+from ...layers import tensor as T
+from ..helper import complex_variable_exists
+
+__all__ = ["reshape", "transpose"]
+
+
+def reshape(x, shape, name=None):
+    complex_variable_exists([x], "reshape")
+    return ComplexVariable(T.reshape(x.real, shape),
+                           T.reshape(x.imag, shape))
+
+
+def transpose(x, perm, name=None):
+    complex_variable_exists([x], "transpose")
+    return ComplexVariable(T.transpose(x.real, perm),
+                           T.transpose(x.imag, perm))

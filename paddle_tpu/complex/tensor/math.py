@@ -1,0 +1,101 @@
+"""Complex elementwise math + kron (reference
+python/paddle/complex/tensor/math.py — elementwise_add/sub/mul/div,
+kron). Each op decomposes into real-part arithmetic through the
+ordinary layers surface; a real operand broadcasts as (x, 0)."""
+from ...framework.core import ComplexVariable
+from ...layers import math as M
+from ..helper import complex_variable_exists, is_complex
+
+__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "kron"]
+
+
+def _parts(v):
+    """(real, imag) with imag=None for a real operand."""
+    if is_complex(v):
+        return v.real, v.imag
+    return v, None
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    """Complex (x + y) (reference math.py:27)."""
+    complex_variable_exists([x, y], "elementwise_add")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    real = M.elementwise_add(xr, yr, axis=axis)
+    if xi is None:
+        imag = yi
+    elif yi is None:
+        imag = xi
+    else:
+        imag = M.elementwise_add(xi, yi, axis=axis)
+    return ComplexVariable(real, imag)
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    """Complex (x - y)."""
+    complex_variable_exists([x, y], "elementwise_sub")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    real = M.elementwise_sub(xr, yr, axis=axis)
+    if yi is None:
+        imag = xi
+    elif xi is None:
+        imag = M.scale(yi, -1.0)
+    else:
+        imag = M.elementwise_sub(xi, yi, axis=axis)
+    return ComplexVariable(real, imag)
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    """Complex (x * y): (ar*br - ai*bi) + (ar*bi + ai*br) i."""
+    complex_variable_exists([x, y], "elementwise_mul")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    if xi is None:                       # real * complex
+        return ComplexVariable(M.elementwise_mul(xr, yr, axis=axis),
+                               M.elementwise_mul(xr, yi, axis=axis))
+    if yi is None:                       # complex * real
+        return ComplexVariable(M.elementwise_mul(xr, yr, axis=axis),
+                               M.elementwise_mul(xi, yr, axis=axis))
+    real = M.elementwise_sub(M.elementwise_mul(xr, yr, axis=axis),
+                             M.elementwise_mul(xi, yi, axis=axis))
+    imag = M.elementwise_add(M.elementwise_mul(xr, yi, axis=axis),
+                             M.elementwise_mul(xi, yr, axis=axis))
+    return ComplexVariable(real, imag)
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    """Complex (x / y): multiply by the conjugate over |y|^2."""
+    complex_variable_exists([x, y], "elementwise_div")
+    yr, yi = _parts(y)
+    if yi is None:                       # complex / real
+        xr, xi = _parts(x)
+        return ComplexVariable(M.elementwise_div(xr, yr, axis=axis),
+                               M.elementwise_div(xi, yr, axis=axis))
+    denom = M.elementwise_add(M.elementwise_mul(yr, yr),
+                              M.elementwise_mul(yi, yi))
+    conj = ComplexVariable(yr, M.scale(yi, -1.0))
+    num = elementwise_mul(x, conj, axis=axis)
+    return ComplexVariable(M.elementwise_div(num.real, denom, axis=axis),
+                           M.elementwise_div(num.imag, denom, axis=axis))
+
+
+def _kron_real(a, b):
+    from ...layers.more import custom_op
+    return custom_op("kron", inputs={"X": a, "Y": b})
+
+
+def kron(x, y, name=None):
+    """Complex Kronecker product (reference math.py kron):
+    (kron(ar,br) - kron(ai,bi)) + (kron(ar,bi) + kron(ai,br)) i."""
+    complex_variable_exists([x, y], "kron")
+    xr, xi = _parts(x)
+    yr, yi = _parts(y)
+    if xi is None:
+        return ComplexVariable(_kron_real(xr, yr), _kron_real(xr, yi))
+    if yi is None:
+        return ComplexVariable(_kron_real(xr, yr), _kron_real(xi, yr))
+    real = M.elementwise_sub(_kron_real(xr, yr), _kron_real(xi, yi))
+    imag = M.elementwise_add(_kron_real(xr, yi), _kron_real(xi, yr))
+    return ComplexVariable(real, imag)

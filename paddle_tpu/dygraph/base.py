@@ -472,10 +472,21 @@ def _tape_vjp_lower(ctx, ins, attrs):
 
 
 def to_variable(value, name=None, zero_copy=None):
-    """numpy/list -> VarBase (reference dygraph/base.py:493)."""
-    if isinstance(value, VarBase):
+    """numpy/list -> VarBase; complex ndarray -> ComplexVariable
+    (reference dygraph/base.py:493/:560)."""
+    from ..framework.core import ComplexVariable
+    if isinstance(value, (VarBase, ComplexVariable)):
         return value
     arr = np.asarray(value)
+    if arr.dtype.kind == "c":
+        part = np.float32 if arr.dtype == np.complex64 else np.float64
+        real = VarBase(np.ascontiguousarray(arr.real, part),
+                       name=(name + ".real") if name else None,
+                       stop_gradient=True)
+        imag = VarBase(np.ascontiguousarray(arr.imag, part),
+                       name=(name + ".imag") if name else None,
+                       stop_gradient=True)
+        return ComplexVariable(real, imag)
     return VarBase(arr, name=name, stop_gradient=True)
 
 

@@ -6,5 +6,6 @@ DygraphToStaticAst). The trace-based TracedLayer path remains the
 fallback for callables the AST pass cannot convert."""
 from .ast_transformer import DygraphToStaticAst, convert_to_static  # noqa: F401
 from .convert_ops import (  # noqa: F401
-    UNDEFINED, convert_for_range, convert_ifelse, convert_while,
+    UNDEFINED, StaticTensorList, convert_for_range, convert_ifelse,
+    convert_while, list_capacity,
 )

@@ -561,14 +561,38 @@ class DygraphToStaticAst(ast.NodeTransformer):
         """foo(...) -> convert_call(foo)(...) for plain-name callees
         (reference call_transformer): user functions get AST-converted
         too; library/builtin callables pass through untouched. print()
-        routes to convert_print."""
+        routes to convert_print, len() to convert_len (tensor lists and
+        Variables have no python __len__)."""
         self.generic_visit(node)
         if isinstance(node.func, ast.Name):
             if node.func.id == "print" and not node.keywords:
                 return _jst_call("convert_print", list(node.args))
+            if node.func.id == "len" and len(node.args) == 1 \
+                    and not node.keywords:
+                return _jst_call("convert_len", list(node.args))
             if node.func.id in ("range", "len", "_paddle_tpu_jst"):
                 return node
             node.func = _jst_call("convert_call", [node.func])
+        return node
+
+    def visit_Expr(self, node):
+        """`name.append(expr)` statements become
+        `name = convert_list_append(name, expr)` (reference
+        list_transformer): the rebinding makes the list visible to the
+        loop/branch write analysis, so it turns into tensor-list loop
+        state inside data-dependent control flow."""
+        self.generic_visit(node)
+        call = node.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)
+                and len(call.args) == 1 and not call.keywords):
+            name = call.func.value.id
+            return ast.Assign(
+                targets=[_store(name)],
+                value=_jst_call("convert_list_append",
+                                [_load(name), call.args[0]]))
         return node
 
 

@@ -11,6 +11,13 @@ Each dispatcher decides AT RUNTIME what the predicate is:
 """
 
 
+class ConversionError(ValueError):
+    """A deliberate dygraph_to_static usage error with an actionable
+    message — NOT retried through the trace fallback (the original
+    function cannot trace either, and the fallback's failure would bury
+    the real cause)."""
+
+
 class _Undefined:
     def __repr__(self):
         return "<undefined before branch>"
@@ -82,23 +89,51 @@ def convert_while(test_fn, body_fn, init, names):
         # to_static_variable)
         state = []
         for v, n in zip(init, names):
+            if isinstance(v, StaticTensorList) or \
+                    (isinstance(v, list) and not v):
+                # tensor lists defer: an empty python list materializes
+                # to a (buffer, count) pair lazily at its first append
+                # inside the body (see convert_list_append)
+                state.append(v)
+                continue
+            if isinstance(v, (list, tuple)):
+                raise ValueError(
+                    f"dygraph_to_static: list {n!r} carried through a "
+                    f"data-dependent loop must be empty before the loop "
+                    f"(tensor-list state starts from its appends)")
             if not _static_var(v):
                 v = _promote_scalar(v, n, layers)
             state.append(v)
         cond_var = layers.logical_and(probe, probe) \
             if probe.dtype != "bool" else layers.assign(probe)
         w = layers.While(cond_var)
+        _overflow_guards = []
         with w.block():
             new_vals = body_fn(*state)
             if not isinstance(new_vals, (list, tuple)):
                 new_vals = [new_vals]
-            for var, nv, n in zip(state, new_vals, names):
+            for k, (var, nv, n) in enumerate(zip(state, new_vals, names)):
+                if isinstance(nv, StaticTensorList):
+                    # carry the (buffer, count) pair through the loop's
+                    # outer view (vars the lazy materialization placed
+                    # in the parent block)
+                    root = nv._root
+                    layers.assign(nv.buffer, output=root.buffer)
+                    layers.assign(nv.count, output=root.count)
+                    state[k] = root
+                    _overflow_guards.append(root)
+                    continue
+                if isinstance(var, list) and isinstance(nv, list):
+                    continue   # list never appended in the body
                 if not _static_var(nv):
                     # python scalar write (e.g. the continue flag's
                     # per-iteration reset) -> keep the carry's [1] shape
                     nv = _promote_scalar(nv, n, layers)
                 layers.assign(nv, output=var)
             layers.assign(test_fn(*state), output=cond_var)
+        for k, v in enumerate(state):
+            if isinstance(v, StaticTensorList) and v in _overflow_guards:
+                state[k] = _guarded_list(v)
         return tuple(state)
     # eager / plain python
     vals = tuple(init)
@@ -121,6 +156,13 @@ def _promote_scalar(v, n, layers):
         return layers.fill_constant([1], "int64", v)
     if isinstance(v, float):
         return layers.fill_constant([1], "float32", v)
+    if isinstance(v, (list, StaticTensorList)):
+        raise ConversionError(
+            f"dygraph_to_static: tensor list {n!r} cannot be written "
+            f"inside a data-dependent `if` branch (cond branches merge "
+            f"fixed-shape values) — append unconditionally and select "
+            f"the value with layers.where/cond, or restructure the "
+            f"branch")
     raise ValueError(
         f"dygraph_to_static: while-loop variable {n!r} must be a "
         f"Variable or a python scalar before a data-dependent loop "
@@ -173,6 +215,196 @@ def convert_logical_not(x):
 def _as_bool_var(x):
     from ... import layers
     return x if x.dtype == "bool" else layers.cast(x, "bool")
+
+
+# ---------------------------------------------------------------- lists
+# (reference dygraph_to_static/list_transformer.py: python lists that
+# interact with tensors inside converted control flow become
+# tensor-array ops. The TPU-native representation is a FIXED-CAPACITY
+# dense (buffer [cap, *row], count) pair — XLA has no dynamically-sized
+# tensor_array; capacity comes from `with list_capacity(K)`.)
+
+_LIST_CAP = [None]
+
+
+def list_capacity(n):
+    """Context manager declaring the max length of tensor lists
+    appended inside data-dependent loops (the static bound XLA needs
+    where the reference's CPU tensor_array could grow unboundedly)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        old = _LIST_CAP[0]
+        _LIST_CAP[0] = int(n)
+        try:
+            yield
+        finally:
+            _LIST_CAP[0] = old
+    return _cm()
+
+
+class StaticTensorList:
+    """Tensor list as (buffer [cap, *row], count [1] int64) Variables.
+
+    Appends are functional scatter-updates; reads are gathers with a
+    (possibly tensor) index; `.stack()` hands back the dense buffer
+    (rows past length() are zeros); `len(l)` in converted code routes to
+    `.length()`. `_root` points at the loop-carried outer view whose
+    buffers live in the loop's parent block."""
+
+    def __init__(self, buffer, count, cap, root=None):
+        self.buffer = buffer
+        self.count = count
+        self.cap = cap
+        self._root = root or self
+
+    def __getitem__(self, i):
+        from ... import layers
+        if isinstance(i, slice):
+            raise ConversionError(
+                "dygraph_to_static: slicing a tensor list is not "
+                "supported — use .stack() and slice the dense buffer "
+                "(rows past length() are zeros)")
+        idx = i
+        if not (_static_var(idx) or _eager_var(idx)):
+            i = int(i)
+            if i < 0:
+                # python end-relative indexing: resolve against the
+                # LIVE length (outs[-1] is the canonical decoder read)
+                idx = layers.increment(self.count, value=i,
+                                       in_place=False)
+            else:
+                idx = layers.fill_constant([1], "int64", i)
+        row = layers.gather(self.buffer, layers.cast(idx, "int64"))
+        # the root's buffer var carries the explicit [cap, *row] shape
+        # (derived views from the overflow guard may not)
+        return layers.reshape(row, list(self._root.buffer.shape[1:]))
+
+    def length(self):
+        return self.count
+
+    def stack(self):
+        """Dense [cap, *row] buffer; entries at index >= length() are
+        zeros. Slice with length() downstream if needed."""
+        return self.buffer
+
+    def append(self, x):
+        """Direct (non-AST) use keeps python list mutation semantics:
+        the converted-code path goes through convert_list_append's
+        functional form instead (rebinding makes it loop state)."""
+        new = convert_list_append(self, x)
+        self.buffer, self.count = new.buffer, new.count
+        return None
+
+
+def _in_sub_block():
+    from ...framework.core import default_main_program
+    return default_main_program().current_block().parent_idx >= 0
+
+
+def _materialize_list(x):
+    """Create (zeros buffer, count) in the PARENT block of the current
+    While sub-block — the While op is appended to the parent on body
+    exit, so these land before it and become ordinary loop-carried
+    state."""
+    from ...framework import unique_name
+    from ...framework.core import default_main_program
+    cap = _LIST_CAP[0]
+    if cap is None:
+        raise ConversionError(
+            "dygraph_to_static: appending a tensor to a python list "
+            "inside a data-dependent loop needs a declared capacity "
+            "(XLA buffers are fixed-size, unlike the reference's CPU "
+            "tensor_array) — wrap the call in "
+            "`with paddle_tpu.dygraph.dygraph_to_static.list_capacity(K):`")
+    prog = default_main_program()
+    blk = prog.current_block()
+    parent = blk.parent_block if blk.parent_idx >= 0 else blk
+    row_shape = [int(s) for s in x.shape]
+    buf = parent.create_var(name=unique_name.generate("tensor_list"),
+                            dtype=x.dtype, shape=[cap] + row_shape)
+    parent.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": [buf]},
+                     attrs={"shape": [cap] + row_shape,
+                            "dtype": str(x.dtype), "value": 0.0})
+    cnt = parent.create_var(name=unique_name.generate("tensor_list_len"),
+                            dtype="int64", shape=[1])
+    parent.append_op(type="fill_constant", inputs={},
+                     outputs={"Out": [cnt]},
+                     attrs={"shape": [1], "dtype": "int64", "value": 0})
+    return StaticTensorList(buf, cnt, cap)
+
+
+def _guarded_list(root):
+    """Post-loop overflow check: appends beyond the declared capacity
+    would be dropped by XLA's out-of-bounds scatter — fail loudly
+    instead. The runtime_assert's zero output is folded into the
+    (buffer, count) the caller reads so the check cannot be
+    dead-code-eliminated."""
+    from ... import layers
+    from ...layers.layer_helper import LayerHelper
+    cap_var = layers.fill_constant([1], "int64", root.cap)
+    ok = layers.less_equal(root.count, cap_var)
+    helper = LayerHelper("runtime_assert")
+    zero = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="runtime_assert", inputs={"Cond": [ok]},
+        outputs={"Out": [zero]},
+        attrs={"msg": (
+            f"dygraph_to_static: tensor list overflowed its declared "
+            f"list_capacity({root.cap}) — raise the capacity to cover "
+            f"the loop's maximum appends")},
+        infer_shape=False)
+    count = layers.elementwise_add(root.count,
+                                   layers.cast(zero, "int64"))
+    buf = layers.elementwise_add(
+        root.buffer, layers.cast(zero, root.buffer.dtype))
+    return StaticTensorList(buf, count, root.cap, root=root)
+
+
+def convert_list_append(l, x):
+    """`l.append(x)` in converted code (rewritten to an assignment so
+    the list becomes loop state). Static tensor appends inside a
+    data-dependent loop go through the fixed-capacity buffer; everything
+    else stays a plain python list."""
+    if isinstance(l, StaticTensorList):
+        from ... import layers
+        new_buf = layers.scatter(l.buffer, layers.cast(l.count, "int64"),
+                                 layers.unsqueeze(x, [0]), overwrite=True)
+        new_cnt = layers.increment(l.count, value=1, in_place=False)
+        return StaticTensorList(new_buf, new_cnt, l.cap, root=l._root)
+    if isinstance(l, tuple):
+        # python semantics: tuples have no append — surface the user
+        # bug instead of silently granting one
+        raise AttributeError("'tuple' object has no attribute 'append'")
+    if not isinstance(l, list):
+        # an object with its own append (not a python list): leave it
+        # alone — the AST rewrite is only for list semantics
+        l.append(x)
+        return l
+    if _static_var(x) and _in_sub_block():
+        if len(l):
+            raise ConversionError(
+                "dygraph_to_static: a list appended inside a "
+                "data-dependent loop must start empty before the loop "
+                f"(got {type(l).__name__} of length {len(l)})")
+        return convert_list_append(_materialize_list(x), x)
+    return list(l) + [x]
+
+
+def convert_len(x):
+    """len(x) in converted code (reference convert_len)."""
+    if isinstance(x, StaticTensorList):
+        return x.length()
+    if _static_var(x) or _eager_var(x):
+        d0 = x.shape[0] if len(x.shape) else None
+        if d0 is not None and int(d0) >= 0:
+            return int(d0)
+        from ... import layers
+        return layers.slice(layers.shape(x), axes=[0], starts=[0],
+                            ends=[1])
+    return len(x)
 
 
 _CONVERTED_CACHE = {}

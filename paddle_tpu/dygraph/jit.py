@@ -182,11 +182,19 @@ class ProgramTranslator:
         first: run the CONVERTED function on static data() Variables so
         tensor-predicate control flow becomes cond/While ops; falls back
         to the trace path on any conversion failure."""
+        from .dygraph_to_static.convert_ops import ConversionError
         try:
             return self._get_program_ast(dygraph_func, *args)
+        except ConversionError:
+            raise   # actionable usage error — a trace would fail worse
         except Exception:
-            _, traced = TracedLayer.trace(_FnLayer(dygraph_func),
-                                          list(args))
+            from . import base as dy
+            import contextlib
+            guard = contextlib.nullcontext() if dy.enabled() \
+                else dy.guard()
+            with guard:
+                _, traced = TracedLayer.trace(_FnLayer(dygraph_func),
+                                              list(args))
             return (traced._program, traced._startup, traced._feed_names,
                     traced._fetch_names)
 

@@ -513,6 +513,56 @@ class Program:
         return "\n".join(lines)
 
 
+class ComplexVariable:
+    """A variable on the complex domain: a (real, imag) pair of ordinary
+    Variables/VarBases (reference framework.py:1683 — the reference also
+    stores complex numbers as two real tensors rather than a complex
+    dtype; on TPU this is additionally the layout XLA vectorizes best).
+    Works in dygraph (as the reference) AND over static Variables, since
+    both share the op surface here. paddle_tpu.complex provides the op
+    namespace."""
+
+    def __init__(self, real, imag):
+        assert tuple(real.shape) == tuple(imag.shape), (
+            "The real part and imaginary part of a ComplexVariable "
+            "should have the same shape!")
+        assert str(real.dtype) == str(imag.dtype), (
+            "The real part and imaginary part of a ComplexVariable "
+            "should have the same data type!")
+        if str(real.dtype) not in ("float32", "float64"):
+            raise TypeError(
+                f"ComplexVariable parts must be float32 (complex64) or "
+                f"float64 (complex128), got {real.dtype}")
+        self.real = real
+        self.imag = imag
+        self._dtype = ("complex64" if str(real.dtype) == "float32"
+                       else "complex128")
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def shape(self):
+        return self.real.shape
+
+    @property
+    def name(self):
+        return {"real": getattr(self.real, "name", None),
+                "imag": getattr(self.imag, "name", None)}
+
+    def numpy(self):
+        import numpy as _np
+        return _np.asarray(self.real.numpy()) + 1j * _np.asarray(
+            self.imag.numpy())
+
+    def __repr__(self):
+        return (f"ComplexVariable(real={self.real!r}, "
+                f"imag={self.imag!r})")
+
+    __str__ = __repr__
+
+
 # ---- global default programs + guards (reference framework.py:5150-5300) ----
 _main_program_ = Program()
 _startup_program_ = Program()

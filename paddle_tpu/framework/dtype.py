@@ -18,6 +18,8 @@ _CANONICAL = {
     "uint8": "uint8",
     "uint32": "uint32",
     "bool": "bool",
+    "complex64": "complex64",
+    "complex128": "complex128",
     # numpy aliases
     "float": "float32",
     "double": "float64",
@@ -29,7 +31,7 @@ _CANONICAL = {
 _PROTO_ENUM = {
     "bool": 0, "int16": 1, "int32": 2, "int64": 3, "float16": 4,
     "float32": 5, "float64": 6, "uint8": 20, "int8": 21, "bfloat16": 22,
-    "uint32": 23,
+    "uint32": 23, "complex64": 24, "complex128": 25,
 }
 _ENUM_TO_NAME = {v: k for k, v in _PROTO_ENUM.items()}
 

@@ -427,3 +427,25 @@ def beam_search_decode(ctx, ins, attrs):
     sent = jnp.flip(toks, axis=0)                      # [T, B, beam]
     return {"SentenceIds": jnp.transpose(sent, (1, 2, 0)),
             "SentenceScores": scores[-1]}
+
+
+@register_op("runtime_assert", grad=False, infer_shape=False)
+def runtime_assert(ctx, ins, attrs):
+    """Host-checked runtime assertion: raises `msg` when Cond is false.
+    The [1] int64 zero output exists to be folded into downstream values
+    so XLA cannot dead-code-eliminate the check (used by the
+    dygraph_to_static tensor-list overflow guard; the reference's analog
+    is PADDLE_ENFORCE inside its CPU kernels)."""
+    import numpy as _np
+    cond = x_of(ins, "Cond")
+    msg = attrs.get("msg", "runtime_assert failed")
+
+    def chk(c):
+        if not bool(_np.asarray(c).reshape(-1)[0]):
+            raise RuntimeError(msg)
+        # int32: a 64-bit callback result needs jax_enable_x64
+        return _np.zeros((1,), _np.int32)
+
+    out = jax.pure_callback(
+        chk, jax.ShapeDtypeStruct((1,), _np.int32), cond)
+    return {"Out": out}

@@ -1,0 +1,127 @@
+"""paddle.complex namespace + ComplexVariable (reference
+python/paddle/complex/ + framework.py:1683): numpy-parity for the
+elementwise ops, kron, matmul, reshape/transpose, in dygraph (the
+reference's only mode) and over static Variables."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+RNG = np.random.default_rng(11)
+
+
+def _cx(shape):
+    return (RNG.standard_normal(shape)
+            + 1j * RNG.standard_normal(shape)).astype(np.complex64)
+
+
+def test_to_variable_roundtrip_and_metadata():
+    a = _cx((2, 3))
+    with dygraph.guard():
+        v = dygraph.to_variable(a, name="zed")
+        assert isinstance(v, fluid.ComplexVariable)
+        assert fluid.complex.is_complex(v)
+        assert not fluid.complex.is_complex(v.real)
+        assert fluid.complex.is_real(v.real)
+        assert v.dtype == "complex64"
+        assert tuple(v.shape) == (2, 3)
+        assert v.name["real"] == "zed.real"
+        np.testing.assert_allclose(v.numpy(), a, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("elementwise_add", np.add),
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+])
+def test_elementwise_numpy_parity(op, npop):
+    a, b = _cx((3, 4)), _cx((3, 4))
+    with dygraph.guard():
+        va, vb = dygraph.to_variable(a), dygraph.to_variable(b)
+        out = getattr(fluid.complex, op)(va, vb)
+        np.testing.assert_allclose(out.numpy(), npop(a, b),
+                                   rtol=1e-5, atol=1e-6)
+        # complex (op) real mixes too
+        r = RNG.standard_normal((3, 4)).astype(np.float32)
+        out2 = getattr(fluid.complex, op)(va, dygraph.to_variable(r))
+        np.testing.assert_allclose(out2.numpy(), npop(a, r),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_real_op_complex_required():
+    with dygraph.guard():
+        r = dygraph.to_variable(np.ones((2, 2), np.float32))
+        with pytest.raises(ValueError, match="ComplexVariable"):
+            fluid.complex.elementwise_add(r, r)
+
+
+def test_kron_numpy_parity():
+    a, b = _cx((2, 3)), _cx((3, 2))
+    with dygraph.guard():
+        out = fluid.complex.kron(dygraph.to_variable(a),
+                                 dygraph.to_variable(b))
+        np.testing.assert_allclose(out.numpy(), np.kron(a, b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_numpy_parity():
+    a, b = _cx((2, 5)), _cx((5, 3))
+    with dygraph.guard():
+        out = fluid.complex.matmul(dygraph.to_variable(a),
+                                   dygraph.to_variable(b))
+        np.testing.assert_allclose(out.numpy(), a @ b,
+                                   rtol=1e-4, atol=1e-5)
+        # complex @ real
+        r = RNG.standard_normal((5, 3)).astype(np.float32)
+        out2 = fluid.complex.matmul(dygraph.to_variable(a),
+                                    dygraph.to_variable(r))
+        np.testing.assert_allclose(out2.numpy(), a @ r,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_reshape_transpose():
+    a = _cx((2, 6))
+    with dygraph.guard():
+        v = dygraph.to_variable(a)
+        rs = fluid.complex.reshape(v, [3, 4])
+        np.testing.assert_allclose(rs.numpy(), a.reshape(3, 4), rtol=1e-6)
+        tp = fluid.complex.transpose(v, [1, 0])
+        np.testing.assert_allclose(tp.numpy(), a.T, rtol=1e-6)
+
+
+def test_static_mode_complex_pair():
+    """ComplexVariable over static Variables: build, run, compare —
+    capability beyond the reference's dygraph-only restriction."""
+    a, b = _cx((2, 2)), _cx((2, 2))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xr = fluid.data("xr", [2, 2], "float32")
+        xi = fluid.data("xi", [2, 2], "float32")
+        yr = fluid.data("yr", [2, 2], "float32")
+        yi = fluid.data("yi", [2, 2], "float32")
+        x = fluid.ComplexVariable(xr, xi)
+        y = fluid.ComplexVariable(yr, yi)
+        out = fluid.complex.elementwise_mul(x, y)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rv, iv = exe.run(main, feed={
+            "xr": a.real.copy(), "xi": a.imag.copy(),
+            "yr": b.real.copy(), "yi": b.imag.copy()},
+            fetch_list=[out.real, out.imag])
+    np.testing.assert_allclose(np.asarray(rv) + 1j * np.asarray(iv),
+                               a * b, rtol=1e-5, atol=1e-6)
+
+
+def test_complex_dtype_in_registry():
+    """complex64/128 are first-class dtype names (registry/serialization
+    support for custom complex-dtype ops)."""
+    from paddle_tpu.framework.dtype import convert_dtype, \
+        dtype_to_proto_enum, np_dtype
+    assert convert_dtype("complex64") == "complex64"
+    assert convert_dtype(np.complex128) == "complex128"
+    assert np_dtype("complex64") == np.complex64
+    assert dtype_to_proto_enum("complex64") != dtype_to_proto_enum(
+        "complex128")

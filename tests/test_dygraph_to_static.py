@@ -370,3 +370,179 @@ def test_return_in_static_branch():
             out, = exe.run(main, feed={feeds[0]: arr},
                            fetch_list=fetches)
         np.testing.assert_allclose(np.asarray(out), arr * factor)
+
+
+def test_list_append_in_data_dependent_loop():
+    """The list transformer (reference list_transformer.py): appends
+    inside a tensor-bound loop become fixed-capacity tensor-list state
+    (scatter + count), producing a data-dependent While program — NOT a
+    trace-unrolled one."""
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs[1]
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(8):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([4], np.int64))
+    types = _op_types(main)
+    assert "while" in types, types          # data-dependent loop
+    assert "scatter" in types, types        # tensor-list append
+    exe = fluid.Executor()
+    # outs[1] = x after two doublings = 4; reruns with n=3 reuse the
+    # SAME program (data-dependence, not baked trip count)
+    for n, expect in ((4, 4.0), (3, 4.0)):
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main,
+                           feed={feeds[0]: np.ones((2,), np.float32),
+                                 feeds[1]: np.array([n], np.int64)},
+                           fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   [expect, expect])
+
+
+def test_list_stack_and_length_in_loop():
+    """Decoder-style accumulate: stack() exposes the dense buffer,
+    len(outs) the live count (convert_len)."""
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs.stack(), len(outs)
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(4):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([3], np.int64))
+    assert "while" in _op_types(main)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        buf, cnt = exe.run(main,
+                           feed={feeds[0]: np.ones((2,), np.float32),
+                                 feeds[1]: np.array([3], np.int64)},
+                           fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(buf),
+                               [[2, 2], [4, 4], [8, 8], [0, 0]])
+    assert int(np.asarray(cnt).reshape(-1)[0]) == 3
+
+
+def test_list_append_capacity_required():
+    """Without a declared capacity the conversion raises the actionable
+    ConversionError (no silent truncation, no baffling trace failure)."""
+    import pytest
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs[0]
+
+    pt = dygraph.ProgramTranslator()
+    with pytest.raises(ValueError, match="list_capacity"):
+        pt.get_program(fn, np.ones((2,), np.float32),
+                       np.array([2], np.int64))
+
+
+def test_nested_call_with_loop_list():
+    """Call transformer x list transformer: a helper function containing
+    a data-dependent loop-list is converted through convert_call."""
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        y = _collect_scaled(x, n)
+        return layers.scale(y, scale=1.0)
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(8):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([3], np.int64))
+    types = _op_types(main)
+    assert "while" in types and "scatter" in types, types
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
+                                   feeds[1]: np.array([3], np.int64)},
+                       fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [8.0, 8.0])
+
+
+def _collect_scaled(x, n):
+    outs = []
+    for i in range(n):
+        x = layers.scale(x, scale=2.0)
+        outs.append(x)
+    return outs[2]
+
+
+def test_list_negative_index_reads_live_end():
+    """outs[-1] resolves against the live length (decoder pattern)."""
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs[-1]
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(8):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([3], np.int64))
+    assert "while" in _op_types(main)
+    exe = fluid.Executor()
+    for n, expect in ((3, 8.0), (2, 4.0)):
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main,
+                           feed={feeds[0]: np.ones((2,), np.float32),
+                                 feeds[1]: np.array([n], np.int64)},
+                           fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   [expect, expect])
+
+
+def test_list_capacity_overflow_raises():
+    """Appending past the declared capacity fails loudly at run time
+    (runtime_assert) — never silent truncation."""
+    import pytest
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs.stack()
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(2):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([2], np.int64))
+    exe = fluid.Executor()
+    # within capacity: fine
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
+                                   feeds[1]: np.array([2], np.int64)},
+                       fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(out), [[2, 2], [4, 4]])
+    # 4 appends into capacity 2: loud failure
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(Exception, match="list_capacity|overflowed"):
+            exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
+                                feeds[1]: np.array([4], np.int64)},
+                    fetch_list=fetches)
