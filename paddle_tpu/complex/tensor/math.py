@@ -17,18 +17,21 @@ def _parts(v):
     return v, None
 
 
+def _zeros_of(v):
+    """A zeros stand-in for a real operand's missing imaginary part, so
+    imag broadcasts exactly like real does."""
+    return M.scale(v, 0.0)
+
+
 def elementwise_add(x, y, axis=-1, name=None):
     """Complex (x + y) (reference math.py:27)."""
     complex_variable_exists([x, y], "elementwise_add")
     xr, xi = _parts(x)
     yr, yi = _parts(y)
     real = M.elementwise_add(xr, yr, axis=axis)
-    if xi is None:
-        imag = yi
-    elif yi is None:
-        imag = xi
-    else:
-        imag = M.elementwise_add(xi, yi, axis=axis)
+    imag = M.elementwise_add(xi if xi is not None else _zeros_of(xr),
+                             yi if yi is not None else _zeros_of(yr),
+                             axis=axis)
     return ComplexVariable(real, imag)
 
 
@@ -38,12 +41,9 @@ def elementwise_sub(x, y, axis=-1, name=None):
     xr, xi = _parts(x)
     yr, yi = _parts(y)
     real = M.elementwise_sub(xr, yr, axis=axis)
-    if yi is None:
-        imag = xi
-    elif xi is None:
-        imag = M.scale(yi, -1.0)
-    else:
-        imag = M.elementwise_sub(xi, yi, axis=axis)
+    imag = M.elementwise_sub(xi if xi is not None else _zeros_of(xr),
+                             yi if yi is not None else _zeros_of(yr),
+                             axis=axis)
     return ComplexVariable(real, imag)
 
 

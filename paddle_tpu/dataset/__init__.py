@@ -11,6 +11,11 @@ DETERMINISTIC SYNTHETIC sample stream with the real dataset's shapes,
 dtypes, vocabulary sizes and label ranges — enough for the book tests'
 convergence gates and any pipeline code, clearly not for real accuracy
 numbers."""
-from . import mnist, cifar, imdb, imikolov, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar, conll05, flowers, image, imdb, imikolov, mnist, movielens,
+    mq2007, sentiment, uci_housing, voc2012, wmt14, wmt16,
+)
 
-__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing"]
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing",
+           "conll05", "movielens", "sentiment", "wmt14", "wmt16",
+           "flowers", "voc2012", "mq2007", "image"]

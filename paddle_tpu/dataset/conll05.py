@@ -1,0 +1,113 @@
+"""CoNLL-2005 semantic-role-labeling reader creators (reference
+python/paddle/dataset/conll05.py: test() yields nine aligned features —
+word_idx, five predicate-context sequences, pred_idx, mark, label_idx;
+get_dict() -> (word, verb, label) dicts; get_embedding() -> pretrained
+word vectors). Synthetic stream policy: deterministic sentences whose
+role labels are a fixed function of position relative to the predicate,
+so an SRL tagger genuinely learns."""
+import numpy as np
+
+from . import common
+
+UNK_IDX = 0
+
+_WORDS = 4000
+_VERBS = 200
+# B-V plus BIO argument tags (a compact subset of the PropBank label set)
+_LABELS = ["O", "B-V", "B-A0", "I-A0", "B-A1", "I-A1", "B-A2", "I-A2",
+           "B-AM-TMP", "I-AM-TMP"]
+_TEST_N = 800
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) (reference :205)."""
+    word_dict = {"<unk>": UNK_IDX, "bos": 1, "eos": 2}
+    word_dict.update({f"w{i}": i + 3 for i in range(_WORDS - 3)})
+    verb_dict = {f"v{i}": i for i in range(_VERBS)}
+    label_dict = {l: i for i, l in enumerate(_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Deterministic pretrained-style embedding table [words, 32]."""
+    rng = common.synthetic_rng("conll05", "emb")
+    return rng.standard_normal((_WORDS, 32)).astype(np.float32)
+
+
+def corpus_reader():
+    """(sentence words, predicate, labels) triples."""
+    def reader():
+        rng = common.synthetic_rng("conll05", "test")
+        word_dict, verb_dict, label_dict = get_dict()
+        words = list(word_dict)
+        verbs = list(verb_dict)
+        for _ in range(_TEST_N):
+            ln = int(rng.integers(5, 30))
+            sent = [words[3 + int(rng.integers(0, _WORDS - 3))]
+                    for _ in range(ln)]
+            vi = int(rng.integers(0, ln))
+            labels = ["O"] * ln
+            labels[vi] = "B-V"
+            # deterministic role structure around the predicate
+            if vi >= 1:
+                labels[vi - 1] = "B-A0"
+            if vi >= 2:
+                labels[vi - 2] = "I-A0" if labels[vi - 2] == "O" else \
+                    labels[vi - 2]
+            if vi + 1 < ln:
+                labels[vi + 1] = "B-A1"
+            if vi + 2 < ln:
+                labels[vi + 2] = "I-A1"
+            pred = verbs[int(rng.integers(0, _VERBS))]
+            yield sent, pred, labels
+    return reader
+
+
+def reader_creator(corpus, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    """Nine aligned sequences per sample (reference :150)."""
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * len(labels)
+            ctx_n1 = sentence[verb_index - 1] if verb_index > 0 else "bos"
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+            ctx_n2 = sentence[verb_index - 2] if verb_index > 1 else "bos"
+            if verb_index > 1:
+                mark[verb_index - 2] = 1
+            mark[verb_index] = 1
+            ctx_0 = sentence[verb_index]
+            ctx_p1 = sentence[verb_index + 1] \
+                if verb_index < len(labels) - 1 else "eos"
+            if verb_index < len(labels) - 1:
+                mark[verb_index + 1] = 1
+            ctx_p2 = sentence[verb_index + 2] \
+                if verb_index < len(labels) - 2 else "eos"
+            if verb_index < len(labels) - 2:
+                mark[verb_index + 2] = 1
+
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx_n2_idx = [word_dict.get(ctx_n2, UNK_IDX)] * sen_len
+            ctx_n1_idx = [word_dict.get(ctx_n1, UNK_IDX)] * sen_len
+            ctx_0_idx = [word_dict.get(ctx_0, UNK_IDX)] * sen_len
+            ctx_p1_idx = [word_dict.get(ctx_p1, UNK_IDX)] * sen_len
+            ctx_p2_idx = [word_dict.get(ctx_p2, UNK_IDX)] * sen_len
+            pred_idx = [predicate_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+            yield (word_idx, ctx_n2_idx, ctx_n1_idx, ctx_0_idx,
+                   ctx_p1_idx, ctx_p2_idx, pred_idx, mark, label_idx)
+    return reader
+
+
+def test():
+    """Reference uses the test split for training (the train set is not
+    free); same here (reference :225)."""
+    word_dict, verb_dict, label_dict = get_dict()
+    return reader_creator(corpus_reader(), word_dict, verb_dict,
+                          label_dict)
+
+
+def fetch():
+    return None

@@ -1,0 +1,59 @@
+"""Oxford-102 flowers reader creators (reference
+python/paddle/dataset/flowers.py: train()/test()/valid() yield
+(image chw float32, label 0..101)). Synthetic stream policy:
+class-conditional color/texture statistics so an image classifier
+genuinely separates classes."""
+import numpy as np
+
+from . import common
+
+_CLASSES = 102
+_HW = 32          # synthetic resolution (reference center-crops larger)
+_TRAIN_N, _TEST_N, _VAL_N = 2040, 1020, 1020
+
+
+def _sample(rng, label):
+    base = common.synthetic_rng("flowers", f"class/{label}")
+    mean = base.random(3).astype(np.float32)          # per-class color
+    freq = 1 + int(label % 7)                          # per-class texture
+    yy, xx = np.mgrid[0:_HW, 0:_HW].astype(np.float32) / _HW
+    tex = 0.25 * np.sin(2 * np.pi * freq * (yy + xx))
+    img = mean[:, None, None] + tex[None] \
+        + 0.1 * rng.standard_normal((3, _HW, _HW)).astype(np.float32)
+    return np.clip(img, 0.0, 1.0).astype(np.float32)
+
+
+def reader_creator(split, n, mapper=None, buffered_size=1024,
+                   use_xmap=False, cycle=False):
+    def reader():
+        while True:
+            rng = common.synthetic_rng("flowers", split)
+            for _ in range(n):
+                label = int(rng.integers(0, _CLASSES))
+                img = _sample(rng, label)
+                sample = (img, label)
+                if mapper is not None:
+                    sample = mapper(sample)
+                yield sample
+            if not cycle:
+                break
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return reader_creator("train", _TRAIN_N, mapper, buffered_size,
+                          use_xmap, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return reader_creator("test", _TEST_N, mapper, buffered_size,
+                          use_xmap, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return reader_creator("valid", _VAL_N, mapper, buffered_size,
+                          use_xmap)
+
+
+def fetch():
+    return None

@@ -1,0 +1,157 @@
+"""Image preprocessing utilities (reference
+python/paddle/dataset/image.py: resize_short, to_chw, center_crop,
+random_crop, left_right_flip, simple_transform, load_and_transform,
+load_image/load_image_bytes, batch_images_from_tar). The reference
+shells out to cv2; these are numpy-native (bilinear resize), with the
+file/bytes decoders gated on an optional cv2/PIL install — everything a
+training pipeline calls per-sample works with no image library."""
+import numpy as np
+
+__all__ = [
+    "load_image_bytes", "load_image", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform", "batch_images_from_tar",
+]
+
+
+def _decoder():
+    try:
+        import cv2
+        return ("cv2", cv2)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        return ("pil", Image)
+    except ImportError:
+        return (None, None)
+
+
+def load_image_bytes(data, is_color=True):
+    """Decode encoded image bytes to an HWC uint8 array (reference
+    :141). Needs cv2 or PIL; raises a guided error without them."""
+    kind, mod = _decoder()
+    if kind == "cv2":
+        flag = 1 if is_color else 0
+        arr = np.frombuffer(data, dtype="uint8")
+        return mod.imdecode(arr, flag)
+    if kind == "pil":
+        import io
+        img = mod.open(io.BytesIO(data))
+        img = img.convert("RGB" if is_color else "L")
+        return np.asarray(img)
+    raise ImportError(
+        "decoding image bytes needs cv2 or PIL (neither installed); "
+        "the numpy-native transforms (resize_short/center_crop/...) "
+        "work on already-decoded arrays")
+
+
+def load_image(file, is_color=True):
+    """Load an image file to HWC uint8 (reference :167)."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize_bilinear(im, h, w):
+    """HWC (or HW) bilinear resize, pure numpy."""
+    if im.ndim == 2:
+        im = im[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    H, W = im.shape[:2]
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = im.astype(np.float32)
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if squeeze:
+        out = out[:, :, 0]
+    return out
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge is `size`, keeping aspect (reference
+    :197)."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_h, new_w = int(round(h * size / w)), size
+    else:
+        new_h, new_w = size, int(round(w * size / h))
+    return _resize_bilinear(im, new_h, new_w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (reference :225)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """Crop the center size x size patch (reference :249)."""
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """Crop a random size x size patch (reference :277)."""
+    rng = rng or np.random.default_rng()
+    h, w = im.shape[:2]
+    h_start = int(rng.integers(0, h - size + 1))
+    w_start = int(rng.integers(0, w - size + 1))
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    """Mirror horizontally (reference :305)."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> (random|center) crop -> maybe flip -> CHW ->
+    mean-subtract (reference :327)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        rng = rng or np.random.default_rng()
+        im = random_crop(im, crop_size, is_color, rng=rng)
+        if rng.random() > 0.5:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """load_image + simple_transform (reference :383)."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Reference :80 pre-batches a tar of images into pickled batches.
+    That is a host-side packing utility for a disk layout this framework
+    does not use (DataLoader streams readers); raise with guidance."""
+    raise NotImplementedError(
+        "batch_images_from_tar packs a tar archive into pickle batches "
+        "(a Paddle-specific disk layout); stream the images through a "
+        "reader + DataLoader instead")

@@ -1,0 +1,147 @@
+"""MovieLens-1M reader creators (reference
+python/paddle/dataset/movielens.py: train()/test() yield
+usr.value() + mov.value() + [[rating]] = [uid, gender, age_bucket, job,
+mov_id, [category ids], [title word ids], [rating]]; plus the meta
+accessors max_user_id/max_movie_id/max_job_id/movie_categories/
+user_info/movie_info/get_movie_title_dict). Synthetic stream policy:
+a deterministic population with a low-rank taste model so recommender
+models genuinely fit."""
+import functools
+
+import numpy as np
+
+from . import common
+
+__all__ = [
+    "train", "test", "get_movie_title_dict", "max_movie_id",
+    "max_user_id", "age_table", "movie_categories", "max_job_id",
+    "user_info", "movie_info",
+]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_N_USERS, _N_MOVIES, _N_JOBS = 600, 400, 21
+_CATEGORIES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+_TITLE_VOCAB = 512
+_RATINGS_N = 8000
+
+
+class MovieInfo:
+    """Movie id, title-word ids and category ids (reference :48)."""
+
+    def __init__(self, index, categories, title_ids):
+        self.index = int(index)
+        self.categories = categories        # category id list
+        self.title = title_ids              # title word-id list
+
+    def value(self):
+        return [self.index, list(self.categories), list(self.title)]
+
+    def __repr__(self):
+        return f"<MovieInfo id({self.index})>"
+
+
+class UserInfo:
+    """User id, gender flag, age bucket, job id (reference :74)."""
+
+    def __init__(self, index, is_male, age_bucket, job_id):
+        self.index = int(index)
+        self.is_male = bool(is_male)
+        self.age = int(age_bucket)
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return f"<UserInfo id({self.index})>"
+
+
+_META = None
+
+
+def _meta():
+    global _META
+    if _META is None:
+        rng = common.synthetic_rng("movielens", "meta")
+        users = {}
+        for uid in range(1, _N_USERS + 1):
+            users[uid] = UserInfo(uid, rng.random() < 0.6,
+                                  rng.integers(0, len(age_table)),
+                                  rng.integers(0, _N_JOBS))
+        movies = {}
+        for mid in range(1, _N_MOVIES + 1):
+            n_cat = int(rng.integers(1, 4))
+            cats = sorted(rng.choice(len(_CATEGORIES), n_cat,
+                                     replace=False).tolist())
+            n_tw = int(rng.integers(1, 6))
+            title = rng.integers(0, _TITLE_VOCAB, n_tw).tolist()
+            movies[mid] = MovieInfo(mid, cats, title)
+        # low-rank taste model: rating = clip(u . m)
+        uf = rng.standard_normal((_N_USERS + 1, 4))
+        mf = rng.standard_normal((_N_MOVIES + 1, 4))
+        _META_local = {"users": users, "movies": movies,
+                       "uf": uf, "mf": mf}
+        _META = _META_local
+    return _META
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    meta = _meta()
+    rng = common.synthetic_rng("movielens",
+                               f"ratings/{rand_seed}")
+    for _ in range(_RATINGS_N):
+        uid = int(rng.integers(1, _N_USERS + 1))
+        mid = int(rng.integers(1, _N_MOVIES + 1))
+        in_test = rng.random() < test_ratio
+        if in_test != is_test:
+            continue
+        raw = float(meta["uf"][uid] @ meta["mf"][mid])
+        rating = float(np.clip(np.round(raw + 3.0), 1, 5) * 2 - 5.0)
+        usr, mov = meta["users"][uid], meta["movies"][mid]
+        yield usr.value() + mov.value() + [[rating]]
+
+
+def __reader_creator__(**kwargs):
+    return lambda: __reader__(**kwargs)
+
+
+train = functools.partial(__reader_creator__, is_test=False)
+test = functools.partial(__reader_creator__, is_test=True)
+
+
+def get_movie_title_dict():
+    return {f"title_{i}": i for i in range(_TITLE_VOCAB)}
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(_CATEGORIES)}
+
+
+def user_info():
+    return list(_meta()["users"].values())
+
+
+def movie_info():
+    return list(_meta()["movies"].values())
+
+
+def fetch():
+    return None

@@ -1,0 +1,101 @@
+"""MQ2007 learning-to-rank reader creators (reference
+python/paddle/dataset/mq2007.py: train/test are generator functions
+yielding per `format` — "pointwise" (score, 46-dim vector), "pairwise"
+(label, left vec, right vec), "listwise" (score list, vector list),
+"plain_txt" (query_id, relevance, features)). Synthetic stream policy:
+deterministic queries with a linear relevance model so rankers fit."""
+import functools
+
+import numpy as np
+
+from . import common
+
+_FEATS = 46
+_QUERIES = {"train": 120, "test": 40}
+_DOCS_PER_QUERY = (5, 15)
+
+
+class QueryList:
+    """One query's documents (reference Query/QueryList, simplified to
+    the fields the generators read)."""
+
+    def __init__(self, query_id, scores, vectors):
+        self.query_id = query_id
+        self.relevance_score_list = scores
+        self.feature_vector_list = vectors
+
+    def __len__(self):
+        return len(self.relevance_score_list)
+
+
+def _querylists(split):
+    rng = common.synthetic_rng("mq2007", split)
+    w = common.synthetic_rng("mq2007", "w").standard_normal(_FEATS)
+    out = []
+    for qid in range(_QUERIES[split]):
+        n = int(rng.integers(*_DOCS_PER_QUERY))
+        vecs = [rng.standard_normal(_FEATS).astype(np.float64)
+                for _ in range(n)]
+        scores = [int(np.clip(np.round(v @ w / _FEATS ** 0.5 + 1), 0, 2))
+                  for v in vecs]
+        out.append(QueryList(qid, scores, vecs))
+    return out
+
+
+def gen_plain_txt(querylist):
+    for score, vec in zip(querylist.relevance_score_list,
+                          querylist.feature_vector_list):
+        yield querylist.query_id, score, np.array(vec)
+
+
+def gen_point(querylist):
+    for score, vec in zip(querylist.relevance_score_list,
+                          querylist.feature_vector_list):
+        yield score, np.array(vec)
+
+
+def gen_pair(querylist, partial_order="full"):
+    for i, (si, vi) in enumerate(zip(querylist.relevance_score_list,
+                                     querylist.feature_vector_list)):
+        for j in range(i + 1, len(querylist)):
+            sj = querylist.relevance_score_list[j]
+            vj = querylist.feature_vector_list[j]
+            if si == sj:
+                continue
+            if si > sj:
+                yield np.array([1.0]), np.array(vi), np.array(vj)
+            else:
+                yield np.array([1.0]), np.array(vj), np.array(vi)
+
+
+def gen_list(querylist):
+    yield (np.array(querylist.relevance_score_list),
+           np.array(querylist.feature_vector_list))
+
+
+def query_filter(querylists):
+    """Drop queries whose docs all share one relevance (reference
+    :252 — they carry no ranking signal)."""
+    return [q for q in querylists
+            if len(set(q.relevance_score_list)) > 1]
+
+
+def __reader__(split, format="pairwise", shuffle=False, fill_missing=-1):
+    for querylist in query_filter(_querylists(split)):
+        if format == "plain_txt":
+            yield next(gen_plain_txt(querylist))
+        elif format == "pointwise":
+            yield next(gen_point(querylist))
+        elif format == "pairwise":
+            for pair in gen_pair(querylist):
+                yield pair
+        elif format == "listwise":
+            yield next(gen_list(querylist))
+
+
+train = functools.partial(__reader__, split="train")
+test = functools.partial(__reader__, split="test")
+
+
+def fetch():
+    return None

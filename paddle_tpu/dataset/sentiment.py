@@ -1,0 +1,46 @@
+"""IMDB-class movie-review sentiment reader creators (reference
+python/paddle/dataset/sentiment.py: train()/test() yield
+(word-id list, label 0/1), get_word_dict()). Synthetic stream policy
+(dataset/common.py): deterministic class-conditional word distributions
+so a bag-of-words classifier genuinely separates the classes."""
+import numpy as np
+
+from . import common
+
+_VOCAB = 5124
+_TRAIN_N, _TEST_N = 1600, 400
+NUM_TRAINING_INSTANCES = _TRAIN_N
+NUM_TEST_INSTANCES = _TEST_N
+
+
+def get_word_dict():
+    """word -> id, most frequent first (reference :70)."""
+    return {f"word_{i:05d}": i for i in range(_VOCAB)}
+
+
+def _reader(split, n):
+    def reader():
+        rng = common.synthetic_rng("sentiment", split)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.integers(0, 2))
+            ln = int(rng.integers(8, 120))
+            # positive reviews skew to the lower half of the vocab
+            base = rng.integers(0, half, ln)
+            flip = rng.random(ln) < 0.25
+            ids = np.where(flip, base + half, base) if label \
+                else np.where(flip, base, base)
+            yield [int(i) for i in ids], label
+    return reader
+
+
+def train():
+    return _reader("train", _TRAIN_N)
+
+
+def test():
+    return _reader("test", _TEST_N)
+
+
+def fetch():
+    return None

@@ -1,0 +1,52 @@
+"""PASCAL VOC2012 segmentation reader creators (reference
+python/paddle/dataset/voc2012.py: train()/test()/val() yield
+(image chw float32, label mask hw int32, 0..20 + 255 ignore)).
+Synthetic stream policy: deterministic scenes of colored rectangles
+whose mask is exactly recoverable from the image."""
+import numpy as np
+
+from . import common
+
+_CLASSES = 21
+_HW = 64
+_TRAIN_N, _TEST_N, _VAL_N = 600, 150, 150
+
+
+def _scene(rng):
+    img = np.zeros((3, _HW, _HW), np.float32)
+    mask = np.zeros((_HW, _HW), np.int32)
+    for _ in range(int(rng.integers(1, 4))):
+        cls = int(rng.integers(1, _CLASSES))
+        h0, w0 = rng.integers(0, _HW - 8, 2)
+        h1 = int(h0 + rng.integers(6, _HW - h0))
+        w1 = int(w0 + rng.integers(6, _HW - w0))
+        color = common.synthetic_rng("voc2012",
+                                     f"class/{cls}").random(3)
+        img[:, h0:h1, w0:w1] = color[:, None, None]
+        mask[h0:h1, w0:w1] = cls
+    img += 0.02 * rng.standard_normal(img.shape).astype(np.float32)
+    return np.clip(img, 0, 1).astype(np.float32), mask
+
+
+def reader_creator(split, n):
+    def reader():
+        rng = common.synthetic_rng("voc2012", split)
+        for _ in range(n):
+            yield _scene(rng)
+    return reader
+
+
+def train():
+    return reader_creator("train", _TRAIN_N)
+
+
+def test():
+    return reader_creator("test", _TEST_N)
+
+
+def val():
+    return reader_creator("val", _VAL_N)
+
+
+def fetch():
+    return None

@@ -1,0 +1,56 @@
+"""WMT14 en->fr reader creators (reference
+python/paddle/dataset/wmt14.py: train/test(dict_size) yield
+(src_ids, trg_ids, trg_ids_next); <s>=0, <e>=1, <unk>=2). Synthetic
+stream policy: deterministic "translation" pairs where trg is a fixed
+affine remap of src, so seq2seq models can genuinely fit."""
+import numpy as np
+
+from . import common
+
+WORDDICT = 30000
+_TRAIN_N, _TEST_N = 2000, 400
+
+
+def _pair(rng, dict_size):
+    ln = int(rng.integers(3, 25))
+    src = rng.integers(3, dict_size, ln)
+    # deterministic "translation": affine remap into the dict
+    trg_core = (src * 7 + 13) % (dict_size - 3) + 3
+    src_ids = [int(i) for i in src]
+    trg_ids = [0] + [int(i) for i in trg_core]            # <s> + words
+    trg_next = [int(i) for i in trg_core] + [1]           # words + <e>
+    return src_ids, trg_ids, trg_next
+
+
+def reader_creator(split, n, dict_size):
+    def reader():
+        rng = common.synthetic_rng("wmt14", f"{split}/{dict_size}")
+        for _ in range(n):
+            yield _pair(rng, dict_size)
+    return reader
+
+
+def train(dict_size):
+    return reader_creator("train", _TRAIN_N, dict_size)
+
+
+def test(dict_size):
+    return reader_creator("test", _TEST_N, dict_size)
+
+
+def gen(dict_size):
+    return reader_creator("gen", _TEST_N, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); reverse=True -> id->word (reference :155)."""
+    words = {0: "<s>", 1: "<e>", 2: "<unk>"}
+    words.update({i: f"w{i}" for i in range(3, dict_size)})
+    if reverse:
+        return dict(words), dict(words)
+    inv = {w: i for i, w in words.items()}
+    return dict(inv), dict(inv)
+
+
+def fetch():
+    return None

@@ -1,0 +1,60 @@
+"""WMT16 en<->de reader creators (reference
+python/paddle/dataset/wmt16.py: train/test/validation with separate
+src/trg dict sizes and src_lang selection; yields
+(src_ids, trg_ids, trg_ids_next)). Synthetic stream policy."""
+import numpy as np
+
+from . import common
+
+_TRAIN_N, _TEST_N, _VAL_N = 2000, 400, 400
+
+
+def _check(src_dict_size, trg_dict_size, src_lang):
+    if src_lang not in ("en", "de"):
+        raise ValueError("src_lang must be 'en' or 'de'")
+    return int(src_dict_size), int(trg_dict_size)
+
+
+def reader_creator(split, n, src_dict_size, trg_dict_size, src_lang):
+    src_dict_size, trg_dict_size = _check(src_dict_size, trg_dict_size,
+                                          src_lang)
+
+    def reader():
+        rng = common.synthetic_rng(
+            "wmt16", f"{split}/{src_dict_size}/{trg_dict_size}/{src_lang}")
+        for _ in range(n):
+            ln = int(rng.integers(3, 25))
+            src = rng.integers(3, src_dict_size, ln)
+            trg_core = (src * 11 + 7) % (trg_dict_size - 3) + 3
+            yield ([int(i) for i in src],
+                   [0] + [int(i) for i in trg_core],
+                   [int(i) for i in trg_core] + [1])
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return reader_creator("train", _TRAIN_N, src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return reader_creator("test", _TEST_N, src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return reader_creator("val", _VAL_N, src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    """word<->id table for `lang` (reference :292)."""
+    words = {0: "<s>", 1: "<e>", 2: "<unk>"}
+    words.update({i: f"{lang}_{i}" for i in range(3, int(dict_size))})
+    if reverse:
+        return dict(words)
+    return {w: i for i, w in words.items()}
+
+
+def fetch():
+    return None

@@ -580,7 +580,10 @@ class DygraphToStaticAst(ast.NodeTransformer):
         `name = convert_list_append(name, expr)` (reference
         list_transformer): the rebinding makes the list visible to the
         loop/branch write analysis, so it turns into tensor-list loop
-        state inside data-dependent control flow."""
+        state inside data-dependent control flow. Only FUNCTION-LOCAL
+        names are rewritten — rebinding a global/closure list would make
+        it local (UnboundLocalError) and break its in-place mutation
+        semantics."""
         self.generic_visit(node)
         call = node.value
         if (isinstance(call, ast.Call)
@@ -589,10 +592,11 @@ class DygraphToStaticAst(ast.NodeTransformer):
                 and isinstance(call.func.value, ast.Name)
                 and len(call.args) == 1 and not call.keywords):
             name = call.func.value.id
-            return ast.Assign(
-                targets=[_store(name)],
-                value=_jst_call("convert_list_append",
-                                [_load(name), call.args[0]]))
+            if name in getattr(self, "_fn_locals", ()):
+                return ast.Assign(
+                    targets=[_store(name)],
+                    value=_jst_call("convert_list_append",
+                                    [_load(name), call.args[0]]))
         return node
 
 
@@ -608,7 +612,14 @@ def convert_to_static(fn):
     # returns inside control flow lower to a (flag, value) pair BEFORE
     # the control-flow conversion (reference return_transformer.py)
     _ReturnRewriter.rewrite_function(fdef)
-    new_tree = DygraphToStaticAst().visit(tree)
+    transformer = DygraphToStaticAst()
+    # function-local names (params + assignments): the append rewrite
+    # must not touch global/closure lists
+    params = [a.arg for a in fdef.args.args] + \
+        [a.arg for a in fdef.args.posonlyargs] + \
+        [a.arg for a in fdef.args.kwonlyargs]
+    transformer._fn_locals = set(params) | set(_assigned_names(fdef.body))
+    new_tree = transformer.visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dygraph_to_static:{fn.__name__}>",
                    mode="exec")

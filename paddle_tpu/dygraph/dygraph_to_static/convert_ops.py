@@ -124,7 +124,17 @@ def convert_while(test_fn, body_fn, init, names):
                     _overflow_guards.append(root)
                     continue
                 if isinstance(var, list) and isinstance(nv, list):
-                    continue   # list never appended in the body
+                    if nv is var or not nv:
+                        continue   # list never appended in the body
+                    # python-VALUE appends inside a data-dependent loop
+                    # have no static representation (they'd silently
+                    # keep only one iteration's worth)
+                    raise ConversionError(
+                        f"dygraph_to_static: list {n!r} collects python "
+                        f"values inside a data-dependent loop — only "
+                        f"tensor appends can become loop state; append "
+                        f"Variables, or keep the loop bound a python "
+                        f"int")
                 if not _static_var(nv):
                     # python scalar write (e.g. the continue flag's
                     # per-iteration reset) -> keep the carry's [1] shape
@@ -276,7 +286,19 @@ class StaticTensorList:
                                        in_place=False)
             else:
                 idx = layers.fill_constant([1], "int64", i)
-        row = layers.gather(self.buffer, layers.cast(idx, "int64"))
+        idx = layers.cast(idx, "int64")
+        # bounds check: reading past the live length would silently
+        # return the buffer's zero fill (eager python raises IndexError)
+        zero_i = layers.fill_constant([1], "int64", 0)
+        ok = layers.logical_and(
+            layers.less_than(idx, self.count),
+            layers.greater_equal(idx, zero_i))
+        chk = _emit_assert(ok, (
+            "dygraph_to_static: tensor list index out of range (read "
+            "past the live length()) — eager python would raise "
+            "IndexError here"))
+        idx = layers.elementwise_add(idx, layers.cast(chk, "int64"))
+        row = layers.gather(self.buffer, idx)
         # the root's buffer var carries the explicit [cap, *row] shape
         # (derived views from the overflow guard may not)
         return layers.reshape(row, list(self._root.buffer.shape[1:]))
@@ -336,6 +358,19 @@ def _materialize_list(x):
     return StaticTensorList(buf, cnt, cap)
 
 
+def _emit_assert(cond_var, msg):
+    """runtime_assert op; returns its [1] int32 zero output for folding
+    into downstream values (keeps the check out of DCE's reach)."""
+    from ...layers.layer_helper import LayerHelper
+    helper = LayerHelper("runtime_assert")
+    zero = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="runtime_assert", inputs={"Cond": [cond_var]},
+        outputs={"Out": [zero]}, attrs={"msg": msg},
+        infer_shape=False)
+    return zero
+
+
 def _guarded_list(root):
     """Post-loop overflow check: appends beyond the declared capacity
     would be dropped by XLA's out-of-bounds scatter — fail loudly
@@ -343,19 +378,12 @@ def _guarded_list(root):
     (buffer, count) the caller reads so the check cannot be
     dead-code-eliminated."""
     from ... import layers
-    from ...layers.layer_helper import LayerHelper
     cap_var = layers.fill_constant([1], "int64", root.cap)
     ok = layers.less_equal(root.count, cap_var)
-    helper = LayerHelper("runtime_assert")
-    zero = helper.create_variable_for_type_inference("int32")
-    helper.append_op(
-        type="runtime_assert", inputs={"Cond": [ok]},
-        outputs={"Out": [zero]},
-        attrs={"msg": (
-            f"dygraph_to_static: tensor list overflowed its declared "
-            f"list_capacity({root.cap}) — raise the capacity to cover "
-            f"the loop's maximum appends")},
-        infer_shape=False)
+    zero = _emit_assert(ok, (
+        f"dygraph_to_static: tensor list overflowed its declared "
+        f"list_capacity({root.cap}) — raise the capacity to cover "
+        f"the loop's maximum appends"))
     count = layers.elementwise_add(root.count,
                                    layers.cast(zero, "int64"))
     buf = layers.elementwise_add(

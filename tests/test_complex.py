@@ -125,3 +125,18 @@ def test_complex_dtype_in_registry():
     assert np_dtype("complex64") == np.complex64
     assert dtype_to_proto_enum("complex64") != dtype_to_proto_enum(
         "complex128")
+
+
+def test_broadcast_real_bigger():
+    """A larger real operand broadcasts the imaginary part too."""
+    r = RNG.standard_normal((3, 4)).astype(np.float32)
+    c = _cx((4,))
+    with dygraph.guard():
+        out = fluid.complex.elementwise_add(dygraph.to_variable(r),
+                                            dygraph.to_variable(c))
+        np.testing.assert_allclose(out.numpy(), r + c, rtol=1e-5,
+                                   atol=1e-6)
+        out2 = fluid.complex.elementwise_sub(dygraph.to_variable(r),
+                                             dygraph.to_variable(c))
+        np.testing.assert_allclose(out2.numpy(), r - c, rtol=1e-5,
+                                   atol=1e-6)

@@ -1,0 +1,134 @@
+"""Canned-dataset long tail (reference python/paddle/dataset/: conll05,
+movielens, sentiment, wmt14, wmt16, flowers, voc2012, mq2007, image) —
+shape/dtype/range contracts of every reader plus determinism of the
+synthetic streams (dataset/common.py policy)."""
+import numpy as np
+
+# NOTE: the `paddle_tpu.dataset` ATTRIBUTE is aliased to dataio for
+# fluid.dataset (DatasetFactory) parity; the canned-dataset package is
+# reached by submodule import, exactly how the book tests use it
+import paddle_tpu.dataset.common  # noqa: F401  (forces package import)
+import sys
+
+dataset = sys.modules["paddle_tpu.dataset"]
+
+
+def _take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+def test_module_diff_vs_reference_is_zero():
+    ref = {"cifar", "common", "conll05", "flowers", "image", "imdb",
+           "imikolov", "mnist", "movielens", "mq2007", "sentiment",
+           "uci_housing", "voc2012", "wmt14", "wmt16"}
+    import os
+    here = {f[:-3] for f in os.listdir(os.path.dirname(dataset.__file__))
+            if f.endswith(".py") and f != "__init__.py"}
+    assert ref - here == set(), ref - here
+
+
+def test_sentiment():
+    wd = dataset.sentiment.get_word_dict()
+    assert len(wd) > 5000
+    samples = _take(dataset.sentiment.train(), 20)
+    for ids, label in samples:
+        assert label in (0, 1)
+        assert all(0 <= i < len(wd) for i in ids)
+    # deterministic stream
+    assert samples[0] == _take(dataset.sentiment.train(), 1)[0]
+
+
+def test_wmt14():
+    src, trg, nxt = _take(dataset.wmt14.train(1000), 1)[0]
+    assert trg[0] == 0 and nxt[-1] == 1          # <s> ... / ... <e>
+    assert trg[1:] == nxt[:-1]
+    assert all(0 <= i < 1000 for i in src + trg + nxt)
+    d_id2w, _ = dataset.wmt14.get_dict(100)
+    assert d_id2w[0] == "<s>"
+
+
+def test_wmt16():
+    src, trg, nxt = _take(dataset.wmt16.train(500, 600, "en"), 1)[0]
+    assert all(i < 500 for i in src)
+    assert all(i < 600 for i in trg)
+    assert trg[1:] == nxt[:-1]
+    w2i = dataset.wmt16.get_dict("de", 100)
+    assert w2i["<e>"] == 1
+    _take(dataset.wmt16.validation(500, 600), 2)
+
+
+def test_movielens():
+    s = _take(dataset.movielens.train(), 5)
+    for uid, gender, age, job, mid, cats, title, rating in s:
+        assert 1 <= uid <= dataset.movielens.max_user_id()
+        assert gender in (0, 1)
+        assert 0 <= age < len(dataset.movielens.age_table)
+        assert 0 <= job <= dataset.movielens.max_job_id()
+        assert 1 <= mid <= dataset.movielens.max_movie_id()
+        assert all(0 <= c < len(dataset.movielens.movie_categories())
+                   for c in cats)
+        assert -5.0 <= rating[0] <= 5.0
+    assert len(dataset.movielens.user_info()) == \
+        dataset.movielens.max_user_id()
+    assert len(dataset.movielens.get_movie_title_dict()) == 512
+
+
+def test_conll05():
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(word_dict) and emb.ndim == 2
+    for sample in _take(dataset.conll05.test(), 5):
+        assert len(sample) == 9
+        ln = len(sample[0])
+        assert all(len(s) == ln for s in sample)       # aligned
+        assert label_dict["B-V"] in sample[8]          # predicate marked
+        assert set(sample[7]) <= {0, 1}                # mark flags
+
+
+def test_flowers():
+    img, label = _take(dataset.flowers.train(), 1)[0]
+    assert img.shape[0] == 3 and img.dtype == np.float32
+    assert 0 <= label < 102
+    assert 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_voc2012():
+    img, mask = _take(dataset.voc2012.train(), 1)[0]
+    assert img.shape[0] == 3 and mask.shape == img.shape[1:]
+    assert mask.dtype == np.int32 and mask.max() < 21
+
+
+def test_mq2007_formats():
+    label, left, right = _take(
+        lambda: dataset.mq2007.train(format="pairwise"), 1)[0]
+    assert left.shape == right.shape == (46,)
+    score, vec = _take(
+        lambda: dataset.mq2007.train(format="pointwise"), 1)[0]
+    assert vec.shape == (46,) and score in (0, 1, 2)
+    scores, vecs = _take(
+        lambda: dataset.mq2007.test(format="listwise"), 1)[0]
+    assert vecs.shape == (len(scores), 46)
+
+
+def test_image_transforms():
+    rng = np.random.default_rng(0)
+    im = (rng.random((48, 64, 3)) * 255).astype(np.uint8)
+    r = dataset.image.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[1] > r.shape[0]
+    c = dataset.image.center_crop(r, 32)
+    assert c.shape[:2] == (32, 32)
+    chw = dataset.image.to_chw(c)
+    assert chw.shape == (3, 32, 32)
+    f = dataset.image.left_right_flip(c)
+    np.testing.assert_array_equal(np.asarray(f)[:, ::-1], c)
+    out = dataset.image.simple_transform(im, 40, 32, is_train=True,
+                                         mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+    # bilinear identity: resizing to the same size preserves values
+    same = dataset.image.resize_short(im.astype(np.float32), 48)
+    np.testing.assert_allclose(same, im.astype(np.float32), atol=1e-3)

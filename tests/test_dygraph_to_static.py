@@ -546,3 +546,71 @@ def test_list_capacity_overflow_raises():
             exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
                                 feeds[1]: np.array([4], np.int64)},
                     fetch_list=fetches)
+
+
+def test_list_read_out_of_range_raises():
+    """Reading past the live length fails loudly (eager raises
+    IndexError; the static program must not hand back buffer zeros)."""
+    import pytest
+    from paddle_tpu.dygraph.dygraph_to_static import list_capacity
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(x)
+        return outs[2]
+
+    pt = dygraph.ProgramTranslator()
+    with list_capacity(8):
+        main, startup, feeds, fetches = pt.get_program(
+            fn, np.ones((2,), np.float32), np.array([3], np.int64))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
+                                   feeds[1]: np.array([3], np.int64)},
+                       fetch_list=fetches)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1), [8., 8.])
+        # only 1 append: outs[2] must raise, not return zeros
+        with pytest.raises(Exception, match="out of range|IndexError"):
+            exe.run(main, feed={feeds[0]: np.ones((2,), np.float32),
+                                feeds[1]: np.array([1], np.int64)},
+                    fetch_list=fetches)
+
+
+def test_python_value_append_in_loop_raises():
+    """Appending python scalars in a data-dependent loop has no static
+    representation: actionable ConversionError, not silent data loss."""
+    import pytest
+
+    def fn(x, n):
+        outs = []
+        for i in range(n):
+            x = layers.scale(x, scale=2.0)
+            outs.append(1.0)
+        return x
+
+    pt = dygraph.ProgramTranslator()
+    with pytest.raises(ValueError, match="python values"):
+        pt.get_program(fn, np.ones((2,), np.float32),
+                       np.array([3], np.int64))
+
+
+_GLOBAL_SINK = []
+
+
+def test_global_list_append_stays_inplace():
+    """Appends to a global list are NOT rewritten (rebinding would make
+    the name local and break mutation semantics)."""
+    def fn(x):
+        _GLOBAL_SINK.append(1)
+        return layers.scale(x, scale=2.0)
+
+    _GLOBAL_SINK.clear()
+    converted = convert_to_static(fn)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("glx", [2], dtype="float32")
+        converted(xv)
+    assert _GLOBAL_SINK == [1]

@@ -1,0 +1,309 @@
+"""OpTest depth pass: the most-used ops swept over dtype (fp32 / bf16 /
+int32 where sensible) x rank x attr matrices — the reference runs most
+ops through dtype/shape/attr grids in its per-op unittests
+(python/paddle/fluid/tests/unittests/op_test.py:170); breadth lived in
+the per-family files here, this file adds the depth dimension.
+Numeric gradients are checked at fp32 (central differences are
+meaningless at bf16 resolution)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from op_test import OpTest
+
+BF16 = jnp.bfloat16
+
+SHAPES = {2: (4, 6), 3: (2, 3, 5), 4: (2, 3, 4, 5)}
+RNG = np.random.default_rng(123)
+
+
+def _data(shape, dtype):
+    if dtype == "int32":
+        return RNG.integers(1, 8, shape).astype(np.int32)
+    x = (RNG.standard_normal(shape) + 0.1).astype(np.float32)
+    if dtype == "bfloat16":
+        return x.astype(BF16)
+    return x
+
+
+def _tol(dtype):
+    return {"float32": (1e-5, 1e-5), "bfloat16": (3e-2, 3e-2),
+            "int32": (0, 0)}[dtype]
+
+
+def _f32(a):
+    return np.asarray(a, np.float32) if a.dtype != np.int32 else a
+
+
+def _cast_back(ref, dtype):
+    if dtype == "bfloat16":
+        return np.asarray(ref).astype(BF16)
+    if dtype == "int32":
+        return np.asarray(ref).astype(np.int32)
+    return np.asarray(ref, np.float32)
+
+
+def _t(op, inputs, attrs, outputs):
+    t = OpTest()
+    t.op_type = op
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
+# ------------------------------------------------------------ elementwise
+
+_EW = [
+    ("elementwise_add", np.add, ("float32", "bfloat16", "int32")),
+    ("elementwise_sub", np.subtract, ("float32", "bfloat16", "int32")),
+    ("elementwise_mul", np.multiply, ("float32", "bfloat16", "int32")),
+    ("elementwise_div", np.divide, ("float32", "bfloat16")),
+    ("elementwise_max", np.maximum, ("float32", "bfloat16", "int32")),
+    ("elementwise_min", np.minimum, ("float32", "bfloat16", "int32")),
+    ("elementwise_pow", np.power, ("float32",)),
+]
+
+
+@pytest.mark.parametrize("op,ref,dtypes", _EW,
+                         ids=[e[0] for e in _EW])
+@pytest.mark.parametrize("rank", [2, 3, 4])
+def test_elementwise_matrix(op, ref, dtypes, rank):
+    shape = SHAPES[rank]
+    for dtype in dtypes:
+        x, y = _data(shape, dtype), _data(shape, dtype)
+        if op == "elementwise_pow":
+            x, y = np.abs(x) + 0.5, np.clip(y, -2, 2)
+        expect = _cast_back(ref(_f32(x), _f32(y)), dtype)
+        t = _t(op, {"X": ("mx_x", x), "Y": ("mx_y", y)}, {},
+               {"Out": ("mx_out", expect)})
+        rtol, atol = _tol(dtype)
+        t.check_output(rtol=rtol, atol=atol)
+        if dtype == "float32" and rank == 2:
+            t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+@pytest.mark.parametrize("axis_rank", [(0, 3)], ids=["bcast_axis0_r3"])
+def test_elementwise_broadcast_axis(axis_rank):
+    """Y broadcast along a leading axis slice (fluid `axis` attr)."""
+    axis, rank = axis_rank
+    shape = SHAPES[rank]
+    x = _data(shape, "float32")
+    y = _data(shape[axis:axis + 2], "float32")
+    expect = x + y.reshape(y.shape + (1,) * (x.ndim - axis - y.ndim))
+    t = _t("elementwise_add", {"X": ("bc_x", x), "Y": ("bc_y", y)},
+           {"axis": axis}, {"Out": ("bc_out", expect)})
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+# ------------------------------------------------------------ activations
+
+def _gelu(x):
+    from scipy.stats import norm
+    return x * norm.cdf(x)
+
+
+_ACTS = [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("gelu", _gelu),
+    ("exp", np.exp),
+    ("square", np.square),
+    ("abs", np.abs),
+    ("sqrt", lambda x: np.sqrt(np.abs(x) + 0.5)),
+    ("leaky_relu", lambda x: np.where(x > 0, x, 0.02 * x)),
+]
+
+
+@pytest.mark.parametrize("op,ref", _ACTS, ids=[a[0] for a in _ACTS])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("rank", [2, 4])
+def test_activation_matrix(op, ref, dtype, rank):
+    x = _data(SHAPES[rank], dtype)
+    if op == "sqrt":
+        x = np.asarray(np.abs(_f32(x)) + 0.5).astype(x.dtype)
+        expect = _cast_back(np.sqrt(_f32(x)), dtype)
+    else:
+        expect = _cast_back(ref(_f32(x)), dtype)
+    attrs = {"alpha": 0.02} if op == "leaky_relu" else {}
+    t = _t(op, {"X": ("act_x", x)}, attrs, {"Out": ("act_out", expect)})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 2e-5), atol=max(atol, 2e-5))
+    if dtype == "float32" and rank == 2 and op not in ("abs", "relu"):
+        # |x| and relu kink at 0 breaks central differences near zero
+        t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+# ------------------------------------------------------------- reductions
+
+_REDUCE = [("reduce_sum", np.sum), ("reduce_mean", np.mean),
+           ("reduce_max", np.max), ("reduce_min", np.min)]
+
+
+@pytest.mark.parametrize("op,ref", _REDUCE, ids=[r[0] for r in _REDUCE])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("dim,keep", [(None, False), ([1], True),
+                                      ([0, 2], False), ([-1], False)])
+def test_reduce_matrix(op, ref, dtype, dim, keep):
+    x = _data(SHAPES[3], dtype)
+    kw = {} if dim is None else {"axis": tuple(dim)}
+    expect = ref(_f32(x), keepdims=keep, **kw)
+    expect = _cast_back(np.asarray(expect).reshape(
+        expect.shape if np.ndim(expect) else (1,)), dtype)
+    attrs = {"keep_dim": keep, "reduce_all": dim is None}
+    if dim is not None:
+        attrs["dim"] = dim
+    t = _t(op, {"X": ("rd_x", x)}, attrs, {"Out": ("rd_out", expect)})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 1e-4), atol=max(atol, 1e-4))
+    if dtype == "float32" and op == "reduce_sum":
+        t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+# ----------------------------------------------------------------- matmul
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+@pytest.mark.parametrize("batched", [False, True])
+def test_matmul_matrix(dtype, tx, ty, batched):
+    def shp(m, k):
+        core = (k, m) if (tx if m == 3 else ty) else (m, k)
+        return core
+    a_core = (5, 3) if not tx else (3, 5)
+    b_core = (3, 4) if not ty else (4, 3)
+    lead = (2,) if batched else ()
+    a = _data(lead + a_core, dtype)
+    b = _data(lead + b_core, dtype)
+    fa = _f32(a).swapaxes(-1, -2) if tx else _f32(a)
+    fb = _f32(b).swapaxes(-1, -2) if ty else _f32(b)
+    expect = _cast_back(fa @ fb, dtype)
+    t = _t("matmul", {"X": ("mm_x", a), "Y": ("mm_y", b)},
+           {"transpose_X": tx, "transpose_Y": ty},
+           {"Out": ("mm_out", expect)})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 1e-4), atol=max(atol, 1e-4))
+    if dtype == "float32" and not batched:
+        t.check_grad(["X", "Y"], "Out", max_relative_error=0.03)
+
+
+# -------------------------------------------------------- shape & indexing
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+def test_shape_op_matrix(dtype):
+    x3 = _data(SHAPES[3], dtype)
+    rtol, atol = _tol(dtype)
+
+    t = _t("reshape2", {"X": ("sh_x", x3)}, {"shape": [2, 15]},
+           {"Out": ("sh_out", np.asarray(x3).reshape(2, 15))})
+    t.check_output(rtol=rtol, atol=atol)
+
+    t = _t("transpose2", {"X": ("tp_x", x3)}, {"axis": [2, 0, 1]},
+           {"Out": ("tp_out", np.transpose(np.asarray(x3), (2, 0, 1)))})
+    t.check_output(rtol=rtol, atol=atol)
+
+    x1 = np.asarray(x3).reshape(1, 2, 3, 5)[:, :1]
+    t = _t("squeeze2", {"X": ("sq_x", x1)}, {"axes": [0, 1]},
+           {"Out": ("sq_out", x1.reshape(3, 5))})
+    t.check_output(rtol=rtol, atol=atol)
+
+    x2 = _data(SHAPES[2], dtype)
+    t = _t("unsqueeze2", {"X": ("us_x", x2)}, {"axes": [0, 2]},
+           {"Out": ("us_out", np.asarray(x2)[None, :, None, :])})
+    t.check_output(rtol=rtol, atol=atol)
+
+    xs = [_data(SHAPES[2], dtype) for _ in range(3)]
+    for axis in (0, 1):
+        t = _t("concat",
+               {"X": [("cc0", xs[0]), ("cc1", xs[1]), ("cc2", xs[2])]},
+               {"axis": axis},
+               {"Out": ("cc_out",
+                        np.concatenate([np.asarray(v) for v in xs],
+                                       axis))})
+        t.check_output(rtol=rtol, atol=atol)
+
+    t = _t("stack", {"X": [("st0", xs[0]), ("st1", xs[1])]}, {"axis": 1},
+           {"Y": ("st_out", np.stack([np.asarray(v) for v in xs[:2]],
+                                     1))})
+    t.check_output(rtol=rtol, atol=atol)
+
+    idx = np.array([3, 0, 2], np.int32)
+    t = _t("gather", {"X": ("ga_x", x2), "Index": ("ga_i", idx)}, {},
+           {"Out": ("ga_out", np.asarray(x2)[idx])})
+    t.check_output(rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("src,dst", [("float32", "int32"),
+                                     ("int32", "float32"),
+                                     ("float32", "bfloat16"),
+                                     ("bfloat16", "float32")])
+def test_cast_matrix(src, dst):
+    x = _data(SHAPES[2], src)
+    to = {"int32": np.int32, "float32": np.float32,
+          "bfloat16": BF16}[dst]
+    t = _t("cast", {"X": ("ct_x", x)},
+           {"in_dtype": src, "out_dtype": dst},
+           {"Out": ("ct_out", np.asarray(x).astype(to))})
+    t.check_output(rtol=1e-2 if "bfloat16" in (src, dst) else 1e-6,
+                   atol=1e-2 if "bfloat16" in (src, dst) else 1e-6)
+
+
+# ----------------------------------------------------------- attr-variant
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("bias_after", [True, False])
+def test_scale_matrix(dtype, bias_after):
+    x = _data(SHAPES[3], dtype)
+    s, b = 2.5, -1.0
+    ref = _f32(x) * s + b if bias_after else (_f32(x) + b) * s
+    t = _t("scale", {"X": ("sc_x", x)},
+           {"scale": s, "bias": b, "bias_after_scale": bias_after},
+           {"Out": ("sc_out", _cast_back(ref, dtype))})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=rtol, atol=atol)
+    if dtype == "float32":
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("axis", [-1, 0, 1])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_softmax_matrix(axis, dtype):
+    x = _data(SHAPES[3], dtype)
+    f = _f32(x)
+    e = np.exp(f - f.max(axis=axis, keepdims=True))
+    ref = e / e.sum(axis=axis, keepdims=True)
+    t = _t("softmax", {"X": ("sm_x", x)}, {"axis": axis},
+           {"Out": ("sm_out", _cast_back(ref, dtype))})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 1e-4), atol=max(atol, 1e-4))
+    if dtype == "float32" and axis == -1:
+        t.check_grad(["X"], "Out", max_relative_error=0.03)
+
+
+@pytest.mark.parametrize("lo,hi", [(-0.5, 0.5), (0.0, 10.0)])
+def test_clip_matrix(lo, hi):
+    x = _data(SHAPES[3], "float32")
+    t = _t("clip", {"X": ("cl_x", x)}, {"min": lo, "max": hi},
+           {"Out": ("cl_out", np.clip(x, lo, hi))})
+    t.check_output()
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_arg_max_matrix(axis):
+    x = _data(SHAPES[3], "float32")
+    t = _t("arg_max", {"X": ("am_x", x)}, {"axis": axis},
+           {"Out": ("am_out",
+                    np.argmax(x, axis=axis).astype(np.int64))})
+    t.check_output()
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_sum_multi_input(n):
+    xs = [_data(SHAPES[2], "float32") for _ in range(n)]
+    t = _t("sum", {"X": [(f"su{i}", v) for i, v in enumerate(xs)]}, {},
+           {"Out": ("su_out", np.sum(xs, axis=0))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
