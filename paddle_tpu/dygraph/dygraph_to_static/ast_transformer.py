@@ -404,6 +404,21 @@ class _BreakContinueRewriter(ast.NodeTransformer):
 
 
 class DygraphToStaticAst(ast.NodeTransformer):
+    # set per enclosing def by visit_FunctionDef: names local to the
+    # CURRENT function scope (the append rewrite must neither touch
+    # global/closure lists nor leak an outer scope's names into nested
+    # defs)
+    _fn_locals = None
+
+    def visit_FunctionDef(self, node):
+        outer = self._fn_locals
+        params = [a.arg for a in (node.args.args + node.args.posonlyargs
+                                  + node.args.kwonlyargs)]
+        self._fn_locals = set(params) | set(_assigned_names(node.body))
+        self.generic_visit(node)
+        self._fn_locals = outer
+        return node
+
     def _fresh(self):
         _COUNTER[0] += 1
         return f"__pt_{_COUNTER[0]}"
@@ -592,7 +607,7 @@ class DygraphToStaticAst(ast.NodeTransformer):
                 and isinstance(call.func.value, ast.Name)
                 and len(call.args) == 1 and not call.keywords):
             name = call.func.value.id
-            if name in getattr(self, "_fn_locals", ()):
+            if name in (self._fn_locals or ()):
                 return ast.Assign(
                     targets=[_store(name)],
                     value=_jst_call("convert_list_append",
@@ -612,14 +627,9 @@ def convert_to_static(fn):
     # returns inside control flow lower to a (flag, value) pair BEFORE
     # the control-flow conversion (reference return_transformer.py)
     _ReturnRewriter.rewrite_function(fdef)
-    transformer = DygraphToStaticAst()
-    # function-local names (params + assignments): the append rewrite
-    # must not touch global/closure lists
-    params = [a.arg for a in fdef.args.args] + \
-        [a.arg for a in fdef.args.posonlyargs] + \
-        [a.arg for a in fdef.args.kwonlyargs]
-    transformer._fn_locals = set(params) | set(_assigned_names(fdef.body))
-    new_tree = transformer.visit(tree)
+    # per-scope locals for the append rewrite are computed by
+    # visit_FunctionDef itself (top-level and nested defs alike)
+    new_tree = DygraphToStaticAst().visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dygraph_to_static:{fn.__name__}>",
                    mode="exec")

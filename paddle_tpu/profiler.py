@@ -16,11 +16,13 @@ import time
 import numpy as np
 
 _events = {}          # name -> [calls, total_s, max_s, min_s]
+_spans = []           # (name, start_s, end_s, tid) — timeline source
+_MAX_SPANS = 200000   # bound memory on long profiled runs
 _active = False
 _trace_dir = None
 
 
-def _record(name, seconds):
+def _record(name, seconds, start=None):
     if not _active:
         return
     row = _events.setdefault(name, [0, 0.0, 0.0, float("inf")])
@@ -28,6 +30,10 @@ def _record(name, seconds):
     row[1] += seconds
     row[2] = max(row[2], seconds)
     row[3] = min(row[3], seconds)
+    if start is not None and len(_spans) < _MAX_SPANS:
+        import threading
+        _spans.append((name, start, start + seconds,
+                       threading.get_ident()))
 
 
 def is_profiling():
@@ -41,12 +47,13 @@ def record_event(name):
     try:
         yield
     finally:
-        _record(name, time.perf_counter() - t0)
+        _record(name, time.perf_counter() - t0, start=t0)
 
 
 def reset_profiler():
     """reference profiler.py:113."""
     _events.clear()
+    _spans.clear()
 
 
 def start_profiler(state="All", tracer_option="Default",
@@ -65,8 +72,11 @@ def start_profiler(state="All", tracer_option="Default",
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    """reference profiler.py:180: stop + print the summary table (and
-    finish the xplane trace when one was started)."""
+    """reference profiler.py:180: stop + print the summary table, write
+    the recorded spans to `profile_path` (the artifact
+    tools/timeline.py converts to a Chrome trace — the reference's
+    profiler proto -> tools/timeline.py flow), and finish the xplane
+    trace when one was started."""
     global _active, _trace_dir
     _active = False
     if _trace_dir:
@@ -75,6 +85,10 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         print(f"[profiler] xplane trace written to {_trace_dir} "
               f"(load in TensorBoard / Perfetto)")
         _trace_dir = None
+    if profile_path and _spans:
+        import json
+        with open(profile_path, "w") as f:
+            json.dump({"spans": [list(s) for s in _spans]}, f)
     rows = summary(sorted_key)
     if rows:
         print(_format_table(rows))

@@ -614,3 +614,27 @@ def test_global_list_append_stays_inplace():
         xv = layers.data("glx", [2], dtype="float32")
         converted(xv)
     assert _GLOBAL_SINK == [1]
+
+
+def test_closure_list_append_in_nested_def():
+    """An append to a closed-over list inside a nested def must keep
+    python mutation semantics (scope-aware rewrite gate)."""
+    def fn(x):
+        outs = []
+
+        def inner(v):
+            outs.append(v)
+        inner(layers.scale(x, scale=2.0))
+        return outs[0]
+
+    converted = convert_to_static(fn)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("clx", [2], dtype="float32")
+        out = converted(xv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        val, = exe.run(main, feed={"clx": np.ones((2,), np.float32)},
+                       fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(val), [2.0, 2.0])

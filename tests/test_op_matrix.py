@@ -170,9 +170,6 @@ def test_reduce_matrix(op, ref, dtype, dim, keep):
                                    (False, True), (True, True)])
 @pytest.mark.parametrize("batched", [False, True])
 def test_matmul_matrix(dtype, tx, ty, batched):
-    def shp(m, k):
-        core = (k, m) if (tx if m == 3 else ty) else (m, k)
-        return core
     a_core = (5, 3) if not tx else (3, 5)
     b_core = (3, 4) if not ty else (4, 3)
     lead = (2,) if batched else ()

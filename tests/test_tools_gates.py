@@ -1,0 +1,107 @@
+"""tools/-class CI gates (reference tools/print_signatures.py +
+diff_api.py API freeze, check_op_desc.py op-schema gate,
+timeline.py Chrome-trace conversion): the committed baselines must
+match the live package, and each gate must catch regressions."""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import paddle_tpu as fluid
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+sys.path.insert(0, TOOLS)
+
+
+def test_api_freeze_baseline_current():
+    """print_signatures vs the committed baseline through diff_api:
+    no deletions/changes (additions allowed)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "print_signatures.py"),
+         "paddle_tpu"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr[-2000:]
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write(out.stdout)
+        newpath = f.name
+    gate = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "diff_api.py"),
+         os.path.join(TOOLS, "api_signatures.txt"), newpath],
+        capture_output=True, text=True)
+    assert gate.returncode == 0, gate.stdout[-3000:]
+
+
+def test_diff_api_catches_deletion_and_change():
+    import diff_api
+    origin = ["a.f (x) doc:1", "a.g (y) doc:2"]
+    assert diff_api.diff(origin, list(origin)) == []
+    assert diff_api.diff(origin, ["a.f (x) doc:1"])          # deletion
+    assert diff_api.diff(origin, ["a.f (x, z) doc:1",
+                                  "a.g (y) doc:2"])          # change
+    # pure addition passes
+    assert diff_api.diff(origin, origin + ["a.h (q) doc:3"]) == []
+
+
+def test_op_schema_gate():
+    import check_op_desc
+    with open(os.path.join(TOOLS, "op_schema_baseline.json")) as f:
+        baseline = json.load(f)
+    now = check_op_desc.current_schema()
+    errors, _added = check_op_desc.check(baseline, now)
+    assert errors == [], errors
+    # the gate catches a deleted op and a lost grad
+    poisoned = dict(now)
+    poisoned["definitely_gone_op"] = {"grad": True}
+    errors, _ = check_op_desc.check(poisoned, now)
+    assert any("deleted" in e for e in errors)
+    lost = {k: dict(v) for k, v in now.items()}
+    some = next(k for k, v in now.items() if v["grad"])
+    lost[some]["grad"] = True
+    now2 = {k: dict(v) for k, v in now.items()}
+    now2[some]["grad"] = False
+    errors, _ = check_op_desc.check(lost, now2)
+    assert any("gradient" in e for e in errors)
+
+
+def test_timeline_conversion_end_to_end():
+    """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
+    valid Chrome trace JSON."""
+    import numpy as np
+    from paddle_tpu import profiler
+    import timeline
+
+    with tempfile.TemporaryDirectory() as d:
+        prof_path = os.path.join(d, "profile")
+        profiler.reset_profiler()
+        profiler.start_profiler("All")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [4, 4], "float32")
+            y = fluid.layers.mean(fluid.layers.relu(x))
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with profiler.record_event("user_scope"):
+                exe.run(main, feed={"x": np.ones((4, 4), np.float32)},
+                        fetch_list=[y])
+        profiler.stop_profiler(profile_path=prof_path)
+        assert os.path.exists(prof_path)
+
+        tl_path = os.path.join(d, "timeline.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "timeline.py"),
+             "--profile_path", prof_path, "--timeline_path", tl_path],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-1500:]
+        with open(tl_path) as f:
+            trace = json.load(f)
+        events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in events}
+        assert "user_scope" in names, names
+        assert any(n.startswith("run/program") for n in names), names
+        for e in events:
+            assert e["dur"] > 0 and e["ts"] >= 0

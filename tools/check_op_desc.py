@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Op-schema compatibility gate (reference tools/check_op_desc.py:
+compares the registered op protos between versions — deleting an op or
+its grad support breaks saved programs). Here the schema is the
+registry: {op_type: {grad, needs_rng, custom_grad, infer_shape}}.
+
+Usage:
+    python tools/check_op_desc.py --dump > tools/op_schema_baseline.json
+    python tools/check_op_desc.py tools/op_schema_baseline.json
+Exit 1 when an op was deleted or lost capability vs the baseline.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def current_schema():
+    from paddle_tpu.framework.registry import OPS
+    import paddle_tpu  # noqa: F401  (registers every op module)
+    out = {}
+    for t, d in sorted(OPS.items()):
+        out[t] = {
+            "grad": d.grad is not False,
+            "custom_grad": d.custom_grad_lower is not None,
+            "needs_rng": bool(d.needs_rng),
+            "custom_infer_shape": not (d.infer_shape is None
+                                       or d.infer_shape is False),
+        }
+    return out
+
+
+def check(baseline, now):
+    """Errors: deleted ops, ops that LOST grad support. Returns
+    (errors, added)."""
+    errors = []
+    for t, spec in baseline.items():
+        if t not in now:
+            errors.append(f"op {t!r} was deleted")
+        elif spec.get("grad") and not now[t]["grad"]:
+            errors.append(f"op {t!r} lost gradient support")
+    added = sorted(set(now) - set(baseline))
+    return errors, added
+
+
+def main():
+    if "--dump" in sys.argv:
+        print(json.dumps(current_schema(), indent=1, sort_keys=True))
+        return
+    with open(sys.argv[1]) as f:
+        baseline = json.load(f)
+    errors, added = check(baseline, current_schema())
+    if errors:
+        print("OP SCHEMA COMPATIBILITY ERRORS:")
+        for e in errors:
+            print(" -", e)
+        sys.exit(1)
+    print(f"op schema compatible: {len(baseline)} baseline ops intact"
+          + (f", {len(added)} added ({', '.join(added[:8])}"
+             f"{'...' if len(added) > 8 else ''})" if added else ""))
+
+
+if __name__ == "__main__":
+    main()

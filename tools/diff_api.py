@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""API-freeze diff gate (reference tools/diff_api.py): deleting or
+changing a public API line is an ERROR; additions are allowed (and
+should be re-baselined deliberately).
+
+Usage:
+    python tools/print_signatures.py paddle_tpu > /tmp/new_api.txt
+    python tools/diff_api.py tools/api_signatures.txt /tmp/new_api.txt
+Exit code 1 on any deletion/change.
+"""
+import difflib
+import sys
+
+
+def diff(origin_lines, new_lines):
+    """Return the list of forbidden (deleted/changed) diff lines."""
+    result = difflib.Differ().compare(origin_lines, new_lines)
+    return [d for d in result if d and d[0] in ("-", "?")]
+
+
+def main():
+    with open(sys.argv[1]) as f:
+        origin = f.read().splitlines()
+    with open(sys.argv[2]) as f:
+        new = f.read().splitlines()
+    bad = diff(origin, new)
+    if bad:
+        print("API CHANGE OR DELETION IS NOT ALLOWED:")
+        for d in bad:
+            print(d)
+        print("(additions are fine — re-baseline with "
+              "print_signatures.py if this change is deliberate)")
+        sys.exit(1)
+    print("API surface unchanged (additions only)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Print every public API signature of a module tree in alphabetical
+order (the paddle_tpu analog of the reference's
+tools/print_signatures.py — the API-freeze half of its CI gate; pair
+with tools/diff_api.py).
+
+Usage:
+    python tools/print_signatures.py paddle_tpu > tools/api_signatures.txt
+"""
+import hashlib
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# namespaces that form the frozen public surface
+_DEFAULT_SUBMODULES = [
+    "paddle_tpu", "paddle_tpu.layers", "paddle_tpu.optimizer",
+    "paddle_tpu.dygraph", "paddle_tpu.io", "paddle_tpu.nets",
+    "paddle_tpu.clip", "paddle_tpu.regularizer", "paddle_tpu.metrics",
+    "paddle_tpu.profiler", "paddle_tpu.transpiler", "paddle_tpu.nn",
+    "paddle_tpu.nn.functional", "paddle_tpu.tensor",
+    "paddle_tpu.complex", "paddle_tpu.inference",
+    "paddle_tpu.contrib.mixed_precision", "paddle_tpu.incubate.fleet",
+]
+
+
+def _sig_of(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(*args, **kwargs)"
+
+
+def _doc_hash(obj):
+    doc = inspect.getdoc(obj) or ""
+    return hashlib.md5(doc.encode()).hexdigest()[:8]
+
+
+def collect(module_names):
+    """{qualified_name: "signature dochash"} over public callables and
+    classes (plus public methods of public classes)."""
+    out = {}
+    for mn in module_names:
+        try:
+            mod = importlib.import_module(mn)
+        except ImportError:
+            continue
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            qual = f"{mn}.{name}"
+            if inspect.isfunction(obj) or inspect.isbuiltin(obj):
+                out[qual] = f"{_sig_of(obj)} doc:{_doc_hash(obj)}"
+            elif inspect.isclass(obj):
+                out[qual] = (f"{_sig_of(obj.__init__)} "
+                             f"doc:{_doc_hash(obj)}")
+                for m in sorted(dir(obj)):
+                    if m.startswith("_"):
+                        continue
+                    meth = inspect.getattr_static(obj, m)
+                    if callable(meth):
+                        out[f"{qual}.{m}"] = _sig_of(
+                            getattr(obj, m, meth))
+    return out
+
+
+def main():
+    roots = sys.argv[1:] or _DEFAULT_SUBMODULES
+    if roots == ["paddle_tpu"]:
+        roots = _DEFAULT_SUBMODULES
+    for name, sig in sorted(collect(roots).items()):
+        print(f"{name} {sig}")
+
+
+if __name__ == "__main__":
+    main()

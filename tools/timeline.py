@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Convert a profiler span dump into a Chrome tracing JSON (reference
+tools/timeline.py — its --profile_path proto becomes the spans JSON
+that paddle_tpu.profiler.stop_profiler(profile_path=...) writes; load
+the output in chrome://tracing or Perfetto).
+
+Usage:
+    python tools/timeline.py --profile_path /tmp/profile \\
+        --timeline_path /tmp/timeline.json
+"""
+import argparse
+import json
+
+
+def to_chrome_trace(spans):
+    """spans: [(name, start_s, end_s, tid)] -> Chrome trace dict
+    (complete events, microsecond timebase, normalized to t0)."""
+    if not spans:
+        return {"traceEvents": []}
+    t0 = min(s[1] for s in spans)
+    events = []
+    tids = {}
+    for name, start, end, tid in spans:
+        tids.setdefault(tid, len(tids))
+        events.append({
+            "name": name,
+            "ph": "X",                       # complete event
+            "ts": (start - t0) * 1e6,
+            "dur": max((end - start) * 1e6, 0.001),
+            "pid": 0,
+            "tid": tids[tid],
+            "cat": "host",
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "paddle_tpu host"}}]
+    meta += [{"name": "thread_name", "ph": "M", "pid": 0, "tid": i,
+              "args": {"name": f"thread {i}"}} for i in tids.values()]
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True,
+                    help="spans JSON written by profiler.stop_profiler")
+    ap.add_argument("--timeline_path", required=True,
+                    help="output Chrome trace JSON")
+    args = ap.parse_args()
+    with open(args.profile_path) as f:
+        spans = json.load(f)["spans"]
+    with open(args.timeline_path, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    print(f"wrote {args.timeline_path} ({len(spans)} spans) — open in "
+          f"chrome://tracing or Perfetto")
+
+
+if __name__ == "__main__":
+    main()
