@@ -324,17 +324,23 @@ def scatter(ctx, ins, attrs):
 
 @register_op("one_hot_v2", grad=False)
 def one_hot_v2(ctx, ins, attrs):
+    """v2 semantics (one_hot_v2_op.cc:39): the depth axis is APPENDED to
+    the input shape — [N, 1] stays [N, 1, depth]."""
     x = x_of(ins)
     depth = attrs["depth"]
-    if x.ndim >= 1 and x.shape[-1] == 1:
-        x = x[..., 0]
     return {"Out": jax.nn.one_hot(x, depth, dtype=np_dtype(
         attrs.get("dtype", "float32")))}
 
 
 @register_op("one_hot", grad=False)
 def one_hot(ctx, ins, attrs):
-    return one_hot_v2(ctx, ins, attrs)
+    """v1 semantics (one_hot_op.cc): a trailing size-1 dim is replaced
+    by the depth axis — [N, 1] becomes [N, depth]."""
+    x = x_of(ins)
+    if x.ndim >= 1 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": jax.nn.one_hot(x, attrs["depth"], dtype=np_dtype(
+        attrs.get("dtype", "float32")))}
 
 
 @register_op("shape", grad=False)

@@ -304,3 +304,161 @@ def test_sum_multi_input(n):
            {"Out": ("su_out", np.sum(xs, axis=0))})
     t.check_output(rtol=1e-5)
     t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------- NN-layer ops
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_layer_norm_matrix(dtype):
+    x = _data((4, 6), dtype)
+    scale = _data((6,), "float32")
+    bias = _data((6,), "float32")
+    f = _f32(x)
+    mean = f.mean(-1, keepdims=True)
+    var = f.var(-1, keepdims=True)
+    ref = (f - mean) / np.sqrt(var + 1e-5) * scale + bias
+    t = _t("layer_norm",
+           {"X": ("ln_x", x), "Scale": ("ln_s", scale),
+            "Bias": ("ln_b", bias)},
+           {"begin_norm_axis": 1, "epsilon": 1e-5},
+           {"Y": ("ln_y", _cast_back(ref, dtype)),
+            "Mean": ("ln_m", mean.reshape(-1).astype(np.float32)),
+            "Variance": ("ln_v", var.reshape(-1).astype(np.float32))})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 1e-4), atol=max(atol, 1e-4),
+                   no_check_set=("Mean", "Variance") if dtype != "float32"
+                   else ())
+    if dtype == "float32":
+        t.check_grad(["X", "Scale", "Bias"], "Y",
+                     max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (2, 1)])
+def test_conv2d_matrix(stride, pad):
+    from scipy import signal
+    x = _data((2, 3, 8, 8), "float32")
+    w = _data((4, 3, 3, 3), "float32") * 0.2
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    H = (xp.shape[2] - 3) // stride + 1
+    ref = np.zeros((2, 4, H, H), np.float32)
+    for b in range(2):
+        for o in range(4):
+            acc = sum(signal.correlate2d(xp[b, c], w[o, c], "valid")
+                      for c in range(3))
+            ref[b, o] = acc[::stride, ::stride]
+    t = _t("conv2d", {"Input": ("cv_x", x), "Filter": ("cv_w", w)},
+           {"strides": [stride, stride], "paddings": [pad, pad],
+            "dilations": [1, 1], "groups": 1},
+           {"Output": ("cv_out", ref)})
+    t.check_output(rtol=1e-4, atol=1e-4)
+    if stride == 1:
+        t.check_grad(["Input", "Filter"], "Output",
+                     max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool2d_matrix(ptype):
+    x = _data((2, 3, 8, 8), "float32")
+    r = x.reshape(2, 3, 4, 2, 4, 2)
+    ref = r.max(axis=(3, 5)) if ptype == "max" else r.mean(axis=(3, 5))
+    t = _t("pool2d", {"X": ("pl_x", x)},
+           {"pooling_type": ptype, "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]},
+           {"Out": ("pl_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("soft_label", [False, True])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_softmax_with_cross_entropy_matrix(soft_label, dtype):
+    x = _data((6, 10), dtype)
+    f = _f32(x)
+    e = np.exp(f - f.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    if soft_label:
+        raw = RNG.random((6, 10)).astype(np.float32)
+        lbl = raw / raw.sum(-1, keepdims=True)
+        ref = -(lbl * np.log(p)).sum(-1, keepdims=True)
+    else:
+        lbl = RNG.integers(0, 10, (6, 1)).astype(np.int64)
+        ref = -np.log(p[np.arange(6), lbl[:, 0]])[:, None]
+    t = _t("softmax_with_cross_entropy",
+           {"Logits": ("ce_x", x), "Label": ("ce_l", lbl)},
+           {"soft_label": soft_label},
+           {"Loss": ("ce_loss", _cast_back(ref, dtype)),
+            "Softmax": ("ce_sm", _cast_back(p, dtype))})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=max(rtol, 1e-4), atol=max(atol, 1e-4))
+    if dtype == "float32":
+        t.check_grad(["Logits"], "Loss", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("padding_idx", [-1, 2])
+def test_lookup_table_v2_matrix(padding_idx):
+    w = _data((10, 4), "float32")
+    ids = np.array([[1, 2], [5, 9]], np.int64)
+    ref = np.asarray(w)[ids]
+    if padding_idx >= 0:
+        ref = ref.copy()
+        ref[ids == padding_idx] = 0.0
+    t = _t("lookup_table_v2", {"W": ("lt_w", w), "Ids": ("lt_i", ids)},
+           {"padding_idx": padding_idx}, {"Out": ("lt_out", ref)})
+    t.check_output()
+    t.check_grad(["W"], "Out", max_relative_error=0.03)
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_top_k_matrix(k):
+    x = _data((4, 8), "float32")
+    idx = np.argsort(-x, axis=-1)[:, :k]
+    val = np.take_along_axis(x, idx, -1)
+    t = _t("top_k", {"X": ("tk_x", x)}, {"k": k},
+           {"Out": ("tk_out", val),
+            "Indices": ("tk_idx", idx.astype(np.int64))})
+    t.check_output()
+
+
+@pytest.mark.parametrize("depth", [5, 12])
+def test_one_hot_v1_v2_shape_semantics(depth):
+    """v1 replaces a trailing [.., 1] dim with depth; v2 APPENDS depth
+    (reference one_hot_v2_op.cc:39 — out_dims = x_dims + [depth])."""
+    ids = RNG.integers(0, depth, (6, 1)).astype(np.int64)
+    eye = np.eye(depth, dtype=np.float32)
+    t = _t("one_hot", {"X": ("oh_x", ids)}, {"depth": depth},
+           {"Out": ("oh_out", eye[ids[:, 0]])})        # [6, depth]
+    t.check_output()
+    t = _t("one_hot_v2", {"X": ("oh2_x", ids)}, {"depth": depth},
+           {"Out": ("oh2_out", eye[ids])})             # [6, 1, depth]
+    t.check_output()
+    flat = RNG.integers(0, depth, (6,)).astype(np.int64)
+    t = _t("one_hot_v2", {"X": ("oh3_x", flat)}, {"depth": depth},
+           {"Out": ("oh3_out", eye[flat])})            # [6, depth]
+    t.check_output()
+
+
+def test_dropout_train_statistics():
+    """Stochastic op: check mask statistics + upscale identity rather
+    than a pointwise oracle."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    p = 0.4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("dx", [256, 256], "float32")
+        out = layers.dropout(x, p, is_test=False, seed=7,
+                             dropout_implementation="upscale_in_train")
+        out_t = layers.dropout(x, p, is_test=True,
+                               dropout_implementation="upscale_in_train")
+    exe = fluid.Executor()
+    xv = np.ones((256, 256), np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        ov, otv = exe.run(main, feed={"dx": xv}, fetch_list=[out, out_t])
+    ov = np.asarray(ov)
+    kept = ov != 0
+    # upscale_in_train: survivors are x/(1-p); test mode is identity
+    np.testing.assert_allclose(np.unique(ov[kept]), 1.0 / (1 - p),
+                               rtol=1e-5)
+    assert abs(kept.mean() - (1 - p)) < 0.03
+    np.testing.assert_allclose(np.asarray(otv), xv)

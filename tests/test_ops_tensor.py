@@ -160,9 +160,10 @@ def test_pad():
 
 
 def test_one_hot_v2():
+    # v2 APPENDS the depth axis (one_hot_v2_op.cc:39): [3,1] -> [3,1,4]
     t = OpTest()
     ids = np.array([[1], [0], [3]], np.int64)
-    ref = np.eye(4, dtype=np.float32)[ids[:, 0]]
+    ref = np.eye(4, dtype=np.float32)[ids]
     t.op_type = "one_hot_v2"
     t.inputs = {"X": ("x", ids)}
     t.attrs = {"depth": 4}
