@@ -23,6 +23,9 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
   dygraph_transformer config 5: Transformer-base MT, eager tracer
   bert_long           extra: BERT + Pallas flash attention at seq 2048
                       (the long-context capability the reference lacks)
+  gpt_long            extra: GPT-base causal LM at seq 2048 through the
+                      flash kernel's causal path (upper-triangle blocks
+                      skipped)
 """
 import json
 import os
@@ -544,6 +547,64 @@ def bench_bert_long():
                                                          max_preds))
 
 
+def _gpt_train_flops_per_sample(cfg, seq_len):
+    """Analytic matmul FLOPs (fwd) x3 for fwd+bwd; causal attention
+    counts the LIVE half of the score square."""
+    h, L, ffn, V = (cfg.hidden_size, cfg.num_layers, cfg.ffn_size,
+                    cfg.vocab_size)
+    per_layer = (4 * 2 * seq_len * h * h            # qkv + out proj
+                 + 2 * 2 * seq_len * h * ffn        # ffn in+out
+                 + 2 * seq_len * seq_len * h)       # causal qk^T + p@v
+    head = 2 * seq_len * h * V                      # tied LM head
+    return 3 * (L * per_layer + head)
+
+
+def bench_gpt_long():
+    """Extra config: GPT-base causal LM at seq 2048 through the flash
+    kernel's causal path (dead upper-triangle blocks skipped) — the
+    generative long-context workload the reference's fused V100
+    attention cannot run."""
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert, gpt
+    from paddle_tpu.contrib import mixed_precision as mp
+    cfg = gpt.GPTConfig.base()
+    batch, seq_len = 8, 2048
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = gpt.gpt_pretrain(cfg, batch, seq_len)
+        opt = fluid.optimizer.AdamOptimizer(1e-4)
+        opt = mp.decorate(opt, init_loss_scaling=1.0,
+                          use_dynamic_loss_scaling=False)
+        opt.minimize(out["loss"])
+    rng = np.random.default_rng(0)
+    pool = [gpt.random_batch(cfg, batch, seq_len, rng=rng)
+            for _ in range(2)]
+    feed_fn = _device_pool(pool)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
+                     10, 3, batch)
+    result = {
+        "metric": "gpt_base_seq2048_causal_flash_bf16_samples_per_sec",
+        "value": round(v, 2), "unit": "samples/sec",
+        "tokens_per_sec": round(v * seq_len, 0),
+        # projected anchor, same protocol as bert_long: the BERT seq-128
+        # anchor scaled by the analytic train-FLOP ratio
+        "vs_baseline": _vs_anchor(
+            v, "bert_base_v100_fp16_seq128_samples_per_sec",
+            scale=_bert_train_flops_per_sample(bert.BertConfig.base(),
+                                               128, 20)
+            / _gpt_train_flops_per_sample(cfg, seq_len)),
+        "vs_baseline_projected": True}
+    return _attach_roofline(result, jax.devices()[0], v, batch,
+                            _step_cost(exe, scope, pool[0], main_prog),
+                            _gpt_train_flops_per_sample(cfg, seq_len))
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -556,6 +617,8 @@ _CONFIGS = {
                             "dygraph_transformer_base_samples_per_sec"),
     "bert_long": (bench_bert_long,
                   "bert_base_seq2048_flash_bf16_samples_per_sec"),
+    "gpt_long": (bench_gpt_long,
+                 "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

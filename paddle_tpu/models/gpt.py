@@ -1,0 +1,145 @@
+"""GPT-style causal language model — the long-context decoder family
+(pre-LN transformer decoder + weight-tied LM head + next-token loss).
+
+The reference era's generative model is ERNIE-GEN-class BERT variants;
+a causal-attention decoder at long sequence lengths is exactly the
+workload its V100 fused attention could not run (O(S^2) scores in HBM)
+— here the Pallas flash kernel's causal path (kernels/
+flash_attention.py, dead-block skipping over the upper triangle) makes
+seq 2048+ trainable on one chip. Static-graph builder in the style of
+models/bert.py; shares its TP/SP sharding annotations style.
+"""
+import numpy as np
+
+from .. import layers
+from ..framework import initializer as I
+from ..layers import math as M
+from ..layers import tensor as T
+from ..param_attr import ParamAttr
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=32000, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_size=3072, max_position=2048,
+                 dropout=0.1, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_size = ffn_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.initializer_range = initializer_range
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=128, hidden_size=32, num_layers=2,
+                   num_heads=2, ffn_size=64, max_position=64,
+                   dropout=0.0)
+
+
+def _param(cfg, name):
+    return ParamAttr(name=name,
+                     initializer=I.Normal(0.0, cfg.initializer_range))
+
+
+def _fc(cfg, x, size, name, act=None):
+    return layers.fc(x, size, num_flatten_dims=2, act=act,
+                     param_attr=_param(cfg, f"{name}.w_0"),
+                     bias_attr=ParamAttr(name=f"{name}.b_0",
+                                         initializer=I.Constant(0.0)))
+
+
+def _ln(cfg, x, name, begin_axis=2):
+    return layers.layer_norm(
+        x, begin_norm_axis=begin_axis,
+        param_attr=ParamAttr(name=f"{name}_scale",
+                             initializer=I.Constant(1.0)),
+        bias_attr=ParamAttr(name=f"{name}_bias",
+                            initializer=I.Constant(0.0)))
+
+
+def decoder_layer(cfg, x, idx, is_test):
+    """Pre-LN block: x + attn(LN(x)); x + ffn(LN(x)). Causal attention
+    through the flash kernel (upper triangle never computed)."""
+    h = cfg.hidden_size
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    pre = f"decoder_layer_{idx}"
+
+    a = _ln(cfg, x, f"{pre}_pre_att_ln")
+    qkv = _fc(cfg, a, 3 * h, f"{pre}_qkv")
+    q = T.slice(qkv, axes=[2], starts=[0], ends=[h])
+    k = T.slice(qkv, axes=[2], starts=[h], ends=[2 * h])
+    v = T.slice(qkv, axes=[2], starts=[2 * h], ends=[3 * h])
+    q = T.transpose(T.reshape(q, [0, 0, n_head, d_head]), [0, 2, 1, 3])
+    k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]), [0, 2, 1, 3])
+    v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]), [0, 2, 1, 3])
+    ctx = layers.nn.flash_attention(q, k, v, causal=True)
+    ctx = T.reshape(T.transpose(ctx, [0, 2, 1, 3]), [0, 0, h])
+    attn_out = _fc(cfg, ctx, h, f"{pre}_att_out")
+    attn_out = layers.dropout(attn_out, cfg.dropout, is_test=is_test,
+                              dropout_implementation="upscale_in_train")
+    x = M.elementwise_add(x, attn_out)
+
+    f = _ln(cfg, x, f"{pre}_pre_ffn_ln")
+    ffn = _fc(cfg, f, cfg.ffn_size, f"{pre}_ffn_0", act="gelu")
+    ffn = _fc(cfg, ffn, h, f"{pre}_ffn_1")
+    ffn = layers.dropout(ffn, cfg.dropout, is_test=is_test,
+                         dropout_implementation="upscale_in_train")
+    return M.elementwise_add(x, ffn)
+
+
+def gpt_pretrain(cfg, batch_size, seq_len, is_test=False):
+    """Feeds -> next-token LM loss. tokens [B, S] predict tokens[:, 1:]
+    (the final position is trained against the padded label)."""
+    tokens = T.data("tokens", [batch_size, seq_len], dtype="int32")
+    labels = T.data("labels", [batch_size, seq_len], dtype="int32")
+    loss_mask = T.data("loss_mask", [batch_size, seq_len],
+                       dtype="float32")
+    pos_ids = T.data("pos_ids", [batch_size, seq_len], dtype="int32")
+
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pos = layers.embedding(pos_ids, size=[cfg.max_position,
+                                          cfg.hidden_size],
+                           param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pos)
+    x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                       dropout_implementation="upscale_in_train")
+    checkpoints = []
+    for i in range(cfg.num_layers):
+        x = decoder_layer(cfg, x, i, is_test)
+        checkpoints.append(x)
+    x = _ln(cfg, x, "final_ln")
+
+    # weight-tied LM head over every position
+    word_emb = x.block.program.global_block().var("word_embedding")
+    flat = T.reshape(x, [-1, cfg.hidden_size])               # [B*S, H]
+    logits = layers.matmul(flat, word_emb, transpose_y=True)  # [B*S, V]
+    ce = layers.softmax_with_cross_entropy(
+        logits, T.reshape(labels, [-1, 1]))
+    w = T.reshape(loss_mask, [-1, 1])
+    loss = M.elementwise_div(
+        M.reduce_sum(M.elementwise_mul(ce, w)),
+        M.elementwise_add(M.reduce_sum(w),
+                          T.fill_constant([1], "float32", 1e-9)))
+    return {"feeds": [tokens, labels, loss_mask, pos_ids],
+            "loss": loss, "checkpoints": checkpoints}
+
+
+def random_batch(cfg, batch_size, seq_len, rng=None):
+    rng = rng or np.random.default_rng()
+    toks = rng.integers(0, cfg.vocab_size,
+                        (batch_size, seq_len + 1)).astype(np.int32)
+    return {
+        "tokens": toks[:, :-1].copy(),
+        "labels": toks[:, 1:].copy(),
+        "loss_mask": np.ones((batch_size, seq_len), np.float32),
+        "pos_ids": np.broadcast_to(
+            np.arange(seq_len, dtype=np.int32),
+            (batch_size, seq_len)).copy(),
+    }
