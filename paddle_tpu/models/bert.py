@@ -76,9 +76,8 @@ def _fc(x, size, name, act=None, num_flatten_dims=2):
 
 
 def _set_dist_attr(program, name, spec):
-    var = program.global_block().vars.get(name)
-    if var is not None:
-        var.dist_attr = tuple(spec)
+    from ..parallel.mesh import set_param_dist_attr
+    set_param_dist_attr(program, name, spec)
 
 
 def encoder_layer(cfg, x, attn_bias, idx, is_test):

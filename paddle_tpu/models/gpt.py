@@ -131,6 +131,27 @@ def gpt_pretrain(cfg, batch_size, seq_len, is_test=False):
             "loss": loss, "checkpoints": checkpoints}
 
 
+# ---- tensor-parallel sharding annotation (Megatron-style over "tp") ----
+
+def apply_tp_sharding(program, cfg):
+    """Same scheme as bert.apply_tp_sharding: QKV and FFN-in split on
+    the output dim, attention-out and FFN-out on the input dim — one
+    psum per matmul pair per block under GSPMD; the tied LM head rides
+    the row-sharded word embedding. Call BEFORE optimizer.minimize():
+    accumulators copy the parameter's dist_attr at creation time, so
+    annotating afterwards leaves optimizer state replicated."""
+    from ..parallel.mesh import set_param_dist_attr as _set
+    for i in range(cfg.num_layers):
+        pre = f"decoder_layer_{i}"
+        _set(program, f"{pre}_qkv.w_0", (None, "tp"))
+        _set(program, f"{pre}_qkv.b_0", ("tp",))
+        _set(program, f"{pre}_att_out.w_0", ("tp", None))
+        _set(program, f"{pre}_ffn_0.w_0", (None, "tp"))
+        _set(program, f"{pre}_ffn_0.b_0", ("tp",))
+        _set(program, f"{pre}_ffn_1.w_0", ("tp", None))
+    _set(program, "word_embedding", ("tp", None))
+
+
 def random_batch(cfg, batch_size, seq_len, rng=None):
     rng = rng or np.random.default_rng()
     toks = rng.integers(0, cfg.vocab_size,

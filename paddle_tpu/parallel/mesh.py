@@ -73,6 +73,17 @@ def default_mesh(n_devices=None):
     return Mesh(np.asarray(devices[:n]), ("dp",))
 
 
+def set_param_dist_attr(program, name, spec):
+    """Annotate a program variable with a mesh-axis sharding spec (the
+    model-agnostic helper behind bert/gpt.apply_tp_sharding). Call
+    BEFORE optimizer.minimize(): accumulators copy the parameter's
+    dist_attr at creation, so annotating afterwards leaves optimizer
+    state replicated."""
+    var = program.global_block().vars.get(name)
+    if var is not None:
+        var.dist_attr = tuple(spec)
+
+
 def partition_spec(mesh, spec, shape=None):
     """Validate a raw axis-name spec against a mesh: unknown axes replicate,
     and (when `shape` is given) axes that don't divide their dim are dropped.

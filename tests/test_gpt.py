@@ -52,3 +52,43 @@ def test_gpt_causality():
         l2, = exe.run(main, feed=feed2, fetch_list=[out["loss"]])
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                rtol=1e-5)
+
+
+def test_gpt_tp_matches_single_device():
+    """Megatron-style tp over the decoder: per-step losses identical to
+    the unsharded run (same parity bar as test_sharding's BERT case)."""
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dropout = 0.0
+    results = []
+    for mesh in (None, make_mesh(MeshConfig(tp=4, dp=2))):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            out = gpt.gpt_pretrain(cfg, 8, 16)
+            # BEFORE minimize: Adam moments copy the parameter's
+            # dist_attr at creation
+            gpt.apply_tp_sharding(main, cfg)
+            fluid.optimizer.AdamOptimizer(1e-3).minimize(out["loss"])
+        qkv = main.global_block().vars["decoder_layer_0_qkv.w_0"]
+        assert qkv.dist_attr == (None, "tp")
+        moments = [v for n, v in main.global_block().vars.items()
+                   if "decoder_layer_0_qkv.w_0" in n and "moment" in n]
+        assert moments and all(
+            m.dist_attr == (None, "tp") for m in moments), \
+            [(m.name, m.dist_attr) for m in moments]
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        feed = gpt.random_batch(cfg, 8, 16,
+                                rng=np.random.default_rng(5))
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            prog = main if mesh is None else fluid.CompiledProgram(
+                main).with_data_parallel(loss_name=out["loss"].name,
+                                         mesh=mesh)
+            losses = [float(np.asarray(
+                exe.run(prog, feed=feed,
+                        fetch_list=[out["loss"]])[0]).ravel()[0])
+                for _ in range(4)]
+        results.append(losses)
+    np.testing.assert_allclose(results[0], results[1], rtol=3e-4)
