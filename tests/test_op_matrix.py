@@ -462,3 +462,68 @@ def test_dropout_train_statistics():
                                rtol=1e-5)
     assert abs(kept.mean() - (1 - p)) < 0.03
     np.testing.assert_allclose(np.asarray(otv), xv)
+
+
+# ------------------------------------------------------------ more depth
+
+@pytest.mark.parametrize("is_test", [False, True])
+def test_batch_norm_matrix(is_test):
+    x = _data((4, 3, 5, 5), "float32")
+    scale = np.abs(_data((3,), "float32")) + 0.5
+    bias = _data((3,), "float32")
+    rmean = _data((3,), "float32") * 0.1
+    rvar = np.abs(_data((3,), "float32")) + 1.0
+    eps = 1e-5
+    if is_test:
+        m, v = rmean, rvar
+    else:
+        m = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+    ref = ((x - m[None, :, None, None])
+           / np.sqrt(v[None, :, None, None] + eps)
+           * scale[None, :, None, None] + bias[None, :, None, None])
+    t = _t("batch_norm",
+           {"X": ("bn_x", x), "Scale": ("bn_s", scale),
+            "Bias": ("bn_b", bias), "Mean": ("bn_m", rmean),
+            "Variance": ("bn_v", rvar)},
+           {"epsilon": eps, "momentum": 0.9, "is_test": is_test},
+           {"Y": ("bn_y", ref.astype(np.float32))})
+    t.check_output(rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("sections,axis", [(3, 1), ([2, 4], 1)])
+def test_split_matrix(sections, axis):
+    x = _data((4, 6), "float32")
+    if isinstance(sections, int):
+        refs = np.split(x, sections, axis)
+        attrs = {"num": sections, "axis": axis}
+    else:
+        refs = np.split(x, np.cumsum(sections)[:-1], axis)
+        attrs = {"sections": sections, "axis": axis}
+    t = _t("split", {"X": ("sp_x", x)}, attrs,
+           {"Out": [(f"sp_o{i}", r) for i, r in enumerate(refs)]})
+    t.check_output()
+
+
+def test_expand_pad_where_flip():
+    x = _data((2, 3), "float32")
+    t = _t("expand", {"X": ("ex_x", x)}, {"expand_times": [2, 2]},
+           {"Out": ("ex_out", np.tile(x, (2, 2)))})
+    t.check_output()
+
+    t = _t("pad", {"X": ("pd_x", x)},
+           {"paddings": [1, 0, 0, 2], "pad_value": -1.0},
+           {"Out": ("pd_out", np.pad(x, ((1, 0), (0, 2)),
+                                     constant_values=-1.0))})
+    t.check_output()
+
+    c = np.array([[True, False, True], [False, True, False]])
+    y = _data((2, 3), "float32")
+    t = _t("where", {"Condition": ("wh_c", c), "X": ("wh_x", x),
+                     "Y": ("wh_y", y)}, {},
+           {"Out": ("wh_out", np.where(c, x, y))})
+    t.check_output()
+
+    t = _t("flip", {"X": ("fl_x", x)}, {"axis": [1]},
+           {"Out": ("fl_out", x[:, ::-1].copy())})
+    t.check_output()
