@@ -694,14 +694,18 @@ def gru_unit(input, hidden, size=None, param_attr=None, bias_attr=None,
 
 
 def ring_attention(q, k, v, attn_bias=None, scale=0.0, mechanism="ring",
-                   name=None):
+                   causal=False, name=None):
     """Sequence-parallel attention for long contexts (north-star extra;
     the reference's sequences are single-device — SURVEY §5.7). q/k/v:
     [B, n_head, S, d_head] with S sharded over the "sp" mesh axis.
     mechanism="ring" rotates K/V blocks around the sp ring with online
     softmax (no full K/V on any chip); "ulysses" all-to-alls the shard
-    dim from sequence to heads. Exact math either way; identical to
-    plain attention without an sp axis."""
+    dim from sequence to heads. `causal` masks from block/iota indices
+    (the RING never materializes an [S, S] mask and skips fully-dead
+    blocks — a FLOP/energy saving, not a latency one, since the ring
+    synchronizes every hop; ulysses scores are dense per device either
+    way). Exact math either way; identical to plain attention without
+    an sp axis."""
     assert mechanism in ("ring", "ulysses")
     helper = LayerHelper(f"{mechanism}_attention", name=name)
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
@@ -710,7 +714,8 @@ def ring_attention(q, k, v, attn_bias=None, scale=0.0, mechanism="ring",
         ins["Bias"] = [attn_bias]
     helper.append_op(
         type=f"{mechanism}_attention", inputs=ins,
-        outputs={"Out": [out]}, attrs={"scale": float(scale)},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "causal": bool(causal)},
         infer_shape=False)
     out.shape = tuple(q.shape or ())
     out.dtype = q.dtype

@@ -31,11 +31,19 @@ from .common import x_of
 _NEG_INF = -1e30
 
 
-def _block_fold(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
-    """Fold one K/V block into the online-softmax accumulator."""
+def _block_fold(q, k_blk, v_blk, bias_blk, scale, m, l, acc,
+                row0=None, col0=None):
+    """Fold one K/V block into the online-softmax accumulator. With
+    (row0, col0) global offsets, a causal mask is synthesized from
+    iota — no [S, S] mask tensor ever exists (the point of ring
+    attention at long S)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
     if bias_blk is not None:
         s = s + bias_blk
+    if row0 is not None:
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
@@ -56,6 +64,7 @@ def ring_attention(ctx, ins, attrs):
     bias = ins.get("Bias")
     bias = bias[0] if bias else None
     scale = float(attrs.get("scale", 0.0)) or float(q.shape[-1]) ** -0.5
+    causal = bool(attrs.get("causal", False))
 
     mesh = ctx.mesh
     sp = (mesh.shape["sp"]
@@ -76,7 +85,9 @@ def ring_attention(ctx, ins, attrs):
         if bias is not None:
             bias_full = jnp.broadcast_to(bias, (B, bias.shape[1],
                                                 bias.shape[2], S))
-        m, l, acc = _block_fold(q, k, v, bias_full, scale, m, l, acc)
+        m, l, acc = _block_fold(q, k, v, bias_full, scale, m, l, acc,
+                                row0=0 if causal else None,
+                                col0=0 if causal else None)
         return {"Out": acc / l[..., None]}
 
     qspec = P(None, None, "sp", None)
@@ -100,15 +111,34 @@ def ring_attention(ctx, ins, attrs):
 
         def step(carry, t):
             k_blk, v_blk, b_rot, m, l, acc = carry
+            j = (idx - t) % sp
             if key_bias:
                 b_blk = b_rot
             else:
                 # full bias: columns of this step's key block
-                j = (idx - t) % sp
                 b_blk = jax.lax.dynamic_slice_in_dim(
                     bias_l, j * blk, blk, axis=3)
-            m, l, acc = _block_fold(q_l, k_blk, v_blk, b_blk, scale,
-                                    m, l, acc)
+            if causal:
+                # global offsets of this device's query rows and the
+                # current key block's columns; step t=0 folds the
+                # DIAGONAL block first, so every row is live from the
+                # start (the online-softmax all-masked hazard never
+                # arises). Blocks entirely ABOVE the diagonal (j > idx)
+                # skip the fold — that halves total FLOPs/energy, but
+                # NOT wall-clock: the ppermute synchronizes every step
+                # and device sp-1 folds on all of them (balancing needs
+                # striped block assignment, which would change the
+                # user-visible contiguous-shard layout).
+                m, l, acc = jax.lax.cond(
+                    j <= idx,
+                    lambda m, l, acc: _block_fold(
+                        q_l, k_blk, v_blk, b_blk, scale, m, l, acc,
+                        row0=idx * blk, col0=j * blk),
+                    lambda m, l, acc: (m, l, acc),
+                    m, l, acc)
+            else:
+                m, l, acc = _block_fold(q_l, k_blk, v_blk, b_blk, scale,
+                                        m, l, acc)
             k_blk = jax.lax.ppermute(k_blk, "sp", ring)
             v_blk = jax.lax.ppermute(v_blk, "sp", ring)
             if key_bias:
@@ -166,6 +196,7 @@ def ulysses_attention(ctx, ins, attrs):
     bias = ins.get("Bias")
     bias = bias[0] if bias else None
     scale = float(attrs.get("scale", 0.0)) or float(q.shape[-1]) ** -0.5
+    causal = bool(attrs.get("causal", False))
 
     mesh = ctx.mesh
     sp = (mesh.shape["sp"]
@@ -183,6 +214,12 @@ def ulysses_attention(ctx, ins, attrs):
         s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * scale
         if bias_ is not None:
             s = s + bias_
+        if causal:
+            # after the all-to-all each device holds FULL sequences for
+            # its heads, so plain iota masking applies
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v_)
 

@@ -20,7 +20,7 @@ def _naive_ref(q, k, v, bias=None):
     return np.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _build(mechanism, with_bias, seed=3):
+def _build(mechanism, with_bias, seed=3, causal=False):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = seed
     with fluid.program_guard(main, startup):
@@ -33,7 +33,8 @@ def _build(mechanism, with_bias, seed=3):
         if with_bias:
             bias = layers.data("bias", [B, 1, 1, S], dtype="float32")
         out = layers.nn.ring_attention(q, k, v, attn_bias=bias,
-                                       mechanism=mechanism)
+                                       mechanism=mechanism,
+                                       causal=causal)
         loss = layers.reduce_sum(layers.elementwise_mul(out, out))
         gq, gk, gv = fluid.gradients(loss, [q, k, v])
     return main, startup, out, (gq, gk, gv)
@@ -51,8 +52,9 @@ def _feed(with_bias):
     return feed
 
 
-def _run(mechanism, mesh, with_bias):
-    main, startup, out, grads = _build(mechanism, with_bias)
+def _run(mechanism, mesh, with_bias, causal=False):
+    main, startup, out, grads = _build(mechanism, with_bias,
+                                       causal=causal)
     exe = fluid.Executor()
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -230,3 +232,22 @@ def test_head_broadcast_causal_mask_both_mechanisms():
                                    atol=1e-5, err_msg=mech)
         np.testing.assert_allclose(run(mech, True), ref, rtol=3e-4,
                                    atol=1e-5, err_msg=f"{mech} sharded")
+
+
+def test_native_causal_flag_both_mechanisms():
+    """causal=True masks from block indices (the ring materializes no
+    [S,S] mask and skips fully-dead blocks): output AND grads match the
+    materialized-mask reference, single-device and sp-sharded."""
+    f = _feed(False)
+    causal_bias = np.triu(np.full((S, S), -1e30, np.float32), k=1)
+    ref = _naive_ref(f["q"], f["k"], f["v"], causal_bias[None, None])
+    mesh = make_mesh(MeshConfig(sp=4, dp=2))
+    for mech in ("ring", "ulysses"):
+        base = _run(mech, None, False, causal=True)
+        sharded = _run(mech, mesh, False, causal=True)
+        np.testing.assert_allclose(base[0], ref, rtol=2e-5, atol=1e-5,
+                                   err_msg=f"{mech} causal")
+        for a, b, name in zip(base, sharded, ("out", "gq", "gk", "gv")):
+            np.testing.assert_allclose(
+                b, a, rtol=3e-4, atol=1e-5,
+                err_msg=f"{mech} causal sp-parity {name}")
