@@ -585,10 +585,47 @@ class DygraphToStaticAst(ast.NodeTransformer):
             if node.func.id == "len" and len(node.args) == 1 \
                     and not node.keywords:
                 return _jst_call("convert_len", list(node.args))
+            if node.func.id in ("int", "float") and \
+                    len(node.args) == 1 and not node.keywords:
+                # reference cast_transformer: int(x)/float(x) on a
+                # Variable lower to cast ops
+                return _jst_call("convert_cast_" + node.func.id,
+                                 list(node.args))
             if node.func.id in ("range", "len", "_paddle_tpu_jst"):
                 return node
             node.func = _jst_call("convert_call", [node.func])
         return node
+
+    def visit_Attribute(self, node):
+        """`<expr>.shape` loads route through convert_shape (reference
+        tensor_shape_transformer): static Variables with -1 dims give
+        shape-op slices, everything else gets `x.shape` back verbatim
+        — so the rewrite is semantics-preserving for numpy arrays,
+        modules, and arbitrary objects alike."""
+        self.generic_visit(node)
+        if node.attr == "shape" and isinstance(node.ctx, ast.Load):
+            return _jst_call("convert_shape", [node.value])
+        return node
+
+    def visit_IfExp(self, node):
+        """`a if p else b` -> convert_ternary(p, lambda: a, lambda: b)
+        (reference ifelse_transformer IfExp handling); branch thunks
+        keep python's lazy evaluation."""
+        self.generic_visit(node)
+        return _jst_call("convert_ternary",
+                         [node.test, _thunk(node.body),
+                          _thunk(node.orelse)])
+
+    def visit_Assert(self, node):
+        """`assert t, msg` -> convert_assert(t, lambda: msg) (reference
+        assert_transformer -> layers.Assert). The message is thunked:
+        python evaluates assert messages only on failure, and idioms
+        like `assert not xs, xs[0]` rely on that."""
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            args.append(_thunk(node.msg))
+        return ast.Expr(value=_jst_call("convert_assert", args))
 
     def visit_Expr(self, node):
         """`name.append(expr)` statements become

@@ -358,15 +358,18 @@ def _materialize_list(x):
     return StaticTensorList(buf, cnt, cap)
 
 
-def _emit_assert(cond_var, msg):
+def _emit_assert(cond_var, msg, ordered=False):
     """runtime_assert op; returns its [1] int32 zero output for folding
-    into downstream values (keeps the check out of DCE's reach)."""
+    into downstream values (keeps the check out of DCE's reach).
+    `ordered=True` lowers to an ordered io_callback instead — for
+    asserts with no downstream consumer to fold Out into (bare assert
+    statements), where an unused pure callback could be DCE'd."""
     from ...layers.layer_helper import LayerHelper
     helper = LayerHelper("runtime_assert")
     zero = helper.create_variable_for_type_inference("int32")
     helper.append_op(
         type="runtime_assert", inputs={"Cond": [cond_var]},
-        outputs={"Out": [zero]}, attrs={"msg": msg},
+        outputs={"Out": [zero]}, attrs={"msg": msg, "ordered": ordered},
         infer_shape=False)
     return zero
 
@@ -433,6 +436,117 @@ def convert_len(x):
         return layers.slice(layers.shape(x), axes=[0], starts=[0],
                             ends=[1])
     return len(x)
+
+
+def convert_shape(x):
+    """`x.shape` in converted code (reference
+    tensor_shape_transformer.py: `var.shape` becomes `nn.shape(var)`
+    when the static shape is unknown). Static Variables with fully
+    known dims return the python tuple — compile-time constants stay
+    python and remain usable as op attrs; each -1 dim becomes a [1]
+    int32 slice of the shape op, so arithmetic on it (and `range()`
+    over it) is data-dependent. Anything else returns `x.shape`
+    untouched, which also keeps rewrites of non-tensor attributes
+    (e.g. `np.shape` as a function value) semantics-preserving."""
+    if _static_var(x):
+        if x.shape is None:
+            # shape-less intermediates (infer_shape=False ops) keep
+            # their pre-rewrite behavior: the read returns None
+            return x.shape
+        dims = list(x.shape)
+        if all(int(d) >= 0 for d in dims):
+            return tuple(int(d) for d in dims)
+        from ... import layers
+        sh = layers.shape(x)
+        out = []
+        for i, d in enumerate(dims):
+            if int(d) >= 0:
+                out.append(int(d))
+            else:
+                out.append(layers.slice(sh, axes=[0], starts=[i],
+                                        ends=[i + 1]))
+        return tuple(out)
+    return x.shape
+
+
+def convert_assert(test, msg_fn=None):
+    """`assert test, msg` in converted code (reference
+    assert_transformer.py -> layers.Assert). A static-Variable test
+    records an ORDERED runtime_assert op — ordered because a bare
+    assert has no downstream consumer to fold the check's output into,
+    and an unused pure callback would be dead-code-eliminated.
+    Concrete values keep exact python assert semantics — including
+    LAZY message evaluation: `msg_fn` is a thunk the transformer wraps
+    around the message expression, called only when the assert fails
+    (python evaluates `assert t, items[0]` messages only on failure).
+    The one divergence: a static program must embed the message string
+    at BUILD time, so the thunk runs once during conversion there."""
+    if _static_var(test):
+        from ... import layers
+        cond = test if str(test.dtype) == "bool" \
+            else layers.cast(test, "bool")
+        if cond.shape is None or any(int(d) != 1 for d in cond.shape):
+            # a multi-element test must hold EVERYWHERE (python would
+            # raise ValueError on the ambiguous bool; the static
+            # analog is the strict reduction)
+            cond = layers.reduce_all(cond)
+        if msg_fn is None:
+            msg = "Assertion failed"
+        else:
+            try:
+                msg = str(msg_fn())
+            except Exception as e:  # msg only evaluable on failure
+                msg = ("Assertion failed (message expression raised "
+                       f"{type(e).__name__} at conversion time)")
+        _emit_assert(cond, msg, ordered=True)
+        return None
+    # eager VarBase included: bool() routes through VarBase.__bool__,
+    # which keeps python's ValueError on multi-element tensors
+    if not bool(test):
+        if msg_fn is None:
+            raise AssertionError()
+        raise AssertionError(msg_fn())
+    return None
+
+
+def convert_ternary(pred, true_fn, false_fn):
+    """`a if p else b` expressions (reference ifelse_transformer's
+    IfExp path). Static predicate -> layers.cond with both branches
+    recorded; python-scalar branch values (`1.0 if big else 0.0`)
+    promote to fill_constant INSIDE the branch, as convert_ifelse
+    does. Concrete values (incl. eager VarBase via __bool__) keep
+    python's lazy-branch semantics through the thunks."""
+    if _static_var(pred):
+        from ... import layers
+
+        def run(fn):
+            v = fn()
+            if _static_var(v) or v is None:
+                return v
+            return _promote_scalar(v, "ternary", layers)
+
+        return layers.cond(pred, lambda: run(true_fn),
+                           lambda: run(false_fn))
+    return true_fn() if bool(pred) else false_fn()
+
+
+def convert_cast_int(x):
+    """`int(x)` in converted code (reference cast_transformer.py:
+    int(var) -> paddle.cast(var, 'int64'))."""
+    if _static_var(x):
+        from ... import layers
+        return layers.cast(x, "int64")
+    # eager VarBase: int() routes through VarBase.__int__ (exact python
+    # semantics, incl. ValueError on multi-element tensors)
+    return int(x)
+
+
+def convert_cast_float(x):
+    """`float(x)` in converted code (reference cast_transformer.py)."""
+    if _static_var(x):
+        from ... import layers
+        return layers.cast(x, "float32")
+    return float(x)
 
 
 _CONVERTED_CACHE = {}

@@ -143,6 +143,17 @@ class Operator:
         self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
         self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
         self.attrs = dict(attrs or {})
+        for k, v in self.attrs.items():
+            if isinstance(v, Variable) or (
+                    isinstance(v, (list, tuple))
+                    and any(isinstance(e, Variable) for e in v)):
+                raise TypeError(
+                    f"op {type!r} attr {k!r} contains a Variable; op "
+                    f"attributes are compile-time constants. Shape-"
+                    f"consuming ops that support tensor dims (reshape, "
+                    f"fill_constant) carry them as a ShapeTensorList "
+                    f"input instead — pass python ints here, or use one "
+                    f"of those ops")
         self.attrs.setdefault(OP_ROLE_KEY, _op_role_stack[-1])
 
     def input(self, slot):

@@ -112,9 +112,18 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
     dtype = convert_dtype(dtype)
     if out is None:
         out = helper.create_variable_for_type_inference(dtype=dtype)
-    helper.append_op(type="fill_constant", outputs={"Out": [out]},
-                     attrs={"shape": list(shape), "dtype": dtype,
-                            "value": float(value)})
+    attr_shape, positions, tensors = _split_tensor_dims(shape)
+    attrs = {"shape": attr_shape, "dtype": dtype, "value": float(value)}
+    if tensors:
+        attrs["shape_tensor_positions"] = positions
+        helper.append_op(type="fill_constant",
+                         inputs={"ShapeTensorList": tensors},
+                         outputs={"Out": [out]}, attrs=attrs,
+                         infer_shape=False)
+        out.shape = tuple(attr_shape)
+    else:
+        helper.append_op(type="fill_constant", outputs={"Out": [out]},
+                         attrs=attrs)
     out.stop_gradient = True
     return out
 
@@ -178,14 +187,51 @@ def assign(input, output=None):
     return output
 
 
+def _split_tensor_dims(shape):
+    """Split a dim list into (attr_shape, positions, tensor_vars).
+    Variable entries become ShapeTensorList inputs (reference
+    reshape_op.cc / fill_constant_op.cc ShapeTensor[List]): each tensor
+    dim rides as a [1] int input and is concretized at lowering — sound
+    under XLA because shape-op outputs are trace-time constants. In
+    dygraph, tensor dims concretize immediately via VarBase.__int__."""
+    from ..framework.core import Variable
+    from ..dygraph import base as dy
+    dims = list(shape)
+    if dy.enabled():
+        return [int(s) for s in dims], [], []
+    attr_shape, positions, tensors = [], [], []
+    for i, s in enumerate(dims):
+        if isinstance(s, Variable):
+            positions.append(i)
+            tensors.append(s)
+            attr_shape.append(-1)
+        else:
+            attr_shape.append(int(s))
+    return attr_shape, positions, tensors
+
+
 def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
     helper = LayerHelper("reshape", name=name)
     out = helper.create_variable_for_type_inference(dtype=x.dtype)
     xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
                                                        stop_gradient=True)
-    helper.append_op(type="reshape2", inputs={"X": [x]},
-                     outputs={"Out": [out], "XShape": [xshape]},
-                     attrs={"shape": list(shape)})
+    attr_shape, positions, tensors = _split_tensor_dims(shape)
+    inputs = {"X": [x]}
+    attrs = {"shape": attr_shape}
+    if tensors:
+        inputs["ShapeTensorList"] = tensors
+        attrs["shape_tensor_positions"] = positions
+        helper.append_op(type="reshape2", inputs=inputs,
+                         outputs={"Out": [out], "XShape": [xshape]},
+                         attrs=attrs, infer_shape=False)
+        # manual annotation: tensor dims are unknown until lowering
+        out.shape = tuple(attr_shape)
+        if x.shape is not None:
+            xshape.shape = (0,) + tuple(x.shape)
+    else:
+        helper.append_op(type="reshape2", inputs=inputs,
+                         outputs={"Out": [out], "XShape": [xshape]},
+                         attrs=attrs)
     return helper.append_activation(out, act)
 
 

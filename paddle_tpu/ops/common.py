@@ -37,6 +37,21 @@ def as_dtype(attrs, key="dtype", default="float32"):
     return dt
 
 
+def host_concrete(*vals):
+    """True when every value is host-resident (numpy / python scalar).
+
+    Shape arithmetic stays on host: the `shape` op emits a numpy array
+    (a tensor's shape is trace-time metadata, not device data), and the
+    scalar-arithmetic lowerings below preserve numpy-ness so dims
+    flowing into ShapeTensorList inputs (reshape/fill_constant) remain
+    concrete ints at lowering. Mirrors the reference, which computes
+    shapes on CPU (reshape_op.cc reads its ShapeTensor host-side)."""
+    import numpy as _np
+    return all(v is None or isinstance(v, (_np.ndarray, _np.generic,
+                                           int, float, bool))
+               for v in vals)
+
+
 def bcast_y(x, y, axis):
     """Fluid elementwise broadcast: Y's shape matches a contiguous slice of
     X's shape starting at `axis` (reference:

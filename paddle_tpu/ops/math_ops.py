@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.registry import register_op
-from .common import x_of, bcast_y, reduce_axes
+from .common import x_of, bcast_y, reduce_axes, host_concrete
 
 
 def _ew(name, fn, grad=None):
@@ -21,6 +21,19 @@ def _ew(name, fn, grad=None):
     def _op(ctx, ins, attrs, _fn=fn):
         x = x_of(ins)
         y = bcast_y(x, x_of(ins, "Y"), attrs.get("axis", -1))
+        if host_concrete(x, y):
+            # host-side shape arithmetic (see common.host_concrete):
+            # jnp.* names match their numpy originals. numpy's 64-bit
+            # promotions (int/int div -> f64, int+f32 -> f64) are
+            # narrowed to match jax's x64-off promotion rules.
+            nfn = getattr(np, _fn.__name__, None)
+            if nfn is not None:
+                out = np.asarray(nfn(x, y))
+                if out.dtype == np.float64:
+                    out = out.astype(np.float32)
+                elif out.dtype in (np.int64, np.uint64):
+                    out = out.astype(np.int32)
+                return {"Out": out}
         return {"Out": _fn(x, y)}
     return _op
 
@@ -64,6 +77,11 @@ def scale(ctx, ins, attrs):
     s = ins.get("ScaleTensor")
     s = s[0] if s else attrs.get("scale", 1.0)
     b = attrs.get("bias", 0.0)
+    if host_concrete(x, s):
+        # host-side shape arithmetic (common.host_concrete)
+        out = x * s + b if attrs.get("bias_after_scale", True) \
+            else (x + b) * s
+        return {"Out": np.asarray(out, x.dtype)}
     if attrs.get("bias_after_scale", True):
         out = x * s + jnp.asarray(b, x.dtype)
     else:

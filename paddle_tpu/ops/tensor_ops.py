@@ -16,10 +16,46 @@ from ..framework.dtype import np_dtype
 from .common import as_dtype, int64_t, x_of
 
 
+def _resolve_shape_tensors(ins, attrs):
+    """Merge ShapeTensorList input dims into the attr shape (reference
+    reshape_op.cc / fill_constant_op.cc ShapeTensor[List] semantics).
+    The tensor dims concretize here: shape-op outputs are trace-time
+    constants under jit (a tensor's shape is static metadata), so
+    `int()` succeeds; a dim computed from DATA is a genuine dynamic
+    shape, which XLA cannot compile — rejected with an actionable
+    error."""
+    shape = list(attrs.get("shape", []))
+    tl = ins.get("ShapeTensorList")
+    if tl:
+        pos = attrs.get("shape_tensor_positions")
+        if pos is None:
+            pos = list(range(len(tl)))
+        for p, tv in zip(pos, tl):
+            try:
+                shape[int(p)] = int(np.asarray(tv).reshape(-1)[0])
+            except jax.errors.TracerArrayConversionError:
+                raise ValueError(
+                    "a tensor dim in this op's shape depends on DATA, "
+                    "not on input shapes; XLA programs have static "
+                    "shapes — derive dims from `x.shape` / "
+                    "layers.shape(x) (trace-time constants) or pass "
+                    "python ints") from None
+    return shape
+
+
 @register_op("fill_constant", grad=False)
 def fill_constant(ctx, ins, attrs):
-    shape = tuple(int(s) for s in attrs.get("shape", []))
+    shape = tuple(int(s) for s in _resolve_shape_tensors(ins, attrs))
     dt = as_dtype(attrs)
+    if int(np.prod(shape)) <= 16 and np.issubdtype(np.dtype(dt),
+                                                   np.integer):
+        # small INTEGER constants stay host-resident (numpy) so scalar
+        # chains — e.g. the promoted `2` in `x.shape[0] * 2` — keep
+        # shape arithmetic concrete (common.host_concrete); XLA treats
+        # either form as a literal. Float constants (eps, lr) stay on
+        # the jnp path so their arithmetic keeps device semantics.
+        return {"Out": np.full(shape, attrs.get("value", 0.0),
+                               dtype=dt)}
     return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dt)}
 
 
@@ -107,7 +143,7 @@ def cast(ctx, ins, attrs):
 @register_op("reshape2")
 def reshape2(ctx, ins, attrs):
     x = x_of(ins)
-    shape = list(attrs["shape"])
+    shape = _resolve_shape_tensors(ins, attrs)
     # fluid semantics: 0 -> copy dim from input; single -1 inferred
     for i, s in enumerate(shape):
         if s == 0:
@@ -119,7 +155,7 @@ def reshape2(ctx, ins, attrs):
 @register_op("reshape")
 def reshape(ctx, ins, attrs):
     x = x_of(ins)
-    shape = list(attrs["shape"])
+    shape = _resolve_shape_tensors(ins, attrs)
     for i, s in enumerate(shape):
         if s == 0:
             shape[i] = x.shape[i]
@@ -345,8 +381,13 @@ def one_hot(ctx, ins, attrs):
 
 @register_op("shape", grad=False)
 def shape_op(ctx, ins, attrs):
+    """Returns NUMPY, deliberately: a tensor's shape is trace-time
+    metadata, so downstream scalar arithmetic stays host-concrete (see
+    common.host_concrete) and dims derived from it can feed
+    ShapeTensorList inputs. jnp.asarray here would stage the constant
+    as a tracer and lose the value."""
     x = x_of(ins, "Input")
-    return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
+    return {"Out": np.asarray(x.shape, dtype=np.int32)}
 
 
 @register_op("range", grad=False)

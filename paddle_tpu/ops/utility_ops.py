@@ -446,6 +446,15 @@ def runtime_assert(ctx, ins, attrs):
         # int32: a 64-bit callback result needs jax_enable_x64
         return _np.zeros((1,), _np.int32)
 
+    if attrs.get("ordered", False):
+        # assert statements (dygraph_to_static convert_assert) have no
+        # downstream consumer to fold Out into; an ordered io_callback
+        # has token-ordering effects, so XLA cannot dead-code-eliminate
+        # the check the way it may an unused pure callback
+        from jax.experimental import io_callback
+        out = io_callback(chk, jax.ShapeDtypeStruct((1,), _np.int32),
+                          cond, ordered=True)
+        return {"Out": out}
     out = jax.pure_callback(
         chk, jax.ShapeDtypeStruct((1,), _np.int32), cond)
     return {"Out": out}
