@@ -1,0 +1,550 @@
+"""OpTest depth matrix, part 2 — dtype x rank x attr sweeps for the
+next tier of most-used ops (reference op unit-test pattern,
+/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170 and
+its per-op test files, e.g. test_cumsum_op.py, test_slice_op.py,
+test_group_norm_op.py: each op exercised over a dtype/shape/attr
+matrix, not a single config). Part 1 (test_op_matrix.py) covers
+elementwise/activation/reduce/matmul/shape/conv/pool/norm heads; this
+file sweeps slicing, scan, sort, interpolation, padding, tiling,
+triangular, scatter/gather_nd, depthwise/transpose conv, and the loss
+long tail."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+BF16 = np.dtype("bfloat16") if hasattr(np, "bfloat16") else None
+try:
+    import ml_dtypes
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:
+    pass
+
+RNG = np.random.default_rng(23)
+
+
+def _data(shape, dtype="float32"):
+    a = RNG.standard_normal(shape)
+    if dtype == "bfloat16":
+        return a.astype(BF16)
+    if dtype == "int32":
+        return (a * 10).astype(np.int32)
+    return a.astype(np.float32)
+
+
+def _tol(dtype):
+    return (2e-2, 2e-2) if dtype == "bfloat16" else (1e-5, 1e-6)
+
+
+def _t(op, inputs, attrs, outputs):
+    t = OpTest()
+    t.op_type = op
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
+# ------------------------------------------------------------ slicing
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+@pytest.mark.parametrize("starts,ends", [([1], [3]), ([-3], [-1])])
+def test_slice_matrix(dtype, starts, ends):
+    x = _data((5, 4), dtype)
+    s = starts[0] + (5 if starts[0] < 0 else 0)
+    e = ends[0] + (5 if ends[0] < 0 else 0)
+    t = _t("slice", {"Input": ("sl_x", x)},
+           {"axes": [0], "starts": starts, "ends": ends},
+           {"Out": ("sl_out", np.asarray(x)[s:e])})
+    rtol, atol = _tol(dtype)
+    t.check_output(rtol=rtol, atol=atol)
+    if dtype == "float32":
+        t.check_grad(["Input"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("strides", [[1, 2], [2, 1]])
+def test_strided_slice_matrix(strides):
+    x = _data((6, 8))
+    ref = np.asarray(x)[0:6:strides[0], 1:7:strides[1]]
+    t = _t("strided_slice", {"Input": ("ss_x", x)},
+           {"axes": [0, 1], "starts": [0, 1], "ends": [6, 7],
+            "strides": strides},
+           {"Out": ("ss_out", ref)})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["Input"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------------ scan/sort
+
+@pytest.mark.parametrize("dtype", ["float32", "int32"])
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("exclusive,reverse",
+                         [(False, False), (True, False), (False, True)])
+def test_cumsum_matrix(dtype, axis, exclusive, reverse):
+    x = _data((4, 5), dtype)
+    f = np.asarray(x)
+    if reverse:
+        ref = np.flip(np.cumsum(np.flip(f, axis), axis=axis), axis)
+    else:
+        ref = np.cumsum(f, axis=axis)
+    if exclusive:
+        ref = ref - f
+    t = _t("cumsum", {"X": ("cs_x", x)},
+           {"axis": axis, "exclusive": exclusive, "reverse": reverse},
+           {"Out": ("cs_out", ref.astype(f.dtype))})
+    t.check_output(rtol=1e-5)
+    if dtype == "float32" and not exclusive and not reverse:
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("descending", [False, True])
+def test_argsort_matrix(axis, descending):
+    x = _data((4, 6))
+    f = np.asarray(x)
+    idx = np.argsort(-f if descending else f, axis=axis)
+    ref = np.take_along_axis(f, idx, axis=axis)
+    t = _t("argsort", {"X": ("as_x", x)},
+           {"axis": axis, "descending": descending},
+           {"Out": ("as_out", ref),
+            "Indices": ("as_idx", idx.astype(np.int64))})
+    t.check_output(rtol=1e-6, no_check_set=("Indices",))
+
+
+# ------------------------------------------------------------ reductions
+
+@pytest.mark.parametrize("op,ref", [("reduce_prod", np.prod),
+                                    ("reduce_min", np.min)])
+@pytest.mark.parametrize("dim,keep", [([0], False), ([1], True),
+                                      ([0, 1], False)])
+def test_reduce_prod_min_matrix(op, ref, dim, keep):
+    x = np.abs(_data((3, 4))) + 0.5   # positive, away from ties
+    r = ref(np.asarray(x), axis=tuple(dim), keepdims=keep)
+    t = _t(op, {"X": ("rd_x", x)}, {"dim": dim, "keep_dim": keep},
+           {"Out": ("rd_out", np.asarray(r, np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("axis,keepdim", [([1], False), ([0], True)])
+def test_logsumexp_matrix(axis, keepdim):
+    x = _data((4, 5))
+    f = np.asarray(x, np.float64)
+    m = f.max(axis=tuple(axis), keepdims=True)
+    ref = np.log(np.exp(f - m).sum(axis=tuple(axis), keepdims=True)) + m
+    if not keepdim:
+        ref = np.squeeze(ref, axis=tuple(axis))
+    t = _t("logsumexp", {"X": ("lse_x", x)},
+           {"axis": axis, "keepdim": keepdim},
+           {"Out": ("lse_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------------ int elementwise
+
+@pytest.mark.parametrize("op,ref", [
+    ("elementwise_mod", lambda a, b: np.mod(a, b)),
+    ("elementwise_floordiv", lambda a, b: a // b),
+])
+def test_int_elementwise_matrix(op, ref):
+    a = (RNG.integers(1, 50, (4, 5))).astype(np.int32)
+    b = (RNG.integers(1, 7, (4, 5))).astype(np.int32)
+    t = _t(op, {"X": ("ie_x", a), "Y": ("ie_y", b)}, {},
+           {"Out": ("ie_out", ref(a, b).astype(np.int32))})
+    t.check_output(rtol=0, atol=0)
+
+
+# ------------------------------------------------------------ unary trig
+
+@pytest.mark.parametrize("op,ref", [
+    ("cos", np.cos), ("sin", np.sin),
+    ("rsqrt", lambda v: 1.0 / np.sqrt(v)),
+])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_unary_matrix(op, ref, dtype):
+    x = _data((3, 4, 5), dtype)
+    if op == "rsqrt":
+        x = np.abs(x) + np.asarray(0.5, x.dtype)
+    r = ref(np.asarray(x, np.float64))
+    rtol, atol = _tol(dtype)
+    t = _t(op, {"X": ("un_x", x)}, {},
+           {"Out": ("un_out", r.astype(np.asarray(x).dtype))})
+    t.check_output(rtol=rtol, atol=atol)
+    if dtype == "float32":
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_erf_matrix():
+    from scipy import special
+    x = _data((4, 6))
+    t = _t("erf", {"X": ("erf_x", x)}, {},
+           {"Out": ("erf_out", special.erf(np.asarray(x)).astype(
+               np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------------ norms
+
+@pytest.mark.parametrize("groups", [1, 2, 4])
+def test_group_norm_matrix(groups):
+    n, c, h, w = 2, 4, 3, 3
+    x = _data((n, c, h, w))
+    scale = _data((c,))
+    bias = _data((c,))
+    f = np.asarray(x, np.float64)
+    xg = f.reshape(n, groups, c // groups, h, w)
+    m = xg.mean(axis=(2, 3, 4), keepdims=True)
+    v = xg.var(axis=(2, 3, 4), keepdims=True)
+    y = ((xg - m) / np.sqrt(v + 1e-5)).reshape(n, c, h, w)
+    y = y * scale.reshape(1, c, 1, 1) + bias.reshape(1, c, 1, 1)
+    t = _t("group_norm",
+           {"X": ("gn_x", x), "Scale": ("gn_s", scale),
+            "Bias": ("gn_b", bias)},
+           {"groups": groups, "epsilon": 1e-5},
+           {"Y": ("gn_y", y.astype(np.float32)),
+            "Mean": ("gn_m", m.reshape(n, groups).astype(np.float32)),
+            "Variance": ("gn_v", v.reshape(n, groups).astype(
+                np.float32))})
+    t.check_output(rtol=1e-4, atol=1e-4, no_check_set=("Variance",))
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.05)
+
+
+def test_instance_norm_matrix():
+    n, c, h, w = 2, 3, 4, 4
+    x = _data((n, c, h, w))
+    scale = _data((c,))
+    bias = _data((c,))
+    f = np.asarray(x, np.float64)
+    m = f.mean(axis=(2, 3), keepdims=True)
+    v = f.var(axis=(2, 3), keepdims=True)
+    y = (f - m) / np.sqrt(v + 1e-5)
+    y = y * scale.reshape(1, c, 1, 1) + bias.reshape(1, c, 1, 1)
+    t = _t("instance_norm",
+           {"X": ("in_x", x), "Scale": ("in_s", scale),
+            "Bias": ("in_b", bias)},
+           {"epsilon": 1e-5},
+           {"Y": ("in_y", y.astype(np.float32)),
+            "SavedMean": ("in_m", np.squeeze(m).astype(np.float32)),
+            "SavedVariance": ("in_v", np.squeeze(v).astype(np.float32))})
+    t.check_output(rtol=1e-4, atol=1e-4,
+                   no_check_set=("SavedMean", "SavedVariance"))
+    t.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("mode", ["all", "channel"])
+def test_prelu_matrix(mode):
+    x = _data((2, 3, 4))
+    # keep inputs off the kink: central differences straddle x=0
+    x = (x + np.sign(x) * 0.5).astype(np.float32)
+    alpha = np.abs(_data((1,) if mode == "all" else (3,))) * 0.25
+    a = alpha if mode == "all" else alpha.reshape(1, 3, 1)
+    ref = np.where(np.asarray(x) > 0, x, a * np.asarray(x))
+    t = _t("prelu", {"X": ("pr_x", x), "Alpha": ("pr_a", alpha)},
+           {"mode": mode},
+           {"Out": ("pr_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X", "Alpha"], "Out", max_relative_error=0.05)
+
+
+# ------------------------------------------------------------ interp / pad
+
+@pytest.mark.parametrize("op", ["nearest_interp", "bilinear_interp"])
+@pytest.mark.parametrize("scale", [2, 3])
+def test_interp_matrix(op, scale):
+    import jax
+    x = _data((2, 3, 4, 4))
+    oh = ow = 4 * scale
+    method = "nearest" if op.startswith("nearest") else "bilinear"
+    ref = np.asarray(jax.image.resize(
+        np.asarray(x), (2, 3, oh, ow), method=method))
+    t = _t(op, {"X": ("ip_x", x)}, {"out_h": oh, "out_w": ow},
+           {"Out": ("ip_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    if op == "bilinear_interp" and scale == 2:
+        t.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "edge"])
+def test_pad2d_matrix(mode):
+    x = _data((2, 3, 4, 5))
+    p = [1, 2, 1, 1]  # top, bottom, left, right
+    widths = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        ref = np.pad(np.asarray(x), widths, constant_values=0.0)
+    else:
+        ref = np.pad(np.asarray(x), widths, mode=mode)
+    t = _t("pad2d", {"X": ("pd_x", x)},
+           {"paddings": p, "mode": mode},
+           {"Out": ("pd_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------------ tiling
+
+@pytest.mark.parametrize("repeat", [[2, 1], [1, 3], [2, 2]])
+def test_tile_matrix(repeat):
+    x = _data((2, 3))
+    t = _t("tile", {"X": ("tl_x", x)}, {"repeat_times": repeat},
+           {"Out": ("tl_out", np.tile(np.asarray(x), repeat))})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("shape", [[4, 2, 3], [2, -1, 3]])
+def test_expand_v2_matrix(shape):
+    x = _data((1, 3))
+    xs = np.asarray(x).reshape((1,) * (len(shape) - 2) + (1, 3))
+    tgt = tuple(xs.shape[i] if s == -1 else s
+                for i, s in enumerate(shape))
+    ref = np.broadcast_to(xs, tgt)
+    t = _t("expand_v2", {"X": ("ev_x", x)}, {"shape": shape},
+           {"Out": ("ev_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+
+
+# ------------------------------------------------------------ triangular / kron / roll
+
+@pytest.mark.parametrize("lower", [True, False])
+@pytest.mark.parametrize("diag", [-1, 0, 1])
+def test_tril_triu_matrix(lower, diag):
+    x = _data((5, 5))
+    ref = np.tril(np.asarray(x), diag) if lower \
+        else np.triu(np.asarray(x), diag)
+    t = _t("tril_triu", {"X": ("tt_x", x)},
+           {"lower": lower, "diagonal": diag},
+           {"Out": ("tt_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_kron_matrix():
+    a = _data((2, 3))
+    b = _data((3, 2))
+    t = _t("kron", {"X": ("kr_x", a), "Y": ("kr_y", b)}, {},
+           {"Out": ("kr_out", np.kron(np.asarray(a),
+                                      np.asarray(b)).astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("shifts,axis", [([1], [0]), ([2, -1], [0, 1])])
+def test_roll_matrix(shifts, axis):
+    x = _data((4, 5))
+    ref = np.roll(np.asarray(x), shifts, axis=tuple(axis))
+    t = _t("roll", {"X": ("rl_x", x)},
+           {"shifts": shifts, "axis": axis},
+           {"Out": ("rl_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+# ------------------------------------------------------------ scatter / gather_nd / unstack
+
+@pytest.mark.parametrize("overwrite", [True, False])
+def test_scatter_matrix(overwrite):
+    x = _data((6, 3))
+    ids = np.array([1, 3, 1], np.int64)
+    upd = _data((3, 3))
+    ref = np.asarray(x).copy()
+    if overwrite:
+        for i, r in zip(ids, np.asarray(upd)):
+            ref[i] = r
+    else:
+        for i, r in zip(ids, np.asarray(upd)):
+            ref[i] += r
+    t = _t("scatter",
+           {"X": ("sc_x", x), "Ids": ("sc_i", ids),
+            "Updates": ("sc_u", upd)},
+           {"overwrite": overwrite},
+           {"Out": ("sc_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+
+
+@pytest.mark.parametrize("idx_last", [1, 2])
+def test_gather_nd_matrix(idx_last):
+    x = _data((4, 5))
+    if idx_last == 1:
+        index = np.array([[0], [2], [3]], np.int64)
+        ref = np.asarray(x)[[0, 2, 3]]
+    else:
+        index = np.array([[0, 1], [2, 3], [3, 4]], np.int64)
+        ref = np.asarray(x)[[0, 2, 3], [1, 3, 4]]
+    t = _t("gather_nd", {"X": ("gn2_x", x), "Index": ("gn2_i", index)},
+           {}, {"Out": ("gn2_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-6)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_unstack_matrix(axis):
+    x = _data((3, 4))
+    parts = [np.squeeze(a, axis)
+             for a in np.split(np.asarray(x), x.shape[axis], axis)]
+    t = _t("unstack", {"X": ("ust_x", x)},
+           {"axis": axis, "num": x.shape[axis]},
+           {"Y": [(f"ust_o{i}", p) for i, p in enumerate(parts)]})
+    t.check_output(rtol=1e-6)
+
+
+def test_flatten2_matrix():
+    x = _data((2, 3, 4))
+    t = _t("flatten2", {"X": ("fl_x", x)}, {"axis": 2},
+           {"Out": ("fl_out", np.asarray(x).reshape(6, 4)),
+            "XShape": ("fl_xs", np.zeros((0, 2, 3, 4), np.float32))})
+    t.check_output(rtol=1e-6, no_check_set=("XShape",))
+
+
+# ------------------------------------------------------------ conv variants
+
+def test_depthwise_conv2d_matrix():
+    from scipy import signal
+    x = _data((2, 3, 6, 6))
+    w = _data((3, 1, 3, 3)) * 0.3
+    ref = np.zeros((2, 3, 4, 4), np.float32)
+    for b in range(2):
+        for c in range(3):
+            ref[b, c] = signal.correlate2d(np.asarray(x)[b, c],
+                                           np.asarray(w)[c, 0], "valid")
+    t = _t("depthwise_conv2d",
+           {"Input": ("dw_x", x), "Filter": ("dw_w", w)},
+           {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1]},
+           {"Output": ("dw_out", ref)})
+    t.check_output(rtol=1e-4, atol=1e-4)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.05)
+
+
+def test_conv2d_transpose_matrix():
+    from scipy import signal
+    x = _data((2, 3, 4, 4))
+    w = _data((3, 2, 3, 3)) * 0.3   # [C_in, C_out, kh, kw]
+    ref = np.zeros((2, 2, 6, 6), np.float32)
+    for b in range(2):
+        for o in range(2):
+            ref[b, o] = sum(
+                signal.convolve2d(np.asarray(x)[b, ci],
+                                  np.asarray(w)[ci, o], "full")
+                for ci in range(3))
+    t = _t("conv2d_transpose",
+           {"Input": ("ct_x", x), "Filter": ("ct_w", w)},
+           {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+            "groups": 1},
+           {"Output": ("ct_out", ref)})
+    t.check_output(rtol=1e-4, atol=1e-4)
+    t.check_grad(["Input", "Filter"], "Output", max_relative_error=0.05)
+
+
+def test_conv3d_matrix():
+    from scipy import signal
+    x = _data((1, 2, 4, 4, 4))
+    w = _data((2, 2, 2, 2, 2)) * 0.3
+    ref = np.zeros((1, 2, 3, 3, 3), np.float32)
+    for o in range(2):
+        ref[0, o] = sum(
+            signal.correlate(np.asarray(x)[0, c], np.asarray(w)[o, c],
+                             "valid")
+            for c in range(2))
+    t = _t("conv3d", {"Input": ("c3_x", x), "Filter": ("c3_w", w)},
+           {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1], "groups": 1},
+           {"Output": ("c3_out", ref)})
+    t.check_output(rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d_matrix(ptype):
+    x = _data((1, 2, 4, 4, 4))
+    r = np.asarray(x).reshape(1, 2, 2, 2, 2, 2, 2, 2)
+    ref = r.max(axis=(3, 5, 7)) if ptype == "max" \
+        else r.mean(axis=(3, 5, 7))
+    t = _t("pool3d", {"X": ("p3_x", x)},
+           {"pooling_type": ptype, "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+           {"Out": ("p3_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+
+
+# ------------------------------------------------------------ losses
+
+@pytest.mark.parametrize("axis", [-1, 0])
+def test_log_softmax_matrix(axis):
+    x = _data((4, 6))
+    f = np.asarray(x, np.float64)
+    m = f.max(axis=axis, keepdims=True)
+    ref = (f - m) - np.log(np.exp(f - m).sum(axis=axis, keepdims=True))
+    t = _t("log_softmax", {"X": ("ls_x", x)}, {"axis": axis},
+           {"Out": ("ls_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("delta", [0.5, 1.0])
+def test_huber_loss_matrix(delta):
+    x = _data((5, 1))
+    y = _data((5, 1))
+    r = np.asarray(y) - np.asarray(x)
+    ref = np.where(np.abs(r) <= delta, 0.5 * r * r,
+                   delta * (np.abs(r) - 0.5 * delta))
+    t = _t("huber_loss", {"X": ("hb_x", x), "Y": ("hb_y", y)},
+           {"delta": delta},
+           {"Out": ("hb_out", ref.astype(np.float32)),
+            "Residual": ("hb_r", r.astype(np.float32))})
+    t.check_output(rtol=1e-5, no_check_set=("Residual",))
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "batchmean",
+                                       "none"])
+def test_kldiv_loss_matrix(reduction):
+    x = _data((4, 5))
+    tgt = np.abs(_data((4, 5))) + 0.1
+    loss = tgt * (np.log(tgt) - np.asarray(x))
+    if reduction == "mean":
+        ref = loss.mean()
+    elif reduction == "sum":
+        ref = loss.sum()
+    elif reduction == "batchmean":
+        ref = loss.sum() / 4
+    else:
+        ref = loss
+    t = _t("kldiv_loss", {"X": ("kl_x", x), "Target": ("kl_t", tgt)},
+           {"reduction": reduction},
+           {"Loss": ("kl_out", np.asarray(ref, np.float32))})
+    t.check_output(rtol=1e-5)
+
+
+def test_bce_loss_matrix():
+    x = np.clip(np.abs(_data((6,))), 0.05, 0.95).astype(np.float32)
+    lab = (RNG.random(6) > 0.5).astype(np.float32)
+    ref = -(lab * np.log(x) + (1 - lab) * np.log(1 - x))
+    t = _t("bce_loss", {"X": ("bc_x", x), "Label": ("bc_l", lab)}, {},
+           {"Out": ("bc_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.05)
+
+
+@pytest.mark.parametrize("sigma", [1.0, 2.0])
+def test_smooth_l1_loss_matrix(sigma):
+    x = _data((4, 3))
+    y = _data((4, 3))
+    s2 = sigma * sigma
+    diff = np.abs(np.asarray(x) - np.asarray(y))
+    loss = np.where(diff < 1.0 / s2, 0.5 * s2 * diff * diff,
+                    diff - 0.5 / s2)
+    t = _t("smooth_l1_loss", {"X": ("s1_x", x), "Y": ("s1_y", y)},
+           {"sigma": sigma},
+           {"Out": ("s1_out", loss.sum(-1, keepdims=True).astype(
+               np.float32)),
+            "Diff": ("s1_d", (np.asarray(x) - np.asarray(y)).astype(
+                np.float32))})
+    t.check_output(rtol=1e-5, no_check_set=("Diff",))
+
+
+@pytest.mark.parametrize("eps", [0.1, 0.2])
+def test_label_smooth_matrix(eps):
+    x = np.eye(4, 5, dtype=np.float32)
+    ref = (1 - eps) * x + eps / 5
+    t = _t("label_smooth", {"X": ("lsm_x", x)}, {"epsilon": eps},
+           {"Out": ("lsm_out", ref.astype(np.float32))})
+    t.check_output(rtol=1e-5)

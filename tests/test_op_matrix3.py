@@ -1,0 +1,265 @@
+"""OpTest depth matrix, part 3 — optimizer update rules swept over
+shape x attr variants against single-step numpy oracles (reference
+test pattern: test_sgd_op.py, test_momentum_op.py, test_adam_op.py,
+test_rmsprop_op.py etc., each exercising attr variants like
+use_nesterov / centered / lazy_mode)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+RNG = np.random.default_rng(31)
+
+
+def _t(op, inputs, attrs, outputs):
+    t = OpTest()
+    t.op_type = op
+    t.inputs = inputs
+    t.attrs = attrs
+    t.outputs = outputs
+    return t
+
+
+def _pgl(shape):
+    p = RNG.standard_normal(shape).astype(np.float32)
+    g = RNG.standard_normal(shape).astype(np.float32) * 0.1
+    lr = np.array([0.05], np.float32)
+    return p, g, lr
+
+
+SHAPES = [(6,), (3, 4)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_sgd_matrix(shape):
+    p, g, lr = _pgl(shape)
+    t = _t("sgd",
+           {"Param": ("sg_p", p), "Grad": ("sg_g", g),
+            "LearningRate": ("sg_lr", lr)}, {},
+           {"ParamOut": ("sg_po", p - lr * g)})
+    t.check_output(rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum_matrix(shape, nesterov):
+    p, g, lr = _pgl(shape)
+    v = RNG.standard_normal(shape).astype(np.float32) * 0.1
+    mu = 0.9
+    vn = mu * v + g
+    po = p - (g + mu * vn) * lr if nesterov else p - lr * vn
+    t = _t("momentum",
+           {"Param": ("mo_p", p), "Grad": ("mo_g", g),
+            "Velocity": ("mo_v", v), "LearningRate": ("mo_lr", lr)},
+           {"mu": mu, "use_nesterov": nesterov},
+           {"ParamOut": ("mo_po", po), "VelocityOut": ("mo_vo", vn)})
+    t.check_output(rtol=1e-6)
+
+
+def _adam_ref(p, g, m1, m2, b1p, b2p, lr, b1, b2, eps):
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+    po = p - lr_t * m1n / (np.sqrt(m2n) + eps)
+    return po, m1n, m2n
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_adam_matrix(shape):
+    p, g, lr = _pgl(shape)
+    m1 = np.zeros(shape, np.float32) + 0.01
+    m2 = np.zeros(shape, np.float32) + 0.02
+    b1p = np.array([0.9], np.float32)
+    b2p = np.array([0.999], np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    po, m1n, m2n = _adam_ref(p, g, m1, m2, b1p, b2p, lr, b1, b2, eps)
+    t = _t("adam",
+           {"Param": ("ad_p", p), "Grad": ("ad_g", g),
+            "LearningRate": ("ad_lr", lr), "Moment1": ("ad_m1", m1),
+            "Moment2": ("ad_m2", m2), "Beta1Pow": ("ad_b1", b1p),
+            "Beta2Pow": ("ad_b2", b2p)},
+           {"beta1": b1, "beta2": b2, "epsilon": eps},
+           {"ParamOut": ("ad_po", po), "Moment1Out": ("ad_m1o", m1n),
+            "Moment2Out": ("ad_m2o", m2n),
+            "Beta1PowOut": ("ad_b1o", b1p * b1),
+            "Beta2PowOut": ("ad_b2o", b2p * b2)})
+    t.check_output(rtol=1e-5)
+
+
+def test_adamw_matrix():
+    shape = (4, 3)
+    p, g, lr = _pgl(shape)
+    m1 = np.zeros(shape, np.float32)
+    m2 = np.zeros(shape, np.float32)
+    b1p = np.array([0.9], np.float32)
+    b2p = np.array([0.999], np.float32)
+    coeff = 0.01
+    po, m1n, m2n = _adam_ref(p, g, m1, m2, b1p, b2p, lr, 0.9, 0.999,
+                             1e-8)
+    po = po - lr * coeff * p
+    t = _t("adamw",
+           {"Param": ("aw_p", p), "Grad": ("aw_g", g),
+            "LearningRate": ("aw_lr", lr), "Moment1": ("aw_m1", m1),
+            "Moment2": ("aw_m2", m2), "Beta1Pow": ("aw_b1", b1p),
+            "Beta2Pow": ("aw_b2", b2p)},
+           {"coeff": coeff, "with_decay": True},
+           {"ParamOut": ("aw_po", po), "Moment1Out": ("aw_m1o", m1n),
+            "Moment2Out": ("aw_m2o", m2n),
+            "Beta1PowOut": ("aw_b1o", b1p * 0.9),
+            "Beta2PowOut": ("aw_b2o", b2p * 0.999)})
+    t.check_output(rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_adagrad_matrix(shape):
+    p, g, lr = _pgl(shape)
+    mom = np.abs(RNG.standard_normal(shape)).astype(np.float32) * 0.1
+    eps = 1e-6
+    mn = mom + g * g
+    po = p - lr * g / (np.sqrt(mn) + eps)
+    t = _t("adagrad",
+           {"Param": ("ag_p", p), "Grad": ("ag_g", g),
+            "Moment": ("ag_m", mom), "LearningRate": ("ag_lr", lr)},
+           {"epsilon": eps},
+           {"ParamOut": ("ag_po", po), "MomentOut": ("ag_mo", mn)})
+    t.check_output(rtol=1e-5)
+
+
+def test_decayed_adagrad_matrix():
+    shape = (5,)
+    p, g, lr = _pgl(shape)
+    mom = np.abs(RNG.standard_normal(shape)).astype(np.float32) * 0.1
+    decay, eps = 0.95, 1e-6
+    mn = decay * mom + (1 - decay) * g * g
+    po = p - lr * g / (np.sqrt(mn) + eps)
+    t = _t("decayed_adagrad",
+           {"Param": ("dg_p", p), "Grad": ("dg_g", g),
+            "Moment": ("dg_m", mom), "LearningRate": ("dg_lr", lr)},
+           {"decay": decay, "epsilon": eps},
+           {"ParamOut": ("dg_po", po), "MomentOut": ("dg_mo", mn)})
+    t.check_output(rtol=1e-5)
+
+
+def test_adadelta_matrix():
+    shape = (3, 4)
+    p, g, _ = _pgl(shape)
+    asg = np.abs(RNG.standard_normal(shape)).astype(np.float32) * 0.1
+    asu = np.abs(RNG.standard_normal(shape)).astype(np.float32) * 0.1
+    rho, eps = 0.95, 1e-6
+    asgn = rho * asg + (1 - rho) * g * g
+    upd = -np.sqrt((asu + eps) / (asgn + eps)) * g
+    asun = rho * asu + (1 - rho) * upd * upd
+    t = _t("adadelta",
+           {"Param": ("dd_p", p), "Grad": ("dd_g", g),
+            "AvgSquaredGrad": ("dd_ag", asg),
+            "AvgSquaredUpdate": ("dd_au", asu)},
+           {"rho": rho, "epsilon": eps},
+           {"ParamOut": ("dd_po", p + upd),
+            "AvgSquaredGradOut": ("dd_ago", asgn),
+            "AvgSquaredUpdateOut": ("dd_auo", asun)})
+    t.check_output(rtol=1e-5)
+
+
+def test_adamax_matrix():
+    shape = (6,)
+    p, g, lr = _pgl(shape)
+    m = np.zeros(shape, np.float32) + 0.01
+    inf = np.abs(RNG.standard_normal(shape)).astype(np.float32) * 0.1
+    b1p = np.array([0.9], np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    mn = b1 * m + (1 - b1) * g
+    infn = np.maximum(b2 * inf, np.abs(g))
+    lr_t = lr / (1 - b1p)
+    po = p - lr_t * mn / (infn + eps)
+    t = _t("adamax",
+           {"Param": ("ax_p", p), "Grad": ("ax_g", g),
+            "LearningRate": ("ax_lr", lr), "Moment": ("ax_m", m),
+            "InfNorm": ("ax_i", inf), "Beta1Pow": ("ax_b1", b1p)},
+           {"beta1": b1, "beta2": b2, "epsilon": eps},
+           {"ParamOut": ("ax_po", po), "MomentOut": ("ax_mo", mn),
+            "InfNormOut": ("ax_io", infn)})
+    t.check_output(rtol=1e-5)
+
+
+@pytest.mark.parametrize("centered", [False, True])
+def test_rmsprop_matrix(centered):
+    shape = (4, 3)
+    p, g, lr = _pgl(shape)
+    ms = np.abs(RNG.standard_normal(shape)).astype(np.float32) + 0.1
+    mg = RNG.standard_normal(shape).astype(np.float32) * 0.1
+    mom = RNG.standard_normal(shape).astype(np.float32) * 0.1
+    rho, eps, mu = 0.95, 1e-6, 0.9
+    msn = rho * ms + (1 - rho) * g * g
+    if centered:
+        mgn = rho * mg + (1 - rho) * g
+        denom = msn - mgn * mgn + eps
+    else:
+        mgn = mg
+        denom = msn + eps
+    momn = mu * mom + lr * g / np.sqrt(denom)
+    t = _t("rmsprop",
+           {"Param": ("rp_p", p), "Grad": ("rp_g", g),
+            "LearningRate": ("rp_lr", lr), "MeanSquare": ("rp_ms", ms),
+            "MeanGrad": ("rp_mg", mg), "Moment": ("rp_m", mom)},
+           {"decay": rho, "epsilon": eps, "momentum": mu,
+            "centered": centered},
+           {"ParamOut": ("rp_po", p - momn),
+            "MeanSquareOut": ("rp_mso", msn),
+            "MeanGradOut": ("rp_mgo", mgn),
+            "MomentOut": ("rp_mo", momn)})
+    t.check_output(rtol=1e-4, atol=1e-5)
+
+
+def test_ftrl_matrix():
+    shape = (5,)
+    p, g, lr = _pgl(shape)
+    sq = np.abs(RNG.standard_normal(shape)).astype(np.float32) + 0.1
+    lin = RNG.standard_normal(shape).astype(np.float32) * 0.1
+    l1, l2, power = 0.1, 0.2, -0.5
+    nsq = sq + g * g
+    sigma = (nsq ** -power - sq ** -power) / lr
+    nlin = lin + g - sigma * p
+    x = l1 * np.sign(nlin) - nlin
+    y = nsq ** -power / lr + 2 * l2
+    po = np.where(np.abs(nlin) > l1, x / y, 0.0).astype(np.float32)
+    t = _t("ftrl",
+           {"Param": ("ft_p", p), "Grad": ("ft_g", g),
+            "LearningRate": ("ft_lr", lr),
+            "SquaredAccumulator": ("ft_sq", sq),
+            "LinearAccumulator": ("ft_l", lin)},
+           {"l1": l1, "l2": l2, "lr_power": power},
+           {"ParamOut": ("ft_po", po),
+            "SquaredAccumOut": ("ft_sqo", nsq),
+            "LinearAccumOut": ("ft_lo", nlin)})
+    t.check_output(rtol=1e-4, atol=1e-5)
+
+
+def test_lamb_matrix():
+    shape = (3, 4)
+    p, g, lr = _pgl(shape)
+    m1 = np.zeros(shape, np.float32) + 0.01
+    m2 = np.zeros(shape, np.float32) + 0.02
+    b1p = np.array([0.9], np.float32)
+    b2p = np.array([0.999], np.float32)
+    b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (np.sqrt(m2h) + eps) + wd * p
+    pn = np.sqrt((p * p).sum())
+    rn = np.sqrt((r * r).sum())
+    trust = pn / rn if (pn > 0 and rn > 0) else 1.0
+    po = p - lr * trust * r
+    t = _t("lamb",
+           {"Param": ("lb_p", p), "Grad": ("lb_g", g),
+            "LearningRate": ("lb_lr", lr), "Moment1": ("lb_m1", m1),
+            "Moment2": ("lb_m2", m2), "Beta1Pow": ("lb_b1", b1p),
+            "Beta2Pow": ("lb_b2", b2p)},
+           {"beta1": b1, "beta2": b2, "epsilon": eps,
+            "weight_decay": wd},
+           {"ParamOut": ("lb_po", po), "Moment1Out": ("lb_m1o", m1n),
+            "Moment2Out": ("lb_m2o", m2n),
+            "Beta1PowOut": ("lb_b1o", b1p * b1),
+            "Beta2PowOut": ("lb_b2o", b2p * b2)})
+    t.check_output(rtol=1e-4, atol=1e-5)
