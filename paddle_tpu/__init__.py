@@ -10,13 +10,15 @@ from .framework.core import (  # noqa: F401
     Program, Variable, Operator, Block, Parameter,
     default_main_program, default_startup_program, program_guard,
     switch_main_program, switch_startup_program,
-    CPUPlace, CUDAPlace, TPUPlace, OpRole,
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, OpRole,
     grad_var_name, ComplexVariable,
+    name_scope, device_guard, require_version,
 )
 from .framework.executor import (  # noqa: F401
     Executor, FetchHandler, Scope, global_scope, scope_guard,
 )
 from .framework.backward import append_backward, gradients  # noqa: F401
+from .framework import backward  # noqa: F401  (fluid.backward module)
 from .framework import initializer  # noqa: F401
 from .framework import unique_name  # noqa: F401
 from .framework import passes  # noqa: F401  (Pass/register_pass/apply_passes)
@@ -29,7 +31,7 @@ from . import nets  # noqa: F401
 from . import dataset  # noqa: F401
 from . import clip  # noqa: F401
 from .parallel.compiler import (  # noqa: F401
-    CompiledProgram, BuildStrategy, ExecutionStrategy,
+    CompiledProgram, BuildStrategy, ExecutionStrategy, ParallelExecutor,
 )
 from . import parallel  # noqa: F401
 from .layers.tensor import data  # noqa: F401
@@ -55,7 +57,21 @@ from .flags import get_flags, set_flags  # noqa: F401
 from . import distributed  # noqa: F401
 from .transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, GeoSgdTranspiler,
+    HashName, RoundRobin, memory_optimize, release_memory,
 )
+from .lod import (  # noqa: F401
+    Tensor, LoDTensor, LoDTensorArray, create_lod_tensor,
+    create_random_int_lodtensor,
+)
+from .trainer_desc import (  # noqa: F401
+    TrainerDesc, MultiTrainer, DistMultiTrainer, PipelineTrainer,
+)
+from .input import embedding, one_hot  # noqa: F401  (v2 semantics)
+from .dataio import DataFeedDesc  # noqa: F401
+from .dygraph.base import (  # noqa: F401
+    enable_dygraph, disable_dygraph, in_dygraph_mode, VarBase,
+)
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,
@@ -97,6 +113,10 @@ def cuda_places(device_ids=None):
 
 def cpu_places(device_count=None):
     return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def cuda_pinned_places(device_count=None):
+    return [CUDAPinnedPlace() for _ in range(device_count or 1)]
 
 
 def device_count():

@@ -2,6 +2,7 @@
 python/paddle/fluid/reader.py, data_feeder.py, dataset.py,
 python/paddle/reader/decorator.py)."""
 from .reader import DataLoader, PyReader, DataFeeder  # noqa: F401
+from .feed_desc import DataFeedDesc  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetFactory, DatasetBase, QueueDataset, InMemoryDataset,
 )

@@ -1,0 +1,74 @@
+"""DataFeedDesc (reference python/paddle/fluid/data_feed_desc.py:21):
+describes the on-disk slot format for the file-list Dataset /
+train_from_dataset path. The reference wraps a DataFeedDesc protobuf
+parsed from a prototxt file; here the descriptor is a plain config
+parsed from the same prototxt-style text (name/type/is_dense/is_used
+per slot + batch_size), consumable by DatasetFactory datasets and the
+native C++ datafeed engine's slot schema."""
+import re
+
+
+class DataFeedDesc:
+    def __init__(self, proto_file):
+        self._batch_size = 32
+        self._slots = []        # [{name, type, is_dense, is_used}]
+        with open(proto_file) as f:
+            text = f.read()
+        self._parse(text)
+
+    def _parse(self, text):
+        m = re.search(r"batch_size\s*:\s*(\d+)", text)
+        if m:
+            self._batch_size = int(m.group(1))
+        for block in re.finditer(r"slots?\s*\{([^}]*)\}", text):
+            body = block.group(1)
+
+            def field(key, default=None):
+                fm = re.search(rf"{key}\s*:\s*\"?([\w.]+)\"?", body)
+                return fm.group(1) if fm else default
+
+            self._slots.append({
+                "name": field("name"),
+                "type": field("type", "uint64"),
+                "is_dense": field("is_dense", "false") == "true",
+                "is_used": field("is_used", "false") == "true",
+            })
+
+    # ---- reference data_feed_desc.py API ----
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def _check_known(self, names):
+        known = {s["name"] for s in self._slots}
+        unknown = [n for n in names if n not in known]
+        if unknown:
+            # reference data_feed_desc.py indexes a name->slot dict and
+            # raises on unknown names; a typo must not be a silent no-op
+            raise ValueError(
+                f"unknown slot name(s) {unknown}; declared slots: "
+                f"{sorted(known)}")
+
+    def set_dense_slots(self, dense_slots_name):
+        names = set(dense_slots_name)
+        self._check_known(names)
+        for s in self._slots:
+            if s["name"] in names:
+                s["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name):
+        names = set(use_slots_name)
+        self._check_known(names)
+        for s in self._slots:
+            if s["name"] in names:
+                s["is_used"] = True
+
+    def desc(self):
+        lines = [f"batch_size: {self._batch_size}"]
+        for s in self._slots:
+            lines.append("slots {")
+            lines.append(f"  name: \"{s['name']}\"")
+            lines.append(f"  type: \"{s['type']}\"")
+            lines.append(f"  is_dense: {str(s['is_dense']).lower()}")
+            lines.append(f"  is_used: {str(s['is_used']).lower()}")
+            lines.append("}")
+        return "\n".join(lines) + "\n"

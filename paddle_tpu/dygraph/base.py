@@ -48,6 +48,20 @@ def guard(place=None):
         _tracer = old
 
 
+def enable_dygraph(place=None):
+    """Global (non-context) dygraph switch (reference
+    fluid.enable_dygraph / framework.py _dygraph_guard machinery):
+    enters eager mode until disable_dygraph()."""
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer()
+
+
+def disable_dygraph():
+    global _tracer
+    _tracer = None
+
+
 class no_grad:
     """Context manager + decorator disabling tape recording. Supports
     @no_grad, @no_grad(), and `with no_grad():`."""

@@ -155,6 +155,8 @@ class Operator:
                     f"input instead — pass python ints here, or use one "
                     f"of those ops")
         self.attrs.setdefault(OP_ROLE_KEY, _op_role_stack[-1])
+        if _device_guard_stack[-1] is not None:
+            self.attrs.setdefault("op_device", _device_guard_stack[-1])
 
     def input(self, slot):
         return self.inputs.get(slot, [])
@@ -622,6 +624,14 @@ class CPUPlace:
         return "CPUPlace"
 
 
+class CUDAPinnedPlace:
+    """Label-only (reference platform/place.h CUDAPinnedPlace): pinned
+    host staging is XLA's transfer manager's concern on TPU."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
 class TPUPlace:
     def __init__(self, device_id=0):
         self.device_id = device_id
@@ -636,3 +646,64 @@ CUDAPlace = TPUPlace
 
 def grad_var_name(name):
     return name + "@GRAD"
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Debug-name prefix for vars/ops created inside (reference
+    framework.py:437). Affects generated names only, never execution;
+    counters are shared with the enclosing generator so names stay
+    unique across scope boundaries."""
+    from . import unique_name as un
+    old = un.generator
+    new = un.UniqueNameGenerator(
+        f"{old.prefix}{prefix}/" if prefix else old.prefix)
+    new.ids = old.ids
+    un.generator = new
+    try:
+        yield
+    finally:
+        un.generator = old
+
+
+_device_guard_stack = [None]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Label ops created inside with a target device (reference
+    framework.py:5395 sets the op's `op_device` attr). On TPU the
+    label is recorded in the IR for placement passes — pipeline-stage
+    assignment over the `pp` mesh axis reads it; XLA owns actual
+    placement within a device."""
+    _device_guard_stack.append(device)
+    try:
+        yield
+    finally:
+        _device_guard_stack.pop()
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless min_version <= installed < max_version-compatible
+    (reference framework.py:73)."""
+    if not isinstance(min_version, str):
+        raise TypeError("min_version must be str")
+    if max_version is not None and not isinstance(max_version, str):
+        raise TypeError("max_version must be str or None")
+
+    def parse(v):
+        parts = v.split(".")
+        if not all(p.isdigit() for p in parts) or not 1 <= len(parts) <= 4:
+            raise ValueError(f"invalid version string {v!r}")
+        return tuple(int(p) for p in parts) + (0,) * (4 - len(parts))
+
+    from .. import __version__
+    installed = parse(__version__)
+    if installed < parse(min_version):
+        raise Exception(
+            f"installed version {__version__} is lower than the "
+            f"required min_version {min_version}")
+    if max_version is not None and installed > parse(max_version):
+        raise Exception(
+            f"installed version {__version__} is higher than the "
+            f"required max_version {max_version}")

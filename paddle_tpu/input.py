@@ -1,0 +1,31 @@
+"""Top-level input helpers (reference python/paddle/fluid/input.py):
+`fluid.one_hot` and `fluid.embedding` — the v2 semantics that drop the
+v1 layers' trailing-[.,1] conventions: one_hot APPENDS the depth axis
+(input.py:24), embedding accepts ids of any rank and appends the
+emb_size axis via lookup_table_v2 (input.py:127)."""
+from .layers.layer_helper import LayerHelper
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """fluid.one_hot: out.shape = input.shape + [depth] (reference
+    input.py:24; contrast layers.one_hot, which keeps the v1 squeeze
+    of a trailing [., 1] dim)."""
+    helper = LayerHelper("one_hot_v2")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(type="one_hot_v2", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"depth": depth,
+                            "allow_out_of_range": allow_out_of_range})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """fluid.embedding: ids of ANY rank, out.shape = ids.shape +
+    [emb_size] (reference input.py:127 -> lookup_table_v2; contrast
+    layers.embedding's v1 lookup_table). Shares the emission body —
+    incl. negative-padding_idx normalization — with layers.embedding."""
+    from .layers.nn import _emit_embedding
+    return _emit_embedding("lookup_table_v2", input, size, is_sparse,
+                           is_distributed, padding_idx, param_attr,
+                           dtype)

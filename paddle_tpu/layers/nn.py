@@ -27,18 +27,34 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return helper.append_activation(out, act)
 
 
-def embedding(input, size, is_sparse=False, is_distributed=False,
-              padding_idx=None, param_attr=None, dtype="float32", name=None):
+def _emit_embedding(op_type, input, size, is_sparse, is_distributed,
+                    padding_idx, param_attr, dtype, name=None):
+    """Shared body of layers.embedding (lookup_table, v1 trailing-[.,1]
+    ids) and fluid.embedding (lookup_table_v2, any-rank ids). A
+    negative padding_idx normalizes to size[0]+padding_idx (reference
+    input.py / layers/nn.py both do this); -1 stays the kernel's
+    no-padding sentinel only when the user passed None."""
     helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    if padding_idx is None:
+        padding_idx = -1
+    elif padding_idx < 0:
+        padding_idx = int(size[0]) + int(padding_idx)
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype)
     out = helper.create_variable_for_type_inference(dtype=dtype)
     helper.append_op(
-        type="lookup_table", inputs={"W": [w], "Ids": [input]},
+        type=op_type, inputs={"W": [w], "Ids": [input]},
         outputs={"Out": [out]},
-        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+        attrs={"padding_idx": padding_idx,
                "is_sparse": is_sparse, "is_distributed": is_distributed})
     return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    return _emit_embedding("lookup_table", input, size, is_sparse,
+                           is_distributed, padding_idx, param_attr,
+                           dtype, name=name)
 
 
 def distributed_embedding(input, size, table_name, endpoint, name=None):

@@ -124,3 +124,40 @@ class CompiledProgram:
 
     def _compile(self, *args, **kwargs):
         return self
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor front (reference
+    parallel_executor.py ParallelExecutor, itself a wrapper over
+    CompiledProgram since 1.6): builds a data-parallel CompiledProgram
+    over the mesh and runs it through an internal Executor. Kept for
+    API parity; CompiledProgram is the first-class path."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..framework.core import default_main_program
+        from ..framework.executor import Executor, global_scope
+        program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            program, build_strategy=build_strategy).with_data_parallel(
+                loss_name=loss_name, exec_strategy=exec_strategy,
+                share_vars_from=getattr(share_vars_from, "_compiled",
+                                        share_vars_from))
+        self._exe = Executor()
+        self._scope = scope or global_scope()
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        from ..framework.executor import scope_guard
+        with scope_guard(self._scope):
+            return self._exe.run(self._compiled,
+                                 feed=feed if feed is not None
+                                 else feed_dict,
+                                 fetch_list=fetch_list,
+                                 return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """reference ParallelExecutor.drop_local_exe_scopes: local
+        scopes are XLA-owned buffers here; nothing to drop."""

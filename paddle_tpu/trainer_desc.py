@@ -1,0 +1,70 @@
+"""Trainer descriptors (reference python/paddle/fluid/trainer_desc.py:
+TrainerDesc base + MultiTrainer / DistMultiTrainer / PipelineTrainer).
+
+In the reference these assemble a protobuf consumed by the C++ trainer
+thread runtime; here `Executor.train_from_dataset` compiles the whole
+program into one XLA executable and streams the dataset through it, so
+a descriptor is a plain config object. They remain the public surface
+for code that constructs trainers explicitly (fleet/pslib paths pass
+`DistMultiTrainer`); train_from_dataset reads the fetch config off
+them."""
+
+
+class TrainerDesc:
+    def __init__(self):
+        self._program = None
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+        self._batch_size = None
+        self._thread_num = 1
+        self._device_worker = None
+        self._infer = False
+
+    # reference trainer_desc.py setter surface
+    def _set_fetch_var_and_info(self, fetch_vars, fetch_info,
+                                print_period):
+        self._fetch_vars = list(fetch_vars or [])
+        self._fetch_info = list(fetch_info or [])
+        self._print_period = print_period
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _set_thread(self, num):
+        self._thread_num = num
+
+    def _set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def _set_device_worker(self, worker):
+        self._device_worker = worker
+
+    def _set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _desc(self):
+        return {
+            "class": type(self).__name__,
+            "thread_num": self._thread_num,
+            "fetch_vars": self._fetch_vars,
+            "fetch_info": self._fetch_info,
+            "print_period": self._print_period,
+            "infer": self._infer,
+        }
+
+
+class MultiTrainer(TrainerDesc):
+    """Multi-thread single-node trainer (reference MultiTrainer): the
+    thread pool is XLA's; kept for API parity."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """Downpour/PS trainer descriptor (reference DistMultiTrainer);
+    distributed/downpour.py drives the equivalent runtime."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """Pipeline-parallel trainer descriptor (reference
+    PipelineTrainer); layers.Pipeline over the `pp` mesh axis is the
+    execution path."""

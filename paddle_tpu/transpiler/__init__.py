@@ -354,3 +354,25 @@ class GeoSgdTranspiler(DistributeTranspiler):
         return GeoCommunicator(
             epmap=self.epmap,
             push_nums=self.config.geo_sgd_need_push_nums, scope=scope)
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Deprecated no-op (reference memory_optimization_transpiler.py —
+    already a deprecation shell in 1.7): buffer reuse/lifetime is
+    XLA's allocator's job on TPU; jit buffer donation covers the
+    in-place cases."""
+    import warnings
+    warnings.warn(
+        "memory_optimize is deprecated and does nothing: XLA owns "
+        "buffer reuse on TPU (jit donation covers in-place updates)",
+        DeprecationWarning, stacklevel=2)
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Deprecated no-op (reference memory_optimization_transpiler.py):
+    XLA frees buffers at their last use."""
+    import warnings
+    warnings.warn(
+        "release_memory is deprecated and does nothing on TPU",
+        DeprecationWarning, stacklevel=2)

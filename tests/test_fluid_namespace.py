@@ -1,0 +1,259 @@
+"""Top-level `fluid.*` namespace parity (reference
+python/paddle/fluid/__init__.py __all__ = framework/executor/
+trainer_desc/transpiler/parallel_executor/lod_tensor/data_feed_desc/
+compiler/backward exports + the literal list). The layers surface was
+verified 301/301 in r4; this locks the 72-name TOP-LEVEL surface and
+functionally checks the pieces added for it: LoDTensor containers,
+v2-semantics fluid.embedding/one_hot, name_scope/device_guard,
+require_version, ParallelExecutor, enable/disable_dygraph, trainer
+descriptors, DataFeedDesc, and the deprecated memory-optimize
+stubs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+RNG = np.random.default_rng(17)
+
+REFERENCE_ALL = [
+    # framework.__all__
+    "Program", "default_startup_program", "default_main_program",
+    "program_guard", "name_scope", "cuda_places", "cpu_places",
+    "cuda_pinned_places", "in_dygraph_mode", "is_compiled_with_cuda",
+    "Variable", "require_version", "device_guard",
+    # executor.__all__
+    "Executor", "global_scope", "scope_guard",
+    # trainer_desc.__all__
+    "TrainerDesc", "MultiTrainer", "DistMultiTrainer", "PipelineTrainer",
+    # transpiler.__all__
+    "DistributeTranspiler", "memory_optimize", "release_memory",
+    "DistributeTranspilerConfig",
+    # parallel_executor / lod_tensor / data_feed_desc / compiler
+    "ParallelExecutor", "create_lod_tensor",
+    "create_random_int_lodtensor", "DataFeedDesc", "CompiledProgram",
+    "ExecutionStrategy", "BuildStrategy",
+    # backward.__all__
+    "append_backward", "gradients",
+    # the literal list
+    "io", "initializer", "embedding", "one_hot", "layers", "contrib",
+    "data", "dygraph", "enable_dygraph", "disable_dygraph",
+    "transpiler", "nets", "optimizer", "learning_rate_decay",
+    "backward", "regularizer", "LoDTensor", "LoDTensorArray",
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "Tensor", "ParamAttr",
+    "WeightNormParamAttr", "DataFeeder", "clip", "profiler",
+    "unique_name", "Scope", "install_check", "save", "load", "VarBase",
+]
+
+
+def test_top_level_surface_complete():
+    missing = [n for n in REFERENCE_ALL if not hasattr(fluid, n)]
+    assert not missing, f"missing fluid.* names: {missing}"
+
+
+def test_create_lod_tensor_roundtrip():
+    t = fluid.create_lod_tensor(
+        np.arange(12, dtype=np.float32).reshape(6, 2), [[2, 1, 3]],
+        fluid.CPUPlace())
+    assert t.recursive_sequence_lengths() == [[2, 1, 3]]
+    assert t.shape() == [6, 2]
+    assert t.has_valid_recursive_sequence_lengths()
+    np.testing.assert_array_equal(
+        np.asarray(t), np.arange(12, dtype=np.float32).reshape(6, 2))
+    # nested-list form flattens
+    t2 = fluid.create_lod_tensor([[1, 2], [3]], [[2, 1]],
+                                 fluid.CPUPlace())
+    assert np.asarray(t2).shape[0] == 3
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(np.zeros((5, 2), np.float32), [[2, 1]],
+                                fluid.CPUPlace())
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[2, 3]], [4],
+                                          fluid.CPUPlace(), 0, 9)
+    assert np.asarray(t).shape == (5, 4)
+    assert np.asarray(t).min() >= 0 and np.asarray(t).max() <= 9
+
+
+def test_fluid_one_hot_appends_axis():
+    """fluid.one_hot: out.shape = in.shape + [depth] (reference
+    input.py:24); layers.one_hot keeps the v1 squeeze convention."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("ids", [4], "int64")
+        y = fluid.one_hot(x, 5)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"ids": np.array([1, 1, 3, 0])},
+                       fetch_list=[y])
+    assert np.asarray(out).shape == (4, 5)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.eye(5, dtype=np.float32)[[1, 1, 3, 0]])
+
+
+def test_fluid_embedding_any_rank_ids():
+    """fluid.embedding: ids of any rank, out = ids.shape + [emb]
+    (reference input.py:127 lookup_table_v2 — no [., 1] trailing dim)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [3, 2], "int64")
+        emb = fluid.embedding(ids, size=[16, 8])
+        loss = layers.reduce_mean(emb)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main,
+                       feed={"ids": RNG.integers(0, 16, (3, 2))},
+                       fetch_list=[emb])
+    assert np.asarray(out).shape == (3, 2, 8)
+
+
+def test_embedding_negative_padding_idx_normalizes():
+    """padding_idx=-1 means row size[0]-1 is the pad row and must come
+    back zero (reference input.py normalization)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [3], "int64")
+        emb = fluid.embedding(ids, size=[4, 2], padding_idx=-1)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out, = exe.run(main, feed={"ids": np.array([0, 3, 3])},
+                       fetch_list=[emb])
+    out = np.asarray(out)
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[2], 0.0)
+    assert np.abs(out[0]).sum() > 0
+
+
+def test_data_feed_desc_unknown_slot_raises(tmp_path):
+    proto = tmp_path / "feed.proto"
+    proto.write_text('batch_size: 32\n'
+                     'slots {\n  name: "click"\n  type: "float"\n'
+                     '  is_dense: false\n  is_used: false\n}\n')
+    desc = fluid.DataFeedDesc(str(proto))
+    with pytest.raises(ValueError, match="unknown slot"):
+        desc.set_use_slots(["clck"])
+    with pytest.raises(ValueError, match="unknown slot"):
+        desc.set_dense_slots(["nope"])
+
+
+def test_name_scope_prefixes_generated_names():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4], "float32")
+        with fluid.name_scope("encoder"):
+            y = layers.fc(x, 4)
+        z = layers.fc(y, 4)
+    assert "encoder/" in y.name
+    assert "encoder/" not in z.name
+
+
+def test_device_guard_records_op_device():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4], "float32")
+        with fluid.device_guard("gpu:1"):
+            y = layers.scale(x, 2.0)
+        z = layers.scale(y, 3.0)
+    ops = main.global_block().ops
+    scales = [op for op in ops if op.type == "scale"]
+    assert scales[0].attr("op_device") == "gpu:1"
+    assert scales[1].attr("op_device") is None
+
+
+def test_require_version():
+    fluid.require_version("0.1.0")
+    fluid.require_version("0.0.1", "9.9.9")
+    with pytest.raises(Exception, match="lower than"):
+        fluid.require_version("99.0.0")
+    with pytest.raises(TypeError):
+        fluid.require_version(1)
+
+
+def test_memory_optimize_deprecated_noop():
+    main = fluid.Program()
+    with pytest.warns(DeprecationWarning):
+        fluid.memory_optimize(main)
+    with pytest.warns(DeprecationWarning):
+        fluid.release_memory(main)
+
+
+def test_enable_disable_dygraph():
+    assert not fluid.in_dygraph_mode()
+    fluid.enable_dygraph()
+    try:
+        assert fluid.in_dygraph_mode()
+        v = fluid.dygraph.to_variable(np.ones((2, 2), np.float32))
+        assert isinstance(v, fluid.VarBase)
+    finally:
+        fluid.disable_dygraph()
+    assert not fluid.in_dygraph_mode()
+
+
+def test_parallel_executor_runs_data_parallel():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main, scope=scope)
+    X = RNG.standard_normal((16, 8)).astype(np.float32)
+    Y = (X.sum(1, keepdims=True) * 0.1).astype(np.float32)
+    first = None
+    for _ in range(30):
+        l, = pe.run(fetch_list=[loss.name], feed={"x": X, "y": Y})
+        if first is None:
+            first = float(np.asarray(l).reshape(-1)[0])
+    last = float(np.asarray(l).reshape(-1)[0])
+    assert last < first
+
+
+def test_trainer_desc_classes():
+    td = fluid.DistMultiTrainer()
+    td._set_batch_size(64)
+    td._set_thread(4)
+    td._set_fetch_var_and_info(["loss"], ["loss"], 10)
+    d = td._desc()
+    assert d["class"] == "DistMultiTrainer" and d["thread_num"] == 4
+    assert isinstance(fluid.MultiTrainer(), fluid.TrainerDesc)
+    assert isinstance(fluid.PipelineTrainer(), fluid.TrainerDesc)
+
+
+def test_data_feed_desc_parses_prototxt(tmp_path):
+    proto = tmp_path / "feed.proto"
+    proto.write_text(
+        'batch_size: 128\n'
+        'slots {\n  name: "click"\n  type: "float"\n'
+        '  is_dense: true\n  is_used: false\n}\n'
+        'slots {\n  name: "ids"\n  type: "uint64"\n'
+        '  is_dense: false\n  is_used: false\n}\n')
+    desc = fluid.DataFeedDesc(str(proto))
+    desc.set_batch_size(256)
+    desc.set_use_slots(["ids"])
+    text = desc.desc()
+    assert "batch_size: 256" in text
+    assert 'name: "ids"' in text and "is_used: true" in text
+
+
+def test_lod_tensor_array():
+    arr = fluid.LoDTensorArray()
+    arr.append(fluid.create_lod_tensor(np.ones((2, 2), np.float32),
+                                       [[2]], fluid.CPUPlace()))
+    assert len(arr) == 1 and np.asarray(arr[0]).shape == (2, 2)
