@@ -4,6 +4,49 @@ from .framework.core import OP_ROLE_KEY, OpRole, default_main_program
 from .framework import unique_name
 
 
+class BaseErrorClipAttr:
+    """Base of error-signal clip attrs (reference clip.py:25). Set on a
+    Variable via `var._set_error_clip(...)`; append_backward clips that
+    var's gradient when it is finalized, before earlier grad ops
+    consume it. Subclasses implement _append_clip_op (reference
+    BaseErrorClipAttr._append_clip_op) emitting the clip and returning
+    the clipped grad var name."""
+
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement "
+            f"_append_clip_op(block, grad_name) -> clipped_name")
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    """Clip a var's backward error signal to [min, max] (reference
+    clip.py:42). min defaults to -max."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _append_clip_op(self, block, grad_name):
+        fwd = block.vars.get(grad_name.split("@GRAD")[0])
+        cname = grad_name + "@CLIP"
+        block.create_var(name=cname,
+                         shape=fwd.shape if fwd is not None else None,
+                         dtype=fwd.dtype if fwd is not None else "float32",
+                         stop_gradient=True)
+        block.append_op(type="clip", inputs={"X": [grad_name]},
+                        outputs={"Out": [cname]},
+                        attrs={"min": self.min, "max": self.max,
+                               OP_ROLE_KEY: OpRole.Backward})
+        return cname
+
+
+def error_clip_callback(block, context):
+    """Reference clip.py:102 callback for append_backward(callbacks=...).
+    Error clipping is applied natively when grads finalize (see
+    framework/backward.py), so passing this callback is satisfied
+    automatically; it exists so reference code importing it ports 1:1."""
+
+
 class GradientClipBase:
     def __call__(self, params_grads):
         raise NotImplementedError

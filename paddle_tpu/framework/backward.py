@@ -37,7 +37,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     a recompute_barrier (see the emission below), so the backward reads
     recomputed activations and only checkpoints stay live across the
     forward->backward gap.
+
+    `callbacks`: the reference's per-grad-op hook list. Error clipping
+    (its main use, clip.error_clip_callback) is applied natively when
+    each grad finalizes, so that callback is accepted and satisfied;
+    other custom callbacks have no equivalent hook in the whole-program
+    emission model and warn.
     """
+    if callbacks:
+        from ..clip import error_clip_callback
+        import warnings
+        for cb in callbacks:
+            if cb is not error_clip_callback:
+                warnings.warn(
+                    f"append_backward callback {cb!r} is not invoked: "
+                    f"error clipping is built in; other per-grad-op "
+                    f"hooks have no equivalent in the whole-program "
+                    f"emission model", stacklevel=2)
     return _append_backward_core(
         [loss], [None], parameter_list=parameter_list,
         no_grad_set=no_grad_set, checkpoints=checkpoints)
@@ -164,19 +180,35 @@ def _append_backward_core(targets, target_gradients, parameter_list=None,
         grad_map[var_name].append(name)
         return name
 
+    error_clipped = set()
+
     def finalize(var_name):
-        """Collapse partial grads of var into one canonical grad var."""
+        """Collapse partial grads of var into one canonical grad var;
+        apply the var's error_clip (reference clip.py
+        error_clip_callback) before earlier grad ops consume it."""
         partials = grad_map[var_name]
         if not partials:
             return None
         if len(partials) == 1:
-            return partials[0]
-        out = grad_var_name(var_name)
-        block.append_op(
-            type="sum", inputs={"X": list(partials)},
-            outputs={"Out": [out]},
-            attrs={OP_ROLE_KEY: OpRole.Backward})
-        grad_map[var_name] = [out]
+            out = partials[0]
+        else:
+            out = grad_var_name(var_name)
+            block.append_op(
+                type="sum", inputs={"X": list(partials)},
+                outputs={"Out": [out]},
+                attrs={OP_ROLE_KEY: OpRole.Backward})
+            grad_map[var_name] = [out]
+        fwd = block.vars.get(var_name)
+        eclip = getattr(fwd, "error_clip", None)
+        if eclip is not None and out not in error_clipped:
+            # keyed by the GRAD name (not the fwd name): a rebound fwd
+            # name has one grad per writer and each must clip
+            # (reference clips at every grad op); the clipped result is
+            # recorded so repeated finalize calls stay idempotent
+            cname = eclip._append_clip_op(block, out)
+            error_clipped.add(cname)
+            grad_map[var_name] = [cname]
+            return cname
         return out
 
     # ---- recompute (reference _append_backward_ops_with_checkpoints_,

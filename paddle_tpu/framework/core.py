@@ -82,6 +82,17 @@ class Variable:
         self.initializer = initializer
         self.dist_attr = tuple(dist_attr) if dist_attr is not None else None
         self.is_parameter = False
+        self.error_clip = None
+
+    def _set_error_clip(self, clip):
+        """reference framework.py Variable._set_error_clip: clip the
+        backward error signal of this var (clip.ErrorClipByValue);
+        applied by append_backward when the grad finalizes."""
+        from ..clip import BaseErrorClipAttr
+        if not isinstance(clip, BaseErrorClipAttr):
+            raise TypeError(
+                "error_clip must be a BaseErrorClipAttr instance")
+        self.error_clip = clip
 
     # ---- convenience mirrors of fluid Variable API ----
     @property

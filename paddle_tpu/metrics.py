@@ -216,3 +216,52 @@ class Auc(MetricBase):
         fp0 = np.concatenate([[0.0], fp[:-1]])
         area = np.sum(self.trapezoid_area(fp0, fp, tp0, tp))
         return float(area / (tot_pos * tot_neg))
+
+
+class DetectionMAP(MetricBase):
+    """Detection mean-average-precision evaluator (reference
+    metrics.py:805 DetectionMAP). The reference threads LoD accumulator
+    states (PosCount/TruePos/FalsePos) through the graph; in the
+    masked-dense design the per-batch mAP is computed in-graph by
+    layers.detection_map and ACCUMULATED HOST-SIDE here (documented
+    divergence — ops/detection_ops.py detection_map): fetch the
+    cur_map var each batch, call update(cur_map, batch_size), read the
+    sample-weighted running mAP with eval().
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 gt_count=None, ap_version="integral", name=None):
+        super().__init__(name)
+        from .layers import detection as _det
+        if class_num is None:
+            raise ValueError("class_num is required")
+        self._cur_map = _det.detection_map(
+            input, (gt_label, gt_box), class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version=ap_version, gt_count=gt_count,
+            difficult=gt_difficult)
+        self.weighted_sum = 0.0
+        self.weight = 0.0
+
+    def get_map_var(self):
+        """The per-batch mAP Variable to fetch (reference returns
+        (cur_map, accum_map); accumulation is host-side here, so the
+        accumulated value comes from eval())."""
+        return self._cur_map
+
+    def update(self, value, weight=1):
+        v = float(np.asarray(value).reshape(-1)[0])
+        w = float(weight)
+        self.weighted_sum += v * w
+        self.weight += w
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError(
+                "DetectionMAP.eval() before any update(): no batches "
+                "accumulated")
+        return self.weighted_sum / self.weight

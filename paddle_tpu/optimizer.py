@@ -390,6 +390,11 @@ class DecayedAdagradOptimizer(AdagradOptimizer):
         super().__init__(learning_rate, epsilon=epsilon, **kw)
         self._decay = decay
 
+    def _eager_attrs(self):
+        # decay must reach the dygraph path too, not just the static
+        # append_op attrs
+        return {"epsilon": self._epsilon, "decay": self._decay}
+
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
         mom = self._get_accumulator("moment", p)
@@ -711,6 +716,7 @@ Momentum = MomentumOptimizer
 Adam = AdamOptimizer
 AdamW = AdamWOptimizer
 Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 Adamax = AdamaxOptimizer
 RMSProp = RMSPropOptimizer

@@ -252,6 +252,102 @@ def test_data_feed_desc_parses_prototxt(tmp_path):
     assert 'name: "ids"' in text and "is_used: true" in text
 
 
+def test_submodule_long_tail_names():
+    assert hasattr(fluid.optimizer, "DecayedAdagrad")
+    assert hasattr(fluid.clip, "ErrorClipByValue")
+    assert hasattr(fluid.clip, "error_clip_callback")
+    assert hasattr(fluid.metrics, "DetectionMAP")
+
+
+def test_error_clip_by_value_clips_error_signal():
+    """var._set_error_clip(ErrorClipByValue(...)) clips the var's
+    GRADIENT during append_backward (reference clip.py
+    error_clip_callback semantics), changing upstream grads."""
+    def build(with_clip):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [4, 3], "float32")
+            h = layers.fc(x, 3, bias_attr=False)
+            if with_clip:
+                main.global_block().var(h.name)._set_error_clip(
+                    fluid.clip.ErrorClipByValue(max=1e-4))
+            loss = layers.reduce_sum(layers.scale(h, scale=100.0))
+            ps = fluid.append_backward(
+                loss, callbacks=[fluid.clip.error_clip_callback])
+        return main, startup, ps
+    main, startup, ps = build(True)
+    types = [op.type for op in main.global_block().ops]
+    assert "clip" in types, types
+    exe = fluid.Executor()
+    xv = RNG.standard_normal((4, 3)).astype(np.float32)
+    grads = {}
+    for with_clip in (False, True):
+        main, startup, ps = build(with_clip)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            g, = exe.run(main, feed={"x": xv},
+                         fetch_list=[ps[0][1].name])
+        grads[with_clip] = np.asarray(g)
+    # unclipped grad is +/-100 per element; clipped error caps it at
+    # 1e-4 before the fc weight grad forms
+    assert np.abs(grads[False]).max() > 1.0
+    assert np.abs(grads[True]).max() <= 1e-4 * np.abs(xv).sum() + 1e-6
+
+
+def test_detection_map_metric_accumulates():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.data("det", [2, 4, 6], "float32")
+        gl = fluid.data("gl", [2, 3], "int64")
+        gb = fluid.data("gb", [2, 3, 4], "float32")
+        m = fluid.metrics.DetectionMAP(det, gl, gb, class_num=3)
+        map_var = m.get_map_var()
+    exe = fluid.Executor()
+    rng = np.random.default_rng(3)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            boxes = np.sort(rng.random((2, 4, 4)).astype(np.float32),
+                            axis=-1)
+            det_v = np.concatenate(
+                [rng.integers(1, 3, (2, 4, 1)).astype(np.float32),
+                 rng.random((2, 4, 1)).astype(np.float32),
+                 boxes], axis=-1)
+            gb_v = np.sort(rng.random((2, 3, 4)).astype(np.float32),
+                           axis=-1)
+            gl_v = rng.integers(1, 3, (2, 3))
+            cur, = exe.run(main, feed={"det": det_v, "gl": gl_v,
+                                       "gb": gb_v},
+                           fetch_list=[map_var])
+            m.update(cur, 2)
+    v = m.eval()
+    assert 0.0 <= v <= 1.0
+
+
+def test_decayed_adagrad_optimizer_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.DecayedAdagrad(0.05).minimize(loss)
+    exe = fluid.Executor()
+    X = RNG.standard_normal((16, 4)).astype(np.float32)
+    Y = X.sum(1, keepdims=True).astype(np.float32) * 0.2
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = None
+        for _ in range(25):
+            l, = exe.run(main, feed={"x": X, "y": Y},
+                         fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(l).reshape(-1)[0])
+    assert float(np.asarray(l).reshape(-1)[0]) < first
+
+
 def test_lod_tensor_array():
     arr = fluid.LoDTensorArray()
     arr.append(fluid.create_lod_tensor(np.ones((2, 2), np.float32),
