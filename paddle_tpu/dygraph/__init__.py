@@ -20,3 +20,33 @@ from .jit import (  # noqa: F401
     TracedLayer, ProgramTranslator, declarative, jit_step, CompiledStep,
 )
 from . import jit  # noqa: F401
+from .base import enable_dygraph, disable_dygraph  # noqa: F401
+from .container import Sequential, LayerList, ParameterList  # noqa: F401
+from .nn import (  # noqa: F401
+    Conv3D, Conv3DTranspose, InstanceNorm, BilinearTensorProduct,
+    GRUUnit, NCE, TreeConv,
+)
+from .parallel import Env as ParallelEnv  # noqa: F401
+from .jit import dygraph_to_static_func  # noqa: F401
+
+
+class BackwardStrategy:
+    """reference imperative/backward_strategy.h BackwardStrategy: the
+    sort_sum_gradient knob ordered the reference engine's gradient
+    accumulation; the tape here sums partials deterministically in
+    reverse-trace order, so the flag is recorded but has no effect."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+def start_gperf_profiler():
+    """reference dygraph start_gperf_profiler: gperftools hooks; the
+    TPU-native profiling surface is fluid.profiler (xplane traces)."""
+    from .. import profiler as _p
+    _p.start_profiler("All")
+
+
+def stop_gperf_profiler():
+    from .. import profiler as _p
+    _p.stop_profiler()

@@ -144,6 +144,13 @@ class VarBase:
         return T.cast(self, dtype)
 
     def backward(self, retain_graph=False):
+        # reference signature backward(backward_strategy=None): a
+        # BackwardStrategy passed positionally is a legacy knob (its
+        # sort_sum_gradient has no effect here — see
+        # dygraph.BackwardStrategy), NOT a retain_graph request
+        from .. import dygraph as _dy
+        if isinstance(retain_graph, _dy.BackwardStrategy):
+            retain_graph = False
         t = _current_tracer()
         assert t is not None, "backward() requires dygraph mode"
         t.run_backward(self, retain_graph=retain_graph)

@@ -507,3 +507,30 @@ def jit_step(fn):
 
     wrapper._compiled_step = step
     return wrapper
+
+
+def dygraph_to_static_func(fn):
+    """reference dygraph/jit.py dygraph_to_static_func — the
+    static-build sibling of @declarative: calling the decorated
+    function while a STATIC program is being built runs the
+    AST-converted body, so its data-dependent control flow lands in
+    the program as cond/While ops; in eager mode the call runs eagerly
+    unchanged. Un-getsource-able functions fall back to running as-is
+    (same policy as convert_call)."""
+    import functools
+    state = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from . import base as dy
+        if dy.enabled():
+            return fn(*args, **kwargs)
+        if "conv" not in state:
+            from .dygraph_to_static import convert_to_static
+            try:
+                state["conv"] = convert_to_static(fn)
+            except (OSError, TypeError, SyntaxError):
+                state["conv"] = fn
+        return state["conv"](*args, **kwargs)
+
+    return wrapper

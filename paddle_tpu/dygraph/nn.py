@@ -404,3 +404,220 @@ class SpectralNorm(Layer):
                                      "VOut": [self.weight_v]})
 
 
+
+
+class Conv3D(Layer):
+    """reference dygraph/nn.py Conv3D (conv3d op, NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size,) * 3
+        to3 = lambda v: list(v) if isinstance(v, (list, tuple)) \
+            else [v] * 3
+        self._attrs = {"strides": to3(stride), "paddings": to3(padding),
+                       "dilations": to3(dilation), "groups": groups}
+        std = (2.0 / (num_channels * fs[0] * fs[1] * fs[2])) ** 0.5
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + list(fs),
+            attr=param_attr, dtype=dtype,
+            default_initializer=I.NormalInitializer(0.0, std))
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _trace("conv3d", {"Input": [x], "Filter": [self.weight]},
+                     attrs=self._attrs, out_dtype=self._dtype,
+                     out_slot="Output")
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": 1}, out_dtype=self._dtype)
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, out_dtype=self._dtype)
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """reference dygraph/nn.py Conv3DTranspose (conv3d_transpose op)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size,) * 3
+        to3 = lambda v: list(v) if isinstance(v, (list, tuple)) \
+            else [v] * 3
+        self._attrs = {"strides": to3(stride), "paddings": to3(padding),
+                       "dilations": to3(dilation), "groups": groups}
+        # default Xavier, matching Conv2DTranspose and the reference
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + list(fs),
+            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _trace("conv3d_transpose",
+                     {"Input": [x], "Filter": [self.weight]},
+                     attrs=self._attrs, out_dtype=self._dtype,
+                     out_slot="Output")
+        if self.bias is not None:
+            out = _trace("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": 1}, out_dtype=self._dtype)
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, out_dtype=self._dtype)
+        return out
+
+
+class InstanceNorm(Layer):
+    """reference dygraph/nn.py InstanceNorm (instance_norm op)."""
+
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._eps = epsilon
+        self.scale = self.create_parameter(
+            [num_channels], attr=param_attr, dtype=dtype,
+            default_initializer=I.ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.scale is not None:
+            ins["Scale"] = [self.scale]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _trace("instance_norm", ins,
+                      attrs={"epsilon": self._eps},
+                      out_dtype=self._dtype, out_slot="Y")
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct:
+    out[b, k] = x[b] . W[k] . y[b] + bias[k]."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr,
+            dtype=dtype)
+        self.bias = self.create_parameter([1, output_dim],
+                                          attr=bias_attr, dtype=dtype,
+                                          is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _trace("bilinear_tensor_product", ins,
+                     out_dtype=self._dtype)
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, out_dtype=self._dtype)
+        return out
+
+
+class GRUUnit(Layer):
+    """reference dygraph/nn.py GRUUnit — one GRU step over a
+    pre-projected input [B, 3H] (gru_unit op). Returns the new hidden
+    state; the reference also returns the reset-hidden/gate
+    intermediates, which the op's fused lowering does not materialize
+    (documented divergence)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        H = size // 3
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation,
+                       "origin_mode": origin_mode}
+        self.weight = self.create_parameter([H, 3 * H], attr=param_attr,
+                                            dtype=dtype)
+        self.bias = self.create_parameter([1, 3 * H], attr=bias_attr,
+                                          dtype=dtype, is_bias=True)
+
+    def forward(self, input, hidden):
+        ins = {"Input": [input], "HiddenPrev": [hidden],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _trace("gru_unit", ins, attrs=self._attrs,
+                      out_dtype=self._dtype, out_slot="Hidden")
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE — noise-contrastive estimation head
+    (nce op, uniform negative sampling)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if sampler != "uniform" or custom_dist is not None or \
+                sample_weight is not None:
+            # unsupported parity args raise rather than silently change
+            # semantics (policy: layers/nn.py sampled_softmax note)
+            raise NotImplementedError(
+                "NCE supports only sampler='uniform' without "
+                "custom_dist/sample_weight; the nce lowering draws "
+                "uniform negatives")
+        self._attrs = {"num_total_classes": int(num_total_classes),
+                       "num_neg_samples": int(num_neg_samples or 10),
+                       "seed": int(seed)}
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter([num_total_classes],
+                                          attr=bias_attr, dtype=dtype,
+                                          is_bias=True)
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        return _trace("nce", ins, attrs=self._attrs,
+                      out_dtype=self._dtype, out_slot="Cost")
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv — tree-based convolution
+    (tree_conv op; contrib.layers.tree_conv is the static twin)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._attrs = {"max_depth": int(max_depth)}
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters],
+            attr=param_attr, dtype=dtype)
+        self.bias = self.create_parameter(
+            [1, 1, output_size, num_filters], attr=bias_attr,
+            dtype=dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        out = _trace("tree_conv",
+                     {"NodesVector": [nodes_vector],
+                      "EdgeSet": [edge_set],
+                      "Filter": [self.weight]},
+                     attrs=self._attrs, out_dtype=self._dtype)
+        if self.bias is not None:
+            out = _trace("elementwise_add",
+                         {"X": [out], "Y": [self.bias]},
+                         attrs={"axis": -1}, out_dtype=self._dtype)
+        if self._act:
+            out = _trace(self._act, {"X": [out]}, out_dtype=self._dtype)
+        return out

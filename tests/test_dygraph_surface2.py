@@ -1,0 +1,234 @@
+"""Dygraph namespace long tail (reference dygraph/nn.py Conv3D/
+Conv3DTranspose/InstanceNorm/BilinearTensorProduct/GRUUnit/NCE/
+TreeConv, container.py Sequential/LayerList/ParameterList,
+jit.py dygraph_to_static_func; test pattern test_imperative_basic.py /
+test_layers.py): every name exists, forwards produce the right shapes,
+and gradients flow to the layers' own parameters."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph, layers
+
+RNG = np.random.default_rng(41)
+
+
+def _has_grads(params):
+    return all(p.grad is not None and np.isfinite(
+        np.asarray(p.grad)).all() for p in params)
+
+
+def test_conv3d_and_transpose_forward_backward():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((2, 3, 5, 5, 5)).astype(np.float32))
+        conv = dygraph.Conv3D(3, 4, 3, padding=1)
+        y = conv(x)
+        assert tuple(y.shape) == (2, 4, 5, 5, 5)
+        deconv = dygraph.Conv3DTranspose(4, 2, 3)
+        z = deconv(y)
+        assert tuple(z.shape) == (2, 2, 7, 7, 7)
+        loss = layers.reduce_mean(z)
+        loss.backward()
+        assert _has_grads(conv.parameters() + deconv.parameters())
+
+
+def test_instance_norm_forward():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((2, 3, 4, 4)).astype(np.float32))
+        inorm = dygraph.InstanceNorm(3)
+        y = inorm(x)
+        v = np.asarray(y.value)
+        # per-(sample, channel) normalization: mean ~0, var ~1
+        np.testing.assert_allclose(v.mean(axis=(2, 3)), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.var(axis=(2, 3)), 1.0, atol=1e-2)
+
+
+def test_bilinear_tensor_product():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((4, 3)).astype(np.float32))
+        y = dygraph.to_variable(
+            RNG.standard_normal((4, 5)).astype(np.float32))
+        btp = dygraph.BilinearTensorProduct(3, 5, 6)
+        out = btp(x, y)
+        assert tuple(out.shape) == (4, 6)
+        ref = np.einsum("bi,kij,bj->bk", np.asarray(x.value),
+                        np.asarray(btp.weight.value),
+                        np.asarray(y.value)) + \
+            np.asarray(btp.bias.value)
+        np.testing.assert_allclose(np.asarray(out.value), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_step():
+    H = 4
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((2, 3 * H)).astype(np.float32))
+        h = dygraph.to_variable(
+            RNG.standard_normal((2, H)).astype(np.float32))
+        cell = dygraph.GRUUnit(3 * H)
+        out = cell(x, h)
+        assert tuple(out.shape) == (2, H)
+        loss = layers.reduce_sum(out)
+        loss.backward()
+        assert _has_grads(cell.parameters())
+
+
+def test_nce_trains():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((6, 8)).astype(np.float32))
+        label = dygraph.to_variable(
+            RNG.integers(0, 20, (6, 1)).astype(np.int64))
+        nce = dygraph.NCE(num_total_classes=20, dim=8,
+                          num_neg_samples=4)
+        cost = nce(x, label)
+        assert cost.shape[0] == 6
+        loss = layers.reduce_mean(cost)
+        loss.backward()
+        assert _has_grads(nce.parameters())
+
+
+def test_tree_conv_forward():
+    with dygraph.guard():
+        nodes = dygraph.to_variable(
+            RNG.standard_normal((1, 5, 4)).astype(np.float32))
+        # chain tree 1-2-3-4-5 (1-indexed; zero rows pad)
+        edges = dygraph.to_variable(np.array(
+            [[[1, 2], [2, 3], [3, 4], [4, 5]]], np.int64))
+        tc = dygraph.TreeConv(feature_size=4, output_size=3,
+                              num_filters=2, max_depth=2)
+        out = tc(nodes, edges)
+        assert tuple(out.shape) == (1, 5, 3, 2)
+
+
+def test_sequential_container():
+    with dygraph.guard():
+        net = dygraph.Sequential(
+            dygraph.Linear(4, 8, act="relu"),
+            ("head", dygraph.Linear(8, 2)),
+        )
+        assert len(net) == 2
+        assert isinstance(net["head"], dygraph.Linear)
+        x = dygraph.to_variable(
+            RNG.standard_normal((3, 4)).astype(np.float32))
+        y = net(x)
+        assert tuple(y.shape) == (3, 2)
+        assert len(net.parameters()) == 4
+        layers.reduce_mean(y).backward()
+        assert _has_grads(net.parameters())
+
+
+def test_layer_list_and_parameter_list():
+    with dygraph.guard():
+        lst = dygraph.LayerList([dygraph.Linear(4, 4)
+                                 for _ in range(3)])
+        lst.append(dygraph.Linear(4, 2))
+        assert len(lst) == 4
+        x = dygraph.to_variable(
+            RNG.standard_normal((2, 4)).astype(np.float32))
+        for layer in lst:
+            x = layer(x)
+        assert tuple(x.shape) == (2, 2)
+        assert len(lst.parameters()) == 8
+
+        class WithParams(dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.ps = dygraph.ParameterList(
+                    [self.create_parameter([3, 3]),
+                     self.create_parameter([3])])
+
+            def forward(self, x):
+                return layers.elementwise_add(
+                    layers.matmul(x, self.ps[0]), self.ps[1])
+
+        m = WithParams()
+        y = m(dygraph.to_variable(
+            RNG.standard_normal((2, 3)).astype(np.float32)))
+        assert tuple(y.shape) == (2, 3)
+        assert len(m.parameters()) == 2
+        assert len(m.ps) == 2
+
+
+def test_backward_strategy_and_parallel_env():
+    bs = dygraph.BackwardStrategy()
+    assert bs.sort_sum_gradient is False
+    bs.sort_sum_gradient = True
+    env = dygraph.ParallelEnv()
+    assert env.nranks >= 1 and env.local_rank >= 0
+
+
+def test_backward_accepts_strategy_without_retaining_tape():
+    """Reference pattern loss.backward(BackwardStrategy()) must not be
+    mistaken for retain_graph=True — a second backward on a cleared
+    tape then accumulates exactly one gradient, not two."""
+    with dygraph.guard():
+        lin = dygraph.Linear(4, 1, bias_attr=False)
+        x = dygraph.to_variable(np.ones((2, 4), np.float32))
+        loss = layers.reduce_sum(lin(x))
+        loss.backward(dygraph.BackwardStrategy())
+        g1 = np.asarray(lin.parameters()[0].grad).copy()
+        lin.clear_gradients()
+        loss2 = layers.reduce_sum(lin(x))
+        loss2.backward(dygraph.BackwardStrategy())
+        g2 = np.asarray(lin.parameters()[0].grad)
+        np.testing.assert_allclose(g1, g2)
+
+
+def test_instance_norm_without_affine_params():
+    with dygraph.guard():
+        x = dygraph.to_variable(
+            RNG.standard_normal((2, 3, 4, 4)).astype(np.float32))
+        inorm = dygraph.InstanceNorm(3, param_attr=False,
+                                     bias_attr=False)
+        v = np.asarray(inorm(x).value)
+        np.testing.assert_allclose(v.mean(axis=(2, 3)), 0.0, atol=1e-5)
+
+
+def test_nce_rejects_unsupported_sampler():
+    with dygraph.guard():
+        with pytest.raises(NotImplementedError, match="uniform"):
+            dygraph.NCE(10, 4, sampler="log_uniform")
+
+
+def model_d2s_func(x):
+    s = layers.reduce_sum(x)
+    zero = layers.fill_constant([1], "float32", 0.0)
+    if layers.greater_than(s, zero):
+        y = layers.scale(x, scale=3.0)
+    else:
+        y = layers.scale(x, scale=-1.0)
+    return y
+
+
+def test_dygraph_to_static_func_in_static_build():
+    """The decorator's static-build path: calling inside a program
+    build emits BOTH branches as a cond (reference
+    dygraph_to_static_func); eager calls run unchanged."""
+    conv = dygraph.dygraph_to_static_func(model_d2s_func)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3, 4], "float32")
+        y = conv(x)
+    types = [op.type for b in main.blocks for op in b.ops]
+    assert "cond" in types
+    exe = fluid.Executor()
+    for sign in (1.0, -1.0):
+        xv = (np.abs(RNG.standard_normal((3, 4))) * sign).astype(
+            np.float32)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        ref = xv * (3.0 if xv.sum() > 0 else -1.0)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    # eager path runs unchanged
+    with dygraph.guard():
+        xv = np.abs(RNG.standard_normal((2, 2))).astype(np.float32)
+        out = conv(dygraph.to_variable(xv))
+        np.testing.assert_allclose(np.asarray(out.value), xv * 3.0,
+                                   rtol=1e-6)
