@@ -1,3 +1,25 @@
 """fluid.contrib namespace (reference: python/paddle/fluid/contrib/)."""
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import layers  # noqa: F401
+from .layers import *  # noqa: F401,F403  (reference: from .layers import *)
+from . import decoder  # noqa: F401
+from .decoder import (  # noqa: F401
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder,
+)
+from . import memory_usage_calc  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+from . import op_frequence  # noqa: F401
+from .op_frequence import op_freq_statistic  # noqa: F401
+from . import quantize  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import distributed_batch_reader  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import (  # noqa: F401
+    HDFSClient, multi_download, multi_upload,
+    convert_dist_to_sparse_program, load_persistables_for_increment,
+    load_persistables_for_inference,
+)
+from . import extend_optimizer  # noqa: F401
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401

@@ -1,0 +1,286 @@
+"""fluid.contrib.decoder (reference contrib/decoder/
+beam_search_decoder.py:43 InitState, :159 StateCell, :384
+TrainingDecoder, :523 BeamSearchDecoder) — the legacy seq2seq decoder
+front.
+
+TPU-first re-design: TrainingDecoder records its step block ONCE into
+layers.DynamicRNN (the reference builds a DynamicRNN too; ours lowers
+to one masked lax.scan). BeamSearchDecoder reuses the dense beam
+machinery of layers.rnn_api (beam_search op + gather_tree) instead of
+the reference's LoD-array While loop: decode() wires the user's
+StateCell into an RNNCell adapter whose parameters stay SHARED across
+the static unroll by replaying the cell's unique-name snapshot, then
+dynamic_decode runs the bounded search. Results are padded dense
+[T, B, beam] back-traced ids + [B, beam] scores (the framework's beam
+convention — layers.gather_tree) rather than LoD tensors."""
+import contextlib
+
+import numpy as np
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+def _L():
+    from ... import layers
+    return layers
+
+
+class InitState:
+    """reference beam_search_decoder.py:43: initial decoder state —
+    either an existing Variable (`init`) or a zeros/`value`-filled
+    tensor of `shape`."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "InitState needs `init` (a Variable) or `init_boot` "
+                "(a batch reference for shape)")
+        else:
+            B = int(init_boot.shape[0])
+            self._init = _L().fill_constant(
+                [B] + [int(s) for s in (shape or [])], dtype, value)
+        self.need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+
+class StateCell:
+    """reference beam_search_decoder.py:159: named states + named
+    inputs + a user `state_updater` describing one decode step."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._state_names = list(states.keys())
+        self._out_state = out_state
+        self._cur_states = {}
+        self._next_states = {}
+        self._updater = None
+        # parameter stability across replayed invocations: snapshot the
+        # unique-name counters at first compute_state and restore before
+        # every later one, so layers.fc etc. inside the updater emit the
+        # SAME parameter names each step (name-keyed params share
+        # storage; reference records its block once instead)
+        self._name_snapshot = None
+
+    # ---- updater registration / execution ----
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs:
+            raise ValueError(f"StateCell has no input {input_name!r}")
+        v = self._inputs[input_name]
+        if v is None:
+            raise ValueError(
+                f"StateCell input {input_name!r} was not fed")
+        return v
+
+    def get_state(self, state_name):
+        if state_name in self._next_states:
+            return self._next_states[state_name]
+        if state_name not in self._cur_states:
+            self._cur_states[state_name] = \
+                self._init_states[state_name].value
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name, state_value):
+        if state_name not in self._state_names:
+            raise ValueError(f"StateCell has no state {state_name!r}")
+        self._next_states[state_name] = state_value
+
+    def compute_state(self, inputs):
+        if self._updater is None:
+            raise ValueError(
+                "StateCell.compute_state before @state_updater was "
+                "registered")
+        from ...framework import unique_name
+        for k, v in inputs.items():
+            if k not in self._inputs:
+                raise ValueError(f"unknown StateCell input {k!r}")
+            self._inputs[k] = v
+        if self._name_snapshot is None:
+            self._name_snapshot = dict(unique_name.generator.ids)
+            self._updater(self)
+        else:
+            saved = dict(unique_name.generator.ids)
+            unique_name.generator.ids.clear()
+            unique_name.generator.ids.update(self._name_snapshot)
+            self._updater(self)
+            # names consumed by the updater replay identically; restore
+            # the outer stream so unrelated layers don't collide
+            unique_name.generator.ids.clear()
+            unique_name.generator.ids.update(saved)
+
+    def update_states(self):
+        self._cur_states.update(self._next_states)
+        self._next_states = {}
+
+    def out_state(self):
+        return self.get_state(self._out_state)
+
+    def _set_states(self, mapping):
+        self._cur_states = dict(mapping)
+        self._next_states = {}
+
+
+class TrainingDecoder:
+    """reference beam_search_decoder.py:384: teacher-forced decoder —
+    a with-block over a DynamicRNN step (recorded once, lowered to one
+    masked scan)."""
+
+    def __init__(self, state_cell, name=None):
+        self._state_cell = state_cell
+        self._drnn = _L().DynamicRNN(name=name)
+        self._mems = {}
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._drnn.block():
+            yield
+            for name, mem in self._mems.items():
+                self._drnn.update_memory(
+                    mem, self._state_cell.get_state(name))
+            self._state_cell.update_states()
+
+    def step_input(self, x, lengths=None, level=0):
+        """x [B, T, ...] padded + lengths [B] (masked-dense stand-in
+        for the reference's LoD step input). The first step_input also
+        binds each StateCell state to a DynamicRNN memory (the rnn's
+        mask must exist before memories — control_flow.py:667)."""
+        out = self._drnn.step_input(x, lengths=lengths, level=level)
+        if not self._mems:
+            for name in self._state_cell._state_names:
+                init = self._state_cell._init_states[name].value
+                self._mems[name] = self._drnn.memory(init=init)
+            self._state_cell._set_states(dict(self._mems))
+        return out
+
+    def static_input(self, x):
+        return self._drnn.static_input(x)
+
+    def output(self, *outputs):
+        return self._drnn.output(*outputs)
+
+    def __call__(self):
+        return self._drnn()
+
+
+class _StateCellRNNCell:
+    """RNNCell adapter: one beam step = feed embedded ids into the
+    StateCell, read out_state, project to vocab."""
+
+    def __init__(self, state_cell, target_dict_dim, extra_inputs):
+        self._sc = state_cell
+        self._V = int(target_dict_dim)
+        self._extra = extra_inputs      # {input_name: [B*beam, D] var}
+        self._proj_w = None
+
+    def call(self, inputs, states):
+        L = _L()
+        sc = self._sc
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        sc._set_states(dict(zip(sc._state_names, states)))
+        feed = dict(self._extra)
+        for name in sc._inputs:
+            if name not in feed:
+                feed[name] = inputs
+        sc.compute_state(inputs=feed)
+        out = sc.out_state()
+        sc.update_states()
+        new_states = [sc.get_state(n) for n in sc._state_names]
+        from ...layers.layer_helper import LayerHelper
+        helper = LayerHelper("beam_decoder_proj")
+        if self._proj_w is None:
+            H = int(out.shape[-1])
+            self._proj_w = helper.create_parameter(
+                helper.param_attr, shape=[H, self._V], dtype="float32")
+        logits = L.matmul(out, self._proj_w)
+        return logits, new_states
+
+
+class BeamSearchDecoder:
+    """reference beam_search_decoder.py:523 — the default decode()
+    semantics (embed previous ids -> StateCell step -> vocab softmax ->
+    beam expansion with end_id termination) over the dense beam
+    machinery (layers.rnn_api). The imperative block()/read_array API
+    of the reference is subsumed by decode(); a custom step belongs in
+    layers.BeamSearchDecoder/dynamic_decode (the modern API)."""
+
+    def __init__(self, state_cell, init_ids, init_scores,
+                 target_dict_dim, word_dim, input_var_dict=None,
+                 topk_size=50, sparse_emb=True, max_len=100,
+                 beam_size=1, end_id=1, name=None):
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._decoded = False
+        self._result = None
+
+    def decode(self):
+        self._decoded = True
+
+    @staticmethod
+    def _start_token_of(init_ids):
+        """The GO token id: the reference feeds it as the init_ids
+        tensor's fill value; the dense beam machinery needs the int, so
+        read it off the producing fill_constant op."""
+        block = init_ids.block
+        for op in block.ops:
+            if init_ids.name in op.output_arg_names and \
+                    op.type == "fill_constant":
+                return int(op.attrs.get("value", 0))
+        raise ValueError(
+            "BeamSearchDecoder could not infer the start token: pass "
+            "init_ids produced by layers.fill_constant(..., value=GO)")
+
+    def __call__(self):
+        if not self._decoded:
+            raise ValueError("call decode() before the decoder")
+        if self._result is not None:
+            return self._result
+        from ...layers import rnn_api
+        from ...layers.layer_helper import LayerHelper
+        L = _L()
+        helper = LayerHelper("beam_decoder_emb")
+        emb_w = helper.create_parameter(
+            helper.param_attr,
+            shape=[self._target_dict_dim, self._word_dim],
+            dtype="float32")
+
+        def embedding_fn(ids):
+            return _L().gather(emb_w, L.cast(ids, "int64"))
+
+        cell = _StateCellRNNCell(self._state_cell,
+                                 self._target_dict_dim, {})
+        decoder = rnn_api.BeamSearchDecoder(
+            cell, start_token=self._start_token_of(self._init_ids),
+            end_token=self._end_id, beam_size=self._beam_size,
+            embedding_fn=embedding_fn)
+        # shared beam tiling (rnn_api.BeamSearchDecoder._tile)
+        cell._extra = {k: decoder._tile(v)
+                       for k, v in self._input_var_dict.items()}
+        inits = [self._state_cell._init_states[n].value
+                 for n in self._state_cell._state_names]
+        (ids, scores), _ = rnn_api.dynamic_decode(
+            decoder, inits=inits, max_step_num=self._max_len)
+        self._result = (ids, scores)
+        return self._result

@@ -1,0 +1,552 @@
+"""fluid.contrib.layers (reference
+python/paddle/fluid/contrib/layers/nn.py, rnn_impl.py, metric_op.py):
+the CTR / text-matching / TDM long tail plus the Basic RNN impls.
+
+Masked-dense conventions: variable-length inputs ride as padded dense
+tensors + explicit ROW/COLUMN/Length vectors (PARITY.md), matching the
+op lowerings in ops/ctr_ops.py / ops/extra_ops.py."""
+import numpy as np
+
+from ..layers.layer_helper import LayerHelper
+from ..framework.core import Variable
+
+__all__ = [
+    "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "search_pyramid_hash", "shuffle_batch",
+    "partial_concat", "partial_sum", "tdm_child", "tdm_sampler",
+    "rank_attention", "batch_fc", "ctr_metric_bundle",
+    "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
+]
+
+
+def _L():
+    from .. import layers
+    return layers
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference contrib/layers/nn.py:41 — Unary(Binary(x, y)) or
+    Binary(x, Unary(y)) for functor_list like
+    ['elementwise_add', 'relu'] (= add(x, relu(y))) or
+    ['relu', 'elementwise_add'] (= relu(add(x, y))). Composed from the
+    constituent ops — XLA fuses the pair exactly as the reference's
+    fused kernel does by hand."""
+    L = _L()
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
+    if len(functor_list) != 2:
+        raise ValueError("functor_list must name exactly two functors")
+    binaries = {"elementwise_add": L.elementwise_add,
+                "elementwise_mul": L.elementwise_mul}
+    unaries = {"relu": L.relu, "tanh": L.tanh,
+               "scale": lambda v: L.scale(v, scale=scale)}
+    a, b = functor_list
+    if a in binaries and b in unaries:
+        return binaries[a](x, unaries[b](y), axis=axis)
+    if a in unaries and b in binaries:
+        return unaries[a](binaries[b](x, y, axis=axis))
+    raise ValueError(
+        f"functor_list {functor_list} must pair one of "
+        f"{sorted(binaries)} with one of {sorted(unaries)}")
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """reference contrib/layers/nn.py:105 var_conv_2d: SAME conv over
+    per-sample valid (row[b], col[b]) regions; invalid area zeroed
+    (ops/ctr_ops.py var_conv_2d)."""
+    helper = LayerHelper("var_conv_2d", param_attr=param_attr,
+                         name=name)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) \
+        else (stride, stride)
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[output_channel, input_channel * fs[0] * fs[1]],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    col_out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="var_conv_2d",
+        inputs={"X": [input], "W": [w], "ROW": [row], "COLUMN": [col]},
+        outputs={"Out": [out], "Col": [col_out]},
+        attrs={"InputChannel": input_channel,
+               "OutputChannel": output_channel,
+               "KernelH": fs[0], "KernelW": fs[1],
+               "StrideH": st[0], "StrideW": st[1]},
+        infer_shape=False)
+    return helper.append_activation(out, act)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """reference contrib/layers/nn.py:222: out[b,t,i,j] =
+    x[b,i] . W[:,t,:] . y[b,j], rows/cols beyond each pair's lengths
+    zeroed. Masked-dense: x [B,Lx,D] + XLength, y [B,Ly,D] + YLength —
+    pass (tensor, lengths) tuples."""
+    helper = LayerHelper("match_matrix_tensor", param_attr=param_attr,
+                         name=name)
+    if not (isinstance(x, (list, tuple)) and isinstance(y, (list, tuple))):
+        raise ValueError(
+            "match_matrix_tensor needs x=(tensor [B,Lx,D], lengths [B])"
+            " and y=(tensor, lengths) in the masked-dense design")
+    xt, xl = x
+    yt, yl = y
+    D = int(xt.shape[-1])
+    Dy = int(yt.shape[-1])
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[D, channel_num, Dy], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    tmp = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="match_matrix_tensor",
+        inputs={"X": [xt], "Y": [yt], "W": [w],
+                "XLength": [xl], "YLength": [yl]},
+        outputs={"Out": [out], "Tmp": [tmp]},
+        attrs={"dim_t": channel_num}, infer_shape=False)
+    return helper.append_activation(out, act), tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """reference contrib/layers/nn.py:309 (ops/extra_ops.py
+    sequence_topk_avg_pooling): per (row, channel), average of the
+    top-k valid column scores for each k in topks."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pos = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="sequence_topk_avg_pooling",
+        inputs={"X": [input], "ROW": [row], "COLUMN": [col]},
+        outputs={"Out": [out], "pos": [pos]},
+        attrs={"topks": [int(k) for k in topks],
+               "channel_num": int(channel_num)},
+        infer_shape=False)
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference contrib/layers/nn.py:377 (ops/ctr_ops.py tree_conv)."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    F = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        helper.param_attr, shape=[F, 3, output_size, num_filters],
+        dtype=nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype=nodes_vector.dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)}, infer_shape=False)
+    if bias_attr:
+        b = helper.create_parameter(
+            helper.bias_attr, shape=[1, 1, output_size, num_filters],
+            dtype=nodes_vector.dtype)
+        out = _L().elementwise_add(out, b, axis=-1)
+    return helper.append_activation(out, act)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """reference contrib/layers/nn.py:447: lookup_table + sum
+    sequence_pool in one step. Masked-dense: ids [B, T]; padding_idx
+    rows embed to zero, so the sum pool needs no separate mask. The
+    composition compiles to one fused XLA gather+reduce — the same
+    fusion the reference's hand-written kernel provides."""
+    if combiner != "sum":
+        raise NotImplementedError(
+            "fused_embedding_seq_pool supports combiner='sum' "
+            "(reference fused_embedding_seq_pool_op.h supports sum "
+            "only)")
+    from ..input import embedding as _emb_v2
+    emb = _emb_v2(input, size, is_sparse=is_sparse,
+                  padding_idx=padding_idx, param_attr=param_attr,
+                  dtype=dtype)                     # [B, T, D]
+    return _L().reduce_sum(emb, dim=[1])           # [B, D]
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0,
+                    return_index=False, name=None):
+    """reference contrib/layers/nn.py:514: multiclass_nms that also
+    returns the kept boxes' original indices (padded -1)."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=bboxes.dtype)
+    index = helper.create_variable_for_type_inference(dtype="int32")
+    rois_num = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="multiclass_nms2",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "Index": [index],
+                 "NmsRoisNum": [rois_num]},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold, "normalized": normalized,
+               "nms_eta": nms_eta, "background_label": background_label},
+        infer_shape=False)
+    if return_index:
+        return out, index
+    return out
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent, is_training,
+                        use_filter, white_list_len, black_list_len,
+                        seed, lr, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """reference contrib/layers/nn.py:644 (ops/ctr_ops.py pyramid_hash):
+    n-gram windows (2..pyramid_layer) hash into a 1-D embedding space;
+    the white/black-list filter is not implemented (raises — parity
+    policy: unsupported args must not silently change semantics).
+    `input` is (ids [B, T] int32, lengths [B]) masked-dense."""
+    if use_filter or white_list_len or black_list_len:
+        raise NotImplementedError(
+            "search_pyramid_hash white/black-list filtering is not "
+            "implemented; pass use_filter=False")
+    helper = LayerHelper("pyramid_hash", param_attr=param_attr,
+                         name=name)
+    ids, lens = input if isinstance(input, (list, tuple)) \
+        else (input, None)
+    if lens is None:
+        raise ValueError(
+            "search_pyramid_hash needs (ids [B, T], lengths [B]) in "
+            "the masked-dense design")
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[space_len + rand_len],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="pyramid_hash",
+        inputs={"X": [ids], "W": [w], "Length": [lens]},
+        outputs={"Out": [out]},
+        attrs={"num_hash": 2, "rand_len": int(rand_len),
+               "max_pyramid": int(pyramid_layer)},
+        infer_shape=False)
+    if is_training and drop_out_percent:
+        out = _L().dropout(out, dropout_prob=float(drop_out_percent))
+    return out
+
+
+def shuffle_batch(x, seed=None):
+    """reference contrib/layers/nn.py:760 (ops/extra_ops.py
+    shuffle_batch): random row permutation; the permutation rides the
+    op's RNG key."""
+    helper = LayerHelper("shuffle_batch")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    idx = helper.create_variable_for_type_inference(dtype="int32")
+    attrs = {}
+    if seed is not None:
+        # 'seed' is what the RNG keying reads (lowering.LowerCtx.op_key)
+        attrs["seed"] = int(seed)
+    helper.append_op(type="shuffle_batch", inputs={"X": [x]},
+                     outputs={"Out": [out], "ShuffleIdx": [idx]},
+                     attrs=attrs, infer_shape=False)
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """reference contrib/layers/nn.py:824 (ops partial_concat)."""
+    helper = LayerHelper("partial_concat")
+    out = helper.create_variable_for_type_inference(
+        dtype=input[0].dtype)
+    helper.append_op(
+        type="partial_concat", inputs={"X": list(input)},
+        outputs={"Out": [out]},
+        attrs={"start_index": int(start_index), "length": int(length)},
+        infer_shape=False)
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """reference contrib/layers/nn.py:887 (ops partial_sum)."""
+    helper = LayerHelper("partial_sum")
+    out = helper.create_variable_for_type_inference(
+        dtype=input[0].dtype)
+    helper.append_op(
+        type="partial_sum", inputs={"X": list(input)},
+        outputs={"Out": [out]},
+        attrs={"start_index": int(start_index), "length": int(length)},
+        infer_shape=False)
+    return out
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """reference contrib/layers/nn.py:941: per queried node, its
+    children and leaf mask from the TreeInfo table (a [node_nums, 3 +
+    child_nums] int parameter: item_id, layer_id, ancestor,
+    children...)."""
+    helper = LayerHelper("tdm_child", param_attr=param_attr)
+    tree_info = helper.create_parameter(
+        helper.param_attr, shape=[node_nums, 3 + child_nums],
+        dtype="int32")
+    child = helper.create_variable_for_type_inference(dtype=dtype)
+    mask = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="tdm_child", inputs={"X": [x], "TreeInfo": [tree_info]},
+        outputs={"Child": [child], "LeafMask": [mask]},
+        attrs={"child_nums": int(child_nums), "dtype": dtype},
+        infer_shape=False)
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list,
+                leaf_node_num, tree_travel_attr=None,
+                tree_layer_attr=None, output_positive=True,
+                output_list=False, seed=0, tree_dtype="int32",
+                dtype="int32"):
+    """reference contrib/layers/nn.py:1026: per item, positive nodes
+    from its travel path + per-layer negative samples. Travel
+    [leaf_node_num, n_layers] and Layer [sum(layer_node_num_list)] are
+    int parameters."""
+    helper = LayerHelper("tdm_sampler")
+    n_layers = len(layer_node_num_list)
+    travel = helper.create_parameter(
+        tree_travel_attr or helper.param_attr,
+        shape=[leaf_node_num, n_layers], dtype="int32")
+    layer = helper.create_parameter(
+        tree_layer_attr or helper.param_attr,
+        shape=[int(sum(layer_node_num_list))], dtype="int32")
+    offsets = [0]
+    for n in layer_node_num_list:
+        offsets.append(offsets[-1] + int(n))
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    labels = helper.create_variable_for_type_inference(dtype=dtype)
+    mask = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="tdm_sampler",
+        inputs={"X": [x], "Travel": [travel], "Layer": [layer]},
+        outputs={"Out": [out], "Labels": [labels], "Mask": [mask]},
+        attrs={"neg_samples_num_list": [int(n) for n in
+                                        neg_samples_num_list],
+               "layer_offset_lod": offsets,
+               "output_positive": bool(output_positive),
+               "dtype": dtype, "seed": int(seed)},
+        infer_shape=False)
+    return out, labels, mask
+
+
+def rank_attention(input, rank_offset, rank_param_shape,
+                   rank_param_attr=None, max_rank=3, max_size=0):
+    """reference contrib/layers/nn.py:1235 (ops rank_attention): rank-
+    conditioned per-instance matmul over a learned rank parameter."""
+    helper = LayerHelper("rank_attention",
+                         param_attr=rank_param_attr)
+    rank_param = helper.create_parameter(
+        helper.param_attr, shape=list(rank_param_shape),
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    input_help = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    ins_rank = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    helper.append_op(
+        type="rank_attention",
+        inputs={"X": [input], "RankOffset": [rank_offset],
+                "RankParam": [rank_param]},
+        outputs={"Out": [out], "InputHelp": [input_help],
+                 "InsRank": [ins_rank]},
+        attrs={"MaxRank": int(max_rank), "MaxSize": int(max_size)},
+        infer_shape=False)
+    return out
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr,
+             act=None):
+    """reference contrib/layers/nn.py:1303 (ops batch_fc): per-slot
+    batched FC — Input [S, B, in] x W [S, in, out] + Bias [S, 1, out]."""
+    helper = LayerHelper("batch_fc", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=list(param_size),
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr,
+                                shape=list(bias_size),
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="batch_fc", inputs={"Input": [input], "W": [w],
+                                 "Bias": [b]},
+        outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    return helper.append_activation(out, act)
+
+
+def ctr_metric_bundle(input, label):
+    """reference contrib/layers/metric_op.py:30: local sums for the
+    CTR metric bundle — (local_sqrerr, local_abserr, local_prob,
+    local_q); divide by the (all-reduced) instance count for
+    MAE/RMSE/predicted-ctr/q."""
+    L = _L()
+    label_f = L.cast(label, input.dtype)
+    diff = L.elementwise_sub(input, label_f)
+    local_sqrerr = L.reduce_sum(L.square(diff))
+    local_abserr = L.reduce_sum(L.abs(diff))
+    local_prob = L.reduce_sum(input)
+    # q = sum of clicks' predicted ctr (label-weighted prob)
+    local_q = L.reduce_sum(L.elementwise_mul(input, label_f))
+    return local_sqrerr, local_abserr, local_prob, local_q
+
+
+# -------------------------------------------------- Basic RNN impls
+
+class BasicGRUUnit:
+    """reference contrib/layers/rnn_impl.py:25 BasicGRUUnit — one GRU
+    step for static-graph composition: unit(input, pre_hidden) ->
+    hidden. Thin front over layers.rnn_api.GRUCell (same math, fused
+    lowering)."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None,
+                 dtype="float32"):
+        if hidden_size is None and isinstance(name_scope, int):
+            name_scope, hidden_size = None, name_scope
+        from ..layers.rnn_api import GRUCell
+        self._cell = GRUCell(hidden_size, param_attr=param_attr,
+                             bias_attr=bias_attr, dtype=dtype,
+                             name=name_scope or "basic_gru_unit")
+
+    def __call__(self, input, pre_hidden):
+        out, _ = self._cell.call(input, [pre_hidden])
+        return out
+
+
+class BasicLSTMUnit:
+    """reference contrib/layers/rnn_impl.py:699 BasicLSTMUnit:
+    unit(input, pre_hidden, pre_cell) -> (hidden, cell)."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None, gate_activation=None,
+                 activation=None, forget_bias=1.0, dtype="float32"):
+        if hidden_size is None and isinstance(name_scope, int):
+            name_scope, hidden_size = None, name_scope
+        from ..layers.rnn_api import LSTMCell
+        self._cell = LSTMCell(hidden_size, param_attr=param_attr,
+                              bias_attr=bias_attr,
+                              forget_bias=forget_bias, dtype=dtype,
+                              name=name_scope or "basic_lstm_unit")
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        _, (h, c) = self._cell.call(input, [pre_hidden, pre_cell])
+        return h, c
+
+
+def _stacked_rnn(cell_factory, input, init_states, hidden_size,
+                 num_layers, sequence_length, dropout_prob,
+                 bidirectional, batch_first, dtype):
+    L = _L()
+    from ..layers import rnn_api
+    x = input if batch_first else L.transpose(input, [1, 0, 2])
+    last_states = []
+    for layer in range(num_layers):
+        outs = []
+        dirs = [False, True] if bidirectional else [False]
+        for rev in dirs:
+            cell = cell_factory(layer, rev)
+            init = None
+            if init_states is not None:
+                init = init_states[len(last_states)]
+            out, final = rnn_api.rnn(cell, x, initial_states=init,
+                                     sequence_length=sequence_length,
+                                     is_reverse=rev)
+            outs.append(out)
+            last_states.append(final)
+        x = outs[0] if len(outs) == 1 else L.concat(outs, axis=-1)
+        if dropout_prob and layer < num_layers - 1:
+            x = L.dropout(x, dropout_prob=dropout_prob)
+    if not batch_first:
+        x = L.transpose(x, [1, 0, 2])
+    return x, last_states
+
+
+def _split_stacked_init(init, num_entries):
+    """Normalize an init-state argument to a per-(layer, direction)
+    list: the reference's stacked [num_layers*dirs, B, H] tensor splits
+    along dim 0; a list/tuple passes through; a single [B, H] tensor
+    serves a single entry."""
+    L = _L()
+    if init is None:
+        return None
+    if isinstance(init, (list, tuple)):
+        entries = list(init)
+    elif len(init.shape) == 3:
+        parts = L.split(init, num_or_sections=int(init.shape[0]),
+                        dim=0)
+        entries = [L.reshape(p, [int(init.shape[1]),
+                                 int(init.shape[2])]) for p in parts]
+    else:
+        entries = [init]
+    if len(entries) != num_entries:
+        raise ValueError(
+            f"init state provides {len(entries)} entries but the "
+            f"stacked RNN has {num_entries} (num_layers x directions)")
+    return entries
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0,
+              bidirectional=False, batch_first=True, param_attr=None,
+              bias_attr=None, gate_activation=None, activation=None,
+              dtype="float32", name="basic_gru"):
+    """reference contrib/layers/rnn_impl.py:164 basic_gru: (possibly
+    bidirectional) stacked GRU; returns (rnn_out, last_hidden list).
+    Composed over layers.rnn_api.rnn's masked static unroll."""
+    from ..layers.rnn_api import GRUCell
+
+    def factory(layer, rev):
+        return GRUCell(hidden_size, param_attr=param_attr,
+                       bias_attr=bias_attr, dtype=dtype,
+                       name=f"{name}_l{layer}{'_r' if rev else ''}")
+
+    n_entries = num_layers * (2 if bidirectional else 1)
+    init = None
+    if init_hidden is not None:
+        init = [[h] for h in _split_stacked_init(init_hidden,
+                                                 n_entries)]
+    out, finals = _stacked_rnn(factory, input, init, hidden_size,
+                               num_layers, sequence_length,
+                               dropout_prob, bidirectional,
+                               batch_first, dtype)
+    last_hidden = [f[0] if isinstance(f, (list, tuple)) else f
+                   for f in finals]
+    return out, last_hidden
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size,
+               num_layers=1, sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """reference contrib/layers/rnn_impl.py:405 basic_lstm: stacked
+    (bi)LSTM; returns (rnn_out, last_hidden list, last_cell list)."""
+    from ..layers.rnn_api import LSTMCell
+
+    def factory(layer, rev):
+        return LSTMCell(hidden_size, param_attr=param_attr,
+                        bias_attr=bias_attr, forget_bias=forget_bias,
+                        dtype=dtype,
+                        name=f"{name}_l{layer}{'_r' if rev else ''}")
+
+    n_entries = num_layers * (2 if bidirectional else 1)
+    init = None
+    if init_hidden is not None and init_cell is not None:
+        hs = _split_stacked_init(init_hidden, n_entries)
+        cs = _split_stacked_init(init_cell, n_entries)
+        init = [[h, c] for h, c in zip(hs, cs)]
+    out, finals = _stacked_rnn(factory, input, init, hidden_size,
+                               num_layers, sequence_length,
+                               dropout_prob, bidirectional,
+                               batch_first, dtype)
+    last_hidden = [f[0] for f in finals]
+    last_cell = [f[1] for f in finals]
+    return out, last_hidden, last_cell

@@ -1,0 +1,37 @@
+"""Memory-usage estimation (reference
+contrib/memory_usage_calc.py:46 memory_usage): sum the sizes of every
+variable in the program with -1 batch dims bound to `batch_size`,
+reported as a (low, high) MB range. The reference brackets its
+estimate the same way (actual placement adds allocator overhead — XLA
+fusion typically LOWERS the real footprint here, so the range is an
+upper-bound style estimate)."""
+import numpy as np
+
+from ..framework.dtype import np_dtype
+
+__all__ = ["memory_usage"]
+
+_BRACKET = 0.15
+
+
+def memory_usage(program, batch_size):
+    from ..framework.core import Program
+    if not isinstance(program, Program):
+        raise TypeError("memory_usage expects a Program")
+    if not isinstance(batch_size, int) or batch_size <= 0:
+        raise ValueError("batch_size must be a positive int")
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.shape is None:
+                continue
+            n = 1
+            for d in var.shape:
+                n *= batch_size if int(d) < 0 else int(d)
+            try:
+                itemsize = np.dtype(np_dtype(var.dtype)).itemsize
+            except TypeError:
+                itemsize = 4
+            total += n * itemsize
+    mb = total / (1024.0 ** 2)
+    return mb * (1 - _BRACKET), mb * (1 + _BRACKET)

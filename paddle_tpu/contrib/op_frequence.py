@@ -1,0 +1,24 @@
+"""Op-frequency statistics (reference contrib/op_frequence.py
+op_freq_statistic): unigram op-type counts and adjacent-pair counts
+over a program, both sorted descending."""
+from collections import Counter, OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    from ..framework.core import Program
+    if not isinstance(program, Program):
+        raise TypeError("op_freq_statistic expects a Program")
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted

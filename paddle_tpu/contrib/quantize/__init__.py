@@ -1,0 +1,41 @@
+"""fluid.contrib.quantize (reference contrib/quantize/
+quantize_transpiler.py QuantizeTranspiler): the pre-slim QAT entry.
+Front over the slim QuantizationTransformPass (the same fake-quant
+instrumentation the reference's transpiler performs op-by-op)."""
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake-quant/dequant ops for QAT (reference
+        QuantizeTranspiler.training_transpile)."""
+        from ..slim.quantization.quantization_pass import (
+            QuantizationTransformPass)
+        from ...framework.core import default_main_program
+        program = program or default_main_program()
+        QuantizationTransformPass(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            activation_quantize_type=self.activation_quantize_type,
+            weight_quantize_type=self.weight_quantize_type,
+            window_size=self.window_size).apply(
+                program, startup_program=startup_program)
+        return program
+
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """Reference freeze_program folds quant scales for inference;
+        here the fake-quant graph is already inference-executable (STE
+        ops are identity at eval), so freezing is a no-op that returns
+        the program."""
+        return program

@@ -1,0 +1,20 @@
+"""Distributed reader decorator (reference contrib/reader/
+distributed_reader.py distributed_batch_reader): each trainer keeps
+every trainers_num-th batch, offset by its trainer id (round-robin
+batch sharding from the PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM
+launcher env, the same contract distributed/launch.py sets)."""
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    trainers_num = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers_num == trainer_id:
+                yield batch
+
+    return decorated

@@ -1,0 +1,164 @@
+"""fluid.contrib.utils (reference contrib/utils/hdfs_utils.py +
+lookup_table_utils.py): HDFS transfer helpers and distributed-lookup-
+table program surgery.
+
+HDFSClient shells out to `hadoop fs` exactly like the reference; the
+binary is probed lazily so import works on machines without a Hadoop
+install (calls then raise an actionable error)."""
+import os
+import subprocess
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+class HDFSClient:
+    """reference hdfs_utils.py:35 — thin `hadoop fs` CLI wrapper."""
+
+    def __init__(self, hadoop_home, configs):
+        self.hadoop_home = hadoop_home
+        self.configs = dict(configs or {})
+        self.pre_commands = [os.path.join(hadoop_home, "bin", "hadoop"),
+                             "fs"]
+        for k, v in self.configs.items():
+            self.pre_commands.append(f"-D{k}={v}")
+
+    def _run(self, args, retry_times=5):
+        cmd = self.pre_commands + list(args)
+        if not os.path.exists(self.pre_commands[0]):
+            raise RuntimeError(
+                f"hadoop binary not found at {self.pre_commands[0]}; "
+                f"HDFSClient needs a Hadoop install (hadoop_home="
+                f"{self.hadoop_home!r})")
+        last = None
+        for _ in range(max(1, retry_times)):
+            p = subprocess.run(cmd, capture_output=True, text=True)
+            last = p
+            if p.returncode == 0:
+                return p.stdout
+        raise RuntimeError(
+            f"hdfs command {' '.join(args)} failed: {last.stderr}")
+
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5):
+        args = ["-put"] + (["-f"] if overwrite else []) + \
+            [local_path, hdfs_path]
+        self._run(args, retry_times)
+        return True
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        self._run(["-get", hdfs_path, local_path])
+        return True
+
+    def is_exist(self, hdfs_path=None):
+        try:
+            self._run(["-test", "-e", hdfs_path], retry_times=1)
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, hdfs_path=None):
+        try:
+            self._run(["-test", "-d", hdfs_path], retry_times=1)
+            return True
+        except RuntimeError:
+            return False
+
+    def delete(self, hdfs_path):
+        self._run(["-rm", "-r", hdfs_path])
+        return True
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        self._run(["-mv", hdfs_src_path, hdfs_dst_path])
+        return True
+
+    def makedirs(self, hdfs_path):
+        self._run(["-mkdir", "-p", hdfs_path])
+        return True
+
+    def ls(self, hdfs_path):
+        out = self._run(["-ls", hdfs_path])
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith("Found")]
+
+    @staticmethod
+    def make_local_dirs(local_path):
+        os.makedirs(local_path, exist_ok=True)
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id,
+                   trainers, multi_processes=5):
+    """reference hdfs_utils.py:437: each trainer downloads its
+    round-robin shard of the files under hdfs_path."""
+    files = client.ls(hdfs_path)
+    mine = [f for i, f in enumerate(sorted(files))
+            if i % trainers == trainer_id]
+    HDFSClient.make_local_dirs(local_path)
+    out = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """reference hdfs_utils.py:518."""
+    client.makedirs(hdfs_path)
+    for root, _, names in os.walk(local_path):
+        for n in names:
+            src = os.path.join(root, n)
+            rel = os.path.relpath(src, local_path)
+            client.upload(os.path.join(hdfs_path, rel), src,
+                          overwrite=overwrite)
+    return True
+
+
+def convert_dist_to_sparse_program(program):
+    """reference lookup_table_utils.py:85: rewrite the trainer
+    program's distributed_lookup_table ops back to LOCAL sparse
+    lookup_table ops so the PS-trained model runs single-process
+    (the pserver-hosted table becomes an ordinary sparse parameter)."""
+    from ...framework.core import Program  # noqa: F401 (type anchor)
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "distributed_lookup_table":
+                op.type = "lookup_table"
+                op.attrs.pop("endpoint", None)
+                op.attrs.pop("table_name", None)
+                op.attrs["is_sparse"] = True
+            elif op.type in ("lookup_table", "lookup_table_v2"):
+                op.attrs["is_distributed"] = False
+                op.attrs["is_sparse"] = True
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var,
+                                    lookup_table_var_path):
+    """reference lookup_table_utils.py:136: load dense persistables
+    from dirname plus the lookup-table param from its own path
+    (PS-sharded table saves live beside the dense checkpoint)."""
+    import numpy as np
+    from ... import io
+    io.load_persistables(executor, dirname, main_program=program)
+    if lookup_table_var is not None and \
+            os.path.exists(lookup_table_var_path):
+        from ...framework.executor import global_scope
+        name = lookup_table_var if isinstance(lookup_table_var, str) \
+            else lookup_table_var.name
+        global_scope().set(name, np.load(lookup_table_var_path,
+                                         allow_pickle=False))
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """reference lookup_table_utils.py:260: same load for the local-
+    inference program converted by convert_dist_to_sparse_program."""
+    load_persistables_for_increment(
+        dirname, executor, program, lookup_table_var_name,
+        os.path.join(dirname, f"{lookup_table_var_name}.npy"))

@@ -257,6 +257,7 @@ def yolo_box(ctx, ins, attrs):
     return {"Boxes": boxes, "Scores": scores}
 
 
+@register_op("multiclass_nms2", grad=False, infer_shape=False)
 @register_op("multiclass_nms", grad=False, infer_shape=False)
 def multiclass_nms(ctx, ins, attrs):
     """Per-class greedy NMS + cross-class top-k (reference
@@ -285,6 +286,7 @@ def multiclass_nms(ctx, ins, attrs):
         all_scores = []
         all_boxes = []
         all_cls = []
+        all_idx = []
         for c in fg_classes:
             s = sc[c]
             top_s, top_i = jax.lax.top_k(s, nms_top_k)
@@ -304,9 +306,11 @@ def multiclass_nms(ctx, ins, attrs):
             all_scores.append(jnp.where(alive, top_s, -1.0))
             all_boxes.append(b)
             all_cls.append(jnp.full((nms_top_k,), c, jnp.float32))
+            all_idx.append(top_i.astype(jnp.int32))
         cat_s = jnp.concatenate(all_scores)
         cat_b = jnp.concatenate(all_boxes, axis=0)
         cat_c = jnp.concatenate(all_cls)
+        cat_i = jnp.concatenate(all_idx)
         k = min(keep_top_k, cat_s.shape[0])
         fin_s, fin_i = jax.lax.top_k(cat_s, k)
         valid = fin_s > score_thresh
@@ -314,10 +318,14 @@ def multiclass_nms(ctx, ins, attrs):
             jnp.where(valid, cat_c[fin_i], -1.0)[:, None],
             jnp.where(valid, fin_s, 0.0)[:, None],
             jnp.where(valid[:, None], cat_b[fin_i], 0.0)], axis=1)
-        return rows, jnp.sum(valid.astype(jnp.int32))
+        # original box index of each kept row (-1 pads) — the v2
+        # (multiclass_nms2) Index output
+        index = jnp.where(valid, cat_i[fin_i], -1)
+        return rows, jnp.sum(valid.astype(jnp.int32)), index
 
-    rows, counts = jax.vmap(per_image)(bboxes, scores)
-    return {"Out": rows, "NmsRoisNum": counts}
+    rows, counts, index = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": rows, "NmsRoisNum": counts,
+            "Index": index[:, :, None]}
 
 
 @register_op("roi_align", infer_shape=False)
