@@ -1,0 +1,10 @@
+"""Fleet execution modes (reference incubate/fleet/base/mode.py)."""
+
+__all__ = ["Mode"]
+
+
+class Mode:
+    """reference mode.py Mode: which fleet backend drives training."""
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3

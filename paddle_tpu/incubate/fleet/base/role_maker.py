@@ -107,3 +107,80 @@ class UserDefinedRoleMaker(RoleMakerBase):
 
     def worker_num(self):
         return max(len(self._worker_endpoints), 1)
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    """reference role_maker.py:952 — explicit collective wiring: every
+    node is a worker."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = int(current_id)
+        self._role = Role.WORKER
+        self._worker_endpoints = list(worker_endpoints or [""])
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """reference role_maker.py MPISymetricRoleMaker: ranks split
+    symmetrically — EVEN ranks are servers, ODD ranks are workers,
+    worker_num == server_num == size // 2. Re-keyed off the launcher
+    env (the reference reads mpi4py COMM_WORLD; there is no MPI on a
+    TPU pod — the PADDLE_TRAINER_* contract carries the same
+    rank/size info)."""
+
+    def __init__(self):
+        super().__init__()
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        eps = [e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                         "").split(",") if e]
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                  str(max(len(eps), 2))))
+        if size % 2 != 0:
+            raise ValueError(
+                f"MPISymetricRoleMaker needs an even world size "
+                f"(got {size}): even ranks serve, odd ranks train")
+        eps = eps or [""] * size
+        self._server_endpoints = eps[0::2]
+        self._worker_endpoints = eps[1::2]
+        self._role = Role.SERVER if rank % 2 == 0 else Role.WORKER
+        self._current_id = rank // 2
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return max(len(self._server_endpoints), 1)
+
+
+class GeneralRoleMaker(RoleMakerBase):
+    """reference role_maker.py GeneralRoleMaker: env-driven like
+    PaddleCloudRoleMaker but with explicit endpoint-list kwargs
+    overriding the environment."""
+
+    def __init__(self, current_id=None, role=None,
+                 worker_endpoints=None, server_endpoints=None, **kwargs):
+        super().__init__()
+        env_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self._role = role if role is not None else (
+            Role.SERVER if env_role == "PSERVER" else Role.WORKER)
+        self._worker_endpoints = list(worker_endpoints or [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if e])
+        self._server_endpoints = list(server_endpoints or [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e])
+        if current_id is not None:
+            self._current_id = int(current_id)
+        elif self._role == Role.WORKER:
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID",
+                                                  "0"))
+        else:
+            cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+            self._current_id = (self._server_endpoints.index(cur)
+                                if cur in self._server_endpoints else 0)
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)

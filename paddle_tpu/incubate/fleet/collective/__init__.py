@@ -208,3 +208,9 @@ class CollectiveOptimizer:
 
 
 fleet = Collective()
+
+# virtual subclasses of the fleet ABC contract (base/fleet_base.py)
+from ..base.fleet_base import Fleet as _Fleet  # noqa: E402
+from ..base.fleet_base import DistributedOptimizer as _DO  # noqa: E402
+_Fleet.register(Collective)
+_DO.register(CollectiveOptimizer)

@@ -103,13 +103,23 @@ class _TranspilerOptimizer:
         result = self._inner.minimize(loss, startup_program,
                                       parameter_list, no_grad_set)
         rm = self._fleet._role_maker
-        t = DistributeTranspiler(config=self._strategy)
+        config = self._strategy
+        # a fleet DistributedStrategy (distribute_transpiler.
+        # distributed_strategy) carries its transpiler config inside
+        if hasattr(config, "get_program_config"):
+            config = config.get_program_config()
+        if config is not None and getattr(config, "geo_sgd_mode", False):
+            # GEO: unmodified local program + periodic delta sync
+            from ....transpiler import GeoSgdTranspiler
+            t = GeoSgdTranspiler(config=config)
+        else:
+            t = DistributeTranspiler(config=config)
         t.transpile(
             trainer_id=rm.worker_index(),
             program=loss.block.program,
             pservers=",".join(rm.get_pserver_endpoints()),
             trainers=rm.worker_num(),
-            sync_mode=getattr(self._strategy, "sync_mode", True),
+            sync_mode=getattr(config, "sync_mode", True),
             startup_program=startup_program)
         self._fleet._transpiler = t
         if rm.is_worker():
@@ -122,3 +132,10 @@ class _TranspilerOptimizer:
 
 
 fleet = ParameterServerFleet()
+
+# virtual subclasses of the fleet ABC contract (base/fleet_base.py) so
+# reference-style isinstance checks hold
+from ..base.fleet_base import Fleet as _Fleet  # noqa: E402
+from ..base.fleet_base import DistributedOptimizer as _DO  # noqa: E402
+_Fleet.register(ParameterServerFleet)
+_DO.register(_TranspilerOptimizer)

@@ -1,0 +1,14 @@
+"""Reference module path incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py — the transpiler-mode PS fleet. The
+implementation lives one level up (parameter_server/__init__.py
+ParameterServerFleet); this package provides the reference import path
+plus the strategy objects, which _TranspilerOptimizer accepts directly
+(a DistributedStrategy's program config and sync mode feed the
+transpile call)."""
+from .. import (  # noqa: F401
+    fleet, ParameterServerFleet, _TranspilerOptimizer,
+)
+from .distributed_strategy import (  # noqa: F401
+    TrainerRuntimeConfig, DistributedStrategy, SyncStrategy,
+    AsyncStrategy, HalfAsyncStrategy, GeoStrategy, StrategyFactory,
+)

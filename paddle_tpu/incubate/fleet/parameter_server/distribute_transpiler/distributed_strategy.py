@@ -1,0 +1,143 @@
+"""PS-mode distributed strategies (reference incubate/fleet/
+parameter_server/distribute_transpiler/distributed_strategy.py:
+TrainerRuntimeConfig, DistributedStrategy, Sync/Async/HalfAsync/Geo
+strategies, StrategyFactory). Each strategy carries a
+DistributeTranspilerConfig plus the communicator mode the trainer
+runtime starts (distributed/communicator.py implements the four
+modes)."""
+from .....transpiler import DistributeTranspilerConfig
+
+__all__ = ["TrainerRuntimeConfig", "DistributedStrategy",
+           "SyncStrategy", "AsyncStrategy", "HalfAsyncStrategy",
+           "GeoStrategy", "StrategyFactory"]
+
+
+class TrainerRuntimeConfig:
+    """reference distributed_strategy.py TrainerRuntimeConfig: the
+    communicator knobs (send queue sizes / wait times)."""
+
+    def __init__(self):
+        self.mode = None
+        self.runtime_configs = {
+            "communicator_max_merge_var_num": 20,
+            "communicator_send_queue_size": 20,
+            "communicator_independent_recv_thread": 1,
+            "communicator_send_wait_times": 5,
+            "communicator_thread_pool_size": 5,
+        }
+
+    def get_communicator_flags(self):
+        return dict(self.runtime_configs)
+
+
+class DistributedStrategy:
+    """reference DistributedStrategy base: program config + trainer
+    runtime config + execute/build strategies."""
+
+    def __init__(self):
+        self._program_config = DistributeTranspilerConfig()
+        self._trainer_runtime_config = TrainerRuntimeConfig()
+        self._build_strategy = None
+        self._execute_strategy = None
+        self._mode = "sync"
+
+    def get_program_config(self):
+        return self._program_config
+
+    def set_program_config(self, config):
+        if isinstance(config, DistributeTranspilerConfig):
+            self._program_config = config
+        elif isinstance(config, dict):
+            for k, v in config.items():
+                if not hasattr(self._program_config, k):
+                    raise ValueError(f"unknown program_config key {k!r}")
+                setattr(self._program_config, k, v)
+        else:
+            raise TypeError(
+                "program_config must be DistributeTranspilerConfig or "
+                "dict")
+
+    def get_trainer_runtime_config(self):
+        return self._trainer_runtime_config
+
+    def set_trainer_runtime_config(self, config):
+        if isinstance(config, TrainerRuntimeConfig):
+            self._trainer_runtime_config = config
+        elif isinstance(config, dict):
+            self._trainer_runtime_config.runtime_configs.update(config)
+        else:
+            raise TypeError(
+                "trainer_runtime_config must be TrainerRuntimeConfig "
+                "or dict")
+
+    def get_build_strategy(self):
+        return self._build_strategy
+
+    def set_build_strategy(self, s):
+        self._build_strategy = s
+
+    def get_execute_strategy(self):
+        return self._execute_strategy
+
+    def set_execute_strategy(self, s):
+        self._execute_strategy = s
+
+    @property
+    def sync_mode(self):
+        return self._mode == "sync"
+
+
+class SyncStrategy(DistributedStrategy):
+    def __init__(self):
+        super().__init__()
+        self._mode = "sync"
+        self._program_config.sync_mode = True
+
+
+class AsyncStrategy(DistributedStrategy):
+    def __init__(self):
+        super().__init__()
+        self._mode = "async"
+        self._program_config.sync_mode = False
+
+
+class HalfAsyncStrategy(DistributedStrategy):
+    def __init__(self):
+        super().__init__()
+        self._mode = "half_async"
+        # the transpiler derives effective sync from
+        # `sync_mode and not half_async` (transpiler/__init__.py:145):
+        # half-async keeps the sync program rewrite but drops the
+        # per-step barrier
+        self._program_config.sync_mode = True
+        self._program_config.half_async = True
+
+
+class GeoStrategy(DistributedStrategy):
+    def __init__(self, update_frequency=100):
+        super().__init__()
+        self._mode = "geo"
+        self._program_config.sync_mode = False
+        self._program_config.geo_sgd_mode = True
+        self._program_config.geo_sgd_need_push_nums = int(
+            update_frequency)
+
+
+class StrategyFactory:
+    """reference StrategyFactory: canned strategy constructors."""
+
+    @staticmethod
+    def create_sync_strategy():
+        return SyncStrategy()
+
+    @staticmethod
+    def create_async_strategy():
+        return AsyncStrategy()
+
+    @staticmethod
+    def create_half_async_strategy():
+        return HalfAsyncStrategy()
+
+    @staticmethod
+    def create_geo_strategy(update_frequency=100):
+        return GeoStrategy(update_frequency)

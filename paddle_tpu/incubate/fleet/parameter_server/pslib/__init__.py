@@ -100,5 +100,19 @@ class DownpourOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self._optimizer.minimize(loss, startup_program,
-                                        parameter_list, no_grad_set)
+        # single dense-minimize implementation: the reference routes
+        # pslib's distributed_optimizer through optimizer_factory
+        # DistributedAdam; so do we
+        from .optimizer_factory import DistributedAdam
+        return DistributedAdam(self._optimizer)._minimize(
+            loss, startup_program, parameter_list, no_grad_set,
+            strategy=self._strategy)
+
+
+# virtual subclasses of the fleet ABC contract (base/fleet_base.py)
+from ...base.fleet_base import Fleet as _Fleet  # noqa: E402
+from ...base.fleet_base import DistributedOptimizer as _DO  # noqa: E402
+from .optimizer_factory import DistributedAdam as _DA  # noqa: E402
+_Fleet.register(PSLibFleet)
+_DO.register(DownpourOptimizer)
+_DO.register(_DA)

@@ -1,0 +1,39 @@
+"""pslib optimizer factory (reference incubate/fleet/
+parameter_server/pslib/optimizer_factory.py: DistributedAdam,
+FLEET_GLOBAL_DICT). The reference's factory compiles the user
+optimizer + sparse-table configs into a Downpour protobuf plan; the
+TPU-native table runtime lives in distributed/downpour.py
+(DownpourTableConfig / FleetWrapper / DownpourWorker), so this
+factory's job is the reference-shaped `_minimize` contract: run the
+dense optimizer locally and hand back per-loss results for
+PSLibFleet's worker loop."""
+
+__all__ = ["DistributedAdam", "FLEET_GLOBAL_DICT"]
+
+FLEET_GLOBAL_DICT = {
+    "enable": False,
+    "emb_to_table": {},
+    "emb_to_accessor": {},
+    "emb_to_size": {},
+}
+
+
+class DistributedAdam:
+    """reference optimizer_factory.py DistributedAdam."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._window = 1
+        self.type = "downpour"
+
+    def _minimize(self, losses, startup_program=None,
+                  parameter_list=None, no_grad_set=None,
+                  strategy=None):
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        results = [self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+            for loss in losses]
+        return results[0] if len(results) == 1 else results
+
+    minimize = _minimize

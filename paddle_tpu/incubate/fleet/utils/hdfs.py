@@ -1,0 +1,6 @@
+"""Reference module path incubate/fleet/utils/hdfs.py — HDFSClient.
+One implementation, shared with fluid.contrib.utils (both reference
+modules wrap the same `hadoop fs` CLI)."""
+from ....contrib.utils import HDFSClient  # noqa: F401
+
+__all__ = ["HDFSClient"]

@@ -1,0 +1,119 @@
+"""Fleet program utilities (reference incubate/fleet/utils/utils.py:
+load_program/save_program, program_type_trans, parse_program,
+check_saved_vars_try_dump, check_pruned_program_vars, graphviz).
+
+Program files here are the framework's JSON serialization
+(Program.to_dict / from_dict) — the reference's binary/pbtxt pair maps
+to compact vs indented JSON, and program_type_trans converts between
+them."""
+import json
+import os
+
+from ....framework.core import Program
+
+__all__ = ["load_program", "save_program", "program_type_trans",
+           "check_saved_vars_try_dump", "parse_program",
+           "check_pruned_program_vars", "graphviz"]
+
+
+def save_program(program, model_filename, is_text=False):
+    """reference utils.py save_program: write a program file (indented
+    JSON when is_text, compact otherwise)."""
+    with open(model_filename, "w") as f:
+        json.dump(program.to_dict(), f,
+                  indent=2 if is_text else None)
+
+
+def load_program(model_filename, is_text=False):
+    """reference utils.py load_program."""
+    with open(model_filename) as f:
+        return Program.from_dict(json.load(f))
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """reference utils.py program_type_trans: convert a program file
+    between the compact (binary-analog) and indented (text-analog)
+    forms; returns the converted file name."""
+    path = os.path.join(prog_dir, prog_fn)
+    prog = load_program(path, is_text)
+    out_fn = prog_fn + (".bin" if is_text else ".pbtxt")
+    save_program(prog, os.path.join(prog_dir, out_fn),
+                 is_text=not is_text)
+    return out_fn
+
+
+def parse_program(program, output_file=None):
+    """reference utils.py parse_program: human-readable summary
+    (feeds, fetches, per-block op list with IO)."""
+    lines = []
+    for block in program.blocks:
+        lines.append(f"block {block.idx} "
+                     f"(parent {block.parent_idx}):")
+        for var in block.vars.values():
+            lines.append(f"  var {var.name}: shape={var.shape} "
+                         f"dtype={var.dtype} "
+                         f"persistable={var.persistable}")
+        for op in block.ops:
+            ins = {k: v for k, v in op.inputs.items()}
+            outs = {k: v for k, v in op.outputs.items()}
+            lines.append(f"  op {op.type}: in={ins} out={outs}")
+    text = "\n".join(lines) + "\n"
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(text)
+    return text
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    """reference utils.py: every var the pruned (inference) program
+    reads must exist in the train program with matching shape/dtype;
+    returns the list of mismatches (empty = compatible)."""
+    train_vars = {}
+    for block in train_prog.blocks:
+        train_vars.update(block.vars)
+    problems = []
+    for block in pruned_prog.blocks:
+        for var in block.vars.values():
+            if getattr(var, "is_data", False):
+                continue
+            tv = train_vars.get(var.name)
+            if tv is None:
+                problems.append((var.name, "missing in train program"))
+            elif tv.shape != var.shape or tv.dtype != var.dtype:
+                problems.append(
+                    (var.name,
+                     f"shape/dtype mismatch: train ({tv.shape}, "
+                     f"{tv.dtype}) vs pruned ({var.shape}, "
+                     f"{var.dtype})"))
+    return problems
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feed_config=None, fetch_config=None,
+                              batch_size=1, save_filename=None):
+    """reference utils.py: load a dumped program and verify it can be
+    summarized (the reference also test-runs it; a parse here proves
+    the file round-trips)."""
+    prog = load_program(os.path.join(dump_dir, dump_prog_fn),
+                        is_text_dump_program)
+    return parse_program(prog)
+
+
+def graphviz(block, output_dir="", filename="program.dot"):
+    """reference utils.py graphviz: emit a DOT graph of the block's
+    op/var dataflow; returns the dot file path."""
+    lines = ["digraph G {"]
+    for i, op in enumerate(block.ops):
+        op_node = f"op_{i}_{op.type}"
+        lines.append(f'  "{op_node}" [shape=box, label="{op.type}"];')
+        for names in op.inputs.values():
+            for n in names:
+                lines.append(f'  "{n}" -> "{op_node}";')
+        for names in op.outputs.values():
+            for n in names:
+                lines.append(f'  "{op_node}" -> "{n}";')
+    lines.append("}")
+    path = os.path.join(output_dir or ".", filename)
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path

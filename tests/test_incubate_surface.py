@@ -1,0 +1,206 @@
+"""incubate.fleet module-path parity (reference incubate/fleet/:
+base/fleet_base.py Fleet/DistributedOptimizer, base/mode.py Mode,
+base/role_maker.py's seven role makers, parameter_server/
+distribute_transpiler/distributed_strategy.py's strategy family,
+pslib/optimizer_factory.py DistributedAdam, utils/hdfs.py +
+utils/utils.py program tools)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_reference_module_paths_import():
+    from paddle_tpu.incubate.fleet.base.fleet_base import (
+        Fleet, DistributedOptimizer, Mode)
+    from paddle_tpu.incubate.fleet.base.mode import Mode as M2
+    from paddle_tpu.incubate.fleet.base import role_maker
+    for n in ("Role", "RoleMakerBase", "MPISymetricRoleMaker",
+              "UserDefinedRoleMaker", "UserDefinedCollectiveRoleMaker",
+              "PaddleCloudRoleMaker", "GeneralRoleMaker"):
+        assert hasattr(role_maker, n), n
+    from paddle_tpu.incubate.fleet.parameter_server \
+        .distribute_transpiler import (
+            fleet, TrainerRuntimeConfig, DistributedStrategy,
+            SyncStrategy, AsyncStrategy, HalfAsyncStrategy,
+            GeoStrategy, StrategyFactory)
+    from paddle_tpu.incubate.fleet.parameter_server.pslib \
+        .optimizer_factory import DistributedAdam, FLEET_GLOBAL_DICT
+    from paddle_tpu.incubate.fleet.utils.hdfs import HDFSClient
+    from paddle_tpu.incubate.fleet.utils import utils
+    for n in ("load_program", "save_program", "program_type_trans",
+              "check_saved_vars_try_dump", "parse_program",
+              "check_pruned_program_vars", "graphviz"):
+        assert hasattr(utils, n), n
+    assert Mode.TRANSPILER == 1 and M2.COLLECTIVE == 3
+
+
+def test_strategy_factory_and_configs():
+    from paddle_tpu.incubate.fleet.parameter_server \
+        .distribute_transpiler import StrategyFactory
+    s = StrategyFactory.create_sync_strategy()
+    assert s.sync_mode and s.get_program_config().sync_mode
+    a = StrategyFactory.create_async_strategy()
+    assert not a.sync_mode
+    g = StrategyFactory.create_geo_strategy(42)
+    pc = g.get_program_config()
+    assert pc.geo_sgd_mode and pc.geo_sgd_need_push_nums == 42
+    h = StrategyFactory.create_half_async_strategy()
+    # half-async keeps the sync rewrite, drops the per-step barrier
+    # (the transpiler derives sync_mode and not half_async)
+    assert h.get_program_config().half_async
+    assert h.get_program_config().sync_mode
+    # config mutation APIs
+    s.set_program_config({"slice_var_up": False})
+    assert s.get_program_config().slice_var_up is False
+    with pytest.raises(ValueError):
+        s.set_program_config({"bogus_key": 1})
+    trc = s.get_trainer_runtime_config()
+    s.set_trainer_runtime_config({"communicator_send_queue_size": 7})
+    assert trc.get_communicator_flags()[
+        "communicator_send_queue_size"] == 7
+
+
+def test_role_makers():
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        MPISymetricRoleMaker, UserDefinedCollectiveRoleMaker,
+        GeneralRoleMaker, Role)
+    env = {"PADDLE_TRAINER_ID": "1",
+           "PADDLE_TRAINER_ENDPOINTS": "a:1,b:2",
+           "PADDLE_PSERVERS_IP_PORT_LIST": "c:3"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        # rank 1 of 2: odd ranks train, even ranks serve (reference
+        # symmetric split), half the world each
+        m = MPISymetricRoleMaker()
+        assert m.is_worker() and not m.is_server()
+        assert m.worker_num() == 1 and m.server_num() == 1
+        assert m.worker_index() == 0
+        os.environ["PADDLE_TRAINER_ID"] = "2"
+        os.environ["PADDLE_TRAINERS_NUM"] = "4"
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = "a:1,b:2,c:3,d:4"
+        ms = MPISymetricRoleMaker()
+        assert ms.is_server() and ms.server_index() == 1
+        assert ms.worker_num() == 2 and ms.server_num() == 2
+        os.environ.update(env)
+        u = UserDefinedCollectiveRoleMaker(
+            current_id=1, worker_endpoints=["a:1", "b:2", "c:3"])
+        assert u.is_worker() and u.worker_num() == 3
+        g = GeneralRoleMaker()
+        assert g.is_worker() and g.worker_index() == 1
+        gs = GeneralRoleMaker(role=Role.SERVER)
+        assert gs.is_server()
+    finally:
+        os.environ.pop("PADDLE_TRAINERS_NUM", None)
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_program_utils_roundtrip(tmp_path):
+    from paddle_tpu.incubate.fleet.utils import utils as U
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = layers.fc(x, 2)
+    fn = str(tmp_path / "prog.json")
+    U.save_program(main, fn, is_text=True)
+    prog2 = U.load_program(fn, is_text=True)
+    assert [op.type for b in prog2.blocks for op in b.ops] == \
+        [op.type for b in main.blocks for op in b.ops]
+    # text summary mentions ops and vars
+    text = U.parse_program(prog2)
+    assert "op mul" in text or "op fc" in text
+    # type conversion emits the sibling format
+    out_fn = U.program_type_trans(str(tmp_path), "prog.json", True)
+    assert os.path.exists(tmp_path / out_fn)
+    assert U.check_saved_vars_try_dump(str(tmp_path), "prog.json", True)
+    # pruned-program compatibility: the test program vs itself is clean
+    assert U.check_pruned_program_vars(main, main.clone(
+        for_test=True)) == []
+    dot = U.graphviz(main.global_block(), str(tmp_path))
+    assert os.path.exists(dot)
+    assert "digraph" in open(dot).read()
+
+
+def test_distributed_adam_factory():
+    from paddle_tpu.incubate.fleet.parameter_server.pslib \
+        .optimizer_factory import DistributedAdam
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 1), y))
+        da = DistributedAdam(fluid.optimizer.Adam(1e-3))
+        da.minimize(loss)
+    exe = fluid.Executor()
+    X = np.random.randn(8, 4).astype(np.float32)
+    Y = X.sum(1, keepdims=True).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        l0, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert np.isfinite(np.asarray(l0)).all()
+
+
+def test_fleet_base_abstract_contract():
+    from paddle_tpu.incubate.fleet.base.fleet_base import Fleet
+    with pytest.raises(TypeError):
+        Fleet()  # abstract
+
+    class Mini(Fleet):
+        def init_worker(self): pass
+        def init_server(self, *a, **k): pass
+        def run_server(self): pass
+        def stop_worker(self): pass
+        def distributed_optimizer(self, optimizer, strategy=None):
+            return optimizer
+
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker)
+    m = Mini()
+    m._role_maker = UserDefinedRoleMaker(current_id=0, worker_num=2)
+    assert m.is_worker() and m.worker_num() == 2
+    # the concrete fleets satisfy the ABC contract (virtual subclasses)
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        fleet as ps_fleet)
+    from paddle_tpu.incubate.fleet.collective import (
+        fleet as col_fleet)
+    from paddle_tpu.incubate.fleet.parameter_server.pslib import (
+        fleet as pslib_fleet)
+    assert isinstance(ps_fleet, Fleet)
+    assert isinstance(col_fleet, Fleet)
+    assert isinstance(pslib_fleet, Fleet)
+
+
+def test_geo_strategy_routes_to_geo_transpiler():
+    """A GeoStrategy must select GeoSgdTranspiler (unmodified local
+    program + delta sync), not the plain transpiler."""
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        ParameterServerFleet)
+    from paddle_tpu.incubate.fleet.parameter_server \
+        .distribute_transpiler import StrategyFactory
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        UserDefinedRoleMaker, Role)
+    from paddle_tpu.transpiler import GeoSgdTranspiler
+    f = ParameterServerFleet()
+    f.init(UserDefinedRoleMaker(
+        current_id=0, role=Role.WORKER, worker_num=1,
+        server_endpoints=["127.0.0.1:0"]))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 1), y))
+        opt = f.distributed_optimizer(
+            fluid.optimizer.SGD(0.1),
+            StrategyFactory.create_geo_strategy(25))
+        opt.minimize(loss, startup_program=startup)
+    assert isinstance(f._transpiler, GeoSgdTranspiler)
