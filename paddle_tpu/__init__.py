@@ -75,6 +75,12 @@ from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,
+    CheckpointSaver,
+)
+from . import resilience  # noqa: F401
+from .resilience import (  # noqa: F401
+    CheckpointCorruptError, EnforceNotMet, NonFiniteError,
+    RpcDeadlineError, WatchdogTimeout,
 )
 # paddle.reader-style decorator namespace + fluid.dataset module parity
 reader = dataio

@@ -2,3 +2,7 @@
 communicators (reference: paddle/fluid/operators/distributed/)."""
 from .ps import ParameterServer, PSClient  # noqa: F401
 from .communicator import GeoCommunicator  # noqa: F401
+from .wire import WireError, WireTruncationError  # noqa: F401
+from ..resilience import (  # noqa: F401
+    CircuitBreaker, CircuitOpenError, RpcDeadlineError, retry_call,
+)

@@ -78,6 +78,9 @@ class AsyncCommunicator:
         self._threading = threading
         self._stop = threading.Event()
         self._threads = []
+        # observability: grads that landed vs. grads dropped because the
+        # pserver stayed unreachable past its RPC deadline/breaker
+        self.stats = {"sent": 0, "dropped": 0}
         # one counter covers queued AND popped-but-unsent grads: a grad is
         # pending from push() until its send lands, so flush() can never
         # observe "empty queues + nothing inflight" while a popped grad is
@@ -131,9 +134,24 @@ class AsyncCommunicator:
                     # MergeVars: average the pending grads into one send
                     grad = np.mean(np.stack(merged), axis=0)
                     cli.push_dense(ep, name, grad)
+                    # one send_loop thread per var: counter updates need
+                    # the lock or concurrent += interleaves lose counts
+                    with self._pending_cv:
+                        self.stats["sent"] += len(merged)
+                except ConnectionError as exc:
+                    # PSClient already retried under the rpc_deadline and
+                    # tripped the endpoint's breaker; the merged grads
+                    # are dropped (async SGD tolerates lost updates), the
+                    # channel lives to try the next batch
+                    with self._pending_cv:
+                        self.stats["dropped"] += len(merged)
+                    print(f"[communicator] dropping {len(merged)} grad(s) "
+                          f"for {name!r}: {exc}")
                 except Exception:
-                    # a transient RPC failure must not kill the channel:
+                    # a non-transport failure must not kill the channel:
                     # the popped grads are lost (logged), the loop lives
+                    with self._pending_cv:
+                        self.stats["dropped"] += len(merged)
                     import traceback
                     traceback.print_exc()
                 finally:

@@ -21,9 +21,12 @@ op registry the compiled trainer uses (no second optimizer implementation).
 """
 import socket
 import threading
+import time
 
 import numpy as np
 
+from ..flags import flag as _flag
+from ..resilience import CircuitBreaker, RpcDeadlineError, retry_call
 from .wire import WireError, default_key, recv_frame, send_frame
 
 
@@ -120,6 +123,9 @@ class ParameterServer:
         self.lr_map = {}          # param name -> {lr var name: value}
         self.sparse_lr = {}       # sparse table name -> lr
         self._grad_acc = {}       # param -> [grads]
+        # client push uid -> (deque of recent seqs, set) so a push whose
+        # reply was lost is NOT double-applied when the client retries it
+        self._applied_pushes = {}
         self._allreduce_acc = {}  # name -> {round, acc, results} state
         self._round = 0
         self._barrier_count = 0
@@ -351,6 +357,33 @@ class ParameterServer:
                 print(f"[pserver] heartbeat: trainer {tid} re-admitted; "
                       f"barrier now needs {self.trainers}")
 
+    def _push_replayed(self, uid, seq):
+        """At-least-once pushes, exactly-once application: the client tags
+        each logical push with (uid, seq); a retry re-sends the same tag,
+        so a tag already applied is acknowledged without re-applying.
+        Bounded memory — only recent seqs are remembered, which is enough
+        because a retry follows its original within one rpc_deadline."""
+        from collections import deque
+        with self._cv:
+            rec = self._applied_pushes.pop(uid, None)
+            if rec is None:
+                rec = (deque(maxlen=256), set())
+                # every restarted trainer brings a fresh uid: cap the
+                # table, evicting the least recently active client (dict
+                # insertion order + pop/reinsert above = LRU)
+                while len(self._applied_pushes) >= 1024:
+                    self._applied_pushes.pop(
+                        next(iter(self._applied_pushes)))
+            self._applied_pushes[uid] = rec
+            recent, seen = rec
+            if seq in seen:
+                return True
+            if len(recent) == recent.maxlen:
+                seen.discard(recent[0])
+            recent.append(seq)
+            seen.add(seq)
+            return False
+
     def _accept_loop(self):
         while not self._stop.is_set():
             try:
@@ -424,6 +457,8 @@ class ParameterServer:
         if kind == "push_dense":
             _, name, grad, *rest = msg
             self._stamp(rest[0] if rest else None)
+            if len(rest) >= 3 and self._push_replayed(rest[1], rest[2]):
+                return ("ok",)    # retry of an already-applied push
             if self.sync_mode:
                 with self._cv:
                     self._grad_acc.setdefault(name, []).append(
@@ -576,12 +611,34 @@ class ParameterServer:
 # --------------------------------------------------------------------------
 
 class PSClient:
+    """RPC client with reference-grade hardening (grpc_client.cc
+    deadline/retry semantics): every call runs under the FLAGS_rpc_deadline
+    wall clock with per-IO socket timeouts, transport failures retry with
+    exponential backoff + jitter (FLAGS_rpc_retry_times /
+    FLAGS_rpc_retry_base_backoff), and a per-endpoint circuit breaker
+    (FLAGS_rpc_circuit_break_failures / FLAGS_rpc_circuit_reset_secs)
+    fails fast on a dead pserver instead of hanging every caller for a
+    full deadline each. Dense pushes are at-least-once on the wire but
+    exactly-once applied: each carries a (uid, seq) tag the server dedups
+    replays on, so a retry after a lost reply cannot double-apply a
+    gradient. Counted/accumulating calls (barriers, allreduce, sparse and
+    GEO pushes) stay retries=0."""
+
     _instances = {}
     _lock = threading.Lock()
 
     def __init__(self, auth_key=None):
+        import itertools
+        import uuid
         self._conns = {}
         self._conn_lock = threading.Lock()
+        self._ep_locks = {}
+        self._breakers = {}
+        # dense-push replay tag: uid identifies this client process to the
+        # server's dedup table, seq numbers each logical push (next() on
+        # count() is atomic under the GIL)
+        self._push_uid = uuid.uuid4().hex
+        self._push_seq = itertools.count(1)
         if isinstance(auth_key, str):
             auth_key = auth_key.encode()
         self._key = auth_key or default_key()
@@ -610,59 +667,151 @@ class PSClient:
                         stacklevel=2)
             return cls._instances[key]
 
-    def _conn(self, endpoint):
+    def _conn(self, endpoint, timeout=None):
+        # caller holds this endpoint's _ep_lock, so per-endpoint connect
+        # is already serialized; _conn_lock only guards the dict
         with self._conn_lock:
             sock = self._conns.get(endpoint)
-            if sock is None:
-                host, port = endpoint.rsplit(":", 1)
-                sock = socket.create_connection((host, int(port)),
-                                                timeout=120.0)
+        if sock is None:
+            host, port = endpoint.rsplit(":", 1)
+            sock = socket.create_connection(
+                (host, int(port)),
+                timeout=min(timeout, 10.0) if timeout else 10.0)
+            with self._conn_lock:
                 self._conns[endpoint] = sock
-            return sock
+        return sock
 
-    def _call(self, endpoint, msg):
-        sock = self._conn(endpoint)
+    def _drop_conn(self, endpoint):
         with self._conn_lock:
-            send_frame(sock, msg, self._key)
-            reply = recv_frame(sock, self._key)
+            sock = self._conns.pop(endpoint, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _ep_lock(self, endpoint):
+        """Per-endpoint IO lock: one stalled pserver must not serialize
+        (or deadline-block) RPCs to every healthy endpoint."""
+        with self._conn_lock:
+            lk = self._ep_locks.get(endpoint)
+            if lk is None:
+                lk = self._ep_locks[endpoint] = threading.Lock()
+            return lk
+
+    def _breaker(self, endpoint):
+        with self._conn_lock:
+            br = self._breakers.get(endpoint)
+            if br is None:
+                br = CircuitBreaker(
+                    endpoint,
+                    failure_threshold=_flag("rpc_circuit_break_failures"),
+                    reset_timeout=_flag("rpc_circuit_reset_secs"))
+                self._breakers[endpoint] = br
+            return br
+
+    def _call(self, endpoint, msg, deadline=None, retries=None):
+        """One RPC under deadline/retry/breaker discipline. ``retries``
+        bounds re-sends of the SAME message — non-idempotent calls
+        (send_barrier: the server counts arrivals) pass retries=0 so a
+        lost reply cannot double-count."""
+        if deadline is None:
+            deadline = _flag("rpc_deadline")
+        if retries is None:
+            retries = _flag("rpc_retry_times")
+        breaker = self._breaker(endpoint)
+        start = time.monotonic()
+
+        def attempt():
+            breaker.before_call()
+            try:
+                with self._ep_lock(endpoint):
+                    # budget computed AFTER acquiring the lock: time spent
+                    # queued behind a stalled call must charge against
+                    # this call's deadline, not extend it
+                    remaining = None if deadline is None else \
+                        max(0.1, deadline - (time.monotonic() - start))
+                    try:
+                        sock = self._conn(endpoint, timeout=remaining)
+                        send_frame(sock, msg, self._key, timeout=remaining)
+                        return_reply = recv_frame(sock, self._key,
+                                                  timeout=remaining)
+                    except (ConnectionError, OSError, WireError):
+                        # drop the dead socket while still HOLDING the
+                        # endpoint lock: a thread queued behind us must
+                        # reconnect, not re-fail on the stale fd and
+                        # count the same blip against the breaker twice
+                        self._drop_conn(endpoint)
+                        raise
+            except (ConnectionError, OSError, WireError):
+                # only transport failures feed the breaker — an encode
+                # TypeError or a KeyboardInterrupt says nothing about the
+                # endpoint's health and must not open its circuit...
+                breaker.record_failure()
+                raise
+            except BaseException:
+                # ...but a non-transport failure must also not leak the
+                # half-open probe slot it was admitted on
+                breaker.release_probe()
+                raise
+            breaker.record_success()
+            return return_reply
+
+        reply = retry_call(
+            attempt, deadline=deadline, retries=retries,
+            base_backoff=_flag("rpc_retry_base_backoff"),
+            retry_on=(ConnectionError, OSError),
+            what=f"rpc {msg[0]!r}", endpoint=endpoint)
         if reply[0] == "err":
             raise RuntimeError(f"pserver {endpoint}: {reply[1]}")
         return reply[1] if reply[0] == "val" else None
 
     # public API used by the distributed ops
     def push_dense(self, endpoint, name, grad, trainer_id=None):
+        # retried (unlike the other pushes): the (uid, seq) tag lets the
+        # server drop a replay whose original was applied but whose reply
+        # was lost, so at-least-once delivery stays exactly-once applied
         self._call(endpoint,
-                   ("push_dense", name, np.asarray(grad), trainer_id))
+                   ("push_dense", name, np.asarray(grad), trainer_id,
+                    self._push_uid, next(self._push_seq)))
 
     def send_barrier(self, endpoints, trainer_id=None):
+        # never retried: the server counts arrivals, so re-sending a
+        # barrier whose reply was lost would double-count this trainer
         for ep in dict.fromkeys(endpoints):
-            self._call(ep, ("send_barrier", trainer_id))
+            self._call(ep, ("send_barrier", trainer_id), retries=0)
 
     def pull_dense(self, endpoint, name):
         return self._call(endpoint, ("pull_dense", name))
 
     def allreduce(self, endpoint, name, value, nranks):
+        # contributes to a counted round — same no-retry rule as barriers
         return self._call(endpoint, ("allreduce", name,
-                                     np.asarray(value), int(nranks)))
+                                     np.asarray(value), int(nranks)),
+                          retries=0)
 
     def push_delta(self, endpoint, name, delta):
-        return self._call(endpoint, ("push_delta", name, np.asarray(delta)))
+        # delta ADDS into the global table: a replay would double-apply
+        return self._call(endpoint, ("push_delta", name,
+                                     np.asarray(delta)), retries=0)
 
     def pull_sparse(self, endpoint, name, ids):
         return self._call(endpoint, ("pull_sparse", name, np.asarray(ids)))
 
     def push_sparse(self, endpoint, name, ids, rows):
+        # row-wise SGD applies on arrival: no replay on lost replies
         self._call(endpoint, ("push_sparse", name, np.asarray(ids),
-                              np.asarray(rows)))
+                              np.asarray(rows)), retries=0)
 
     def dp_pull(self, endpoint, table_id, ids):
         return self._call(endpoint, ("dp_pull", int(table_id),
                                      np.asarray(ids)))
 
     def dp_push(self, endpoint, table_id, ids, grads, shows, clicks):
+        # applies grads + show/click stats on arrival: no replay
         self._call(endpoint, ("dp_push", int(table_id), np.asarray(ids),
                               np.asarray(grads), np.asarray(shows),
-                              np.asarray(clicks)))
+                              np.asarray(clicks)), retries=0)
 
     def dp_stat(self, endpoint, table_id):
         return self._call(endpoint, ("dp_stat", int(table_id)))
@@ -677,10 +826,14 @@ class PSClient:
         for ep in dict.fromkeys(endpoints):
             self._call(ep, ("load_persistables", dirname))
 
+    def breaker_state(self, endpoint):
+        """Observability hook: 'closed' | 'open' | 'half-open'."""
+        return self._breaker(endpoint).state
+
     def stop_servers(self, endpoints):
         for ep in dict.fromkeys(endpoints):
             try:
-                self._call(ep, ("stop",))
+                self._call(ep, ("stop",), deadline=5.0, retries=0)
             except (ConnectionError, OSError, RuntimeError):
                 pass
 

@@ -41,6 +41,8 @@ import struct
 
 import numpy as np
 
+from ..resilience import maybe_fail
+
 MAGIC = b"PT01"
 MAC_LEN = 32
 # hard cap on a single frame: a hostile length prefix must not make the
@@ -52,6 +54,31 @@ _ALLOWED_KINDS = frozenset("biufc")   # bool/int/uint/float/complex
 
 class WireError(ValueError):
     pass
+
+
+class WireTruncationError(WireError, ConnectionError):
+    """The peer closed mid-frame. Doubles as ConnectionError so
+    transport-level handlers (server accept loop, client retry) treat it
+    as a broken link, while WireError handlers still see a protocol
+    fault. Carries ``endpoint``, ``expected`` and ``received`` byte
+    counts so a flaky pserver link is diagnosable from the message."""
+
+    def __init__(self, endpoint=None, expected=None, received=None,
+                 context="frame"):
+        self.endpoint = endpoint
+        self.expected = expected
+        self.received = received
+        super().__init__(
+            f"connection to {endpoint or 'peer'} closed mid-{context}: "
+            f"expected {expected} bytes, received {received}")
+
+
+def _peer(sock):
+    try:
+        host, port = sock.getpeername()[:2]
+        return f"{host}:{port}"
+    except OSError:
+        return None
 
 
 def default_key():
@@ -219,32 +246,42 @@ def decode(buf):
 
 # ------------------------------------------------------------------ frame
 
-def _recv_exact(sock, n):
+def _recv_exact(sock, n, context="frame"):
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("peer closed")
+            raise WireTruncationError(endpoint=_peer(sock), expected=n,
+                                      received=len(buf), context=context)
         buf += chunk
     return buf
 
 
-def send_frame(sock, obj, key=None):
+def send_frame(sock, obj, key=None, timeout=None):
+    """``timeout`` (seconds) bounds every blocking send on this call; the
+    socket keeps it afterwards (per-call deadline management lives in
+    PSClient)."""
+    maybe_fail("wire.send_frame", endpoint=_peer(sock))
+    if timeout is not None:
+        sock.settimeout(timeout)
     payload = encode(obj)
     mac = hmac.new(key, payload, hashlib.sha256).digest() if key \
         else b"\x00" * MAC_LEN
     sock.sendall(MAGIC + mac + struct.pack(">Q", len(payload)) + payload)
 
 
-def recv_frame(sock, key=None):
-    head = _recv_exact(sock, len(MAGIC) + MAC_LEN + 8)
+def recv_frame(sock, key=None, timeout=None):
+    maybe_fail("wire.recv_frame", endpoint=_peer(sock))
+    if timeout is not None:
+        sock.settimeout(timeout)
+    head = _recv_exact(sock, len(MAGIC) + MAC_LEN + 8, context="header")
     if head[:len(MAGIC)] != MAGIC:
         raise WireError("bad magic — not a paddle_tpu PS frame")
     mac = head[len(MAGIC):len(MAGIC) + MAC_LEN]
     (n,) = struct.unpack(">Q", head[len(MAGIC) + MAC_LEN:])
     if n > MAX_FRAME:
         raise WireError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
-    payload = _recv_exact(sock, n)
+    payload = _recv_exact(sock, n, context="payload")
     if key is not None:
         want = hmac.new(key, payload, hashlib.sha256).digest()
         if not hmac.compare_digest(mac, want):

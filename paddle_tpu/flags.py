@@ -18,7 +18,18 @@ import os
 
 _DEFS = {
     # name: (default, type, applies)
-    "check_nan_inf": (False, bool, "jax_debug_nans"),
+    # checked host-side by Executor.run so the error names the offending
+    # variable (reference nan_inf_utils_detail.cc), not via jax_debug_nans
+    # (which reports an anonymous FloatingPointError mid-jit)
+    "check_nan_inf": (False, bool, None),
+    # -- RPC hardening (reference FLAGS_rpc_deadline ms / rpc_retry_times;
+    # here the deadline is SECONDS and must exceed the pserver's 120s
+    # sync-barrier wait so a slow-but-live barrier isn't killed) --
+    "rpc_deadline": (150.0, float, None),
+    "rpc_retry_times": (3, int, None),
+    "rpc_retry_base_backoff": (0.05, float, None),
+    "rpc_circuit_break_failures": (3, int, None),
+    "rpc_circuit_reset_secs": (5.0, float, None),
     "cudnn_deterministic": (False, bool, None),
     "cpu_deterministic": (False, bool, None),
     "benchmark": (False, bool, None),
@@ -86,6 +97,11 @@ def set_flags(flags_dict):
             raise ValueError(f"unknown flag {n!r}")
         _values[key] = _coerce(v, _DEFS[key][1])
         _apply(key, _values[key])
+
+
+def flag(name):
+    """Fast single-flag getter for hot paths (Executor.run, PSClient)."""
+    return _values[name]
 
 
 def globals_():

@@ -16,6 +16,9 @@ import numpy as np
 
 from . import unique_name
 from .dtype import convert_dtype
+# fluid.core parity home for the enforcement-failure type (reference
+# platform/enforce.h; raised e.g. by the FLAGS_check_nan_inf guard)
+from ..resilience import EnforceNotMet, NonFiniteError  # noqa: F401
 
 # Op role attribute, mirroring the reference's OpRole
 # (/root/reference/paddle/fluid/framework/op_proto_maker.h) so program

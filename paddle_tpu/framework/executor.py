@@ -16,8 +16,44 @@ import jax.numpy as jnp
 from .core import Program, Variable, default_main_program
 from .dtype import np_dtype
 from .lowering import analyze_block_io, build_block_fn
+from ..flags import flag as _flag
+from ..resilience import NonFiniteError
 
 RNG_STATE_NAME = "@RNG_KEY@"
+
+
+def _nonfinite_count(value):
+    """Count nan/inf elements host-side. Integer/bool tensors are always
+    finite; non-native floats (bfloat16 & friends) go through float32."""
+    arr = np.asarray(value)
+    kind = arr.dtype.kind
+    if kind in "iub" or arr.size == 0:
+        return 0
+    if kind not in "fc":
+        try:
+            arr = arr.astype(np.float32)
+        except (TypeError, ValueError):
+            return 0
+    return int((~np.isfinite(arr)).sum())
+
+
+def _scan_nonfinite(fetch_names, fetches, new_state):
+    """FLAGS_check_nan_inf scan (reference
+    framework/details/nan_inf_utils_detail.cc checks every op output; one
+    compiled XLA module has no per-op boundary, so the observable surface
+    is fetched outputs + updated state). Returns (kind, name, count) for
+    the first offender or None."""
+    for name, val in zip(fetch_names, fetches):
+        n = _nonfinite_count(val)
+        if n:
+            return "fetched output", name, n
+    for name, val in new_state.items():
+        if name == RNG_STATE_NAME:
+            continue
+        n = _nonfinite_count(val)
+        if n:
+            return "updated variable", name, n
+    return None
 
 
 class Scope:
@@ -144,7 +180,15 @@ class Executor:
 
     # -- main entry ------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True,
+            check_nan_inf=None, skip_nonfinite_steps=False):
+        """``check_nan_inf`` (default: FLAGS_check_nan_inf) scans fetched
+        outputs and updated variables for nan/inf after the step and
+        raises NonFiniteError (an EnforceNotMet) naming the first
+        offender. ``skip_nonfinite_steps`` instead ROLLS BACK the step —
+        scope state and RNG are restored to their pre-step values and the
+        (non-finite) fetches are returned, so one bad batch cannot poison
+        the parameters (the trainer loop moves on to the next batch)."""
         from ..parallel.compiler import CompiledProgram
         mesh = None
         if isinstance(program, CompiledProgram):
@@ -213,6 +257,14 @@ class Executor:
                     for n, a in st.items():
                         scope.set(n, a)
 
+        if check_nan_inf is None:
+            check_nan_inf = _flag("check_nan_inf")
+        backup = None
+        if skip_nonfinite_steps:
+            # the jit donates state_mut buffers, so rollback needs host
+            # copies taken BEFORE the step (the price of the opt-in)
+            backup = {n: np.asarray(v) for n, v in state_mut.items()}
+
         from .. import profiler as _prof
         if _prof.is_profiling():
             with _prof.record_event(f"run/program_{program._uid}"):
@@ -222,9 +274,37 @@ class Executor:
         else:
             fetches, new_state, new_key = jitted(state_mut, state_ro,
                                                  feed_arrays, base_key)
+
+        bad = None
+        if check_nan_inf or skip_nonfinite_steps:
+            bad = _scan_nonfinite(fetch_names, fetches, new_state)
+        if bad is not None and skip_nonfinite_steps:
+            # roll the step back: pre-step params/accumulators and RNG go
+            # back into the scope, nothing is committed
+            kind, name, count = bad
+            for n, a in backup.items():
+                scope.set(n, a)
+            scope.set(RNG_STATE_NAME, base_key)
+            print(f"[executor] skip_nonfinite_steps: {kind} {name!r} has "
+                  f"{count} non-finite value(s) — step rolled back")
+            if return_numpy:
+                return [np.asarray(f) for f in fetches]
+            return fetches
+
+        # commit even when about to raise: state_mut buffers were donated
+        # to the jit, so the scope must reference the step's outputs (the
+        # error is a diagnostic about the step, not a rollback)
         for n, v in new_state.items():
             scope.set(n, v)
         scope.set(RNG_STATE_NAME, new_key)
+        if bad is not None:
+            kind, name, count = bad
+            raise NonFiniteError(
+                f"Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
+                f"{kind} {name!r} has {count} non-finite value(s) in "
+                f"program_{program._uid}. Feed data, learning rate, or "
+                f"loss scaling are the usual suspects.",
+                var_name=name, count=count)
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]
@@ -270,7 +350,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, skip_nonfinite_steps=False):
         assert dataset is not None, "train_from_dataset needs a dataset"
         fetch_names = self._fetch_names(fetch_list)
         fetch_info = fetch_info or fetch_names
@@ -283,7 +363,8 @@ class Executor:
         try:
             for step, feed in enumerate(dataset.batch_iterator()):
                 out = self.run(program, feed=feed,
-                               fetch_list=fetch_list, scope=scope)
+                               fetch_list=fetch_list, scope=scope,
+                               skip_nonfinite_steps=skip_nonfinite_steps)
                 last = out
                 if fetch_names and print_period and \
                         step % print_period == 0:

@@ -122,43 +122,70 @@ class Collective:
     # :166 save_checkpoint/load_checkpoint with TrainStatus; recovery =
     # reload the newest checkpoint, §5.3 of the reference's failure
     # model) --------------------------------------------------------------
+    _KEEP_UNSET = object()
+
+    def _saver(self, path, max_to_keep=_KEEP_UNSET):
+        from .... import io
+        # one saver per path: repeated async saves share the number
+        # reservation (no staging collisions) and checkpoint_wait() joins
+        # every pending write, not just the newest saver's. Only a save
+        # (which passes max_to_keep) may change retention policy — a
+        # load_checkpoint must not reset it under a pending async save.
+        savers = getattr(self, "_savers", None)
+        if savers is None:
+            savers = self._savers = {}
+        saver = savers.get(path)
+        if saver is None:
+            keep = None if max_to_keep is self._KEEP_UNSET else max_to_keep
+            saver = savers[path] = io.CheckpointSaver(
+                path, max_to_keep=keep,
+                prefix="__paddle_checkpoint__")
+        elif max_to_keep is not self._KEEP_UNSET:
+            saver.max_to_keep = (None if max_to_keep is None
+                                 else int(max_to_keep))
+        return saver
+
     def save_checkpoint(self, executor, path, train_status,
                         main_program=None, fs=None, local_cache_path=None,
-                        remain_all_checkpoint=True):
-        import json
-        import os
-        from .... import io
-        nums = [int(d.split("_")[-1]) for d in os.listdir(path)
-                if d.startswith("__paddle_checkpoint__")] \
-            if os.path.isdir(path) else []
-        no = (max(nums) + 1) if nums else 0
-        ckpt = os.path.join(path, f"__paddle_checkpoint__{no}")
-        os.makedirs(ckpt, exist_ok=True)
-        io.save_persistables(executor, ckpt,
-                             main_program or self._origin_program)
-        with open(os.path.join(ckpt, "train_status.json"), "w") as f:
-            json.dump({"epoch_no": train_status._epoch_no}, f)
+                        remain_all_checkpoint=True, max_to_keep=_KEEP_UNSET,
+                        async_save=False):
+        """Numbered atomic checkpoint (io.CheckpointSaver: staged
+        directory + manifest + atomic rename, so a preempted worker never
+        leaves a half-written checkpoint that load_checkpoint would
+        trust). ``async_save`` snapshots synchronously and writes on a
+        background thread — call ``checkpoint_wait()`` before exiting.
+        ``max_to_keep`` prunes old checkpoints (``remain_all_checkpoint=
+        False`` is the legacy spelling of ``max_to_keep=1``); omitting it
+        keeps the path's current retention policy (initially: keep
+        all)."""
         if not remain_all_checkpoint:
-            import shutil
-            for n in nums:
-                shutil.rmtree(os.path.join(
-                    path, f"__paddle_checkpoint__{n}"), ignore_errors=True)
-        return no
+            max_to_keep = 1
+        saver = self._saver(path, max_to_keep=max_to_keep)
+        extra = {"train_status.json":
+                 {"epoch_no": train_status._epoch_no}}
+        kwargs = dict(main_program=main_program or self._origin_program,
+                      extra_files=extra)
+        if async_save:
+            return saver.save_async(executor, **kwargs)
+        return saver.save(executor, **kwargs)
+
+    def checkpoint_wait(self):
+        """Join pending async checkpoint writes (re-raises failures)."""
+        for saver in getattr(self, "_savers", {}).values():
+            saver.wait()
 
     def load_checkpoint(self, executor, path, trainer_id=0,
                         main_program=None, fs=None, local_cache_path=None,
                         ignore_empty=True):
         import json
         import os
-        from .... import io
-        nums = [int(d.split("_")[-1]) for d in os.listdir(path)
-                if d.startswith("__paddle_checkpoint__")] \
-            if os.path.isdir(path) else []
-        if not nums:
+        saver = self._saver(path)
+        no, ckpt = saver.latest()
+        if no is None:
             if ignore_empty:
                 return TrainStatus(-1)
             raise RuntimeError(f"no checkpoint under {path}")
-        ckpt = os.path.join(path, f"__paddle_checkpoint__{max(nums)}")
+        from .... import io
         io.load_persistables(executor, ckpt,
                              main_program or self._origin_program)
         with open(os.path.join(ckpt, "train_status.json")) as f:

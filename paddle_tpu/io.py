@@ -13,18 +13,193 @@ save and re-placed per their Variable ``dist_attr`` on the next mesh run
 one ``.npy`` per var (or one ``.npz`` when ``filename`` is given) plus a
 ``__meta__.json`` carrying exact dtypes (bfloat16 round-trips as raw bytes)
 and the RNG key so a resumed run continues the same random stream.
+
+Checkpoint integrity (reference lineage: TF's atomic checkpoint rename +
+Fluid's checkpoint-notify): every array file is written to a temp path,
+fsynced, and atomically renamed; a ``_manifest.json`` with per-file sha256
+and per-var dtype/shape is committed LAST, so its presence marks a
+complete checkpoint. Loads verify hashes against the manifest and raise
+CheckpointCorruptError naming the bad file instead of silently restoring
+garbage. ``CheckpointSaver`` adds numbered checkpoints with retention
+pruning and a background-thread async save mode.
 """
+import hashlib
 import json
 import os
+import threading
 
 import numpy as np
 
 from .framework.core import Program, Variable, Parameter
 from .framework.executor import global_scope, RNG_STATE_NAME
 from .framework.dtype import np_dtype
+from .resilience import CheckpointCorruptError
+from .resilience import maybe_fail as _maybe_fail
 
 _META_FILE = "__meta__.json"
 _MODEL_FILE = "__model__"
+_MANIFEST_FILE = "_manifest.json"
+
+
+# ---------------------------------------------------------------------------
+# durable writes + manifest integrity
+# ---------------------------------------------------------------------------
+
+class _Sha256Writer:
+    """File-object proxy that sha256s bytes in-flight, so the manifest
+    does not have to re-read a multi-GB checkpoint it just wrote. A
+    writer that seeks (zipfile rewriting headers in np.savez) makes the
+    stream hash diverge from the final file; hexdigest() then returns
+    None and the manifest falls back to hashing from disk."""
+
+    def __init__(self, f):
+        self._f = f
+        self._h = hashlib.sha256()
+        self._linear = True
+
+    def write(self, b):
+        self._h.update(b)
+        return self._f.write(b)
+
+    def seek(self, *args, **kwargs):
+        self._linear = False
+        return self._f.seek(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+    def hexdigest(self):
+        return self._h.hexdigest() if self._linear else None
+
+
+def _fsync_write(path, write_fn):
+    """Crash-safe file write: temp path -> write -> flush+fsync -> atomic
+    rename. A crash at any point leaves either the old file or no file,
+    never a torn one. Returns the content sha256 (None if write_fn
+    seeked, making the stream hash unreliable)."""
+    _maybe_fail("io.fsync_write", path=path)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        w = _Sha256Writer(f)
+        write_fn(w)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return w.hexdigest()
+
+
+def _fsync_dir(dirname):
+    """Make the renames themselves durable (POSIX: directory entry
+    updates need a directory fsync)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _write_manifest(dirname, files, meta, preserve_existing=False,
+                    digests=None):
+    """Commit record: per-file sha256+size and per-var dtype/shape.
+    Written last — a checkpoint without a manifest is incomplete (or
+    predates manifests; loads then skip verification).
+    ``preserve_existing`` keeps prior entries for OTHER files still on
+    disk (several `save(program, path)` models can share one dir).
+    ``digests`` carries sha256s computed while the files were written;
+    files without one are (re-)read from disk."""
+    kept = {}
+    if preserve_existing:
+        try:
+            prev = _read_manifest(dirname) or {}
+        except CheckpointCorruptError:
+            prev = {}
+        kept = {rel: entry for rel, entry in prev.get("files", {}).items()
+                if rel not in files
+                and os.path.exists(os.path.join(dirname, rel))}
+
+    def _sha(rel):
+        return (digests or {}).get(rel) or \
+            _sha256_file(os.path.join(dirname, rel))
+
+    manifest = {
+        "version": 1,
+        "files": {**kept,
+                  **{rel: {"sha256": _sha(rel),
+                           "bytes":
+                           os.path.getsize(os.path.join(dirname, rel))}
+                     for rel in files}},
+        "vars": meta.get("vars", {}),
+        "extra": meta.get("extra", {}),
+    }
+    _fsync_write(os.path.join(dirname, _MANIFEST_FILE),
+                 lambda f: f.write(json.dumps(manifest, indent=1).encode()))
+    _fsync_dir(dirname)
+
+
+def _read_manifest(dirname):
+    path = os.path.join(dirname, _MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint manifest {path!r} is unreadable: {e}", path=path)
+
+
+def _verify_against_manifest(dirname, rel, manifest):
+    """Hash-check one file the load is about to trust. Unknown files
+    (not in the manifest) pass — the manifest guards what it recorded."""
+    entry = (manifest or {}).get("files", {}).get(rel)
+    if entry is None:
+        return
+    path = os.path.join(dirname, rel)
+    _maybe_fail("io.verify", path=path)
+    if not os.path.exists(path):
+        raise CheckpointCorruptError(
+            f"checkpoint file {rel!r} is listed in the manifest but "
+            f"missing from {dirname!r}", path=path)
+    size = os.path.getsize(path)
+    if size != entry.get("bytes", size):
+        raise CheckpointCorruptError(
+            f"checkpoint file {rel!r} in {dirname!r} is "
+            f"{size} bytes, manifest says {entry['bytes']} — truncated "
+            f"or partially written", path=path)
+    digest = _sha256_file(path)
+    if digest != entry["sha256"]:
+        raise CheckpointCorruptError(
+            f"checkpoint file {rel!r} in {dirname!r} fails its integrity "
+            f"check (sha256 {digest[:12]}… != manifest "
+            f"{entry['sha256'][:12]}…) — the checkpoint is corrupt",
+            path=path)
+
+
+def verify_checkpoint(dirname):
+    """Hash-check every manifest-listed file under ``dirname``. Returns
+    the manifest dict, or None when the directory predates manifests."""
+    manifest = _read_manifest(dirname)
+    if manifest is None:
+        return None
+    for rel in manifest.get("files", {}):
+        _verify_against_manifest(dirname, rel, manifest)
+    return manifest
 
 
 def _escape(name):
@@ -114,9 +289,29 @@ def is_parameter(var):
 # save/load vars (reference io.py:161 save_vars / :661 load_vars)
 # ---------------------------------------------------------------------------
 
+def _write_array_dir(dirname, arrays, meta, manifest_extra=None):
+    """One array per .npy + meta + manifest — the single writer both
+    save_vars and CheckpointSaver's async path go through, so a format
+    change cannot drift between sync and async checkpoints.
+    ``manifest_extra`` lists already-written sibling files (e.g. the
+    inference ``__model__``) to record in the manifest too."""
+    digests = {}
+    for name, arr in arrays.items():
+        rel = _escape(name) + ".npy"
+        digests[rel] = _fsync_write(
+            os.path.join(dirname, rel),
+            lambda f, _a=arr: np.save(f, _a, allow_pickle=False))
+    digests[_META_FILE] = _fsync_write(
+        os.path.join(dirname, _META_FILE),
+        lambda f: f.write(json.dumps(meta, indent=1).encode()))
+    _write_manifest(dirname,
+                    list(digests) + list(manifest_extra or ()), meta,
+                    digests=digests)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None, scope=None,
-              extra_state=None):
+              extra_state=None, _manifest_extra=None):
     """Write the current scope values of the selected vars under `dirname`.
 
     executor is accepted for API parity; persistence itself is host-side.
@@ -126,14 +321,23 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     os.makedirs(dirname, exist_ok=True)
     arrays, meta = _collect_arrays(scope, var_list, extra_state)
     if filename is None:
-        for name, arr in arrays.items():
-            np.save(os.path.join(dirname, _escape(name) + ".npy"), arr,
-                    allow_pickle=False)
-    else:
-        np.savez(os.path.join(dirname, filename),
-                 **{_escape(n): a for n, a in arrays.items()})
-    with open(os.path.join(dirname, _META_FILE), "w") as f:
-        json.dump(meta, f, indent=1)
+        _write_array_dir(dirname, arrays, meta,
+                         manifest_extra=_manifest_extra)
+        return
+    # writing through a file object keeps the name exact (np.savez
+    # appends ".npz" to bare string paths); the loader accepts both
+    digests = {
+        filename: _fsync_write(
+            os.path.join(dirname, filename),
+            lambda f: np.savez(
+                f, **{_escape(n): a for n, a in arrays.items()})),
+        _META_FILE: _fsync_write(
+            os.path.join(dirname, _META_FILE),
+            lambda f: f.write(json.dumps(meta, indent=1).encode())),
+    }
+    _write_manifest(dirname,
+                    [filename, _META_FILE] + list(_manifest_extra or ()),
+                    meta, digests=digests)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
@@ -142,38 +346,76 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     (e.g. the RNG key saved by save_persistables)."""
     scope = scope or global_scope()
     main_program, var_list = _resolve_vars(main_program, vars, predicate)
+    manifest = _read_manifest(dirname)
     meta_path = os.path.join(dirname, _META_FILE)
     meta = {"vars": {}, "extra": {}}
     if os.path.exists(meta_path):
+        if manifest is not None:
+            _verify_against_manifest(dirname, _META_FILE, manifest)
         with open(meta_path) as f:
             meta = json.load(f)
 
+    unreadable = {}                       # file -> reason
+
     if filename is not None:
         zpath = os.path.join(dirname, filename)
+        rel = filename
         if not zpath.endswith(".npz") and not os.path.exists(zpath):
-            zpath = zpath + ".npz"
+            zpath, rel = zpath + ".npz", filename + ".npz"
+        if manifest is not None:
+            _verify_against_manifest(dirname, rel, manifest)
         archive = np.load(zpath, allow_pickle=False)
+
         def _read(name):
             key = _escape(name)
             return archive[key] if key in archive.files else None
     else:
         def _read(name):
-            p = os.path.join(dirname, _escape(name) + ".npy")
-            return np.load(p, allow_pickle=False) if os.path.exists(p) \
-                else None
+            rel = _escape(name) + ".npy"
+            p = os.path.join(dirname, rel)
+            if not os.path.exists(p):
+                return None
+            if manifest is not None:
+                _verify_against_manifest(dirname, rel, manifest)
+            try:
+                return np.load(p, allow_pickle=False)
+            except (OSError, ValueError) as e:
+                unreadable[rel] = f"{type(e).__name__}: {e}"
+                return None
 
+    # validate the FULL restore before touching the scope: a partial
+    # restore that stops at the first missing file leaves a frankenstate
+    # of new+old params behind
+    staged, missing = {}, []
     for var in var_list:
         arr = _read(var.name)
         if arr is None:
-            raise RuntimeError(
-                f"no saved value for variable {var.name!r} in {dirname}")
+            missing.append(var.name)
+            continue
         tag = meta["vars"].get(var.name, {}).get("dtype", str(arr.dtype))
-        scope.set(var.name, _restore(arr, tag))
+        staged[var.name] = _restore(arr, tag)
+    # stage extras BEFORE the completeness check so a corrupt extra file
+    # (e.g. the RNG key) raises too; a merely absent extra is tolerated
+    # (legacy checkpoints) and simply stays out of the dict
     extras = {}
     for name, info in meta.get("extra", {}).items():
         arr = _read(name)
         if arr is not None:
             extras[name] = _restore(arr, info.get("dtype", str(arr.dtype)))
+    if missing or unreadable:
+        detail = []
+        if missing:
+            detail.append(f"{len(missing)} variable(s) have no saved "
+                          f"value: {', '.join(sorted(missing))}")
+        if unreadable:
+            detail.append("unreadable file(s): " + "; ".join(
+                f"{k} ({v})" for k, v in sorted(unreadable.items())))
+        raise RuntimeError(
+            f"checkpoint restore from {dirname!r} is incomplete — "
+            + " | ".join(detail)
+            + ". The scope was left untouched.")
+    for name, val in staged.items():
+        scope.set(name, val)
     return extras
 
 
@@ -242,13 +484,20 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_var_names": list(feeded_var_names),
         "fetch_var_names": target_names,
     }
-    model_path = os.path.join(dirname, model_filename or _MODEL_FILE)
-    with open(model_path, "w") as f:
-        json.dump(model, f)
-    if not program_only:
+    rel_model = model_filename or _MODEL_FILE
+    model_sha = _fsync_write(os.path.join(dirname, rel_model),
+                             lambda f: f.write(json.dumps(model).encode()))
+    if program_only:
+        # a program-only refresh next to previously saved params must not
+        # drop their integrity entries from the shared manifest
+        _write_manifest(dirname, [rel_model], {}, preserve_existing=True,
+                        digests={rel_model: model_sha})
+    else:
+        # the params save also records __model__ in the manifest, so a
+        # torn model file is caught by verification like any other file
         save_vars(executor, dirname, main_program=pruned,
                   predicate=is_persistable, filename=params_filename,
-                  scope=scope)
+                  scope=scope, _manifest_extra=[rel_model])
     return target_names
 
 
@@ -256,7 +505,11 @@ def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, scope=None):
     """Returns (program, feed_target_names, fetch_targets); params are
     loaded into the scope so `executor.run(program, ...)` works directly."""
-    model_path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    rel_model = model_filename or _MODEL_FILE
+    # hash-check the program file before trusting it: a torn __model__
+    # must surface as CheckpointCorruptError, not a JSONDecodeError
+    _verify_against_manifest(dirname, rel_model, _read_manifest(dirname))
+    model_path = os.path.join(dirname, rel_model)
     with open(model_path) as f:
         model = json.load(f)
     program = Program.from_dict(model["program"])
@@ -275,6 +528,10 @@ def load_inference_model(dirname, executor, model_filename=None,
 # modern single-file API (reference io.py:1566 save / :1624 load)
 # ---------------------------------------------------------------------------
 
+_PD_SUFFIXES = (".pdparams", ".pdparams.meta.json", ".pdopt",
+                ".pdopt.meta.json", ".pdmodel")
+
+
 def save(program, model_path, scope=None):
     """program params -> {model_path}.pdparams, other persistables ->
     {model_path}.pdopt, program IR -> {model_path}.pdmodel."""
@@ -282,26 +539,46 @@ def save(program, model_path, scope=None):
     base_dir = os.path.dirname(os.path.abspath(model_path)) or "."
     os.makedirs(base_dir, exist_ok=True)
 
+    base = os.path.basename(model_path)
+    digests = {}
+
     def _dump(vars_, path, extra=None):
         arrays, meta = _collect_arrays(scope, vars_, extra)
-        np.savez(path, **{_escape(n): a for n, a in arrays.items()})
-        if os.path.exists(path + ".npz"):  # np.savez appends .npz
-            os.replace(path + ".npz", path)
-        with open(path + ".meta.json", "w") as f:
-            json.dump(meta, f)
+        rel = os.path.basename(path)
+        # np.savez seeks (zip headers), so its stream hash comes back
+        # None and the manifest re-hashes that file from disk
+        digests[rel] = _fsync_write(path, lambda f: np.savez(
+            f, **{_escape(n): a for n, a in arrays.items()}))
+        digests[rel + ".meta.json"] = _fsync_write(
+            path + ".meta.json",
+            lambda f: f.write(json.dumps(meta).encode()))
 
     params = [v for v in program.list_vars() if is_parameter(v)]
     others = [v for v in program.list_vars()
               if is_persistable(v) and not is_parameter(v)]
     _dump(params, model_path + ".pdparams")
     _dump(others, model_path + ".pdopt", extra=_rng_extra(scope))
-    with open(model_path + ".pdmodel", "w") as f:
-        json.dump(program.to_dict(), f)
+    digests[base + ".pdmodel"] = _fsync_write(
+        model_path + ".pdmodel",
+        lambda f: f.write(json.dumps(program.to_dict()).encode()))
+    _write_manifest(base_dir, [base + sfx for sfx in _PD_SUFFIXES], {},
+                    preserve_existing=True, digests=digests)
 
 
 def load(program, model_path, executor=None, var_list=None, scope=None):
     """Restore {model_path}.pdparams/.pdopt into the scope for `program`."""
     scope = scope or global_scope()
+
+    # verify EVERY file against the manifest before any array touches the
+    # scope — corruption must raise CheckpointCorruptError up front, not
+    # a zipfile error halfway through a partial restore
+    base_dir = os.path.dirname(os.path.abspath(model_path)) or "."
+    base = os.path.basename(model_path)
+    manifest = _read_manifest(base_dir)
+    for sfx in _PD_SUFFIXES:
+        rel = base + sfx
+        if os.path.exists(os.path.join(base_dir, rel)):
+            _verify_against_manifest(base_dir, rel, manifest)
 
     def _slurp(path, vars_):
         if not os.path.exists(path):
@@ -342,3 +619,171 @@ def load(program, model_path, executor=None, var_list=None, scope=None):
     _slurp(model_path + ".pdparams", params)
     extras = _slurp(model_path + ".pdopt", others)
     _restore_rng(scope, extras)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointSaver: numbered checkpoints, retention pruning, async saves
+# ---------------------------------------------------------------------------
+
+class CheckpointSaver:
+    """Numbered training checkpoints with retention + async saves.
+
+    Each ``save`` writes ``<dirname>/<prefix><n>`` via save_persistables
+    (manifest-verified on load), committed by an atomic DIRECTORY rename
+    from a ``.tmp`` staging path — readers can never observe a partially
+    written checkpoint directory. ``max_to_keep`` prunes the oldest
+    checkpoints after each successful save (None keeps all).
+
+    ``save_async`` gathers the scope state synchronously (so the
+    snapshot is consistent even while training continues) and does the
+    hashing/fsync/rename on a background thread; ``wait()`` joins
+    pending saves and re-raises the first failure.
+    """
+
+    def __init__(self, dirname, max_to_keep=5,
+                 prefix="__paddle_checkpoint__"):
+        self.dirname = dirname
+        self.max_to_keep = None if max_to_keep is None else int(max_to_keep)
+        self.prefix = prefix
+        self._pending = []
+        self._errors = []
+        self._lock = threading.Lock()
+        # numbers handed out by _stage() whose save has not committed yet
+        # — two back-to-back save_async calls must not pick the same
+        # number and clobber each other's staging directory
+        self._reserved = set()
+
+    # -- numbering ---------------------------------------------------------
+    def checkpoint_numbers(self):
+        if not os.path.isdir(self.dirname):
+            return []
+        out = []
+        for d in os.listdir(self.dirname):
+            if not d.startswith(self.prefix) or d.endswith(".tmp"):
+                continue
+            try:
+                out.append(int(d[len(self.prefix):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _path(self, no):
+        return os.path.join(self.dirname, f"{self.prefix}{no}")
+
+    def latest(self):
+        nums = self.checkpoint_numbers()
+        return (nums[-1], self._path(nums[-1])) if nums else (None, None)
+
+    # -- saving ------------------------------------------------------------
+    def save(self, executor, main_program=None, scope=None,
+             extra_files=None):
+        """Synchronous numbered save. Returns the checkpoint number."""
+        no, stage = self._stage()
+        self._write(no, stage, executor, main_program, scope, extra_files)
+        return no
+
+    def save_async(self, executor, main_program=None, scope=None,
+                   extra_files=None):
+        """Snapshot now, write in the background. Returns the checkpoint
+        number immediately; call wait() before relying on the files."""
+        from .framework.executor import global_scope as _gs
+        scope = scope or _gs()
+        main_program, var_list = _resolve_vars(main_program, None,
+                                               is_persistable)
+        # the gather must be synchronous: by the time the thread runs,
+        # the live scope may already hold the next step's params
+        arrays, meta = _collect_arrays(scope, var_list, _rng_extra(scope))
+        no, stage = self._stage()
+
+        def _bg():
+            try:
+                self._write_arrays(no, stage, arrays, meta, extra_files)
+            except BaseException as exc:  # noqa: BLE001 — re-raised in wait
+                with self._lock:
+                    self._errors.append(exc)
+
+        t = threading.Thread(target=_bg, daemon=True,
+                             name=f"ckpt-save-{no}")
+        with self._lock:
+            self._pending.append(t)
+        t.start()
+        return no
+
+    def wait(self):
+        """Join pending async saves; re-raise the first failure."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for t in pending:
+            t.join()
+        with self._lock:
+            if self._errors:
+                exc = self._errors[0]
+                self._errors = []
+                raise exc
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, executor, main_program=None, scope=None):
+        """Load the newest checkpoint; returns its number (None when the
+        directory holds no checkpoints)."""
+        no, path = self.latest()
+        if no is None:
+            return None
+        load_persistables(executor, path, main_program=main_program,
+                          scope=scope)
+        return no
+
+    # -- internals ---------------------------------------------------------
+    def _stage(self):
+        os.makedirs(self.dirname, exist_ok=True)
+        with self._lock:
+            nums = self.checkpoint_numbers()
+            floor = max(nums[-1] if nums else -1,
+                        max(self._reserved, default=-1))
+            no = floor + 1
+            self._reserved.add(no)
+        stage = self._path(no) + ".tmp"
+        if os.path.isdir(stage):
+            import shutil
+            shutil.rmtree(stage, ignore_errors=True)
+        return no, stage
+
+    def _release(self, no):
+        with self._lock:
+            self._reserved.discard(no)
+
+    def _write(self, no, stage, executor, main_program, scope,
+               extra_files):
+        try:
+            os.makedirs(stage, exist_ok=True)
+            save_persistables(executor, stage, main_program=main_program,
+                              scope=scope)
+            self._commit(no, stage, extra_files)
+        finally:
+            self._release(no)
+
+    def _write_arrays(self, no, stage, arrays, meta, extra_files):
+        try:
+            os.makedirs(stage, exist_ok=True)
+            _write_array_dir(stage, arrays, meta)
+            self._commit(no, stage, extra_files)
+        finally:
+            self._release(no)
+
+    def _commit(self, no, stage, extra_files):
+        for rel, payload in (extra_files or {}).items():
+            _fsync_write(os.path.join(stage, rel),
+                         lambda f, _p=payload: f.write(
+                             json.dumps(_p).encode()))
+        os.replace(stage, self._path(no))
+        _fsync_dir(self.dirname)
+        self._prune(keep_at_least=no)
+
+    def _prune(self, keep_at_least):
+        if self.max_to_keep is None:
+            return
+        import shutil
+        nums = self.checkpoint_numbers()
+        for n in nums[:-self.max_to_keep] if self.max_to_keep else nums:
+            if n == keep_at_least:
+                continue
+            shutil.rmtree(self._path(n), ignore_errors=True)

@@ -1,0 +1,14 @@
+"""shard_map across jax versions.
+
+Newer jax exposes ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases ship ``jax.experimental.shard_map.shard_map`` where the same
+knob is spelled ``check_rep``. Ops import from here so both work.
+"""
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)

@@ -22,7 +22,7 @@ numerically comparable.
 """
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._shard_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..framework.registry import register_op

@@ -1,0 +1,343 @@
+"""Fault-tolerant training runtime primitives.
+
+The reference Fluid stack survives real fleets with a spread of
+mechanisms — gRPC deadline/retry semantics
+(/root/reference/paddle/fluid/operators/distributed/grpc/grpc_client.cc,
+FLAGS_rpc_deadline / FLAGS_rpc_retry_times), the HeartBeatMonitor
+(operators/distributed/heart_beat_monitor.h), checkpoint-notify ops, and
+FLAGS_check_nan_inf nan/inf interception (framework/details/
+nan_inf_utils_detail.cc). This module centralizes the runtime-neutral
+pieces of that story so io.py, distributed/wire.py, distributed/ps.py and
+framework/executor.py share one vocabulary:
+
+- typed errors: CheckpointCorruptError, RpcDeadlineError, CircuitOpenError,
+  NonFiniteError, WatchdogTimeout
+- retry_call(fn, deadline, base_backoff): exponential backoff + jitter
+  under a wall-clock deadline
+- CircuitBreaker: per-endpoint closed/open/half-open fail-fast gate so a
+  dead pserver costs one deadline, not one deadline per call forever
+- watchdog(budget)/run_with_watchdog: abort work exceeding a wall-clock
+  budget (the host-side analog of a preempted-TPU step that never returns)
+- fault_injection(point, ...): test hook arming named failure points that
+  production code declares with maybe_fail(point)
+"""
+import random
+import threading
+import time
+from contextlib import contextmanager
+
+
+# --------------------------------------------------------------------------
+# typed errors
+# --------------------------------------------------------------------------
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed its manifest integrity check (sha256
+    mismatch, truncation, or unreadable payload). Carries ``path`` — the
+    offending file — so operators know what to delete/re-replicate."""
+
+    def __init__(self, message, path=None):
+        super().__init__(message)
+        self.path = path
+
+
+class RpcDeadlineError(ConnectionError):
+    """An RPC did not succeed within its wall-clock deadline (reference
+    gRPC FLAGS_rpc_deadline semantics). Subclasses ConnectionError so
+    existing transport-failure handlers keep working. Carries
+    ``endpoint`` and ``elapsed`` (seconds spent retrying)."""
+
+    def __init__(self, message, endpoint=None, elapsed=None):
+        super().__init__(message)
+        self.endpoint = endpoint
+        self.elapsed = elapsed
+
+
+class CircuitOpenError(RpcDeadlineError):
+    """Fail-fast rejection: the endpoint's circuit breaker is open after
+    repeated failures, so the call is refused without touching the wire."""
+
+
+class EnforceNotMet(RuntimeError):
+    """Runtime enforcement violation (reference platform/enforce.h
+    PADDLE_ENFORCE / fluid.core.EnforceNotMet)."""
+
+
+class NonFiniteError(EnforceNotMet):
+    """FLAGS_check_nan_inf tripped: a fetched output or updated parameter
+    contains nan/inf. Carries ``var_name`` (first offender) and ``count``
+    (non-finite element count in that tensor)."""
+
+    def __init__(self, message, var_name=None, count=None):
+        super().__init__(message)
+        self.var_name = var_name
+        self.count = count
+
+
+class WatchdogTimeout(RuntimeError):
+    """Work under a watchdog exceeded its wall-clock budget."""
+
+
+# --------------------------------------------------------------------------
+# retry with exponential backoff + jitter
+# --------------------------------------------------------------------------
+
+def retry_call(fn, deadline=30.0, base_backoff=0.05, max_backoff=2.0,
+               retries=None, retry_on=(ConnectionError, OSError),
+               jitter=0.5, what="call", endpoint=None, on_retry=None):
+    """Run ``fn()`` until it succeeds, a non-retryable error escapes, the
+    attempt budget is spent, or the wall-clock ``deadline`` passes.
+
+    Backoff between attempts is ``base_backoff * 2**k`` capped at
+    ``max_backoff``, with up to ``jitter`` fraction of random extra so a
+    fleet of trainers retrying a recovered pserver doesn't stampede it.
+    ``retries`` bounds ADDITIONAL attempts (None = unlimited within the
+    deadline; 0 = single attempt). CircuitOpenError always propagates —
+    retrying a breaker-rejected call would defeat the breaker.
+
+    Raises RpcDeadlineError (chained to the last failure) when the budget
+    is exhausted.
+    """
+    start = time.monotonic()
+    attempt = 0
+    backoff = float(base_backoff)
+    while True:
+        try:
+            return fn()
+        except CircuitOpenError:
+            raise
+        except retry_on as exc:
+            now = time.monotonic()
+            elapsed = now - start
+            out_of_attempts = retries is not None and attempt >= retries
+            # next attempt would land past the deadline: give up now
+            # instead of sleeping into guaranteed failure
+            out_of_time = deadline is not None and \
+                elapsed + backoff >= deadline
+            if out_of_attempts or out_of_time:
+                raise RpcDeadlineError(
+                    f"{what} failed after {attempt + 1} attempt(s) over "
+                    f"{elapsed:.2f}s"
+                    + (f" (deadline {deadline}s)" if deadline else "")
+                    + (f" to {endpoint}" if endpoint else "")
+                    + f": {type(exc).__name__}: {exc}",
+                    endpoint=endpoint, elapsed=elapsed) from exc
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            time.sleep(backoff * (1.0 + jitter * random.random()))
+            attempt += 1
+            backoff = min(backoff * 2.0, float(max_backoff))
+
+
+# --------------------------------------------------------------------------
+# circuit breaker
+# --------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-endpoint fail-fast gate (closed -> open -> half-open).
+
+    ``failure_threshold`` consecutive failures open the circuit: calls
+    raise CircuitOpenError immediately for ``reset_timeout`` seconds.
+    After that one trial call is admitted (half-open); success closes the
+    circuit, failure re-opens it for another ``reset_timeout``.
+    """
+
+    def __init__(self, endpoint=None, failure_threshold=3,
+                 reset_timeout=5.0):
+        self.endpoint = endpoint
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout = float(reset_timeout)
+        self._failures = 0
+        self._opened_at = None
+        self._half_open_inflight = False
+        self._lock = threading.Lock()
+
+    @property
+    def state(self):
+        with self._lock:
+            if self._opened_at is None:
+                return "closed"
+            if time.monotonic() - self._opened_at >= self.reset_timeout:
+                return "half-open"
+            return "open"
+
+    def before_call(self):
+        """Admission check; raises CircuitOpenError when open."""
+        with self._lock:
+            if self._opened_at is None:
+                return
+            waited = time.monotonic() - self._opened_at
+            if waited < self.reset_timeout:
+                raise CircuitOpenError(
+                    f"circuit breaker open for {self.endpoint or 'peer'} "
+                    f"({self._failures} consecutive failures; retrying "
+                    f"in {self.reset_timeout - waited:.1f}s)",
+                    endpoint=self.endpoint)
+            # half-open: admit exactly one probe at a time
+            if self._half_open_inflight:
+                raise CircuitOpenError(
+                    f"circuit breaker half-open for "
+                    f"{self.endpoint or 'peer'}: probe already in flight",
+                    endpoint=self.endpoint)
+            self._half_open_inflight = True
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._half_open_inflight = False
+
+    def release_probe(self):
+        """Abandon an admitted call without judging the endpoint — for
+        failures that are the caller's (encode TypeError, interrupt), not
+        the peer's. Frees the half-open probe slot so an abandoned probe
+        cannot wedge the breaker in fail-fast forever."""
+        with self._lock:
+            self._half_open_inflight = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            self._half_open_inflight = False
+            if self._failures >= self.failure_threshold:
+                self._opened_at = time.monotonic()
+
+
+# --------------------------------------------------------------------------
+# watchdog
+# --------------------------------------------------------------------------
+
+@contextmanager
+def watchdog(budget_secs, what="operation"):
+    """Abort the enclosed block when it exceeds ``budget_secs``.
+
+    Main-thread only (uses interrupt_main, the same lever Ctrl-C pulls);
+    from other threads use run_with_watchdog. The interrupt lands at the
+    next Python bytecode boundary — a block stuck inside a single C call
+    is aborted as soon as it re-enters Python.
+    """
+    import signal
+    import _thread
+    main = threading.main_thread()
+    if threading.current_thread() is not main:
+        raise RuntimeError("watchdog() only arms on the main thread; "
+                           "use run_with_watchdog elsewhere")
+    fired = [False]
+    armed = [True]
+    # _fire sends the signal while HOLDING this lock, and the exit path
+    # disarms while holding it — so the interrupt can never land after
+    # the with-block has moved on into unrelated code
+    arm_lock = threading.Lock()
+
+    def _fire():
+        with arm_lock:
+            if not armed[0]:
+                return
+            fired[0] = True
+            try:
+                # a real SIGINT interrupts blocking syscalls (sleep,
+                # socket recv) with EINTR; interrupt_main() only sets a
+                # flag the interpreter notices AFTER the syscall returns
+                signal.pthread_kill(main.ident, signal.SIGINT)
+            except (AttributeError, OSError, ValueError):
+                _thread.interrupt_main()
+
+    timer = threading.Timer(float(budget_secs), _fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    except KeyboardInterrupt:
+        if fired[0]:
+            raise WatchdogTimeout(
+                f"{what} exceeded its {budget_secs}s wall-clock budget")
+        raise
+    finally:
+        try:
+            with arm_lock:
+                armed[0] = False
+        except KeyboardInterrupt:
+            armed[0] = False
+            if not fired[0]:
+                raise           # a genuine Ctrl-C, not our timer
+            # the timer fired in the instant between the block completing
+            # and the disarm: the work finished within budget, absorb the
+            # late interrupt instead of letting it escape
+        timer.cancel()
+
+
+def run_with_watchdog(fn, budget_secs, *args, what=None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` on a worker thread; raise
+    WatchdogTimeout if it does not finish within ``budget_secs``. Safe
+    from any thread. The overrunning worker is left to die as a daemon —
+    its result is discarded."""
+    box = {}
+
+    def _target():
+        try:
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: BLE001 — relayed to caller
+            box["error"] = exc
+
+    t = threading.Thread(target=_target, daemon=True)
+    t.start()
+    t.join(float(budget_secs))
+    if t.is_alive():
+        raise WatchdogTimeout(
+            f"{what or getattr(fn, '__name__', 'operation')} exceeded "
+            f"its {budget_secs}s wall-clock budget")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+# --------------------------------------------------------------------------
+# fault injection (test hook)
+# --------------------------------------------------------------------------
+
+_faults = {}
+_faults_lock = threading.Lock()
+
+
+def maybe_fail(point, **context):
+    """Production-side failure point: raises the armed exception when a
+    test has armed ``point`` via fault_injection. No-op (one dict lookup)
+    otherwise."""
+    with _faults_lock:
+        spec = _faults.get(point)
+        if spec is None or spec["remaining"] == 0:
+            return
+        spec["remaining"] -= 1
+        spec["fired"] += 1
+        exc = spec["exc"]
+    if callable(exc) and not isinstance(exc, type):
+        exc = exc(point, context)
+        if exc is None:
+            return
+    raise exc if not isinstance(exc, type) else exc(
+        f"fault injected at {point}")
+
+
+def clear_faults():
+    with _faults_lock:
+        _faults.clear()
+
+
+@contextmanager
+def fault_injection(point, exc=ConnectionError, times=1):
+    """Arm ``point`` to raise ``exc`` for the next ``times`` hits
+    (``times=-1`` = every hit while armed). ``exc`` may be an exception
+    class, an instance, or a callable ``(point, context) -> exception or
+    None``. Yields the spec dict; ``spec['fired']`` counts trips."""
+    spec = {"exc": exc, "remaining": int(times), "fired": 0}
+    with _faults_lock:
+        prev = _faults.get(point)
+        _faults[point] = spec
+    try:
+        yield spec
+    finally:
+        with _faults_lock:
+            if prev is None:
+                _faults.pop(point, None)
+            else:
+                _faults[point] = prev

@@ -14,5 +14,25 @@ if "host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # keep `-m "not slow"` (the tier-1 filter) warning-free
+    config.addinivalue_line(
+        "markers",
+        "slow: long kill/restart or multi-process tests excluded from the "
+        "fast tier-1 run")
+
+
+@pytest.fixture
+def fault_points():
+    """Fault-injection handle (paddle_tpu.resilience): arm named failure
+    points in wire/io with ``fault_points.fault_injection(point, ...)``;
+    everything armed is cleared after the test, pass or fail."""
+    from paddle_tpu import resilience
+    resilience.clear_faults()
+    yield resilience
+    resilience.clear_faults()
