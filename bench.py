@@ -605,6 +605,76 @@ def bench_gpt_long():
                             _gpt_train_flops_per_sample(cfg, seq_len))
 
 
+def bench_serving():
+    """Serving runtime through the wire protocol: 8 concurrent clients,
+    request batch sizes {1, 8, 32} (the BENCHMARKS.md serving entry).
+    Reports requests/s, samples/s, request p50/p99 (enqueue->reply) and
+    the observed mean device-batch size per request size. A fresh server
+    per request size keeps the stage histograms per-config."""
+    import tempfile
+    import threading
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, serving
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 64], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        out = layers.fc(h, 32, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, ["x"], [out], exe,
+                                      main_program=main)
+
+    rng = np.random.default_rng(0)
+    n_threads, n_req = 8, 40
+    per_batch = {}
+    for rb in (1, 8, 32):
+        server = serving.InferenceServer(tmp, max_batch_size=64,
+                                         batch_timeout_ms=2.0,
+                                         queue_depth=1024)
+        server.start(warmup_batch_sizes=(rb, n_threads * rb))
+        xv = rng.standard_normal((rb, 64)).astype(np.float32)
+
+        def drive():
+            with serving.Client(server.endpoint) as c:
+                for _ in range(n_req):
+                    c.infer({"x": xv})
+
+        threads = [threading.Thread(target=drive)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        st = server.stats()
+        server.stop()
+        total = n_threads * n_req
+        per_batch[str(rb)] = {
+            "requests_per_sec": round(total / dt, 1),
+            "samples_per_sec": round(total * rb / dt, 1),
+            "p50_ms": st["total_p50_ms"],
+            "p99_ms": st["total_p99_ms"],
+            "mean_batch_size": st["mean_batch_size"],
+            "batch_occupancy": st["batch_occupancy"],
+            "cache_hit_rate": round(
+                st["cache_hits"] / max(st["cache_hits"]
+                                       + st["cache_misses"], 1), 4),
+        }
+    return {
+        "metric": "serving_mlp_batch32_samples_per_sec",
+        "value": per_batch["32"]["samples_per_sec"],
+        "unit": "samples/sec",
+        "vs_baseline": None,          # no published anchor for this path
+        "request_batches": per_batch,
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -619,6 +689,7 @@ _CONFIGS = {
                   "bert_base_seq2048_flash_bf16_samples_per_sec"),
     "gpt_long": (bench_gpt_long,
                  "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
+    "serving": (bench_serving, "serving_mlp_batch32_samples_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

@@ -78,6 +78,7 @@ from .io import (  # noqa: F401
     CheckpointSaver,
 )
 from . import resilience  # noqa: F401
+from . import serving  # noqa: F401
 from .resilience import (  # noqa: F401
     CheckpointCorruptError, EnforceNotMet, NonFiniteError,
     RpcDeadlineError, WatchdogTimeout,

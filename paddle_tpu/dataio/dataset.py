@@ -173,11 +173,51 @@ class InMemoryDataset(DatasetBase):
         # crashed previous run sharing the spool dir can never satisfy
         # this run's barrier
         if not hasattr(self, "_shuffle_token"):
-            from jax.experimental import multihost_utils
             import secrets
-            tok = np.asarray(secrets.randbits(31), np.int32)
-            self._shuffle_token = int(
-                multihost_utils.broadcast_one_to_all(tok))
+            try:
+                from jax.experimental import multihost_utils
+                tok = np.asarray(secrets.randbits(31), np.int32)
+                self._shuffle_token = int(
+                    multihost_utils.broadcast_one_to_all(tok))
+            except Exception:
+                # backends without multiprocess collectives (jaxlib's CPU
+                # backend raises XlaRuntimeError): agree through the spool
+                # dir itself. Process 0 ALWAYS rewrites the token file
+                # with a fresh random value (temp + atomic replace) — a
+                # token left by a crashed previous run is overwritten,
+                # never reused, so that run's shard/done files (named by
+                # the old token) can never satisfy this run's barrier.
+                # Other ranks only accept a token file written at/after
+                # their own arrival (small slack for clock fuzz); a stale
+                # file is ignored until rank 0 replaces it.
+                tfile = os.path.join(spool_dir, "_run_token")
+                if idx == 0:
+                    tok0 = secrets.randbits(31)
+                    tmp = tfile + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(str(tok0))
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, tfile)
+                    self._shuffle_token = tok0
+                else:
+                    fresh_after = time.time() - 120.0
+                    deadline0 = time.monotonic() + 300
+                    while True:
+                        try:
+                            if os.stat(tfile).st_mtime >= fresh_after:
+                                with open(tfile) as f:
+                                    txt = f.read().strip()
+                                if txt:
+                                    self._shuffle_token = int(txt)
+                                    break
+                        except OSError:
+                            pass
+                        if time.monotonic() > deadline0:
+                            raise TimeoutError(
+                                "global_shuffle: rank 0 never wrote a "
+                                "fresh run token to the spool dir")
+                        time.sleep(0.02)
         tok = self._shuffle_token
         r = getattr(self, "_shuffle_round", 0)
         rng = random.Random(self._seed)

@@ -247,14 +247,17 @@ def decode(buf):
 # ------------------------------------------------------------------ frame
 
 def _recv_exact(sock, n, context="frame"):
-    buf = b""
+    # bytearray accumulator: amortized O(n) reassembly — serving-size
+    # frames (batched tensor replies) arrive in many TCP segments, and
+    # bytes += would re-copy the whole prefix per segment
+    buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise WireTruncationError(endpoint=_peer(sock), expected=n,
                                       received=len(buf), context=context)
         buf += chunk
-    return buf
+    return bytes(buf)
 
 
 def send_frame(sock, obj, key=None, timeout=None):

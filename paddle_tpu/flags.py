@@ -30,6 +30,25 @@ _DEFS = {
     "rpc_retry_base_backoff": (0.05, float, None),
     "rpc_circuit_break_failures": (3, int, None),
     "rpc_circuit_reset_secs": (5.0, float, None),
+    # -- serving runtime (paddle_tpu/serving) --
+    # batch former: flush a signature's batch at max_batch_size rows or
+    # after the oldest member waited batch_timeout_ms
+    "serving_max_batch_size": (32, int, None),
+    "serving_batch_timeout_ms": (5.0, float, None),
+    # admission: hard pending-request cap (backpressure) and the default
+    # per-request deadline (0 = no deadline unless the request sets one)
+    "serving_queue_depth": (256, int, None),
+    "serving_default_deadline_ms": (0.0, float, None),
+    # compiled-executable cache caps (0 = unbounded on that axis)
+    "serving_cache_entries": (32, int, None),
+    "serving_cache_bytes": (0, int, None),
+    # load-shed breaker: consecutive queue-full refusals that open it,
+    # and how long it sheds before re-probing
+    "serving_shed_failures": (8, int, None),
+    "serving_shed_reset_secs": (0.5, float, None),
+    # Executor per-(program, feed-shape) compile cache entry cap — bounds
+    # what was previously unbounded growth per input-shape signature
+    "executor_cache_entries": (128, int, None),
     "cudnn_deterministic": (False, bool, None),
     "cpu_deterministic": (False, bool, None),
     "benchmark": (False, bool, None),

@@ -144,11 +144,23 @@ def _check_int64_feed(name, arr):
 class Executor:
     """Compile-and-run executor with a program cache
     (the reference caches prepared contexts at executor.py:1169; we cache
-    jitted callables keyed on program version + feed signature)."""
+    jitted callables keyed on program version + feed signature).
+
+    The cache is an LRU capped at ``FLAGS_executor_cache_entries``
+    (previously unbounded: every new feed-shape signature grew it
+    forever — a shape-diverse inference caller leaked compiled
+    executables). Eviction only drops the jitted callable; the next use
+    of that signature recompiles. ``cache_stats()`` exposes
+    hit/miss/evict counters."""
 
     def __init__(self, place=None):
+        from ..utils.lru import LRUCache
         self.place = place
-        self._cache = {}
+        self._cache = LRUCache(max_entries=_flag("executor_cache_entries"))
+
+    def cache_stats(self):
+        """Compile-cache occupancy and hit/miss/evict counters."""
+        return self._cache.stats()
 
     # -- helpers ---------------------------------------------------------
     @staticmethod

@@ -176,10 +176,16 @@ def generic_grad_lower(ctx, ins, attrs, fwd_def):
     """
     fwd = attrs["__fwd_op__"]
     fwd_attrs = fwd["attrs"]
-    in_slots = [s for s in fwd["inputs"] if s in ins]
-    primals = {s: ins[s] for s in in_slots}
     # which inputs need grads
     req = attrs["__grad_inputs__"]  # {slot: [bool per index]}
+    # only grad-requiring slots become vjp primals; the rest stay
+    # closure-captured with their ORIGINAL values. This keeps host-side
+    # shape carriers (ShapeTensorList from the `shape` op) as concrete
+    # numpy — jnp.asarray-ing them into tracers broke
+    # _resolve_shape_tensors' int() concretization in backward passes
+    in_slots = [s for s in fwd["inputs"]
+                if s in ins and any(req.get(s) or ())]
+    primals = {s: ins[s] for s in in_slots}
 
     def f(p):
         full = dict(ins)

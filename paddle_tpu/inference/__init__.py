@@ -205,6 +205,14 @@ class AnalysisPredictor:
         self.run(feeds)
         return self
 
+    def cache_stats(self):
+        """Compile-cache counters for THIS predictor's executor: entries,
+        hit/miss/evict. The per-shape cache is LRU-bounded by
+        ``FLAGS_executor_cache_entries`` (it previously grew without
+        limit per input-shape signature). For the multi-client serving
+        layer above this predictor see ``paddle_tpu.serving``."""
+        return self._exe.cache_stats()
+
     def clone(self):
         """Share weights/program; private executor cache (reference
         clone-per-thread serving)."""

@@ -479,10 +479,22 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pruned = main_program.clone(for_test=True)._prune(
         target_names, feeds=feeded_var_names)
     os.makedirs(dirname, exist_ok=True)
+    # feed signature record (shape template, -1 = dynamic): the serving
+    # runtime's warmup (serving.ServingEngine.warmup) and external
+    # tooling read these instead of re-deriving them from the program
+    gb = pruned.global_block()
+    feed_specs = {}
+    for n in feeded_var_names:
+        var = gb.vars.get(n)
+        shape = [int(d) for d in (getattr(var, "shape", None) or [])]
+        feed_specs[n] = {"shape": shape,
+                         "dtype": str(getattr(var, "dtype", "float32")
+                                      or "float32")}
     model = {
         "program": pruned.to_dict(),
         "feed_var_names": list(feeded_var_names),
         "fetch_var_names": target_names,
+        "feed_specs": feed_specs,
     }
     rel_model = model_filename or _MODEL_FILE
     model_sha = _fsync_write(os.path.join(dirname, rel_model),
@@ -514,6 +526,10 @@ def load_inference_model(dirname, executor, model_filename=None,
         model = json.load(f)
     program = Program.from_dict(model["program"])
     program._is_test = True
+    # save-time feed signature record (shape template, -1 = dynamic):
+    # consumed by serving.ServingEngine.feed_specs / warmup; absent on
+    # pre-upgrade saves
+    program._feed_specs = model.get("feed_specs")
     has_persistables = any(is_persistable(v) for v in program.list_vars())
     if has_persistables:
         load_vars(executor, dirname, main_program=program,

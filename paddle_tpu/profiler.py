@@ -40,6 +40,14 @@ def is_profiling():
     return _active
 
 
+def record_duration(name, seconds):
+    """Record an externally timed span into the event table (no-op while
+    profiling is off). The serving runtime's stage histograms feed their
+    measurements through here, so a ``profiler.profiler()`` block around
+    live traffic shows ``serving/*`` rows in the summary table."""
+    _record(name, float(seconds))
+
+
 @contextlib.contextmanager
 def record_event(name):
     """RAII event span (reference platform::RecordEvent)."""

@@ -1,0 +1,42 @@
+"""TPU serving runtime: dynamic micro-batching, compiled-executable
+cache, admission control.
+
+The reference ships a standalone inference engine (AnalysisPredictor +
+zero-copy tensors) for single callers; this package is the multi-client
+layer above it — the TPU-native analog of a serving stack in the
+clipper/ORCA adaptive-batching tradition:
+
+- ``RequestQueue`` + ``MicroBatcher`` coalesce single requests into
+  padded power-of-two batches per feed-shape signature
+  (``FLAGS_serving_max_batch_size`` / ``FLAGS_serving_batch_timeout_ms``)
+- ``ExecutableCache`` holds AOT-compiled XLA executables — LRU, byte- and
+  entry-capped, hit/miss/evict counters, warmup from a recorded
+  signature file
+- admission control: queue-depth backpressure
+  (``ServerOverloadedError``), per-request deadlines
+  (``DeadlineExceededError``), load shedding via
+  ``resilience.CircuitBreaker``
+- ``InferenceServer`` speaks the ``distributed/wire.py`` length-prefixed
+  framing (HMAC-optional, same retry semantics as the PS transport);
+  ``Client`` is the matching caller; both also work purely in-process
+- ``server.stats()`` snapshots per-stage latency histograms
+  (queue/pad/compile/execute), throughput and batch occupancy; the same
+  spans land in ``paddle_tpu.profiler`` event tables while profiling
+
+Quick start::
+
+    import paddle_tpu.serving as serving
+    server = serving.InferenceServer("/path/to/saved_model").start()
+    with serving.Client(server.endpoint) as c:
+        probs, = c.infer({"x": batch}, deadline_ms=50.0)
+    print(server.stats()["mean_batch_size"])
+    server.stop()
+"""
+from .batching import (  # noqa: F401
+    DeadlineExceededError, MicroBatcher, Request, RequestQueue,
+    ServerOverloadedError, ServingError, next_bucket,
+)
+from .cache import ExecutableCache, LRUCache, feed_signature  # noqa: F401
+from .engine import SIGNATURE_FILE, ServingEngine  # noqa: F401
+from .metrics import LatencyHistogram, ServingStats  # noqa: F401
+from .server import Client, InferenceServer, ServingConfig  # noqa: F401

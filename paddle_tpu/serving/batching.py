@@ -1,0 +1,350 @@
+"""Request queue + dynamic micro-batcher.
+
+Clipper/ORCA-style adaptive batching for the TPU serving runtime: single
+requests (each carrying a small leading-dim batch of examples) are
+coalesced into padded device batches under ``max_batch_size`` /
+``batch_timeout_ms``. Requests only share a batch when their PER-EXAMPLE
+signature (trailing dims + dtype per feed) matches; total rows are
+padded up to the next power-of-two bucket so at most 2x padding waste
+and a bounded set of compiled shapes.
+
+Admission control lives in ``RequestQueue.put``: a hard queue-depth
+limit (backpressure -> ``ServerOverloadedError``), per-request deadlines
+(``DeadlineExceededError`` — checked at admission, again when the batch
+is formed, and a third time right before execution), and load-shedding
+through a ``resilience.CircuitBreaker``: sustained overload/engine
+failures open the breaker, and while it is open requests are refused in
+O(1) without touching the queue.
+"""
+import threading
+import time
+
+import numpy as np
+
+from ..resilience import CircuitBreaker, CircuitOpenError, maybe_fail
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-runtime request failures."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before it reached the chip. Carries
+    ``deadline_ms`` (the budget) and ``waited_ms`` (time actually spent
+    queued when the expiry was detected)."""
+
+    def __init__(self, message, deadline_ms=None, waited_ms=None):
+        super().__init__(message)
+        self.deadline_ms = deadline_ms
+        self.waited_ms = waited_ms
+
+
+class ServerOverloadedError(ServingError):
+    """Admission refused: queue at depth limit or load-shed breaker open.
+    Clients should back off (the wire server maps this to an
+    ``etype: "Overloaded"`` reply)."""
+
+
+class Request:
+    """One in-flight prediction request.
+
+    ``feeds``: {name: np.ndarray}, every array with a leading example
+    dim (shape ``(rows, *example_shape)``); all feeds must agree on
+    ``rows``. The response is delivered through ``wait()`` ->
+    ``result`` (list of np arrays, one per fetch target) or raises the
+    recorded error.
+    """
+
+    __slots__ = ("feeds", "rows", "example_sig", "deadline_at",
+                 "deadline_ms", "t_enqueue", "t_flush", "result", "error",
+                 "_done")
+
+    def __init__(self, feeds, deadline_ms=None):
+        self.feeds = {n: np.ascontiguousarray(a) for n, a in feeds.items()}
+        if not self.feeds:
+            raise ValueError("request has no feeds")
+        rows = {a.shape[0] if a.ndim else 1 for a in self.feeds.values()}
+        if len(rows) != 1:
+            raise ValueError(
+                f"feeds disagree on the leading example dim: "
+                f"{ {n: a.shape for n, a in self.feeds.items()} }")
+        self.rows = rows.pop()
+        if self.rows < 1:
+            raise ValueError("request carries zero examples")
+        self.example_sig = tuple(sorted(
+            (n, tuple(a.shape[1:]), str(a.dtype))
+            for n, a in self.feeds.items()))
+        self.deadline_ms = deadline_ms
+        now = time.monotonic()
+        self.t_enqueue = now
+        self.t_flush = None
+        self.deadline_at = (now + deadline_ms / 1e3
+                            if deadline_ms else None)
+        self.result = None
+        self.error = None
+        self._done = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+    def expired(self, now=None):
+        return (self.deadline_at is not None
+                and (now or time.monotonic()) > self.deadline_at)
+
+    def expire(self, now=None, where="queue"):
+        now = now or time.monotonic()
+        waited = (now - self.t_enqueue) * 1e3
+        self.set_error(DeadlineExceededError(
+            f"request deadline of {self.deadline_ms:.1f}ms exceeded in "
+            f"{where} after {waited:.1f}ms",
+            deadline_ms=self.deadline_ms, waited_ms=waited))
+
+    def set_result(self, result):
+        self.result = result
+        self._done.set()
+
+    def set_error(self, exc):
+        self.error = exc
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block until the reply is in; returns the fetch list or raises
+        the recorded error. ``timeout`` None waits forever."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"no reply within {timeout}s (request still in flight)")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class RequestQueue:
+    """Bounded FIFO with admission control. ``put`` is the single gate
+    every request passes: breaker check (load shed), depth check
+    (backpressure), deadline-already-passed check. ``get`` is consumed by
+    the MicroBatcher only."""
+
+    def __init__(self, max_depth=None, breaker=None, stats=None):
+        if max_depth is None:
+            from ..flags import flag
+            max_depth = flag("serving_queue_depth")
+        self.max_depth = int(max_depth)
+        self._items = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self.stats = stats
+        if breaker is None:
+            from ..flags import flag
+            breaker = CircuitBreaker(
+                endpoint="serving-admission",
+                failure_threshold=flag("serving_shed_failures"),
+                reset_timeout=flag("serving_shed_reset_secs"))
+        self.breaker = breaker
+
+    def __len__(self):
+        with self._cv:
+            return len(self._items)
+
+    def put(self, req):
+        """Admit ``req`` or raise ServerOverloadedError /
+        DeadlineExceededError. Never blocks — backpressure is a fast
+        refusal, not a slow accept (the client owns retry policy)."""
+        maybe_fail("serving.admit")
+        try:
+            self.breaker.before_call()
+        except CircuitOpenError as e:
+            if self.stats:
+                self.stats.bump("shed_overload")
+            raise ServerOverloadedError(
+                f"load shedding: {e}") from e
+        if req.expired():
+            self.breaker.release_probe()    # not the server's fault
+            if self.stats:
+                self.stats.bump("shed_deadline")
+            req.expire(where="admission")
+            raise req.error
+        with self._cv:
+            if self._closed:
+                self.breaker.release_probe()
+                raise ServerOverloadedError("server is shutting down")
+            if len(self._items) >= self.max_depth:
+                overloaded = True
+            else:
+                self._items.append(req)
+                self._cv.notify()
+                overloaded = False
+        if overloaded:
+            self.breaker.record_failure()
+            if self.stats:
+                self.stats.bump("shed_overload")
+            raise ServerOverloadedError(
+                f"request queue at depth limit ({self.max_depth}); "
+                f"retry with backoff")
+        self.breaker.record_success()
+        if self.stats:
+            self.stats.bump("requests_admitted")
+        return req
+
+    def get(self, timeout=None):
+        """Pop the oldest request, or None on timeout/close."""
+        with self._cv:
+            if not self._items:
+                self._cv.wait(timeout)
+            if not self._items:
+                return None
+            return self._items.pop(0)
+
+    def close(self):
+        """Stop admitting; fail whatever is still queued."""
+        with self._cv:
+            self._closed = True
+            drained = self._items[:]
+            self._items.clear()
+            self._cv.notify_all()
+        for req in drained:
+            req.set_error(ServerOverloadedError("server shut down with "
+                                                "the request still queued"))
+
+
+def next_bucket(rows, min_bucket=1):
+    """Smallest power-of-two >= rows (>= min_bucket): bounded padding
+    waste (< 2x) and a bounded universe of compiled shapes."""
+    b = max(int(min_bucket), 1)
+    rows = max(int(rows), 1)
+    while b < rows:
+        b <<= 1
+    return b
+
+
+class MicroBatcher:
+    """Pulls requests off the queue, groups them by per-example
+    signature, and flushes a group to ``execute_fn(requests)`` when it
+    reaches ``max_batch_size`` rows or its oldest member has waited
+    ``batch_timeout_ms``. Single execution thread: batches hit the chip
+    serially, which is exactly what a single-TPU serving process wants
+    (the chip is the bottleneck resource; concurrency lives in the
+    connection threads)."""
+
+    def __init__(self, queue, execute_fn, max_batch_size=None,
+                 batch_timeout_ms=None, stats=None):
+        from ..flags import flag
+        self.queue = queue
+        self.execute_fn = execute_fn
+        self.max_batch_size = int(max_batch_size
+                                  if max_batch_size is not None
+                                  else flag("serving_max_batch_size"))
+        timeout_ms = (batch_timeout_ms if batch_timeout_ms is not None
+                      else flag("serving_batch_timeout_ms"))
+        self.batch_timeout_s = float(timeout_ms) / 1e3
+        self.stats = stats
+        self._stop = threading.Event()
+        self._thread = None
+        self._pending = {}   # sig -> {"reqs": [...], "rows": n, "flush_at": t}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-microbatcher")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5):
+        self._stop.set()
+        with self.queue._cv:
+            self.queue._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # the loop thread owns _pending; it is still inside a
+                # long execute (e.g. a first-request compile) — touching
+                # the dict here would race it, and the in-flight requests
+                # will still get their results when it finishes
+                return
+        # thread is down (or never started): fail anything still forming
+        # so no client hangs
+        for ent in self._pending.values():
+            for req in ent["reqs"]:
+                if not req.done():
+                    req.set_error(ServerOverloadedError(
+                        "server stopped while the request was batching"))
+        self._pending.clear()
+
+    # -- core loop --------------------------------------------------------
+    def _admit_to_batch(self, req, now):
+        if req.expired(now):
+            if self.stats:
+                self.stats.bump("shed_deadline")
+            req.expire(now, where="queue")
+            return
+        ent = self._pending.get(req.example_sig)
+        if ent is None:
+            ent = {"reqs": [], "rows": 0,
+                   "flush_at": now + self.batch_timeout_s}
+            self._pending[req.example_sig] = ent
+        ent["reqs"].append(req)
+        ent["rows"] += req.rows
+        # a full group flushes IMMEDIATELY — never deferred to the drain
+        # loop's end, so no signature's group can grow past
+        # max_batch_size (+ the final request's own rows) no matter how
+        # deep the queue backlog is
+        if ent["rows"] >= self.max_batch_size:
+            del self._pending[req.example_sig]
+            self._flush(ent["reqs"], time.monotonic())
+
+    def _flush_ready(self, now):
+        for sig in list(self._pending):
+            ent = self._pending[sig]
+            if now >= ent["flush_at"]:
+                del self._pending[sig]
+                self._flush(ent["reqs"], now)
+
+    def _flush(self, reqs, now):
+        live = []
+        for req in reqs:
+            if req.expired(now):
+                if self.stats:
+                    self.stats.bump("shed_deadline")
+                req.expire(now, where="batcher")
+            else:
+                req.t_flush = now
+                if self.stats:
+                    self.stats.hist["queue"].observe(now - req.t_enqueue)
+                live.append(req)
+        if not live:
+            return
+        try:
+            self.execute_fn(live)
+        except Exception as exc:  # noqa: BLE001 — must reach the clients
+            for req in live:
+                if not req.done():
+                    req.set_error(exc)
+            if self.stats:
+                self.stats.bump("requests_failed", len(live))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if self._pending:
+                wake = min(ent["flush_at"]
+                           for ent in self._pending.values())
+                timeout = max(min(wake - now, 0.1), 0.0)
+            else:
+                timeout = 0.1
+            req = self.queue.get(timeout=timeout)
+            if req is not None:
+                self._admit_to_batch(req, time.monotonic())
+                # drain whatever is already queued before sleeping again:
+                # a burst coalesces instead of going request-by-request
+                # (full groups flush inside _admit_to_batch as they
+                # fill). Timed-out groups are checked INSIDE the drain —
+                # sustained arrivals must not starve a rare signature's
+                # batch_timeout_ms while the hot signature churns.
+                while not self._stop.is_set():
+                    nxt = self.queue.get(timeout=0)
+                    if nxt is None:
+                        break
+                    now = time.monotonic()
+                    self._admit_to_batch(nxt, now)
+                    self._flush_ready(now)
+            self._flush_ready(time.monotonic())

@@ -1,0 +1,85 @@
+"""Bounded executable/compile caches for the serving runtime.
+
+The reference's inference engine amortizes analysis passes by caching a
+NaiveExecutor per AnalysisPredictor; the TPU analog caches COMPILED XLA
+EXECUTABLES keyed on a feed-shape signature. Unlike jax's internal jit
+cache (unbounded, invisible), this one is byte- and entry-capped with
+hit/miss/evict counters, so a server fed adversarial shape traffic
+degrades to recompiles instead of OOMing the host, and the occupancy is
+observable in ``server.stats()``.
+
+The generic capped map lives in ``utils.lru.LRUCache`` (it also bounds
+``framework.executor.Executor``'s per-shape program cache — the
+executor must not depend on this package); ``ExecutableCache`` adds
+shape-signature keys and signature-file record/warmup so a restarted
+server can precompile yesterday's traffic.
+"""
+import json
+
+from ..utils.lru import LRUCache
+
+
+def feed_signature(feed):
+    """Canonical cache key for a feed dict: sorted
+    ``(name, shape, dtype)`` triples. Works on numpy arrays and anything
+    with ``.shape``/``.dtype``."""
+    return tuple(sorted(
+        (name, tuple(int(d) for d in arr.shape), str(arr.dtype))
+        for name, arr in feed.items()))
+
+
+class ExecutableCache(LRUCache):
+    """LRU of compiled XLA executables keyed by feed signature, plus the
+    signature-file half of the warmup story: ``record(path)`` writes the
+    signatures currently cached (i.e. observed traffic), and
+    ``load_signatures(path)`` reads them back so a fresh server can
+    precompile before taking traffic (see ``ServingEngine.warmup``)."""
+
+    def __init__(self, max_entries=None, max_bytes=None, on_evict=None):
+        if max_entries is None or max_bytes is None:
+            from ..flags import flag
+            if max_entries is None:
+                max_entries = flag("serving_cache_entries")
+            if max_bytes is None:
+                max_bytes = flag("serving_cache_bytes")
+        super().__init__(max_entries=max_entries, max_bytes=max_bytes,
+                         on_evict=on_evict)
+
+    signature = staticmethod(feed_signature)
+
+    def record(self, path):
+        """Write the cached signatures (most recently used last) to a
+        JSON file; returns the number written. Temp-write + fsync +
+        atomic rename: a killed server can never leave a torn file that
+        poisons the next launch's warmup."""
+        import os
+        sigs = self.keys()
+        doc = [[[name, list(shape), dtype] for name, shape, dtype in sig]
+               for sig in sigs]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "signatures": doc}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return len(doc)
+
+    @staticmethod
+    def load_signatures(path):
+        """Read a signature file back into a list of
+        ``{name: (shape, dtype)}`` dicts (compile-warmup input). A
+        missing/corrupt file returns [] with a warning — warmup is
+        best-effort, it must never stop a server from starting."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            out = []
+            for sig in doc.get("signatures", []):
+                out.append({name: (tuple(shape), dtype)
+                            for name, shape, dtype in sig})
+            return out
+        except (OSError, ValueError, TypeError) as e:
+            import warnings
+            warnings.warn(f"serving signature file {path!r} unreadable "
+                          f"({e}); warming up without it", stacklevel=2)
+            return []

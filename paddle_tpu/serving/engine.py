@@ -1,0 +1,289 @@
+"""ServingEngine: saved inference model -> padded-batch executor with an
+AOT executable cache.
+
+Reuses the framework's lowering exactly as ``inference.AnalysisPredictor``
+does (one XLA module per program), but compiles through an explicit
+``jit.lower(...).compile()`` pipeline so the compiled executables live in
+the serving ``ExecutableCache`` — byte/entry-capped, counted, recordable
+— instead of jax's invisible internal cache. Model state (params) is
+device-resident and shared by every executable; feeds are the only
+per-call traffic.
+"""
+import os
+import time
+
+import numpy as np
+
+from .batching import next_bucket
+from .cache import ExecutableCache, feed_signature
+from ..resilience import maybe_fail
+
+SIGNATURE_FILE = "_serving_signatures.json"
+
+
+class ServingEngine:
+    """Loads a saved inference model once and executes padded batches.
+
+    ``execute(requests)`` is the MicroBatcher flush target: concatenates
+    request rows, pads to the power-of-two bucket, runs the cached
+    executable for that signature (compiling on miss), splits the rows
+    back per request and delivers results. Also usable stand-alone via
+    ``run(feeds)`` for single-shot prediction.
+    """
+
+    def __init__(self, model_dir=None, *, program=None, scope=None,
+                 feed_names=None, fetch_targets=None, model_filename=None,
+                 params_filename=None, cache=None, stats=None):
+        from ..framework.executor import Executor, Scope, scope_guard
+        from ..framework.lowering import analyze_block_io, build_block_fn
+        import jax
+
+        if program is None:
+            if model_dir is None:
+                raise ValueError("ServingEngine needs model_dir= or a "
+                                 "loaded program=")
+            from .. import io as fluid_io
+            scope = scope or Scope()
+            with scope_guard(scope):
+                program, feed_names, fetch_targets = \
+                    fluid_io.load_inference_model(
+                        model_dir, Executor(),
+                        model_filename=model_filename,
+                        params_filename=params_filename)
+        self.model_dir = model_dir
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = [t.name if hasattr(t, "name") else str(t)
+                            for t in fetch_targets]
+        self.stats = stats
+
+        state_in, _ = analyze_block_io(program, 0, list(self.feed_names))
+        fn = build_block_fn(program, 0, list(self.feed_names),
+                            list(self.fetch_names), state_in, [])
+        key = jax.random.PRNGKey(0)
+
+        def infer(state, feed):
+            fetches, _, _ = fn({}, state, feed, key)
+            return fetches
+
+        self._infer = jax.jit(infer)
+        self._state = {}
+        for n in state_in:
+            v = scope.find_var(n) if scope is not None else None
+            if v is None:
+                raise RuntimeError(
+                    f"inference model state var {n!r} is not in the "
+                    f"scope — load_inference_model must run first")
+            self._state[n] = jax.device_put(np.asarray(v))
+        self.cache = cache if cache is not None else ExecutableCache()
+        gb = program.global_block()
+        # batching across requests is only sound when every feed's
+        # leading dim is dynamic (-1): a static-batch model is executed
+        # request-by-request at its natural shape instead
+        self.batchable = all(
+            (gb.vars.get(n) is None
+             or not getattr(gb.vars[n], "shape", None)
+             or int(gb.vars[n].shape[0]) < 0)
+            for n in self.feed_names)
+        # which fetches are per-row, decided STATICALLY from the program
+        # IR: a dynamic (-1) leading dim means the output scales with the
+        # batch and is sliced back per request; anything else (scalar,
+        # fixed-size table) is batch-global and replicated. None = shape
+        # unknown in the IR, fall back to a runtime dim check.
+        self._row_aligned = []
+        for n in self.fetch_names:
+            var = gb.vars.get(n)
+            shape = getattr(var, "shape", None) if var is not None else None
+            self._row_aligned.append(
+                None if not shape else int(shape[0]) < 0)
+
+    # -- compilation ------------------------------------------------------
+    def _compile(self, feed):
+        """AOT-compile the module for this feed signature and cache it."""
+        from .. import profiler as _prof
+        t0 = time.perf_counter()
+        with _prof.record_event("serving/compile_inner"):
+            lowered = self._infer.lower(self._state, feed)
+            compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        nbytes = self._executable_bytes(compiled, feed)
+        sig = feed_signature(feed)
+        self.cache.put(sig, compiled, nbytes=nbytes)
+        if self.stats:
+            self.stats.bump("compiles")
+            self.stats.hist["compile"].observe(dt)
+        else:
+            _prof.record_duration("serving/compile", dt)
+        return compiled
+
+    @staticmethod
+    def _executable_bytes(compiled, feed):
+        """Byte cost of a cache entry: XLA's own generated-code +
+        temp-buffer sizes when the backend reports them, else the feed
+        buffer size as a proportional lower bound."""
+        try:
+            ma = compiled.memory_analysis()
+            n = int(getattr(ma, "generated_code_size_in_bytes", 0)
+                    + getattr(ma, "temp_size_in_bytes", 0)
+                    + getattr(ma, "output_size_in_bytes", 0))
+            if n > 0:
+                return n
+        except Exception:  # noqa: BLE001 — backend-dependent surface
+            pass
+        return sum(a.nbytes for a in feed.values())
+
+    def _executable_for(self, feed):
+        sig = feed_signature(feed)
+        compiled = self.cache.get(sig)
+        if compiled is None:
+            compiled = self._compile(feed)
+        return compiled
+
+    # -- single-shot ------------------------------------------------------
+    def run(self, feeds):
+        """Run one feed dict as-is (no cross-request batching, still
+        cached): returns the fetch list as numpy arrays."""
+        feed = {n: np.ascontiguousarray(feeds[n]) for n in self.feed_names}
+        compiled = self._executable_for(feed)
+        outs = compiled(self._state, feed)
+        return [np.asarray(o) for o in outs]
+
+    # -- batched path (MicroBatcher flush target) -------------------------
+    def execute(self, requests):
+        """Execute a same-signature group of requests as one padded
+        batch. Delivers per-request results/errors; never raises for a
+        single bad request (the batch-level failure path is handled by
+        the MicroBatcher)."""
+        maybe_fail("serving.execute")
+        now = time.monotonic()
+        live = [r for r in requests if not r.done()]
+        if not live:
+            return
+        if not self.batchable:
+            # static-batch model: request-by-request at natural shape
+            for req in live:
+                try:
+                    outs = self.run(req.feeds)
+                    if self.stats:
+                        self.stats.observe_batch(req.rows, req.rows)
+                        self.stats.bump("requests_completed")
+                        self.stats.hist["total"].observe(
+                            time.monotonic() - req.t_enqueue)
+                    req.set_result(outs)
+                except Exception as exc:  # noqa: BLE001
+                    req.set_error(exc)
+                    if self.stats:
+                        self.stats.bump("requests_failed")
+            return
+
+        t_pad0 = time.perf_counter()
+        total = sum(r.rows for r in live)
+        bucket = next_bucket(total)
+        feed = {}
+        for name in self.feed_names:
+            parts = [r.feeds[name] for r in live]
+            arr = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            if bucket > total:
+                pad = np.zeros((bucket - total,) + arr.shape[1:],
+                               dtype=arr.dtype)
+                arr = np.concatenate([arr, pad])
+            feed[name] = np.ascontiguousarray(arr)
+        t_pad = time.perf_counter() - t_pad0
+        if self.stats:
+            self.stats.hist["pad"].observe(t_pad)
+
+        compiled = self._executable_for(feed)
+        t_exec0 = time.perf_counter()
+        outs = compiled(self._state, feed)
+        outs = [np.asarray(o) for o in outs]
+        t_exec = time.perf_counter() - t_exec0
+        if self.stats:
+            self.stats.hist["execute"].observe(t_exec)
+            self.stats.observe_batch(total, bucket)
+
+        off = 0
+        done_t = time.monotonic()
+        for req in live:
+            res = []
+            for o, aligned in zip(outs, self._row_aligned):
+                if aligned is None:
+                    aligned = bool(o.ndim) and o.shape[0] == bucket
+                if aligned:
+                    res.append(o[off:off + req.rows])
+                else:
+                    # batch-global output (scalar, fixed table): the
+                    # full tensor is replicated to every request
+                    res.append(o)
+            off += req.rows
+            req.set_result(res)
+            if self.stats:
+                self.stats.bump("requests_completed")
+                self.stats.hist["total"].observe(done_t - req.t_enqueue)
+
+    # -- warmup -----------------------------------------------------------
+    def feed_specs(self, batch_size=None):
+        """{name: (shape, dtype)} for warmup feeds; dynamic dims become
+        ``batch_size`` (leading) / 1 (others). Prefers the save-time
+        ``feed_specs`` record ``save_inference_model`` writes into
+        ``__model__`` (attached as ``program._feed_specs`` on load);
+        falls back to the program's feed vars for pre-upgrade saves."""
+        from ..framework.dtype import np_dtype
+        gb = self.program.global_block()
+        recorded = getattr(self.program, "_feed_specs", None) or {}
+        specs = {}
+        for n in self.feed_names:
+            rec = recorded.get(n)
+            if rec and rec.get("shape"):
+                shape = [int(d) for d in rec["shape"]]
+                dt = np_dtype(rec.get("dtype") or "float32")
+            else:
+                var = gb.vars.get(n)
+                shape = [int(d)
+                         for d in getattr(var, "shape", None) or (1,)]
+                dt = np_dtype(getattr(var, "dtype", "float32")
+                              or "float32")
+            for i, d in enumerate(shape):
+                if d < 0:
+                    shape[i] = int(batch_size or 1) if i == 0 else 1
+            specs[n] = (tuple(shape), np.dtype(dt).name)
+        return specs
+
+    def warmup(self, batch_sizes=(1,), signature_file=None):
+        """Precompile executables before taking traffic: one per bucket
+        size in ``batch_sizes`` (from the model's feed specs), plus every
+        signature in ``signature_file`` (a recorded-traffic file written
+        by ``record_signatures``; missing file is not an error — warmup
+        is best-effort by design). Returns the number of compiles."""
+        sigs = []
+        for b in batch_sizes or ():
+            sigs.append(self.feed_specs(batch_size=next_bucket(b)))
+        if signature_file:
+            path = signature_file
+            if path is True and self.model_dir:
+                path = os.path.join(self.model_dir, SIGNATURE_FILE)
+            if isinstance(path, str) and os.path.exists(path):
+                sigs.extend(ExecutableCache.load_signatures(path))
+        n = 0
+        for spec in sigs:
+            try:
+                feed = {name: np.zeros(shape, dtype=dtype)
+                        for name, (shape, dtype) in spec.items()}
+                if feed_signature(feed) not in self.cache:
+                    self._compile(feed)
+                    n += 1
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort
+                import warnings
+                warnings.warn(f"serving warmup skipped signature {spec}: "
+                              f"{type(e).__name__}: {e}", stacklevel=2)
+        return n
+
+    def record_signatures(self, path=None):
+        """Persist the cache's observed signatures for next launch's
+        warmup. Default path: ``<model_dir>/_serving_signatures.json``."""
+        if path is None:
+            if not self.model_dir:
+                raise ValueError("record_signatures needs a path when the "
+                                 "engine was not loaded from a model_dir")
+            path = os.path.join(self.model_dir, SIGNATURE_FILE)
+        self.cache.record(path)
+        return path

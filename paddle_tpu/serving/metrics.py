@@ -1,0 +1,142 @@
+"""Serving observability: per-stage latency histograms, throughput and
+batch-occupancy counters.
+
+Two export paths share one measurement: every stage duration lands in a
+fixed-bucket ``LatencyHistogram`` here (always on — integer bumps, no
+allocation) AND in ``paddle_tpu.profiler``'s event table via
+``profiler.record_duration`` (visible only while profiling is active, so
+``profiler.profiler()`` around a traffic replay yields the familiar
+Fluid-style table with ``serving/queue``, ``serving/pad``,
+``serving/compile``, ``serving/execute`` rows)."""
+import threading
+import time
+
+from .. import profiler as _prof
+
+# log-spaced upper bounds in milliseconds; the last bucket is +inf
+DEFAULT_BOUNDS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (observations in seconds, bounds in
+    ms). Percentiles are linear-interpolated within the winning bucket —
+    the standard prometheus-style estimate, good to a bucket width."""
+
+    def __init__(self, name, bounds_ms=DEFAULT_BOUNDS_MS):
+        self.name = name
+        self.bounds_ms = tuple(float(b) for b in bounds_ms)
+        self._counts = [0] * (len(self.bounds_ms) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds):
+        ms = seconds * 1e3
+        idx = len(self.bounds_ms)
+        for i, b in enumerate(self.bounds_ms):
+            if ms <= b:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += seconds
+            if seconds > self._max:
+                self._max = seconds
+        _prof.record_duration(self.name, seconds)
+
+    @property
+    def count(self):
+        return self._count
+
+    def percentile(self, p):
+        """p in [0, 100] -> estimated latency in seconds."""
+        with self._lock:
+            if not self._count:
+                return 0.0
+            target = self._count * (float(p) / 100.0)
+            seen = 0
+            for i, c in enumerate(self._counts):
+                if not c:
+                    continue
+                if seen + c >= target:
+                    lo = self.bounds_ms[i - 1] if i > 0 else 0.0
+                    hi = (self.bounds_ms[i]
+                          if i < len(self.bounds_ms) else self._max * 1e3)
+                    frac = (target - seen) / c
+                    return (lo + (max(hi, lo) - lo) * frac) / 1e3
+                seen += c
+            return self._max
+
+    def snapshot(self):
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+        return {
+            "count": count,
+            "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
+            "p50_ms": round(self.percentile(50) * 1e3, 3),
+            "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "max_ms": round(mx * 1e3, 3),
+        }
+
+
+class ServingStats:
+    """One shared stats sink for queue, batcher, engine and server: stage
+    histograms plus monotonic counters. ``snapshot()`` is the
+    ``server.stats()`` payload — plain ints/floats only, so it crosses
+    the wire protocol's typed value universe unchanged."""
+
+    STAGES = ("queue", "pad", "compile", "execute", "total")
+
+    def __init__(self):
+        self.hist = {s: LatencyHistogram(f"serving/{s}")
+                     for s in self.STAGES}
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        self._c = {
+            "requests_admitted": 0,
+            "requests_completed": 0,
+            "requests_failed": 0,
+            "shed_overload": 0,
+            "shed_deadline": 0,
+            "batches": 0,
+            "rows": 0,            # real example rows executed
+            "padded_rows": 0,     # bucket capacity across executed batches
+            "compiles": 0,
+        }
+
+    def bump(self, name, n=1):
+        with self._lock:
+            self._c[name] += n
+
+    def observe_batch(self, rows, capacity):
+        with self._lock:
+            self._c["batches"] += 1
+            self._c["rows"] += rows
+            self._c["padded_rows"] += capacity
+
+    def counter(self, name):
+        with self._lock:
+            return self._c[name]
+
+    def snapshot(self, extra=None):
+        with self._lock:
+            c = dict(self._c)
+            uptime = time.monotonic() - self._started
+        out = {"uptime_s": round(uptime, 3)}
+        out.update(c)
+        out["throughput_rps"] = round(
+            c["requests_completed"] / uptime, 3) if uptime > 0 else 0.0
+        out["mean_batch_size"] = round(
+            c["rows"] / c["batches"], 3) if c["batches"] else 0.0
+        out["batch_occupancy"] = round(
+            c["rows"] / c["padded_rows"], 4) if c["padded_rows"] else 0.0
+        for s, h in self.hist.items():
+            snap = h.snapshot()
+            for k, v in snap.items():
+                out[f"{s}_{k}"] = v
+        if extra:
+            out.update(extra)
+        return out

@@ -1,0 +1,369 @@
+"""Thread-based prediction service over the PS wire framing.
+
+``InferenceServer`` turns a saved inference model into a multi-client
+service: connection threads speak the length-prefixed, HMAC-optional
+frame protocol from ``distributed/wire.py`` (so ``WireTruncationError``
+and the PR-1 retry semantics apply unchanged), admission happens on the
+connection thread (backpressure is refused in O(1), never queued), and
+one MicroBatcher thread feeds the chip padded batches.
+
+Wire protocol (all values inside the typed wire universe):
+
+    request  {"op": "infer", "feed": {name: ndarray},
+              "deadline_ms": float|None}
+    reply    {"ok": True, "fetch": (ndarray, ...), "batched": int}
+           | {"ok": False, "etype": "DeadlineExceeded"|"Overloaded"
+                                    |"BadRequest"|"Internal",
+              "error": str}
+    request  {"op": "stats"}   -> {"ok": True, "stats": {...}}
+    request  {"op": "ping"}    -> {"ok": True}
+
+Deadline semantics: ``deadline_ms`` is a budget measured from ADMISSION
+at the server (transit time is the client's problem; clocks never need
+agreement). It is checked at admission, when the batch forms, and the
+expiry reply carries how long the request actually waited. A request
+that expires mid-execution still completes and returns its result — the
+chip's work is never thrown away.
+"""
+import socket
+import threading
+
+import numpy as np
+
+from .batching import (DeadlineExceededError, MicroBatcher, Request,
+                       RequestQueue, ServerOverloadedError)
+from .engine import ServingEngine
+from .metrics import ServingStats
+from ..distributed.wire import (WireError, default_key, recv_frame,
+                                send_frame)
+
+
+class ServingConfig:
+    """Knobs, defaulting from ``FLAGS_serving_*`` (env-overridable like
+    every other flag): batching shape, queue depth, deadlines, cache
+    caps, load-shed breaker tuning."""
+
+    _FLAG_FIELDS = {
+        "max_batch_size": "serving_max_batch_size",
+        "batch_timeout_ms": "serving_batch_timeout_ms",
+        "queue_depth": "serving_queue_depth",
+        "default_deadline_ms": "serving_default_deadline_ms",
+        "cache_entries": "serving_cache_entries",
+        "cache_bytes": "serving_cache_bytes",
+        "shed_failures": "serving_shed_failures",
+        "shed_reset_secs": "serving_shed_reset_secs",
+    }
+
+    def __init__(self, **overrides):
+        from ..flags import flag
+        for field, fname in self._FLAG_FIELDS.items():
+            setattr(self, field, overrides.pop(field, None)
+                    if field in overrides else flag(fname))
+            if getattr(self, field) is None:
+                setattr(self, field, flag(fname))
+        if overrides:
+            raise TypeError(f"unknown ServingConfig fields: "
+                            f"{sorted(overrides)}")
+
+
+class InferenceServer:
+    """Multi-client serving front-end. In-process use:
+
+        server = InferenceServer(model_dir).start()
+        out = server.infer({"x": batch})          # or submit() for async
+
+    Network use: ``start()`` also binds a socket (default loopback,
+    OS-assigned port) and ``Client(server.endpoint)`` speaks the wire
+    protocol. Authentication mirrors the PS transport: set
+    ``PADDLE_PS_AUTH_KEY`` on both ends (required for non-loopback binds
+    unless ``allow_insecure=True``)."""
+
+    def __init__(self, model_dir=None, *, engine=None, config=None,
+                 host="127.0.0.1", port=0, auth_key=None,
+                 allow_insecure=False, **config_overrides):
+        self.config = config or ServingConfig(**config_overrides)
+        self.stats_sink = ServingStats()
+        if engine is None:
+            from .cache import ExecutableCache
+            cache = ExecutableCache(max_entries=self.config.cache_entries,
+                                    max_bytes=self.config.cache_bytes)
+            engine = ServingEngine(model_dir, cache=cache,
+                                   stats=self.stats_sink)
+        else:
+            engine.stats = engine.stats or self.stats_sink
+        self.engine = engine
+        self.queue = RequestQueue(max_depth=self.config.queue_depth,
+                                  stats=self.stats_sink)
+        self.batcher = MicroBatcher(
+            self.queue, self.engine.execute,
+            max_batch_size=self.config.max_batch_size,
+            batch_timeout_ms=self.config.batch_timeout_ms,
+            stats=self.stats_sink)
+        self.host = host
+        self.port = int(port)
+        self._key = auth_key if auth_key is not None else default_key()
+        self._allow_insecure = allow_insecure
+        self._sock = None
+        self._stop = threading.Event()
+        self._threads = []
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def start(self, serve_network=True, warmup_batch_sizes=None,
+              warmup_signature_file=None):
+        """Start the batcher (always) and the socket front-end (unless
+        ``serve_network=False`` for purely in-process serving). Optional
+        warmup precompiles before the first byte of traffic."""
+        if warmup_batch_sizes or warmup_signature_file:
+            self.engine.warmup(batch_sizes=warmup_batch_sizes or (),
+                               signature_file=warmup_signature_file)
+        self.batcher.start()
+        if serve_network:
+            loopback = (self.host.startswith("127.")
+                        or self.host in ("localhost", "::1"))
+            if not loopback and self._key is None \
+                    and not self._allow_insecure:
+                raise PermissionError(
+                    f"refusing to bind the inference server on "
+                    f"non-loopback {self.host}:{self.port} without "
+                    f"authentication — set PADDLE_PS_AUTH_KEY (both "
+                    f"ends) or pass allow_insecure=True")
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+            self._sock.listen(128)
+            t = threading.Thread(target=self._accept_loop, daemon=True,
+                                 name="serving-accept")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # close accepted connections too: a keep-alive client blocked in
+        # recv_frame on the other end holds its handler thread forever
+        # otherwise (the _stop flag is only re-checked between frames)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.queue.close()
+        self.batcher.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- in-process client path -------------------------------------------
+    def submit(self, feeds, deadline_ms=None):
+        """Admit a request (raises ServerOverloadedError /
+        DeadlineExceededError at the door); returns the Request — call
+        ``.wait()`` for the fetch list."""
+        if deadline_ms is None and self.config.default_deadline_ms > 0:
+            deadline_ms = self.config.default_deadline_ms
+        return self.queue.put(Request(feeds, deadline_ms=deadline_ms))
+
+    def infer(self, feeds, deadline_ms=None, timeout=None):
+        return self.submit(feeds, deadline_ms=deadline_ms).wait(
+            timeout=timeout)
+
+    def stats(self):
+        """One snapshot across every stage: admission counters, stage
+        latency histograms, batch occupancy, executable-cache hit/miss/
+        evict, queue depth."""
+        extra = {"queue_depth": len(self.queue),
+                 "breaker_state": self.queue.breaker.state}
+        for k, v in self.engine.cache.stats().items():
+            extra[f"cache_{k}"] = v
+        return self.stats_sink.snapshot(extra=extra)
+
+    def record_signatures(self, path=None):
+        return self.engine.record_signatures(path)
+
+    # -- network front-end ------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="serving-conn")
+            t.start()
+            # prune finished connection threads so a long-lived server
+            # doesn't accumulate one dead handle per past client
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_frame(conn, self._key)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                except WireError:
+                    # unauthenticated/malformed frame: drop the
+                    # connection (same policy as the PS server)
+                    return
+                reply = self._handle(msg)
+                try:
+                    send_frame(conn, reply, self._key)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg):
+        if not isinstance(msg, dict) or "op" not in msg:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "expected a dict with an 'op' field"}
+        op = msg["op"]
+        if op == "ping":
+            return {"ok": True}
+        if op == "stats":
+            return {"ok": True, "stats": self.stats()}
+        if op != "infer":
+            return {"ok": False, "etype": "BadRequest",
+                    "error": f"unknown op {op!r}"}
+        try:
+            feed = msg.get("feed")
+            if not isinstance(feed, dict) or not feed:
+                raise ValueError("'feed' must be a non-empty dict of "
+                                 "arrays")
+            missing = [n for n in self.engine.feed_names if n not in feed]
+            if missing:
+                raise ValueError(f"missing feeds: {missing}")
+            feed = {n: np.asarray(feed[n])
+                    for n in self.engine.feed_names}
+            req = self.submit(feed, deadline_ms=msg.get("deadline_ms"))
+        except ServerOverloadedError as e:
+            return {"ok": False, "etype": "Overloaded", "error": str(e)}
+        except DeadlineExceededError as e:
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": str(e)}
+        except (ValueError, TypeError) as e:
+            return {"ok": False, "etype": "BadRequest", "error": str(e)}
+        # bound the wait: the deadline (if any) plus compile/execute
+        # headroom, else a hard server-side cap
+        budget = msg.get("deadline_ms")
+        wait_s = (budget / 1e3 + 60.0) if budget else 300.0
+        try:
+            outs = req.wait(timeout=wait_s)
+            return {"ok": True, "fetch": tuple(outs),
+                    "batched": int(req.rows)}
+        except DeadlineExceededError as e:
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": str(e)}
+        except ServerOverloadedError as e:
+            return {"ok": False, "etype": "Overloaded", "error": str(e)}
+        except Exception as e:  # noqa: BLE001 — surface, don't die
+            return {"ok": False, "etype": "Internal",
+                    "error": f"{type(e).__name__}: {e}"}
+
+
+_ETYPES = {"DeadlineExceeded": DeadlineExceededError,
+           "Overloaded": ServerOverloadedError}
+
+
+class Client:
+    """Wire-protocol client. One socket, serial request/reply (run one
+    Client per concurrent caller — sockets are cheap; the server batches
+    across them). Transport failures surface as ConnectionError
+    subclasses (``WireTruncationError`` included), so callers can wrap
+    ``infer`` in ``resilience.retry_call`` — inference is idempotent."""
+
+    def __init__(self, endpoint, auth_key=None, timeout=None,
+                 connect_retries=20):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._addr = (host, int(port))
+        self._key = auth_key if auth_key is not None else default_key()
+        self._timeout = timeout
+        self._connect_retries = connect_retries
+        self._sock = None
+
+    def _ensure(self):
+        if self._sock is None:
+            from ..resilience import retry_call
+            self._sock = retry_call(
+                lambda: socket.create_connection(
+                    self._addr, timeout=self._timeout),
+                deadline=10.0, retries=self._connect_retries,
+                what="serving connect", endpoint=self.endpoint)
+        return self._sock
+
+    def _call(self, msg):
+        sock = self._ensure()
+        try:
+            send_frame(sock, msg, self._key, timeout=self._timeout)
+            reply = recv_frame(sock, self._key, timeout=self._timeout)
+        except (ConnectionError, OSError):
+            self.close()
+            raise
+        if not isinstance(reply, dict):
+            raise WireError(f"malformed serving reply: {type(reply)}")
+        if reply.get("ok"):
+            return reply
+        etype = _ETYPES.get(reply.get("etype"), RuntimeError)
+        raise etype(reply.get("error", "serving request failed"))
+
+    def infer(self, feeds, deadline_ms=None):
+        """Returns the fetch list (numpy arrays). Raises
+        DeadlineExceededError / ServerOverloadedError mapped from the
+        server's reply, ConnectionError on transport failure."""
+        reply = self._call({"op": "infer", "feed": dict(feeds),
+                            "deadline_ms": deadline_ms})
+        return [np.asarray(a) for a in reply["fetch"]]
+
+    def stats(self):
+        return self._call({"op": "stats"})["stats"]
+
+    def ping(self):
+        return bool(self._call({"op": "ping"}).get("ok"))
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -7,6 +7,7 @@ import sys
 import tempfile
 
 import numpy as np
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -38,6 +39,14 @@ def test_role_makers():
     assert rm.is_server() and rm.get_current_endpoint() == "127.0.0.1:7100"
 
 
+@pytest.mark.skipif(
+    os.environ.get("PADDLE_TPU_MULTIPROC_TESTS") != "1",
+    reason="this jaxlib's CPU backend cannot execute cross-process "
+           "computations (XlaRuntimeError: \"Multiprocess computations "
+           "aren't implemented on the CPU backend\" from the jitted "
+           "all-reduce step) — set PADDLE_TPU_MULTIPROC_TESTS=1 to run "
+           "on a backend with multiprocess collectives (real TPU pod or "
+           "a jaxlib built with CPU collectives)")
 def test_fleet_collective_two_process_parity():
     """2 worker processes through the launcher: both ranks' losses are
     identical (dp all-reduce over jax.distributed) and match a local
