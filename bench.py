@@ -26,6 +26,9 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
   gpt_long            extra: GPT-base causal LM at seq 2048 through the
                       flash kernel's causal path (upper-triangle blocks
                       skipped)
+  train_loop          extra: fused multi-step loop A/B — steps/sec at
+                      Executor.run_steps K in {1, 8, 32} on the
+                      mnist-size config (dispatch-bound small-model fix)
 """
 import json
 import os
@@ -605,6 +608,86 @@ def bench_gpt_long():
                             _gpt_train_flops_per_sample(cfg, seq_len))
 
 
+def bench_train_loop():
+    """Fused multi-step training loop (Executor.run_steps): steps/sec on
+    the mnist-size config at steps_per_run K in {1, 8, 32}. K=1 is the
+    classic one-dispatch-per-step Executor.run loop; fused K lowers the
+    whole slab into one jitted lax.scan, so Python dispatch, feed
+    binding, and fetch materialization amortize over K steps. On an
+    accelerator behind a dispatch-bound link this is the BENCH_r05 mnist
+    fix; the CPU path is a fast smoke (exercised by a non-slow test)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.lenet import build_lenet_train
+    dev = jax.devices()[0]
+    on_accel = dev.platform in ("tpu", "gpu", "axon")
+    if on_accel:
+        batch, slabs, warmup_slabs = 512, 6, 2
+    else:
+        batch, slabs, warmup_slabs = 64, 3, 1
+    main_prog, startup, _, fetches = build_lenet_train()
+    loss_name = fetches[0].name
+    rng = np.random.default_rng(0)
+    pool = [{"img": rng.standard_normal(
+                 (batch, 1, 28, 28)).astype(np.float32),
+             "label": rng.integers(0, 10, (batch, 1)).astype(np.int64)}
+            for _ in range(2)]
+
+    per_k = {}
+    for k in (1, 8, 32):
+        # device-resident slabs: one slab per pool entry, rotating — the
+        # same no-tunnel-flattery protocol as _device_pool
+        import itertools
+        import jax.numpy as jnp
+        staged = [{n: jax.device_put(np.broadcast_to(
+                       v[None], (k,) + v.shape).copy())
+                   for n, v in b.items()} for b in pool]
+        for b in staged:
+            for v in b.values():
+                float(jnp.sum(v.astype(jnp.float32)))
+        it = itertools.cycle(staged)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            def one(slab):
+                if k == 1:
+                    row = {n: a[0] for n, a in slab.items()}
+                    return exe.run(main_prog, feed=row,
+                                   fetch_list=[loss_name],
+                                   return_numpy=False)
+                # unroll=0 (auto): loop form on accelerators, full
+                # unroll on CPU where while-loop bodies drop threading
+                return exe.run_steps(main_prog, feed=slab,
+                                     fetch_list=[loss_name],
+                                     return_numpy=False, unroll=0)
+            for _ in range(max(warmup_slabs, 1)):
+                out = one(next(it))
+            lv = np.asarray(out[0]).reshape(-1)[-1]   # hard sync
+            t0 = time.perf_counter()
+            for _ in range(slabs):
+                for _ in range(32 // k):  # equal STEP counts per config
+                    out = one(next(it))
+            lv = float(np.asarray(out[0]).reshape(-1)[-1])
+            dt = time.perf_counter() - t0
+        assert np.isfinite(lv), lv
+        per_k[str(k)] = {
+            "steps_per_sec": round(slabs * 32 / dt, 2),
+            "samples_per_sec": round(slabs * 32 * batch / dt, 1),
+        }
+    base = per_k["1"]["steps_per_sec"]
+    for k, row in per_k.items():
+        row["speedup_vs_k1"] = round(row["steps_per_sec"] / base, 2)
+    return {
+        "metric": "train_loop_fused_k8_steps_per_sec",
+        "value": per_k["8"]["steps_per_sec"],
+        "unit": "steps/sec",
+        "vs_baseline": None,       # intra-repo A/B, no external anchor
+        "batch": batch,
+        "k": per_k,
+    }
+
+
 def bench_serving():
     """Serving runtime through the wire protocol: 8 concurrent clients,
     request batch sizes {1, 8, 32} (the BENCHMARKS.md serving entry).
@@ -690,6 +773,7 @@ _CONFIGS = {
     "gpt_long": (bench_gpt_long,
                  "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
     "serving": (bench_serving, "serving_mlp_batch32_samples_per_sec"),
+    "train_loop": (bench_train_loop, "train_loop_fused_k8_steps_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

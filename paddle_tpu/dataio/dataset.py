@@ -99,6 +99,38 @@ class DatasetBase:
             out[n] = np.stack([s[i] for s in buf])
         return out
 
+    @staticmethod
+    def _slab_batches(batches, k):
+        """Group consecutive same-shape batches into slabs: dicts with a
+        new leading axis of up to `k` steps, the feed format of
+        Executor.run_steps. EVERY shape change flushes the open slab
+        early so slabs stay homogeneous — for a fixed-shape stream only
+        the tail is short, but variable-shape streams (bucketed
+        sequence lengths) flush at each bucket switch and those short
+        slabs run unfused (train_from_dataset falls back to per-step
+        run() for them); pad/bucket to a stable shape to keep fusion."""
+        buf, sig = [], None
+        for b in batches:
+            # np.shape/getattr: batch values may be plain lists/scalars
+            # (run() feeds accept them, so the collator must too)
+            s = {n: (np.shape(a), str(getattr(a, "dtype", "")))
+                 for n, a in b.items()}
+            if buf and s != sig:
+                yield DatasetBase._stack_slab(buf)
+                buf = []
+            sig = s
+            buf.append(b)
+            if len(buf) == k:
+                yield DatasetBase._stack_slab(buf)
+                buf = []
+        if buf:
+            yield DatasetBase._stack_slab(buf)
+
+    @staticmethod
+    def _stack_slab(buf):
+        return {n: np.stack([np.asarray(b[n]) for b in buf])
+                for n in buf[0]}
+
 
 class QueueDataset(DatasetBase):
     """Streaming: parse + batch on the fly (reference QueueDataset). When
@@ -121,15 +153,19 @@ class QueueDataset(DatasetBase):
                 and self.pipe_command is None and self.use_vars
                 and native_feed.available())
 
-    def batch_iterator(self):
+    def batch_iterator(self, slab=None):
         if self._native_ok():
             from .native_feed import NativeDataFeed
             slots = [(v.name, "int64" if "int" in v.dtype else "float32")
                      for v in self.use_vars]
-            return iter(NativeDataFeed(
+            it = iter(NativeDataFeed(
                 slots, self._shard_files(), self.batch_size,
                 threads=max(self.thread_num, 1)))
-        return self._batches(self._iter_files(self._shard_files()))
+        else:
+            it = self._batches(self._iter_files(self._shard_files()))
+        if slab and slab > 1:
+            return self._slab_batches(it, int(slab))
+        return it
 
 
 class InMemoryDataset(DatasetBase):
@@ -266,5 +302,8 @@ class InMemoryDataset(DatasetBase):
     def get_shuffle_data_size(self, fleet=None):
         return len(self._samples)
 
-    def batch_iterator(self):
-        return self._batches(iter(self._samples))
+    def batch_iterator(self, slab=None):
+        it = self._batches(iter(self._samples))
+        if slab and slab > 1:
+            return self._slab_batches(it, int(slab))
+        return it

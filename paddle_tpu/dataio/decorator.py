@@ -8,6 +8,20 @@ import random
 import threading
 
 
+def put_until_closed(q, item, closed, tick=0.05):
+    """Blocking queue put that gives up once `closed` is set — the
+    closeable timeout-put shared by buffered() and reader._QueueIterator
+    so an abandoned consumer never strands a producer thread mid-put.
+    Returns True when the item was enqueued."""
+    while not closed.is_set():
+        try:
+            q.put(item, timeout=tick)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 def batch(reader, batch_size, drop_last=False):
     def batch_reader():
         buf = []
@@ -38,31 +52,57 @@ def shuffle(reader, buf_size, seed=None):
 
 def buffered(reader, size):
     """Background-thread prefetch of up to `size` samples (reference
-    decorator.py buffered — the host-side half of double buffering)."""
+    decorator.py buffered — the host-side half of double buffering).
+
+    The producer uses a closeable timeout-put: when the consumer
+    abandons the generator early (break / GeneratorExit), the close
+    event is set, the producer drains out of its blocked put within one
+    timeout tick and exits — no daemon thread leaks per abandoned
+    reader, and the source reader's own generator is closed too."""
     end = object()
 
     def buffered_reader():
         q = queue.Queue(maxsize=size)
         err = []
+        closed = threading.Event()
 
         def fill():
+            it = reader()
             try:
-                for sample in reader():
-                    q.put(sample)
+                for sample in it:
+                    if not put_until_closed(q, sample, closed):
+                        return
             except BaseException as e:  # propagate into the consumer
                 err.append(e)
             finally:
-                q.put(end)
+                close_fn = getattr(it, "close", None)
+                if close_fn is not None:
+                    try:
+                        close_fn()
+                    except BaseException as e:
+                        # a raising cleanup must not swallow the end
+                        # sentinel (the consumer would block forever)
+                        err.append(e)
+                put_until_closed(q, end, closed)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            s = q.get()
-            if s is end:
-                if err:
-                    raise err[0]
-                return
-            yield s
+        try:
+            while True:
+                s = q.get()
+                if s is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield s
+        finally:
+            closed.set()
+            try:  # unblock a producer mid-put; drop whatever it queued
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=1.0)
     return buffered_reader
 
 

@@ -58,28 +58,21 @@ class _QueueIterator:
         self.thread.start()
 
     def _fill(self, gen_fn):
+        from .decorator import put_until_closed
         try:
             for item in gen_fn():
-                while not self._closed.is_set():
-                    try:
-                        self.q.put(item, timeout=0.05)
-                        break
-                    except queue.Full:
-                        continue
-                if self._closed.is_set():
+                if not put_until_closed(self.q, item, self._closed):
                     return
         except BaseException as e:
             self.err.append(e)
         finally:
-            while not self._closed.is_set():
-                try:
-                    self.q.put(self._END, timeout=0.05)
-                    break
-                except queue.Full:
-                    continue
+            put_until_closed(self.q, self._END, self._closed)
 
     def close(self):
-        """Stop the producer and drop queued batches (early-exit path)."""
+        """Stop the producer and drop queued batches (early-exit path).
+        Joins the producer thread with a bounded timeout so early-exiting
+        loops (and pytest teardown) don't accumulate live threads — the
+        drain above guarantees its timeout-put unblocks within a tick."""
         self._closed.set()
         try:
             while True:
@@ -87,6 +80,13 @@ class _QueueIterator:
         except queue.Empty:
             pass
         self._pending = None
+        t = getattr(self, "thread", None)
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            try:
+                t.join(timeout=1.0)
+            except RuntimeError:
+                pass  # interpreter shutdown
 
     __del__ = close
 

@@ -49,6 +49,21 @@ _DEFS = {
     # Executor per-(program, feed-shape) compile cache entry cap — bounds
     # what was previously unbounded growth per input-shape signature
     "executor_cache_entries": (128, int, None),
+    # -- fused multi-step training loop (Executor.run_steps) --
+    # default K for train_from_dataset: K steps compile into ONE jitted
+    # lax.scan over a stacked feed slab (1 = unfused per-step dispatch)
+    "steps_per_run": (1, int, None),
+    # materialize fetches only on every N-th slab / print_period hit;
+    # in-between slabs run a fetch-free executable (1 = every slab)
+    "fetch_every_n": (1, int, None),
+    # run_steps scan unroll factor. 1 (default) = loop form: bitwise
+    # parity with sequential run() and K-independent compile time.
+    # 0 = auto: full unroll on the CPU backend (XLA CPU runs while-loop
+    # bodies without intra-op threading, so the loop form serializes
+    # convs), loop form on accelerators. N>1 unrolls N steps per loop
+    # iteration. Unrolled steps may fuse across step boundaries —
+    # numerically equivalent but not bit-identical to sequential run().
+    "scan_unroll": (1, int, None),
     "cudnn_deterministic": (False, bool, None),
     "cpu_deterministic": (False, bool, None),
     "benchmark": (False, bool, None),

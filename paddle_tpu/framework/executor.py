@@ -9,13 +9,15 @@ fetch-list) key and replays the compiled XLA executable — there is no per-op
 dispatch loop, no per-run InferShape, and no feed/fetch op injection; feeds
 bind directly into the traced env and fetches read out of it.
 """
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .core import Program, Variable, default_main_program
 from .dtype import np_dtype
-from .lowering import analyze_block_io, build_block_fn
+from .lowering import analyze_block_io, build_block_fn, build_multi_step_fn
 from ..flags import flag as _flag
 from ..resilience import NonFiniteError
 
@@ -141,6 +143,17 @@ def _check_int64_feed(name, arr):
                 f"int64 policy)")
 
 
+def _sanitize_np_feed(gblock, name, arr):
+    """Host-feed sanitation shared by run/run_steps/_device_put_slab:
+    cast to the program var's dtype and validate int64 range at the
+    feed boundary (np-path only — device arrays are already placed)."""
+    var = gblock.vars.get(name) if gblock is not None else None
+    if var is not None and arr.dtype != np_dtype(var.dtype):
+        arr = arr.astype(np_dtype(var.dtype))
+    _check_int64_feed(name, arr)
+    return arr
+
+
 class Executor:
     """Compile-and-run executor with a program cache
     (the reference caches prepared contexts at executor.py:1169; we cache
@@ -177,6 +190,30 @@ class Executor:
         for f in fetch_list or []:
             names.append(f.name if isinstance(f, Variable) else str(f))
         return names
+
+    @staticmethod
+    def _split_scope_state(scope, state_in, state_out_set):
+        """Bind state_in vars from the scope into (mutable, read-only)
+        dicts — shared by run() and run_steps()."""
+        state_mut, state_ro = {}, {}
+        for n in state_in:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable {n!r} is not initialized in the scope — "
+                    f"run the startup program first (fluid semantics: "
+                    f"exe.run(fluid.default_startup_program()))")
+            (state_mut if n in state_out_set else state_ro)[n] = v
+        return state_mut, state_ro
+
+    @staticmethod
+    def _reshard_state_to_scope(scope, program, mesh, state_mut, state_ro):
+        """Place state per dist_attr and write resharded arrays back so
+        later runs see them already placed — shared by run()/run_steps()."""
+        for st in (state_mut, state_ro):
+            if _shard_state(st, mesh, program):
+                for n, a in st.items():
+                    scope.set(n, a)
 
     def _ensure_rng(self, scope, program):
         key = scope.find_var(RNG_STATE_NAME)
@@ -221,10 +258,7 @@ class Executor:
         for name, val in feed.items():
             arr = np.asarray(val) if not isinstance(val, jax.Array) else val
             if isinstance(arr, np.ndarray):
-                var = program.global_block().vars.get(name)
-                if var is not None and arr.dtype != np_dtype(var.dtype):
-                    arr = arr.astype(np_dtype(var.dtype))
-                _check_int64_feed(name, arr)
+                arr = _sanitize_np_feed(program.global_block(), name, arr)
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 
@@ -250,24 +284,14 @@ class Executor:
 
         base_key = self._ensure_rng(scope, program)
         state_out_set = set(state_out)
-        state_mut, state_ro = {}, {}
-        for n in state_in:
-            v = scope.find_var(n)
-            if v is None:
-                raise RuntimeError(
-                    f"variable {n!r} is not initialized in the scope — run "
-                    f"the startup program first (fluid semantics: "
-                    f"exe.run(fluid.default_startup_program()))")
-            (state_mut if n in state_out_set else state_ro)[n] = v
+        state_mut, state_ro = self._split_scope_state(scope, state_in,
+                                                      state_out_set)
 
         if mesh is not None:
             feed_arrays = _shard_feed(feed_arrays, mesh, program)
-            # write resharded arrays back so later runs see them already
-            # placed (esp. read-only params of inference programs)
-            for st in (state_mut, state_ro):
-                if _shard_state(st, mesh, program):
-                    for n, a in st.items():
-                        scope.set(n, a)
+            # esp. read-only params of inference programs
+            self._reshard_state_to_scope(scope, program, mesh, state_mut,
+                                         state_ro)
 
         if check_nan_inf is None:
             check_nan_inf = _flag("check_nan_inf")
@@ -322,6 +346,236 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return fetches
 
+    # -- fused multi-step entry -----------------------------------------
+    def run_steps(self, program=None, feed=None, fetch_list=None,
+                  scope=None, return_numpy=True, use_program_cache=True,
+                  check_nan_inf=None, skip_nonfinite_steps=False,
+                  steps_per_run=None, unroll=None):
+        """Run K training steps as ONE compiled executable: a jitted
+        ``lax.scan`` over feeds stacked on a leading K axis (a "slab").
+        Bitwise-identical to K sequential :meth:`run` calls — state
+        threads through the scan carry with buffer donation and the RNG
+        chain advances per step exactly as the unfused path does — but
+        pays Python dispatch, H2D binding, and (optionally) fetch
+        materialization once per slab instead of once per step.
+
+        `feed` is either a dict of arrays with a leading K axis or a list
+        of K per-step feed dicts (stacked here). Fetches come back
+        stacked on a leading K axis, transferred in ONE device->host copy
+        when `return_numpy` (device arrays, sync-free, otherwise).
+
+        ``check_nan_inf`` (default FLAGS_check_nan_inf) compiles an
+        on-device guard into the scan: each step emits a non-finite
+        violation count + first-offender slot index, and the host reads
+        back one small int vector per slab — no parameter transfer.
+        NOTE: the raised NonFiniteError names the FIRST bad step, but
+        all K steps have executed and the scope holds end-of-slab state
+        (stopping mid-slab would need a per-step host sync — the cost
+        this path removes). To preserve usable state past a bad batch
+        use ``skip_nonfinite_steps`` (in-graph rollback); for
+        first-failure forensics run with steps_per_run=1.
+        ``skip_nonfinite_steps`` compiles the rollback IN-GRAPH: a
+        ``lax.cond`` selects the pre-step state (and pre-step RNG key)
+        when the step produced non-finite values, so no host backup
+        copies exist and mesh-sharded state rolls back without a gather.
+
+        ``unroll`` (default FLAGS_scan_unroll) is the scan unroll
+        factor. The loop form (1) is bitwise-identical to sequential
+        run(); 0 = auto picks full unroll on the CPU backend (whose
+        while-loop bodies lose intra-op threading) — unrolled steps may
+        fuse across step boundaries, numerically equivalent but not
+        bit-identical.
+        """
+        from ..parallel.compiler import CompiledProgram
+        mesh = None
+        if isinstance(program, CompiledProgram):
+            mesh = program.mesh
+            program = program.program
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        if isinstance(feed, (list, tuple)):
+            feed = _stack_feed_slab([self._feed_dict(f) for f in feed])
+        feed = self._feed_dict(feed)
+        if not feed:
+            raise ValueError(
+                "run_steps needs at least one fed variable: the slab's "
+                "leading axis defines the step count")
+        fetch_names = self._fetch_names(fetch_list)
+
+        feed_arrays = {}
+        feed_sig = []
+        k_steps = None
+        for name, val in feed.items():
+            arr = np.asarray(val) if not isinstance(val, jax.Array) else val
+            if arr.ndim == 0:
+                raise ValueError(
+                    f"feed {name!r} is a scalar — run_steps feeds need a "
+                    f"leading steps axis")
+            if k_steps is None:
+                k_steps = int(arr.shape[0])
+            elif int(arr.shape[0]) != k_steps:
+                raise ValueError(
+                    f"feed {name!r} has {arr.shape[0]} steps on its "
+                    f"leading axis, other feeds have {k_steps}")
+            if isinstance(arr, np.ndarray):
+                arr = _sanitize_np_feed(program.global_block(), name, arr)
+            feed_arrays[name] = arr
+            feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
+        if steps_per_run is not None and int(steps_per_run) != k_steps:
+            raise ValueError(
+                f"steps_per_run={steps_per_run} but the fed slab carries "
+                f"{k_steps} steps on its leading axis")
+
+        if check_nan_inf is None:
+            check_nan_inf = _flag("check_nan_inf")
+        guard = bool(check_nan_inf or skip_nonfinite_steps)
+        if unroll is None:
+            unroll = _flag("scan_unroll")
+        unroll = int(unroll)
+        if unroll <= 0:
+            # auto: XLA CPU runs while-loop bodies without intra-op
+            # threading — full unroll restores it; accelerators keep the
+            # loop form so compile time stays K-independent
+            unroll = k_steps if jax.default_backend() == "cpu" else 1
+
+        cache_key = (program._uid, program.version,
+                     tuple(sorted(feed_sig)), tuple(fetch_names), id(mesh),
+                     "steps", k_steps, guard, bool(skip_nonfinite_steps),
+                     unroll)
+        entry = self._cache.get(cache_key) if use_program_cache else None
+        fresh_compile = entry is None
+        if entry is not None:
+            (jitted, state_in, state_out, mut_names, slot_names,
+             wo_avals) = entry
+        else:
+            state_in, state_out = analyze_block_io(
+                program, 0, list(feed_arrays.keys()))
+
+        base_key = self._ensure_rng(scope, program)
+        state_out_set = set(state_out)
+        state_mut, state_ro = self._split_scope_state(scope, state_in,
+                                                      state_out_set)
+
+        if mesh is not None:
+            feed_arrays = _shard_feed_slab(feed_arrays, mesh)
+            self._reshard_state_to_scope(scope, program, mesh, state_mut,
+                                         state_ro)
+
+        from .. import profiler as _prof
+        if fresh_compile:
+            with _prof.record_event(
+                    f"compile/fused_program_{program._uid}_x{k_steps}"):
+                step_fn = build_block_fn(
+                    program, 0, list(feed_arrays.keys()), fetch_names,
+                    state_in, state_out, mesh=mesh)
+                feed_row = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                            for n, a in feed_arrays.items()}
+                _, new_state_s, _ = jax.eval_shape(
+                    step_fn, state_mut, state_ro, feed_row, base_key)
+                mut_names = [n for n in state_in if n in state_out_set]
+                slot_names = (["fetched output " + repr(n)
+                               for n in fetch_names]
+                              + ["updated variable " + repr(n)
+                                 for n in new_state_s])
+                wo_avals = {n: jax.ShapeDtypeStruct(s.shape, s.dtype)
+                            for n, s in new_state_s.items()
+                            if n not in state_mut}
+                fn = build_multi_step_fn(
+                    program, 0, list(feed_arrays.keys()), fetch_names,
+                    state_in, state_out, mut_names, mesh=mesh,
+                    guard=guard,
+                    skip_nonfinite=bool(skip_nonfinite_steps),
+                    unroll=unroll)
+                if mesh is not None:
+                    jitted = _jit_with_mesh_steps(fn, mesh)
+                else:
+                    jitted = jax.jit(fn, donate_argnums=(0,))
+            if use_program_cache:
+                self._cache[cache_key] = (jitted, state_in, state_out,
+                                          mut_names, slot_names, wo_avals)
+
+        # write-only persistable outputs ride the scan carry so a
+        # rolled-back step restores what the scope held (sequential-skip
+        # parity); vars the scope has never seen are seeded with zeros
+        # and un-committed below if every step rolled back
+        absent_wo = set()
+        for n, aval in wo_avals.items():
+            v = scope.find_var(n)
+            if v is None:
+                v = np.zeros(aval.shape, aval.dtype)
+                absent_wo.add(n)
+            state_mut[n] = v
+        if mesh is not None and wo_avals:
+            tmp = {n: state_mut[n] for n in wo_avals}
+            _shard_state(tmp, mesh, program)
+            state_mut.update(tmp)
+
+        profiling = _prof.is_profiling()
+        t0 = time.perf_counter()
+        fetches, final_state, final_key, viols, slots = jitted(
+            state_mut, state_ro, feed_arrays, base_key)
+        if profiling:
+            t1 = time.perf_counter()
+            jax.block_until_ready(fetches if fetches else final_key)
+            span = time.perf_counter() - t0
+            if fresh_compile:
+                # XLA compiles lazily at first call: charge that span to
+                # the compile event, not the step-time histogram
+                _prof.record_duration(
+                    f"compile/fused_program_{program._uid}_x{k_steps}",
+                    span)
+            else:
+                _prof.record_duration(
+                    f"dispatch/program_{program._uid}_x{k_steps}",
+                    t1 - t0)
+                _prof.record_duration(
+                    f"scan/program_{program._uid}_x{k_steps}", span)
+                _prof.record_step_time(span / k_steps, k_steps)
+
+        v = np.asarray(viols) if guard else None  # ONE small readback
+        # commit (buffers were donated); guard diagnostics after. If
+        # EVERY step rolled back, scope-absent write-only vars stay
+        # uncommitted — K sequential skipped run() calls never create
+        # them either (their committed value would be the zeros seed).
+        all_rolled = bool(skip_nonfinite_steps and v is not None
+                          and v.size and (v > 0).all())
+        for n, val in final_state.items():
+            if all_rolled and n in absent_wo:
+                continue
+            scope.set(n, val)
+        scope.set(RNG_STATE_NAME, final_key)
+
+        if guard and v.any():
+            first = int(np.argmax(v > 0))
+            name = self._slot_name(slots, first, slot_names)
+            if skip_nonfinite_steps:
+                rolled = int((v > 0).sum())
+                print(f"[executor] skip_nonfinite_steps: {rolled} of "
+                      f"{k_steps} fused step(s) rolled back in-graph "
+                      f"(first at slab step {first}: {int(v[first])} "
+                      f"non-finite value(s) across outputs/state, "
+                      f"first offender {name})")
+            else:
+                raise NonFiniteError(
+                    f"Operator output contains Inf/Nan "
+                    f"(FLAGS_check_nan_inf): fused step "
+                    f"{first}/{k_steps} of program_{program._uid} "
+                    f"produced {int(v[first])} non-finite value(s) "
+                    f"across outputs/state; first offender {name}. "
+                    f"Feed data, learning rate, or loss scaling are "
+                    f"the usual suspects.",
+                    var_name=name, count=int(v[first]))
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    @staticmethod
+    def _slot_name(slots, step_idx, slot_names):
+        i = int(np.asarray(slots)[step_idx])
+        return slot_names[i] if 0 <= i < len(slot_names) else f"slot {i}"
+
     def _run_pserver(self, ls_op, scope):
         """Host parameter-server event loop (reference
         listen_and_serv_op.cc:333 RunImpl — the op IS the server). Blocks
@@ -362,8 +616,25 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None, skip_nonfinite_steps=False):
+                           fetch_handler=None, skip_nonfinite_steps=False,
+                           steps_per_run=None, fetch_every_n=None):
+        """``steps_per_run=K`` (default FLAGS_steps_per_run) drives the
+        fused :meth:`run_steps` path: the dataset collates K-step slabs
+        (``batch_iterator(slab=K)``), the next slab's H2D transfer is
+        dispatched while the current slab executes, and ``print_period``
+        reports from the slab's already-materialized stacked fetches —
+        no mid-loop device sync. ``fetch_every_n=N`` (default
+        FLAGS_fetch_every_n) > 1 skips fetch materialization entirely on
+        slabs that contain neither a ``print_period`` step nor an N-th
+        slab boundary (those slabs run a fetch-free executable; the
+        final slab always fetches so the return value is fresh). Under
+        the fused path the returned last-fetches are stacked per-step
+        arrays with a leading slab axis, not single-step values."""
         assert dataset is not None, "train_from_dataset needs a dataset"
+        k_steps = int(steps_per_run if steps_per_run is not None
+                      else _flag("steps_per_run"))
+        fetch_every = int(fetch_every_n if fetch_every_n is not None
+                          else _flag("fetch_every_n"))
         fetch_names = self._fetch_names(fetch_list)
         fetch_info = fetch_info or fetch_names
         monitor = None
@@ -371,21 +642,125 @@ class Executor:
             monitor = _FetchHandlerMonitor(scope or global_scope(),
                                            fetch_handler)
             monitor.start()
-        last = None
         try:
-            for step, feed in enumerate(dataset.batch_iterator()):
-                out = self.run(program, feed=feed,
-                               fetch_list=fetch_list, scope=scope,
-                               skip_nonfinite_steps=skip_nonfinite_steps)
-                last = out
-                if fetch_names and print_period and \
-                        step % print_period == 0:
-                    msg = ", ".join(f"{i}={np.asarray(v).mean():.6f}"
-                                    for i, v in zip(fetch_info, out))
-                    print(f"step {step}: {msg}")
+            if k_steps > 1:
+                return self._train_fused(
+                    program, dataset, scope, fetch_list, fetch_names,
+                    fetch_info, print_period, skip_nonfinite_steps,
+                    k_steps, fetch_every)
+            return self._train_stepwise(
+                program, dataset, scope, fetch_list, fetch_names,
+                fetch_info, print_period, skip_nonfinite_steps)
         finally:
             if monitor is not None:
                 monitor.stop()
+
+    def _train_stepwise(self, program, dataset, scope, fetch_list,
+                        fetch_names, fetch_info, print_period,
+                        skip_nonfinite_steps):
+        """One run() per batch. Steps dispatch asynchronously
+        (return_numpy=False); fetches only materialize on a reporting
+        step — a print_period hit no longer forces a device sync on every
+        non-reporting step, and step 0 (untrained params) is not
+        reported."""
+        last = None
+        for step, feed in enumerate(dataset.batch_iterator()):
+            out = self.run(program, feed=feed,
+                           fetch_list=fetch_list, scope=scope,
+                           return_numpy=False,
+                           skip_nonfinite_steps=skip_nonfinite_steps)
+            last = out
+            if fetch_names and print_period and step \
+                    and step % print_period == 0:
+                vals = [np.asarray(v) for v in out]
+                msg = ", ".join(f"{i}={v.mean():.6f}"
+                                for i, v in zip(fetch_info, vals))
+                print(f"step {step}: {msg}")
+            elif step % 64 == 63:
+                # backpressure: async dispatch with no fetch sync would
+                # otherwise let in-flight steps (and their feed buffers)
+                # pile up without bound on the device queue
+                _block_on_step(out, scope)
+        if last is not None:
+            last = [np.asarray(v) for v in last]
+        return last
+
+    def _train_fused(self, program, dataset, scope, fetch_list,
+                     fetch_names, fetch_info, print_period,
+                     skip_nonfinite_steps, k_steps, fetch_every):
+        """Slab loop behind train_from_dataset(steps_per_run=K): full
+        slabs go through run_steps (one compiled scan), the short tail
+        slab (dataset length not divisible by K, or a partial final
+        batch) falls back to sequential run() calls so no second
+        executable is compiled for a shape seen once."""
+        from ..parallel.compiler import CompiledProgram
+        if program is None:
+            # resolve here, not just in run_steps: _device_put_slab
+            # needs the program for feed dtype casts + int64 validation
+            program = default_main_program()
+        # mesh feeds are placed by _shard_feed_slab at run time; plain
+        # device_put here would pin them to device 0 first
+        prefetch = not isinstance(program, CompiledProgram)
+        try:
+            it = dataset.batch_iterator(slab=k_steps)
+        except TypeError:
+            # duck-typed dataset without the slab kwarg: collate here
+            from ..dataio.dataset import DatasetBase
+            it = DatasetBase._slab_batches(dataset.batch_iterator(),
+                                           k_steps)
+        last = None
+        step = 0
+        slab_idx = 0
+        cur = next(it, None)
+        if cur is not None and prefetch:
+            cur = _device_put_slab(cur, program)
+        while cur is not None:
+            # prefetch BEFORE dispatching: the next slab's H2D is in
+            # flight while this slab executes even when the guard makes
+            # run_steps block on its per-slab violation readback
+            nxt = next(it, None)
+            if nxt is not None and prefetch:
+                nxt = _device_put_slab(nxt, program)
+            k = int(next(iter(cur.values())).shape[0])
+            hit = bool(print_period) and fetch_names and any(
+                (step + j) and (step + j) % print_period == 0
+                for j in range(k))
+            want = bool(fetch_names) and (
+                fetch_every <= 1 or hit or slab_idx % fetch_every == 0
+                or nxt is None)  # final slab: the return value is fresh
+            flist = fetch_list if want else []
+            if k == k_steps:
+                out = self.run_steps(
+                    program, feed=cur, fetch_list=flist, scope=scope,
+                    return_numpy=False,
+                    skip_nonfinite_steps=skip_nonfinite_steps)
+            else:
+                outs = [self.run(program,
+                                 feed={n: a[j] for n, a in cur.items()},
+                                 fetch_list=flist, scope=scope,
+                                 return_numpy=False,
+                                 skip_nonfinite_steps=skip_nonfinite_steps)
+                        for j in range(k)]
+                out = [np.stack([np.asarray(o[i]) for o in outs])
+                       for i in range(len(fetch_names))] if want else []
+            if want and out:
+                mats = [np.asarray(v) for v in out]  # one copy per slab
+                last = mats
+                if hit:
+                    for j in range(k):
+                        g = step + j
+                        if g and g % print_period == 0:
+                            msg = ", ".join(
+                                f"{i}={np.asarray(v[j]).mean():.6f}"
+                                for i, v in zip(fetch_info, mats))
+                            print(f"step {g}: {msg}")
+            if not want and slab_idx % 8 == 7:
+                _block_on_step(out, scope)  # bound the dispatch queue
+            step += k
+            slab_idx += 1
+            cur = nxt
+        if last is None and not fetch_names and slab_idx:
+            last = []  # match the stepwise path's no-fetch return
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -394,6 +769,49 @@ class Executor:
         prog = program.clone(for_test=True) if program is not None else None
         return self.train_from_dataset(prog, dataset, scope, thread, debug,
                                        fetch_list, fetch_info, print_period)
+
+
+def _block_on_step(out, scope):
+    """Periodic backpressure for the async training loops: wait for the
+    newest dispatched step (its fetches, or the committed RNG key when
+    nothing was fetched) so unmaterialized in-flight steps can't grow
+    the device queue without bound."""
+    ref = out if out else (scope or global_scope()).find_var(
+        RNG_STATE_NAME)
+    if ref is not None:
+        jax.block_until_ready(ref)
+
+
+def _stack_feed_slab(feeds):
+    """Stack a list of per-step feed dicts on a new leading K axis.
+    Key ORDER may differ between steps; the variable set may not."""
+    if not feeds:
+        raise ValueError("run_steps got an empty feed list")
+    names = list(feeds[0].keys())
+    for f in feeds[1:]:
+        if set(f.keys()) != set(names):
+            raise ValueError(
+                "run_steps feed dicts must bind the same variables in "
+                f"every step: {sorted(names)} vs {sorted(f.keys())}")
+    return {n: np.stack([np.asarray(f[n]) for f in feeds]) for n in names}
+
+
+def _device_put_slab(slab, program=None):
+    """Async H2D of a host slab (dispatch-only timing: device_put
+    returns before the copy lands, which is the point — the transfer
+    overlaps the previous slab's compute). Applies the same var-dtype
+    cast and int64 feed-boundary validation run() would, BEFORE the
+    value becomes a device array and skips that np-path."""
+    from .. import profiler as _prof
+    gblock = program.global_block() if program is not None else None
+    t0 = time.perf_counter()
+    out = {}
+    for n, a in slab.items():
+        if isinstance(a, np.ndarray):
+            a = _sanitize_np_feed(gblock, n, a)
+        out[n] = jax.device_put(a)
+    _prof.record_duration("h2d/slab", time.perf_counter() - t0)
+    return out
 
 
 def _jit_with_mesh(fn, mesh, program):
@@ -415,12 +833,62 @@ def _jit_with_mesh(fn, mesh, program):
 
 
 def _batch_pspec(mesh, arr):
+    return _batch_pspec_shape(mesh, tuple(arr.shape))
+
+
+def _batch_pspec_shape(mesh, shape):
     from jax.sharding import PartitionSpec as P
     from ..parallel.mesh import partition_spec
-    if arr.ndim == 0:
+    if not shape:
         return P()
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
-    return partition_spec(mesh, (axis,), arr.shape)
+    return partition_spec(mesh, (axis,), shape)
+
+
+def _slab_pspec(mesh, arr):
+    """Batch pspec shifted one axis right for a K-leading feed slab: the
+    steps axis replicates (every step runs on the whole mesh), the batch
+    dim under it shards exactly as the unfused feed would."""
+    from jax.sharding import PartitionSpec as P
+    if arr.ndim <= 1:
+        return P()
+    return P(None, *_batch_pspec_shape(mesh, tuple(arr.shape[1:])))
+
+
+def _jit_with_mesh_steps(fn, mesh):
+    """Fused-scan variant of _jit_with_mesh: the same GSPMD treatment,
+    with the sharding constraint applied under the slab's leading K
+    axis."""
+    from jax.sharding import NamedSharding
+
+    def sharded_fn(state_mut, state_ro, feed_slab, base_key):
+        feed_slab = {
+            n: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, _slab_pspec(mesh, a)))
+            for n, a in feed_slab.items()
+        }
+        return fn(state_mut, state_ro, feed_slab, base_key)
+
+    return jax.jit(sharded_fn, donate_argnums=(0,))
+
+
+def _shard_feed_slab(feed_arrays, mesh):
+    """_shard_feed for K-leading slabs: single-process shards the batch
+    dim under the steps axis; multi-host assembles each trainer's local
+    slab into one global array along dp (reference semantics — every
+    trainer feeds its own shard)."""
+    from jax.sharding import NamedSharding
+    out = {}
+    multi = jax.process_count() > 1
+    for n, a in feed_arrays.items():
+        arr = np.asarray(a) if not isinstance(a, jax.Array) else a
+        sharding = NamedSharding(mesh, _slab_pspec(mesh, arr))
+        if multi:
+            out[n] = jax.make_array_from_process_local_data(
+                sharding, np.asarray(arr))
+        else:
+            out[n] = jax.device_put(arr, sharding)
+    return out
 
 
 def _shard_state(state, mesh, program):

@@ -162,3 +162,87 @@ def build_block_fn(program, block_idx, feed_names, fetch_names, state_in,
         return fetches, new_state, new_key
 
     return fn
+
+
+def _nonfinite_leaf(x):
+    """Per-array non-finite element count as an in-graph int32 scalar.
+    Integer/bool arrays are always finite and contribute a constant 0 (they
+    stay in the slot list so slot indices line up with slot names)."""
+    if jnp.issubdtype(x.dtype, jnp.floating) or \
+            jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return (~jnp.isfinite(x)).sum(dtype=jnp.int32)
+    return jnp.int32(0)
+
+
+def build_multi_step_fn(program, block_idx, feed_names, fetch_names,
+                        state_in, state_out, mut_names,
+                        mesh=None, guard=False, skip_nonfinite=False,
+                        unroll=1):
+    """Return fn(state_mut, state_ro, feed_slab, base_key) ->
+    (stacked_fetches, final_state, final_key, viol_counts, viol_slots):
+    K training steps fused into one ``lax.scan`` over feeds stacked on a
+    leading K axis.
+
+    Per-step semantics are bitwise those of K sequential
+    ``build_block_fn`` calls: the scan body IS the single-step fn, state
+    rebinds through the carry and the RNG key advances by the same
+    ``split(key, 1)[0]`` chain, so per-op ``fold_in`` streams match the
+    unfused executor exactly.
+
+    `mut_names` is the read-and-updated subset of `state_in`; the passed
+    `state_mut` dict must ALSO carry an initial value for every
+    write-only persistable output (callers seed it from the scope, or
+    zeros when absent) — those live in the scan carry so the LAST
+    step's value survives and a rolled-back step restores what the
+    scope held, matching the sequential executor's skip path.
+
+    With `guard` (FLAGS_check_nan_inf) the body also emits a per-step
+    int32 violation count plus the index of the first offending slot
+    (ordered: fetches, then updated state) — the whole non-finite check
+    stays on device and costs one tiny readback instead of a device->host
+    transfer of every updated parameter. With `skip_nonfinite` the carry
+    update becomes a ``lax.cond`` select between pre- and post-step state
+    (and pre/post RNG key): a poisoned step rolls back IN-GRAPH, with no
+    host backup copies — this also works for mesh-sharded state where a
+    host-side ``np.asarray`` snapshot would gather.
+
+    `unroll` feeds through to ``lax.scan``: the loop form (1) keeps
+    compile time K-independent; full unroll (K) restores straight-line
+    code on backends whose while-loop bodies pessimize (XLA CPU drops
+    intra-op threading inside loops). Both forms run the identical
+    per-step computation."""
+    step_fn = build_block_fn(program, block_idx, feed_names, fetch_names,
+                             state_in, state_out, mesh=mesh)
+    mut_names = list(mut_names)
+
+    def fn(state_mut, state_ro, feed_slab, base_key):
+        carry_state = dict(state_mut)
+
+        def body(carry, feed_k):
+            cstate, key = carry
+            smut = {n: cstate[n] for n in mut_names}
+            fetches, new_state, new_key = step_fn(smut, state_ro, feed_k,
+                                                  key)
+            out_state = dict(cstate)
+            out_state.update(new_state)
+            viol = jnp.int32(0)
+            slot = jnp.int32(0)
+            if guard or skip_nonfinite:
+                leaves = list(fetches) + list(new_state.values())
+                counts = (jnp.stack([_nonfinite_leaf(v) for v in leaves])
+                          if leaves else jnp.zeros((1,), jnp.int32))
+                viol = counts.sum(dtype=jnp.int32)
+                slot = jnp.argmax(counts > 0).astype(jnp.int32)
+            if skip_nonfinite:
+                out_state, new_key = jax.lax.cond(
+                    viol > 0,
+                    lambda: (cstate, key),
+                    lambda: (out_state, new_key))
+            return (out_state, new_key), (tuple(fetches), viol, slot)
+
+        (final_state, final_key), (ys, viols, slots) = jax.lax.scan(
+            body, (carry_state, base_key), feed_slab,
+            unroll=max(int(unroll), 1))
+        return list(ys), final_state, final_key, viols, slots
+
+    return fn

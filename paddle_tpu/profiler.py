@@ -21,6 +21,14 @@ _MAX_SPANS = 200000   # bound memory on long profiled runs
 _active = False
 _trace_dir = None
 
+# step-time histogram: log2 buckets over per-step wall time, fed by the
+# training loop (Executor.run_steps amortizes one slab measurement over
+# its K steps). Bounded by construction — counters, not samples.
+_STEP_BUCKETS_MS = (0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0, 30.0, 100.0,
+                    300.0, 1000.0, 3000.0, 10000.0)
+_step_hist = [0] * (len(_STEP_BUCKETS_MS) + 1)
+_step_stats = [0, 0.0]  # count, total_s
+
 
 def _record(name, seconds, start=None):
     if not _active:
@@ -48,6 +56,30 @@ def record_duration(name, seconds):
     _record(name, float(seconds))
 
 
+def record_step_time(seconds, steps=1):
+    """Accumulate `steps` training steps of `seconds` each into the
+    step-time histogram (no-op while profiling is off). The fused loop
+    measures once per slab and amortizes over its K steps."""
+    if not _active:
+        return
+    import bisect
+    i = bisect.bisect_left(_STEP_BUCKETS_MS, float(seconds) * 1e3)
+    _step_hist[i] += int(steps)
+    _step_stats[0] += int(steps)
+    _step_stats[1] += float(seconds) * int(steps)
+
+
+def step_time_histogram():
+    """{"count", "mean_ms", "buckets": [(le_ms, n), ..., (inf, n)]} of
+    every step recorded since the last reset_profiler()."""
+    buckets = [(le, n) for le, n in zip(_STEP_BUCKETS_MS, _step_hist)]
+    buckets.append((float("inf"), _step_hist[-1]))
+    count = _step_stats[0]
+    return {"count": count,
+            "mean_ms": (_step_stats[1] / count * 1e3) if count else 0.0,
+            "buckets": buckets}
+
+
 @contextlib.contextmanager
 def record_event(name):
     """RAII event span (reference platform::RecordEvent)."""
@@ -62,6 +94,10 @@ def reset_profiler():
     """reference profiler.py:113."""
     _events.clear()
     _spans.clear()
+    for i in range(len(_step_hist)):
+        _step_hist[i] = 0
+    _step_stats[0] = 0
+    _step_stats[1] = 0.0
 
 
 def start_profiler(state="All", tracer_option="Default",
@@ -100,6 +136,14 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     rows = summary(sorted_key)
     if rows:
         print(_format_table(rows))
+    hist = step_time_histogram()
+    if hist["count"]:
+        buckets = ", ".join(
+            (f"<={le:g}ms: {n}" if le != float("inf")
+             else f">{_STEP_BUCKETS_MS[-1]:g}ms: {n}")
+            for le, n in hist["buckets"] if n)
+        print(f"[profiler] step time: {hist['count']} steps, mean "
+              f"{hist['mean_ms']:.3f}ms [{buckets}]")
     return rows
 
 

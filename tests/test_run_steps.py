@@ -1,0 +1,418 @@
+"""Fused multi-step training loop (Executor.run_steps): bitwise parity
+with K sequential run() calls — params, RNG stream, fetched losses —
+including the dp-mesh case, the on-device non-finite guard, and the
+in-graph skip_nonfinite_steps rollback with a NaN injected mid-slab."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework.executor import RNG_STATE_NAME
+from paddle_tpu.parallel.compiler import CompiledProgram
+from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+from paddle_tpu.resilience import NonFiniteError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build(with_dropout=False, lr=0.01):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        h = layers.fc(x, 16, act="relu")
+        if with_dropout:
+            h = layers.dropout(h, dropout_prob=0.3)
+        loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+        fluid.optimizer.Adam(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(k, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.standard_normal((batch, 4)).astype(np.float32),
+             "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+            for _ in range(k)]
+
+
+def _key_data(v):
+    if jax.dtypes.issubdtype(getattr(v, "dtype", None),
+                             jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(v))
+    return np.asarray(v)
+
+
+def _assert_scopes_bitwise_equal(s1, s2):
+    names1 = sorted(s1.keys())
+    assert names1 == sorted(s2.keys())
+    for n in names1:
+        a, b = _key_data(s1.find_var(n)), _key_data(s2.find_var(n))
+        assert np.array_equal(a, b), \
+            f"scope var {n!r} diverged between sequential and fused runs"
+
+
+def _run_pair(check_nan_inf=False, with_dropout=True, feeds=None,
+              skip_nonfinite=False):
+    """(sequential losses+scope, fused losses+scope) on the same program."""
+    feeds = feeds if feeds is not None else _feeds(6)
+    main, startup, loss = _build(with_dropout=with_dropout)
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        seq = [exe.run(main, feed=f, fetch_list=[loss],
+                       check_nan_inf=check_nan_inf,
+                       skip_nonfinite_steps=skip_nonfinite)[0]
+               for f in feeds]
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        fused = exe.run_steps(main, feed=feeds, fetch_list=[loss],
+                              check_nan_inf=check_nan_inf,
+                              skip_nonfinite_steps=skip_nonfinite)
+    seq = np.stack([np.asarray(v).reshape(()) for v in seq])
+    return seq, np.asarray(fused[0]).reshape(-1), s1, s2
+
+
+def test_run_steps_bitwise_parity_guard_off():
+    # default FLAGS_scan_unroll=1: a real XLA while loop, bitwise
+    seq, fused, s1, s2 = _run_pair(check_nan_inf=False)
+    assert np.array_equal(seq, fused)
+    _assert_scopes_bitwise_equal(s1, s2)  # params + RNG_STATE
+
+
+def test_run_steps_unrolled_numerically_equivalent():
+    """unroll=0 (auto -> full unroll on CPU) may fuse across step
+    boundaries: numerically equivalent, documented as not necessarily
+    bit-identical."""
+    feeds = _feeds(6)
+    main, startup, loss = _build(with_dropout=True)
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        seq = [exe.run(main, feed=f, fetch_list=[loss])[0] for f in feeds]
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        fused = exe.run_steps(main, feed=feeds, fetch_list=[loss],
+                              unroll=0)
+    np.testing.assert_allclose(
+        np.stack([np.asarray(v).reshape(()) for v in seq]),
+        np.asarray(fused[0]).reshape(-1), rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_bitwise_parity_guard_on():
+    """FLAGS_check_nan_inf compiles the guard into the scan — it must not
+    perturb a single bit of the training computation."""
+    seq, fused, s1, s2 = _run_pair(check_nan_inf=True)
+    assert np.array_equal(seq, fused)
+    _assert_scopes_bitwise_equal(s1, s2)
+
+
+def test_run_steps_accepts_prestacked_slab():
+    feeds = _feeds(4)
+    slab = {n: np.stack([f[n] for f in feeds]) for n in feeds[0]}
+    main, startup, loss = _build()
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        a = exe.run_steps(main, feed=feeds, fetch_list=[loss])
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        b = exe.run_steps(main, feed=slab, fetch_list=[loss],
+                          steps_per_run=4)
+    assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+
+
+def test_run_steps_dp_mesh_parity():
+    """Fused scan through the GSPMD path: slab pspec shards the batch dim
+    UNDER the leading steps axis; results match per-step mesh runs
+    bitwise, and rolled state stays sharded."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    feeds = _feeds(4, seed=2)
+    main, startup, loss = _build(with_dropout=False)
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        comp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+        seq = [exe.run(comp, feed=f, fetch_list=[loss])[0] for f in feeds]
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        comp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+        fused = exe.run_steps(comp, feed=feeds, fetch_list=[loss])
+    assert np.array_equal(
+        np.stack([np.asarray(v).reshape(()) for v in seq]),
+        np.asarray(fused[0]).reshape(-1))
+    _assert_scopes_bitwise_equal(s1, s2)
+
+
+def test_check_nan_inf_raises_naming_fused_step():
+    feeds = _feeds(5, seed=1)
+    feeds[2] = {"x": feeds[2]["x"].copy(), "y": feeds[2]["y"]}
+    feeds[2]["x"][0, 0] = np.nan
+    main, startup, loss = _build(with_dropout=False)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        try:
+            exe.run_steps(main, feed=feeds, fetch_list=[loss],
+                          check_nan_inf=True)
+            raise AssertionError("expected NonFiniteError")
+        except NonFiniteError as e:
+            assert "fused step 2/5" in str(e)
+
+
+def test_skip_nonfinite_rollback_mid_slab():
+    """NaN injected mid-slab: the in-graph lax.cond rollback must leave
+    exactly the same params/RNG as the host-side per-step skip path, and
+    the clean steps around the bad one must still apply."""
+    feeds = _feeds(6, seed=3)
+    feeds[3] = {"x": feeds[3]["x"].copy(), "y": feeds[3]["y"]}
+    feeds[3]["x"][:, :] = np.inf
+    seq, fused, s1, s2 = _run_pair(check_nan_inf=False, with_dropout=True,
+                                   feeds=feeds, skip_nonfinite=True)
+    assert np.array_equal(seq, fused, equal_nan=True)
+    _assert_scopes_bitwise_equal(s1, s2)
+    # the poisoned step really trained nothing, but later steps did:
+    # compare against a run over the clean steps only
+    clean = [f for i, f in enumerate(feeds) if i != 3]
+    main, startup, loss = _build(with_dropout=True)
+    exe = fluid.Executor()
+    s3 = fluid.Scope()
+    with fluid.scope_guard(s3):
+        exe.run(startup)
+        exe.run_steps(main, feed=clean, fetch_list=[loss])
+    w2 = next(np.asarray(v) for n, v in s2.items() if n.endswith(".w_0"))
+    assert np.isfinite(w2).all()
+
+
+def test_skip_nonfinite_write_only_persistable_rollback():
+    """A persistable var that ops WRITE but never read (e.g. a metric
+    snapshot) rides the scan carry: a rolled-back step must restore the
+    value the scope held, and an all-poisoned slab must leave it exactly
+    as K sequential skipped run() calls would."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 16), y))
+        snap = layers.create_global_var([1], 0.0, "float32",
+                                        persistable=True,
+                                        name="loss_snapshot")
+        layers.assign(loss, output=snap)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    feeds = _feeds(4, seed=7)
+    poisoned = [{"x": np.full_like(f["x"], np.nan), "y": f["y"]}
+                for f in feeds]
+    exe = fluid.Executor()
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    for scope, runner in ((s1, "seq"), (s2, "fused")):
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            # one clean step seeds the snapshot with a real value
+            exe.run(main, feed=feeds[0], fetch_list=[loss])
+            if runner == "seq":
+                for f in poisoned:
+                    exe.run(main, feed=f, fetch_list=[loss],
+                            skip_nonfinite_steps=True)
+            else:
+                exe.run_steps(main, feed=poisoned, fetch_list=[loss],
+                              skip_nonfinite_steps=True)
+    _assert_scopes_bitwise_equal(s1, s2)
+    good = np.asarray(s1.find_var("loss_snapshot"))
+    assert np.isfinite(good).all()  # the poisoned slab never overwrote it
+
+
+def test_run_steps_feed_validation():
+    main, startup, loss = _build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feeds = _feeds(3)
+        slab = {n: np.stack([f[n] for f in feeds]) for n in feeds[0]}
+        try:
+            exe.run_steps(main, feed=dict(slab, y=slab["y"][:2]),
+                          fetch_list=[loss])
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "leading axis" in str(e)
+        try:
+            exe.run_steps(main, feed=slab, fetch_list=[loss],
+                          steps_per_run=8)
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "steps_per_run" in str(e)
+        try:
+            exe.run_steps(main, feed={}, fetch_list=[loss])
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "at least one fed variable" in str(e)
+
+
+class _GenDataset:
+    """Duck-typed dataset (no slab kwarg) — exercises the executor-side
+    collation fallback."""
+
+    def __init__(self, n=11, batch=8, seed=5):
+        self.n, self.batch, self.seed = n, batch, seed
+
+    def batch_iterator(self):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.n):
+            x = rng.standard_normal((self.batch, 4)).astype(np.float32)
+            yield {"x": x, "y": (x[:, :1] * 0.5).astype(np.float32)}
+
+
+def test_train_from_dataset_fused_matches_stepwise():
+    """steps_per_run=4 over 11 batches (tail of 3 falls back to per-step
+    runs) must land on bitwise the same params as the unfused loop."""
+    main, startup, loss = _build(with_dropout=False, lr=0.05)
+    exe = fluid.Executor()
+    scopes = []
+    for k in (1, 4):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.train_from_dataset(main, _GenDataset(), fetch_list=[loss],
+                                   print_period=0, steps_per_run=k)
+        scopes.append(scope)
+    _assert_scopes_bitwise_equal(*scopes)
+
+
+def test_train_from_dataset_fetch_every_n_param_parity(capsys):
+    main, startup, loss = _build(with_dropout=False, lr=0.05)
+    exe = fluid.Executor()
+    scopes, lasts = [], []
+    for fe in (1, 3):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            last = exe.train_from_dataset(
+                main, _GenDataset(), fetch_list=[loss], print_period=4,
+                steps_per_run=4, fetch_every_n=fe)
+        assert last is not None and np.isfinite(last[0]).all()
+        scopes.append(scope)
+        lasts.append(last)
+    _assert_scopes_bitwise_equal(*scopes)
+    # the final slab always fetches: fetch_every_n must not return a
+    # stale earlier slab as the loop's result
+    assert np.array_equal(lasts[0][0], lasts[1][0])
+    out = capsys.readouterr().out
+    assert "step 4:" in out and "step 8:" in out
+    assert "step 0:" not in out  # untrained params are not reported
+
+
+def test_dataset_slab_iterator_groups_and_tail(tmp_path):
+    import paddle_tpu.dataset as D
+    f = tmp_path / "data.txt"
+    lines = [f"y:{i}.0 x:{i}.0,{i}.5" for i in range(11)]
+    f.write_text("\n".join(lines))
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        y = fluid.data("y", [-1, 1], "float32")
+        x = fluid.data("x", [-1, 2], "float32")
+    ds = D.DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist([str(f)])
+    ds.set_batch_size(2)          # 5 full batches + 1 partial
+    ds.set_use_var([y, x])
+    slabs = list(ds.batch_iterator(slab=2))
+    # 2 slabs of 2 full batches, 1 slab of 1 full batch (shape-change
+    # flush before the partial final batch), 1 slab of the partial batch
+    shapes = [s["x"].shape for s in slabs]
+    assert shapes == [(2, 2, 2), (2, 2, 2), (1, 2, 2), (1, 1, 2)]
+    flat = np.concatenate([s["x"].reshape(-1, 2) for s in slabs])
+    assert flat.shape == (11, 2)
+    np.testing.assert_allclose(flat[:, 0], np.arange(11, dtype=np.float32))
+
+
+def test_slab_batches_accepts_plain_list_values():
+    """run() feeds accept plain lists; the slab collator must not crash
+    on them (it np.shape's the signature and np.stack coerces)."""
+    from paddle_tpu.dataio.dataset import DatasetBase
+    batches = [{"x": [[1.0, 2.0]], "y": [3]} for _ in range(4)]
+    slabs = list(DatasetBase._slab_batches(iter(batches), 2))
+    assert [s["x"].shape for s in slabs] == [(2, 1, 2), (2, 1, 2)]
+    assert slabs[0]["y"].shape == (2, 1)
+
+
+def test_buffered_early_exit_releases_producer_thread():
+    from paddle_tpu.dataio.decorator import buffered
+    started = threading.Event()
+
+    def slow_reader():
+        started.set()
+        for i in range(10000):
+            yield i
+
+    before = set(threading.enumerate())
+    it = buffered(slow_reader, 4)()
+    assert next(it) == 0
+    started.wait(timeout=2)
+    it.close()  # abandon early — GeneratorExit must stop the producer
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline:
+        leaked = [t for t in set(threading.enumerate()) - before
+                  if t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.02)
+    assert not leaked, f"buffered() leaked producer threads: {leaked}"
+
+
+def test_queue_iterator_close_joins_thread():
+    from paddle_tpu.dataio.reader import _QueueIterator
+
+    def gen():
+        for i in range(10000):
+            yield {"x": np.float32(i)}
+
+    it = _QueueIterator(gen, capacity=2, prefetch_to_device=False)
+    next(it)
+    it.close()
+    assert not it.thread.is_alive(), \
+        "_QueueIterator.close() must join its producer thread"
+
+
+def test_profiler_step_time_histogram():
+    from paddle_tpu import profiler
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    profiler.record_step_time(0.002, steps=8)
+    profiler.record_step_time(0.5, steps=1)
+    hist = profiler.step_time_histogram()
+    profiler.stop_profiler(profile_path=None)
+    assert hist["count"] == 9
+    by_le = dict(hist["buckets"])
+    assert by_le[3.0] == 8 and by_le[1000.0] == 1
+    profiler.reset_profiler()
+    assert profiler.step_time_histogram()["count"] == 0
+
+
+def test_bench_train_loop_smoke():
+    """bench.py --config train_loop CPU smoke path: completes quickly and
+    reports the K=1 vs fused-K steps/sec table."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config",
+         "train_loop"], capture_output=True, text=True, timeout=300,
+        env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["unit"] == "steps/sec"
+    ks = rec["k"]
+    assert set(ks) == {"1", "8", "32"}
+    assert all(v["steps_per_sec"] > 0 for v in ks.values())
+    assert rec["value"] == ks["8"]["steps_per_sec"]
