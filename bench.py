@@ -29,6 +29,14 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
   train_loop          extra: fused multi-step loop A/B — steps/sec at
                       Executor.run_steps K in {1, 8, 32} on the
                       mnist-size config (dispatch-bound small-model fix)
+  passes              extra: program-pass pipeline A/B — lowered op
+                      count, trace+compile ms, and cold-start latency
+                      with FLAGS_program_passes on vs off on a
+                      BERT-shaped train program
+
+Every throughput config also reports cold_start_ms (first-step
+end-to-end latency) plus the executor's pass/trace/compile ms split, so
+the pass pipeline's warmup win is visible per config.
 """
 import json
 import os
@@ -54,25 +62,17 @@ def _peak_flops(device):
     return None
 
 
-def _step_cost(exe, scope, feed, prog):
+def _step_cost(exe, prog):
     """XLA cost analysis of the compiled train step sitting in the
-    executor's program cache: {flops, bytes} per step. Reconstructs the
-    jitted callable's argument binding the way Executor.run does, lowers,
-    and reads compiled.cost_analysis() — the same measurement the
-    flagship roofline in BENCHMARKS.md uses. Returns None where the
-    backend can't report costs."""
-    from paddle_tpu.framework.executor import RNG_STATE_NAME
+    executor's program cache: {flops, bytes} per step. The executor
+    caches the AOT executable itself (entry[0]), so its
+    cost_analysis() reads directly — the same measurement the flagship
+    roofline in BENCHMARKS.md uses. Returns None where the backend
+    can't report costs."""
     try:
-        jitted, state_in, state_out = next(
+        entry = next(
             v for k, v in exe._cache.items() if k[0] == prog._uid)
-        state_out_set = set(state_out)
-        state_mut, state_ro = {}, {}
-        for n in state_in:
-            v = scope.find_var(n)
-            (state_mut if n in state_out_set else state_ro)[n] = v
-        key = scope.find_var(RNG_STATE_NAME)
-        compiled = jitted.lower(state_mut, state_ro, feed, key).compile()
-        ca = compiled.cost_analysis()
+        ca = entry[0].cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         flops = float(ca.get("flops", 0.0))
@@ -238,9 +238,9 @@ def main():
     with fluid.scope_guard(scope):
         exe.run(startup)
     it = iter(loader())
-    value = _time_static(exe, scope, main_prog, lambda: next(it),
-                         loss_name, steps, warmup, batch,
-                         window=min(10, steps))
+    value, cold_ms = _time_static(exe, scope, main_prog, lambda: next(it),
+                                  loss_name, steps, warmup, batch,
+                                  window=min(10, steps))
     loader.reset()
 
     # fallback 200.0 = the published V100 fp16 BERT-base seq128 anchor,
@@ -255,8 +255,9 @@ def main():
         "unit": "samples/sec",
         "vs_baseline": round(value / anchor, 4),
     }
+    _attach_compile_split(result, exe, cold_ms)
     if on_accel:
-        cost = _step_cost(exe, scope, pool[0], main_prog)
+        cost = _step_cost(exe, main_prog)
         _attach_roofline(result, dev, value, batch, cost,
                          _bert_train_flops_per_sample(cfg, seq_len,
                                                       max_preds))
@@ -291,10 +292,18 @@ def _time_static(exe, scope, prog, feed_fn, loss_name, steps, warmup,
     per-step host sync would serialize the device against the host round
     trip); each window ends with a hard fetch; the MEDIAN window is
     reported — robust to interference spikes on a shared chip without
-    cherry-picking the single fastest window."""
+    cherry-picking the single fastest window. Returns
+    (samples_per_sec, cold_start_ms): the cold figure is the FIRST step
+    end-to-end (program passes + trace + XLA compile + run + fetch) —
+    the serving/restart warmup cost the DCE/CSE passes attack."""
     import paddle_tpu as fluid
     with fluid.scope_guard(scope):
-        for _ in range(warmup):
+        t0 = time.perf_counter()
+        loss, = exe.run(prog, feed=feed_fn(), fetch_list=[loss_name],
+                        return_numpy=False)
+        float(np.asarray(loss).reshape(()))       # hard cold-step fetch
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        for _ in range(max(warmup - 1, 0)):
             loss, = exe.run(prog, feed=feed_fn(), fetch_list=[loss_name],
                             return_numpy=False)
         float(np.asarray(loss).reshape(()))
@@ -309,7 +318,20 @@ def _time_static(exe, scope, prog, feed_fn, loss_name, steps, warmup,
             lv = float(np.asarray(loss).reshape(()))
             dts.append(time.perf_counter() - t0)
     assert np.isfinite(lv), lv
-    return batch * window / float(np.median(dts))
+    return batch * window / float(np.median(dts)), cold_ms
+
+
+def _attach_compile_split(result, exe, cold_ms):
+    """Cold-start + compile-cost fields for a config's JSON line:
+    first-step latency and the executor's cumulative pass/trace/compile
+    split (framework passes + jit.lower + XLA compile, covering the
+    startup and train programs this executor compiled)."""
+    st = exe.cache_stats()
+    result["cold_start_ms"] = round(cold_ms, 1)
+    result["pass_ms"] = round(st["pass_ms"], 1)
+    result["trace_ms"] = round(st["trace_ms"], 1)
+    result["compile_ms"] = round(st["compile_ms"], 1)
+    return result
 
 
 def bench_mnist():
@@ -328,14 +350,15 @@ def bench_mnist():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-    v = _time_static(exe, scope, main_prog, feed_fn, fetches[0].name,
-                     40, 5, batch)
+    v, cold_ms = _time_static(exe, scope, main_prog, feed_fn,
+                              fetches[0].name, 40, 5, batch)
     result = {"metric": "mnist_lenet_samples_per_sec",
               "value": round(v, 1), "unit": "samples/sec",
               "vs_baseline": _vs_anchor(
                   v, "mnist_lenet_gpu_samples_per_sec")}
+    _attach_compile_split(result, exe, cold_ms)
     return _attach_roofline(result, jax.devices()[0], v, batch,
-                            _step_cost(exe, scope, pool[0], main_prog))
+                            _step_cost(exe, main_prog))
 
 
 def bench_resnet50():
@@ -368,14 +391,15 @@ def bench_resnet50():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
-                     20, 5, batch)
+    v, cold_ms = _time_static(exe, scope, main_prog, feed_fn,
+                              out["loss"].name, 20, 5, batch)
     result = {"metric": "resnet50_bf16_images_per_sec_per_chip",
               "value": round(v, 1), "unit": "images/sec",
               "vs_baseline": _vs_anchor(
                   v, "resnet50_v100_fp16_images_per_sec")}
+    _attach_compile_split(result, exe, cold_ms)
     return _attach_roofline(result, jax.devices()[0], v, batch,
-                            _step_cost(exe, scope, pool[0], main_prog))
+                            _step_cost(exe, main_prog))
 
 
 def bench_widedeep():
@@ -394,14 +418,15 @@ def bench_widedeep():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
-                     40, 5, batch)
+    v, cold_ms = _time_static(exe, scope, main_prog, feed_fn,
+                              out["loss"].name, 40, 5, batch)
     result = {"metric": "widedeep_ctr_samples_per_sec_per_chip",
               "value": round(v, 1), "unit": "samples/sec",
               "vs_baseline": _vs_anchor(
                   v, "widedeep_ctr_ps_node_samples_per_sec")}
+    _attach_compile_split(result, exe, cold_ms)
     return _attach_roofline(result, jax.devices()[0], v, batch,
-                            _step_cost(exe, scope, pool[0], main_prog))
+                            _step_cost(exe, main_prog))
 
 
 def bench_dygraph_transformer():
@@ -528,8 +553,8 @@ def bench_bert_long():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
-                     10, 3, batch)
+    v, cold_ms = _time_static(exe, scope, main_prog, feed_fn,
+                              out["loss"].name, 10, 3, batch)
     # projected anchor (BASELINE.json provenance "bert_long"): the
     # seq-128 V100 anchor scaled by the analytic per-sample train-FLOP
     # ratio — no published V100 seq-2048 BERT numbers exist (the
@@ -544,8 +569,9 @@ def bench_bert_long():
             v, "bert_base_v100_fp16_seq128_samples_per_sec",
             scale=f128 / f2048),
         "vs_baseline_projected": True}
+    _attach_compile_split(result, exe, cold_ms)
     return _attach_roofline(result, jax.devices()[0], v, batch,
-                            _step_cost(exe, scope, pool[0], main_prog),
+                            _step_cost(exe, main_prog),
                             _bert_train_flops_per_sample(cfg, seq_len,
                                                          max_preds))
 
@@ -589,8 +615,8 @@ def bench_gpt_long():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-    v = _time_static(exe, scope, main_prog, feed_fn, out["loss"].name,
-                     10, 3, batch)
+    v, cold_ms = _time_static(exe, scope, main_prog, feed_fn,
+                              out["loss"].name, 10, 3, batch)
     result = {
         "metric": "gpt_base_seq2048_causal_flash_bf16_samples_per_sec",
         "value": round(v, 2), "unit": "samples/sec",
@@ -603,8 +629,9 @@ def bench_gpt_long():
                                                128, 20)
             / _gpt_train_flops_per_sample(cfg, seq_len)),
         "vs_baseline_projected": True}
+    _attach_compile_split(result, exe, cold_ms)
     return _attach_roofline(result, jax.devices()[0], v, batch,
-                            _step_cost(exe, scope, pool[0], main_prog),
+                            _step_cost(exe, main_prog),
                             _gpt_train_flops_per_sample(cfg, seq_len))
 
 
@@ -758,6 +785,90 @@ def bench_serving():
     }
 
 
+def bench_passes():
+    """Program-pass pipeline A/B on a BERT-shaped training program:
+    lowered op count (fused optimizer buckets), trace+compile wall time,
+    and cold-start (first-step) latency with FLAGS_program_passes on vs
+    off. This is the acceptance measurement for the DCE/CSE/fusion
+    pipeline — the headline value is the ON side's trace+compile cost,
+    with the OFF side and the deltas alongside. Accelerators run
+    BERT-base; CPU runs the tiny config (same program shape, fast
+    smoke exercised by a non-slow test)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.framework import passes as P
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform in ("tpu", "gpu", "axon")
+    if on_accel:
+        cfg = bert.BertConfig.base()
+        batch, seq_len, max_preds = 32, 128, 20
+    else:
+        cfg = bert.BertConfig.tiny()
+        batch, seq_len, max_preds = 4, 32, 5
+
+    old = fluid.get_flags("FLAGS_program_passes")["FLAGS_program_passes"]
+    sides = {}
+    try:
+        for label, spec in (("passes_off", "0"), ("passes_on", "1")):
+            fluid.set_flags({"FLAGS_program_passes": spec})
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                out = bert.bert_pretrain(cfg, batch, seq_len, max_preds)
+                fluid.optimizer.AdamOptimizer(1e-4).minimize(out["loss"])
+            rng = np.random.default_rng(0)
+            feed = bert.random_batch(cfg, batch, seq_len, max_preds,
+                                     rng=rng)
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                st0 = exe.cache_stats()
+                t0 = time.perf_counter()
+                loss, = exe.run(main_prog, feed=feed,
+                                fetch_list=[out["loss"]],
+                                return_numpy=False)
+                lv = float(np.asarray(loss).reshape(()))
+                cold_ms = (time.perf_counter() - t0) * 1e3
+            assert np.isfinite(lv), lv
+            st = exe.cache_stats()
+            # what actually lowered: the optimized clone under this flag
+            opt = P.optimize_program(main_prog,
+                                     fetch_names=[out["loss"].name])
+            ops = [op for blk in opt.blocks for op in blk.ops]
+            sides[label] = {
+                "lowered_op_count": len(ops),
+                "optimizer_update_ops": sum(
+                    1 for op in ops
+                    if op.type == "adam" or op.type.startswith("fused_")),
+                "fused_buckets": sum(
+                    1 for op in ops if op.type.startswith("fused_")),
+                "cold_start_ms": round(cold_ms, 1),
+                "pass_ms": round(st["pass_ms"] - st0["pass_ms"], 1),
+                "trace_ms": round(st["trace_ms"] - st0["trace_ms"], 1),
+                "compile_ms": round(st["compile_ms"] - st0["compile_ms"],
+                                    1),
+            }
+    finally:
+        fluid.set_flags({"FLAGS_program_passes": old})
+    on, off = sides["passes_on"], sides["passes_off"]
+    tc_on = on["trace_ms"] + on["compile_ms"]
+    tc_off = off["trace_ms"] + off["compile_ms"]
+    return {
+        "metric": "passes_bert_train_step_trace_plus_compile_ms",
+        "value": round(tc_on, 1),
+        "unit": "ms",
+        "vs_baseline": None,         # intra-repo A/B, no external anchor
+        "trace_compile_speedup_vs_off": round(tc_off / max(tc_on, 1e-9),
+                                              3),
+        "op_count_reduction": (off["lowered_op_count"]
+                               - on["lowered_op_count"]),
+        "passes_on": on,
+        "passes_off": off,
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -774,6 +885,8 @@ _CONFIGS = {
                  "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
     "serving": (bench_serving, "serving_mlp_batch32_samples_per_sec"),
     "train_loop": (bench_train_loop, "train_loop_fused_k8_steps_per_sec"),
+    "passes": (bench_passes,
+               "passes_bert_train_step_trace_plus_compile_ms"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

@@ -49,6 +49,16 @@ _DEFS = {
     # Executor per-(program, feed-shape) compile cache entry cap — bounds
     # what was previously unbounded growth per input-shape signature
     "executor_cache_entries": (128, int, None),
+    # -- pre-lowering program optimization pipeline (framework/passes) --
+    # "1"/"default" = the default pipeline (dce,cse,fuse_optimizer) runs
+    # on every executor compile-cache miss; "0" = off, reproducing the
+    # unoptimized lowering bitwise; or an explicit comma-separated pass
+    # list (e.g. "dce,cse") run in canonical registry order
+    "program_passes": ("1", str, None),
+    # flattened-concat byte cap per fused-optimizer bucket (multi-tensor
+    # apply): same-(op, dtype, hyperparam) update ops group into buckets
+    # of at most this many megabytes of parameters
+    "fuse_optimizer_bucket_mb": (64, int, None),
     # -- fused multi-step training loop (Executor.run_steps) --
     # default K for train_from_dataset: K steps compile into ONE jitted
     # lax.scan over a stacked feed slab (1 = unfused per-step dispatch)

@@ -170,10 +170,115 @@ class Executor:
         from ..utils.lru import LRUCache
         self.place = place
         self._cache = LRUCache(max_entries=_flag("executor_cache_entries"))
+        # optimized-program memo: the pass pipeline's output depends on
+        # (program, fetch set, pass config) but NOT on feed shapes — a
+        # shape-diverse caller must not re-clone + re-optimize per shape
+        # signature, and all shape entries share ONE optimized clone
+        self._opt_cache = LRUCache(max_entries=32)
+        # cumulative cache-miss cost split: program passes, python
+        # trace+StableHLO lowering, XLA compilation (milliseconds)
+        self._compile_stats = {"pass_ms": 0.0, "trace_ms": 0.0,
+                               "compile_ms": 0.0, "compiles": 0}
 
     def cache_stats(self):
-        """Compile-cache occupancy and hit/miss/evict counters."""
-        return self._cache.stats()
+        """Compile-cache occupancy, hit/miss/evict counters, and the
+        cumulative cost split of every cache miss: ``pass_ms``
+        (pre-lowering optimization pipeline), ``trace_ms`` (python
+        trace + StableHLO lowering), ``compile_ms`` (XLA compile),
+        ``compiles`` (miss count)."""
+        return {**self._cache.stats(), **self._compile_stats}
+
+    def _optimize(self, program, fetch_names):
+        """Run the FLAGS_program_passes pipeline over a clone of
+        `program` (framework/passes.py), charging the span to
+        ``pass_ms`` and the ``pass/program_<uid>`` profiler event. With
+        the pipeline off the original program is returned untouched —
+        bitwise the unoptimized lowering."""
+        from .. import profiler as _prof
+        from .passes import optimize_program, pipeline_signature
+        sig = pipeline_signature()
+        if not sig:
+            return program
+        key = (program._uid, program.version, tuple(fetch_names), sig)
+        opt = self._opt_cache.get(key)
+        if opt is not None:
+            return opt
+        t0 = time.perf_counter()
+        opt = optimize_program(program, fetch_names=fetch_names)
+        if opt is not program:
+            dt = time.perf_counter() - t0
+            self._compile_stats["pass_ms"] += dt * 1e3
+            _prof.record_duration(f"pass/program_{program._uid}", dt)
+        self._opt_cache[key] = opt
+        return opt
+
+    def _lower_and_compile(self, jitted, event, args):
+        """Explicit trace (``jitted.lower``) / XLA-compile split so the
+        two are separately measurable (``trace/<event>`` and
+        ``compile/<event>`` profiler rows, cache_stats() totals). The
+        returned AOT executable is what the cache replays."""
+        from .. import profiler as _prof
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        self._compile_stats["trace_ms"] += (t1 - t0) * 1e3
+        self._compile_stats["compile_ms"] += (t2 - t1) * 1e3
+        self._compile_stats["compiles"] += 1
+        _prof.record_duration(f"trace/{event}", t1 - t0)
+        _prof.record_duration(f"compile/{event}", t2 - t1)
+        return compiled
+
+    @staticmethod
+    def _state_fetches(program, fetch_names, feed_names, state_in, scope):
+        """Fetch targets no op produces and no feed binds are reads of
+        scope state (e.g. PTQ fetching calibrated weights): they must
+        ride state_in into the env even when DCE pruned every op that
+        read them. Only names the scope actually holds qualify — a
+        typo'd fetch stays out of state_in and surfaces as the
+        trace-time \"fetch target was never computed\" KeyError instead
+        of a misleading not-initialized error. Returns
+        (state_in + extras, extras): the extras are scope-DEPENDENT, so
+        cache entries record them and a hit under a scope that lacks one
+        recompiles instead of replaying a wrong binding."""
+        produced = {n for blk in program.blocks for op in blk.ops
+                    for n in op.output_arg_names}
+        known = produced | set(feed_names) | set(state_in)
+        extras = [n for n in fetch_names
+                  if n not in known and scope.find_var(n) is not None]
+        return state_in + extras, tuple(extras)
+
+    @staticmethod
+    def _entry_valid(entry, scope):
+        """A cached entry is replayable under `scope` iff every
+        scope-state fetch it was compiled with is still present."""
+        return all(scope.find_var(n) is not None for n in entry[-1])
+
+    def _invoke(self, compiled, jitted, args, event, cache_key=None):
+        """Replay the AOT executable; if the call-time avals drifted from
+        the lowered ones (e.g. scope state replaced with a different
+        weak-type/sharding after a checkpoint load), RE-lower+compile
+        under the new avals and refresh the cache entry, so later calls
+        return to the AOT fast path instead of paying a raised-and-caught
+        validation error per step. Only input-validation failures recover
+        — the AOT call validates BEFORE executing (and before any buffer
+        donation), so nothing runs twice and the args are intact for the
+        recompile; the recompile shows up in cache_stats() ``compiles``
+        and the ``trace/``/``compile/`` events. Any other error
+        propagates."""
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError) as e:
+            if "compiled" not in str(e).lower():
+                raise
+            new_compiled = self._lower_and_compile(jitted, event, args)
+            if cache_key is not None:
+                ent = self._cache.get(cache_key)
+                if ent is not None:
+                    self._cache[cache_key] = \
+                        (new_compiled,) + tuple(ent[1:])
+            return new_compiled(*args)
 
     # -- helpers ---------------------------------------------------------
     @staticmethod
@@ -262,25 +367,20 @@ class Executor:
             feed_arrays[name] = arr
             feed_sig.append((name, tuple(arr.shape), str(arr.dtype)))
 
+        from .passes import pipeline_signature
         cache_key = (program._uid, program.version, tuple(sorted(feed_sig)),
-                     tuple(fetch_names), id(mesh))
+                     tuple(fetch_names), id(mesh), pipeline_signature())
         entry = self._cache.get(cache_key) if use_program_cache else None
-        if entry is None:
-            from .. import profiler as _prof
-            with _prof.record_event(f"compile/program_{program._uid}"):
-                state_in, state_out = analyze_block_io(
-                    program, 0, list(feed_arrays.keys()))
-                fn = build_block_fn(program, 0, list(feed_arrays.keys()),
-                                    fetch_names, state_in, state_out,
-                                    mesh=mesh)
-                if mesh is not None:
-                    jitted = _jit_with_mesh(fn, mesh, program)
-                else:
-                    jitted = jax.jit(fn, donate_argnums=(0,))
-            entry = (jitted, state_in, state_out)
-            if use_program_cache:
-                self._cache[cache_key] = entry
-        jitted, state_in, state_out = entry
+        if entry is not None and not self._entry_valid(entry, scope):
+            entry = None               # scope-state fetch binding changed
+        if entry is not None:
+            compiled, jitted, state_in, state_out, state_fetches = entry
+        else:
+            opt_prog = self._optimize(program, fetch_names)
+            state_in, state_out = analyze_block_io(
+                opt_prog, 0, list(feed_arrays.keys()))
+            state_in, state_fetches = self._state_fetches(
+                opt_prog, fetch_names, feed_arrays, state_in, scope)
 
         base_key = self._ensure_rng(scope, program)
         state_out_set = set(state_out)
@@ -293,23 +393,40 @@ class Executor:
             self._reshard_state_to_scope(scope, program, mesh, state_mut,
                                          state_ro)
 
+        if entry is None:
+            fn = build_block_fn(opt_prog, 0, list(feed_arrays.keys()),
+                                fetch_names, state_in, state_out,
+                                mesh=mesh)
+            if mesh is not None:
+                jitted = _jit_with_mesh(fn, mesh, opt_prog)
+            else:
+                jitted = jax.jit(fn, donate_argnums=(0,))
+            compiled = self._lower_and_compile(
+                jitted, f"program_{program._uid}",
+                (state_mut, state_ro, feed_arrays, base_key))
+            if use_program_cache:
+                self._cache[cache_key] = (compiled, jitted, state_in,
+                                          state_out, state_fetches)
+
         if check_nan_inf is None:
             check_nan_inf = _flag("check_nan_inf")
         backup = None
         if skip_nonfinite_steps:
-            # the jit donates state_mut buffers, so rollback needs host
-            # copies taken BEFORE the step (the price of the opt-in)
+            # the executable donates state_mut buffers, so rollback needs
+            # host copies taken BEFORE the step (the price of the opt-in)
             backup = {n: np.asarray(v) for n, v in state_mut.items()}
 
         from .. import profiler as _prof
+        invoke_args = (compiled, jitted,
+                       (state_mut, state_ro, feed_arrays, base_key),
+                       f"program_{program._uid}",
+                       cache_key if use_program_cache else None)
         if _prof.is_profiling():
             with _prof.record_event(f"run/program_{program._uid}"):
-                fetches, new_state, new_key = jitted(
-                    state_mut, state_ro, feed_arrays, base_key)
+                fetches, new_state, new_key = self._invoke(*invoke_args)
                 jax.block_until_ready(fetches)
         else:
-            fetches, new_state, new_key = jitted(state_mut, state_ro,
-                                                 feed_arrays, base_key)
+            fetches, new_state, new_key = self._invoke(*invoke_args)
 
         bad = None
         if check_nan_inf or skip_nonfinite_steps:
@@ -439,18 +556,24 @@ class Executor:
             # loop form so compile time stays K-independent
             unroll = k_steps if jax.default_backend() == "cpu" else 1
 
+        from .passes import pipeline_signature
         cache_key = (program._uid, program.version,
                      tuple(sorted(feed_sig)), tuple(fetch_names), id(mesh),
                      "steps", k_steps, guard, bool(skip_nonfinite_steps),
-                     unroll)
+                     unroll, pipeline_signature())
         entry = self._cache.get(cache_key) if use_program_cache else None
+        if entry is not None and not self._entry_valid(entry, scope):
+            entry = None               # scope-state fetch binding changed
         fresh_compile = entry is None
         if entry is not None:
-            (jitted, state_in, state_out, mut_names, slot_names,
-             wo_avals) = entry
+            (compiled, jitted, state_in, state_out, mut_names, slot_names,
+             wo_avals, state_fetches) = entry
         else:
+            opt_prog = self._optimize(program, fetch_names)
             state_in, state_out = analyze_block_io(
-                program, 0, list(feed_arrays.keys()))
+                opt_prog, 0, list(feed_arrays.keys()))
+            state_in, state_fetches = self._state_fetches(
+                opt_prog, fetch_names, feed_arrays, state_in, scope)
 
         base_key = self._ensure_rng(scope, program)
         state_out_set = set(state_out)
@@ -464,36 +587,21 @@ class Executor:
 
         from .. import profiler as _prof
         if fresh_compile:
-            with _prof.record_event(
-                    f"compile/fused_program_{program._uid}_x{k_steps}"):
-                step_fn = build_block_fn(
-                    program, 0, list(feed_arrays.keys()), fetch_names,
-                    state_in, state_out, mesh=mesh)
-                feed_row = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
-                            for n, a in feed_arrays.items()}
-                _, new_state_s, _ = jax.eval_shape(
-                    step_fn, state_mut, state_ro, feed_row, base_key)
-                mut_names = [n for n in state_in if n in state_out_set]
-                slot_names = (["fetched output " + repr(n)
-                               for n in fetch_names]
-                              + ["updated variable " + repr(n)
-                                 for n in new_state_s])
-                wo_avals = {n: jax.ShapeDtypeStruct(s.shape, s.dtype)
-                            for n, s in new_state_s.items()
-                            if n not in state_mut}
-                fn = build_multi_step_fn(
-                    program, 0, list(feed_arrays.keys()), fetch_names,
-                    state_in, state_out, mut_names, mesh=mesh,
-                    guard=guard,
-                    skip_nonfinite=bool(skip_nonfinite_steps),
-                    unroll=unroll)
-                if mesh is not None:
-                    jitted = _jit_with_mesh_steps(fn, mesh)
-                else:
-                    jitted = jax.jit(fn, donate_argnums=(0,))
-            if use_program_cache:
-                self._cache[cache_key] = (jitted, state_in, state_out,
-                                          mut_names, slot_names, wo_avals)
+            step_fn = build_block_fn(
+                opt_prog, 0, list(feed_arrays.keys()), fetch_names,
+                state_in, state_out, mesh=mesh)
+            feed_row = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                        for n, a in feed_arrays.items()}
+            _, new_state_s, _ = jax.eval_shape(
+                step_fn, state_mut, state_ro, feed_row, base_key)
+            mut_names = [n for n in state_in if n in state_out_set]
+            slot_names = (["fetched output " + repr(n)
+                           for n in fetch_names]
+                          + ["updated variable " + repr(n)
+                             for n in new_state_s])
+            wo_avals = {n: jax.ShapeDtypeStruct(s.shape, s.dtype)
+                        for n, s in new_state_s.items()
+                        if n not in state_mut}
 
         # write-only persistable outputs ride the scan carry so a
         # rolled-back step restores what the scope held (sequential-skip
@@ -511,27 +619,41 @@ class Executor:
             _shard_state(tmp, mesh, program)
             state_mut.update(tmp)
 
+        if fresh_compile:
+            fn = build_multi_step_fn(
+                opt_prog, 0, list(feed_arrays.keys()), fetch_names,
+                state_in, state_out, mut_names, mesh=mesh,
+                guard=guard,
+                skip_nonfinite=bool(skip_nonfinite_steps),
+                unroll=unroll)
+            if mesh is not None:
+                jitted = _jit_with_mesh_steps(fn, mesh)
+            else:
+                jitted = jax.jit(fn, donate_argnums=(0,))
+            compiled = self._lower_and_compile(
+                jitted, f"fused_program_{program._uid}_x{k_steps}",
+                (state_mut, state_ro, feed_arrays, base_key))
+            if use_program_cache:
+                self._cache[cache_key] = (compiled, jitted, state_in,
+                                          state_out, mut_names,
+                                          slot_names, wo_avals,
+                                          state_fetches)
+
         profiling = _prof.is_profiling()
         t0 = time.perf_counter()
-        fetches, final_state, final_key, viols, slots = jitted(
-            state_mut, state_ro, feed_arrays, base_key)
+        fetches, final_state, final_key, viols, slots = self._invoke(
+            compiled, jitted, (state_mut, state_ro, feed_arrays, base_key),
+            f"fused_program_{program._uid}_x{k_steps}",
+            cache_key if use_program_cache else None)
         if profiling:
             t1 = time.perf_counter()
             jax.block_until_ready(fetches if fetches else final_key)
             span = time.perf_counter() - t0
-            if fresh_compile:
-                # XLA compiles lazily at first call: charge that span to
-                # the compile event, not the step-time histogram
-                _prof.record_duration(
-                    f"compile/fused_program_{program._uid}_x{k_steps}",
-                    span)
-            else:
-                _prof.record_duration(
-                    f"dispatch/program_{program._uid}_x{k_steps}",
-                    t1 - t0)
-                _prof.record_duration(
-                    f"scan/program_{program._uid}_x{k_steps}", span)
-                _prof.record_step_time(span / k_steps, k_steps)
+            _prof.record_duration(
+                f"dispatch/program_{program._uid}_x{k_steps}", t1 - t0)
+            _prof.record_duration(
+                f"scan/program_{program._uid}_x{k_steps}", span)
+            _prof.record_step_time(span / k_steps, k_steps)
 
         v = np.asarray(viols) if guard else None  # ONE small readback
         # commit (buffers were donated); guard diagnostics after. If
@@ -609,6 +731,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._opt_cache.clear()
 
     # ---- dataset ingestion (reference executor.py:1440 train_from_dataset
     # -> C++ trainer threads; here the host parses/batches and the compiled

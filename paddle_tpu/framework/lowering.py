@@ -8,6 +8,8 @@ by XLA. Sequential in-place semantics of the reference (optimizer writes, BN
 running stats) are recovered by name rebinding in the env; persistable writes
 flow back to the Scope.
 """
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -162,6 +164,56 @@ def build_block_fn(program, block_idx, feed_names, fetch_names, state_in,
         return fetches, new_state, new_key
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Flattened-concat machinery for the fused multi-tensor optimizer kernels
+# (framework/passes.py FuseOptimizerPass -> ops/optimizer_ops.py fused_*).
+# A bucket of N per-param updates lowers as ONE elementwise update over
+# the concatenation of the flattened params; because every op involved is
+# elementwise, each element sees exactly the arithmetic the per-param op
+# would apply — the fused path is bitwise-identical, just 1 kernel
+# instead of N.
+# ---------------------------------------------------------------------------
+
+def flatten_concat(arrs, mesh=None):
+    """Concatenate arrays into one flat vector; returns
+    (flat, shapes) where `shapes` undoes the concat via
+    :func:`split_unflatten`. Under a mesh the result is pinned
+    REPLICATED: the fusion pass only buckets unsharded params, but
+    GSPMD's propagation through a concat of values derived from
+    tp-sharded activations must not be left to choose a partitioning
+    the split would mis-slice."""
+    shapes = [tuple(a.shape) for a in arrs]
+    flat = jnp.concatenate([jnp.reshape(a, (-1,)) for a in arrs])
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        flat = jax.lax.with_sharding_constraint(
+            flat, NamedSharding(mesh, P()))
+    return flat, shapes
+
+
+def split_unflatten(flat, shapes):
+    """Inverse of :func:`flatten_concat`: split `flat` back into arrays
+    of the given shapes (static sizes — XLA lowers this to slices)."""
+    sizes = [math.prod(s) for s in shapes]
+    offsets = []
+    acc = 0
+    for n in sizes[:-1]:
+        acc += n
+        offsets.append(acc)
+    parts = jnp.split(flat, offsets) if offsets else [flat]
+    return [jnp.reshape(p, s) for p, s in zip(parts, shapes)]
+
+
+def broadcast_segments(scalars, shapes, dtype):
+    """Per-segment scalar broadcast over a flattened concat: segment i
+    (of size prod(shapes[i])) is filled with scalars[i]. Used for
+    per-param scalars (adam's bias-corrected step size) so each element
+    is multiplied by exactly the scalar its per-param op would use."""
+    return jnp.concatenate([
+        jnp.full((math.prod(s),), jnp.reshape(sc, ()).astype(dtype))
+        for sc, s in zip(scalars, shapes)])
 
 
 def _nonfinite_leaf(x):

@@ -1,4 +1,5 @@
-"""Program-pass framework: registry + ordered application.
+"""Program-pass framework: registry + ordered application + the
+pre-lowering optimization pipeline.
 
 Capability parity with the reference's IR pass infrastructure
 (/root/reference/paddle/fluid/framework/ir/pass.h — Pass::Apply over a
@@ -10,15 +11,68 @@ insertion, QAT instrumentation, sync-BN substitution) already walks by
 hand. Registering them gives users the reference's extension point: write
 a Pass subclass, `register_pass` it, and `apply_passes(program, [...])`
 runs an ordered pipeline.
+
+The DEFAULT pipeline (``FLAGS_program_passes``, on by default) runs on
+every Executor compile-cache miss, over a CLONE of the user's program —
+the original Program is never mutated, so program versions (and with
+them the compile-cache keys) stay stable:
+
+- ``dce``   — dead code elimination: drop ops whose outputs are
+  unreachable from the fetch targets, persistable writes, or
+  side-effecting ops (the reference traces fetch-pruned programs
+  op-by-op; here dead branches cost trace/compile time even though XLA
+  would DCE them later).
+- ``cse``   — common subexpression elimination: dedupe identical
+  (type, inputs-at-version, attrs) pure ops within the global block
+  (duplicate casts/fill_constants from AMP and grad-merge rewrites).
+- ``fuse_optimizer`` — multi-tensor optimizer fusion: per-param
+  sgd/momentum/adam/adamw update ops group into byte-capped buckets,
+  each lowered as ONE flattened-concat update (NVIDIA-Apex-style
+  multi_tensor_apply; the reference's fuse_adam_op_pass). Elementwise
+  math on the concatenation is bitwise-identical to the per-param ops.
+
+Every pass records op/byte deltas and wall time — ``stats()`` reports
+the last pipeline run, and profiler events (``pass/<name>``) feed the
+summary table.
 """
+import time
+
+import numpy as np
+
+from ..flags import flag as _flag
+# underscore-aliased: this namespace is part of the frozen public API
+# surface (tools/api_signatures.txt) — only the pass registry is public
+from .core import OP_ROLE_KEY
+from .core import Operator as _Operator
+from .core import OpRole as _OpRole
+from .core import VarType as _VarType
+from .dtype import np_dtype as _np_dtype
+
+
+class UnknownPassError(KeyError):
+    """Raised for a pass name that is not in the registry; the message
+    names the registered passes (a typo'd name used to surface as a bare
+    KeyError with no context)."""
+
+    def __init__(self, name):
+        self.pass_name = name
+        super().__init__(name)
+
+    def __str__(self):
+        return (f"pass {self.pass_name!r} is not registered; "
+                f"known passes: {list_passes()}")
 
 
 class Pass:
     """Base pass: override apply(program) and mutate in place (return
-    the program for chaining). `name` defaults to the class name
-    de-camelized; attrs passed at construction are available on self."""
+    the program for chaining). `name` defaults to the registration name;
+    attrs passed at construction are available on self.
+    ``pipeline_order`` ranks the pass in canonical pipeline order
+    (lower runs earlier; None = no canonical position, ordered by
+    registration)."""
 
     name = None
+    pipeline_order = None
 
     def __init__(self, **attrs):
         for k, v in attrs.items():
@@ -40,13 +94,27 @@ class Pass:
 
 
 _PASSES = {}
+_REG_SEQ = {}          # name -> registration index (ordering tiebreak)
+_REG_GEN = [0]         # bumped per registration: pass IDENTITY version
+_sig_memo = {}         # (flag values, reg gen) -> pipeline_signature()
 
 
 def register_pass(name):
     """Decorator: register a Pass subclass (or factory) under `name`
-    (reference REGISTER_PASS(name, class))."""
+    (reference REGISTER_PASS(name, class)). Re-registering a name
+    overrides the previous entry (the extension point for swapping a
+    built-in pass with a custom one); the registration generation feeds
+    :func:`pipeline_signature`, so executables compiled under the old
+    pass can never be replayed for the new one."""
     def deco(cls):
         _PASSES[name] = cls
+        _REG_SEQ.setdefault(name, len(_REG_SEQ))
+        _REG_GEN[0] += 1
+        try:
+            cls._reg_serial = _REG_GEN[0]
+        except (AttributeError, TypeError):
+            pass
+        _sig_memo.clear()
         if getattr(cls, "name", None) is None:
             try:
                 cls.name = name
@@ -59,8 +127,7 @@ def register_pass(name):
 def get_pass(name, **attrs):
     cls = _PASSES.get(name)
     if cls is None:
-        raise KeyError(
-            f"pass {name!r} is not registered; known: {sorted(_PASSES)}")
+        raise UnknownPassError(name)
     return cls(**attrs)
 
 
@@ -72,14 +139,168 @@ def list_passes():
     return sorted(_PASSES)
 
 
+def canonical_order(names):
+    """Deterministic pipeline order for a collection of pass names:
+    by ``pipeline_order`` (dce < cse < fuse_optimizer), then by
+    registration sequence for passes without a canonical position."""
+    def rank(n):
+        cls = _PASSES.get(n)
+        order = getattr(cls, "pipeline_order", None) if cls else None
+        return (0, order, "") if order is not None \
+            else (1, _REG_SEQ.get(n, len(_REG_SEQ)), n)
+    return sorted(names, key=rank)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline application + stats
+# ---------------------------------------------------------------------------
+
+_last_stats = {"passes": [], "total_ms": 0.0}
+
+
+def stats():
+    """Report of the LAST apply_passes run: per-pass
+    {pass, ops_before, ops_after, bytes_before, bytes_after, ms, detail}
+    plus the pipeline total."""
+    return {"passes": [dict(r) for r in _last_stats["passes"]],
+            "total_ms": _last_stats["total_ms"]}
+
+
+def _program_op_count(program):
+    return sum(len(blk.ops) for blk in program.blocks)
+
+
+def _program_bytes(program):
+    """Static-size estimate of every var the program's ops touch (dims
+    of -1 and unknown shapes contribute 0 — a telemetry measure, not an
+    allocator)."""
+    seen = set()
+    total = 0
+    for blk in program.blocks:
+        for op in blk.ops:
+            for n in op.input_arg_names + op.output_arg_names:
+                if n in seen:
+                    continue
+                seen.add(n)
+                try:
+                    var = blk.var(n)
+                except ValueError:
+                    continue
+                shape = getattr(var, "shape", None)
+                if shape is None or any(int(s) < 0 for s in shape):
+                    continue
+                try:
+                    itemsize = np.dtype(_np_dtype(var.dtype)).itemsize
+                except (TypeError, ValueError):
+                    continue
+                total += int(np.prod(shape, dtype=np.int64)) * itemsize
+    return total
+
+
 def apply_passes(program, names, **common_attrs):
-    """Run passes in the given order (reference PassBuilder::Build).
-    `names` entries are either a registered name or an instantiated
-    Pass/callable."""
+    """Run passes over `program` (reference PassBuilder::Build).
+    `names` entries are either registered names or instantiated
+    Pass/callables. Lists/tuples run in the GIVEN order; unordered
+    collections (set/frozenset/dict keys) are canonicalized with
+    :func:`canonical_order` so the pipeline is deterministic. An unknown
+    name raises :class:`UnknownPassError` naming the registry contents.
+    Per-pass op/byte deltas and wall time land in :func:`stats` and the
+    profiler event table (``pass/<name>``)."""
+    from .. import profiler as _prof
+    if isinstance(names, (set, frozenset)) or (
+            isinstance(names, dict) or type(names).__name__ == "dict_keys"):
+        names = canonical_order(list(names))
+    rows = []
+    t_pipeline = time.perf_counter()
+    ops = _program_op_count(program)
+    nbytes = _program_bytes(program)
     for n in names:
         p = get_pass(n, **common_attrs) if isinstance(n, str) else n
+        pname = getattr(p, "name", None) or type(p).__name__
+        t0 = time.perf_counter()
         program = p(program) or program
+        dt = time.perf_counter() - t0
+        ops_after = _program_op_count(program)
+        bytes_after = _program_bytes(program)
+        row = {"pass": pname, "ops_before": ops, "ops_after": ops_after,
+               "bytes_before": nbytes, "bytes_after": bytes_after,
+               "ms": dt * 1e3}
+        detail = getattr(p, "_report", None)
+        if detail:
+            row["detail"] = dict(detail)
+        rows.append(row)
+        _prof.record_duration(f"pass/{pname}", dt)
+        ops, nbytes = ops_after, bytes_after
+    _last_stats["passes"] = rows
+    _last_stats["total_ms"] = (time.perf_counter() - t_pipeline) * 1e3
     return program
+
+
+# The executor's default pipeline (canonical order).
+DEFAULT_PIPELINE = ("dce", "cse", "fuse_optimizer")
+
+
+def resolve_pipeline(spec=None):
+    """FLAGS_program_passes -> ordered tuple of pass names. "0"/"off"
+    disables the pipeline entirely (the executor then lowers the user's
+    program untouched — bitwise today's behavior); "1"/"default" is
+    DEFAULT_PIPELINE; anything else is a comma-separated pass list run
+    in canonical order."""
+    if spec is None:
+        spec = _flag("program_passes")
+    s = str(spec).strip().lower()
+    if s in ("0", "", "off", "false", "none"):
+        return ()
+    if s in ("1", "on", "true", "default"):
+        names = list(DEFAULT_PIPELINE)
+    else:
+        names = [t.strip() for t in str(spec).split(",") if t.strip()]
+    for n in names:
+        if n not in _PASSES:
+            raise UnknownPassError(n)
+    return tuple(canonical_order(names))
+
+
+def pipeline_signature(spec=None):
+    """Hashable identity of the active pass configuration — the flag's
+    resolved pipeline, each pass's registration serial (re-registering a
+    pass changes its serial, so executables compiled under the old
+    implementation can't replay), and every attr that changes a pass's
+    output. Part of the executor's compile-cache key so toggling passes
+    can never serve a stale executable. Memoized on the flag values +
+    registry generation: this sits on the per-step dispatch path, so
+    the parse/sort must not recur."""
+    raw = (_flag("program_passes") if spec is None else spec,
+           _flag("fuse_optimizer_bucket_mb"), _REG_GEN[0])
+    sig = _sig_memo.get(raw)
+    if sig is not None:
+        return sig
+    names = resolve_pipeline(raw[0])
+    if not names:
+        sig = ()
+    else:
+        extras = []
+        if "fuse_optimizer" in names:
+            extras.append(("fuse_optimizer_bucket_mb", int(raw[1])))
+        sig = (tuple((n, getattr(_PASSES[n], "_reg_serial", 0))
+                     for n in names), tuple(extras))
+    if len(_sig_memo) < 64:        # flags take few distinct values
+        _sig_memo[raw] = sig
+    return sig
+
+
+def optimize_program(program, fetch_names=(), spec=None):
+    """Run the configured pipeline over a CLONE of `program` and return
+    it (the caller's program is never mutated, keeping its version — and
+    the executor cache keys derived from it — stable). With the pipeline
+    disabled the original program is returned as-is."""
+    names = resolve_pipeline(spec)
+    if not names:
+        return program
+    opt = program.clone()
+    pipeline = [get_pass(n, fetch_names=tuple(fetch_names)) for n in names]
+    apply_passes(opt, pipeline)
+    return opt
 
 
 # ---------------------------------------------------------------------------
@@ -134,3 +355,409 @@ class QuantAwarePass(Pass):
         QuantizationTransformPass(
             weight_bits=self.weight_bits,
             activation_bits=self.activation_bits).apply(program)
+
+
+# ---------------------------------------------------------------------------
+# The pre-lowering optimization pipeline: DCE / CSE / optimizer fusion.
+# ---------------------------------------------------------------------------
+
+# Ops whose execution is observable beyond their outputs (host printing,
+# RPC/parameter-server traffic, user callbacks, runtime checks): DCE
+# roots, never CSE candidates. Collective "c_*"-prefixed ops are treated
+# the same without being listed.
+SIDE_EFFECT_OPS = frozenset({
+    "print", "py_func", "runtime_assert", "assert", "feed", "fetch",
+    "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
+    "distributed_lookup_table", "pull_sparse", "pull_sparse_v2",
+    "push_sparse", "push_sparse_v2", "pull_box_sparse", "push_box_sparse",
+    "broadcast", "alltoall", "run_program",
+})
+
+
+def _has_sub_block(op):
+    from .core import Program
+    return any(op.attrs.get(a) is not None
+               for a in Program._SUB_BLOCK_ATTRS)
+
+
+def _is_side_effect_type(t):
+    """Side-effecting op types, including their grad ops: a custom grad
+    lowering can carry the effect itself (distributed_lookup_table_grad
+    pushes sparse grads to the pserver via io_callback — removing it as
+    'dead' silently stops the embedding from learning)."""
+    if t in SIDE_EFFECT_OPS or t.startswith("c_"):
+        return True
+    return t.endswith("_grad") and _is_side_effect_type(t[:-5])
+
+
+def _writes_persistable(block, op):
+    for n in op.output_arg_names:
+        try:
+            if block.var(n).persistable:
+                return True
+        except ValueError:
+            continue
+    return False
+
+
+def _needs_rng(op):
+    if "__rng_seed__" in op.attrs:
+        return True
+    from .registry import OPS
+    t = op.type
+    base = OPS.get(t) or (OPS.get(t[:-5]) if t.endswith("_grad") else None)
+    return bool(base is not None and base.needs_rng)
+
+
+def _freeze(v):
+    """Stable hashable form of an op attr value (nested dicts from grad
+    ops' __fwd_op__, numpy arrays, lists)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return ("__ndarray__", v.shape, str(v.dtype), v.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted((_freeze(x) for x in v), key=repr))
+    return v
+
+
+@register_pass("dce")
+class DeadCodeEliminationPass(Pass):
+    """Drop global-block ops whose outputs are unreachable from the
+    fetch targets, any persistable write, or any side-effecting op
+    (reference: the executor GC/pruning role of
+    framework/executor_gc_helper.cc + Program._prune, but run
+    automatically before lowering). Control-flow ops keep their whole
+    sub-block; only block 0 is pruned. attrs: fetch_names."""
+
+    pipeline_order = 10
+    fetch_names = ()
+
+    def _is_root(self, block, op):
+        from .registry import has_op
+        t = op.type
+        if _is_side_effect_type(t):
+            return True
+        if _has_sub_block(op):
+            return True
+        if not op.outputs:
+            return True            # output-less ops act for effect only
+        if not has_op(t):
+            return True            # unknown semantics: keep
+        return _writes_persistable(block, op)
+
+    def apply(self, program):
+        block = program.global_block()
+        needed = set(self.fetch_names or ())
+        kept = []
+        removed = 0
+        for op in reversed(block.ops):
+            if self._is_root(block, op) or \
+                    any(n in needed for n in op.output_arg_names):
+                kept.append(op)
+                needed.update(program._op_reads(op))
+            else:
+                removed += 1
+        kept.reverse()
+        block.ops = kept
+        self._report = {"removed_ops": removed}
+
+
+@register_pass("cse")
+class CommonSubexpressionEliminationPass(Pass):
+    """Dedupe identical pure ops in the global block: two ops with the
+    same (type, attrs, input names at the same binding version) compute
+    the same values, so the second is dropped and later readers are
+    renamed to the first's outputs. Never merges RNG-consuming ops
+    (each carries a unique __rng_seed__ and must keep its own stream),
+    side-effecting ops, control-flow ops, or ops whose outputs are
+    persistable, fetched, rebound elsewhere, or read inside a
+    sub-block (those reads cannot be renamed). attrs: fetch_names."""
+
+    pipeline_order = 20
+    fetch_names = ()
+
+    def _pinned_names(self, program):
+        pinned = set(self.fetch_names or ())
+        for blk in program.blocks:
+            for op in blk.ops:
+                if _has_sub_block(op):
+                    # renames don't descend into sub-blocks, so anything
+                    # such an op (transitively) reads stays fixed
+                    pinned |= program._op_reads(op)
+        return pinned
+
+    def _eligible(self, block, op, pinned, def_count, version):
+        from .registry import has_op
+        t = op.type
+        if _is_side_effect_type(t) or not has_op(t):
+            return False
+        if _has_sub_block(op) or _needs_rng(op):
+            return False
+        outs = op.output_arg_names
+        if not outs:
+            return False
+        for n in outs:
+            if n in pinned or n in version or def_count.get(n, 0) != 1:
+                return False       # only fresh, single-def outputs
+            try:
+                if block.var(n).persistable:
+                    return False
+            except ValueError:
+                pass
+        return True
+
+    @staticmethod
+    def _key(op, version):
+        attrs = tuple(sorted((k, _freeze(v)) for k, v in op.attrs.items()
+                             if k != OP_ROLE_KEY))
+        ins = tuple(sorted(
+            (slot, tuple((n, version.get(n, 0)) for n in names))
+            for slot, names in op.inputs.items()))
+        out_shape = tuple(sorted((slot, len(names))
+                                 for slot, names in op.outputs.items()))
+        return (op.type, attrs, ins, out_shape)
+
+    def apply(self, program):
+        block = program.global_block()
+        pinned = self._pinned_names(program)
+        def_count = {}
+        for op in block.ops:
+            for n in op.output_arg_names:
+                def_count[n] = def_count.get(n, 0) + 1
+        version = {}       # name -> rebind count (value identity)
+        rename = {}        # dropped output -> canonical output
+        seen = {}          # value key -> canonical op
+        kept = []
+        merged = 0
+        for op in block.ops:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename.get(n, n) for n in names]
+            if self._eligible(block, op, pinned, def_count, version):
+                key = self._key(op, version)
+                prior = seen.get(key)
+                if prior is not None:
+                    for slot, names in op.outputs.items():
+                        for mine, theirs in zip(names,
+                                                prior.outputs.get(slot,
+                                                                  ())):
+                            rename[mine] = theirs
+                    merged += 1
+                    continue       # drop the duplicate
+                seen[key] = op
+            kept.append(op)
+            for n in op.output_arg_names:
+                version[n] = version.get(n, 0) + 1
+        block.ops = kept
+        self._report = {"merged_ops": merged}
+
+
+# Fusable per-param optimizer updates: state slots riding along with
+# Param/Grad/LearningRate. LARS/LAMB are excluded on purpose — their
+# per-PARAM norm reductions would change meaning over a concatenation.
+_FUSABLE_OPTIMIZERS = {
+    "sgd": (),
+    "momentum": ("Velocity",),
+    "adam": ("Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+    "adamw": ("Moment1", "Moment2", "Beta1Pow", "Beta2Pow"),
+}
+# scalar-broadcast state (per-param scalars, NOT concatenated)
+_SCALAR_STATE = frozenset({"Beta1Pow", "Beta2Pow"})
+_STATE_OUT = {"Velocity": "VelocityOut", "Moment1": "Moment1Out",
+              "Moment2": "Moment2Out", "Beta1Pow": "Beta1PowOut",
+              "Beta2Pow": "Beta2PowOut"}
+
+
+@register_pass("fuse_optimizer")
+class FuseOptimizerPass(Pass):
+    """Multi-tensor optimizer fusion (reference
+    ir/fuse_optimizer_ops_pass/fuse_adam_op_pass.cc; NVIDIA Apex
+    multi_tensor_apply): per-param sgd/momentum/adam/adamw update ops
+    with the same (op type, param dtype, hyperparameters, LR var) fuse
+    into bucketed ``fused_<type>`` ops, each lowered as ONE
+    flattened-concat elementwise update (framework/lowering.py
+    fused_flat_apply) — bitwise-identical per element to the per-param
+    ops, but hundreds of tiny kernels become a handful. Buckets cap at
+    ``max_bucket_bytes`` (default FLAGS_fuse_optimizer_bucket_mb).
+    Sparse (SelectedRows) grads, lazy-mode adam, sharded (dist_attr)
+    params, and param-shaped beta-pow accumulators stay unfused."""
+
+    pipeline_order = 30
+    fetch_names = ()
+    max_bucket_bytes = None
+
+    # -- eligibility ------------------------------------------------------
+    @staticmethod
+    def _maybe_sparse_names(block):
+        """Var names that may hold a SelectedRows VALUE at run time
+        (sparsity is a value property here, not an IR var type): outputs
+        of sparse-grad emitters, propagated through any op they feed."""
+        sparse = set()
+        for op in block.ops:
+            t = op.type
+            src = t in ("split_selected_rows", "merge_selected_rows")
+            if not src and t.endswith("_grad"):
+                fwd = op.attrs.get("__fwd_op__")
+                src = bool(op.attrs.get("is_sparse")) or (
+                    isinstance(fwd, dict)
+                    and fwd.get("attrs", {}).get("is_sparse"))
+            if src or any(n in sparse for n in op.input_arg_names):
+                sparse.update(op.output_arg_names)
+        return sparse
+
+    def _candidate(self, block, op, sparse_names):
+        """(group_key, param_bytes) when `op` is a fusable per-param
+        update, else None."""
+        state_slots = _FUSABLE_OPTIMIZERS.get(op.type)
+        if state_slots is None:
+            return None
+        if op.attrs.get("lazy_mode"):
+            return None
+        needed = ("Param", "Grad", "LearningRate") + state_slots
+        if any(len(op.inputs.get(s, ())) != 1 for s in needed):
+            return None
+        pname = op.inputs["Param"][0]
+        if op.outputs.get("ParamOut", [None])[0] != pname:
+            return None            # only the in-place update form
+        for slot in state_slots:   # state must be in-place too: the
+            if op.outputs.get(_STATE_OUT[slot], [None])[0] != \
+                    op.inputs[slot][0]:
+                return None        # fused op rebinds the input names
+        gname = op.inputs["Grad"][0]
+        if gname in sparse_names:
+            return None            # SelectedRows grad: keep sparse path
+        try:
+            pvar = block.var(pname)
+            gvar = block.var(gname)
+        except ValueError:
+            return None
+        if getattr(pvar, "dist_attr", None) is not None:
+            return None            # sharded param: keep natural layout
+        if getattr(gvar, "type", _VarType.LOD_TENSOR) != _VarType.LOD_TENSOR:
+            return None            # sparse grad
+        shape = getattr(pvar, "shape", None)
+        if shape is None or any(int(s) < 0 for s in shape):
+            return None
+        # beta-pow accumulators come scalar-shaped OR param-shaped (both
+        # are elementwise in the update); a bucket must be homogeneous so
+        # the fused kernel picks ONE broadcast strategy
+        pow_mode = ""
+        for slot in state_slots:
+            try:
+                svar = block.var(op.inputs[slot][0])
+            except ValueError:
+                return None
+            if slot in _SCALAR_STATE:
+                sshape = getattr(svar, "shape", None)
+                if sshape is None:
+                    return None
+                if tuple(sshape) == tuple(shape):
+                    mode = "dense"     # wins ties for ()/(1,)-params
+                elif tuple(sshape) in ((), (1,)):
+                    mode = "scalar"
+                else:
+                    return None
+                if pow_mode and mode != pow_mode:
+                    return None
+                pow_mode = mode
+        attrs = tuple(sorted(
+            (k, _freeze(v)) for k, v in op.attrs.items()
+            if k not in (OP_ROLE_KEY, "op_device", "lazy_mode")))
+        try:
+            itemsize = np.dtype(_np_dtype(pvar.dtype)).itemsize
+        except (TypeError, ValueError):
+            return None
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize
+        key = (op.type, str(pvar.dtype), op.inputs["LearningRate"][0],
+               attrs, pow_mode)
+        return key, nbytes
+
+    @staticmethod
+    def _op_names(block, op):
+        # sub-block reads count: a control-flow op that reads an updated
+        # param only inside its sub_block must still close the bucket,
+        # or the fused update would move past it
+        reads = (set(block.program._op_reads(op)) if _has_sub_block(op)
+                 else set(op.input_arg_names))
+        writes = set(op.output_arg_names)
+        return reads, writes
+
+    def _build_fused(self, block, ops):
+        first = ops[0]
+        state_slots = _FUSABLE_OPTIMIZERS[first.type]
+        inputs = {"Param": [o.inputs["Param"][0] for o in ops],
+                  "Grad": [o.inputs["Grad"][0] for o in ops],
+                  "LearningRate": [first.inputs["LearningRate"][0]]}
+        outputs = {"ParamOut": [o.inputs["Param"][0] for o in ops]}
+        for slot in state_slots:
+            inputs[slot] = [o.inputs[slot][0] for o in ops]
+            outputs[_STATE_OUT[slot]] = [o.inputs[slot][0] for o in ops]
+        attrs = {k: v for k, v in first.attrs.items()
+                 if k not in (OP_ROLE_KEY, "op_device")}
+        attrs[OP_ROLE_KEY] = _OpRole.Optimize
+        return _Operator(block, "fused_" + first.type, inputs=inputs,
+                        outputs=outputs, attrs=attrs)
+
+    def apply(self, program):
+        block = program.global_block()
+        cap = self.max_bucket_bytes
+        if not cap:
+            cap = int(_flag("fuse_optimizer_bucket_mb")) * (1 << 20)
+        # One forward walk. Fusable ops join the open bucket for their
+        # group key; the bucket's fused op is emitted where the bucket
+        # CLOSES — i.e. members only ever move LATER, to the point just
+        # before the first op that observes them. An op that reads or
+        # rebinds any var a member already wrote, or rebinds a var a
+        # member read, closes the bucket first, so every such observer
+        # still sees exactly the values it saw under per-param order.
+        new_ops = []
+        open_buckets = {}       # key -> {"ops", "bytes", reads, writes}
+        report = {"fused_buckets": 0, "fused_params": 0}
+        sparse_names = self._maybe_sparse_names(block)
+
+        def close(key):
+            b = open_buckets.pop(key, None)
+            if b is None:
+                return
+            if len(b["ops"]) == 1:
+                new_ops.append(b["ops"][0])
+            else:
+                new_ops.append(self._build_fused(block, b["ops"]))
+                report["fused_buckets"] += 1
+                report["fused_params"] += len(b["ops"])
+
+        def conflicts(reads, writes, bucket):
+            return (writes & bucket["writes"] or reads & bucket["writes"]
+                    or writes & bucket["reads"])
+
+        for op in block.ops:
+            reads, writes = self._op_names(block, op)
+            cand = self._candidate(block, op, sparse_names)
+            key = cand[0] if cand else None
+            for k in [k for k, b in open_buckets.items()
+                      if k != key and conflicts(reads, writes, b)]:
+                close(k)
+            if cand is None:
+                new_ops.append(op)
+                continue
+            _, nbytes = cand
+            bucket = open_buckets.get(key)
+            if bucket is not None and (
+                    conflicts(reads, writes, bucket)
+                    or bucket["bytes"] + nbytes > cap):
+                close(key)
+                bucket = None
+            if bucket is None:
+                bucket = {"ops": [], "bytes": 0, "reads": set(),
+                          "writes": set()}
+                open_buckets[key] = bucket
+            bucket["ops"].append(op)
+            bucket["bytes"] += nbytes
+            bucket["reads"] |= reads
+            bucket["writes"] |= writes
+        for k in list(open_buckets):
+            close(k)
+        block.ops = new_ops
+        self._report = report

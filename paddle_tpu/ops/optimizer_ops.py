@@ -272,6 +272,125 @@ def dpsgd(ctx, ins, attrs):
     return {"ParamOut": p - lr.astype(p.dtype) * (g * scale + noise)}
 
 
+# ---------------------------------------------------------------------------
+# Fused multi-tensor optimizer kernels (framework/passes.py
+# FuseOptimizerPass; reference ir/fuse_optimizer_ops_pass + NVIDIA Apex
+# multi_tensor_apply). Each op receives N params (+ grads/accumulators)
+# in parallel slot lists and applies ONE flattened-concat elementwise
+# update (framework/lowering.py flatten_concat/split_unflatten). All the
+# math is elementwise, so every element undergoes exactly the arithmetic
+# of its per-param op — bitwise-identical results from 1 kernel launch
+# instead of N. Per-param scalars (adam's bias-corrected step size) are
+# broadcast per segment, never shared across params.
+# ---------------------------------------------------------------------------
+
+def _scalar(x, dtype):
+    """A () scalar view of a ()- or (1,)-shaped hyperparameter tensor
+    in the bucket's param dtype (same value the per-param op broadcasts)."""
+    return jnp.reshape(x, ()).astype(dtype)
+
+
+def _flat_pg(ctx, ins):
+    """(flat_params, flat_grads_cast, shapes, dtype) of the bucket."""
+    from ..framework.lowering import flatten_concat
+    mesh = getattr(ctx, "mesh", None)
+    ps = ins["Param"]
+    dtype = ps[0].dtype
+    flat_p, shapes = flatten_concat(ps, mesh=mesh)
+    flat_g, _ = flatten_concat([g.astype(dtype) for g in ins["Grad"]],
+                               mesh=mesh)
+    return flat_p, flat_g, shapes, dtype
+
+
+@register_op("fused_sgd", grad=False, infer_shape=False)
+def fused_sgd(ctx, ins, attrs):
+    from ..framework.lowering import split_unflatten
+    flat_p, flat_g, shapes, dtype = _flat_pg(ctx, ins)
+    lr = _scalar(ins["LearningRate"][0], dtype)
+    return {"ParamOut": split_unflatten(flat_p - lr * flat_g, shapes)}
+
+
+@register_op("fused_momentum", grad=False, infer_shape=False)
+def fused_momentum(ctx, ins, attrs):
+    from ..framework.lowering import flatten_concat, split_unflatten
+    mesh = getattr(ctx, "mesh", None)
+    flat_p, flat_g, shapes, dtype = _flat_pg(ctx, ins)
+    flat_v, _ = flatten_concat(ins["Velocity"], mesh=mesh)
+    mu = attrs.get("mu", 0.9)
+    lr = _scalar(ins["LearningRate"][0], dtype)
+    v_new = mu * flat_v + flat_g
+    if attrs.get("use_nesterov", False):
+        p_new = flat_p - (flat_g + mu * v_new) * lr
+    else:
+        p_new = flat_p - lr * v_new
+    return {"ParamOut": split_unflatten(p_new, shapes),
+            "VelocityOut": split_unflatten(v_new, shapes)}
+
+
+def _fused_adam_core(ctx, ins, attrs):
+    """Shared adam/adamw bucket math; returns
+    (outs, flat_new_param, flat_old_param, shapes, dtype, lr_scalar).
+    `outs` holds the moment/beta-pow outputs but NOT ParamOut — the
+    caller splits its (possibly further-updated) flat param itself;
+    adamw needs `flat_old_param` (pre-update values) and `lr_scalar`
+    for the decoupled weight decay."""
+    from ..framework.lowering import (broadcast_segments, flatten_concat,
+                                      split_unflatten)
+    mesh = getattr(ctx, "mesh", None)
+    flat_p, flat_g, shapes, dtype = _flat_pg(ctx, ins)
+    flat_m1, _ = flatten_concat(ins["Moment1"], mesh=mesh)
+    flat_m2, _ = flatten_concat(ins["Moment2"], mesh=mesh)
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _scalar(ins["LearningRate"][0], dtype)
+    m1n = b1 * flat_m1 + (1 - b1) * flat_g
+    m2n = b2 * flat_m2 + (1 - b2) * jnp.square(flat_g)
+    # the bias-corrected step size is PER-PARAM (each param carries its
+    # own beta-pow accumulators). Beta-pows arrive either param-shaped
+    # (elementwise: concat them like the moments) or ()/(1,)-scalar
+    # (broadcast each scalar over its param's segment); the fusion pass
+    # keys buckets so a bucket is homogeneous in this.
+    if tuple(ins["Beta1Pow"][0].shape) == tuple(shapes[0]):
+        flat_b1p, _ = flatten_concat(
+            [b.astype(dtype) for b in ins["Beta1Pow"]], mesh=mesh)
+        flat_b2p, _ = flatten_concat(
+            [b.astype(dtype) for b in ins["Beta2Pow"]], mesh=mesh)
+        lr_t = lr * jnp.sqrt(1 - flat_b2p) / (1 - flat_b1p)
+    else:
+        lr_t = broadcast_segments(
+            [lr * jnp.sqrt(1 - _scalar(b2p, dtype))
+             / (1 - _scalar(b1p, dtype))
+             for b1p, b2p in zip(ins["Beta1Pow"], ins["Beta2Pow"])],
+            shapes, dtype)
+    p_new = flat_p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    outs = {"Moment1Out": split_unflatten(m1n, shapes),
+            "Moment2Out": split_unflatten(m2n, shapes),
+            "Beta1PowOut": [b1p * b1 for b1p in ins["Beta1Pow"]],
+            "Beta2PowOut": [b2p * b2 for b2p in ins["Beta2Pow"]]}
+    return outs, p_new, flat_p, shapes, dtype, lr
+
+
+@register_op("fused_adam", grad=False, infer_shape=False)
+def fused_adam(ctx, ins, attrs):
+    from ..framework.lowering import split_unflatten
+    outs, p_new, _, shapes, _, _ = _fused_adam_core(ctx, ins, attrs)
+    outs["ParamOut"] = split_unflatten(p_new, shapes)
+    return outs
+
+
+@register_op("fused_adamw", grad=False, infer_shape=False)
+def fused_adamw(ctx, ins, attrs):
+    from ..framework.lowering import split_unflatten
+    outs, p_new, flat_p, shapes, dtype, lr = _fused_adam_core(ctx, ins,
+                                                              attrs)
+    if attrs.get("with_decay", True):
+        coeff = attrs.get("coeff", 0.01)
+        p_new = p_new - lr * coeff * flat_p
+    outs["ParamOut"] = split_unflatten(p_new, shapes)
+    return outs
+
+
 @register_op("dgc_sparsify", grad=False, infer_shape=False)
 def dgc_sparsify(ctx, ins, attrs):
     """Deep Gradient Compression core (reference operators/dgc_op.cc +

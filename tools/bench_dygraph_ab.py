@@ -42,7 +42,7 @@ with fluid.scope_guard(scope):
         float(np.asarray(l).reshape(()))
         best = min(best, (time.perf_counter() - t0) / (N + 1))
     import bench
-    cost_s = bench._step_cost(exe, scope, pool[0], main)
+    cost_s = bench._step_cost(exe, main)
 print(f"static:  {best*1e3:8.2f} ms/step  {batch/best:8.1f} samples/s  "
       f"flops {cost_s['flops']/1e9:.1f}G bytes {cost_s['bytes']/1e9:.1f}G")
 t_static = best
