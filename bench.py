@@ -33,6 +33,10 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
                       count, trace+compile ms, and cold-start latency
                       with FLAGS_program_passes on vs off on a
                       BERT-shaped train program
+  decode              extra: KV-cached autoregressive decoding A/B —
+                      tokens/s and ms/token of the prefill+cached-decode
+                      path vs naive full-recompute generation at
+                      prompt seq in {128, 256}
 
 Every throughput config also reports cold_start_ms (first-step
 end-to-end latency) plus the executor's pass/trace/compile ms split, so
@@ -869,6 +873,87 @@ def bench_passes():
     }
 
 
+def bench_decode():
+    """KV-cached autoregressive decoding A/B (models/generation): after
+    a bucketed prefill of a seq-{128,256} prompt, generate N tokens via
+    the compiled cached decode step vs naive full-recompute generation
+    (every token re-runs the whole forward at the bucketed current
+    length — what the framework could do before the cache existed).
+    Reports tokens/s, ms/token and the speedup; the acceptance bar is
+    >= 3x tokens/s at seq 256. Warmup generations run first so both
+    sides measure steady-state, not compiles (compile cost is reported
+    separately). Accelerators run GPT-base; CPU a narrow 4-layer config
+    (same graph shape, sized so the smoke test finishes fast)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.generation import GPTGenerator
+
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "gpu", "axon"):
+        cfg = gpt.GPTConfig.base()
+        new_tokens, seqs = 64, (128, 256)
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                            num_heads=4, ffn_size=256, max_position=1024,
+                            dropout=0.0)
+        new_tokens, seqs = 32, (128, 256)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        gpt.gpt_logits(cfg)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    max_len = max(seqs) + new_tokens + 1
+    gen = GPTGenerator(cfg, scope, max_len=max_len)
+    rng = np.random.default_rng(0)
+
+    per_seq = {}
+    for seq in seqs:
+        prompt = [rng.integers(1, cfg.vocab_size, seq).astype(np.int32)]
+        # warmup: compile prefill/decode/sample (kv) and every naive
+        # length bucket; correctness ride-along — greedy parity is the
+        # acceptance gate of the whole fast path
+        t0 = time.perf_counter()
+        kv_out = gen.generate(prompt, max_new_tokens=new_tokens)
+        compile_plus_first_ms = (time.perf_counter() - t0) * 1e3
+        naive_out = gen.generate_naive(prompt, max_new_tokens=new_tokens)
+        assert np.array_equal(kv_out[0], naive_out[0]), \
+            "greedy kv-cached decode diverged from full recompute"
+
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            gen.generate(prompt, max_new_tokens=new_tokens)
+        dt_kv = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            gen.generate_naive(prompt, max_new_tokens=new_tokens)
+        dt_naive = (time.perf_counter() - t0) / reps
+
+        per_seq[str(seq)] = {
+            "tokens_per_sec": round(new_tokens / dt_kv, 2),
+            "ms_per_token": round(dt_kv / new_tokens * 1e3, 3),
+            "naive_tokens_per_sec": round(new_tokens / dt_naive, 2),
+            "naive_ms_per_token": round(dt_naive / new_tokens * 1e3, 3),
+            "speedup_vs_full_recompute": round(dt_naive / dt_kv, 2),
+            "first_call_ms": round(compile_plus_first_ms, 1),
+        }
+    return {
+        "metric": "decode_kv_cache_seq256_tokens_per_sec",
+        "value": per_seq[str(max(seqs))]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,        # intra-repo A/B, no external anchor
+        "new_tokens": new_tokens,
+        "speedup_vs_full_recompute":
+            per_seq[str(max(seqs))]["speedup_vs_full_recompute"],
+        "seq": per_seq,
+        "cache": gen.cache.stats(),
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -887,6 +972,7 @@ _CONFIGS = {
     "train_loop": (bench_train_loop, "train_loop_fused_k8_steps_per_sec"),
     "passes": (bench_passes,
                "passes_bert_train_step_trace_plus_compile_ms"),
+    "decode": (bench_decode, "decode_kv_cache_seq256_tokens_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

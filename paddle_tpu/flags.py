@@ -46,6 +46,20 @@ _DEFS = {
     # and how long it sheds before re-probing
     "serving_shed_failures": (8, int, None),
     "serving_shed_reset_secs": (0.5, float, None),
+    # -- KV-cached autoregressive decoding (models/generation, serving
+    # decode batching) --
+    # preallocated per-layer KV cache length [B, H, decode_max_len, D]:
+    # prompt length + max_new_tokens must fit (clamped to the model's
+    # max_position)
+    "decode_max_len": (2048, int, None),
+    # minimum prefill sequence bucket: prompts pad up to the next
+    # power-of-two >= this, bounding the universe of compiled prefill
+    # shapes (buckets: decode_bucket_min, 2x, 4x, ... decode_max_len)
+    "decode_bucket_min": (16, int, None),
+    # serving decode batch: fixed number of generation slots stepped by
+    # one compiled decode executable; finished rows free their slot for
+    # the next admitted request (continuous batching)
+    "decode_slots": (8, int, None),
     # Executor per-(program, feed-shape) compile cache entry cap — bounds
     # what was previously unbounded growth per input-shape signature
     "executor_cache_entries": (128, int, None),

@@ -289,12 +289,33 @@ def is_parameter(var):
 # save/load vars (reference io.py:161 save_vars / :661 load_vars)
 # ---------------------------------------------------------------------------
 
+def _merged_meta(dirname, meta):
+    """Merge a prior save's ``__meta__`` entries (dtype tags, extras
+    like the RNG key) under the new save's: several programs sharing
+    one dir must not lose each other's var/extra records — the meta
+    analog of ``preserve_existing`` for the manifest. New entries win
+    on name collision; an unreadable prior meta is ignored."""
+    path = os.path.join(dirname, _META_FILE)
+    if not os.path.exists(path):
+        return meta
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        return meta
+    merged = dict(meta)
+    merged["vars"] = {**prev.get("vars", {}), **meta.get("vars", {})}
+    merged["extra"] = {**prev.get("extra", {}), **meta.get("extra", {})}
+    return merged
+
+
 def _write_array_dir(dirname, arrays, meta, manifest_extra=None):
     """One array per .npy + meta + manifest — the single writer both
     save_vars and CheckpointSaver's async path go through, so a format
     change cannot drift between sync and async checkpoints.
     ``manifest_extra`` lists already-written sibling files (e.g. the
     inference ``__model__``) to record in the manifest too."""
+    meta = _merged_meta(dirname, meta)
     digests = {}
     for name, arr in arrays.items():
         rel = _escape(name) + ".npy"
@@ -304,9 +325,14 @@ def _write_array_dir(dirname, arrays, meta, manifest_extra=None):
     digests[_META_FILE] = _fsync_write(
         os.path.join(dirname, _META_FILE),
         lambda f: f.write(json.dumps(meta, indent=1).encode()))
+    # preserve_existing: saving a SECOND program's params into a dir
+    # that already holds another save must keep the earlier files' hash
+    # entries, or their later corruption loads silently (the
+    # save_inference_model path has always preserved; this writer and
+    # the filename= branch below were the gap)
     _write_manifest(dirname,
                     list(digests) + list(manifest_extra or ()), meta,
-                    digests=digests)
+                    preserve_existing=True, digests=digests)
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -326,6 +352,7 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         return
     # writing through a file object keeps the name exact (np.savez
     # appends ".npz" to bare string paths); the loader accepts both
+    meta = _merged_meta(dirname, meta)
     digests = {
         filename: _fsync_write(
             os.path.join(dirname, filename),
@@ -337,7 +364,7 @@ def save_vars(executor, dirname, main_program=None, vars=None,
     }
     _write_manifest(dirname,
                     [filename, _META_FILE] + list(_manifest_extra or ()),
-                    meta, digests=digests)
+                    meta, preserve_existing=True, digests=digests)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,

@@ -761,6 +761,72 @@ def flash_attention(q, k, v, attn_bias=None, scale=0.0, causal=False,
     return out
 
 
+def kv_cache_write(cache, kv, pos, name=None):
+    """Append ``kv`` [B, H, S, D] into the preallocated KV ``cache``
+    [B, H, max_len, D] at each row's own ``pos`` [B] int32 (vmapped
+    position-indexed ``dynamic_update_slice``). Returns the updated
+    cache; the incremental-decoding append (see models/gpt.py)."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    out = helper.create_variable_for_type_inference(dtype=cache.dtype)
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={"Cache": [cache], "KV": [kv], "Pos": [pos]},
+        outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    out.shape = tuple(cache.shape or ())
+    out.dtype = cache.dtype
+    return out
+
+
+def kv_cached_attention(q, k_cache, v_cache, pos, scale=0.0, name=None):
+    """Causal attention of fresh queries ``q`` [B, H, S, D] over KV
+    caches [B, H, max_len, D], masked by per-row position counters
+    ``pos`` [B] int32 (key slot j visible to query i iff
+    j <= pos[b] + i). Rows at different positions share one executable —
+    the decode-batch fast path of autoregressive generation."""
+    helper = LayerHelper("kv_cached_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    helper.append_op(
+        type="kv_cached_attention",
+        inputs={"Q": [q], "K": [k_cache], "V": [v_cache], "Pos": [pos]},
+        outputs={"Out": [out]}, attrs={"scale": float(scale)},
+        infer_shape=False)
+    out.shape = tuple(q.shape or ())
+    out.dtype = q.dtype
+    return out
+
+
+def row_gather(x, index, name=None):
+    """Out[b] = x[b, index[b]] — per-row gather along axis 1 (e.g. the
+    last real token's position of a right-padded batch)."""
+    helper = LayerHelper("row_gather", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="row_gather", inputs={"X": [x], "Index": [index]},
+        outputs={"Out": [out]}, attrs={}, infer_shape=False)
+    out.shape = tuple(x.shape[:1] or ()) + tuple(x.shape[2:] or ())
+    out.dtype = x.dtype
+    return out
+
+
+def sample_tokens(logits, temperature, top_k=None, seed=0, name=None):
+    """Next-token selection over ``logits`` [B, V] with per-row sampling
+    config: ``temperature`` [B] float32 (<= 0 -> greedy argmax), optional
+    ``top_k`` [B] int32 (> 0 -> restrict sampling to the k highest
+    logits). Draws from the framework RNG stream — fixed executor seed
+    gives bitwise-reproducible sequences. Returns sampled ids [B] int32."""
+    helper = LayerHelper("sample_tokens", name=name)
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"X": [logits], "Temperature": [temperature]}
+    if top_k is not None:
+        ins["TopK"] = [top_k]
+    helper.append_op(
+        type="sample_tokens", inputs=ins, outputs={"Out": [out]},
+        attrs={"seed": int(seed)}, infer_shape=False)
+    out.shape = tuple(logits.shape[:1] or ())
+    out.dtype = "int32"
+    return out
+
+
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
                 name=None):
     """One beam expansion step (reference layers/rnn.py beam_search ->

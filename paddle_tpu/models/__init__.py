@@ -2,3 +2,6 @@
 BERT/ERNIE (3), Wide&Deep CTR (4), DyGraph Transformer (5)."""
 from . import lenet, bert, resnet, widedeep, transformer  # noqa: F401
 from . import seq2seq  # noqa: F401
+from . import gpt  # noqa: F401
+from . import generation  # noqa: F401
+from .generation import GPTGenerator  # noqa: F401

@@ -1,0 +1,402 @@
+"""Autoregressive generation driver: the prefill/decode split over the
+KV-cached GPT graphs (models/gpt.py gpt_prefill / gpt_decode_step).
+
+Naive generation re-runs the full forward for every new token — N tokens
+cost N O(S^2) recomputes. ``GPTGenerator.generate`` instead runs ONE
+bucketed prefill over the prompt (building every layer's
+``[B, H, max_len, D]`` KV cache), then loops a single compiled decode
+step whose per-token cost is a cache append + read. All executables are
+AOT-compiled (``jit.lower().compile()``) into a serving
+``ExecutableCache`` — length-bucketed prefill shapes stay bounded
+(power-of-two buckets, ``FLAGS_decode_bucket_min`` floor) and the cache's
+hit/miss/evict counters make compile traffic observable. Sampling
+(greedy / temperature / top-k, per ROW) is the ``sample_tokens`` op
+drawing from the framework RNG stream: a fixed seed reproduces the
+token sequence bitwise.
+
+``generate_naive`` is the full-recompute baseline (same bucketing, same
+sampler, no cache ops) — the A/B half of ``bench.py --config decode``
+and the greedy-parity reference in tests.
+"""
+import time
+
+import numpy as np
+
+from .. import profiler as _prof
+from ..flags import flag
+from . import gpt
+
+
+def length_bucket(n, lo=1):
+    """Smallest power-of-two >= n (>= lo): bounded padding waste and a
+    bounded universe of compiled prefill shapes — the serving batcher's
+    bucketing policy, shared so prefill and batch buckets can't drift."""
+    from ..serving.batching import next_bucket
+    return next_bucket(n, min_bucket=lo)
+
+
+def _sample_program_outs():
+    from .. import layers
+    from ..layers import tensor as T
+    logits = T.data("logits", [-1, -1], dtype="float32")
+    temperature = T.data("temperature", [-1], dtype="float32")
+    top_k = T.data("top_k", [-1], dtype="int32")
+    toks = layers.nn.sample_tokens(logits, temperature, top_k)
+    return {"feed_names": ["logits", "temperature", "top_k"],
+            "tokens": toks}
+
+
+def _sample_temp_program_outs():
+    """Temperature-only variant (no TopK input): the op skips the
+    full-vocab top-k sort entirely, which is pure waste when no row
+    restricts the vocabulary."""
+    from .. import layers
+    from ..layers import tensor as T
+    logits = T.data("logits", [-1, -1], dtype="float32")
+    temperature = T.data("temperature", [-1], dtype="float32")
+    toks = layers.nn.sample_tokens(logits, temperature)
+    return {"feed_names": ["logits", "temperature"], "tokens": toks}
+
+
+def _greedy_program_outs():
+    """Pure-argmax variant for all-greedy batches: skips the sampler's
+    full-vocab sort + categorical draw, which at a realistic vocab would
+    dominate the serial per-token loop (still advances the RNG key once
+    per call like every compiled program, so switching between greedy
+    and sampled runs keeps the key chain aligned)."""
+    from ..layers import tensor as T
+    logits = T.data("logits", [-1, -1], dtype="float32")
+    toks = T.cast(T.argmax(logits, axis=-1), "int32")
+    return {"feed_names": ["logits"], "tokens": toks}
+
+
+class GPTGenerator:
+    """Compiled prefill + decode-step + sampler over a parameter scope.
+
+    The scope must already hold the model's trained (or startup-
+    initialized) parameters under the standard ``models/gpt.py`` names —
+    the generator builds its OWN inference programs and snapshots the
+    parameters onto the device at first use (``refresh_state()`` re-pulls
+    after further training).
+
+        gen = GPTGenerator(cfg, scope, max_len=512)
+        outs = gen.generate([prompt_ids], max_new_tokens=64,
+                            temperature=0.8, top_k=40, seed=7)
+
+    ``stats`` (a ``serving.ServingStats``) routes per-stage latencies
+    into the prefill/decode/sample histograms; the same spans land in
+    ``paddle_tpu.profiler`` event tables while profiling is active.
+    """
+
+    def __init__(self, cfg, scope=None, *, max_len=None, bucket_min=None,
+                 cache=None, stats=None):
+        from ..framework.core import Program, program_guard
+        from ..framework.executor import global_scope
+
+        self.cfg = cfg
+        self.scope = scope if scope is not None else global_scope()
+        self.max_len = int(max_len or flag("decode_max_len"))
+        if self.max_len > cfg.max_position:
+            self.max_len = int(cfg.max_position)
+        self.bucket_min = int(bucket_min or flag("decode_bucket_min"))
+        if cache is None:
+            from ..serving.cache import ExecutableCache
+            cache = ExecutableCache()
+        self.cache = cache
+        self.stats = stats
+
+        builders = {
+            "prefill": lambda: gpt.gpt_prefill(cfg, self.max_len),
+            "decode": lambda: gpt.gpt_decode_step(cfg, self.max_len),
+            "logits": lambda: gpt.gpt_logits(cfg),
+            "sample": _sample_program_outs,
+            "sample_temp": _sample_temp_program_outs,
+            "sample_greedy": _greedy_program_outs,
+        }
+        self._progs = {}
+        for kind, build in builders.items():
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                outs = build()
+            self._progs[kind] = (main, outs)
+        self._fns = {}      # kind -> (jitted, device_state)
+        self._params = {}   # param name -> device array, shared by kinds
+
+    # -- compilation ------------------------------------------------------
+    def _fetch_names(self, outs):
+        if "tokens" in outs:
+            return [outs["tokens"].name]
+        return ([outs["logits"].name]
+                + [v.name for v in outs.get("cache_k", ())]
+                + [v.name for v in outs.get("cache_v", ())])
+
+    def _ensure_fn(self, kind):
+        entry = self._fns.get(kind)
+        if entry is not None:
+            return entry
+        import jax
+        from ..framework.lowering import analyze_block_io, build_block_fn
+
+        main, outs = self._progs[kind]
+        feed_names = list(outs["feed_names"])
+        fetch_names = self._fetch_names(outs)
+        state_in, _ = analyze_block_io(main, 0, feed_names)
+        fn = build_block_fn(main, 0, feed_names, fetch_names, state_in, [])
+
+        # only the decode step's KV caches are worth donating (XLA
+        # aliases the cache append in place — no 2x cache traffic);
+        # everything else is a fresh host array every call
+        def run(state, caches, feed, base_key):
+            env = dict(feed)
+            env.update(caches)
+            fetches, _, new_key = fn({}, state, env, base_key)
+            return fetches, new_key
+
+        jitted = jax.jit(run, donate_argnums=(1,))
+        # one device snapshot per PARAMETER, shared by every kind's
+        # state dict (prefill/decode/logits read the same weights — a
+        # per-kind device_put would hold N identical copies in HBM)
+        state = {}
+        for n in state_in:
+            a = self._params.get(n)
+            if a is None:
+                v = self.scope.find_var(n)
+                if v is None:
+                    raise RuntimeError(
+                        f"generation parameter {n!r} is not in the "
+                        f"scope — run the startup program or load "
+                        f"trained params first")
+                a = jax.device_put(np.asarray(v))
+                self._params[n] = a
+            state[n] = a
+        self._fns[kind] = (jitted, state)
+        return self._fns[kind]
+
+    def refresh_state(self):
+        """Re-snapshot the scope's parameters onto the device (call after
+        the params changed, e.g. more training steps)."""
+        import jax
+        for n in list(self._params):
+            v = self.scope.find_var(n)
+            if v is not None:
+                self._params[n] = jax.device_put(np.asarray(v))
+        for kind, (jitted, state) in self._fns.items():
+            for n in list(state):
+                state[n] = self._params[n]
+
+    @staticmethod
+    def _signature(kind, feed):
+        from ..serving.cache import feed_signature
+        return tuple(sorted(
+            ((f"__program__/{kind}", (), "meta"),)
+            + feed_signature(feed)))
+
+    def _invoke(self, kind, stage, feed, key):
+        import jax
+        jitted, state = self._ensure_fn(kind)
+        sig = self._signature(kind, feed)
+        caches = {n: a for n, a in feed.items() if n.startswith("cache_")}
+        rest = {n: a for n, a in feed.items()
+                if not n.startswith("cache_")}
+        compiled = self.cache.get(sig)
+        if compiled is None:
+            t0 = time.perf_counter()
+            with _prof.record_event(f"decode/compile_{kind}"):
+                compiled = jitted.lower(state, caches, rest,
+                                        key).compile()
+            dt = time.perf_counter() - t0
+            from ..serving.engine import ServingEngine
+            self.cache.put(sig, compiled,
+                           nbytes=ServingEngine._executable_bytes(
+                               compiled, feed))
+            if self.stats:
+                self.stats.bump("compiles")
+                self.stats.hist["compile"].observe(dt)
+            # (no stats: the record_event above already logged the span)
+        t0 = time.perf_counter()
+        fetches, new_key = compiled(state, caches, rest, key)
+        # block before recording so the span holds device time, not
+        # dispatch time (the per-token loop is serial anyway — the next
+        # step needs this token)
+        jax.block_until_ready(fetches)
+        dt = time.perf_counter() - t0
+        if self.stats:
+            self.stats.hist[stage].observe(dt)
+        else:
+            _prof.record_duration(f"decode/{stage}", dt)
+        return fetches, new_key
+
+    # -- stage runners ----------------------------------------------------
+    def _unpack_caches(self, fetches):
+        """Fetch layout of the cache-bearing programs (_fetch_names):
+        logits at 0, then cache_k_0..n-1, then cache_v_0..n-1."""
+        n = self.cfg.num_layers
+        caches = {}
+        for i in range(n):
+            caches[f"cache_k_{i}"] = fetches[1 + i]
+            caches[f"cache_v_{i}"] = fetches[1 + n + i]
+        return fetches[0], caches
+
+    def _run_prefill(self, tokens, pos_ids, last_pos, key):
+        feed = {"tokens": tokens, "pos_ids": pos_ids, "last_pos": last_pos}
+        fetches, key = self._invoke("prefill", "prefill", feed, key)
+        logits, caches = self._unpack_caches(fetches)
+        return logits, caches, key
+
+    def _run_decode(self, token, pos, caches, key):
+        feed = dict(caches)
+        feed["token"] = token
+        feed["pos"] = pos
+        fetches, key = self._invoke("decode", "decode", feed, key)
+        logits, caches = self._unpack_caches(fetches)
+        return logits, caches, key
+
+    def _run_logits(self, tokens, pos_ids, last_pos, key):
+        feed = {"tokens": tokens, "pos_ids": pos_ids, "last_pos": last_pos}
+        fetches, key = self._invoke("logits", "prefill", feed, key)
+        return fetches[0], key
+
+    def _run_sample(self, logits, temperature, top_k, key):
+        # cheapest program that covers the batch: argmax when every row
+        # is greedy, sort-free sampler when no row restricts top-k,
+        # full sampler otherwise (all variants advance the RNG key once,
+        # so mixing them keeps the key chain aligned)
+        if np.all(np.asarray(temperature) <= 0.0):
+            fetches, key = self._invoke("sample_greedy", "sample",
+                                        {"logits": logits}, key)
+            return fetches[0], key
+        if np.all(np.asarray(top_k) <= 0):
+            feed = {"logits": logits, "temperature": temperature}
+            fetches, key = self._invoke("sample_temp", "sample", feed,
+                                        key)
+            return fetches[0], key
+        feed = {"logits": logits, "temperature": temperature,
+                "top_k": top_k}
+        fetches, key = self._invoke("sample", "sample", feed, key)
+        return fetches[0], key
+
+    # -- public API -------------------------------------------------------
+    def _prep(self, prompts, max_new_tokens, seed, key):
+        import jax
+        # a bare 1-D array / flat list of ints is ONE prompt (the shape
+        # the serving Client takes), not a batch of one-token prompts
+        if isinstance(prompts, np.ndarray):
+            prompts = [prompts] if prompts.ndim <= 1 else list(prompts)
+        elif isinstance(prompts, (list, tuple)) and prompts \
+                and np.isscalar(prompts[0]):
+            prompts = [np.asarray(prompts)]
+        prompts = [np.asarray(p).ravel().astype(np.int32)
+                   for p in prompts]
+        if not prompts:
+            raise ValueError("generate() needs at least one prompt")
+        lens = [int(p.size) for p in prompts]
+        if min(lens) < 1:
+            raise ValueError("empty prompt")
+        if max(lens) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt len {max(lens)} + max_new_tokens "
+                f"{max_new_tokens} exceeds the generator's max_len "
+                f"{self.max_len} (raise max_len= or "
+                f"FLAGS_decode_max_len)")
+        if key is None:
+            key = jax.random.PRNGKey(0 if seed is None else int(seed))
+        return prompts, lens, key
+
+    def _pack_prompts(self, prompts):
+        """Right-pad 1-D int32 prompts into the bucketed prefill feed:
+        (tokens [bb, s], pos_ids [bb, s], last_pos [bb]) — the ONE
+        packing used by generate(), generate_naive() and the serving
+        GenerationEngine, so offline and served prefill cannot drift."""
+        lens = [int(p.size) for p in prompts]
+        bb = length_bucket(len(prompts))
+        s = min(length_bucket(max(lens), self.bucket_min), self.max_len)
+        tokens = np.zeros((bb, s), np.int32)
+        for r, p in enumerate(prompts):
+            tokens[r, :p.size] = p
+        pos_ids = np.broadcast_to(np.arange(s, dtype=np.int32),
+                                  (bb, s)).copy()
+        last = np.zeros((bb,), np.int32)
+        last[:len(prompts)] = np.asarray(lens, np.int32) - 1
+        return tokens, pos_ids, last
+
+    @staticmethod
+    def _emit(tok_h, outs, done, eos_id, max_new_tokens):
+        for r in range(len(outs)):
+            if done[r]:
+                continue
+            t = int(tok_h[r])
+            if eos_id is not None and t == int(eos_id):
+                done[r] = True
+                continue
+            outs[r].append(t)
+            if len(outs[r]) >= max_new_tokens:
+                done[r] = True
+
+    def generate(self, prompts, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_id=None, seed=None, key=None):
+        """KV-cached generation: one bucketed prefill, then one compiled
+        decode step per token. ``prompts`` is a list of 1-D int token
+        arrays (ragged lengths fine — rows are right-padded to the
+        bucket and tracked by per-row position counters). Returns a list
+        of 1-D int32 arrays of NEW tokens (prompt excluded; generation
+        stops at ``eos_id``, which is not included)."""
+        prompts, lens, key = self._prep(prompts, max_new_tokens, seed,
+                                        key)
+        B = len(prompts)
+        tokens, pos_ids, last = self._pack_prompts(prompts)
+        bb = tokens.shape[0]
+
+        logits, caches, key = self._run_prefill(tokens, pos_ids, last,
+                                                key)
+        temp = np.full((bb,), float(temperature), np.float32)
+        topk = np.full((bb,), int(top_k), np.int32)
+        tok, key = self._run_sample(logits, temp, topk, key)
+        tok_h = np.asarray(tok)
+
+        outs = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        # pos[r] = cache slot the NEXT fed token lands in
+        pos = np.zeros((bb,), np.int32)
+        pos[:B] = np.asarray(lens, np.int32)
+        self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+
+        while not done.all():
+            logits, caches, key = self._run_decode(tok, pos, caches, key)
+            tok, key = self._run_sample(logits, temp, topk, key)
+            tok_h = np.asarray(tok)
+            pos[:B] = np.where(done, pos[:B], pos[:B] + 1)
+            self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+            if self.stats:
+                self.stats.bump("decode_steps")
+        if self.stats:
+            self.stats.bump("tokens_generated",
+                            int(sum(len(o) for o in outs)))
+        return [np.asarray(o, np.int32) for o in outs]
+
+    def generate_naive(self, prompts, max_new_tokens=32, temperature=0.0,
+                       top_k=0, eos_id=None, seed=None, key=None):
+        """Full-recompute baseline: every new token re-runs the whole
+        forward at the (bucketed) current length — O(S^2) attention per
+        token, no KV cache. Same bucketing, same sampler, same RNG
+        stream as ``generate`` (greedy output is token-for-token
+        identical); exists for the bench A/B and parity tests."""
+        prompts, lens, key = self._prep(prompts, max_new_tokens, seed,
+                                        key)
+        B = len(prompts)
+        bb = length_bucket(B)
+        cur = [list(map(int, p)) for p in prompts]
+        outs = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        temp = np.full((bb,), float(temperature), np.float32)
+        topk = np.full((bb,), int(top_k), np.int32)
+        while not done.all():
+            tokens, pos_ids, last = self._pack_prompts(
+                [np.asarray(c, np.int32) for c in cur])
+            logits, key = self._run_logits(tokens, pos_ids, last, key)
+            tok, key = self._run_sample(logits, temp, topk, key)
+            tok_h = np.asarray(tok)
+            for r in range(B):
+                if not done[r]:
+                    cur[r].append(int(tok_h[r]))
+            self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+        return [np.asarray(o, np.int32) for o in outs]

@@ -63,9 +63,25 @@ def _ln(cfg, x, name, begin_axis=2):
                             initializer=I.Constant(0.0)))
 
 
-def decoder_layer(cfg, x, idx, is_test):
-    """Pre-LN block: x + attn(LN(x)); x + ffn(LN(x)). Causal attention
-    through the flash kernel (upper triangle never computed)."""
+def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
+    """Pre-LN block: x + attn(LN(x)); x + ffn(LN(x)).
+
+    Three attention modes, one set of parameter names (so trained
+    params drive every path):
+
+    - ``kv_cache=None`` (training / full-sequence eval): causal attention
+      through the flash kernel (upper triangle never computed).
+    - ``kv_cache={"k": c_k, "v": c_v, "mode": "prefill"}`` with ``pos``
+      [B] int32: the fresh k/v are written into the preallocated
+      ``[B, H, max_len, D]`` caches at ``pos`` AND attended causally via
+      the flash path (prompt rows start at position 0, so attention runs
+      over the length BUCKET, not the whole cache). Returns
+      ``(x, new_k_cache, new_v_cache)``.
+    - ``mode: "decode"``: the incremental step — append this token's k/v
+      at each row's own position, then attend the query over the full
+      cache with the per-row position mask (O(max_len) read instead of an
+      O(S^2) recompute). Returns ``(x, new_k_cache, new_v_cache)``.
+    """
     h = cfg.hidden_size
     n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     pre = f"decoder_layer_{idx}"
@@ -78,7 +94,16 @@ def decoder_layer(cfg, x, idx, is_test):
     q = T.transpose(T.reshape(q, [0, 0, n_head, d_head]), [0, 2, 1, 3])
     k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]), [0, 2, 1, 3])
     v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]), [0, 2, 1, 3])
-    ctx = layers.nn.flash_attention(q, k, v, causal=True)
+    new_k = new_v = None
+    if kv_cache is None:
+        ctx = layers.nn.flash_attention(q, k, v, causal=True)
+    else:
+        new_k = layers.nn.kv_cache_write(kv_cache["k"], k, pos)
+        new_v = layers.nn.kv_cache_write(kv_cache["v"], v, pos)
+        if kv_cache.get("mode", "decode") == "prefill":
+            ctx = layers.nn.flash_attention(q, k, v, causal=True)
+        else:
+            ctx = layers.nn.kv_cached_attention(q, new_k, new_v, pos)
     ctx = T.reshape(T.transpose(ctx, [0, 2, 1, 3]), [0, 0, h])
     attn_out = _fc(cfg, ctx, h, f"{pre}_att_out")
     attn_out = layers.dropout(attn_out, cfg.dropout, is_test=is_test,
@@ -90,7 +115,10 @@ def decoder_layer(cfg, x, idx, is_test):
     ffn = _fc(cfg, ffn, h, f"{pre}_ffn_1")
     ffn = layers.dropout(ffn, cfg.dropout, is_test=is_test,
                          dropout_implementation="upscale_in_train")
-    return M.elementwise_add(x, ffn)
+    out = M.elementwise_add(x, ffn)
+    if kv_cache is None:
+        return out
+    return out, new_k, new_v
 
 
 def gpt_pretrain(cfg, batch_size, seq_len, is_test=False):
@@ -129,6 +157,112 @@ def gpt_pretrain(cfg, batch_size, seq_len, is_test=False):
                           T.fill_constant([1], "float32", 1e-9)))
     return {"feeds": [tokens, labels, loss_mask, pos_ids],
             "loss": loss, "checkpoints": checkpoints}
+
+
+# ---- inference graphs: full-forward logits, prefill, cached decode ----
+# (the generation driver over these lives in models/generation.py)
+
+def _tied_next_logits(cfg, x, last_pos):
+    """final-LN hidden [B, S, H] -> next-token logits [B, V] at each
+    row's own last REAL position (right-padded batches)."""
+    x = _ln(cfg, x, "final_ln")
+    h = layers.nn.row_gather(x, last_pos)                    # [B, H]
+    word_emb = x.block.program.global_block().var("word_embedding")
+    return layers.matmul(h, word_emb, transpose_y=True)      # [B, V]
+
+
+def gpt_logits(cfg, batch_size=-1, seq_len=-1):
+    """Full-sequence forward -> next-token logits (no KV cache): the
+    naive-generation baseline and the prefill-parity reference. Feeds:
+    tokens [B, S] int32, pos_ids [B, S] int32, last_pos [B] int32 (index
+    of each row's last real token)."""
+    tokens = T.data("tokens", [batch_size, seq_len], dtype="int32")
+    pos_ids = T.data("pos_ids", [batch_size, seq_len], dtype="int32")
+    last_pos = T.data("last_pos", [batch_size], dtype="int32")
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pos = layers.embedding(pos_ids, size=[cfg.max_position,
+                                          cfg.hidden_size],
+                           param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pos)
+    for i in range(cfg.num_layers):
+        x = decoder_layer(cfg, x, i, True)
+    logits = _tied_next_logits(cfg, x, last_pos)
+    return {"feed_names": ["tokens", "pos_ids", "last_pos"],
+            "logits": logits}
+
+
+def gpt_prefill(cfg, max_len, batch_size=-1, seq_len=-1):
+    """Prompt ingestion: one causal forward over the (length-bucketed)
+    prompt that ALSO materializes every layer's ``[B, H, max_len, D]``
+    KV cache — zero-initialized in-graph, fresh k/v written at position
+    0. Padded rows write garbage beyond their true length; the decode
+    step's per-row position mask never attends it and later appends
+    overwrite it slot by slot. Fetch ``logits`` [B, V] (each row's last
+    real position) plus the caches."""
+    tokens = T.data("tokens", [batch_size, seq_len], dtype="int32")
+    pos_ids = T.data("pos_ids", [batch_size, seq_len], dtype="int32")
+    last_pos = T.data("last_pos", [batch_size], dtype="int32")
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pos = layers.embedding(pos_ids, size=[cfg.max_position,
+                                          cfg.hidden_size],
+                           param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pos)
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    zero_pos = T.fill_constant_batch_size_like(tokens, [-1], "int32", 0)
+    cache_k, cache_v = [], []
+    for i in range(cfg.num_layers):
+        zk = T.fill_constant_batch_size_like(
+            tokens, [-1, n_head, max_len, d_head], "float32", 0.0)
+        zv = T.fill_constant_batch_size_like(
+            tokens, [-1, n_head, max_len, d_head], "float32", 0.0)
+        x, ck, cv = decoder_layer(
+            cfg, x, i, True,
+            kv_cache={"k": zk, "v": zv, "mode": "prefill"}, pos=zero_pos)
+        cache_k.append(ck)
+        cache_v.append(cv)
+    logits = _tied_next_logits(cfg, x, last_pos)
+    return {"feed_names": ["tokens", "pos_ids", "last_pos"],
+            "logits": logits, "cache_k": cache_k, "cache_v": cache_v}
+
+
+def gpt_decode_step(cfg, max_len, batch_size=-1):
+    """ONE incremental decode step: embed the current token at each
+    row's own position, append its k/v into every layer's cache
+    (position-indexed dynamic_update_slice), attend over the cache with
+    the per-row position mask, emit next-token logits. Rows at different
+    positions share this one executable — per-token cost is an O(max_len)
+    cache-append + read instead of an O(S^2) full recompute.
+
+    Feeds: token [B] int32, pos [B] int32 (cache index this token is
+    written to), cache_k_<i>/cache_v_<i> [B, H, max_len, D]."""
+    token = T.data("token", [batch_size], dtype="int32")
+    pos = T.data("pos", [batch_size], dtype="int32")
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    emb = layers.embedding(token, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pemb = layers.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
+                            param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pemb)                     # [B, H]
+    x = T.reshape(x, [-1, 1, cfg.hidden_size])           # [B, 1, H]
+    feed_names = ["token", "pos"]
+    cache_k, cache_v = [], []
+    for i in range(cfg.num_layers):
+        ck_in = T.data(f"cache_k_{i}",
+                       [batch_size, n_head, max_len, d_head])
+        cv_in = T.data(f"cache_v_{i}",
+                       [batch_size, n_head, max_len, d_head])
+        feed_names += [f"cache_k_{i}", f"cache_v_{i}"]
+        x, ck, cv = decoder_layer(
+            cfg, x, i, True,
+            kv_cache={"k": ck_in, "v": cv_in, "mode": "decode"}, pos=pos)
+        cache_k.append(ck)
+        cache_v.append(cv)
+    zero = T.fill_constant_batch_size_like(token, [-1], "int32", 0)
+    logits = _tied_next_logits(cfg, x, zero)             # S=1: gather at 0
+    return {"feed_names": feed_names, "logits": logits,
+            "cache_k": cache_k, "cache_v": cache_v}
 
 
 # ---- tensor-parallel sharding annotation (Megatron-style over "tp") ----

@@ -1,0 +1,133 @@
+"""Incremental-decoding ops: the KV-cache fast path for autoregressive
+LMs (models/gpt.py generate(), serving decode batching).
+
+The reference generates with beam_search/sampling_id over FULL forward
+passes — every new token recomputes all S positions, O(S^2) attention
+per token. These ops implement the standard prefill/decode split from
+the LLM-serving literature (Orca iteration-level scheduling; vLLM's
+cache-centric serving): each decoder layer keeps a preallocated
+``[B, H, max_len, D]`` key/value cache, new tokens append via a
+position-indexed ``lax.dynamic_update_slice`` (vmapped so every row of
+the batch can sit at a DIFFERENT position — the decode batch shares one
+executable), and causal masking is driven by the per-row position
+counters instead of the query/key index triangle. Per-token cost drops
+from a full O(S^2) recompute to one O(S) cache-append + cache-wide
+attention read, which is bandwidth-bound — the difference between a
+demo and a servable LM.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.registry import register_op
+from .common import x_of
+
+_NEG_INF = -1e30   # additive mask value; -inf breaks softmax on all-masked rows
+
+
+@register_op("kv_cache_write", grad=False, infer_shape=False)
+def kv_cache_write(ctx, ins, attrs):
+    """Append S new key/value vectors into a preallocated cache at each
+    row's own position. Cache [B, H, L, D], KV [B, H, S, D], Pos [B]
+    int32 -> Out [B, H, L, D] with Out[b, :, pos[b]:pos[b]+S, :] = KV[b].
+
+    ``dynamic_update_slice`` clamps the start index to [0, L-S], so an
+    (invalid) overflowing position writes at the end instead of OOB —
+    callers enforce position < max_len host-side.
+    """
+    cache = x_of(ins, "Cache")
+    kv = x_of(ins, "KV")
+    pos = x_of(ins, "Pos")
+
+    def row(c, u, p):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(
+            c, u.astype(c.dtype), (z, p.astype(jnp.int32), z))
+
+    return {"Out": jax.vmap(row)(cache, kv, pos)}
+
+
+@register_op("kv_cached_attention", grad=False, infer_shape=False)
+def kv_cached_attention(ctx, ins, attrs):
+    """Causal attention of S fresh queries over a KV cache, masked by
+    per-row position counters. Q [B, H, S, D]; K/V caches [B, H, L, D];
+    Pos [B] int32 (absolute position of the FIRST query token, i.e. the
+    cache index its k/v was just written to). Key slot j is visible to
+    query i iff j <= pos[b] + i — rows at different positions share one
+    executable, and stale/garbage cache entries beyond a row's position
+    are never attended.
+
+    Scores/softmax accumulate in float32 (flash-kernel convention);
+    the output is cast back to Q's dtype. Decode (S=1) is a cache-wide
+    read per token: bandwidth-bound by design.
+    """
+    q = x_of(ins, "Q")
+    k = x_of(ins, "K")
+    v = x_of(ins, "V")
+    pos = x_of(ins, "Pos").astype(jnp.int32)
+    scale = float(attrs.get("scale", 0.0)) or float(q.shape[-1]) ** -0.5
+
+    scores = jnp.einsum("bhsd,bhld->bhsl", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    L = k.shape[2]
+    S = q.shape[2]
+    key_idx = jnp.arange(L, dtype=jnp.int32)[None, None, :]     # [1,1,L]
+    qry_pos = pos[:, None, None] + jnp.arange(S, dtype=jnp.int32)[None, :,
+                                                                  None]
+    mask = key_idx <= qry_pos                                    # [B,S,L]
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsl,bhld->bhsd", probs, v.astype(jnp.float32))
+    return {"Out": out.astype(q.dtype)}
+
+
+@register_op("row_gather", grad=False, infer_shape=False)
+def row_gather(ctx, ins, attrs):
+    """Out[b] = X[b, Index[b]] — per-row gather along axis 1 (e.g. the
+    last REAL token's hidden state of a right-padded prefill batch).
+    X [B, S, ...], Index [B] int -> Out [B, ...]."""
+    x = x_of(ins)
+    idx = x_of(ins, "Index").astype(jnp.int32)
+    idx = jnp.clip(idx, 0, x.shape[1] - 1)
+    expand = idx.reshape(idx.shape + (1,) * (x.ndim - 1))
+    return {"Out": jnp.take_along_axis(x, expand, axis=1)[:, 0]}
+
+
+@register_op("sample_tokens", grad=False, needs_rng=True,
+             infer_shape=False)
+def sample_tokens(ctx, ins, attrs):
+    """Next-token selection over logits [B, V] with PER-ROW sampling
+    config, so greedy and stochastic requests share one decode batch
+    (and one executable):
+
+    - Temperature [B] float32: rows with t <= 0 take argmax (greedy);
+      rows with t > 0 sample from softmax(logits / t).
+    - TopK [B] int32 (optional input): rows with k > 0 restrict sampling
+      to the k highest logits (ties at the threshold stay eligible);
+      k <= 0 means the full vocabulary.
+
+    Draws from the framework RNG stream: the op folds its build-time
+    ``__rng_seed__`` into the executor's run key (``ctx.op_key``), which
+    advances by ``split(key, 1)[0]`` per call — fixed seed => bitwise
+    reproducible sequences, and the forward-vjp replay rules of
+    dropout apply unchanged. Out [B] int32.
+    """
+    logits = x_of(ins).astype(jnp.float32)
+    temp = x_of(ins, "Temperature").astype(jnp.float32)
+    topk = ins.get("TopK")
+    key = ctx.op_key(attrs)
+    V = logits.shape[-1]
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    if topk:
+        k = jnp.clip(topk[0].astype(jnp.int32), 1, V)            # [B]
+        sorted_desc = -jnp.sort(-logits, axis=-1)                # [B, V]
+        thresh = jnp.take_along_axis(sorted_desc, (k - 1)[:, None],
+                                     axis=1)                     # [B, 1]
+        allowed = (topk[0].astype(jnp.int32) <= 0)[:, None] | \
+            (logits >= thresh)
+        scaled = jnp.where(allowed, scaled, _NEG_INF)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(
+        jnp.int32)
+    return {"Out": jnp.where(temp <= 0.0, greedy, sampled)}

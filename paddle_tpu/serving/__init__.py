@@ -23,6 +23,15 @@ clipper/ORCA adaptive-batching tradition:
   (queue/pad/compile/execute), throughput and batch occupancy; the same
   spans land in ``paddle_tpu.profiler`` event tables while profiling
 
+- generation: pass a ``models.generation.GPTGenerator`` as
+  ``InferenceServer(generator=...)`` and the server also speaks
+  ``op: "generate"`` — requests join a fixed bank of decode slots
+  (``FLAGS_decode_slots``) stepped one token at a time by a single
+  compiled KV-cached decode executable (ORCA-style continuous
+  batching: per-row position counters, token-level deadlines, slot
+  reuse the moment a row finishes); ``stats()`` adds prefill/decode/
+  sample histograms, ``tokens_per_s`` and ``decode_occupancy``
+
 Quick start::
 
     import paddle_tpu.serving as serving
@@ -31,12 +40,24 @@ Quick start::
         probs, = c.infer({"x": batch}, deadline_ms=50.0)
     print(server.stats()["mean_batch_size"])
     server.stop()
+
+Generation quick start::
+
+    gen = paddle_tpu.models.GPTGenerator(cfg, scope, max_len=512)
+    server = serving.InferenceServer(generator=gen).start()
+    with serving.Client(server.endpoint) as c:
+        new_tokens = c.generate(prompt_ids, max_new_tokens=64,
+                                temperature=0.8, top_k=40)
+    server.stop()
 """
 from .batching import (  # noqa: F401
-    DeadlineExceededError, MicroBatcher, Request, RequestQueue,
+    DeadlineExceededError, DecodeBatcher, GenerationRequest,
+    MicroBatcher, Request, RequestQueue,
     ServerOverloadedError, ServingError, next_bucket,
 )
 from .cache import ExecutableCache, LRUCache, feed_signature  # noqa: F401
-from .engine import SIGNATURE_FILE, ServingEngine  # noqa: F401
+from .engine import (  # noqa: F401
+    SIGNATURE_FILE, GenerationEngine, ServingEngine,
+)
 from .metrics import LatencyHistogram, ServingStats  # noqa: F401
 from .server import Client, InferenceServer, ServingConfig  # noqa: F401

@@ -74,6 +74,11 @@ class Request:
         self.example_sig = tuple(sorted(
             (n, tuple(a.shape[1:]), str(a.dtype))
             for n, a in self.feeds.items()))
+        self._init_lifecycle(deadline_ms)
+
+    def _init_lifecycle(self, deadline_ms):
+        """Deadline/event/result bookkeeping shared with subclasses that
+        don't carry an infer feeds dict (GenerationRequest)."""
         self.deadline_ms = deadline_ms
         now = time.monotonic()
         self.t_enqueue = now
@@ -205,6 +210,247 @@ class RequestQueue:
         for req in drained:
             req.set_error(ServerOverloadedError("server shut down with "
                                                 "the request still queued"))
+
+
+class GenerationRequest(Request):
+    """One in-flight autoregressive generation request: a 1-D int prompt
+    plus sampling knobs. Admission control (queue depth, deadline,
+    breaker) is inherited from :class:`Request` — ``deadline_ms`` is
+    token-level: it is re-checked between decode steps, so a request
+    whose budget runs out mid-generation fails fast instead of holding
+    its slot for the full ``max_new_tokens``."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
+                 "eos_id", "out_tokens", "slot")
+
+    def __init__(self, prompt, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_id=None, deadline_ms=None):
+        prompt = np.asarray(prompt, dtype=np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("generation request has an empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # no infer feeds dict: the prompt is the payload (feeds/
+        # example_sig are MicroBatcher concepts; the DecodeBatcher
+        # groups by slot, not signature)
+        self.feeds = None
+        self.rows = 1
+        self.example_sig = None
+        self._init_lifecycle(deadline_ms)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.out_tokens = []
+        self.slot = None
+
+
+class DecodeBatcher:
+    """Continuous batching over a fixed bank of decode slots
+    (ORCA-style iteration-level scheduling): one thread pulls
+    GenerationRequests off the queue, prefills them into free slots,
+    then steps the WHOLE bank one token at a time — new requests join
+    between steps, finished rows (EOS / max_new_tokens / deadline) free
+    their slot immediately for the next admission. Per-row state
+    (position counter, current token, sampling config, done) lives
+    here; the device-side slot caches live in the GenerationEngine."""
+
+    def __init__(self, queue, engine, stats=None):
+        self.queue = queue
+        self.engine = engine
+        self.slots = engine.slots
+        self.stats = stats
+        self._stop = threading.Event()
+        self._thread = None
+        self._free = list(range(self.slots))
+        self._active = {}                       # slot -> request
+        self._tok = np.zeros((self.slots,), np.int32)
+        self._pos = np.zeros((self.slots,), np.int32)
+        self._temp = np.zeros((self.slots,), np.float32)
+        self._topk = np.zeros((self.slots,), np.int32)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-decode-batcher")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5):
+        self._stop.set()
+        with self.queue._cv:
+            self.queue._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # loop thread owns the row state and is still inside a
+                # long step (e.g. a first-shape compile); it fails the
+                # in-flight requests itself on exit (_loop's finally),
+                # so no client hangs even though we stop waiting here
+                return
+        for req in list(self._active.values()):
+            if not req.done():
+                req.set_error(ServerOverloadedError(
+                    "server stopped while the request was decoding"))
+        self._active.clear()
+
+    # -- row lifecycle ----------------------------------------------------
+    def _finish(self, req, error=None):
+        slot = req.slot
+        if slot is not None and slot in self._active:
+            del self._active[slot]
+            self._free.append(slot)
+            # reset the freed slot's sampling config: a stale
+            # temperature > 0 would force the full sampler program on
+            # an otherwise all-greedy bank (the engine picks the argmax
+            # fast path only when every row's temperature is <= 0)
+            self._temp[slot] = 0.0
+            self._topk[slot] = 0
+        if req.done():
+            # abandoned request (e.g. the wire handler's wait budget
+            # expired and set an error): the slot is reclaimed above,
+            # nothing to deliver
+            return
+        if error is not None:
+            req.set_error(error)
+            if self.stats:
+                self.stats.bump("requests_failed")
+            return
+        req.set_result([np.asarray(req.out_tokens, np.int32)])
+        if self.stats:
+            self.stats.bump("requests_completed")
+            self.stats.hist["total"].observe(
+                time.monotonic() - req.t_enqueue)
+
+    def _deliver_token(self, req, tok):
+        """Record one sampled token; finish the row on EOS or budget.
+        Returns True while the row stays live."""
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(req)
+            return False
+        req.out_tokens.append(tok)
+        if self.stats:
+            self.stats.bump("tokens_generated")
+        if len(req.out_tokens) >= req.max_new_tokens:
+            self._finish(req)
+            return False
+        return True
+
+    def _fail_active_if_bank_lost(self, exc):
+        """After an engine failure, a donated-call loss of the slot bank
+        takes every ACTIVE row's caches with it — fail those rows too
+        rather than letting them silently decode against a rebuilt zero
+        bank."""
+        if getattr(self.engine, "bank_lost", False) and self._active:
+            for req in list(self._active.values()):
+                self._finish(req, ServingError(
+                    f"decode slot bank lost to an engine failure "
+                    f"({type(exc).__name__}: {exc}); the row's cache "
+                    f"is unrecoverable"))
+
+    def _check_deadlines(self, now):
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req.expired(now):
+                waited = (now - req.t_enqueue) * 1e3
+                if self.stats:
+                    self.stats.bump("shed_deadline")
+                self._finish(req, DeadlineExceededError(
+                    f"token-level deadline of {req.deadline_ms:.1f}ms "
+                    f"exceeded after {waited:.1f}ms with "
+                    f"{len(req.out_tokens)} tokens generated",
+                    deadline_ms=req.deadline_ms, waited_ms=waited))
+
+    # -- admission --------------------------------------------------------
+    def _admit(self):
+        take = []
+        while self._free and len(take) < len(self._free) \
+                and not self._stop.is_set():
+            # block briefly only when the bank is idle and nothing was
+            # taken yet; once rows are decoding, admission must not
+            # stall the step loop
+            timeout = 0.05 if not (self._active or take) else 0
+            req = self.queue.get(timeout=timeout)
+            if req is None:
+                break
+            now = time.monotonic()
+            if req.done():              # abandoned while queued
+                continue
+            if req.expired(now):
+                if self.stats:
+                    self.stats.bump("shed_deadline")
+                req.expire(now, where="decode-queue")
+                continue
+            if req.prompt.size + req.max_new_tokens > self.engine.max_len:
+                req.set_error(ValueError(
+                    f"prompt ({req.prompt.size} tokens) + max_new_tokens "
+                    f"({req.max_new_tokens}) exceeds the decode cache "
+                    f"length {self.engine.max_len}"))
+                if self.stats:
+                    self.stats.bump("requests_failed")
+                continue
+            take.append(req)
+        if not take:
+            return
+        slots = [self._free.pop() for _ in take]
+        try:
+            first = self.engine.admit(take, slots)
+        except Exception as exc:  # noqa: BLE001 — must reach the clients
+            self._free.extend(slots)
+            for req in take:
+                req.set_error(exc)
+                if self.stats:
+                    self.stats.bump("requests_failed")
+            self._fail_active_if_bank_lost(exc)
+            return
+        for tok, req, slot in zip(first, take, slots):
+            if self.stats:
+                self.stats.bump("generate_requests")
+            req.slot = slot
+            self._active[slot] = req
+            self._pos[slot] = req.prompt.size
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._tok[slot] = tok
+            self._deliver_token(req, int(tok))
+
+    # -- core loop --------------------------------------------------------
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                self._admit()
+                if not self._active:
+                    continue
+                self._check_deadlines(time.monotonic())
+                if not self._active:
+                    continue
+                try:
+                    toks = self.engine.step(self._tok, self._pos,
+                                            self._temp, self._topk)
+                except Exception as exc:  # noqa: BLE001
+                    for req in list(self._active.values()):
+                        self._finish(req, exc)
+                    continue
+                live = len(self._active)
+                if self.stats:
+                    self.stats.observe_decode_step(live, self.slots)
+                for slot in list(self._active):
+                    req = self._active[slot]
+                    if req.done():      # abandoned by its waiter
+                        self._finish(req)
+                        continue
+                    self._pos[slot] += 1
+                    self._tok[slot] = toks[slot]
+                    self._deliver_token(req, int(toks[slot]))
+        finally:
+            # rows still mid-generation when the loop exits (stop() or
+            # a crash) must fail fast, not leave their clients waiting
+            for req in list(self._active.values()):
+                if not req.done():
+                    req.set_error(ServerOverloadedError(
+                        "server stopped while the request was decoding"))
+            self._active.clear()
 
 
 def next_bucket(rows, min_bucket=1):

@@ -287,3 +287,128 @@ class ServingEngine:
             path = os.path.join(self.model_dir, SIGNATURE_FILE)
         self.cache.record(path)
         return path
+
+
+class GenerationEngine:
+    """Slot-batched autoregressive decoding primitives for the serving
+    runtime, over a ``models.generation.GPTGenerator``.
+
+    The engine owns a fixed bank of ``slots`` generation rows whose KV
+    caches live on the device as ONE ``[slots, H, max_len, D]`` buffer
+    per layer, stepped by a single compiled decode executable
+    (``FLAGS_decode_slots``). The ``DecodeBatcher`` drives it:
+
+    - ``admit(requests, slot_ids)``: bucketed prefill over the new
+      prompts, per-row sampling of their first tokens, and a jitted
+      scatter of the fresh row caches into the slot bank (slot reuse —
+      a finished row's stale cache is simply overwritten).
+    - ``step(tokens, pos, temperature, top_k)``: one decode + sample
+      over the whole bank; rows at different positions (and with
+      different sampling configs) share the executable.
+
+    All methods are single-caller by design — the DecodeBatcher thread
+    is the only driver (the chip is the bottleneck resource; concurrency
+    lives in the connection threads, exactly like the infer path).
+    """
+
+    def __init__(self, generator, *, slots=None, stats=None, seed=0):
+        import jax
+        from ..flags import flag
+        self.gen = generator
+        self.slots = int(slots or flag("decode_slots"))
+        self.stats = stats if stats is not None else generator.stats
+        # a generator WITHOUT its own sink adopts the server's (stage
+        # histograms land in server.stats()), and a sink a PREVIOUS
+        # engine bound is rebound to the live server (else a reused
+        # generator reports into a dead server's sink). A sink the USER
+        # set stays put — rebinding it would make unrelated offline
+        # generate() calls pollute the served-traffic counters.
+        if generator.stats is None or getattr(generator,
+                                              "_stats_adopted", False):
+            generator.stats = self.stats
+            generator._stats_adopted = True
+        self.max_len = generator.max_len
+        self._key = jax.random.PRNGKey(int(seed))
+        self._caches = None        # lazy: zeros [slots, H, L, D] per layer
+        self._insert_fn = None
+        self.bank_lost = False     # see _drop_bank
+
+    def _ensure_caches(self):
+        self.bank_lost = False
+        if self._caches is not None:
+            return
+        import jax.numpy as jnp
+        cfg = self.gen.cfg
+        d_head = cfg.hidden_size // cfg.num_heads
+        shape = (self.slots, cfg.num_heads, self.max_len, d_head)
+        self._caches = {}
+        for i in range(cfg.num_layers):
+            self._caches[f"cache_k_{i}"] = jnp.zeros(shape, jnp.float32)
+            self._caches[f"cache_v_{i}"] = jnp.zeros(shape, jnp.float32)
+
+    def _insert(self, row_caches, slot_ids):
+        """Scatter freshly prefilled row caches into the slot bank (one
+        jitted executable; jax's shape cache handles the (n, bucket)
+        universe)."""
+        import jax
+        import jax.numpy as jnp
+        if self._insert_fn is None:
+            def ins(dst, src, idx):
+                return {name: dst[name].at[idx].set(src[name][:idx.shape[0]])
+                        for name in dst}
+            self._insert_fn = jax.jit(ins, donate_argnums=(0,))
+        idx = jnp.asarray(slot_ids, jnp.int32)
+        try:
+            self._caches = self._insert_fn(self._caches, row_caches, idx)
+        except Exception:
+            self._drop_bank()
+            raise
+
+    def _drop_bank(self):
+        """A failed donated call may have invalidated the slot bank's
+        buffers: drop it (the next admission rebuilds zeros) and flag
+        the loss so the DecodeBatcher fails every active row instead of
+        letting them silently decode against a fresh zero cache."""
+        self._caches = None
+        self.bank_lost = True
+
+    def admit(self, requests, slot_ids):
+        """Prefill the new requests' prompts (one bucketed batch), sample
+        their first tokens, write their caches into ``slot_ids``.
+        Returns the first tokens as np int32 [len(requests)]."""
+        self._ensure_caches()
+        n = len(requests)
+        tokens, pos_ids, last = self.gen._pack_prompts(
+            [req.prompt for req in requests])
+        bb = tokens.shape[0]
+        temp = np.zeros((bb,), np.float32)
+        topk = np.zeros((bb,), np.int32)
+        for r, req in enumerate(requests):
+            temp[r] = req.temperature
+            topk[r] = req.top_k
+
+        logits, row_caches, self._key = self.gen._run_prefill(
+            tokens, pos_ids, last, self._key)
+        toks, self._key = self.gen._run_sample(logits, temp, topk,
+                                               self._key)
+        self._insert(row_caches, list(slot_ids))
+        return np.asarray(toks)[:n]
+
+    def step(self, tokens, pos, temperature, top_k):
+        """One decode + sample over the whole slot bank. ``tokens``/
+        ``pos``/``temperature``/``top_k`` are np arrays of length
+        ``slots`` (free slots carry harmless stale values — their rows
+        are never read). Returns sampled np int32 tokens [slots]."""
+        self._ensure_caches()
+        try:
+            logits, self._caches, self._key = self.gen._run_decode(
+                np.ascontiguousarray(tokens, dtype=np.int32),
+                np.ascontiguousarray(pos, dtype=np.int32),
+                self._caches, self._key)
+        except Exception:
+            self._drop_bank()      # caches were donated into the call
+            raise
+        toks, self._key = self.gen._run_sample(
+            logits, np.ascontiguousarray(temperature, dtype=np.float32),
+            np.ascontiguousarray(top_k, dtype=np.int32), self._key)
+        return np.asarray(toks)

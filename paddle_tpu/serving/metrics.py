@@ -88,7 +88,12 @@ class ServingStats:
     ``server.stats()`` payload — plain ints/floats only, so it crosses
     the wire protocol's typed value universe unchanged."""
 
-    STAGES = ("queue", "pad", "compile", "execute", "total")
+    STAGES = ("queue", "pad", "compile", "execute", "total",
+              # generation pipeline stages (KV-cached decoding):
+              # prefill = prompt ingestion forward, decode = one
+              # incremental step over the slot batch, sample = the
+              # next-token selection executable
+              "prefill", "decode", "sample")
 
     def __init__(self):
         self.hist = {s: LatencyHistogram(f"serving/{s}")
@@ -105,6 +110,12 @@ class ServingStats:
             "rows": 0,            # real example rows executed
             "padded_rows": 0,     # bucket capacity across executed batches
             "compiles": 0,
+            # -- generation (decode batching) --
+            "generate_requests": 0,
+            "tokens_generated": 0,
+            "decode_steps": 0,
+            "decode_rows": 0,       # live generation rows stepped
+            "decode_slot_rows": 0,  # slot capacity across steps
         }
 
     def bump(self, name, n=1):
@@ -116,6 +127,12 @@ class ServingStats:
             self._c["batches"] += 1
             self._c["rows"] += rows
             self._c["padded_rows"] += capacity
+
+    def observe_decode_step(self, live_rows, slots):
+        with self._lock:
+            self._c["decode_steps"] += 1
+            self._c["decode_rows"] += live_rows
+            self._c["decode_slot_rows"] += slots
 
     def counter(self, name):
         with self._lock:
@@ -133,6 +150,11 @@ class ServingStats:
             c["rows"] / c["batches"], 3) if c["batches"] else 0.0
         out["batch_occupancy"] = round(
             c["rows"] / c["padded_rows"], 4) if c["padded_rows"] else 0.0
+        out["tokens_per_s"] = round(
+            c["tokens_generated"] / uptime, 3) if uptime > 0 else 0.0
+        out["decode_occupancy"] = round(
+            c["decode_rows"] / c["decode_slot_rows"], 4) \
+            if c["decode_slot_rows"] else 0.0
         for s, h in self.hist.items():
             snap = h.snapshot()
             for k, v in snap.items():

@@ -30,9 +30,10 @@ import threading
 
 import numpy as np
 
-from .batching import (DeadlineExceededError, MicroBatcher, Request,
+from .batching import (DeadlineExceededError, DecodeBatcher,
+                       GenerationRequest, MicroBatcher, Request,
                        RequestQueue, ServerOverloadedError)
-from .engine import ServingEngine
+from .engine import GenerationEngine, ServingEngine
 from .metrics import ServingStats
 from ..distributed.wire import (WireError, default_key, recv_frame,
                                 send_frame)
@@ -78,27 +79,43 @@ class InferenceServer:
     ``PADDLE_PS_AUTH_KEY`` on both ends (required for non-loopback binds
     unless ``allow_insecure=True``)."""
 
-    def __init__(self, model_dir=None, *, engine=None, config=None,
+    def __init__(self, model_dir=None, *, engine=None, generator=None,
+                 decode_slots=None, config=None,
                  host="127.0.0.1", port=0, auth_key=None,
                  allow_insecure=False, **config_overrides):
         self.config = config or ServingConfig(**config_overrides)
         self.stats_sink = ServingStats()
-        if engine is None:
+        if engine is None and (model_dir is not None
+                               or generator is None):
             from .cache import ExecutableCache
             cache = ExecutableCache(max_entries=self.config.cache_entries,
                                     max_bytes=self.config.cache_bytes)
             engine = ServingEngine(model_dir, cache=cache,
                                    stats=self.stats_sink)
-        else:
+        elif engine is not None:
             engine.stats = engine.stats or self.stats_sink
-        self.engine = engine
-        self.queue = RequestQueue(max_depth=self.config.queue_depth,
-                                  stats=self.stats_sink)
-        self.batcher = MicroBatcher(
-            self.queue, self.engine.execute,
-            max_batch_size=self.config.max_batch_size,
-            batch_timeout_ms=self.config.batch_timeout_ms,
-            stats=self.stats_sink)
+        self.engine = engine          # None for a generation-only server
+        self.queue = self.batcher = None
+        if engine is not None:
+            self.queue = RequestQueue(max_depth=self.config.queue_depth,
+                                      stats=self.stats_sink)
+            self.batcher = MicroBatcher(
+                self.queue, self.engine.execute,
+                max_batch_size=self.config.max_batch_size,
+                batch_timeout_ms=self.config.batch_timeout_ms,
+                stats=self.stats_sink)
+        # generation endpoint: a models.generation.GPTGenerator turns
+        # the server into a token service — requests join a fixed bank
+        # of decode slots (continuous batching, slot reuse on finish)
+        self.gen_engine = self.gen_queue = self.decode_batcher = None
+        if generator is not None:
+            self.gen_engine = GenerationEngine(generator,
+                                               slots=decode_slots,
+                                               stats=self.stats_sink)
+            self.gen_queue = RequestQueue(
+                max_depth=self.config.queue_depth, stats=self.stats_sink)
+            self.decode_batcher = DecodeBatcher(
+                self.gen_queue, self.gen_engine, stats=self.stats_sink)
         self.host = host
         self.port = int(port)
         self._key = auth_key if auth_key is not None else default_key()
@@ -119,10 +136,14 @@ class InferenceServer:
         """Start the batcher (always) and the socket front-end (unless
         ``serve_network=False`` for purely in-process serving). Optional
         warmup precompiles before the first byte of traffic."""
-        if warmup_batch_sizes or warmup_signature_file:
+        if (warmup_batch_sizes or warmup_signature_file) \
+                and self.engine is not None:
             self.engine.warmup(batch_sizes=warmup_batch_sizes or (),
                                signature_file=warmup_signature_file)
-        self.batcher.start()
+        if self.batcher is not None:
+            self.batcher.start()
+        if self.decode_batcher is not None:
+            self.decode_batcher.start()
         if serve_network:
             loopback = (self.host.startswith("127.")
                         or self.host in ("localhost", "::1"))
@@ -165,8 +186,14 @@ class InferenceServer:
                 c.close()
             except OSError:
                 pass
-        self.queue.close()
-        self.batcher.stop()
+        if self.queue is not None:
+            self.queue.close()
+        if self.batcher is not None:
+            self.batcher.stop()
+        if self.gen_queue is not None:
+            self.gen_queue.close()
+        if self.decode_batcher is not None:
+            self.decode_batcher.stop()
         for t in self._threads:
             t.join(timeout=2)
 
@@ -181,6 +208,9 @@ class InferenceServer:
         """Admit a request (raises ServerOverloadedError /
         DeadlineExceededError at the door); returns the Request — call
         ``.wait()`` for the fetch list."""
+        if self.queue is None:
+            raise ValueError("no inference model loaded — this server "
+                             "only serves 'generate'")
         if deadline_ms is None and self.config.default_deadline_ms > 0:
             deadline_ms = self.config.default_deadline_ms
         return self.queue.put(Request(feeds, deadline_ms=deadline_ms))
@@ -189,17 +219,55 @@ class InferenceServer:
         return self.submit(feeds, deadline_ms=deadline_ms).wait(
             timeout=timeout)
 
+    def submit_generate(self, tokens, max_new_tokens=32, temperature=0.0,
+                        top_k=0, eos_id=None, deadline_ms=None):
+        """Admit a generation request into the decode bank (admission
+        control applies: queue depth, breaker, deadline). Returns the
+        GenerationRequest — ``.wait()`` yields ``[np int32 tokens]``.
+
+        ``FLAGS_serving_default_deadline_ms`` is NOT inherited here: it
+        is a per-infer-batch budget, and a whole generation (prefill +
+        up to max_new_tokens decode steps) lives on a different time
+        scale — generation deadlines are per-request opt-in."""
+        if self.gen_queue is None:
+            raise ValueError("no generator loaded — pass generator= to "
+                             "InferenceServer to serve 'generate'")
+        return self.gen_queue.put(GenerationRequest(
+            tokens, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, eos_id=eos_id,
+            deadline_ms=deadline_ms))
+
+    def generate(self, tokens, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_id=None, deadline_ms=None, timeout=None):
+        """Generate new tokens for one prompt; returns a 1-D np.int32
+        array (EOS excluded)."""
+        req = self.submit_generate(tokens, max_new_tokens=max_new_tokens,
+                                   temperature=temperature, top_k=top_k,
+                                   eos_id=eos_id, deadline_ms=deadline_ms)
+        return req.wait(timeout=timeout)[0]
+
     def stats(self):
         """One snapshot across every stage: admission counters, stage
         latency histograms, batch occupancy, executable-cache hit/miss/
         evict, queue depth."""
-        extra = {"queue_depth": len(self.queue),
-                 "breaker_state": self.queue.breaker.state}
-        for k, v in self.engine.cache.stats().items():
-            extra[f"cache_{k}"] = v
+        extra = {}
+        if self.queue is not None:
+            extra["queue_depth"] = len(self.queue)
+            extra["breaker_state"] = self.queue.breaker.state
+        if self.engine is not None:
+            for k, v in self.engine.cache.stats().items():
+                extra[f"cache_{k}"] = v
+        if self.gen_queue is not None:
+            extra["decode_queue_depth"] = len(self.gen_queue)
+            extra["decode_free_slots"] = len(self.decode_batcher._free)
+            for k, v in self.gen_engine.gen.cache.stats().items():
+                extra[f"decode_cache_{k}"] = v
         return self.stats_sink.snapshot(extra=extra)
 
     def record_signatures(self, path=None):
+        if self.engine is None:
+            raise ValueError("no inference model loaded — this server "
+                             "only serves 'generate'")
         return self.engine.record_signatures(path)
 
     # -- network front-end ------------------------------------------------
@@ -255,9 +323,15 @@ class InferenceServer:
             return {"ok": True}
         if op == "stats":
             return {"ok": True, "stats": self.stats()}
+        if op == "generate":
+            return self._handle_generate(msg)
         if op != "infer":
             return {"ok": False, "etype": "BadRequest",
                     "error": f"unknown op {op!r}"}
+        if self.engine is None:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "no inference model loaded — this server "
+                             "only serves 'generate'"}
         try:
             feed = msg.get("feed")
             if not isinstance(feed, dict) or not feed:
@@ -284,6 +358,57 @@ class InferenceServer:
             outs = req.wait(timeout=wait_s)
             return {"ok": True, "fetch": tuple(outs),
                     "batched": int(req.rows)}
+        except DeadlineExceededError as e:
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": str(e)}
+        except ServerOverloadedError as e:
+            return {"ok": False, "etype": "Overloaded", "error": str(e)}
+        except Exception as e:  # noqa: BLE001 — surface, don't die
+            return {"ok": False, "etype": "Internal",
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def _handle_generate(self, msg):
+        if self.gen_queue is None:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "this server has no generator — pass "
+                             "generator= to InferenceServer"}
+        try:
+            tokens = msg.get("tokens")
+            if tokens is None:
+                raise ValueError("'tokens' (1-D int prompt) is required")
+            req = self.submit_generate(
+                np.asarray(tokens),
+                max_new_tokens=int(msg.get("max_new_tokens", 32)),
+                temperature=float(msg.get("temperature", 0.0)),
+                top_k=int(msg.get("top_k", 0)),
+                eos_id=msg.get("eos_id"),
+                deadline_ms=msg.get("deadline_ms"))
+        except ServerOverloadedError as e:
+            return {"ok": False, "etype": "Overloaded", "error": str(e)}
+        except DeadlineExceededError as e:
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": str(e)}
+        except (ValueError, TypeError) as e:
+            return {"ok": False, "etype": "BadRequest", "error": str(e)}
+        # generation budget: prompt prefill + one step per token, plus
+        # compile headroom on the first request of a shape
+        budget = msg.get("deadline_ms")
+        wait_s = (budget / 1e3 + 120.0) if budget else 600.0
+        try:
+            out, = req.wait(timeout=wait_s)
+            return {"ok": True, "tokens": np.asarray(out, np.int32),
+                    "generated": int(np.asarray(out).size)}
+        except TimeoutError:
+            # abandon the request properly: marking it done lets the
+            # DecodeBatcher reclaim its slot instead of decoding tokens
+            # nobody will read, and the client gets a typed, retryable
+            # error instead of a generic Internal
+            err = DeadlineExceededError(
+                f"server-side wait budget of {wait_s:.0f}s exceeded; "
+                f"the request was abandoned")
+            req.set_error(err)
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": str(err)}
         except DeadlineExceededError as e:
             return {"ok": False, "etype": "DeadlineExceeded",
                     "error": str(e)}
@@ -347,6 +472,23 @@ class Client:
         reply = self._call({"op": "infer", "feed": dict(feeds),
                             "deadline_ms": deadline_ms})
         return [np.asarray(a) for a in reply["fetch"]]
+
+    def generate(self, tokens, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_id=None, deadline_ms=None):
+        """Autoregressive generation for one prompt (1-D int tokens).
+        Returns the NEW tokens as a 1-D np.int32 array (EOS excluded).
+        Same error mapping as ``infer``; ``deadline_ms`` is token-level
+        (checked between decode steps server-side)."""
+        reply = self._call({
+            "op": "generate",
+            "tokens": np.asarray(tokens, dtype=np.int32).ravel(),
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "deadline_ms": deadline_ms,
+        })
+        return np.asarray(reply["tokens"], dtype=np.int32)
 
     def stats(self):
         return self._call({"op": "stats"})["stats"]

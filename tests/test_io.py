@@ -277,3 +277,69 @@ def test_modern_load_missing_file_raises(tmp_path):
     exe = fluid.Executor()
     with pytest.raises(RuntimeError):
         fluid.load(main, str(tmp_path / "nope" / "ckpt"))
+
+
+def test_shared_dir_manifest_preserves_other_programs(tmp_path):
+    """save_params of a SECOND program into a dir already holding
+    another program's params must keep the earlier files' manifest hash
+    entries (preserve_existing), so their later corruption is still
+    detected instead of loading silently (PR-4 known issue)."""
+    from paddle_tpu.io import CheckpointCorruptError
+
+    d = str(tmp_path / "shared")
+    progs = {}
+    for tag in ("a", "b"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4], "float32")
+            layers.fc(x, 3, param_attr=fluid.ParamAttr(
+                name=f"prog_{tag}_w"), bias_attr=False)
+        progs[tag] = (main, startup)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(progs["a"][1])
+        exe.run(progs["b"][1])
+        fluid.save_params(exe, d, main_program=progs["a"][0])
+        fluid.save_params(exe, d, main_program=progs["b"][0])
+        # corrupt program A's param file AFTER program B's save rewrote
+        # the manifest
+        victim = tmp_path / "shared" / "prog_a_w.npy"
+        blob = bytearray(victim.read_bytes())
+        blob[-4] ^= 0xFF
+        victim.write_bytes(bytes(blob))
+        with pytest.raises(CheckpointCorruptError):
+            fluid.load_params(exe, d, main_program=progs["a"][0])
+        # program B is untouched and still loads
+        fluid.load_params(exe, d, main_program=progs["b"][0])
+
+
+def test_shared_dir_meta_extras_survive_second_save(tmp_path):
+    """The meta analog: program A's dtype tags AND extras (the RNG key
+    save_persistables records) must survive program B's later save into
+    the same dir, so load_persistables(A) still restores A's RNG."""
+    d = str(tmp_path / "shared2")
+    ma, sa = fluid.Program(), fluid.Program()
+    with fluid.program_guard(ma, sa):
+        x = fluid.data("x", [-1, 4], "float32")
+        layers.fc(x, 3, param_attr=fluid.ParamAttr(name="pa_w"),
+                  bias_attr=False)
+    mb, sb = fluid.Program(), fluid.Program()
+    with fluid.program_guard(mb, sb):
+        x = fluid.data("x", [-1, 4], "float32")
+        layers.fc(x, 3, param_attr=fluid.ParamAttr(name="pb_w"),
+                  bias_attr=False)
+    from paddle_tpu.framework.executor import RNG_STATE_NAME as RNG
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sa)
+        exe.run(sb)
+        exe.run(ma, feed={"x": np.zeros((1, 4), np.float32)})  # mint RNG
+        rng_before = np.asarray(scope.find_var(RNG))
+        fluid.save_persistables(exe, d, main_program=ma)
+        fluid.save_params(exe, d, main_program=mb)
+        scope.set(RNG, np.zeros_like(rng_before))
+        fluid.load_persistables(exe, d, main_program=ma)
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(RNG)), rng_before)
