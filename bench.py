@@ -873,6 +873,122 @@ def bench_passes():
     }
 
 
+def bench_chaos():
+    """Serving resilience recovery metrics (the BENCHMARKS.md recovery
+    table): (a) loop-restart time — kill the micro-batcher loop thread
+    and measure wall time until the next successful infer; (b) hot
+    weight reload — the decode-bank swap pause (admission paused while
+    in-flight rows finish on the old weights) and the infer-engine swap
+    (atomic, ~0); (c) hedged p99 — client p99 with hedging off vs on
+    while a chaos point stalls 5% of connection handlers ("The Tail at
+    Scale" scenario)."""
+    import tempfile
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, resilience, serving
+
+    tmp = tempfile.mkdtemp(prefix="bench_chaos_")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 64], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        out = layers.fc(h, 32, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, ["x"], [out], exe,
+                                      main_program=main)
+        fluid.io.save_params(exe, os.path.join(tmp, "ckpt"),
+                             main_program=main)
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((1, 64)).astype(np.float32)
+
+    # (a) loop-restart time + (b) infer-engine reload swap
+    server = serving.InferenceServer(tmp, batch_timeout_ms=1.0,
+                                     queue_depth=256)
+    server.supervisor.poll_s = 0.01
+    server.supervisor.restart_backoff = 0.01
+    server.start(serve_network=False, warmup_batch_sizes=(1,))
+    server.infer({"x": xv}, timeout=60)
+    restart_ms = []
+    for _ in range(5):
+        with resilience.fault_injection("serving.queue",
+                                        exc=RuntimeError, times=1):
+            t0 = time.perf_counter()
+            while True:          # fault kills the loop on its next poll
+                try:
+                    server.infer({"x": xv}, deadline_ms=2000.0,
+                                 timeout=10)
+                    break
+                except serving.ServingError:
+                    time.sleep(0.002)
+            restart_ms.append((time.perf_counter() - t0) * 1e3)
+    t0 = time.perf_counter()
+    server.reload_weights(os.path.join(tmp, "ckpt"))
+    infer_reload_ms = (time.perf_counter() - t0) * 1e3
+    server.stop()
+
+    # (b) decode-bank swap pause under an in-flight generation
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.models.generation import GPTGenerator
+    cfg = gpt_mod.GPTConfig.tiny()
+    gmain, gstartup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gmain, gstartup):
+        gpt_mod.gpt_logits(cfg)
+    gscope = fluid.Scope()
+    with fluid.scope_guard(gscope):
+        exe.run(gstartup)
+        fluid.io.save_params(exe, os.path.join(tmp, "gpt_ckpt"),
+                             main_program=gmain)
+    gen = GPTGenerator(cfg, gscope, max_len=64, bucket_min=8)
+    gserver = serving.InferenceServer(generator=gen, decode_slots=4)
+    gserver.start(serve_network=False)
+    prompt = rng.integers(1, cfg.vocab_size, 8).astype(np.int32)
+    gserver.submit_generate(prompt, max_new_tokens=2).wait(timeout=300)
+    req = gserver.submit_generate(prompt, max_new_tokens=40)
+    time.sleep(0.05)             # let it admit
+    report = gserver.reload_weights(os.path.join(tmp, "gpt_ckpt"),
+                                    timeout=120)
+    req.wait(timeout=120)
+    decode_swap_pause_ms = report["swap_pause_ms"]
+    gserver.stop()
+
+    # (c) hedged p99 under 5% stalled connection handlers
+    server = serving.InferenceServer(tmp, batch_timeout_ms=1.0,
+                                     queue_depth=256)
+    server.start(warmup_batch_sizes=(1,))
+
+    def drive(hedge_ms, n=150):
+        lat = []
+        with serving.Client(server.endpoint, hedge_ms=hedge_ms) as c:
+            c.infer({"x": xv})                   # connect + warm
+            with resilience.chaos("serving.handle", p=0.05, seed=7,
+                                  delay=0.25):
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    c.infer({"x": xv})
+                    lat.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(np.asarray(lat), 99)), c.hedge_stats()
+
+    p99_off, _ = drive(hedge_ms=0.0)
+    p99_on, hstats = drive(hedge_ms=20.0)
+    server.stop()
+
+    restart = float(np.median(np.asarray(restart_ms)))
+    return {
+        "metric": "chaos_loop_restart_ms",
+        "value": round(restart, 2),
+        "unit": "ms",
+        "vs_baseline": None,     # recovery metric, no external anchor
+        "loop_restart_ms": [round(v, 2) for v in restart_ms],
+        "reload_infer_swap_ms": round(infer_reload_ms, 2),
+        "reload_decode_swap_pause_ms": round(decode_swap_pause_ms, 2),
+        "hedged_p99_ms": {"off": round(p99_off, 2),
+                          "on": round(p99_on, 2)},
+        "hedge_stats": hstats,
+    }
+
+
 def bench_decode():
     """KV-cached autoregressive decoding A/B (models/generation): after
     a bucketed prefill of a seq-{128,256} prompt, generate N tokens via
@@ -969,6 +1085,7 @@ _CONFIGS = {
     "gpt_long": (bench_gpt_long,
                  "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
     "serving": (bench_serving, "serving_mlp_batch32_samples_per_sec"),
+    "chaos": (bench_chaos, "chaos_loop_restart_ms"),
     "train_loop": (bench_train_loop, "train_loop_fused_k8_steps_per_sec"),
     "passes": (bench_passes,
                "passes_bert_train_step_trace_plus_compile_ms"),

@@ -46,6 +46,19 @@ _DEFS = {
     # and how long it sheds before re-probing
     "serving_shed_failures": (8, int, None),
     "serving_shed_reset_secs": (0.5, float, None),
+    # -- serving resilience layer --
+    # wall-clock budget per batcher execute / decode step (run under
+    # resilience.run_with_watchdog so a hung chip call fails that
+    # batch's clients instead of wedging the loop) and the supervisor's
+    # stale-heartbeat threshold. Must exceed the worst-case first-shape
+    # compile; 0 disables the watchdog and the hung-loop detector.
+    "serving_loop_watchdog_s": (60.0, float, None),
+    # client-side hedged requests: hedge `infer` after this many ms
+    # without a reply (p99-derived once the client has observed enough
+    # traffic; this flag is the cold-start delay). 0 = hedging off.
+    "serving_hedge_ms": (0.0, float, None),
+    # default seed for resilience.chaos() fault-point streams
+    "chaos_seed": (0, int, None),
     # -- KV-cached autoregressive decoding (models/generation, serving
     # decode batching) --
     # preallocated per-layer KV cache length [B, H, decode_max_len, D]:

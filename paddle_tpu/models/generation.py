@@ -184,6 +184,22 @@ class GPTGenerator:
             for n in list(state):
                 state[n] = self._params[n]
 
+    def swap_params(self, device_params):
+        """Atomically rebind the parameter snapshot to already-device
+        arrays (the hot-weight-reload swap: the expensive device_put
+        happened off-thread; this is dict construction only). Each
+        compiled kind gets a FRESH state dict — an in-flight call
+        already holds a reference to the old one, so it finishes on the
+        old weights while every later call reads the new ones."""
+        missing = [n for n in self._params if n not in device_params]
+        if missing:
+            raise ValueError(f"swap_params snapshot is missing "
+                             f"parameters: {sorted(missing)}")
+        self._params = {n: device_params[n] for n in self._params}
+        for kind, (jitted, state) in list(self._fns.items()):
+            self._fns[kind] = (jitted,
+                               {n: self._params[n] for n in state})
+
     @staticmethod
     def _signature(kind, feed):
         from ..serving.cache import feed_signature

@@ -20,6 +20,9 @@ framework/executor.py share one vocabulary:
   budget (the host-side analog of a preempted-TPU step that never returns)
 - fault_injection(point, ...): test hook arming named failure points that
   production code declares with maybe_fail(point)
+- chaos(points, ...): seeded, probabilistic, schedulable fault injection
+  across MANY points at once — the serving chaos harness ("The Tail at
+  Scale" failure modes on demand: crashes, delays, lost replies)
 """
 import random
 import threading
@@ -76,6 +79,12 @@ class NonFiniteError(EnforceNotMet):
 
 class WatchdogTimeout(RuntimeError):
     """Work under a watchdog exceeded its wall-clock budget."""
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised by an armed chaos fault point. Distinct
+    from real failure types so a soak can tell injected damage from a
+    genuine bug in the recovery machinery."""
 
 
 # --------------------------------------------------------------------------
@@ -341,3 +350,122 @@ def fault_injection(point, exc=ConnectionError, times=1):
                 _faults.pop(point, None)
             else:
                 _faults[point] = prev
+
+
+# --------------------------------------------------------------------------
+# chaos harness (seeded, probabilistic, schedulable fault points)
+# --------------------------------------------------------------------------
+
+class ChaosMonkey:
+    """Handle yielded by :func:`chaos`: per-point hit and fire counters
+    (``hits[point]`` = times the armed point was reached, ``fired[point]``
+    = times it actually injected a fault/delay)."""
+
+    def __init__(self, seed):
+        self.seed = seed
+        self.hits = {}
+        self.fired = {}
+        self._lock = threading.Lock()
+
+    def _record(self, point, fire):
+        with self._lock:
+            self.hits[point] = self.hits.get(point, 0) + 1
+            if fire:
+                self.fired[point] = self.fired.get(point, 0) + 1
+
+    def total_fired(self):
+        with self._lock:
+            return sum(self.fired.values())
+
+
+def _chaos_spec(point, cfg, monkey):
+    """Build one armed-point callable from a per-point config dict:
+    ``p`` (fire probability per hit), ``after`` (skip the first N hits),
+    ``every`` (deterministic: fire on every Nth hit, overriding p),
+    ``times`` (stop after N fires; -1 unlimited), ``delay`` (inject a
+    stall of that many seconds instead of raising), ``exc`` (exception
+    class/instance to raise). Each point draws from its OWN seeded RNG
+    stream so arming more points never perturbs another point's
+    pattern."""
+    p = float(cfg.get("p", 1.0))
+    after = int(cfg.get("after", 0))
+    every = cfg.get("every")
+    times = int(cfg.get("times", -1))
+    delay = cfg.get("delay")
+    exc = cfg.get("exc", FaultInjected)
+    rng = random.Random(f"{monkey.seed}/{point}")
+    state = {"hits": 0, "fires": 0}
+    lock = threading.Lock()
+
+    def _fire(pt, context):
+        with lock:
+            state["hits"] += 1
+            hit = state["hits"]
+            draw = rng.random()       # always drawn: keeps the stream
+            if hit <= after:          # aligned whether or not we fire
+                fire = False
+            elif times >= 0 and state["fires"] >= times:
+                fire = False
+            elif every is not None:
+                fire = (hit - after) % int(every) == 0
+            else:
+                fire = draw < p
+            if fire:
+                state["fires"] += 1
+        monkey._record(pt, fire)
+        if not fire:
+            return None
+        if delay:
+            time.sleep(float(delay))
+            return None
+        if isinstance(exc, type):
+            return exc(f"fault injected at {pt}")
+        return exc
+
+    return {"exc": _fire, "remaining": -1, "fired": 0}
+
+
+@contextmanager
+def chaos(points, p=1.0, seed=None, exc=FaultInjected, times=-1,
+          after=0, every=None, delay=None):
+    """Arm MANY fault points at once with seeded, probabilistic,
+    schedulable behavior — the serving chaos harness.
+
+    ``points`` is a point name, an iterable of names, or a dict mapping
+    name -> per-point overrides (any of ``p``/``after``/``every``/
+    ``times``/``delay``/``exc``); the keyword arguments are the
+    defaults every point inherits. ``seed`` None reads
+    ``FLAGS_chaos_seed``. Determinism: each point owns an RNG seeded
+    from ``(seed, point)``, so a single-threaded test replays the exact
+    same fire pattern run after run, and adding a point never shifts
+    another's stream (under concurrency the per-point pattern stays
+    fixed; which REQUEST absorbs each fault depends on scheduling).
+
+    Yields a :class:`ChaosMonkey` with per-point hit/fire counters.
+    """
+    if seed is None:
+        from .flags import flag
+        seed = flag("chaos_seed")
+    if isinstance(points, str):
+        points = {points: {}}
+    elif not isinstance(points, dict):
+        points = {pt: {} for pt in points}
+    monkey = ChaosMonkey(seed)
+    defaults = {"p": p, "after": after, "every": every, "times": times,
+                "delay": delay, "exc": exc}
+    prev = {}
+    with _faults_lock:
+        for pt, overrides in points.items():
+            cfg = dict(defaults)
+            cfg.update(overrides or {})
+            prev[pt] = _faults.get(pt)
+            _faults[pt] = _chaos_spec(pt, cfg, monkey)
+    try:
+        yield monkey
+    finally:
+        with _faults_lock:
+            for pt, old in prev.items():
+                if old is None:
+                    _faults.pop(pt, None)
+                else:
+                    _faults[pt] = old

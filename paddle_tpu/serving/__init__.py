@@ -32,6 +32,16 @@ clipper/ORCA adaptive-batching tradition:
   reuse the moment a row finishes); ``stats()`` adds prefill/decode/
   sample histograms, ``tokens_per_s`` and ``decode_occupancy``
 
+- resilience: the server runs a lifecycle state machine (warming ->
+  serving -> draining -> stopped, degraded while the loop supervisor's
+  breaker is open), a ``health`` wire op, ``drain()`` graceful shutdown,
+  ``reload_weights()`` hot checkpoint swap (manifest-verified; in-flight
+  generations finish on the old weights), supervised batcher loops
+  (heartbeats, watchdogged executes, capped-backoff restarts), and a
+  hedging/reconnecting ``Client`` with server-side request-id dedup.
+  ``resilience.chaos()`` arms seeded fault points through every serving
+  stage for deterministic failure testing.
+
 Quick start::
 
     import paddle_tpu.serving as serving
@@ -51,13 +61,16 @@ Generation quick start::
     server.stop()
 """
 from .batching import (  # noqa: F401
-    DeadlineExceededError, DecodeBatcher, GenerationRequest,
-    MicroBatcher, Request, RequestQueue,
-    ServerOverloadedError, ServingError, next_bucket,
+    BadRequestError, DeadlineExceededError, DecodeBatcher,
+    GenerationRequest, InternalServerError, MicroBatcher, Request,
+    RequestCancelledError, RequestQueue, ServerOverloadedError,
+    ServerShutdownError, ServingError, SwapHandle, next_bucket,
 )
 from .cache import ExecutableCache, LRUCache, feed_signature  # noqa: F401
 from .engine import (  # noqa: F401
     SIGNATURE_FILE, GenerationEngine, ServingEngine,
+    load_param_snapshot,
 )
 from .metrics import LatencyHistogram, ServingStats  # noqa: F401
 from .server import Client, InferenceServer, ServingConfig  # noqa: F401
+from .supervise import LoopSupervisor  # noqa: F401

@@ -21,7 +21,8 @@ import time
 
 import numpy as np
 
-from ..resilience import CircuitBreaker, CircuitOpenError, maybe_fail
+from ..resilience import (CircuitBreaker, CircuitOpenError, WatchdogTimeout,
+                          maybe_fail, run_with_watchdog)
 
 
 class ServingError(RuntimeError):
@@ -43,6 +44,36 @@ class ServerOverloadedError(ServingError):
     """Admission refused: queue at depth limit or load-shed breaker open.
     Clients should back off (the wire server maps this to an
     ``etype: "Overloaded"`` reply)."""
+
+
+class ServerShutdownError(ServerOverloadedError):
+    """The server is draining or stopping: admission is closed, and
+    requests still queued at ``stop()`` are failed with this
+    immediately rather than left to ride out their own timeouts.
+    Subclasses :class:`ServerOverloadedError` so pre-existing overload
+    handlers (back off, try another replica) keep working; the wire
+    server maps it to ``etype: "Shutdown"``."""
+
+
+class RequestCancelledError(ServingError):
+    """The request was cancelled by its client (hedged-request loser:
+    the twin that lost the race is cancelled by request id so a hedged
+    pair never executes twice)."""
+
+
+class InternalServerError(ServingError):
+    """Client-side face of an ``etype: "Internal"`` (or unrecognized)
+    error reply: the server deliberately answered with a failure the
+    wire protocol does not map to a more specific class. Still a
+    ServingError — a caller catching the typed serving surface sees
+    every reply-borne failure."""
+
+
+class BadRequestError(ServingError):
+    """Client-side face of an ``etype: "BadRequest"`` reply: the server
+    validated the request and refused it (missing feeds, malformed
+    prompt). Distinguishable from server faults — retrying without
+    fixing the input will not help."""
 
 
 class Request:
@@ -138,6 +169,7 @@ class RequestQueue:
         self._items = []
         self._cv = threading.Condition()
         self._closed = False
+        self._draining = False
         self.stats = stats
         if breaker is None:
             from ..flags import flag
@@ -170,9 +202,12 @@ class RequestQueue:
             req.expire(where="admission")
             raise req.error
         with self._cv:
-            if self._closed:
+            if self._closed or self._draining:
                 self.breaker.release_probe()
-                raise ServerOverloadedError("server is shutting down")
+                raise ServerShutdownError(
+                    "server is draining — admission closed"
+                    if self._draining and not self._closed
+                    else "server is shutting down")
             if len(self._items) >= self.max_depth:
                 overloaded = True
             else:
@@ -193,6 +228,7 @@ class RequestQueue:
 
     def get(self, timeout=None):
         """Pop the oldest request, or None on timeout/close."""
+        maybe_fail("serving.queue")
         with self._cv:
             if not self._items:
                 self._cv.wait(timeout)
@@ -200,16 +236,25 @@ class RequestQueue:
                 return None
             return self._items.pop(0)
 
+    def quiesce(self):
+        """Stop admitting (``put`` raises :class:`ServerShutdownError`)
+        but keep everything already queued flowing to the batcher — the
+        drain() half of shutdown. Idempotent."""
+        with self._cv:
+            self._draining = True
+
     def close(self):
-        """Stop admitting; fail whatever is still queued."""
+        """Stop admitting; fail whatever is still queued IMMEDIATELY
+        with the typed shutdown error (a queued request must never be
+        left to ride out its own timeout against a dead server)."""
         with self._cv:
             self._closed = True
             drained = self._items[:]
             self._items.clear()
             self._cv.notify_all()
         for req in drained:
-            req.set_error(ServerOverloadedError("server shut down with "
-                                                "the request still queued"))
+            req.set_error(ServerShutdownError(
+                "server shut down with the request still queued"))
 
 
 class GenerationRequest(Request):
@@ -246,6 +291,41 @@ class GenerationRequest(Request):
         self.slot = None
 
 
+class SwapHandle:
+    """Future for a hot weight swap scheduled onto the decode loop
+    (:meth:`DecodeBatcher.request_swap`): ``wait()`` blocks until the
+    loop applied the swap between decode steps (or failed); carries the
+    measured admission pause in ``pause_ms``."""
+
+    def __init__(self, apply_fn):
+        self.apply_fn = apply_fn
+        self.requested_at = time.monotonic()
+        self.pause_ms = None
+        self.error = None
+        self._done = threading.Event()
+
+    def apply(self):
+        try:
+            self.apply_fn()
+            self.pause_ms = (time.monotonic() - self.requested_at) * 1e3
+        except Exception as exc:  # noqa: BLE001 — relayed to the waiter
+            self.error = exc
+        self._done.set()
+
+    def fail(self, exc):
+        self.error = exc
+        self._done.set()
+
+    def wait(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"weight swap not applied within {timeout}s (decode "
+                f"rows still draining)")
+        if self.error is not None:
+            raise self.error
+        return self.pause_ms
+
+
 class DecodeBatcher:
     """Continuous batching over a fixed bank of decode slots
     (ORCA-style iteration-level scheduling): one thread pulls
@@ -256,11 +336,15 @@ class DecodeBatcher:
     (position counter, current token, sampling config, done) lives
     here; the device-side slot caches live in the GenerationEngine."""
 
-    def __init__(self, queue, engine, stats=None):
+    def __init__(self, queue, engine, stats=None, watchdog_s=None):
+        if watchdog_s is None:
+            from ..flags import flag
+            watchdog_s = flag("serving_loop_watchdog_s")
         self.queue = queue
         self.engine = engine
         self.slots = engine.slots
         self.stats = stats
+        self.watchdog_s = float(watchdog_s)
         self._stop = threading.Event()
         self._thread = None
         self._free = list(range(self.slots))
@@ -269,13 +353,33 @@ class DecodeBatcher:
         self._pos = np.zeros((self.slots,), np.int32)
         self._temp = np.zeros((self.slots,), np.float32)
         self._topk = np.zeros((self.slots,), np.int32)
+        # supervision handles: the loop stamps `heartbeat` every
+        # iteration; `_epoch` deposes a hung thread on restart (the old
+        # loop notices the bump and exits without touching shared state)
+        self.heartbeat = time.monotonic()
+        self._epoch = 0
+        self.consecutive_failures = 0
+        self._swap = None                       # pending SwapHandle
+        self._swap_lock = threading.Lock()
+        self._admitting = 0     # popped from the queue, not yet in a slot
+        self._admitting_reqs = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
+        self.heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-decode-batcher")
         self._thread.start()
         return self
+
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def inflight(self):
+        """Rows being decoded PLUS requests mid-admission (popped from
+        the queue but not yet in a slot — prefill compile can hold them
+        there for seconds; drain() polls this to zero)."""
+        return len(self._active) + self._admitting
 
     def stop(self, timeout=5):
         self._stop.set()
@@ -291,9 +395,33 @@ class DecodeBatcher:
                 return
         for req in list(self._active.values()):
             if not req.done():
-                req.set_error(ServerOverloadedError(
+                req.set_error(ServerShutdownError(
                     "server stopped while the request was decoding"))
         self._active.clear()
+
+    def restart(self, reason="supervisor restart"):
+        """Replace a dead/hung loop thread: depose the old thread (epoch
+        bump), fail every in-flight row with a typed error, reset the
+        slot bank (row caches died with the old loop's state), start a
+        fresh loop. Called by the LoopSupervisor only."""
+        self._epoch += 1
+        err = ServingError(f"decode loop restarted ({reason}); the "
+                           f"request's decode state was lost")
+        for req in list(self._active.values()):
+            if not req.done():
+                req.set_error(err)
+                if self.stats:
+                    self.stats.bump("requests_failed")
+        self._active.clear()
+        self._free = list(range(self.slots))
+        self._admitting = 0
+        self.engine.reset()
+        with self._swap_lock:
+            sw, self._swap = self._swap, None
+        if sw is not None:
+            sw.fail(ServingError(f"weight swap abandoned: {reason}"))
+        self.consecutive_failures = 0
+        self.start()
 
     # -- row lifecycle ----------------------------------------------------
     def _finish(self, req, error=None):
@@ -363,10 +491,29 @@ class DecodeBatcher:
                     deadline_ms=req.deadline_ms, waited_ms=waited))
 
     # -- admission --------------------------------------------------------
-    def _admit(self):
-        take = []
+    def _admit(self, epoch=None):
+        try:
+            self._admit_inner(self._epoch if epoch is None else epoch)
+        except BaseException:
+            # a crash mid-collection (e.g. an injected queue fault on
+            # the SECOND pop) must not silently drop the requests
+            # already taken off the queue — _admit_inner parks them in
+            # _admitting_reqs until they reach a slot
+            for req in self._admitting_reqs:
+                if not req.done():
+                    req.set_error(ServingError(
+                        "decode loop crashed during admission"))
+                    if self.stats:
+                        self.stats.bump("requests_failed")
+            raise
+        finally:
+            self._admitting_reqs = []
+            self._admitting = 0
+
+    def _admit_inner(self, epoch):
+        take = self._admitting_reqs
         while self._free and len(take) < len(self._free) \
-                and not self._stop.is_set():
+                and not self._stop.is_set() and self._epoch == epoch:
             # block briefly only when the bank is idle and nothing was
             # taken yet; once rows are decoding, admission must not
             # stall the step loop
@@ -391,18 +538,44 @@ class DecodeBatcher:
                     self.stats.bump("requests_failed")
                 continue
             take.append(req)
+            self._admitting = len(take)
         if not take:
+            return
+        if self._epoch != epoch:
+            for req in take:
+                if not req.done():
+                    req.set_error(ServingError(
+                        "decode loop restarted during admission"))
+                    if self.stats:
+                        self.stats.bump("requests_failed")
             return
         slots = [self._free.pop() for _ in take]
         try:
             first = self.engine.admit(take, slots)
         except Exception as exc:  # noqa: BLE001 — must reach the clients
-            self._free.extend(slots)
             for req in take:
                 req.set_error(exc)
                 if self.stats:
                     self.stats.bump("requests_failed")
+            if self._epoch != epoch:
+                return       # deposed: _free/_active belong to the new
+            self._free.extend(slots)                       # loop thread
+            self.consecutive_failures += 1
+            if self.stats:
+                self.stats.bump("engine_failures")
             self._fail_active_if_bank_lost(exc)
+            return
+        if self._epoch != epoch:
+            # deposed while blocked in the prefill (it eventually
+            # returned): the restarted loop owns the slot bank — fail
+            # the taken requests instead of registering them
+            for req in take:
+                if not req.done():
+                    req.set_error(ServingError(
+                        "decode loop restarted during admission; the "
+                        "request's prefill was discarded"))
+                    if self.stats:
+                        self.stats.bump("requests_failed")
             return
         for tok, req, slot in zip(first, take, slots):
             if self.stats:
@@ -415,11 +588,60 @@ class DecodeBatcher:
             self._tok[slot] = tok
             self._deliver_token(req, int(tok))
 
+    # -- hot weight swap ---------------------------------------------------
+    def request_swap(self, apply_fn):
+        """Schedule ``apply_fn`` (the weight swap) onto the decode loop:
+        admission pauses (new requests stay QUEUED, not failed), the
+        in-flight rows finish their generations on the OLD weights, and
+        the swap applies atomically between decode steps once the bank
+        is empty. Returns a :class:`SwapHandle`. If the loop is not
+        running the swap applies inline (nothing is in flight). A swap
+        requested while another is still pending is failed immediately
+        (one reload at a time — the caller retries after the first)."""
+        handle = SwapHandle(apply_fn)
+        with self._swap_lock:
+            if self._swap is not None:
+                handle.fail(ServingError(
+                    "another weight swap is already pending — one "
+                    "reload at a time"))
+                return handle
+            parked = self.alive()
+            if parked:
+                self._swap = handle
+        if not parked:
+            handle.apply()
+            return handle
+        with self.queue._cv:
+            self.queue._cv.notify_all()
+        # the loop may have exited BETWEEN the liveness check and the
+        # store (its exit path only fails a swap it could see): reclaim
+        # the parked handle and apply inline — nothing is in flight
+        with self._swap_lock:
+            orphaned = not self.alive() and self._swap is handle
+            if orphaned:
+                self._swap = None
+        if orphaned:
+            handle.apply()
+        return handle
+
     # -- core loop --------------------------------------------------------
     def _loop(self):
+        epoch = self._epoch
         try:
-            while not self._stop.is_set():
-                self._admit()
+            while not self._stop.is_set() and self._epoch == epoch:
+                self.heartbeat = time.monotonic()
+                sw = self._swap
+                if sw is not None:
+                    # a pending swap stops admission so the bank drains;
+                    # in-flight rows keep decoding on the old weights
+                    if not self._active:
+                        sw.apply()
+                        with self._swap_lock:
+                            if self._swap is sw:
+                                self._swap = None
+                        continue
+                else:
+                    self._admit(epoch)
                 if not self._active:
                     continue
                 self._check_deadlines(time.monotonic())
@@ -427,11 +649,25 @@ class DecodeBatcher:
                     continue
                 try:
                     toks = self.engine.step(self._tok, self._pos,
-                                            self._temp, self._topk)
+                                            self._temp, self._topk,
+                                            budget=self.watchdog_s or None)
                 except Exception as exc:  # noqa: BLE001
+                    if self._epoch != epoch:
+                        return       # deposed mid-step: restart() owns
+                    self.consecutive_failures += 1      # the row state
+                    if self.stats:
+                        self.stats.bump("engine_failures")
+                        if isinstance(exc, WatchdogTimeout):
+                            self.stats.bump("watchdog_timeouts")
                     for req in list(self._active.values()):
                         self._finish(req, exc)
                     continue
+                if self._epoch != epoch:
+                    # deposed while blocked in the step (hung chip call
+                    # that eventually returned): the restarted loop owns
+                    # _active/_free now — do not touch them
+                    return
+                self.consecutive_failures = 0
                 live = len(self._active)
                 if self.stats:
                     self.stats.observe_decode_step(live, self.slots)
@@ -445,12 +681,23 @@ class DecodeBatcher:
                     self._deliver_token(req, int(toks[slot]))
         finally:
             # rows still mid-generation when the loop exits (stop() or
-            # a crash) must fail fast, not leave their clients waiting
-            for req in list(self._active.values()):
-                if not req.done():
-                    req.set_error(ServerOverloadedError(
-                        "server stopped while the request was decoding"))
-            self._active.clear()
+            # a crash) must fail fast, not leave their clients waiting.
+            # A DEPOSED thread (epoch moved on: restart() owns the row
+            # state now) must not touch anything.
+            if self._epoch == epoch:
+                self._admitting = 0
+                for req in list(self._active.values()):
+                    if not req.done():
+                        req.set_error(ServerShutdownError(
+                            "server stopped while the request was "
+                            "decoding"))
+                self._active.clear()
+                with self._swap_lock:
+                    sw, self._swap = self._swap, None
+                if sw is not None:
+                    sw.fail(ServerShutdownError(
+                        "decode loop exited with the weight swap "
+                        "pending"))
 
 
 def next_bucket(rows, min_bucket=1):
@@ -473,7 +720,7 @@ class MicroBatcher:
     connection threads)."""
 
     def __init__(self, queue, execute_fn, max_batch_size=None,
-                 batch_timeout_ms=None, stats=None):
+                 batch_timeout_ms=None, stats=None, watchdog_s=None):
         from ..flags import flag
         self.queue = queue
         self.execute_fn = execute_fn
@@ -483,17 +730,34 @@ class MicroBatcher:
         timeout_ms = (batch_timeout_ms if batch_timeout_ms is not None
                       else flag("serving_batch_timeout_ms"))
         self.batch_timeout_s = float(timeout_ms) / 1e3
+        self.watchdog_s = float(watchdog_s if watchdog_s is not None
+                                else flag("serving_loop_watchdog_s"))
         self.stats = stats
         self._stop = threading.Event()
         self._thread = None
         self._pending = {}   # sig -> {"reqs": [...], "rows": n, "flush_at": t}
+        self.heartbeat = time.monotonic()
+        self._epoch = 0
+        self._executing = 0           # requests inside execute_fn right now
+        self._ingesting = 0           # popped, not yet in _pending
+        self.consecutive_failures = 0
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
+        self.heartbeat = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-microbatcher")
         self._thread.start()
         return self
+
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def inflight(self):
+        """Requests forming a batch, mid-ingest, or inside the engine
+        right now (drain() polls this to zero)."""
+        return (sum(len(ent["reqs"]) for ent in self._pending.values())
+                + self._executing + self._ingesting)
 
     def stop(self, timeout=5):
         self._stop.set()
@@ -512,9 +776,26 @@ class MicroBatcher:
         for ent in self._pending.values():
             for req in ent["reqs"]:
                 if not req.done():
-                    req.set_error(ServerOverloadedError(
+                    req.set_error(ServerShutdownError(
                         "server stopped while the request was batching"))
         self._pending.clear()
+
+    def restart(self, reason="supervisor restart"):
+        """Replace a dead/hung loop thread: depose the old thread (epoch
+        bump), fail the batches it was forming with a typed error, start
+        a fresh loop. Called by the LoopSupervisor only."""
+        self._epoch += 1
+        err = ServingError(f"batcher loop restarted ({reason}); the "
+                           f"request was failed mid-batch")
+        for ent in self._pending.values():
+            for req in ent["reqs"]:
+                if not req.done():
+                    req.set_error(err)
+                    if self.stats:
+                        self.stats.bump("requests_failed")
+        self._pending = {}
+        self.consecutive_failures = 0
+        self.start()
 
     # -- core loop --------------------------------------------------------
     def _admit_to_batch(self, req, now):
@@ -559,38 +840,95 @@ class MicroBatcher:
                 live.append(req)
         if not live:
             return
+        self._executing = len(live)
         try:
-            self.execute_fn(live)
+            # the watchdog bounds a hung chip call (or a wedged
+            # first-shape compile): the batch's clients get a typed
+            # WatchdogTimeout instead of hanging, and the loop survives
+            # to serve the next batch
+            if self.watchdog_s > 0:
+                run_with_watchdog(self.execute_fn, self.watchdog_s, live,
+                                  what="serving execute")
+            else:
+                self.execute_fn(live)
+            self.consecutive_failures = 0
         except Exception as exc:  # noqa: BLE001 — must reach the clients
+            self.consecutive_failures += 1
+            if self.stats:
+                self.stats.bump("engine_failures")
+                if isinstance(exc, WatchdogTimeout):
+                    self.stats.bump("watchdog_timeouts")
             for req in live:
                 if not req.done():
                     req.set_error(exc)
             if self.stats:
                 self.stats.bump("requests_failed", len(live))
+        finally:
+            self._executing = 0
 
     def _loop(self):
-        while not self._stop.is_set():
-            now = time.monotonic()
-            if self._pending:
-                wake = min(ent["flush_at"]
-                           for ent in self._pending.values())
-                timeout = max(min(wake - now, 0.1), 0.0)
-            else:
-                timeout = 0.1
-            req = self.queue.get(timeout=timeout)
-            if req is not None:
-                self._admit_to_batch(req, time.monotonic())
-                # drain whatever is already queued before sleeping again:
-                # a burst coalesces instead of going request-by-request
-                # (full groups flush inside _admit_to_batch as they
-                # fill). Timed-out groups are checked INSIDE the drain —
-                # sustained arrivals must not starve a rare signature's
-                # batch_timeout_ms while the hot signature churns.
-                while not self._stop.is_set():
-                    nxt = self.queue.get(timeout=0)
-                    if nxt is None:
-                        break
-                    now = time.monotonic()
-                    self._admit_to_batch(nxt, now)
-                    self._flush_ready(now)
-            self._flush_ready(time.monotonic())
+        epoch = self._epoch
+        try:
+            while not self._stop.is_set() and self._epoch == epoch:
+                self.heartbeat = time.monotonic()
+                now = time.monotonic()
+                if self._pending:
+                    wake = min(ent["flush_at"]
+                               for ent in self._pending.values())
+                    timeout = max(min(wake - now, 0.1), 0.0)
+                else:
+                    timeout = 0.1
+                req = self.queue.get(timeout=timeout)
+                if self._epoch != epoch:
+                    # deposed while blocked (hung execute that finally
+                    # returned, or a get that raced a restart): the new
+                    # loop owns _pending — fail the popped request
+                    # instead of batching it into someone else's state
+                    if req is not None and not req.done():
+                        req.set_error(ServingError(
+                            "batcher loop restarted; the request was "
+                            "failed mid-ingest"))
+                        if self.stats:
+                            self.stats.bump("requests_failed")
+                    return
+                if req is not None:
+                    self._ingesting = 1
+                    self._admit_to_batch(req, time.monotonic())
+                    # drain whatever is already queued before sleeping
+                    # again: a burst coalesces instead of going
+                    # request-by-request (full groups flush inside
+                    # _admit_to_batch as they fill). Timed-out groups are
+                    # checked INSIDE the drain — sustained arrivals must
+                    # not starve a rare signature's batch_timeout_ms
+                    # while the hot signature churns. The heartbeat is
+                    # stamped HERE too: sustained load keeps the thread
+                    # in this inner loop, and a fresh heartbeat is what
+                    # tells the supervisor busy != hung.
+                    while not self._stop.is_set() \
+                            and self._epoch == epoch:
+                        self.heartbeat = time.monotonic()
+                        nxt = self.queue.get(timeout=0)
+                        if nxt is None:
+                            break
+                        now = time.monotonic()
+                        self._admit_to_batch(nxt, now)
+                        self._flush_ready(now)
+                    self._ingesting = 0
+                if self._epoch != epoch:
+                    return
+                self._flush_ready(time.monotonic())
+        finally:
+            self._ingesting = 0
+            # batches still forming when the loop exits (stop() or a
+            # crash) fail fast — mirrors the decode loop's exit fix. A
+            # deposed thread (restart() bumped the epoch and owns
+            # _pending now) must not touch anything.
+            if self._epoch == epoch and (self._stop.is_set()
+                                         or self._pending):
+                for ent in self._pending.values():
+                    for r in ent["reqs"]:
+                        if not r.done():
+                            r.set_error(ServerShutdownError(
+                                "server stopped while the request was "
+                                "batching"))
+                self._pending = {}

@@ -9,6 +9,7 @@ the serving ``ExecutableCache`` — byte/entry-capped, counted, recordable
 device-resident and shared by every executable; feeds are the only
 per-call traffic.
 """
+import json
 import os
 import time
 
@@ -16,9 +17,69 @@ import numpy as np
 
 from .batching import next_bucket
 from .cache import ExecutableCache, feed_signature
-from ..resilience import maybe_fail
+from ..resilience import (CheckpointCorruptError, maybe_fail,
+                          run_with_watchdog)
 
 SIGNATURE_FILE = "_serving_signatures.json"
+
+
+def load_param_snapshot(dirname, current):
+    """Load + integrity-check new values for ``current``'s parameters
+    from a ``save_params``-layout checkpoint dir (per-var ``.npy`` files
+    + ``_manifest.json``) — the hot-weight-reload loader.
+
+    Every file is verified against the manifest BEFORE anything is
+    returned (CheckFreq-style atomic swap discipline: a corrupt or
+    incomplete checkpoint raises :class:`CheckpointCorruptError` and the
+    serving snapshot is never touched), and each array must match the
+    live parameter's shape and dtype. Returns {name: host ndarray}.
+    """
+    from .. import io as fluid_io
+    manifest = fluid_io._read_manifest(dirname)
+    if manifest is None:
+        raise CheckpointCorruptError(
+            f"checkpoint dir {dirname!r} has no _manifest.json — "
+            f"reload_weights only trusts manifest-verified checkpoints "
+            f"(save with io.save_params / save_persistables)",
+            path=dirname)
+    meta = {"vars": {}}
+    meta_path = os.path.join(dirname, fluid_io._META_FILE)
+    if os.path.exists(meta_path):
+        fluid_io._verify_against_manifest(dirname, fluid_io._META_FILE,
+                                          manifest)
+        with open(meta_path) as f:
+            meta = json.load(f)
+    out, missing = {}, []
+    for name, cur in current.items():
+        rel = fluid_io._escape(name) + ".npy"
+        path = os.path.join(dirname, rel)
+        if not os.path.exists(path):
+            missing.append(name)
+            continue
+        fluid_io._verify_against_manifest(dirname, rel, manifest)
+        try:
+            arr = np.load(path, allow_pickle=False)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint file {rel!r} in {dirname!r} is unreadable: "
+                f"{type(e).__name__}: {e}", path=path)
+        tag = meta["vars"].get(name, {}).get("dtype", str(arr.dtype))
+        arr = fluid_io._restore(arr, tag)
+        cur_np = cur if hasattr(cur, "shape") else np.asarray(cur)
+        if tuple(arr.shape) != tuple(cur_np.shape) \
+                or str(arr.dtype) != str(np.dtype(cur_np.dtype)):
+            raise ValueError(
+                f"checkpoint param {name!r} is {arr.shape}/{arr.dtype}, "
+                f"the serving snapshot holds "
+                f"{tuple(cur_np.shape)}/{np.dtype(cur_np.dtype)} — "
+                f"reload_weights only swaps like-for-like weights")
+        out[name] = arr
+    if missing:
+        raise CheckpointCorruptError(
+            f"checkpoint at {dirname!r} is missing {len(missing)} "
+            f"serving parameter(s): {', '.join(sorted(missing))} — "
+            f"the old snapshot was left untouched", path=dirname)
+    return out
 
 
 class ServingEngine:
@@ -101,6 +162,7 @@ class ServingEngine:
     def _compile(self, feed):
         """AOT-compile the module for this feed signature and cache it."""
         from .. import profiler as _prof
+        maybe_fail("serving.compile")
         t0 = time.perf_counter()
         with _prof.record_event("serving/compile_inner"):
             lowered = self._infer.lower(self._state, feed)
@@ -139,13 +201,35 @@ class ServingEngine:
             compiled = self._compile(feed)
         return compiled
 
+    # -- hot weight reload ------------------------------------------------
+    def load_state_snapshot(self, dirname):
+        """Verify + load a new device snapshot of every model state var
+        from a manifest-carrying checkpoint dir. Raises
+        CheckpointCorruptError / ValueError without touching the live
+        snapshot; the result is ready for :meth:`swap_state`."""
+        import jax
+        host = load_param_snapshot(dirname, self._state)
+        return {n: jax.device_put(a) for n, a in host.items()}
+
+    def swap_state(self, new_state):
+        """Atomically swap the device param snapshot between
+        micro-batches: ``execute``/``run`` capture ``self._state`` once
+        at entry, so an in-flight batch finishes on the old weights and
+        every later batch reads the new ones."""
+        missing = [n for n in self._state if n not in new_state]
+        if missing:
+            raise ValueError(f"swap_state snapshot is missing state "
+                             f"vars: {sorted(missing)}")
+        self._state = {n: new_state[n] for n in self._state}
+
     # -- single-shot ------------------------------------------------------
     def run(self, feeds):
         """Run one feed dict as-is (no cross-request batching, still
         cached): returns the fetch list as numpy arrays."""
+        state = self._state          # one snapshot for the whole call
         feed = {n: np.ascontiguousarray(feeds[n]) for n in self.feed_names}
         compiled = self._executable_for(feed)
-        outs = compiled(self._state, feed)
+        outs = compiled(state, feed)
         return [np.asarray(o) for o in outs]
 
     # -- batched path (MicroBatcher flush target) -------------------------
@@ -155,7 +239,8 @@ class ServingEngine:
         single bad request (the batch-level failure path is handled by
         the MicroBatcher)."""
         maybe_fail("serving.execute")
-        now = time.monotonic()
+        state = self._state          # one snapshot for the whole batch:
+        now = time.monotonic()       # a reload swaps BETWEEN batches
         live = [r for r in requests if not r.done()]
         if not live:
             return
@@ -194,7 +279,7 @@ class ServingEngine:
 
         compiled = self._executable_for(feed)
         t_exec0 = time.perf_counter()
-        outs = compiled(self._state, feed)
+        outs = compiled(state, feed)
         outs = [np.asarray(o) for o in outs]
         t_exec = time.perf_counter() - t_exec0
         if self.stats:
@@ -352,6 +437,7 @@ class GenerationEngine:
         universe)."""
         import jax
         import jax.numpy as jnp
+        maybe_fail("serving.slot_insert")
         if self._insert_fn is None:
             def ins(dst, src, idx):
                 return {name: dst[name].at[idx].set(src[name][:idx.shape[0]])
@@ -372,10 +458,42 @@ class GenerationEngine:
         self._caches = None
         self.bank_lost = True
 
+    def reset(self):
+        """Forget the slot bank without flagging a loss — the restart
+        path: a replaced decode loop starts from an empty bank (its rows
+        were already failed by the supervisor), so the stale caches are
+        garbage, not state."""
+        self._caches = None
+        self.bank_lost = False
+
+    # -- hot weight reload ------------------------------------------------
+    def load_param_snapshot(self, dirname):
+        """Verify + load new HOST values for every generator parameter
+        (building the parameter-bearing programs first if no traffic
+        has). Raises without touching the live snapshot."""
+        for kind in ("prefill", "decode", "logits"):
+            self.gen._ensure_fn(kind)
+        return load_param_snapshot(dirname, self.gen._params)
+
+    def stage_params(self, host_params):
+        """Device-put the verified host arrays — run OFF the decode loop
+        so the swap itself (apply_params) is a dict rebind, not a
+        transfer."""
+        import jax
+        return {n: jax.device_put(a) for n, a in host_params.items()}
+
+    def apply_params(self, device_params):
+        """The atomic swap half: rebind the generator's parameter
+        snapshot. Scheduled between decode steps via
+        DecodeBatcher.request_swap so in-flight generations finish on
+        the old weights."""
+        self.gen.swap_params(device_params)
+
     def admit(self, requests, slot_ids):
         """Prefill the new requests' prompts (one bucketed batch), sample
         their first tokens, write their caches into ``slot_ids``.
         Returns the first tokens as np int32 [len(requests)]."""
+        maybe_fail("serving.prefill")
         self._ensure_caches()
         n = len(requests)
         tokens, pos_ids, last = self.gen._pack_prompts(
@@ -394,20 +512,37 @@ class GenerationEngine:
         self._insert(row_caches, list(slot_ids))
         return np.asarray(toks)[:n]
 
-    def step(self, tokens, pos, temperature, top_k):
+    def step(self, tokens, pos, temperature, top_k, budget=None):
         """One decode + sample over the whole slot bank. ``tokens``/
         ``pos``/``temperature``/``top_k`` are np arrays of length
         ``slots`` (free slots carry harmless stale values — their rows
-        are never read). Returns sampled np int32 tokens [slots]."""
+        are never read). Returns sampled np int32 tokens [slots].
+
+        ``budget`` (seconds) runs the decode call under
+        ``resilience.run_with_watchdog``: a hung chip call raises
+        WatchdogTimeout instead of wedging the decode loop. The worker
+        only COMPUTES — state (caches, RNG key) is assigned on this
+        thread after it returns, so an abandoned overbudget worker can
+        never resurrect a bank this thread already dropped."""
+        maybe_fail("serving.decode_step")
         self._ensure_caches()
+        tok = np.ascontiguousarray(tokens, dtype=np.int32)
+        posc = np.ascontiguousarray(pos, dtype=np.int32)
+        caches, key = self._caches, self._key
+
+        def _decode():
+            return self.gen._run_decode(tok, posc, caches, key)
+
         try:
-            logits, self._caches, self._key = self.gen._run_decode(
-                np.ascontiguousarray(tokens, dtype=np.int32),
-                np.ascontiguousarray(pos, dtype=np.int32),
-                self._caches, self._key)
+            if budget:
+                logits, new_caches, new_key = run_with_watchdog(
+                    _decode, budget, what="serving decode step")
+            else:
+                logits, new_caches, new_key = _decode()
         except Exception:
             self._drop_bank()      # caches were donated into the call
             raise
+        self._caches, self._key = new_caches, new_key
         toks, self._key = self.gen._run_sample(
             logits, np.ascontiguousarray(temperature, dtype=np.float32),
             np.ascontiguousarray(top_k, dtype=np.int32), self._key)

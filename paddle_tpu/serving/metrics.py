@@ -116,6 +116,13 @@ class ServingStats:
             "decode_steps": 0,
             "decode_rows": 0,       # live generation rows stepped
             "decode_slot_rows": 0,  # slot capacity across steps
+            # -- resilience layer --
+            "engine_failures": 0,     # failed execute / decode steps
+            "watchdog_timeouts": 0,   # executes killed by the watchdog
+            "loop_restarts": 0,       # supervisor-restarted loop threads
+            "weight_reloads": 0,      # successful reload_weights swaps
+            "hedge_dedup_hits": 0,    # hedged twins joined in flight
+            "requests_cancelled": 0,  # cancel op (hedge losers)
         }
 
     def bump(self, name, n=1):

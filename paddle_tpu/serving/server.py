@@ -13,10 +13,14 @@ Wire protocol (all values inside the typed wire universe):
               "deadline_ms": float|None}
     reply    {"ok": True, "fetch": (ndarray, ...), "batched": int}
            | {"ok": False, "etype": "DeadlineExceeded"|"Overloaded"
+                                    |"Shutdown"|"Cancelled"|"Watchdog"
                                     |"BadRequest"|"Internal",
               "error": str}
     request  {"op": "stats"}   -> {"ok": True, "stats": {...}}
     request  {"op": "ping"}    -> {"ok": True}
+    request  {"op": "health"}  -> {"ok": True, "health": {state, queue
+                                   depths, loop liveness, weights_version}}
+    request  {"op": "cancel", "rid": str} -> {"ok": True, "cancelled": bool}
 
 Deadline semantics: ``deadline_ms`` is a budget measured from ADMISSION
 at the server (transit time is the client's problem; clocks never need
@@ -24,19 +28,35 @@ agreement). It is checked at admission, when the batch forms, and the
 expiry reply carries how long the request actually waited. A request
 that expires mid-execution still completes and returns its result — the
 chip's work is never thrown away.
+
+Resilience layer: the server walks a lifecycle state machine (warming ->
+serving -> draining -> stopped, plus degraded while the loop supervisor's
+breaker is open), ``drain()`` is the graceful half of shutdown (stop
+admission, let in-flight work finish, then stop), ``reload_weights()``
+swaps a manifest-verified checkpoint in without dropping traffic, and
+``infer``/``generate`` requests may carry a client ``rid`` — a hedged
+pair (Dean & Barroso, "The Tail at Scale") dedups onto ONE in-flight
+execution and the loser is cancelled by rid.
 """
 import socket
 import threading
+import time
+import uuid
+from collections import OrderedDict, deque
 
 import numpy as np
 
-from .batching import (DeadlineExceededError, DecodeBatcher,
-                       GenerationRequest, MicroBatcher, Request,
-                       RequestQueue, ServerOverloadedError)
+from .batching import (BadRequestError, DeadlineExceededError,
+                       DecodeBatcher, GenerationRequest,
+                       InternalServerError, MicroBatcher, Request,
+                       RequestCancelledError, RequestQueue,
+                       ServerOverloadedError, ServerShutdownError)
 from .engine import GenerationEngine, ServingEngine
 from .metrics import ServingStats
+from .supervise import LoopSupervisor
 from ..distributed.wire import (WireError, default_key, recv_frame,
                                 send_frame)
+from ..resilience import WatchdogTimeout, retry_call
 
 
 class ServingConfig:
@@ -53,6 +73,7 @@ class ServingConfig:
         "cache_bytes": "serving_cache_bytes",
         "shed_failures": "serving_shed_failures",
         "shed_reset_secs": "serving_shed_reset_secs",
+        "loop_watchdog_s": "serving_loop_watchdog_s",
     }
 
     def __init__(self, **overrides):
@@ -103,7 +124,8 @@ class InferenceServer:
                 self.queue, self.engine.execute,
                 max_batch_size=self.config.max_batch_size,
                 batch_timeout_ms=self.config.batch_timeout_ms,
-                stats=self.stats_sink)
+                stats=self.stats_sink,
+                watchdog_s=self.config.loop_watchdog_s)
         # generation endpoint: a models.generation.GPTGenerator turns
         # the server into a token service — requests join a fixed bank
         # of decode slots (continuous batching, slot reuse on finish)
@@ -115,7 +137,22 @@ class InferenceServer:
             self.gen_queue = RequestQueue(
                 max_depth=self.config.queue_depth, stats=self.stats_sink)
             self.decode_batcher = DecodeBatcher(
-                self.gen_queue, self.gen_engine, stats=self.stats_sink)
+                self.gen_queue, self.gen_engine, stats=self.stats_sink,
+                watchdog_s=self.config.loop_watchdog_s)
+        # supervision: dead/hung loop threads are restarted with backoff;
+        # repeated restarts open the breaker -> DEGRADED state (generate
+        # sheds, ping/health/stats keep answering)
+        self.supervisor = LoopSupervisor(
+            stats=self.stats_sink,
+            watchdog_s=self.config.loop_watchdog_s,
+            on_degraded=lambda: self._set_state("degraded",
+                                               only_from=("serving",)),
+            on_recovered=lambda: self._set_state("serving",
+                                                 only_from=("degraded",)))
+        if self.batcher is not None:
+            self.supervisor.add("microbatcher", self.batcher)
+        if self.decode_batcher is not None:
+            self.supervisor.add("decode", self.decode_batcher)
         self.host = host
         self.port = int(port)
         self._key = auth_key if auth_key is not None else default_key()
@@ -125,17 +162,45 @@ class InferenceServer:
         self._threads = []
         self._conns = set()
         self._conns_lock = threading.Lock()
+        self._started_at = time.monotonic()
+        self._state_lock = threading.Lock()
+        self._lifecycle = "created"
+        self._weights_version = 1
+        # request-id dedup (hedged pairs attach to ONE in-flight
+        # execution); LRU-capped like the PS push-dedup table
+        self._rids = OrderedDict()
+        self._rids_lock = threading.Lock()
+        self._rid_cap = 2048
 
     # -- lifecycle --------------------------------------------------------
     @property
     def endpoint(self):
         return f"{self.host}:{self.port}"
 
+    @property
+    def state(self):
+        """Lifecycle state: created -> warming -> serving -> draining ->
+        stopped, with serving <-> degraded while the supervisor breaker
+        is open."""
+        with self._state_lock:
+            return self._lifecycle
+
+    def _set_state(self, new, only_from=None):
+        with self._state_lock:
+            if self._lifecycle == "stopped":      # terminal
+                return False
+            if only_from is not None \
+                    and self._lifecycle not in only_from:
+                return False
+            self._lifecycle = new
+            return True
+
     def start(self, serve_network=True, warmup_batch_sizes=None,
               warmup_signature_file=None):
         """Start the batcher (always) and the socket front-end (unless
         ``serve_network=False`` for purely in-process serving). Optional
         warmup precompiles before the first byte of traffic."""
+        self._set_state("warming")
         if (warmup_batch_sizes or warmup_signature_file) \
                 and self.engine is not None:
             self.engine.warmup(batch_sizes=warmup_batch_sizes or (),
@@ -144,6 +209,7 @@ class InferenceServer:
             self.batcher.start()
         if self.decode_batcher is not None:
             self.decode_batcher.start()
+        self.supervisor.start()
         if serve_network:
             loopback = (self.host.startswith("127.")
                         or self.host in ("localhost", "::1"))
@@ -163,9 +229,54 @@ class InferenceServer:
                                  name="serving-accept")
             t.start()
             self._threads.append(t)
+        self._set_state("serving", only_from=("warming", "created"))
         return self
 
+    def drain(self, timeout=30.0):
+        """Graceful shutdown: stop ADMISSION (new requests are refused
+        with the typed ``ServerShutdownError``), let every in-flight
+        micro-batch and decode row finish — token-level deadlines stay
+        enforced, so the wait is bounded — then ``stop()``. ``ping``/
+        ``stats``/``health`` keep answering throughout. Returns
+        ``{"drained": bool, "remaining": n}`` (``remaining`` counts the
+        requests abandoned to the hard stop when ``timeout`` ran out)."""
+        self._set_state("draining")
+        for q in (self.queue, self.gen_queue):
+            if q is not None:
+                q.quiesce()
+
+        def _inflight():
+            n = 0
+            if self.queue is not None:
+                n += len(self.queue)
+            if self.batcher is not None:
+                n += self.batcher.inflight()
+            if self.gen_queue is not None:
+                n += len(self.gen_queue)
+            if self.decode_batcher is not None:
+                n += self.decode_batcher.inflight()
+            return n
+
+        deadline = time.monotonic() + float(timeout)
+        zero_streak = 0
+        while time.monotonic() < deadline:
+            if _inflight() == 0:
+                # require consecutive zero reads: a request can sit
+                # BETWEEN the queue and the batcher's pending dict for
+                # an instant (popped, not yet admitted to a batch)
+                zero_streak += 1
+                if zero_streak >= 3:
+                    break
+            else:
+                zero_streak = 0
+            time.sleep(0.005)
+        remaining = _inflight()
+        self.stop()
+        return {"drained": remaining == 0, "remaining": remaining}
+
     def stop(self):
+        self._set_state("stopped")
+        self.supervisor.stop()
         self._stop.set()
         if self._sock is not None:
             try:
@@ -232,6 +343,13 @@ class InferenceServer:
         if self.gen_queue is None:
             raise ValueError("no generator loaded — pass generator= to "
                              "InferenceServer to serve 'generate'")
+        if self.state == "degraded":
+            if self.stats_sink:
+                self.stats_sink.bump("shed_overload")
+            raise ServerOverloadedError(
+                "server is degraded (supervisor breaker open after "
+                "repeated loop failures) — generation is shed; "
+                "ping/health/stats still answer")
         return self.gen_queue.put(GenerationRequest(
             tokens, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, eos_id=eos_id,
@@ -262,7 +380,70 @@ class InferenceServer:
             extra["decode_free_slots"] = len(self.decode_batcher._free)
             for k, v in self.gen_engine.gen.cache.stats().items():
                 extra[f"decode_cache_{k}"] = v
+        extra["state"] = self.state
+        extra["weights_version"] = self._weights_version
         return self.stats_sink.snapshot(extra=extra)
+
+    def health(self):
+        """Liveness/readiness snapshot, cheap enough for a poller: the
+        lifecycle state, queue depths, per-loop thread liveness +
+        heartbeat age + restart counts, the supervisor breaker, and the
+        current weights version."""
+        h = {
+            "state": self.state,
+            "weights_version": self._weights_version,
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "loops": self.supervisor.snapshot(),
+            "breaker": self.supervisor.breaker.state,
+        }
+        if self.queue is not None:
+            h["queue_depth"] = len(self.queue)
+        if self.gen_queue is not None:
+            h["decode_queue_depth"] = len(self.gen_queue)
+            h["decode_active_rows"] = self.decode_batcher.inflight()
+        return h
+
+    def reload_weights(self, path, timeout=120.0):
+        """Hot weight reload (CheckFreq-style atomic swap, zero dropped
+        traffic): verify + load a manifest-carrying checkpoint dir,
+        build the new DEVICE snapshot off the serving loops, then swap —
+        the infer engine swaps atomically between micro-batches, and the
+        decode bank pauses ADMISSION (requests queue, nothing is failed)
+        while in-flight generations FINISH ON THE OLD WEIGHTS, applying
+        the swap between decode steps once the bank is empty.
+
+        A corrupt/incomplete checkpoint raises
+        ``CheckpointCorruptError`` (or ``ValueError`` on a shape/dtype
+        mismatch) with the old snapshot untouched. Returns
+        ``{"weights_version", "swap_pause_ms"}``."""
+        if self.state == "stopped":
+            raise ServerShutdownError("cannot reload weights on a "
+                                      "stopped server")
+        # load + verify EVERYTHING first: a failure in either engine's
+        # checkpoint must leave both snapshots untouched
+        new_state = staged = None
+        if self.engine is not None:
+            new_state = self.engine.load_state_snapshot(path)
+        if self.gen_engine is not None:
+            host = self.gen_engine.load_param_snapshot(path)
+            staged = self.gen_engine.stage_params(host)
+        pause_ms = 0.0
+        if new_state is not None:
+            self.engine.swap_state(new_state)
+        if staged is not None:
+            if self.decode_batcher is not None \
+                    and self.decode_batcher.alive():
+                handle = self.decode_batcher.request_swap(
+                    lambda: self.gen_engine.apply_params(staged))
+                pause_ms = handle.wait(timeout)
+            else:
+                self.gen_engine.apply_params(staged)
+        with self._state_lock:
+            self._weights_version += 1
+            version = self._weights_version
+        self.stats_sink.bump("weight_reloads")
+        return {"weights_version": version,
+                "swap_pause_ms": round(float(pause_ms or 0.0), 3)}
 
     def record_signatures(self, path=None):
         if self.engine is None:
@@ -301,7 +482,16 @@ class InferenceServer:
                     # unauthenticated/malformed frame: drop the
                     # connection (same policy as the PS server)
                     return
-                reply = self._handle(msg)
+                try:
+                    # chaos point: a stalled/killed connection handler
+                    # (the hedged-client scenario — the request made it
+                    # onto the wire but its reply never comes)
+                    from ..resilience import maybe_fail
+                    maybe_fail("serving.handle")
+                except Exception as e:  # noqa: BLE001 — typed reply
+                    reply = _error_reply(e)
+                else:
+                    reply = self._handle(msg)
                 try:
                     send_frame(conn, reply, self._key)
                 except (ConnectionError, OSError):
@@ -314,6 +504,25 @@ class InferenceServer:
             except OSError:
                 pass
 
+    def _dedup(self, rid, admit):
+        """Request-id dedup: the second half of a hedged pair ATTACHES
+        to the first's in-flight request instead of admitting a second
+        execution. ``admit`` runs under the table lock (it is the O(1)
+        non-blocking queue put), so racing twins cannot double-admit.
+        Returns ``(request, joined)``."""
+        if not rid:
+            return admit(), False
+        with self._rids_lock:
+            req = self._rids.get(rid)
+            if req is not None:
+                self._rids.move_to_end(rid)
+                return req, True
+            req = admit()
+            self._rids[rid] = req
+            while len(self._rids) > self._rid_cap:
+                self._rids.popitem(last=False)
+            return req, False
+
     def _handle(self, msg):
         if not isinstance(msg, dict) or "op" not in msg:
             return {"ok": False, "etype": "BadRequest",
@@ -323,6 +532,10 @@ class InferenceServer:
             return {"ok": True}
         if op == "stats":
             return {"ok": True, "stats": self.stats()}
+        if op == "health":
+            return {"ok": True, "health": self.health()}
+        if op == "cancel":
+            return self._handle_cancel(msg)
         if op == "generate":
             return self._handle_generate(msg)
         if op != "infer":
@@ -342,14 +555,14 @@ class InferenceServer:
                 raise ValueError(f"missing feeds: {missing}")
             feed = {n: np.asarray(feed[n])
                     for n in self.engine.feed_names}
-            req = self.submit(feed, deadline_ms=msg.get("deadline_ms"))
-        except ServerOverloadedError as e:
-            return {"ok": False, "etype": "Overloaded", "error": str(e)}
-        except DeadlineExceededError as e:
-            return {"ok": False, "etype": "DeadlineExceeded",
-                    "error": str(e)}
-        except (ValueError, TypeError) as e:
-            return {"ok": False, "etype": "BadRequest", "error": str(e)}
+            req, joined = self._dedup(
+                msg.get("rid"),
+                lambda: self.submit(feed,
+                                    deadline_ms=msg.get("deadline_ms")))
+            if joined and self.stats_sink:
+                self.stats_sink.bump("hedge_dedup_hits")
+        except Exception as e:  # noqa: BLE001 — typed refusal reply
+            return _error_reply(e)
         # bound the wait: the deadline (if any) plus compile/execute
         # headroom, else a hard server-side cap
         budget = msg.get("deadline_ms")
@@ -358,14 +571,27 @@ class InferenceServer:
             outs = req.wait(timeout=wait_s)
             return {"ok": True, "fetch": tuple(outs),
                     "batched": int(req.rows)}
-        except DeadlineExceededError as e:
-            return {"ok": False, "etype": "DeadlineExceeded",
-                    "error": str(e)}
-        except ServerOverloadedError as e:
-            return {"ok": False, "etype": "Overloaded", "error": str(e)}
         except Exception as e:  # noqa: BLE001 — surface, don't die
-            return {"ok": False, "etype": "Internal",
-                    "error": f"{type(e).__name__}: {e}"}
+            return _error_reply(e)
+
+    def _handle_cancel(self, msg):
+        """Cancel a request by client request id (the hedge loser): a
+        still-in-flight request is failed with the typed cancellation
+        error (the batchers skip done requests), a finished one is left
+        alone."""
+        rid = msg.get("rid")
+        req = None
+        if rid:
+            with self._rids_lock:
+                req = self._rids.get(rid)
+        cancelled = False
+        if req is not None and not req.done():
+            req.set_error(RequestCancelledError(
+                f"cancelled by the client (request id {rid})"))
+            cancelled = True
+            if self.stats_sink:
+                self.stats_sink.bump("requests_cancelled")
+        return {"ok": True, "cancelled": cancelled}
 
     def _handle_generate(self, msg):
         if self.gen_queue is None:
@@ -376,20 +602,19 @@ class InferenceServer:
             tokens = msg.get("tokens")
             if tokens is None:
                 raise ValueError("'tokens' (1-D int prompt) is required")
-            req = self.submit_generate(
-                np.asarray(tokens),
-                max_new_tokens=int(msg.get("max_new_tokens", 32)),
-                temperature=float(msg.get("temperature", 0.0)),
-                top_k=int(msg.get("top_k", 0)),
-                eos_id=msg.get("eos_id"),
-                deadline_ms=msg.get("deadline_ms"))
-        except ServerOverloadedError as e:
-            return {"ok": False, "etype": "Overloaded", "error": str(e)}
-        except DeadlineExceededError as e:
-            return {"ok": False, "etype": "DeadlineExceeded",
-                    "error": str(e)}
-        except (ValueError, TypeError) as e:
-            return {"ok": False, "etype": "BadRequest", "error": str(e)}
+            req, joined = self._dedup(
+                msg.get("rid"),
+                lambda: self.submit_generate(
+                    np.asarray(tokens),
+                    max_new_tokens=int(msg.get("max_new_tokens", 32)),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    top_k=int(msg.get("top_k", 0)),
+                    eos_id=msg.get("eos_id"),
+                    deadline_ms=msg.get("deadline_ms")))
+            if joined and self.stats_sink:
+                self.stats_sink.bump("hedge_dedup_hits")
+        except Exception as e:  # noqa: BLE001 — typed refusal reply
+            return _error_reply(e)
         # generation budget: prompt prefill + one step per token, plus
         # compile headroom on the first request of a shape
         budget = msg.get("deadline_ms")
@@ -407,31 +632,59 @@ class InferenceServer:
                 f"server-side wait budget of {wait_s:.0f}s exceeded; "
                 f"the request was abandoned")
             req.set_error(err)
-            return {"ok": False, "etype": "DeadlineExceeded",
-                    "error": str(err)}
-        except DeadlineExceededError as e:
-            return {"ok": False, "etype": "DeadlineExceeded",
-                    "error": str(e)}
-        except ServerOverloadedError as e:
-            return {"ok": False, "etype": "Overloaded", "error": str(e)}
+            return _error_reply(err)
         except Exception as e:  # noqa: BLE001 — surface, don't die
-            return {"ok": False, "etype": "Internal",
-                    "error": f"{type(e).__name__}: {e}"}
+            return _error_reply(e)
 
 
-_ETYPES = {"DeadlineExceeded": DeadlineExceededError,
-           "Overloaded": ServerOverloadedError}
+# reply etype <-> exception mapping. Order matters server-side:
+# subclasses (Cancelled/Shutdown before their bases) must match first
+_ETYPE_MAP = (
+    ("Cancelled", RequestCancelledError),
+    ("Shutdown", ServerShutdownError),
+    ("DeadlineExceeded", DeadlineExceededError),
+    ("Overloaded", ServerOverloadedError),
+    ("Watchdog", WatchdogTimeout),
+    ("BadRequest", (ValueError, TypeError)),
+)
+# client-side reply mapping: server-side BadRequest detection matches
+# (ValueError, TypeError), but the CLIENT raises the typed ServingError
+# subclass so input refusals stay distinguishable from server faults
+_ETYPES = {etype: cls for etype, cls in _ETYPE_MAP
+           if isinstance(cls, type)}
+_ETYPES["BadRequest"] = BadRequestError
+
+
+def _error_reply(exc):
+    """Map an exception to its typed wire reply."""
+    for etype, cls in _ETYPE_MAP:
+        if isinstance(exc, cls):
+            return {"ok": False, "etype": etype, "error": str(exc)}
+    return {"ok": False, "etype": "Internal",
+            "error": f"{type(exc).__name__}: {exc}"}
 
 
 class Client:
     """Wire-protocol client. One socket, serial request/reply (run one
     Client per concurrent caller — sockets are cheap; the server batches
     across them). Transport failures surface as ConnectionError
-    subclasses (``WireTruncationError`` included), so callers can wrap
-    ``infer`` in ``resilience.retry_call`` — inference is idempotent."""
+    subclasses (``WireTruncationError`` included).
+
+    Resilience: a dead cached socket is detected on send/recv failure
+    and reconnected ONCE transparently before any error surfaces (a
+    bounced server does not strand old clients), ``ping``/``stats``/
+    ``health`` retry with backoff via ``resilience.retry_call`` (they
+    are idempotent), every ``infer``/``generate`` carries a request id
+    (the server dedups, so a retried or hedged pair executes once), and
+    ``infer`` can HEDGE: if no reply lands within a p99-derived delay
+    (``hedge_ms``, default ``FLAGS_serving_hedge_ms``; the observed p99
+    takes over once enough latencies are banked), a twin request races
+    on a second connection, the first reply wins and the loser is
+    cancelled by request id."""
 
     def __init__(self, endpoint, auth_key=None, timeout=None,
-                 connect_retries=20):
+                 connect_retries=20, hedge_ms=None):
+        from ..flags import flag
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self._addr = (host, int(port))
@@ -439,10 +692,14 @@ class Client:
         self._timeout = timeout
         self._connect_retries = connect_retries
         self._sock = None
+        self._hedge_ms = float(hedge_ms if hedge_ms is not None
+                               else flag("serving_hedge_ms"))
+        self._lat_s = deque(maxlen=256)     # winning infer latencies
+        self._hedges = 0
+        self._hedge_wins = 0
 
     def _ensure(self):
         if self._sock is None:
-            from ..resilience import retry_call
             self._sock = retry_call(
                 lambda: socket.create_connection(
                     self._addr, timeout=self._timeout),
@@ -450,27 +707,158 @@ class Client:
                 what="serving connect", endpoint=self.endpoint)
         return self._sock
 
-    def _call(self, msg):
-        sock = self._ensure()
+    def _transact(self, sock, msg):
+        """One request/reply exchange on ``sock``; maps error replies to
+        their typed exceptions. No reconnect logic here. ANY failure
+        inside the exchange (transport error, timeout, injected fault)
+        poisons the socket — a half-done exchange can leave the reply in
+        the buffer, and reusing the socket would pair the NEXT request
+        with this one's stale reply — so the cached socket is dropped
+        and the next call reconnects."""
         try:
             send_frame(sock, msg, self._key, timeout=self._timeout)
             reply = recv_frame(sock, self._key, timeout=self._timeout)
-        except (ConnectionError, OSError):
-            self.close()
+        except BaseException:
+            if sock is self._sock:
+                self.close()
             raise
+        # past here the exchange is COMPLETE — reply-decode errors are
+        # typed results, not transport damage; the socket stays cached
         if not isinstance(reply, dict):
             raise WireError(f"malformed serving reply: {type(reply)}")
         if reply.get("ok"):
             return reply
-        etype = _ETYPES.get(reply.get("etype"), RuntimeError)
+        etype = _ETYPES.get(reply.get("etype"), InternalServerError)
         raise etype(reply.get("error", "serving request failed"))
 
-    def infer(self, feeds, deadline_ms=None):
+    def _call(self, msg):
+        """Exchange with reconnect-once: a send/recv failure on the
+        cached socket (typically a bounced server) closes it and retries
+        the exchange on a fresh connection before surfacing anything.
+        Safe because infer/generate carry a request id the server
+        dedups, and the other ops are idempotent."""
+        for attempt in (0, 1):
+            sock = self._ensure()
+            try:
+                return self._transact(sock, msg)
+            except (ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    # -- hedging -----------------------------------------------------------
+    def _hedge_delay_s(self, hedge_ms):
+        """Effective hedge trigger: the observed p99 infer latency once
+        >= 16 samples are banked (floored at 1 ms so a microsecond p99
+        cannot hedge every call), else the configured cold-start
+        delay."""
+        base = self._hedge_ms if hedge_ms is None else float(hedge_ms)
+        if base <= 0:
+            return 0.0
+        if len(self._lat_s) >= 16:
+            p99 = float(np.percentile(np.asarray(self._lat_s), 99)) * 1e3
+            return max(p99, 1.0) / 1e3
+        return base / 1e3
+
+    def hedge_stats(self):
+        return {"hedges": self._hedges, "hedge_wins": self._hedge_wins,
+                "observed": len(self._lat_s)}
+
+    def _call_hedged(self, msg, delay_s):
+        """Race the primary exchange against a delayed twin on a fresh
+        connection; first reply wins, the loser is cancelled by request
+        id (the server's dedup table guarantees the pair executed at
+        most once)."""
+        state = {"reply": None, "who": None, "errors": [], "done": 0}
+        cv = threading.Condition()
+
+        def attempt(tag, fn):
+            try:
+                r = fn()
+            except Exception as e:  # noqa: BLE001 — judged by the racer
+                r = None
+                err = e
+            with cv:
+                if r is not None and state["reply"] is None:
+                    state["reply"], state["who"] = r, tag
+                elif r is None:
+                    state["errors"].append(err)
+                state["done"] += 1
+                cv.notify_all()
+
+        sock = self._ensure()
+        threading.Thread(
+            target=attempt, args=("primary",
+                                  lambda: self._transact(sock, msg)),
+            daemon=True, name="serving-client-primary").start()
+        launched = 1
+        with cv:
+            cv.wait_for(lambda: state["reply"] is not None
+                        or state["done"] >= launched, timeout=delay_s)
+            fire_hedge = state["reply"] is None and state["done"] < 1
+
+        if fire_hedge:
+            self._hedges += 1
+
+            def hedge_fn():
+                hs = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+                try:
+                    return self._transact(hs, msg)
+                finally:
+                    try:
+                        hs.close()
+                    except OSError:
+                        pass
+
+            threading.Thread(target=attempt, args=("hedge", hedge_fn),
+                             daemon=True,
+                             name="serving-client-hedge").start()
+            launched = 2
+        with cv:
+            cv.wait_for(lambda: state["reply"] is not None
+                        or state["done"] >= launched)
+            reply, who = state["reply"], state["who"]
+            errors = list(state["errors"])
+        if reply is None:
+            if all(isinstance(e, (ConnectionError, OSError))
+                   for e in errors):
+                # both attempts died on transport: the reconnect-once
+                # contract still applies — one fresh-socket retry (the
+                # request id makes the replay exactly-once server-side)
+                self.close()
+                return self._call(msg)
+            raise errors[0]
+        if who == "hedge":
+            self._hedge_wins += 1
+            # the primary worker is still blocked on the cached socket:
+            # drop it so the NEXT call gets a fresh connection instead
+            # of interleaving frames with the abandoned exchange
+            self.close()
+        if launched == 2:
+            try:
+                self._call({"op": "cancel", "rid": msg["rid"]})
+            except Exception:  # noqa: BLE001 — cancel is best-effort
+                pass
+        return reply
+
+    # -- ops ---------------------------------------------------------------
+    def infer(self, feeds, deadline_ms=None, hedge_ms=None):
         """Returns the fetch list (numpy arrays). Raises
-        DeadlineExceededError / ServerOverloadedError mapped from the
-        server's reply, ConnectionError on transport failure."""
-        reply = self._call({"op": "infer", "feed": dict(feeds),
-                            "deadline_ms": deadline_ms})
+        DeadlineExceededError / ServerOverloadedError /
+        ServerShutdownError mapped from the server's reply,
+        ConnectionError on transport failure. ``hedge_ms`` overrides the
+        client's hedging delay for this call (0 disables)."""
+        msg = {"op": "infer", "feed": dict(feeds),
+               "deadline_ms": deadline_ms, "rid": uuid.uuid4().hex}
+        delay_s = self._hedge_delay_s(hedge_ms)
+        t0 = time.monotonic()
+        if delay_s <= 0:
+            reply = self._call(msg)
+        else:
+            reply = self._call_hedged(msg, delay_s)
+        self._lat_s.append(time.monotonic() - t0)
         return [np.asarray(a) for a in reply["fetch"]]
 
     def generate(self, tokens, max_new_tokens=32, temperature=0.0,
@@ -487,14 +875,32 @@ class Client:
             "top_k": int(top_k),
             "eos_id": None if eos_id is None else int(eos_id),
             "deadline_ms": deadline_ms,
+            "rid": uuid.uuid4().hex,
         })
         return np.asarray(reply["tokens"], dtype=np.int32)
 
+    def cancel(self, rid):
+        """Cancel an in-flight request by its id (hedge losers; also
+        usable after abandoning a slow call). Returns True if the server
+        actually cancelled something."""
+        return bool(self._call({"op": "cancel",
+                                "rid": str(rid)}).get("cancelled"))
+
+    def _idempotent(self, msg):
+        return retry_call(lambda: self._call(msg), deadline=10.0,
+                          retries=2, what=f"serving {msg['op']}",
+                          endpoint=self.endpoint)
+
     def stats(self):
-        return self._call({"op": "stats"})["stats"]
+        return self._idempotent({"op": "stats"})["stats"]
+
+    def health(self):
+        """The server's lifecycle/liveness snapshot (state, queue
+        depths, loop heartbeats + restarts, weights_version)."""
+        return self._idempotent({"op": "health"})["health"]
 
     def ping(self):
-        return bool(self._call({"op": "ping"}).get("ok"))
+        return bool(self._idempotent({"op": "ping"}).get("ok"))
 
     def close(self):
         if self._sock is not None:

@@ -615,3 +615,120 @@ def test_watchdog_context_aborts_overbudget_block():
     assert time.monotonic() - t0 < 5.0
     with watchdog(5.0):           # under budget: no interference
         time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# serving resilience primitives (chaos harness, supervised-loop breaker)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_bounds_serving_execute(fault_points):
+    """run_with_watchdog under the MicroBatcher execute path: a hung
+    engine call fails the batch's clients with WatchdogTimeout while
+    the loop thread survives (the serving half of the watchdog
+    contract)."""
+    from paddle_tpu.serving import MicroBatcher, Request, RequestQueue
+
+    calls = []
+
+    def engine(reqs):
+        calls.append(len(reqs))
+        if len(calls) == 1:
+            time.sleep(2.0)          # first batch hangs
+        for r in reqs:
+            r.set_result([np.zeros(1)])
+
+    q = RequestQueue(max_depth=16)
+    mb = MicroBatcher(q, engine, max_batch_size=4, batch_timeout_ms=1.0,
+                      watchdog_s=0.2)
+    mb.start()
+    try:
+        hung = q.put(Request({"x": np.zeros((1, 2), np.float32)}))
+        with pytest.raises(WatchdogTimeout):
+            hung.wait(timeout=5)
+        ok = q.put(Request({"x": np.zeros((1, 2), np.float32)}))
+        ok.wait(timeout=5)           # the loop survived the hang
+        assert mb.alive()
+        assert mb.consecutive_failures == 0   # reset by the success
+    finally:
+        mb.stop()
+
+
+class _FakeLoop:
+    """Minimal supervised-loop duck type for LoopSupervisor unit tests."""
+
+    def __init__(self):
+        self.heartbeat = time.monotonic()
+        self.consecutive_failures = 0
+        self.restarts = 0
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def restart(self, reason=""):
+        self.restarts += 1
+        self._alive = True
+        self.heartbeat = time.monotonic()
+
+
+def test_circuit_breaker_drives_degraded_state_and_recovery():
+    """Repeated loop deaths trip the supervisor's CircuitBreaker into
+    the degraded callback; sustained health closes it again."""
+    from paddle_tpu.serving import LoopSupervisor
+
+    events = []
+    loop = _FakeLoop()
+    sup = LoopSupervisor(watchdog_s=5.0, poll_s=0.01,
+                         restart_threshold=2, reset_secs=0.2,
+                         restart_backoff=0.0,
+                         on_degraded=lambda: events.append("degraded"),
+                         on_recovered=lambda: events.append("recovered"))
+    sup.add("loop", loop)
+    now = time.monotonic()
+    # two consecutive deaths: threshold 2 -> breaker open -> degraded
+    loop._alive = False
+    sup._tick(now)
+    assert loop.restarts == 1 and events == []
+    loop._alive = False
+    sup._tick(now + 0.1)
+    assert loop.restarts == 2
+    assert events == ["degraded"] and sup.degraded
+    assert sup.breaker.state in ("open", "half-open")
+    # healthy past reset_secs -> breaker closes -> recovered
+    loop.heartbeat = now + 1.0
+    sup._tick(now + 1.0)
+    assert events == ["degraded", "recovered"]
+    assert not sup.degraded and sup.breaker.state == "closed"
+    assert sup.restarts() == 2
+
+
+def test_supervisor_counts_engine_failure_streaks():
+    """A loop that is alive but fails every batch must also feed the
+    breaker (degraded on repeated execute failures, not just crashes)."""
+    from paddle_tpu.serving import LoopSupervisor
+
+    events = []
+    loop = _FakeLoop()
+    sup = LoopSupervisor(watchdog_s=5.0, poll_s=0.01,
+                         restart_threshold=2, reset_secs=60.0,
+                         on_degraded=lambda: events.append("degraded"))
+    sup.add("loop", loop)
+    now = time.monotonic()
+    for i in range(2):
+        loop.heartbeat = now + i
+        loop.consecutive_failures = 2       # streak >= threshold
+        sup._tick(now + i)
+        assert loop.consecutive_failures == 0    # consumed by the tick
+    assert events == ["degraded"]
+    assert loop.restarts == 0               # no restart: the loop lives
+
+
+def test_chaos_restores_previously_armed_points(fault_points):
+    """chaos() nests over fault_injection without clobbering it."""
+    from paddle_tpu.resilience import FaultInjected, chaos, maybe_fail
+    with fault_points.fault_injection("pt", exc=ValueError, times=-1):
+        with chaos("pt", exc=FaultInjected, times=1):
+            with pytest.raises(FaultInjected):
+                maybe_fail("pt")
+        with pytest.raises(ValueError):      # outer arming restored
+            maybe_fail("pt")
