@@ -989,6 +989,112 @@ def bench_chaos():
     }
 
 
+def bench_train_chaos():
+    """Elastic-training recovery metrics (the BENCHMARKS.md recovery
+    table, training side): (a) steady-state checkpoint overhead — fused
+    run_slabs throughput with CheckFreq-staged async checkpoints every
+    2 slabs vs none; (b) preempt-to-exit — request_preemption() to the
+    typed PreemptedError at the next slab boundary, INCLUDING the
+    bounded-deadline fast checkpoint; (c) resume-to-first-step — fresh
+    TrainingSupervisor, verified-checkpoint restore through the first
+    completed slab; (d) kill->resume recovery — a chaos fault crashes
+    one dispatch, supervised restart (reload + replay) to the next
+    completed slab."""
+    import tempfile
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, resilience, train
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 64], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        h = layers.fc(h, 256, act="relu")
+        loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    k, batch, n_slabs = 8, 256, 24
+    rng = np.random.default_rng(0)
+    slabs = [{"x": rng.standard_normal((k, batch, 64)).astype(np.float32),
+              "y": rng.standard_normal((k, batch, 1)).astype(np.float32)}
+             for _ in range(n_slabs)]
+    root = tempfile.mkdtemp(prefix="bench_train_chaos_")
+
+    def sup(name, **kw):
+        kw.setdefault("checkpoint_every_n_slabs", 10 ** 9)
+        return train.TrainingSupervisor(
+            exe, main, os.path.join(root, name),
+            startup_program=startup, scope=fluid.Scope(),
+            steps_per_run=k, restart_backoff=0.01, **kw)
+
+    # warm the fused executable so the A/B below is compile-free
+    sup("warm").run_slabs(slabs[:2], fetch_list=[loss])
+
+    # (a) checkpoint overhead: every-4-slab async saves vs none (both
+    # runs pay the same final sync checkpoint). CheckFreq contract: the
+    # critical path pays the synchronous scope gather; fsync/rename ride
+    # the background thread as long as the interval exceeds persist time
+    t0 = time.perf_counter()
+    sup("nockpt").run_slabs(slabs, fetch_list=[loss])
+    t_plain = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sup("ckpt", checkpoint_every_n_slabs=4).run_slabs(
+        slabs, fetch_list=[loss])
+    t_ckpt = time.perf_counter() - t0
+    overhead_pct = (t_ckpt - t_plain) / t_plain * 100.0
+
+    # (b) preempt-to-exit: flag raised right after slab 8 completes; the
+    # measured span covers the boundary check + fast sync checkpoint +
+    # typed exit
+    marks = {}
+
+    def preempt_cb(slab, step, fetches):
+        if slab == 8:
+            marks["t0"] = time.perf_counter()
+            train.request_preemption("bench")
+
+    s_pre = sup("preempt", checkpoint_every_n_slabs=4,
+                on_slab_end=preempt_cb)
+    try:
+        s_pre.run_slabs(slabs, fetch_list=[loss])
+        raise RuntimeError("preemption did not fire")
+    except train.PreemptedError:
+        preempt_exit_ms = (time.perf_counter() - marks["t0"]) * 1e3
+    train.clear_preemption()
+
+    # (c) resume-to-first-step: restore the preempted run's checkpoint
+    # and finish; span = train() entry to the first resumed slab
+    def first_cb(slab, step, fetches):
+        marks.setdefault("t1", time.perf_counter())
+
+    s_res = sup("preempt", checkpoint_every_n_slabs=4,
+                on_slab_end=first_cb)
+    t0 = time.perf_counter()
+    s_res.run_slabs(slabs, fetch_list=[loss])
+    resume_ms = (marks["t1"] - t0) * 1e3
+
+    # (d) kill -> resume: one injected dispatch crash, supervised
+    # restart; the supervisor reports crash-to-next-completed-slab
+    s_kill = sup("kill", checkpoint_every_n_slabs=2, restart_budget=3)
+    with resilience.chaos({"train.dispatch": {"after": 8, "times": 1}}):
+        r = s_kill.run_slabs(slabs, fetch_list=[loss])
+    assert r["restarts"] == 1, r["restarts"]
+    kill_recovery_ms = r["recoveries_ms"][0]
+
+    return {
+        "metric": "train_chaos_preempt_to_exit_ms",
+        "value": round(preempt_exit_ms, 2),
+        "unit": "ms",
+        "vs_baseline": None,     # recovery metric, no external anchor
+        "checkpoint_overhead_pct": round(overhead_pct, 2),
+        "resume_to_first_step_ms": round(resume_ms, 2),
+        "kill_resume_recovery_ms": round(kill_recovery_ms, 2),
+        "train_s_plain": round(t_plain, 3),
+        "train_s_ckpt_every_4": round(t_ckpt, 3),
+        "k": k, "slabs": n_slabs, "batch": batch,
+    }
+
+
 def bench_decode():
     """KV-cached autoregressive decoding A/B (models/generation): after
     a bucketed prefill of a seq-{128,256} prompt, generate N tokens via
@@ -1086,6 +1192,7 @@ _CONFIGS = {
                  "gpt_base_seq2048_causal_flash_bf16_samples_per_sec"),
     "serving": (bench_serving, "serving_mlp_batch32_samples_per_sec"),
     "chaos": (bench_chaos, "chaos_loop_restart_ms"),
+    "train_chaos": (bench_train_chaos, "train_chaos_preempt_to_exit_ms"),
     "train_loop": (bench_train_loop, "train_loop_fused_k8_steps_per_sec"),
     "passes": (bench_passes,
                "passes_bert_train_step_trace_plus_compile_ms"),

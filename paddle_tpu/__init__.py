@@ -75,9 +75,11 @@ from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
 from .io import (  # noqa: F401
     save_params, load_params, save_persistables, load_persistables,
     save_inference_model, load_inference_model, save, load,
+    save_checkpoint, load_checkpoint,
     CheckpointSaver,
 )
 from . import resilience  # noqa: F401
+from . import train  # noqa: F401  (elastic training supervisor)
 from . import serving  # noqa: F401
 from .resilience import (  # noqa: F401
     CheckpointCorruptError, EnforceNotMet, NonFiniteError,

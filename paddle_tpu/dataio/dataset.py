@@ -13,6 +13,67 @@ import random
 
 import numpy as np
 
+from ..resilience import maybe_fail as _maybe_fail
+
+
+class PositionedBatchIterator:
+    """Batch/slab iterator with a resumable cursor — the position API
+    behind preemption-aware training (train.TrainingSupervisor).
+
+    Wraps a raw batch stream; ``position()`` reports exactly how much of
+    the stream the consumer has RECEIVED (batches land in the count only
+    when the batch — or the completed slab holding it — is yielded, so a
+    slab buffered half-full at kill time is not counted):
+
+    - ``epoch``: the epoch index this iterator was created for
+    - ``batches``: batches consumed so far, INCLUDING the replay-skipped
+      prefix — feed it back as ``position={"batches": n}`` to resume
+    - ``slabs``: slabs (or batches when unslabbed) yielded this epoch
+    - ``skipped``: the buffered-reader skip count — how many batches this
+      iterator re-parsed and dropped to reach its resume point
+    - ``shuffle_seed``: the dataset's shuffle seed at creation (None when
+      the dataset has none), so a resumed run can replay the same
+      permutation before skipping
+    """
+
+    def __init__(self, raw_batches, slab=None, epoch=0, skip_batches=0,
+                 shuffle_seed=None):
+        # slab=1 (unlike the legacy positionless path) still SLABS: the
+        # consumer asked for run_steps-shaped dicts with a leading step
+        # axis, and a [batch, ...] dict would be misread as a 1-sample
+        # K=batch slab
+        self._slab = int(slab) if slab else 0
+        self._epoch = int(epoch)
+        self._shuffle_seed = shuffle_seed
+        self._skipped = 0
+        for _ in range(int(skip_batches)):
+            if next(raw_batches, None) is None:
+                break
+            self._skipped += 1
+        self._batches = self._skipped
+        self._slabs = 0
+        self._it = (DatasetBase._slab_batches(raw_batches, self._slab)
+                    if self._slab >= 1 else raw_batches)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        out = next(self._it)
+        if self._slab >= 1:
+            # the slab's leading axis IS its batch count (shape-change
+            # flushes and the tail yield short slabs)
+            self._batches += int(np.shape(next(iter(out.values())))[0])
+        else:
+            self._batches += 1
+        self._slabs += 1
+        return out
+
+    def position(self):
+        return {"epoch": self._epoch, "batches": self._batches,
+                "slabs": self._slabs, "skipped": self._skipped,
+                "shuffle_seed": self._shuffle_seed}
+
 
 class DatasetFactory:
     def create_dataset(self, datafeed_class="QueueDataset"):
@@ -87,10 +148,28 @@ class DatasetBase:
         for s in samples:
             buf.append(s)
             if len(buf) == self.batch_size:
+                _maybe_fail("dataio.producer")
                 yield self._collate(names, buf)
                 buf = []
         if buf:
+            _maybe_fail("dataio.producer")
             yield self._collate(names, buf)
+
+    def _positioned(self, it, slab, position):
+        """Shared batch_iterator tail: with ``position`` the stream is
+        wrapped in a :class:`PositionedBatchIterator` (skipping the
+        already-consumed prefix); without it the legacy plain iterator
+        comes back unchanged."""
+        if position is not None:
+            return PositionedBatchIterator(
+                iter(it), slab=slab,
+                epoch=position.get("epoch", 0),
+                skip_batches=position.get("batches", 0),
+                shuffle_seed=position.get("shuffle_seed",
+                                          getattr(self, "_seed", None)))
+        if slab and slab > 1:
+            return self._slab_batches(it, int(slab))
+        return it
 
     @staticmethod
     def _collate(names, buf):
@@ -153,7 +232,7 @@ class QueueDataset(DatasetBase):
                 and self.pipe_command is None and self.use_vars
                 and native_feed.available())
 
-    def batch_iterator(self, slab=None):
+    def batch_iterator(self, slab=None, position=None):
         if self._native_ok():
             from .native_feed import NativeDataFeed
             slots = [(v.name, "int64" if "int" in v.dtype else "float32")
@@ -163,9 +242,7 @@ class QueueDataset(DatasetBase):
                 threads=max(self.thread_num, 1)))
         else:
             it = self._batches(self._iter_files(self._shard_files()))
-        if slab and slab > 1:
-            return self._slab_batches(it, int(slab))
-        return it
+        return self._positioned(it, slab, position)
 
 
 class InMemoryDataset(DatasetBase):
@@ -302,8 +379,6 @@ class InMemoryDataset(DatasetBase):
     def get_shuffle_data_size(self, fleet=None):
         return len(self._samples)
 
-    def batch_iterator(self, slab=None):
+    def batch_iterator(self, slab=None, position=None):
         it = self._batches(iter(self._samples))
-        if slab and slab > 1:
-            return self._slab_batches(it, int(slab))
-        return it
+        return self._positioned(it, slab, position)

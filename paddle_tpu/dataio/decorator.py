@@ -67,9 +67,14 @@ def buffered(reader, size):
         closed = threading.Event()
 
         def fill():
+            from ..resilience import maybe_fail
             it = reader()
             try:
                 for sample in it:
+                    # chaos point for the dataset-producer stage: a
+                    # fault here propagates through `err` into the
+                    # consuming training loop like a real parse crash
+                    maybe_fail("dataio.producer")
                     if not put_until_closed(q, sample, closed):
                         return
             except BaseException as e:  # propagate into the consumer

@@ -26,7 +26,8 @@ import time
 import numpy as np
 
 from ..flags import flag as _flag
-from ..resilience import CircuitBreaker, RpcDeadlineError, retry_call
+from ..resilience import (CircuitBreaker, RpcDeadlineError, maybe_fail,
+                          retry_call)
 from .wire import WireError, default_key, recv_frame, send_frame
 
 
@@ -771,6 +772,7 @@ class PSClient:
         # retried (unlike the other pushes): the (uid, seq) tag lets the
         # server drop a replay whose original was applied but whose reply
         # was lost, so at-least-once delivery stays exactly-once applied
+        maybe_fail("ps.push_dense", endpoint=endpoint, name=name)
         self._call(endpoint,
                    ("push_dense", name, np.asarray(grad), trainer_id,
                     self._push_uid, next(self._push_seq)))
@@ -782,6 +784,7 @@ class PSClient:
             self._call(ep, ("send_barrier", trainer_id), retries=0)
 
     def pull_dense(self, endpoint, name):
+        maybe_fail("ps.pull_dense", endpoint=endpoint, name=name)
         return self._call(endpoint, ("pull_dense", name))
 
     def allreduce(self, endpoint, name, value, nranks):

@@ -59,6 +59,19 @@ _DEFS = {
     "serving_hedge_ms": (0.0, float, None),
     # default seed for resilience.chaos() fault-point streams
     "chaos_seed": (0, int, None),
+    # -- elastic training (paddle_tpu/train) --
+    # periodic full-training-state checkpoint cadence for
+    # TrainingSupervisor: one async (CheckFreq-staged) checkpoint every
+    # N fused slabs
+    "checkpoint_every_n_slabs": (16, int, None),
+    # wall-clock budget for the preemption fast checkpoint (SIGTERM ->
+    # save at next slab boundary -> exit); a save that misses it is
+    # abandoned and the previous verified checkpoint stands. 0 = no
+    # bound (save however long it takes before exiting)
+    "preempt_deadline_s": (30.0, float, None),
+    # how many supervised-restart attempts (crash/hang -> reload newest
+    # checkpoint with capped backoff) before RestartBudgetExceeded
+    "train_restart_budget": (3, int, None),
     # -- KV-cached autoregressive decoding (models/generation, serving
     # decode batching) --
     # preallocated per-layer KV cache length [B, H, decode_max_len, D]:

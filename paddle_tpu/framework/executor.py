@@ -20,6 +20,7 @@ from .dtype import np_dtype
 from .lowering import analyze_block_io, build_block_fn, build_multi_step_fn
 from ..flags import flag as _flag
 from ..resilience import NonFiniteError
+from ..resilience import maybe_fail as _maybe_fail
 
 RNG_STATE_NAME = "@RNG_KEY@"
 
@@ -639,6 +640,10 @@ class Executor:
                                           slot_names, wo_avals,
                                           state_fetches)
 
+        # chaos point for the training dispatch stage: fires BEFORE the
+        # executable runs, so the scope still holds pre-slab state and a
+        # supervised restart resumes bitwise from the last checkpoint
+        _maybe_fail("train.dispatch")
         profiling = _prof.is_profiling()
         t0 = time.perf_counter()
         fetches, final_state, final_key, viols, slots = self._invoke(
@@ -926,6 +931,7 @@ def _device_put_slab(slab, program=None):
     cast and int64 feed-boundary validation run() would, BEFORE the
     value becomes a device array and skips that np-path."""
     from .. import profiler as _prof
+    _maybe_fail("train.h2d")    # chaos point: slab H2D transfer stage
     gblock = program.global_block() if program is not None else None
     t0 = time.perf_counter()
     out = {}

@@ -186,9 +186,16 @@ class Collective:
                 return TrainStatus(-1)
             raise RuntimeError(f"no checkpoint under {path}")
         from .... import io
-        io.load_persistables(executor, ckpt,
-                             main_program or self._origin_program)
-        with open(os.path.join(ckpt, "train_status.json")) as f:
+        # typed full-state load: a checkpoint missing optimizer slabs or
+        # the RNG stream record raises CheckpointIncompleteError instead
+        # of silently resuming with reset training state
+        io.load_checkpoint(executor, ckpt,
+                           main_program=main_program or
+                           self._origin_program)
+        status_path = os.path.join(ckpt, "train_status.json")
+        io._verify_against_manifest(ckpt, "train_status.json",
+                                    io._read_manifest(ckpt))
+        with open(status_path) as f:
             return TrainStatus(json.load(f)["epoch_no"])
 
     def save_inference_model(self, executor, dirname, feeded_var_names,

@@ -33,12 +33,13 @@ import numpy as np
 from .framework.core import Program, Variable, Parameter
 from .framework.executor import global_scope, RNG_STATE_NAME
 from .framework.dtype import np_dtype
-from .resilience import CheckpointCorruptError
+from .resilience import CheckpointCorruptError, CheckpointIncompleteError
 from .resilience import maybe_fail as _maybe_fail
 
 _META_FILE = "__meta__.json"
 _MODEL_FILE = "__model__"
 _MANIFEST_FILE = "_manifest.json"
+TRAIN_STATE_FILE = "train_state.json"
 
 
 # ---------------------------------------------------------------------------
@@ -83,7 +84,9 @@ def _fsync_write(path, write_fn):
         w = _Sha256Writer(f)
         write_fn(w)
         f.flush()
+        _maybe_fail("io.fsync", path=path)
         os.fsync(f.fileno())
+    _maybe_fail("io.rename", path=path)
     os.replace(tmp, path)
     return w.hexdigest()
 
@@ -482,6 +485,120 @@ def load_persistables(executor, dirname, main_program=None, filename=None,
 
 
 # ---------------------------------------------------------------------------
+# full-training-state checkpoint (exact-resume contract)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(executor, dirname, main_program=None, scope=None,
+                    train_state=None):
+    """Full-training-state checkpoint into ``dirname``: every persistable
+    (params + optimizer state slabs + LR/step counters), the RNG stream
+    position (``__meta__`` extras), and an optional ``train_state`` dict
+    (the dataset cursor / slab index, written as ``train_state.json``) —
+    ALL of it manifest-covered, so a torn or corrupted file in ANY part
+    of the training state surfaces as CheckpointCorruptError on load
+    instead of a silently diverging resume."""
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    extra = []
+    if train_state is not None:
+        _fsync_write(os.path.join(dirname, TRAIN_STATE_FILE),
+                     lambda f: f.write(json.dumps(train_state,
+                                                  indent=1).encode()))
+        extra.append(TRAIN_STATE_FILE)
+    save_vars(executor, dirname, main_program=main_program,
+              predicate=is_persistable, scope=scope,
+              extra_state=_rng_extra(scope), _manifest_extra=extra)
+
+
+def _raise_incomplete(dirname, main_program, missing):
+    gb = main_program.global_block()
+    opt = sorted(n for n in missing
+                 if getattr(gb.vars.get(n), "is_optimizer_state", False))
+    what = (f"optimizer state for {len(opt)} variable(s) "
+            f"(e.g. {opt[0]!r})" if opt else
+            f"{len(missing)} persistable variable(s) "
+            f"(e.g. {sorted(missing)[0]!r})")
+    raise CheckpointIncompleteError(
+        f"checkpoint {dirname!r} is missing {what} — it looks like a "
+        f"params-only save; resuming from it would silently reset "
+        f"the missing state. Use io.load_params for a params-only "
+        f"restore, or re-save with io.save_checkpoint/"
+        f"save_persistables for exact resume.",
+        path=dirname, missing=sorted(missing))
+
+
+def load_checkpoint(executor, dirname, main_program=None, scope=None,
+                    strict=True, filename=None):
+    """Restore a :func:`save_checkpoint` (or full ``save_persistables``)
+    directory for EXACT resume; returns the saved ``train_state`` dict
+    (None when the checkpoint carries none). ``filename`` names a
+    single-archive save (``save_persistables(..., filename=...)``).
+
+    Unlike load_persistables this refuses to resume from partial state:
+    a checkpoint missing optimizer slabs or the RNG stream record (e.g.
+    a params-only ``save_params`` directory) raises a typed
+    :class:`~paddle_tpu.resilience.CheckpointIncompleteError` BEFORE the
+    scope is touched — resuming from it would silently train with reset
+    moments / a reseeded random stream. ``strict=False`` tolerates a
+    missing RNG record (pre-upgrade checkpoints)."""
+    scope = scope or global_scope()
+    main_program, var_list = _resolve_vars(main_program, None,
+                                           is_persistable)
+    if filename is None:
+        # per-var format: classify missing files up front (typed error
+        # before any disk read, naming the optimizer slabs)
+        missing = [v.name for v in var_list
+                   if not os.path.exists(
+                       os.path.join(dirname, _escape(v.name) + ".npy"))]
+        if missing:
+            _raise_incomplete(dirname, main_program, missing)
+    if strict:
+        meta_path = os.path.join(dirname, _META_FILE)
+        has_rng = False
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    has_rng = RNG_STATE_NAME in \
+                        json.load(f).get("extra", {})
+            except (OSError, ValueError) as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint meta {meta_path!r} is unreadable: {e}",
+                    path=meta_path)
+        if not has_rng:
+            raise CheckpointIncompleteError(
+                f"checkpoint {dirname!r} has no RNG stream record in its "
+                f"__meta__ extras — resuming would replay a RESEEDED "
+                f"random stream (dropout, shuffles) and diverge from the "
+                f"uninterrupted run. Re-save with io.save_checkpoint, or "
+                f"pass strict=False to accept the divergence.",
+                path=dirname, missing=[RNG_STATE_NAME])
+    try:
+        extras = load_vars(executor, dirname, main_program=main_program,
+                           predicate=is_persistable, scope=scope,
+                           filename=filename)
+    except RuntimeError as e:
+        # load_vars validates the FULL restore before touching the scope
+        # and reports every missing var; surface that as the typed
+        # incomplete-checkpoint error (archive format has no per-var
+        # files to pre-check)
+        if "incomplete" not in str(e) or isinstance(e,
+                                                    CheckpointCorruptError):
+            raise
+        missing = [v.name for v in var_list
+                   if f"{v.name}" in str(e)]
+        _raise_incomplete(dirname, main_program,
+                          missing or [v.name for v in var_list])
+    _restore_rng(scope, extras)
+    state_path = os.path.join(dirname, TRAIN_STATE_FILE)
+    if not os.path.exists(state_path):
+        return None
+    _verify_against_manifest(dirname, TRAIN_STATE_FILE,
+                             _read_manifest(dirname))
+    with open(state_path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
 # inference model (reference io.py:1067 save_inference_model /
 # :1274 load_inference_model)
 # ---------------------------------------------------------------------------
@@ -695,6 +812,14 @@ class CheckpointSaver:
         # — two back-to-back save_async calls must not pick the same
         # number and clobber each other's staging directory
         self._reserved = set()
+        # numbers whose in-flight save was ABANDONED (e.g. a preemption
+        # fast save that missed its deadline): _commit drops them on the
+        # floor instead of publishing a checkpoint the caller was told
+        # does not exist
+        self._abandoned = set()
+        # a save killed mid-write (preemption, crash) leaves its staging
+        # dir/files behind forever; anything stale is garbage on startup
+        self._gc_stale_temps()
 
     # -- numbering ---------------------------------------------------------
     def checkpoint_numbers(self):
@@ -794,39 +919,117 @@ class CheckpointSaver:
         with self._lock:
             self._reserved.discard(no)
 
+    @staticmethod
+    def _write_extra_files(stage, extra_files):
+        """Write the sidecar JSON payloads (train status, cursor) into
+        the staging dir BEFORE the array save commits the manifest, so
+        they are manifest-covered like every array file — a torn
+        train_status.json must fail verification, not parse as garbage.
+        Returns the relative names for ``_manifest_extra``."""
+        names = []
+        for rel, payload in (extra_files or {}).items():
+            _fsync_write(os.path.join(stage, rel),
+                         lambda f, _p=payload: f.write(
+                             json.dumps(_p).encode()))
+            names.append(rel)
+        return names
+
     def _write(self, no, stage, executor, main_program, scope,
                extra_files):
         try:
             os.makedirs(stage, exist_ok=True)
-            save_persistables(executor, stage, main_program=main_program,
-                              scope=scope)
-            self._commit(no, stage, extra_files)
+            names = self._write_extra_files(stage, extra_files)
+            scope_ = scope or global_scope()
+            # save_persistables inlined so the extra files ride the
+            # manifest (_manifest_extra); format identical otherwise
+            save_vars(executor, stage, main_program=main_program,
+                      predicate=is_persistable, scope=scope_,
+                      extra_state=_rng_extra(scope_),
+                      _manifest_extra=names)
+            self._commit(no, stage)
         finally:
             self._release(no)
 
     def _write_arrays(self, no, stage, arrays, meta, extra_files):
         try:
             os.makedirs(stage, exist_ok=True)
-            _write_array_dir(stage, arrays, meta)
-            self._commit(no, stage, extra_files)
+            names = self._write_extra_files(stage, extra_files)
+            _write_array_dir(stage, arrays, meta, manifest_extra=names)
+            self._commit(no, stage)
         finally:
             self._release(no)
 
-    def _commit(self, no, stage, extra_files):
-        for rel, payload in (extra_files or {}).items():
-            _fsync_write(os.path.join(stage, rel),
-                         lambda f, _p=payload: f.write(
-                             json.dumps(_p).encode()))
+    def abandon_inflight(self):
+        """Mark every currently in-flight (reserved, uncommitted) save
+        abandoned: its eventual _commit is skipped and the staging dir
+        removed. For callers that gave up waiting (bounded-deadline
+        preemption saves) — the worker thread cannot be cancelled, but
+        it must not publish a checkpoint the caller already reported as
+        nonexistent. Returns the abandoned numbers."""
+        with self._lock:
+            nums = set(self._reserved)
+            self._abandoned |= nums
+        return nums
+
+    def _commit(self, no, stage):
+        with self._lock:
+            if no in self._abandoned:
+                self._abandoned.discard(no)
+                abandoned = True
+            else:
+                abandoned = False
+        if abandoned:
+            import shutil
+            shutil.rmtree(stage, ignore_errors=True)
+            return
+        _maybe_fail("io.commit", path=self._path(no))
         os.replace(stage, self._path(no))
         _fsync_dir(self.dirname)
         self._prune(keep_at_least=no)
 
     def _prune(self, keep_at_least):
-        if self.max_to_keep is None:
+        if self.max_to_keep is not None:
+            import shutil
+            nums = self.checkpoint_numbers()
+            keep = nums[:-self.max_to_keep] if self.max_to_keep else nums
+            for n in keep:
+                if n == keep_at_least:
+                    continue
+                shutil.rmtree(self._path(n), ignore_errors=True)
+        self._gc_stale_temps()
+
+    def _gc_stale_temps(self):
+        """Remove orphaned ``.tmp`` staging dirs/files: a save killed
+        mid-write (preemption SIGKILL, crash, missed preempt deadline)
+        leaves them behind forever otherwise. Anything ``.tmp`` under
+        the checkpoint dir that is not an in-flight save of THIS saver
+        is garbage — numbers are reserved in-process, which is the
+        one-writer-per-directory contract CheckpointSaver already
+        requires for safe numbering."""
+        if not os.path.isdir(self.dirname):
             return
         import shutil
-        nums = self.checkpoint_numbers()
-        for n in nums[:-self.max_to_keep] if self.max_to_keep else nums:
-            if n == keep_at_least:
+        for entry in os.listdir(self.dirname):
+            if not entry.endswith(".tmp"):
                 continue
-            shutil.rmtree(self._path(n), ignore_errors=True)
+            if entry.startswith(self.prefix):
+                try:
+                    no = int(entry[len(self.prefix):-len(".tmp")])
+                except ValueError:
+                    no = None
+                # re-check the reservation AT REMOVAL time: a save
+                # staged after a snapshot taken up front would race the
+                # scan (reserve happens before its staging dir exists,
+                # so a dir this listdir saw is either reserved now or
+                # genuinely stale)
+                with self._lock:
+                    if no in self._reserved:
+                        continue      # in-flight save's staging dir
+            full = os.path.join(self.dirname, entry)
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass

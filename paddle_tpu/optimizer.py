@@ -66,6 +66,10 @@ class Optimizer:
             name=unique_name.generate(f"{param.name}_{name}"),
             shape=shape or param.shape, dtype=dtype or param.dtype,
             persistable=True, stop_gradient=True)
+        # io.load_checkpoint reads this marker to tell "params-only save,
+        # optimizer slabs missing" apart from a generally torn checkpoint
+        # and raise the actionable CheckpointIncompleteError
+        var.is_optimizer_state = True
         if param.dist_attr is not None and (shape is None or
                                             list(shape) == list(param.shape)):
             var.dist_attr = param.dist_attr

@@ -81,6 +81,49 @@ class WatchdogTimeout(RuntimeError):
     """Work under a watchdog exceeded its wall-clock budget."""
 
 
+class CheckpointIncompleteError(CheckpointCorruptError):
+    """A checkpoint loaded for training resume lacks part of the full
+    training state (optimizer slabs, the RNG stream record, ...). Resuming
+    from it would SILENTLY diverge from the uninterrupted run — reset
+    moments, replayed RNG draws — so the load refuses instead. Carries
+    ``missing`` (the absent variable/extra names). Subclasses
+    CheckpointCorruptError so existing corrupt-checkpoint handlers treat
+    it as an unusable checkpoint."""
+
+    def __init__(self, message, path=None, missing=None):
+        super().__init__(message, path=path)
+        self.missing = list(missing or [])
+
+
+class PreemptedError(RuntimeError):
+    """The training loop was preempted (SIGTERM/SIGINT or an in-process
+    ``train.request_preemption``) and exited at a slab boundary after its
+    bounded-deadline fast checkpoint. Carries ``slab``/``step`` (progress
+    at exit), ``checkpoint_no`` (the newest durable checkpoint — None
+    when the fast save missed its deadline and the previous checkpoint
+    stands) and ``reason`` (which trigger fired)."""
+
+    def __init__(self, message, slab=None, step=None, checkpoint_no=None,
+                 reason=None):
+        super().__init__(message)
+        self.slab = slab
+        self.step = step
+        self.checkpoint_no = checkpoint_no
+        self.reason = reason
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The supervised training loop crashed more times than
+    ``FLAGS_train_restart_budget`` allows; the last failure is chained as
+    ``__cause__``. Carries ``restarts`` and ``errors`` (the typed error
+    names of every restart cause, oldest first)."""
+
+    def __init__(self, message, restarts=None, errors=None):
+        super().__init__(message)
+        self.restarts = restarts
+        self.errors = list(errors or [])
+
+
 class FaultInjected(RuntimeError):
     """Default exception raised by an armed chaos fault point. Distinct
     from real failure types so a soak can tell injected damage from a

@@ -1,0 +1,33 @@
+"""Elastic training: preemption-aware checkpointing, bitwise-deterministic
+resume, and a chaos-hardened supervised training loop.
+
+The serving side got its resilience layer in the serving PR (chaos
+harness, LoopSupervisor, watchdogs, drain); this package points the same
+machinery at TRAINING. A ``TrainingSupervisor`` makes any
+``Executor.run_steps`` / ``train_from_dataset`` loop killable and
+resumable with bitwise parity (CheckFreq-style async checkpoint staging,
+Tail-at-Scale-style hang detection on the fused step):
+
+    sup = train.TrainingSupervisor(exe, main_prog, "/ckpts",
+                                   startup_program=startup,
+                                   steps_per_run=8,
+                                   checkpoint_every_n_slabs=4,
+                                   handle_signals=True)
+    result = sup.train(dataset, fetch_list=[loss])   # auto-resumes
+
+Kill the process at any point; rerunning the same two lines continues
+exactly where the uninterrupted run would be — params, optimizer slabs,
+RNG stream, and reported losses are bitwise-identical. A SIGTERM (or
+``train.request_preemption()``) exits with a typed ``PreemptedError``
+after a bounded-deadline fast checkpoint at the next slab boundary.
+"""
+from ..resilience import (  # noqa: F401  (typed error surface)
+    PreemptedError, RestartBudgetExceeded, CheckpointIncompleteError,
+    WatchdogTimeout,
+)
+from .preemption import (  # noqa: F401
+    request_preemption, preemption_requested, preemption_reason,
+    clear_preemption, signal_preemption,
+)
+from .checkpoint import TrainCheckpoint, TRAIN_STATE_FILE  # noqa: F401
+from .supervisor import TrainingSupervisor  # noqa: F401

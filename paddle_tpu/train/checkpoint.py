@@ -1,0 +1,79 @@
+"""Full-training-state checkpoints for exact resume.
+
+:class:`TrainCheckpoint` layers the elastic-training contract on the
+PR-1 :class:`~paddle_tpu.io.CheckpointSaver` (numbered, staged,
+manifest-verified, atomically renamed directories):
+
+- every checkpoint is a :func:`paddle_tpu.io.save_checkpoint` payload —
+  params + optimizer state slabs + the RNG stream position + the
+  dataset cursor (``train_state.json``), all manifest-covered
+- steady-state saves are ASYNC CheckFreq-style: the scope snapshot is
+  gathered synchronously (consistent even while training continues) and
+  hashing/fsync/rename happen on a background thread, so the step loop
+  only pays the host gather
+- :meth:`restore_latest` walks checkpoints newest -> oldest and SKIPS
+  corrupt or incomplete ones (a preempted process can die mid-commit on
+  a shared FS; the previous verified checkpoint must still win), only
+  raising when every checkpoint is unusable
+"""
+import os
+
+from .. import io as _io
+from ..resilience import CheckpointCorruptError
+
+TRAIN_STATE_FILE = _io.TRAIN_STATE_FILE
+
+
+class TrainCheckpoint:
+    """Numbered full-training-state checkpoints under ``dirname``."""
+
+    def __init__(self, dirname, max_to_keep=5,
+                 prefix="__train_checkpoint__"):
+        self.saver = _io.CheckpointSaver(dirname, max_to_keep=max_to_keep,
+                                         prefix=prefix)
+        self.dirname = dirname
+
+    # -- save --------------------------------------------------------------
+    def save(self, executor, program=None, scope=None, train_state=None,
+             async_save=False):
+        """Save a numbered checkpoint; returns its number. ``async_save``
+        snapshots now and writes in the background (call :meth:`wait`
+        before relying on durability)."""
+        extra = {TRAIN_STATE_FILE: dict(train_state or {})}
+        if async_save:
+            return self.saver.save_async(executor, main_program=program,
+                                         scope=scope, extra_files=extra)
+        return self.saver.save(executor, main_program=program,
+                               scope=scope, extra_files=extra)
+
+    def wait(self):
+        """Join pending async saves; re-raises the first failure."""
+        self.saver.wait()
+
+    def latest_no(self):
+        return self.saver.latest()[0]
+
+    # -- restore -----------------------------------------------------------
+    def restore_latest(self, executor, program=None, scope=None):
+        """Load the newest USABLE checkpoint into ``scope`` for exact
+        resume. Returns ``(number, train_state)`` — ``(None, None)``
+        when the directory holds no checkpoints. Corrupt/incomplete/
+        partially-written checkpoints are skipped with a warning (newest
+        first); if every checkpoint fails, the last error propagates."""
+        nums = self.saver.checkpoint_numbers()
+        last_exc = None
+        for no in reversed(nums):
+            path = self.saver._path(no)
+            try:
+                state = _io.load_checkpoint(executor, path,
+                                            main_program=program,
+                                            scope=scope)
+                return no, (state or {})
+            except (CheckpointCorruptError, RuntimeError) as exc:
+                last_exc = exc
+                print(f"[train] checkpoint {path} unusable "
+                      f"({type(exc).__name__}: {exc}); trying the "
+                      f"previous one")
+        if last_exc is not None:
+            raise last_exc
+        return None, None

@@ -1,0 +1,92 @@
+"""Preemption signaling for the elastic training loop.
+
+TPU pods are preemptible: the scheduler sends SIGTERM and gives the
+process a bounded grace window to flush state and exit. The reference
+Fluid stack absorbs this with trainer restart + PS-held state; here the
+contract is a process-wide preemption FLAG that the supervised training
+loop polls at every slab boundary — the next boundary after the flag is
+raised performs a bounded-deadline fast checkpoint and exits with a
+typed :class:`~paddle_tpu.resilience.PreemptedError`.
+
+Three triggers raise the flag:
+
+- a delivered signal while :func:`signal_preemption` is active
+  (SIGTERM/SIGINT by default — installed only on the main thread, the
+  only thread Python delivers signals to; prior handlers are restored
+  on exit)
+- :func:`request_preemption` — the in-process, testable trigger
+- any code holding a reference to this module (e.g. a cluster-agent
+  heartbeat thread) calling :func:`request_preemption`
+
+The flag is process-global on purpose: one trainer process is one
+preemption domain, and a supervisor restart must NOT clear a pending
+preemption (the scheduler is still coming for the process).
+"""
+import signal
+import threading
+from contextlib import contextmanager
+
+from ..resilience import PreemptedError  # noqa: F401  (re-export surface)
+
+_preempt = threading.Event()
+_reason = [None]
+
+
+def request_preemption(reason="requested"):
+    """Raise the process-wide preemption flag. Safe from any thread and
+    from signal handlers; idempotent (the first reason wins).
+
+    Deliberately LOCK-FREE: a handler for a second signal can run on
+    the main thread between any two bytecodes of the first handler, so
+    taking a non-reentrant lock here could deadlock the process inside
+    its own SIGTERM grace window. The check-then-set below is benign to
+    race — at worst a near-simultaneous second trigger's reason wins."""
+    if _reason[0] is None:
+        _reason[0] = str(reason)
+    _preempt.set()
+
+
+def preemption_requested():
+    """True once a preemption has been requested and not cleared."""
+    return _preempt.is_set()
+
+
+def preemption_reason():
+    """The first recorded trigger ("signal SIGTERM", "requested", ...)
+    or None."""
+    return _reason[0]
+
+
+def clear_preemption():
+    """Drop the flag — for tests and for a fresh training run in a
+    process that previously handled a preemption."""
+    _reason[0] = None
+    _preempt.clear()
+
+
+@contextmanager
+def signal_preemption(signals=(signal.SIGTERM, signal.SIGINT)):
+    """Route the given signals into :func:`request_preemption` while the
+    block runs. On a non-main thread this is a no-op passthrough (Python
+    only delivers signals to the main thread, and ``signal.signal``
+    refuses elsewhere). Prior handlers are restored on exit, so a
+    Ctrl-C AFTER training is a normal KeyboardInterrupt again."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    prev = {}
+
+    def _handler(signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        request_preemption(reason=f"signal {name}")
+
+    for s in signals:
+        prev[s] = signal.signal(s, _handler)
+    try:
+        yield
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)

@@ -1,0 +1,430 @@
+"""TrainingSupervisor: a killable-and-resumable elastic training loop.
+
+Any ``Executor.run_steps`` / ``train_from_dataset``-shaped loop, run
+under the PR-6 supervision idiom, with the guarantee the reference Fluid
+stack gets from trainer-restart + PS state — except BITWISE: a run that
+is killed at slab k and resumed continues exactly where the uninterrupted
+run would be (params, optimizer slabs, RNG stream, reported losses),
+because the checkpoint carries the FULL training state:
+
+- every persistable (params + optimizer accumulators + LR counters)
+- the ``@RNG_KEY@`` stream position
+- the dataset cursor — epoch, consumed-batch count, slab index, shuffle
+  seed — via the ``dataio.dataset.batch_iterator`` position API
+
+The loop composes four mechanisms:
+
+- **checkpointing** (:class:`~paddle_tpu.train.checkpoint.TrainCheckpoint`)
+  every ``FLAGS_checkpoint_every_n_slabs`` slabs, async CheckFreq-style
+  so steady-state overhead is the host gather, not the fsync
+- **preemption**: SIGTERM/SIGINT (under ``handle_signals=True``) or the
+  in-process :func:`~paddle_tpu.train.preemption.request_preemption`
+  raise a flag the loop polls at every slab boundary; the next boundary
+  runs a bounded-deadline (``FLAGS_preempt_deadline_s``) synchronous
+  fast checkpoint and exits with a typed ``PreemptedError`` — if the
+  save misses the deadline the previous verified checkpoint stands (the
+  orphaned staging dir is GC'd by the next saver)
+- **supervision**: each slab optionally runs under
+  ``resilience.run_with_watchdog`` (``step_watchdog_s``) so a hung fused
+  step trips a typed ``WatchdogTimeout`` instead of wedging the trainer;
+  ANY crash (watchdog, chaos fault, non-finite step, checkpoint-write
+  failure) restarts the loop from the newest verified checkpoint with
+  capped exponential backoff, bounded by ``FLAGS_train_restart_budget``
+  (then ``RestartBudgetExceeded`` chains the last failure). After a
+  watchdog trip the supervisor DEPOSES the old scope — the restarted
+  attempt runs on a fresh ``Scope`` so an abandoned hung worker thread
+  can never resurrect stale state into the live run (the PR-6 epoch-bump
+  idiom); ``sup.scope`` always names the live one
+- **rollback**: ``skip_nonfinite_steps`` passes through to the in-graph
+  PR-1/PR-3 rollback and composes with resume — a rolled-back slab is
+  rolled back identically on replay
+
+Chaos coverage: the slab path crosses the armed fault points
+``train.dispatch`` (executor), ``train.h2d`` (slab transfer),
+``dataio.producer`` (dataset), ``io.fsync_write``/``io.fsync``/
+``io.rename``/``io.commit`` (checkpoint), and — under a PS strategy —
+``ps.push_dense``/``ps.pull_dense``; the training chaos soak in
+tests/test_elastic_training.py proves typed-errors-only + bitwise-correct
+final params under sustained injection across all of them.
+"""
+import time
+from contextlib import nullcontext
+
+import numpy as np
+
+from ..flags import flag as _flag
+from ..framework.executor import Scope, global_scope, _device_put_slab
+from ..resilience import (PreemptedError, RestartBudgetExceeded,
+                          WatchdogTimeout, run_with_watchdog)
+from .checkpoint import TrainCheckpoint
+from . import preemption as _preempt
+
+
+class _ListSlabIter:
+    """Position-tracking iterator over a prestacked list of feed slabs —
+    the ``run_steps`` twin of the dataset position API."""
+
+    def __init__(self, slabs, start=0, epoch=0):
+        self._slabs = list(slabs)
+        self._i = int(start)
+        self._epoch = int(epoch)
+        self._skipped = int(start)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._i >= len(self._slabs):
+            raise StopIteration
+        out = self._slabs[self._i]
+        self._i += 1
+        return out
+
+    def position(self):
+        return {"epoch": self._epoch, "batches": self._i,
+                "slabs": self._i, "skipped": self._skipped,
+                "shuffle_seed": None}
+
+
+class TrainingSupervisor:
+    """Supervised, preemption-aware, exactly-resumable training loop.
+
+    ``program`` may be a plain Program or a mesh-wrapped
+    ``CompiledProgram`` (dp sharding resumes bitwise: checkpoints gather
+    to host, run_steps reshards on load). ``scope`` defaults to the
+    global scope; after a watchdog restart the supervisor continues on
+    a fresh internal scope — read ``sup.scope`` for the live one.
+    """
+
+    def __init__(self, executor, program, checkpoint_dir, *,
+                 startup_program=None, scope=None, steps_per_run=None,
+                 checkpoint_every_n_slabs=None, preempt_deadline_s=None,
+                 restart_budget=None, max_to_keep=5, step_watchdog_s=0.0,
+                 restart_backoff=0.05, max_backoff=2.0,
+                 handle_signals=False, skip_nonfinite_steps=False,
+                 shuffle_each_epoch=False, on_slab_end=None):
+        self.executor = executor
+        self.program = program
+        self.startup_program = startup_program
+        self._scope = scope or global_scope()
+        self.steps_per_run = int(steps_per_run if steps_per_run is not None
+                                 else max(1, _flag("steps_per_run")))
+        self.checkpoint_every_n_slabs = int(
+            checkpoint_every_n_slabs if checkpoint_every_n_slabs is not None
+            else _flag("checkpoint_every_n_slabs"))
+        self.preempt_deadline_s = float(
+            preempt_deadline_s if preempt_deadline_s is not None
+            else _flag("preempt_deadline_s"))
+        self.restart_budget = int(restart_budget if restart_budget is not None
+                                  else _flag("train_restart_budget"))
+        self.step_watchdog_s = float(step_watchdog_s)
+        self.restart_backoff = float(restart_backoff)
+        self.max_backoff = float(max_backoff)
+        self.handle_signals = bool(handle_signals)
+        self.skip_nonfinite_steps = bool(skip_nonfinite_steps)
+        self.shuffle_each_epoch = bool(shuffle_each_epoch)
+        self.on_slab_end = on_slab_end
+        self.checkpoint = TrainCheckpoint(checkpoint_dir,
+                                          max_to_keep=max_to_keep)
+        self._epoch0_order = None   # dataset load order, for reshuffles
+        # mesh programs must not device_put feeds ahead of the run (the
+        # run places them per the mesh sharding) — _train_fused idiom
+        from ..parallel.compiler import CompiledProgram
+        self._prefetch = not isinstance(program, CompiledProgram)
+        self._plain_program = (program.program
+                               if isinstance(program, CompiledProgram)
+                               else program)
+
+    @property
+    def scope(self):
+        """The live training scope (replaced by a fresh one after a
+        watchdog restart deposes a possibly-still-running worker)."""
+        return self._scope
+
+    # -- public entry points ----------------------------------------------
+    def resume(self):
+        """Load the newest verified checkpoint into the scope. Returns
+        its train_state dict, or None when starting fresh."""
+        no, state = self.checkpoint.restore_latest(
+            self.executor, program=self._plain_program, scope=self._scope)
+        return state if no is not None else None
+
+    def train(self, dataset, fetch_list=None, epochs=1,
+              collect_fetches=False):
+        """Supervised ``train_from_dataset``-shaped loop: ``dataset``
+        provides ``batch_iterator(slab=K, position=...)`` (duck-typed
+        datasets without those kwargs are wrapped). Auto-resumes from
+        the newest checkpoint in ``checkpoint_dir`` when one exists."""
+        k = self.steps_per_run
+
+        def make_iter(cursor):
+            try:
+                return dataset.batch_iterator(slab=k, position=cursor)
+            except TypeError:
+                # duck-typed dataset: collate + position-wrap here
+                from ..dataio.dataset import PositionedBatchIterator
+                return PositionedBatchIterator(
+                    iter(dataset.batch_iterator()), slab=k,
+                    epoch=cursor.get("epoch", 0),
+                    skip_batches=cursor.get("batches", 0))
+
+        # a supervisor reused with a different dataset must not restore
+        # the PREVIOUS dataset's load order on reshuffle
+        self._epoch0_order = None
+        return self._supervised(make_iter, dataset, fetch_list,
+                                int(epochs), collect_fetches)
+
+    def run_slabs(self, slabs, fetch_list=None, collect_fetches=False):
+        """Supervised ``run_steps``-shaped loop over a prestacked list
+        of feed slabs (each a dict with a leading K axis)."""
+        slabs = list(slabs)
+
+        def make_iter(cursor):
+            # one prestacked slab == one "batch" in cursor units
+            return _ListSlabIter(slabs, start=cursor.get("batches", 0),
+                                 epoch=cursor.get("epoch", 0))
+
+        return self._supervised(make_iter, None, fetch_list, 1,
+                                collect_fetches)
+
+    # -- the supervised outer loop ----------------------------------------
+    def _supervised(self, make_iter, dataset, fetch_list, epochs,
+                    collect_fetches):
+        restarts = 0
+        restart_errors = []
+        recoveries_ms = []
+        backoff = self.restart_backoff
+        pending_recovery_t0 = None
+        # collected fetches survive supervised restarts: slabs reported
+        # before a crash WERE reported; the resumed attempt re-reports
+        # from its checkpoint onward (later attempts win on overlap)
+        fetches = {} if collect_fetches else None
+        while True:
+            try:
+                result = self._attempt(make_iter, dataset, fetch_list,
+                                       epochs, fetches,
+                                       pending_recovery_t0, recoveries_ms)
+                result["restarts"] = restarts
+                result["restart_errors"] = list(restart_errors)
+                result["recoveries_ms"] = list(recoveries_ms)
+                return result
+            except (PreemptedError, KeyboardInterrupt):
+                raise
+            except Exception as exc:  # noqa: BLE001 — supervised restart
+                restarts += 1
+                restart_errors.append(type(exc).__name__)
+                if restarts > self.restart_budget:
+                    raise RestartBudgetExceeded(
+                        f"training crashed {restarts} time(s), exceeding "
+                        f"the restart budget of {self.restart_budget} "
+                        f"(FLAGS_train_restart_budget); last failure: "
+                        f"{type(exc).__name__}: {exc}",
+                        restarts=restarts,
+                        errors=restart_errors) from exc
+                print(f"[train] supervised restart {restarts}/"
+                      f"{self.restart_budget} after "
+                      f"{type(exc).__name__}: {exc} (backoff "
+                      f"{backoff * 1e3:.0f}ms)")
+                pending_recovery_t0 = time.monotonic()
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0, self.max_backoff)
+                # drain the crashed attempt's in-flight async saves
+                # BEFORE resuming: a stale parked failure must not
+                # re-raise at the next attempt's first wait() (a
+                # phantom crash burning restart budget), and resume()
+                # must not race a commit landing mid-restore
+                try:
+                    self.checkpoint.wait()
+                except Exception as stale:  # noqa: BLE001 — superseded
+                    print(f"[train] dropping failed async checkpoint "
+                          f"from the crashed attempt: "
+                          f"{type(stale).__name__}: {stale}")
+                # depose the old scope on EVERY restart: a hung watchdog
+                # worker may still be running (and must never commit a
+                # late step into the restarted attempt), and a crash
+                # before the first checkpoint must restart from the
+                # bitwise-identical fresh init, not half-trained state
+                self._scope = Scope()
+
+    # -- one attempt (fresh or resumed) -----------------------------------
+    def _attempt(self, make_iter, dataset, fetch_list, epochs,
+                 fetches, recovery_t0, recoveries_ms):
+        exe = self.executor
+        state = self.resume()
+        if state is None:
+            self._fresh_init(dataset)
+            state = {"epoch": 0, "batches": 0, "slab": 0, "step": 0,
+                     "shuffle_base_seed": self._base_seed(dataset)}
+        cursor_epoch = int(state.get("epoch", 0))
+        cursor_batches = int(state.get("batches", 0))
+        slab_idx = int(state.get("slab", 0))
+        step = int(state.get("step", 0))
+        base_seed = state.get("shuffle_base_seed")
+        checkpoints = 0
+        last_fetches = None
+        every_n = max(1, self.checkpoint_every_n_slabs)
+        with _preempt.signal_preemption() if self.handle_signals \
+                else nullcontext():
+            for epoch in range(cursor_epoch, max(1, epochs)):
+                self._maybe_shuffle(dataset, base_seed, epoch)
+                it = make_iter({"epoch": epoch,
+                                "batches": cursor_batches,
+                                "shuffle_seed": base_seed})
+                cur, cur_pos = self._pull(it)
+                while cur is not None:
+                    if _preempt.preemption_requested():
+                        self._preempt_exit(slab_idx, step, epoch,
+                                           cursor_batches, base_seed)
+                    nxt, nxt_pos = self._pull(it)
+                    out = self._run_slab(cur, fetch_list)
+                    k = int(np.shape(next(iter(cur.values())))[0])
+                    slab_idx += 1
+                    step += k
+                    cursor_batches = int(cur_pos["batches"])
+                    if recovery_t0 is not None:
+                        recoveries_ms.append(
+                            (time.monotonic() - recovery_t0) * 1e3)
+                        recovery_t0 = None
+                    if fetch_list:
+                        last_fetches = [np.asarray(v) for v in out]
+                        if fetches is not None:
+                            fetches[slab_idx - 1] = last_fetches
+                    if self.on_slab_end is not None:
+                        self.on_slab_end(slab_idx, step, last_fetches)
+                    if _preempt.preemption_requested():
+                        self._preempt_exit(slab_idx, step, epoch,
+                                           cursor_batches, base_seed)
+                    if slab_idx % every_n == 0:
+                        # CheckFreq staging: join the PREVIOUS persist
+                        # (usually done), snapshot now, write async
+                        self.checkpoint.wait()
+                        self.checkpoint.save(
+                            exe, program=self._plain_program,
+                            scope=self._scope,
+                            train_state=self._train_state(
+                                epoch, cursor_batches, slab_idx, step,
+                                base_seed),
+                            async_save=True)
+                        checkpoints += 1
+                    cur, cur_pos = nxt, nxt_pos
+                cursor_batches = 0
+        # final durable checkpoint: next-epoch cursor, synchronous
+        self.checkpoint.wait()
+        final_no = self.checkpoint.save(
+            exe, program=self._plain_program, scope=self._scope,
+            train_state=self._train_state(max(1, epochs), 0, slab_idx,
+                                          step, base_seed))
+        result = {"slabs": slab_idx, "steps": step,
+                  "epochs": max(1, epochs), "checkpoints": checkpoints + 1,
+                  "checkpoint_no": final_no, "last_fetches": last_fetches}
+        if fetches is not None:
+            result["fetches"] = fetches
+        return result
+
+    # -- helpers -----------------------------------------------------------
+    def _train_state(self, epoch, batches, slab, step, base_seed):
+        return {"epoch": epoch, "batches": batches, "slab": slab,
+                "step": step, "shuffle_base_seed": base_seed,
+                "steps_per_run": self.steps_per_run}
+
+    @staticmethod
+    def _base_seed(dataset):
+        return getattr(dataset, "_seed", None)
+
+    def _maybe_shuffle(self, dataset, base_seed, epoch):
+        """Deterministic per-epoch reshuffle: the samples are reset to
+        their load order and shuffled with seed = base + epoch, so the
+        permutation depends only on (base_seed, epoch) — a resumed OR
+        restarted run replays the SAME order the uninterrupted run drew
+        for this epoch before skipping to the cursor, no matter how many
+        shuffles the crashed attempt already applied in place."""
+        if not self.shuffle_each_epoch or dataset is None:
+            return
+        shuffle = getattr(dataset, "local_shuffle", None)
+        samples = getattr(dataset, "_samples", None)
+        if shuffle is None or samples is None or base_seed is None:
+            return
+        if self._epoch0_order is None:
+            self._epoch0_order = list(samples)
+        dataset._samples = list(self._epoch0_order)
+        dataset._seed = int(base_seed) + int(epoch)
+        shuffle()
+
+    def _fresh_init(self, dataset):
+        """No checkpoint: run the startup program when the scope lacks
+        any of the program's persistables (deterministic — the RNG chain
+        reseeds from program.random_seed, so a from-scratch restart is
+        bitwise the original fresh run)."""
+        if self.startup_program is None:
+            return
+        gb = self._plain_program.global_block()
+        missing = any(self._scope.find_var(v.name) is None
+                      for v in gb.vars.values()
+                      if getattr(v, "persistable", False)
+                      and v.type not in ("reader", "raw"))
+        if missing:
+            self.executor.run(self.startup_program, scope=self._scope)
+
+    def _pull(self, it):
+        """Advance the iterator and capture ITS position before the next
+        prefetch moves it — the checkpoint after slab i must record the
+        cursor at slab i, not at the prefetched slab i+1."""
+        slab = next(it, None)
+        if slab is None:
+            return None, None
+        pos = it.position()
+        if self._prefetch:
+            slab = _device_put_slab(slab, self._plain_program)
+        return slab, pos
+
+    def _run_slab(self, slab, fetch_list):
+        k = int(np.shape(next(iter(slab.values())))[0])
+        kwargs = dict(feed=slab, fetch_list=fetch_list,
+                      scope=self._scope, return_numpy=False,
+                      skip_nonfinite_steps=self.skip_nonfinite_steps)
+        if self.step_watchdog_s > 0:
+            return run_with_watchdog(
+                self.executor.run_steps, self.step_watchdog_s,
+                self.program, what=f"fused training slab ({k} steps)",
+                **kwargs)
+        return self.executor.run_steps(self.program, **kwargs)
+
+    def _preempt_exit(self, slab_idx, step, epoch, batches, base_seed):
+        """Bounded-deadline fast checkpoint, then typed exit. A save
+        that misses ``FLAGS_preempt_deadline_s`` is abandoned (its
+        staging dir is GC'd by the next saver); the previous verified
+        checkpoint stands."""
+        no = None
+        state = self._train_state(epoch, batches, slab_idx, step,
+                                  base_seed)
+
+        def _fast_save():
+            self.checkpoint.wait()     # pending async persists count too
+            return self.checkpoint.save(
+                self.executor, program=self._plain_program,
+                scope=self._scope, train_state=state)
+
+        try:
+            if self.preempt_deadline_s > 0:
+                no = run_with_watchdog(_fast_save, self.preempt_deadline_s,
+                                       what="preemption fast checkpoint")
+            else:
+                no = _fast_save()
+        except WatchdogTimeout:
+            # the overbudget worker cannot be cancelled, but it must not
+            # publish a checkpoint AFTER we report it nonexistent —
+            # abandon every in-flight number so its eventual commit is
+            # dropped and the staging dir removed
+            self.checkpoint.saver.abandon_inflight()
+            no = self.checkpoint.latest_no()
+        except Exception as exc:  # noqa: BLE001 — exit beats durability
+            print(f"[train] preemption checkpoint failed "
+                  f"({type(exc).__name__}: {exc}); the previous "
+                  f"checkpoint stands")
+            no = self.checkpoint.latest_no()
+        reason = _preempt.preemption_reason() or "requested"
+        raise PreemptedError(
+            f"training preempted ({reason}) at slab {slab_idx} "
+            f"(step {step}); newest durable checkpoint: "
+            f"{no if no is not None else 'none'}",
+            slab=slab_idx, step=step, checkpoint_no=no, reason=reason)

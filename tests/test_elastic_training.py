@@ -1,0 +1,649 @@
+"""Elastic training (paddle_tpu/train): preemption-aware checkpointing,
+bitwise-deterministic resume, and the chaos-hardened supervised loop.
+
+The core guarantee under test: a training run killed at slab k (via the
+in-process preemption trigger, a SIGTERM, or an injected chaos fault)
+and resumed from its checkpoint produces params / optimizer slabs / RNG
+stream / reported losses BITWISE-identical to the uninterrupted run —
+including under a dp mesh and with skip_nonfinite_steps rollback active.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, resilience, train
+from paddle_tpu import io as fio
+from paddle_tpu.framework.executor import RNG_STATE_NAME
+from paddle_tpu.resilience import (CheckpointCorruptError,
+                                   CheckpointIncompleteError,
+                                   RestartBudgetExceeded, WatchdogTimeout)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_shared_cache = {}
+
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    train.clear_preemption()
+    yield
+    train.clear_preemption()
+
+
+def _shared():
+    """One program + executor reused by every parity test (separate
+    scopes and checkpoint dirs keep the tests independent; sharing the
+    program keeps the fused executable compiled once)."""
+    if not _shared_cache:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], dtype="float32")
+            y = layers.data("y", [-1, 1], dtype="float32")
+            h = layers.fc(x, 16, act="relu")
+            h = layers.dropout(h, dropout_prob=0.3)
+            loss = layers.mean(
+                layers.square_error_cost(layers.fc(h, 1), y))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        _shared_cache.update(main=main, startup=startup, loss=loss,
+                             exe=fluid.Executor())
+    c = _shared_cache
+    return c["main"], c["startup"], c["loss"], c["exe"]
+
+
+def _slabs(n=6, k=4, batch=8, bad_at=None):
+    """n prestacked feed slabs of k steps; `bad_at=(slab, step)` plants
+    an inf batch for the skip_nonfinite composition tests."""
+    out = []
+    for i in range(n):
+        r = np.random.default_rng(i)
+        s = {"x": r.standard_normal((k, batch, 4)).astype(np.float32),
+             "y": r.standard_normal((k, batch, 1)).astype(np.float32)}
+        if bad_at is not None and bad_at[0] == i:
+            s["x"][bad_at[1], 0, 0] = np.inf
+        out.append(s)
+    return out
+
+
+def _key_data(v):
+    if jax.dtypes.issubdtype(getattr(v, "dtype", None),
+                             jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(v))
+    return np.asarray(v)
+
+
+def _assert_scopes_bitwise_equal(s1, s2):
+    names = sorted(s1.keys())
+    assert names == sorted(s2.keys())
+    for n in names:
+        a, b = _key_data(s1.find_var(n)), _key_data(s2.find_var(n))
+        eq = (np.array_equal(a, b, equal_nan=True)
+              if a.dtype.kind in "fc" else np.array_equal(a, b))
+        assert eq, f"scope var {n!r} diverged between runs"
+
+
+def _assert_fetch_overlap_equal(r_clean, r_other):
+    assert r_other["fetches"], "no fetches collected"
+    for i in sorted(r_other["fetches"]):
+        a = r_clean["fetches"][i][0]
+        b = r_other["fetches"][i][0]
+        assert np.array_equal(a, b, equal_nan=True), \
+            f"reported losses diverged at slab {i}"
+
+
+def _supervisor(ckpt_dir, program=None, **kw):
+    main, startup, loss, exe = _shared()
+    kw.setdefault("steps_per_run", 4)
+    kw.setdefault("checkpoint_every_n_slabs", 2)
+    kw.setdefault("scope", fluid.Scope())
+    kw.setdefault("restart_backoff", 0.01)
+    return train.TrainingSupervisor(
+        exe, program if program is not None else main, ckpt_dir,
+        startup_program=startup, **kw)
+
+
+def _clean_run(tmp, **kw):
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(os.path.join(tmp, "clean"), **kw)
+    return sup, sup.run_slabs(_slabs(), fetch_list=[loss],
+                              collect_fetches=True)
+
+
+def _dataset(n_batches=24, batch=8):
+    main, startup, loss, exe = _shared()
+    gb = main.global_block()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(batch)
+    ds.set_use_var([gb.var("x"), gb.var("y")])
+    r = np.random.default_rng(7)
+    ds._samples = [(r.standard_normal(4).astype(np.float32),
+                    r.standard_normal(1).astype(np.float32))
+                   for _ in range(batch * n_batches)]
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# io.save_checkpoint / load_checkpoint (full-state round-trip, typed errors)
+# ---------------------------------------------------------------------------
+
+def test_save_load_checkpoint_roundtrips_opt_state_and_rng(tmp_path):
+    main, startup, loss, exe = _shared()
+    slabs = _slabs(4)
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        exe.run_steps(main, feed=slabs[0], fetch_list=[loss])
+        fio.save_checkpoint(exe, str(tmp_path / "ck"), main_program=main,
+                            train_state={"slab": 1})
+        ref = [np.asarray(exe.run_steps(main, feed=s,
+                                        fetch_list=[loss])[0])
+               for s in slabs[1:]]
+    s2 = fluid.Scope()
+    with fluid.scope_guard(s2):
+        state = fio.load_checkpoint(exe, str(tmp_path / "ck"),
+                                    main_program=main)
+        assert state == {"slab": 1}
+        got = [np.asarray(exe.run_steps(main, feed=s,
+                                        fetch_list=[loss])[0])
+               for s in slabs[1:]]
+    for a, b in zip(ref, got):
+        assert np.array_equal(a, b)   # moments + RNG stream round-tripped
+    _assert_scopes_bitwise_equal(s1, s2)
+
+
+def test_load_checkpoint_params_only_raises_typed(tmp_path):
+    main, startup, loss, exe = _shared()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed=_slabs(1)[0], fetch_list=[loss])
+        fio.save_params(exe, str(tmp_path / "params"), main_program=main)
+    with pytest.raises(CheckpointIncompleteError) as ei:
+        fio.load_checkpoint(exe, str(tmp_path / "params"),
+                            main_program=main, scope=fluid.Scope())
+    assert "optimizer state" in str(ei.value)
+    assert ei.value.missing
+    # a CheckpointIncompleteError IS a CheckpointCorruptError for
+    # existing handlers (unusable checkpoint)
+    assert isinstance(ei.value, CheckpointCorruptError)
+
+
+def test_load_checkpoint_missing_rng_raises_unless_lenient(tmp_path):
+    main, startup, loss, exe = _shared()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed=_slabs(1)[0], fetch_list=[loss])
+        # full persistables but NO extra_state: the RNG record is absent
+        fio.save_vars(exe, str(tmp_path / "norng"), main_program=main,
+                      predicate=fio.is_persistable)
+    with pytest.raises(CheckpointIncompleteError) as ei:
+        fio.load_checkpoint(exe, str(tmp_path / "norng"),
+                            main_program=main, scope=fluid.Scope())
+    assert RNG_STATE_NAME in ei.value.missing
+    # lenient mode tolerates pre-upgrade checkpoints
+    fio.load_checkpoint(exe, str(tmp_path / "norng"), main_program=main,
+                        scope=fluid.Scope(), strict=False)
+
+
+def test_train_state_is_manifest_covered(tmp_path):
+    main, startup, loss, exe = _shared()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed=_slabs(1)[0], fetch_list=[loss])
+        fio.save_checkpoint(exe, str(tmp_path / "ck"), main_program=main,
+                            train_state={"slab": 1})
+    sp = tmp_path / "ck" / fio.TRAIN_STATE_FILE
+    sp.write_text(json.dumps({"slab": 999}))   # torn/corrupted cursor
+    with pytest.raises(CheckpointCorruptError):
+        fio.load_checkpoint(exe, str(tmp_path / "ck"), main_program=main,
+                            scope=fluid.Scope())
+
+
+# ---------------------------------------------------------------------------
+# CheckpointSaver stale-temp GC
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_saver_gcs_stale_temps(tmp_path):
+    d = str(tmp_path / "cks")
+    os.makedirs(os.path.join(d, "__paddle_checkpoint__3.tmp"))
+    with open(os.path.join(d, "__paddle_checkpoint__3.tmp",
+                           "w.npy.tmp"), "w") as f:
+        f.write("half-written")
+    with open(os.path.join(d, "junk.npy.tmp"), "w") as f:
+        f.write("orphan")
+    saver = fluid.CheckpointSaver(d)     # startup GC
+    assert not any(e.endswith(".tmp") for e in os.listdir(d))
+    # in-flight staging survives GC (reserved number)
+    no, stage = saver._stage()
+    os.makedirs(stage, exist_ok=True)
+    saver._gc_stale_temps()
+    assert os.path.isdir(stage)
+    saver._release(no)
+    saver._gc_stale_temps()
+    assert not os.path.isdir(stage)
+
+
+def test_failed_save_temp_gced_by_next_saver(tmp_path, fault_points):
+    main, startup, loss, exe = _shared()
+    d = str(tmp_path / "cks")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck = train.TrainCheckpoint(d)
+        ck.save(exe, program=main, scope=scope, train_state={})
+        with fault_points.fault_injection("io.rename", exc=OSError,
+                                         times=1):
+            with pytest.raises(OSError):
+                ck.save(exe, program=main, scope=scope, train_state={})
+    assert any(e.endswith(".tmp") for e in os.listdir(d))   # the leak
+    ck2 = train.TrainCheckpoint(d)                          # startup GC
+    assert not any(e.endswith(".tmp") for e in os.listdir(d))
+    # the earlier committed checkpoint is untouched and loadable
+    no, state = ck2.restore_latest(exe, program=main,
+                                   scope=fluid.Scope())
+    assert no == 0
+
+
+# ---------------------------------------------------------------------------
+# dataset position API
+# ---------------------------------------------------------------------------
+
+def test_positioned_iterator_resumes_bitwise():
+    ds = _dataset(n_batches=10)
+    it = ds.batch_iterator(position={"epoch": 0, "batches": 0})
+    first = [next(it) for _ in range(4)]
+    pos = it.position()
+    assert pos["batches"] == 4 and pos["skipped"] == 0
+    rest = list(it)
+    it2 = ds.batch_iterator(position=pos)
+    assert it2.position()["skipped"] == 4   # buffered-reader skip count
+    rest2 = list(it2)
+    assert len(rest) == len(rest2) == 6
+    for a, b in zip(rest, rest2):
+        for n in a:
+            assert np.array_equal(a[n], b[n])
+
+
+def test_positioned_iterator_slab_counts():
+    ds = _dataset(n_batches=10)
+    it = ds.batch_iterator(slab=4, position={"epoch": 2, "batches": 0})
+    s1 = next(it)
+    assert next(iter(s1.values())).shape[0] == 4
+    assert it.position() == {"epoch": 2, "batches": 4, "slabs": 1,
+                             "skipped": 0, "shuffle_seed": 0}
+    list(it)
+    assert it.position()["batches"] == 10   # tail slab counted exactly
+    # resume mid-stream on a slab boundary
+    it3 = ds.batch_iterator(slab=4, position={"epoch": 2, "batches": 4})
+    s2 = next(it3)
+    assert it3.position()["batches"] == 8
+    ref = ds.batch_iterator(slab=4)
+    next(ref)
+    s2_ref = next(ref)
+    for n in s2:
+        assert np.array_equal(s2[n], s2_ref[n])
+
+
+def test_producer_fault_point_armed(fault_points):
+    ds = _dataset(n_batches=4)
+    with fault_points.fault_injection("dataio.producer",
+                                      exc=RuntimeError, times=1):
+        with pytest.raises(RuntimeError):
+            list(ds.batch_iterator())
+
+
+# ---------------------------------------------------------------------------
+# bitwise resume parity (the acceptance core)
+# ---------------------------------------------------------------------------
+
+def test_preempt_resume_bitwise_run_slabs(tmp_path):
+    main, startup, loss, exe = _shared()
+    sup1, r1 = _clean_run(str(tmp_path))
+
+    def cb(slab, step, fetches):
+        if slab == 3:
+            train.request_preemption("test")
+
+    sup2 = _supervisor(str(tmp_path / "pre"), on_slab_end=cb)
+    with pytest.raises(train.PreemptedError) as ei:
+        sup2.run_slabs(_slabs(), fetch_list=[loss], collect_fetches=True)
+    assert ei.value.slab == 3 and ei.value.checkpoint_no is not None
+    train.clear_preemption()
+
+    sup3 = _supervisor(str(tmp_path / "pre"))
+    r3 = sup3.run_slabs(_slabs(), fetch_list=[loss], collect_fetches=True)
+    assert sorted(r3["fetches"]) == [3, 4, 5]   # resumed exactly at k
+    _assert_fetch_overlap_equal(r1, r3)
+    _assert_scopes_bitwise_equal(sup1.scope, sup3.scope)
+
+
+def test_preempt_resume_bitwise_dataset(tmp_path):
+    main, startup, loss, exe = _shared()
+    ds = _dataset()
+    sup1 = _supervisor(str(tmp_path / "clean"))
+    r1 = sup1.train(ds, fetch_list=[loss], collect_fetches=True)
+    assert r1["slabs"] == 6 and r1["steps"] == 24
+
+    def cb(slab, step, fetches):
+        if slab == 3:
+            train.request_preemption("test")
+
+    sup2 = _supervisor(str(tmp_path / "pre"), on_slab_end=cb)
+    with pytest.raises(train.PreemptedError):
+        sup2.train(ds, fetch_list=[loss], collect_fetches=True)
+    train.clear_preemption()
+    sup3 = _supervisor(str(tmp_path / "pre"))
+    r3 = sup3.train(ds, fetch_list=[loss], collect_fetches=True)
+    assert sorted(r3["fetches"]) == [3, 4, 5]
+    _assert_fetch_overlap_equal(r1, r3)
+    _assert_scopes_bitwise_equal(sup1.scope, sup3.scope)
+
+
+def test_chaos_kill_restart_bitwise(tmp_path):
+    """A chaos fault at slab 4's dispatch crashes the loop; the
+    supervisor restarts from the newest checkpoint and the finished run
+    is bitwise the uninterrupted one."""
+    main, startup, loss, exe = _shared()
+    sup1, r1 = _clean_run(str(tmp_path))
+    sup2 = _supervisor(str(tmp_path / "chaos"), checkpoint_every_n_slabs=1)
+    with resilience.chaos({"train.dispatch": {"after": 3, "times": 1}}):
+        r2 = sup2.run_slabs(_slabs(), fetch_list=[loss],
+                            collect_fetches=True)
+    assert r2["restarts"] == 1
+    assert r2["restart_errors"] == ["FaultInjected"]
+    assert r2["recoveries_ms"] and r2["recoveries_ms"][0] > 0
+    _assert_fetch_overlap_equal(r1, r2)
+    _assert_scopes_bitwise_equal(sup1.scope, sup2.scope)
+
+
+def test_crash_before_first_checkpoint_restarts_from_scratch(tmp_path):
+    """No checkpoint yet -> the restart re-runs the startup program in a
+    fresh scope; the from-scratch replay is bitwise the clean run."""
+    main, startup, loss, exe = _shared()
+    sup1, r1 = _clean_run(str(tmp_path))
+    sup2 = _supervisor(str(tmp_path / "early"),
+                       checkpoint_every_n_slabs=100)
+    with resilience.chaos({"train.dispatch": {"after": 1, "times": 1}}):
+        r2 = sup2.run_slabs(_slabs(), fetch_list=[loss],
+                            collect_fetches=True)
+    assert r2["restarts"] == 1
+    _assert_fetch_overlap_equal(r1, r2)
+    _assert_scopes_bitwise_equal(sup1.scope, sup2.scope)
+
+
+def test_mesh_dp_resume_parity(tmp_path):
+    """Preempt/resume under mesh(dp=8): checkpoints gather the sharded
+    state to host; the resumed run reshards and continues bitwise."""
+    from paddle_tpu.parallel.compiler import CompiledProgram
+    from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+    main, startup, loss, exe = _shared()
+    mesh = make_mesh(MeshConfig(dp=8))
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name,
+                                                 mesh=mesh)
+    sup1 = _supervisor(str(tmp_path / "clean"), program=cp)
+    r1 = sup1.run_slabs(_slabs(), fetch_list=[loss], collect_fetches=True)
+
+    def cb(slab, step, fetches):
+        if slab == 3:
+            train.request_preemption("test")
+
+    sup2 = _supervisor(str(tmp_path / "pre"), program=cp, on_slab_end=cb)
+    with pytest.raises(train.PreemptedError):
+        sup2.run_slabs(_slabs(), fetch_list=[loss], collect_fetches=True)
+    train.clear_preemption()
+    sup3 = _supervisor(str(tmp_path / "pre"), program=cp)
+    r3 = sup3.run_slabs(_slabs(), fetch_list=[loss], collect_fetches=True)
+    _assert_fetch_overlap_equal(r1, r3)
+    _assert_scopes_bitwise_equal(sup1.scope, sup3.scope)
+
+
+def test_skip_nonfinite_rollback_composes_with_resume(tmp_path):
+    """An inf batch mid-slab is rolled back in-graph; the rollback
+    replays identically on the resumed run."""
+    main, startup, loss, exe = _shared()
+    bad = _slabs(bad_at=(4, 1))
+    sup1 = _supervisor(str(tmp_path / "clean"),
+                       skip_nonfinite_steps=True)
+    r1 = sup1.run_slabs(bad, fetch_list=[loss], collect_fetches=True)
+
+    def cb(slab, step, fetches):
+        if slab == 3:
+            train.request_preemption("test")
+
+    sup2 = _supervisor(str(tmp_path / "pre"), skip_nonfinite_steps=True,
+                       on_slab_end=cb)
+    with pytest.raises(train.PreemptedError):
+        sup2.run_slabs(bad, fetch_list=[loss], collect_fetches=True)
+    train.clear_preemption()
+    sup3 = _supervisor(str(tmp_path / "pre"), skip_nonfinite_steps=True)
+    r3 = sup3.run_slabs(bad, fetch_list=[loss], collect_fetches=True)
+    _assert_fetch_overlap_equal(r1, r3)
+    _assert_scopes_bitwise_equal(sup1.scope, sup3.scope)
+
+
+def test_load_checkpoint_single_archive_roundtrip(tmp_path):
+    """A complete save_persistables(filename=...) archive is a valid
+    exact-resume payload, not a false 'params-only' refusal."""
+    main, startup, loss, exe = _shared()
+    s1 = fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        exe.run_steps(main, feed=_slabs(1)[0], fetch_list=[loss])
+        fio.save_persistables(exe, str(tmp_path / "ar"),
+                              main_program=main, filename="all")
+    s2 = fluid.Scope()
+    fio.load_checkpoint(exe, str(tmp_path / "ar"), main_program=main,
+                        scope=s2, filename="all")
+    _assert_scopes_bitwise_equal(s1, s2)
+
+
+def test_steps_per_run_1_dataset_parity(tmp_path):
+    """steps_per_run=1 must run one step per BATCH (1-step slabs), not
+    misread the batch axis as K — and stays bitwise with the fused K=4
+    run over the same stream."""
+    main, startup, loss, exe = _shared()
+    ds = _dataset()
+    sup1 = _supervisor(str(tmp_path / "k4"))
+    r1 = sup1.train(ds, fetch_list=[loss])
+    sup2 = _supervisor(str(tmp_path / "k1"), steps_per_run=1,
+                       checkpoint_every_n_slabs=8)
+    r2 = sup2.train(ds, fetch_list=[loss])
+    assert r2["steps"] == r1["steps"] == 24   # 24 batches = 24 steps
+    assert r2["slabs"] == 24
+    _assert_scopes_bitwise_equal(sup1.scope, sup2.scope)
+
+
+# ---------------------------------------------------------------------------
+# supervision: hangs, budgets, deadlines, signals
+# ---------------------------------------------------------------------------
+
+def test_hung_step_trips_watchdog_and_restarts(tmp_path):
+    """A stalled fused step (chaos delay > watchdog budget) raises a
+    typed WatchdogTimeout; the supervisor deposes the hung worker's
+    scope, restarts from checkpoint, and still finishes bitwise."""
+    main, startup, loss, exe = _shared()
+    sup1, r1 = _clean_run(str(tmp_path))
+    sup2 = _supervisor(str(tmp_path / "hang"), checkpoint_every_n_slabs=1,
+                       step_watchdog_s=0.4)
+    with resilience.chaos({"train.dispatch":
+                           {"after": 3, "times": 1, "delay": 1.5}}):
+        r2 = sup2.run_slabs(_slabs(), fetch_list=[loss],
+                            collect_fetches=True)
+    assert "WatchdogTimeout" in r2["restart_errors"]
+    # let the abandoned worker finish its late commit into the DEPOSED
+    # scope, then prove it never reached the live one
+    time.sleep(1.3)
+    _assert_fetch_overlap_equal(r1, r2)
+    _assert_scopes_bitwise_equal(sup1.scope, sup2.scope)
+
+
+def test_restart_budget_exceeded_typed(tmp_path):
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(str(tmp_path / "budget"), restart_budget=2)
+    with resilience.chaos("train.dispatch"):   # every dispatch crashes
+        with pytest.raises(RestartBudgetExceeded) as ei:
+            sup.run_slabs(_slabs(2), fetch_list=[loss])
+    assert ei.value.restarts == 3
+    assert set(ei.value.errors) == {"FaultInjected"}
+    assert isinstance(ei.value.__cause__, resilience.FaultInjected)
+
+
+def test_preempt_fast_checkpoint_bounded_deadline(tmp_path):
+    """A checkpoint write stalled past FLAGS_preempt_deadline_s does not
+    block the preemption exit: the save is abandoned and PreemptedError
+    reports the newest DURABLE checkpoint (none here — periodic saves
+    are disabled so the stalled fast save is the first)."""
+    main, startup, loss, exe = _shared()
+
+    def cb(slab, step, fetches):
+        if slab == 3:
+            train.request_preemption("test")
+
+    sup = _supervisor(str(tmp_path / "dl"),
+                      checkpoint_every_n_slabs=100,
+                      preempt_deadline_s=0.3, on_slab_end=cb)
+    t0 = time.monotonic()
+    with resilience.chaos({"io.fsync_write": {"delay": 1.2, "times": 1}}):
+        with pytest.raises(train.PreemptedError) as ei:
+            sup.run_slabs(_slabs(), fetch_list=[loss])
+        elapsed = time.monotonic() - t0
+    assert elapsed < 1.1, f"preempt exit took {elapsed:.1f}s"
+    assert ei.value.checkpoint_no is None   # nothing durable yet
+    assert ei.value.slab == 3
+    # the abandoned worker finishes its stalled write later — its commit
+    # must be DROPPED (the caller already reported no durable
+    # checkpoint), and its staging dir removed
+    time.sleep(1.6)
+    assert sup.checkpoint.latest_no() is None
+    assert not any(e.endswith(".tmp")
+                   for e in os.listdir(str(tmp_path / "dl")))
+
+
+def test_sigterm_triggers_typed_preemption(tmp_path):
+    main, startup, loss, exe = _shared()
+
+    def cb(slab, step, fetches):
+        if slab == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    sup = _supervisor(str(tmp_path / "sig"), handle_signals=True,
+                      on_slab_end=cb)
+    with pytest.raises(train.PreemptedError) as ei:
+        sup.run_slabs(_slabs(), fetch_list=[loss])
+    assert ei.value.reason == "signal SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is prev   # handler restored
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: typed errors only, no leaked temps, bitwise-correct params
+# ---------------------------------------------------------------------------
+
+_SOAK_TYPED = {"FaultInjected", "WatchdogTimeout",
+               "CheckpointCorruptError", "CheckpointIncompleteError"}
+# only typed errors may surface from the supervised loop under chaos;
+# an AttributeError/KeyError/etc. in restart_errors is a recovery bug
+
+
+def _soak(tmp_path, points, slabs_n, budget, every_n=1):
+    main, startup, loss, exe = _shared()
+    feed = _slabs(slabs_n)
+    sup1 = _supervisor(str(tmp_path / "clean"))
+    r1 = sup1.run_slabs(feed, fetch_list=[loss], collect_fetches=True)
+    ckdir = str(tmp_path / "soak")
+    sup2 = _supervisor(ckdir, checkpoint_every_n_slabs=every_n,
+                       restart_budget=budget, max_backoff=0.05)
+    with resilience.chaos(points, seed=11) as monkey:
+        r2 = sup2.run_slabs(feed, fetch_list=[loss], collect_fetches=True)
+    assert monkey.total_fired() > 0, "soak injected nothing"
+    assert set(r2["restart_errors"]) <= _SOAK_TYPED, r2["restart_errors"]
+    leaked = [e for e in os.listdir(ckdir) if e.endswith(".tmp")]
+    assert not leaked, f"leaked temps: {leaked}"
+    _assert_fetch_overlap_equal(r1, r2)
+    _assert_scopes_bitwise_equal(sup1.scope, sup2.scope)
+    return r2, monkey
+
+
+def test_train_chaos_mini_soak(tmp_path):
+    """Fast tier-1 soak: faults across dispatch / h2d / dataset-producer
+    / checkpoint-write stages; the supervised loop must finish with only
+    typed errors, no leaked temps, and bitwise-correct final params."""
+    r2, monkey = _soak(
+        tmp_path,
+        {"train.dispatch": {"p": 0.1},
+         "train.h2d": {"p": 0.05},
+         "dataio.producer": {"p": 0.02},
+         "io.fsync_write": {"p": 0.03}},
+        slabs_n=6, budget=60)
+    assert r2["restarts"] > 0
+
+
+@pytest.mark.slow
+def test_train_chaos_soak(tmp_path):
+    """Sustained soak across every training fault stage, including the
+    checkpoint fsync/rename/commit points."""
+    r2, monkey = _soak(
+        tmp_path,
+        {"train.dispatch": {"p": 0.12},
+         "train.h2d": {"p": 0.08},
+         "dataio.producer": {"p": 0.04},
+         "io.fsync_write": {"p": 0.05},
+         "io.fsync": {"p": 0.03},
+         "io.rename": {"p": 0.03},
+         "io.commit": {"p": 0.05}},
+        slabs_n=10, budget=400, every_n=1)
+    assert r2["restarts"] > 3
+    assert sum(monkey.fired.values()) > 10
+
+
+# ---------------------------------------------------------------------------
+# fleet + bench integration
+# ---------------------------------------------------------------------------
+
+def test_fleet_load_checkpoint_typed_on_incomplete(tmp_path):
+    """fleet.load_checkpoint refuses a checkpoint whose optimizer slabs
+    were deleted, with the typed actionable error."""
+    from paddle_tpu.incubate.fleet.collective import (Collective,
+                                                      TrainStatus)
+    main, startup, loss, exe = _shared()
+    scope = fluid.Scope()
+    fleet_obj = Collective()
+    fleet_obj._origin_program = main
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed=_slabs(1)[0], fetch_list=[loss])
+        path = str(tmp_path / "fleet_ck")
+        fleet_obj.save_checkpoint(exe, path, TrainStatus(3))
+        no, ck = fleet_obj._saver(path).latest()
+        # delete one optimizer slab: resume would silently reset it
+        victim = next(f for f in os.listdir(ck) if "moment" in f)
+        os.remove(os.path.join(ck, victim))
+        with pytest.raises(CheckpointIncompleteError):
+            fleet_obj.load_checkpoint(exe, path)
+
+
+def test_bench_train_chaos_smoke():
+    """bench.py --config train_chaos CPU smoke: reports checkpoint
+    overhead and the preempt/resume/recovery latencies."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--config",
+         "train_chaos"], capture_output=True, text=True, timeout=420,
+        env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["unit"] == "ms"
+    assert rec["value"] is not None and rec["value"] >= 0
+    assert rec["checkpoint_overhead_pct"] is not None
+    assert rec["resume_to_first_step_ms"] > 0
+    assert rec["kill_resume_recovery_ms"] > 0

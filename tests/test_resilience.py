@@ -648,6 +648,12 @@ def test_watchdog_bounds_serving_execute(fault_points):
         ok = q.put(Request({"x": np.zeros((1, 2), np.float32)}))
         ok.wait(timeout=5)           # the loop survived the hang
         assert mb.alive()
+        # the success resets the failure streak, but set_result wakes
+        # this thread BEFORE the loop thread performs the reset — poll
+        # briefly instead of racing it
+        deadline = time.monotonic() + 2.0
+        while mb.consecutive_failures and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert mb.consecutive_failures == 0   # reset by the success
     finally:
         mb.stop()
