@@ -854,6 +854,34 @@ def bench_passes():
                 "compile_ms": round(st["compile_ms"] - st0["compile_ms"],
                                     1),
             }
+        # verifier overhead (FLAGS_verify_passes, framework/analysis.py):
+        # per-pass translation validation wall time vs the pipeline
+        # itself — medians over repeats on the same program, verify off
+        # (pure pass cost) vs on (validation cost from passes.stats()).
+        # The production default is OFF; this is what turning it on
+        # would cost per compile-cache miss.
+        old_verify = fluid.get_flags("FLAGS_verify_passes")[
+            "FLAGS_verify_passes"]
+        reps = 7
+        try:
+            fluid.set_flags({"FLAGS_program_passes": "1",
+                             "FLAGS_verify_passes": False})
+            pass_samples = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                P.optimize_program(main_prog,
+                                   fetch_names=[out["loss"].name])
+                pass_samples.append((time.perf_counter() - t0) * 1e3)
+            fluid.set_flags({"FLAGS_verify_passes": True})
+            verify_samples = []
+            for _ in range(reps):
+                P.optimize_program(main_prog,
+                                   fetch_names=[out["loss"].name])
+                verify_samples.append(P.stats()["verify_ms"])
+        finally:
+            fluid.set_flags({"FLAGS_verify_passes": old_verify})
+        pass_med = sorted(pass_samples)[reps // 2]
+        verify_med = sorted(verify_samples)[reps // 2]
     finally:
         fluid.set_flags({"FLAGS_program_passes": old})
     on, off = sides["passes_on"], sides["passes_off"]
@@ -868,6 +896,9 @@ def bench_passes():
                                               3),
         "op_count_reduction": (off["lowered_op_count"]
                                - on["lowered_op_count"]),
+        "verify_ms": round(verify_med, 2),
+        "verify_pct_of_pass_ms": round(
+            100.0 * verify_med / max(pass_med, 1e-9), 1),
         "passes_on": on,
         "passes_off": off,
     }

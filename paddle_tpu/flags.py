@@ -95,6 +95,13 @@ _DEFS = {
     # unoptimized lowering bitwise; or an explicit comma-separated pass
     # list (e.g. "dce,cse") run in canonical registry order
     "program_passes": ("1", str, None),
+    # per-pass translation validation (framework/analysis.py): verify
+    # every pass's output program and the user program on compile-cache
+    # misses, raising typed ProgramVerifyError with pass provenance.
+    # Off by default (the hot path pays nothing); tests/CI turn it on
+    # (tests/conftest.py), and `python tools/lint_program.py` runs the
+    # same checkers standalone
+    "verify_passes": (False, bool, None),
     # flattened-concat byte cap per fused-optimizer bucket (multi-tensor
     # apply): same-(op, dtype, hyperparam) update ops group into buckets
     # of at most this many megabytes of parameters
@@ -134,6 +141,25 @@ _DEFS = {
     "tracer_profile_fname": ("", str, None),
     "selected_tpus": ("", str, None),
 }
+
+# Accepted-but-inert compatibility knobs: declared so reference launch
+# scripts (CUDA allocator tuning, communicator threading, eager GC) run
+# unchanged, but nothing on the TPU path reads them — XLA owns what they
+# governed. tools/lint_flags.py enforces that every OTHER declared flag
+# is actually referenced somewhere in paddle_tpu/ (and that every
+# FLAGS_* reference is declared); a new flag is either read by code or
+# belongs in this set.
+_COMPAT_ONLY = frozenset({
+    "allocator_strategy", "benchmark",
+    "communicator_independent_recv_thread",
+    "communicator_max_merge_var_num", "communicator_send_queue_size",
+    "cpu_deterministic", "cudnn_deterministic",
+    "eager_delete_tensor_gb", "fast_eager_deletion_mode",
+    "fraction_of_gpu_memory_to_use", "free_idle_chunk",
+    "init_allocated_mem", "inner_op_parallelism",
+    "memory_fraction_of_eager_deletion", "paddle_num_threads",
+    "sync_nccl_allreduce", "tracer_profile_fname", "use_pinned_memory",
+})
 
 _values = {}
 

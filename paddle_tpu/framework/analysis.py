@@ -1,0 +1,971 @@
+"""Static analysis over the Program IR: def-use chains, liveness,
+side-effect classification, and the program verifier.
+
+Capability parity with the reference's C++-layer well-formedness
+enforcement (operator.cc OperatorBase checks, tools/check_op_desc.py
+schema gates) plus the move MLIR makes with its between-pass verifier:
+every pass in the pre-lowering pipeline (framework/passes.py) rewrites a
+cloned program based on invariants, and nothing used to check a pass's
+OUTPUT — a buggy rewrite surfaced as a deep lowering KeyError or, behind
+a compile-cache hit, silently wrong numerics. This module is the shared
+substrate:
+
+- one authoritative purity/side-effect classifier (:data:`SIDE_EFFECT_OPS`,
+  :func:`is_side_effect_type`, :func:`is_pure_op`) — previously copied
+  ad hoc inside ``passes.py``;
+- SSA-style def-use chains keyed on binding versions
+  (:func:`block_def_use`): the IR rebinds names (optimizer in-place
+  writes, BN stats), so a value is identified by ``(name, version)``;
+- reachability/liveness from the fetch/persistable/side-effect roots
+  (:func:`live_op_ids`) — the single implementation DCE consumes;
+- sub-block-aware read/write sets (:func:`op_reads` / :func:`op_writes`);
+- registry-driven shape/dtype inference checking
+  (:func:`check_shapes`) — re-derives output shapes through each op's
+  registered lowering (jax.eval_shape) and compares to the declared
+  VarDescs;
+- :func:`verify_program`: the checker suite, raising a typed
+  :class:`ProgramVerifyError` carrying op index and producing-pass
+  provenance instead of a runtime KeyError;
+- :class:`PipelineValidator`: per-pass translation validation (Pnueli's
+  "verify each output instead of trusting the transformation") run by
+  ``optimize_program`` under ``FLAGS_verify_passes`` — well-formedness
+  diffs against the pipeline input plus semantic preservation checks
+  (live RNG streams, side-effect ops, persistable writes, and
+  writes-before-observer ordering).
+"""
+import collections
+
+import numpy as np
+
+from ..resilience import EnforceNotMet
+
+# ---------------------------------------------------------------------------
+# Authoritative purity / side-effect classification.
+# ---------------------------------------------------------------------------
+
+# Ops whose execution is observable beyond their outputs (host printing,
+# RPC/parameter-server traffic, user callbacks, runtime checks): DCE
+# roots, never CSE candidates. Collective "c_*"-prefixed ops are treated
+# the same without being listed.
+# Discard sentinel for unneeded grad outputs (reference kEmptyVarName):
+# a write sink, legitimately repeated within one grad op, never read.
+EMPTY_VAR = "@EMPTY@"
+
+SIDE_EFFECT_OPS = frozenset({
+    "print", "py_func", "runtime_assert", "assert", "feed", "fetch",
+    "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
+    "distributed_lookup_table", "pull_sparse", "pull_sparse_v2",
+    "push_sparse", "push_sparse_v2", "pull_box_sparse", "push_box_sparse",
+    "broadcast", "alltoall", "run_program",
+})
+
+
+# type -> bool memo: classification is pure string logic over a frozen
+# set, and the verifier asks tens of thousands of times per pipeline
+_side_effect_memo = {}
+
+
+def is_side_effect_type(t):
+    """Side-effecting op types, including their grad ops: a custom grad
+    lowering can carry the effect itself (distributed_lookup_table_grad
+    pushes sparse grads to the pserver via io_callback — removing it as
+    'dead' silently stops the embedding from learning)."""
+    r = _side_effect_memo.get(t)
+    if r is None:
+        if t in SIDE_EFFECT_OPS or t.startswith("c_"):
+            r = True
+        else:
+            r = t.endswith("_grad") and is_side_effect_type(t[:-5])
+        _side_effect_memo[t] = r
+    return r
+
+
+def has_sub_block(op):
+    attrs = op.attrs
+    # inlined Program._SUB_BLOCK_ATTRS: this sits on every per-op walk
+    return (attrs.get("sub_block") is not None
+            or attrs.get("sub_block_true") is not None
+            or attrs.get("sub_block_false") is not None)
+
+
+_OPS = None          # registry.OPS, bound on first use (mutated in
+                     # place by register_op/tests, so the ref stays live)
+
+
+def _ops():
+    global _OPS
+    if _OPS is None:
+        from .registry import OPS
+        _OPS = OPS
+    return _OPS
+
+
+def needs_rng(op):
+    """Whether `op` consumes the program RNG stream (its own
+    ``__rng_seed__`` attr, or a registry op marked needs_rng — grad ops
+    inherit the forward op's classification). The registry is consulted
+    live (never memoized): tests and load_op_library mutate OPS."""
+    if "__rng_seed__" in op.attrs:
+        return True
+    OPS = _ops()
+    t = op.type
+    base = OPS.get(t) or (OPS.get(t[:-5]) if t.endswith("_grad") else None)
+    return bool(base is not None and base.needs_rng)
+
+
+def rng_seed_of(op):
+    """The seed identifying an op's PRNG stream: its own
+    ``__rng_seed__``, the forward op's seed for grad ops (carried inside
+    ``__fwd_op__`` so fwd/bwd dropout masks match), or a user-pinned
+    ``seed`` attr. None = no stream identity (the missing-rng-seed
+    diagnostic)."""
+    seed = op.attrs.get("__rng_seed__")
+    if seed is not None:
+        return seed
+    fwd = op.attrs.get("__fwd_op__")
+    if isinstance(fwd, dict):
+        seed = fwd.get("attrs", {}).get("__rng_seed__")
+        if seed is not None:
+            return seed
+    return op.attrs.get("seed") or None
+
+
+def writes_persistable(block, op):
+    for n in op.output_arg_names:
+        try:
+            if block.var(n).persistable:
+                return True
+        except ValueError:
+            continue
+    return False
+
+
+def is_pure_op(op):
+    """Pure = removable when its outputs are dead, mergeable when its
+    value is duplicated: registered, no side effects, no sub-block, no
+    RNG stream."""
+    from .registry import has_op
+    return (has_op(op.type) and not is_side_effect_type(op.type)
+            and not has_sub_block(op) and not needs_rng(op))
+
+
+# ---------------------------------------------------------------------------
+# Sub-block-aware read/write sets.
+# ---------------------------------------------------------------------------
+
+def sub_block_bound_names(op):
+    """Names a control-flow op itself binds inside its sub-block (scan
+    slices, loop memories, branch operands): defined there, not read
+    from the enclosing frame."""
+    bound = set(op.attrs.get("step_input_vars", ()))
+    for m in op.attrs.get("memories", ()):
+        # the lowering (analyze_block_io) binds the memory's FIRST name
+        # at sub-block entry; later names are produced by sub-block ops
+        bound.add(m[0] if isinstance(m, (list, tuple)) else m)
+    bound.update(op.attrs.get("x_names", ()))
+    if "x_name" in op.attrs:
+        bound.add(op.attrs["x_name"])
+    return bound
+
+
+def op_reads(program, op):
+    """All var names an op (transitively, through its sub-blocks) reads
+    from its defining block's frame."""
+    return program._op_reads(op)
+
+
+def op_writes(program, op, _seen=None):
+    """All var names an op writes into its defining block's frame: its
+    own outputs plus sub-block op outputs (the lowering runs sub-block
+    ops over the SHARED env, so their writes are visible after the
+    control-flow op) that the sub-block did not bind locally. Dangling
+    or cyclic ``sub_block`` attrs (a corrupted artifact) are skipped —
+    the verifier's sub-block-scope checker is where they get reported."""
+    from .core import Program
+    writes = set(op.output_arg_names)
+    if _seen is None:
+        _seen = set()
+    for attr in Program._SUB_BLOCK_ATTRS:
+        sb = op.attrs.get(attr)
+        if sb is None:
+            continue
+        if not isinstance(sb, int) or not 0 <= sb < len(program.blocks) \
+                or sb in _seen:
+            continue     # dangling/cyclic attr: the verifier reports it
+        _seen.add(sb)
+        inner = sub_block_bound_names(op)
+        for sop in program.blocks[sb].ops:
+            writes.update(n for n in op_writes(program, sop, _seen)
+                          if n not in inner)
+    return writes
+
+
+def sub_block_pinned_reads(program):
+    """Every name a control-flow op (transitively) reads: renames don't
+    descend into sub-blocks, so these names must stay fixed under CSE
+    and act as observation points for fusion/reorder checks."""
+    pinned = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            if has_sub_block(op):
+                pinned |= op_reads(program, op)
+    return pinned
+
+
+# ---------------------------------------------------------------------------
+# SSA-style def-use chains keyed on binding versions.
+# ---------------------------------------------------------------------------
+
+class OpSite:
+    """One op occurrence in a block walk: reads/writes as
+    (name, version) pairs. The classifier bits are the standalone
+    functions above (is_side_effect_type, has_sub_block, ...) — kept off
+    this record so building def-use for a 200-op program stays a single
+    cheap walk."""
+
+    __slots__ = ("index", "op", "reads", "writes")
+
+    def __init__(self, index, op, reads, writes):
+        self.index = index
+        self.op = op
+        self.reads = reads            # tuple[(name, version-read)]
+        self.writes = writes          # tuple[(name, version-created)]
+
+
+class BlockDefUse:
+    """Def-use over one block's linear op list. A value is
+    ``(name, version)``: version 0 is the binding live at block entry
+    (feed / scope state), each write creates version+1.
+
+    - ``sites``: one :class:`OpSite` per op, in order
+    - ``defs``: (name, version) -> defining op index (version >= 1)
+    - ``uses``: (name, version) -> [op indices reading that binding]
+    - ``def_count``: name -> number of writes in the block
+    """
+
+    def __init__(self, program, block):
+        self.program = program
+        self.block = block
+        self.sites = []
+        self.defs = {}
+        self.uses = collections.defaultdict(list)
+        self.def_count = collections.Counter()
+        version = collections.Counter()
+        for i, op in enumerate(block.ops):
+            reads = tuple((n, version[n]) for n in op.input_arg_names)
+            for n, v in reads:
+                self.uses[(n, v)].append(i)
+            writes = []
+            for n in op.output_arg_names:
+                version[n] += 1
+                self.def_count[n] += 1
+                writes.append((n, version[n]))
+                self.defs[(n, version[n])] = i
+            self.sites.append(OpSite(i, op, reads, tuple(writes)))
+        self._final_version = version
+
+    def readers_of(self, name, version):
+        return self.uses.get((name, version), [])
+
+    def last_version(self, name):
+        return self._final_version[name]
+
+
+def block_def_use(program, block_idx=0):
+    """Build :class:`BlockDefUse` for one block (default: global)."""
+    return BlockDefUse(program, program.blocks[block_idx])
+
+
+# ---------------------------------------------------------------------------
+# Liveness: reachability from fetch / persistable-write / side-effect
+# roots — THE definition DCE and the translation summaries share.
+# ---------------------------------------------------------------------------
+
+def global_persistable_names(program):
+    """Global-block persistable var names (the DCE/verifier root set)."""
+    return {n for n, v in program.global_block().vars.items()
+            if v.persistable}
+
+
+def live_op_ids(program, fetch_names=(), _pset=None):
+    """ids of the global-block ops reachable (backwards) from the fetch
+    targets, persistable writes, and side-effect roots. Control-flow ops
+    keep their whole sub-block; only block 0 is analyzed (sub-block ops
+    live iff their owner does). The root predicate — side-effecting,
+    has a sub-block, output-less, unregistered type, or writes a
+    persistable — lives inlined in the loop below; it has no other
+    copy."""
+    block = program.global_block()
+    if isinstance(fetch_names, str):
+        fetch_names = (fetch_names,)
+    needed = set(fetch_names or ())
+    pset = global_persistable_names(program) if _pset is None else _pset
+    live = set()
+    OPS = _ops()
+    for op in reversed(block.ops):
+        t = op.type
+        sub = has_sub_block(op)
+        if (is_side_effect_type(t) or sub or not op.outputs
+                or not (t in OPS
+                        or (t.endswith("_grad") and t[:-5] in OPS))
+                or any(n in pset or n in needed
+                       for ns in op.outputs.values() for n in ns)):
+            live.add(id(op))
+            if sub:
+                needed.update(op_reads(program, op))
+            else:
+                for ns in op.inputs.values():
+                    needed.update(ns)
+    return live
+
+
+# ---------------------------------------------------------------------------
+# The program verifier.
+# ---------------------------------------------------------------------------
+
+#: code -> one-line description (the diagnostics catalog; every checker
+#: in verify_program emits exactly one of these codes)
+CHECKS = {
+    "unknown-op": "op type is not in the registry (framework.registry."
+                  "OPS) and has no generic grad fallback",
+    "missing-rng-seed": "an RNG-consuming op lost its __rng_seed__ attr "
+                        "(its stream would collide with seed 0)",
+    "dangling-read": "op reads a var no op defines that is neither "
+                     "persistable, fed, nor data",
+    "use-before-def": "op reads a var that is only defined by a LATER "
+                      "op in the same block",
+    "duplicate-output": "one op lists the same output name more than "
+                        "once (ambiguous binding)",
+    "dead-persistable-write": "a pure op's persistable write is "
+                              "clobbered before any op reads it "
+                              "(pedantic tier: per-pass validation and "
+                              "lint --pedantic only — user programs "
+                              "legally double-init shared params)",
+    "sub-block-scope": "a sub-block op reads a name invisible in its "
+                       "frame chain, or a sub_block attr points at a "
+                       "missing/mis-parented block",
+    "unreachable-fetch": "a fetch target no op produces and the scope "
+                         "cannot supply",
+    "shape-mismatch": "declared output shape disagrees with the "
+                      "registry lowering's inferred shape",
+    "dtype-mismatch": "declared output dtype disagrees with the "
+                      "registry lowering's inferred dtype",
+    # translation-validation codes (pass-pair checks; PipelineValidator)
+    "rng-stream-dropped": "a live RNG op's stream disappeared across a "
+                          "pass (e.g. CSE merged two dropout ops)",
+    "side-effect-dropped": "a live side-effecting op disappeared across "
+                           "a pass",
+    "persistable-write-dropped": "a live persistable write (e.g. an "
+                                 "optimizer update) disappeared across "
+                                 "a pass",
+    "reordered-past-observer": "a write moved across a side-effect/"
+                               "sub-block op that observes that var",
+}
+
+
+class Diagnostic:
+    """One verifier finding. ``key`` is stable across op-index shifts so
+    pipeline-input findings can be suppressed when re-checking a pass's
+    output."""
+
+    __slots__ = ("code", "message", "block_idx", "op_index", "op_type",
+                 "var")
+
+    def __init__(self, code, message, block_idx=0, op_index=None,
+                 op_type=None, var=None):
+        self.code = code
+        self.message = message
+        self.block_idx = block_idx
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+
+    @property
+    def key(self):
+        return (self.code, self.block_idx, self.op_type, self.var)
+
+    def __str__(self):
+        loc = f"block {self.block_idx}"
+        if self.op_index is not None:
+            loc += f" op #{self.op_index}"
+        if self.op_type:
+            loc += f" ({self.op_type})"
+        return f"[{self.code}] {loc}: {self.message}"
+
+    def __repr__(self):
+        return f"Diagnostic({self!s})"
+
+
+class ProgramVerifyError(EnforceNotMet):
+    """A program failed verification. Carries the structured location —
+    ``code`` (one of :data:`CHECKS`), ``op_index``/``op_type``/
+    ``block_idx``/``var`` — plus ``pass_name``, the producing pass when
+    the failure came from per-pass translation validation
+    (``FLAGS_verify_passes``), and ``diagnostics``, every finding of the
+    run (the message shows the first)."""
+
+    def __init__(self, diagnostics, pass_name=None, program_desc=None):
+        if isinstance(diagnostics, Diagnostic):
+            diagnostics = [diagnostics]
+        self.diagnostics = list(diagnostics)
+        first = self.diagnostics[0]
+        self.code = first.code
+        self.op_index = first.op_index
+        self.op_type = first.op_type
+        self.block_idx = first.block_idx
+        self.var = first.var
+        self.pass_name = pass_name
+        parts = []
+        if pass_name:
+            parts.append(f"pass {pass_name!r} produced an invalid "
+                         f"program")
+        elif program_desc:
+            parts.append(f"program verification failed ({program_desc})")
+        else:
+            parts.append("program verification failed")
+        parts.append(str(first))
+        if len(self.diagnostics) > 1:
+            parts.append(f"(+{len(self.diagnostics) - 1} more finding"
+                         f"{'s' if len(self.diagnostics) > 2 else ''})")
+        super().__init__(": ".join(parts[:2]) + (
+            " " + parts[2] if len(parts) > 2 else ""))
+
+
+class _WalkState:
+    """Shared mutable state for the fused verifier walk (one traversal
+    runs the schema, def-use, duplicate-output, and dead-persistable
+    checkers together — this executes per pass under
+    FLAGS_verify_passes, so the op loop must stay single-visit)."""
+
+    __slots__ = ("diags", "all_defs", "pset0", "pending", "pversion",
+                 "visited", "pedantic")
+
+    def __init__(self, diags, all_defs, pset0, pedantic=False):
+        self.diags = diags
+        self.all_defs = all_defs     # every name any op writes
+        self.pset0 = pset0           # global-block persistable names
+        self.pending = {}            # unread pure persistable writes
+        self.pversion = collections.Counter()
+        self.visited = set()         # block idxs reached from block 0
+        self.pedantic = pedantic     # dead-persistable-write tier
+
+
+def _walk_block(program, block_idx, defined, st, depth=0):
+    """The fused verifier walk: `defined` is the set of names bound at
+    this block's entry (mutated as ops write). Per op: registry/RNG
+    schema checks, duplicate outputs, read binding (dangling-read /
+    use-before-def / sub-block-scope), dead-persistable-write tracking
+    (block 0 straight line), and sub-block descent."""
+    from .core import Program
+    st.visited.add(block_idx)
+    diags = st.diags
+    all_defs = st.all_defs
+    block = program.blocks[block_idx]
+    OPS = _ops()
+    for i, op in enumerate(block.ops):
+        t = op.type
+        # --- schema: registered type, RNG stream identity (one registry
+        # lookup per op — this loop runs per pass under the flag)
+        opdef = OPS.get(t) or (OPS.get(t[:-5])
+                               if t.endswith("_grad") else None)
+        if opdef is None:
+            diags.append(Diagnostic(
+                "unknown-op",
+                f"op type {t!r} is not registered (version skew, or a "
+                f"pass invented it); known ops live in "
+                f"framework.registry.OPS", block_idx, i, t))
+            registered = False
+        else:
+            registered = True
+            if opdef.needs_rng and rng_seed_of(op) is None:
+                diags.append(Diagnostic(
+                    "missing-rng-seed",
+                    f"RNG op lost its __rng_seed__ attr: its stream "
+                    f"would collide with every other seedless op",
+                    block_idx, i, t))
+        # --- duplicate outputs within one op (@EMPTY@ is a discard sink)
+        outs = op.output_arg_names
+        if len(outs) != len(set(outs)):
+            culled = [n for n in outs if n != EMPTY_VAR]
+            if len(culled) != len(set(culled)):
+                dup = next(n for n in culled if culled.count(n) > 1)
+                diags.append(Diagnostic(
+                    "duplicate-output",
+                    f"op writes {dup!r} more than once in one "
+                    f"invocation", block_idx, i, t, dup))
+        # --- reads must be bound (and settle pending persistable writes)
+        pending = st.pending
+        for ns in op.inputs.values():
+            for n in ns:
+                if pending:
+                    pending.pop(n, None)
+                if n in defined:
+                    continue
+                if n in all_defs:
+                    code = ("sub-block-scope" if depth
+                            else "use-before-def")
+                    msg = (f"reads {n!r}, which is only defined "
+                           f"{'outside this frame chain' if depth else 'by a later op'}")
+                else:
+                    code = ("sub-block-scope" if depth
+                            else "dangling-read")
+                    msg = (f"reads {n!r}, which no op defines and "
+                           f"which is neither persistable, fed, nor "
+                           f"data")
+                diags.append(Diagnostic(code, msg, block_idx, i, t, n))
+                defined.add(n)      # report each missing name once
+        # --- descend into sub-blocks with the frame visible here
+        if has_sub_block(op):
+            for attr in Program._SUB_BLOCK_ATTRS:
+                sb = op.attrs.get(attr)
+                if sb is None:
+                    continue
+                if not isinstance(sb, int) or \
+                        not 0 <= sb < len(program.blocks):
+                    diags.append(Diagnostic(
+                        "sub-block-scope",
+                        f"attr {attr!r} points at missing block {sb!r}",
+                        block_idx, i, t))
+                    continue
+                if sb in st.visited:
+                    # every sub-block has exactly one owning op in this
+                    # IR (_create_block per control-flow op): a re-visit
+                    # means a cyclic or shared sub_block attr — report
+                    # it instead of recursing forever over a corrupted
+                    # artifact
+                    diags.append(Diagnostic(
+                        "sub-block-scope",
+                        f"attr {attr!r} points at block {sb}, which is "
+                        f"already owned by another op (cyclic or "
+                        f"mis-parented sub_block)", block_idx, i, t))
+                    continue
+                inner = set(defined) | sub_block_bound_names(op)
+                _walk_block(program, sb, inner, st, depth + 1)
+                # sub-block writes land in the shared env: visible after
+                defined.update(n for n in inner if n not in defined
+                               and n in all_defs)
+        # --- writes: bind names; in pedantic mode also track
+        # clobbered pure persistable writes (block 0 straight line only
+        # — control-flow/side-effect/unknown writers are observable in
+        # other ways, a sub-block write is CONDITIONAL so it settles the
+        # pending write rather than flagging it, and user programs
+        # legitimately double-init shared params, which is why this
+        # checker only runs pedantic: per-pass validation diffs it
+        # against the pipeline input, and the lint CLI gates it behind
+        # --pedantic)
+        if not st.pedantic:
+            for n in outs:
+                defined.add(n)
+        else:
+            exempt = (not registered or is_side_effect_type(t)
+                      or has_sub_block(op))
+            for n in outs:
+                defined.add(n)
+                if n in st.pset0:
+                    if depth:
+                        pending.pop(n, None)
+                        continue
+                    st.pversion[n] += 1
+                    prior = pending.pop(n, None)
+                    if prior is not None and not exempt:
+                        diags.append(Diagnostic(
+                            "dead-persistable-write",
+                            f"write #{prior[2]} of persistable {n!r} "
+                            f"is clobbered by a later write with no "
+                            f"read in between", 0, prior[0],
+                            prior[1].type, n))
+                    if not exempt:
+                        pending[n] = (i, op, st.pversion[n])
+
+
+def collect_diagnostics(program, fetch_names=(), feed_names=(),
+                        scope_names=None, check_shapes=False,
+                        pedantic=False):
+    """Run every checker; return the full Diagnostic list (empty =
+    verifier-clean). :func:`verify_program` is the raising wrapper.
+    ``pedantic`` adds the dead-persistable-write checker — off for user
+    programs (the shared-param double-init idiom is legal), on inside
+    per-pass validation where the pipeline-input diff absorbs it."""
+    if isinstance(fetch_names, str):
+        fetch_names = (fetch_names,)
+    if isinstance(feed_names, str):
+        feed_names = (feed_names,)
+    diags = []
+
+    # names bound before the first op runs: feeds, data vars, scope
+    # state. Without a concrete scope, persistable vars stand in for it
+    # (the startup program/init story); with one, its actual keys do too.
+    entry = set(feed_names or ())
+    for blk in program.blocks:
+        for n, v in blk.vars.items():
+            if v.persistable or v.is_data:
+                entry.add(n)
+    pset0 = global_persistable_names(program)
+    if scope_names is not None:
+        entry.update(scope_names)
+
+    all_defs = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            for ns in op.outputs.values():
+                all_defs.update(ns)
+
+    st = _WalkState(diags, all_defs, pset0, pedantic=pedantic)
+    _walk_block(program, 0, set(entry), st)
+    # blocks unreachable from block 0 (no sub_block attr points at them,
+    # e.g. leftovers of a pruning pass) still get the schema checks
+    for blk in program.blocks:
+        if blk.idx in st.visited:
+            continue
+        for i, op in enumerate(blk.ops):
+            t = op.type
+            if not (t in _ops()
+                    or (t.endswith("_grad") and t[:-5] in _ops())):
+                diags.append(Diagnostic(
+                    "unknown-op",
+                    f"op type {t!r} is not registered", blk.idx, i, t))
+            elif needs_rng(op) and rng_seed_of(op) is None:
+                diags.append(Diagnostic(
+                    "missing-rng-seed",
+                    f"RNG op lost its __rng_seed__ attr", blk.idx, i, t))
+
+    # fetch reachability (all_defs == every produced name)
+    for n in (fetch_names or ()):
+        if n in all_defs or n in entry:
+            continue
+        diags.append(Diagnostic(
+            "unreachable-fetch",
+            f"fetch target {n!r}: no op produces it and it is neither "
+            f"persistable, fed, nor scope state", 0, None, None, n))
+
+    if check_shapes:
+        diags.extend(infer_shape_diagnostics(program))
+    return diags
+
+
+def verify_program(program, fetch_names=(), feed_names=(),
+                   scope_names=None, check_shapes=False,
+                   provenance=None, pedantic=False):
+    """Verify program well-formedness; raise :class:`ProgramVerifyError`
+    on the first finding (all findings ride on ``.diagnostics``).
+
+    - ``fetch_names`` / ``feed_names``: the run's fetch/feed bindings.
+    - ``scope_names``: names the executing scope holds, when known —
+      reads/fetches of scope state then verify exactly (without it,
+      persistable/data vars stand in).
+    - ``check_shapes``: also re-derive output shapes/dtypes through each
+      op's registered lowering and compare to the declared VarDescs
+      (slower; the lint tool's --shapes mode).
+    - ``provenance``: producing-pass name to carry on the error.
+    """
+    diags = collect_diagnostics(program, fetch_names, feed_names,
+                                scope_names, check_shapes, pedantic)
+    if diags:
+        raise ProgramVerifyError(diags, pass_name=provenance)
+
+
+# ---------------------------------------------------------------------------
+# Registry-driven shape/dtype inference checking.
+# ---------------------------------------------------------------------------
+
+def infer_shape_diagnostics(program):
+    """Compare each global-block op's DECLARED output shapes/dtypes
+    against what its registered lowering infers (jax.eval_shape — the
+    same machinery registry.infer_op_shapes uses at build time, run
+    non-destructively). Ops with custom/disabled infer_shape, grad ops
+    (they need runtime __fwd_op__ context), and ops with unknown or
+    dynamic input shapes are skipped. -1 dims are wildcards."""
+    import jax
+
+    from .dtype import np_dtype
+    from .lowering import LowerCtx
+    from .registry import OPS, normalize_outs
+
+    diags = []
+    block = program.global_block()
+    for i, op in enumerate(block.ops):
+        opdef = OPS.get(op.type)
+        if opdef is None or opdef.infer_shape is not None:
+            continue                 # unknown/custom/disabled: skip
+        if op.type.endswith("_grad") or "__fwd_op__" in op.attrs:
+            continue
+        ins = {}
+        ok = True
+        for slot, names in op.inputs.items():
+            arrs = []
+            for n in names:
+                try:
+                    v = block.var(n)
+                except ValueError:
+                    ok = False
+                    break
+                if v.shape is None or any(int(s) < 0 for s in v.shape):
+                    ok = False
+                    break
+                try:
+                    arrs.append(jax.ShapeDtypeStruct(
+                        tuple(v.shape), np_dtype(v.dtype)))
+                except (TypeError, ValueError):
+                    ok = False
+                    break
+            if not ok:
+                break
+            ins[slot] = arrs
+        if not ok:
+            continue
+        ctx = LowerCtx(program, block, env=None, base_key=None,
+                       abstract=True)
+
+        def fn(ins):
+            raw = opdef.lower(ctx, dict(ins), op.attrs)
+            return normalize_outs(op.outputs, raw)
+
+        try:
+            out_shapes = jax.eval_shape(fn, ins)
+        except Exception:
+            continue                 # value-dependent op: not checkable
+        for slot, names in op.outputs.items():
+            shapes = out_shapes.get(slot)
+            if shapes is None:
+                continue
+            for n, sd in zip(names, shapes):
+                if sd is None:
+                    continue
+                try:
+                    var = block.var(n)
+                except ValueError:
+                    continue
+                decl = var.shape
+                if decl is None:
+                    continue
+                inferred = tuple(int(d) for d in sd.shape)
+                if len(decl) != len(inferred) or any(
+                        d != -1 and d != e
+                        for d, e in zip(decl, inferred)):
+                    diags.append(Diagnostic(
+                        "shape-mismatch",
+                        f"{n!r} declared {tuple(decl)} but the "
+                        f"registered lowering infers {inferred}",
+                        0, i, op.type, n))
+                    continue
+                inf_dtype = ("bfloat16"
+                             if sd.dtype == jax.numpy.bfloat16
+                             else str(np.dtype(sd.dtype)))
+                if str(var.dtype) != inf_dtype:
+                    diags.append(Diagnostic(
+                        "dtype-mismatch",
+                        f"{n!r} declared dtype {var.dtype} but the "
+                        f"registered lowering infers {inf_dtype}",
+                        0, i, op.type, n))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Per-pass translation validation.
+# ---------------------------------------------------------------------------
+
+class TranslationSummary:
+    """What a correct pass must preserve about a program, cheap enough
+    to recompute per pass: multisets over LIVE ops (so a correct DCE
+    changes nothing) plus per-observer write-order counts."""
+
+    __slots__ = ("rng_seeds", "side_effects", "persist_writes",
+                 "observer_counts")
+
+    def __init__(self, program, fetch_names=()):
+        pset = global_persistable_names(program)
+        live = live_op_ids(program, fetch_names, _pset=pset)
+        block = program.global_block()
+        self.rng_seeds = collections.Counter()
+        self.side_effects = collections.Counter()
+        # a MULTISET of live persistable writes per name: a pass
+        # dropping one of several live writes to the same var must not
+        # hide behind the surviving one
+        self.persist_writes = collections.Counter()
+        self.observer_counts = {}
+        observers = None
+        for op in block.ops:
+            if id(op) not in live:
+                continue
+            if needs_rng(op):
+                self.rng_seeds[(op.type, rng_seed_of(op))] += 1
+            side = is_side_effect_type(op.type)
+            if side:
+                self.side_effects[op.type] += 1
+            for ns in op.outputs.values():
+                for n in ns:
+                    if n in pset:
+                        self.persist_writes[n] += 1
+            if side or has_sub_block(op):
+                observers = observers or []
+                observers.append(op)
+        if observers:
+            # second walk only when the program HAS observation points:
+            # what each observer has seen = number of writes to each name
+            # it reads that happened before it ran
+            obs_ids = {id(op) for op in observers}
+            writes_so_far = {}
+            for op in block.ops:
+                if id(op) not in live:
+                    continue
+                if id(op) in obs_ids:
+                    self.observer_counts[id(op)] = {
+                        n: writes_so_far.get(n, 0)
+                        for n in op_reads(program, op)}
+                for ns in op.outputs.values():
+                    for n in ns:
+                        writes_so_far[n] = writes_so_far.get(n, 0) + 1
+
+
+def compare_summaries(before, after):
+    """Diagnostics for semantic invariants a pass broke: live RNG
+    streams, side-effect ops, and persistable writes must survive
+    (additions are allowed — instrumentation passes create them);
+    observers present in both programs must have seen the same number of
+    writes to every name they read."""
+    diags = []
+    missing_rng = before.rng_seeds - after.rng_seeds
+    for (t, seed), cnt in missing_rng.items():
+        diags.append(Diagnostic(
+            "rng-stream-dropped",
+            f"{cnt} live {t!r} op(s) with __rng_seed__={seed} "
+            f"disappeared (RNG ops are never mergeable/removable while "
+            f"live)", 0, None, t))
+    missing_se = before.side_effects - after.side_effects
+    for t, cnt in missing_se.items():
+        diags.append(Diagnostic(
+            "side-effect-dropped",
+            f"{cnt} live side-effecting {t!r} op(s) disappeared", 0,
+            None, t))
+    for n, cnt in sorted(
+            (before.persist_writes - after.persist_writes).items()):
+        diags.append(Diagnostic(
+            "persistable-write-dropped",
+            f"{cnt} live write(s) of persistable {n!r} (e.g. an "
+            f"optimizer update) disappeared", 0, None, None, n))
+    for oid, counts in before.observer_counts.items():
+        now = after.observer_counts.get(oid)
+        if now is None:
+            continue                 # observer itself flagged above
+        for name, cnt in counts.items():
+            if name not in now:
+                continue             # legitimately renamed (CSE merge of
+                                     # a pure producer feeding the
+                                     # observer); persistables — the
+                                     # reorder threat — are never renamed
+            if now[name] != cnt:
+                diags.append(Diagnostic(
+                    "reordered-past-observer",
+                    f"the observer saw {cnt} write(s) of {name!r} "
+                    f"before the pass but {now[name]} after — a "
+                    f"write moved across an op that observes it", 0,
+                    None, None, name))
+    return diags
+
+
+class PipelineValidator:
+    """Per-pass translation validation for ``optimize_program``.
+
+    Fast path (every run): snapshot the pipeline INPUT's diagnostics
+    (pre-existing user-program findings are never blamed on a pass) and
+    its :class:`TranslationSummary`; after every pass compare summaries
+    — the semantic preservation checks (live RNG streams, side-effect
+    ops, persistable writes, observer write-order) raise immediately
+    naming the pass. The full well-formedness collect runs ONCE, on the
+    pipeline output (:meth:`finalize`).
+
+    Slow path (only on a finalize finding): re-run the pipeline from a
+    fresh clone, re-collecting after each pass, to attribute the
+    diagnostic to the pass that introduced it — correctness checking
+    stays O(pipeline) in the common all-green case, and the raised
+    :class:`ProgramVerifyError` still names the guilty pass.
+
+    ``verify_ms`` accumulates the total validation wall time (the bench
+    overhead measurement); each pass's share lands in ``last_pass_ms``.
+    """
+
+    def __init__(self, program, fetch_names=(), replay=None):
+        import time
+        t0 = time.perf_counter()
+        if isinstance(fetch_names, str):
+            fetch_names = (fetch_names,)
+        self.fetch_names = tuple(fetch_names or ())
+        self._replay = replay        # () -> (fresh clone, [passes])
+        # the input's diagnostic keys are only needed once the OUTPUT
+        # shows a finding (to avoid blaming pre-existing user-program
+        # findings on a pass): with a replay callback available they are
+        # collected lazily from a fresh input clone on that rare path —
+        # the all-green fast path never pays the input collect
+        self.baseline = None
+        if replay is None:
+            self.baseline = collections.Counter(
+                d.key for d in collect_diagnostics(program,
+                                                   self.fetch_names,
+                                                   pedantic=True))
+        self.summary = TranslationSummary(program, self.fetch_names)
+        self.verify_ms = (time.perf_counter() - t0) * 1e3
+        self.last_pass_ms = 0.0
+
+    def _baseline_keys(self):
+        if self.baseline is None:
+            prog, _ = self._replay()
+            self.baseline = collections.Counter(
+                d.key for d in collect_diagnostics(prog,
+                                                   self.fetch_names,
+                                                   pedantic=True))
+        return self.baseline
+
+    def _new_diags(self, program):
+        diags = collect_diagnostics(program, self.fetch_names,
+                                    pedantic=True)
+        if not diags:
+            return diags
+        # MULTISET suppression: a key is stable across op-index shifts,
+        # but a pass that introduces a SECOND finding colliding with a
+        # pre-existing one on (code, block, op_type, var) must still be
+        # caught — only up to the baseline's count is forgiven
+        baseline = self._baseline_keys()
+        seen = collections.Counter()
+        fresh = []
+        for d in diags:
+            seen[d.key] += 1
+            if seen[d.key] > baseline.get(d.key, 0):
+                fresh.append(d)
+        return fresh
+
+    def after_pass(self, program, pass_name):
+        import time
+        t0 = time.perf_counter()
+        try:
+            summary = TranslationSummary(program, self.fetch_names)
+            sem = compare_summaries(self.summary, summary)
+            if sem:
+                raise ProgramVerifyError(sem, pass_name=pass_name)
+            self.summary = summary
+        finally:
+            self.last_pass_ms = (time.perf_counter() - t0) * 1e3
+            self.verify_ms += self.last_pass_ms
+
+    def finalize(self, program, last_pass_name=None):
+        """Full well-formedness collect over the pipeline OUTPUT; on a
+        new finding, replay the pipeline pass-by-pass to name the pass
+        that introduced it."""
+        import time
+        t0 = time.perf_counter()
+        try:
+            diags = self._new_diags(program)
+            if not diags:
+                return
+            guilty = last_pass_name
+            if self._replay is not None:
+                prog, pipeline = self._replay()
+                for p in pipeline:
+                    pname = (getattr(p, "name", None)
+                             or type(p).__name__)
+                    prog = p(prog) or prog
+                    step = self._new_diags(prog)
+                    if step:
+                        raise ProgramVerifyError(step, pass_name=pname)
+            raise ProgramVerifyError(diags, pass_name=guilty)
+        finally:
+            self.verify_ms += (time.perf_counter() - t0) * 1e3

@@ -433,19 +433,29 @@ class Program:
 
     _SUB_BLOCK_ATTRS = ("sub_block", "sub_block_true", "sub_block_false")
 
-    def _op_reads(self, op):
+    def _op_reads(self, op, _seen=None):
         """All var names an op (transitively, through its sub-blocks) reads
-        from its defining block's frame."""
+        from its defining block's frame. Dangling or cyclic sub_block
+        attrs (a corrupted artifact) are skipped rather than recursed —
+        the analysis verifier is where they get diagnosed."""
         reads = set(op.input_arg_names)
+        if _seen is None:
+            _seen = set()
         for attr in self._SUB_BLOCK_ATTRS:
             sb = op.attrs.get(attr)
             if sb is None:
                 continue
-            inner_defined = set(op.attrs.get("step_input_vars", ()))
-            inner_defined.update(m[0] for m in op.attrs.get("memories", ()))
-            inner_defined.update(op.attrs.get("x_names", ()))
+            if not isinstance(sb, int) or not 0 <= sb < len(self.blocks) \
+                    or sb in _seen:
+                continue
+            _seen.add(sb)
+            # ONE definition of what a control-flow op binds at
+            # sub-block entry, shared with the verifier and the
+            # lowering's analyze_block_io
+            from .analysis import sub_block_bound_names
+            inner_defined = sub_block_bound_names(op)
             for sop in self.blocks[sb].ops:
-                reads.update(n for n in self._op_reads(sop)
+                reads.update(n for n in self._op_reads(sop, _seen)
                              if n not in inner_defined)
                 inner_defined.update(sop.output_arg_names)
         return reads

@@ -179,28 +179,60 @@ class Executor:
         # cumulative cache-miss cost split: program passes, python
         # trace+StableHLO lowering, XLA compilation (milliseconds)
         self._compile_stats = {"pass_ms": 0.0, "trace_ms": 0.0,
-                               "compile_ms": 0.0, "compiles": 0}
+                               "compile_ms": 0.0, "compiles": 0,
+                               "verify_ms": 0.0}
 
     def cache_stats(self):
         """Compile-cache occupancy, hit/miss/evict counters, and the
         cumulative cost split of every cache miss: ``pass_ms``
         (pre-lowering optimization pipeline), ``trace_ms`` (python
         trace + StableHLO lowering), ``compile_ms`` (XLA compile),
-        ``compiles`` (miss count)."""
+        ``compiles`` (miss count), ``verify_ms`` (FLAGS_verify_passes
+        program verification + per-pass translation validation)."""
         return {**self._cache.stats(), **self._compile_stats}
 
-    def _optimize(self, program, fetch_names):
+    def _optimize(self, program, fetch_names, feed_names=(), scope=None):
         """Run the FLAGS_program_passes pipeline over a clone of
         `program` (framework/passes.py), charging the span to
         ``pass_ms`` and the ``pass/program_<uid>`` profiler event. With
         the pipeline off the original program is returned untouched —
-        bitwise the unoptimized lowering."""
+        bitwise the unoptimized lowering.
+
+        Under ``FLAGS_verify_passes`` every compile-cache miss also
+        verifies the USER program (framework/analysis.verify_program,
+        with the live scope's names so scope-state reads/fetches check
+        exactly) and each pass's output — a malformed program fails with
+        a typed ProgramVerifyError naming the op (and producing pass)
+        instead of a deep lowering KeyError. Verification wall time
+        accumulates in ``cache_stats()['verify_ms']``."""
         from .. import profiler as _prof
+        from .passes import _last_stats as _pass_stats
         from .passes import optimize_program, pipeline_signature
         sig = pipeline_signature()
+        verify = _flag("verify_passes")
+        if not sig and not verify:
+            return program
+        if verify:
+            # verify on EVERY executable-cache miss, before the
+            # optimized-program memo: feeds/scope/flag state differ per
+            # call, so a memoized clean verdict from one (feed, scope)
+            # must not silence a later broken binding (~1 ms against a
+            # compile measured in hundreds)
+            from .analysis import verify_program
+            t0 = time.perf_counter()
+            verify_program(
+                program, fetch_names=fetch_names, feed_names=feed_names,
+                scope_names=(set(scope.keys())
+                             if scope is not None else None))
+            self._compile_stats["verify_ms"] += \
+                (time.perf_counter() - t0) * 1e3
         if not sig:
             return program
-        key = (program._uid, program.version, tuple(fetch_names), sig)
+        # verify is part of the key: an optimized clone memoized with
+        # validation off must not be served as 'validated' after the
+        # operator flips FLAGS_verify_passes on to debug that program
+        key = (program._uid, program.version, tuple(fetch_names), sig,
+               verify)
         opt = self._opt_cache.get(key)
         if opt is not None:
             return opt
@@ -208,8 +240,14 @@ class Executor:
         opt = optimize_program(program, fetch_names=fetch_names)
         if opt is not program:
             dt = time.perf_counter() - t0
-            self._compile_stats["pass_ms"] += dt * 1e3
-            _prof.record_duration(f"pass/program_{program._uid}", dt)
+            vms = _pass_stats.get("verify_ms", 0.0) if verify else 0.0
+            # the optimize span includes the per-pass validation when
+            # the flag is on; split it out so pass_ms + verify_ms sum
+            # to the miss cost instead of double-counting validation
+            self._compile_stats["pass_ms"] += max(dt * 1e3 - vms, 0.0)
+            self._compile_stats["verify_ms"] += vms
+            _prof.record_duration(f"pass/program_{program._uid}",
+                                  max(dt - vms / 1e3, 0.0))
         self._opt_cache[key] = opt
         return opt
 
@@ -377,7 +415,9 @@ class Executor:
         if entry is not None:
             compiled, jitted, state_in, state_out, state_fetches = entry
         else:
-            opt_prog = self._optimize(program, fetch_names)
+            opt_prog = self._optimize(program, fetch_names,
+                                      feed_names=feed_arrays.keys(),
+                                      scope=scope)
             state_in, state_out = analyze_block_io(
                 opt_prog, 0, list(feed_arrays.keys()))
             state_in, state_fetches = self._state_fetches(
@@ -570,7 +610,9 @@ class Executor:
             (compiled, jitted, state_in, state_out, mut_names, slot_names,
              wo_avals, state_fetches) = entry
         else:
-            opt_prog = self._optimize(program, fetch_names)
+            opt_prog = self._optimize(program, fetch_names,
+                                      feed_names=feed_arrays.keys(),
+                                      scope=scope)
             state_in, state_out = analyze_block_io(
                 opt_prog, 0, list(feed_arrays.keys()))
             state_in, state_fetches = self._state_fetches(

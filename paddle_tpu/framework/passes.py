@@ -42,6 +42,11 @@ import numpy as np
 from ..flags import flag as _flag
 # underscore-aliased: this namespace is part of the frozen public API
 # surface (tools/api_signatures.txt) — only the pass registry is public
+from .analysis import SIDE_EFFECT_OPS  # noqa: F401  (compat re-export)
+from .analysis import has_sub_block as _has_sub_block
+from .analysis import is_side_effect_type as _is_side_effect_type  # noqa: F401,E501  (compat re-export)
+from .analysis import needs_rng as _needs_rng  # noqa: F401  (compat re-export)
+from .analysis import writes_persistable as _writes_persistable  # noqa: F401,E501  (compat re-export)
 from .core import OP_ROLE_KEY
 from .core import Operator as _Operator
 from .core import OpRole as _OpRole
@@ -155,15 +160,18 @@ def canonical_order(names):
 # Pipeline application + stats
 # ---------------------------------------------------------------------------
 
-_last_stats = {"passes": [], "total_ms": 0.0}
+_last_stats = {"passes": [], "total_ms": 0.0, "verify_ms": 0.0}
 
 
 def stats():
     """Report of the LAST apply_passes run: per-pass
     {pass, ops_before, ops_after, bytes_before, bytes_after, ms, detail}
-    plus the pipeline total."""
+    plus the pipeline total and, when ``FLAGS_verify_passes`` ran the
+    per-pass translation validation, its wall time (``verify_ms``,
+    also per row)."""
     return {"passes": [dict(r) for r in _last_stats["passes"]],
-            "total_ms": _last_stats["total_ms"]}
+            "total_ms": _last_stats["total_ms"],
+            "verify_ms": _last_stats.get("verify_ms", 0.0)}
 
 
 def _program_op_count(program):
@@ -197,7 +205,7 @@ def _program_bytes(program):
     return total
 
 
-def apply_passes(program, names, **common_attrs):
+def apply_passes(program, names, _validate=None, **common_attrs):
     """Run passes over `program` (reference PassBuilder::Build).
     `names` entries are either registered names or instantiated
     Pass/callables. Lists/tuples run in the GIVEN order; unordered
@@ -205,7 +213,14 @@ def apply_passes(program, names, **common_attrs):
     :func:`canonical_order` so the pipeline is deterministic. An unknown
     name raises :class:`UnknownPassError` naming the registry contents.
     Per-pass op/byte deltas and wall time land in :func:`stats` and the
-    profiler event table (``pass/<name>``)."""
+    profiler event table (``pass/<name>``).
+
+    ``_validate`` (an :class:`analysis.PipelineValidator`) runs
+    translation validation after every pass — a pass whose output fails
+    well-formedness or breaks a preservation invariant raises
+    :class:`analysis.ProgramVerifyError` naming the pass; validation
+    wall time lands in each row's ``verify_ms`` and the pipeline
+    ``verify_ms`` total."""
     from .. import profiler as _prof
     if isinstance(names, (set, frozenset)) or (
             isinstance(names, dict) or type(names).__name__ == "dict_keys"):
@@ -228,11 +243,16 @@ def apply_passes(program, names, **common_attrs):
         detail = getattr(p, "_report", None)
         if detail:
             row["detail"] = dict(detail)
+        if _validate is not None:
+            _validate.after_pass(program, pname)
+            row["verify_ms"] = _validate.last_pass_ms
         rows.append(row)
         _prof.record_duration(f"pass/{pname}", dt)
         ops, nbytes = ops_after, bytes_after
     _last_stats["passes"] = rows
     _last_stats["total_ms"] = (time.perf_counter() - t_pipeline) * 1e3
+    _last_stats["verify_ms"] = (_validate.verify_ms
+                                if _validate is not None else 0.0)
     return program
 
 
@@ -293,13 +313,36 @@ def optimize_program(program, fetch_names=(), spec=None):
     """Run the configured pipeline over a CLONE of `program` and return
     it (the caller's program is never mutated, keeping its version — and
     the executor cache keys derived from it — stable). With the pipeline
-    disabled the original program is returned as-is."""
+    disabled the original program is returned as-is.
+
+    Under ``FLAGS_verify_passes`` every pass's output is translation-
+    validated (framework/analysis.py): a buggy rewrite raises a typed
+    ``ProgramVerifyError`` naming the pass and op instead of surfacing
+    as a deep lowering KeyError — or worse, silently wrong numerics
+    behind a compile-cache hit."""
     names = resolve_pipeline(spec)
     if not names:
         return program
+    if isinstance(fetch_names, str):
+        # a bare string must mean ONE fetch target; tuple() would
+        # char-split it into nonsense DCE roots that drop the program
+        fetch_names = (fetch_names,)
     opt = program.clone()
     pipeline = [get_pass(n, fetch_names=tuple(fetch_names)) for n in names]
-    apply_passes(opt, pipeline)
+    validator = None
+    if _flag("verify_passes"):
+        from .analysis import PipelineValidator
+        validator = PipelineValidator(
+            opt, fetch_names,
+            # failure-path attribution: replay the pipeline over a fresh
+            # clone, verifying after each pass, to name the guilty one
+            replay=lambda: (program.clone(),
+                            [get_pass(n, fetch_names=tuple(fetch_names))
+                             for n in names]))
+    apply_passes(opt, pipeline, _validate=validator)
+    if validator is not None:
+        validator.finalize(opt, last_pass_name=names[-1])
+        _last_stats["verify_ms"] = validator.verify_ms
     return opt
 
 
@@ -359,54 +402,13 @@ class QuantAwarePass(Pass):
 
 # ---------------------------------------------------------------------------
 # The pre-lowering optimization pipeline: DCE / CSE / optimizer fusion.
+#
+# The purity/side-effect classifier and the def-use/liveness machinery
+# live in framework/analysis.py — ONE authoritative implementation shared
+# by the passes, the program verifier, and future passes (ZeRO bucket
+# sharding, fuse_embedding). The original names stay importable from
+# here (SIDE_EFFECT_OPS, _is_side_effect_type, ... aliased at the top).
 # ---------------------------------------------------------------------------
-
-# Ops whose execution is observable beyond their outputs (host printing,
-# RPC/parameter-server traffic, user callbacks, runtime checks): DCE
-# roots, never CSE candidates. Collective "c_*"-prefixed ops are treated
-# the same without being listed.
-SIDE_EFFECT_OPS = frozenset({
-    "print", "py_func", "runtime_assert", "assert", "feed", "fetch",
-    "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
-    "distributed_lookup_table", "pull_sparse", "pull_sparse_v2",
-    "push_sparse", "push_sparse_v2", "pull_box_sparse", "push_box_sparse",
-    "broadcast", "alltoall", "run_program",
-})
-
-
-def _has_sub_block(op):
-    from .core import Program
-    return any(op.attrs.get(a) is not None
-               for a in Program._SUB_BLOCK_ATTRS)
-
-
-def _is_side_effect_type(t):
-    """Side-effecting op types, including their grad ops: a custom grad
-    lowering can carry the effect itself (distributed_lookup_table_grad
-    pushes sparse grads to the pserver via io_callback — removing it as
-    'dead' silently stops the embedding from learning)."""
-    if t in SIDE_EFFECT_OPS or t.startswith("c_"):
-        return True
-    return t.endswith("_grad") and _is_side_effect_type(t[:-5])
-
-
-def _writes_persistable(block, op):
-    for n in op.output_arg_names:
-        try:
-            if block.var(n).persistable:
-                return True
-        except ValueError:
-            continue
-    return False
-
-
-def _needs_rng(op):
-    if "__rng_seed__" in op.attrs:
-        return True
-    from .registry import OPS
-    t = op.type
-    base = OPS.get(t) or (OPS.get(t[:-5]) if t.endswith("_grad") else None)
-    return bool(base is not None and base.needs_rng)
 
 
 def _freeze(v):
@@ -435,34 +437,13 @@ class DeadCodeEliminationPass(Pass):
     pipeline_order = 10
     fetch_names = ()
 
-    def _is_root(self, block, op):
-        from .registry import has_op
-        t = op.type
-        if _is_side_effect_type(t):
-            return True
-        if _has_sub_block(op):
-            return True
-        if not op.outputs:
-            return True            # output-less ops act for effect only
-        if not has_op(t):
-            return True            # unknown semantics: keep
-        return _writes_persistable(block, op)
-
     def apply(self, program):
+        from .analysis import live_op_ids
         block = program.global_block()
-        needed = set(self.fetch_names or ())
-        kept = []
-        removed = 0
-        for op in reversed(block.ops):
-            if self._is_root(block, op) or \
-                    any(n in needed for n in op.output_arg_names):
-                kept.append(op)
-                needed.update(program._op_reads(op))
-            else:
-                removed += 1
-        kept.reverse()
+        live = live_op_ids(program, self.fetch_names or ())
+        kept = [op for op in block.ops if id(op) in live]
+        self._report = {"removed_ops": len(block.ops) - len(kept)}
         block.ops = kept
-        self._report = {"removed_ops": removed}
 
 
 @register_pass("cse")
@@ -480,21 +461,17 @@ class CommonSubexpressionEliminationPass(Pass):
     fetch_names = ()
 
     def _pinned_names(self, program):
-        pinned = set(self.fetch_names or ())
-        for blk in program.blocks:
-            for op in blk.ops:
-                if _has_sub_block(op):
-                    # renames don't descend into sub-blocks, so anything
-                    # such an op (transitively) reads stays fixed
-                    pinned |= program._op_reads(op)
-        return pinned
+        from .analysis import sub_block_pinned_reads
+        fetches = self.fetch_names or ()
+        if isinstance(fetches, str):
+            fetches = (fetches,)
+        # renames don't descend into sub-blocks, so anything a
+        # control-flow op (transitively) reads stays fixed
+        return set(fetches) | sub_block_pinned_reads(program)
 
     def _eligible(self, block, op, pinned, def_count, version):
-        from .registry import has_op
-        t = op.type
-        if _is_side_effect_type(t) or not has_op(t):
-            return False
-        if _has_sub_block(op) or _needs_rng(op):
+        from .analysis import is_pure_op
+        if not is_pure_op(op):
             return False
         outs = op.output_arg_names
         if not outs:
@@ -521,12 +498,10 @@ class CommonSubexpressionEliminationPass(Pass):
         return (op.type, attrs, ins, out_shape)
 
     def apply(self, program):
+        from .analysis import block_def_use
         block = program.global_block()
         pinned = self._pinned_names(program)
-        def_count = {}
-        for op in block.ops:
-            for n in op.output_arg_names:
-                def_count[n] = def_count.get(n, 0) + 1
+        def_count = block_def_use(program).def_count
         version = {}       # name -> rebind count (value identity)
         rename = {}        # dropped output -> canonical output
         seen = {}          # value key -> canonical op
@@ -676,13 +651,14 @@ class FuseOptimizerPass(Pass):
 
     @staticmethod
     def _op_names(block, op):
-        # sub-block reads count: a control-flow op that reads an updated
-        # param only inside its sub_block must still close the bucket,
-        # or the fused update would move past it
-        reads = (set(block.program._op_reads(op)) if _has_sub_block(op)
-                 else set(op.input_arg_names))
-        writes = set(op.output_arg_names)
-        return reads, writes
+        # sub-block reads AND writes count: a control-flow op that
+        # touches an updated param only inside its sub_block must still
+        # close the bucket, or the fused update would move past it
+        from .analysis import op_reads, op_writes
+        if _has_sub_block(op):
+            return (set(op_reads(block.program, op)),
+                    set(op_writes(block.program, op)))
+        return set(op.input_arg_names), set(op.output_arg_names)
 
     def _build_fused(self, block, ops):
         first = ops[0]

@@ -670,6 +670,14 @@ def load_inference_model(dirname, executor, model_filename=None,
         model = json.load(f)
     program = Program.from_dict(model["program"])
     program._is_test = True
+    # verify the deserialized IR before anything trusts it: a
+    # hand-edited or version-skewed __model__ (op deleted from the
+    # registry, dangling reads, unreachable fetch targets) fails HERE
+    # with a named ProgramVerifyError diagnostic instead of a
+    # mid-lowering stack trace on the first Predictor.run
+    from .framework.analysis import verify_program
+    verify_program(program, fetch_names=model.get("fetch_var_names", ()),
+                   feed_names=model.get("feed_var_names", ()))
     # save-time feed signature record (shape template, -1 = dynamic):
     # consumed by serving.ServingEngine.feed_specs / warmup; absent on
     # pre-upgrade saves

@@ -13,6 +13,12 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Program verification + per-pass translation validation are ON for the
+# whole suite (framework/analysis.py): every compile-cache miss verifies
+# the program and every optimization pass's output. Off by default in
+# production (FLAGS_verify_passes=0) — the bench measures the overhead.
+os.environ.setdefault("FLAGS_verify_passes", "1")
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

@@ -523,3 +523,8 @@ def test_bench_passes_smoke():
     for side in (on, off):
         assert side["trace_ms"] > 0 and side["compile_ms"] > 0
         assert side["cold_start_ms"] > 0
+    # FLAGS_verify_passes overhead: per-pass translation validation must
+    # stay a small fraction of the pipeline itself (acceptance < 20% on
+    # the tiny-BERT config; generous slack here for CI timing noise)
+    assert rec["verify_ms"] > 0
+    assert rec["verify_pct_of_pass_ms"] < 35.0, rec

@@ -67,6 +67,73 @@ def test_op_schema_gate():
     assert any("gradient" in e for e in errors)
 
 
+def test_op_schema_gate_cli():
+    """The check_op_desc.py CLI itself gates in tier-1 (it previously
+    only ran by hand): exit 0 against the committed baseline, exit 1
+    against a poisoned one."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "check_op_desc.py"),
+         os.path.join(TOOLS, "op_schema_baseline.json")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "compatible" in ok.stdout
+    with open(os.path.join(TOOLS, "op_schema_baseline.json")) as f:
+        baseline = json.load(f)
+    baseline["definitely_gone_op"] = {"grad": True}
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(baseline, f)
+        poisoned = f.name
+    bad = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "check_op_desc.py"),
+         poisoned],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr[-2000:]
+    assert "deleted" in bad.stdout
+
+
+def test_op_schema_gate_catches_rng_contract_change():
+    """Flipping an op's needs_rng breaks every saved program's
+    __rng_seed__ layout — the schema gate must flag it."""
+    import check_op_desc
+    now = check_op_desc.current_schema()
+    rng_op = next(k for k, v in now.items() if v["needs_rng"])
+    flipped = {k: dict(v) for k, v in now.items()}
+    flipped[rng_op]["needs_rng"] = False
+    errors, _ = check_op_desc.check(now, flipped)
+    assert any("RNG contract" in e for e in errors), errors
+
+
+def test_lint_flags_gate():
+    """tools/lint_flags.py: the live tree is clean, and the checker
+    catches both rot modes (undeclared reference, unreferenced
+    declaration)."""
+    import lint_flags
+    from paddle_tpu import flags as F
+    declared = set(F._DEFS)
+    compat = set(F._COMPAT_ONLY)
+    refs = lint_flags.scan_references()
+    assert lint_flags.check(declared, compat, refs) == []
+    # the aliased hot-path getter idiom _flag("name") must count as a
+    # reference (a \b-anchored regex silently missed it)
+    assert "verify_passes" in refs and "program_passes" in refs
+    # a reference to an undeclared flag is flagged
+    poisoned = dict(refs)
+    poisoned["totally_new_flag"] = ["paddle_tpu/somewhere.py"]
+    errors = lint_flags.check(declared, compat, poisoned)
+    assert any("totally_new_flag" in e and "not declared" in e
+               for e in errors), errors
+    # a declared-but-never-referenced flag is flagged
+    errors = lint_flags.check(declared | {"dead_flag"}, compat, refs)
+    assert any("dead_flag" in e and "nothing" in e
+               for e in errors), errors
+    # compat-listed flags that ARE referenced get called out
+    some_ref = next(n for n in refs if n in declared)
+    errors = lint_flags.check(declared, compat | {some_ref}, refs)
+    assert any(some_ref in e and "compat" in e for e in errors), errors
+
+
 def test_timeline_conversion_end_to_end():
     """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
     valid Chrome trace JSON."""

@@ -33,14 +33,23 @@ def current_schema():
 
 
 def check(baseline, now):
-    """Errors: deleted ops, ops that LOST grad support. Returns
-    (errors, added)."""
+    """Errors: deleted ops, ops that LOST grad support, ops whose RNG
+    contract changed (a saved program's ops carry __rng_seed__ attrs iff
+    the op consumed the stream at save time — flipping needs_rng makes
+    every such program fail the verifier's missing-rng-seed check, or
+    silently share stream 0). Returns (errors, added)."""
     errors = []
     for t, spec in baseline.items():
         if t not in now:
             errors.append(f"op {t!r} was deleted")
-        elif spec.get("grad") and not now[t]["grad"]:
+            continue
+        if spec.get("grad") and not now[t]["grad"]:
             errors.append(f"op {t!r} lost gradient support")
+        if "needs_rng" in spec and spec["needs_rng"] != now[t]["needs_rng"]:
+            errors.append(
+                f"op {t!r} changed its RNG contract (needs_rng "
+                f"{spec['needs_rng']} -> {now[t]['needs_rng']}): saved "
+                f"programs' __rng_seed__ attrs no longer line up")
     added = sorted(set(now) - set(baseline))
     return errors, added
 

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""FLAGS cross-reference lint: every ``FLAGS_<name>`` referenced
+anywhere in ``paddle_tpu/`` must be declared in ``paddle_tpu/flags.py``,
+and every declared flag must be referenced somewhere (read via
+``flag("<name>")``/``FLAGS_<name>`` or documented as an accepted-no-op
+compat knob in ``flags._COMPAT_ONLY``). Catches the two rot modes the
+typed registry can't: a flag renamed in flags.py while a doc/env
+reference keeps the old name, and a flag added "for later" that nothing
+ever reads.
+
+Usage: python tools/lint_flags.py        (exit 1 on any finding)
+Also runs as a tier-1 test (tests/test_tools_gates.py).
+"""
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PKG = os.path.join(REPO, "paddle_tpu")
+FLAGS_PY = os.path.join(PKG, "flags.py")
+
+# FLAGS_<name> in code, strings, and docstrings; <name> ending in "_"
+# is a prefix wildcard (docstring idiom "FLAGS_serving_*")
+_REF_FLAGS = re.compile(r"FLAGS_([A-Za-z0-9_]+)")
+# flag("<name>") / _flag("<name>") hot-path getter calls (the lookbehind
+# instead of \b: a word boundary never matches between '_' and 'f', so
+# \bflag\( would silently miss the dominant aliased _flag(...) idiom)
+_REF_CALL = re.compile(
+    r"(?<![A-Za-z0-9])_?flag\(\s*['\"]([A-Za-z0-9_]+)['\"]\s*\)")
+
+
+def scan_references(pkg_dir=PKG):
+    """{flag name -> [files]} for every reference outside flags.py."""
+    refs = {}
+    for dirpath, _dirs, files in sorted(os.walk(pkg_dir)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.abspath(path) == os.path.abspath(FLAGS_PY):
+                continue
+            src = open(path, encoding="utf-8", errors="replace").read()
+            rel = os.path.relpath(path, REPO)
+            for pat in (_REF_FLAGS, _REF_CALL):
+                for m in pat.finditer(src):
+                    refs.setdefault(m.group(1), []).append(rel)
+    return refs
+
+
+def check(declared, compat_only, refs):
+    """-> list of error strings (empty = clean). Wildcard references
+    (trailing "_") expand to every declared flag with that prefix."""
+    errors = []
+    referenced = set()
+    for name, files in sorted(refs.items()):
+        if name.endswith("_"):      # prefix wildcard (FLAGS_serving_*)
+            hits = {d for d in declared if d.startswith(name)}
+            if hits:
+                referenced |= hits
+            else:
+                errors.append(
+                    f"FLAGS_{name}* (in {files[0]}) matches no "
+                    f"declared flag prefix")
+            continue
+        if name in declared:
+            referenced.add(name)
+        else:
+            errors.append(
+                f"FLAGS_{name} referenced in {sorted(set(files))} but "
+                f"not declared in paddle_tpu/flags.py")
+    for name in sorted(declared - referenced - compat_only):
+        errors.append(
+            f"flag {name!r} is declared in paddle_tpu/flags.py but "
+            f"nothing in paddle_tpu/ references it (read it, or add it "
+            f"to flags._COMPAT_ONLY with a reason)")
+    for name in sorted(compat_only - declared):
+        errors.append(
+            f"_COMPAT_ONLY lists {name!r}, which is not declared")
+    for name in sorted(compat_only & referenced):
+        errors.append(
+            f"flag {name!r} is in _COMPAT_ONLY but IS referenced — "
+            f"drop it from the compat set")
+    return errors
+
+
+def main():
+    from paddle_tpu import flags as F
+    errors = check(set(F._DEFS), set(F._COMPAT_ONLY), scan_references())
+    if errors:
+        print("FLAG LINT ERRORS:")
+        for e in errors:
+            print(" -", e)
+        return 1
+    print(f"flags clean: {len(F._DEFS)} declared "
+          f"({len(F._COMPAT_ONLY)} compat-only), every reference "
+          f"declared and every non-compat flag referenced")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

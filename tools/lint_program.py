@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Standalone Program-IR lint: run the framework verifier
+(paddle_tpu/framework/analysis.py) over a saved program and print every
+diagnostic — the CLI front-end to the same checker suite
+``FLAGS_verify_passes`` runs between optimization passes.
+
+Usage:
+    python tools/lint_program.py <path> [--shapes] [--fetch NAME ...]
+    python tools/lint_program.py --list-checks
+
+<path> is an inference-model directory (containing ``__model__``), a
+``__model__``/``*.pdmodel`` JSON file, or any file written by
+save_inference_model. Exit 1 when any diagnostic fires.
+
+    --shapes        also run registry-driven shape/dtype inference
+                    checking (re-derives every output shape through the
+                    op's registered lowering; slower)
+    --fetch NAME    extra fetch targets to check reachability for
+                    (defaults to the model's recorded fetch_var_names)
+    --list-checks   print the diagnostics catalog and exit
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_program(path):
+    """(program, feed_names, fetch_names) from a model dir or file."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    with open(path) as f:
+        model = json.load(f)
+    from paddle_tpu.framework.core import Program
+    if "program" in model:          # save_inference_model layout
+        return (Program.from_dict(model["program"]),
+                model.get("feed_var_names", ()),
+                model.get("fetch_var_names", ()))
+    return Program.from_dict(model), (), ()   # bare .pdmodel program dump
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Verify a saved program's IR well-formedness")
+    ap.add_argument("path", nargs="?",
+                    help="model dir or __model__/.pdmodel file")
+    ap.add_argument("--shapes", action="store_true",
+                    help="also check declared shapes/dtypes against the "
+                         "registry lowering's inference")
+    ap.add_argument("--fetch", action="append", default=[],
+                    help="extra fetch target to check (repeatable)")
+    ap.add_argument("--pedantic", action="store_true",
+                    help="also run pedantic-tier checkers "
+                         "(dead-persistable-write)")
+    ap.add_argument("--list-checks", action="store_true",
+                    help="print the diagnostics catalog and exit")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.framework.analysis import CHECKS, collect_diagnostics
+    if args.list_checks:
+        for code in sorted(CHECKS):
+            print(f"{code:26s} {CHECKS[code]}")
+        return 0
+    if not args.path:
+        ap.error("a model path is required (or --list-checks)")
+
+    program, feeds, fetches = load_program(args.path)
+    fetches = list(fetches) + list(args.fetch)
+    diags = collect_diagnostics(program, fetch_names=fetches,
+                                feed_names=feeds,
+                                check_shapes=args.shapes,
+                                pedantic=args.pedantic)
+    n_ops = sum(len(b.ops) for b in program.blocks)
+    if not diags:
+        print(f"OK: {n_ops} ops / {len(program.blocks)} block(s), "
+              f"{len(fetches)} fetch target(s) verified"
+              + (" (shapes checked)" if args.shapes else ""))
+        return 0
+    print(f"{len(diags)} diagnostic(s) in {args.path}:")
+    for d in diags:
+        print(" -", d)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
