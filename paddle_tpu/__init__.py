@@ -44,6 +44,7 @@ from . import transpiler  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401  (unified telemetry substrate)
 from . import flags  # noqa: F401
 from . import debugger  # noqa: F401
 from . import install_check  # noqa: F401

@@ -59,6 +59,21 @@ _DEFS = {
     "serving_hedge_ms": (0.0, float, None),
     # default seed for resilience.chaos() fault-point streams
     "chaos_seed": (0, int, None),
+    # -- unified telemetry (paddle_tpu/observability) --
+    # fraction of requests that carry a trace context (wire-propagated
+    # request tracing): 0.0 = off, 1.0 = every request. Sampled at the
+    # CLIENT (serving.Client / tracing.maybe_trace); untraced requests
+    # pay one random() draw and nothing else
+    "trace_sample_rate": (0.01, float, None),
+    # flight recorder ring capacity (recent structured events kept for
+    # postmortem dumps: admissions, evictions, restarts, chaos firings,
+    # non-finite hits, weight reloads, preemptions)
+    "flight_recorder_events": (512, int, None),
+    # directory for AUTOMATIC flight-recorder dumps (written when a
+    # typed Internal/Watchdog error crosses the serving wire boundary,
+    # rate-limited). "" = automatic dumps off; the "debug_dump" wire op
+    # and FlightRecorder.dump() always work
+    "flight_recorder_dir": ("", str, None),
     # -- elastic training (paddle_tpu/train) --
     # periodic full-training-state checkpoint cadence for
     # TrainingSupervisor: one async (CheckFreq-staged) checkpoint every

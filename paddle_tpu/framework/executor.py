@@ -19,10 +19,59 @@ from .core import Program, Variable, default_main_program
 from .dtype import np_dtype
 from .lowering import analyze_block_io, build_block_fn, build_multi_step_fn
 from ..flags import flag as _flag
+from ..observability import utilization as _util
+from ..observability import metrics as _obs_metrics
+from ..observability.metrics import default_registry as _registry
+from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import NonFiniteError
 from ..resilience import maybe_fail as _maybe_fail
 
 RNG_STATE_NAME = "@RNG_KEY@"
+
+# cache_stats() key -> exported metric (name, kind)
+_CACHE_METRICS = (
+    ("hits", "executor_cache_hits_total", "counter"),
+    ("misses", "executor_cache_misses_total", "counter"),
+    ("evictions", "executor_cache_evictions_total", "counter"),
+    ("inserts", "executor_cache_inserts_total", "counter"),
+    ("entries", "executor_cache_entries_count", "gauge"),
+    ("bytes", "executor_cache_bytes", "gauge"),
+    ("pass_ms", "executor_compile_pass_ms_total", "counter"),
+    ("trace_ms", "executor_compile_trace_ms_total", "counter"),
+    ("compile_ms", "executor_compile_xla_ms_total", "counter"),
+    ("verify_ms", "executor_compile_verify_ms_total", "counter"),
+    ("compiles", "executor_compiles_total", "counter"),
+)
+
+
+# live-executor aggregation: counters bank on GC so exported *_total
+# stays monotonic across executor churn (tests, rolling in-process
+# restarts); gauges — entries/bytes — retire to zero with the cache
+# they described (observability.metrics.InstanceAggregator)
+_exec_agg = _obs_metrics.InstanceAggregator(
+    [k for k, _n, kd in _CACHE_METRICS if kd == "counter"])
+
+
+def _collect_executors():
+    """Scrape-time collector: Executor.cache_stats() summed across
+    every live executor plus the retired totals of collected ones (the
+    Python payload stays per-instance)."""
+    totals = _exec_agg.totals(
+        lambda exe: exe.cache_stats(),
+        live_only_keys=[k for k, _n, kd in _CACHE_METRICS
+                        if kd == "gauge"])
+    return [{"name": name, "kind": kind,
+             "help": f"Executor cache_stats() {key!r} (summed across "
+                     f"live executors)",
+             "labels": (), "samples": [((), totals[key])]}
+            for key, name, kind in _CACHE_METRICS]
+
+
+_registry().register_collector(
+    _collect_executors,
+    families=[{"name": name, "kind": kind,
+               "help": f"Executor cache_stats() {key!r}", "labels": ()}
+              for key, name, kind in _CACHE_METRICS])
 
 
 def _nonfinite_count(value):
@@ -181,6 +230,19 @@ class Executor:
         self._compile_stats = {"pass_ms": 0.0, "trace_ms": 0.0,
                                "compile_ms": 0.0, "compiles": 0,
                                "verify_ms": 0.0}
+        # cost_analysis memo per executable (False = backend reports
+        # nothing) + the previous dispatch mark, for the live MFU/HBM
+        # gauges (steady-state dispatch-to-dispatch timing — no sync)
+        self._exec_costs = LRUCache(max_entries=256)
+        self._last_dispatch = None
+        self._gap_streak = 0    # consecutive over-cadence deltas
+        # closures bind the stat containers, never self; clearing the
+        # cache on retire drops the compiled executables (device memory)
+        _exec_agg.track(
+            self,
+            lambda cache=self._cache, cs=self._compile_stats:
+                {**cache.stats(), **cs},
+            extra_retire=self._cache.clear)
 
     def cache_stats(self):
         """Compile-cache occupancy, hit/miss/evict counters, and the
@@ -190,6 +252,48 @@ class Executor:
         ``compiles`` (miss count), ``verify_ms`` (FLAGS_verify_passes
         program verification + per-pass translation validation)."""
         return {**self._cache.stats(), **self._compile_stats}
+
+    def _observe_utilization(self, where, cost_key, compiled):
+        """Feed the live MFU / HBM-bandwidth gauges: the executable's
+        cost_analysis() flops/bytes (memoized once per executable)
+        attached to the dispatch-to-dispatch wall time. Only
+        consecutive dispatches of the SAME executable are measured —
+        the steady-state training/inference loop — so no device sync is
+        ever forced for telemetry. A delta far above the loop's recent
+        cadence is an idle pause, not a slow step: it is dropped so the
+        gauge keeps the utilization-while-executing semantics the
+        serving stages report (utilization.py module docstring)."""
+        now = time.perf_counter()
+        cost = _util.cost_for(self._exec_costs, cost_key, compiled)
+        prev = self._last_dispatch
+        delta = cadence = None
+        if prev is not None and prev[0] == cost_key:
+            delta = now - prev[1]
+            cadence = prev[2]
+            if cadence is None:
+                # first delta only SEEDS the cadence baseline — it may
+                # span an arbitrary idle gap after warmup, which must
+                # not inflate device_compute_ms_total
+                cadence, delta = delta, None
+                self._gap_streak = 0
+            elif delta > 10.0 * cadence:
+                # one or two outliers are idle gaps; a RUN of them
+                # means the loop is durably slower, and a frozen
+                # baseline would classify every future delta as idle —
+                # gauges stuck at the pre-slowdown reading forever.
+                # Re-seed exactly like the first delta above.
+                self._gap_streak += 1
+                if self._gap_streak >= 3:
+                    cadence, delta = delta, None
+                    self._gap_streak = 0
+                else:
+                    delta = None
+            else:
+                cadence = delta
+                self._gap_streak = 0
+        self._last_dispatch = (cost_key, now, cadence)
+        if delta is not None and cost:
+            _util.observe_execution(where, cost, delta)
 
     def _optimize(self, program, fetch_names, feed_names=(), scope=None):
         """Run the FLAGS_program_passes pipeline over a clone of
@@ -468,6 +572,7 @@ class Executor:
                 jax.block_until_ready(fetches)
         else:
             fetches, new_state, new_key = self._invoke(*invoke_args)
+        self._observe_utilization("step", cache_key, compiled)
 
         bad = None
         if check_nan_inf or skip_nonfinite_steps:
@@ -476,6 +581,9 @@ class Executor:
             # roll the step back: pre-step params/accumulators and RNG go
             # back into the scope, nothing is committed
             kind, name, count = bad
+            _flightrec().record("nonfinite", program=program._uid,
+                                var=name, count=count, where=kind,
+                                rolled_back=True)
             for n, a in backup.items():
                 scope.set(n, a)
             scope.set(RNG_STATE_NAME, base_key)
@@ -493,6 +601,8 @@ class Executor:
         scope.set(RNG_STATE_NAME, new_key)
         if bad is not None:
             kind, name, count = bad
+            _flightrec().record("nonfinite", program=program._uid,
+                                var=name, count=count, where=kind)
             raise NonFiniteError(
                 f"Operator output contains Inf/Nan (FLAGS_check_nan_inf): "
                 f"{kind} {name!r} has {count} non-finite value(s) in "
@@ -701,6 +811,7 @@ class Executor:
             _prof.record_duration(
                 f"scan/program_{program._uid}_x{k_steps}", span)
             _prof.record_step_time(span / k_steps, k_steps)
+        self._observe_utilization("train", cache_key, compiled)
 
         v = np.asarray(viols) if guard else None  # ONE small readback
         # commit (buffers were donated); guard diagnostics after. If
@@ -718,6 +829,10 @@ class Executor:
         if guard and v.any():
             first = int(np.argmax(v > 0))
             name = self._slot_name(slots, first, slot_names)
+            _flightrec().record(
+                "nonfinite", program=program._uid, var=name,
+                count=int(v[first]), where=f"fused step {first}",
+                rolled_back=bool(skip_nonfinite_steps))
             if skip_nonfinite_steps:
                 rolled = int((v > 0).sum())
                 print(f"[executor] skip_nonfinite_steps: {rolled} of "

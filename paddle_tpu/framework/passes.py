@@ -162,6 +162,21 @@ def canonical_order(names):
 
 _last_stats = {"passes": [], "total_ms": 0.0, "verify_ms": 0.0}
 
+# cumulative per-pass telemetry (stats() stays "the LAST run"; these
+# feed the process metrics registry / Prometheus exposition)
+from ..observability.metrics import default_registry as _registry  # noqa: E402
+
+_M_PASS_RUNS = _registry().counter(
+    "program_pass_runs_total", "pipeline pass applications",
+    labels=("pass",), max_series=32)
+_M_PASS_MS = _registry().counter(
+    "program_pass_ms_total", "wall ms spent inside each pass",
+    labels=("pass",), max_series=32)
+_M_PASS_OPS_REMOVED = _registry().counter(
+    "program_pass_ops_removed_total",
+    "ops removed by each pass (net, clamped at 0 per run)",
+    labels=("pass",), max_series=32)
+
 
 def stats():
     """Report of the LAST apply_passes run: per-pass
@@ -248,6 +263,10 @@ def apply_passes(program, names, _validate=None, **common_attrs):
             row["verify_ms"] = _validate.last_pass_ms
         rows.append(row)
         _prof.record_duration(f"pass/{pname}", dt)
+        _M_PASS_RUNS.inc(labels=(pname,))
+        _M_PASS_MS.inc(dt * 1e3, labels=(pname,))
+        _M_PASS_OPS_REMOVED.inc(max(ops - ops_after, 0),
+                                labels=(pname,))
         ops, nbytes = ops_after, bytes_after
     _last_stats["passes"] = rows
     _last_stats["total_ms"] = (time.perf_counter() - t_pipeline) * 1e3

@@ -24,6 +24,7 @@ import numpy as np
 
 from .. import profiler as _prof
 from ..flags import flag
+from ..observability import utilization as _util
 from . import gpt
 
 
@@ -121,6 +122,11 @@ class GPTGenerator:
             self._progs[kind] = (main, outs)
         self._fns = {}      # kind -> (jitted, device_state)
         self._params = {}   # param name -> device array, shared by kinds
+        # signature -> cost_analysis dict|False for the live MFU/HBM
+        # gauges; LRU so an evicted entry recomputes instead of
+        # freezing the gauges for a still-cached executable
+        from ..utils.lru import LRUCache
+        self._exec_costs = LRUCache(max_entries=256)
 
     # -- compilation ------------------------------------------------------
     def _fetch_names(self, outs):
@@ -225,6 +231,7 @@ class GPTGenerator:
             self.cache.put(sig, compiled,
                            nbytes=ServingEngine._executable_bytes(
                                compiled, feed))
+            _util.cost_for(self._exec_costs, sig, compiled)
             if self.stats:
                 self.stats.bump("compiles")
                 self.stats.hist["compile"].observe(dt)
@@ -236,6 +243,9 @@ class GPTGenerator:
         # step needs this token)
         jax.block_until_ready(fetches)
         dt = time.perf_counter() - t0
+        cost = _util.cost_for(self._exec_costs, sig, compiled)
+        if cost:
+            _util.observe_execution(stage, cost, dt)
         if self.stats:
             self.stats.hist[stage].observe(dt)
         else:

@@ -1,0 +1,38 @@
+"""Unified telemetry substrate (the observability PR's tentpole).
+
+Four pieces, one package:
+
+- :mod:`metrics` — ``MetricsRegistry`` of labeled counters / gauges /
+  histograms with Prometheus text exposition; existing stat sinks
+  (``ServingStats``, ``Executor.cache_stats()``, ``passes.stats()``,
+  breaker states, the train supervisor) report into it via native
+  instruments or scrape-time collectors without changing their Python
+  payloads. Scraped by the ``"metrics"`` serving wire op and
+  ``tools/export_metrics.py``.
+- :mod:`tracing` — Dapper-style trace/span contexts minted at the
+  client, wire-propagated next to ``rid``, threaded through queue /
+  pad / compile / execute and the decode slot bank, recorded into the
+  profiler's unified span table so ``tools/timeline.py`` renders one
+  Chrome/Perfetto trace. ``FLAGS_trace_sample_rate`` keeps the
+  off-path cost near zero.
+- :mod:`utilization` — live MFU / HBM-bandwidth gauges: each cached
+  AOT executable's ``cost_analysis()`` flops/bytes attached to its
+  runtime step timings (``bench.py`` imports the same peak tables, so
+  live gauges and the offline roofline agree by construction).
+- :mod:`recorder` — the flight recorder: a bounded ring of recent
+  structured events (admissions, evictions, restarts, chaos firings,
+  non-finite hits, weight reloads, preemptions) dumped to JSON on a
+  typed server-boundary error or the ``"debug_dump"`` wire op.
+"""
+from .metrics import (  # noqa: F401
+    DEFAULT_BOUNDS_MS, Family, MetricsRegistry, UNIT_SUFFIXES,
+    default_registry, render_metrics,
+)
+from .recorder import FlightRecorder, flight_recorder  # noqa: F401
+from .tracing import (  # noqa: F401
+    SpanContext, ambient, current, from_wire, maybe_trace, new_trace,
+    record_child, record_span, span, to_wire,
+)
+from .utilization import (  # noqa: F401
+    executable_cost, hbm_peak, observe_execution, peak_flops, set_peaks,
+)

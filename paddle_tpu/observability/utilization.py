@@ -1,0 +1,245 @@
+"""Live MFU / HBM-bandwidth-utilization gauges.
+
+``bench.py`` derives MFU and bandwidth utilization offline from XLA's
+``cost_analysis()`` of the compiled step; this module makes the same
+measurement ALWAYS-ON: each cached AOT executable's flops/bytes are read
+once at compile time (:func:`executable_cost` — the bench ``_step_cost``
+machinery) and attached to its runtime step timings, so ``run_steps``,
+the serving engine and the decode slot bank export continuous
+``device_mfu_ratio`` / ``device_hbm_bw_util_ratio`` gauges. bench.py
+imports the peak tables from HERE, so the live gauges and the offline
+roofline agree by construction.
+
+Gauge semantics (the same for every ``where`` label): achieved rate
+over the recent MEASURED-EXECUTION window — i.e. utilization while the
+executable is actually running. Serving/decode stages time each
+execution exactly (they sync on the result); the executor's train/step
+labels use dispatch-to-dispatch deltas of a steady loop as the
+execution-time proxy (no telemetry-forced sync) and DROP deltas far
+above the loop's recent cadence, so an idle pause reads as "no new
+observation", never as a utilization collapse or a phantom busy chip.
+For duty cycle (how much of wall clock the chip computed at all),
+compare the ``device_compute_ms_total`` counter against scrape-interval
+wall time — the raw ``device_flops_total`` / ``device_hbm_bytes_total``
+counters ride along for the same reason.
+"""
+import threading
+from collections import deque
+
+from .metrics import default_registry
+
+# chip peak bf16 TFLOP/s by device_kind substring (public specs) — the
+# single source bench.py's roofline reads too
+PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+    "v6": 918.0,
+}
+
+# chip HBM peak bytes/s by device_kind substring (public specs)
+HBM_PEAK = {
+    "v5 lite": 819e9, "v5e": 819e9,
+    "v4": 1228e9,
+    "v3": 900e9,
+    "v2": 700e9,
+    "v6": 1638e9,
+}
+
+_override = {"flops": None, "bytes": None}
+
+_MFU = default_registry().gauge(
+    "device_mfu_ratio",
+    "achieved / peak FLOP rate over the recent measured-execution "
+    "window (utilization WHILE executing; duty cycle comes from "
+    "device_compute_ms_total vs wall clock)",
+    labels=("where",), max_series=16)
+_BW = default_registry().gauge(
+    "device_hbm_bw_util_ratio",
+    "achieved / peak HBM bandwidth over the recent measured-execution "
+    "window (clamped at 1.0: XLA bytes-accessed is pre-fusion and can "
+    "overcount)",
+    labels=("where",), max_series=16)
+_FLOPS = default_registry().counter(
+    "device_flops_total", "cost_analysis FLOPs dispatched",
+    labels=("where",), max_series=16)
+_BYTES = default_registry().counter(
+    "device_hbm_bytes_total", "cost_analysis bytes accessed",
+    labels=("where",), max_series=16)
+_MS = default_registry().counter(
+    "device_compute_ms_total",
+    "wall milliseconds attributed to measured executions",
+    labels=("where",), max_series=16)
+
+
+def peak_flops(device=None):
+    """Peak bf16 FLOP/s of ``device`` (default: jax.devices()[0]), or
+    None when the chip is not in the table (e.g. CPU). An operator (or
+    test) override via :func:`set_peaks` wins."""
+    if _override["flops"] is not None:
+        return _override["flops"]
+    kind = _device_kind(device)
+    for key, tf in PEAK_TFLOPS.items():
+        if key in kind:
+            return tf * 1e12
+    return None
+
+
+def hbm_peak(device=None):
+    """Peak HBM bytes/s of ``device``; same contract as
+    :func:`peak_flops`."""
+    if _override["bytes"] is not None:
+        return _override["bytes"]
+    kind = _device_kind(device)
+    for key, b in HBM_PEAK.items():
+        if key in kind:
+            return b
+    return None
+
+
+def _device_kind(device):
+    if device is None:
+        try:
+            import jax
+            device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 — no backend, no gauges
+            return ""
+    return getattr(device, "device_kind", "").lower()
+
+
+# default-device peaks memo for the hot path: the device kind cannot
+# change within a process, so observe_execution must not re-resolve
+# jax.devices() + rescan the tables per execution. set_peaks
+# invalidates.
+_peaks_memo = None
+
+
+def _default_peaks():
+    global _peaks_memo
+    if _peaks_memo is None:
+        _peaks_memo = (peak_flops(), hbm_peak())
+    return _peaks_memo
+
+
+def set_peaks(flops_per_s=None, hbm_bytes_per_s=None):
+    """Override the peak tables (unlisted hardware, or tests that need
+    deterministic ratios on CPU). ``None`` restores table lookup."""
+    global _peaks_memo
+    _override["flops"] = flops_per_s
+    _override["bytes"] = hbm_bytes_per_s
+    _peaks_memo = None
+
+
+def executable_cost(compiled):
+    """{"flops", "bytes"} from a compiled XLA executable's
+    ``cost_analysis()`` (the bench ``_step_cost`` read), or None when
+    the backend reports nothing usable. Call once per executable and
+    memoize — the analysis walk is not free."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
+        if flops <= 0 and nbytes <= 0:
+            return None
+        return {"flops": max(flops, 0.0), "bytes": max(nbytes, 0.0)}
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        return None
+
+
+def cost_for(memo, key, compiled):
+    """:func:`executable_cost` for ``compiled``, memoized in the LRU
+    ``memo`` under ``key`` (False = backend reports nothing). Misses
+    RECOMPUTE from the executable in hand, so an evicted memo entry for
+    a still-cached executable never freezes the gauges. One helper for
+    the executor, the serving engine and the generator — the False
+    sentinel contract lives here only."""
+    cost = memo.get(key)
+    if cost is None:
+        cost = executable_cost(compiled) or False
+        memo.put(key, cost)
+    return cost
+
+
+class _Window:
+    """Sliding window with O(1) running totals (add the new
+    observation, subtract the evicted one) and its OWN lock, so the
+    decode loop, the micro-batcher and the executor never contend on
+    one global lock for O(window) re-summation. The totals are
+    recomputed from the deque every 4096 observations to shed
+    accumulated float drift."""
+
+    __slots__ = ("obs", "t", "f", "b", "n", "lock")
+
+    def __init__(self):
+        self.obs = deque(maxlen=64)     # (seconds, flops, bytes)
+        self.t = self.f = self.b = 0.0
+        self.n = 0
+        self.lock = threading.Lock()
+
+    def add(self, seconds, flops, nbytes):
+        with self.lock:
+            if len(self.obs) == self.obs.maxlen:
+                es, ef, eb = self.obs[0]
+                self.t -= es
+                self.f -= ef
+                self.b -= eb
+            self.obs.append((seconds, flops, nbytes))
+            self.t += seconds
+            self.f += flops
+            self.b += nbytes
+            self.n += 1
+            if self.n % 4096 == 0:      # shed float drift
+                self.t = sum(o[0] for o in self.obs)
+                self.f = sum(o[1] for o in self.obs)
+                self.b = sum(o[2] for o in self.obs)
+            return self.t, self.f, self.b
+
+
+_windows = {}
+_lock = threading.Lock()        # guards the _windows dict only
+
+
+def observe_execution(where, cost, seconds):
+    """Attach one timed execution of an executable with ``cost``
+    (:func:`executable_cost` dict) to the live gauges for ``where``
+    ("train", "step", "infer", "prefill", "decode", ...). Counters bump
+    unconditionally; the MFU/BW gauges update only when the device's
+    peaks are known."""
+    if not cost or seconds <= 0:    # None AND cost_for's False sentinel
+        return
+    flops, nbytes = cost["flops"], cost["bytes"]
+    lab = (where,)
+    _FLOPS.inc(flops, labels=lab)
+    _BYTES.inc(nbytes, labels=lab)
+    _MS.inc(seconds * 1e3, labels=lab)
+    pf, pb = _default_peaks()
+    if pf is None and pb is None:
+        return
+    w = _windows.get(where)
+    if w is None:
+        with _lock:
+            w = _windows.setdefault(where, _Window())
+    t, f, b = w.add(seconds, flops, nbytes)
+    if t <= 0:
+        return
+    if pf:
+        _MFU.set(min(f / t / pf, 1.0), labels=lab)
+    if pb:
+        _BW.set(min(b / t / pb, 1.0), labels=lab)
+
+
+def utilization(where):
+    """Current gauge readings {mfu, hbm_bw_util} for ``where`` (0.0
+    when never observed / peaks unknown)."""
+    return {"mfu": _MFU.value(labels=(where,)),
+            "hbm_bw_util": _BW.value(labels=(where,))}
+
+
+def reset_windows():
+    """Drop the sliding windows (tests; gauges keep their last value
+    until the next observation)."""
+    with _lock:
+        _windows.clear()

@@ -11,13 +11,32 @@ the reference-style PER-OP table by interpreting a program once with
 per-op timers (normal runs stay one fused XLA module, so op cost only
 exists when you ask for it)."""
 import contextlib
+import threading
 import time
 
 import numpy as np
 
 _events = {}          # name -> [calls, total_s, max_s, min_s]
-_spans = []           # (name, start_s, end_s, tid) — timeline source
+# (name, start_s, end_s, tid[, trace_id, span_id, parent_id]) — the
+# unified timeline source: profiler events AND sampled request-trace
+# spans (observability.tracing) land here, so tools/timeline.py renders
+# one Chrome trace interleaving both. A deque: at the _MAX_SPANS cap a
+# bounded PROFILING session keeps the first N (a run's head is what a
+# bench wants), while the always-on traced stream of a long-lived
+# server rotates the OLDEST span out (a postmortem wants the newest) —
+# either way drops are counted, never silent
+import collections as _collections
+_spans = _collections.deque()
+# the traced stream appends from server threads while a driver may be
+# dumping/clearing — every structural span-table access takes this lock
+# (appends are rare enough that a ~100ns lock is in the noise)
+_spans_lock = threading.Lock()
 _MAX_SPANS = 200000   # bound memory on long profiled runs
+_spans_dropped = 0    # spans lost to the _MAX_SPANS cap since reset
+_spans_dropped_cum = 0  # process-lifetime drop total: reset_profiler
+                        # zeroes the session counter only, so the
+                        # exported telemetry_spans_dropped_total stays
+                        # monotonic (Prometheus counter contract)
 _active = False
 _trace_dir = None
 
@@ -31,6 +50,7 @@ _step_stats = [0, 0.0]  # count, total_s
 
 
 def _record(name, seconds, start=None):
+    global _spans_dropped, _spans_dropped_cum
     if not _active:
         return
     row = _events.setdefault(name, [0, 0.0, 0.0, float("inf")])
@@ -38,10 +58,52 @@ def _record(name, seconds, start=None):
     row[1] += seconds
     row[2] = max(row[2], seconds)
     row[3] = min(row[3], seconds)
-    if start is not None and len(_spans) < _MAX_SPANS:
-        import threading
-        _spans.append((name, start, start + seconds,
-                       threading.get_ident()))
+    if start is not None:
+        with _spans_lock:
+            if len(_spans) < _MAX_SPANS:
+                _spans.append((name, start, start + seconds,
+                               threading.get_ident()))
+            else:
+                # count the loss: silent truncation reads as full
+                # coverage
+                _spans_dropped += 1
+                _spans_dropped_cum += 1
+
+
+def record_span(name, start_s, end_s, trace=None):
+    """Append a completed span to the unified span table. ``trace`` is
+    an optional ``(trace_id, span_id, parent_id)`` triple from
+    ``observability.tracing``; TRACED spans record even while profiling
+    is inactive (they are the always-on sampled request stream).
+    Untraced spans record only under an active profiler. At the
+    ``_MAX_SPANS`` cap an active profiling session keeps the FIRST N
+    spans, the always-on traced stream rotates the oldest out — a
+    long-lived server's stream never silently dies; drops are counted
+    either way (:func:`spans_dropped`)."""
+    global _spans_dropped, _spans_dropped_cum
+    if trace is None and not _active:
+        return
+    row = (name, float(start_s), float(end_s), threading.get_ident())
+    with _spans_lock:
+        if len(_spans) >= _MAX_SPANS:
+            _spans_dropped += 1
+            _spans_dropped_cum += 1
+            if _active:
+                return          # profiling session: keep the run's head
+            _spans.popleft()    # traced stream: keep the newest
+        _spans.append(row if trace is None else row + tuple(trace))
+
+
+def spans_dropped():
+    """Spans lost to the ``_MAX_SPANS`` cap since the last
+    ``reset_profiler()``."""
+    return _spans_dropped
+
+
+def spans_dropped_total():
+    """Process-lifetime span-drop total — NEVER reset (the monotonic
+    counter the metrics exposition exports)."""
+    return _spans_dropped_cum
 
 
 def is_profiling():
@@ -92,8 +154,11 @@ def record_event(name):
 
 def reset_profiler():
     """reference profiler.py:113."""
+    global _spans_dropped
     _events.clear()
-    _spans.clear()
+    with _spans_lock:
+        _spans.clear()
+        _spans_dropped = 0
     for i in range(len(_step_hist)):
         _step_hist[i] = 0
     _step_stats[0] = 0
@@ -105,9 +170,21 @@ def start_profiler(state="All", tracer_option="Default",
     """reference profiler.py:129. `state` kept for parity ("CPU"/"GPU"/
     "All" pick the same path here — XLA owns the device). With trace_dir,
     also starts a jax.profiler xplane trace."""
-    global _active, _trace_dir
+    global _active, _trace_dir, _spans_dropped, _spans_dropped_cum
     if state not in ("CPU", "GPU", "All"):
         raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    # the always-on traced stream may have filled the span table while
+    # profiling was off; the session cap policy keeps the FIRST N, so
+    # starting against a full table would drop 100% of the session's
+    # spans. Trim the backlog to its newest half: every session starts
+    # with headroom, recent traced spans stay for interleaving, and the
+    # drops are counted, never silent.
+    with _spans_lock:
+        keep = _MAX_SPANS // 2
+        while len(_spans) > keep:
+            _spans.popleft()
+            _spans_dropped += 1
+            _spans_dropped_cum += 1
     _active = True
     if trace_dir:
         import jax
@@ -129,10 +206,17 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         print(f"[profiler] xplane trace written to {_trace_dir} "
               f"(load in TensorBoard / Perfetto)")
         _trace_dir = None
-    if profile_path and _spans:
+    with _spans_lock:       # a traced request may append mid-dump
+        span_snapshot = [list(s) for s in _spans]
+    if profile_path and span_snapshot:
         import json
         with open(profile_path, "w") as f:
-            json.dump({"spans": [list(s) for s in _spans]}, f)
+            json.dump({"spans": span_snapshot,
+                       "dropped": _spans_dropped}, f)
+    if _spans_dropped:
+        print(f"[profiler] {_spans_dropped} spans dropped (span table "
+              f"capped at {_MAX_SPANS}; the event table and step "
+              f"histogram still cover every call)")
     rows = summary(sorted_key)
     if rows:
         print(_format_table(rows))

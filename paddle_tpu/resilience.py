@@ -27,7 +27,62 @@ framework/executor.py share one vocabulary:
 import random
 import threading
 import time
+import weakref
 from contextlib import contextmanager
+
+from .observability.metrics import default_registry as _registry
+from .observability.recorder import flight_recorder as _flightrec
+
+_CHAOS_FIRED = _registry().counter(
+    "chaos_faults_fired_total",
+    "chaos-harness faults actually injected, by armed point",
+    labels=("point",), max_series=64)
+
+# every live CircuitBreaker, for the breaker-state metrics collector
+_BREAKERS = weakref.WeakSet()
+_BREAKER_STATES = {"closed": 0, "half-open": 1, "open": 2}
+_BREAKER_SERIES_CAP = 64
+# endpoints ever folded past the cap: dropped = len(set) is monotone
+# and grows with actual cardinality, not with scrape frequency
+_folded_endpoints = set()
+_fold_lock = threading.Lock()
+
+
+def _collect_breakers():
+    by_endpoint = {}
+    for b in list(_BREAKERS):
+        ep = b.endpoint or "unknown"
+        st = _BREAKER_STATES.get(b.state, 0)
+        by_endpoint[ep] = max(by_endpoint.get(ep, 0), st)
+    items = sorted(by_endpoint.items())
+    if len(items) > _BREAKER_SERIES_CAP:
+        # fold the overflow into one _other series (max state, so an
+        # OPEN breaker past the cap still trips dashboards) and feed
+        # the fold count to telemetry_series_dropped_total — silent
+        # truncation would read as "all breakers closed" mid-outage
+        kept = items[:_BREAKER_SERIES_CAP - 1]
+        overflow = items[_BREAKER_SERIES_CAP - 1:]
+        kept.append(("_other", max(st for _ep, st in overflow)))
+        items = kept
+        with _fold_lock:
+            _folded_endpoints.update(ep for ep, _st in overflow)
+    with _fold_lock:
+        dropped = len(_folded_endpoints)
+    return [{"name": "resilience_breaker_state", "kind": "gauge",
+             "help": "circuit breaker state by endpoint "
+                     "(0=closed, 1=half-open, 2=open; max across "
+                     "same-endpoint breakers)",
+             "labels": ("endpoint",),
+             "samples": [((ep,), st) for ep, st in items],
+             "dropped": dropped}]
+
+
+_registry().register_collector(
+    _collect_breakers,
+    families=[{"name": "resilience_breaker_state", "kind": "gauge",
+               "help": "circuit breaker state by endpoint "
+                       "(0=closed, 1=half-open, 2=open)",
+               "labels": ("endpoint",)}])
 
 
 # --------------------------------------------------------------------------
@@ -203,6 +258,7 @@ class CircuitBreaker:
         self._opened_at = None
         self._half_open_inflight = False
         self._lock = threading.Lock()
+        _BREAKERS.add(self)
 
     @property
     def state(self):
@@ -335,9 +391,11 @@ def run_with_watchdog(fn, budget_secs, *args, what=None, **kwargs):
     t.start()
     t.join(float(budget_secs))
     if t.is_alive():
+        what = what or getattr(fn, "__name__", "operation")
+        _flightrec().record("watchdog", what=str(what),
+                            budget_s=float(budget_secs))
         raise WatchdogTimeout(
-            f"{what or getattr(fn, '__name__', 'operation')} exceeded "
-            f"its {budget_secs}s wall-clock budget")
+            f"{what} exceeded its {budget_secs}s wall-clock budget")
     if "error" in box:
         raise box["error"]
     return box.get("result")
@@ -415,6 +473,11 @@ class ChaosMonkey:
             self.hits[point] = self.hits.get(point, 0) + 1
             if fire:
                 self.fired[point] = self.fired.get(point, 0) + 1
+        if fire:
+            # black-box the injection: a chaos-soak postmortem dump
+            # names every fault point that actually fired
+            _CHAOS_FIRED.inc(labels=(point,))
+            _flightrec().record("chaos", point=point, seed=self.seed)
 
     def total_fired(self):
         with self._lock:

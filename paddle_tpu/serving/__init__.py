@@ -32,6 +32,15 @@ clipper/ORCA adaptive-batching tradition:
   reuse the moment a row finishes); ``stats()`` adds prefill/decode/
   sample histograms, ``tokens_per_s`` and ``decode_occupancy``
 
+- telemetry: the ``metrics`` wire op (``Client.metrics()``) returns the
+  Prometheus text exposition of the process metrics registry
+  (``paddle_tpu.observability``); ``debug_dump`` returns the flight
+  recorder's recent structured events; ``infer``/``generate`` frames
+  may carry a ``trace`` context (sampled client-side at
+  ``FLAGS_trace_sample_rate``) that the server threads through every
+  stage into the profiler's unified span table for
+  ``tools/timeline.py``
+
 - resilience: the server runs a lifecycle state machine (warming ->
   serving -> draining -> stopped, degraded while the loop supervisor's
   breaker is open), a ``health`` wire op, ``drain()`` graceful shutdown,

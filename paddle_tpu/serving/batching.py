@@ -21,6 +21,8 @@ import time
 
 import numpy as np
 
+from ..observability import tracing as _trace
+from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import (CircuitBreaker, CircuitOpenError, WatchdogTimeout,
                           maybe_fail, run_with_watchdog)
 
@@ -76,6 +78,17 @@ class BadRequestError(ServingError):
     fixing the input will not help."""
 
 
+def _record_queue_span(req, now):
+    """One copy of the queue-span arithmetic for both batchers: the
+    span ends NOW and covers the monotonic time since enqueue, re-based
+    onto the profiler's perf_counter clock."""
+    if req.trace is None:
+        return
+    pc = time.perf_counter()
+    _trace.record_child("serving/queue", pc - (now - req.t_enqueue), pc,
+                        req.trace)
+
+
 class Request:
     """One in-flight prediction request.
 
@@ -88,7 +101,7 @@ class Request:
 
     __slots__ = ("feeds", "rows", "example_sig", "deadline_at",
                  "deadline_ms", "t_enqueue", "t_flush", "result", "error",
-                 "_done")
+                 "_done", "trace")
 
     def __init__(self, feeds, deadline_ms=None):
         self.feeds = {n: np.ascontiguousarray(a) for n, a in feeds.items()}
@@ -119,6 +132,10 @@ class Request:
         self.result = None
         self.error = None
         self._done = threading.Event()
+        # request-scoped trace context: the server's connection handler
+        # (or any caller) installs one via tracing.ambient() before
+        # admission; stage spans (queue/pad/execute/decode) parent here
+        self.trace = _trace.current()
 
     # -- lifecycle --------------------------------------------------------
     def expired(self, now=None):
@@ -170,6 +187,9 @@ class RequestQueue:
         self._cv = threading.Condition()
         self._closed = False
         self._draining = False
+        # flight-recorder admission sampling: per-outcome counters
+        self._adm_lock = threading.Lock()
+        self._adm_counts = {}
         self.stats = stats
         if breaker is None:
             from ..flags import flag
@@ -183,6 +203,21 @@ class RequestQueue:
         with self._cv:
             return len(self._items)
 
+    def _record_admission(self, outcome, **fields):
+        """Flight-record one admission outcome, SAMPLED per outcome
+        (first, then every 64th): at production QPS — shed storms
+        included — a per-request event would turn the ring over in
+        under a second and evict exactly the rare events (restarts,
+        chaos, non-finite) the black box exists to keep. The cumulative
+        per-outcome count rides every sampled event, so the dump still
+        quantifies a storm it didn't record request-by-request."""
+        with self._adm_lock:
+            n = self._adm_counts.get(outcome, 0) + 1
+            self._adm_counts[outcome] = n
+        if n == 1 or n % 64 == 0:
+            _flightrec().record("admission", outcome=outcome, n=n,
+                                **fields)
+
     def put(self, req):
         """Admit ``req`` or raise ServerOverloadedError /
         DeadlineExceededError. Never blocks — backpressure is a fast
@@ -193,17 +228,21 @@ class RequestQueue:
         except CircuitOpenError as e:
             if self.stats:
                 self.stats.bump("shed_overload")
+            self._record_admission("shed_breaker")
             raise ServerOverloadedError(
                 f"load shedding: {e}") from e
         if req.expired():
             self.breaker.release_probe()    # not the server's fault
             if self.stats:
                 self.stats.bump("shed_deadline")
+            self._record_admission("shed_deadline",
+                                   deadline_ms=req.deadline_ms)
             req.expire(where="admission")
             raise req.error
         with self._cv:
             if self._closed or self._draining:
                 self.breaker.release_probe()
+                self._record_admission("shutdown")
                 raise ServerShutdownError(
                     "server is draining — admission closed"
                     if self._draining and not self._closed
@@ -218,12 +257,14 @@ class RequestQueue:
             self.breaker.record_failure()
             if self.stats:
                 self.stats.bump("shed_overload")
+            self._record_admission("shed_overload", depth=self.max_depth)
             raise ServerOverloadedError(
                 f"request queue at depth limit ({self.max_depth}); "
                 f"retry with backoff")
         self.breaker.record_success()
         if self.stats:
             self.stats.bump("requests_admitted")
+        self._record_admission("admitted", rows=req.rows)
         return req
 
     def get(self, timeout=None):
@@ -537,6 +578,7 @@ class DecodeBatcher:
                 if self.stats:
                     self.stats.bump("requests_failed")
                 continue
+            _record_queue_span(req, now)
             take.append(req)
             self._admitting = len(take)
         if not take:
@@ -647,6 +689,12 @@ class DecodeBatcher:
                 self._check_deadlines(time.monotonic())
                 if not self._active:
                     continue
+                # per-token spans for TRACED rows only (sampled at the
+                # client edge): untraced traffic pays one list-comp over
+                # <= slots entries per step
+                traced = [r for r in self._active.values()
+                          if r.trace is not None]
+                t_step0 = time.perf_counter() if traced else 0.0
                 try:
                     toks = self.engine.step(self._tok, self._pos,
                                             self._temp, self._topk,
@@ -668,6 +716,11 @@ class DecodeBatcher:
                     # _active/_free now — do not touch them
                     return
                 self.consecutive_failures = 0
+                if traced:
+                    t_step1 = time.perf_counter()
+                    for r in traced:
+                        _trace.record_child("serving/decode", t_step0,
+                                            t_step1, r.trace)
                 live = len(self._active)
                 if self.stats:
                     self.stats.observe_decode_step(live, self.slots)
@@ -837,6 +890,7 @@ class MicroBatcher:
                 req.t_flush = now
                 if self.stats:
                     self.stats.hist["queue"].observe(now - req.t_enqueue)
+                _record_queue_span(req, now)
                 live.append(req)
         if not live:
             return

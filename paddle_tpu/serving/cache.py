@@ -42,8 +42,18 @@ class ExecutableCache(LRUCache):
                 max_entries = flag("serving_cache_entries")
             if max_bytes is None:
                 max_bytes = flag("serving_cache_bytes")
+
+        def _evict_hook(key, value, _user=on_evict):
+            # every eviction lands in the flight recorder: "why did
+            # that signature recompile mid-soak" is answerable
+            from ..observability.recorder import flight_recorder
+            flight_recorder().record("eviction", cache="executable",
+                                     signature=str(key)[:200])
+            if _user is not None:
+                _user(key, value)
+
         super().__init__(max_entries=max_entries, max_bytes=max_bytes,
-                         on_evict=on_evict)
+                         on_evict=_evict_hook)
 
     signature = staticmethod(feed_signature)
 

@@ -17,8 +17,11 @@ import numpy as np
 
 from .batching import next_bucket
 from .cache import ExecutableCache, feed_signature
+from ..observability import tracing as _trace
+from ..observability import utilization as _util
 from ..resilience import (CheckpointCorruptError, maybe_fail,
                           run_with_watchdog)
+from ..utils.lru import LRUCache
 
 SIGNATURE_FILE = "_serving_signatures.json"
 
@@ -137,6 +140,9 @@ class ServingEngine:
                     f"scope — load_inference_model must run first")
             self._state[n] = jax.device_put(np.asarray(v))
         self.cache = cache if cache is not None else ExecutableCache()
+        # feed signature -> cost_analysis dict|False (LRU: misses for
+        # still-cached executables recompute via _util.cost_for)
+        self._costs = LRUCache(max_entries=256)
         gb = program.global_block()
         # batching across requests is only sound when every feed's
         # leading dim is dynamic (-1): a static-batch model is executed
@@ -171,6 +177,9 @@ class ServingEngine:
         nbytes = self._executable_bytes(compiled, feed)
         sig = feed_signature(feed)
         self.cache.put(sig, compiled, nbytes=nbytes)
+        # cost_analysis read once per executable: the live MFU/HBM
+        # gauges attach it to every later execute() timing
+        _util.cost_for(self._costs, sig, compiled)
         if self.stats:
             self.stats.bump("compiles")
             self.stats.hist["compile"].observe(dt)
@@ -195,11 +204,17 @@ class ServingEngine:
         return sum(a.nbytes for a in feed.values())
 
     def _executable_for(self, feed):
+        """(signature, executable, compile_seconds) for ``feed`` —
+        ``compile_seconds`` is None on a cache hit, so callers can
+        attribute a compile span without re-implementing the miss
+        path."""
         sig = feed_signature(feed)
         compiled = self.cache.get(sig)
         if compiled is None:
+            t0 = time.perf_counter()
             compiled = self._compile(feed)
-        return compiled
+            return sig, compiled, time.perf_counter() - t0
+        return sig, compiled, None
 
     # -- hot weight reload ------------------------------------------------
     def load_state_snapshot(self, dirname):
@@ -228,7 +243,7 @@ class ServingEngine:
         cached): returns the fetch list as numpy arrays."""
         state = self._state          # one snapshot for the whole call
         feed = {n: np.ascontiguousarray(feeds[n]) for n in self.feed_names}
-        compiled = self._executable_for(feed)
+        _sig, compiled, _dt = self._executable_for(feed)
         outs = compiled(state, feed)
         return [np.asarray(o) for o in outs]
 
@@ -276,12 +291,27 @@ class ServingEngine:
         t_pad = time.perf_counter() - t_pad0
         if self.stats:
             self.stats.hist["pad"].observe(t_pad)
+        traced = [r for r in live if r.trace is not None]
+        for req in traced:
+            _trace.record_child("serving/pad", t_pad0, t_pad0 + t_pad,
+                                req.trace)
 
-        compiled = self._executable_for(feed)
+        sig, compiled, compile_s = self._executable_for(feed)
+        if compile_s is not None:
+            t_c1 = time.perf_counter()
+            for req in traced:
+                _trace.record_child("serving/compile", t_c1 - compile_s,
+                                    t_c1, req.trace)
         t_exec0 = time.perf_counter()
         outs = compiled(state, feed)
         outs = [np.asarray(o) for o in outs]
         t_exec = time.perf_counter() - t_exec0
+        for req in traced:
+            _trace.record_child("serving/execute", t_exec0,
+                                t_exec0 + t_exec, req.trace)
+        cost = _util.cost_for(self._costs, sig, compiled)
+        if cost:
+            _util.observe_execution("infer", cost, t_exec)
         if self.stats:
             self.stats.hist["execute"].observe(t_exec)
             self.stats.observe_batch(total, bucket)
@@ -495,6 +525,7 @@ class GenerationEngine:
         Returns the first tokens as np int32 [len(requests)]."""
         maybe_fail("serving.prefill")
         self._ensure_caches()
+        t0 = time.perf_counter()
         n = len(requests)
         tokens, pos_ids, last = self.gen._pack_prompts(
             [req.prompt for req in requests])
@@ -510,7 +541,12 @@ class GenerationEngine:
         toks, self._key = self.gen._run_sample(logits, temp, topk,
                                                self._key)
         self._insert(row_caches, list(slot_ids))
-        return np.asarray(toks)[:n]
+        out = np.asarray(toks)[:n]
+        t1 = time.perf_counter()
+        for req in requests:
+            if getattr(req, "trace", None) is not None:
+                _trace.record_child("serving/prefill", t0, t1, req.trace)
+        return out
 
     def step(self, tokens, pos, temperature, top_k, budget=None):
         """One decode + sample over the whole slot bank. ``tokens``/

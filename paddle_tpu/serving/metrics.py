@@ -1,21 +1,28 @@
 """Serving observability: per-stage latency histograms, throughput and
 batch-occupancy counters.
 
-Two export paths share one measurement: every stage duration lands in a
-fixed-bucket ``LatencyHistogram`` here (always on — integer bumps, no
-allocation) AND in ``paddle_tpu.profiler``'s event table via
-``profiler.record_duration`` (visible only while profiling is active, so
-``profiler.profiler()`` around a traffic replay yields the familiar
-Fluid-style table with ``serving/queue``, ``serving/pad``,
-``serving/compile``, ``serving/execute`` rows)."""
+Three export paths share one measurement: every stage duration lands in
+a fixed-bucket ``LatencyHistogram`` here (always on — integer bumps, no
+allocation), in ``paddle_tpu.profiler``'s event table via
+``profiler.record_duration`` (visible only while profiling is active),
+AND — aggregated across every live ``ServingStats`` sink — in the
+process-global ``observability.MetricsRegistry`` through a scrape-time
+collector, so the ``"metrics"`` wire op / ``tools/export_metrics.py``
+expose ``serving_*_total`` counters and the
+``serving_stage_latency_ms`` histogram in Prometheus text format. The
+``snapshot()`` payload (the ``server.stats()`` contract) is unchanged.
+"""
 import threading
+
 import time
 
 from .. import profiler as _prof
-
-# log-spaced upper bounds in milliseconds; the last bucket is +inf
-DEFAULT_BOUNDS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
-                     100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+# log-spaced upper bounds in milliseconds (last bucket +inf) — ONE
+# definition, owned by the lower-level substrate: the registry bridge
+# below zips LatencyHistogram counts against these bounds at scrape
+# time, so a second copy here could silently truncate the zip
+from ..observability.metrics import DEFAULT_BOUNDS_MS  # noqa: F401
+from ..observability.metrics import InstanceAggregator, default_registry
 
 
 class LatencyHistogram:
@@ -51,42 +58,160 @@ class LatencyHistogram:
     def count(self):
         return self._count
 
+    def _state(self):
+        """One consistent copy of everything derived values need."""
+        with self._lock:
+            return list(self._counts), self._count, self._sum, self._max
+
+    def _estimate(self, counts, count, mx, p):
+        """Percentile from a CONSISTENT (counts, count, max) snapshot —
+        all of snapshot()'s derived values come from one copy, so p50/
+        p99 can never disagree with count under concurrent observe()."""
+        if not count:
+            return 0.0
+        target = count * (float(p) / 100.0)
+        seen = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if seen + c >= target:
+                lo = self.bounds_ms[i - 1] if i > 0 else 0.0
+                hi = (self.bounds_ms[i]
+                      if i < len(self.bounds_ms) else mx * 1e3)
+                frac = (target - seen) / c
+                return (lo + (max(hi, lo) - lo) * frac) / 1e3
+            seen += c
+        return mx
+
     def percentile(self, p):
         """p in [0, 100] -> estimated latency in seconds."""
-        with self._lock:
-            if not self._count:
-                return 0.0
-            target = self._count * (float(p) / 100.0)
-            seen = 0
-            for i, c in enumerate(self._counts):
-                if not c:
-                    continue
-                if seen + c >= target:
-                    lo = self.bounds_ms[i - 1] if i > 0 else 0.0
-                    hi = (self.bounds_ms[i]
-                          if i < len(self.bounds_ms) else self._max * 1e3)
-                    frac = (target - seen) / c
-                    return (lo + (max(hi, lo) - lo) * frac) / 1e3
-                seen += c
-            return self._max
+        counts, count, _total, mx = self._state()
+        return self._estimate(counts, count, mx, p)
 
     def snapshot(self):
-        with self._lock:
-            count, total, mx = self._count, self._sum, self._max
+        counts, count, total, mx = self._state()
         return {
             "count": count,
             "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
-            "p50_ms": round(self.percentile(50) * 1e3, 3),
-            "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "p50_ms": round(self._estimate(counts, count, mx, 50) * 1e3,
+                            3),
+            "p99_ms": round(self._estimate(counts, count, mx, 99) * 1e3,
+                            3),
             "max_ms": round(mx * 1e3, 3),
         }
+
+
+# -- registry bridge ---------------------------------------------------
+
+# counter banking across sink churn lives in the shared
+# InstanceAggregator (see its docstring for the monotonicity
+# rationale); the stage-HISTOGRAM mass of garbage-collected sinks is
+# serving-specific and banked here, riding the same finalizer
+_retired_lock = threading.Lock()
+_retired_stages = {}            # stage -> [bucket counts, count, sum]
+
+
+def _merge_hist(stages, stage, hist):
+    """Fold one LatencyHistogram's consistent (counts, count, sum)
+    snapshot into ``stages[stage]`` — the one copy of the bucket merge
+    shared by the retire bank and the live scrape."""
+    with hist._lock:
+        counts, count, tot = list(hist._counts), hist._count, hist._sum
+    agg = stages.get(stage)
+    if agg is None:
+        stages[stage] = [counts, count, tot]
+    else:
+        agg[0] = [a + b for a, b in zip(agg[0], counts)]
+        agg[1] += count
+        agg[2] += tot
+
+
+def _retire_hists(hists):
+    """Fold a dead sink's stage histograms into the retired totals (the
+    closure keeps only the histogram dict alive, not the sink)."""
+    with _retired_lock:
+        for stage, h in hists.items():
+            _merge_hist(_retired_stages, stage, h)
+
+# ServingStats counter keys (module-level so the metrics collector can
+# DECLARE serving_<key>_total families without an instance)
+_COUNTER_KEYS = (
+    "requests_admitted",
+    "requests_completed",
+    "requests_failed",
+    "shed_overload",
+    "shed_deadline",
+    "batches",
+    "rows",               # real example rows executed
+    "padded_rows",        # bucket capacity across executed batches
+    "compiles",
+    # -- generation (decode batching) --
+    "generate_requests",
+    "tokens_generated",
+    "decode_steps",
+    "decode_rows",        # live generation rows stepped
+    "decode_slot_rows",   # slot capacity across steps
+    # -- resilience layer --
+    "engine_failures",      # failed execute / decode steps
+    "watchdog_timeouts",    # executes killed by the watchdog
+    "loop_restarts",        # supervisor-restarted loop threads
+    "weight_reloads",       # successful reload_weights swaps
+    "hedge_dedup_hits",     # hedged twins joined in flight
+    "requests_cancelled",   # cancel op (hedge losers)
+)
+
+
+_sink_agg = InstanceAggregator(_COUNTER_KEYS)
+
+
+def _collect():
+    """Scrape-time collector: aggregate counters and stage histograms
+    across every live ServingStats sink (multiple servers in one
+    process sum — one chip, one exposition) PLUS the retired totals of
+    collected sinks, so the exported counters never decrease."""
+    totals = _sink_agg.totals(lambda s: s._counts_copy())
+    sinks = _sink_agg.live()
+    with _retired_lock:
+        stage_counts = {stage: [list(a[0]), a[1], a[2]]
+                        for stage, a in _retired_stages.items()}
+    for s in sinks:
+        for stage, h in s.hist.items():
+            _merge_hist(stage_counts, stage, h)
+    fams = [{"name": f"serving_{k}_total", "kind": "counter",
+             "help": f"ServingStats counter {k!r}", "labels": (),
+             "samples": [((), totals[k])]} for k in _COUNTER_KEYS]
+    hsamples = []
+    for stage in sorted(stage_counts):
+        counts, count, tot = stage_counts[stage]
+        cum, buckets = 0, []
+        for le, c in zip(DEFAULT_BOUNDS_MS + (float("inf"),), counts):
+            cum += c
+            buckets.append((le, cum))
+        hsamples.append(((stage,), {"buckets": buckets, "count": count,
+                                    "sum": round(tot * 1e3, 6)}))
+    fams.append({"name": "serving_stage_latency_ms", "kind": "histogram",
+                 "help": "per-stage serving latency (sum in ms)",
+                 "labels": ("stage",), "samples": hsamples})
+    return fams
+
+
+default_registry().register_collector(
+    _collect,
+    families=[{"name": f"serving_{k}_total", "kind": "counter",
+               "help": f"ServingStats counter {k!r}", "labels": ()}
+              for k in _COUNTER_KEYS]
+    + [{"name": "serving_stage_latency_ms", "kind": "histogram",
+        "help": "per-stage serving latency (sum in ms)",
+        "labels": ("stage",)}])
 
 
 class ServingStats:
     """One shared stats sink for queue, batcher, engine and server: stage
     histograms plus monotonic counters. ``snapshot()`` is the
     ``server.stats()`` payload — plain ints/floats only, so it crosses
-    the wire protocol's typed value universe unchanged."""
+    the wire protocol's typed value universe unchanged. Every live sink
+    also aggregates into the process metrics registry (see module
+    docstring)."""
 
     STAGES = ("queue", "pad", "compile", "execute", "total",
               # generation pipeline stages (KV-cached decoding):
@@ -100,30 +225,14 @@ class ServingStats:
                      for s in self.STAGES}
         self._lock = threading.Lock()
         self._started = time.monotonic()
-        self._c = {
-            "requests_admitted": 0,
-            "requests_completed": 0,
-            "requests_failed": 0,
-            "shed_overload": 0,
-            "shed_deadline": 0,
-            "batches": 0,
-            "rows": 0,            # real example rows executed
-            "padded_rows": 0,     # bucket capacity across executed batches
-            "compiles": 0,
-            # -- generation (decode batching) --
-            "generate_requests": 0,
-            "tokens_generated": 0,
-            "decode_steps": 0,
-            "decode_rows": 0,       # live generation rows stepped
-            "decode_slot_rows": 0,  # slot capacity across steps
-            # -- resilience layer --
-            "engine_failures": 0,     # failed execute / decode steps
-            "watchdog_timeouts": 0,   # executes killed by the watchdog
-            "loop_restarts": 0,       # supervisor-restarted loop threads
-            "weight_reloads": 0,      # successful reload_weights swaps
-            "hedge_dedup_hits": 0,    # hedged twins joined in flight
-            "requests_cancelled": 0,  # cancel op (hedge losers)
-        }
+        self._c = {k: 0 for k in _COUNTER_KEYS}
+        # closures bind the stat containers, never self
+        _sink_agg.track(self, lambda c=self._c: dict(c),
+                        extra_retire=lambda h=self.hist: _retire_hists(h))
+
+    def _counts_copy(self):
+        with self._lock:
+            return dict(self._c)
 
     def bump(self, name, n=1):
         with self._lock:

@@ -17,6 +17,12 @@ Wire protocol (all values inside the typed wire universe):
                                     |"BadRequest"|"Internal",
               "error": str}
     request  {"op": "stats"}   -> {"ok": True, "stats": {...}}
+    request  {"op": "metrics"} -> {"ok": True, "metrics": str}
+                                  (Prometheus text exposition of the
+                                   process metrics registry)
+    request  {"op": "debug_dump", "write": bool} -> {"ok": True,
+                                  "events": [...], "path": str|None}
+                                  (flight-recorder snapshot / dump)
     request  {"op": "ping"}    -> {"ok": True}
     request  {"op": "health"}  -> {"ok": True, "health": {state, queue
                                    depths, loop liveness, weights_version}}
@@ -29,6 +35,13 @@ expiry reply carries how long the request actually waited. A request
 that expires mid-execution still completes and returns its result — the
 chip's work is never thrown away.
 
+Tracing: ``infer``/``generate`` requests may carry a ``"trace"`` dict
+(``{"tid", "sid"}``, minted client-side at ``FLAGS_trace_sample_rate``)
+next to the existing ``rid``; the server threads a child context through
+admission -> queue -> pad/compile/execute (and prefill/decode in the
+slot bank), recording spans into the profiler's unified span table so
+``tools/timeline.py`` renders one Chrome/Perfetto trace per request.
+
 Resilience layer: the server walks a lifecycle state machine (warming ->
 serving -> draining -> stopped, plus degraded while the loop supervisor's
 breaker is open), ``drain()`` is the graceful half of shutdown (stop
@@ -38,6 +51,7 @@ swaps a manifest-verified checkpoint in without dropping traffic, and
 pair (Dean & Barroso, "The Tail at Scale") dedups onto ONE in-flight
 execution and the loser is cancelled by rid.
 """
+import contextlib
 import socket
 import threading
 import time
@@ -56,6 +70,9 @@ from .metrics import ServingStats
 from .supervise import LoopSupervisor
 from ..distributed.wire import (WireError, default_key, recv_frame,
                                 send_frame)
+from ..observability import tracing as _trace
+from ..observability.metrics import render_metrics
+from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import WatchdogTimeout, retry_call
 
 
@@ -442,6 +459,10 @@ class InferenceServer:
             self._weights_version += 1
             version = self._weights_version
         self.stats_sink.bump("weight_reloads")
+        _flightrec().record("weight_reload", path=str(path),
+                            weights_version=version,
+                            swap_pause_ms=round(float(pause_ms or 0.0),
+                                                3))
         return {"weights_version": version,
                 "swap_pause_ms": round(float(pause_ms or 0.0), 3)}
 
@@ -492,10 +513,16 @@ class InferenceServer:
                     reply = _error_reply(e)
                 else:
                     reply = self._handle(msg)
+                tr = msg.get("trace") if isinstance(msg, dict) else None
+                t_r0 = time.perf_counter() if tr is not None else 0.0
                 try:
                     send_frame(conn, reply, self._key)
                 except (ConnectionError, OSError):
                     return
+                if tr is not None:
+                    _trace.record_child("serving/reply", t_r0,
+                                        time.perf_counter(),
+                                        _trace.from_wire(tr))
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -523,6 +550,13 @@ class InferenceServer:
                 self._rids.popitem(last=False)
             return req, False
 
+    def metrics(self):
+        """Prometheus text exposition of the process metrics registry
+        (serving counters/histograms, executor cache, pass pipeline,
+        breaker states, training, utilization gauges — everything that
+        reports into ``observability.default_registry()``)."""
+        return render_metrics()
+
     def _handle(self, msg):
         if not isinstance(msg, dict) or "op" not in msg:
             return {"ok": False, "etype": "BadRequest",
@@ -532,6 +566,10 @@ class InferenceServer:
             return {"ok": True}
         if op == "stats":
             return {"ok": True, "stats": self.stats()}
+        if op == "metrics":
+            return {"ok": True, "metrics": self.metrics()}
+        if op == "debug_dump":
+            return self._handle_debug_dump(msg)
         if op == "health":
             return {"ok": True, "health": self.health()}
         if op == "cancel":
@@ -541,38 +579,59 @@ class InferenceServer:
         if op != "infer":
             return {"ok": False, "etype": "BadRequest",
                     "error": f"unknown op {op!r}"}
+        return self._handle_infer(msg)
+
+    def _handle_debug_dump(self, msg):
+        """Flight-recorder snapshot over the wire; ``"write": True``
+        also dumps it to a JSON file server-side and returns the
+        path."""
+        rec = _flightrec()
+        path = None
+        if msg.get("write"):
+            try:
+                path = rec.dump(reason="debug_dump wire op")
+            except OSError as e:
+                return _error_reply(e)
+        return {"ok": True, "events": rec.snapshot(), "path": path}
+
+    def _handle_infer(self, msg):
         if self.engine is None:
             return {"ok": False, "etype": "BadRequest",
                     "error": "no inference model loaded — this server "
                              "only serves 'generate'"}
-        try:
-            feed = msg.get("feed")
-            if not isinstance(feed, dict) or not feed:
-                raise ValueError("'feed' must be a non-empty dict of "
-                                 "arrays")
-            missing = [n for n in self.engine.feed_names if n not in feed]
-            if missing:
-                raise ValueError(f"missing feeds: {missing}")
-            feed = {n: np.asarray(feed[n])
-                    for n in self.engine.feed_names}
-            req, joined = self._dedup(
-                msg.get("rid"),
-                lambda: self.submit(feed,
-                                    deadline_ms=msg.get("deadline_ms")))
-            if joined and self.stats_sink:
-                self.stats_sink.bump("hedge_dedup_hits")
-        except Exception as e:  # noqa: BLE001 — typed refusal reply
-            return _error_reply(e)
-        # bound the wait: the deadline (if any) plus compile/execute
-        # headroom, else a hard server-side cap
-        budget = msg.get("deadline_ms")
-        wait_s = (budget / 1e3 + 60.0) if budget else 300.0
-        try:
-            outs = req.wait(timeout=wait_s)
-            return {"ok": True, "fetch": tuple(outs),
-                    "batched": int(req.rows)}
-        except Exception as e:  # noqa: BLE001 — surface, don't die
-            return _error_reply(e)
+        # the handler span is ambient for the whole body, so the
+        # Request minted inside parents its stage spans under it
+        with _trace.span("serving/handle",
+                         parent=_trace.from_wire(msg.get("trace"))):
+            try:
+                feed = msg.get("feed")
+                if not isinstance(feed, dict) or not feed:
+                    raise ValueError("'feed' must be a non-empty dict "
+                                     "of arrays")
+                missing = [n for n in self.engine.feed_names
+                           if n not in feed]
+                if missing:
+                    raise ValueError(f"missing feeds: {missing}")
+                feed = {n: np.asarray(feed[n])
+                        for n in self.engine.feed_names}
+                req, joined = self._dedup(
+                    msg.get("rid"),
+                    lambda: self.submit(
+                        feed, deadline_ms=msg.get("deadline_ms")))
+                if joined and self.stats_sink:
+                    self.stats_sink.bump("hedge_dedup_hits")
+            except Exception as e:  # noqa: BLE001 — typed refusal reply
+                return _error_reply(e)
+            # bound the wait: the deadline (if any) plus compile/execute
+            # headroom, else a hard server-side cap
+            budget = msg.get("deadline_ms")
+            wait_s = (budget / 1e3 + 60.0) if budget else 300.0
+            try:
+                outs = req.wait(timeout=wait_s)
+                return {"ok": True, "fetch": tuple(outs),
+                        "batched": int(req.rows)}
+            except Exception as e:  # noqa: BLE001 — surface, don't die
+                return _error_reply(e)
 
     def _handle_cancel(self, msg):
         """Cancel a request by client request id (the hedge loser): a
@@ -598,6 +657,11 @@ class InferenceServer:
             return {"ok": False, "etype": "BadRequest",
                     "error": "this server has no generator — pass "
                              "generator= to InferenceServer"}
+        with _trace.span("serving/handle",
+                         parent=_trace.from_wire(msg.get("trace"))):
+            return self._handle_generate_inner(msg)
+
+    def _handle_generate_inner(self, msg):
         try:
             tokens = msg.get("tokens")
             if tokens is None:
@@ -655,11 +719,42 @@ _ETYPES = {etype: cls for etype, cls in _ETYPE_MAP
 _ETYPES["BadRequest"] = BadRequestError
 
 
+_ierr_lock = threading.Lock()
+_ierr_counts = {}       # exception type name -> cumulative count
+
+
+def _record_internal_error(exc):
+    """Flight-record an internal error crossing the server boundary,
+    SAMPLED per exception type (first, then every 64th, cumulative
+    count riding each sampled event — the RequestQueue admission
+    discipline): a wedged engine failing every request at production
+    QPS must not churn the ring and evict the restart/chaos/non-finite
+    events that explain WHY it wedged."""
+    key = type(exc).__name__
+    with _ierr_lock:
+        n = _ierr_counts.get(key, 0) + 1
+        _ierr_counts[key] = n
+    if n == 1 or n % 64 == 0:
+        _flightrec().record("internal_error", etype=key, n=n,
+                            error=str(exc)[:200])
+
+
 def _error_reply(exc):
-    """Map an exception to its typed wire reply."""
+    """Map an exception to its typed wire reply. Internal/Watchdog
+    faults crossing the server boundary trigger an automatic
+    flight-recorder dump (rate-limited; only when
+    ``FLAGS_flight_recorder_dir`` is set) — the chaos-soak postmortem
+    artifact."""
     for etype, cls in _ETYPE_MAP:
         if isinstance(exc, cls):
+            if etype == "Watchdog":
+                _flightrec().auto_dump(
+                    f"Watchdog error crossed the server boundary: {exc}")
             return {"ok": False, "etype": etype, "error": str(exc)}
+    _record_internal_error(exc)
+    _flightrec().auto_dump(
+        f"Internal error crossed the server boundary: "
+        f"{type(exc).__name__}: {exc}")
     return {"ok": False, "etype": "Internal",
             "error": f"{type(exc).__name__}: {exc}"}
 
@@ -843,21 +938,41 @@ class Client:
                 pass
         return reply
 
+    @contextlib.contextmanager
+    def _traced(self, msg):
+        """Attach the sampled/ambient trace context to an outgoing
+        request and record the client/send span around the call — the
+        one copy of the trace-attach arithmetic for infer/generate."""
+        ctx = _trace.maybe_trace()
+        if ctx is not None:
+            msg["trace"] = _trace.to_wire(ctx)
+        t0p = time.perf_counter() if ctx is not None else 0.0
+        try:
+            yield
+        finally:
+            if ctx is not None:
+                _trace.record_span("client/send", t0p,
+                                   time.perf_counter(), ctx)
+
     # -- ops ---------------------------------------------------------------
     def infer(self, feeds, deadline_ms=None, hedge_ms=None):
         """Returns the fetch list (numpy arrays). Raises
         DeadlineExceededError / ServerOverloadedError /
         ServerShutdownError mapped from the server's reply,
         ConnectionError on transport failure. ``hedge_ms`` overrides the
-        client's hedging delay for this call (0 disables)."""
+        client's hedging delay for this call (0 disables). At
+        ``FLAGS_trace_sample_rate`` (or inside an ambient
+        ``tracing.span``) the request carries a trace context the
+        server's stages parent under."""
         msg = {"op": "infer", "feed": dict(feeds),
                "deadline_ms": deadline_ms, "rid": uuid.uuid4().hex}
         delay_s = self._hedge_delay_s(hedge_ms)
         t0 = time.monotonic()
-        if delay_s <= 0:
-            reply = self._call(msg)
-        else:
-            reply = self._call_hedged(msg, delay_s)
+        with self._traced(msg):
+            if delay_s <= 0:
+                reply = self._call(msg)
+            else:
+                reply = self._call_hedged(msg, delay_s)
         self._lat_s.append(time.monotonic() - t0)
         return [np.asarray(a) for a in reply["fetch"]]
 
@@ -867,7 +982,7 @@ class Client:
         Returns the NEW tokens as a 1-D np.int32 array (EOS excluded).
         Same error mapping as ``infer``; ``deadline_ms`` is token-level
         (checked between decode steps server-side)."""
-        reply = self._call({
+        msg = {
             "op": "generate",
             "tokens": np.asarray(tokens, dtype=np.int32).ravel(),
             "max_new_tokens": int(max_new_tokens),
@@ -876,7 +991,9 @@ class Client:
             "eos_id": None if eos_id is None else int(eos_id),
             "deadline_ms": deadline_ms,
             "rid": uuid.uuid4().hex,
-        })
+        }
+        with self._traced(msg):
+            reply = self._call(msg)
         return np.asarray(reply["tokens"], dtype=np.int32)
 
     def cancel(self, rid):
@@ -893,6 +1010,27 @@ class Client:
 
     def stats(self):
         return self._idempotent({"op": "stats"})["stats"]
+
+    def metrics(self):
+        """Prometheus text exposition of the server process's metrics
+        registry (the scrape endpoint: pipe it to a pushgateway or the
+        node-exporter textfile collector via
+        ``tools/export_metrics.py``)."""
+        return self._idempotent({"op": "metrics"})["metrics"]
+
+    def debug_dump(self, write=False):
+        """The server's flight-recorder snapshot:
+        ``{"ok", "events", "path"}`` with ``events`` the structured
+        event dicts, oldest first. ``write=True`` also dumps them to a
+        JSON file server-side; ``path`` is then its location (None
+        otherwise)."""
+        msg = {"op": "debug_dump", "write": bool(write)}
+        if write:
+            # the server-side file write is NOT idempotent: a retry
+            # after a dropped reply would leave orphan dump files that
+            # disagree about the incident window — one shot only
+            return self._call(msg)
+        return self._idempotent(msg)
 
     def health(self):
         """The server's lifecycle/liveness snapshot (state, queue

@@ -29,6 +29,7 @@ Restart counts and per-loop liveness are exported through
 import threading
 import time
 
+from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import CircuitBreaker
 
 
@@ -158,10 +159,13 @@ class LoopSupervisor:
                 and now - self._last_failure > self.reset_secs:
             self.breaker.record_success()
             self._degraded = False
+            _flightrec().record("recovered")
             if self.on_recovered:
                 self.on_recovered()
 
     def _restart(self, name, ent, now, reason):
+        _flightrec().record("loop_restart", loop=name, reason=reason,
+                            restarts=ent["restarts"] + 1)
         ent["batcher"].restart(reason=reason)
         ent["restarts"] += 1
         ent["last_restart"] = now
@@ -176,5 +180,7 @@ class LoopSupervisor:
         self.breaker.record_failure()
         if self.breaker.state != "closed" and not self._degraded:
             self._degraded = True
+            _flightrec().record("degraded",
+                                breaker=self.breaker.state)
             if self.on_degraded:
                 self.on_degraded()

@@ -54,10 +54,30 @@ import numpy as np
 
 from ..flags import flag as _flag
 from ..framework.executor import Scope, global_scope, _device_put_slab
+from ..observability.metrics import default_registry as _registry
+from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import (PreemptedError, RestartBudgetExceeded,
                           WatchdogTimeout, run_with_watchdog)
 from .checkpoint import TrainCheckpoint
 from . import preemption as _preempt
+
+_M_SLAB_MS = _registry().histogram(
+    "train_slab_ms",
+    "wall ms per supervised fused slab (dispatch + any guard sync)",
+    bounds=(1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+            1000.0, 2500.0, 5000.0, 10000.0, 30000.0))
+_M_CKPT_MS = _registry().histogram(
+    "train_checkpoint_ms",
+    "wall ms per training checkpoint save (critical-path half: the "
+    "synchronous gather for async saves, the full write otherwise)",
+    bounds=(5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+            2500.0, 5000.0, 10000.0, 30000.0))
+_M_CKPTS = _registry().counter(
+    "train_checkpoints_total", "training checkpoints saved")
+_M_RESTARTS = _registry().counter(
+    "train_restarts_total", "supervised training-loop restarts")
+_M_PREEMPTIONS = _registry().counter(
+    "train_preemptions_total", "preemption exits (typed PreemptedError)")
 
 
 class _ListSlabIter:
@@ -213,6 +233,10 @@ class TrainingSupervisor:
             except Exception as exc:  # noqa: BLE001 — supervised restart
                 restarts += 1
                 restart_errors.append(type(exc).__name__)
+                _M_RESTARTS.inc()
+                _flightrec().record("train_restart",
+                                    error=type(exc).__name__,
+                                    restarts=restarts)
                 if restarts > self.restart_budget:
                     raise RestartBudgetExceeded(
                         f"training crashed {restarts} time(s), exceeding "
@@ -249,7 +273,6 @@ class TrainingSupervisor:
     # -- one attempt (fresh or resumed) -----------------------------------
     def _attempt(self, make_iter, dataset, fetch_list, epochs,
                  fetches, recovery_t0, recoveries_ms):
-        exe = self.executor
         state = self.resume()
         if state is None:
             self._fresh_init(dataset)
@@ -298,22 +321,18 @@ class TrainingSupervisor:
                         # CheckFreq staging: join the PREVIOUS persist
                         # (usually done), snapshot now, write async
                         self.checkpoint.wait()
-                        self.checkpoint.save(
-                            exe, program=self._plain_program,
-                            scope=self._scope,
-                            train_state=self._train_state(
-                                epoch, cursor_batches, slab_idx, step,
-                                base_seed),
+                        self._timed_save(
+                            self._train_state(epoch, cursor_batches,
+                                              slab_idx, step, base_seed),
                             async_save=True)
                         checkpoints += 1
                     cur, cur_pos = nxt, nxt_pos
                 cursor_batches = 0
         # final durable checkpoint: next-epoch cursor, synchronous
         self.checkpoint.wait()
-        final_no = self.checkpoint.save(
-            exe, program=self._plain_program, scope=self._scope,
-            train_state=self._train_state(max(1, epochs), 0, slab_idx,
-                                          step, base_seed))
+        final_no = self._timed_save(
+            self._train_state(max(1, epochs), 0, slab_idx, step,
+                              base_seed))
         result = {"slabs": slab_idx, "steps": step,
                   "epochs": max(1, epochs), "checkpoints": checkpoints + 1,
                   "checkpoint_no": final_no, "last_fetches": last_fetches}
@@ -322,6 +341,31 @@ class TrainingSupervisor:
         return result
 
     # -- helpers -----------------------------------------------------------
+    def _timed_save(self, train_state, async_save=False):
+        """One checkpoint save with its critical-path duration landed in
+        the ``train_checkpoint_ms`` histogram + a flight-recorder
+        event."""
+        t0 = time.perf_counter()
+        no = self.checkpoint.save(
+            self.executor, program=self._plain_program,
+            scope=self._scope, train_state=train_state,
+            async_save=async_save)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if (not async_save
+                and no not in self.checkpoint.saver.checkpoint_numbers()):
+            # the commit was abandoned mid-save (bounded-deadline
+            # preemption gave up on this number): nothing durable
+            # exists, so counting it would have the telemetry
+            # contradict the adjacent "preempted" event
+            return no
+        _M_CKPT_MS.observe(dt_ms)
+        _M_CKPTS.inc()
+        _flightrec().record("checkpoint", no=no,
+                            slab=train_state.get("slab"),
+                            async_save=bool(async_save),
+                            critical_path_ms=round(dt_ms, 3))
+        return no
+
     def _train_state(self, epoch, batches, slab, step, base_seed):
         return {"epoch": epoch, "batches": batches, "slab": slab,
                 "step": step, "shuffle_base_seed": base_seed,
@@ -382,12 +426,16 @@ class TrainingSupervisor:
         kwargs = dict(feed=slab, fetch_list=fetch_list,
                       scope=self._scope, return_numpy=False,
                       skip_nonfinite_steps=self.skip_nonfinite_steps)
-        if self.step_watchdog_s > 0:
-            return run_with_watchdog(
-                self.executor.run_steps, self.step_watchdog_s,
-                self.program, what=f"fused training slab ({k} steps)",
-                **kwargs)
-        return self.executor.run_steps(self.program, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            if self.step_watchdog_s > 0:
+                return run_with_watchdog(
+                    self.executor.run_steps, self.step_watchdog_s,
+                    self.program,
+                    what=f"fused training slab ({k} steps)", **kwargs)
+            return self.executor.run_steps(self.program, **kwargs)
+        finally:
+            _M_SLAB_MS.observe((time.perf_counter() - t0) * 1e3)
 
     def _preempt_exit(self, slab_idx, step, epoch, batches, base_seed):
         """Bounded-deadline fast checkpoint, then typed exit. A save
@@ -400,9 +448,7 @@ class TrainingSupervisor:
 
         def _fast_save():
             self.checkpoint.wait()     # pending async persists count too
-            return self.checkpoint.save(
-                self.executor, program=self._plain_program,
-                scope=self._scope, train_state=state)
+            return self._timed_save(state)
 
         try:
             if self.preempt_deadline_s > 0:
@@ -423,6 +469,9 @@ class TrainingSupervisor:
                   f"checkpoint stands")
             no = self.checkpoint.latest_no()
         reason = _preempt.preemption_reason() or "requested"
+        _M_PREEMPTIONS.inc()
+        _flightrec().record("preempted", reason=reason, slab=slab_idx,
+                            step=step, checkpoint_no=no)
         raise PreemptedError(
             f"training preempted ({reason}) at slab {slab_idx} "
             f"(step {step}); newest durable checkpoint: "

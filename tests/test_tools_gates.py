@@ -134,6 +134,18 @@ def test_lint_flags_gate():
     assert any(some_ref in e and "compat" in e for e in errors), errors
 
 
+def test_lint_metrics_gate():
+    """tools/lint_metrics.py: every registered metric name is
+    snake_case, unique, unit-suffixed and documented in the README
+    catalog — and the CLI itself gates in tier-1."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "lint_metrics.py")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "metrics clean" in ok.stdout
+
+
 def test_timeline_conversion_end_to_end():
     """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
     valid Chrome trace JSON."""

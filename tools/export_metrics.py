@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Dump Prometheus text-format metrics to a textfile (the node-exporter
+textfile-collector idiom) or stdout.
+
+Two sources:
+
+- ``--endpoint host:port``: scrape a running ``InferenceServer`` over
+  the wire (the ``"metrics"`` op — works across processes).
+- no endpoint: render THIS process's registry (useful from a training
+  driver: ``import tools.export_metrics as em; em.export(path)`` after
+  importing paddle_tpu subsystems).
+
+The output file is written atomically (tmp + rename) so a scraper never
+reads a torn exposition.
+
+Usage:
+    python tools/export_metrics.py --endpoint 127.0.0.1:8500 \\
+        --out /var/lib/node_exporter/textfile/paddle_tpu.prom
+    python tools/export_metrics.py            # this process, stdout
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def scrape(endpoint=None, auth_key=None):
+    """The exposition text, from a remote server or this process."""
+    if endpoint:
+        from paddle_tpu.serving import Client
+        with Client(endpoint, auth_key=auth_key) as c:
+            return c.metrics()
+    from paddle_tpu.observability import render_metrics
+    return render_metrics()
+
+
+def export(path, text=None, endpoint=None):
+    """Write the exposition atomically to ``path``; returns the byte
+    count."""
+    text = text if text is not None else scrape(endpoint)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(text)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoint", default=None,
+                    help="serving endpoint host:port (default: render "
+                         "this process's registry)")
+    ap.add_argument("--out", default=None,
+                    help="textfile path (default: stdout)")
+    args = ap.parse_args()
+    if args.out:
+        n = export(args.out, endpoint=args.endpoint)
+        print(f"wrote {n} bytes to {args.out}")
+    else:
+        sys.stdout.write(scrape(args.endpoint))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,6 +4,17 @@ tools/timeline.py — its --profile_path proto becomes the spans JSON
 that paddle_tpu.profiler.stop_profiler(profile_path=...) writes; load
 the output in chrome://tracing or Perfetto).
 
+Spans come in two shapes, unified in one span table:
+
+    [name, start_s, end_s, tid]                          profiler event
+    [name, start_s, end_s, tid, trace_id, span_id,
+     parent_id]                                          traced request
+
+Traced spans (observability.tracing, wire-propagated request tracing)
+carry their ids in the event ``args`` and are linked parent -> child
+with Chrome flow events, so ONE request renders as one connected trace
+interleaved with the host-side profiler spans around it.
+
 Usage:
     python tools/timeline.py --profile_path /tmp/profile \\
         --timeline_path /tmp/timeline.json
@@ -13,16 +24,22 @@ import json
 
 
 def to_chrome_trace(spans):
-    """spans: [(name, start_s, end_s, tid)] -> Chrome trace dict
-    (complete events, microsecond timebase, normalized to t0)."""
+    """spans: [(name, start_s, end_s, tid[, trace_id, span_id,
+    parent_id])] -> Chrome trace dict (complete events, microsecond
+    timebase, normalized to t0; flow events link traced parent/child
+    spans)."""
     if not spans:
         return {"traceEvents": []}
     t0 = min(s[1] for s in spans)
     events = []
     tids = {}
-    for name, start, end, tid in spans:
+    # span_id -> (end_ts, tid) of traced spans, for flow binding
+    by_span_id = {}
+    traced = []
+    for s in spans:
+        name, start, end, tid = s[0], s[1], s[2], s[3]
         tids.setdefault(tid, len(tids))
-        events.append({
+        ev = {
             "name": name,
             "ph": "X",                       # complete event
             "ts": (start - t0) * 1e6,
@@ -30,12 +47,35 @@ def to_chrome_trace(spans):
             "pid": 0,
             "tid": tids[tid],
             "cat": "host",
-        })
+        }
+        if len(s) >= 7:
+            trace_id, span_id, parent_id = s[4], s[5], s[6]
+            ev["cat"] = "request"
+            ev["args"] = {"trace_id": trace_id, "span_id": span_id,
+                          "parent_span_id": parent_id}
+            by_span_id[span_id] = (ev["ts"], ev["dur"], tids[tid])
+            traced.append(ev)
+        events.append(ev)
+    # flow events: one arrow per traced child from its parent span
+    flows = []
+    for ev in traced:
+        parent = ev["args"]["parent_span_id"]
+        src = by_span_id.get(parent)
+        if not src:
+            continue
+        fid = f"{ev['args']['trace_id']}/{ev['args']['span_id']}"
+        src_ts, src_dur, src_tid = src
+        flows.append({"name": "trace", "ph": "s", "cat": "request",
+                      "id": fid, "pid": 0, "tid": src_tid,
+                      "ts": src_ts})
+        flows.append({"name": "trace", "ph": "f", "bp": "e",
+                      "cat": "request", "id": fid, "pid": 0,
+                      "tid": ev["tid"], "ts": ev["ts"]})
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
              "args": {"name": "paddle_tpu host"}}]
     meta += [{"name": "thread_name", "ph": "M", "pid": 0, "tid": i,
               "args": {"name": f"thread {i}"}} for i in tids.values()]
-    return {"traceEvents": meta + events,
+    return {"traceEvents": meta + events + flows,
             "displayTimeUnit": "ms"}
 
 
@@ -47,11 +87,15 @@ def main():
                     help="output Chrome trace JSON")
     args = ap.parse_args()
     with open(args.profile_path) as f:
-        spans = json.load(f)["spans"]
+        doc = json.load(f)
+    spans = doc["spans"]
     with open(args.timeline_path, "w") as f:
         json.dump(to_chrome_trace(spans), f)
-    print(f"wrote {args.timeline_path} ({len(spans)} spans) — open in "
-          f"chrome://tracing or Perfetto")
+    dropped = doc.get("dropped", 0)
+    drop_note = f"; {dropped} spans were dropped at record time" \
+        if dropped else ""
+    print(f"wrote {args.timeline_path} ({len(spans)} spans{drop_note}) "
+          f"— open in chrome://tracing or Perfetto")
 
 
 if __name__ == "__main__":
