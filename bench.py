@@ -1309,6 +1309,76 @@ def bench_decode():
             "speedup_vs_full_recompute": round(dt_naive / dt_kv, 2),
             "first_call_ms": round(compile_plus_first_ms, 1),
         }
+
+    # paged + quantized rows (block-paged KV pool, serving/kvpool +
+    # kernels/paged_attention) at the longest prompt: fp32 is the
+    # bitwise greedy-parity row, bf16/int8 the bandwidth-multiplier
+    # rows (cache bytes per token is the decode roofline)
+    seq = max(seqs)
+    prompt = [rng.integers(1, cfg.vocab_size, seq).astype(np.int32)]
+    dense_out = gen.generate(prompt, max_new_tokens=new_tokens)
+    paged = {}
+    for kv_dtype in ("fp32", "bf16", "int8"):
+        t0 = time.perf_counter()
+        out = gen.generate(prompt, max_new_tokens=new_tokens,
+                           paged=True, kv_dtype=kv_dtype)
+        first_ms = (time.perf_counter() - t0) * 1e3
+        n = min(len(out[0]), len(dense_out[0]))
+        match = float(np.mean(np.asarray(out[0][:n])
+                              == np.asarray(dense_out[0][:n]))) \
+            if n else 1.0
+        if kv_dtype == "fp32":
+            assert np.array_equal(out[0], dense_out[0]), \
+                "paged fp32 greedy decode diverged from the dense bank"
+        reps = 2
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            gen.generate(prompt, max_new_tokens=new_tokens, paged=True,
+                         kv_dtype=kv_dtype)
+        dt_p = (time.perf_counter() - t0) / reps
+        paged[kv_dtype] = {
+            "tokens_per_sec": round(new_tokens / dt_p, 2),
+            "ms_per_token": round(dt_p / new_tokens * 1e3, 3),
+            "greedy_match_vs_dense": round(match, 4),
+            "first_call_ms": round(first_ms, 1),
+        }
+
+    # concurrent-slots-at-fixed-HBM: give the paged pool EXACTLY the
+    # bytes a dense 8-slot fp32 bank holds at max_len=2048 and count
+    # how many (prompt seq + new_tokens)-token generations its
+    # allocator admits (pure accounting — no device arrays are built).
+    # The dense bank admits its 8 slots whatever the real lengths.
+    from paddle_tpu.serving.kvpool import (KVBlockPool,
+                                           KVPoolExhaustedError)
+    bank_len, dense_slots = 2048, 8
+    req_tokens = seq + new_tokens
+    d_head = cfg.hidden_size // cfg.num_heads
+    fixed_hbm = {"max_len": bank_len, "dense_slots": dense_slots,
+                 "request_tokens": req_tokens}
+    for kv_dtype in ("fp32", "bf16", "int8"):
+        pool = KVBlockPool(
+            slots=4096, num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads, d_head=d_head,
+            max_seq_len=bank_len, block_size=16, num_blocks=2,
+            dtype=kv_dtype, name=f"bench_{kv_dtype}")
+        budget = dense_slots * pool.dense_slot_bytes()
+        pool.num_blocks = budget // pool.block_bytes() + 1
+        pool.reset()                       # rebuild the free list
+        fixed_hbm.setdefault("hbm_budget_mib",
+                             round(budget / 2**20, 2))
+        admitted = 0
+        try:
+            while admitted < pool.slots:
+                pool.alloc(admitted, req_tokens)
+                admitted += 1
+        except KVPoolExhaustedError:
+            pass
+        fixed_hbm[kv_dtype] = {
+            "slots": admitted,
+            "x_vs_dense": round(admitted / dense_slots, 2),
+        }
+    assert fixed_hbm["fp32"]["slots"] >= 2 * dense_slots, fixed_hbm
+
     return {
         "metric": "decode_kv_cache_seq256_tokens_per_sec",
         "value": per_seq[str(max(seqs))]["tokens_per_sec"],
@@ -1318,6 +1388,8 @@ def bench_decode():
         "speedup_vs_full_recompute":
             per_seq[str(max(seqs))]["speedup_vs_full_recompute"],
         "seq": per_seq,
+        "paged": paged,
+        "fixed_hbm_concurrency": fixed_hbm,
         "cache": gen.cache.stats(),
     }
 

@@ -101,6 +101,25 @@ _DEFS = {
     # one compiled decode executable; finished rows free their slot for
     # the next admitted request (continuous batching)
     "decode_slots": (8, int, None),
+    # -- paged KV cache (serving/kvpool, kernels/paged_attention) --
+    # opt-in block-paged decode memory: KV caches live in a shared
+    # block pool with per-slot block tables (vLLM/PagedAttention)
+    # instead of the dense [slots, H, max_len, D] bank; blocks allocate
+    # on append and free on EOS/deadline/cancel, so concurrency is
+    # bounded by actual tokens. 0 keeps the dense bank (the parity
+    # baseline)
+    "kv_paged": (False, bool, None),
+    # KV-cache element type: fp32 (bitwise baseline), bf16 (half the
+    # cache bytes), int8 (quarter, with per-(block, head, slot) float32
+    # scales) — at bandwidth-bound decode, cache bytes ARE tokens/s
+    "kv_cache_dtype": ("fp32", str, None),
+    # tokens per KV block: small = fine-grained allocation (less
+    # last-block waste), large = smaller tables and fewer allocations
+    "kv_block_size": (16, int, None),
+    # total pool blocks (incl. the reserved trash block); 0 = size the
+    # pool HBM-equivalent to the dense bank it replaces
+    # (slots * ceil(max_len/block_size) + 1)
+    "kv_pool_blocks": (0, int, None),
     # Executor per-(program, feed-shape) compile cache entry cap — bounds
     # what was previously unbounded growth per input-shape signature
     "executor_cache_entries": (128, int, None),

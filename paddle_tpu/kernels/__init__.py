@@ -4,3 +4,6 @@ only where XLA underperforms"). Each kernel ships with an XLA composite
 fallback so every op runs on any backend; the Pallas path is selected on
 TPU."""
 from .flash_attention import flash_attention  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    dequantize_kv, paged_attention, quantize_kv,
+)

@@ -1,0 +1,252 @@
+"""Paged decode-attention as a Pallas TPU kernel, with a pure-JAX oracle.
+
+The decode half of the flash-attention story (kernels/flash_attention.py
+fused prefill): one query token per row attends over that row's KV cache
+stored as BLOCKS of a shared pool (vLLM/PagedAttention, Kwon et al.
+2023) instead of a dense per-slot ``[B, H, max_len, D]`` bank. The
+block-table gather IS the kernel's index map — each grid step's
+``BlockSpec`` resolves ``(tables[b, j], h, 0, 0)`` from a
+scalar-prefetched block table, so the gather and the attention read are
+one fused pass over VMEM-resident blocks and the ``[B, max_len]`` dense
+cache is never materialized (decode is bandwidth-bound: bytes streamed
+per token IS the token rate).
+
+Two implementations, same math:
+
+- ``pallas``: grid ``(B, H, blocks_per_row)``, online-softmax running
+  state (m, l, acc) in VMEM scratch carried across a row's blocks,
+  dead-block skipping via the per-row position counter (a block past
+  ``pos[b]`` is never fetched into the running state — table padding
+  rides the same skip), int8 blocks dequantized in-register against
+  their per-slot scales. ``interpret`` runs the SAME kernel through the
+  Pallas interpreter on CPU.
+- ``xla``: a ``jnp.take``-based gather + masked softmax composite — the
+  CPU-CI path and the parity oracle the kernel is tested against.
+
+Quantized cache (KVQuant-style bandwidth multiplier): blocks may hold
+``int8`` values with a float32 scale per (block, head, slot) stored in a
+parallel ``[N, H, block_size]`` array — at bandwidth-bound decode,
+quarter-size cache bytes are ~4x tokens/s headroom. ``quantize_kv``/
+``dequantize_kv`` are the one symmetric-scale codec every writer/reader
+shares (absmax / 127 per head-token, zero-scale guarded).
+
+Layout: q ``[B, H, 1, D]`` (single decode step per row), k/v pools
+``[num_blocks, H, block_size, D]``, block tables ``[B, blocks_per_row]``
+int32 (entries past a row's allocation point at the reserved trash
+block — masked by ``pos``), pos ``[B]`` int32 (index of the query's own
+slot: key slot j is visible iff ``j <= pos[b]``).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_QMAX = 127.0        # symmetric int8 range
+
+
+def _auto_impl():
+    backend = jax.default_backend()
+    return "pallas" if backend in ("tpu", "axon") else "xla"
+
+
+# ------------------------------------------------------------ quant codec
+
+def quantize_kv(kv):
+    """Symmetric per-head-token int8 quantization of ``kv`` [..., D]:
+    returns (int8 values, float32 scale [...]) with
+    ``scale = absmax(D) / 127`` (0 -> 1.0 so an all-zero vector round-
+    trips exactly). The ONE codec shared by the pool writer ops, the
+    prefill scatter and the attention readers."""
+    kv = kv.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(kv), axis=-1) / _QMAX
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.round(kv / scale[..., None])
+    q = jnp.clip(q, -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv`: int8 values [..., D] * scale
+    [...] -> float32."""
+    return q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+
+
+# -------------------------------------------------------------- reference
+
+def _xla_paged_attention(q, k_pool, v_pool, tables, pos, k_scale, v_scale,
+                         scale):
+    """Gather-then-attend composite: per-row ``jnp.take`` of the row's
+    blocks, per-row position mask, fp32 softmax — identical math to
+    ``ops.decode_ops.kv_cached_attention`` over the gathered layout.
+    Runs anywhere (CPU CI) and is the kernel's parity oracle."""
+    B, H, S, D = q.shape
+    bs = k_pool.shape[2]
+    nblk = tables.shape[1]
+    L = nblk * bs
+
+    def gather(pool, sc):
+        # [B, nblk, H, bs, D] -> [B, H, L, D], dequantized
+        g = jnp.take(pool, tables, axis=0)
+        if sc is not None:
+            gs = jnp.take(sc, tables, axis=0)        # [B, nblk, H, bs]
+            g = dequantize_kv(g, gs)
+        g = g.astype(jnp.float32)
+        return g.transpose(0, 2, 1, 3, 4).reshape(B, H, L, D)
+
+    k = gather(k_pool, k_scale)
+    v = gather(v_pool, v_scale)
+    scores = jnp.einsum("bhsd,bhld->bhsl", q.astype(jnp.float32),
+                        k) * scale
+    key_idx = jnp.arange(L, dtype=jnp.int32)[None, None, :]       # [1,1,L]
+    qry_pos = pos.astype(jnp.int32)[:, None, None] \
+        + jnp.arange(S, dtype=jnp.int32)[None, :, None]
+    mask = key_idx <= qry_pos                                     # [B,S,L]
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsl,bhld->bhsd", probs, v)
+    return out.astype(q.dtype)
+
+
+# ----------------------------------------------------------------- kernel
+
+def _paged_kernel(tables_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                  vs_ref, out_ref, m_sc, l_sc, acc_sc, *, scale, bs,
+                  nblk):
+    """One (b, h, j) grid step folds block j of row b into the running
+    online-softmax state. The block-table gather already happened in the
+    BlockSpec index map — k_ref/v_ref hold block ``tables[b, j]``."""
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    p = pos_ref[b]
+
+    # dead-block skip: block j covers key slots [j*bs, (j+1)*bs); nothing
+    # there is visible once j*bs > pos[b]. Block-table padding (trash
+    # block 0) only ever appears PAST a row's allocation, so the same
+    # predicate keeps garbage out of the state.
+    @pl.when(j * bs <= p)
+    def _fold():
+        qv = q_ref[0, 0].astype(jnp.float32)                  # [1, D]
+        kb = k_ref[0, 0]                                      # [bs, D]
+        if ks_ref is not None:
+            kb = kb.astype(jnp.float32) \
+                * ks_ref[0, 0].reshape(bs, 1).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qv, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale       # [1, bs]
+        idx = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx <= p, s, _NEG_INF)
+        m_prev = m_sc[0, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s))
+        corr = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)                               # [1, bs]
+        vb = v_ref[0, 0]
+        if vs_ref is not None:
+            vb = vb.astype(jnp.float32) \
+                * vs_ref[0, 0].reshape(bs, 1).astype(jnp.float32)
+        acc_sc[:, :] = acc_sc[:, :] * corr + jax.lax.dot_general(
+            pr.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [1, D]
+        l_sc[0, 0] = l_sc[0, 0] * corr + jnp.sum(pr)
+        m_sc[0, 0] = m_new
+
+    @pl.when(j == nblk - 1)
+    def _finalize():
+        l = l_sc[0, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0, 0] = (acc_sc[:, :] / l).astype(out_ref.dtype)
+
+
+def _pallas_paged_attention(q, k_pool, v_pool, tables, pos, k_scale,
+                            v_scale, scale, interpret):
+    B, H, S, D = q.shape
+    if S != 1:
+        raise ValueError(
+            f"paged_attention kernel decodes ONE query per row (S=1), "
+            f"got S={S}; prefill goes through flash_attention")
+    bs = k_pool.shape[2]
+    nblk = tables.shape[1]
+    quant = k_scale is not None
+
+    # index maps see the grid indices THEN the scalar-prefetch refs:
+    # the pool block for (b, j) is whatever the row's table names — the
+    # fused gather
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, D), lambda b, h, j, t, p: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda b, h, j, t, p: (t[b, j], h, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda b, h, j, t, p: (t[b, j], h, 0, 0)),
+    ]
+    args = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs),
+                         lambda b, h, j, t, p: (t[b, j], h, 0)),
+            pl.BlockSpec((1, 1, bs),
+                         lambda b, h, j, t, p: (t[b, j], h, 0)),
+        ]
+        args += [k_scale, v_scale]
+
+    body = functools.partial(_paged_kernel, scale=scale, bs=bs, nblk=nblk)
+
+    if quant:
+        kern = body
+    else:
+        def kern(tables_ref, pos_ref, q_ref, k_ref, v_ref, out_ref,
+                 m_sc, l_sc, acc_sc):
+            body(tables_ref, pos_ref, q_ref, k_ref, v_ref, None, None,
+                 out_ref, m_sc, l_sc, acc_sc)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, nblk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, 1, D),
+                               lambda b, h, j, t, p: (b, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.float32),
+                        pltpu.VMEM((1, 1), jnp.float32),
+                        pltpu.VMEM((1, D), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), pos.astype(jnp.int32), *args)
+
+
+# ----------------------------------------------------------- public entry
+
+def paged_attention(q, k_pool, v_pool, block_tables, pos, k_scale=None,
+                    v_scale=None, scale=None, impl=None):
+    """Decode attention of one query per row over a block-paged KV pool.
+
+    q ``[B, H, 1, D]``; k_pool/v_pool ``[num_blocks, H, block_size, D]``
+    (float32/bfloat16, or int8 with ``k_scale``/``v_scale``
+    ``[num_blocks, H, block_size]``); block_tables ``[B, blocks_per_row]``
+    int32; pos ``[B]`` int32. Returns ``[B, H, 1, D]`` in q's dtype.
+    impl: None (auto — pallas on TPU backends, xla elsewhere),
+    "pallas", "interpret" (Pallas interpreter, CPU-runnable), "xla"
+    (the gather composite / parity oracle)."""
+    if scale is None or scale == 0.0:
+        scale = float(q.shape[-1]) ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("paged_attention needs BOTH k_scale and "
+                         "v_scale for a quantized pool (or neither)")
+    if k_pool.dtype == jnp.int8 and k_scale is None:
+        raise ValueError("int8 KV pool needs k_scale/v_scale arrays")
+    impl = impl or _auto_impl()
+    if impl == "xla":
+        return _xla_paged_attention(q, k_pool, v_pool, block_tables, pos,
+                                    k_scale, v_scale, float(scale))
+    return _pallas_paged_attention(q, k_pool, v_pool, block_tables, pos,
+                                   k_scale, v_scale, float(scale),
+                                   impl == "interpret")

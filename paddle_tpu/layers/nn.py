@@ -795,6 +795,60 @@ def kv_cached_attention(q, k_cache, v_cache, pos, scale=0.0, name=None):
     return out
 
 
+def paged_kv_cache_write(cache, kv, tables, pos, scale=None, name=None):
+    """Append one decode token's ``kv`` [B, H, 1, D] into the
+    block-paged pool ``cache`` [num_blocks, H, block_size, D] at each
+    row's own ``pos`` [B] int32, routed through the per-row block
+    ``tables`` [B, nblk] int32. For an int8 pool pass its ``scale``
+    array [num_blocks, H, block_size]; the op quantizes and returns
+    ``(updated_pool, updated_scale)``, else just the updated pool."""
+    helper = LayerHelper("paged_kv_cache_write", name=name)
+    out = helper.create_variable_for_type_inference(dtype=cache.dtype)
+    ins = {"Cache": [cache], "KV": [kv], "Tables": [tables],
+           "Pos": [pos]}
+    outs = {"Out": [out]}
+    out_scale = None
+    if scale is not None:
+        ins["Scale"] = [scale]
+        out_scale = helper.create_variable_for_type_inference(
+            dtype=scale.dtype)
+        outs["OutScale"] = [out_scale]
+    helper.append_op(
+        type="paged_kv_cache_write", inputs=ins, outputs=outs,
+        attrs={}, infer_shape=False)
+    out.shape = tuple(cache.shape or ())
+    out.dtype = cache.dtype
+    if out_scale is not None:
+        out_scale.shape = tuple(scale.shape or ())
+        out_scale.dtype = scale.dtype
+        return out, out_scale
+    return out
+
+
+def paged_attention(q, k_cache, v_cache, tables, pos, k_scale=None,
+                    v_scale=None, scale=0.0, impl=None, name=None):
+    """Decode attention of one query per row (``q`` [B, H, 1, D]) over
+    the block-paged KV pool ([num_blocks, H, block_size, D], int8 pools
+    with their [num_blocks, H, block_size] scales), gathered through the
+    per-row block ``tables`` and masked by per-row ``pos`` counters —
+    the paged analogue of :func:`kv_cached_attention`. Fused Pallas
+    gather+attend on TPU; ``jnp.take`` reference elsewhere."""
+    helper = LayerHelper("paged_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    ins = {"Q": [q], "K": [k_cache], "V": [v_cache],
+           "Tables": [tables], "Pos": [pos]}
+    if k_scale is not None:
+        ins["KScale"] = [k_scale]
+        ins["VScale"] = [v_scale]
+    helper.append_op(
+        type="paged_attention", inputs=ins, outputs={"Out": [out]},
+        attrs={"scale": float(scale), "impl": impl or ""},
+        infer_shape=False)
+    out.shape = tuple(q.shape or ())
+    out.dtype = q.dtype
+    return out
+
+
 def row_gather(x, index, name=None):
     """Out[b] = x[b, index[b]] — per-row gather along axis 1 (e.g. the
     last real token's position of a right-padded batch)."""

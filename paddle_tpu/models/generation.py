@@ -122,6 +122,12 @@ class GPTGenerator:
             self._progs[kind] = (main, outs)
         self._fns = {}      # kind -> (jitted, device_state)
         self._params = {}   # param name -> device array, shared by kinds
+        # (bucket_rows, kv_dtype, block_size) -> KVBlockPool reused
+        # across generate(paged=True) calls: keeps the pool's jitted
+        # prefill-scatter closure and device arrays warm instead of
+        # recompiling/reallocating per call (blocks are still freed on
+        # the way out of every call)
+        self._paged_pools = {}
         # signature -> cost_analysis dict|False for the live MFU/HBM
         # gauges; LRU so an evicted entry recomputes instead of
         # freezing the gauges for a still-cached executable
@@ -132,9 +138,30 @@ class GPTGenerator:
     def _fetch_names(self, outs):
         if "tokens" in outs:
             return [outs["tokens"].name]
+        if "cache_vars" in outs:            # paged decode: pool arrays
+            return ([outs["logits"].name]
+                    + [v.name for v in outs["cache_vars"]])
         return ([outs["logits"].name]
                 + [v.name for v in outs.get("cache_k", ())]
                 + [v.name for v in outs.get("cache_v", ())])
+
+    def _ensure_prog(self, kind):
+        """Program for ``kind``, building the lazily-declared ones on
+        first use (the paged decode step exists per KV-cache dtype —
+        ``decode_paged_fp32|bf16|int8`` — and most processes never
+        touch them)."""
+        entry = self._progs.get(kind)
+        if entry is not None:
+            return entry
+        if not kind.startswith("decode_paged_"):
+            raise KeyError(f"unknown generation program kind {kind!r}")
+        from ..framework.core import Program, program_guard
+        kv_dtype = kind.rsplit("_", 1)[1]
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            outs = gpt.gpt_decode_step_paged(self.cfg, kv_dtype=kv_dtype)
+        self._progs[kind] = (main, outs)
+        return self._progs[kind]
 
     def _ensure_fn(self, kind):
         entry = self._fns.get(kind)
@@ -143,7 +170,7 @@ class GPTGenerator:
         import jax
         from ..framework.lowering import analyze_block_io, build_block_fn
 
-        main, outs = self._progs[kind]
+        main, outs = self._ensure_prog(kind)
         feed_names = list(outs["feed_names"])
         fetch_names = self._fetch_names(outs)
         state_in, _ = analyze_block_io(main, 0, feed_names)
@@ -277,6 +304,22 @@ class GPTGenerator:
         logits, caches = self._unpack_caches(fetches)
         return logits, caches, key
 
+    def _run_decode_paged(self, token, pos, pool, key):
+        """One decode step over the block-paged KV pool: feeds the
+        pool's device arrays (donated — XLA appends in place) plus the
+        host block tables, adopts the updated pool arrays back into the
+        pool. On ANY failure the donated arrays must be presumed lost —
+        the pool's device side is dropped (host accounting survives)."""
+        from ..serving.kvpool import adopt_decode_fetches, decode_feed
+        feed = decode_feed(pool, token, pos)
+        try:
+            fetches, key = self._invoke(f"decode_paged_{pool.dtype}",
+                                        "decode", feed, key)
+        except Exception:
+            pool.drop_device()
+            raise
+        return adopt_decode_fetches(pool, fetches), key
+
     def _run_logits(self, tokens, pos_ids, last_pos, key):
         feed = {"tokens": tokens, "pos_ids": pos_ids, "last_pos": last_pos}
         fetches, key = self._invoke("logits", "prefill", feed, key)
@@ -359,13 +402,27 @@ class GPTGenerator:
                 done[r] = True
 
     def generate(self, prompts, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_id=None, seed=None, key=None):
+                 top_k=0, eos_id=None, seed=None, key=None, paged=None,
+                 kv_dtype=None):
         """KV-cached generation: one bucketed prefill, then one compiled
         decode step per token. ``prompts`` is a list of 1-D int token
         arrays (ragged lengths fine — rows are right-padded to the
         bucket and tracked by per-row position counters). Returns a list
         of 1-D int32 arrays of NEW tokens (prompt excluded; generation
-        stops at ``eos_id``, which is not included)."""
+        stops at ``eos_id``, which is not included).
+
+        ``paged`` (None -> ``FLAGS_kv_paged``) routes the decode loop
+        through a transient block-paged KV pool (``serving/kvpool``)
+        instead of the dense ``[B, H, max_len, D]`` bank — same prefill,
+        same sampler, same RNG chain, greedy output token-for-token
+        identical. ``kv_dtype`` (None -> ``FLAGS_kv_cache_dtype``)
+        selects the paged pool's element type (fp32/bf16/int8)."""
+        if paged is None:
+            paged = bool(flag("kv_paged"))
+        if paged:
+            return self._generate_paged(
+                prompts, max_new_tokens, temperature, top_k, eos_id,
+                seed, key, kv_dtype)
         prompts, lens, key = self._prep(prompts, max_new_tokens, seed,
                                         key)
         B = len(prompts)
@@ -398,6 +455,76 @@ class GPTGenerator:
             self.stats.bump("tokens_generated",
                             int(sum(len(o) for o in outs)))
         return [np.asarray(o, np.int32) for o in outs]
+
+    def _generate_paged(self, prompts, max_new_tokens, temperature,
+                        top_k, eos_id, seed, key, kv_dtype=None):
+        """The block-paged decode loop behind ``generate(paged=True)``:
+        one dense bucketed prefill (unchanged — prefill is compute-bound
+        and already flash-fused), a jitted scatter of the fresh row
+        caches into a transient :class:`serving.kvpool.KVBlockPool`,
+        then per-token paged decode steps with allocation-on-append.
+        The pool is freed when generation ends."""
+        from ..serving.kvpool import KVBlockPool
+        prompts, lens, key = self._prep(prompts, max_new_tokens, seed,
+                                        key)
+        B = len(prompts)
+        tokens, pos_ids, last = self._pack_prompts(prompts)
+        bb, s = tokens.shape
+        cfg = self.cfg
+        kv_dtype = kv_dtype or flag("kv_cache_dtype")
+        pool_key = (bb, kv_dtype, int(flag("kv_block_size")))
+        pool = self._paged_pools.get(pool_key)
+        if pool is None:
+            pool = KVBlockPool(
+                slots=bb, num_layers=cfg.num_layers,
+                num_heads=cfg.num_heads,
+                d_head=cfg.hidden_size // cfg.num_heads,
+                max_seq_len=self.max_len, dtype=kv_dtype,
+                name="offline")
+            self._paged_pools[pool_key] = pool
+        try:
+            for r in range(B):
+                pool.alloc(r, lens[r])
+            logits, row_caches, key = self._run_prefill(
+                tokens, pos_ids, last, key)
+            pool.scatter_prefill(list(range(B)), row_caches, s)
+
+            temp = np.full((bb,), float(temperature), np.float32)
+            topk = np.full((bb,), int(top_k), np.int32)
+            tok, key = self._run_sample(logits, temp, topk, key)
+            tok_h = np.asarray(tok)
+
+            outs = [[] for _ in range(B)]
+            done = np.zeros(B, bool)
+            pos = np.zeros((bb,), np.int32)
+            pos[:B] = np.asarray(lens, np.int32)
+            self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+
+            while not done.all():
+                for r in range(B):
+                    if not done[r]:       # allocation-on-append
+                        pool.ensure(r, int(pos[r]))
+                logits, key = self._run_decode_paged(tok, pos, pool, key)
+                tok, key = self._run_sample(logits, temp, topk, key)
+                tok_h = np.asarray(tok)
+                pos[:B] = np.where(done, pos[:B], pos[:B] + 1)
+                self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+                if self.stats:
+                    self.stats.bump("decode_steps")
+            if self.stats:
+                self.stats.bump("tokens_generated",
+                                int(sum(len(o) for o in outs)))
+            return [np.asarray(o, np.int32) for o in outs]
+        finally:
+            # free every block and the device arrays, but KEEP the
+            # pool instance (its compiled prefill-scatter closure is
+            # the expensive part — the next call rebuilds zero arrays
+            # without retracing); one cached pool per (bucket, dtype,
+            # block size) must not pin dense-bank-equivalent HBM
+            # between calls
+            for r in range(bb):
+                pool.free_slot(r)
+            pool.drop_device()
 
     def generate_naive(self, prompts, max_new_tokens=32, temperature=0.0,
                        top_k=0, eos_id=None, seed=None, key=None):

@@ -81,6 +81,14 @@ def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
       at each row's own position, then attend the query over the full
       cache with the per-row position mask (O(max_len) read instead of an
       O(S^2) recompute). Returns ``(x, new_k_cache, new_v_cache)``.
+    - ``mode: "paged"`` with ``tables`` [B, nblk] int32: the
+      block-paged incremental step — k/v caches are a SHARED pool
+      ``[num_blocks, H, block_size, D]`` routed through per-row block
+      tables (serving/kvpool.py owns the allocator), appended via
+      ``paged_kv_cache_write`` and read by the fused
+      ``paged_attention`` kernel. Quantized (int8) pools carry
+      ``k_scale``/``v_scale`` arrays; returns
+      ``(x, new_pk, new_pv[, new_ks, new_vs])``.
     """
     h = cfg.hidden_size
     n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
@@ -95,8 +103,25 @@ def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
     k = T.transpose(T.reshape(k, [0, 0, n_head, d_head]), [0, 2, 1, 3])
     v = T.transpose(T.reshape(v, [0, 0, n_head, d_head]), [0, 2, 1, 3])
     new_k = new_v = None
+    new_ks = new_vs = None
+    paged = kv_cache is not None and kv_cache.get("mode") == "paged"
     if kv_cache is None:
         ctx = layers.nn.flash_attention(q, k, v, causal=True)
+    elif paged:
+        tables = kv_cache["tables"]
+        k_sc, v_sc = kv_cache.get("k_scale"), kv_cache.get("v_scale")
+        if k_sc is not None:
+            new_k, new_ks = layers.nn.paged_kv_cache_write(
+                kv_cache["k"], k, tables, pos, scale=k_sc)
+            new_v, new_vs = layers.nn.paged_kv_cache_write(
+                kv_cache["v"], v, tables, pos, scale=v_sc)
+        else:
+            new_k = layers.nn.paged_kv_cache_write(
+                kv_cache["k"], k, tables, pos)
+            new_v = layers.nn.paged_kv_cache_write(
+                kv_cache["v"], v, tables, pos)
+        ctx = layers.nn.paged_attention(q, new_k, new_v, tables, pos,
+                                        k_scale=new_ks, v_scale=new_vs)
     else:
         new_k = layers.nn.kv_cache_write(kv_cache["k"], k, pos)
         new_v = layers.nn.kv_cache_write(kv_cache["v"], v, pos)
@@ -118,6 +143,8 @@ def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
     out = M.elementwise_add(x, ffn)
     if kv_cache is None:
         return out
+    if paged and new_ks is not None:
+        return out, new_k, new_v, new_ks, new_vs
     return out, new_k, new_v
 
 
@@ -263,6 +290,74 @@ def gpt_decode_step(cfg, max_len, batch_size=-1):
     logits = _tied_next_logits(cfg, x, zero)             # S=1: gather at 0
     return {"feed_names": feed_names, "logits": logits,
             "cache_k": cache_k, "cache_v": cache_v}
+
+
+def gpt_decode_step_paged(cfg, kv_dtype="fp32", batch_size=-1):
+    """ONE block-paged incremental decode step: like
+    :func:`gpt_decode_step`, but every layer's KV cache is the SHARED
+    block pool ``[num_blocks, H, block_size, D]`` (``serving/kvpool``)
+    routed through a per-row block table — append via
+    ``paged_kv_cache_write``, read via the fused ``paged_attention``
+    kernel. All pool dims are dynamic, so one program covers every pool
+    size; ``kv_dtype`` picks the cache element type (``int8`` adds the
+    per-(block, head, slot) float32 scale pools to the feed/fetch set).
+
+    Feeds: token [B] int32, pos [B] int32, block_tables [B, nblk] int32,
+    cache_pk_<i>/cache_pv_<i> pools (+ cache_pks_<i>/cache_pvs_<i> for
+    int8). Fetches: logits, then the updated pools in
+    ``serving.kvpool.pool_feed_names`` order (``cache_names``)."""
+    quantized = kv_dtype == "int8"
+    cache_dt = {"fp32": "float32", "bf16": "bfloat16",
+                "int8": "int8"}[kv_dtype]
+    token = T.data("token", [batch_size], dtype="int32")
+    pos = T.data("pos", [batch_size], dtype="int32")
+    tables = T.data("block_tables", [batch_size, -1], dtype="int32")
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    emb = layers.embedding(token, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pemb = layers.embedding(pos, size=[cfg.max_position, cfg.hidden_size],
+                            param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pemb)                     # [B, H]
+    x = T.reshape(x, [-1, 1, cfg.hidden_size])           # [B, 1, H]
+    feed_names = ["token", "pos", "block_tables"]
+    pk_out, pv_out, ks_out, vs_out = [], [], [], []
+    for i in range(cfg.num_layers):
+        pk = T.data(f"cache_pk_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        pv = T.data(f"cache_pv_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        feed_names += [f"cache_pk_{i}", f"cache_pv_{i}"]
+        kv_cache = {"k": pk, "v": pv, "mode": "paged", "tables": tables}
+        if quantized:
+            pks = T.data(f"cache_pks_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            pvs = T.data(f"cache_pvs_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            feed_names += [f"cache_pks_{i}", f"cache_pvs_{i}"]
+            kv_cache["k_scale"], kv_cache["v_scale"] = pks, pvs
+            x, npk, npv, nks, nvs = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=pos)
+            ks_out.append(nks)
+            vs_out.append(nvs)
+        else:
+            x, npk, npv = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=pos)
+        pk_out.append(npk)
+        pv_out.append(npv)
+    zero = T.fill_constant_batch_size_like(token, [-1], "int32", 0)
+    logits = _tied_next_logits(cfg, x, zero)             # S=1: gather at 0
+    from ..serving.kvpool import pool_feed_names
+    cache_names = pool_feed_names(cfg.num_layers, quantized)
+    by_name = {}
+    for i in range(cfg.num_layers):
+        by_name[f"cache_pk_{i}"] = pk_out[i]
+        by_name[f"cache_pv_{i}"] = pv_out[i]
+        if quantized:
+            by_name[f"cache_pks_{i}"] = ks_out[i]
+            by_name[f"cache_pvs_{i}"] = vs_out[i]
+    return {"feed_names": feed_names, "logits": logits,
+            "cache_names": cache_names,
+            "cache_vars": [by_name[n] for n in cache_names]}
 
 
 # ---- tensor-parallel sharding annotation (Megatron-style over "tp") ----

@@ -80,6 +80,66 @@ def kv_cached_attention(ctx, ins, attrs):
     return {"Out": out.astype(q.dtype)}
 
 
+@register_op("paged_kv_cache_write", grad=False, infer_shape=False)
+def paged_kv_cache_write(ctx, ins, attrs):
+    """Append one decode token's k/v into a BLOCK-PAGED pool at each
+    row's own position. Cache [N, H, bs, D] (the shared pool), KV
+    [B, H, 1, D], Tables [B, nblk] int32 (per-row block table), Pos [B]
+    int32 -> Out: pool with row b's vector written at
+    ``(Tables[b, Pos[b]//bs], :, Pos[b]%bs)``. With an int8 pool the op
+    quantizes (kernels/paged_attention.quantize_kv) and the optional
+    Scale input [N, H, bs] is updated too (second output OutScale).
+
+    One scatter covers the batch: slots own disjoint blocks, so the
+    (block, offset) pairs are unique; rows whose table entry is the
+    trash block (free serving slots) write garbage nobody reads.
+    """
+    from ..kernels.paged_attention import quantize_kv
+
+    pool = x_of(ins, "Cache")
+    kv = x_of(ins, "KV")
+    tables = x_of(ins, "Tables").astype(jnp.int32)
+    pos = x_of(ins, "Pos").astype(jnp.int32)
+    bs = pool.shape[2]
+    B = kv.shape[0]
+
+    block_ids = tables[jnp.arange(B), pos // bs]        # [B]
+    offs = pos % bs                                     # [B]
+    vec = kv[:, :, 0, :]                                # [B, H, D]
+    outs = {}
+    if pool.dtype == jnp.int8:
+        q, sc = quantize_kv(vec)
+        outs["Out"] = pool.at[block_ids, :, offs, :].set(q)
+        scale = x_of(ins, "Scale")
+        outs["OutScale"] = scale.at[block_ids, :, offs].set(sc)
+    else:
+        outs["Out"] = pool.at[block_ids, :, offs, :].set(
+            vec.astype(pool.dtype))
+    return outs
+
+
+@register_op("paged_attention", grad=False, infer_shape=False)
+def paged_attention_op(ctx, ins, attrs):
+    """Decode attention of one query per row over the block-paged pool:
+    Q [B, H, 1, D], K/V pools [N, H, bs, D] (+ KScale/VScale [N, H, bs]
+    for int8), Tables [B, nblk] int32, Pos [B] int32 -> Out [B, H, 1, D].
+    Dispatches to kernels/paged_attention (Pallas fused gather+attend on
+    TPU; jnp.take reference elsewhere — attrs["impl"] overrides)."""
+    from ..kernels.paged_attention import paged_attention as _kernel
+
+    q = x_of(ins, "Q")
+    k = x_of(ins, "K")
+    v = x_of(ins, "V")
+    tables = x_of(ins, "Tables")
+    pos = x_of(ins, "Pos")
+    out = _kernel(q, k, v, tables, pos,
+                  k_scale=x_of(ins, "KScale"),
+                  v_scale=x_of(ins, "VScale"),
+                  scale=float(attrs.get("scale", 0.0)) or None,
+                  impl=attrs.get("impl") or None)
+    return {"Out": out}
+
+
 @register_op("row_gather", grad=False, infer_shape=False)
 def row_gather(ctx, ins, attrs):
     """Out[b] = X[b, Index[b]] — per-row gather along axis 1 (e.g. the

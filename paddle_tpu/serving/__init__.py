@@ -32,6 +32,16 @@ clipper/ORCA adaptive-batching tradition:
   reuse the moment a row finishes); ``stats()`` adds prefill/decode/
   sample histograms, ``tokens_per_s`` and ``decode_occupancy``
 
+- paged KV cache (``FLAGS_kv_paged``): the dense per-slot decode bank
+  becomes a shared block-paged ``kvpool.KVBlockPool``
+  (vLLM/PagedAttention) — per-slot block tables, allocation on append,
+  frees on EOS/deadline/cancel, typed
+  ``KVPoolExhaustedError`` backpressure, optional bf16/int8 cache
+  (``FLAGS_kv_cache_dtype``) read by the fused
+  ``kernels.paged_attention`` decode kernel; ``stats()`` adds
+  ``kvpool_*`` occupancy/fragmentation and the registry exports
+  ``kvpool_*`` gauges
+
 - telemetry: the ``metrics`` wire op (``Client.metrics()``) returns the
   Prometheus text exposition of the process metrics registry
   (``paddle_tpu.observability``); ``debug_dump`` returns the flight
@@ -80,6 +90,7 @@ from .engine import (  # noqa: F401
     SIGNATURE_FILE, GenerationEngine, ServingEngine,
     load_param_snapshot,
 )
+from .kvpool import KVBlockPool, KVPoolExhaustedError  # noqa: F401
 from .metrics import LatencyHistogram, ServingStats  # noqa: F401
 from .server import Client, InferenceServer, ServingConfig  # noqa: F401
 from .supervise import LoopSupervisor  # noqa: F401

@@ -404,6 +404,7 @@ class DecodeBatcher:
         self._swap_lock = threading.Lock()
         self._admitting = 0     # popped from the queue, not yet in a slot
         self._admitting_reqs = []
+        self._steps_since_sweep = 0             # paged-pool leak sweep
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -434,10 +435,13 @@ class DecodeBatcher:
                 # in-flight requests itself on exit (_loop's finally),
                 # so no client hangs even though we stop waiting here
                 return
-        for req in list(self._active.values()):
+        release = getattr(self.engine, "release_slot", None)
+        for slot, req in list(self._active.items()):
             if not req.done():
                 req.set_error(ServerShutdownError(
                     "server stopped while the request was decoding"))
+            if release is not None:
+                release(slot)
         self._active.clear()
 
     def restart(self, reason="supervisor restart"):
@@ -476,6 +480,11 @@ class DecodeBatcher:
             # fast path only when every row's temperature is <= 0)
             self._temp[slot] = 0.0
             self._topk[slot] = 0
+            # paged pool: EOS/deadline/cancel/error all land here — the
+            # row's KV blocks go back to the free list immediately
+            release = getattr(self.engine, "release_slot", None)
+            if release is not None:
+                release(slot)
         if req.done():
             # abandoned request (e.g. the wire handler's wait budget
             # expired and set an error): the slot is reclaimed above,
@@ -570,11 +579,29 @@ class DecodeBatcher:
                     self.stats.bump("shed_deadline")
                 req.expire(now, where="decode-queue")
                 continue
-            if req.prompt.size + req.max_new_tokens > self.engine.max_len:
-                req.set_error(ValueError(
-                    f"prompt ({req.prompt.size} tokens) + max_new_tokens "
-                    f"({req.max_new_tokens}) exceeds the decode cache "
-                    f"length {self.engine.max_len}"))
+            try:
+                check = getattr(self.engine, "admission_check", None)
+                if check is not None:
+                    # pending_tokens: prompts already accepted this
+                    # round hold free blocks hostage — admission must
+                    # not promise the same blocks twice
+                    check(req.prompt.size, req.max_new_tokens,
+                          pending_tokens=[r.prompt.size for r in take])
+                elif req.prompt.size + req.max_new_tokens \
+                        > self.engine.max_len:
+                    raise BadRequestError(
+                        f"prompt ({req.prompt.size} tokens) + "
+                        f"max_new_tokens ({req.max_new_tokens}) exceeds "
+                        f"the decode cache length {self.engine.max_len}")
+            except ServerOverloadedError as exc:
+                # paged pool exhausted: typed shed — the client backs
+                # off and retries once finished rows return blocks
+                req.set_error(exc)
+                if self.stats:
+                    self.stats.bump("shed_overload")
+                continue
+            except Exception as exc:  # noqa: BLE001 — BadRequest etc.
+                req.set_error(exc)
                 if self.stats:
                     self.stats.bump("requests_failed")
                 continue
@@ -689,6 +716,32 @@ class DecodeBatcher:
                 self._check_deadlines(time.monotonic())
                 if not self._active:
                     continue
+                # paged pool: allocation-on-append for the live rows;
+                # rows the pool cannot grow are shed TYPED while the
+                # rest of the bank keeps decoding (their freed blocks
+                # unblock the next step's growth)
+                prep = getattr(self.engine, "prepare_step", None)
+                if prep is not None:
+                    shed = prep({slot: int(self._pos[slot])
+                                 for slot in self._active})
+                    for slot, exc in shed.items():
+                        req = self._active.get(slot)
+                        if req is None:
+                            continue
+                        if isinstance(exc, ServerOverloadedError):
+                            # overload shed, not a failure: same
+                            # bookkeeping as the admission-time shed
+                            # (shed_overload only, no requests_failed),
+                            # then reclaim the slot + its blocks
+                            if not req.done():
+                                req.set_error(exc)
+                            if self.stats:
+                                self.stats.bump("shed_overload")
+                            self._finish(req)
+                        else:
+                            self._finish(req, exc)
+                    if not self._active:
+                        continue
                 # per-token spans for TRACED rows only (sampled at the
                 # client edge): untraced traffic pays one list-comp over
                 # <= slots entries per step
@@ -732,6 +785,15 @@ class DecodeBatcher:
                     self._pos[slot] += 1
                     self._tok[slot] = toks[slot]
                     self._deliver_token(req, int(toks[slot]))
+                # periodic paged-pool leak sweep: blocks held by slots
+                # no longer active are a bug — reclaim + flight-record
+                # them instead of bleeding capacity
+                self._steps_since_sweep += 1
+                if self._steps_since_sweep >= 256:
+                    self._steps_since_sweep = 0
+                    sweep = getattr(self.engine, "reclaim_leaks", None)
+                    if sweep is not None:
+                        sweep(list(self._active))
         finally:
             # rows still mid-generation when the loop exits (stop() or
             # a crash) must fail fast, not leave their clients waiting.
@@ -739,11 +801,14 @@ class DecodeBatcher:
             # state now) must not touch anything.
             if self._epoch == epoch:
                 self._admitting = 0
-                for req in list(self._active.values()):
+                release = getattr(self.engine, "release_slot", None)
+                for slot, req in list(self._active.items()):
                     if not req.done():
                         req.set_error(ServerShutdownError(
                             "server stopped while the request was "
                             "decoding"))
+                    if release is not None:
+                        release(slot)
                 self._active.clear()
                 with self._swap_lock:
                     sw, self._swap = self._swap, None

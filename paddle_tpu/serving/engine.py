@@ -426,12 +426,31 @@ class GenerationEngine:
     lives in the connection threads, exactly like the infer path).
     """
 
-    def __init__(self, generator, *, slots=None, stats=None, seed=0):
+    def __init__(self, generator, *, slots=None, stats=None, seed=0,
+                 paged=None, kv_dtype=None, kv_block_size=None,
+                 kv_pool_blocks=None):
         import jax
         from ..flags import flag
         self.gen = generator
         self.slots = int(slots or flag("decode_slots"))
         self.stats = stats if stats is not None else generator.stats
+        # block-paged decode memory (FLAGS_kv_paged / paged=True): the
+        # slot bank becomes a shared KVBlockPool with per-slot block
+        # tables — concurrency bounded by actual tokens, not
+        # slots * max_len. None/False keeps the dense bank (the parity
+        # baseline).
+        self.paged = bool(flag("kv_paged") if paged is None else paged)
+        self.pool = None
+        if self.paged:
+            from .kvpool import KVBlockPool
+            cfg = generator.cfg
+            self.pool = KVBlockPool(
+                slots=self.slots, num_layers=cfg.num_layers,
+                num_heads=cfg.num_heads,
+                d_head=cfg.hidden_size // cfg.num_heads,
+                max_seq_len=generator.max_len,
+                block_size=kv_block_size, num_blocks=kv_pool_blocks,
+                dtype=kv_dtype, name="serving")
         # a generator WITHOUT its own sink adopts the server's (stage
         # histograms land in server.stats()), and a sink a PREVIOUS
         # engine bound is rebound to the live server (else a reused
@@ -450,6 +469,9 @@ class GenerationEngine:
 
     def _ensure_caches(self):
         self.bank_lost = False
+        if self.pool is not None:
+            self.pool.arrays()       # lazy device-side pool build
+            return
         if self._caches is not None:
             return
         import jax.numpy as jnp
@@ -484,17 +506,82 @@ class GenerationEngine:
         """A failed donated call may have invalidated the slot bank's
         buffers: drop it (the next admission rebuilds zeros) and flag
         the loss so the DecodeBatcher fails every active row instead of
-        letting them silently decode against a fresh zero cache."""
+        letting them silently decode against a fresh zero cache. Paged
+        mode drops the pool's DEVICE arrays only — the host block
+        accounting survives, and the failed rows return their blocks
+        through the batcher's release path."""
         self._caches = None
+        if self.pool is not None:
+            self.pool.drop_device()
         self.bank_lost = True
 
     def reset(self):
         """Forget the slot bank without flagging a loss — the restart
         path: a replaced decode loop starts from an empty bank (its rows
         were already failed by the supervisor), so the stale caches are
-        garbage, not state."""
+        garbage, not state. Paged mode frees every block too."""
         self._caches = None
+        if self.pool is not None:
+            self.pool.reset()
         self.bank_lost = False
+
+    # -- paged-pool admission / lifecycle hooks ---------------------------
+    def admission_check(self, prompt_len, max_new_tokens,
+                        pending_tokens=(), static_only=False):
+        """Typed admission gate, callable BEFORE any queue wait or
+        prefill compile: an overlong request raises
+        :class:`batching.BadRequestError` (the wire maps it to
+        ``etype: "BadRequest"`` — retrying without fixing the input
+        cannot help), and in paged mode so does a request the pool
+        could NEVER hold even empty; a request whose prompt blocks are
+        merely not free RIGHT NOW (unless ``static_only``) raises the
+        retryable :class:`kvpool.KVPoolExhaustedError` instead,
+        counting requests already accepted this admission round via
+        ``pending_tokens`` (their prompt lengths)."""
+        from .batching import BadRequestError
+        prompt_len, max_new_tokens = int(prompt_len), int(max_new_tokens)
+        if prompt_len + max_new_tokens > self.max_len:
+            raise BadRequestError(
+                f"prompt ({prompt_len} tokens) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the decode cache length "
+                f"{self.max_len}")
+        if self.pool is not None:
+            self.pool.check_fits(prompt_len + max_new_tokens)
+            if not static_only:
+                # +1: the first decode append may open a fresh block
+                self.pool.admission_check(
+                    prompt_len + 1, [int(t) + 1 for t in pending_tokens])
+
+    def release_slot(self, slot):
+        """Return a finished slot's KV blocks to the pool (EOS /
+        deadline / cancel / error — the continuous-batching reclaim).
+        Dense mode: no-op (the bank row is simply overwritten)."""
+        if self.pool is not None:
+            self.pool.free_slot(slot)
+
+    def prepare_step(self, active_pos):
+        """Allocation-on-append before a decode step: grow each live
+        row's blocks to cover the slot its next token writes
+        (``active_pos`` maps slot -> position). Returns ``{slot: exc}``
+        for rows the pool could not grow — the batcher sheds exactly
+        those rows (typed) while the rest of the bank keeps decoding.
+        Dense mode returns ``{}``."""
+        if self.pool is None:
+            return {}
+        shed = {}
+        for slot, p in active_pos.items():
+            try:
+                self.pool.ensure(slot, int(p))
+            except Exception as exc:  # noqa: BLE001 — per-row shed
+                shed[slot] = exc
+        return shed
+
+    def reclaim_leaks(self, live_slots):
+        """Leak sweep: free blocks held by slots not in ``live_slots``
+        (flight-recorded per leaking slot). Dense mode: 0."""
+        if self.pool is None:
+            return 0
+        return self.pool.reclaim_leaks(live_slots)
 
     # -- hot weight reload ------------------------------------------------
     def load_param_snapshot(self, dirname):
@@ -536,11 +623,38 @@ class GenerationEngine:
             temp[r] = req.temperature
             topk[r] = req.top_k
 
+        if self.pool is not None:
+            # allocate each row's prompt blocks BEFORE the prefill (the
+            # scatter routes through the tables); a mid-batch failure
+            # rolls this batch's allocations back untouched
+            allocated = []
+            try:
+                for req, slot in zip(requests, slot_ids):
+                    self.pool.free_slot(slot)   # stale holder (if any)
+                    self.pool.alloc(slot, int(req.prompt.size))
+                    allocated.append(slot)
+            except Exception:
+                for sl in allocated:
+                    self.pool.free_slot(sl)
+                raise
         logits, row_caches, self._key = self.gen._run_prefill(
             tokens, pos_ids, last, self._key)
         toks, self._key = self.gen._run_sample(logits, temp, topk,
                                                self._key)
-        self._insert(row_caches, list(slot_ids))
+        if self.pool is not None:
+            try:
+                self.pool.scatter_prefill(list(slot_ids), row_caches,
+                                          tokens.shape[1])
+            except Exception:
+                # the donated device pool is lost (scatter dropped it);
+                # this batch's blocks go back, the batcher fails the
+                # other active rows via bank_lost
+                for sl in slot_ids:
+                    self.pool.free_slot(sl)
+                self.bank_lost = True
+                raise
+        else:
+            self._insert(row_caches, list(slot_ids))
         out = np.asarray(toks)[:n]
         t1 = time.perf_counter()
         for req in requests:
@@ -564,21 +678,48 @@ class GenerationEngine:
         self._ensure_caches()
         tok = np.ascontiguousarray(tokens, dtype=np.int32)
         posc = np.ascontiguousarray(pos, dtype=np.int32)
-        caches, key = self._caches, self._key
+        key = self._key
 
-        def _decode():
-            return self.gen._run_decode(tok, posc, caches, key)
+        if self.pool is not None:
+            # paged decode: the worker only COMPUTES (feed built here,
+            # pool state adopted on this thread after it returns), so an
+            # abandoned overbudget worker can never resurrect a pool
+            # this thread already dropped — mirroring the dense path
+            from .kvpool import adopt_decode_fetches, decode_feed
+            feed = decode_feed(self.pool, tok, posc)
+            kind = f"decode_paged_{self.pool.dtype}"
 
-        try:
-            if budget:
-                logits, new_caches, new_key = run_with_watchdog(
-                    _decode, budget, what="serving decode step")
-            else:
-                logits, new_caches, new_key = _decode()
-        except Exception:
-            self._drop_bank()      # caches were donated into the call
-            raise
-        self._caches, self._key = new_caches, new_key
+            def _decode_paged():
+                return self.gen._invoke(kind, "decode", feed, key)
+
+            try:
+                if budget:
+                    fetches, new_key = run_with_watchdog(
+                        _decode_paged, budget,
+                        what="serving decode step")
+                else:
+                    fetches, new_key = _decode_paged()
+            except Exception:
+                self._drop_bank()  # pool arrays were donated in
+                raise
+            logits = adopt_decode_fetches(self.pool, fetches)
+            self._key = new_key
+        else:
+            caches = self._caches
+
+            def _decode():
+                return self.gen._run_decode(tok, posc, caches, key)
+
+            try:
+                if budget:
+                    logits, new_caches, new_key = run_with_watchdog(
+                        _decode, budget, what="serving decode step")
+                else:
+                    logits, new_caches, new_key = _decode()
+            except Exception:
+                self._drop_bank()  # caches were donated into the call
+                raise
+            self._caches, self._key = new_caches, new_key
         toks, self._key = self.gen._run_sample(
             logits, np.ascontiguousarray(temperature, dtype=np.float32),
             np.ascontiguousarray(top_k, dtype=np.int32), self._key)

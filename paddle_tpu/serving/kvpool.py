@@ -1,0 +1,523 @@
+"""Block-paged KV-cache pool: decode memory priced by ACTUAL tokens.
+
+The dense decode bank (``GenerationEngine``'s ``[slots, H, max_len, D]``
+buffer per layer) charges every slot ``max_len`` HBM whatever its real
+length — BENCHMARKS.md shows decode is bandwidth-bound against exactly
+that buffer. This module is the vLLM/PagedAttention alternative (Kwon et
+al. 2023): one device-resident pool of fixed-size blocks
+(``[num_blocks, H, block_size, D]`` per layer, K and V) shared across
+slots, a per-slot block table, blocks allocated on append and returned
+on EOS/deadline/cancel, so concurrent generations are bounded by the
+pool's token capacity — not ``slots * max_len``.
+
+Host side (this file): a free-list allocator with occupancy /
+internal-fragmentation accounting, typed
+:class:`KVPoolExhaustedError` admission backpressure (a
+``ServerOverloadedError`` subclass — the wire maps it to
+``etype: "Overloaded"`` and clients back off), ``kvpool_*`` metrics in
+the process registry, flight-recorder events for exhaustion and block
+leaks, and the ``serving.kv_alloc`` chaos point through every
+allocation. Block 0 is the reserved TRASH block: padded block-table
+entries point at it, so bucket-padded prefill scatters and stale free
+slots write garbage somewhere harmless that position masks never read.
+
+Device side: lazily-built jnp pool arrays (float32 / bfloat16 / int8
+with per-(block, head, slot) float32 scales — ``FLAGS_kv_cache_dtype``;
+at bandwidth-bound decode, halving cache bytes is ~2x tokens/s), a
+jitted bucketed prefill scatter (dense prefill row caches reshaped to
+blocks and scattered through the table in one donated call), and the
+paged decode programs' feed dict. The fused read path is
+``kernels/paged_attention.py``.
+"""
+import math
+import threading
+
+import numpy as np
+
+from ..flags import flag
+from ..observability.metrics import default_registry
+from ..observability.recorder import flight_recorder as _flightrec
+from ..resilience import maybe_fail
+from .batching import BadRequestError, ServerOverloadedError
+
+# -- typed backpressure ----------------------------------------------------
+
+
+class KVPoolExhaustedError(ServerOverloadedError):
+    """The pool has no free blocks for the allocation. Subclasses
+    :class:`ServerOverloadedError`, so admission surfaces it as
+    backpressure (wire ``etype: "Overloaded"``) — the client backs off
+    and retries, by which time finished rows have returned blocks.
+    Carries ``needed``/``free``/``capacity`` block counts."""
+
+    def __init__(self, message, needed=None, free=None, capacity=None):
+        super().__init__(message)
+        self.needed = needed
+        self.free = free
+        self.capacity = capacity
+
+
+# -- metrics (native families; ``pool`` label keeps a serving pool and
+#    transient offline pools from clobbering each other's gauges) --------
+
+_BLOCKS_IN_USE = default_registry().gauge(
+    "kvpool_blocks_in_use_count",
+    "KV-pool blocks currently allocated to live slots",
+    labels=("pool",), max_series=8)
+_CAPACITY = default_registry().gauge(
+    "kvpool_capacity_blocks_count",
+    "KV-pool allocatable block capacity (trash block excluded)",
+    labels=("pool",), max_series=8)
+_OCCUPANCY = default_registry().gauge(
+    "kvpool_occupancy_ratio",
+    "allocated / allocatable KV-pool blocks",
+    labels=("pool",), max_series=8)
+_SAVED = default_registry().gauge(
+    "kvpool_saved_vs_dense_bytes",
+    "device bytes a dense [slots, H, max_len, D] fp32 bank would hold "
+    "minus the pool bytes actually allocated",
+    labels=("pool",), max_series=8)
+_ALLOC_FAIL = default_registry().counter(
+    "kvpool_alloc_failures_total",
+    "block allocations refused with KVPoolExhaustedError",
+    labels=("pool",), max_series=8)
+_ALLOCATED = default_registry().counter(
+    "kvpool_blocks_allocated_total",
+    "KV-pool blocks handed out by the free-list allocator",
+    labels=("pool",), max_series=8)
+_FREED = default_registry().counter(
+    "kvpool_blocks_freed_total",
+    "KV-pool blocks returned to the free list",
+    labels=("pool",), max_series=8)
+_LEAKED = default_registry().counter(
+    "kvpool_leaked_blocks_total",
+    "blocks found still held by finished slots and reclaimed by the "
+    "leak sweep",
+    labels=("pool",), max_series=8)
+
+_DTYPES = ("fp32", "bf16", "int8")
+_ELEM_BYTES = {"fp32": 4, "bf16": 2, "int8": 1}
+
+
+def _np_pool_dtype(kv_dtype):
+    import jax.numpy as jnp
+    return {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[kv_dtype]
+
+
+def pool_feed_names(num_layers, quantized):
+    """Feed/fetch names of the paged decode program's pool arrays, in
+    the ONE canonical order the graph builder, the generator's unpack
+    and this pool all share: k pools, v pools, then (int8 only) k/v
+    scale pools. The ``cache_`` prefix keeps them in the generator's
+    donated-argument group — XLA aliases the append in place."""
+    names = [f"cache_pk_{i}" for i in range(num_layers)] \
+        + [f"cache_pv_{i}" for i in range(num_layers)]
+    if quantized:
+        names += [f"cache_pks_{i}" for i in range(num_layers)] \
+            + [f"cache_pvs_{i}" for i in range(num_layers)]
+    return names
+
+
+def decode_feed(pool, token, pos):
+    """ONE paged decode step's feed dict: the pool's device arrays
+    (donated into the call — XLA appends in place), this step's
+    token/pos vectors, and the host block tables. The one builder both
+    the offline generator loop and the serving engine use."""
+    feed = dict(pool.arrays())
+    feed["token"] = token
+    feed["pos"] = pos
+    feed["block_tables"] = np.ascontiguousarray(pool.tables)
+    return feed
+
+
+def adopt_decode_fetches(pool, fetches):
+    """Adopt a paged decode step's fetched (donated-in-place) pool
+    arrays back into ``pool`` and return the logits — the fetch-order
+    contract (logits first, then :func:`pool_feed_names` order) lives
+    HERE, next to the feed-order contract, so the two callers cannot
+    drift."""
+    names = pool_feed_names(pool.num_layers, pool.quantized)
+    pool.update_arrays({n: fetches[1 + i] for i, n in enumerate(names)})
+    return fetches[0]
+
+
+class KVBlockPool:
+    """Device block pool + host free-list allocator + per-slot tables.
+
+    Single-driver by design, like the ``GenerationEngine`` it backs: the
+    decode loop is the only caller of alloc/free/scatter/update (a lock
+    still guards the accounting so stats()/metrics scrapes from other
+    threads read consistent state).
+
+    ``num_blocks`` counts the trash block: the allocatable capacity is
+    ``num_blocks - 1``. Default sizing is HBM-equivalent to the dense
+    bank it replaces (``slots * ceil(max_seq_len/block_size) + 1``) —
+    the paged win is that short generations leave most of it free for
+    MORE concurrent slots, where dense burned it on padding.
+    """
+
+    def __init__(self, *, slots, num_layers, num_heads, d_head,
+                 max_seq_len, block_size=None, num_blocks=None,
+                 dtype=None, name="serving"):
+        self.slots = int(slots)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.d_head = int(d_head)
+        self.max_seq_len = int(max_seq_len)
+        self.block_size = int(block_size or flag("kv_block_size"))
+        if self.block_size < 1:
+            raise ValueError("kv_block_size must be >= 1")
+        self.dtype = dtype or flag("kv_cache_dtype")
+        if self.dtype not in _DTYPES:
+            raise ValueError(
+                f"kv_cache_dtype must be one of {_DTYPES}, "
+                f"got {self.dtype!r}")
+        self.blocks_per_row = _ceil_div(self.max_seq_len, self.block_size)
+        if num_blocks is None:
+            num_blocks = int(flag("kv_pool_blocks")) or \
+                self.slots * self.blocks_per_row + 1
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < 2:
+            raise ValueError("KVBlockPool needs >= 2 blocks (block 0 is "
+                             "the reserved trash block)")
+        self.name = str(name)
+        self.quantized = self.dtype == "int8"
+
+        # host accounting (block 0 = trash, never allocated). LIFO free
+        # list: recently-freed blocks are re-used first, which keeps the
+        # working set of hot blocks small.
+        self._lock = threading.Lock()
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._slot_nblocks = {}        # slot -> blocks held
+        self._slot_tokens = {}         # slot -> tokens accounted
+        self.tables = np.zeros((self.slots, self.blocks_per_row),
+                               np.int32)
+        self._arrays = None            # lazy device pool
+        self._scatter_fn = None
+        self._update_gauges()
+
+    # -- sizing helpers ---------------------------------------------------
+    def blocks_for_tokens(self, ntokens):
+        return _ceil_div(max(int(ntokens), 0), self.block_size)
+
+    @property
+    def capacity_blocks(self):
+        """Allocatable blocks (trash excluded)."""
+        return self.num_blocks - 1
+
+    def block_bytes(self):
+        """Device bytes per block across layers, K+V, scales included."""
+        elem = _ELEM_BYTES[self.dtype]
+        n = 2 * self.num_layers * self.num_heads * self.block_size \
+            * self.d_head * elem
+        if self.quantized:
+            n += 2 * self.num_layers * self.num_heads * self.block_size \
+                * 4
+        return n
+
+    def dense_slot_bytes(self):
+        """Device bytes ONE dense bank slot costs (fp32, max_seq_len)."""
+        return 2 * self.num_layers * self.num_heads * self.max_seq_len \
+            * self.d_head * 4
+
+    # -- allocator --------------------------------------------------------
+    def check_fits(self, ntokens):
+        """Raise :class:`~.batching.BadRequestError` when a request of
+        ``ntokens`` could NEVER be satisfied by this pool — even empty.
+        The submit-time door check: refusing it early costs nothing,
+        and the error is TERMINAL (wire ``etype: "BadRequest"``), not
+        the retryable ``Overloaded`` backpressure — backing off cannot
+        make an impossible request fit."""
+        need = self.blocks_for_tokens(ntokens)
+        if need > self.capacity_blocks:
+            raise BadRequestError(
+                f"request needs {need} KV blocks "
+                f"({ntokens} tokens at block_size={self.block_size}) "
+                f"but the pool's total capacity is "
+                f"{self.capacity_blocks} blocks — it can never be "
+                f"admitted; raise FLAGS_kv_pool_blocks")
+
+    def admission_check(self, ntokens, pending_tokens=()):
+        """The admission-time capacity gate: blocks for ``ntokens``,
+        PLUS blocks for every entry of ``pending_tokens`` (requests
+        already accepted this admission round but not yet allocated),
+        must be free right now — else a counted, flight-recorded
+        :class:`KVPoolExhaustedError` (the typed shed half of
+        backpressure: the client backs off; blocks return as rows
+        finish)."""
+        need = self.blocks_for_tokens(ntokens)
+        pending = sum(self.blocks_for_tokens(t) for t in pending_tokens)
+        with self._lock:
+            free = len(self._free)
+        if need + pending > free:
+            _ALLOC_FAIL.inc(labels=(self.name,))
+            _flightrec().record(
+                "kv_pool_exhausted", pool=self.name, slot=None,
+                needed_blocks=need + pending, free_blocks=free,
+                capacity_blocks=self.capacity_blocks)
+            raise KVPoolExhaustedError(
+                f"KV pool {self.name!r} cannot admit a request of "
+                f"{ntokens} tokens right now: {need} block(s) needed "
+                f"(+{pending} pending this round), {free} free of "
+                f"{self.capacity_blocks} — back off and retry",
+                needed=need + pending, free=free,
+                capacity=self.capacity_blocks)
+
+    def alloc(self, slot, ntokens):
+        """Grow ``slot``'s allocation to cover ``ntokens`` tokens
+        (no-op when it already does). Raises
+        :class:`KVPoolExhaustedError` with nothing changed when the
+        free list cannot cover the growth."""
+        maybe_fail("serving.kv_alloc")
+        slot = int(slot)
+        need = self.blocks_for_tokens(ntokens)
+        with self._lock:
+            have = self._slot_nblocks.get(slot, 0)
+            add = need - have
+            if add <= 0:
+                self._slot_tokens[slot] = max(
+                    self._slot_tokens.get(slot, 0), int(ntokens))
+                return 0
+            if add > len(self._free):
+                free_now = len(self._free)
+            else:
+                for j in range(have, need):
+                    self.tables[slot, j] = self._free.pop()
+                self._slot_nblocks[slot] = need
+                self._slot_tokens[slot] = max(
+                    self._slot_tokens.get(slot, 0), int(ntokens))
+                self._update_gauges_locked()
+                free_now = None
+        if free_now is not None:
+            _ALLOC_FAIL.inc(labels=(self.name,))
+            _flightrec().record(
+                "kv_pool_exhausted", pool=self.name, slot=slot,
+                needed_blocks=add, free_blocks=free_now,
+                capacity_blocks=self.capacity_blocks)
+            raise KVPoolExhaustedError(
+                f"KV pool {self.name!r} exhausted: slot {slot} needs "
+                f"{add} more block(s) for {ntokens} tokens, "
+                f"{free_now} free of {self.capacity_blocks}",
+                needed=add, free=free_now, capacity=self.capacity_blocks)
+        _ALLOCATED.inc(add, labels=(self.name,))
+        return add
+
+    def ensure(self, slot, pos):
+        """Allocation-on-append: make sure the block holding cache slot
+        ``pos`` exists before the decode step writes there."""
+        return self.alloc(slot, int(pos) + 1)
+
+    def free_slot(self, slot):
+        """Return every block ``slot`` holds (EOS / deadline / cancel /
+        error — the continuous-batching reclaim). Idempotent; returns
+        the number of blocks freed."""
+        slot = int(slot)
+        with self._lock:
+            n = self._slot_nblocks.pop(slot, 0)
+            self._slot_tokens.pop(slot, None)
+            for j in range(n):
+                self._free.append(int(self.tables[slot, j]))
+            self.tables[slot, :] = 0
+            self._update_gauges_locked()
+        if n:
+            _FREED.inc(n, labels=(self.name,))
+        return n
+
+    def blocks_in_use(self):
+        with self._lock:
+            return self.capacity_blocks - len(self._free)
+
+    def holders(self):
+        """{slot: blocks_held} for every slot holding blocks."""
+        with self._lock:
+            return dict(self._slot_nblocks)
+
+    def reclaim_leaks(self, live_slots):
+        """Free blocks held by slots NOT in ``live_slots`` — the leak
+        sweep (a finished slot should have freed on its way out; blocks
+        it still holds are a leak). Records a flight-recorder event per
+        leaking slot so ``debug_dump`` explains shed admissions.
+        Returns blocks reclaimed."""
+        live = set(int(s) for s in live_slots)
+        with self._lock:
+            leaked = [s for s, n in self._slot_nblocks.items()
+                      if s not in live and n > 0]
+        total = 0
+        for slot in leaked:
+            n = self.free_slot(slot)
+            total += n
+            _LEAKED.inc(n, labels=(self.name,))
+            _flightrec().record("kv_block_leak", pool=self.name,
+                                slot=slot, blocks=n)
+        return total
+
+    # -- device arrays ----------------------------------------------------
+    def arrays(self):
+        """The paged decode program's pool feed dict (lazily built
+        zeros): ``{cache_pk_i, cache_pv_i[, cache_pks_i, cache_pvs_i]}``
+        — see :func:`pool_feed_names` for the order contract."""
+        if self._arrays is None:
+            import jax.numpy as jnp
+            shape = (self.num_blocks, self.num_heads, self.block_size,
+                     self.d_head)
+            dt = _np_pool_dtype(self.dtype)
+            arrs = {}
+            for i in range(self.num_layers):
+                arrs[f"cache_pk_{i}"] = jnp.zeros(shape, dt)
+                arrs[f"cache_pv_{i}"] = jnp.zeros(shape, dt)
+            if self.quantized:
+                sshape = shape[:3]
+                for i in range(self.num_layers):
+                    # scale 1.0, not 0: a read of a never-written slot
+                    # dequantizes 0 * 1.0 instead of hitting a 0-scale
+                    arrs[f"cache_pks_{i}"] = jnp.ones(sshape, jnp.float32)
+                    arrs[f"cache_pvs_{i}"] = jnp.ones(sshape, jnp.float32)
+            self._arrays = arrs
+        return self._arrays
+
+    def update_arrays(self, new_arrays):
+        """Adopt the decode step's fetched (donated-in-place) pool
+        arrays."""
+        self._arrays = dict(new_arrays)
+
+    def drop_device(self):
+        """Forget the device arrays (a failed donated call may have
+        invalidated them); the next :meth:`arrays` rebuilds zeros. Host
+        accounting is NOT touched — callers that also lost the logical
+        contents call :meth:`reset`."""
+        self._arrays = None
+
+    def reset(self):
+        """Free everything and drop the device pool — the engine
+        restart / bank-lost path."""
+        with self._lock:
+            freed = self.capacity_blocks - len(self._free)
+            self._free = list(range(self.num_blocks - 1, 0, -1))
+            self._slot_nblocks.clear()
+            self._slot_tokens.clear()
+            self.tables[:] = 0
+            self._arrays = None
+            self._update_gauges_locked()
+        if freed:
+            _FREED.inc(freed, labels=(self.name,))
+
+    # -- prefill scatter --------------------------------------------------
+    def scatter_prefill(self, slot_ids, row_caches, bucket_len):
+        """Move freshly-prefilled dense row caches into the pool: rows
+        ``slot_ids`` of the tables receive the first ``bucket_len``
+        positions of ``row_caches[cache_{k,v}_i][:len(slot_ids)]``
+        (shape ``[bb, H, max_len, D]``), reshaped into blocks and
+        scattered through the block table in ONE donated jitted call.
+        Table entries past a row's allocation point at the trash block,
+        so bucket padding lands there. Quantizes on the way in for an
+        int8 pool. On ANY failure the donated pool arrays must be
+        presumed lost — callers reset the pool."""
+        import jax
+        import jax.numpy as jnp
+
+        n = len(slot_ids)
+        nblk = self.blocks_for_tokens(bucket_len)
+        tables = np.ascontiguousarray(
+            self.tables[np.asarray(slot_ids, np.int32), :nblk]
+        ).reshape(-1)                                     # [n*nblk]
+
+        if self._scatter_fn is None:
+            from ..kernels.paged_attention import quantize_kv
+            bs, quant = self.block_size, self.quantized
+            nl = self.num_layers
+
+            def scatter(pool, rows, tables_flat):
+                out = dict(pool)
+                m = tables_flat.shape[0]
+                for i in range(nl):
+                    for kind in ("k", "v"):
+                        src = rows[f"cache_{kind}_{i}"]    # [n,H,L,D]
+                        n_rows = src.shape[0]
+                        # the covered length is shape-determined (the
+                        # jit retraces per (n, m) pair): m//n blocks of
+                        # bs slots per row, zero-padded past max_len
+                        cover = (m // n_rows) * bs
+                        take = min(cover, src.shape[2])
+                        vals = src[:, :, :take]
+                        if take < cover:
+                            pad = jnp.zeros(
+                                src.shape[:2] + (cover - take,
+                                                 src.shape[3]),
+                                src.dtype)
+                            vals = jnp.concatenate([vals, pad], axis=2)
+                        vals = vals.reshape(n_rows, vals.shape[1],
+                                            cover // bs, bs,
+                                            vals.shape[3])
+                        vals = vals.transpose(0, 2, 1, 3, 4).reshape(
+                            m, vals.shape[1], bs, vals.shape[4])
+                        dst = out[f"cache_p{kind}_{i}"]
+                        if quant:
+                            q, sc = quantize_kv(vals)
+                            out[f"cache_p{kind}_{i}"] = \
+                                dst.at[tables_flat].set(q)
+                            skey = f"cache_p{kind}s_{i}"
+                            out[skey] = out[skey].at[tables_flat].set(sc)
+                        else:
+                            out[f"cache_p{kind}_{i}"] = \
+                                dst.at[tables_flat].set(
+                                    vals.astype(dst.dtype))
+                return out
+
+            self._scatter_fn = jax.jit(scatter, donate_argnums=(0,))
+        rows = {name: a[:n] for name, a in row_caches.items()}
+        try:
+            self._arrays = self._scatter_fn(
+                self.arrays(), rows, jnp.asarray(tables, jnp.int32))
+        except Exception:
+            self._arrays = None
+            raise
+
+    # -- reporting --------------------------------------------------------
+    def _update_gauges_locked(self):
+        lab = (self.name,)
+        in_use = self.capacity_blocks - len(self._free)
+        _BLOCKS_IN_USE.set(in_use, labels=lab)
+        _CAPACITY.set(self.capacity_blocks, labels=lab)
+        _OCCUPANCY.set(in_use / self.capacity_blocks
+                       if self.capacity_blocks else 0.0, labels=lab)
+        _SAVED.set(self.slots * self.dense_slot_bytes()
+                   - in_use * self.block_bytes(), labels=lab)
+
+    def _update_gauges(self):
+        with self._lock:
+            self._update_gauges_locked()
+
+    def stats(self):
+        """Occupancy / fragmentation snapshot (plain ints/floats — wire
+        safe, merged into ``server.stats()`` under ``kvpool_*``)."""
+        with self._lock:
+            in_use = self.capacity_blocks - len(self._free)
+            tokens = sum(self._slot_tokens.values())
+            slots_held = sum(1 for n in self._slot_nblocks.values()
+                             if n > 0)
+        cap_tokens = in_use * self.block_size
+        return {
+            "blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "dtype": self.dtype,
+            "capacity_blocks": self.capacity_blocks,
+            "blocks_in_use": in_use,
+            "blocks_free": self.capacity_blocks - in_use,
+            "occupancy": round(in_use / self.capacity_blocks, 4)
+            if self.capacity_blocks else 0.0,
+            # internal fragmentation: allocated capacity the held
+            # tokens don't fill (last-block slack per slot)
+            "fragmentation": round(1.0 - tokens / cap_tokens, 4)
+            if cap_tokens else 0.0,
+            "tokens_held": tokens,
+            "slots_holding_blocks": slots_held,
+            "bytes_in_use": in_use * self.block_bytes(),
+            "bytes_capacity": self.capacity_blocks * self.block_bytes(),
+            "saved_vs_dense_bytes": self.slots * self.dense_slot_bytes()
+            - in_use * self.block_bytes(),
+        }
+
+
+def _ceil_div(a, b):
+    return -(-int(a) // int(b))

@@ -356,10 +356,19 @@ class InferenceServer:
         ``FLAGS_serving_default_deadline_ms`` is NOT inherited here: it
         is a per-infer-batch budget, and a whole generation (prefill +
         up to max_new_tokens decode steps) lives on a different time
-        scale — generation deadlines are per-request opt-in."""
+        scale — generation deadlines are per-request opt-in.
+
+        Requests that could NEVER run are refused typed AT THE DOOR,
+        before any queue wait or prefill compile: an overlong prompt
+        (prompt + max_new_tokens > the decode cache length) and, in
+        paged mode, a request bigger than the whole KV pool both raise
+        :class:`BadRequestError` (wire ``etype: "BadRequest"`` —
+        retrying cannot help)."""
         if self.gen_queue is None:
             raise ValueError("no generator loaded — pass generator= to "
                              "InferenceServer to serve 'generate'")
+        self.gen_engine.admission_check(
+            np.asarray(tokens).size, max_new_tokens, static_only=True)
         if self.state == "degraded":
             if self.stats_sink:
                 self.stats_sink.bump("shed_overload")
@@ -397,6 +406,9 @@ class InferenceServer:
             extra["decode_free_slots"] = len(self.decode_batcher._free)
             for k, v in self.gen_engine.gen.cache.stats().items():
                 extra[f"decode_cache_{k}"] = v
+            if self.gen_engine.pool is not None:
+                for k, v in self.gen_engine.pool.stats().items():
+                    extra[f"kvpool_{k}"] = v
         extra["state"] = self.state
         extra["weights_version"] = self._weights_version
         return self.stats_sink.snapshot(extra=extra)
@@ -709,7 +721,7 @@ _ETYPE_MAP = (
     ("DeadlineExceeded", DeadlineExceededError),
     ("Overloaded", ServerOverloadedError),
     ("Watchdog", WatchdogTimeout),
-    ("BadRequest", (ValueError, TypeError)),
+    ("BadRequest", (BadRequestError, ValueError, TypeError)),
 )
 # client-side reply mapping: server-side BadRequest detection matches
 # (ValueError, TypeError), but the CLIENT raises the typed ServingError

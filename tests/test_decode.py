@@ -328,3 +328,15 @@ def test_bench_decode_smoke():
     assert set(rec["seq"]) == {"128", "256"}
     assert rec["value"] > 0
     assert rec["seq"]["256"]["speedup_vs_full_recompute"] >= 3.0, rec
+    # paged KV-pool rows: fp32 is bitwise-parity-gated inside the
+    # bench; the quantized rows must be present with tokens/s
+    assert set(rec["paged"]) == {"fp32", "bf16", "int8"}
+    for row in rec["paged"].values():
+        assert row["tokens_per_sec"] > 0
+    assert rec["paged"]["fp32"]["greedy_match_vs_dense"] == 1.0
+    # fixed-HBM concurrency acceptance: paged admits >= 2x dense slots
+    # at max_len=2048 (also asserted inside bench_decode itself)
+    fh = rec["fixed_hbm_concurrency"]
+    assert fh["max_len"] == 2048
+    assert fh["fp32"]["x_vs_dense"] >= 2.0, fh
+    assert fh["int8"]["slots"] >= fh["fp32"]["slots"], fh
