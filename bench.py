@@ -41,6 +41,11 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
                       p99 and fused-loop step time with request
                       tracing off vs the default sample rate vs 1.0
                       (the BENCHMARKS.md telemetry rows)
+  fleet               extra: disaggregated serving fleet — aggregate
+                      tokens/s behind the Router scaling 1 -> 3
+                      replicas, the prefill/decode split's KV-block
+                      migration cost + parity, and p99 inter-token
+                      latency through a mid-generation replica kill
 
 Every throughput config also reports cold_start_ms (first-step
 end-to-end latency) plus the executor's pass/trace/compile ms split, so
@@ -1394,6 +1399,238 @@ def bench_decode():
     }
 
 
+def bench_fleet():
+    """Disaggregated serving fleet (serving/fleet, the BENCHMARKS.md
+    fleet table): (a) aggregate decode tokens/s behind the
+    telemetry-driven Router scaling 1 -> 3 replicas at fixed offered
+    load; (b) the disaggregated prefill/decode split — two-hop routed
+    generate with the KV blocks migrated over the wire, greedy parity
+    against a colocated replica plus the migration byte cost; (c) the
+    chaos kill — one of three replicas dies mid-generation and the
+    p99 inter-token latency (request wall / tokens, the no-streaming
+    proxy) is measured THROUGH the kill: typed errors only, traced
+    failover, zero leaked KV blocks fleet-wide. Accelerators run
+    GPT-base; CPU the tiny config (same fleet machinery, sized so the
+    smoke run finishes fast)."""
+    import threading
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.generation import GPTGenerator
+    from paddle_tpu.serving import fleet
+
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "gpu", "axon"):
+        cfg = gpt.GPTConfig.base()
+        new_tokens, prompt_len, slots, n_req = 32, 64, 4, 6
+    else:
+        # mid-size on CPU: the decode step must be COMPUTE-bound (the
+        # XLA host backend runs it off-GIL across cores) for replica
+        # scaling to be measurable — at tiny scale every replica loop
+        # serializes on Python dispatch and the fleet can't show its
+        # aggregate throughput
+        cfg = gpt.GPTConfig(vocab_size=2048, hidden_size=256,
+                            num_layers=6, num_heads=8, ffn_size=1024,
+                            max_position=128, dropout=0.0)
+        new_tokens, prompt_len, slots, n_req = 24, 8, 2, 4
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        gpt.gpt_logits(cfg)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    max_len = prompt_len + new_tokens + 8
+    rng = np.random.default_rng(0)
+
+    def mksrv(name):
+        gen = GPTGenerator(cfg, scope, max_len=max_len, bucket_min=8)
+        return serving.InferenceServer(
+            generator=gen, decode_slots=slots, kv_paged=True,
+            kv_pool_name=name).start()
+
+    def warm(reps):
+        # compile prefill AND every decode-length bucket once per
+        # replica (each has a fresh jit cache) so the measured window
+        # (and the chaos kill) is steady-state, not compiles
+        p = rng.integers(1, cfg.vocab_size, prompt_len).astype(np.int32)
+        for r in reps:
+            with serving.Client(r.endpoint) as c:
+                c.generate(p, max_new_tokens=new_tokens)
+
+    # 1.5x the 3-replica slot capacity: every scaling point must be
+    # SERVICE-limited (slots busy end to end), not arrival-limited
+    n_clients = 9
+
+    def drive(endpoint, kill=None):
+        """n_clients threads x n_req sequential routed generates.
+        Returns (wall_s, ok_latencies_s, errors). ``kill`` is an
+        (after_s, server) pair — the chaos lever."""
+        lats, errors = [], []
+        lock = threading.Lock()
+        # prompts drawn on THIS thread: np.random.Generator is not
+        # thread-safe, so workers must not share the bench rng
+        worker_prompts = [rng.integers(1, cfg.vocab_size,
+                                       prompt_len).astype(np.int32)
+                          for _ in range(n_clients)]
+
+        def work(i):
+            p = worker_prompts[i]
+            with serving.Client(endpoint) as c:
+                for _ in range(n_req):
+                    t0 = time.perf_counter()
+                    try:
+                        c.generate(p, max_new_tokens=new_tokens,
+                                   deadline_ms=120000.0)
+                    except serving.ServingError as exc:
+                        with lock:
+                            errors.append(exc)
+                        continue
+                    with lock:
+                        lats.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if kill is not None:
+            time.sleep(kill[0])
+            kill[1].stop()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, lats, errors
+
+    def intertoken_ms(lats, q):
+        per_tok = np.asarray(lats) / new_tokens * 1e3
+        return round(float(np.percentile(per_tok, q)), 3)
+
+    # (a) aggregate tokens/s, 1 -> 3 replicas at fixed offered load
+    # (best of 2 measured windows per point — replicas share this
+    # host's cores, so a neighbor's burst must not pollute a point)
+    scaling = {}
+    for n in (1, 2, 3):
+        reps = [mksrv(f"fleet{n}_{i}") for i in range(n)]
+        warm(reps)
+        router = fleet.Router([r.endpoint for r in reps],
+                              probe_interval_s=0.05).start()
+        try:
+            best = None
+            for _rep in range(2):
+                wall, lats, errors = drive(router.endpoint)
+                assert not errors, errors
+                if best is None or wall < best[0]:
+                    best = (wall, lats)
+            wall, lats = best
+            scaling[str(n)] = {
+                "tokens_per_sec": round(
+                    len(lats) * new_tokens / wall, 1),
+                "intertoken_p50_ms": intertoken_ms(lats, 50),
+                "intertoken_p99_ms": intertoken_ms(lats, 99),
+            }
+        finally:
+            router.stop()
+            for r in reps:
+                r.stop()
+    for n in ("2", "3"):
+        scaling[n]["speedup_vs_1"] = round(
+            scaling[n]["tokens_per_sec"]
+            / scaling["1"]["tokens_per_sec"], 2)
+    scaling["3"]["scaling_efficiency"] = round(
+        scaling["3"]["speedup_vs_1"] / 3, 2)
+
+    # (b) disaggregated prefill/decode split: two-hop parity + the
+    # migration cost (each pool scales on its own roofline)
+    prompt = rng.integers(1, cfg.vocab_size, prompt_len).astype(np.int32)
+    colo = mksrv("fleet_colo")
+    try:
+        warm([colo])
+        with serving.Client(colo.endpoint) as c:
+            ref = c.generate(prompt, max_new_tokens=new_tokens)
+    finally:
+        colo.stop()
+    pre, dec = mksrv("fleet_pre"), mksrv("fleet_dec")
+    router = fleet.Router([(pre.endpoint, "prefill"),
+                           (dec.endpoint, "decode")],
+                          probe_interval_s=0.05).start()
+    try:
+        warm([pre, dec])
+        with serving.Client(router.endpoint) as c:
+            t0 = time.perf_counter()
+            out = c.generate(prompt, max_new_tokens=new_tokens)
+            two_hop_s = time.perf_counter() - t0
+        assert np.array_equal(out, ref), \
+            "disaggregated greedy decode diverged from colocated"
+        st = router.stats()
+        disagg = {
+            "greedy_parity": True,
+            "tokens_per_sec": round(new_tokens / two_hop_s, 1),
+            "kv_migrations": st["router_kv_migrations"],
+            "kv_migrated_kib": round(
+                st["router_kv_migrated_bytes"] / 1024, 1),
+        }
+        assert pre.gen_engine.pool.blocks_in_use() == 0
+        assert dec.gen_engine.pool.blocks_in_use() == 0
+    finally:
+        router.stop()
+        pre.stop()
+        dec.stop()
+
+    # (c) chaos kill: one of three replicas dies mid-generation
+    reps = [mksrv(f"fleet_chaos{i}") for i in range(3)]
+    warm(reps)
+    router = fleet.Router([r.endpoint for r in reps],
+                          probe_interval_s=0.05, probe_timeout_s=0.5,
+                          evict_after=2).start()
+    try:
+        wall, lats, errors = drive(router.endpoint,
+                                   kill=(0.2, reps[1]))
+        for exc in errors:
+            assert isinstance(exc, serving.ServingError), \
+                f"untyped error crossed the fleet: {type(exc)}: {exc}"
+        st = router.stats()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and any(
+                r.gen_engine.pool.blocks_in_use() for r in reps):
+            time.sleep(0.05)
+        leaked = {r.gen_engine.pool.name: r.gen_engine.pool.holders()
+                  for r in reps if r.gen_engine.pool.blocks_in_use()}
+        assert not leaked, f"leaked KV blocks after the kill: {leaked}"
+        chaos_kill = {
+            "requests_ok": len(lats),
+            "requests_typed_errors": len(errors),
+            "tokens_per_sec": round(len(lats) * new_tokens / wall, 1),
+            "intertoken_p50_ms": intertoken_ms(lats, 50),
+            "intertoken_p99_ms": intertoken_ms(lats, 99),
+            "intertoken_p99_vs_steady": round(
+                intertoken_ms(lats, 99)
+                / scaling["3"]["intertoken_p99_ms"], 2),
+            "failovers": st["router_failovers"],
+            "fleet_events": st["fleet_events"],
+            "replicas_healthy_after": router.registry.healthy_count(),
+            "leaked_kv_blocks": 0,
+        }
+    finally:
+        router.stop()
+        for r in reps:
+            r.stop()
+
+    return {
+        "metric": "fleet_3_replica_aggregate_tokens_per_sec",
+        "value": scaling["3"]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,       # fleet-layer A/B, no external anchor
+        "new_tokens": new_tokens,
+        "offered_load_clients": n_clients,
+        "decode_slots_per_replica": slots,
+        "scaling": scaling,
+        "disaggregated": disagg,
+        "chaos_kill": chaos_kill,
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -1417,6 +1654,7 @@ _CONFIGS = {
     "passes": (bench_passes,
                "passes_bert_train_step_trace_plus_compile_ms"),
     "decode": (bench_decode, "decode_kv_cache_seq256_tokens_per_sec"),
+    "fleet": (bench_fleet, "fleet_3_replica_aggregate_tokens_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

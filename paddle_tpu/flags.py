@@ -120,6 +120,23 @@ _DEFS = {
     # pool HBM-equivalent to the dense bank it replaces
     # (slots * ceil(max_len/block_size) + 1)
     "kv_pool_blocks": (0, int, None),
+    # -- disaggregated serving fleet (serving/fleet) --
+    # router health-probe cadence against every registered replica, and
+    # the per-probe wire timeout (a hung replica's accept loop must fail
+    # the probe fast, not inherit the long socket default)
+    "router_probe_interval_s": (0.5, float, None),
+    "router_probe_timeout_s": (2.0, float, None),
+    # consecutive failed probes before a replica is EVICTED from the
+    # dispatch rotation (probing continues; a healthy probe readmits it)
+    "router_evict_after": (3, int, None),
+    # cross-replica hedging: fire a twin of a routed generate on a
+    # SECOND replica after this many ms without a reply (the loser is
+    # cancelled by request id). 0 = hedging off (failover-on-death only)
+    "router_hedge_ms": (0.0, float, None),
+    # extra replicas tried when a dispatch target dies mid-request
+    # (transport failure -> the replica is marked dead and the request
+    # fails over with the SAME request id)
+    "router_dispatch_retries": (2, int, None),
     # Executor per-(program, feed-shape) compile cache entry cap — bounds
     # what was previously unbounded growth per input-shape signature
     "executor_cache_entries": (128, int, None),

@@ -18,6 +18,7 @@ token sequence bitwise.
 sampler, no cache ops) — the A/B half of ``bench.py --config decode``
 and the greedy-parity reference in tests.
 """
+import threading
 import time
 
 import numpy as np
@@ -26,6 +27,14 @@ from .. import profiler as _prof
 from ..flags import flag
 from ..observability import utilization as _util
 from . import gpt
+
+# fluid program construction mutates process-global state (the default
+# program pair swapped by ``program_guard`` plus the unique_name
+# counters). Two generators lazily building a program from different
+# threads — e.g. several in-process fleet replicas hitting their first
+# paged decode at once — would interleave ops into each other's
+# programs; every build in this module happens under this lock.
+_PROG_BUILD_LOCK = threading.Lock()
 
 
 def length_bucket(n, lo=1):
@@ -115,11 +124,12 @@ class GPTGenerator:
             "sample_greedy": _greedy_program_outs,
         }
         self._progs = {}
-        for kind, build in builders.items():
-            main, startup = Program(), Program()
-            with program_guard(main, startup):
-                outs = build()
-            self._progs[kind] = (main, outs)
+        with _PROG_BUILD_LOCK:
+            for kind, build in builders.items():
+                main, startup = Program(), Program()
+                with program_guard(main, startup):
+                    outs = build()
+                self._progs[kind] = (main, outs)
         self._fns = {}      # kind -> (jitted, device_state)
         self._params = {}   # param name -> device array, shared by kinds
         # (bucket_rows, kv_dtype, block_size) -> KVBlockPool reused
@@ -157,10 +167,15 @@ class GPTGenerator:
             raise KeyError(f"unknown generation program kind {kind!r}")
         from ..framework.core import Program, program_guard
         kv_dtype = kind.rsplit("_", 1)[1]
-        main, startup = Program(), Program()
-        with program_guard(main, startup):
-            outs = gpt.gpt_decode_step_paged(self.cfg, kv_dtype=kv_dtype)
-        self._progs[kind] = (main, outs)
+        with _PROG_BUILD_LOCK:
+            entry = self._progs.get(kind)
+            if entry is not None:     # lost the build race to a peer
+                return entry
+            main, startup = Program(), Program()
+            with program_guard(main, startup):
+                outs = gpt.gpt_decode_step_paged(self.cfg,
+                                                 kv_dtype=kv_dtype)
+            self._progs[kind] = (main, outs)
         return self._progs[kind]
 
     def _ensure_fn(self, kind):

@@ -51,6 +51,15 @@ clipper/ORCA adaptive-batching tradition:
   stage into the profiler's unified span table for
   ``tools/timeline.py``
 
+- fleet (``serving.fleet``): a ``Router`` tier fronts N replicas over
+  the same wire protocol — telemetry-driven least-loaded dispatch
+  (probed ``health`` snapshots: queue depths + kvpool occupancy),
+  replica eviction/readmission, cross-replica failover + hedging with
+  request-id dedup, drain-aware rolling weight reloads, and a
+  DISAGGREGATED prefill/decode split that streams finished KV blocks
+  from compute-bound prefill replicas into bandwidth-bound decode
+  replicas' pools (``op: "prefill"`` + ``generate``'s ``kv=`` import)
+
 - resilience: the server runs a lifecycle state machine (warming ->
   serving -> draining -> stopped, degraded while the loop supervisor's
   breaker is open), a ``health`` wire op, ``drain()`` graceful shutdown,
@@ -94,3 +103,4 @@ from .kvpool import KVBlockPool, KVPoolExhaustedError  # noqa: F401
 from .metrics import LatencyHistogram, ServingStats  # noqa: F401
 from .server import Client, InferenceServer, ServingConfig  # noqa: F401
 from .supervise import LoopSupervisor  # noqa: F401
+from . import fleet  # noqa: F401  — Router/ReplicaRegistry (serving.fleet)

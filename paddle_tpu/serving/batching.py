@@ -304,18 +304,36 @@ class GenerationRequest(Request):
     breaker) is inherited from :class:`Request` — ``deadline_ms`` is
     token-level: it is re-checked between decode steps, so a request
     whose budget runs out mid-generation fails fast instead of holding
-    its slot for the full ``max_new_tokens``."""
+    its slot for the full ``max_new_tokens``.
+
+    Disaggregated prefill/decode split (serving/fleet): with
+    ``export_kv=True`` the request is prefill-ONLY — the prompt is
+    prefilled and its first token sampled as usual, then the slot's KV
+    blocks are serialized and delivered as the result instead of the
+    row joining the decode bank. With ``kv=`` (a
+    ``kvpool.export_slot`` payload) plus ``first_token=``, the request
+    is the other half: it skips prefill entirely, streaming the
+    migrated blocks into its slot and decoding from ``first_token``."""
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "top_k",
-                 "eos_id", "out_tokens", "slot")
+                 "eos_id", "out_tokens", "slot", "export_kv", "kv",
+                 "first_token")
 
     def __init__(self, prompt, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_id=None, deadline_ms=None):
+                 top_k=0, eos_id=None, deadline_ms=None,
+                 export_kv=False, kv=None, first_token=None):
         prompt = np.asarray(prompt, dtype=np.int32).ravel()
         if prompt.size < 1:
             raise ValueError("generation request has an empty prompt")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if kv is not None and export_kv:
+            raise ValueError("a request cannot both import (kv=) and "
+                             "export (export_kv=True) KV state")
+        if (kv is None) != (first_token is None):
+            raise ValueError("kv= and first_token= come together: the "
+                             "migrated payload is decoded FROM the "
+                             "prefill-side sampled token")
         # no infer feeds dict: the prompt is the payload (feeds/
         # example_sig are MicroBatcher concepts; the DecodeBatcher
         # groups by slot, not signature)
@@ -330,6 +348,10 @@ class GenerationRequest(Request):
         self.eos_id = None if eos_id is None else int(eos_id)
         self.out_tokens = []
         self.slot = None
+        self.export_kv = bool(export_kv)
+        self.kv = kv
+        self.first_token = None if first_token is None \
+            else int(first_token)
 
 
 class SwapHandle:
@@ -618,44 +640,114 @@ class DecodeBatcher:
                     if self.stats:
                         self.stats.bump("requests_failed")
             return
-        slots = [self._free.pop() for _ in take]
+        # migrated requests (kv=) admit through the KV-import path,
+        # everything else prefills; failures are ISOLATED — the fresh
+        # prefills admit as one batch, but each migrated payload admits
+        # ALONE (validation is per-payload), so one poisoned migration
+        # neither takes down the round's prefills nor its sibling
+        # imports
+        fresh = [r for r in take if getattr(r, "kv", None) is None]
+        imported = [r for r in take if getattr(r, "kv", None) is not None]
+        admit_imported = getattr(self.engine, "admit_imported", None)
+        if imported and admit_imported is None:
+            for req in imported:
+                req.set_error(BadRequestError(
+                    "this engine cannot admit migrated KV state"))
+                if self.stats:
+                    self.stats.bump("requests_failed")
+            imported = []
+        batches = ([(fresh, self.engine.admit)] if fresh else []) \
+            + [([r], admit_imported) for r in imported]
+        for group, admit in batches:
+            slots = [self._free.pop() for _ in group]
+            try:
+                first = admit(group, slots)
+            except Exception as exc:  # noqa: BLE001 — reach the clients
+                for req in group:
+                    req.set_error(exc)
+                    if self.stats:
+                        self.stats.bump("requests_failed")
+                if self._epoch != epoch:
+                    # deposed: _free/_active belong to the new loop
+                    # thread — and the round's remaining taken requests
+                    # will never be admitted; fail them all
+                    self._fail_deposed(take)
+                    return
+                self._free.extend(slots)
+                if isinstance(exc, BadRequestError):
+                    # the request's own payload was refused (migrated
+                    # KV geometry mismatch, ...) — a client error, not
+                    # an engine fault: the loop breaker must not move
+                    continue
+                self.consecutive_failures += 1
+                if self.stats:
+                    self.stats.bump("engine_failures")
+                self._fail_active_if_bank_lost(exc)
+                continue
+            if self._epoch != epoch:
+                # deposed while blocked in the prefill (it eventually
+                # returned): the restarted loop owns the slot bank —
+                # fail EVERY taken request instead of registering any
+                self._fail_deposed(take)
+                return
+            if group is not fresh and self.stats:
+                self.stats.bump("kv_imports", len(group))
+            for tok, req, slot in zip(first, group, slots):
+                if self.stats:
+                    self.stats.bump("generate_requests")
+                if getattr(req, "export_kv", False):
+                    self._finish_export(req, slot, int(tok))
+                    continue
+                req.slot = slot
+                self._active[slot] = req
+                self._pos[slot] = req.prompt.size
+                self._temp[slot] = req.temperature
+                self._topk[slot] = req.top_k
+                self._tok[slot] = tok
+                self._deliver_token(req, int(tok))
+
+    def _fail_deposed(self, take):
+        """The loop was restarted while this (now deposed) thread held
+        requests it had already popped from the queue: fail every one
+        that hasn't finished — the restarted loop will never see them,
+        and a silent drop would strand their clients until the wire
+        wait budget."""
+        for req in take:
+            if not req.done():
+                req.set_error(ServingError(
+                    "decode loop restarted during admission; "
+                    "the request's prefill was discarded"))
+                if self.stats:
+                    self.stats.bump("requests_failed")
+
+    def _finish_export(self, req, slot, tok):
+        """Deliver a prefill-only request (disaggregated split): the
+        freshly prefilled slot's KV blocks are serialized as the result
+        — ``first_token`` and the prompt length ride inside the payload
+        — and the slot is freed immediately; the row never joins the
+        decode bank (its decode runs on another replica)."""
         try:
-            first = self.engine.admit(take, slots)
-        except Exception as exc:  # noqa: BLE001 — must reach the clients
-            for req in take:
+            payload = self.engine.export_slot(slot)
+        except Exception as exc:  # noqa: BLE001 — typed to the client
+            self.engine.release_slot(slot)
+            self._free.append(slot)
+            if not req.done():
                 req.set_error(exc)
                 if self.stats:
                     self.stats.bump("requests_failed")
-            if self._epoch != epoch:
-                return       # deposed: _free/_active belong to the new
-            self._free.extend(slots)                       # loop thread
-            self.consecutive_failures += 1
-            if self.stats:
-                self.stats.bump("engine_failures")
-            self._fail_active_if_bank_lost(exc)
             return
-        if self._epoch != epoch:
-            # deposed while blocked in the prefill (it eventually
-            # returned): the restarted loop owns the slot bank — fail
-            # the taken requests instead of registering them
-            for req in take:
-                if not req.done():
-                    req.set_error(ServingError(
-                        "decode loop restarted during admission; the "
-                        "request's prefill was discarded"))
-                    if self.stats:
-                        self.stats.bump("requests_failed")
+        self.engine.release_slot(slot)
+        self._free.append(slot)
+        payload["first_token"] = tok
+        payload["prompt_tokens"] = int(req.prompt.size)
+        if req.done():          # abandoned while prefilling
             return
-        for tok, req, slot in zip(first, take, slots):
-            if self.stats:
-                self.stats.bump("generate_requests")
-            req.slot = slot
-            self._active[slot] = req
-            self._pos[slot] = req.prompt.size
-            self._temp[slot] = req.temperature
-            self._topk[slot] = req.top_k
-            self._tok[slot] = tok
-            self._deliver_token(req, int(tok))
+        req.set_result([payload])
+        if self.stats:
+            self.stats.bump("kv_exports")
+            self.stats.bump("requests_completed")
+            self.stats.hist["total"].observe(
+                time.monotonic() - req.t_enqueue)
 
     # -- hot weight swap ---------------------------------------------------
     def request_swap(self, apply_fn):

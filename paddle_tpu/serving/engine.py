@@ -428,7 +428,7 @@ class GenerationEngine:
 
     def __init__(self, generator, *, slots=None, stats=None, seed=0,
                  paged=None, kv_dtype=None, kv_block_size=None,
-                 kv_pool_blocks=None):
+                 kv_pool_blocks=None, pool_name="serving"):
         import jax
         from ..flags import flag
         self.gen = generator
@@ -438,7 +438,9 @@ class GenerationEngine:
         # slot bank becomes a shared KVBlockPool with per-slot block
         # tables — concurrency bounded by actual tokens, not
         # slots * max_len. None/False keeps the dense bank (the parity
-        # baseline).
+        # baseline). ``pool_name`` labels the pool's kvpool_* gauge
+        # series — fleet replicas sharing one process must not clobber
+        # each other's occupancy.
         self.paged = bool(flag("kv_paged") if paged is None else paged)
         self.pool = None
         if self.paged:
@@ -450,7 +452,7 @@ class GenerationEngine:
                 d_head=cfg.hidden_size // cfg.num_heads,
                 max_seq_len=generator.max_len,
                 block_size=kv_block_size, num_blocks=kv_pool_blocks,
-                dtype=kv_dtype, name="serving")
+                dtype=kv_dtype, name=pool_name)
         # a generator WITHOUT its own sink adopts the server's (stage
         # histograms land in server.stats()), and a sink a PREVIOUS
         # engine bound is rebound to the live server (else a reused
@@ -661,6 +663,62 @@ class GenerationEngine:
             if getattr(req, "trace", None) is not None:
                 _trace.record_child("serving/prefill", t0, t1, req.trace)
         return out
+
+    # -- disaggregated prefill/decode (KV-block migration) ----------------
+    def export_slot(self, slot):
+        """Serialize ``slot``'s KV blocks for cross-replica migration
+        (the prefill half of the disaggregated split). Paged mode only:
+        the block table is what makes in-flight KV state a well-defined,
+        movable unit — the dense bank has no such boundary."""
+        from .batching import BadRequestError
+        if self.pool is None:
+            raise BadRequestError(
+                "KV export requires the paged pool (FLAGS_kv_paged / "
+                "paged=True) — the dense bank's rows are not migratable")
+        return self.pool.export_slot(slot)
+
+    def admit_imported(self, requests, slot_ids):
+        """Admit requests whose prefill ran on ANOTHER replica: stream
+        each request's ``kv`` payload into its slot's blocks instead of
+        running a prefill. Mirrors :meth:`admit`'s contract — returns
+        the first tokens (carried in the payloads, sampled prefill-side)
+        as np int32 [len(requests)]; on failure nothing stays allocated
+        and a donated-array loss flags ``bank_lost``."""
+        from .batching import BadRequestError
+        if self.pool is None:
+            raise BadRequestError(
+                "KV import requires the paged pool (FLAGS_kv_paged / "
+                "paged=True) on the decode replica")
+        self._ensure_caches()
+        t0 = time.perf_counter()
+        imported = []
+        try:
+            for req, slot in zip(requests, slot_ids):
+                self.pool.free_slot(slot)     # stale holder (if any)
+                self.pool.import_slot(slot, req.kv)
+                imported.append(slot)
+        except Exception:
+            for sl in imported:
+                self.pool.free_slot(sl)
+            # a scatter failure dropped the donated device arrays
+            # (import_slot already forgot them); the other active rows'
+            # caches died with them
+            if self.pool._arrays is None:
+                self.bank_lost = True
+            raise
+        t1 = time.perf_counter()
+        first = np.asarray([int(req.first_token) for req in requests],
+                           np.int32)
+        for req in requests:
+            if getattr(req, "trace", None) is not None:
+                _trace.record_child("serving/kv_import", t0, t1,
+                                    req.trace)
+            # the device pool owns the blocks now: drop the host-side
+            # payload — the server's rid-dedup table retains completed
+            # request objects, and a pinned multi-MB payload per entry
+            # would accumulate into real host-memory growth
+            req.kv = None
+        return first
 
     def step(self, tokens, pos, temperature, top_k, budget=None):
         """One decode + sample over the whole slot bank. ``tokens``/

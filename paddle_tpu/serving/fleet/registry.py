@@ -1,0 +1,345 @@
+"""Replica registry: the router's view of the fleet.
+
+Each :class:`Replica` is one ``InferenceServer`` endpoint with a ROLE —
+``"both"`` (colocated prefill+decode), ``"prefill"`` (compute-bound pool)
+or ``"decode"`` (bandwidth-bound pool) — and the live telemetry the last
+health probe scraped (lifecycle state, queue depths, kvpool occupancy).
+The :class:`ReplicaRegistry` owns the probe loop:
+
+- every ``FLAGS_router_probe_interval_s`` each replica answers a
+  ``health`` probe under ``FLAGS_router_probe_timeout_s`` (the per-call
+  Client timeout — a hung replica, stalled accept loop included, fails
+  the probe fast instead of inheriting the long execute-path default);
+- ``FLAGS_router_evict_after`` consecutive failed probes EVICT the
+  replica from the dispatch rotation (flight-recorded, counted).
+  Probing continues — one healthy probe READMITS it, so a bounced
+  replica rejoins without operator action;
+- a transport death observed by a dispatch (``mark_dead``) evicts
+  immediately — the prober's job is detecting quiet deaths, not
+  gating the loud ones.
+
+``pick()`` is the telemetry-driven dispatch half: among in-rotation
+replicas of the wanted roles, it returns the lowest LOAD SCORE — the
+router-tracked in-flight dispatches plus the probed queue depths and
+active decode rows, plus the probed ``kvpool_occupancy`` weighted so a
+nearly-full pool loses ties against an empty one.
+"""
+import threading
+import time
+
+from ...flags import flag
+from ...observability.metrics import default_registry
+from ...observability.recorder import flight_recorder as _flightrec
+from ...resilience import maybe_fail
+from ..server import Client
+
+_HEALTHY = default_registry().gauge(
+    "router_replicas_healthy_count",
+    "fleet replicas currently in the dispatch rotation",
+    labels=("router",), max_series=8)
+_PROBE_FAILS = default_registry().counter(
+    "router_probe_failures_total",
+    "replica health probes that failed (timeout/transport/typed error)",
+    labels=("router",), max_series=8)
+_EVICTIONS = default_registry().counter(
+    "router_replica_evictions_total",
+    "replicas evicted from the dispatch rotation by consecutive failed "
+    "probes",
+    labels=("router",), max_series=8)
+_READMISSIONS = default_registry().counter(
+    "router_replica_readmissions_total",
+    "evicted/dead replicas readmitted by a healthy probe",
+    labels=("router",), max_series=8)
+_DEATHS = default_registry().counter(
+    "router_replica_deaths_total",
+    "replica deaths observed by a dispatch (transport failure "
+    "mid-request)",
+    labels=("router",), max_series=8)
+
+_ROLES = ("both", "prefill", "decode")
+
+# the probed server lifecycle states a replica may be dispatched in
+# (draining/degraded/stopped replicas shed or refuse generation — the
+# router routes around them instead of bouncing clients off them)
+_DISPATCHABLE_STATES = ("serving", "warming")
+
+
+class Replica:
+    """One registered replica endpoint + its probed telemetry. All
+    mutation happens under the owning registry's lock."""
+
+    def __init__(self, endpoint, role="both"):
+        if role not in _ROLES:
+            raise ValueError(f"replica role must be one of {_ROLES}, "
+                             f"got {role!r}")
+        self.endpoint = str(endpoint)
+        self.role = role
+        self.state = "unknown"      # unknown|healthy|evicted|draining
+        self.probe_failures = 0     # consecutive
+        self.last_health = {}       # last successful health() payload
+        self.last_probe = 0.0       # monotonic stamp of it
+        self.inflight = 0           # router-tracked dispatches right now
+        self.dispatched_total = 0
+        self.evictions = 0
+        self.readmissions = 0
+
+    def load_score(self):
+        """Lower = less loaded. Router-tracked in-flight dispatches are
+        the freshest signal (they move between probes); the probed
+        queue depths and active decode rows cover traffic from other
+        routers/clients; kvpool occupancy (0..1) is weighted x4 so a
+        nearly-full pool loses ties well before it starts shedding."""
+        h = self.last_health
+        depth = (h.get("queue_depth", 0) or 0) \
+            + (h.get("decode_queue_depth", 0) or 0) \
+            + (h.get("decode_active_rows", 0) or 0)
+        occ = float(h.get("kvpool_occupancy", 0.0) or 0.0)
+        return self.inflight + depth + 4.0 * occ
+
+    def dispatchable(self):
+        return (self.state == "healthy"
+                and self.last_health.get("state")
+                in _DISPATCHABLE_STATES)
+
+    def snapshot(self):
+        """Wire-safe summary for ``Router.stats()``/``health``."""
+        h = self.last_health
+        return {
+            "endpoint": self.endpoint,
+            "role": self.role,
+            "state": self.state,
+            "replica_state": h.get("state"),
+            "probe_failures": self.probe_failures,
+            "probe_age_s": round(time.monotonic() - self.last_probe, 3)
+            if self.last_probe else None,
+            "inflight": self.inflight,
+            "dispatched_total": self.dispatched_total,
+            "evictions": self.evictions,
+            "readmissions": self.readmissions,
+            "queue_depth": h.get("queue_depth", 0),
+            "decode_queue_depth": h.get("decode_queue_depth", 0),
+            "decode_active_rows": h.get("decode_active_rows", 0),
+            "kvpool_occupancy": h.get("kvpool_occupancy", 0.0),
+            "weights_version": h.get("weights_version"),
+            "load_score": round(self.load_score(), 3),
+        }
+
+
+class ReplicaRegistry:
+    """Thread-safe replica table + the health-probe loop."""
+
+    def __init__(self, name="router", auth_key=None,
+                 probe_interval_s=None, probe_timeout_s=None,
+                 evict_after=None):
+        self.name = str(name)
+        self._auth_key = auth_key
+        self.probe_interval_s = float(
+            probe_interval_s if probe_interval_s is not None
+            else flag("router_probe_interval_s"))
+        self.probe_timeout_s = float(
+            probe_timeout_s if probe_timeout_s is not None
+            else flag("router_probe_timeout_s"))
+        self.evict_after = int(evict_after if evict_after is not None
+                               else flag("router_evict_after"))
+        self._lock = threading.Lock()
+        self._reps = {}             # endpoint -> Replica
+        self._clients = {}          # endpoint -> probe Client
+        # the probe Client is one-socket/serial — a register-op probe
+        # overlapping the prober loop must not interleave frames on it
+        self._probe_locks = {}      # endpoint -> Lock
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- membership -------------------------------------------------------
+    def add(self, endpoint, role="both", probe=True):
+        """Register a replica; an immediate synchronous probe (best
+        effort) makes it dispatchable without waiting a probe period."""
+        rep = Replica(endpoint, role=role)
+        with self._lock:
+            if rep.endpoint in self._reps:
+                raise ValueError(f"replica {rep.endpoint} is already "
+                                 f"registered")
+            self._reps[rep.endpoint] = rep
+        if probe:
+            self.probe_once(rep)
+        self._update_gauge()
+        return rep
+
+    def remove(self, endpoint):
+        with self._lock:
+            rep = self._reps.pop(str(endpoint), None)
+            client = self._clients.pop(str(endpoint), None)
+            self._probe_locks.pop(str(endpoint), None)
+        if client is not None:
+            client.close()
+        self._update_gauge()
+        return rep is not None
+
+    def get(self, endpoint):
+        with self._lock:
+            return self._reps.get(str(endpoint))
+
+    def all(self):
+        with self._lock:
+            return list(self._reps.values())
+
+    def has_role(self, role):
+        with self._lock:
+            return any(r.role == role for r in self._reps.values())
+
+    def healthy_count(self):
+        with self._lock:
+            return sum(1 for r in self._reps.values()
+                       if r.state == "healthy")
+
+    def snapshot(self):
+        with self._lock:
+            return {ep: r.snapshot() for ep, r in self._reps.items()}
+
+    # -- dispatch support -------------------------------------------------
+    def pick(self, roles, exclude=()):
+        """The least-loaded in-rotation replica whose role is in
+        ``roles`` (endpoints in ``exclude`` skipped); None when the
+        rotation is empty."""
+        exclude = set(exclude)
+        with self._lock:
+            cands = [r for r in self._reps.values()
+                     if r.role in roles and r.endpoint not in exclude
+                     and r.dispatchable()]
+            if not cands:
+                return None
+            return min(cands, key=lambda r: (r.load_score(),
+                                             r.endpoint))
+
+    def checkout(self, rep):
+        with self._lock:
+            rep.inflight += 1
+            rep.dispatched_total += 1
+
+    def checkin(self, rep):
+        with self._lock:
+            rep.inflight = max(rep.inflight - 1, 0)
+
+    def set_state(self, endpoint, state):
+        """Manual rotation control (rolling reload uses ``draining`` /
+        ``healthy``)."""
+        with self._lock:
+            rep = self._reps.get(str(endpoint))
+            if rep is not None:
+                rep.state = state
+        self._update_gauge()
+
+    def mark_dead(self, endpoint, reason):
+        """A dispatch watched this replica die (transport failure):
+        evict immediately — the prober readmits it when it answers
+        health probes again."""
+        with self._lock:
+            rep = self._reps.get(str(endpoint))
+            if rep is None or rep.state == "evicted":
+                return
+            rep.state = "evicted"
+            rep.evictions += 1
+            rep.probe_failures = max(rep.probe_failures,
+                                     self.evict_after)
+            client = self._clients.pop(str(endpoint), None)
+        if client is not None:
+            client.close()
+        _DEATHS.inc(labels=(self.name,))
+        _flightrec().record("replica_death", router=self.name,
+                            endpoint=str(endpoint), reason=str(reason)[:200])
+        self._update_gauge()
+
+    # -- probing ----------------------------------------------------------
+    def _client(self, endpoint):
+        with self._lock:
+            c = self._clients.get(endpoint)
+            if c is None:
+                c = Client(endpoint, auth_key=self._auth_key,
+                           timeout=self.probe_timeout_s,
+                           connect_retries=1)
+                self._clients[endpoint] = c
+            return c
+
+    def probe_once(self, rep):
+        """One health probe against ``rep``; updates its telemetry and
+        walks the evict/readmit state machine. Returns True when the
+        replica answered."""
+        with self._lock:
+            probe_lock = self._probe_locks.setdefault(
+                rep.endpoint, threading.Lock())
+        try:
+            # chaos point INSIDE the failure accounting: an injected
+            # probe fault must walk the same evict path a real one does
+            maybe_fail("fleet.probe")
+            with probe_lock:
+                h = self._client(rep.endpoint).health(
+                    timeout=self.probe_timeout_s)
+        except Exception as exc:  # noqa: BLE001 — every failure counts
+            _PROBE_FAILS.inc(labels=(self.name,))
+            evict = False
+            with self._lock:
+                rep.probe_failures += 1
+                if rep.probe_failures >= self.evict_after \
+                        and rep.state in ("healthy", "unknown"):
+                    rep.state = "evicted"
+                    rep.evictions += 1
+                    evict = True
+                client = self._clients.pop(rep.endpoint, None) \
+                    if evict else None
+            if client is not None:
+                client.close()
+            if evict:
+                _EVICTIONS.inc(labels=(self.name,))
+                _flightrec().record(
+                    "replica_evicted", router=self.name,
+                    endpoint=rep.endpoint,
+                    probe_failures=rep.probe_failures,
+                    reason=f"{type(exc).__name__}: {exc}"[:200])
+                self._update_gauge()
+            return False
+        readmitted = False
+        with self._lock:
+            rep.probe_failures = 0
+            rep.last_health = h
+            rep.last_probe = time.monotonic()
+            if rep.state in ("evicted", "unknown"):
+                readmitted = rep.state == "evicted"
+                rep.state = "healthy"
+        if readmitted:
+            rep.readmissions += 1
+            _READMISSIONS.inc(labels=(self.name,))
+            _flightrec().record("replica_readmitted", router=self.name,
+                                endpoint=rep.endpoint)
+        self._update_gauge()
+        return True
+
+    def _update_gauge(self):
+        _HEALTHY.set(self.healthy_count(), labels=(self.name,))
+
+    # -- probe loop -------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="router-prober")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=2):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+    def _run(self):
+        while not self._stop.wait(self.probe_interval_s):
+            for rep in self.all():
+                if self._stop.is_set():
+                    return
+                if rep.state == "draining":
+                    continue       # rolling reload owns this replica
+                try:
+                    self.probe_once(rep)
+                except Exception:  # noqa: BLE001 — the prober never dies
+                    pass

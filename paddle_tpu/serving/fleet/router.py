@@ -1,0 +1,855 @@
+"""Router tier: one endpoint fronting N ``InferenceServer`` replicas.
+
+The router speaks the SAME length-prefixed wire protocol as the
+replicas (an existing ``serving.Client`` pointed at a router cannot
+tell the difference), and composes the machinery the serving layer
+already has into a fleet:
+
+- **Telemetry-driven dispatch**: every ``generate`` goes to the
+  least-loaded in-rotation replica — router-tracked in-flight
+  dispatches plus the probed queue depths and ``kvpool_occupancy``
+  (see ``registry.Replica.load_score``). Probing, eviction and
+  readmission live in :class:`~.registry.ReplicaRegistry`.
+- **Failover**: a replica dying mid-request (transport failure) is
+  evicted immediately and the request retries on the next replica with
+  the SAME request id — at most ``FLAGS_router_dispatch_retries``
+  extra attempts, every hop flight-recorded. Typed error replies are
+  the answer, not a failure: they pass through (Overloaded/Shutdown
+  retry on another replica first — backpressure from one replica is
+  not backpressure from the fleet).
+- **Hedging** (``FLAGS_router_hedge_ms`` > 0): a routed generate that
+  hasn't replied within the delay fires a twin on a SECOND replica;
+  the first ok reply wins and the loser is cancelled by request id on
+  its replica (Dean & Barroso — the cross-replica version of the
+  client-side hedge PR 6 shipped).
+- **Request-id dedup**: the router keeps its own rid table — a
+  reconnect-replayed ``generate`` ATTACHES to the in-flight dispatch
+  instead of dispatching twice, so a failover never double-executes.
+- **Disaggregated prefill/decode**: when the fleet has dedicated
+  ``prefill`` and ``decode`` replicas, a generate becomes two hops —
+  ``prefill`` on a compute-bound replica serializes the finished
+  slot's KV blocks (int8 scales included) out of its pool, and the
+  router streams them into a bandwidth-bound decode replica's pool via
+  ``generate``'s ``kv=`` field. Each pool scales on its own roofline;
+  every migration is counted (``fleet_kv_*``) and flight-recorded.
+- **Rolling weight reloads**: :meth:`Router.rolling_reload` drains and
+  reloads ONE replica at a time through the PR-6 ``reload_weights``
+  machinery — the fleet never loses more than one replica of capacity.
+"""
+import socket
+import threading
+import time
+import uuid
+from collections import OrderedDict
+
+import numpy as np
+
+from ...distributed.wire import (WireError, default_key, recv_frame,
+                                 send_frame)
+from ...flags import flag
+from ...observability import tracing as _trace
+from ...observability.metrics import default_registry, render_metrics
+from ...observability.recorder import flight_recorder as _flightrec
+from ...resilience import maybe_fail
+from ..batching import ServerOverloadedError
+from ..kvpool import KVBlockPool
+from ..server import _ETYPES, _error_reply
+from .registry import ReplicaRegistry
+
+_DISPATCH = default_registry().counter(
+    "router_dispatch_total",
+    "downstream requests dispatched to replicas, by hop role",
+    labels=("router", "role"), max_series=16)
+_FAILOVERS = default_registry().counter(
+    "router_failovers_total",
+    "dispatches retried on another replica after a transport death",
+    labels=("router",), max_series=8)
+_HEDGES = default_registry().counter(
+    "router_hedges_total",
+    "cross-replica hedge twins fired by the router",
+    labels=("router",), max_series=8)
+_DEDUP_HITS = default_registry().counter(
+    "router_dedup_hits_total",
+    "routed requests that attached to an in-flight dispatch by rid",
+    labels=("router",), max_series=8)
+_KV_MIGRATIONS = default_registry().counter(
+    "fleet_kv_migrations_total",
+    "prefill->decode KV-block migrations routed across replicas",
+    labels=("router",), max_series=8)
+_KV_MIG_BYTES = default_registry().counter(
+    "fleet_kv_migrated_bytes_total",
+    "payload array bytes streamed prefill->decode across replicas",
+    labels=("router",), max_series=8)
+
+_COUNTERS = ("dispatches", "failovers", "hedges", "hedge_wins",
+             "dedup_hits", "kv_migrations", "kv_migrated_bytes",
+             "rolling_reloads", "no_replica_refusals")
+
+# flight-recorder event kinds the fleet emits (Router.stats surfaces
+# their in-ring counts; the debug_dump wire op returns the events)
+FLEET_EVENT_KINDS = ("replica_death", "replica_evicted",
+                     "replica_readmitted", "failover", "kv_migration",
+                     "rolling_reload")
+
+
+class _InflightCall:
+    """Router-side dedup entry: the twin of a hedged/replayed routed
+    request waits on the first dispatch's reply instead of dispatching
+    again. ``targets`` (the endpoints this rid was sent to) is written
+    by dispatch threads and read by hedge/cancel bookkeeping — always
+    through the locked accessors."""
+
+    __slots__ = ("reply", "_targets", "_tlock", "_done")
+
+    def __init__(self):
+        self.reply = None
+        self._targets = set()       # endpoints this rid was sent to
+        self._tlock = threading.Lock()
+        self._done = threading.Event()
+
+    def add_target(self, endpoint):
+        with self._tlock:
+            self._targets.add(endpoint)
+
+    def targets(self):
+        with self._tlock:
+            return set(self._targets)
+
+    def finish(self, reply):
+        self.reply = reply
+        self._done.set()
+
+    def wait(self, timeout):
+        if not self._done.wait(timeout):
+            return {"ok": False, "etype": "DeadlineExceeded",
+                    "error": "joined an in-flight routed request that "
+                             "did not finish in time"}
+        return self.reply
+
+
+class Router:
+    """Fleet front-end. In-process use::
+
+        router = fleet.Router([srv1.endpoint, srv2.endpoint]).start()
+        out = router.generate(prompt_ids, max_new_tokens=32)
+
+    Network use: ``Client(router.endpoint)`` speaks the ordinary wire
+    protocol (``generate``/``health``/``stats``/``metrics``/
+    ``debug_dump``/``cancel``), plus ``{"op": "register", "endpoint",
+    "role"}`` for membership and ``{"op": "reload_weights", "path"}``
+    for a fleet-wide rolling reload. ``replicas`` entries are endpoints
+    or ``(endpoint, role)`` pairs with role in ``both``/``prefill``/
+    ``decode``."""
+
+    def __init__(self, replicas=(), *, name="router", host="127.0.0.1",
+                 port=0, auth_key=None, allow_insecure=False,
+                 probe_interval_s=None, probe_timeout_s=None,
+                 evict_after=None, hedge_ms=None,
+                 dispatch_retries=None):
+        self.name = str(name)
+        self.host = host
+        self.port = int(port)
+        self._key = auth_key if auth_key is not None else default_key()
+        self._allow_insecure = allow_insecure
+        self.registry = ReplicaRegistry(
+            name=self.name, auth_key=auth_key,
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s, evict_after=evict_after)
+        self._hedge_ms = float(hedge_ms if hedge_ms is not None
+                               else flag("router_hedge_ms"))
+        self._dispatch_retries = int(
+            dispatch_retries if dispatch_retries is not None
+            else flag("router_dispatch_retries"))
+        for entry in replicas:
+            if isinstance(entry, (tuple, list)):
+                self.add_replica(*entry)
+            else:
+                self.add_replica(entry)
+        self._sock = None
+        self._stop = threading.Event()
+        self._threads = []
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._lifecycle = "created"
+        self._started_at = time.monotonic()
+        # downstream socket pool: per-endpoint free list (each routed
+        # exchange is serial on its socket; concurrent handler threads
+        # check out their own)
+        self._pool = {}
+        self._pool_lock = threading.Lock()
+        # router-side rid dedup (failover/replay never double-executes)
+        self._rids = OrderedDict()
+        self._rids_lock = threading.Lock()
+        self._rid_cap = 2048
+        self._c = {k: 0 for k in _COUNTERS}
+        self._c_lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    @property
+    def state(self):
+        with self._state_lock:
+            return self._lifecycle
+
+    @property
+    def disaggregated(self):
+        """True when the fleet has BOTH dedicated prefill and dedicated
+        decode replicas — generate then runs as two hops with a KV
+        migration between them."""
+        return (self.registry.has_role("prefill")
+                and self.registry.has_role("decode"))
+
+    def add_replica(self, endpoint, role="both"):
+        """Register (and immediately probe) a replica."""
+        return self.registry.add(endpoint, role=role)
+
+    def remove_replica(self, endpoint):
+        self._drop_pool(endpoint)
+        return self.registry.remove(endpoint)
+
+    def start(self, serve_network=True):
+        self.registry.start()
+        if serve_network:
+            loopback = (self.host.startswith("127.")
+                        or self.host in ("localhost", "::1"))
+            if not loopback and self._key is None \
+                    and not self._allow_insecure:
+                raise PermissionError(
+                    f"refusing to bind the router on non-loopback "
+                    f"{self.host}:{self.port} without authentication — "
+                    f"set PADDLE_PS_AUTH_KEY (both ends) or pass "
+                    f"allow_insecure=True")
+            self._sock = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+            self._sock.bind((self.host, self.port))
+            self.port = self._sock.getsockname()[1]
+            self._sock.listen(128)
+            t = threading.Thread(target=self._accept_loop, daemon=True,
+                                 name="router-accept")
+            t.start()
+            self._threads.append(t)
+        with self._state_lock:
+            self._lifecycle = "serving"
+        return self
+
+    def stop(self):
+        with self._state_lock:
+            self._lifecycle = "stopped"
+        self._stop.set()
+        self.registry.stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        with self._pool_lock:
+            pool, self._pool = self._pool, {}
+        for socks in pool.values():
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- bookkeeping ------------------------------------------------------
+    def _bump(self, name, n=1):
+        with self._c_lock:
+            self._c[name] += n
+
+    def stats(self):
+        """Fleet snapshot: router counters, per-replica telemetry (the
+        probed load signals the dispatcher reads), the rid-table size
+        and the in-ring counts of the fleet's flight-recorder events
+        (deaths, failovers, evictions/readmissions, KV migrations,
+        rolling reloads)."""
+        with self._c_lock:
+            c = dict(self._c)
+        rec_counts = _flightrec().counts()
+        out = {
+            "state": self.state,
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "disaggregated": self.disaggregated,
+            "replicas": self.registry.snapshot(),
+            "replicas_healthy": self.registry.healthy_count(),
+            "rid_table": len(self._rids),
+            "fleet_events": {k: rec_counts.get(k, 0)
+                             for k in FLEET_EVENT_KINDS},
+        }
+        out.update({f"router_{k}": v for k, v in c.items()})
+        return out
+
+    def health(self):
+        return {
+            "state": self.state,
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "replicas_total": len(self.registry.all()),
+            "replicas_healthy": self.registry.healthy_count(),
+            "disaggregated": self.disaggregated,
+        }
+
+    # -- downstream socket pool -------------------------------------------
+    def _checkout(self, endpoint, timeout):
+        """-> (socket, pooled): ``pooled`` means the socket sat idle in
+        the free list and may be stale (the replica bounced since)."""
+        with self._pool_lock:
+            socks = self._pool.get(endpoint)
+            if socks:
+                return socks.pop(), True
+        host, port = endpoint.rsplit(":", 1)
+        return socket.create_connection(
+            (host, int(port)),
+            timeout=min(timeout, self.registry.probe_timeout_s)
+            if timeout else self.registry.probe_timeout_s), False
+
+    def _checkin(self, endpoint, sock):
+        with self._pool_lock:
+            if self._stop.is_set():
+                pass            # closing below, don't re-pool
+            else:
+                self._pool.setdefault(endpoint, []).append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _drop_pool(self, endpoint):
+        with self._pool_lock:
+            socks = self._pool.pop(endpoint, [])
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _exchange(self, endpoint, msg, timeout):
+        """One serial request/reply against a replica. Any failure
+        poisons the socket (never re-pooled — a half-done exchange
+        could pair the next request with a stale reply). A transport
+        failure on a POOLED socket retries ONCE on a fresh connection
+        — an idle pooled socket to a replica that bounced in between
+        is stale, not dead, and must not read as a replica death
+        (generate carries a rid the replica dedups; probe/control ops
+        are idempotent). An explicit timeout never retries: the reply
+        not arriving IS the answer."""
+        for attempt in (0, 1):
+            sock, pooled = self._checkout(endpoint, timeout)
+            try:
+                send_frame(sock, msg, self._key, timeout=timeout)
+                reply = recv_frame(sock, self._key, timeout=timeout)
+            except socket.timeout:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            except (ConnectionError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if pooled and attempt == 0 and not self._stop.is_set():
+                    continue
+                raise
+            except BaseException:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            self._checkin(endpoint, sock)
+            if not isinstance(reply, dict):
+                raise WireError(
+                    f"malformed replica reply: {type(reply)}")
+            return reply
+        raise AssertionError("unreachable")
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, msg, roles, timeout, entry=None,
+                  role_label="both", exclude=()):
+        """Dispatch ``msg`` to the least-loaded replica of ``roles``;
+        fail over (same rid) on transport death or a typed
+        Overloaded/Shutdown refusal, up to
+        ``FLAGS_router_dispatch_retries`` extra replicas. Returns
+        ``(reply, endpoint)`` — ``reply`` is the replica's wire dict
+        (or a typed error reply when the rotation is exhausted)."""
+        tried = set(exclude)
+        last_refusal = None
+        for attempt in range(self._dispatch_retries + 1):
+            rep = self.registry.pick(roles, exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.endpoint)
+            if entry is not None:
+                entry.add_target(rep.endpoint)
+            maybe_fail("fleet.dispatch")
+            _DISPATCH.inc(labels=(self.name, role_label))
+            self._bump("dispatches")
+            self.registry.checkout(rep)
+            try:
+                reply = self._exchange(rep.endpoint, msg, timeout)
+            except (ConnectionError, OSError) as exc:
+                # the rest of the free list to this endpoint is as
+                # suspect as the socket that just died
+                self._drop_pool(rep.endpoint)
+                self.registry.mark_dead(
+                    rep.endpoint,
+                    f"dispatch transport failure: "
+                    f"{type(exc).__name__}: {exc}")
+                _FAILOVERS.inc(labels=(self.name,))
+                self._bump("failovers")
+                _flightrec().record(
+                    "failover", router=self.name, rid=msg.get("rid"),
+                    from_endpoint=rep.endpoint, attempt=attempt,
+                    error=f"{type(exc).__name__}: {exc}"[:200])
+                continue
+            finally:
+                self.registry.checkin(rep)
+            if not reply.get("ok") \
+                    and reply.get("etype") in ("Overloaded", "Shutdown"):
+                # backpressure from ONE replica is not backpressure
+                # from the fleet: remember the refusal, try the next
+                last_refusal = reply
+                continue
+            return reply, rep.endpoint
+        if last_refusal is not None:
+            return last_refusal, None
+        self._bump("no_replica_refusals")
+        return _error_reply(ServerOverloadedError(
+            f"router {self.name!r}: no healthy "
+            f"{'/'.join(sorted(roles))} replica in rotation "
+            f"({len(self.registry.all())} registered) — back off and "
+            f"retry")), None
+
+    def _dispatch_hedged(self, msg, roles, timeout, entry,
+                         role_label="both"):
+        """Race the primary dispatch against a delayed twin on ANOTHER
+        replica (``FLAGS_router_hedge_ms``; 0 = plain dispatch). First
+        ok reply wins; the loser is cancelled by rid on every other
+        target."""
+        delay_s = self._hedge_ms / 1e3
+        if delay_s <= 0:
+            return self._dispatch(msg, roles, timeout, entry=entry,
+                                  role_label=role_label)
+        # "ok" holds the first ok reply (the winner); "last" the most
+        # recent non-ok one, so a leg that comes back with a typed
+        # refusal BEFORE the hedge delay still yields a reply instead
+        # of stranding the caller
+        state = {"ok": None, "last": None, "done": 0}
+        cv = threading.Condition()
+
+        def attempt(tag, exclude):
+            try:
+                r, ep = self._dispatch(msg, roles, timeout,
+                                       entry=entry,
+                                       role_label=role_label,
+                                       exclude=exclude)
+            except Exception as exc:  # noqa: BLE001 — the leg MUST
+                # report in: a dying thread that never bumps "done"
+                # (WireError, injected fault, ...) would strand the
+                # handler in the final wait_for forever
+                r, ep = _error_reply(exc), None
+            with cv:
+                state["done"] += 1
+                if r.get("ok") and state["ok"] is None:
+                    state["ok"] = ((r, ep), tag)
+                else:
+                    state["last"] = ((r, ep), tag)
+                cv.notify_all()
+
+        primary_eps = set()
+        t = threading.Thread(target=attempt, args=("primary", ()),
+                             daemon=True, name="router-primary")
+        t.start()
+        with cv:
+            cv.wait_for(lambda: state["done"] >= 1, timeout=delay_s)
+            fire = state["done"] < 1
+            primary_eps = entry.targets()
+        launched = 1
+        if fire:
+            _HEDGES.inc(labels=(self.name,))
+            self._bump("hedges")
+            threading.Thread(target=attempt,
+                             args=("hedge", primary_eps),
+                             daemon=True, name="router-hedge").start()
+            launched = 2
+        with cv:
+            cv.wait_for(lambda: state["ok"] is not None
+                        or state["done"] >= launched)
+            (reply, ep), who = (state["ok"] if state["ok"] is not None
+                                else state["last"])
+        if launched == 2:
+            if who == "hedge" and reply.get("ok"):
+                self._bump("hedge_wins")
+            # cancel the loser wherever else the rid landed —
+            # fire-and-forget on a background thread: a hung loser must
+            # not delay the winning reply that is already in hand
+            losers = entry.targets() - ({ep} if ep else set())
+            if losers:
+                threading.Thread(
+                    target=self._cancel_losers,
+                    args=(losers, msg.get("rid")),
+                    daemon=True, name="router-hedge-cancel").start()
+        return reply, ep
+
+    def _cancel_losers(self, losers, rid):
+        for loser in losers:
+            try:
+                self._exchange(loser, {"op": "cancel", "rid": rid},
+                               self.registry.probe_timeout_s)
+            except Exception:  # noqa: BLE001 — best-effort cancel
+                pass
+
+    # -- rid dedup --------------------------------------------------------
+    def _dedup_entry(self, rid):
+        """Returns ``(entry, joined)`` — ``joined`` means another
+        handler thread already owns the dispatch for this rid and the
+        caller should wait on the entry instead of dispatching."""
+        if not rid:
+            return _InflightCall(), False
+        with self._rids_lock:
+            ent = self._rids.get(rid)
+            if ent is not None:
+                self._rids.move_to_end(rid)
+                return ent, True
+            ent = _InflightCall()
+            self._rids[rid] = ent
+            while len(self._rids) > self._rid_cap:
+                self._rids.popitem(last=False)
+            return ent, False
+
+    # -- routed generate --------------------------------------------------
+    def _route_generate(self, msg):
+        rid = msg.get("rid")
+        entry, joined = self._dedup_entry(rid)
+        if joined:
+            _DEDUP_HITS.inc(labels=(self.name,))
+            self._bump("dedup_hits")
+            budget = msg.get("deadline_ms")
+            return entry.wait((budget / 1e3 + 120.0) if budget
+                              else 600.0)
+        try:
+            reply = self._route_generate_inner(msg, entry)
+        except Exception as exc:  # noqa: BLE001 — typed reply, not death
+            reply = _error_reply(exc)
+        entry.finish(reply)
+        return reply
+
+    def _route_generate_inner(self, msg, entry):
+        tokens = msg.get("tokens")
+        if tokens is None:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "'tokens' (1-D int prompt) is required"}
+        budget = msg.get("deadline_ms")
+        hop_timeout = (budget / 1e3 + 120.0) if budget else 600.0
+        parent = _trace.from_wire(msg.get("trace"))
+        with _trace.span("router/generate", parent=parent) as ctx:
+            downstream_trace = _trace.to_wire(ctx)
+            if not self.disaggregated:
+                fwd = dict(msg)
+                if downstream_trace is not None:
+                    fwd["trace"] = downstream_trace
+                reply, _ep = self._dispatch_hedged(
+                    fwd, ("both",), hop_timeout, entry,
+                    role_label="both")
+                return reply
+            return self._route_disaggregated(msg, entry, hop_timeout,
+                                             downstream_trace)
+
+    def _route_disaggregated(self, msg, entry, hop_timeout, trace):
+        """Two-hop generate: prefill on a compute-bound replica, KV
+        blocks streamed into a bandwidth-bound decode replica."""
+        rid = msg.get("rid") or uuid.uuid4().hex
+        pmsg = {
+            "op": "prefill",
+            "tokens": msg["tokens"],
+            "max_new_tokens": int(msg.get("max_new_tokens", 32)),
+            "temperature": float(msg.get("temperature", 0.0)),
+            "top_k": int(msg.get("top_k", 0)),
+            "deadline_ms": msg.get("deadline_ms"),
+            "rid": f"{rid}-prefill",
+        }
+        if trace is not None:
+            pmsg["trace"] = trace
+        reply, src = self._dispatch_hedged(pmsg, ("prefill", "both"),
+                                           hop_timeout, entry,
+                                           role_label="prefill")
+        if not reply.get("ok"):
+            return reply
+        kv = reply["kv"]
+        first = int(kv["first_token"])
+        nbytes = KVBlockPool.payload_bytes(kv)
+        # the prefill alone may already answer the request: its sampled
+        # token hit EOS, or the budget was one token — no migration
+        eos = msg.get("eos_id")
+        if eos is not None and first == int(eos):
+            return {"ok": True, "tokens": np.asarray([], np.int32),
+                    "generated": 0}
+        if int(msg.get("max_new_tokens", 32)) <= 1:
+            return {"ok": True, "tokens": np.asarray([first], np.int32),
+                    "generated": 1}
+        dmsg = {
+            "op": "generate",
+            "tokens": msg["tokens"],
+            "max_new_tokens": int(msg.get("max_new_tokens", 32)),
+            "temperature": float(msg.get("temperature", 0.0)),
+            "top_k": int(msg.get("top_k", 0)),
+            "eos_id": msg.get("eos_id"),
+            "deadline_ms": msg.get("deadline_ms"),
+            "kv": kv,
+            "first_token": first,
+            "rid": rid,
+        }
+        if trace is not None:
+            dmsg["trace"] = trace
+        reply2, dst = self._dispatch_hedged(dmsg, ("decode", "both"),
+                                            hop_timeout, entry,
+                                            role_label="decode")
+        _KV_MIGRATIONS.inc(labels=(self.name,))
+        _KV_MIG_BYTES.inc(nbytes, labels=(self.name,))
+        self._bump("kv_migrations")
+        self._bump("kv_migrated_bytes", nbytes)
+        _flightrec().record(
+            "kv_migration", router=self.name, rid=rid,
+            from_endpoint=src, to_endpoint=dst,
+            blocks=int(kv.get("nblocks", 0)), bytes=nbytes,
+            ok=bool(reply2.get("ok")))
+        return reply2
+
+    # -- rolling weight reload --------------------------------------------
+    def rolling_reload(self, path, drain_timeout=30.0,
+                       reload_timeout=120.0):
+        """Drain-aware rolling weight reload: ONE replica at a time
+        leaves the dispatch rotation (``draining``), the router waits
+        for its in-flight dispatches to hit zero (the replica-side
+        ``reload_weights`` additionally lets in-flight generations
+        finish on the old weights), reloads it over the wire, then
+        returns it to rotation. The fleet never loses more than one
+        replica of capacity. Returns
+        ``{endpoint: {"ok", "weights_version"| "error"}}``."""
+        out = {}
+        for rep in self.registry.all():
+            ep = rep.endpoint
+            prev_state = rep.state
+            self.registry.set_state(ep, "draining")
+            _flightrec().record("rolling_reload", router=self.name,
+                                endpoint=ep, phase="drain")
+            deadline = time.monotonic() + float(drain_timeout)
+            while time.monotonic() < deadline and rep.inflight > 0:
+                time.sleep(0.01)
+            try:
+                reply = self._exchange(
+                    ep, {"op": "reload_weights", "path": str(path),
+                         "timeout": float(reload_timeout)},
+                    float(reload_timeout) + 10.0)
+            except Exception as exc:  # noqa: BLE001 — per-replica fate
+                reply = _error_reply(exc)
+            if reply.get("ok"):
+                out[ep] = {"ok": True,
+                           "weights_version": reply["weights_version"]}
+                self._bump("rolling_reloads")
+                self.registry.set_state(ep, "healthy")
+                self.registry.probe_once(rep)    # refresh telemetry
+                _flightrec().record(
+                    "rolling_reload", router=self.name, endpoint=ep,
+                    phase="done",
+                    weights_version=reply["weights_version"])
+            else:
+                out[ep] = {"ok": False, "error": reply.get("error"),
+                           "etype": reply.get("etype")}
+                # a replica that failed its reload is NOT readmitted
+                # with ambiguous weights — evict it; the prober
+                # readmits once it answers health probes again (an
+                # operator bounce or a successful retry)
+                self.registry.set_state(ep, prev_state)
+                self.registry.mark_dead(
+                    ep, f"rolling reload failed: {reply.get('error')}")
+                _flightrec().record("rolling_reload", router=self.name,
+                                    endpoint=ep, phase="failed",
+                                    error=str(reply.get("error"))[:200])
+        return out
+
+    # -- in-process convenience (tests / bench) ---------------------------
+    def generate(self, tokens, max_new_tokens=32, temperature=0.0,
+                 top_k=0, eos_id=None, deadline_ms=None):
+        """Routed generation without a socket in between: same dispatch
+        path the wire op takes; raises the typed serving errors."""
+        msg = {
+            "op": "generate",
+            "tokens": np.asarray(tokens, np.int32).ravel(),
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "deadline_ms": deadline_ms,
+            "rid": uuid.uuid4().hex,
+        }
+        ctx = _trace.maybe_trace()
+        if ctx is not None:
+            msg["trace"] = _trace.to_wire(ctx)
+        reply = self._route_generate(msg)
+        if not reply.get("ok"):
+            from ..batching import InternalServerError
+            raise _ETYPES.get(reply.get("etype"),
+                              InternalServerError)(
+                reply.get("error", "routed generate failed"))
+        return np.asarray(reply["tokens"], np.int32)
+
+    # -- wire front-end ---------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="router-conn")
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_frame(conn, self._key)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                except WireError:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # noqa: BLE001 — typed reply
+                    reply = _error_reply(e)
+                try:
+                    send_frame(conn, reply, self._key)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg):
+        if not isinstance(msg, dict) or "op" not in msg:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "expected a dict with an 'op' field"}
+        op = msg["op"]
+        if op == "ping":
+            return {"ok": True}
+        if op in ("stats", "metrics", "health", "cancel"):
+            with _trace.span(f"router/{op}",
+                             parent=_trace.from_wire(msg.get("trace"))):
+                if op == "stats":
+                    return {"ok": True, "stats": self.stats()}
+                if op == "metrics":
+                    return {"ok": True, "metrics": render_metrics()}
+                if op == "health":
+                    return {"ok": True, "health": self.health()}
+                return self._handle_cancel(msg)
+        if op == "debug_dump":
+            rec = _flightrec()
+            path = None
+            if msg.get("write"):
+                try:
+                    path = rec.dump(reason="router debug_dump wire op")
+                except OSError as e:
+                    return _error_reply(e)
+            return {"ok": True, "events": rec.snapshot(), "path": path}
+        if op == "register":
+            return self._handle_register(msg)
+        if op == "reload_weights":
+            path = msg.get("path")
+            if not isinstance(path, str) or not path:
+                return {"ok": False, "etype": "BadRequest",
+                        "error": "'path' (checkpoint dir) is required"}
+            return {"ok": True,
+                    "replicas": self.rolling_reload(
+                        path,
+                        reload_timeout=float(msg.get("timeout",
+                                                     120.0)))}
+        if op == "generate":
+            if self.state != "serving":
+                return {"ok": False, "etype": "Shutdown",
+                        "error": "router is stopped"}
+            return self._route_generate(msg)
+        return {"ok": False, "etype": "BadRequest",
+                "error": f"router does not serve op {msg['op']!r} — "
+                         f"it routes 'generate' (plus register/"
+                         f"reload_weights/health/stats/metrics/"
+                         f"debug_dump/cancel/ping)"}
+
+    def _handle_register(self, msg):
+        endpoint = msg.get("endpoint")
+        if not isinstance(endpoint, str) or ":" not in endpoint:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "'endpoint' (host:port) is required"}
+        try:
+            if msg.get("remove"):
+                removed = self.remove_replica(endpoint)
+                return {"ok": True, "removed": removed,
+                        "replicas": len(self.registry.all())}
+            rep = self.add_replica(endpoint,
+                                   role=msg.get("role", "both"))
+            return {"ok": True, "state": rep.state,
+                    "replicas": len(self.registry.all())}
+        except ValueError as e:
+            return {"ok": False, "etype": "BadRequest", "error": str(e)}
+
+    def _handle_cancel(self, msg):
+        """Forward a cancel to every replica the rid was dispatched
+        to."""
+        rid = msg.get("rid")
+        targets = set()
+        if rid:
+            with self._rids_lock:
+                ent = self._rids.get(rid)
+            if ent is not None:
+                targets = ent.targets()
+        cancelled = False
+        for ep in targets:
+            # the disaggregated prefill hop was dispatched under
+            # rid + "-prefill" (_route_disaggregated) — try both ids
+            # so a cancel can reach a request mid-prefill too
+            for hop_rid in (rid, f"{rid}-prefill"):
+                try:
+                    r = self._exchange(
+                        ep, {"op": "cancel", "rid": hop_rid},
+                        self.registry.probe_timeout_s)
+                    cancelled = cancelled or bool(r.get("cancelled"))
+                except Exception:  # noqa: BLE001 — best-effort fan-out
+                    pass
+        return {"ok": True, "cancelled": cancelled}

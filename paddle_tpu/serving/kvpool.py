@@ -38,7 +38,7 @@ from ..flags import flag
 from ..observability.metrics import default_registry
 from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import maybe_fail
-from .batching import BadRequestError, ServerOverloadedError
+from .batching import BadRequestError, ServerOverloadedError, next_bucket
 
 # -- typed backpressure ----------------------------------------------------
 
@@ -63,40 +63,52 @@ class KVPoolExhaustedError(ServerOverloadedError):
 _BLOCKS_IN_USE = default_registry().gauge(
     "kvpool_blocks_in_use_count",
     "KV-pool blocks currently allocated to live slots",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _CAPACITY = default_registry().gauge(
     "kvpool_capacity_blocks_count",
     "KV-pool allocatable block capacity (trash block excluded)",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _OCCUPANCY = default_registry().gauge(
     "kvpool_occupancy_ratio",
     "allocated / allocatable KV-pool blocks",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _SAVED = default_registry().gauge(
     "kvpool_saved_vs_dense_bytes",
     "device bytes a dense [slots, H, max_len, D] fp32 bank would hold "
     "minus the pool bytes actually allocated",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _ALLOC_FAIL = default_registry().counter(
     "kvpool_alloc_failures_total",
     "block allocations refused with KVPoolExhaustedError",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _ALLOCATED = default_registry().counter(
     "kvpool_blocks_allocated_total",
     "KV-pool blocks handed out by the free-list allocator",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _FREED = default_registry().counter(
     "kvpool_blocks_freed_total",
     "KV-pool blocks returned to the free list",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
 _LEAKED = default_registry().counter(
     "kvpool_leaked_blocks_total",
     "blocks found still held by finished slots and reclaimed by the "
     "leak sweep",
-    labels=("pool",), max_series=8)
+    labels=("pool",), max_series=64)
+_EXPORTED = default_registry().counter(
+    "kvpool_blocks_exported_total",
+    "KV blocks serialized out of the pool for cross-replica migration",
+    labels=("pool",), max_series=64)
+_IMPORTED = default_registry().counter(
+    "kvpool_blocks_imported_total",
+    "migrated KV blocks deserialized into the pool",
+    labels=("pool",), max_series=64)
 
 _DTYPES = ("fp32", "bf16", "int8")
 _ELEM_BYTES = {"fp32": 4, "bf16": 2, "int8": 1}
+
+# migration payload format tag (bump on any layout change: an importer
+# must never guess at a frame written by a different code revision)
+KV_WIRE_FMT = "kvblocks1"
 
 
 def _np_pool_dtype(kv_dtype):
@@ -195,6 +207,7 @@ class KVBlockPool:
                                np.int32)
         self._arrays = None            # lazy device pool
         self._scatter_fn = None
+        self._import_fn = None         # migration scatter (import_slot)
         self._update_gauges()
 
     # -- sizing helpers ---------------------------------------------------
@@ -472,6 +485,180 @@ class KVBlockPool:
         except Exception:
             self._arrays = None
             raise
+
+    # -- cross-replica block migration ------------------------------------
+    # A finished prefill's KV state is a well-defined unit: the slot's
+    # allocated blocks (in table order) plus the geometry needed to
+    # validate them on the far side. export_slot/import_slot are the two
+    # halves of the disaggregated prefill/decode split: a compute-bound
+    # prefill replica serializes the finished slot out of its pool and a
+    # bandwidth-bound decode replica streams it into its own. Payloads
+    # stay inside the typed wire universe (bf16 travels as its uint16
+    # bit pattern — numpy's bfloat16 is a void-kind dtype the wire
+    # refuses; the bitcast round-trips exactly).
+
+    def export_slot(self, slot):
+        """Serialize ``slot``'s allocated blocks into a wire-safe dict:
+        geometry fields + per-layer ``k_i``/``v_i`` arrays of shape
+        ``[nblocks, H, block_size, D]`` (plus ``ks_i``/``vs_i`` float32
+        scales for an int8 pool). Raises ``ValueError`` when the slot
+        holds nothing. Single-driver like alloc/free — the decode loop
+        is the only caller."""
+        maybe_fail("serving.kv_export")
+        slot = int(slot)
+        with self._lock:
+            n = int(self._slot_nblocks.get(slot, 0))
+            tokens = int(self._slot_tokens.get(slot, 0))
+            ids = self.tables[slot, :n].copy()
+        if n == 0:
+            raise ValueError(
+                f"KV pool {self.name!r} slot {slot} holds no blocks — "
+                f"nothing to export")
+        import jax.numpy as jnp
+        arrs = self.arrays()
+        idx = jnp.asarray(ids, jnp.int32)
+        payload = {
+            "fmt": KV_WIRE_FMT, "pool_dtype": self.dtype,
+            "block_size": self.block_size, "num_layers": self.num_layers,
+            "num_heads": self.num_heads, "d_head": self.d_head,
+            "tokens": tokens, "nblocks": n,
+        }
+        for i in range(self.num_layers):
+            for kind in ("k", "v"):
+                a = np.asarray(arrs[f"cache_p{kind}_{i}"][idx])
+                if self.dtype == "bf16":
+                    a = a.view(np.uint16)
+                payload[f"{kind}_{i}"] = a
+                if self.quantized:
+                    payload[f"{kind}s_{i}"] = np.asarray(
+                        arrs[f"cache_p{kind}s_{i}"][idx])
+        _EXPORTED.inc(n, labels=(self.name,))
+        return payload
+
+    @staticmethod
+    def payload_bytes(payload):
+        """Total array bytes a migration payload carries (the wire-cost
+        number the router's fleet_kv_migrated_bytes_total counts)."""
+        return int(sum(a.nbytes for a in payload.values()
+                       if isinstance(a, np.ndarray)))
+
+    def import_slot(self, slot, payload):
+        """Deserialize a migrated payload into ``slot``: validates the
+        geometry against this pool (mismatch -> typed
+        :class:`~.batching.BadRequestError` — retrying cannot help),
+        allocates the blocks (typed :class:`KVPoolExhaustedError`
+        backpressure with nothing changed), then scatters the arrays
+        through the fresh table entries in one donated jitted call. On a
+        scatter failure the blocks are returned and the device arrays
+        presumed lost (the caller's bank-lost path applies)."""
+        maybe_fail("serving.kv_import")
+        slot = int(slot)
+        geom = self._validate_payload(payload)
+        tokens, n = geom["tokens"], geom["nblocks"]
+        self.alloc(slot, tokens)        # typed exhaustion, nothing held
+        # the scatter's operand shapes are [nblocks, ...]: pad the
+        # block count up to a power of two (the prefill bucketing
+        # policy) so the jitted import compiles per BUCKET, not per
+        # distinct prompt length — padded rows scatter into the trash
+        # block, which nothing ever reads
+        n_pad = next_bucket(n)
+        with self._lock:
+            ids = np.zeros(n_pad, np.int32)        # trash-block padding
+            ids[:n] = self.tables[slot, :n]
+        import jax
+        import jax.numpy as jnp
+        vals = {}
+        try:
+            pool_np = _np_pool_dtype(self.dtype)
+
+            def padded(a):
+                if n_pad == n:
+                    return a
+                return np.concatenate(
+                    [a, np.zeros((n_pad - n,) + a.shape[1:], a.dtype)])
+
+            for i in range(self.num_layers):
+                for kind in ("k", "v"):
+                    a = np.ascontiguousarray(payload[f"{kind}_{i}"])
+                    if self.dtype == "bf16":
+                        a = a.view(pool_np)
+                    vals[f"cache_p{kind}_{i}"] = jnp.asarray(padded(a))
+                    if self.quantized:
+                        vals[f"cache_p{kind}s_{i}"] = jnp.asarray(
+                            padded(np.ascontiguousarray(
+                                payload[f"{kind}s_{i}"],
+                                dtype=np.float32)))
+            if self._import_fn is None:
+                def imp(pool, new_vals, idx):
+                    out = dict(pool)
+                    for name, v in new_vals.items():
+                        out[name] = out[name].at[idx].set(v)
+                    return out
+                self._import_fn = jax.jit(imp, donate_argnums=(0,))
+            self._arrays = self._import_fn(self.arrays(), vals,
+                                           jnp.asarray(ids, jnp.int32))
+        except Exception:
+            # the donated pool arrays must be presumed lost; the blocks
+            # just allocated go straight back
+            self._arrays = None
+            self.free_slot(slot)
+            raise
+        _IMPORTED.inc(n, labels=(self.name,))
+        return n
+
+    def _validate_payload(self, payload):
+        """Geometry/shape checks for a migration payload; returns
+        ``{"tokens", "nblocks"}``. Every refusal is a
+        :class:`~.batching.BadRequestError` (terminal, not retryable)."""
+        if not isinstance(payload, dict) \
+                or payload.get("fmt") != KV_WIRE_FMT:
+            raise BadRequestError(
+                f"KV payload format {payload.get('fmt') if isinstance(payload, dict) else type(payload).__name__!r} "
+                f"is not {KV_WIRE_FMT!r}")
+        for field, mine in (("pool_dtype", self.dtype),
+                            ("block_size", self.block_size),
+                            ("num_layers", self.num_layers),
+                            ("num_heads", self.num_heads),
+                            ("d_head", self.d_head)):
+            got = payload.get(field)
+            if got != mine:
+                raise BadRequestError(
+                    f"KV payload {field}={got!r} does not match the "
+                    f"receiving pool's {mine!r} — prefill and decode "
+                    f"replicas must share the cache geometry")
+        try:
+            tokens = int(payload["tokens"])
+            n = int(payload["nblocks"])
+        except (KeyError, TypeError, ValueError):
+            raise BadRequestError("KV payload lacks integer "
+                                  "tokens/nblocks fields")
+        if tokens < 1 or n != self.blocks_for_tokens(tokens):
+            raise BadRequestError(
+                f"KV payload claims {tokens} tokens in {n} blocks; "
+                f"{self.blocks_for_tokens(tokens)} blocks expected at "
+                f"block_size={self.block_size}")
+        if tokens > self.max_seq_len:
+            raise BadRequestError(
+                f"KV payload holds {tokens} tokens but the receiving "
+                f"pool's rows cap at max_seq_len={self.max_seq_len}")
+        shape = (n, self.num_heads, self.block_size, self.d_head)
+        for i in range(self.num_layers):
+            for kind in ("k", "v"):
+                a = payload.get(f"{kind}_{i}")
+                if not isinstance(a, np.ndarray) \
+                        or tuple(a.shape) != shape:
+                    raise BadRequestError(
+                        f"KV payload array {kind}_{i} is "
+                        f"{getattr(a, 'shape', None)}, expected {shape}")
+                if self.quantized:
+                    s = payload.get(f"{kind}s_{i}")
+                    if not isinstance(s, np.ndarray) \
+                            or tuple(s.shape) != shape[:3]:
+                        raise BadRequestError(
+                            f"int8 KV payload scale array {kind}s_{i} "
+                            f"is {getattr(s, 'shape', None)}, expected "
+                            f"{shape[:3]}")
+        return {"tokens": tokens, "nblocks": n}
 
     # -- reporting --------------------------------------------------------
     def _update_gauges_locked(self):

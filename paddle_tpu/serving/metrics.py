@@ -151,6 +151,9 @@ _COUNTER_KEYS = (
     "decode_steps",
     "decode_rows",        # live generation rows stepped
     "decode_slot_rows",   # slot capacity across steps
+    # -- disaggregated prefill/decode (fleet KV migration) --
+    "kv_exports",         # prefill-only requests serialized out
+    "kv_imports",         # migrated requests admitted from KV blocks
     # -- resilience layer --
     "engine_failures",      # failed execute / decode steps
     "watchdog_timeouts",    # executes killed by the watchdog

@@ -25,8 +25,21 @@ Wire protocol (all values inside the typed wire universe):
                                   (flight-recorder snapshot / dump)
     request  {"op": "ping"}    -> {"ok": True}
     request  {"op": "health"}  -> {"ok": True, "health": {state, queue
-                                   depths, loop liveness, weights_version}}
+                                   depths, loop liveness, weights_version,
+                                   kvpool_occupancy (paged)}}
     request  {"op": "cancel", "rid": str} -> {"ok": True, "cancelled": bool}
+    request  {"op": "prefill", "tokens": ...} -> {"ok": True, "kv": {...}}
+                                  (disaggregated split, prefill half:
+                                   the prompt's KV blocks serialized out
+                                   of the paged pool, first_token and
+                                   prompt_tokens riding inside)
+    request  {"op": "generate", ..., "kv": {...}, "first_token": int}
+                                  (decode half: stream migrated blocks
+                                   into this replica's pool and decode
+                                   from first_token — no prefill runs)
+    request  {"op": "reload_weights", "path": str} -> {"ok": True,
+                                  "weights_version": int,
+                                  "swap_pause_ms": float}
 
 Deadline semantics: ``deadline_ms`` is a budget measured from ADMISSION
 at the server (transit time is the client's problem; clocks never need
@@ -120,7 +133,8 @@ class InferenceServer:
     def __init__(self, model_dir=None, *, engine=None, generator=None,
                  decode_slots=None, config=None,
                  host="127.0.0.1", port=0, auth_key=None,
-                 allow_insecure=False, **config_overrides):
+                 allow_insecure=False, kv_paged=None,
+                 kv_pool_name="serving", **config_overrides):
         self.config = config or ServingConfig(**config_overrides)
         self.stats_sink = ServingStats()
         if engine is None and (model_dir is not None
@@ -150,7 +164,9 @@ class InferenceServer:
         if generator is not None:
             self.gen_engine = GenerationEngine(generator,
                                                slots=decode_slots,
-                                               stats=self.stats_sink)
+                                               stats=self.stats_sink,
+                                               paged=kv_paged,
+                                               pool_name=kv_pool_name)
             self.gen_queue = RequestQueue(
                 max_depth=self.config.queue_depth, stats=self.stats_sink)
             self.decode_batcher = DecodeBatcher(
@@ -348,7 +364,8 @@ class InferenceServer:
             timeout=timeout)
 
     def submit_generate(self, tokens, max_new_tokens=32, temperature=0.0,
-                        top_k=0, eos_id=None, deadline_ms=None):
+                        top_k=0, eos_id=None, deadline_ms=None,
+                        export_kv=False, kv=None, first_token=None):
         """Admit a generation request into the decode bank (admission
         control applies: queue depth, breaker, deadline). Returns the
         GenerationRequest — ``.wait()`` yields ``[np int32 tokens]``.
@@ -367,8 +384,24 @@ class InferenceServer:
         if self.gen_queue is None:
             raise ValueError("no generator loaded — pass generator= to "
                              "InferenceServer to serve 'generate'")
+        ntokens = np.asarray(tokens).size
         self.gen_engine.admission_check(
-            np.asarray(tokens).size, max_new_tokens, static_only=True)
+            ntokens, max_new_tokens, static_only=True)
+        if (export_kv or kv is not None) \
+                and self.gen_engine.pool is None:
+            raise BadRequestError(
+                "disaggregated prefill/decode requires the paged KV "
+                "pool (FLAGS_kv_paged / kv_paged=True) — the dense "
+                "bank's rows are not migratable")
+        if kv is not None:
+            # door check: the migrated payload must describe exactly
+            # this prompt's prefill (position arithmetic depends on it)
+            claimed = kv.get("tokens") if isinstance(kv, dict) else None
+            if claimed != ntokens:
+                raise BadRequestError(
+                    f"migrated KV payload covers {claimed!r} tokens but "
+                    f"the prompt has {ntokens} — prefill and decode "
+                    f"halves disagree")
         if self.state == "degraded":
             if self.stats_sink:
                 self.stats_sink.bump("shed_overload")
@@ -379,7 +412,8 @@ class InferenceServer:
         return self.gen_queue.put(GenerationRequest(
             tokens, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, eos_id=eos_id,
-            deadline_ms=deadline_ms))
+            deadline_ms=deadline_ms, export_kv=export_kv, kv=kv,
+            first_token=first_token))
 
     def generate(self, tokens, max_new_tokens=32, temperature=0.0,
                  top_k=0, eos_id=None, deadline_ms=None, timeout=None):
@@ -430,6 +464,14 @@ class InferenceServer:
         if self.gen_queue is not None:
             h["decode_queue_depth"] = len(self.gen_queue)
             h["decode_active_rows"] = self.decode_batcher.inflight()
+            pool = self.gen_engine.pool
+            if pool is not None:
+                # the router's least-loaded dispatch reads this: live
+                # kvpool occupancy next to the queue depths, one cheap
+                # probe instead of a full stats()/metrics scrape
+                cap = pool.capacity_blocks
+                h["kvpool_occupancy"] = round(
+                    pool.blocks_in_use() / cap, 4) if cap else 0.0
         return h
 
     def reload_weights(self, path, timeout=120.0):
@@ -576,18 +618,28 @@ class InferenceServer:
         op = msg["op"]
         if op == "ping":
             return {"ok": True}
-        if op == "stats":
-            return {"ok": True, "stats": self.stats()}
-        if op == "metrics":
-            return {"ok": True, "metrics": self.metrics()}
+        if op in ("stats", "metrics", "health", "cancel"):
+            # probe/control ops carry the trace context too (a router's
+            # health-probe latency belongs on the Perfetto timeline next
+            # to the requests it gates); span() with a None parent is
+            # free, so untraced probes pay nothing
+            with _trace.span(f"serving/{op}",
+                             parent=_trace.from_wire(msg.get("trace"))):
+                if op == "stats":
+                    return {"ok": True, "stats": self.stats()}
+                if op == "metrics":
+                    return {"ok": True, "metrics": self.metrics()}
+                if op == "health":
+                    return {"ok": True, "health": self.health()}
+                return self._handle_cancel(msg)
         if op == "debug_dump":
             return self._handle_debug_dump(msg)
-        if op == "health":
-            return {"ok": True, "health": self.health()}
-        if op == "cancel":
-            return self._handle_cancel(msg)
         if op == "generate":
             return self._handle_generate(msg)
+        if op == "prefill":
+            return self._handle_prefill(msg)
+        if op == "reload_weights":
+            return self._handle_reload(msg)
         if op != "infer":
             return {"ok": False, "etype": "BadRequest",
                     "error": f"unknown op {op!r}"}
@@ -678,6 +730,7 @@ class InferenceServer:
             tokens = msg.get("tokens")
             if tokens is None:
                 raise ValueError("'tokens' (1-D int prompt) is required")
+            first_token = msg.get("first_token")
             req, joined = self._dedup(
                 msg.get("rid"),
                 lambda: self.submit_generate(
@@ -686,7 +739,10 @@ class InferenceServer:
                     temperature=float(msg.get("temperature", 0.0)),
                     top_k=int(msg.get("top_k", 0)),
                     eos_id=msg.get("eos_id"),
-                    deadline_ms=msg.get("deadline_ms")))
+                    deadline_ms=msg.get("deadline_ms"),
+                    kv=msg.get("kv"),
+                    first_token=None if first_token is None
+                    else int(first_token)))
             if joined and self.stats_sink:
                 self.stats_sink.bump("hedge_dedup_hits")
         except Exception as e:  # noqa: BLE001 — typed refusal reply
@@ -711,6 +767,67 @@ class InferenceServer:
             return _error_reply(err)
         except Exception as e:  # noqa: BLE001 — surface, don't die
             return _error_reply(e)
+
+    def _handle_prefill(self, msg):
+        """The compute-bound half of the disaggregated split: prefill
+        the prompt, sample its first token, then serialize the slot's
+        KV blocks out of the paged pool instead of decoding. Reply
+        ``{"ok": True, "kv": payload}`` where the payload carries
+        ``first_token``/``prompt_tokens`` plus the block arrays —
+        ready to stream into a decode replica via ``generate``'s
+        ``kv=`` field."""
+        if self.gen_queue is None:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "this server has no generator — pass "
+                             "generator= to InferenceServer"}
+        with _trace.span("serving/handle",
+                         parent=_trace.from_wire(msg.get("trace"))):
+            try:
+                tokens = msg.get("tokens")
+                if tokens is None:
+                    raise ValueError(
+                        "'tokens' (1-D int prompt) is required")
+                req, joined = self._dedup(
+                    msg.get("rid"),
+                    lambda: self.submit_generate(
+                        np.asarray(tokens),
+                        max_new_tokens=int(msg.get("max_new_tokens",
+                                                    32)),
+                        temperature=float(msg.get("temperature", 0.0)),
+                        top_k=int(msg.get("top_k", 0)),
+                        deadline_ms=msg.get("deadline_ms"),
+                        export_kv=True))
+                if joined and self.stats_sink:
+                    self.stats_sink.bump("hedge_dedup_hits")
+            except Exception as e:  # noqa: BLE001 — typed refusal
+                return _error_reply(e)
+            budget = msg.get("deadline_ms")
+            wait_s = (budget / 1e3 + 120.0) if budget else 600.0
+            try:
+                payload, = req.wait(timeout=wait_s)
+                return {"ok": True, "kv": payload}
+            except TimeoutError:
+                err = DeadlineExceededError(
+                    f"server-side wait budget of {wait_s:.0f}s "
+                    f"exceeded; the prefill was abandoned")
+                req.set_error(err)
+                return _error_reply(err)
+            except Exception as e:  # noqa: BLE001 — surface, don't die
+                return _error_reply(e)
+
+    def _handle_reload(self, msg):
+        """Hot weight reload over the wire (the router's rolling-reload
+        building block): same contract as :meth:`reload_weights`."""
+        path = msg.get("path")
+        if not isinstance(path, str) or not path:
+            return {"ok": False, "etype": "BadRequest",
+                    "error": "'path' (checkpoint dir) is required"}
+        try:
+            out = self.reload_weights(
+                path, timeout=float(msg.get("timeout", 120.0)))
+        except Exception as e:  # noqa: BLE001 — typed reply
+            return _error_reply(e)
+        return {"ok": True, **out}
 
 
 # reply etype <-> exception mapping. Order matters server-side:
@@ -771,6 +888,18 @@ def _error_reply(exc):
             "error": f"{type(exc).__name__}: {exc}"}
 
 
+# "argument not given" sentinel for per-call timeout overrides (None is
+# a meaningful value: block forever). The stable repr keeps
+# tools/api_signatures.txt reproducible across processes (a bare
+# object()'s repr embeds its address).
+class _Unset:
+    def __repr__(self):
+        return "<unset>"
+
+
+_UNSET = _Unset()
+
+
 class Client:
     """Wire-protocol client. One socket, serial request/reply (run one
     Client per concurrent caller — sockets are cheap; the server batches
@@ -805,26 +934,34 @@ class Client:
         self._hedges = 0
         self._hedge_wins = 0
 
-    def _ensure(self):
+    def _ensure(self, timeout=_UNSET):
         if self._sock is None:
+            t = self._timeout if timeout is _UNSET else timeout
+            # an explicit per-call timeout also bounds the CONNECT
+            # retries: a router probing a dead replica must fail fast,
+            # not ride out the 10s reconnect discipline
+            deadline = 10.0 if timeout is _UNSET or timeout is None \
+                else max(float(timeout), 0.05)
             self._sock = retry_call(
-                lambda: socket.create_connection(
-                    self._addr, timeout=self._timeout),
-                deadline=10.0, retries=self._connect_retries,
+                lambda: socket.create_connection(self._addr, timeout=t),
+                deadline=deadline, retries=self._connect_retries,
                 what="serving connect", endpoint=self.endpoint)
         return self._sock
 
-    def _transact(self, sock, msg):
+    def _transact(self, sock, msg, timeout=_UNSET):
         """One request/reply exchange on ``sock``; maps error replies to
         their typed exceptions. No reconnect logic here. ANY failure
         inside the exchange (transport error, timeout, injected fault)
         poisons the socket — a half-done exchange can leave the reply in
         the buffer, and reusing the socket would pair the NEXT request
         with this one's stale reply — so the cached socket is dropped
-        and the next call reconnects."""
+        and the next call reconnects. ``timeout`` overrides the client
+        default for THIS exchange (health probes against a hung replica
+        fail fast instead of inheriting the long socket default)."""
+        t = self._timeout if timeout is _UNSET else timeout
         try:
-            send_frame(sock, msg, self._key, timeout=self._timeout)
-            reply = recv_frame(sock, self._key, timeout=self._timeout)
+            send_frame(sock, msg, self._key, timeout=t)
+            reply = recv_frame(sock, self._key, timeout=t)
         except BaseException:
             if sock is self._sock:
                 self.close()
@@ -838,19 +975,23 @@ class Client:
         etype = _ETYPES.get(reply.get("etype"), InternalServerError)
         raise etype(reply.get("error", "serving request failed"))
 
-    def _call(self, msg):
+    def _call(self, msg, timeout=_UNSET):
         """Exchange with reconnect-once: a send/recv failure on the
         cached socket (typically a bounced server) closes it and retries
         the exchange on a fresh connection before surfacing anything.
         Safe because infer/generate carry a request id the server
         dedups, and the other ops are idempotent."""
         for attempt in (0, 1):
-            sock = self._ensure()
+            sock = self._ensure(timeout=timeout)
             try:
-                return self._transact(sock, msg)
-            except (ConnectionError, OSError):
+                return self._transact(sock, msg, timeout=timeout)
+            except (ConnectionError, OSError) as e:
                 self.close()
-                if attempt:
+                # an explicit per-call timeout expiring is the answer
+                # (replica hung), not a stale-socket symptom — retrying
+                # would double the caller's deadline
+                if attempt or (timeout is not _UNSET
+                               and isinstance(e, socket.timeout)):
                     raise
         raise AssertionError("unreachable")
 
@@ -1008,27 +1149,90 @@ class Client:
             reply = self._call(msg)
         return np.asarray(reply["tokens"], dtype=np.int32)
 
+    def prefill(self, tokens, max_new_tokens=32, temperature=0.0,
+                top_k=0, deadline_ms=None):
+        """The compute-bound half of the disaggregated split: prefill
+        the prompt on this (prefill) replica and return the serialized
+        KV payload — ``first_token``/``prompt_tokens`` plus the slot's
+        block arrays — ready to pass to another replica's
+        :meth:`generate` as ``kv=``. Requires the server's paged pool."""
+        msg = {
+            "op": "prefill",
+            "tokens": np.asarray(tokens, dtype=np.int32).ravel(),
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "deadline_ms": deadline_ms,
+            "rid": uuid.uuid4().hex,
+        }
+        with self._traced(msg):
+            return self._call(msg)["kv"]
+
+    def generate_from_kv(self, tokens, kv, max_new_tokens=32,
+                         temperature=0.0, top_k=0, eos_id=None,
+                         deadline_ms=None):
+        """The bandwidth-bound half: stream a migrated ``kv`` payload
+        (from :meth:`prefill`) into this (decode) replica's pool and
+        continue decoding from its ``first_token``. Returns ALL new
+        tokens (the prefill-side first token included) as np.int32."""
+        msg = {
+            "op": "generate",
+            "tokens": np.asarray(tokens, dtype=np.int32).ravel(),
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "deadline_ms": deadline_ms,
+            "kv": dict(kv),
+            "first_token": int(kv["first_token"]),
+            "rid": uuid.uuid4().hex,
+        }
+        with self._traced(msg):
+            reply = self._call(msg)
+        return np.asarray(reply["tokens"], dtype=np.int32)
+
+    def reload_weights(self, path, timeout=120.0):
+        """Hot weight reload on the server (manifest-verified atomic
+        swap; the router's rolling-reload building block). Returns
+        ``{"weights_version", "swap_pause_ms"}``."""
+        msg = {"op": "reload_weights", "path": str(path),
+               "timeout": float(timeout)}
+        reply = self._call(msg)
+        return {"weights_version": reply["weights_version"],
+                "swap_pause_ms": reply["swap_pause_ms"]}
+
     def cancel(self, rid):
         """Cancel an in-flight request by its id (hedge losers; also
         usable after abandoning a slow call). Returns True if the server
         actually cancelled something."""
-        return bool(self._call({"op": "cancel",
-                                "rid": str(rid)}).get("cancelled"))
+        msg = {"op": "cancel", "rid": str(rid)}
+        with self._traced(msg):
+            return bool(self._call(msg).get("cancelled"))
 
-    def _idempotent(self, msg):
-        return retry_call(lambda: self._call(msg), deadline=10.0,
+    def _idempotent(self, msg, timeout=_UNSET):
+        deadline = 10.0 if timeout is _UNSET or timeout is None \
+            else max(float(timeout), 0.05)
+        return retry_call(lambda: self._call(msg, timeout=timeout),
+                          deadline=deadline,
                           retries=2, what=f"serving {msg['op']}",
                           endpoint=self.endpoint)
 
-    def stats(self):
-        return self._idempotent({"op": "stats"})["stats"]
+    def stats(self, timeout=_UNSET):
+        """One server-stage stats snapshot. ``timeout`` (seconds)
+        overrides the client's socket default for this call — probe
+        loops against a hung replica fail fast."""
+        msg = {"op": "stats"}
+        with self._traced(msg):
+            return self._idempotent(msg, timeout=timeout)["stats"]
 
-    def metrics(self):
+    def metrics(self, timeout=_UNSET):
         """Prometheus text exposition of the server process's metrics
         registry (the scrape endpoint: pipe it to a pushgateway or the
         node-exporter textfile collector via
-        ``tools/export_metrics.py``)."""
-        return self._idempotent({"op": "metrics"})["metrics"]
+        ``tools/export_metrics.py``). ``timeout`` is per-call."""
+        msg = {"op": "metrics"}
+        with self._traced(msg):
+            return self._idempotent(msg, timeout=timeout)["metrics"]
 
     def debug_dump(self, write=False):
         """The server's flight-recorder snapshot:
@@ -1044,13 +1248,21 @@ class Client:
             return self._call(msg)
         return self._idempotent(msg)
 
-    def health(self):
+    def health(self, timeout=_UNSET):
         """The server's lifecycle/liveness snapshot (state, queue
-        depths, loop heartbeats + restarts, weights_version)."""
-        return self._idempotent({"op": "health"})["health"]
+        depths, loop heartbeats + restarts, weights_version, kvpool
+        occupancy when paged). ``timeout`` (seconds) overrides the
+        client's socket default for this one call — the router's
+        health probes pass ``FLAGS_router_probe_timeout_s`` so a hung
+        replica (stalled accept loop included) fails the probe fast
+        instead of inheriting the long execute-path default."""
+        msg = {"op": "health"}
+        with self._traced(msg):
+            return self._idempotent(msg, timeout=timeout)["health"]
 
-    def ping(self):
-        return bool(self._idempotent({"op": "ping"}).get("ok"))
+    def ping(self, timeout=_UNSET):
+        return bool(self._idempotent({"op": "ping"},
+                                     timeout=timeout).get("ok"))
 
     def close(self):
         if self._sock is not None:

@@ -139,7 +139,7 @@ def test_serving_stats_snapshot_keys_unchanged():
         "tokens_generated", "decode_steps", "decode_rows",
         "decode_slot_rows", "engine_failures", "watchdog_timeouts",
         "loop_restarts", "weight_reloads", "hedge_dedup_hits",
-        "requests_cancelled"}
+        "requests_cancelled", "kv_exports", "kv_imports"}
     derived = {"uptime_s", "throughput_rps", "mean_batch_size",
                "batch_occupancy", "tokens_per_s", "decode_occupancy",
                "queue_depth"}
