@@ -37,6 +37,11 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
                       tokens/s and ms/token of the prefill+cached-decode
                       path vs naive full-recompute generation at
                       prompt seq in {128, 256}
+  profile             extra: performance attribution — widedeep per-op
+                      flops/bytes attribution vs XLA's executable_cost
+                      (top-3 cost ops named), tiny-BERT HBM live-set
+                      peak vs cost bytes, and the FLAGS_profile_ops=0
+                      zero-overhead gate
   telemetry           extra: instrumentation-overhead gate — serving
                       p99 and fused-loop step time with request
                       tracing off vs the default sample rate vs 1.0
@@ -99,6 +104,20 @@ def _step_cost(exe, prog):
         if flops <= 0:
             return None
         return {"flops": flops, "bytes": nbytes}
+    except Exception:
+        return None
+
+
+def _step_memory(exe, prog):
+    """XLA memory_analysis of the cached compiled step: argument/temp/
+    output byte sizes + derived peak (the live-set profiler's
+    validation target). None where the backend can't report."""
+    try:
+        from paddle_tpu.observability.utilization import \
+            executable_memory
+        entry = next(
+            v for k, v in exe._cache.items() if k[0] == prog._uid)
+        return executable_memory(entry[0])
     except Exception:
         return None
 
@@ -1399,6 +1418,157 @@ def bench_decode():
     }
 
 
+def bench_profile():
+    """Performance attribution (the BENCHMARKS.md attribution tables):
+    (a) per-op cost attribution of the widedeep train step —
+    estimated flops/bytes per op (observability/profiling.py) validated
+    against XLA's own ``executable_cost()``, with the top-3 cost ops
+    NAMED (the "why is widedeep 0.008 MFU" answer); (b) the HBM
+    live-set memory profiler over the fused tiny-BERT config, peak
+    residency vs ``executable_cost()`` bytes; (c) the profiler-overhead
+    gate: train-step wall time at FLAGS_profile_ops=0 (the default)
+    vs sampled (16) vs every-step (1), plus a bitwise check that the
+    flag never changes committed numerics (the measured replay is a
+    side channel — the fused executable still produces the result)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert, widedeep
+    from paddle_tpu.observability import profiling
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform in ("tpu", "gpu", "axon")
+    batch = 4096 if on_accel else 256
+
+    # (a) widedeep per-op attribution
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        out = widedeep.wide_deep(batch_size=batch)
+        fluid.optimizer.Adam(1e-3).minimize(out["loss"])
+    rng = np.random.default_rng(0)
+    feed = widedeep.random_batch(batch, rng=rng)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main_prog, feed=feed, fetch_list=[out["loss"]])
+    cost = _step_cost(exe, main_prog)
+    report = profiling.profile_program(
+        main_prog, feed=feed, fetch_list=[out["loss"]], cost=cost)
+    tot = report["totals"]
+    top3 = [{"op": f"#{r['index']} {r['type']}",
+             "share_pct": round(r["share"] * 100, 1),
+             "bound": r["bound"],
+             "gflop": round(r["flops"] / 1e9, 3),
+             "mib": round(r["bytes"] / 2**20, 2)}
+            for r in report["ops"][:3]]
+    top_share = round(sum(r["share"] for r in report["ops"][:3]), 4)
+
+    def _closeness(a, b):
+        return round(min(a, b) / max(a, b), 4) if a and b else None
+
+    attribution = {
+        "est_flops_gflop": round(tot["flops"] / 1e9, 2),
+        "est_bytes_gib": round(tot["bytes"] / 2**30, 3),
+        "named_rule_share": {k: round(v, 4)
+                             for k, v in report["named_share"].items()},
+    }
+    if cost:
+        attribution["xla_flops_gflop"] = round(cost["flops"] / 1e9, 2)
+        attribution["xla_bytes_gib"] = round(cost["bytes"] / 2**30, 3)
+        attribution["flops_attributed_vs_xla"] = _closeness(
+            tot["flops"], cost["flops"])
+        attribution["bytes_attributed_vs_xla"] = _closeness(
+            tot["bytes"], cost["bytes"])
+
+    # (b) HBM live-set profiler on the fused tiny-BERT config (the
+    # fuse_optimizer pipeline is on by default — memory_profile walks
+    # the optimized clone, exactly what lowers)
+    cfg = bert.BertConfig.tiny()
+    b_batch, b_seq, b_preds = (32, 128, 20) if on_accel else (8, 32, 5)
+    bmain, bstartup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(bmain, bstartup):
+        bout = bert.bert_pretrain(cfg, b_batch, b_seq, b_preds)
+        fluid.optimizer.AdamOptimizer(1e-4).minimize(bout["loss"])
+    bfeed = bert.random_batch(cfg, b_batch, b_seq, b_preds, rng=rng)
+    bscope = fluid.Scope()
+    with fluid.scope_guard(bscope):
+        exe.run(bstartup)
+        exe.run(bmain, feed=bfeed, fetch_list=[bout["loss"]])
+    bcost = _step_cost(exe, bmain)
+    mem = profiling.memory_profile(bmain,
+                                   fetch_names=(bout["loss"].name,),
+                                   feed=bfeed, optimize=True)
+    memory = {
+        "peak_mib": round(mem["peak_bytes"] / 2**20, 2),
+        "baseline_params_mib": round(mem["baseline_bytes"] / 2**20, 2),
+        "peak_op": f"#{mem['peak_op_index']} {mem['peak_op_type']}",
+        "top_tensors": [{"name": r["name"],
+                         "mib": round(r["bytes"] / 2**20, 2),
+                         "kind": r["kind"]} for r in mem["top"][:3]],
+    }
+    if bcost:
+        memory["xla_bytes_accessed_mib"] = round(bcost["bytes"] / 2**20,
+                                                 2)
+    bmem = _step_memory(exe, bmain)
+    if bmem:
+        # the honest validation target: XLA's own live-footprint
+        # accounting (args + temps + outputs - aliased) of the compiled
+        # step, NOT bytes-accessed traffic
+        memory["xla_peak_mib"] = round(bmem["peak_bytes"] / 2**20, 2)
+        memory["peak_vs_xla_peak"] = round(
+            mem["peak_bytes"] / bmem["peak_bytes"], 4)
+
+    # (c) overhead gate: FLAGS_profile_ops=0 must be free (and the flag
+    # must never change committed numerics)
+    def timed_steps(n, flag):
+        fluid.set_flags({"FLAGS_profile_ops": flag})
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            exe.run(main_prog, feed=feed, fetch_list=[out["loss"]])
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss, = exe.run(main_prog, feed=feed,
+                                fetch_list=[out["loss"]],
+                                return_numpy=False)
+            lv = np.asarray(loss)
+            dt = time.perf_counter() - t0
+        return dt / n * 1e3, lv
+
+    n_steps = 8 if on_accel else 4
+    old_flag = fluid.get_flags("FLAGS_profile_ops")["FLAGS_profile_ops"]
+    try:
+        ms_off, loss_off = timed_steps(n_steps, 0)
+        ms_sampled, _ = timed_steps(n_steps, 16)
+        ms_every, loss_on = timed_steps(n_steps, 1)
+    finally:
+        fluid.set_flags({"FLAGS_profile_ops": old_flag})
+    assert np.array_equal(loss_off, loss_on), \
+        "FLAGS_profile_ops changed committed numerics"
+    overhead = {
+        "step_ms_flag_0": round(ms_off, 3),
+        "step_ms_flag_16_sampled": round(ms_sampled, 3),
+        "step_ms_flag_1_every": round(ms_every, 3),
+        "bitwise_vs_flag_0": True,
+    }
+
+    # headline: the share of widedeep's estimated step bytes attributed
+    # by a SPECIFIC named rule (matmul/conv/gather/optimizer/...) —
+    # the >= 0.9 acceptance bar; est-vs-XLA validation rides alongside
+    return {
+        "metric": "profile_widedeep_bytes_attributed_ratio",
+        "value": attribution["named_rule_share"]["bytes"],
+        "unit": "ratio",
+        "vs_baseline": None,       # attribution tool, no external anchor
+        "batch": batch,
+        "widedeep_top3_cost_ops": top3,
+        "widedeep_top3_share_of_est_time": top_share,
+        "widedeep_attribution": attribution,
+        "tiny_bert_memory": memory,
+        "profile_ops_overhead": overhead,
+    }
+
+
 def bench_fleet():
     """Disaggregated serving fleet (serving/fleet, the BENCHMARKS.md
     fleet table): (a) aggregate decode tokens/s behind the
@@ -1654,6 +1824,7 @@ _CONFIGS = {
     "passes": (bench_passes,
                "passes_bert_train_step_trace_plus_compile_ms"),
     "decode": (bench_decode, "decode_kv_cache_seq256_tokens_per_sec"),
+    "profile": (bench_profile, "profile_widedeep_bytes_attributed_ratio"),
     "fleet": (bench_fleet, "fleet_3_replica_aggregate_tokens_per_sec"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }

@@ -74,6 +74,31 @@ _DEFS = {
     # rate-limited). "" = automatic dumps off; the "debug_dump" wire op
     # and FlightRecorder.dump() always work
     "flight_recorder_dir": ("", str, None),
+    # -- performance attribution & SLO guardrails --
+    # sampled MEASURED per-op profiling: 0 = off (the default — the
+    # executor hot path pays one flag read and is bitwise-unchanged);
+    # N >= 1 = every N-th Executor.run dispatch of a program additionally
+    # replays the optimized clone op-by-op (eager, synced) to record a
+    # per-op wall-time table + Perfetto op spans + the hbm_live_bytes
+    # counter track. The committed step result still comes from the
+    # fused executable — profiling never changes numerics.
+    "profile_ops": (0, int, None),
+    # start the default SLO monitor (observability/slo.py) inside every
+    # InferenceServer: p99 inter-token latency, queue-depth ratios,
+    # kvpool occupancy, optional MFU floor
+    "slo_monitor": (True, bool, None),
+    # SLO rule evaluation cadence (the supervised monitor loop)
+    "slo_poll_s": (0.25, float, None),
+    # default-ruleset thresholds (0 disables the individual rule):
+    # windowed p99 of the decode stage (inter-token latency proxy, ms)
+    "slo_decode_p99_ms": (2000.0, float, None),
+    # queue depth as a fraction of the admission cap
+    "slo_queue_ratio": (0.9, float, None),
+    # paged KV pool occupancy (blocks in use / allocatable)
+    "slo_kvpool_ratio": (0.95, float, None),
+    # MFU floor on the decode path (0 = rule off; set > 0 on real
+    # accelerators where peak tables are known)
+    "slo_mfu_floor": (0.0, float, None),
     # -- elastic training (paddle_tpu/train) --
     # periodic full-training-state checkpoint cadence for
     # TrainingSupervisor: one async (CheckFreq-staged) checkpoint every

@@ -236,6 +236,9 @@ class Executor:
         self._exec_costs = LRUCache(max_entries=256)
         self._last_dispatch = None
         self._gap_streak = 0    # consecutive over-cadence deltas
+        # FLAGS_profile_ops sampling counters, per cache key (bounded:
+        # cleared when the key universe outgrows the compile cache)
+        self._profile_seq = {}
         # closures bind the stat containers, never self; clearing the
         # cache on retire drops the compiled executables (device memory)
         _exec_agg.track(
@@ -561,6 +564,18 @@ class Executor:
             # host copies taken BEFORE the step (the price of the opt-in)
             backup = {n: np.asarray(v) for n, v in state_mut.items()}
 
+        # sampled measured op profiling (FLAGS_profile_ops=N): every
+        # N-th dispatch of a program replays the optimized clone
+        # op-by-op BEFORE the fused invoke (its buffers are donated
+        # after). The committed result below is still the fused
+        # executable's — numerics are untouched; with the default N=0
+        # this costs one flag read.
+        prof_n = int(_flag("profile_ops"))
+        if prof_n > 0 and mesh is None:
+            self._maybe_profile_ops(prof_n, cache_key, program,
+                                    fetch_names, feed_arrays, state_mut,
+                                    state_ro, base_key, scope)
+
         from .. import profiler as _prof
         invoke_args = (compiled, jitted,
                        (state_mut, state_ro, feed_arrays, base_key),
@@ -859,6 +874,35 @@ class Executor:
     def _slot_name(slots, step_idx, slot_names):
         i = int(np.asarray(slots)[step_idx])
         return slot_names[i] if 0 <= i < len(slot_names) else f"slot {i}"
+
+    def _maybe_profile_ops(self, every_n, cache_key, program,
+                           fetch_names, feed_arrays, state_mut,
+                           state_ro, base_key, scope):
+        """The FLAGS_profile_ops sampling gate + measured replay: every
+        ``every_n``-th dispatch of ``cache_key``, interpret the pass
+        pipeline's optimized CLONE eagerly with per-op timing
+        (observability.profiling.measure_op_times — spans, the
+        hbm_live_bytes counter track, and the last_op_profile() table).
+        Failures are swallowed: profiling must never break a step."""
+        if len(self._profile_seq) > 512:
+            self._profile_seq.clear()
+        seq = self._profile_seq.get(cache_key, 0) + 1
+        self._profile_seq[cache_key] = seq
+        if (seq - 1) % max(every_n, 1):
+            return
+        try:
+            from ..observability import profiling as _opprof
+            opt = self._optimize(program, fetch_names,
+                                 feed_names=feed_arrays.keys(),
+                                 scope=scope)
+            env = dict(state_ro)
+            env.update(state_mut)
+            env.update(feed_arrays)
+            env[RNG_STATE_NAME] = base_key
+            _opprof.measure_op_times(opt, env,
+                                     tag=f"program_{program._uid}")
+        except Exception:  # noqa: BLE001 — telemetry never kills a step
+            pass
 
     def _run_pserver(self, ls_op, scope):
         """Host parameter-server event loop (reference

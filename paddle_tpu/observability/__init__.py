@@ -23,12 +23,26 @@ Four pieces, one package:
   structured events (admissions, evictions, restarts, chaos firings,
   non-finite hits, weight reloads, preemptions) dumped to JSON on a
   typed server-boundary error or the ``"debug_dump"`` wire op.
+- :mod:`profiling` — performance attribution: the per-op cost profiler
+  (estimated flops/bytes roofline ranking + ``FLAGS_profile_ops``
+  measured op-granular replays with Perfetto spans) and the HBM
+  live-set memory profiler (peak residency, op index at peak, top-k
+  tensors live at peak).
+- :mod:`slo` — the rule-driven SLO monitor: declarative rules over
+  metric streams become ``slo_breach``/``slo_recovered`` flight events,
+  ``slo_*`` metrics, and dispatch-penalty signals the fleet Router
+  consumes.
 """
 from .metrics import (  # noqa: F401
     DEFAULT_BOUNDS_MS, Family, MetricsRegistry, UNIT_SUFFIXES,
     default_registry, render_metrics,
 )
+from .profiling import (  # noqa: F401
+    format_table, last_op_profile, measure_op_times, memory_profile,
+    profile_program,
+)
 from .recorder import FlightRecorder, flight_recorder  # noqa: F401
+from .slo import SloMonitor, SloRule, default_server_rules  # noqa: F401
 from .tracing import (  # noqa: F401
     SpanContext, ambient, current, from_wire, maybe_trace, new_trace,
     record_child, record_span, span, to_wire,

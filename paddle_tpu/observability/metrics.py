@@ -250,6 +250,41 @@ class MetricsRegistry:
                     "per-family label-cardinality cap", "labels": ()}
         return out
 
+    def collect(self):
+        """Structured snapshot of every family's CURRENT samples —
+        native instruments AND collector-emitted ones::
+
+            {name: {"kind", "help", "labels", "samples"}}
+
+        with ``samples`` in :meth:`Family.samples` shape. This is the
+        programmatic scrape the SLO monitor evaluates rules against and
+        the fleet-metrics aggregation re-exposes; :meth:`render` is the
+        same data as Prometheus text."""
+        with self._lock:
+            fams = list(self._families.items())
+            collectors = list(self._collectors)
+            declared = dict(self._declared)
+        out = {}
+        for name, fam in fams:
+            out[name] = {"kind": fam.kind, "help": fam.help,
+                         "labels": fam.label_names,
+                         "samples": fam.samples()}
+        for fn in collectors:
+            try:
+                emitted = fn()
+            except Exception:  # noqa: BLE001 — one sink never kills it
+                continue
+            for f in emitted:
+                meta = declared.get(f["name"], {})
+                out[f["name"]] = {
+                    "kind": f.get("kind", meta.get("kind", "counter")),
+                    "help": f.get("help", meta.get("help", "")),
+                    "labels": tuple(f.get("labels",
+                                          meta.get("labels", ()))),
+                    "samples": list(f.get("samples", ())),
+                }
+        return out
+
     # -- exposition -------------------------------------------------------
     @staticmethod
     def _labelstr(names, values):

@@ -1,0 +1,532 @@
+"""Performance attribution: per-op cost profiler + HBM live-set memory
+profiler.
+
+The telemetry layer (metrics/tracing/utilization) says *how fast* the
+system runs — whole-executable MFU/HBM-bw gauges, stage histograms —
+but nothing says *why* it is slow: one fused XLA module has no per-op
+boundary, so nobody can name the op that burns the time or the tensor
+that pins the memory. This module is the attribution half:
+
+- :func:`profile_program` — **estimated** per-op cost breakdown: walk
+  the (optionally pass-optimized clone of the) program's global block
+  and attribute flops/bytes per op from the declared shapes (the same
+  registry shape info build-time inference populates), then rank ops by
+  roofline-limited time against the SAME peak tables the live
+  ``utilization`` gauges and ``bench.py`` read — attribution and the
+  production MFU gauge agree by construction. Estimates can be
+  validated against XLA's own ``executable_cost()`` via ``cost=``.
+- **measured** mode (``FLAGS_profile_ops``, or ``measured=True``):
+  interpret the op list eagerly over a CLONE-derived program (the pass
+  pipeline's clone machinery — the user program is never mutated),
+  syncing between ops, so each op's real wall time lands in a
+  ``passes.stats()``-style table AND as Perfetto child spans
+  (``op/<type>#<i>`` under one ``profile/ops`` parent) in the unified
+  span table — ``tools/timeline.py`` renders an op-level flame chart.
+  The executor samples this automatically every N-th dispatch when
+  ``FLAGS_profile_ops=N`` (see ``Executor.run``); the committed step
+  result still comes from the fused executable, so numerics are
+  untouched even with the flag on.
+- :func:`memory_profile` — the **HBM live-set** profiler: built on the
+  PR-8 liveness/def-use analysis + declared shapes, it computes the
+  byte-weighted live-set timeline across the program (persistable
+  params as the resident baseline, temporaries live from their def to
+  their last use, fetches live to the end), reports peak HBM, the op
+  index at peak and the top-k tensors live at peak — the "why is this
+  OOM / 0.008-MFU" tool — and (in measured mode) emits a
+  ``hbm_live_bytes`` Perfetto counter track next to the op spans.
+
+``FLAGS_profile_ops=0`` (the default) leaves every hot path untouched:
+the executor pays one flag read per dispatch and nothing else.
+"""
+import threading
+import time
+
+import numpy as np
+
+from .. import profiler as _prof
+from ..flags import flag as _flag
+from . import tracing as _tracing
+from .metrics import default_registry
+from .utilization import hbm_peak, peak_flops
+
+# reference-chip peaks used for RANKING when the local device's peaks
+# are unknown (CPU dev boxes): v5e bf16 / HBM — the ordering of
+# roofline-limited times is what matters offline, not absolute ms
+REF_PEAK_FLOPS = 197e12
+REF_HBM_PEAK = 819e9
+
+_REPLAYS = default_registry().counter(
+    "profile_op_replays_total",
+    "measured op-granular profile replays recorded "
+    "(FLAGS_profile_ops sampling)")
+_REPLAY_MS = default_registry().counter(
+    "profile_op_ms_total",
+    "wall ms spent inside measured op-granular profile replays")
+
+_last = {"measured": None}
+_last_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Shape resolution + per-op flop/byte estimation.
+# ---------------------------------------------------------------------------
+
+def _shape_table(program, feed=None, batch=None):
+    """name -> concrete shape tuple for every var the global block
+    declares. Feed arrays pin their own shapes; remaining -1 dims take
+    ``batch`` (default: the leading dim of any fed array, else 1)."""
+    block = program.global_block()
+    shapes = {}
+    if feed:
+        for n, a in feed.items():
+            shp = tuple(a) if isinstance(a, (tuple, list)) \
+                else tuple(np.shape(a))
+            shapes[n] = shp
+            if batch is None and shp:
+                batch = int(shp[0])
+    if batch is None:
+        batch = 1
+    for n, v in block.vars.items():
+        if n in shapes:
+            continue
+        shp = getattr(v, "shape", None)
+        if shp is None:
+            continue
+        shapes[n] = tuple(int(batch) if int(d) == -1 else int(d)
+                          for d in shp)
+    return shapes
+
+
+def _var_bytes(program, shapes, name, _memo):
+    b = _memo.get(name)
+    if b is not None:
+        return b
+    from ..framework.dtype import np_dtype
+    shp = shapes.get(name)
+    b = 0
+    if shp is not None:
+        try:
+            var = program.global_block().var(name)
+            itemsize = np.dtype(np_dtype(var.dtype)).itemsize
+            b = int(np.prod(shp, dtype=np.int64)) * itemsize
+        except (ValueError, TypeError):
+            b = 0
+    _memo[name] = b
+    return b
+
+
+def _prod(shp):
+    return int(np.prod(shp, dtype=np.int64)) if shp else 1
+
+
+# op types with a specific flop rule ("named" attribution — everything
+# else falls into the default one-flop-per-output-element bucket)
+_MATMUL_OPS = ("mul", "matmul")
+
+# per-param-element flop counts of the optimizer update kernels (moment
+# updates + bias correction + the parameter write)
+_OPT_FLOPS_PER_ELEM = {"sgd": 2.0, "momentum": 4.0, "adam": 12.0,
+                       "adamw": 14.0}
+
+
+def _op_flops(op, shapes):
+    """(flops, rule): estimated FLOPs for one op plus the rule that
+    produced them ("matmul"/"conv"/"gather"/"reduce"/"softmax"/
+    "elementwise"). Grad ops take 2x their forward's estimate (the
+    generic vjp computes both input cotangents; XLA CSEs the recomputed
+    forward against the live one)."""
+    t = op.type
+    grad = t.endswith("_grad")
+    base = t[:-5] if grad else t
+    if base.startswith("fused_"):
+        base = base[6:]
+    mult = 2.0 if grad else 1.0
+
+    def shp(slot, i=0):
+        names = op.inputs.get(slot) or ()
+        if i < len(names):
+            return shapes.get(names[i])
+        return None
+
+    def out_shp(slot="Out", i=0):
+        names = op.outputs.get(slot) or ()
+        if i < len(names):
+            return shapes.get(names[i])
+        return None
+
+    if base in _MATMUL_OPS:
+        x = shp("X")
+        y = shp("Y")
+        out = out_shp()
+        if x and out:
+            if base == "mul":
+                ncd = int(op.attrs.get("x_num_col_dims", 1))
+                k = _prod(x[ncd:])
+            else:
+                k = int(x[-2] if op.attrs.get("transpose_X") else x[-1])
+            return mult * 2.0 * _prod(out) * k, "matmul"
+        if x and y:
+            return mult * 2.0 * _prod(x) * (y[-1] if y else 1), "matmul"
+    elif base in ("conv2d", "depthwise_conv2d"):
+        out = out_shp("Output") or out_shp()
+        flt = shp("Filter")
+        if out and flt:
+            per_out = 2.0 * _prod(flt[1:])     # Ci/groups * kh * kw MACs
+            return mult * _prod(out) * per_out, "conv"
+    elif base in ("lookup_table", "lookup_table_v2"):
+        if grad:
+            # backward is a scatter-ADD into the table: one add per
+            # incoming grad element
+            g = shp("Out@GRAD")
+            return float(_prod(g)) if g else 0.0, "gather"
+        return 0.0, "gather"                   # forward: pure movement
+    elif base in _OPT_FLOPS_PER_ELEM and not grad:
+        n = sum(_prod(shapes[nm]) for nm in op.inputs.get("Param", ())
+                if nm in shapes)
+        if n:
+            return _OPT_FLOPS_PER_ELEM[base] * n, "optimizer"
+    elif base in ("softmax", "softmax_with_cross_entropy"):
+        x = shp("X") or shp("Logits")
+        if x:
+            return mult * 5.0 * _prod(x), "softmax"
+    elif base in ("reduce_sum", "reduce_mean", "mean", "sum"):
+        x = shp("X")
+        if x:
+            return mult * _prod(x), "reduce"
+    elif base == "layer_norm":
+        x = shp("X")
+        if x:
+            return mult * 8.0 * _prod(x), "reduce"
+    # default: one flop per output element
+    total = 0
+    for names in op.outputs.values():
+        for n in names:
+            s = shapes.get(n)
+            if s is not None:
+                total += _prod(s)
+    return mult * float(total), "elementwise"
+
+
+def _op_bytes(program, op, shapes, memo):
+    """HBM traffic estimate: every distinct input read once + every
+    output written once (XLA fusion can do better; this is the
+    attribution upper bound, same convention as cost_analysis)."""
+    seen = set()
+    total = 0
+    for names in op.inputs.values():
+        for n in names:
+            if n not in seen:
+                seen.add(n)
+                total += _var_bytes(program, shapes, n, memo)
+    for names in op.outputs.values():
+        for n in names:
+            if n not in seen:
+                seen.add(n)
+                total += _var_bytes(program, shapes, n, memo)
+    return total
+
+
+def profile_program(program, feed=None, fetch_list=None, scope=None,
+                    batch=None, topk=None, cost=None, optimize=True,
+                    measured=None):
+    """Per-op cost attribution for ``program``'s global block.
+
+    Returns a report dict:
+
+    - ``ops``: one row per op, RANKED by roofline-limited time —
+      ``{"index", "type", "outputs", "flops", "bytes", "est_ms",
+      "bound", "rule", "share"}`` (``share`` = fraction of the total
+      estimated time; ``bound`` = "compute"/"bandwidth").
+    - ``totals``: summed ``flops``/``bytes``/``est_ms`` plus the peak
+      table used.
+    - ``coverage`` (when ``cost`` — an ``executable_cost()`` dict — is
+      given): ``est_vs_xla_flops_ratio`` / ``est_vs_xla_bytes_ratio``,
+      the validation against XLA's own analysis.
+    - ``named_share``: fraction of estimated flops/bytes attributed by
+      a SPECIFIC rule (matmul/conv/gather/reduce/softmax) rather than
+      the default elementwise bucket.
+    - ``measured`` (measured mode): the per-op wall-time table from one
+      eager, synced interpretation (see :func:`measure_op_times`).
+
+    ``optimize=True`` profiles the pass pipeline's optimized CLONE (what
+    actually lowers; the user program is never mutated); pass False to
+    profile the program as written. ``measured`` defaults to
+    ``bool(FLAGS_profile_ops)``.
+    """
+    from ..framework.passes import optimize_program
+    fetch_names = []
+    for f in (fetch_list or ()):
+        fetch_names.append(getattr(f, "name", None) or str(f))
+    prog = optimize_program(program, fetch_names=tuple(fetch_names)) \
+        if optimize else program
+    shapes = _shape_table(prog, feed=feed, batch=batch)
+    pf = peak_flops() or REF_PEAK_FLOPS
+    pb = hbm_peak() or REF_HBM_PEAK
+    memo = {}
+    rows = []
+    tot_f = tot_b = tot_t = 0.0
+    named_f = named_b = 0.0
+    for i, op in enumerate(prog.global_block().ops):
+        flops, rule = _op_flops(op, shapes)
+        nbytes = _op_bytes(prog, op, shapes, memo)
+        t_c = flops / pf
+        t_m = nbytes / pb
+        est_s = max(t_c, t_m)
+        rows.append({
+            "index": i, "type": op.type,
+            "outputs": list(op.output_arg_names)[:4],
+            "flops": flops, "bytes": nbytes,
+            "est_ms": est_s * 1e3,
+            "bound": "compute" if t_c >= t_m else "bandwidth",
+            "rule": rule,
+        })
+        tot_f += flops
+        tot_b += nbytes
+        tot_t += est_s
+        if rule != "elementwise":
+            named_f += flops
+            named_b += nbytes
+    rows.sort(key=lambda r: -r["est_ms"])
+    for r in rows:
+        r["share"] = (r["est_ms"] / (tot_t * 1e3)) if tot_t else 0.0
+    report = {
+        "n_ops": len(rows),
+        "ops": rows[:topk] if topk else rows,
+        "totals": {"flops": tot_f, "bytes": tot_b,
+                   "est_ms": tot_t * 1e3,
+                   "peak_flops": pf, "peak_hbm_bytes_per_s": pb},
+        "named_share": {
+            "flops": (named_f / tot_f) if tot_f else 0.0,
+            "bytes": (named_b / tot_b) if tot_b else 0.0,
+        },
+    }
+    if cost:
+        report["coverage"] = {
+            "est_vs_xla_flops_ratio": (tot_f / cost["flops"])
+            if cost.get("flops") else None,
+            "est_vs_xla_bytes_ratio": (tot_b / cost["bytes"])
+            if cost.get("bytes") else None,
+        }
+    if measured is None:
+        measured = bool(_flag("profile_ops"))
+    if measured:
+        if scope is None:
+            from ..framework.executor import global_scope
+            scope = global_scope()
+        env = {n: v for n, v in scope.items()}
+        for n, a in (feed or {}).items():
+            env[n] = np.asarray(a) if not hasattr(a, "dtype") else a
+        report["measured"] = measure_op_times(prog, env,
+                                              tag=str(program._uid))
+    return report
+
+
+def format_table(report, topk=12):
+    """passes.stats()-style text table of the top-k rows."""
+    lines = [f"{'#':>4} {'op':<28} {'GFLOP':>10} {'MiB':>9} "
+             f"{'est_ms':>8} {'share':>6}  bound"]
+    for r in report["ops"][:topk]:
+        lines.append(
+            f"{r['index']:>4} {r['type'][:28]:<28} "
+            f"{r['flops'] / 1e9:>10.3f} {r['bytes'] / 2**20:>9.2f} "
+            f"{r['est_ms']:>8.3f} {r['share'] * 100:>5.1f}%  "
+            f"{r['bound']}")
+    t = report["totals"]
+    lines.append(f"{'':>4} {'TOTAL (' + str(report['n_ops']) + ' ops)':<28} "
+                 f"{t['flops'] / 1e9:>10.3f} {t['bytes'] / 2**20:>9.2f} "
+                 f"{t['est_ms']:>8.3f}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# HBM live-set memory profiler (liveness + shapes -> byte timeline).
+# ---------------------------------------------------------------------------
+
+def memory_profile(program, fetch_names=(), feed=None, batch=None,
+                   topk=8, optimize=False):
+    """Byte-weighted live-set timeline over the global block.
+
+    Persistable vars (params, optimizer state) are the resident
+    baseline — live across the whole program. A temporary is live from
+    the op that defines it through its last read (def-use chains,
+    framework/analysis.py); fed vars are live from op 0; fetch targets
+    stay live to the end. Returns::
+
+        {"peak_bytes", "peak_op_index", "peak_op_type",
+         "baseline_bytes", "timeline": [bytes per op index],
+         "top": [{"name", "bytes", "producer", "kind"}, ...],  # at peak
+         "n_ops"}
+    """
+    from ..framework.passes import optimize_program
+    if isinstance(fetch_names, str):
+        fetch_names = (fetch_names,)
+    prog = optimize_program(program, fetch_names=tuple(fetch_names)) \
+        if optimize else program
+    block = prog.global_block()
+    ops = block.ops
+    n = len(ops)
+    shapes = _shape_table(prog, feed=feed, batch=batch)
+    memo = {}
+
+    persist = set()
+    for name, v in block.vars.items():
+        if getattr(v, "persistable", False):
+            persist.add(name)
+    baseline = sum(_var_bytes(prog, shapes, p, memo) for p in persist)
+
+    first_def, last_use, producer = {}, {}, {}
+    for i, op in enumerate(ops):
+        for nm in op.input_arg_names:
+            if nm in persist:
+                continue
+            last_use[nm] = i
+            first_def.setdefault(nm, 0)        # fed/scope state: live at 0
+        for nm in op.output_arg_names:
+            if nm in persist:
+                continue
+            first_def.setdefault(nm, i)
+            last_use[nm] = max(last_use.get(nm, i), i)
+            producer.setdefault(nm, op.type)
+    for nm in fetch_names:
+        if nm in first_def:
+            last_use[nm] = n - 1
+
+    # sweep: +bytes at first_def, -bytes after last_use
+    delta = [0] * (n + 1)
+    for nm, d0 in first_def.items():
+        b = _var_bytes(prog, shapes, nm, memo)
+        if not b:
+            continue
+        delta[d0] += b
+        delta[last_use.get(nm, d0) + 1] -= b
+    timeline = []
+    cur = baseline
+    peak, peak_idx = baseline, 0
+    for i in range(n):
+        cur += delta[i]
+        timeline.append(cur)
+        if cur > peak:
+            peak, peak_idx = cur, i
+    top = []
+    for nm, d0 in first_def.items():
+        if d0 <= peak_idx <= last_use.get(nm, d0):
+            b = _var_bytes(prog, shapes, nm, memo)
+            if b:
+                top.append({"name": nm, "bytes": b,
+                            "producer": producer.get(nm, "feed"),
+                            "kind": "temp"})
+    for p in persist:
+        b = _var_bytes(prog, shapes, p, memo)
+        if b:
+            top.append({"name": p, "bytes": b, "producer": "persistable",
+                        "kind": "param"})
+    top.sort(key=lambda r: -r["bytes"])
+    return {
+        "peak_bytes": int(peak),
+        "peak_op_index": int(peak_idx),
+        "peak_op_type": ops[peak_idx].type if n else None,
+        "baseline_bytes": int(baseline),
+        "timeline": timeline,
+        "top": top[:topk],
+        "n_ops": n,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Measured mode: eager, synced op-by-op interpretation with spans + the
+# hbm_live_bytes counter track.
+# ---------------------------------------------------------------------------
+
+def _replay_safe(program):
+    """Only pure programs replay: a measured replay EXECUTES every op a
+    second time, and a side-effecting op (print, py_func, PS push)
+    must never run twice for telemetry."""
+    from ..framework.analysis import is_side_effect_type
+    for blk in program.blocks:
+        for op in blk.ops:
+            if is_side_effect_type(op.type):
+                return False
+    return True
+
+
+def measure_op_times(program, env, tag="program", mem=None,
+                     allow_side_effects=False, sync=True):
+    """Interpret the global block eagerly over ``env`` (a plain dict —
+    the caller's scope/feed values; never written back), timing each op
+    with a device sync in between. Emits:
+
+    - ``op/<type>#<i>`` spans as children of one ``profile/ops_<tag>``
+      parent (under the ambient trace context when one is active, so a
+      traced request's flame chart nests op-level detail under its
+      execute span) — always recorded (traced spans bypass the
+      profiler-active gate);
+    - a ``hbm_live_bytes`` counter sample per op (the live-set estimate
+      from :func:`memory_profile`, with -1 batch dims resolved from the
+      REAL fed arrays in ``env``) while the profiler is active;
+    - a ``passes.stats()``-style row table, also stored for
+      :func:`last_op_profile`.
+
+    Returns ``{"tag", "rows", "total_ms", "n_ops"}`` or ``None`` when
+    the program is not replay-safe (side-effecting ops present) —
+    unless ``allow_side_effects`` (the explicit, user-invoked
+    ``profiler.profile_program`` path, where this walk IS the one
+    execution rather than a replay next to one).
+    """
+    if not allow_side_effects and not _replay_safe(program):
+        return None
+    import jax
+    from ..framework.lowering import LowerCtx, run_op
+    if mem is None:
+        # resolve -1 (batch) dims from the arrays actually bound in the
+        # env, so the counter track reports the REAL live set, not a
+        # batch-1 one disagreeing with the estimate tables
+        feed_shapes = {
+            n: tuple(np.shape(env[n]))
+            for n, v in program.global_block().vars.items()
+            if getattr(v, "is_data", False) and n in env}
+        mem = memory_profile(program, feed=feed_shapes or None)
+    timeline = mem["timeline"]
+    block = program.global_block()
+    base_key = env.get("@RNG_KEY@")
+    if base_key is None:
+        base_key = jax.random.PRNGKey(0)
+    ctx = LowerCtx(program, block, dict(env), base_key)
+    parent = _tracing.current() or _tracing.new_trace()
+    rows = []
+    t_begin = time.perf_counter()
+    with _tracing.ambient(parent):
+        with _tracing.span(f"profile/ops_{tag}") as span_ctx:
+            for i, op in enumerate(block.ops):
+                t0 = time.perf_counter()
+                run_op(ctx, op)
+                if sync:
+                    for nm in op.output_arg_names:
+                        v = ctx.env.get(nm)
+                        if hasattr(v, "block_until_ready"):
+                            v.block_until_ready()
+                t1 = time.perf_counter()
+                _tracing.record_child(f"op/{op.type}#{i}", t0, t1,
+                                      span_ctx)
+                if i < len(timeline):
+                    _prof.record_counter("hbm_live_bytes", t1,
+                                         timeline[i])
+                rows.append({"index": i, "type": op.type,
+                             "ms": (t1 - t0) * 1e3})
+    total_ms = (time.perf_counter() - t_begin) * 1e3
+    out = {"tag": str(tag), "rows": rows, "total_ms": total_ms,
+           "n_ops": len(rows),
+           "peak_bytes": mem["peak_bytes"],
+           "peak_op_index": mem["peak_op_index"]}
+    with _last_lock:
+        _last["measured"] = out
+    _REPLAYS.inc()
+    _REPLAY_MS.inc(total_ms)
+    return out
+
+
+def last_op_profile():
+    """The most recent measured per-op table (None until a measured
+    replay ran — via ``FLAGS_profile_ops`` sampling in the executor or
+    ``profile_program(measured=True)``)."""
+    with _last_lock:
+        return _last["measured"]

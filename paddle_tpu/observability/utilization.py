@@ -24,6 +24,7 @@ wall time — the raw ``device_flops_total`` / ``device_hbm_bytes_total``
 counters ride along for the same reason.
 """
 import threading
+import time
 from collections import deque
 
 from .metrics import default_registry
@@ -49,18 +50,6 @@ HBM_PEAK = {
 
 _override = {"flops": None, "bytes": None}
 
-_MFU = default_registry().gauge(
-    "device_mfu_ratio",
-    "achieved / peak FLOP rate over the recent measured-execution "
-    "window (utilization WHILE executing; duty cycle comes from "
-    "device_compute_ms_total vs wall clock)",
-    labels=("where",), max_series=16)
-_BW = default_registry().gauge(
-    "device_hbm_bw_util_ratio",
-    "achieved / peak HBM bandwidth over the recent measured-execution "
-    "window (clamped at 1.0: XLA bytes-accessed is pre-fusion and can "
-    "overcount)",
-    labels=("where",), max_series=16)
 _FLOPS = default_registry().counter(
     "device_flops_total", "cost_analysis FLOPs dispatched",
     labels=("where",), max_series=16)
@@ -149,6 +138,28 @@ def executable_cost(compiled):
         return None
 
 
+def executable_memory(compiled):
+    """Device-memory footprint of a compiled executable from XLA's
+    ``memory_analysis()``: argument/output/temp/alias byte sizes plus a
+    derived ``peak_bytes`` (args + temps + outputs - aliased, i.e. the
+    live bytes while the executable runs — the validation target for
+    the static HBM live-set profiler). None when the backend reports
+    nothing."""
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        tmp = int(ma.temp_size_in_bytes)
+        alias = int(ma.alias_size_in_bytes)
+        if arg <= 0 and out <= 0 and tmp <= 0:
+            return None
+        return {"argument_bytes": arg, "output_bytes": out,
+                "temp_bytes": tmp, "alias_bytes": alias,
+                "peak_bytes": arg + out + tmp - alias}
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        return None
+
+
 def cost_for(memo, key, compiled):
     """:func:`executable_cost` for ``compiled``, memoized in the LRU
     ``memo`` under ``key`` (False = backend reports nothing). Misses
@@ -169,34 +180,52 @@ class _Window:
     decode loop, the micro-batcher and the executor never contend on
     one global lock for O(window) re-summation. The totals are
     recomputed from the deque every 4096 observations to shed
-    accumulated float drift."""
+    accumulated float drift. Each observation also stamps wall time
+    (monotonic) — the staleness contract below reads the stamps."""
 
-    __slots__ = ("obs", "t", "f", "b", "n", "lock")
+    __slots__ = ("obs", "t", "f", "b", "n", "lock", "last_wall")
 
     def __init__(self):
-        self.obs = deque(maxlen=64)     # (seconds, flops, bytes)
+        self.obs = deque(maxlen=64)     # (seconds, flops, bytes, wall)
         self.t = self.f = self.b = 0.0
         self.n = 0
+        self.last_wall = 0.0
         self.lock = threading.Lock()
 
     def add(self, seconds, flops, nbytes):
+        now = time.monotonic()
         with self.lock:
             if len(self.obs) == self.obs.maxlen:
-                es, ef, eb = self.obs[0]
+                es, ef, eb, _ew = self.obs[0]
                 self.t -= es
                 self.f -= ef
                 self.b -= eb
-            self.obs.append((seconds, flops, nbytes))
+            self.obs.append((seconds, flops, nbytes, now))
             self.t += seconds
             self.f += flops
             self.b += nbytes
             self.n += 1
+            self.last_wall = now
             if self.n % 4096 == 0:      # shed float drift
                 self.t = sum(o[0] for o in self.obs)
                 self.f = sum(o[1] for o in self.obs)
                 self.b = sum(o[2] for o in self.obs)
-            return self.t, self.f, self.b
 
+    def snapshot(self):
+        """(exec_seconds, flops, bytes, wall_span, last_wall) of the
+        retained window — one consistent copy."""
+        with self.lock:
+            if not self.obs:
+                return None
+            span = self.last_wall - self.obs[0][3]
+            return self.t, self.f, self.b, span, self.last_wall
+
+
+# a window is STALE once it has been idle longer than the wall span it
+# covers (floored so a two-observation window isn't stale a split
+# second later): a stopped/idle server must read as "no current
+# utilization", not as its last busy-period gauge forever
+_STALE_FLOOR_S = 1.0
 
 _windows = {}
 _lock = threading.Lock()        # guards the _windows dict only
@@ -206,8 +235,9 @@ def observe_execution(where, cost, seconds):
     """Attach one timed execution of an executable with ``cost``
     (:func:`executable_cost` dict) to the live gauges for ``where``
     ("train", "step", "infer", "prefill", "decode", ...). Counters bump
-    unconditionally; the MFU/BW gauges update only when the device's
-    peaks are known."""
+    unconditionally; the MFU/BW ratio gauges are derived from the
+    sliding window AT SCRAPE TIME (see :func:`_collect_ratios`) so an
+    idle window goes stale instead of freezing at its last value."""
     if not cost or seconds <= 0:    # None AND cost_for's False sentinel
         return
     flops, nbytes = cost["flops"], cost["bytes"]
@@ -222,24 +252,95 @@ def observe_execution(where, cost, seconds):
     if w is None:
         with _lock:
             w = _windows.setdefault(where, _Window())
-    t, f, b = w.add(seconds, flops, nbytes)
+    w.add(seconds, flops, nbytes)
+
+
+def _window_ratios(where, now=None):
+    """(mfu, bw, stale) computed from the retained window, or None when
+    never observed / peaks unknown. Each ratio is individually None
+    when ITS peak is unknown (an operator who only set the FLOP peak
+    must not export a false 0.0 bandwidth utilization)."""
+    w = _windows.get(where)
+    if w is None:
+        return None
+    snap = w.snapshot()
+    if snap is None:
+        return None
+    t, f, b, span, last_wall = snap
     if t <= 0:
-        return
-    if pf:
-        _MFU.set(min(f / t / pf, 1.0), labels=lab)
-    if pb:
-        _BW.set(min(b / t / pb, 1.0), labels=lab)
+        return None
+    pf, pb = _default_peaks()
+    if pf is None and pb is None:
+        return None
+    now = time.monotonic() if now is None else now
+    stale = (now - last_wall) > max(span, _STALE_FLOOR_S)
+    mfu = min(f / t / pf, 1.0) if pf else None
+    bw = min(b / t / pb, 1.0) if pb else None
+    return mfu, bw, stale
+
+
+def _collect_ratios():
+    """Scrape-time collector for the MFU / HBM-bw ratio gauges: derived
+    from the sliding windows at scrape time, SKIPPING stale windows —
+    a stopped server's exposition simply stops carrying the series
+    instead of exporting its last busy reading forever."""
+    with _lock:
+        wheres = list(_windows)
+    mfu_s, bw_s = [], []
+    now = time.monotonic()
+    for where in wheres:
+        r = _window_ratios(where, now=now)
+        if r is None or r[2]:           # unknown peaks / stale: skip
+            continue
+        if r[0] is not None:
+            mfu_s.append(((where,), r[0]))
+        if r[1] is not None:
+            bw_s.append(((where,), r[1]))
+    return [
+        {"name": "device_mfu_ratio", "kind": "gauge",
+         "help": "achieved / peak FLOP rate over the recent "
+                 "measured-execution window (utilization WHILE "
+                 "executing; stale/idle windows are omitted — duty "
+                 "cycle comes from device_compute_ms_total vs wall "
+                 "clock)",
+         "labels": ("where",), "samples": mfu_s},
+        {"name": "device_hbm_bw_util_ratio", "kind": "gauge",
+         "help": "achieved / peak HBM bandwidth over the recent "
+                 "measured-execution window (clamped at 1.0: XLA "
+                 "bytes-accessed is pre-fusion and can overcount; "
+                 "stale/idle windows are omitted)",
+         "labels": ("where",), "samples": bw_s},
+    ]
+
+
+default_registry().register_collector(
+    _collect_ratios,
+    families=[
+        {"name": "device_mfu_ratio", "kind": "gauge",
+         "help": "achieved / peak FLOP rate over the recent "
+                 "measured-execution window", "labels": ("where",)},
+        {"name": "device_hbm_bw_util_ratio", "kind": "gauge",
+         "help": "achieved / peak HBM bandwidth over the recent "
+                 "measured-execution window", "labels": ("where",)},
+    ])
 
 
 def utilization(where):
-    """Current gauge readings {mfu, hbm_bw_util} for ``where`` (0.0
-    when never observed / peaks unknown)."""
-    return {"mfu": _MFU.value(labels=(where,)),
-            "hbm_bw_util": _BW.value(labels=(where,))}
+    """Current window readings ``{mfu, hbm_bw_util, stale}`` for
+    ``where`` (zeros / stale=False when never observed or peaks
+    unknown). ``stale=True`` means the window has been idle longer
+    than the wall span it covers — the reading describes a PAST busy
+    period, not the present (the Prometheus collector omits the series
+    entirely in that state)."""
+    r = _window_ratios(where)
+    if r is None:
+        return {"mfu": 0.0, "hbm_bw_util": 0.0, "stale": False}
+    return {"mfu": r[0] or 0.0, "hbm_bw_util": r[1] or 0.0,
+            "stale": r[2]}
 
 
 def reset_windows():
-    """Drop the sliding windows (tests; gauges keep their last value
-    until the next observation)."""
+    """Drop the sliding windows (tests; the ratio series disappear from
+    the exposition until the next observation)."""
     with _lock:
         _windows.clear()

@@ -94,6 +94,32 @@ def record_span(name, start_s, end_s, trace=None):
         _spans.append(row if trace is None else row + tuple(trace))
 
 
+# counter track: (name, t_s, value) samples — the memory profiler's
+# hbm_live_bytes live-set timeline rides here so tools/timeline.py can
+# render a Perfetto counter track under the op-level spans. Bounded
+# like the span table; recorded only under an active profiler (the
+# always-on path is the measured-op TABLE, not the counter track).
+_counters = _collections.deque()
+_MAX_COUNTERS = 100000
+
+
+def record_counter(name, t_s, value):
+    """Append one counter sample to the counter track (no-op while
+    profiling is inactive; silently bounded at ``_MAX_COUNTERS``)."""
+    if not _active:
+        return
+    with _spans_lock:
+        if len(_counters) >= _MAX_COUNTERS:
+            return
+        _counters.append((str(name), float(t_s), float(value)))
+
+
+def counters():
+    """Snapshot of the counter track (name, t_s, value) rows."""
+    with _spans_lock:
+        return [list(c) for c in _counters]
+
+
 def spans_dropped():
     """Spans lost to the ``_MAX_SPANS`` cap since the last
     ``reset_profiler()``."""
@@ -158,6 +184,7 @@ def reset_profiler():
     _events.clear()
     with _spans_lock:
         _spans.clear()
+        _counters.clear()
         _spans_dropped = 0
     for i in range(len(_step_hist)):
         _step_hist[i] = 0
@@ -208,10 +235,12 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         _trace_dir = None
     with _spans_lock:       # a traced request may append mid-dump
         span_snapshot = [list(s) for s in _spans]
+        counter_snapshot = [list(c) for c in _counters]
     if profile_path and span_snapshot:
         import json
         with open(profile_path, "w") as f:
             json.dump({"spans": span_snapshot,
+                       "counters": counter_snapshot,
                        "dropped": _spans_dropped}, f)
     if _spans_dropped:
         print(f"[profiler] {_spans_dropped} spans dropped (span table "
@@ -276,12 +305,14 @@ def profile_program(program, feed, scope=None, repeat=1, sync=True):
     timing each op's lowering+execution eagerly (block_until_ready between
     ops). Normal execution fuses everything into one XLA module, so this
     is the explicit op-cost probe (reference pays this bookkeeping on
-    every run — profiler.cc RecordEvent around each op->Run).
+    every run — profiler.cc RecordEvent around each op->Run). One
+    replay loop serves this, FLAGS_profile_ops sampling, and
+    profile_program(measured=True): observability.profiling.
+    measure_op_times (side effects allowed here — this walk IS the
+    execution the caller asked for, not a replay next to one).
     Returns [(op_type, calls, total_s)] sorted by total."""
-    import jax
     from .framework.executor import global_scope
-    from .framework.lowering import LowerCtx, run_op
-    from .framework.registry import get_op_def  # noqa: F401 (op check)
+    from .observability import profiling as _profiling
 
     scope = scope or global_scope()
     env = {}
@@ -291,20 +322,13 @@ def profile_program(program, feed, scope=None, repeat=1, sync=True):
         env[name] = np.asarray(val)
     per_op = {}
     for _ in range(repeat):
-        ctx = LowerCtx(program, program.global_block(), env,
-                       jax.random.PRNGKey(0))
-        for op in program.global_block().ops:
-            t0 = time.perf_counter()
-            run_op(ctx, op)
-            if sync:
-                for n in op.output_arg_names:
-                    v = env.get(n)
-                    if hasattr(v, "block_until_ready"):
-                        v.block_until_ready()
-            dt = time.perf_counter() - t0
-            row = per_op.setdefault(op.type, [0, 0.0])
+        out = _profiling.measure_op_times(
+            program, env, tag=f"program_{program._uid}",
+            allow_side_effects=True, sync=sync)
+        for r in out["rows"]:
+            row = per_op.setdefault(r["type"], [0, 0.0])
             row[0] += 1
-            row[1] += dt
+            row[1] += r["ms"] / 1e3
     rows = sorted(((t, c, tot) for t, (c, tot) in per_op.items()),
                   key=lambda r: -r[2])
     return rows

@@ -839,7 +839,7 @@ class DecodeBatcher:
                 # <= slots entries per step
                 traced = [r for r in self._active.values()
                           if r.trace is not None]
-                t_step0 = time.perf_counter() if traced else 0.0
+                t_step0 = time.perf_counter()
                 try:
                     toks = self.engine.step(self._tok, self._pos,
                                             self._temp, self._topk,
@@ -868,6 +868,11 @@ class DecodeBatcher:
                                             t_step1, r.trace)
                 live = len(self._active)
                 if self.stats:
+                    # inter-token latency: the WHOLE step's wall time
+                    # (decode + sample + any stall), the signal the SLO
+                    # monitor's default p99 rule evaluates windowed
+                    self.stats.hist["token"].observe(
+                        time.perf_counter() - t_step0)
                     self.stats.observe_decode_step(live, self.slots)
                 for slot in list(self._active):
                     req = self._active[slot]

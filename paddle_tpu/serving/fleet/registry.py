@@ -88,13 +88,18 @@ class Replica:
         the freshest signal (they move between probes); the probed
         queue depths and active decode rows cover traffic from other
         routers/clients; kvpool occupancy (0..1) is weighted x4 so a
-        nearly-full pool loses ties well before it starts shedding."""
+        nearly-full pool loses ties well before it starts shedding; a
+        replica whose SLO monitor reports breached rules (p99,
+        queue/kvpool pressure — observability/slo.py) takes an 8-point
+        penalty PER breached rule, so dispatch shifts away from a
+        regressed replica before clients feel its tail."""
         h = self.last_health
         depth = (h.get("queue_depth", 0) or 0) \
             + (h.get("decode_queue_depth", 0) or 0) \
             + (h.get("decode_active_rows", 0) or 0)
         occ = float(h.get("kvpool_occupancy", 0.0) or 0.0)
-        return self.inflight + depth + 4.0 * occ
+        slo = int(h.get("slo_breached", 0) or 0)
+        return self.inflight + depth + 4.0 * occ + 8.0 * slo
 
     def dispatchable(self):
         return (self.state == "healthy"
@@ -120,6 +125,7 @@ class Replica:
             "decode_queue_depth": h.get("decode_queue_depth", 0),
             "decode_active_rows": h.get("decode_active_rows", 0),
             "kvpool_occupancy": h.get("kvpool_occupancy", 0.0),
+            "slo_breached": h.get("slo_breached", 0),
             "weights_version": h.get("weights_version"),
             "load_score": round(self.load_score(), 3),
         }

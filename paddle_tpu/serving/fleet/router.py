@@ -36,6 +36,7 @@ already has into a fleet:
   reloads ONE replica at a time through the PR-6 ``reload_weights``
   machinery — the fleet never loses more than one replica of capacity.
 """
+import re
 import socket
 import threading
 import time
@@ -81,15 +82,100 @@ _KV_MIG_BYTES = default_registry().counter(
     "payload array bytes streamed prefill->decode across replicas",
     labels=("router",), max_series=8)
 
+_FLEET_SCRAPE_FAILS = default_registry().counter(
+    "router_fleet_scrape_failures_total",
+    "replica metric scrapes that failed during fleet-wide aggregation",
+    labels=("router",), max_series=8)
+
 _COUNTERS = ("dispatches", "failovers", "hedges", "hedge_wins",
              "dedup_hits", "kv_migrations", "kv_migrated_bytes",
-             "rolling_reloads", "no_replica_refusals")
+             "rolling_reloads", "no_replica_refusals",
+             "fleet_scrape_failures")
 
 # flight-recorder event kinds the fleet emits (Router.stats surfaces
 # their in-ring counts; the debug_dump wire op returns the events)
 FLEET_EVENT_KINDS = ("replica_death", "replica_evicted",
                      "replica_readmitted", "failover", "kv_migration",
                      "rolling_reload")
+
+
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+
+
+def _merge_expositions(sources, max_replicas=16):
+    """Merge ``[(replica_label, prometheus_text)]`` into ONE exposition
+    where every sample line carries a ``replica`` label (the router's
+    own process metrics ride as ``replica="router"``). Family HELP/TYPE
+    headers are emitted once (first seen — duplicate family blocks are
+    invalid exposition); sources past ``max_replicas`` fold into
+    ``replica="_other"`` with values SUMMED per series, the same
+    bounded-cardinality overflow idiom the registry families use."""
+    order, meta, fam_lines = [], {}, {}
+    other, other_order = {}, {}
+    histograms = set()
+
+    def _family(fam):
+        if fam not in meta:
+            meta[fam] = {}
+            order.append(fam)
+            fam_lines[fam] = []
+        return meta[fam]
+
+    for idx, (label, text) in enumerate(sources):
+        fold = idx >= max_replicas
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                parts = line.split(" ", 3)
+                if len(parts) < 3:
+                    continue
+                m = _family(parts[2])
+                m.setdefault(parts[1], line)
+                if parts[1] == "TYPE" and len(parts) > 3 \
+                        and parts[3].strip() == "histogram":
+                    histograms.add(parts[2])
+                continue
+            if not line.strip() or line.startswith("#"):
+                continue
+            sm = _SAMPLE_RE.match(line)
+            if sm is None:
+                continue
+            name, labelstr, value = sm.group(1), sm.group(3) or "", \
+                sm.group(4)
+            fam = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) \
+                        and name[: -len(suffix)] in histograms:
+                    fam = name[: -len(suffix)]
+                    break
+            _family(fam)
+            if fold:
+                try:
+                    v = float(value)
+                except ValueError:
+                    continue
+                k = (name, labelstr)
+                if k not in other:
+                    other[k] = 0.0
+                    other_order.setdefault(fam, []).append(k)
+                other[k] += v
+            else:
+                inner = f'replica="{label}"' \
+                    + ("," + labelstr if labelstr else "")
+                fam_lines[fam].append(f"{name}{{{inner}}} {value}")
+    out = []
+    for fam in order:
+        for key in ("HELP", "TYPE"):
+            if key in meta[fam]:
+                out.append(meta[fam][key])
+        out.extend(fam_lines[fam])
+        for name, labelstr in other_order.get(fam, ()):
+            v = other[(name, labelstr)]
+            vs = str(int(v)) if v == int(v) else repr(v)
+            inner = 'replica="_other"' \
+                + ("," + labelstr if labelstr else "")
+            out.append(f"{name}{{{inner}}} {vs}")
+    return "\n".join(out) + "\n"
 
 
 class _InflightCall:
@@ -311,6 +397,44 @@ class Router:
             "replicas_healthy": self.registry.healthy_count(),
             "disaggregated": self.disaggregated,
         }
+
+    def fleet_metrics(self, max_replicas=16):
+        """Fleet-wide metrics aggregation (the ``"metrics"`` wire op's
+        reply): scrape every registered replica's Prometheus exposition
+        over the wire and re-expose all samples — the router's own
+        process metrics included — with a ``replica`` label, so ONE
+        scrape sees the whole fleet. Replicas past ``max_replicas``
+        fold into ``replica="_other"`` (summed — the bounded-
+        cardinality overflow idiom); a replica that fails its scrape is
+        skipped and counted (``router_fleet_scrape_failures_total``)
+        rather than failing the whole scrape."""
+        sources = [("router", render_metrics())]
+        failures = 0
+        for rep in self.registry.all():
+            if rep.state == "evicted":
+                # a dead replica would burn a full connect timeout PER
+                # SCRAPE (serially — 5 dead replicas blow a Prometheus
+                # scrape_timeout); the prober readmits it when it
+                # answers health again, and then it is scraped
+                continue
+            try:
+                reply = self._exchange(rep.endpoint, {"op": "metrics"},
+                                       self.registry.probe_timeout_s)
+            except Exception:  # noqa: BLE001 — one replica never kills
+                failures += 1  # the fleet scrape
+                continue
+            if reply.get("ok") and isinstance(reply.get("metrics"),
+                                              str):
+                sources.append((rep.endpoint, reply["metrics"]))
+            else:
+                failures += 1
+        if failures:
+            _FLEET_SCRAPE_FAILS.inc(failures, labels=(self.name,))
+            self._bump("fleet_scrape_failures", failures)
+        # +1: the router's own exposition occupies slot 0 and must not
+        # count a replica out of the cap
+        return _merge_expositions(sources,
+                                  max_replicas=max_replicas + 1)
 
     # -- downstream socket pool -------------------------------------------
     def _checkout(self, endpoint, timeout):
@@ -776,7 +900,11 @@ class Router:
                 if op == "stats":
                     return {"ok": True, "stats": self.stats()}
                 if op == "metrics":
-                    return {"ok": True, "metrics": render_metrics()}
+                    # the fleet aggregation: every live replica's
+                    # samples re-exposed with a replica label (one
+                    # scrape sees the fleet; tools/export_metrics.py
+                    # --router is the textfile-collector front-end)
+                    return {"ok": True, "metrics": self.fleet_metrics()}
                 if op == "health":
                     return {"ok": True, "health": self.health()}
                 return self._handle_cancel(msg)

@@ -220,8 +220,12 @@ class ServingStats:
               # generation pipeline stages (KV-cached decoding):
               # prefill = prompt ingestion forward, decode = one
               # incremental step over the slot batch, sample = the
-              # next-token selection executable
-              "prefill", "decode", "sample")
+              # next-token selection executable, token = one WHOLE
+              # decode-loop step (engine.step wall: decode + sample +
+              # host work — the inter-token latency the SLO monitor's
+              # default p99 rule watches; a stall anywhere in the step
+              # lands here even if the compiled call itself was fast)
+              "prefill", "decode", "sample", "token")
 
     def __init__(self):
         self.hist = {s: LatencyHistogram(f"serving/{s}")

@@ -134,7 +134,8 @@ class InferenceServer:
                  decode_slots=None, config=None,
                  host="127.0.0.1", port=0, auth_key=None,
                  allow_insecure=False, kv_paged=None,
-                 kv_pool_name="serving", **config_overrides):
+                 kv_pool_name="serving", slo_rules=None,
+                 **config_overrides):
         self.config = config or ServingConfig(**config_overrides)
         self.stats_sink = ServingStats()
         if engine is None and (model_dir is not None
@@ -186,6 +187,14 @@ class InferenceServer:
             self.supervisor.add("microbatcher", self.batcher)
         if self.decode_batcher is not None:
             self.supervisor.add("decode", self.decode_batcher)
+        # SLO guardrails: declarative rules (default: p99 inter-token
+        # latency, queue-depth ratios, kvpool occupancy, optional MFU
+        # floor) evaluated on a supervised loop; breach state rides
+        # health() so the fleet Router penalizes a breached replica's
+        # dispatch score. Built in start() (FLAGS_slo_monitor) so the
+        # default rules bind the final queue/engine wiring.
+        self._slo_rules = slo_rules
+        self.slo_monitor = None
         self.host = host
         self.port = int(port)
         self._key = auth_key if auth_key is not None else default_key()
@@ -262,6 +271,20 @@ class InferenceServer:
                                  name="serving-accept")
             t.start()
             self._threads.append(t)
+        from ..flags import flag as _flag
+        if _flag("slo_monitor") and self.slo_monitor is None:
+            from ..observability import slo as _slo
+            if callable(self._slo_rules):
+                rules = self._slo_rules(self)   # rules need live wiring
+            elif self._slo_rules is not None:
+                rules = self._slo_rules         # [] = monitor off
+            else:
+                rules = _slo.default_server_rules(self)
+            if rules:
+                scope = self.endpoint if serve_network \
+                    else f"server-{id(self) & 0xffffff:x}"
+                self.slo_monitor = _slo.SloMonitor(rules,
+                                                   scope=scope).start()
         self._set_state("serving", only_from=("warming", "created"))
         return self
 
@@ -309,6 +332,9 @@ class InferenceServer:
 
     def stop(self):
         self._set_state("stopped")
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
+            self.slo_monitor = None
         self.supervisor.stop()
         self._stop.set()
         if self._sock is not None:
@@ -459,6 +485,13 @@ class InferenceServer:
             "loops": self.supervisor.snapshot(),
             "breaker": self.supervisor.breaker.state,
         }
+        if self.slo_monitor is not None:
+            # the Router's dispatch-score penalty reads this: current
+            # SLO breach state next to the load signals, one cheap probe
+            breached = self.slo_monitor.breached()
+            h["slo_breached"] = len(breached)
+            if breached:
+                h["slo_breached_rules"] = ",".join(sorted(breached))
         if self.queue is not None:
             h["queue_depth"] = len(self.queue)
         if self.gen_queue is not None:

@@ -486,6 +486,60 @@ def test_timeline_round_trip(tmp_path):
     profiler.reset_profiler()
 
 
+def test_timeline_op_spans_and_memory_counter_round_trip(tmp_path):
+    """Satellite: a FLAGS_profile_ops measured replay -> op-level child
+    spans + the hbm_live_bytes counter track -> stop_profiler JSON ->
+    timeline.py -> valid Perfetto/Chrome JSON: counter ("C") events
+    with monotone timestamps at op boundaries, op spans parent-chained
+    under one profile span."""
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        y = layers.mean(layers.relu(layers.fc(x, 4)))
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_profile_ops": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                    fetch_list=[y])
+    finally:
+        fluid.set_flags({"FLAGS_profile_ops": 0})
+    prof_path = str(tmp_path / "prof.json")
+    out_path = str(tmp_path / "timeline.json")
+    profiler.stop_profiler(profile_path=prof_path)
+    with open(prof_path) as f:
+        doc = json.load(f)
+    assert doc.get("counters"), "hbm_live_bytes track missing"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "timeline.py"),
+         "--profile_path", prof_path, "--timeline_path", out_path],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    with open(out_path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    ops = [e for e in events if e["ph"] == "X"
+           and e["name"].startswith("op/")]
+    parents = [e for e in events if e["ph"] == "X"
+               and e["name"].startswith("profile/ops_")]
+    assert ops and parents
+    parent_ids = {p["args"]["span_id"] for p in parents}
+    assert all(e["args"]["parent_span_id"] in parent_ids
+               for e in ops), "op spans must chain under profile/ops"
+    counters = [e for e in events if e["ph"] == "C"
+                and e["name"] == "hbm_live_bytes"]
+    assert counters
+    ts = [e["ts"] for e in counters]
+    assert ts == sorted(ts), "counter samples must be time-monotone"
+    assert all(e["args"]["value"] >= 0 for e in counters)
+    profiler.reset_profiler()
+
+
 # ------------------------------------------- wire integration (server)
 
 def _save_mlp(tmp_path, in_dim=8, out_dim=4):

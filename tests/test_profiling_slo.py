@@ -1,0 +1,556 @@
+"""Performance attribution & SLO guardrails (PR 12): the per-op cost
+profiler, the HBM live-set memory profiler, FLAGS_profile_ops measured
+replays, the rule-driven SLO monitor (breach -> router dispatch shift ->
+recovery), fleet-wide metrics aggregation, and the utilization
+staleness fix."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, profiler, resilience, serving
+from paddle_tpu.observability import (flight_recorder, profiling,
+                                      render_metrics, set_peaks, slo,
+                                      tracing)
+from paddle_tpu.observability import utilization as util
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.serving.metrics import LatencyHistogram
+
+RNG = np.random.default_rng(7)
+
+
+def _train_program(in_dim=8, hidden=16):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, in_dim], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        h = layers.fc(x, hidden, act="relu")
+        loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+# ------------------------------------------------- per-op cost profiler
+
+def test_matmul_flop_estimate_exact():
+    """The matmul rule is the 2*M*K*N textbook count (forward op)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 8], dtype="float32")
+        out = layers.fc(x, 16)
+    report = profiling.profile_program(main, fetch_list=[out],
+                                       optimize=False, measured=False)
+    muls = [r for r in report["ops"] if r["type"] == "mul"]
+    assert muls and muls[0]["flops"] == 2.0 * 4 * 8 * 16
+    assert muls[0]["rule"] == "matmul"
+
+
+def test_profile_report_ranked_and_consistent():
+    main, startup, loss = _train_program()
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    report = profiling.profile_program(main, feed=feed,
+                                       fetch_list=[loss],
+                                       measured=False)
+    rows = report["ops"]
+    assert rows == sorted(rows, key=lambda r: -r["est_ms"])
+    assert report["n_ops"] == len(rows) > 5
+    tot = report["totals"]
+    assert tot["flops"] == pytest.approx(sum(r["flops"] for r in rows))
+    assert tot["bytes"] == sum(r["bytes"] for r in rows)
+    assert sum(r["share"] for r in rows) == pytest.approx(1.0)
+    assert all(r["bound"] in ("compute", "bandwidth") for r in rows)
+    # coverage against a (fake) XLA cost report
+    rep2 = profiling.profile_program(
+        main, feed=feed, fetch_list=[loss], measured=False,
+        cost={"flops": tot["flops"] * 2, "bytes": tot["bytes"]})
+    assert rep2["coverage"]["est_vs_xla_flops_ratio"] == \
+        pytest.approx(0.5)
+    assert rep2["coverage"]["est_vs_xla_bytes_ratio"] == \
+        pytest.approx(1.0)
+
+
+def test_profile_program_never_mutates_user_program():
+    main, _startup, loss = _train_program()
+    version = main.version
+    n_ops = len(main.global_block().ops)
+    profiling.profile_program(main, fetch_list=[loss], measured=False)
+    assert main.version == version
+    assert len(main.global_block().ops) == n_ops
+
+
+# --------------------------------------------- HBM live-set memory prof
+
+def test_memory_profile_liveness_timeline():
+    """relu chain: exactly two activations live at any op, and fetching
+    an INTERMEDIATE extends its liveness to the end."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4, 1024], dtype="float32")   # 16 KiB
+        a = layers.relu(x)
+        b = layers.relu(a)
+        c = layers.relu(b)
+    nb = 4 * 1024 * 4
+    mem = profiling.memory_profile(main, fetch_names=(c.name,))
+    assert mem["baseline_bytes"] == 0          # no persistables
+    assert mem["peak_bytes"] == 2 * nb
+    assert mem["timeline"] == [2 * nb, 2 * nb, 2 * nb]
+    # fetching `a` pins it live through the end: op 2 holds a, b, c
+    mem2 = profiling.memory_profile(main, fetch_names=(a.name, c.name))
+    assert mem2["peak_bytes"] == 3 * nb
+    assert mem2["peak_op_index"] == 2          # a (pinned) + b + c
+    top_names = [r["name"] for r in mem2["top"]]
+    assert a.name in top_names
+
+
+def test_memory_profile_params_are_baseline():
+    main, _startup, loss = _train_program(in_dim=8, hidden=16)
+    feed = {"x": np.zeros((4, 8), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    mem = profiling.memory_profile(main, fetch_names=(loss.name,),
+                                   feed=feed)
+    # fc weights + biases (8x16 + 16 + 16x1 + 1 floats) plus the SGD
+    # learning-rate scalar live the whole program
+    assert mem["baseline_bytes"] == (8 * 16 + 16 + 16 + 1 + 1) * 4
+    assert mem["peak_bytes"] > mem["baseline_bytes"]
+    kinds = {r["kind"] for r in mem["top"]}
+    assert "param" in kinds and "temp" in kinds
+
+
+# ------------------------------------- FLAGS_profile_ops measured mode
+
+def test_profile_ops_measured_replay_and_bitwise():
+    """flag=1 records a per-op table + op spans; committed numerics are
+    bitwise those of flag=0 (the replay is a side channel)."""
+    from paddle_tpu.observability.profiling import _REPLAYS
+    main, startup, loss = _train_program()
+    feed = {"x": RNG.standard_normal((4, 8)).astype(np.float32),
+            "y": RNG.standard_normal((4, 1)).astype(np.float32)}
+
+    def run_steps(flag, n=3):
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        out = []
+        with fluid.scope_guard(scope):
+            fluid.set_flags({"FLAGS_profile_ops": 0})
+            exe.run(startup)              # startup never counted
+            fluid.set_flags({"FLAGS_profile_ops": flag})
+            for _ in range(n):
+                v, = exe.run(main, feed=feed, fetch_list=[loss])
+                out.append(np.asarray(v))
+        return out
+
+    profiler.reset_profiler()
+    try:
+        off = run_steps(0)
+        base_replays = _REPLAYS.value()
+        on = run_steps(1)
+        assert _REPLAYS.value() == base_replays + 3
+        for a, b in zip(off, on):
+            assert np.array_equal(a, b), \
+                "FLAGS_profile_ops changed committed numerics"
+        prof = profiling.last_op_profile()
+        assert prof is not None
+        assert prof["n_ops"] == len(prof["rows"]) > 5
+        assert all(r["ms"] >= 0 for r in prof["rows"])
+        assert prof["peak_bytes"] > 0
+        # op spans landed as TRACED children of one profile parent
+        spans = [s for s in profiler._spans if len(s) >= 7]
+        op_spans = [s for s in spans if s[0].startswith("op/")]
+        parents = [s for s in spans
+                   if s[0].startswith("profile/ops_")]
+        assert op_spans and parents
+        parent_ids = {s[5] for s in parents}
+        assert all(s[6] in parent_ids for s in op_spans), \
+            "op spans must parent under the profile span"
+        # sampling: every 4th dispatch replays (1st, 5th of 6 runs)
+        base_replays = _REPLAYS.value()
+        run_steps(4, n=6)
+        assert _REPLAYS.value() == base_replays + 2
+    finally:
+        fluid.set_flags({"FLAGS_profile_ops": 0})
+        profiler.reset_profiler()
+
+
+def test_profile_ops_skips_side_effect_programs():
+    """A measured replay EXECUTES ops — side-effecting programs (print,
+    PS pushes) must never run twice for telemetry."""
+    from paddle_tpu.observability.profiling import _REPLAYS
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        out = layers.mean(layers.relu(x))
+        layers.Print(out, message="side effect")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    base = _REPLAYS.value()
+    fluid.set_flags({"FLAGS_profile_ops": 1})
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_profile_ops": 0})
+    assert _REPLAYS.value() == base
+
+
+# ------------------------------------------------------- SLO monitor
+
+def _getter_rule(box, name="unit_rule", threshold=10.0, **kw):
+    return slo.SloRule(name, ">", threshold,
+                       getter=lambda: box["v"], **kw)
+
+
+def test_slo_breach_and_recovery_cycle():
+    box = {"v": 0.0}
+    events = []
+    mon = slo.SloMonitor([_getter_rule(box)], scope="t_cycle",
+                         on_event=lambda r, b, v: events.append((r.name,
+                                                                 b, v)))
+    rec = flight_recorder()
+    mon.evaluate_once()
+    assert mon.breached_count() == 0
+    box["v"] = 42.0
+    mon.evaluate_once()
+    assert mon.breached() == ["unit_rule"]
+    assert slo._STATE.value(labels=("t_cycle", "unit_rule")) == 1
+    assert slo._BREACHED.value(labels=("t_cycle", "unit_rule")) == 1
+    assert events == [("unit_rule", True, 42.0)]
+    box["v"] = 1.0
+    mon.evaluate_once()
+    assert mon.breached_count() == 0
+    assert slo._STATE.value(labels=("t_cycle", "unit_rule")) == 0
+    assert events[-1] == ("unit_rule", False, 1.0)
+    kinds = [(e["kind"], e.get("rule")) for e in rec.snapshot()
+             if e.get("scope") == "t_cycle"]
+    assert ("slo_breach", "unit_rule") in kinds
+    assert ("slo_recovered", "unit_rule") in kinds
+
+
+def test_slo_for_s_hold_duration():
+    box = {"v": 99.0}
+    mon = slo.SloMonitor([_getter_rule(box, name="held", for_s=10.0)],
+                         scope="t_hold")
+    mon.evaluate_once(now=100.0)
+    assert mon.breached_count() == 0          # pending, not held yet
+    mon.evaluate_once(now=105.0)
+    assert mon.breached_count() == 0
+    mon.evaluate_once(now=110.5)
+    assert mon.breached() == ["held"]
+    # a dip resets the hold clock
+    box["v"] = 0.0
+    mon.evaluate_once(now=111.0)
+    box["v"] = 99.0
+    mon.evaluate_once(now=112.0)
+    assert mon.breached_count() == 0          # hold restarted
+
+
+def test_slo_windowed_histogram_quantile_recovers():
+    """The hist source is the quantile over the delta since the last
+    evaluation — a cumulative histogram can never recover, a windowed
+    one can; an empty window is healthy no-data."""
+    h = LatencyHistogram("slo_unit")
+    rule = slo.SloRule("p99_ms", ">", 100.0, hist=h, q=0.99)
+    mon = slo.SloMonitor([rule], scope="t_hist")
+    for _ in range(5):
+        h.observe(0.5)                         # 500 ms
+    mon.evaluate_once()
+    assert mon.breached() == ["p99_ms"]
+    for _ in range(50):
+        h.observe(0.001)                       # 1 ms window
+    mon.evaluate_once()
+    assert mon.breached_count() == 0
+    mon.evaluate_once()                        # empty window: no data
+    assert mon.breached_count() == 0
+
+
+def test_slo_registry_value_and_rate_sources():
+    reg = MetricsRegistry()
+    g = reg.gauge("unit_depth_count", labels=("q",))
+    c = reg.counter("unit_reqs_total")
+    g.set(5, labels=("a",))
+    c.inc()                                    # the series must exist
+    mon = slo.SloMonitor(
+        [slo.SloRule("depth", ">", 3.0, metric="unit_depth_count",
+                     labels=("a",)),
+         slo.SloRule("req_rate", ">", 10.0, metric="unit_reqs_total",
+                     source="rate")],
+        registry=reg, scope="t_reg")
+    mon.evaluate_once(now=0.0)
+    assert mon.breached() == ["depth"]         # rate: first eval no data
+    c.inc(100)
+    mon.evaluate_once(now=2.0)                 # 50/s > 10
+    assert sorted(mon.breached()) == ["depth", "req_rate"]
+    mon.evaluate_once(now=4.0)                 # no new incs: rate 0
+    assert mon.breached() == ["depth"]
+
+
+def test_bucket_quantile_interpolation():
+    from paddle_tpu.observability.slo import _bucket_quantile
+    bounds = (1.0, 10.0, 100.0)
+    assert _bucket_quantile(bounds, [0, 0, 0, 0], 0.99) is None
+    v = _bucket_quantile(bounds, [0, 10, 0, 0], 0.5)
+    assert 1.0 <= v <= 10.0
+    assert _bucket_quantile(bounds, [0, 0, 0, 5], 0.99) == 100.0
+
+
+def test_server_default_slo_monitor_wired(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        out = layers.fc(x, 4, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        path = str(tmp_path / "mlp")
+        fluid.io.save_inference_model(path, ["x"], [out], exe,
+                                      main_program=main)
+    server = serving.InferenceServer(path, batch_timeout_ms=1.0)
+    server.start(serve_network=False)
+    try:
+        assert server.slo_monitor is not None
+        names = [r.name for r in server.slo_monitor.rules]
+        assert "infer_queue_ratio" in names
+        h = server.health()
+        assert h["slo_breached"] == 0
+    finally:
+        server.stop()
+    assert server.slo_monitor is None
+
+
+def _tiny_gpt_server(scope_holder, slo_rules=None, **kw):
+    from paddle_tpu.models import gpt as gpt_mod
+    from paddle_tpu.models.generation import GPTGenerator
+    cfg = gpt_mod.GPTConfig.tiny()
+    gmain, gstartup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gmain, gstartup):
+        gpt_mod.gpt_logits(cfg)
+    exe = fluid.Executor()
+    gscope = fluid.Scope()
+    with fluid.scope_guard(gscope):
+        exe.run(gstartup)
+    scope_holder.append(gscope)
+    gen = GPTGenerator(cfg, gscope, max_len=48, bucket_min=8)
+    return cfg, serving.InferenceServer(generator=gen, decode_slots=2,
+                                        slo_rules=slo_rules, **kw)
+
+
+def test_slo_chaos_delay_breach_recovery_single_server():
+    """Acceptance (server half): a chaos ``delay=`` slow handler on the
+    decode step trips the p99 rule through the LIVE monitor loop
+    (flight event + slo_rule_state{rule}=1 + health), and fast traffic
+    recovers it — typed errors only throughout."""
+    holder = []
+
+    def rules(srv):
+        return [slo.SloRule("intertoken_p99_ms", ">", 30.0,
+                            hist=srv.stats_sink.hist["token"], q=0.99)]
+
+    cfg, server = _tiny_gpt_server(holder, slo_rules=rules)
+    server.start(serve_network=False)
+    try:
+        server.slo_monitor.poll_s = 0.05
+        prompt = np.arange(1, 6, dtype=np.int32)
+        server.submit_generate(prompt, max_new_tokens=2).wait(
+            timeout=300)                       # compile out of the way
+        with resilience.chaos("serving.decode_step", p=1.0,
+                              delay=0.05):
+            server.submit_generate(prompt, max_new_tokens=4).wait(
+                timeout=300)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline \
+                    and server.slo_monitor.breached_count() == 0:
+                time.sleep(0.02)
+        assert server.slo_monitor.breached() == ["intertoken_p99_ms"]
+        assert server.health()["slo_breached"] == 1
+        scope = server.slo_monitor.scope
+        assert slo._STATE.value(labels=(scope,
+                                        "intertoken_p99_ms")) == 1
+        # recovery: fast traffic refills the window
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline \
+                and server.slo_monitor.breached_count():
+            server.submit_generate(prompt, max_new_tokens=2).wait(
+                timeout=300)
+            time.sleep(0.05)
+        assert server.slo_monitor.breached_count() == 0
+        assert server.health()["slo_breached"] == 0
+        assert slo._STATE.value(labels=(scope,
+                                        "intertoken_p99_ms")) == 0
+        kinds = {e["kind"] for e in flight_recorder().snapshot()
+                 if e.get("scope") == scope}
+        assert {"slo_breach", "slo_recovered"} <= kinds
+    finally:
+        server.stop()
+
+
+def test_slo_breach_shifts_router_dispatch_and_recovers():
+    """Acceptance (fleet half): an injected slow handler on ONE replica
+    breaches its p99 rule; the router's probed ``slo_breached`` state
+    penalizes its dispatch score, shifting traffic to the healthy
+    replica; recovery flips the state back and the replica rejoins."""
+    from paddle_tpu.serving import fleet
+    holder = []
+    _cfg, srv_a = _tiny_gpt_server(holder, slo_rules=[])
+    _cfg, srv_b = _tiny_gpt_server(holder, slo_rules=[])
+    srv_a.start()
+    srv_b.start()
+    router = fleet.Router([srv_a.endpoint, srv_b.endpoint],
+                          probe_interval_s=10.0).start()
+    mon_a = slo.SloMonitor(
+        [slo.SloRule("intertoken_p99_ms", ">", 30.0,
+                     hist=srv_a.stats_sink.hist["token"], q=0.99)],
+        scope="repA")
+    srv_a.slo_monitor = mon_a                 # evaluated explicitly
+    try:
+        prompt = np.arange(1, 6, dtype=np.int32)
+        for s in (srv_a, srv_b):              # warm both compile paths
+            with serving.Client(s.endpoint) as c:
+                c.generate(prompt, max_new_tokens=2)
+        # inject the slow handler on replica A's decode step
+        orig = srv_a.gen_engine.step
+
+        def slow_step(*a, **kw):
+            time.sleep(0.05)
+            return orig(*a, **kw)
+
+        srv_a.gen_engine.step = slow_step
+        with serving.Client(srv_a.endpoint) as c:
+            c.generate(prompt, max_new_tokens=4)
+        mon_a.evaluate_once()
+        assert mon_a.breached() == ["intertoken_p99_ms"]
+        rep_a = router.registry.get(srv_a.endpoint)
+        rep_b = router.registry.get(srv_b.endpoint)
+        router.registry.probe_once(rep_a)
+        router.registry.probe_once(rep_b)
+        assert rep_a.last_health["slo_breached"] == 1
+        assert rep_a.snapshot()["slo_breached"] == 1
+        assert rep_a.load_score() >= rep_b.load_score() + 8.0
+        # dispatch shifts away from the breached replica
+        dispatched_a = rep_a.dispatched_total
+        for _ in range(3):
+            picked = router.registry.pick(("both",))
+            assert picked.endpoint == srv_b.endpoint
+            toks = router.generate(prompt, max_new_tokens=2)
+            assert toks.size > 0
+        assert rep_a.dispatched_total == dispatched_a
+        # recovery: remove the injection, fast traffic, re-evaluate
+        srv_a.gen_engine.step = orig
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and mon_a.breached_count():
+            with serving.Client(srv_a.endpoint) as c:
+                c.generate(prompt, max_new_tokens=2)
+            mon_a.evaluate_once()
+        assert mon_a.breached_count() == 0
+        router.registry.probe_once(rep_a)
+        assert rep_a.last_health["slo_breached"] == 0
+        assert rep_a.dispatchable()
+    finally:
+        router.stop()
+        srv_a.stop()
+        srv_b.stop()
+
+
+# --------------------------------------- fleet metrics aggregation
+
+def test_merge_expositions_replica_labels_and_overflow():
+    from paddle_tpu.serving.fleet.router import _merge_expositions
+    text = ("# HELP x_reqs_total reqs\n"
+            "# TYPE x_reqs_total counter\n"
+            "x_reqs_total 3\n"
+            'x_reqs_total{kind="a"} 2\n')
+    merged = _merge_expositions([("r1", text), ("r2", text)])
+    assert merged.count("# TYPE x_reqs_total counter") == 1
+    assert 'x_reqs_total{replica="r1"} 3' in merged
+    assert 'x_reqs_total{replica="r2",kind="a"} 2' in merged
+    # overflow folds into _other, SUMMED
+    merged2 = _merge_expositions([("r1", text), ("r2", text),
+                                  ("r3", text)], max_replicas=1)
+    assert 'x_reqs_total{replica="_other"} 6' in merged2
+    assert 'x_reqs_total{replica="_other",kind="a"} 4' in merged2
+
+
+def test_router_metrics_op_aggregates_fleet(tmp_path):
+    from paddle_tpu.serving import fleet
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], dtype="float32")
+        out = layers.fc(x, 4, act="softmax")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        path = str(tmp_path / "mlp")
+        fluid.io.save_inference_model(path, ["x"], [out], exe,
+                                      main_program=main)
+    s1 = serving.InferenceServer(path, batch_timeout_ms=1.0).start()
+    s2 = serving.InferenceServer(path, batch_timeout_ms=1.0).start()
+    router = fleet.Router([s1.endpoint, s2.endpoint],
+                          probe_interval_s=10.0).start()
+    try:
+        with serving.Client(router.endpoint) as c:
+            text = c.metrics()
+        for label in ("router", s1.endpoint, s2.endpoint):
+            assert (f'serving_requests_admitted_total'
+                    f'{{replica="{label}"}}') in text, label
+        # family headers once, not once per replica
+        assert text.count(
+            "# TYPE serving_requests_admitted_total counter") == 1
+        st = router.stats()
+        assert st["router_fleet_scrape_failures"] == 0
+    finally:
+        router.stop()
+        s1.stop()
+        s2.stop()
+
+
+# -------------------------------------------- utilization staleness
+
+def test_utilization_staleness_and_collector_skip():
+    util.reset_windows()
+    set_peaks(flops_per_s=1e12, hbm_bytes_per_s=1e11)
+    try:
+        cost = {"flops": 2e9, "bytes": 1e8}
+        for _ in range(4):
+            util.observe_execution("fresh_w", cost, 0.01)
+            util.observe_execution("stale_w", cost, 0.01)
+        u = util.utilization("stale_w")
+        assert u["mfu"] > 0 and u["stale"] is False
+        txt = render_metrics()
+        assert 'device_mfu_ratio{where="stale_w"}' in txt
+        # age the stale_w window past its span
+        w = util._windows["stale_w"]
+        with w.lock:
+            w.last_wall -= 1000.0
+            w.obs = type(w.obs)(
+                ((s, f, b, wall - 1000.0) for s, f, b, wall in w.obs),
+                maxlen=w.obs.maxlen)
+        u = util.utilization("stale_w")
+        assert u["stale"] is True
+        assert u["mfu"] > 0                   # the PAST reading, flagged
+        txt = render_metrics()
+        assert 'device_mfu_ratio{where="stale_w"}' not in txt
+        assert 'device_mfu_ratio{where="fresh_w"}' in txt
+        assert 'device_hbm_bw_util_ratio{where="stale_w"}' not in txt
+    finally:
+        set_peaks()
+        util.reset_windows()
+
+
+def test_registry_collect_snapshot():
+    reg = MetricsRegistry()
+    c = reg.counter("snap_things_total", labels=("k",))
+    c.inc(3, labels=("a",))
+    reg.register_collector(
+        lambda: [{"name": "snap_col_total", "kind": "counter",
+                  "help": "h", "labels": (), "samples": [((), 7)]}],
+        families=[{"name": "snap_col_total", "kind": "counter",
+                   "help": "h", "labels": ()}])
+    snap = reg.collect()
+    assert snap["snap_things_total"]["samples"] == [(("a",), 3)]
+    assert snap["snap_col_total"]["samples"] == [((), 7)]
+    assert snap["snap_col_total"]["kind"] == "counter"

@@ -146,6 +146,95 @@ def test_lint_metrics_gate():
     assert "metrics clean" in ok.stdout
 
 
+def _save_tools_mlp(tmp):
+    import numpy as np  # noqa: F401 — program build only
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16], "float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        out = fluid.layers.fc(h, 8, act="softmax")
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, ["x"], [out], exe,
+                                      main_program=main)
+    return tmp
+
+
+def test_profile_program_gate(tmp_path):
+    """tools/profile_program.py gates in tier-1: exit 0 on a clean
+    program (per-op + memory report), exit 1 with a NAMED finding when
+    --assert-mfu-floor is violated."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    path = _save_tools_mlp(str(tmp_path / "mlp"))
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "profile_program.py"),
+         path, "--ops", "--memory", "--json", "--batch", "4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    doc = json.loads(ok.stdout)
+    assert doc["ops"] and doc["memory"]["peak_bytes"] > 0
+    assert doc["totals"]["flops"] > 0
+    # a generous floor passes...
+    ok2 = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "profile_program.py"),
+         path, "--assert-mfu-floor", "1e-9", "--batch", "4"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert ok2.returncode == 0, ok2.stdout + ok2.stderr[-2000:]
+    assert "OK: est MFU" in ok2.stdout
+    # ...a bandwidth-starved chip profile violates the floor, exit 1,
+    # and the finding NAMES the top cost op
+    bad = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "profile_program.py"),
+         path, "--assert-mfu-floor", "0.5", "--batch", "4",
+         "--peak-tflops", "1000", "--peak-hbm-gbs", "0.001"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert bad.returncode == 1, bad.stdout + bad.stderr[-2000:]
+    assert "MFU-FLOOR VIOLATION" in bad.stderr
+    assert "top cost op" in bad.stderr
+
+
+def test_bench_compare_gate(tmp_path):
+    """tools/bench_compare.py: the bench trajectory is a checkable
+    artifact — exit 0 within tolerance, exit 1 naming the regressed
+    key; lower-is-better keys invert; the BENCH_rNN wrapper parses."""
+    import bench_compare
+    old = {"metric": "m", "value": 100.0,
+           "configs": {"widedeep": {"value": 1000.0},
+                       "chaos": {"value": 10.0}}}
+    new_ok = {"metric": "m", "value": 95.0,
+              "configs": {"widedeep": {"value": 980.0},
+                          "chaos": {"value": 10.5}}}
+    new_bad = {"metric": "m", "value": 50.0,
+               "configs": {"widedeep": {"value": 500.0},
+                           "chaos": {"value": 30.0}}}
+    p_old = str(tmp_path / "old.json")
+    p_ok = str(tmp_path / "ok.json")
+    p_bad = str(tmp_path / "bad.json")
+    with open(p_old, "w") as f:
+        json.dump({"tail": json.dumps(old)}, f)    # BENCH_rNN wrapper
+    with open(p_ok, "w") as f:
+        f.write(json.dumps({"noise": 1}) + "\n" + json.dumps(new_ok))
+    with open(p_bad, "w") as f:
+        json.dump(new_bad, f)
+    keys = ["--key", "value", "--key", "configs.widedeep.value",
+            "--key=-configs.chaos.value"]   # leading '-' needs '='
+    assert bench_compare.main(
+        [p_old, p_ok, *keys, "--max-regress-pct", "10"]) == 0
+    assert bench_compare.main(
+        [p_old, p_bad, *keys, "--max-regress-pct", "10"]) == 1
+    regs, _notes = bench_compare.compare(
+        old, new_bad, ["value", "configs.widedeep.value",
+                       "-configs.chaos.value"], 10.0)
+    assert len(regs) == 3
+    assert any("configs.widedeep.value" in r for r in regs)
+    # missing keys only fail under --strict
+    assert bench_compare.main(
+        [p_old, p_ok, "--key", "configs.nope.value"]) == 0
+    assert bench_compare.main(
+        [p_old, p_ok, "--key", "configs.nope.value", "--strict"]) == 1
+
+
 def test_timeline_conversion_end_to_end():
     """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
     valid Chrome trace JSON."""

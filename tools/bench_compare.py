@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Diff two bench result files and gate on regressions: the bench
+trajectory becomes a CHECKABLE artifact instead of a table a human
+eyeballs.
+
+Inputs are either raw ``bench.py`` output (JSON lines; the LAST line is
+the summary) or the driver's ``BENCH_rNN.json`` wrapper (``{"tail":
+"<json lines>"}``). Keys are dotted paths into the summary object, e.g.
+``value``, ``configs.widedeep.value``, ``configs.decode.value``.
+
+By default a key is HIGHER-IS-BETTER (throughput); prefix it with ``-``
+for lower-is-better (latency/ms):
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json \\
+        --key value --key configs.widedeep.value \\
+        --key=-configs.chaos.value --max-regress-pct 10
+
+(lower-is-better keys need the ``--key=-...`` form — argparse treats a
+bare leading ``-`` as an option.)
+
+Exit 1 when any named key regressed by more than ``--max-regress-pct``
+(missing/null keys are reported but only fail under ``--strict``).
+"""
+import argparse
+import json
+import sys
+
+
+def load_summary(path):
+    """The LAST parseable JSON object of a bench output file (or of the
+    BENCH_rNN wrapper's "tail")."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "tail" in doc \
+                and isinstance(doc["tail"], str):
+            text = doc["tail"]
+        elif isinstance(doc, dict):
+            return doc                       # already one summary object
+    except ValueError:
+        pass
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            last = json.loads(line)
+        except ValueError:
+            continue
+    if last is None:
+        raise ValueError(f"{path}: no JSON summary line found")
+    return last
+
+
+def lookup(doc, dotted):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def compare(old, new, keys, max_regress_pct):
+    """-> (regressions, notes): ``regressions`` are gate failures,
+    ``notes`` informational lines (improvements, missing keys)."""
+    regressions, notes = [], []
+    for raw in keys:
+        lower_better = raw.startswith("-")
+        key = raw[1:] if lower_better else raw
+        ov, nv = lookup(old, key), lookup(new, key)
+        if not isinstance(ov, (int, float)) \
+                or not isinstance(nv, (int, float)):
+            notes.append(f"SKIP {key}: old={ov!r} new={nv!r} "
+                         f"(non-numeric/missing)")
+            continue
+        if ov == 0:
+            notes.append(f"SKIP {key}: old value is 0")
+            continue
+        delta_pct = (nv - ov) / abs(ov) * 100.0
+        regressed = (-delta_pct if not lower_better else delta_pct) \
+            > max_regress_pct
+        line = (f"{key}: {ov:g} -> {nv:g} ({delta_pct:+.2f}%"
+                f"{', lower is better' if lower_better else ''})")
+        if regressed:
+            regressions.append(f"REGRESSION {line} exceeds "
+                               f"{max_regress_pct:g}%")
+        else:
+            notes.append(f"ok {line}")
+    return regressions, notes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Gate bench results against a prior run")
+    ap.add_argument("old", help="baseline bench file (raw output or "
+                                "BENCH_rNN.json wrapper)")
+    ap.add_argument("new", help="candidate bench file")
+    ap.add_argument("--key", action="append", default=[],
+                    help="dotted path into the summary (repeatable); "
+                         "prefix '-' for lower-is-better")
+    ap.add_argument("--max-regress-pct", type=float, default=10.0)
+    ap.add_argument("--strict", action="store_true",
+                    help="missing/non-numeric keys also fail the gate")
+    args = ap.parse_args(argv)
+    keys = args.key or ["value"]
+    old = load_summary(args.old)
+    new = load_summary(args.new)
+    regressions, notes = compare(old, new, keys, args.max_regress_pct)
+    for n in notes:
+        print(n)
+    for r in regressions:
+        print(r, file=sys.stderr)
+    if args.strict and any(n.startswith("SKIP") for n in notes):
+        print("STRICT: skipped keys fail the gate", file=sys.stderr)
+        return 1
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,14 +54,20 @@ def main():
     ap.add_argument("--endpoint", default=None,
                     help="serving endpoint host:port (default: render "
                          "this process's registry)")
+    ap.add_argument("--router", default=None,
+                    help="fleet Router endpoint host:port — the reply "
+                         "is the FLEET-WIDE exposition: every replica's "
+                         "samples re-exposed with a replica label (one "
+                         "scrape sees the fleet)")
     ap.add_argument("--out", default=None,
                     help="textfile path (default: stdout)")
     args = ap.parse_args()
+    endpoint = args.router or args.endpoint
     if args.out:
-        n = export(args.out, endpoint=args.endpoint)
+        n = export(args.out, endpoint=endpoint)
         print(f"wrote {n} bytes to {args.out}")
     else:
-        sys.stdout.write(scrape(args.endpoint))
+        sys.stdout.write(scrape(endpoint))
     return 0
 
 

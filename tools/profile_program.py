@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Standalone performance-attribution CLI: run the per-op cost profiler
+and the HBM live-set memory profiler (paddle_tpu/observability/
+profiling.py) over a saved program — the offline front-end to the same
+machinery ``FLAGS_profile_ops`` samples at run time.
+
+Usage:
+    python tools/profile_program.py <path> [--ops] [--memory]
+        [--topk N] [--batch B] [--json]
+        [--assert-mfu-floor R [--peak-tflops T --peak-hbm-gbs G]]
+
+<path> is an inference-model directory (containing ``__model__``), a
+``__model__``/``*.pdmodel`` JSON file, or any file written by
+save_inference_model (the ``tools/lint_program.py`` input contract).
+
+    --ops              per-op cost table (flops/bytes/roofline est_ms,
+                       ranked; the default when neither mode is given)
+    --memory           HBM live-set report: peak bytes, op index at
+                       peak, top-k tensors live at peak
+    --topk N           rows/tensors to print (default 12)
+    --batch B          value substituted for -1 (batch) dims
+                       (default 32)
+    --json             machine-readable output (one JSON object)
+    --assert-mfu-floor R
+                       exit 1 with a named finding when the program's
+                       ROOFLINE-LIMITED MFU estimate (est flops /
+                       (est time * peak flops)) is below R — the CI
+                       guardrail against landing a bandwidth-starved
+                       program shape
+    --peak-tflops T / --peak-hbm-gbs G
+                       override the peak tables (CPU CI boxes have no
+                       TPU entry; same contract as
+                       observability.set_peaks)
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_program(path):
+    """(program, feed_names, fetch_names) — same loader contract as
+    tools/lint_program.py."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    with open(path) as f:
+        model = json.load(f)
+    from paddle_tpu.framework.core import Program
+    if "program" in model:          # save_inference_model layout
+        return (Program.from_dict(model["program"]),
+                model.get("feed_var_names", ()),
+                model.get("fetch_var_names", ()))
+    return Program.from_dict(model), (), ()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Per-op cost + HBM live-set profile of a saved "
+                    "program")
+    ap.add_argument("path", help="model dir or __model__/.pdmodel file")
+    ap.add_argument("--ops", action="store_true",
+                    help="per-op cost attribution table")
+    ap.add_argument("--memory", action="store_true",
+                    help="HBM live-set memory profile")
+    ap.add_argument("--topk", type=int, default=12)
+    ap.add_argument("--batch", type=int, default=32,
+                    help="value substituted for -1 (batch) dims")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--assert-mfu-floor", type=float, default=None,
+                    help="exit 1 when the roofline-limited MFU "
+                         "estimate is below this ratio")
+    ap.add_argument("--peak-tflops", type=float, default=None)
+    ap.add_argument("--peak-hbm-gbs", type=float, default=None)
+    args = ap.parse_args(argv)
+    if not args.ops and not args.memory:
+        args.ops = True
+
+    from paddle_tpu.observability import profiling, set_peaks
+    if args.peak_tflops or args.peak_hbm_gbs:
+        set_peaks(
+            flops_per_s=(args.peak_tflops * 1e12
+                         if args.peak_tflops else None),
+            hbm_bytes_per_s=(args.peak_hbm_gbs * 1e9
+                             if args.peak_hbm_gbs else None))
+
+    program, feeds, fetches = load_program(args.path)
+    out = {"path": args.path, "n_ops":
+           sum(len(b.ops) for b in program.blocks)}
+    report = None
+    if args.ops or args.assert_mfu_floor is not None:
+        report = profiling.profile_program(
+            program, fetch_list=list(fetches), batch=args.batch,
+            topk=None, optimize=False, measured=False)
+        out["ops"] = report["ops"][:args.topk]
+        out["totals"] = report["totals"]
+        out["named_share"] = report["named_share"]
+    if args.memory:
+        out["memory"] = profiling.memory_profile(
+            program, fetch_names=tuple(fetches), batch=args.batch,
+            topk=args.topk)
+        out["memory"].pop("timeline", None)   # keep the output compact
+
+    finding = None
+    if args.assert_mfu_floor is not None:
+        t = report["totals"]
+        est_s = t["est_ms"] / 1e3
+        mfu = (t["flops"] / (est_s * t["peak_flops"])) if est_s else 0.0
+        out["est_mfu"] = round(mfu, 6)
+        if mfu < args.assert_mfu_floor:
+            top = report["ops"][0] if report["ops"] else None
+            finding = (
+                f"MFU-FLOOR VIOLATION: roofline-limited MFU estimate "
+                f"{mfu:.4f} < floor {args.assert_mfu_floor:.4f}"
+                + (f"; top cost op: #{top['index']} {top['type']!r} "
+                   f"({top['bound']}-bound, "
+                   f"{top['share'] * 100:.1f}% of est time)"
+                   if top else ""))
+            out["finding"] = finding
+
+    if args.as_json:
+        print(json.dumps(out, default=float))
+    else:
+        if args.ops:
+            print(profiling.format_table(report, topk=args.topk))
+        if args.memory:
+            m = out["memory"]
+            print(f"peak HBM live set: {m['peak_bytes'] / 2**20:.2f} "
+                  f"MiB at op #{m['peak_op_index']} "
+                  f"({m['peak_op_type']}); resident baseline "
+                  f"{m['baseline_bytes'] / 2**20:.2f} MiB")
+            for r in m["top"]:
+                print(f"  {r['bytes'] / 2**20:>9.2f} MiB  "
+                      f"{r['name']:<40} [{r['kind']}, "
+                      f"producer {r['producer']}]")
+        if args.assert_mfu_floor is not None and finding is None:
+            print(f"OK: est MFU {out['est_mfu']:.4f} >= floor "
+                  f"{args.assert_mfu_floor:.4f}")
+    if finding:
+        print(finding, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,14 +23,21 @@ import argparse
 import json
 
 
-def to_chrome_trace(spans):
+def to_chrome_trace(spans, counters=()):
     """spans: [(name, start_s, end_s, tid[, trace_id, span_id,
     parent_id])] -> Chrome trace dict (complete events, microsecond
     timebase, normalized to t0; flow events link traced parent/child
-    spans)."""
-    if not spans:
+    spans). ``counters`` ([(name, t_s, value)] — e.g. the memory
+    profiler's hbm_live_bytes live-set track) render as Chrome counter
+    ("C") events, so Perfetto shows the byte timeline under the op
+    spans."""
+    if not spans and not counters:
         return {"traceEvents": []}
-    t0 = min(s[1] for s in spans)
+    t0 = min([s[1] for s in spans] + [c[1] for c in counters])
+    if not spans:
+        return {"traceEvents": [
+            {"name": c[0], "ph": "C", "ts": (c[1] - t0) * 1e6,
+             "pid": 0, "args": {"value": c[2]}} for c in counters]}
     events = []
     tids = {}
     # span_id -> (end_ts, tid) of traced spans, for flow binding
@@ -71,11 +78,14 @@ def to_chrome_trace(spans):
         flows.append({"name": "trace", "ph": "f", "bp": "e",
                       "cat": "request", "id": fid, "pid": 0,
                       "tid": ev["tid"], "ts": ev["ts"]})
+    counter_events = [
+        {"name": c[0], "ph": "C", "ts": (c[1] - t0) * 1e6, "pid": 0,
+         "args": {"value": c[2]}} for c in counters]
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
              "args": {"name": "paddle_tpu host"}}]
     meta += [{"name": "thread_name", "ph": "M", "pid": 0, "tid": i,
               "args": {"name": f"thread {i}"}} for i in tids.values()]
-    return {"traceEvents": meta + events + flows,
+    return {"traceEvents": meta + events + flows + counter_events,
             "displayTimeUnit": "ms"}
 
 
@@ -89,12 +99,16 @@ def main():
     with open(args.profile_path) as f:
         doc = json.load(f)
     spans = doc["spans"]
+    counters = doc.get("counters", [])
     with open(args.timeline_path, "w") as f:
-        json.dump(to_chrome_trace(spans), f)
+        json.dump(to_chrome_trace(spans, counters=counters), f)
     dropped = doc.get("dropped", 0)
     drop_note = f"; {dropped} spans were dropped at record time" \
         if dropped else ""
-    print(f"wrote {args.timeline_path} ({len(spans)} spans{drop_note}) "
+    counter_note = f", {len(counters)} counter samples" if counters \
+        else ""
+    print(f"wrote {args.timeline_path} ({len(spans)} spans"
+          f"{counter_note}{drop_note}) "
           f"— open in chrome://tracing or Perfetto")
 
 
