@@ -6,20 +6,36 @@ import itertools
 import queue
 import random
 import threading
+import time
 
 
-def put_until_closed(q, item, closed, tick=0.05):
+def put_until_closed(q, item, closed, tick=0.05, on_wait=None):
     """Blocking queue put that gives up once `closed` is set — the
     closeable timeout-put shared by buffered() and reader._QueueIterator
     so an abandoned consumer never strands a producer thread mid-put.
-    Returns True when the item was enqueued."""
-    while not closed.is_set():
-        try:
-            q.put(item, timeout=tick)
-            return True
-        except queue.Full:
-            continue
-    return False
+    Returns True when the item was enqueued. ``on_wait(seconds)``, if
+    given, reports the time spent BLOCKED on a full queue (the stall
+    profiler's producer-wait signal); the non-blocking fast path never
+    calls it."""
+    if closed.is_set():
+        return False
+    try:
+        q.put_nowait(item)
+        return True
+    except queue.Full:
+        pass
+    t0 = time.perf_counter() if on_wait is not None else 0.0
+    try:
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=tick)
+                return True
+            except queue.Full:
+                continue
+        return False
+    finally:
+        if on_wait is not None:
+            on_wait(time.perf_counter() - t0)
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -58,13 +74,20 @@ def buffered(reader, size):
     abandons the generator early (break / GeneratorExit), the close
     event is set, the producer drains out of its blocked put within one
     timeout tick and exits — no daemon thread leaks per abandoned
-    reader, and the source reader's own generator is closed too."""
+    reader, and the source reader's own generator is closed too.
+
+    Both sides feed the input-pipeline stall profiler
+    (observability/inputstall): producer/consumer wait histograms when
+    a put/get actually blocks, a queue-occupancy gauge, and a
+    ``data_stall`` flight event when consumer waits dominate a window."""
     end = object()
 
     def buffered_reader():
+        from ..observability.inputstall import StallTracker
         q = queue.Queue(maxsize=size)
         err = []
         closed = threading.Event()
+        tracker = StallTracker("buffered", size)
 
         def fill():
             from ..resilience import maybe_fail
@@ -75,7 +98,8 @@ def buffered(reader, size):
                     # fault here propagates through `err` into the
                     # consuming training loop like a real parse crash
                     maybe_fail("dataio.producer")
-                    if not put_until_closed(q, sample, closed):
+                    if not put_until_closed(q, sample, closed,
+                                            on_wait=tracker.producer_wait):
                         return
             except BaseException as e:  # propagate into the consumer
                 err.append(e)
@@ -94,7 +118,15 @@ def buffered(reader, size):
         t.start()
         try:
             while True:
-                s = q.get()
+                tracker.sample_occupancy(q.qsize())
+                try:
+                    s = q.get_nowait()
+                except queue.Empty:
+                    # the consumer is about to block: the producer is
+                    # behind — this wait IS the input-pipeline stall
+                    t0 = time.perf_counter()
+                    s = q.get()
+                    tracker.consumer_wait(time.perf_counter() - t0)
                 if s is end:
                     if err:
                         raise err[0]

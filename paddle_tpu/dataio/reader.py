@@ -12,6 +12,7 @@ lookahead achieves the reference's double buffering).
 """
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -48,11 +49,15 @@ class _QueueIterator:
     _END = object()
 
     def __init__(self, gen_fn, capacity, prefetch_to_device):
+        from ..observability.inputstall import StallTracker
         self.q = queue.Queue(maxsize=capacity)
         self.err = []
         self.prefetch = prefetch_to_device
         self._pending = None
         self._closed = threading.Event()
+        # input-pipeline stall profiler: producer/consumer wait
+        # histograms + occupancy gauge + data_stall flight events
+        self._tracker = StallTracker("dataloader", capacity)
         self.thread = threading.Thread(target=self._fill, args=(gen_fn,),
                                        daemon=True)
         self.thread.start()
@@ -61,7 +66,8 @@ class _QueueIterator:
         from .decorator import put_until_closed
         try:
             for item in gen_fn():
-                if not put_until_closed(self.q, item, self._closed):
+                if not put_until_closed(self.q, item, self._closed,
+                                        on_wait=self._tracker.producer_wait):
                     return
         except BaseException as e:
             self.err.append(e)
@@ -98,7 +104,15 @@ class _QueueIterator:
         """Next raw item; terminal state is sticky."""
         if self._closed.is_set():
             return self._END
-        item = self.q.get()
+        self._tracker.sample_occupancy(self.q.qsize())
+        try:
+            item = self.q.get_nowait()
+        except queue.Empty:
+            # consumer blocked on an empty queue: the producer is
+            # behind — the stall profiler's consumer-wait signal
+            t0 = time.perf_counter()
+            item = self.q.get()
+            self._tracker.consumer_wait(time.perf_counter() - t0)
         if item is self._END:
             self.q.put(self._END)  # stay terminal for any further call
             return self._END

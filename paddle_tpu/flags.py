@@ -99,6 +99,25 @@ _DEFS = {
     # MFU floor on the decode path (0 = rule off; set > 0 on real
     # accelerators where peak tables are known)
     "slo_mfu_floor": (0.0, float, None),
+    # -- training observability (observability/goodput, train/health,
+    # observability/inputstall) --
+    # model-health monitoring cadence: every N-th supervised slab
+    # additionally fetches per-slab loss / global grad-norm /
+    # param-update-ratio IN-GRAPH through the run_steps fetch path and
+    # evaluates the loss-spike / grad-norm-spike SLO rules. 0 (default)
+    # = off: no ops are added to the program and the fused-step path is
+    # bitwise-unchanged
+    "train_health_every_n": (0, int, None),
+    # health rule thresholds: breach when the fetched value exceeds
+    # this multiple of its trailing EMA (loss spike / grad-norm spike)
+    "train_loss_spike_ratio": (3.0, float, None),
+    "train_grad_spike_ratio": (10.0, float, None),
+    # input-pipeline stall profiler: flag a data_stall flight event
+    # when, over a window of at least dataio_stall_window_s seconds,
+    # the consumer spent more than dataio_stall_ratio of the wall time
+    # blocked waiting on the producer queue
+    "dataio_stall_window_s": (1.0, float, None),
+    "dataio_stall_ratio": (0.5, float, None),
     # -- elastic training (paddle_tpu/train) --
     # periodic full-training-state checkpoint cadence for
     # TrainingSupervisor: one async (CheckFreq-staged) checkpoint every

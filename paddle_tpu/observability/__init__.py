@@ -32,7 +32,17 @@ Four pieces, one package:
   metric streams become ``slo_breach``/``slo_recovered`` flight events,
   ``slo_*`` metrics, and dispatch-penalty signals the fleet Router
   consumes.
+- :mod:`goodput` — the training goodput ledger: every second of a
+  supervised training run attributed to compute / compile / data_stall
+  / h2d / checkpoint / recovery / preempt / other (MegaScale-style),
+  exported as ``train_time_seconds_total{category}`` +
+  ``train_goodput_ratio`` + a Perfetto counter track.
+- :mod:`inputstall` — the input-pipeline stall profiler: queue
+  occupancy gauges, producer/consumer wait histograms, and
+  ``data_stall`` flight events on the dataio queues.
 """
+from .goodput import CATEGORIES, GoodputLedger  # noqa: F401
+from .inputstall import StallTracker  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BOUNDS_MS, Family, MetricsRegistry, UNIT_SUFFIXES,
     default_registry, render_metrics,

@@ -1,0 +1,160 @@
+"""Training goodput ledger: every second of a supervised run, attributed.
+
+MegaScale (NSDI 2024) runs production LLM training on a per-second
+accounting of where wall time went — compute vs. data stalls vs.
+recovery — because at scale the difference between 0.55 and 0.60 MFU is
+a category of waste somebody has to NAME before they can remove it.
+This module is that instrument for ``train.TrainingSupervisor``: a
+:class:`GoodputLedger` attributes the run's wall clock to the closed
+category set :data:`CATEGORIES`:
+
+- ``compute``   — fused-slab execution (run_steps dispatch + device run)
+- ``compile``   — trace/XLA-compile share of slab wall (cache-miss
+  slabs; split out of the slab span via ``Executor.cache_stats()``
+  deltas so steady state reports pure compute)
+- ``data_stall``— the loop blocked pulling the next slab from the
+  dataset iterator (the host-bound input path, measured at last)
+- ``h2d``       — host-to-device slab transfer dispatch
+- ``checkpoint``— critical-path checkpoint time (the sync gather for
+  async saves, the full write otherwise)
+- ``recovery``  — supervised-restart work: backoff, checkpoint reload,
+  deposed-scope re-init, and REPLAYED slabs (work the crash destroyed)
+- ``preempt``   — the bounded-deadline preemption fast checkpoint +
+  typed exit
+- ``other``     — everything unattributed (startup init, fetch
+  materialization, user callbacks); computed as wall − attributed, so
+  the categories always sum to wall and OVER-counting shows up as a
+  reported ``overcount_s`` instead of hiding
+
+The accounting is exclusive by construction: only the (single-threaded)
+supervisor loop reports, and each report covers a disjoint interval of
+its own wall clock. Exports:
+
+- ``train_time_seconds_total{category}`` counters +
+  ``train_goodput_ratio`` gauge in the default registry,
+- a ``goodput/<category>_s`` Perfetto counter track (cumulative
+  seconds, recorded only under an active profiler) so
+  ``tools/timeline.py`` renders the ledger under the slab spans,
+- :meth:`GoodputLedger.report` — the structured dict behind
+  ``supervisor.goodput_report()`` and ``tools/train_report.py``.
+"""
+import threading
+import time
+from contextlib import contextmanager
+
+from .metrics import default_registry as _registry
+
+CATEGORIES = ("compute", "compile", "data_stall", "h2d", "checkpoint",
+              "recovery", "preempt", "other")
+
+_TIME = _registry().counter(
+    "train_time_seconds_total",
+    "supervised-training wall seconds attributed per goodput-ledger "
+    "category (compute/compile/data_stall/h2d/checkpoint/recovery/"
+    "preempt/other)",
+    labels=("category",), max_series=16)
+_GOODPUT = _registry().gauge(
+    "train_goodput_ratio",
+    "compute seconds / wall seconds of the most recent supervised "
+    "training run (goodput in the MegaScale sense)")
+
+
+class GoodputLedger:
+    """Per-run wall-time attribution. One ledger per supervised run;
+    ``add``/``span`` charge seconds to a category, ``report`` closes
+    the books (``other`` absorbs the unattributed remainder)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._acc = {c: 0.0 for c in CATEGORIES}
+        self._t0 = None
+        self._t_end = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._t0 = self._clock()
+        self._t_end = None
+        return self
+
+    def stop(self):
+        if self._t0 is not None and self._t_end is None:
+            self._t_end = self._clock()
+            # fold the unattributed remainder into the exported
+            # ``other`` counter so the Prometheus series sum to wall
+            # like the in-process report does (idempotent: only the
+            # first stop folds)
+            with self._lock:
+                attributed = sum(self._acc.values())
+            rem = self.wall_s() - attributed
+            if rem > 0:
+                self.add("other", rem)
+        return self
+
+    def wall_s(self):
+        if self._t0 is None:
+            return 0.0
+        end = self._t_end if self._t_end is not None else self._clock()
+        return max(end - self._t0, 0.0)
+
+    # -- recording --------------------------------------------------------
+    def add(self, category, seconds):
+        """Charge ``seconds`` to ``category`` (exported immediately;
+        the per-run books live in this ledger)."""
+        if category not in self._acc:
+            raise ValueError(
+                f"unknown goodput category {category!r} "
+                f"(one of {CATEGORIES})")
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            self._acc[category] += s
+            cum = self._acc[category]
+            compute = self._acc["compute"]
+        _TIME.inc(s, labels=(category,))
+        wall = self.wall_s()
+        if wall > 0:
+            _GOODPUT.set(min(compute / wall, 1.0))
+        # Perfetto counter track (active profiler only): cumulative
+        # seconds per category, timestamped on the profiler's clock
+        from .. import profiler as _prof
+        if _prof.is_profiling():
+            _prof.record_counter(f"goodput/{category}_s",
+                                 self._clock(), cum)
+        return s
+
+    @contextmanager
+    def span(self, category):
+        """Charge the duration of the block to ``category`` (exception-
+        safe — a raising block still lands its elapsed time)."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.add(category, self._clock() - t0)
+
+    # -- reporting --------------------------------------------------------
+    def report(self):
+        """Close the books: ``{"wall_s", "categories", "goodput_ratio",
+        "attributed_s", "unattributed_s", "overcount_s", "sum_s"}``.
+        ``categories`` includes ``other`` = explicit other + the
+        unattributed remainder, so ``sum_s`` equals ``wall_s`` unless
+        the explicit categories OVER-counted (then ``overcount_s`` > 0
+        and the 1% sum gate in ``bench.py --config goodput`` fails)."""
+        wall = self.wall_s()
+        with self._lock:
+            acc = dict(self._acc)
+        attributed = sum(acc.values())
+        remainder = wall - attributed
+        cats = dict(acc)
+        cats["other"] += max(remainder, 0.0)
+        total = sum(cats.values())
+        compute = cats["compute"]
+        return {
+            "wall_s": wall,
+            "categories": cats,
+            "goodput_ratio": (compute / wall) if wall > 0 else 0.0,
+            "attributed_s": attributed,
+            "unattributed_s": max(remainder, 0.0),
+            "overcount_s": max(-remainder, 0.0),
+            "sum_s": total,
+        }

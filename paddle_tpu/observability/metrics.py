@@ -34,7 +34,9 @@ import weakref
 # _total  monotonic counters          _ms     millisecond durations
 # _bytes  byte sizes                  _ratio  0..1 utilizations
 # _state  small state enums (0/1/2)   _count  gauge-valued counts
-UNIT_SUFFIXES = ("_total", "_ms", "_bytes", "_ratio", "_state", "_count")
+# _value  dimensionless instantaneous readings (loss, norms)
+UNIT_SUFFIXES = ("_total", "_ms", "_bytes", "_ratio", "_state", "_count",
+                 "_value")
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 

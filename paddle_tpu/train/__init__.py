@@ -30,4 +30,5 @@ from .preemption import (  # noqa: F401
     clear_preemption, signal_preemption,
 )
 from .checkpoint import TrainCheckpoint, TRAIN_STATE_FILE  # noqa: F401
+from .health import HealthMonitor  # noqa: F401
 from .supervisor import TrainingSupervisor  # noqa: F401

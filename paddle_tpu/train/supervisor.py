@@ -54,6 +54,7 @@ import numpy as np
 
 from ..flags import flag as _flag
 from ..framework.executor import Scope, global_scope, _device_put_slab
+from ..observability.goodput import GoodputLedger
 from ..observability.metrics import default_registry as _registry
 from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import (PreemptedError, RestartBudgetExceeded,
@@ -122,7 +123,9 @@ class TrainingSupervisor:
                  restart_budget=None, max_to_keep=5, step_watchdog_s=0.0,
                  restart_backoff=0.05, max_backoff=2.0,
                  handle_signals=False, skip_nonfinite_steps=False,
-                 shuffle_each_epoch=False, on_slab_end=None):
+                 shuffle_each_epoch=False, on_slab_end=None,
+                 health_every_n=None, health_rules=None,
+                 on_health_breach=None):
         self.executor = executor
         self.program = program
         self.startup_program = startup_program
@@ -154,12 +157,43 @@ class TrainingSupervisor:
         self._plain_program = (program.program
                                if isinstance(program, CompiledProgram)
                                else program)
+        # goodput ledger (one per supervised run; goodput_report()
+        # reads the most recent) + replay watermark for the
+        # restart-replay -> recovery attribution
+        self._ledger = None
+        self._max_slab_done = 0
+        # model-health monitor (FLAGS_train_health_every_n; 0 = off:
+        # nothing constructed, no ops added, fused path bitwise-unchanged)
+        hn = int(health_every_n if health_every_n is not None
+                 else _flag("train_health_every_n"))
+        if hn > 0:
+            from .health import HealthMonitor
+            self.health = HealthMonitor(
+                self._plain_program, every_n=hn, rules=health_rules,
+                on_breach=on_health_breach)
+        else:
+            self.health = None
 
     @property
     def scope(self):
         """The live training scope (replaced by a fresh one after a
         watchdog restart deposes a possibly-still-running worker)."""
         return self._scope
+
+    def goodput_report(self):
+        """The goodput ledger's attribution of the current/most recent
+        run (:meth:`~paddle_tpu.observability.goodput.GoodputLedger.
+        report`), or None before the first run."""
+        return self._ledger.report() if self._ledger is not None else None
+
+    def health_report(self):
+        """The model-health monitor's live snapshot (values, trailing
+        EMAs, breached rules), or None when health monitoring is off."""
+        return self.health.snapshot() if self.health is not None else None
+
+    def _led_span(self, category):
+        return (self._ledger.span(category)
+                if self._ledger is not None else nullcontext())
 
     # -- public entry points ----------------------------------------------
     def resume(self):
@@ -219,65 +253,82 @@ class TrainingSupervisor:
         # before a crash WERE reported; the resumed attempt re-reports
         # from its checkpoint onward (later attempts win on overlap)
         fetches = {} if collect_fetches else None
-        while True:
-            try:
-                result = self._attempt(make_iter, dataset, fetch_list,
-                                       epochs, fetches,
-                                       pending_recovery_t0, recoveries_ms)
-                result["restarts"] = restarts
-                result["restart_errors"] = list(restart_errors)
-                result["recoveries_ms"] = list(recoveries_ms)
-                return result
-            except (PreemptedError, KeyboardInterrupt):
-                raise
-            except Exception as exc:  # noqa: BLE001 — supervised restart
-                restarts += 1
-                restart_errors.append(type(exc).__name__)
-                _M_RESTARTS.inc()
-                _flightrec().record("train_restart",
-                                    error=type(exc).__name__,
-                                    restarts=restarts)
-                if restarts > self.restart_budget:
-                    raise RestartBudgetExceeded(
-                        f"training crashed {restarts} time(s), exceeding "
-                        f"the restart budget of {self.restart_budget} "
-                        f"(FLAGS_train_restart_budget); last failure: "
-                        f"{type(exc).__name__}: {exc}",
-                        restarts=restarts,
-                        errors=restart_errors) from exc
-                print(f"[train] supervised restart {restarts}/"
-                      f"{self.restart_budget} after "
-                      f"{type(exc).__name__}: {exc} (backoff "
-                      f"{backoff * 1e3:.0f}ms)")
-                pending_recovery_t0 = time.monotonic()
-                time.sleep(backoff)
-                backoff = min(backoff * 2.0, self.max_backoff)
-                # drain the crashed attempt's in-flight async saves
-                # BEFORE resuming: a stale parked failure must not
-                # re-raise at the next attempt's first wait() (a
-                # phantom crash burning restart budget), and resume()
-                # must not race a commit landing mid-restore
+        self._ledger = GoodputLedger().start()
+        self._max_slab_done = 0
+        try:
+            while True:
                 try:
-                    self.checkpoint.wait()
-                except Exception as stale:  # noqa: BLE001 — superseded
-                    print(f"[train] dropping failed async checkpoint "
-                          f"from the crashed attempt: "
-                          f"{type(stale).__name__}: {stale}")
-                # depose the old scope on EVERY restart: a hung watchdog
-                # worker may still be running (and must never commit a
-                # late step into the restarted attempt), and a crash
-                # before the first checkpoint must restart from the
-                # bitwise-identical fresh init, not half-trained state
-                self._scope = Scope()
+                    result = self._attempt(make_iter, dataset, fetch_list,
+                                           epochs, fetches,
+                                           pending_recovery_t0,
+                                           recoveries_ms)
+                    result["restarts"] = restarts
+                    result["restart_errors"] = list(restart_errors)
+                    result["recoveries_ms"] = list(recoveries_ms)
+                    self._ledger.stop()
+                    result["goodput"] = self._ledger.report()
+                    return result
+                except (PreemptedError, KeyboardInterrupt):
+                    raise
+                except Exception as exc:  # noqa: BLE001 — supervised
+                    restarts += 1         # restart
+                    restart_errors.append(type(exc).__name__)
+                    _M_RESTARTS.inc()
+                    _flightrec().record("train_restart",
+                                        error=type(exc).__name__,
+                                        restarts=restarts)
+                    if restarts > self.restart_budget:
+                        raise RestartBudgetExceeded(
+                            f"training crashed {restarts} time(s), "
+                            f"exceeding the restart budget of "
+                            f"{self.restart_budget} "
+                            f"(FLAGS_train_restart_budget); last failure: "
+                            f"{type(exc).__name__}: {exc}",
+                            restarts=restarts,
+                            errors=restart_errors) from exc
+                    print(f"[train] supervised restart {restarts}/"
+                          f"{self.restart_budget} after "
+                          f"{type(exc).__name__}: {exc} (backoff "
+                          f"{backoff * 1e3:.0f}ms)")
+                    pending_recovery_t0 = time.monotonic()
+                    with self._led_span("recovery"):
+                        time.sleep(backoff)
+                        backoff = min(backoff * 2.0, self.max_backoff)
+                        # drain the crashed attempt's in-flight async
+                        # saves BEFORE resuming: a stale parked failure
+                        # must not re-raise at the next attempt's first
+                        # wait() (a phantom crash burning restart
+                        # budget), and resume() must not race a commit
+                        # landing mid-restore
+                        try:
+                            self.checkpoint.wait()
+                        except Exception as stale:  # noqa: BLE001
+                            print(f"[train] dropping failed async "
+                                  f"checkpoint from the crashed "
+                                  f"attempt: {type(stale).__name__}: "
+                                  f"{stale}")
+                        # depose the old scope on EVERY restart: a hung
+                        # watchdog worker may still be running (and must
+                        # never commit a late step into the restarted
+                        # attempt), and a crash before the first
+                        # checkpoint must restart from the bitwise-
+                        # identical fresh init, not half-trained state
+                        self._scope = Scope()
+        finally:
+            self._ledger.stop()
 
     # -- one attempt (fresh or resumed) -----------------------------------
     def _attempt(self, make_iter, dataset, fetch_list, epochs,
                  fetches, recovery_t0, recoveries_ms):
-        state = self.resume()
-        if state is None:
-            self._fresh_init(dataset)
-            state = {"epoch": 0, "batches": 0, "slab": 0, "step": 0,
-                     "shuffle_base_seed": self._base_seed(dataset)}
+        # on a restarted attempt the reload/re-init is crash recovery;
+        # on a fresh run it is startup (unattributed -> "other")
+        is_restart = recovery_t0 is not None
+        with self._led_span("recovery" if is_restart else "other"):
+            state = self.resume()
+            if state is None:
+                self._fresh_init(dataset)
+                state = {"epoch": 0, "batches": 0, "slab": 0, "step": 0,
+                         "shuffle_base_seed": self._base_seed(dataset)}
         cursor_epoch = int(state.get("epoch", 0))
         cursor_batches = int(state.get("batches", 0))
         slab_idx = int(state.get("slab", 0))
@@ -286,22 +337,45 @@ class TrainingSupervisor:
         checkpoints = 0
         last_fetches = None
         every_n = max(1, self.checkpoint_every_n_slabs)
+        # model-health fetch extension: built once (pure ops, dead on
+        # non-health slabs -> those executables stay bitwise-unchanged)
+        health_names = []
+        if self.health is not None and self.health.every_n > 0:
+            health_names = self.health.ensure_fetches(
+                self._first_fetch_name(fetch_list))
+        n_user = len(fetch_list) if fetch_list else 0
         with _preempt.signal_preemption() if self.handle_signals \
                 else nullcontext():
             for epoch in range(cursor_epoch, max(1, epochs)):
                 self._maybe_shuffle(dataset, base_seed, epoch)
-                it = make_iter({"epoch": epoch,
-                                "batches": cursor_batches,
-                                "shuffle_seed": base_seed})
+                with self._led_span("recovery" if is_restart
+                                    else "data_stall"):
+                    # creating the iterator replays/skips the consumed
+                    # prefix — lost-input work on a restart, input wait
+                    # otherwise
+                    it = make_iter({"epoch": epoch,
+                                    "batches": cursor_batches,
+                                    "shuffle_seed": base_seed})
+                is_restart = False   # later epochs are normal progress
                 cur, cur_pos = self._pull(it)
                 while cur is not None:
                     if _preempt.preemption_requested():
                         self._preempt_exit(slab_idx, step, epoch,
                                            cursor_batches, base_seed)
                     nxt, nxt_pos = self._pull(it)
-                    out = self._run_slab(cur, fetch_list)
+                    health_slab = bool(health_names) and \
+                        self.health.is_health_slab(slab_idx)
+                    fl = (list(fetch_list or []) + health_names
+                          if health_slab else fetch_list)
+                    out = self._run_slab(
+                        cur, fl, replay=slab_idx < self._max_slab_done)
+                    if health_slab:
+                        self.health.observe(slab_idx, out[n_user:])
+                        out = out[:n_user]
                     k = int(np.shape(next(iter(cur.values())))[0])
                     slab_idx += 1
+                    self._max_slab_done = max(self._max_slab_done,
+                                              slab_idx)
                     step += k
                     cursor_batches = int(cur_pos["batches"])
                     if recovery_t0 is not None:
@@ -320,7 +394,8 @@ class TrainingSupervisor:
                     if slab_idx % every_n == 0:
                         # CheckFreq staging: join the PREVIOUS persist
                         # (usually done), snapshot now, write async
-                        self.checkpoint.wait()
+                        with self._led_span("checkpoint"):
+                            self.checkpoint.wait()
                         self._timed_save(
                             self._train_state(epoch, cursor_batches,
                                               slab_idx, step, base_seed),
@@ -329,7 +404,8 @@ class TrainingSupervisor:
                     cur, cur_pos = nxt, nxt_pos
                 cursor_batches = 0
         # final durable checkpoint: next-epoch cursor, synchronous
-        self.checkpoint.wait()
+        with self._led_span("checkpoint"):
+            self.checkpoint.wait()
         final_no = self._timed_save(
             self._train_state(max(1, epochs), 0, slab_idx, step,
                               base_seed))
@@ -341,15 +417,32 @@ class TrainingSupervisor:
         return result
 
     # -- helpers -----------------------------------------------------------
-    def _timed_save(self, train_state, async_save=False):
+    @staticmethod
+    def _first_fetch_name(fetch_list):
+        """The loss var name the health monitor reports: the first
+        fetch target (the training-loop convention), or None."""
+        for f in fetch_list or []:
+            name = getattr(f, "name", f if isinstance(f, str) else None)
+            if name:
+                return str(name)
+        return None
+
+    def _timed_save(self, train_state, async_save=False,
+                    ledger_cat="checkpoint"):
         """One checkpoint save with its critical-path duration landed in
-        the ``train_checkpoint_ms`` histogram + a flight-recorder
-        event."""
+        the ``train_checkpoint_ms`` histogram + a flight-recorder event
+        + the goodput ledger (``ledger_cat=None`` when an enclosing
+        span — the preemption exit — already owns the interval)."""
         t0 = time.perf_counter()
-        no = self.checkpoint.save(
-            self.executor, program=self._plain_program,
-            scope=self._scope, train_state=train_state,
-            async_save=async_save)
+        try:
+            no = self.checkpoint.save(
+                self.executor, program=self._plain_program,
+                scope=self._scope, train_state=train_state,
+                async_save=async_save)
+        finally:
+            if self._ledger is not None and ledger_cat:
+                self._ledger.add(ledger_cat,
+                                 time.perf_counter() - t0)
         dt_ms = (time.perf_counter() - t0) * 1e3
         if (not async_save
                 and no not in self.checkpoint.saver.checkpoint_numbers()):
@@ -412,30 +505,59 @@ class TrainingSupervisor:
     def _pull(self, it):
         """Advance the iterator and capture ITS position before the next
         prefetch moves it — the checkpoint after slab i must record the
-        cursor at slab i, not at the prefetched slab i+1."""
-        slab = next(it, None)
+        cursor at slab i, not at the prefetched slab i+1. The time the
+        loop spends blocked in ``next`` is the goodput ledger's
+        ``data_stall``; the device transfer is ``h2d`` (both spans are
+        exception-safe so an injected producer/h2d fault still lands
+        its elapsed time)."""
+        with self._led_span("data_stall"):
+            slab = next(it, None)
         if slab is None:
             return None, None
         pos = it.position()
         if self._prefetch:
-            slab = _device_put_slab(slab, self._plain_program)
+            with self._led_span("h2d"):
+                slab = _device_put_slab(slab, self._plain_program)
         return slab, pos
 
-    def _run_slab(self, slab, fetch_list):
+    _COMPILE_KEYS = ("pass_ms", "trace_ms", "compile_ms", "verify_ms")
+
+    def _run_slab(self, slab, fetch_list, replay=False):
         k = int(np.shape(next(iter(slab.values())))[0])
         kwargs = dict(feed=slab, fetch_list=fetch_list,
                       scope=self._scope, return_numpy=False,
                       skip_nonfinite_steps=self.skip_nonfinite_steps)
+        from .. import profiler as _prof
+        cs0 = (self.executor.cache_stats()
+               if self._ledger is not None and not replay else None)
         t0 = time.perf_counter()
         try:
-            if self.step_watchdog_s > 0:
-                return run_with_watchdog(
-                    self.executor.run_steps, self.step_watchdog_s,
-                    self.program,
-                    what=f"fused training slab ({k} steps)", **kwargs)
-            return self.executor.run_steps(self.program, **kwargs)
+            with _prof.record_event("train/slab"):
+                if self.step_watchdog_s > 0:
+                    return run_with_watchdog(
+                        self.executor.run_steps, self.step_watchdog_s,
+                        self.program,
+                        what=f"fused training slab ({k} steps)",
+                        **kwargs)
+                return self.executor.run_steps(self.program, **kwargs)
         finally:
-            _M_SLAB_MS.observe((time.perf_counter() - t0) * 1e3)
+            dt = time.perf_counter() - t0
+            _M_SLAB_MS.observe(dt * 1e3)
+            if self._ledger is not None:
+                if replay:
+                    # re-running a slab the crash destroyed is
+                    # restart-replay, not forward progress
+                    self._ledger.add("recovery", dt)
+                else:
+                    # split the cache-miss trace/XLA-compile share out
+                    # of the slab wall so steady state reports compute
+                    cs1 = self.executor.cache_stats()
+                    comp = sum(cs1[c] - cs0[c]
+                               for c in self._COMPILE_KEYS) / 1e3
+                    comp = min(max(comp, 0.0), dt)
+                    if comp:
+                        self._ledger.add("compile", comp)
+                    self._ledger.add("compute", dt - comp)
 
     def _preempt_exit(self, slab_idx, step, epoch, batches, base_seed):
         """Bounded-deadline fast checkpoint, then typed exit. A save
@@ -448,30 +570,34 @@ class TrainingSupervisor:
 
         def _fast_save():
             self.checkpoint.wait()     # pending async persists count too
-            return self._timed_save(state)
+            # the preempt ledger span owns this whole interval — the
+            # save must not double-charge "checkpoint"
+            return self._timed_save(state, ledger_cat=None)
 
-        try:
-            if self.preempt_deadline_s > 0:
-                no = run_with_watchdog(_fast_save, self.preempt_deadline_s,
-                                       what="preemption fast checkpoint")
-            else:
-                no = _fast_save()
-        except WatchdogTimeout:
-            # the overbudget worker cannot be cancelled, but it must not
-            # publish a checkpoint AFTER we report it nonexistent —
-            # abandon every in-flight number so its eventual commit is
-            # dropped and the staging dir removed
-            self.checkpoint.saver.abandon_inflight()
-            no = self.checkpoint.latest_no()
-        except Exception as exc:  # noqa: BLE001 — exit beats durability
-            print(f"[train] preemption checkpoint failed "
-                  f"({type(exc).__name__}: {exc}); the previous "
-                  f"checkpoint stands")
-            no = self.checkpoint.latest_no()
-        reason = _preempt.preemption_reason() or "requested"
-        _M_PREEMPTIONS.inc()
-        _flightrec().record("preempted", reason=reason, slab=slab_idx,
-                            step=step, checkpoint_no=no)
+        with self._led_span("preempt"):
+            try:
+                if self.preempt_deadline_s > 0:
+                    no = run_with_watchdog(
+                        _fast_save, self.preempt_deadline_s,
+                        what="preemption fast checkpoint")
+                else:
+                    no = _fast_save()
+            except WatchdogTimeout:
+                # the overbudget worker cannot be cancelled, but it
+                # must not publish a checkpoint AFTER we report it
+                # nonexistent — abandon every in-flight number so its
+                # eventual commit is dropped and the staging dir removed
+                self.checkpoint.saver.abandon_inflight()
+                no = self.checkpoint.latest_no()
+            except Exception as exc:  # noqa: BLE001 — exit > durability
+                print(f"[train] preemption checkpoint failed "
+                      f"({type(exc).__name__}: {exc}); the previous "
+                      f"checkpoint stands")
+                no = self.checkpoint.latest_no()
+            reason = _preempt.preemption_reason() or "requested"
+            _M_PREEMPTIONS.inc()
+            _flightrec().record("preempted", reason=reason, slab=slab_idx,
+                                step=step, checkpoint_no=no)
         raise PreemptedError(
             f"training preempted ({reason}) at slab {slab_idx} "
             f"(step {step}); newest durable checkpoint: "

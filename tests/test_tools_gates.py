@@ -235,6 +235,51 @@ def test_bench_compare_gate(tmp_path):
         [p_old, p_ok, "--key", "configs.nope.value", "--strict"]) == 1
 
 
+def test_train_report_gate(tmp_path):
+    """tools/train_report.py gates in tier-1: exit 0 rendering a
+    goodput dump, exit 1 with a NAMED worst category when
+    --assert-goodput-floor is violated, exit 2 on a dump with no
+    ledger samples."""
+    prom = "\n".join([
+        'train_time_seconds_total{category="compute"} 3.0',
+        'train_time_seconds_total{category="data_stall"} 6.0',
+        'train_time_seconds_total{category="checkpoint"} 1.0',
+        'train_goodput_ratio 0.3',
+    ])
+    f = str(tmp_path / "train.prom")
+    with open(f, "w") as fh:
+        fh.write(prom)
+    flight = str(tmp_path / "flight.json")
+    with open(flight, "w") as fh:
+        json.dump({"events": [
+            {"kind": "data_stall", "queue": "buffered",
+             "wait_ms": 812.0, "window_s": 1.0, "fraction": 0.81},
+            {"kind": "checkpoint", "no": 1}]}, fh)
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "train_report.py"),
+         "--from", f, "--flight", flight,
+         "--assert-goodput-floor", "0.25"],
+        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "data_stall" in ok.stdout and "812.0ms" in ok.stdout
+    assert "OK: goodput ratio" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "train_report.py"),
+         "--from", f, "--assert-goodput-floor", "0.8"],
+        capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 1, bad.stdout + bad.stderr[-2000:]
+    assert "GOODPUT-FLOOR VIOLATION" in bad.stderr
+    assert "data_stall" in bad.stderr     # names the worst category
+    empty = str(tmp_path / "empty.prom")
+    with open(empty, "w") as fh:
+        fh.write("some_other_metric 1\n")
+    none = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "train_report.py"),
+         "--from", empty],
+        capture_output=True, text=True, timeout=120)
+    assert none.returncode == 2, none.stdout + none.stderr[-2000:]
+
+
 def test_timeline_conversion_end_to_end():
     """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
     valid Chrome trace JSON."""

@@ -1,0 +1,503 @@
+"""Training observability (goodput PR): the goodput ledger's
+chaos-driven attribution, the input-pipeline stall profiler, the
+model-health monitors, and the train_report / export_metrics tooling.
+
+The attribution contract under test: every second of a supervised run
+lands in exactly one ledger category, the categories sum to measured
+wall time within 1%, and an injected fault moves time into the category
+that NAMES it — producer delay -> data_stall, kill-restart -> recovery,
+preemption -> preempt. The health contract: with the flag at its
+default the fused path is bitwise-unchanged, and a seeded divergence
+breaches the health rules strictly before FLAGS_check_nan_inf raises.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, resilience, train
+from paddle_tpu.dataio import decorator
+from paddle_tpu.observability import GoodputLedger, default_registry
+from paddle_tpu.observability.goodput import CATEGORIES
+from paddle_tpu.observability.recorder import flight_recorder
+from paddle_tpu.resilience import RestartBudgetExceeded
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+sys.path.insert(0, TOOLS)
+
+_shared_cache = {}
+
+
+@pytest.fixture(autouse=True)
+def _clear_preemption():
+    train.clear_preemption()
+    yield
+    train.clear_preemption()
+
+
+def _shared():
+    if not _shared_cache:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], dtype="float32")
+            y = layers.data("y", [-1, 1], dtype="float32")
+            h = layers.fc(x, 16, act="relu")
+            loss = layers.mean(
+                layers.square_error_cost(layers.fc(h, 1), y))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+        _shared_cache.update(main=main, startup=startup, loss=loss,
+                             exe=fluid.Executor())
+    c = _shared_cache
+    return c["main"], c["startup"], c["loss"], c["exe"]
+
+
+def _slabs(n=6, k=4, batch=8):
+    out = []
+    for i in range(n):
+        r = np.random.default_rng(i)
+        out.append(
+            {"x": r.standard_normal((k, batch, 4)).astype(np.float32),
+             "y": r.standard_normal((k, batch, 1)).astype(np.float32)})
+    return out
+
+
+def _supervisor(tmp, name, **kw):
+    main, startup, loss, exe = _shared()
+    kw.setdefault("checkpoint_every_n_slabs", 3)
+    kw.setdefault("restart_backoff", 0.01)
+    kw.setdefault("scope", fluid.Scope())
+    return train.TrainingSupervisor(
+        exe, main, os.path.join(tmp, name), startup_program=startup,
+        steps_per_run=4, **kw)
+
+
+def _dataset(n_batches=12, batch=8):
+    main, startup, loss, exe = _shared()
+    gb = main.global_block()
+    ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(batch)
+    ds.set_use_var([gb.var("x"), gb.var("y")])
+    r = np.random.default_rng(7)
+    ds._samples = [(r.standard_normal(4).astype(np.float32),
+                    r.standard_normal(1).astype(np.float32))
+                   for _ in range(batch * n_batches)]
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# GoodputLedger units
+# ---------------------------------------------------------------------------
+
+def test_ledger_categories_sum_to_wall_and_other_absorbs():
+    led = GoodputLedger().start()
+    with led.span("compute"):
+        time.sleep(0.02)
+    with led.span("checkpoint"):
+        time.sleep(0.01)
+    time.sleep(0.02)               # unattributed -> other
+    led.stop()
+    rep = led.report()
+    assert set(rep["categories"]) == set(CATEGORIES)
+    assert abs(rep["sum_s"] - rep["wall_s"]) <= 0.01 * rep["wall_s"]
+    assert rep["overcount_s"] == 0.0
+    assert rep["categories"]["compute"] >= 0.02
+    assert rep["categories"]["checkpoint"] >= 0.01
+    assert rep["categories"]["other"] >= 0.015
+    assert rep["goodput_ratio"] == pytest.approx(
+        rep["categories"]["compute"] / rep["wall_s"], rel=1e-6)
+    with pytest.raises(ValueError):
+        led.add("not_a_category", 1.0)
+
+
+def test_ledger_reports_overcount_instead_of_hiding_it():
+    led = GoodputLedger().start()
+    time.sleep(0.01)
+    led.add("compute", 5.0)        # double-booked: more than wall
+    led.stop()
+    rep = led.report()
+    assert rep["overcount_s"] > 4.0
+    assert rep["sum_s"] > rep["wall_s"]    # the 1% gate would fail
+
+
+# ---------------------------------------------------------------------------
+# chaos-driven attribution
+# ---------------------------------------------------------------------------
+
+def test_supervised_run_attribution_sums_within_1pct(tmp_path):
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(str(tmp_path), "clean")
+    r = sup.run_slabs(_slabs(), fetch_list=[loss])
+    gp = r["goodput"]
+    assert abs(gp["sum_s"] - gp["wall_s"]) <= 0.01 * gp["wall_s"]
+    assert gp["overcount_s"] <= 0.01 * gp["wall_s"]
+    assert gp["categories"]["compute"] > 0
+    assert gp["categories"]["checkpoint"] > 0
+    assert sup.goodput_report()["wall_s"] == pytest.approx(
+        gp["wall_s"], rel=1e-6)
+
+
+def test_producer_delay_chaos_lands_in_data_stall(tmp_path):
+    main, startup, loss, exe = _shared()
+    ds = _dataset()
+    sup = _supervisor(str(tmp_path), "stall",
+                      checkpoint_every_n_slabs=10 ** 9)
+    with resilience.chaos({"dataio.producer": {"delay": 0.04}}):
+        r = sup.train(ds, fetch_list=[loss])
+    gp = r["goodput"]
+    cats = gp["categories"]
+    # 12 batches x 40ms injected parse delay >= 0.4s of data_stall
+    assert cats["data_stall"] >= 0.3, cats
+    non_compute = {c: s for c, s in cats.items()
+                   if c not in ("compute", "compile")}
+    assert max(non_compute, key=non_compute.get) == "data_stall", cats
+    assert abs(gp["sum_s"] - gp["wall_s"]) <= 0.01 * gp["wall_s"]
+
+
+def test_kill_restart_lands_in_recovery(tmp_path):
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(str(tmp_path), "kill", restart_budget=2,
+                      checkpoint_every_n_slabs=2)
+    with resilience.chaos({"train.dispatch": {"after": 4, "times": 1}}):
+        r = sup.run_slabs(_slabs(), fetch_list=[loss])
+    assert r["restarts"] == 1
+    cats = r["goodput"]["categories"]
+    # backoff + reload + replayed slabs all land in recovery
+    assert cats["recovery"] > 0, cats
+    assert cats["compute"] > 0
+
+
+def test_preemption_lands_in_preempt(tmp_path):
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(str(tmp_path), "pre", checkpoint_every_n_slabs=2,
+                      on_slab_end=lambda s, st, f:
+                      train.request_preemption("test") if s == 3
+                      else None)
+    with pytest.raises(train.PreemptedError):
+        sup.run_slabs(_slabs(), fetch_list=[loss])
+    gp = sup.goodput_report()
+    cats = gp["categories"]
+    # the bounded-deadline fast checkpoint + typed exit is preempt, and
+    # the save inside it is not double-charged to checkpoint
+    assert cats["preempt"] > 0, cats
+    assert gp["overcount_s"] <= 0.01 * gp["wall_s"]
+
+
+# ---------------------------------------------------------------------------
+# input-pipeline stall profiler
+# ---------------------------------------------------------------------------
+
+def _hist_count(fam_name, label):
+    fam = default_registry().collect()[fam_name]
+    for values, payload in fam["samples"]:
+        if tuple(values) == (label,):
+            return payload["count"]
+    return 0
+
+
+def test_buffered_slow_producer_records_consumer_waits_and_stall():
+    before = _hist_count("dataio_consumer_wait_ms", "buffered")
+    stalls_before = flight_recorder().counts().get("data_stall", 0)
+    fluid.set_flags({"dataio_stall_window_s": 0.05,
+                     "dataio_stall_ratio": 0.5})
+    try:
+        def slow_reader():
+            for i in range(30):
+                time.sleep(0.01)   # producer-bound: consumer must wait
+                yield i
+        out = list(decorator.buffered(lambda: slow_reader(), 2)())
+        assert out == list(range(30))
+    finally:
+        fluid.set_flags({"dataio_stall_window_s": 1.0,
+                         "dataio_stall_ratio": 0.5})
+    assert _hist_count("dataio_consumer_wait_ms", "buffered") > before
+    # consumer waits dominated every window -> data_stall flight events
+    assert flight_recorder().counts().get("data_stall", 0) \
+        > stalls_before
+
+
+def test_buffered_slow_consumer_records_producer_waits():
+    before = _hist_count("dataio_producer_wait_ms", "buffered")
+    gen = decorator.buffered(lambda: iter(range(40)), 2)()
+    for _ in range(40):            # slow consumer: queue stays full
+        next(gen)
+        time.sleep(0.002)
+    assert _hist_count("dataio_producer_wait_ms", "buffered") > before
+
+
+def test_queue_iterator_occupancy_gauge_and_waits():
+    from paddle_tpu.dataio.reader import DataLoader
+    before = _hist_count("dataio_consumer_wait_ms", "dataloader")
+    loader = DataLoader.from_generator(
+        feed_list=[], capacity=4, use_double_buffer=False)
+
+    def gen():
+        for i in range(24):
+            time.sleep(0.005)
+            yield {"x": np.full((2, 2), i, np.float32)}
+    loader.set_batch_generator(gen)
+    n = sum(1 for _ in loader())
+    assert n == 24
+    assert _hist_count("dataio_consumer_wait_ms", "dataloader") > before
+    occ = default_registry().collect()["dataio_queue_occupancy_ratio"]
+    assert any(tuple(v) == ("dataloader",) for v, _p in occ["samples"])
+
+
+# ---------------------------------------------------------------------------
+# model-health monitors
+# ---------------------------------------------------------------------------
+
+def test_health_fetches_bitwise_unchanged_and_gauges(tmp_path):
+    main, startup, loss, exe = _shared()
+    slabs = _slabs()
+    s_off, s_on = fluid.Scope(), fluid.Scope()
+    r_off = _supervisor(str(tmp_path), "hoff", scope=s_off).run_slabs(
+        slabs, fetch_list=[loss])
+    sup_on = _supervisor(str(tmp_path), "hon", scope=s_on,
+                         health_every_n=2)
+    r_on = sup_on.run_slabs(slabs, fetch_list=[loss])
+    # committed numerics bitwise-identical with health fetches riding
+    gb = main.global_block()
+    for v in list(gb.vars.values()):
+        if not getattr(v, "persistable", False) \
+                or v.type in ("reader", "raw"):
+            continue
+        a, b = s_off.find_var(v.name), s_on.find_var(v.name)
+        if a is None or b is None:
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), v.name
+    # reported fetches identical too (health tail stripped)
+    np.testing.assert_array_equal(np.asarray(r_off["last_fetches"][0]),
+                                  np.asarray(r_on["last_fetches"][0]))
+    hr = sup_on.health_report()
+    assert hr["values"]["loss"] is not None
+    assert hr["values"]["grad_norm"] > 0
+    assert hr["values"]["update_ratio"] > 0
+    assert hr["breached"] == []
+    fam = default_registry().collect()
+    assert fam["train_health_grad_norm_value"]["samples"]
+    # a second supervisor on the same program reuses the health ops
+    # (no program mutation -> no executable invalidation)
+    v0 = main.version
+    _supervisor(str(tmp_path), "hon2",
+                health_every_n=2).run_slabs(slabs[:2],
+                                            fetch_list=[loss])
+    assert main.version == v0
+
+
+def test_seeded_grad_spike_breaches_before_nan_guard(tmp_path):
+    """A diverging run must trip the health rules (flight event +
+    callback) STRICTLY before FLAGS_check_nan_inf raises."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 1), y))
+        # seeded divergence: an overcritical LR multiplies the params
+        # by ~40x per step — a few finite-but-exploding slabs first
+        # (the health monitor's window), float32 overflow soon after
+        fluid.optimizer.SGD(20.0).minimize(loss)
+    exe = fluid.Executor()
+    r = np.random.default_rng(3)
+    slabs = [{"x": r.standard_normal((4, 8, 4)).astype(np.float32),
+              "y": r.standard_normal((4, 8, 1)).astype(np.float32)}
+             for _ in range(20)]
+    flight_recorder().clear()
+    breaches = []
+    sup = train.TrainingSupervisor(
+        exe, main, str(tmp_path / "spike"), startup_program=startup,
+        scope=fluid.Scope(), steps_per_run=4,
+        checkpoint_every_n_slabs=10 ** 9, restart_budget=0,
+        health_every_n=1,
+        on_health_breach=lambda rule, v: breaches.append(rule))
+    fluid.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(RestartBudgetExceeded) as ei:
+            sup.run_slabs(slabs, fetch_list=[loss])
+    finally:
+        fluid.set_flags({"check_nan_inf": False})
+    assert "NonFiniteError" in str(ei.value)
+    assert breaches, "health monitor never breached"
+    events = flight_recorder().snapshot()
+    breach_seq = min(e["seq"] for e in events
+                     if e["kind"] == "train_health_breach")
+    nan_seq = min(e["seq"] for e in events if e["kind"] == "nonfinite")
+    assert breach_seq < nan_seq, \
+        "health breach did not precede the non-finite guard"
+    # the slo machinery recorded the transition too
+    assert any(e["kind"] == "slo_breach"
+               and e.get("scope") == "train_health" for e in events)
+
+
+def test_health_on_forward_only_program_fails_fast(tmp_path):
+    """A config error (no param@GRAD) must raise at supervisor
+    CONSTRUCTION, not burn the restart budget re-hitting the same
+    ValueError inside the supervised loop."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 4], dtype="float32")
+        layers.mean(layers.fc(x, 1))     # forward only, no optimizer
+    with pytest.raises(ValueError, match="param@GRAD"):
+        train.TrainingSupervisor(
+            fluid.Executor(), main, str(tmp_path / "ck"),
+            startup_program=startup, scope=fluid.Scope(),
+            steps_per_run=2, health_every_n=1)
+
+
+def test_health_monitor_loss_spike_unit():
+    from paddle_tpu.train.health import HealthMonitor
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, 2], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        loss = layers.mean(
+            layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    hm = HealthMonitor(main, every_n=1)
+    names = hm.ensure_fetches(loss.name)
+    assert names[0] == loss.name and len(names) == 3
+    # steady loss: no breach; 10x spike: loss_spike breaches
+    for i, lv in enumerate((1.0, 1.05, 1.0, 10.0)):
+        hm.observe(i, [np.asarray([lv]), np.asarray([1.0]),
+                       np.asarray([0.01])], now=float(i))
+    assert any(r == "loss_spike" for r, _v, _s in hm.breaches)
+    # the breach record carries the spike ratio and the slab index
+    rule, value, slab = next(b for b in hm.breaches
+                             if b[0] == "loss_spike")
+    assert value > 3.0 and slab == 3
+
+
+# ---------------------------------------------------------------------------
+# tools: train_report CLI + export_metrics serve()
+# ---------------------------------------------------------------------------
+
+def test_train_report_parse_render_and_floor(tmp_path):
+    import train_report
+    prom = "\n".join([
+        '# HELP train_time_seconds_total x',
+        '# TYPE train_time_seconds_total counter',
+        'train_time_seconds_total{category="compute"} 2.0',
+        'train_time_seconds_total{category="data_stall"} 7.0',
+        'train_time_seconds_total{category="checkpoint"} 1.0',
+        'train_goodput_ratio 0.2',
+    ])
+    p = parsed = train_report.parse_exposition(prom)
+    assert p["categories"]["data_stall"] == 7.0
+    assert p["goodput_ratio"] == 0.2
+    worst, secs = train_report.worst_category(parsed["categories"])
+    assert worst == "data_stall" and secs == 7.0
+    out = train_report.render(p["categories"], p["goodput_ratio"])
+    assert "data_stall" in out and "goodput ratio" in out
+    f = str(tmp_path / "train.prom")
+    with open(f, "w") as fh:
+        fh.write(prom)
+    assert train_report.main(["--from", f]) == 0
+    assert train_report.main(
+        ["--from", f, "--assert-goodput-floor", "0.1"]) == 0
+    assert train_report.main(
+        ["--from", f, "--assert-goodput-floor", "0.9"]) == 1
+
+
+def test_train_report_reads_live_ledger_export(tmp_path):
+    """End-to-end: a real supervised run -> export_metrics dump ->
+    train_report parses the same categories the ledger reported."""
+    import export_metrics
+    import train_report
+    main, startup, loss, exe = _shared()
+    sup = _supervisor(str(tmp_path), "live")
+    r = sup.run_slabs(_slabs(4), fetch_list=[loss])
+    f = str(tmp_path / "live.prom")
+    export_metrics.export(f)
+    with open(f) as fh:
+        parsed = train_report.parse_exposition(fh.read())
+    # cumulative counters cover this run's categories (>= its report)
+    for cat in ("compute", "checkpoint"):
+        assert parsed["categories"].get(cat, 0.0) \
+            >= r["goodput"]["categories"][cat] * 0.5
+    assert parsed["goodput_ratio"] is not None
+
+
+def test_export_metrics_serve_training_process(tmp_path):
+    """The standalone/training-process mode: an in-process HTTP
+    exposition endpoint, scraped like a replica."""
+    from urllib.request import urlopen
+    import export_metrics
+    server = export_metrics.serve("127.0.0.1:0")
+    try:
+        host, port = server.server_address[:2]
+        with urlopen(f"http://{host}:{port}/metrics", timeout=10) as r:
+            text = r.read().decode("utf-8")
+        assert "train_time_seconds_total" in text
+        assert "dataio_queue_occupancy_ratio" in text
+        assert "train_goodput_ratio" in text
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# timeline round-trip: slab spans + goodput / queue-depth counter tracks
+# ---------------------------------------------------------------------------
+
+def test_timeline_roundtrip_training_spans_and_counter_tracks(tmp_path):
+    import timeline
+    from paddle_tpu import profiler
+    main, startup, loss, exe = _shared()
+    gb = main.global_block()
+
+    class _BufferedDataset:
+        """Duck-typed dataset over a buffered() reader so the queue
+        instrumentation runs under the profiler."""
+
+        def batch_iterator(self):
+            r = np.random.default_rng(5)
+
+            def raw():
+                for _ in range(20):
+                    time.sleep(0.002)
+                    yield {"x": r.standard_normal(
+                               (8, 4)).astype(np.float32),
+                           "y": r.standard_normal(
+                               (8, 1)).astype(np.float32)}
+            return decorator.buffered(raw, 2)()
+
+    prof_path = str(tmp_path / "profile")
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    try:
+        sup = _supervisor(str(tmp_path), "tl",
+                          checkpoint_every_n_slabs=10 ** 9)
+        sup.train(_BufferedDataset(), fetch_list=[loss])
+    finally:
+        profiler.stop_profiler(profile_path=prof_path)
+    with open(prof_path) as f:
+        doc = json.load(f)
+    counter_names = {c[0] for c in doc.get("counters", ())}
+    assert any(n.startswith("goodput/") for n in counter_names), \
+        counter_names
+    assert any(n.startswith("dataio/queue_depth") for n in
+               counter_names), counter_names
+    tl_path = str(tmp_path / "timeline.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "timeline.py"),
+         "--profile_path", prof_path, "--timeline_path", tl_path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-1500:]
+    with open(tl_path) as f:
+        trace = json.load(f)
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert "train/slab" in names, names
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    cnames = {e["name"] for e in counters}
+    assert any(n.startswith("goodput/") for n in cnames), cnames
+    assert any(n.startswith("dataio/queue_depth") for n in cnames)
+    # the goodput compute track is monotonically non-decreasing
+    comp = [e["args"]["value"] for e in counters
+            if e["name"] == "goodput/compute_s"]
+    assert comp == sorted(comp) and len(comp) >= 2

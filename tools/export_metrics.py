@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Dump Prometheus text-format metrics to a textfile (the node-exporter
-textfile-collector idiom) or stdout.
+textfile-collector idiom) or stdout — or serve them over HTTP.
 
 Two sources:
 
@@ -13,14 +13,30 @@ Two sources:
 The output file is written atomically (tmp + rename) so a scraper never
 reads a torn exposition.
 
+Standalone / training-process mode: a training job has no serving wire
+to answer ``{"op": "metrics"}``, so two in-process paths make it
+scrapable exactly like a replica:
+
+- ``serve("127.0.0.1:9400")`` starts a daemon-thread HTTP exposition
+  endpoint inside the trainer (Prometheus scrapes it directly; the
+  goodput ledger, stall profiler, and health gauges all ride along);
+- ``--interval N --out path`` loops an atomic textfile dump every N
+  seconds (the textfile-collector cadence for jobs behind a
+  node-exporter).
+
 Usage:
     python tools/export_metrics.py --endpoint 127.0.0.1:8500 \\
         --out /var/lib/node_exporter/textfile/paddle_tpu.prom
     python tools/export_metrics.py            # this process, stdout
+    # in the training driver:
+    #   import tools.export_metrics as em
+    #   em.serve("127.0.0.1:9400")            # scrape like a replica
 """
 import argparse
 import os
 import sys
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -49,6 +65,44 @@ def export(path, text=None, endpoint=None):
     return len(text)
 
 
+def serve(addr="127.0.0.1:0", endpoint=None):
+    """Start a daemon-thread HTTP exposition server (the in-process
+    Prometheus endpoint for TRAINING jobs — no serving wire needed).
+    ``addr`` is ``host:port`` (port 0 = ephemeral); returns the live
+    ``http.server`` instance — read ``server.server_address`` for the
+    bound port, call ``server.shutdown()`` to stop. Every GET renders
+    a fresh scrape of this process's registry (or of ``endpoint`` when
+    forwarding)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            try:
+                body = scrape(endpoint).encode("utf-8")
+            except Exception as exc:  # noqa: BLE001 — scrape survives
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(str(exc).encode("utf-8"))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):   # scrapes are not access-log news
+            pass
+
+    host, _, port = addr.partition(":")
+    server = ThreadingHTTPServer((host or "127.0.0.1", int(port or 0)),
+                                 _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="metrics-exposition")
+    t.start()
+    return server
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--endpoint", default=None,
@@ -61,11 +115,36 @@ def main():
                          "scrape sees the fleet)")
     ap.add_argument("--out", default=None,
                     help="textfile path (default: stdout)")
+    ap.add_argument("--interval", type=float, default=0.0,
+                    help="with --out: re-dump every N seconds (the "
+                         "textfile-collector loop for training jobs; "
+                         "0 = dump once)")
     args = ap.parse_args()
     endpoint = args.router or args.endpoint
+    if args.interval > 0 and not args.out:
+        ap.error("--interval needs --out")
     if args.out:
-        n = export(args.out, endpoint=endpoint)
-        print(f"wrote {n} bytes to {args.out}")
+        first = True
+        while True:
+            try:
+                n = export(args.out, endpoint=endpoint)
+                if first:
+                    print(f"wrote {n} bytes to {args.out}", flush=True)
+                    first = False
+            except Exception as exc:  # noqa: BLE001 — a replica
+                # restart or one timed-out exchange (including on the
+                # VERY FIRST scrape — the exporter may start before
+                # the trainer) must not kill the long-lived scrape
+                # loop: stale-forever metrics are the exact failure
+                # mode this exporter exists to prevent
+                if args.interval <= 0:
+                    raise
+                print(f"scrape failed ({type(exc).__name__}: {exc}); "
+                      f"retrying in {args.interval}s", file=sys.stderr,
+                      flush=True)
+            if args.interval <= 0:
+                break
+            time.sleep(args.interval)
     else:
         sys.stdout.write(scrape(endpoint))
     return 0

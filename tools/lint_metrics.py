@@ -7,7 +7,8 @@ families AND collector-declared ones — must be
 - unique (the registry enforces this at registration; the lint
   re-checks so a poisoned catalog list is caught in tests),
 - unit-suffixed with one of ``observability.metrics.UNIT_SUFFIXES``
-  (``_total``/``_ms``/``_bytes``/``_ratio``/``_state``/``_count``),
+  (``_total``/``_ms``/``_bytes``/``_ratio``/``_state``/``_count``/
+  ``_value``),
 - present in the README "Observability" metric catalog table (a metric
   nobody documented is a metric nobody will find in a dashboard).
 

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Training goodput report: render the goodput ledger as a table and
+gate CI on a goodput floor.
+
+Sources (exactly one):
+
+- ``--from FILE`` — a Prometheus text dump written by
+  ``tools/export_metrics.py`` (``--out``) from a training process;
+- ``--url URL`` — a live scrape of an ``export_metrics.serve()``
+  endpoint (or any exposition URL);
+- no source — THIS process's registry (the library path:
+  ``import train_report; train_report.main([])`` after training
+  in-process).
+
+``--flight FILE`` (a ``FlightRecorder.dump`` JSON) adds the top
+``data_stall`` windows to the table. ``--assert-goodput-floor X``
+exits 1 when compute/wall < X, NAMING the worst non-compute category —
+the CI gate that keeps an input-pipeline regression from landing as a
+silent MFU drop.
+
+Usage:
+    python tools/export_metrics.py --out train.prom   # in the trainer
+    python tools/train_report.py --from train.prom \\
+        --assert-goodput-floor 0.5
+"""
+import argparse
+import json
+import re
+import sys
+
+_CAT_RE = re.compile(
+    r'^train_time_seconds_total\{category="([^"]+)"\}\s+(\S+)\s*$')
+_RATIO_RE = re.compile(r"^train_goodput_ratio\s+(\S+)\s*$")
+
+
+def parse_exposition(text):
+    """-> {"categories": {name: seconds}, "goodput_ratio": float|None}
+    from Prometheus text format."""
+    cats = {}
+    ratio = None
+    for line in text.splitlines():
+        m = _CAT_RE.match(line)
+        if m:
+            cats[m.group(1)] = float(m.group(2))
+            continue
+        m = _RATIO_RE.match(line)
+        if m:
+            ratio = float(m.group(1))
+    return {"categories": cats, "goodput_ratio": ratio}
+
+
+def top_stalls(flight_doc, n=5):
+    """The n largest data_stall windows from a flight-recorder dump."""
+    events = [e for e in flight_doc.get("events", ())
+              if e.get("kind") == "data_stall"]
+    events.sort(key=lambda e: -float(e.get("wait_ms", 0.0)))
+    return events[:n]
+
+
+def cumulative_ratio(categories):
+    """compute / total over the scraped counters — the ratio that is
+    CONSISTENT with the table and with worst_category() (the
+    train_goodput_ratio gauge covers only the most recent run, while
+    the counters accumulate across the process lifetime)."""
+    total = sum(categories.values())
+    return (categories.get("compute", 0.0) / total) if total else 0.0
+
+
+def render(categories, goodput_ratio=None, stalls=()):
+    """The per-category table (share of the category sum — the dump has
+    no wall clock, but a stopped ledger's categories sum to wall)."""
+    total = sum(categories.values())
+    lines = ["----------------  Training goodput ledger  "
+             "----------------",
+             f"{'category':<12} {'seconds':>12} {'share':>8}"]
+    for cat in sorted(categories, key=lambda c: -categories[c]):
+        share = (categories[cat] / total * 100.0) if total > 0 else 0.0
+        lines.append(f"{cat:<12} {categories[cat]:>12.3f} "
+                     f"{share:>7.1f}%")
+    lines.append(f"{'total':<12} {total:>12.3f} {100.0:>7.1f}%")
+    lines.append(f"goodput ratio (compute/wall, cumulative): "
+                 f"{cumulative_ratio(categories):.4f}")
+    if goodput_ratio is not None:
+        lines.append(f"goodput ratio (last run, gauge): "
+                     f"{goodput_ratio:.4f}")
+    for ev in stalls:
+        lines.append(
+            f"stall: queue={ev.get('queue', '?')} waited "
+            f"{float(ev.get('wait_ms', 0.0)):.1f}ms "
+            f"({float(ev.get('fraction', 0.0)):.0%} of a "
+            f"{float(ev.get('window_s', 0.0)):.2f}s window)")
+    return "\n".join(lines)
+
+
+def worst_category(categories):
+    """The largest NON-compute category — what a goodput-floor
+    violation names as the thing to fix."""
+    non_compute = {c: s for c, s in categories.items() if c != "compute"}
+    if not non_compute:
+        return None, 0.0
+    worst = max(non_compute, key=non_compute.get)
+    return worst, non_compute[worst]
+
+
+def _live_text():
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from paddle_tpu.observability import render_metrics
+    return render_metrics()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--from", dest="src", default=None,
+                    help="Prometheus text dump (export_metrics.py "
+                         "--out)")
+    ap.add_argument("--url", default=None,
+                    help="live exposition URL (export_metrics.serve)")
+    ap.add_argument("--flight", default=None,
+                    help="flight-recorder dump JSON: adds the top "
+                         "data_stall windows")
+    ap.add_argument("--assert-goodput-floor", type=float, default=None,
+                    metavar="X",
+                    help="exit 1 when compute/wall < X, naming the "
+                         "worst non-compute category")
+    args = ap.parse_args(argv)
+    if args.src:
+        with open(args.src, encoding="utf-8") as f:
+            text = f.read()
+    elif args.url:
+        from urllib.request import urlopen
+        with urlopen(args.url, timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+    else:
+        text = _live_text()
+    parsed = parse_exposition(text)
+    cats = parsed["categories"]
+    if not cats:
+        print("no train_time_seconds_total samples found — did a "
+              "TrainingSupervisor run in the scraped process?",
+              file=sys.stderr)
+        return 2
+    stalls = ()
+    if args.flight:
+        with open(args.flight, encoding="utf-8") as f:
+            stalls = top_stalls(json.load(f))
+    print(render(cats, parsed["goodput_ratio"], stalls))
+    if args.assert_goodput_floor is not None:
+        # the floor and the named worst category both come from the
+        # SAME cumulative counters — judging the last-run gauge while
+        # blaming a category accumulated across earlier runs would
+        # point the operator at the wrong fix
+        total = sum(cats.values())
+        ratio = cumulative_ratio(cats)
+        if ratio < args.assert_goodput_floor:
+            worst, secs = worst_category(cats)
+            print(f"GOODPUT-FLOOR VIOLATION: ratio {ratio:.4f} < floor "
+                  f"{args.assert_goodput_floor}; worst non-compute "
+                  f"category: {worst} ({secs:.3f}s of "
+                  f"{total:.3f}s wall)", file=sys.stderr)
+            return 1
+        print(f"OK: goodput ratio {ratio:.4f} >= floor "
+              f"{args.assert_goodput_floor}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
