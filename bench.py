@@ -51,6 +51,11 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
                       replicas, the prefill/decode split's KV-block
                       migration cost + parity, and p99 inter-token
                       latency through a mid-generation replica kill
+  comms               extra: sharding audit + collective-traffic
+                      ledger over the three MULTICHIP dryrun meshes
+                      (dp/tp/sp, pp/dp, ep/dp) — per-(collective,
+                      axis) bytes/count ledger, audit finding counts,
+                      predicted comm-bound fraction per mesh
 
 Every throughput config also reports cold_start_ms (first-step
 end-to-end latency) plus the executor's pass/trace/compile ms split, so
@@ -1993,6 +1998,68 @@ def bench_fleet():
     }
 
 
+def bench_comms():
+    """Sharding audit + collective-traffic ledger over the three
+    MULTICHIP dryrun meshes (dp/tp/sp, pp/dp, ep/dp): run
+    ``__graft_entry__.dryrun_multichip(8)`` in a subprocess (it
+    provisions its own 8 virtual CPU devices and always arms
+    FLAGS_shard_audit/FLAGS_comms_ledger), parse the structured
+    per-mesh JSON it now emits, and report per-(collective, axis)
+    bytes/count ledgers, audit finding counts, and the predicted
+    comm-bound fraction per mesh (ICI/DCN peak tables; reference v5e
+    peaks on CPU). The BENCHMARKS.md comms tables come from here."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # the dryrun provisions 8 devices
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py")],
+        capture_output=True, text=True, cwd=repo, env=env,
+        timeout=1200)
+    wall = time.time() - t0
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"dryrun_multichip failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    summary = None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if "meshes" in doc:
+                summary = doc
+    if summary is None:
+        raise RuntimeError("dryrun emitted no structured mesh summary")
+    meshes = {}
+    for name, rec in summary["meshes"].items():
+        led = rec.get("ledger") or {}
+        totals = led.get("totals") or {}
+        meshes[name] = {
+            "loss": rec.get("loss"),
+            "audit_findings": rec.get("audit") or {},
+            "collectives": totals.get("count", 0),
+            "payload_bytes_per_step": totals.get("payload_bytes", 0),
+            "wire_bytes_per_step": totals.get("wire_bytes", 0),
+            "wire_bytes_by_axis": totals.get("by_axis", {}),
+            "comm_bound_ratio": rec.get("comm_bound_ratio"),
+            "ledger": {k: v for k, v in led.items() if k != "totals"},
+        }
+    flagship = meshes.get("dp_tp_sp", {})
+    return {
+        "metric": "comms_dp_tp_sp_predicted_comm_bound_ratio",
+        "value": flagship.get("comm_bound_ratio"),
+        "unit": "ratio",
+        "vs_baseline": None,       # diagnostic layer, no external anchor
+        "dryrun_wall_s": round(wall, 1),
+        "meshes": meshes,
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -2019,6 +2086,8 @@ _CONFIGS = {
     "decode": (bench_decode, "decode_kv_cache_seq256_tokens_per_sec"),
     "profile": (bench_profile, "profile_widedeep_bytes_attributed_ratio"),
     "fleet": (bench_fleet, "fleet_3_replica_aggregate_tokens_per_sec"),
+    "comms": (bench_comms,
+              "comms_dp_tp_sp_predicted_comm_bound_ratio"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

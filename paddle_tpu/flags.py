@@ -99,6 +99,32 @@ _DEFS = {
     # MFU floor on the decode path (0 = rule off; set > 0 on real
     # accelerators where peak tables are known)
     "slo_mfu_floor": (0.0, float, None),
+    # -- sharding audit & collective-traffic ledger (observability/
+    # sharding, observability/comms) --
+    # audit every newly compiled MESH executable's actual shardings
+    # against the declared dist_attr/PartitionSpecs and emit typed
+    # findings (replicated-large-param, unsharded-batch,
+    # sharding-mismatch, reshard-inserted) as shard_audit_finding
+    # flight events + shard_audit_findings_total. Off by default: the
+    # compile-miss path pays one flag read and numerics are
+    # bitwise-unchanged either way (the audit only READS the compiled
+    # executable)
+    "shard_audit": (False, bool, None),
+    # replicated-large-param threshold: a persistable input replicated
+    # across a >1 mesh axis only becomes a finding at or above this
+    # many megabytes (small scales/biases legitimately replicate)
+    "shard_audit_replicated_mb": (16.0, float, None),
+    # parse every newly compiled mesh executable's HLO for collectives
+    # (all-reduce / all-gather / reduce-scatter / all-to-all /
+    # collective-permute), attribute each to a mesh axis via its
+    # replica_groups, and export per-(collective, axis) bytes/op
+    # counters plus the predicted device_comm_bound_ratio gauge
+    "comms_ledger": (False, bool, None),
+    # comma-separated mesh axes that ride DCN instead of ICI (multi-
+    # slice deployments: an axis spanning slices prices its
+    # collectives at the cross-slice fabric). A collective whose group
+    # varies over ANY listed axis uses the DCN peak. "" = all-ICI
+    "comms_dcn_axes": ("", str, None),
     # -- training observability (observability/goodput, train/health,
     # observability/inputstall) --
     # model-health monitoring cadence: every N-th supervised slab

@@ -298,6 +298,27 @@ class Executor:
         if delta is not None and cost:
             _util.observe_execution(where, cost, delta)
 
+    def _maybe_shard_obs(self, where, cache_key, compiled, mesh,
+                         program, feed_names, batch_dim=0):
+        """FLAGS_shard_audit / FLAGS_comms_ledger hook: audit one NEWLY
+        compiled mesh executable's actual shardings and parse its HLO
+        for collective traffic (observability/sharding.py + comms.py).
+        Sits on the compile-miss path only, so it runs once per
+        executable by construction; with both flags off the shared
+        front door costs two flag reads per compile and nothing on the
+        hot path (the cost_for read lands in the same memo
+        _observe_utilization fills on this step anyway). The audit
+        only reads the compiled artifact — numerics are
+        bitwise-unchanged either way."""
+        if mesh is None:
+            return
+        from ..observability.sharding import maybe_observe
+        maybe_observe(
+            where, compiled, mesh, program=program,
+            feed_names=feed_names, batch_dim=batch_dim,
+            cost=_util.cost_for(self._exec_costs, cache_key, compiled),
+            tag=f"program_{program._uid}")
+
     def _optimize(self, program, fetch_names, feed_names=(), scope=None):
         """Run the FLAGS_program_passes pipeline over a clone of
         `program` (framework/passes.py), charging the span to
@@ -555,6 +576,8 @@ class Executor:
             if use_program_cache:
                 self._cache[cache_key] = (compiled, jitted, state_in,
                                           state_out, state_fetches)
+            self._maybe_shard_obs("step", cache_key, compiled, mesh,
+                                  program, tuple(feed_arrays))
 
         if check_nan_inf is None:
             check_nan_inf = _flag("check_nan_inf")
@@ -806,6 +829,12 @@ class Executor:
                                           state_out, mut_names,
                                           slot_names, wo_avals,
                                           state_fetches)
+            # batch_dim=1: the slab's leading K axis replicates by
+            # design; the batch dim the dp axis should shard sits
+            # under it
+            self._maybe_shard_obs("train", cache_key, compiled, mesh,
+                                  program, tuple(feed_arrays),
+                                  batch_dim=1)
 
         # chaos point for the training dispatch stage: fires BEFORE the
         # executable runs, so the scope still holds pre-slab state and a

@@ -273,7 +273,17 @@ class GPTGenerator:
             self.cache.put(sig, compiled,
                            nbytes=ServingEngine._executable_bytes(
                                compiled, feed))
-            _util.cost_for(self._exec_costs, sig, compiled)
+            cost = _util.cost_for(self._exec_costs, sig, compiled)
+            # sharding audit + collective ledger on newly compiled
+            # generation executables (flag-gated shared front door;
+            # program + feed names so fed tensors — tokens, cache
+            # slabs, masks — audit as FEEDS, not as replicated params)
+            from ..observability.sharding import maybe_observe
+            from ..parallel.mesh import get_mesh
+            maybe_observe(stage, compiled, get_mesh(),
+                          program=self._ensure_prog(kind)[0],
+                          feed_names=tuple(feed), cost=cost,
+                          tag=f"generate_{kind}")
             if self.stats:
                 self.stats.bump("compiles")
                 self.stats.hist["compile"].observe(dt)

@@ -40,7 +40,18 @@ Four pieces, one package:
 - :mod:`inputstall` — the input-pipeline stall profiler: queue
   occupancy gauges, producer/consumer wait histograms, and
   ``data_stall`` flight events on the dataio queues.
+- :mod:`sharding` — the sharding audit: per-tensor ACTUAL shardings of
+  a compiled mesh executable diffed against declared
+  ``dist_attr``/PartitionSpecs, typed findings
+  (replicated-large-param, unsharded-batch, sharding-mismatch,
+  reshard-inserted) as flight events + metrics.
+- :mod:`comms` — the collective-traffic ledger: every
+  all-reduce/all-gather/reduce-scatter/all-to-all/collective-permute
+  in a compiled executable's HLO attributed to a mesh axis via its
+  replica_groups, bytes+counts per (collective, axis), rooflined
+  against the ICI/DCN peak tables into ``device_comm_bound_ratio``.
 """
+from .comms import CommLedger, parse_collectives  # noqa: F401
 from .goodput import CATEGORIES, GoodputLedger  # noqa: F401
 from .inputstall import StallTracker  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -52,11 +63,17 @@ from .profiling import (  # noqa: F401
     profile_program,
 )
 from .recorder import FlightRecorder, flight_recorder  # noqa: F401
+from .sharding import (  # noqa: F401
+    ShardingAuditReport, ShardingFinding, audit_executable,
+    lower_program, maybe_observe, observe_executable,
+    recent_observations,
+)
 from .slo import SloMonitor, SloRule, default_server_rules  # noqa: F401
 from .tracing import (  # noqa: F401
     SpanContext, ambient, current, from_wire, maybe_trace, new_trace,
     record_child, record_span, span, to_wire,
 )
 from .utilization import (  # noqa: F401
-    executable_cost, hbm_peak, observe_execution, peak_flops, set_peaks,
+    dcn_peak, executable_cost, hbm_peak, ici_peak, observe_execution,
+    peak_flops, set_peaks,
 )

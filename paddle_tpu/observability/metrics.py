@@ -66,6 +66,10 @@ def _fmt(v):
     if v == float("inf"):
         return "+Inf"
     f = float(v)
+    if f != f:                      # NaN: Prometheus's "no value"
+        return "NaN"
+    if f == float("-inf"):
+        return "-Inf"
     return str(int(f)) if f == int(f) else repr(f)
 
 

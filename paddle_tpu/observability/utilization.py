@@ -48,7 +48,35 @@ HBM_PEAK = {
     "v6": 1638e9,
 }
 
-_override = {"flops": None, "bytes": None}
+# per-chip aggregate ICI (inter-chip interconnect) bandwidth in bytes/s
+# (public per-chip-kind "interchip interconnect BW" specs, Gbps -> B/s).
+# The collective-traffic ledger (observability/comms.py) rooflines each
+# mesh axis's per-step bytes against this — bench.py and the live
+# device_comm_bound_ratio gauge import the SAME table, the PR-9 MFU
+# agreement-by-construction discipline applied to communication.
+ICI_PEAK = {
+    "v5 lite": 200e9, "v5e": 200e9,   # 1600 Gbps
+    "v5p": 600e9,                     # 4800 Gbps
+    "v4": 300e9,                      # 2400 Gbps
+    "v3": 82e9,                       # 656 Gbps
+    "v2": 62e9,                       # 496 Gbps
+    "v6": 448e9,                      # 3584 Gbps (Trillium)
+}
+
+# per-host DCN (data-center network) bandwidth in bytes/s — the
+# cross-slice fabric collectives ride when a mesh axis spans slices
+# (mesh.py: intra-slice traffic rides ICI, cross-slice DCN). Public
+# per-host NIC specs; coarser than ICI by construction.
+DCN_PEAK = {
+    "v5 lite": 25e9, "v5e": 25e9,     # 200 Gbps host NIC
+    "v5p": 25e9,
+    "v4": 25e9,
+    "v3": 12.5e9,                     # 100 Gbps
+    "v2": 12.5e9,
+    "v6": 50e9,                       # 400 Gbps
+}
+
+_override = {"flops": None, "bytes": None, "ici": None, "dcn": None}
 
 _FLOPS = default_registry().counter(
     "device_flops_total", "cost_analysis FLOPs dispatched",
@@ -87,6 +115,31 @@ def hbm_peak(device=None):
     return None
 
 
+def ici_peak(device=None):
+    """Per-chip ICI bandwidth (bytes/s) of ``device``; same
+    substring-match + :func:`set_peaks` override contract as
+    :func:`peak_flops` (None on unlisted hardware, e.g. CPU)."""
+    if _override["ici"] is not None:
+        return _override["ici"]
+    kind = _device_kind(device)
+    for key, b in ICI_PEAK.items():
+        if key in kind:
+            return b
+    return None
+
+
+def dcn_peak(device=None):
+    """Per-host DCN bandwidth (bytes/s) of ``device``; same contract as
+    :func:`ici_peak`."""
+    if _override["dcn"] is not None:
+        return _override["dcn"]
+    kind = _device_kind(device)
+    for key, b in DCN_PEAK.items():
+        if key in kind:
+            return b
+    return None
+
+
 def _device_kind(device):
     if device is None:
         try:
@@ -111,12 +164,17 @@ def _default_peaks():
     return _peaks_memo
 
 
-def set_peaks(flops_per_s=None, hbm_bytes_per_s=None):
+def set_peaks(flops_per_s=None, hbm_bytes_per_s=None,
+              ici_bytes_per_s=None, dcn_bytes_per_s=None):
     """Override the peak tables (unlisted hardware, or tests that need
-    deterministic ratios on CPU). ``None`` restores table lookup."""
+    deterministic ratios on CPU). ``None`` restores table lookup for
+    that peak — every call re-states all four, so ``set_peaks()`` is a
+    full reset. Invalidates the hot-path memos."""
     global _peaks_memo
     _override["flops"] = flops_per_s
     _override["bytes"] = hbm_bytes_per_s
+    _override["ici"] = ici_bytes_per_s
+    _override["dcn"] = dcn_bytes_per_s
     _peaks_memo = None
 
 

@@ -62,16 +62,26 @@ class Optimizer:
                 param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         block = default_main_program().global_block()
+        # NOTE: `shape or param.shape` means an explicit scalar
+        # shape=[] ALSO falls back to param.shape (beta-pow
+        # accumulators are param-shaped, reference-compat — the fused
+        # optimizer pass and checkpoints encode that layout)
+        actual_shape = shape or param.shape
         var = block.create_var(
             name=unique_name.generate(f"{param.name}_{name}"),
-            shape=shape or param.shape, dtype=dtype or param.dtype,
+            shape=actual_shape, dtype=dtype or param.dtype,
             persistable=True, stop_gradient=True)
         # io.load_checkpoint reads this marker to tell "params-only save,
         # optimizer slabs missing" apart from a generally torn checkpoint
         # and raise the actionable CheckpointIncompleteError
         var.is_optimizer_state = True
-        if param.dist_attr is not None and (shape is None or
-                                            list(shape) == list(param.shape)):
+        # copy the param's sharding onto every accumulator the CREATED
+        # shape actually matches — checking the passed `shape` instead
+        # left the param-shaped beta-pows replicated across tp meshes
+        # (every chip updating a full param-sized tensor; found by the
+        # sharding audit)
+        if param.dist_attr is not None and \
+                list(actual_shape) == list(param.shape):
             var.dist_attr = param.dist_attr
         ConstantInitializer(fill_value)(var)
         self._accumulators.setdefault(name, {})[param.name] = var

@@ -17,6 +17,7 @@ import numpy as np
 
 from .batching import next_bucket
 from .cache import ExecutableCache, feed_signature
+from ..flags import flag
 from ..observability import tracing as _trace
 from ..observability import utilization as _util
 from ..resilience import (CheckpointCorruptError, maybe_fail,
@@ -179,7 +180,16 @@ class ServingEngine:
         self.cache.put(sig, compiled, nbytes=nbytes)
         # cost_analysis read once per executable: the live MFU/HBM
         # gauges attach it to every later execute() timing
-        _util.cost_for(self._costs, sig, compiled)
+        cost = _util.cost_for(self._costs, sig, compiled)
+        # sharding audit + collective ledger on newly compiled serving
+        # executables (flag-gated shared front door, mesh runs only —
+        # the tensor-parallel serving PR this instruments)
+        from ..observability.sharding import maybe_observe
+        from ..parallel.mesh import get_mesh
+        maybe_observe("infer", compiled, get_mesh(),
+                      program=self.program,
+                      feed_names=self.feed_names, cost=cost,
+                      tag="serving_infer")
         if self.stats:
             self.stats.bump("compiles")
             self.stats.hist["compile"].observe(dt)
@@ -430,7 +440,6 @@ class GenerationEngine:
                  paged=None, kv_dtype=None, kv_block_size=None,
                  kv_pool_blocks=None, pool_name="serving"):
         import jax
-        from ..flags import flag
         self.gen = generator
         self.slots = int(slots or flag("decode_slots"))
         self.stats = stats if stats is not None else generator.stats

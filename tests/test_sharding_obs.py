@@ -1,0 +1,573 @@
+"""Sharding audit & collective-traffic ledger
+(observability/sharding.py + observability/comms.py): seeded findings
+one per code, hand-computable ledger bytes, flag-off bitwise parity on
+the GPT dp-mesh path, Perfetto round-trip of comm spans + counter
+tracks, and the ICI/DCN peak-table override contract."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.observability import comms, sharding as shobs
+from paddle_tpu.observability import utilization
+from paddle_tpu.observability.metrics import default_registry
+from paddle_tpu.parallel.compiler import CompiledProgram
+from paddle_tpu.parallel.mesh import (MeshConfig, make_mesh,
+                                      set_param_dist_attr)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture
+def obs_flags():
+    """Arm the audit + ledger flags for one test; restore after."""
+    old = fluid.get_flags(["FLAGS_shard_audit", "FLAGS_comms_ledger",
+                           "FLAGS_shard_audit_replicated_mb"])
+    fluid.set_flags({"FLAGS_shard_audit": True,
+                     "FLAGS_comms_ledger": True,
+                     "FLAGS_shard_audit_replicated_mb": 0.001})
+    shobs.recent_observations(clear=True)
+    yield
+    fluid.set_flags(old)
+    shobs.recent_observations(clear=True)
+
+
+def _mesh(**axes):
+    import math
+    n = math.prod(axes.values())
+    return make_mesh(MeshConfig(**axes), devices=jax.devices()[:n])
+
+
+def _mlp_train_program(in_dim=64, hidden=256):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [-1, in_dim], dtype="float32")
+        y = layers.data("y", [-1, 1], dtype="float32")
+        h = layers.fc(x, hidden, act="relu", name="big")
+        loss = layers.mean(layers.square_error_cost(
+            layers.fc(h, 1, name="head"), y))
+        fluid.optimizer.SGDOptimizer(1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _param(main, prefix, ndim=2):
+    """The program's persistable var named ``<prefix>.w_<k>`` — the
+    unique-name counter shifts suffixes between tests, so tests resolve
+    names instead of hard-coding ``_0``."""
+    gb = main.global_block()
+    for n, v in gb.vars.items():
+        if n.startswith(prefix + ".w_") and len(v.shape) == ndim \
+                and getattr(v, "persistable", False):
+            return n
+    raise KeyError(prefix)
+
+
+# ---------------------------------------------------------------------------
+# Seeded audit findings, one per code.
+# ---------------------------------------------------------------------------
+
+def test_replicated_large_param_finding():
+    """A deliberately un-annotated large param under a tp mesh is named
+    with its bytes; annotating it makes the finding disappear."""
+    mesh = _mesh(dp=2, tp=2)
+    main, _startup, loss = _mlp_train_program()
+    compiled, feeds = shobs.lower_program(main, mesh, batch=8,
+                                          fetch_names=[loss.name])
+    big_w = _param(main, "big")
+    rep = shobs.audit_executable(compiled, mesh, program=main,
+                                 feed_names=feeds, threshold_mb=0.001)
+    bad = rep.by_code("replicated-large-param")
+    assert any(f.var == big_w for f in bad), rep.format_table()
+    w = next(f for f in bad if f.var == big_w)
+    assert w.nbytes == 64 * 256 * 4            # exact byte attribution
+    assert w.actual == (None, None)
+    # annotate -> the tp-sharded weight no longer replicates
+    set_param_dist_attr(main, big_w, (None, "tp"))
+    compiled2, feeds2 = shobs.lower_program(main, mesh, batch=8,
+                                            fetch_names=[loss.name])
+    rep2 = shobs.audit_executable(compiled2, mesh, program=main,
+                                  feed_names=feeds2, threshold_mb=0.001)
+    assert not any(f.var == big_w for f in
+                   rep2.by_code("replicated-large-param")), \
+        rep2.format_table()
+
+
+def test_unsharded_batch_finding():
+    """A batch dim that does not divide dp replicates the feed — the
+    audit names it; a dividing batch stays clean."""
+    mesh = _mesh(dp=2)
+    main, _startup, loss = _mlp_train_program(in_dim=16, hidden=8)
+    compiled, feeds = shobs.lower_program(main, mesh, batch=3,
+                                          fetch_names=[loss.name])
+    rep = shobs.audit_executable(compiled, mesh, program=main,
+                                 feed_names=feeds, threshold_mb=1e9)
+    found = rep.by_code("unsharded-batch")
+    assert {f.var for f in found} == {"x", "y"}, rep.format_table()
+    assert "does not divide dp=2" in found[0].message
+    compiled2, feeds2 = shobs.lower_program(main, mesh, batch=4,
+                                            fetch_names=[loss.name])
+    rep2 = shobs.audit_executable(compiled2, mesh, program=main,
+                                  feed_names=feeds2, threshold_mb=1e9)
+    assert not rep2.by_code("unsharded-batch"), rep2.format_table()
+
+
+def test_sharding_mismatch_finding():
+    """A dist_attr annotated AFTER the executable was compiled (the
+    annotate-after-minimize failure mode) diverges from the actual
+    placement and is flagged."""
+    mesh = _mesh(dp=2, tp=2)
+    main, _startup, loss = _mlp_train_program(in_dim=16, hidden=8)
+    compiled, feeds = shobs.lower_program(main, mesh, batch=8,
+                                          fetch_names=[loss.name])
+    big_w = _param(main, "big")
+    set_param_dist_attr(main, big_w, (None, "tp"))  # too late
+    rep = shobs.audit_executable(compiled, mesh, program=main,
+                                 feed_names=feeds, threshold_mb=1e9)
+    mm = rep.by_code("sharding-mismatch")
+    assert [f.var for f in mm] == [big_w], rep.format_table()
+    assert mm[0].declared == (None, "tp")
+    assert mm[0].actual == (None, None)
+
+
+def test_reshard_inserted_finding_and_exact_ledger_bytes():
+    """A with_sharding_constraint round-trip forces a GSPMD all-gather:
+    the audit flags it and the ledger's bytes are exactly
+    hand-computable (8x16 f32 gathered over dp=2 -> payload 512 B,
+    ring wire (S-1)/S -> 256 B)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh(dp=2)
+
+    def f(x):
+        y = x * 2.0
+        return jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P()))
+
+    aval = jax.ShapeDtypeStruct((8, 16), np.float32,
+                                sharding=NamedSharding(mesh, P("dp")))
+    compiled = jax.jit(f).lower(aval).compile()
+    rep = shobs.audit_executable(compiled, mesh, threshold_mb=1e9)
+    rs = rep.by_code("reshard-inserted")
+    assert rs and rs[0].op_type == "all-gather", rep.format_table()
+    led = comms.CommLedger.from_compiled(compiled, mesh)
+    assert led.rows == {("all-gather", "dp"): {
+        "count": 1, "payload_bytes": 512, "wire_bytes": 256,
+        "group_size": 2}}, led.rows
+    t = led.totals()
+    assert t["by_axis"] == {"dp": 256}
+
+
+def test_psum_ledger_axis_attribution():
+    """A contraction over a tp-sharded dim lowers to one psum: the
+    ledger attributes the all-reduce to tp, not dp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _mesh(dp=2, tp=2)
+
+    def f(x, w):
+        return x @ w                     # contraction dim tp-sharded
+
+    xa = jax.ShapeDtypeStruct((8, 16), np.float32,
+                              sharding=NamedSharding(mesh,
+                                                     P("dp", "tp")))
+    wa = jax.ShapeDtypeStruct((16, 4), np.float32,
+                              sharding=NamedSharding(mesh,
+                                                     P("tp", None)))
+    compiled = jax.jit(f).lower(xa, wa).compile()
+    led = comms.CommLedger.from_compiled(compiled, mesh)
+    kinds = {k for k, _axis in led.rows}
+    axes = {axis for _k, axis in led.rows}
+    assert "all-reduce" in kinds or "reduce-scatter" in kinds, led.rows
+    assert "tp" in axes and "dp" not in axes, led.rows
+
+
+def test_async_start_collectives_payload_from_operands():
+    """TPU backends print async collectives as -start/-done pairs whose
+    result is a TUPLE carrying the operand alongside the output:
+    payload must come from the operand list (x S for all-gather), not
+    the tuple sum, and the -done half must not double-count."""
+    mesh = _mesh(dp=2)
+    hlo = "\n".join([
+        "  %ag = (f32[4,16]{1,0}, f32[8,16]{1,0}) "
+        "all-gather-start(f32[4,16]{1,0} %p), channel_id=1, "
+        "replica_groups=[1,2]<=[2], dimensions={0}, "
+        "use_global_device_ids=true",
+        "  %ag.1 = f32[8,16]{1,0} all-gather-done("
+        "(f32[4,16]{1,0}, f32[8,16]{1,0}) %ag)",
+        "  %ar = (f32[8]{0}, f32[8]{0}) all-reduce-start("
+        "f32[8]{0} %q), channel_id=2, replica_groups=[1,2]<=[2], "
+        "use_global_device_ids=true, to_apply=%add",
+        "  %ar.1 = f32[8]{0} all-reduce-done((f32[8]{0}, f32[8]{0}) "
+        "%ar)",
+    ])
+    got = comms.parse_collectives(hlo, mesh)
+    assert [c["kind"] for c in got] == ["all-gather", "all-reduce"]
+    ag, ar = got
+    assert ag["payload_bytes"] == 4 * 16 * 4 * 2    # operand x S
+    assert ag["wire_bytes"] == ag["payload_bytes"] // 2
+    assert ar["payload_bytes"] == 8 * 4             # operand, not tuple
+    assert ag["axis"] == ar["axis"] == "dp"
+
+
+def test_tpu_tiled_layouts_and_variadic_operands():
+    """TPU HLO prints tiled layouts with parens INSIDE operand shapes
+    ({1,0:T(8,128)}): the operand segment must extend to the MATCHING
+    close paren, so every operand of a variadic all-reduce-start (XLA
+    fused gradient buckets) counts."""
+    mesh = _mesh(dp=2)
+    hlo = ("  %ar = (bf16[512,64]{1,0:T(8,128)}, bf16[64]{0:T(256)}, "
+           "bf16[512,64]{1,0:T(8,128)}, bf16[64]{0:T(256)}) "
+           "all-reduce-start(bf16[512,64]{1,0:T(8,128)} %a, "
+           "bf16[64]{0:T(256)} %b), channel_id=1, "
+           "replica_groups=[1,2]<=[2], use_global_device_ids=true, "
+           "to_apply=%add")
+    c, = comms.parse_collectives(hlo, mesh)
+    # both operands counted (512*64 + 64 bf16 elements = 2 bytes each)
+    assert c["payload_bytes"] == (512 * 64 + 64) * 2
+    assert c["axis"] == "dp" and c["group_size"] == 2
+
+
+def test_multi_axis_groups_price_dcn_when_any_axis_crosses():
+    """A 'dp+sp+tp' fused-optimizer all-reduce must ride DCN when ANY
+    of its component axes is cross-slice."""
+    led = comms.CommLedger([{
+        "kind": "all-reduce", "axis": "dp+tp", "group_size": 4,
+        "n_groups": 1, "payload_bytes": 100e9, "wire_bytes": 100e9,
+        "op_name": ""}])
+    utilization.set_peaks(ici_bytes_per_s=100e9, dcn_bytes_per_s=10e9)
+    try:
+        t_ici, _ = led.predicted_comm_s()
+        t_dcn, _ = led.predicted_comm_s(dcn_axes=("dp",))
+        assert abs(t_ici - 1.0) < 1e-9
+        assert abs(t_dcn - 10.0) < 1e-9      # dp crosses -> DCN priced
+    finally:
+        utilization.set_peaks()
+
+
+def test_comm_bound_unknown_cost_is_none():
+    """A missing/False cost (backends without cost_analysis) must read
+    as 'no prediction', never as 100% comm-bound — and the gauge for
+    that `where` must go to NaN (Prometheus "no value"), not keep the
+    previous executable's ratio, without crashing the renderer."""
+    led = comms.CommLedger([{
+        "kind": "all-reduce", "axis": "dp", "group_size": 2,
+        "n_groups": 1, "payload_bytes": 1024, "wire_bytes": 1024,
+        "op_name": ""}])
+    assert led.comm_bound_ratio(None) is None
+    assert led.comm_bound_ratio(False) is None
+    comms.observe_ledger("obs_test_stale", led,
+                         cost={"flops": 1e6, "bytes": 1e6})
+    comms.observe_ledger("obs_test_stale", led, cost=False)
+    text = default_registry().render()
+    assert 'device_comm_bound_ratio{where="obs_test_stale"} NaN' \
+        in text
+
+
+def test_replica_group_parsing_both_syntaxes():
+    mesh = _mesh(dp=2, tp=2)
+    explicit = comms.parse_replica_groups("{{0,1},{2,3}}")
+    assert explicit == [(0, 1), (2, 3)]
+    assert comms.axes_label(explicit, mesh) == "tp"
+    iota = comms.parse_replica_groups("[2,2]<=[2,2]T(1,0)")
+    assert iota == [(0, 2), (1, 3)]
+    assert comms.axes_label(iota, mesh) == "dp"
+    # multi-axis groups get the joined label in axis order
+    whole = comms.parse_replica_groups("[1,4]<=[4]")
+    assert comms.axes_label(whole, mesh) == "dp+tp"
+    assert comms.axes_label([(0,), (1,)], mesh) == "none"
+
+
+def test_empty_replica_groups_means_all_devices():
+    """HLO ``replica_groups={}`` is "all devices in ONE group" — the
+    global all-reduce must not vanish with group_size 1 / wire 0."""
+    mesh = _mesh(dp=2, tp=2)
+    hlo = ("  %ar = f32[256]{0} all-reduce(f32[256]{0} %x), "
+           "replica_groups={}, to_apply=%add")
+    got = comms.parse_collectives(hlo, mesh)
+    assert len(got) == 1
+    c = got[0]
+    assert c["group_size"] == 4 and c["axis"] == "dp+tp"
+    assert c["payload_bytes"] == 1024
+    assert c["wire_bytes"] == int(1024 * 2 * 3 / 4)    # ring 2(S-1)/S
+
+
+# ---------------------------------------------------------------------------
+# Executor / metrics / flight integration.
+# ---------------------------------------------------------------------------
+
+def _run_mesh_step(mesh, scope=None, batch=8):
+    main, startup, loss = _mlp_train_program(in_dim=16, hidden=128)
+    exe = fluid.Executor()
+    scope = scope or fluid.Scope()
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.standard_normal((batch, 16)).astype(np.float32),
+            "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        comp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+        out, = exe.run(comp, feed=feed, fetch_list=[loss])
+    return main, out
+
+
+def test_executor_hook_records_audit_and_ledger(obs_flags):
+    from paddle_tpu.observability.recorder import flight_recorder
+    mesh = _mesh(dp=2)
+    reg = default_registry()
+    ops0 = reg.collect().get("comms_ops_total", {"samples": []})
+    n0 = sum(v for _l, v in ops0["samples"])
+    main, _loss = _run_mesh_step(mesh)
+    obs = shobs.recent_observations()
+    tag = f"program_{main._uid}"
+    assert tag in obs, list(obs)
+    rec = obs[tag]
+    assert rec["findings"].get("replicated-large-param", 0) >= 1
+    assert rec["ledger"].rows, "mesh step produced no collectives?"
+    assert rec["comm_bound_ratio"] is not None
+    # registry export: per-(collective, axis) counters moved
+    snap = reg.collect()
+    n1 = sum(v for _l, v in snap["comms_ops_total"]["samples"])
+    assert n1 > n0
+    labsets = {l for l, _v in snap["comms_ops_total"]["samples"]}
+    assert any(axis == "dp" for _k, axis in labsets), labsets
+    gauge = dict(snap["device_comm_bound_ratio"]["samples"])
+    assert ("step",) in gauge
+    # flight events carry code + var + bytes
+    evs = [e for e in flight_recorder().snapshot()
+           if e["kind"] == "shard_audit_finding" and e["tag"] == tag]
+    assert evs and evs[0]["code"] == "replicated-large-param"
+    assert evs[0]["bytes"] > 0 and evs[0]["var"]
+
+
+def test_recent_observations_keys_unique_per_executable(obs_flags):
+    """Constant tags (serving engine / per-shape executor buckets)
+    must not overwrite earlier executables' records."""
+    mesh = _mesh(dp=2)
+    for batch in (8, 4):                   # two shapes, same tag basis
+        main, out = _run_mesh_step(mesh, batch=batch)
+    obs = shobs.recent_observations()
+    # two distinct programs here, but also force the collision path:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    aval = jax.ShapeDtypeStruct((8,), np.float32,
+                                sharding=NamedSharding(mesh, P("dp")))
+    compiled = jax.jit(lambda x: x.sum()).lower(aval).compile()
+    before = len(shobs.recent_observations())
+    for _ in range(2):
+        shobs.observe_executable("step", compiled, mesh, tag="same")
+    obs = shobs.recent_observations()
+    assert len(obs) == before + 2
+    assert "same" in obs and any(k.startswith("same#") for k in obs)
+
+
+def test_flags_off_records_nothing():
+    fluid.set_flags({"FLAGS_shard_audit": False,
+                     "FLAGS_comms_ledger": False})
+    shobs.recent_observations(clear=True)
+    _run_mesh_step(_mesh(dp=2))
+    assert shobs.recent_observations() == {}
+
+
+def test_gpt_dp_mesh_flag_off_bitwise_parity():
+    """The audit only READS the compiled artifact: a GPT dp-mesh train
+    step with the flags on is bitwise the flags-off step (losses and a
+    touched param)."""
+    from paddle_tpu.models import gpt
+
+    def run(flags_on):
+        fluid.set_flags({"FLAGS_shard_audit": flags_on,
+                         "FLAGS_comms_ledger": flags_on})
+        try:
+            mesh = _mesh(dp=2)
+            cfg = gpt.GPTConfig.tiny()
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 7
+            with fluid.program_guard(main, startup):
+                out = gpt.gpt_pretrain(cfg, 4, 8)
+                fluid.optimizer.AdamOptimizer(1e-3).minimize(
+                    out["loss"])
+            exe = fluid.Executor()
+            scope = fluid.Scope()
+            losses = []
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                comp = CompiledProgram(main).with_data_parallel(
+                    loss_name=out["loss"].name, mesh=mesh)
+                for step in range(3):
+                    feed = gpt.random_batch(
+                        cfg, 4, 8, rng=np.random.default_rng(step))
+                    l, = exe.run(comp, feed=feed,
+                                 fetch_list=[out["loss"]])
+                    losses.append(np.asarray(l))
+                param = np.asarray(
+                    scope.find_var("decoder_layer_0_qkv.w_0"))
+            return losses, param
+        finally:
+            fluid.set_flags({"FLAGS_shard_audit": False,
+                             "FLAGS_comms_ledger": False})
+
+    losses_off, param_off = run(False)
+    losses_on, param_on = run(True)
+    for a, b in zip(losses_off, losses_on):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(param_off, param_on)
+
+
+def test_gpt_tp_mesh_audits_clean_of_replicated_params(obs_flags):
+    """The GPT tensor-parallel config (apply_tp_sharding before
+    minimize) audits clean: every >threshold param carries a tp
+    dist_attr that the compiled executable honors."""
+    from paddle_tpu.models import gpt
+    mesh = _mesh(tp=2)
+    cfg = gpt.GPTConfig.tiny()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = gpt.gpt_pretrain(cfg, 4, 8)
+        gpt.apply_tp_sharding(main, cfg)
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(out["loss"])
+    compiled, feeds = shobs.lower_program(
+        main, mesh, batch=4, fetch_names=[out["loss"].name])
+    # 0.01 MiB: pos_embedding (8 KiB) replicates BY DESIGN
+    # (Megatron keeps position embeddings replicated) and sits below;
+    # an unsharded qkv/ffn weight (12+ KiB) would not. The
+    # param-shaped Adam accumulators inherit their param's dist_attr
+    # (the optimizer copy-condition fix this audit surfaced).
+    rep = shobs.audit_executable(
+        compiled, mesh, program=main, feed_names=feeds,
+        threshold_mb=0.01)
+    assert not rep.by_code("replicated-large-param"), \
+        rep.format_table()
+    # and the Megatron psums are on the tp axis in the ledger
+    led = comms.CommLedger.from_compiled(compiled, mesh)
+    assert ("all-reduce", "tp") in led.rows, led.rows
+
+
+# ---------------------------------------------------------------------------
+# Perfetto round-trip: comm child spans + comms/<axis>_bytes counters.
+# ---------------------------------------------------------------------------
+
+def test_timeline_roundtrip_comm_spans_and_counter_tracks(
+        tmp_path, obs_flags):
+    sys.path.insert(0, TOOLS)
+    import timeline
+    from paddle_tpu import profiler
+    prof_path = str(tmp_path / "profile")
+    profiler.reset_profiler()
+    profiler.start_profiler("All")
+    try:
+        _run_mesh_step(_mesh(dp=2))
+    finally:
+        profiler.stop_profiler(profile_path=prof_path)
+    with open(prof_path) as f:
+        doc = json.load(f)
+    span_names = {s[0] for s in doc["spans"]}
+    assert any(n.startswith("comms/ledger_") for n in span_names), \
+        span_names
+    assert any(n.startswith("comm/") and "@" in n
+               for n in span_names), span_names
+    counter_names = {c[0] for c in doc.get("counters", ())}
+    assert "comms/dp_bytes" in counter_names, counter_names
+    tl_path = str(tmp_path / "timeline.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "timeline.py"),
+         "--profile_path", prof_path, "--timeline_path", tl_path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-1500:]
+    with open(tl_path) as f:
+        trace = json.load(f)
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in spans}
+    assert any(n.startswith("comm/") for n in names), names
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"
+                and e["name"] == "comms/dp_bytes"]
+    assert counters, "comms counter track missing from the trace"
+    vals = [e["args"]["value"] for e in counters]
+    assert vals == sorted(vals)      # cumulative per-axis bytes
+
+
+# ---------------------------------------------------------------------------
+# Peak tables: same override/memo contract as PEAK_TFLOPS/HBM_PEAK.
+# ---------------------------------------------------------------------------
+
+def test_ici_dcn_peak_override_and_reset():
+    assert utilization.ici_peak() is None       # CPU: unlisted
+    assert utilization.dcn_peak() is None
+    utilization.set_peaks(ici_bytes_per_s=100e9, dcn_bytes_per_s=10e9)
+    try:
+        assert utilization.ici_peak() == 100e9
+        assert utilization.dcn_peak() == 10e9
+        led = comms.CommLedger([{
+            "kind": "all-reduce", "axis": "dp", "group_size": 2,
+            "n_groups": 1, "payload_bytes": 100e9,
+            "wire_bytes": 100e9, "op_name": ""}])
+        t, ref = led.predicted_comm_s()
+        assert not ref and abs(t - 1.0) < 1e-9       # 100 GB / ICI
+        t2, _ = led.predicted_comm_s(dcn_axes=("dp",))
+        assert abs(t2 - 10.0) < 1e-9                 # 100 GB / DCN
+    finally:
+        utilization.set_peaks()
+    assert utilization.ici_peak() is None
+    # with no table entry the prediction falls back to reference peaks
+    # — flagged per-USE (an empty ledger divides by nothing and stays
+    # unflagged; one fabric overridden doesn't hide the other's ref)
+    led_dp = comms.CommLedger([{
+        "kind": "all-reduce", "axis": "dp", "group_size": 2,
+        "n_groups": 1, "payload_bytes": 8, "wire_bytes": 8,
+        "op_name": ""}])
+    _t, ref = led_dp.predicted_comm_s()
+    assert ref
+    _t, ref = comms.CommLedger([]).predicted_comm_s()
+    assert not ref
+    utilization.set_peaks(ici_bytes_per_s=100e9)     # dcn still ref
+    try:
+        _t, ref = led_dp.predicted_comm_s()
+        assert not ref                               # ici real, used
+        _t, ref = led_dp.predicted_comm_s(dcn_axes=("dp",))
+        assert ref                                   # dcn ref, used
+    finally:
+        utilization.set_peaks()
+
+
+def test_shard_report_cli_mesh_arg():
+    sys.path.insert(0, TOOLS)
+    import shard_report
+    assert shard_report.parse_mesh_arg("dp=2,tp=2") == {"dp": 2,
+                                                        "tp": 2}
+    assert shard_report.parse_mesh_arg("") == {}
+    with pytest.raises(ValueError):
+        shard_report.parse_mesh_arg("zz=2")
+    with pytest.raises(ValueError, match="axis size"):
+        shard_report.parse_mesh_arg("dp=0")
+    with pytest.raises(ValueError, match="want axis=N"):
+        shard_report.parse_mesh_arg("dp=two")
+
+
+def test_parse_collectives_meshless_global_group_counts():
+    """Without a mesh an empty replica_groups still counts: S=2 wire
+    lower bound under the 'unknown' axis, never 0 bytes."""
+    hlo = ("  %ar = f32[256]{0} all-reduce(f32[256]{0} %x), "
+           "replica_groups={}, to_apply=%add")
+    c, = comms.parse_collectives(hlo, mesh=None)
+    assert c["axis"] == "unknown" and c["group_size"] == 2
+    assert c["payload_bytes"] == 1024 and c["wire_bytes"] == 1024
+
+
+def test_multichip_record_nesting_diffable():
+    """The MULTICHIP dryrun's structured record is reachable with
+    tools/bench_compare.py dotted keys (no dots inside ledger keys by
+    construction)."""
+    sys.path.insert(0, TOOLS)
+    import bench_compare
+    doc = {"meshes": {"dp_tp_sp": {
+        "loss": 5.5, "audit": {"reshard-inserted": 24},
+        "ledger": {"all-reduce@dp": {"wire_bytes": 100},
+                   "totals": {"wire_bytes": 100}},
+        "comm_bound_ratio": 0.19}}}
+    assert bench_compare.lookup(
+        doc, "meshes.dp_tp_sp.ledger.all-reduce@dp.wire_bytes") == 100
+    assert bench_compare.lookup(
+        doc, "meshes.dp_tp_sp.comm_bound_ratio") == 0.19

@@ -194,6 +194,62 @@ def test_profile_program_gate(tmp_path):
     assert "top cost op" in bad.stderr
 
 
+def _save_tools_mlp_sharded(tmp):
+    """The _save_tools_mlp program with every 2-D param tp-annotated —
+    the audits-clean input for the shard_report gate (dist_attr
+    survives save_inference_model serialization)."""
+    from paddle_tpu.parallel.mesh import set_param_dist_attr
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16], "float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        out = fluid.layers.fc(h, 8, act="softmax")
+        gb = main.global_block()
+        for n, v in gb.vars.items():
+            if getattr(v, "persistable", False) and len(v.shape) == 2:
+                set_param_dist_attr(main, n, (None, "tp"))
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, ["x"], [out], exe,
+                                      main_program=main)
+    return tmp
+
+
+def test_shard_report_gate(tmp_path):
+    """tools/shard_report.py gates in tier-1: exit 0 (audit clean) on a
+    tp-sharded program, exit 1 NAMING the replicated param on the same
+    program without annotations — the CI gate every mesh PR's sharded
+    program runs through."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    good = _save_tools_mlp_sharded(str(tmp_path / "good"))
+    bad = _save_tools_mlp(str(tmp_path / "bad"))
+    # 0.001 MiB: the 128-byte biases (legitimately replicated) pass,
+    # the 2 KiB fc_0 weight matrix does not
+    mesh = ["--mesh", "dp=2,tp=2", "--threshold-mb", "0.001"]
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shard_report.py"), good,
+         "--audit", "--ledger", "--assert-no-replicated-params",
+         *mesh],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "OK: no replicated-large-param findings" in ok.stdout
+    # the tp psum shows up in the ledger table
+    assert "all-reduce" in ok.stdout and "comm-bound fraction" \
+        in ok.stdout, ok.stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shard_report.py"), bad,
+         "--assert-no-replicated-params", "--json", *mesh],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr[-2000:]
+    assert "REPLICATED-PARAM VIOLATION" in r.stderr
+    doc = json.loads(r.stdout)
+    worst = doc["finding"]
+    # exit 1 NAMES the worst (largest) replicated param
+    assert "fc_" in worst and ".w_" in worst, worst
+    assert doc["audit"]["counts"]["replicated-large-param"] >= 1
+
+
 def test_bench_compare_gate(tmp_path):
     """tools/bench_compare.py: the bench trajectory is a checkable
     artifact — exit 0 within tolerance, exit 1 naming the regressed

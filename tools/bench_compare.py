@@ -4,9 +4,14 @@ trajectory becomes a CHECKABLE artifact instead of a table a human
 eyeballs.
 
 Inputs are either raw ``bench.py`` output (JSON lines; the LAST line is
-the summary) or the driver's ``BENCH_rNN.json`` wrapper (``{"tail":
-"<json lines>"}``). Keys are dotted paths into the summary object, e.g.
-``value``, ``configs.widedeep.value``, ``configs.decode.value``.
+the summary) or a driver wrapper (``{"tail": "<json lines>"}``) — both
+``BENCH_rNN.json`` and ``MULTICHIP_rNN.json`` parse, since the
+multichip dryrun now ends with a structured ``{"meshes": {...}}``
+summary line. Keys are dotted paths into the summary object, e.g.
+``value``, ``configs.widedeep.value``, or for multichip records
+``meshes.dp_tp_sp.comm_bound_ratio`` /
+``meshes.ep_dp.ledger.totals.wire_bytes`` (ledger keys avoid dots by
+construction: ``all-reduce@dp``).
 
 By default a key is HIGHER-IS-BETTER (throughput); prefix it with ``-``
 for lower-is-better (latency/ms):

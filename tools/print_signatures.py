@@ -26,7 +26,7 @@ _DEFAULT_SUBMODULES = [
     "paddle_tpu.complex", "paddle_tpu.inference",
     "paddle_tpu.contrib.mixed_precision", "paddle_tpu.incubate.fleet",
     "paddle_tpu.serving", "paddle_tpu.framework.passes",
-    "paddle_tpu.train",
+    "paddle_tpu.train", "paddle_tpu.observability",
 ]
 
 

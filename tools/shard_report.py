@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Standalone sharding-audit + collective-ledger CLI: compile a saved
+program under a named mesh FROM AVALS ALONE (no data, no initialized
+scope — ``observability.sharding.lower_program``) and report what GSPMD
+decided: per-tensor actual shardings diffed against the program's
+``dist_attr`` annotations, and the per-(collective, axis) traffic
+ledger parsed from the compiled HLO. The offline front-end to the same
+machinery ``FLAGS_shard_audit`` / ``FLAGS_comms_ledger`` run at compile
+time.
+
+Usage:
+    python tools/shard_report.py <path> [--mesh dp=2,tp=2] [--batch B]
+        [--audit] [--ledger] [--json] [--topk N]
+        [--threshold-mb X] [--assert-no-replicated-params]
+        [--ici-gbs G] [--dcn-gbs G] [--dcn-axes pp,...]
+
+<path> is an inference-model directory (containing ``__model__``), a
+``__model__``/``*.pdmodel`` JSON file, or any file written by
+save_inference_model (the tools/profile_program.py input contract;
+``dist_attr`` annotations survive serialization).
+
+    --mesh dp=2,tp=2   mesh axis sizes (default: dp over every device);
+                       the CLI self-provisions that many virtual CPU
+                       devices when the platform has too few
+    --batch B          value substituted for -1 (batch) dims (default 8)
+    --audit            per-tensor sharding findings table (the default
+                       when neither mode is given)
+    --ledger           per-(collective, axis) bytes/count table + the
+                       predicted comm-bound fraction
+    --json             machine-readable output (one JSON object)
+    --threshold-mb X   replicated-large-param threshold (default:
+                       FLAGS_shard_audit_replicated_mb)
+    --assert-no-replicated-params
+                       exit 1 NAMING the largest replicated param when
+                       any replicated-large-param finding fires — the
+                       CI gate a mesh PR runs over its sharded program
+    --ici-gbs / --dcn-gbs / --dcn-axes
+                       override the comm peak tables / mark axes as
+                       cross-slice (observability.set_peaks contract)
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_mesh_arg(spec):
+    """"dp=2,tp=2" -> {"dp": 2, "tp": 2} (validated axis names)."""
+    out = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad --mesh entry {part!r} "
+                             f"(want axis=N)")
+        name, _, n = part.partition("=")
+        name = name.strip()
+        if name not in ("dp", "tp", "pp", "sp", "ep"):
+            raise ValueError(f"unknown mesh axis {name!r} "
+                             f"(dp/tp/pp/sp/ep)")
+        try:
+            size = int(n)
+        except ValueError:
+            raise ValueError(f"bad --mesh entry {part!r} "
+                             f"(want axis=N)") from None
+        if size < 1:
+            raise ValueError(f"bad --mesh entry {part!r} "
+                             f"(axis size must be >= 1)")
+        out[name] = size
+    return out
+
+
+def _provision(n_devices):
+    """Make sure jax sees >= n virtual CPU devices — ONE copy of the
+    fragile XLA_FLAGS/re-init dance lives in
+    ``__graft_entry__._provision_cpu_devices``; delegate to it (the
+    repo root is already on sys.path)."""
+    import __graft_entry__
+    return __graft_entry__._provision_cpu_devices(n_devices)
+
+
+def load_program(path):
+    """(program, feed_names, fetch_names) — ONE loader implementation
+    shared with tools/profile_program.py (same input contract)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import profile_program
+    return profile_program.load_program(path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Sharding audit + collective-traffic ledger of a "
+                    "saved program under a mesh")
+    ap.add_argument("path", help="model dir or __model__/.pdmodel file")
+    ap.add_argument("--mesh", default="",
+                    help="axis sizes, e.g. dp=2,tp=2 (default: dp over "
+                         "every visible device)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--audit", action="store_true")
+    ap.add_argument("--ledger", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--topk", type=int, default=12)
+    ap.add_argument("--threshold-mb", type=float, default=None)
+    ap.add_argument("--assert-no-replicated-params",
+                    action="store_true")
+    ap.add_argument("--ici-gbs", type=float, default=None)
+    ap.add_argument("--dcn-gbs", type=float, default=None)
+    ap.add_argument("--dcn-axes", default="",
+                    help="comma list of axes that ride DCN (default "
+                         "none)")
+    args = ap.parse_args(argv)
+    if not args.audit and not args.ledger:
+        args.audit = True
+
+    axes = parse_mesh_arg(args.mesh)
+    import math
+    n_needed = max(math.prod(axes.values()) if axes else 1, 1)
+    devices = _provision(n_needed)
+
+    from paddle_tpu.observability import set_peaks, sharding
+    from paddle_tpu.observability.comms import CommLedger
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+    if args.ici_gbs or args.dcn_gbs:
+        set_peaks(ici_bytes_per_s=(args.ici_gbs * 1e9
+                                   if args.ici_gbs else None),
+                  dcn_bytes_per_s=(args.dcn_gbs * 1e9
+                                   if args.dcn_gbs else None))
+    dcn_axes = tuple(a.strip() for a in args.dcn_axes.split(",")
+                     if a.strip())
+
+    program, feeds, fetches = load_program(args.path)
+    if axes:
+        mesh = make_mesh(MeshConfig(**axes),
+                         devices=devices[:n_needed])
+    else:
+        mesh = make_mesh(MeshConfig(dp=len(devices)), devices=devices)
+    compiled, feed_names = sharding.lower_program(
+        program, mesh, batch=args.batch,
+        fetch_names=list(fetches) or None,
+        feed_names=list(feeds) or None)
+
+    out = {"path": args.path,
+           "mesh": {a: int(mesh.shape[a]) for a in mesh.axis_names},
+           "batch": args.batch}
+    # one HLO read + one parse, shared by audit and ledger (the
+    # observe_executable discipline — optimized mesh HLO is megabytes)
+    from paddle_tpu.observability.comms import parse_collectives
+    try:
+        hlo_text = compiled.as_text()
+    except Exception:  # noqa: BLE001 — backend-dependent surface
+        hlo_text = ""
+    collectives = parse_collectives(hlo_text, mesh)
+    report = None
+    if args.audit or args.assert_no_replicated_params:
+        report = sharding.audit_executable(
+            program=program, compiled=compiled, mesh=mesh,
+            feed_names=feed_names, threshold_mb=args.threshold_mb,
+            collectives=collectives)
+        out["audit"] = {"counts": report.counts(),
+                        "findings": [f.to_dict() for f in
+                                     report.findings[:args.topk]]}
+    ledger = None
+    if args.ledger:
+        ledger = CommLedger(collectives, mesh=mesh)
+        comm_s, ref = ledger.predicted_comm_s(dcn_axes=dcn_axes)
+        from paddle_tpu.observability.utilization import \
+            executable_cost
+        ratio = ledger.comm_bound_ratio(executable_cost(compiled),
+                                        dcn_axes=dcn_axes)
+        out["ledger"] = ledger.to_dict()
+        out["predicted_comm_s"] = comm_s
+        out["comm_bound_ratio"] = ratio
+        out["ref_peaks"] = ref
+
+    finding = None
+    if args.assert_no_replicated_params:
+        worst = report.worst("replicated-large-param")
+        if worst is not None:
+            n = len(report.by_code("replicated-large-param"))
+            finding = (
+                f"REPLICATED-PARAM VIOLATION: {n} persistable "
+                f"input(s) fully replicated across mesh {out['mesh']}; "
+                f"worst offender {worst.var!r} "
+                f"({worst.nbytes / 2**20:.2f} MiB on every chip) — "
+                f"annotate dist_attr before optimizer.minimize() or "
+                f"raise --threshold-mb")
+            out["finding"] = finding
+
+    if args.as_json:
+        print(json.dumps(out, default=float))
+    else:
+        print(f"mesh {out['mesh']} batch {args.batch}")
+        if args.audit:
+            print(report.format_table())
+        if args.ledger:
+            print(ledger.format_table())
+            rp = " (reference v5e peaks)" if out["ref_peaks"] else ""
+            print(f"predicted comm time/step: "
+                  f"{out['predicted_comm_s'] * 1e3:.4f} ms{rp}; "
+                  f"comm-bound fraction: "
+                  + (f"{out['comm_bound_ratio']:.3f}"
+                     if out["comm_bound_ratio"] is not None else "n/a"))
+        if args.assert_no_replicated_params and finding is None:
+            print("OK: no replicated-large-param findings")
+    if finding:
+        print(finding, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
