@@ -190,6 +190,28 @@ _DEFS = {
     # pool HBM-equivalent to the dense bank it replaces
     # (slots * ceil(max_len/block_size) + 1)
     "kv_pool_blocks": (0, int, None),
+    # -- overload control (resilience.RetryBudget, serving brownout,
+    # fleet autoscaler) --
+    # process-global retry budget: every initial request deposits this
+    # many retry tokens; every retry/hedge/failover withdraws one, so
+    # tail-fighting machinery is bounded at ~ratio x offered load and a
+    # saturated fleet sheds instead of amplifying itself (Tail at
+    # Scale). A small time-based reserve keeps isolated failures
+    # retryable. < 0 disables the budget (unbounded retries — the
+    # bench.py --config overload A/B lever)
+    "retry_budget_ratio": (0.1, float, None),
+    # brownout degradation ladder: a breached-SLO server degrades
+    # best-effort, then batch traffic (shed + capped max_new_tokens +
+    # shrunken admission) BEFORE interactive traffic, recovering
+    # symmetrically as breaches clear
+    "serving_brownout": (True, bool, None),
+    # fleet autoscaler bounds: the Autoscaler holds the replica pool
+    # between these (inclusive), scaling on windowed fleet telemetry
+    "fleet_min_replicas": (1, int, None),
+    "fleet_max_replicas": (4, int, None),
+    # minimum seconds between autoscaler scale events (with the
+    # full-window hysteresis this is what keeps the pool from flapping)
+    "fleet_scale_cooldown_s": (5.0, float, None),
     # -- disaggregated serving fleet (serving/fleet) --
     # router health-probe cadence against every registered replica, and
     # the per-probe wire timeout (a hung replica's accept loop must fail

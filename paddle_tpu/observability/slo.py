@@ -45,16 +45,22 @@ from ..flags import flag as _flag
 from .metrics import default_registry
 from .recorder import flight_recorder as _flightrec
 
+# 256, not the default 64: every InferenceServer mints a monitor scope
+# with several rules, and an in-process fleet (tests, bench, embedded
+# replicas) legitimately churns through far more than 64 (scope, rule)
+# pairs — overflowing the cap folds a NEW server's series into _other
+# and its breach state reads as permanently 0 (the kvpool families hit
+# the same wall in PR 11)
 _BREACHED = default_registry().counter(
     "slo_breached_total",
     "SLO rule breach transitions (ok -> breached), by monitor scope "
     "and rule",
-    labels=("scope", "rule"), max_series=64)
+    labels=("scope", "rule"), max_series=256)
 _STATE = default_registry().gauge(
     "slo_rule_state",
     "current SLO rule state (0 = ok, 1 = breached), by monitor scope "
     "and rule",
-    labels=("scope", "rule"), max_series=64)
+    labels=("scope", "rule"), max_series=256)
 
 _OPS = {
     "<": lambda v, t: v < t,

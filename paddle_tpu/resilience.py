@@ -37,6 +37,11 @@ _CHAOS_FIRED = _registry().counter(
     "chaos_faults_fired_total",
     "chaos-harness faults actually injected, by armed point",
     labels=("point",), max_series=64)
+_BUDGET_EXHAUSTED = _registry().counter(
+    "serving_retry_budget_exhausted_total",
+    "retries/hedges/failovers refused by the process retry budget, by "
+    "consumer",
+    labels=("what",), max_series=16)
 
 # every live CircuitBreaker, for the breaker-state metrics collector
 _BREAKERS = weakref.WeakSet()
@@ -185,13 +190,183 @@ class FaultInjected(RuntimeError):
     genuine bug in the recovery machinery."""
 
 
+class RetryBudgetExhausted(RpcDeadlineError):
+    """The process retry budget refused this retry/hedge/failover: the
+    fleet is already saturated with first-try traffic, and another
+    retry would amplify the overload instead of fixing anything (the
+    metastable retry-storm mode "The Tail at Scale" warns about).
+    Callers must treat it as a fast shed — back off or surface the
+    underlying failure — never as one more thing to retry.
+    Subclasses :class:`RpcDeadlineError` so transport-failure handlers
+    see a connection-class error; ``retry_call`` propagates it without
+    retrying (the CircuitOpenError discipline)."""
+
+
+# --------------------------------------------------------------------------
+# retry budget (token bucket bounding ALL tail-fighting machinery)
+# --------------------------------------------------------------------------
+
+class RetryBudget:
+    """Token bucket bounding retries/hedges/failovers process-wide.
+
+    Every INITIAL request deposits ``ratio`` tokens
+    (:meth:`record_request`); every retry-shaped action withdraws one
+    (:meth:`try_acquire`/:meth:`acquire`). Steady state therefore allows
+    ~``ratio`` retries per request — under a sustained overload every
+    layer's retry machinery (client reconnect, hedging, router
+    failover, ``retry_call`` backoff loops) collectively drains the
+    bucket and converts into fast typed sheds instead of multiplying
+    the offered load. A small time-based reserve
+    (``min_reserve`` tokens refilled over ``window_s``) keeps isolated
+    failures retryable on an otherwise idle process.
+
+    The bucket is shared process-wide by design (per-layer budgets
+    would multiply the allowed amplification), but each distinct
+    consumer (``what``) also holds a small EMERGENCY reserve
+    (``what_reserve`` tokens, refilled over ``window_s``, consulted
+    only when the shared pool is dry) — one subsystem's storm draining
+    the pool must bound, not STARVE, another subsystem's isolated
+    recovery retry (a serving shed storm must not abort a trainer's
+    recoverable pserver bounce). ``window_s = 0`` disables both
+    time-based refills and the per-consumer reserve.
+
+    ``ratio < 0`` disables the budget entirely (every acquire granted)
+    — the A/B lever for demonstrating the retry-storm failure mode.
+    """
+
+    def __init__(self, ratio=None, min_reserve=10.0, window_s=10.0,
+                 cap=None, what_reserve=2.0):
+        if ratio is None:
+            from .flags import flag
+            ratio = flag("retry_budget_ratio")
+        self.ratio = float(ratio)
+        self.min_reserve = float(min_reserve)
+        self.window_s = float(window_s)
+        self.what_reserve = float(what_reserve)
+        # cap bounds token accumulation so a long quiet stretch cannot
+        # bank an unbounded retry burst
+        self.cap = float(cap) if cap is not None \
+            else max(4.0 * self.min_reserve, 60.0)
+        self._tokens = self.min_reserve
+        self._last_refill = time.monotonic()
+        self._what = {}        # consumer -> [tokens, last_refill]
+        self._lock = threading.Lock()
+        self._granted = 0
+        self._denied = 0
+        self._deposits = 0
+
+    def _refill_locked(self, now):
+        if self.window_s > 0:
+            dt = now - self._last_refill
+            if dt > 0:
+                self._tokens = min(
+                    self.cap,
+                    self._tokens + dt * self.min_reserve / self.window_s)
+        self._last_refill = now
+
+    def _what_acquire_locked(self, what, now):
+        """Per-consumer trickle reserve: each distinct ``what`` starts
+        with ``what_reserve`` emergency tokens and refills at
+        ``what_reserve / window_s`` tokens/s — only reached when the
+        shared pool is dry, so a storm elsewhere bounds this consumer
+        to a trickle instead of starving it outright."""
+        if self.window_s <= 0 or self.what_reserve <= 0:
+            return False
+        cell = self._what.get(what)
+        if cell is None:
+            if len(self._what) >= 64:   # bounded like a label set
+                return False
+            cell = self._what[what] = [self.what_reserve, now]
+        dt = now - cell[1]
+        if dt > 0:
+            cell[0] = min(self.what_reserve,
+                          cell[0] + dt * self.what_reserve
+                          / self.window_s)
+        cell[1] = now
+        if cell[0] >= 1.0:
+            cell[0] -= 1.0
+            return True
+        return False
+
+    def record_request(self):
+        """Deposit ``ratio`` tokens for one initial (non-retry)
+        request."""
+        if self.ratio < 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._refill_locked(now)
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+            self._deposits += 1
+
+    def try_acquire(self, what="retry"):
+        """Withdraw one token for a retry/hedge/failover; False (and a
+        bump of ``serving_retry_budget_exhausted_total{what}``) when the
+        budget is spent."""
+        if self.ratio < 0:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            self._refill_locked(now)
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self._granted += 1
+                return True
+            if self._what_acquire_locked(str(what), now):
+                self._granted += 1
+                return True
+            self._denied += 1
+        _BUDGET_EXHAUSTED.inc(labels=(str(what),))
+        _flightrec().record("retry_budget_exhausted", what=str(what))
+        return False
+
+    def acquire(self, what="retry"):
+        """:meth:`try_acquire` or raise :class:`RetryBudgetExhausted`."""
+        if not self.try_acquire(what=what):
+            raise RetryBudgetExhausted(
+                f"retry budget exhausted for {what} (ratio "
+                f"{self.ratio}): the process is already retrying at its "
+                f"bound — shedding instead of amplifying the overload")
+
+    def snapshot(self):
+        with self._lock:
+            return {"tokens": round(self._tokens, 3),
+                    "ratio": self.ratio, "granted": self._granted,
+                    "denied": self._denied, "deposits": self._deposits}
+
+
+_default_budget = None
+_budget_lock = threading.Lock()
+
+
+def default_retry_budget():
+    """THE process-global retry budget — consulted by ``retry_call``,
+    the serving client's reconnect/hedging, and the fleet router's
+    failover/hedging, so one bucket bounds every layer's amplification
+    at once (per-layer budgets would multiply)."""
+    global _default_budget
+    with _budget_lock:
+        if _default_budget is None:
+            _default_budget = RetryBudget()
+        return _default_budget
+
+
+def reset_retry_budget():
+    """Drop the process budget so the next use rebuilds it from the
+    current ``FLAGS_retry_budget_ratio`` — tests and flag flips."""
+    global _default_budget
+    with _budget_lock:
+        _default_budget = None
+
+
 # --------------------------------------------------------------------------
 # retry with exponential backoff + jitter
 # --------------------------------------------------------------------------
 
 def retry_call(fn, deadline=30.0, base_backoff=0.05, max_backoff=2.0,
                retries=None, retry_on=(ConnectionError, OSError),
-               jitter=0.5, what="call", endpoint=None, on_retry=None):
+               jitter=0.5, what="call", endpoint=None, on_retry=None,
+               budget=None):
     """Run ``fn()`` until it succeeds, a non-retryable error escapes, the
     attempt budget is spent, or the wall-clock ``deadline`` passes.
 
@@ -199,8 +374,16 @@ def retry_call(fn, deadline=30.0, base_backoff=0.05, max_backoff=2.0,
     ``max_backoff``, with up to ``jitter`` fraction of random extra so a
     fleet of trainers retrying a recovered pserver doesn't stampede it.
     ``retries`` bounds ADDITIONAL attempts (None = unlimited within the
-    deadline; 0 = single attempt). CircuitOpenError always propagates —
-    retrying a breaker-rejected call would defeat the breaker.
+    deadline; 0 = single attempt). CircuitOpenError and
+    RetryBudgetExhausted always propagate — retrying a breaker- or
+    budget-rejected call would defeat the shed.
+
+    Every retry (not the first attempt) withdraws one token from the
+    process :func:`default_retry_budget` (``budget=`` overrides; the
+    first attempt deposits): when the bucket is dry the call raises
+    :class:`RetryBudgetExhausted` chained to the last failure instead
+    of sleeping into another attempt — a process full of failing
+    callers stops amplifying its own overload.
 
     Raises RpcDeadlineError (chained to the last failure) when the budget
     is exhausted.
@@ -208,10 +391,12 @@ def retry_call(fn, deadline=30.0, base_backoff=0.05, max_backoff=2.0,
     start = time.monotonic()
     attempt = 0
     backoff = float(base_backoff)
+    bud = budget if budget is not None else default_retry_budget()
+    bud.record_request()
     while True:
         try:
             return fn()
-        except CircuitOpenError:
+        except (CircuitOpenError, RetryBudgetExhausted):
             raise
         except retry_on as exc:
             now = time.monotonic()
@@ -229,6 +414,13 @@ def retry_call(fn, deadline=30.0, base_backoff=0.05, max_backoff=2.0,
                     + (f" to {endpoint}" if endpoint else "")
                     + f": {type(exc).__name__}: {exc}",
                     endpoint=endpoint, elapsed=elapsed) from exc
+            if not bud.try_acquire(what=what):
+                raise RetryBudgetExhausted(
+                    f"{what} not retried after {attempt + 1} attempt(s) "
+                    f"over {elapsed:.2f}s"
+                    + (f" to {endpoint}" if endpoint else "")
+                    + f": process retry budget exhausted (last failure "
+                    f"{type(exc).__name__}: {exc})") from exc
             if on_retry is not None:
                 on_retry(attempt, exc)
             time.sleep(backoff * (1.0 + jitter * random.random()))

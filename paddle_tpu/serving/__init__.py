@@ -60,6 +60,18 @@ clipper/ORCA adaptive-batching tradition:
   from compute-bound prefill replicas into bandwidth-bound decode
   replicas' pools (``op: "prefill"`` + ``generate``'s ``kv=`` import)
 
+- overload control: every request carries a priority class
+  (``interactive``/``batch``/``best_effort``) — the queue serves
+  higher classes first and sheds the lowest first under backpressure,
+  deadline-expired queue entries are evicted typed, ``deadline_ms``
+  propagates as the REMAINING budget across client -> router ->
+  replica hops, one process-global ``resilience.RetryBudget`` bounds
+  every retry/hedge/failover (``FLAGS_retry_budget_ratio``), a
+  breached-SLO server walks the brownout ladder
+  (``serving.brownout``, best_effort then batch degrade before
+  interactive), and ``fleet.Autoscaler`` scales the replica pool on
+  the probed telemetry with hysteresis + cooldown
+
 - resilience: the server runs a lifecycle state machine (warming ->
   serving -> draining -> stopped, degraded while the loop supervisor's
   breaker is open), a ``health`` wire op, ``drain()`` graceful shutdown,
@@ -89,11 +101,13 @@ Generation quick start::
     server.stop()
 """
 from .batching import (  # noqa: F401
-    BadRequestError, DeadlineExceededError, DecodeBatcher,
+    PRIORITIES, BadRequestError, DeadlineExceededError, DecodeBatcher,
     GenerationRequest, InternalServerError, MicroBatcher, Request,
     RequestCancelledError, RequestQueue, ServerOverloadedError,
     ServerShutdownError, ServingError, SwapHandle, next_bucket,
+    priority_rank,
 )
+from .brownout import BrownoutController  # noqa: F401
 from .cache import ExecutableCache, LRUCache, feed_signature  # noqa: F401
 from .engine import (  # noqa: F401
     SIGNATURE_FILE, GenerationEngine, ServingEngine,

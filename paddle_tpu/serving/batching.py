@@ -15,16 +15,55 @@ is formed, and a third time right before execution), and load-shedding
 through a ``resilience.CircuitBreaker``: sustained overload/engine
 failures open the breaker, and while it is open requests are refused in
 O(1) without touching the queue.
+
+Priority admission: every request carries a priority CLASS —
+``interactive`` (the default), ``batch``, ``best_effort`` — and the
+queue serves higher classes first (FIFO within a class). Under
+backpressure the LOWEST class sheds first: a full queue evicts its
+youngest lowest-class entry (typed ``ServerOverloadedError``) to admit
+a strictly-higher-class arrival, and entries whose deadline expired
+WHILE QUEUED are failed typed immediately instead of dequeuing into a
+doomed micro-batch (``serving_expired_in_queue_total``).
 """
 import threading
 import time
 
 import numpy as np
 
+from .metrics import (record_class_shed, record_class_done,
+                      record_expired_in_queue)
 from ..observability import tracing as _trace
 from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import (CircuitBreaker, CircuitOpenError, WatchdogTimeout,
                           maybe_fail, run_with_watchdog)
+
+# priority classes, highest first: under overload the server sheds
+# best_effort, then batch, and protects interactive (the brownout
+# ladder follows the same order)
+PRIORITIES = ("interactive", "batch", "best_effort")
+_PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+
+def priority_rank(priority):
+    """Validated rank (0 = highest) for a priority-class name; None
+    means the default class."""
+    if priority is None:
+        return 0
+    try:
+        return _PRIORITY_RANK[priority]
+    except KeyError:
+        raise ValueError(
+            f"unknown priority class {priority!r} — one of "
+            f"{PRIORITIES}") from None
+
+
+def remaining_budget_ms(budget_ms, t0, now=None):
+    """Deadline budget still unspent at ``now`` in ms (may be <= 0 =
+    spent) — the ONE copy of the propagation arithmetic shared by the
+    client's re-send/hedge rewrites and the router's hop forwarding,
+    so the two tiers' accounting can never drift."""
+    return float(budget_ms) \
+        - ((time.monotonic() if now is None else now) - t0) * 1e3
 
 
 class ServingError(RuntimeError):
@@ -101,9 +140,9 @@ class Request:
 
     __slots__ = ("feeds", "rows", "example_sig", "deadline_at",
                  "deadline_ms", "t_enqueue", "t_flush", "result", "error",
-                 "_done", "trace")
+                 "_done", "trace", "priority", "rank")
 
-    def __init__(self, feeds, deadline_ms=None):
+    def __init__(self, feeds, deadline_ms=None, priority=None):
         self.feeds = {n: np.ascontiguousarray(a) for n, a in feeds.items()}
         if not self.feeds:
             raise ValueError("request has no feeds")
@@ -118,11 +157,13 @@ class Request:
         self.example_sig = tuple(sorted(
             (n, tuple(a.shape[1:]), str(a.dtype))
             for n, a in self.feeds.items()))
-        self._init_lifecycle(deadline_ms)
+        self._init_lifecycle(deadline_ms, priority)
 
-    def _init_lifecycle(self, deadline_ms):
+    def _init_lifecycle(self, deadline_ms, priority=None):
         """Deadline/event/result bookkeeping shared with subclasses that
         don't carry an infer feeds dict (GenerationRequest)."""
+        self.rank = priority_rank(priority)
+        self.priority = PRIORITIES[self.rank]
         self.deadline_ms = deadline_ms
         now = time.monotonic()
         self.t_enqueue = now
@@ -173,17 +214,21 @@ class Request:
 
 
 class RequestQueue:
-    """Bounded FIFO with admission control. ``put`` is the single gate
-    every request passes: breaker check (load shed), depth check
-    (backpressure), deadline-already-passed check. ``get`` is consumed by
-    the MicroBatcher only."""
+    """Bounded priority queue with admission control. ``put`` is the
+    single gate every request passes: breaker check (load shed), depth
+    check (backpressure, lowest priority class shed first),
+    deadline-already-passed check. ``get`` is consumed by the batchers
+    only; it serves the highest class first (FIFO within a class) and
+    evicts entries whose deadline expired while queued — they fail
+    typed immediately instead of riding into a doomed batch."""
 
     def __init__(self, max_depth=None, breaker=None, stats=None):
         if max_depth is None:
             from ..flags import flag
             max_depth = flag("serving_queue_depth")
         self.max_depth = int(max_depth)
-        self._items = []
+        # one FIFO per priority rank; depth/backpressure span all three
+        self._items = {r: [] for r in range(len(PRIORITIES))}
         self._cv = threading.Condition()
         self._closed = False
         self._draining = False
@@ -191,6 +236,8 @@ class RequestQueue:
         self._adm_lock = threading.Lock()
         self._adm_counts = {}
         self.stats = stats
+        self.expired_in_queue = 0
+        self.priority_evictions = 0
         if breaker is None:
             from ..flags import flag
             breaker = CircuitBreaker(
@@ -201,7 +248,37 @@ class RequestQueue:
 
     def __len__(self):
         with self._cv:
-            return len(self._items)
+            return sum(len(q) for q in self._items.values())
+
+    def _depth_locked(self):
+        return sum(len(q) for q in self._items.values())
+
+    def _sweep_expired_locked(self, now):
+        """Drop every queued entry whose deadline already passed;
+        returns them (the caller fails them OUTSIDE the lock — a
+        waiter's callback must not run under ``_cv``)."""
+        dead = []
+        for q in self._items.values():
+            live = []
+            for req in q:
+                if req.done():
+                    continue           # abandoned while queued
+                if req.expired(now):
+                    dead.append(req)
+                else:
+                    live.append(req)
+            q[:] = live
+        return dead
+
+    def _fail_expired(self, dead):
+        if not dead:
+            return
+        self.expired_in_queue += len(dead)
+        record_expired_in_queue(len(dead))
+        for req in dead:
+            if self.stats:
+                self.stats.bump("shed_deadline")
+            req.expire(where="queue")
 
     def _record_admission(self, outcome, **fields):
         """Flight-record one admission outcome, SAMPLED per outcome
@@ -218,16 +295,28 @@ class RequestQueue:
             _flightrec().record("admission", outcome=outcome, n=n,
                                 **fields)
 
-    def put(self, req):
+    def put(self, req, max_depth=None):
         """Admit ``req`` or raise ServerOverloadedError /
         DeadlineExceededError. Never blocks — backpressure is a fast
-        refusal, not a slow accept (the client owns retry policy)."""
+        refusal, not a slow accept (the client owns retry policy).
+
+        Under backpressure the lowest class sheds first: expired
+        entries are swept out, then — if the queue is still full — the
+        youngest entry of a strictly LOWER class than ``req`` is
+        evicted (typed) to make room; only when no lower-class victim
+        exists is ``req`` itself refused. ``max_depth`` overrides the
+        queue's depth limit for this one admission (the brownout ladder
+        shrinks admission for degraded classes without touching
+        interactive traffic)."""
         maybe_fail("serving.admit")
+        depth_cap = self.max_depth if max_depth is None \
+            else min(int(max_depth), self.max_depth)
         try:
             self.breaker.before_call()
         except CircuitOpenError as e:
             if self.stats:
                 self.stats.bump("shed_overload")
+            record_class_shed(req.priority)
             self._record_admission("shed_breaker")
             raise ServerOverloadedError(
                 f"load shedding: {e}") from e
@@ -239,6 +328,8 @@ class RequestQueue:
                                    deadline_ms=req.deadline_ms)
             req.expire(where="admission")
             raise req.error
+        dead, victim = [], None
+        genuinely_full = False
         with self._cv:
             if self._closed or self._draining:
                 self.breaker.release_probe()
@@ -247,19 +338,61 @@ class RequestQueue:
                     "server is draining — admission closed"
                     if self._draining and not self._closed
                     else "server is shutting down")
-            if len(self._items) >= self.max_depth:
-                overloaded = True
+            if self._depth_locked() >= depth_cap:
+                # expired entries must not hold a slot against live
+                # traffic: sweep before judging the depth
+                dead = self._sweep_expired_locked(time.monotonic())
+            if self._depth_locked() >= depth_cap:
+                genuinely_full = self._depth_locked() >= self.max_depth
+                # victim eviction only for UN-capped admissions at a
+                # genuinely full queue: a request admitted under a
+                # shrunken per-call cap (the brownout ladder halving a
+                # degraded class's admission) is refused outright — a
+                # degraded class must never evict lower-class work the
+                # queue already admitted, full or not
+                if max_depth is None and genuinely_full:
+                    # shed the lowest class first: evict the YOUNGEST
+                    # entry of the lowest populated class strictly
+                    # below req's (the youngest has waited least —
+                    # least sunk cost to throw away)
+                    for r in range(len(PRIORITIES) - 1, req.rank, -1):
+                        if self._items[r]:
+                            victim = self._items[r].pop()
+                            self.priority_evictions += 1
+                            break
+                overloaded = victim is None
             else:
-                self._items.append(req)
-                self._cv.notify()
                 overloaded = False
-        if overloaded:
-            self.breaker.record_failure()
+            if not overloaded:
+                self._items[req.rank].append(req)
+                self._cv.notify()
+        self._fail_expired(dead)
+        if victim is not None:
             if self.stats:
                 self.stats.bump("shed_overload")
-            self._record_admission("shed_overload", depth=self.max_depth)
+            record_class_shed(victim.priority)
+            self._record_admission("shed_evicted",
+                                   victim=victim.priority)
+            victim.set_error(ServerOverloadedError(
+                f"queued {victim.priority} request shed to admit "
+                f"{req.priority} traffic under backpressure — back off "
+                f"and retry"))
+        if overloaded:
+            if genuinely_full:
+                self.breaker.record_failure()
+            else:
+                # refused by an ARTIFICIAL per-call cap (brownout
+                # shrinking a degraded class) with global capacity to
+                # spare: not the server's fault — the load-shed
+                # breaker must not open and start refusing the
+                # interactive traffic the ladder exists to protect
+                self.breaker.release_probe()
+            if self.stats:
+                self.stats.bump("shed_overload")
+            record_class_shed(req.priority)
+            self._record_admission("shed_overload", depth=depth_cap)
             raise ServerOverloadedError(
-                f"request queue at depth limit ({self.max_depth}); "
+                f"request queue at depth limit ({depth_cap}); "
                 f"retry with backoff")
         self.breaker.record_success()
         if self.stats:
@@ -268,14 +401,35 @@ class RequestQueue:
         return req
 
     def get(self, timeout=None):
-        """Pop the oldest request, or None on timeout/close."""
+        """Pop the oldest request of the HIGHEST populated class, or
+        None on timeout/close. Entries whose deadline expired (or were
+        abandoned) while queued are failed typed as they reach the
+        front — a doomed request must not burn a micro-batch slot —
+        and the pop continues to the next live entry. Cost is
+        amortized O(1): only entries actually removed are examined
+        (the full sweep runs on the put-when-full path, where the
+        depth scan is already being paid)."""
         maybe_fail("serving.queue")
+        dead, out = [], None
         with self._cv:
-            if not self._items:
+            if not self._depth_locked():
                 self._cv.wait(timeout)
-            if not self._items:
-                return None
-            return self._items.pop(0)
+            now = time.monotonic()
+            for r in range(len(PRIORITIES)):
+                q = self._items[r]
+                while q:
+                    req = q.pop(0)
+                    if req.done():          # abandoned while queued
+                        continue
+                    if req.expired(now):
+                        dead.append(req)
+                        continue
+                    out = req
+                    break
+                if out is not None:
+                    break
+        self._fail_expired(dead)
+        return out
 
     def quiesce(self):
         """Stop admitting (``put`` raises :class:`ServerShutdownError`)
@@ -290,8 +444,10 @@ class RequestQueue:
         left to ride out its own timeout against a dead server)."""
         with self._cv:
             self._closed = True
-            drained = self._items[:]
-            self._items.clear()
+            drained = [req for r in range(len(PRIORITIES))
+                       for req in self._items[r]]
+            for q in self._items.values():
+                q.clear()
             self._cv.notify_all()
         for req in drained:
             req.set_error(ServerShutdownError(
@@ -321,7 +477,8 @@ class GenerationRequest(Request):
 
     def __init__(self, prompt, max_new_tokens=32, temperature=0.0,
                  top_k=0, eos_id=None, deadline_ms=None,
-                 export_kv=False, kv=None, first_token=None):
+                 export_kv=False, kv=None, first_token=None,
+                 priority=None):
         prompt = np.asarray(prompt, dtype=np.int32).ravel()
         if prompt.size < 1:
             raise ValueError("generation request has an empty prompt")
@@ -340,7 +497,7 @@ class GenerationRequest(Request):
         self.feeds = None
         self.rows = 1
         self.example_sig = None
-        self._init_lifecycle(deadline_ms)
+        self._init_lifecycle(deadline_ms, priority)
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -518,6 +675,7 @@ class DecodeBatcher:
                 self.stats.bump("requests_failed")
             return
         req.set_result([np.asarray(req.out_tokens, np.int32)])
+        record_class_done(req.priority, time.monotonic() - req.t_enqueue)
         if self.stats:
             self.stats.bump("requests_completed")
             self.stats.hist["total"].observe(
@@ -743,6 +901,10 @@ class DecodeBatcher:
         if req.done():          # abandoned while prefilling
             return
         req.set_result([payload])
+        # NOT record_class_done: in a disaggregated fleet this is the
+        # prefill HOP of one user generate — the decode half records
+        # the class completion; counting both would double goodput and
+        # dilute the gated per-class latency with half-request times
         if self.stats:
             self.stats.bump("kv_exports")
             self.stats.bump("requests_completed")

@@ -17,6 +17,7 @@ import numpy as np
 
 from .batching import next_bucket
 from .cache import ExecutableCache, feed_signature
+from .metrics import record_class_done
 from ..flags import flag
 from ..observability import tracing as _trace
 from ..observability import utilization as _util
@@ -280,6 +281,8 @@ class ServingEngine:
                         self.stats.hist["total"].observe(
                             time.monotonic() - req.t_enqueue)
                     req.set_result(outs)
+                    record_class_done(req.priority,
+                                      time.monotonic() - req.t_enqueue)
                 except Exception as exc:  # noqa: BLE001
                     req.set_error(exc)
                     if self.stats:
@@ -341,6 +344,7 @@ class ServingEngine:
                     res.append(o)
             off += req.rows
             req.set_result(res)
+            record_class_done(req.priority, done_t - req.t_enqueue)
             if self.stats:
                 self.stats.bump("requests_completed")
                 self.stats.hist["total"].observe(done_t - req.t_enqueue)

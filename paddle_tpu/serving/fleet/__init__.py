@@ -36,6 +36,16 @@ Disaggregated split: register replicas with roles instead::
 
     router = fleet.Router([(pre.endpoint, "prefill"),
                            (dec.endpoint, "decode")]).start()
+
+Autoscaling (:class:`~.autoscaler.Autoscaler`): hand the router a
+replica factory and the pool scales itself between
+``FLAGS_fleet_min_replicas`` and ``FLAGS_fleet_max_replicas`` on the
+probed fleet telemetry (queue ratios, kvpool occupancy, SLO breach
+state), with full-window hysteresis + cooldown so it never flaps and a
+drain-aware scale-down path::
+
+    scaler = fleet.Autoscaler(router, factory=spawn_replica).start()
 """
+from .autoscaler import Autoscaler  # noqa: F401
 from .registry import Replica, ReplicaRegistry  # noqa: F401
 from .router import FLEET_EVENT_KINDS, Router  # noqa: F401

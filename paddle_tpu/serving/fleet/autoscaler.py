@@ -1,0 +1,322 @@
+"""Telemetry-driven fleet autoscaler (Autopilot-style, EuroSys 2020).
+
+The Router already exposes every signal a horizontal scaler needs —
+per-replica probed ``health()`` snapshots with queue depths,
+``kvpool_occupancy``, SLO breach counts and brownout levels — but
+nothing acted on them: capacity was whatever the operator started.
+:class:`Autoscaler` closes the loop:
+
+- **windowed signals, not instants**: every ``poll_s`` it folds the
+  in-rotation replicas' telemetry into one pressure sample (mean queue
+  ratio, mean kvpool occupancy, total breached SLO rules) and keeps the
+  last ``window`` samples. A scale decision needs the WHOLE window to
+  agree — one hot scrape never grows the fleet, one idle scrape never
+  shrinks it.
+- **hysteresis + cooldown**: scale-up and scale-down use separate
+  thresholds (``up_*`` / ``down_*``, the no-man's-land between them is
+  the hysteresis band) and every event arms a
+  ``FLAGS_fleet_scale_cooldown_s`` cooldown, so the pool cannot flap
+  even when load sits exactly at a threshold.
+- **replica factory**: ``factory()`` returns a STARTED replica (an
+  ``InferenceServer`` or anything with ``.endpoint``); tests and
+  ``bench.py --config overload`` spawn in-process replicas, production
+  wraps its pod launcher. The autoscaler registers the endpoint with
+  the router and owns the replica's retirement.
+- **drain-aware scale-down**: the victim leaves the dispatch rotation
+  first (``registry.set_state(ep, "draining")``), the autoscaler waits
+  for router-tracked in-flight dispatches to hit zero, removes it from
+  the router, then retires it through ``retire`` (default:
+  ``server.drain()`` — the PR-6 graceful path, in-flight generations
+  finish, nothing is dropped).
+
+Bounds come from ``FLAGS_fleet_min_replicas`` /
+``FLAGS_fleet_max_replicas``; every decision is flight-recorded,
+counted in ``fleet_scale_events_total{direction}`` and visible as the
+``fleet_replicas_count{state}`` gauge — ``tools/fleet_report.py``
+renders the trail from any metrics dump.
+"""
+import threading
+import time
+from collections import deque
+
+from ...flags import flag
+from ...observability.metrics import default_registry
+from ...observability.recorder import flight_recorder as _flightrec
+
+_REPLICAS = default_registry().gauge(
+    "fleet_replicas_count",
+    "autoscaled fleet replicas by rotation state "
+    "(serving/draining/evicted)",
+    labels=("state",), max_series=8)
+_SCALE_EVENTS = default_registry().counter(
+    "fleet_scale_events_total",
+    "autoscaler scale decisions executed, by direction (up/down)",
+    labels=("direction",), max_series=4)
+
+
+class Autoscaler:
+    """Scales a Router's replica pool between min/max on windowed fleet
+    telemetry. See the module docstring for the control law."""
+
+    def __init__(self, router, factory, *, retire=None,
+                 min_replicas=None, max_replicas=None, cooldown_s=None,
+                 poll_s=0.25, window=3, up_queue_ratio=0.5,
+                 down_queue_ratio=0.05, up_kv_ratio=0.75,
+                 down_kv_ratio=0.25, drain_timeout_s=15.0, role="both"):
+        self.router = router
+        self.factory = factory
+        self._retire = retire
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else flag("fleet_min_replicas"))
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else flag("fleet_max_replicas"))
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else flag("fleet_scale_cooldown_s"))
+        self.poll_s = float(poll_s)
+        self.window = int(window)
+        self.up_queue_ratio = float(up_queue_ratio)
+        self.down_queue_ratio = float(down_queue_ratio)
+        self.up_kv_ratio = float(up_kv_ratio)
+        self.down_kv_ratio = float(down_kv_ratio)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.role = str(role)
+        self._owned = {}            # endpoint -> replica object
+        self._samples = deque(maxlen=self.window)
+        self._last_scale_at = 0.0
+        # bounded decision trail (the counters/flight events are the
+        # durable record): a long-lived fleet's periodic load swings
+        # must not grow an unbounded list copied on every stats()
+        self.events = deque(maxlen=256)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        """Grow the pool to ``min_replicas`` synchronously (a fleet
+        below its floor is a config error, not a signal to wait for),
+        then start the control loop."""
+        while self._pool_size() < self.min_replicas:
+            self._scale_up(reason="min_replicas floor")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5, retire_owned=False):
+        """Stop the control loop; ``retire_owned=True`` also drains and
+        retires every replica this autoscaler spawned."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if retire_owned:
+            with self._lock:
+                owned = dict(self._owned)
+                self._owned.clear()
+            for ep, srv in owned.items():
+                self.router.remove_replica(ep)
+                self._do_retire(srv)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(retire_owned=True)
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception as exc:  # noqa: BLE001 — never dies, but
+                # a failing factory/registry must leave a trail: an
+                # overloaded fleet pinned at its size with an empty
+                # decision log is undiagnosable
+                _flightrec().record(
+                    "fleet_scale_error",
+                    error=f"{type(exc).__name__}: {exc}"[:200])
+
+    # -- signals ----------------------------------------------------------
+    def _pool_size(self):
+        return sum(1 for r in self.router.registry.all()
+                   if r.state != "evicted")
+
+    def sample(self):
+        """One pressure sample over the in-rotation replicas: mean
+        queue-depth ratio (probed depths + active rows over the probed
+        admission capacity), mean kvpool occupancy, and the total
+        breached SLO rule count. None when nothing is dispatchable
+        (an empty rotation is a scale-up signal of its own)."""
+        reps = [r for r in self.router.registry.all()
+                if r.dispatchable()]
+        if not reps:
+            return None
+        q_ratios, kv, breached = [], [], 0
+        for r in reps:
+            h = r.last_health
+            cap = int(h.get("queue_capacity") or 0)
+            # max, not sum: router-tracked in-flight dispatches SIT in
+            # the replica's probed queue/active rows, so adding them
+            # would double-count against the absolute capacity ratio;
+            # the max keeps the fresher signal as a lower bound when
+            # the probe is stale
+            depth = max(r.probed_depth(), r.inflight)
+            q_ratios.append(depth / cap if cap > 0 else 0.0)
+            kv.append(float(h.get("kvpool_occupancy", 0.0) or 0.0))
+            breached += int(h.get("slo_breached", 0) or 0)
+        return {
+            "replicas": len(reps),
+            "queue_ratio": sum(q_ratios) / len(q_ratios),
+            "kvpool_occupancy": sum(kv) / len(kv),
+            "slo_breached": breached,
+        }
+
+    def _overloaded(self, s):
+        return (s["queue_ratio"] >= self.up_queue_ratio
+                or s["kvpool_occupancy"] >= self.up_kv_ratio
+                or s["slo_breached"] > 0)
+
+    def _idle(self, s):
+        return (s["queue_ratio"] <= self.down_queue_ratio
+                and s["kvpool_occupancy"] <= self.down_kv_ratio
+                and s["slo_breached"] == 0)
+
+    # -- control law ------------------------------------------------------
+    def tick(self, now=None):
+        """One control-loop evaluation: fold a sample into the window,
+        decide, act. Public so tests drive it deterministically."""
+        now = time.monotonic() if now is None else now
+        s = self.sample()
+        self._update_gauge()
+        if s is None:
+            # nothing dispatchable: below the floor by definition
+            if self._pool_size() < self.min_replicas:
+                self._scale_up(reason="rotation empty")
+            return None
+        with self._lock:
+            self._samples.append(s)
+            window_full = len(self._samples) == self.window
+            all_over = window_full and all(self._overloaded(x)
+                                           for x in self._samples)
+            all_idle = window_full and all(self._idle(x)
+                                           for x in self._samples)
+            cooled = now - self._last_scale_at >= self.cooldown_s
+        n = self._pool_size()
+        if all_over and cooled and n < self.max_replicas:
+            self._scale_up(reason=self._reason(s))
+        elif all_idle and cooled and n > self.min_replicas:
+            self._scale_down()
+        return s
+
+    def _reason(self, s):
+        parts = []
+        if s["queue_ratio"] >= self.up_queue_ratio:
+            parts.append(f"queue_ratio {s['queue_ratio']:.2f}")
+        if s["kvpool_occupancy"] >= self.up_kv_ratio:
+            parts.append(f"kvpool {s['kvpool_occupancy']:.2f}")
+        if s["slo_breached"] > 0:
+            parts.append(f"slo_breached {s['slo_breached']}")
+        return ", ".join(parts) or "window overloaded"
+
+    def _record(self, direction, endpoint, reason):
+        # cooldown measured from when the action COMPLETED (spawning/
+        # draining a replica can itself take a while — charging that
+        # time against the cooldown would let back-to-back windows
+        # bypass it)
+        with self._lock:
+            self._last_scale_at = time.monotonic()
+            self._samples.clear()       # a fresh pool needs fresh data
+            self.events.append({
+                "t": self._last_scale_at, "direction": direction,
+                "endpoint": endpoint,
+                "replicas": self._pool_size(), "reason": reason,
+            })
+        _SCALE_EVENTS.inc(labels=(direction,))
+        _flightrec().record("fleet_scale", direction=direction,
+                            endpoint=str(endpoint),
+                            replicas=self._pool_size(),
+                            reason=str(reason)[:200])
+        self._update_gauge()
+
+    # -- actions ----------------------------------------------------------
+    def _scale_up(self, reason=""):
+        srv = self.factory()
+        ep = getattr(srv, "endpoint", srv)
+        with self._lock:
+            self._owned[ep] = srv
+        self.router.add_replica(ep, role=self.role)
+        self._record("up", ep, reason)
+        return ep
+
+    def _pick_victim(self):
+        """The least-loaded OWNED in-rotation replica — never one the
+        operator registered directly (the autoscaler can only retire
+        what it spawned)."""
+        with self._lock:
+            owned = set(self._owned)
+        cands = [r for r in self.router.registry.all()
+                 if r.endpoint in owned and r.state != "evicted"]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.load_score(), r.endpoint))
+
+    def _do_retire(self, srv):
+        try:
+            if self._retire is not None:
+                self._retire(srv)
+            elif hasattr(srv, "drain"):
+                srv.drain(timeout=self.drain_timeout_s)
+            elif hasattr(srv, "stop"):
+                srv.stop()
+        except Exception as exc:  # noqa: BLE001 — a wedged retire must
+            # not wedge the control loop, but a replica that failed to
+            # drain is a potential leak worth a trail
+            _flightrec().record(
+                "fleet_retire_error",
+                endpoint=str(getattr(srv, "endpoint", srv)),
+                error=f"{type(exc).__name__}: {exc}"[:200])
+
+    def _scale_down(self):
+        rep = self._pick_victim()
+        if rep is None:
+            return None
+        ep = rep.endpoint
+        # drain-aware: out of the rotation first, wait for the router's
+        # in-flight dispatches to finish, THEN retire (the replica-side
+        # drain() additionally finishes its decode rows)
+        self.router.registry.set_state(ep, "draining")
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline and rep.inflight > 0:
+            time.sleep(0.01)
+        self.router.remove_replica(ep)
+        with self._lock:
+            srv = self._owned.pop(ep, None)
+        if srv is not None:
+            self._do_retire(srv)
+        self._record("down", ep, "window idle")
+        return ep
+
+    # -- reporting --------------------------------------------------------
+    def _update_gauge(self):
+        counts = {"serving": 0, "draining": 0, "evicted": 0}
+        for r in self.router.registry.all():
+            key = {"healthy": "serving", "unknown": "serving"}.get(
+                r.state, r.state)
+            counts[key] = counts.get(key, 0) + 1
+        for state, n in counts.items():
+            _REPLICAS.set(n, labels=(state,))
+
+    def stats(self):
+        with self._lock:
+            return {
+                "replicas": self._pool_size(),
+                "owned": sorted(self._owned),
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "window": [dict(s) for s in self._samples],
+                "last_scale_at": self._last_scale_at,
+                "events": [dict(e) for e in self.events],
+            }

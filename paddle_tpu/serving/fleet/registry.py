@@ -30,8 +30,14 @@ import time
 from ...flags import flag
 from ...observability.metrics import default_registry
 from ...observability.recorder import flight_recorder as _flightrec
-from ...resilience import maybe_fail
+from ...resilience import RetryBudget, maybe_fail
 from ..server import Client
+
+# probe clients bypass the process retry budget (a disabled private
+# bucket): probing is bounded polling infrastructure, and a dead
+# replica probed every interval must not drain the shared bucket and
+# suppress hedges/failovers for healthy user traffic
+_PROBE_BUDGET = RetryBudget(ratio=-1.0)
 
 _HEALTHY = default_registry().gauge(
     "router_replicas_healthy_count",
@@ -83,6 +89,16 @@ class Replica:
         self.evictions = 0
         self.readmissions = 0
 
+    def probed_depth(self):
+        """Probed queued/active work at the replica (infer queue +
+        decode queue + active decode rows) — the one copy of the depth
+        sum shared by the dispatch score and the autoscaler's pressure
+        signal."""
+        h = self.last_health
+        return (h.get("queue_depth", 0) or 0) \
+            + (h.get("decode_queue_depth", 0) or 0) \
+            + (h.get("decode_active_rows", 0) or 0)
+
     def load_score(self):
         """Lower = less loaded. Router-tracked in-flight dispatches are
         the freshest signal (they move between probes); the probed
@@ -94,12 +110,10 @@ class Replica:
         penalty PER breached rule, so dispatch shifts away from a
         regressed replica before clients feel its tail."""
         h = self.last_health
-        depth = (h.get("queue_depth", 0) or 0) \
-            + (h.get("decode_queue_depth", 0) or 0) \
-            + (h.get("decode_active_rows", 0) or 0)
         occ = float(h.get("kvpool_occupancy", 0.0) or 0.0)
         slo = int(h.get("slo_breached", 0) or 0)
-        return self.inflight + depth + 4.0 * occ + 8.0 * slo
+        return self.inflight + self.probed_depth() + 4.0 * occ \
+            + 8.0 * slo
 
     def dispatchable(self):
         return (self.state == "healthy"
@@ -126,6 +140,8 @@ class Replica:
             "decode_active_rows": h.get("decode_active_rows", 0),
             "kvpool_occupancy": h.get("kvpool_occupancy", 0.0),
             "slo_breached": h.get("slo_breached", 0),
+            "brownout_level": h.get("brownout_level", 0),
+            "queue_capacity": h.get("queue_capacity", 0),
             "weights_version": h.get("weights_version"),
             "load_score": round(self.load_score(), 3),
         }
@@ -198,6 +214,15 @@ class ReplicaRegistry:
             return sum(1 for r in self._reps.values()
                        if r.state == "healthy")
 
+    def any_brownout(self):
+        """True when any in-rotation replica's last probe reported an
+        active brownout level — the router stops hedging against a
+        fleet that is already shedding optional work."""
+        with self._lock:
+            return any(
+                (r.last_health.get("brownout_level") or 0) > 0
+                for r in self._reps.values() if r.state == "healthy")
+
     def snapshot(self):
         with self._lock:
             return {ep: r.snapshot() for ep, r in self._reps.items()}
@@ -262,7 +287,8 @@ class ReplicaRegistry:
             if c is None:
                 c = Client(endpoint, auth_key=self._auth_key,
                            timeout=self.probe_timeout_s,
-                           connect_retries=1)
+                           connect_retries=1,
+                           retry_budget=_PROBE_BUDGET)
                 self._clients[endpoint] = c
             return c
 

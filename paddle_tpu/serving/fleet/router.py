@@ -51,8 +51,9 @@ from ...flags import flag
 from ...observability import tracing as _trace
 from ...observability.metrics import default_registry, render_metrics
 from ...observability.recorder import flight_recorder as _flightrec
-from ...resilience import maybe_fail
-from ..batching import ServerOverloadedError
+from ...resilience import default_retry_budget, maybe_fail
+from ..batching import (DeadlineExceededError, ServerOverloadedError,
+                        priority_rank, remaining_budget_ms)
 from ..kvpool import KVBlockPool
 from ..server import _ETYPES, _error_reply
 from .registry import ReplicaRegistry
@@ -90,7 +91,8 @@ _FLEET_SCRAPE_FAILS = default_registry().counter(
 _COUNTERS = ("dispatches", "failovers", "hedges", "hedge_wins",
              "dedup_hits", "kv_migrations", "kv_migrated_bytes",
              "rolling_reloads", "no_replica_refusals",
-             "fleet_scrape_failures")
+             "fleet_scrape_failures", "hedges_suppressed",
+             "failovers_suppressed", "deadline_expired_in_router")
 
 # flight-recorder event kinds the fleet emits (Router.stats surfaces
 # their in-ring counts; the debug_dump wire op returns the events)
@@ -515,19 +517,49 @@ class Router:
 
     # -- dispatch ---------------------------------------------------------
     def _dispatch(self, msg, roles, timeout, entry=None,
-                  role_label="both", exclude=()):
+                  role_label="both", exclude=(), budget=None):
         """Dispatch ``msg`` to the least-loaded replica of ``roles``;
         fail over (same rid) on transport death or a typed
         Overloaded/Shutdown refusal, up to
         ``FLAGS_router_dispatch_retries`` extra replicas. Returns
         ``(reply, endpoint)`` — ``reply`` is the replica's wire dict
-        (or a typed error reply when the rotation is exhausted)."""
+        (or a typed error reply when the rotation is exhausted).
+
+        ``budget`` is ``(deadline_ms, t0)`` deadline propagation: each
+        hop carries the budget REMAINING at its send (router queue/
+        failover time subtracted), and a spent budget returns the typed
+        expiry without touching a replica. Failover attempts past the
+        first withdraw from the process retry budget — when the fleet
+        is saturated the rotation walk itself must not multiply load
+        (typed Overloaded shed instead)."""
         tried = set(exclude)
         last_refusal = None
         for attempt in range(self._dispatch_retries + 1):
+            # cheap disqualifiers FIRST: a spent deadline or an empty
+            # rotation must not burn a retry-budget token — under
+            # overload that waste is exactly what drains the bucket
+            # the other layers depend on
+            if budget is not None and budget[0] is not None:
+                rem = remaining_budget_ms(budget[0], budget[1])
+                if rem <= 0:
+                    self._bump("deadline_expired_in_router")
+                    return _error_reply(DeadlineExceededError(
+                        f"deadline budget of {float(budget[0]):.1f}ms "
+                        f"spent at the router (queue + "
+                        f"{attempt} dispatch attempt(s)) — not "
+                        f"forwarded", deadline_ms=float(budget[0]))), \
+                        None
+                msg["deadline_ms"] = rem
             rep = self.registry.pick(roles, exclude=tried)
             if rep is None:
                 break
+            if attempt > 0 and not default_retry_budget().try_acquire(
+                    what="router-failover"):
+                self._bump("failovers_suppressed")
+                return _error_reply(ServerOverloadedError(
+                    f"router {self.name!r}: retry budget exhausted "
+                    f"after {attempt} attempt(s) — shedding instead of "
+                    f"walking the rotation")), None
             tried.add(rep.endpoint)
             if entry is not None:
                 entry.add_target(rep.endpoint)
@@ -571,15 +603,24 @@ class Router:
             f"retry")), None
 
     def _dispatch_hedged(self, msg, roles, timeout, entry,
-                         role_label="both"):
+                         role_label="both", budget=None):
         """Race the primary dispatch against a delayed twin on ANOTHER
         replica (``FLAGS_router_hedge_ms``; 0 = plain dispatch). First
         ok reply wins; the loser is cancelled by rid on every other
-        target."""
+        target.
+
+        Hedging is optional tail-fighting work, so it is the first
+        thing overload control turns off: only interactive-class
+        requests hedge, a fleet with any brownout-active replica does
+        not hedge at all, and the twin withdraws from the process retry
+        budget (suppressions counted in ``stats()``)."""
         delay_s = self._hedge_ms / 1e3
+        if delay_s > 0 and (priority_rank(msg.get("priority")) > 0
+                            or self.registry.any_brownout()):
+            delay_s = 0.0
         if delay_s <= 0:
             return self._dispatch(msg, roles, timeout, entry=entry,
-                                  role_label=role_label)
+                                  role_label=role_label, budget=budget)
         # "ok" holds the first ok reply (the winner); "last" the most
         # recent non-ok one, so a leg that comes back with a typed
         # refusal BEFORE the hedge delay still yields a reply instead
@@ -589,10 +630,14 @@ class Router:
 
         def attempt(tag, exclude):
             try:
-                r, ep = self._dispatch(msg, roles, timeout,
+                # each leg owns its COPY: _dispatch rewrites the
+                # remaining-deadline field per attempt, and a shared
+                # dict would let one leg's rewrite race the other
+                # leg's frame serialization
+                r, ep = self._dispatch(dict(msg), roles, timeout,
                                        entry=entry,
                                        role_label=role_label,
-                                       exclude=exclude)
+                                       exclude=exclude, budget=budget)
             except Exception as exc:  # noqa: BLE001 — the leg MUST
                 # report in: a dying thread that never bumps "done"
                 # (WireError, injected fault, ...) would strand the
@@ -615,6 +660,10 @@ class Router:
             fire = state["done"] < 1
             primary_eps = entry.targets()
         launched = 1
+        if fire and not default_retry_budget().try_acquire(
+                what="router-hedge"):
+            self._bump("hedges_suppressed")
+            fire = False
         if fire:
             _HEDGES.inc(labels=(self.name,))
             self._bump("hedges")
@@ -669,6 +718,10 @@ class Router:
 
     # -- routed generate --------------------------------------------------
     def _route_generate(self, msg):
+        # the deadline clock starts the moment the router OWNS the
+        # request: every downstream hop carries what remains after the
+        # router's own queue/dispatch time
+        t0 = time.monotonic()
         rid = msg.get("rid")
         entry, joined = self._dedup_entry(rid)
         if joined:
@@ -677,20 +730,22 @@ class Router:
             budget = msg.get("deadline_ms")
             return entry.wait((budget / 1e3 + 120.0) if budget
                               else 600.0)
+        default_retry_budget().record_request()
         try:
-            reply = self._route_generate_inner(msg, entry)
+            reply = self._route_generate_inner(msg, entry, t0)
         except Exception as exc:  # noqa: BLE001 — typed reply, not death
             reply = _error_reply(exc)
         entry.finish(reply)
         return reply
 
-    def _route_generate_inner(self, msg, entry):
+    def _route_generate_inner(self, msg, entry, t0):
         tokens = msg.get("tokens")
         if tokens is None:
             return {"ok": False, "etype": "BadRequest",
                     "error": "'tokens' (1-D int prompt) is required"}
         budget = msg.get("deadline_ms")
         hop_timeout = (budget / 1e3 + 120.0) if budget else 600.0
+        hop_budget = (budget, t0)
         parent = _trace.from_wire(msg.get("trace"))
         with _trace.span("router/generate", parent=parent) as ctx:
             downstream_trace = _trace.to_wire(ctx)
@@ -700,14 +755,18 @@ class Router:
                     fwd["trace"] = downstream_trace
                 reply, _ep = self._dispatch_hedged(
                     fwd, ("both",), hop_timeout, entry,
-                    role_label="both")
+                    role_label="both", budget=hop_budget)
                 return reply
             return self._route_disaggregated(msg, entry, hop_timeout,
-                                             downstream_trace)
+                                             downstream_trace,
+                                             hop_budget)
 
-    def _route_disaggregated(self, msg, entry, hop_timeout, trace):
+    def _route_disaggregated(self, msg, entry, hop_timeout, trace,
+                             hop_budget):
         """Two-hop generate: prefill on a compute-bound replica, KV
-        blocks streamed into a bandwidth-bound decode replica."""
+        blocks streamed into a bandwidth-bound decode replica. Both
+        hops carry the REMAINING deadline budget — the decode hop
+        inherits what the prefill hop left unspent."""
         rid = msg.get("rid") or uuid.uuid4().hex
         pmsg = {
             "op": "prefill",
@@ -718,11 +777,14 @@ class Router:
             "deadline_ms": msg.get("deadline_ms"),
             "rid": f"{rid}-prefill",
         }
+        if msg.get("priority") is not None:
+            pmsg["priority"] = msg["priority"]
         if trace is not None:
             pmsg["trace"] = trace
         reply, src = self._dispatch_hedged(pmsg, ("prefill", "both"),
                                            hop_timeout, entry,
-                                           role_label="prefill")
+                                           role_label="prefill",
+                                           budget=hop_budget)
         if not reply.get("ok"):
             return reply
         kv = reply["kv"]
@@ -749,11 +811,14 @@ class Router:
             "first_token": first,
             "rid": rid,
         }
+        if msg.get("priority") is not None:
+            dmsg["priority"] = msg["priority"]
         if trace is not None:
             dmsg["trace"] = trace
         reply2, dst = self._dispatch_hedged(dmsg, ("decode", "both"),
                                             hop_timeout, entry,
-                                            role_label="decode")
+                                            role_label="decode",
+                                            budget=hop_budget)
         _KV_MIGRATIONS.inc(labels=(self.name,))
         _KV_MIG_BYTES.inc(nbytes, labels=(self.name,))
         self._bump("kv_migrations")
@@ -820,7 +885,7 @@ class Router:
 
     # -- in-process convenience (tests / bench) ---------------------------
     def generate(self, tokens, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_id=None, deadline_ms=None):
+                 top_k=0, eos_id=None, deadline_ms=None, priority=None):
         """Routed generation without a socket in between: same dispatch
         path the wire op takes; raises the typed serving errors."""
         msg = {
@@ -833,6 +898,8 @@ class Router:
             "deadline_ms": deadline_ms,
             "rid": uuid.uuid4().hex,
         }
+        if priority is not None:
+            msg["priority"] = str(priority)
         ctx = _trace.maybe_trace()
         if ctx is not None:
             msg["trace"] = _trace.to_wire(ctx)

@@ -101,6 +101,48 @@ class LatencyHistogram:
         }
 
 
+# -- priority-class admission telemetry --------------------------------
+#
+# Native registry families (not ServingStats counters) because they are
+# class-labeled and shared across every queue/server in the process:
+# one `class` axis is what dashboards and tools/fleet_report.py slice.
+
+_CLASS_SHED = default_registry().counter(
+    "serving_admission_shed_total",
+    "requests shed at admission by overload machinery (queue-full "
+    "refusal, priority eviction, breaker, brownout), by priority class",
+    labels=("class",), max_series=8)
+_CLASS_DONE = default_registry().counter(
+    "serving_class_completed_total",
+    "requests completed end-to-end, by priority class",
+    labels=("class",), max_series=8)
+_CLASS_LAT = default_registry().histogram(
+    "serving_class_latency_ms",
+    "end-to-end request latency (admission -> result), by priority "
+    "class",
+    labels=("class",), max_series=8)
+_EXPIRED_IN_QUEUE = default_registry().counter(
+    "serving_expired_in_queue_total",
+    "queued requests evicted because their deadline expired while "
+    "waiting (failed typed instead of dequeuing into a doomed batch)")
+
+
+def record_class_shed(priority):
+    _CLASS_SHED.inc(labels=(str(priority),))
+
+
+def record_class_done(priority, seconds):
+    """One completed request of ``priority`` that took ``seconds`` from
+    admission to result — feeds the per-class goodput counters and the
+    latency histogram ``tools/fleet_report.py`` gates p99 on."""
+    _CLASS_DONE.inc(labels=(str(priority),))
+    _CLASS_LAT.observe(float(seconds) * 1e3, labels=(str(priority),))
+
+
+def record_expired_in_queue(n=1):
+    _EXPIRED_IN_QUEUE.inc(n)
+
+
 # -- registry bridge ---------------------------------------------------
 
 # counter banking across sink churn lives in the shared

@@ -77,16 +77,19 @@ from .batching import (BadRequestError, DeadlineExceededError,
                        DecodeBatcher, GenerationRequest,
                        InternalServerError, MicroBatcher, Request,
                        RequestCancelledError, RequestQueue,
-                       ServerOverloadedError, ServerShutdownError)
+                       ServerOverloadedError, ServerShutdownError,
+                       priority_rank, remaining_budget_ms)
+from .brownout import BrownoutController
 from .engine import GenerationEngine, ServingEngine
-from .metrics import ServingStats
+from .metrics import ServingStats, record_class_shed
 from .supervise import LoopSupervisor
 from ..distributed.wire import (WireError, default_key, recv_frame,
                                 send_frame)
 from ..observability import tracing as _trace
 from ..observability.metrics import render_metrics
 from ..observability.recorder import flight_recorder as _flightrec
-from ..resilience import WatchdogTimeout, retry_call
+from ..resilience import (WatchdogTimeout, default_retry_budget,
+                          retry_call)
 
 
 class ServingConfig:
@@ -195,6 +198,15 @@ class InferenceServer:
         # default rules bind the final queue/engine wiring.
         self._slo_rules = slo_rules
         self.slo_monitor = None
+        # brownout ladder (FLAGS_serving_brownout): an SLO breach
+        # degrades best_effort, then batch traffic (shed / capped
+        # max_new_tokens / shrunken admission) BEFORE interactive; the
+        # getter reads the live monitor so the ladder follows breaches
+        # the moment start() wires the rules
+        self.brownout = BrownoutController(
+            lambda: (len(self.slo_monitor.breached())
+                     if self.slo_monitor is not None else 0),
+            scope=f"server-{id(self) & 0xffffff:x}")
         self.host = host
         self.port = int(port)
         self._key = auth_key if auth_key is not None else default_key()
@@ -374,24 +386,50 @@ class InferenceServer:
         self.stop()
 
     # -- in-process client path -------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, priority=None):
         """Admit a request (raises ServerOverloadedError /
         DeadlineExceededError at the door); returns the Request — call
-        ``.wait()`` for the fetch list."""
+        ``.wait()`` for the fetch list. ``priority`` is the admission
+        class (interactive/batch/best_effort): lower classes shed first
+        under backpressure and brownout."""
         if self.queue is None:
             raise ValueError("no inference model loaded — this server "
                              "only serves 'generate'")
         if deadline_ms is None and self.config.default_deadline_ms > 0:
             deadline_ms = self.config.default_deadline_ms
-        return self.queue.put(Request(feeds, deadline_ms=deadline_ms))
+        _mnt, depth_cap = self._brownout_gate(priority)
+        return self.queue.put(
+            Request(feeds, deadline_ms=deadline_ms, priority=priority),
+            max_depth=depth_cap)
 
-    def infer(self, feeds, deadline_ms=None, timeout=None):
-        return self.submit(feeds, deadline_ms=deadline_ms).wait(
-            timeout=timeout)
+    def _brownout_gate(self, priority, max_new_tokens=None):
+        """The one copy of the brownout admission verdict for the
+        infer and generate doors: raises the typed shed for degraded
+        classes, else returns ``(max_new_tokens, depth_cap)`` with the
+        class's cap/shrink applied."""
+        shed, mnt, depth_cap = self.brownout.admission(
+            priority_rank(priority), max_new_tokens=max_new_tokens,
+            queue_depth=self.config.queue_depth)
+        if shed:
+            if self.stats_sink:
+                self.stats_sink.bump("shed_overload")
+            record_class_shed(priority)
+            raise ServerOverloadedError(
+                f"brownout level {self.brownout.level()}: "
+                f"{priority} traffic is shed while the server works "
+                f"off its SLO breach — retry later or upgrade the "
+                f"request's class")
+        return mnt, depth_cap
+
+    def infer(self, feeds, deadline_ms=None, timeout=None,
+              priority=None):
+        return self.submit(feeds, deadline_ms=deadline_ms,
+                           priority=priority).wait(timeout=timeout)
 
     def submit_generate(self, tokens, max_new_tokens=32, temperature=0.0,
                         top_k=0, eos_id=None, deadline_ms=None,
-                        export_kv=False, kv=None, first_token=None):
+                        export_kv=False, kv=None, first_token=None,
+                        priority=None):
         """Admit a generation request into the decode bank (admission
         control applies: queue depth, breaker, deadline). Returns the
         GenerationRequest — ``.wait()`` yields ``[np int32 tokens]``.
@@ -435,19 +473,27 @@ class InferenceServer:
                 "server is degraded (supervisor breaker open after "
                 "repeated loop failures) — generation is shed; "
                 "ping/health/stats still answer")
+        # brownout ladder: a breached-SLO server sheds best_effort
+        # (then batch) typed at the door, caps batch token budgets and
+        # shrinks batch admission — interactive traffic degrades LAST
+        max_new_tokens, depth_cap = self._brownout_gate(
+            priority, max_new_tokens=int(max_new_tokens))
         return self.gen_queue.put(GenerationRequest(
             tokens, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, eos_id=eos_id,
             deadline_ms=deadline_ms, export_kv=export_kv, kv=kv,
-            first_token=first_token))
+            first_token=first_token, priority=priority),
+            max_depth=depth_cap)
 
     def generate(self, tokens, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_id=None, deadline_ms=None, timeout=None):
+                 top_k=0, eos_id=None, deadline_ms=None, timeout=None,
+                 priority=None):
         """Generate new tokens for one prompt; returns a 1-D np.int32
         array (EOS excluded)."""
         req = self.submit_generate(tokens, max_new_tokens=max_new_tokens,
                                    temperature=temperature, top_k=top_k,
-                                   eos_id=eos_id, deadline_ms=deadline_ms)
+                                   eos_id=eos_id, deadline_ms=deadline_ms,
+                                   priority=priority)
         return req.wait(timeout=timeout)[0]
 
     def stats(self):
@@ -471,6 +517,18 @@ class InferenceServer:
                     extra[f"kvpool_{k}"] = v
         extra["state"] = self.state
         extra["weights_version"] = self._weights_version
+        # level() (not snapshot's cached value): the ladder is
+        # evaluated lazily, and a server whose traffic stopped at
+        # level 2 must report recovery once its breaches clear
+        extra["brownout_level"] = self.brownout.level()
+        extra["brownout_shed"] = self.brownout.snapshot()["shed"]
+        for q, key in ((self.queue, "expired_in_queue"),
+                       (self.gen_queue, "decode_expired_in_queue")):
+            if q is not None:
+                extra[key] = q.expired_in_queue
+                extra[key.replace("expired_in_queue",
+                                  "priority_evictions")] = \
+                    q.priority_evictions
         return self.stats_sink.snapshot(extra=extra)
 
     def health(self):
@@ -484,6 +542,11 @@ class InferenceServer:
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "loops": self.supervisor.snapshot(),
             "breaker": self.supervisor.breaker.state,
+            # the autoscaler's queue-ratio signal and the router's
+            # hedge policy read these: degradation state + the depth
+            # cap that turns probed queue depths into a ratio
+            "brownout_level": self.brownout.level(),
+            "queue_capacity": int(self.config.queue_depth),
         }
         if self.slo_monitor is not None:
             # the Router's dispatch-score penalty reads this: current
@@ -714,7 +777,8 @@ class InferenceServer:
                 req, joined = self._dedup(
                     msg.get("rid"),
                     lambda: self.submit(
-                        feed, deadline_ms=msg.get("deadline_ms")))
+                        feed, deadline_ms=msg.get("deadline_ms"),
+                        priority=msg.get("priority")))
                 if joined and self.stats_sink:
                     self.stats_sink.bump("hedge_dedup_hits")
             except Exception as e:  # noqa: BLE001 — typed refusal reply
@@ -775,7 +839,8 @@ class InferenceServer:
                     deadline_ms=msg.get("deadline_ms"),
                     kv=msg.get("kv"),
                     first_token=None if first_token is None
-                    else int(first_token)))
+                    else int(first_token),
+                    priority=msg.get("priority")))
             if joined and self.stats_sink:
                 self.stats_sink.bump("hedge_dedup_hits")
         except Exception as e:  # noqa: BLE001 — typed refusal reply
@@ -829,7 +894,8 @@ class InferenceServer:
                         temperature=float(msg.get("temperature", 0.0)),
                         top_k=int(msg.get("top_k", 0)),
                         deadline_ms=msg.get("deadline_ms"),
-                        export_kv=True))
+                        export_kv=True,
+                        priority=msg.get("priority")))
                 if joined and self.stats_sink:
                     self.stats_sink.bump("hedge_dedup_hits")
             except Exception as e:  # noqa: BLE001 — typed refusal
@@ -952,7 +1018,7 @@ class Client:
     cancelled by request id."""
 
     def __init__(self, endpoint, auth_key=None, timeout=None,
-                 connect_retries=20, hedge_ms=None):
+                 connect_retries=20, hedge_ms=None, retry_budget=None):
         from ..flags import flag
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
@@ -960,12 +1026,41 @@ class Client:
         self._key = auth_key if auth_key is not None else default_key()
         self._timeout = timeout
         self._connect_retries = connect_retries
+        # None = the process-global retry budget. Infrastructure
+        # callers (the router's health-probe clients) pass their own —
+        # a dead replica probed every interval must not drain the
+        # shared bucket and suppress hedges/failovers for healthy
+        # user traffic
+        self._retry_budget = retry_budget
         self._sock = None
         self._hedge_ms = float(hedge_ms if hedge_ms is not None
                                else flag("serving_hedge_ms"))
         self._lat_s = deque(maxlen=256)     # winning infer latencies
         self._hedges = 0
         self._hedge_wins = 0
+        self._hedges_suppressed = 0     # refused by the retry budget
+
+    def _budget(self):
+        return (self._retry_budget if self._retry_budget is not None
+                else default_retry_budget())
+
+    @staticmethod
+    def _remaining_ms(budget_ms, t0):
+        """Deadline budget still unspent at THIS moment — what actually
+        goes on the wire, so a hop (or a delayed retry/hedge) never
+        grants itself the caller's full original budget again. Raises
+        the typed expiry when nothing is left: no tier should burn
+        compute on a request its caller has already abandoned."""
+        if budget_ms is None:
+            return None
+        rem = remaining_budget_ms(budget_ms, t0)
+        if rem <= 0:
+            raise DeadlineExceededError(
+                f"deadline budget of {float(budget_ms):.1f}ms spent "
+                f"client-side before the request reached a server",
+                deadline_ms=float(budget_ms),
+                waited_ms=(time.monotonic() - t0) * 1e3)
+        return rem
 
     def _ensure(self, timeout=_UNSET):
         if self._sock is None:
@@ -978,7 +1073,8 @@ class Client:
             self._sock = retry_call(
                 lambda: socket.create_connection(self._addr, timeout=t),
                 deadline=deadline, retries=self._connect_retries,
-                what="serving connect", endpoint=self.endpoint)
+                what="serving connect", endpoint=self.endpoint,
+                budget=self._budget())
         return self._sock
 
     def _transact(self, sock, msg, timeout=_UNSET):
@@ -1008,13 +1104,22 @@ class Client:
         etype = _ETYPES.get(reply.get("etype"), InternalServerError)
         raise etype(reply.get("error", "serving request failed"))
 
-    def _call(self, msg, timeout=_UNSET):
+    def _call(self, msg, timeout=_UNSET, budget_ms=None, t0=None):
         """Exchange with reconnect-once: a send/recv failure on the
         cached socket (typically a bounced server) closes it and retries
         the exchange on a fresh connection before surfacing anything.
         Safe because infer/generate carry a request id the server
-        dedups, and the other ops are idempotent."""
+        dedups, and the other ops are idempotent.
+
+        ``budget_ms``/``t0`` arm deadline propagation: before every
+        attempt the wire ``deadline_ms`` is rewritten to the REMAINING
+        budget (raising typed expiry when none is left), and the
+        reconnect retry itself withdraws from the process retry budget
+        — a saturated fleet turns a reconnect storm into fast typed
+        sheds instead of doubled offered load."""
         for attempt in (0, 1):
+            if budget_ms is not None:
+                msg["deadline_ms"] = self._remaining_ms(budget_ms, t0)
             sock = self._ensure(timeout=timeout)
             try:
                 return self._transact(sock, msg, timeout=timeout)
@@ -1026,6 +1131,7 @@ class Client:
                 if attempt or (timeout is not _UNSET
                                and isinstance(e, socket.timeout)):
                     raise
+                self._budget().acquire(what="client-reconnect")
         raise AssertionError("unreachable")
 
     # -- hedging -----------------------------------------------------------
@@ -1044,13 +1150,18 @@ class Client:
 
     def hedge_stats(self):
         return {"hedges": self._hedges, "hedge_wins": self._hedge_wins,
+                "budget_suppressed": self._hedges_suppressed,
                 "observed": len(self._lat_s)}
 
-    def _call_hedged(self, msg, delay_s):
+    def _call_hedged(self, msg, delay_s, budget_ms=None, t0=None):
         """Race the primary exchange against a delayed twin on a fresh
         connection; first reply wins, the loser is cancelled by request
         id (the server's dedup table guarantees the pair executed at
-        most once)."""
+        most once). The twin withdraws from the process retry budget
+        first: when the bucket is dry the hedge is SUPPRESSED (counted
+        in :meth:`hedge_stats`) and the call rides the primary alone —
+        hedging is optional tail-fighting work, the first thing a
+        saturated fleet must stop doing."""
         state = {"reply": None, "who": None, "errors": [], "done": 0}
         cv = threading.Condition()
 
@@ -1068,6 +1179,8 @@ class Client:
                 state["done"] += 1
                 cv.notify_all()
 
+        if budget_ms is not None:
+            msg["deadline_ms"] = self._remaining_ms(budget_ms, t0)
         sock = self._ensure()
         threading.Thread(
             target=attempt, args=("primary",
@@ -1079,6 +1192,23 @@ class Client:
                         or state["done"] >= launched, timeout=delay_s)
             fire_hedge = state["reply"] is None and state["done"] < 1
 
+        # the twin owns its COPY of the message (the primary thread may
+        # still be serializing the original) and fires LATER than the
+        # primary: it carries the budget remaining NOW, not the
+        # primary's stale copy — a spent budget means no twin (the
+        # primary is still the caller's best hope), checked BEFORE the
+        # budget withdrawal so a deadline-cancelled hedge doesn't leak
+        # a token
+        hmsg = dict(msg) if fire_hedge else None
+        if fire_hedge and budget_ms is not None:
+            try:
+                hmsg["deadline_ms"] = self._remaining_ms(budget_ms, t0)
+            except DeadlineExceededError:
+                fire_hedge = False
+        if fire_hedge and not self._budget().try_acquire(
+                what="client-hedge"):
+            self._hedges_suppressed += 1
+            fire_hedge = False
         if fire_hedge:
             self._hedges += 1
 
@@ -1086,7 +1216,7 @@ class Client:
                 hs = socket.create_connection(self._addr,
                                               timeout=self._timeout)
                 try:
-                    return self._transact(hs, msg)
+                    return self._transact(hs, hmsg)
                 finally:
                     try:
                         hs.close()
@@ -1109,7 +1239,8 @@ class Client:
                 # contract still applies — one fresh-socket retry (the
                 # request id makes the replay exactly-once server-side)
                 self.close()
-                return self._call(msg)
+                self._budget().acquire(what="client-reconnect")
+                return self._call(msg, budget_ms=budget_ms, t0=t0)
             raise errors[0]
         if who == "hedge":
             self._hedge_wins += 1
@@ -1141,33 +1272,45 @@ class Client:
                                    time.perf_counter(), ctx)
 
     # -- ops ---------------------------------------------------------------
-    def infer(self, feeds, deadline_ms=None, hedge_ms=None):
+    def infer(self, feeds, deadline_ms=None, hedge_ms=None,
+              priority=None):
         """Returns the fetch list (numpy arrays). Raises
         DeadlineExceededError / ServerOverloadedError /
         ServerShutdownError mapped from the server's reply,
         ConnectionError on transport failure. ``hedge_ms`` overrides the
-        client's hedging delay for this call (0 disables). At
+        client's hedging delay for this call (0 disables); ``priority``
+        is the admission class (interactive/batch/best_effort).
+        ``deadline_ms`` is a BUDGET: what goes on the wire is the part
+        still unspent at send time, so a retried/hedged attempt never
+        re-grants itself the full original allowance. At
         ``FLAGS_trace_sample_rate`` (or inside an ambient
         ``tracing.span``) the request carries a trace context the
         server's stages parent under."""
         msg = {"op": "infer", "feed": dict(feeds),
                "deadline_ms": deadline_ms, "rid": uuid.uuid4().hex}
+        if priority is not None:
+            msg["priority"] = str(priority)
         delay_s = self._hedge_delay_s(hedge_ms)
         t0 = time.monotonic()
+        self._budget().record_request()
         with self._traced(msg):
             if delay_s <= 0:
-                reply = self._call(msg)
+                reply = self._call(msg, budget_ms=deadline_ms, t0=t0)
             else:
-                reply = self._call_hedged(msg, delay_s)
+                reply = self._call_hedged(msg, delay_s,
+                                          budget_ms=deadline_ms, t0=t0)
         self._lat_s.append(time.monotonic() - t0)
         return [np.asarray(a) for a in reply["fetch"]]
 
     def generate(self, tokens, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_id=None, deadline_ms=None):
+                 top_k=0, eos_id=None, deadline_ms=None, priority=None):
         """Autoregressive generation for one prompt (1-D int tokens).
         Returns the NEW tokens as a 1-D np.int32 array (EOS excluded).
         Same error mapping as ``infer``; ``deadline_ms`` is token-level
-        (checked between decode steps server-side)."""
+        (checked between decode steps server-side) and propagates as a
+        REMAINING budget across retries; ``priority`` is the admission
+        class (interactive/batch/best_effort — lower classes shed
+        first under overload and brownout)."""
         msg = {
             "op": "generate",
             "tokens": np.asarray(tokens, dtype=np.int32).ravel(),
@@ -1178,8 +1321,12 @@ class Client:
             "deadline_ms": deadline_ms,
             "rid": uuid.uuid4().hex,
         }
+        if priority is not None:
+            msg["priority"] = str(priority)
+        t0 = time.monotonic()
+        self._budget().record_request()
         with self._traced(msg):
-            reply = self._call(msg)
+            reply = self._call(msg, budget_ms=deadline_ms, t0=t0)
         return np.asarray(reply["tokens"], dtype=np.int32)
 
     def prefill(self, tokens, max_new_tokens=32, temperature=0.0,
@@ -1248,7 +1395,7 @@ class Client:
         return retry_call(lambda: self._call(msg, timeout=timeout),
                           deadline=deadline,
                           retries=2, what=f"serving {msg['op']}",
-                          endpoint=self.endpoint)
+                          endpoint=self.endpoint, budget=self._budget())
 
     def stats(self, timeout=_UNSET):
         """One server-stage stats snapshot. ``timeout`` (seconds)

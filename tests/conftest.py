@@ -33,6 +33,18 @@ def pytest_configure(config):
         "fast tier-1 run")
 
 
+@pytest.fixture(autouse=True)
+def _fresh_retry_budget():
+    """The retry budget is PROCESS-global by design (one bucket bounds
+    every layer's amplification); across a test suite that would let a
+    retry-heavy test starve an unrelated later test's legitimate
+    retries, so each test starts with a fresh bucket."""
+    from paddle_tpu import resilience
+    resilience.reset_retry_budget()
+    yield
+    resilience.reset_retry_budget()
+
+
 @pytest.fixture
 def fault_points():
     """Fault-injection handle (paddle_tpu.resilience): arm named failure

@@ -504,6 +504,7 @@ def test_hedged_infer_wins_and_dedups(tmp_path, fault_points):
         np.testing.assert_array_equal(got, want)
         assert dt < 1.4                  # the hedge won, not the stall
         assert c.hedge_stats() == {"hedges": 1, "hedge_wins": 1,
+                                   "budget_suppressed": 0,
                                    "observed": 2}
         # once the stalled primary resumes it ATTACHES to the hedged
         # twin's (completed) request: a dedup hit, not a 2nd execution

@@ -336,6 +336,66 @@ def test_train_report_gate(tmp_path):
     assert none.returncode == 2, none.stdout + none.stderr[-2000:]
 
 
+def test_fleet_report_gate(tmp_path):
+    """tools/fleet_report.py gates in tier-1: exit 0 rendering the
+    autoscaler trail + per-class ledger from a prom dump, exit 1 with
+    the interactive p99 NAMED when --assert-interactive-p99-ms is
+    violated, exit 2 on a dump with no interactive latency samples."""
+    prom = "\n".join([
+        'fleet_replicas_count{state="serving"} 3',
+        'fleet_replicas_count{state="draining"} 1',
+        'fleet_scale_events_total{direction="up"} 2',
+        'fleet_scale_events_total{direction="down"} 1',
+        'serving_class_completed_total{class="interactive"} 90',
+        'serving_class_completed_total{class="batch"} 40',
+        'serving_admission_shed_total{class="best_effort"} 25',
+        'serving_admission_shed_total{class="batch"} 10',
+        'serving_retry_budget_exhausted_total{what="router-failover"} 7',
+        'serving_expired_in_queue_total 4',
+        # interactive latency histogram: 80 obs <= 100ms, 10 in
+        # (100, 250] -> p99 lands inside the 250ms bucket
+        'serving_class_latency_ms_bucket{class="interactive",'
+        'le="100.0"} 80',
+        'serving_class_latency_ms_bucket{class="interactive",'
+        'le="250.0"} 90',
+        'serving_class_latency_ms_bucket{class="interactive",'
+        'le="+Inf"} 90',
+    ])
+    f = str(tmp_path / "fleet.prom")
+    with open(f, "w") as fh:
+        fh.write(prom)
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "fleet_report.py"),
+         "--from", f, "--assert-interactive-p99-ms", "300"],
+        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "up=2" in ok.stdout and "down=1" in ok.stdout
+    assert "interactive" in ok.stdout and "best_effort" in ok.stdout
+    assert "OK: interactive p99" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "fleet_report.py"),
+         "--from", f, "--assert-interactive-p99-ms", "50"],
+        capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 1, bad.stdout + bad.stderr[-2000:]
+    assert "INTERACTIVE-P99 VIOLATION" in bad.stderr
+    # goodput arithmetic: batch completed 40 / offered 50
+    import fleet_report
+    with open(f) as fh:
+        doc = fleet_report.summarize(
+            fleet_report.parse_exposition(fh.read()))
+    assert doc["classes"]["batch"]["goodput"] == 0.8
+    assert doc["classes"]["best_effort"]["completed"] == 0
+    assert doc["retry_budget_exhausted"] == 7
+    empty = str(tmp_path / "empty.prom")
+    with open(empty, "w") as fh:
+        fh.write("some_other_metric 1\n")
+    none = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "fleet_report.py"),
+         "--from", empty, "--assert-interactive-p99-ms", "300"],
+        capture_output=True, text=True, timeout=120)
+    assert none.returncode == 2, none.stdout + none.stderr[-2000:]
+
+
 def test_timeline_conversion_end_to_end():
     """profiler spans -> stop_profiler(profile_path) -> timeline.py ->
     valid Chrome trace JSON."""

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Fleet overload report: render autoscaler decisions and per-class
+admission outcomes from a Prometheus dump, and gate CI on the
+interactive-class p99.
+
+Sources (exactly one):
+
+- ``--from FILE`` — a Prometheus text dump written by
+  ``tools/export_metrics.py`` (``--out``) from a serving process (or a
+  router's fleet-wide aggregation);
+- ``--url URL`` — a live scrape of any exposition endpoint;
+- no source — THIS process's registry (the library path after an
+  in-process fleet run).
+
+Rendered: the autoscaler trail (``fleet_replicas_count{state}``,
+``fleet_scale_events_total{direction}``), the per-class ledger
+(``serving_class_completed_total{class}`` vs
+``serving_admission_shed_total{class}`` plus the class p99 from
+``serving_class_latency_ms``), and the overload-control counters
+(``serving_retry_budget_exhausted_total``,
+``serving_expired_in_queue_total``).
+
+``--assert-interactive-p99-ms X`` exits 1 when the interactive-class
+p99 exceeds X — the CI gate that keeps an overload-control regression
+(a retry storm reaching interactive traffic) from landing as a silent
+tail blowup. Exit 2 when the dump has no interactive samples to judge.
+
+Usage:
+    python tools/export_metrics.py --out fleet.prom   # in the server
+    python tools/fleet_report.py --from fleet.prom \\
+        --assert-interactive-p99-ms 250
+"""
+import argparse
+import re
+import sys
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_exposition(text):
+    """-> {metric: {frozen-label-tuple: value}} for every sample line
+    (labels as a sorted tuple of (k, v) pairs)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labelstr, val = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        labels = tuple(sorted(_LABEL_RE.findall(labelstr)))
+        out.setdefault(name, {})[labels] = \
+            out.get(name, {}).get(labels, 0.0) + v
+    return out
+
+
+def _by_label(samples, key):
+    """Fold a metric's samples onto one label axis (summing the rest —
+    a router-aggregated dump carries an extra ``replica`` label)."""
+    out = {}
+    for labels, v in (samples or {}).items():
+        d = dict(labels)
+        if key in d:
+            out[d[key]] = out.get(d[key], 0.0) + v
+    return out
+
+
+def _total(samples):
+    return sum((samples or {}).values())
+
+
+def class_p99_ms(metrics, cls="interactive"):
+    """p99 (ms) of ``serving_class_latency_ms`` for one class, from the
+    cumulative ``_bucket`` samples (linear interpolation inside the
+    winning bucket, the Prometheus histogram_quantile convention).
+    None when the class has no observations."""
+    buckets = {}
+    for labels, v in (metrics.get("serving_class_latency_ms_bucket")
+                      or {}).items():
+        d = dict(labels)
+        if d.get("class") != cls or "le" not in d:
+            continue
+        le = float("inf") if d["le"] in ("+Inf", "inf") else float(d["le"])
+        buckets[le] = buckets.get(le, 0.0) + v
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    target = total * 0.99
+    prev_le, prev_cum = 0.0, 0.0
+    for le in bounds:
+        cum = buckets[le]
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le      # overflow bucket: clamp (Prom idiom)
+            width = le - prev_le
+            in_bucket = cum - prev_cum
+            frac = ((target - prev_cum) / in_bucket) if in_bucket else 0
+            return prev_le + width * frac
+        prev_le, prev_cum = le, cum
+    return bounds[-1]
+
+
+def summarize(metrics):
+    """Everything the report renders, as one dict (the --json payload
+    and the test surface)."""
+    completed = _by_label(
+        metrics.get("serving_class_completed_total"), "class")
+    shed = _by_label(metrics.get("serving_admission_shed_total"),
+                     "class")
+    classes = {}
+    for cls in sorted(set(completed) | set(shed)):
+        done = completed.get(cls, 0.0)
+        lost = shed.get(cls, 0.0)
+        offered = done + lost
+        classes[cls] = {
+            "completed": done, "shed": lost,
+            "goodput": round(done / offered, 4) if offered else None,
+            "p99_ms": class_p99_ms(metrics, cls),
+        }
+    return {
+        "replicas": _by_label(metrics.get("fleet_replicas_count"),
+                              "state"),
+        "scale_events": _by_label(
+            metrics.get("fleet_scale_events_total"), "direction"),
+        "classes": classes,
+        "retry_budget_exhausted": _total(
+            metrics.get("serving_retry_budget_exhausted_total")),
+        "expired_in_queue": _total(
+            metrics.get("serving_expired_in_queue_total")),
+    }
+
+
+def render(doc):
+    lines = ["----------------  Fleet overload report  ----------------"]
+    reps = doc["replicas"]
+    if reps:
+        lines.append("replicas: " + ", ".join(
+            f"{s}={int(n)}" for s, n in sorted(reps.items())))
+    ev = doc["scale_events"]
+    lines.append(f"autoscaler events: up={int(ev.get('up', 0))} "
+                 f"down={int(ev.get('down', 0))}")
+    lines.append(f"{'class':<14} {'completed':>10} {'shed':>8} "
+                 f"{'goodput':>8} {'p99_ms':>10}")
+    for cls, row in doc["classes"].items():
+        gp = f"{row['goodput']:.3f}" if row["goodput"] is not None \
+            else "-"
+        p99 = f"{row['p99_ms']:.1f}" if row["p99_ms"] is not None \
+            else "-"
+        lines.append(f"{cls:<14} {int(row['completed']):>10} "
+                     f"{int(row['shed']):>8} {gp:>8} {p99:>10}")
+    lines.append(f"retry budget exhaustions: "
+                 f"{int(doc['retry_budget_exhausted'])}")
+    lines.append(f"expired while queued: "
+                 f"{int(doc['expired_in_queue'])}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Fleet overload/autoscaler report + interactive-p99 "
+                    "CI gate")
+    ap.add_argument("--from", dest="src", default=None,
+                    help="Prometheus text dump file")
+    ap.add_argument("--url", default=None,
+                    help="live exposition URL to scrape")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    ap.add_argument("--assert-interactive-p99-ms", type=float,
+                    default=None, metavar="X",
+                    help="exit 1 when the interactive-class p99 "
+                         "exceeds X ms")
+    args = ap.parse_args(argv)
+    if args.src and args.url:
+        ap.error("--from and --url are mutually exclusive")
+    if args.src:
+        with open(args.src, encoding="utf-8") as f:
+            text = f.read()
+    elif args.url:
+        from urllib.request import urlopen
+        with urlopen(args.url, timeout=10) as r:
+            text = r.read().decode("utf-8", "replace")
+    else:
+        from paddle_tpu.observability.metrics import render_metrics
+        text = render_metrics()
+    doc = summarize(parse_exposition(text))
+    if args.json:
+        import json
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(render(doc))
+    floor = args.assert_interactive_p99_ms
+    if floor is not None:
+        p99 = doc["classes"].get("interactive", {}).get("p99_ms")
+        if p99 is None:
+            print("FLEET REPORT: no interactive-class latency samples "
+                  "in the dump — nothing to gate", file=sys.stderr)
+            return 2
+        if p99 > floor:
+            print(f"INTERACTIVE-P99 VIOLATION: p99 {p99:.1f}ms exceeds "
+                  f"the {floor:.1f}ms gate "
+                  f"(completed="
+                  f"{int(doc['classes']['interactive']['completed'])}, "
+                  f"budget exhaustions="
+                  f"{int(doc['retry_budget_exhausted'])})",
+                  file=sys.stderr)
+            return 1
+        print(f"OK: interactive p99 {p99:.1f}ms <= {floor:.1f}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())
