@@ -56,6 +56,12 @@ throughput, vs_baseline only where BASELINE.json stores an anchor):
                       (dp/tp/sp, pp/dp, ep/dp) — per-(collective,
                       axis) bytes/count ledger, audit finding counts,
                       predicted comm-bound fraction per mesh
+  multislice          extra: 2-slice mesh(dcn_dp=2, dp=4) elastic
+                      training — simulated-DCN A/B of hierarchical vs
+                      flat gradient sync (per-fabric wire bytes,
+                      predicted comm s, measured step wall) plus the
+                      slice kill/regrow drill with goodput-attributed
+                      recovery seconds
 
 Every throughput config also reports cold_start_ms (first-step
 end-to-end latency) plus the executor's pass/trace/compile ms split, so
@@ -2393,6 +2399,62 @@ def bench_comms():
     }
 
 
+def bench_multislice():
+    """Multi-slice elastic training over a 2-slice ``mesh(dcn_dp=2,
+    dp=4)``: run ``__graft_entry__.multislice_bench()`` in a subprocess
+    (it provisions its own 8 virtual CPU devices) and report the
+    simulated-DCN A/B of hierarchical vs flat gradient sync — per-fabric
+    wire bytes, predicted comm seconds at ICI/DCN reference peaks,
+    measured step wall — plus the slice kill/regrow drill's membership
+    events and goodput-attributed recovery seconds. Headline: how many
+    times more DCN wire bytes the naive flat all-reduce moves per step
+    than the hierarchical decomposition (the in-slice reduce-scatter
+    divides the cross-slice payload by dp; wire factors push it
+    higher)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # the bench provisions 8 devices
+    t0 = time.time()
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "__graft_entry__.py"),
+         "--multislice"],
+        capture_output=True, text=True, cwd=repo, env=env,
+        timeout=1200)
+    wall = time.time() - t0
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"multislice_bench failed rc={out.returncode}: "
+            f"{out.stderr[-2000:]}")
+    summary = None
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("ok") and "drill" in doc:
+                summary = doc
+    if summary is None:
+        raise RuntimeError("multislice bench emitted no summary line")
+    return {
+        "metric": "multislice_dcn_wire_bytes_flat_over_hier",
+        "value": summary["dcn_wire_ratio_flat_over_hier"],
+        "unit": "ratio",
+        "vs_baseline": None,       # diagnostic layer, no external anchor
+        "bench_wall_s": round(wall, 1),
+        "mesh": summary["mesh"],
+        "hier": summary["hier"],
+        "flat": summary["flat"],
+        "simulated_step_ratio_flat_over_hier":
+            summary["simulated_step_ratio_flat_over_hier"],
+        "loss_delta": summary["loss_delta"],
+        "drill": summary["drill"],
+    }
+
+
 # one table drives everything: insertion order is the default run order.
 # The FLAGSHIP ("bert") runs LAST — the driver records the LAST JSON line
 # of the output tail, so the headline metric must be the final thing
@@ -2423,6 +2485,8 @@ _CONFIGS = {
                  "overload_interactive_p99_3x_over_1x_ratio"),
     "comms": (bench_comms,
               "comms_dp_tp_sp_predicted_comm_bound_ratio"),
+    "multislice": (bench_multislice,
+                   "multislice_dcn_wire_bytes_flat_over_hier"),
     "bert": (main, "bert_base_pretrain_bf16_samples_per_sec_per_chip"),
 }
 

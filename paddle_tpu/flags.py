@@ -125,6 +125,29 @@ _DEFS = {
     # collectives at the cross-slice fabric). A collective whose group
     # varies over ANY listed axis uses the DCN peak. "" = all-ICI
     "comms_dcn_axes": ("", str, None),
+    # -- multi-slice training (train/slices, framework/passes
+    # hier_grad_sync) --
+    # run dcn_dp meshes through the hierarchical grad-sync path:
+    # reduce-scatter in-slice (ICI), all-reduce across slices (DCN) on
+    # the 1/dp shard each chip owns, all-gather in-slice. False =
+    # plain GSPMD (the flat-all-reduce A/B baseline; numerics
+    # unchanged — hier_allreduce is mathematically the same mean)
+    "dcn_hierarchical": (True, bool, None),
+    # before the first multi-slice slab is dispatched, parse the
+    # compiled HLO and ASSERT the decomposition: DCN-priced traffic
+    # only on FLAGS_comms_dcn_axes, and cross-slice wire bytes
+    # strictly below the flat all-reduce estimate — raising
+    # HierarchicalCommsError before a chip is burned
+    "dcn_assert_hier": (True, bool, None),
+    # SliceSupervisor liveness: a slice whose last heartbeat is older
+    # than this many seconds counts one stale observation
+    "slice_heartbeat_timeout_s": (5.0, float, None),
+    # hysteresis window: membership only changes after this many
+    # CONSECUTIVE stale (shrink) or fresh (regrow) observations
+    "slice_window": (3, int, None),
+    # cooldown between membership changes (shrink or regrow), so a
+    # flapping slice can't thrash checkpoint-restore cycles
+    "slice_cooldown_s": (10.0, float, None),
     # -- training observability (observability/goodput, train/health,
     # observability/inputstall) --
     # model-health monitoring cadence: every N-th supervised slab

@@ -578,6 +578,24 @@ class Executor:
                                           state_out, state_fetches)
             self._maybe_shard_obs("step", cache_key, compiled, mesh,
                                   program, tuple(feed_arrays))
+            if mesh is not None and "dcn_dp" in mesh.axis_names \
+                    and _flag("dcn_hierarchical") \
+                    and any(op.type == "hier_allreduce"
+                            for op in program.global_block().ops):
+                # the single-step run() path lowers through plain GSPMD:
+                # hier_allreduce collapses to identity (no bound axes) and
+                # the gradient sync comes back as ONE flat all-reduce over
+                # dcn_dp+dp — numerically right, but every byte of it
+                # crosses the DCN. Warn once per compiled executable; the
+                # decomposed path is run_steps.
+                _flightrec().record(
+                    "hier_single_step_flat",
+                    where=f"program_{program._uid}",
+                    mesh_axes=",".join(mesh.axis_names),
+                    hint="FLAGS_dcn_hierarchical is on and the program "
+                         "carries hier_allreduce sync ops, but "
+                         "Executor.run lowers flat-GSPMD; use "
+                         "run_steps for the hierarchical DCN path")
 
         if check_nan_inf is None:
             check_nan_inf = _flag("check_nan_inf")
@@ -745,11 +763,28 @@ class Executor:
             # loop form so compile time stays K-independent
             unroll = k_steps if jax.default_backend() == "cpu" else 1
 
+        # hierarchical multi-slice path: a dcn_dp mesh whose program went
+        # through the hier_grad_sync pass runs under shard_map so the
+        # gradient reduction decomposes per fabric (RS in-slice / AR
+        # cross-slice / AG in-slice). Requires the explicit sync ops —
+        # without them per-device state would silently diverge — and a
+        # pure data-parallel mesh (tp/pp/sp compose via GSPMD only).
+        # FLAGS_dcn_hierarchical=False is the flat-GSPMD A/B baseline:
+        # same program, hier_allreduce collapses to identity.
+        from .lowering import hier_dp_axes
+        hier_axes = ()
+        if mesh is not None and _flag("dcn_hierarchical") \
+                and set(mesh.axis_names) <= {"dcn_dp", "dp"} \
+                and any(op.type == "hier_allreduce"
+                        for op in program.global_block().ops):
+            hier_axes = hier_dp_axes(mesh)
+        hier_on = bool(hier_axes)
+
         from .passes import pipeline_signature
         cache_key = (program._uid, program.version,
                      tuple(sorted(feed_sig)), tuple(fetch_names), id(mesh),
                      "steps", k_steps, guard, bool(skip_nonfinite_steps),
-                     unroll, pipeline_signature())
+                     unroll, hier_on, pipeline_signature())
         entry = self._cache.get(cache_key) if use_program_cache else None
         if entry is not None and not self._entry_valid(entry, scope):
             entry = None               # scope-state fetch binding changed
@@ -816,8 +851,13 @@ class Executor:
                 state_in, state_out, mut_names, mesh=mesh,
                 guard=guard,
                 skip_nonfinite=bool(skip_nonfinite_steps),
-                unroll=unroll)
-            if mesh is not None:
+                unroll=unroll,
+                viol_axes=hier_axes)
+            if hier_on:
+                from .lowering import wrap_hier_dp_steps
+                jitted = jax.jit(wrap_hier_dp_steps(fn, mesh, feed_arrays),
+                                 donate_argnums=(0,))
+            elif mesh is not None:
                 jitted = _jit_with_mesh_steps(fn, mesh)
             else:
                 jitted = jax.jit(fn, donate_argnums=(0,))
@@ -835,11 +875,26 @@ class Executor:
             self._maybe_shard_obs("train", cache_key, compiled, mesh,
                                   program, tuple(feed_arrays),
                                   batch_dim=1)
+            if hier_on and _flag("dcn_assert_hier"):
+                # pre-burn gate: parse the compiled HLO and prove the
+                # hierarchical decomposition landed — DCN-priced traffic
+                # only on the designated axes, cross-slice wire bytes
+                # strictly below the flat all-reduce — BEFORE the first
+                # slab is dispatched to hardware
+                from ..observability.comms import assert_hier_decomposition
+                assert_hier_decomposition(
+                    compiled, mesh,
+                    where=f"fused_program_{program._uid}_x{k_steps}")
 
         # chaos point for the training dispatch stage: fires BEFORE the
         # executable runs, so the scope still holds pre-slab state and a
         # supervised restart resumes bitwise from the last checkpoint
         _maybe_fail("train.dispatch")
+        if hier_axes:
+            # chaos point for the cross-slice reduction stage: raising
+            # simulates a slice whose DCN collective fails; delay=
+            # simulates a straggling slice stretching the step
+            _maybe_fail("train.allreduce_dcn")
         profiling = _prof.is_profiling()
         t0 = time.perf_counter()
         fetches, final_state, final_key, viols, slots = self._invoke(
@@ -1200,6 +1255,11 @@ def _batch_pspec_shape(mesh, shape):
     from ..parallel.mesh import partition_spec
     if not shape:
         return P()
+    if "dcn_dp" in mesh.axis_names and "dp" in mesh.axis_names:
+        # multi-slice: the batch dim shards jointly over the cross-slice
+        # and in-slice data axes (dcn_dp-major, so each slice holds a
+        # contiguous block of the global batch)
+        return partition_spec(mesh, (("dcn_dp", "dp"),), shape)
     axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
     return partition_spec(mesh, (axis,), shape)
 

@@ -229,7 +229,7 @@ def _nonfinite_leaf(x):
 def build_multi_step_fn(program, block_idx, feed_names, fetch_names,
                         state_in, state_out, mut_names,
                         mesh=None, guard=False, skip_nonfinite=False,
-                        unroll=1):
+                        unroll=1, viol_axes=()):
     """Return fn(state_mut, state_ro, feed_slab, base_key) ->
     (stacked_fetches, final_state, final_key, viol_counts, viol_slots):
     K training steps fused into one ``lax.scan`` over feeds stacked on a
@@ -262,7 +262,15 @@ def build_multi_step_fn(program, block_idx, feed_names, fetch_names,
     compile time K-independent; full unroll (K) restores straight-line
     code on backends whose while-loop bodies pessimize (XLA CPU drops
     intra-op threading inside loops). Both forms run the identical
-    per-step computation."""
+    per-step computation.
+
+    `viol_axes` (hierarchical multi-slice path): mapped axis names the
+    per-step violation count is psum'd over INSIDE the scan body, so the
+    ``skip_nonfinite`` rollback ``cond`` takes the same branch on every
+    device — a NaN seen by one slice's local batch must roll the step
+    back everywhere, not fork the replicas. Per-axis psums, innermost
+    first, so the cross-slice hop of this int32 rides only the
+    designated DCN axis."""
     step_fn = build_block_fn(program, block_idx, feed_names, fetch_names,
                              state_in, state_out, mesh=mesh)
     mut_names = list(mut_names)
@@ -285,6 +293,9 @@ def build_multi_step_fn(program, block_idx, feed_names, fetch_names,
                           if leaves else jnp.zeros((1,), jnp.int32))
                 viol = counts.sum(dtype=jnp.int32)
                 slot = jnp.argmax(counts > 0).astype(jnp.int32)
+                for a in reversed(tuple(viol_axes)):
+                    viol = jax.lax.psum(viol, a)
+                    slot = jax.lax.pmax(slot, a)
             if skip_nonfinite:
                 out_state, new_key = jax.lax.cond(
                     viol > 0,
@@ -298,3 +309,80 @@ def build_multi_step_fn(program, block_idx, feed_names, fetch_names,
         return list(ys), final_state, final_key, viols, slots
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Multi-slice hierarchical data parallelism (ROADMAP item 5, MegaScale
+# NSDI'24 shape): a mesh whose outermost axis is ``dcn_dp`` spans TPU
+# slices over DCN. Left to GSPMD, the gradient sync would be ONE flat
+# all-reduce over (dcn_dp x dp) — the full gradient payload crossing the
+# slow fabric. Instead the executor runs the fused step fn under
+# shard_map over the whole mesh, which binds the axis names so the
+# ``hier_allreduce`` ops the hier_grad_sync pass inserted decompose per
+# fabric: reduce-scatter@dp (ICI), all-reduce@dcn_dp on the owned 1/dp
+# shard (DCN), all-gather@dp (ICI).
+# ---------------------------------------------------------------------------
+
+def hier_dp_axes(mesh):
+    """The batch-sharding axes of a multi-slice mesh, outermost first
+    (``("dcn_dp", "dp")`` / ``("dcn_dp",)``), or ``()`` when the mesh
+    has no cross-slice axis (the hierarchical path does not apply)."""
+    if mesh is None or "dcn_dp" not in mesh.axis_names:
+        return ()
+    return tuple(a for a in ("dcn_dp", "dp") if a in mesh.axis_names)
+
+
+def _hier_fetch_reduce(y, axes):
+    """Cross-replica mean of a fetched value, one pmean per axis
+    (inner/ICI first) so the cross-slice hop reduces an already
+    slice-reduced value and DCN traffic stays on the designated axis.
+    Non-float fetches pass through (per-device value)."""
+    if not jnp.issubdtype(jnp.result_type(y), jnp.inexact):
+        return y
+    for a in reversed(axes):
+        y = jax.lax.pmean(y, a)
+    return y
+
+
+def wrap_hier_dp_steps(fn, mesh, feed_slab):
+    """shard_map a ``build_multi_step_fn`` product over a dcn_dp mesh.
+
+    Per-device semantics: each device traces the SAME program over its
+    local batch shard (feed slabs shard dim 1 jointly over
+    (dcn_dp, dp); state and the RNG key replicate), and the
+    hier_allreduce ops make the updated state identical everywhere —
+    ``out_specs=P()`` with the replication check off, since the
+    compiler cannot prove what the sync guarantees. Fetches are
+    pmean'd hierarchically before leaving the region (losses/metrics
+    become their global-batch means, matching the GSPMD path's
+    mean-over-global-batch up to summation order).
+
+    The global batch must divide by the total data-parallel degree;
+    feed arrays whose dim 1 does not divide (per-step scalars,
+    K-leading aux feeds) replicate instead.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..ops._shard_compat import shard_map
+
+    axes = hier_dp_axes(mesh)
+    denom = 1
+    for a in axes:
+        denom *= int(mesh.shape[a])
+    batch_spec = axes if len(axes) > 1 else axes[0]
+    feed_specs = {}
+    for n, a in feed_slab.items():
+        shape = tuple(getattr(a, "shape", ()) or ())
+        if len(shape) >= 2 and denom > 1 and shape[1] % denom == 0:
+            feed_specs[n] = P(None, batch_spec)
+        else:
+            feed_specs[n] = P()
+
+    def body(state_mut, state_ro, feed_slab, base_key):
+        ys, final_state, final_key, viols, slots = fn(
+            state_mut, state_ro, feed_slab, base_key)
+        ys = [_hier_fetch_reduce(y, axes) for y in ys]
+        return ys, final_state, final_key, viols, slots
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=(P(), P(), feed_specs, P()),
+                     out_specs=P(), check_vma=False)

@@ -402,6 +402,86 @@ class SyncBatchNormPass(Pass):
                     op.type = "sync_batch_norm"
 
 
+@register_pass("hier_grad_sync")
+class HierGradSyncPass(Pass):
+    """Insert an explicit ``hier_allreduce`` after every parameter
+    gradient's producer — the multi-slice gradient-sync pass
+    (CompiledProgram applies it when the mesh has a ``dcn_dp`` axis).
+
+    Under the executor's shard_map hier path each device computes its
+    LOCAL-batch gradient; the inserted op makes it the global mean via
+    reduce-scatter in-slice (ICI) / all-reduce across slices (DCN, on
+    the 1/dp shard) / all-gather in-slice. Insertion happens directly
+    AFTER the raw ``<param>@GRAD`` producer — not batched at the end of
+    backward — so XLA can overlap layer k's cross-slice hop against
+    layer k-1's backward compute. All downstream readers (gradient
+    clipping, regularization, the optimize op) are rewired to the
+    synced value, so grad transformations see the same global gradient
+    the flat-GSPMD path gives them. Outside a mapped axis the op is an
+    identity: applying this pass never changes single-mesh numerics,
+    which is what makes FLAGS_dcn_hierarchical a pure runtime A/B
+    switch on ONE program.
+
+    Idempotent: a grad whose ``@HIER`` twin already exists is skipped.
+    """
+
+    inner_axis = "dp"
+    outer_axis = "dcn_dp"
+    GRAD_SUFFIX = "@GRAD"
+    SYNC_SUFFIX = "@HIER"
+
+    def apply(self, program):
+        for block in program.blocks:
+            self._apply_block(block)
+
+    def _grad_names(self, block):
+        """Gradient vars to sync, preferring the raw ``<param>@GRAD``
+        over the optimize op's (possibly clipped/regularized) Grad
+        input so upstream grad transforms also see the synced value."""
+        out, seen = [], set()
+        for op in block.ops:
+            if op.attrs.get(OP_ROLE_KEY) != _OpRole.Optimize:
+                continue
+            params = op.input("Param")
+            fed = op.input("Grad")
+            for i, g in enumerate(fed):
+                if i < len(params):
+                    raw = params[i] + self.GRAD_SUFFIX
+                    if raw in block.vars:
+                        g = raw
+                if g not in seen:
+                    seen.add(g)
+                    out.append(g)
+        return out
+
+    def _apply_block(self, block):
+        for g in self._grad_names(block):
+            synced = g + self.SYNC_SUFFIX
+            if synced in block.vars:
+                continue
+            writers = [i for i, op in enumerate(block.ops)
+                       if g in op.output_arg_names
+                       and op.type != "hier_allreduce"]
+            if not writers:
+                continue
+            idx = writers[-1]
+            v = block.vars.get(g)
+            block.create_var(name=synced,
+                             shape=getattr(v, "shape", None),
+                             dtype=getattr(v, "dtype", "float32"))
+            block._insert_op(
+                idx + 1, "hier_allreduce",
+                inputs={"X": [g]}, outputs={"Out": [synced]},
+                attrs={"inner_axis": self.inner_axis,
+                       "outer_axis": self.outer_axis,
+                       "mean": True,
+                       OP_ROLE_KEY: _OpRole.Backward})
+            for op in block.ops[idx + 2:]:
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [synced if n == g else n
+                                       for n in names]
+
+
 @register_pass("quant_aware")
 class QuantAwarePass(Pass):
     """QAT fake-quant instrumentation (reference contrib/slim

@@ -377,6 +377,92 @@ class CommLedger:
         return "\n".join(lines)
 
 
+def flat_allreduce_wire_bytes(ledger, mesh, dcn_axes=("dcn_dp",)):
+    """What the NAIVE flat all-reduce would move over DCN per step: the
+    full gradient volume (reconstructed as the hier path's cross-slice
+    payload x the in-slice degree it was scattered by) all-reduced over
+    the whole ``S = dcn x dp`` group at DCN pricing —
+    ``2(S-1)/S x B_total`` per device. The yardstick
+    :func:`assert_hier_decomposition` holds the observed DCN traffic
+    against."""
+    inner = 1
+    total = 1
+    for a in mesh.axis_names:
+        total *= int(mesh.shape[a])
+        if a not in dcn_axes:
+            inner *= int(mesh.shape[a])
+    dcn_payload = sum(row["payload_bytes"]
+                      for (kind, axis), row in ledger.rows.items()
+                      if _rides_dcn(axis, dcn_axes))
+    return _WIRE_FACTOR["all-reduce"](total) * dcn_payload * inner
+
+
+def assert_hier_decomposition(compiled_or_ledger, mesh, dcn_axes=None,
+                              where="train"):
+    """Pre-burn gate for the multi-slice hierarchical grad sync: parse
+    the compiled executable's collectives and PROVE the decomposition
+    before the first slab is dispatched. Three checks, all fatal
+    (:class:`~paddle_tpu.resilience.HierarchicalCommsError`):
+
+    1. every DCN-priced collective's group varies ONLY over declared
+       cross-slice axes — a ``"dcn_dp+dp"`` label means a collective
+       spans both fabrics and the whole payload crawls at DCN speed;
+    2. the observed cross-slice wire bytes are STRICTLY below the flat
+       all-reduce estimate (:func:`flat_allreduce_wire_bytes`) — the
+       decomposition must actually pay off, not just exist;
+    3. cross-slice collectives exist at all — zero DCN rows on a
+       dcn_dp mesh means hier_grad_sync never ran and gradients are
+       not synchronized across slices.
+
+    Returns the ledger on success so callers can log it. ``dcn_axes``
+    defaults to ``FLAGS_comms_dcn_axes``, falling back to
+    ``("dcn_dp",)`` (the axis the mesh module declares cross-slice).
+    """
+    from ..resilience import HierarchicalCommsError
+    if dcn_axes is None:
+        from ..flags import flag as _flag
+        dcn_axes = tuple(a.strip() for a in
+                         _flag("comms_dcn_axes").split(",")
+                         if a.strip()) or ("dcn_dp",)
+    ledger = compiled_or_ledger \
+        if isinstance(compiled_or_ledger, CommLedger) \
+        else CommLedger.from_compiled(compiled_or_ledger, mesh)
+    violations = []
+    dcn_wire = 0
+    dcn_rows = 0
+    for (kind, axis), row in sorted(ledger.rows.items()):
+        if not _rides_dcn(axis, dcn_axes):
+            continue
+        dcn_rows += row["count"]
+        dcn_wire += row["wire_bytes"]
+        stray = [p for p in axis.split("+") if p not in dcn_axes]
+        if stray:
+            violations.append(
+                f"{kind}@{axis}: group varies over non-DCN axes "
+                f"{stray} ({row['wire_bytes']} wire bytes would cross "
+                f"slices carrying in-slice traffic)")
+    if dcn_rows == 0:
+        violations.append(
+            "no cross-slice collectives found — the hier_grad_sync "
+            "pass did not run on this program (compile it through "
+            "CompiledProgram.with_data_parallel over the dcn_dp mesh) "
+            "and per-slice gradients would silently diverge")
+    else:
+        flat = flat_allreduce_wire_bytes(ledger, mesh, dcn_axes)
+        if flat and dcn_wire >= flat:
+            violations.append(
+                f"cross-slice wire bytes {dcn_wire} do not beat the "
+                f"flat all-reduce estimate {flat:.0f} — the "
+                f"decomposition exists but does not pay")
+    if violations:
+        raise HierarchicalCommsError(
+            f"hierarchical-comms gate failed for {where!r} on mesh "
+            f"{dict(mesh.shape)} (DCN axes {tuple(dcn_axes)}):\n  - "
+            + "\n  - ".join(violations),
+            violations=violations, ledger=ledger)
+    return ledger
+
+
 def observe_ledger(where, ledger, cost=None, dcn_axes=()):
     """Export one newly compiled executable's ledger: bump the
     per-(collective, axis) byte/op counters, set the predicted

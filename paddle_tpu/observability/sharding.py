@@ -371,6 +371,17 @@ def observe_executable(where, compiled, mesh, program=None,
         dcn_axes = tuple(a.strip() for a in
                          _flag("comms_dcn_axes").split(",")
                          if a.strip())
+    unknown = tuple(a for a in dcn_axes if a not in mesh.axis_names)
+    if unknown:
+        # a listed cross-slice axis the active mesh doesn't have prices
+        # NOTHING at DCN — silently, which is exactly how a typo'd
+        # FLAGS_comms_dcn_axes would fake an all-ICI traffic profile
+        _flightrec().record(
+            "comms_dcn_axis_unknown", axes=",".join(unknown),
+            mesh_axes=",".join(mesh.axis_names), where=where,
+            hint="FLAGS_comms_dcn_axes names axes absent from the "
+                 "active mesh; their collectives are priced at ICI "
+                 "bandwidth, not DCN")
     record = {"where": where, "tag": tag or where}
     # ONE HLO text read + ONE regex parse, shared by the audit's
     # reshard scan and the ledger (real mesh programs' optimized HLO

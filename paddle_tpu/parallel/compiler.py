@@ -116,6 +116,19 @@ class CompiledProgram:
                 from ..framework.passes import apply_passes
                 self.program = self.program.clone()
                 apply_passes(self.program, ["sync_batch_norm"])
+        if self.mesh is not None and "dcn_dp" in self.mesh.axis_names:
+            # multi-slice mesh: make the gradient sync EXPLICIT so the
+            # executor's hierarchical path can decompose it per fabric
+            # (framework/passes.py hier_grad_sync). Applied to a clone,
+            # never the user's Program; unconditional for dcn meshes —
+            # the inserted ops are identities outside shard_map, so the
+            # flat-GSPMD baseline (FLAGS_dcn_hierarchical=False) runs
+            # the SAME compiled program and an A/B needs no rebuild
+            if not any(op.type == "hier_allreduce"
+                       for blk in self.program.blocks for op in blk.ops):
+                from ..framework.passes import apply_passes
+                self.program = self.program.clone()
+                apply_passes(self.program, ["hier_grad_sync"])
         return self
 
     def with_inference_optimize(self, config=None):

@@ -8,11 +8,16 @@ Axis names are the framework-wide contract:
 
   dp — data parallel        tp — tensor (model) parallel
   pp — pipeline stages      sp — sequence/context parallel
-  ep — expert parallel
+  ep — expert parallel      dcn_dp — cross-slice data parallel (DCN)
 
 Intra-slice traffic rides ICI, cross-slice DCN — both chosen by XLA from the
 same named-axis collectives, which is why there is no ring bootstrap, no
 NCCL-id RPC (c_gen_nccl_id_op.cc), and no comm/calc stream split here.
+``dcn_dp`` is the one axis DECLARED to cross slices: it sits outermost
+(the slowest fabric gets the outermost placement, like pp before it), the
+comms ledger prices its collectives at DCN bandwidth
+(``FLAGS_comms_dcn_axes``), and the executor runs dcn_dp meshes through
+the hierarchical grad-sync path (framework/passes.py hier_grad_sync).
 """
 import math
 from dataclasses import dataclass, field
@@ -21,7 +26,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+AXIS_ORDER = ("dcn_dp", "pp", "dp", "ep", "sp", "tp")
 
 _current_mesh = None
 
@@ -33,10 +38,11 @@ class MeshConfig:
     pp: int = 1
     sp: int = 1
     ep: int = 1
+    dcn_dp: int = 1
 
     def axis_sizes(self):
-        return {"pp": self.pp, "dp": self.dp, "ep": self.ep,
-                "sp": self.sp, "tp": self.tp}
+        return {"dcn_dp": self.dcn_dp, "pp": self.pp, "dp": self.dp,
+                "ep": self.ep, "sp": self.sp, "tp": self.tp}
 
 
 def make_mesh(config=None, devices=None, **axes):
@@ -94,6 +100,18 @@ def partition_spec(mesh, spec, shape=None):
         spec = spec[:len(shape)] + (None,) * (len(shape) - len(spec))
     out = []
     for i, a in enumerate(spec):
+        if isinstance(a, (tuple, list)):
+            # joint sharding of one dim over several axes (the batch dim
+            # of a multi-slice mesh shards over ("dcn_dp", "dp")):
+            # unknown component axes drop, and the dim must divide by
+            # the PRODUCT of the surviving sizes
+            sub = tuple(x for x in a if x in mesh.axis_names)
+            prod = math.prod(int(mesh.shape[x]) for x in sub) if sub else 1
+            if not sub or (shape is not None and shape[i] % prod != 0):
+                out.append(None)
+            else:
+                out.append(sub if len(sub) > 1 else sub[0])
+            continue
         if a is None or a not in mesh.axis_names:
             out.append(None)
         elif shape is not None and shape[i] % mesh.shape[a] != 0:

@@ -190,6 +190,39 @@ class FaultInjected(RuntimeError):
     genuine bug in the recovery machinery."""
 
 
+class HierarchicalCommsError(RuntimeError):
+    """The compiled multi-slice executable FAILED the pre-burn comms
+    gate (``observability/comms.assert_hier_decomposition``): either
+    DCN-priced traffic appears on an axis that should stay on ICI, or
+    the cross-slice wire bytes don't beat the flat all-reduce estimate,
+    or the program carries no cross-slice collectives at all (the
+    hier_grad_sync pass never ran). Raised BEFORE the first slab is
+    dispatched, so a mis-decomposed program costs a compile, not a
+    DCN-saturated training run. Carries ``violations`` (human-readable
+    strings) and ``ledger`` (the offending CommLedger)."""
+
+    def __init__(self, message, violations=None, ledger=None):
+        super().__init__(message)
+        self.violations = list(violations or [])
+        self.ledger = ledger
+
+
+class SliceWidthError(RuntimeError):
+    """A checkpoint restored at a different ``dcn_dp`` width carries
+    state incompatible with the rebuilt program (an optimizer slab or
+    parameter whose shape disagrees with the program's declaration).
+    Raised by ``train.slices.validate_restored_widths`` instead of
+    letting GSPMD silently reshard — or jit fail with an opaque shape
+    error — mid-recovery. Carries ``var``, ``found`` and ``expected``
+    shapes."""
+
+    def __init__(self, message, var=None, found=None, expected=None):
+        super().__init__(message)
+        self.var = var
+        self.found = tuple(found) if found is not None else None
+        self.expected = tuple(expected) if expected is not None else None
+
+
 class RetryBudgetExhausted(RpcDeadlineError):
     """The process retry budget refused this retry/hedge/failover: the
     fleet is already saturated with first-try traffic, and another

@@ -32,3 +32,6 @@ from .preemption import (  # noqa: F401
 from .checkpoint import TrainCheckpoint, TRAIN_STATE_FILE  # noqa: F401
 from .health import HealthMonitor  # noqa: F401
 from .supervisor import TrainingSupervisor  # noqa: F401
+from .slices import (  # noqa: F401
+    SliceSupervisor, validate_restored_widths,
+)

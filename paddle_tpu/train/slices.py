@@ -1,0 +1,349 @@
+"""SliceSupervisor: multi-slice elastic training with slice-loss
+remediation.
+
+The MegaScale-shaped failure model (PAPERS.md, NSDI 2024): the outer
+data-parallel axis crosses TPU slices over DCN, and losing a slice is a
+ROUTINE event — a maintenance drain, an optical-link flap, a preempted
+reservation — not an outage. This module composes the PR-7
+:class:`~paddle_tpu.train.supervisor.TrainingSupervisor` (bitwise
+preempt/resume) with a PR-15-style control loop (hysteresis window,
+cooldown, drain-aware membership changes):
+
+- every slice reports liveness via :meth:`SliceSupervisor.beat`; a
+  slice whose last heartbeat is older than
+  ``FLAGS_slice_heartbeat_timeout_s`` for ``FLAGS_slice_window``
+  CONSECUTIVE :meth:`SliceSupervisor.tick` observations is declared
+  lost (hysteresis: one missed beat never thrashes membership);
+- a persistent cross-slice collective failure — the inner supervisor's
+  restart budget exhausted on ``train.allreduce_dcn`` faults — shrinks
+  immediately, blaming the stalest slice (the restart loop already
+  proved the fault is not transient);
+- a membership change DRAINS, never kills: the control loop requests
+  an in-process preemption, the inner supervisor runs its bounded-
+  deadline fast checkpoint at the next slab boundary, and only then is
+  the program rebuilt at the new ``dcn_dp`` width and the checkpoint
+  restored — so no batch is dropped or double-trained (the data cursor
+  is the GLOBAL slab index: the global batch size is constant across
+  widths, narrower meshes just give each chip a larger shard);
+- a lost slice whose heartbeats return fresh for a full window (after
+  ``FLAGS_slice_cooldown_s`` of quiet) regrows membership through the
+  symmetric drain → checkpoint → rebuild-wider path.
+
+Attribution: every second of shrink/regrow lands in the goodput
+ledger's ``recovery`` category, each change emits a
+``slice_lost``/``slice_rejoined`` flight event carrying its recovery
+seconds, and the ``train_slices_count{state}`` gauge /
+``train_slice_events_total{event}`` counter keep the membership
+history scrapable — ``tools/train_report.py --assert-goodput-floor``
+is the CI gate that a recovery-heavy run cannot silently pass.
+"""
+import time
+from collections import deque
+
+import numpy as np
+
+from ..flags import flag as _flag
+from ..observability.goodput import GoodputLedger
+from ..observability.metrics import default_registry as _registry
+from ..observability.recorder import flight_recorder as _flightrec
+from ..resilience import (FaultInjected, PreemptedError,
+                          RestartBudgetExceeded, SliceWidthError,
+                          maybe_fail)
+from . import preemption as _preempt
+from .supervisor import TrainingSupervisor
+
+_M_SLICES = _registry().gauge(
+    "train_slices_count",
+    "slices by membership state (active participates in dcn_dp, lost "
+    "is awaiting regrow)",
+    labels=("state",), max_series=4)
+_M_SLICE_EVENTS = _registry().counter(
+    "train_slice_events_total",
+    "slice membership changes applied by the SliceSupervisor",
+    labels=("event",), max_series=4)
+
+SHRINK_REASON = "slice_shrink"
+REGROW_REASON = "slice_regrow"
+
+
+def validate_restored_widths(scope, program, width):
+    """Post-restore width validation: every persistable the checkpoint
+    put in ``scope`` must match the shape the ``dcn_dp=width`` program
+    declares (dynamic ``-1``/None dims skipped). A mismatched optimizer
+    slab raises a typed, actionable
+    :class:`~paddle_tpu.resilience.SliceWidthError` instead of letting
+    GSPMD silently reshard — or the jit fail with an opaque
+    shape error — mid-recovery."""
+    gb = program.global_block()
+    for name, var in gb.vars.items():
+        if not getattr(var, "persistable", False):
+            continue
+        declared = getattr(var, "shape", None)
+        if declared is None:
+            continue
+        val = scope.find_var(name)
+        if val is None:
+            continue
+        found = tuple(int(d) for d in np.shape(val))
+        ok = len(found) == len(declared) and all(
+            d in (-1, None) or int(f) == int(d)
+            for f, d in zip(found, declared))
+        if not ok:
+            raise SliceWidthError(
+                f"restored state {name!r} has shape {found} but the "
+                f"dcn_dp={width} program declares "
+                f"{tuple(declared)} — the checkpoint was written for "
+                f"an incompatible program/width and optimizer slabs do "
+                f"not reshard implicitly. Restore it at the width it "
+                f"was written at, or point the SliceSupervisor at the "
+                f"matching checkpoint_dir.",
+                var=name, found=found, expected=declared)
+
+
+class _WidthStampedSupervisor(TrainingSupervisor):
+    """TrainingSupervisor whose checkpoints record the ``dcn_dp`` width
+    they were written at — what lets a restore-time width audit say
+    'written at 2, restoring at 1' instead of guessing."""
+
+    def __init__(self, *args, dcn_dp=1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.dcn_dp = int(dcn_dp)
+
+    def _train_state(self, epoch, batches, slab, step, base_seed):
+        st = super()._train_state(epoch, batches, slab, step, base_seed)
+        st["dcn_dp"] = self.dcn_dp
+        return st
+
+
+class SliceSupervisor:
+    """Slice-membership control loop over a rebuildable training run.
+
+    ``build`` is a callback ``build(dcn_dp) -> dict`` returning at
+    least ``executor`` and ``program`` (plus optional
+    ``startup_program`` / ``scope``) for that cross-slice width — the
+    mesh/program factory the supervisor re-invokes on every membership
+    change. ``supervisor_kwargs`` pass through to the inner
+    :class:`TrainingSupervisor` (``checkpoint_every_n_slabs=1`` makes
+    membership changes zero-replay). ``clock`` is injectable for
+    deterministic heartbeat tests.
+    """
+
+    def __init__(self, build, checkpoint_dir, *, slices=2, min_slices=1,
+                 heartbeat_timeout_s=None, window=None, cooldown_s=None,
+                 clock=time.monotonic, **supervisor_kwargs):
+        if int(slices) < int(min_slices) or int(min_slices) < 1:
+            raise ValueError(
+                f"need slices >= min_slices >= 1, got slices={slices} "
+                f"min_slices={min_slices}")
+        self.build = build
+        self.checkpoint_dir = checkpoint_dir
+        self.total_slices = int(slices)
+        self.min_slices = int(min_slices)
+        self.heartbeat_timeout_s = float(
+            heartbeat_timeout_s if heartbeat_timeout_s is not None
+            else _flag("slice_heartbeat_timeout_s"))
+        self.window = max(1, int(window if window is not None
+                                 else _flag("slice_window")))
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else _flag("slice_cooldown_s"))
+        self._clock = clock
+        self._kwargs = dict(supervisor_kwargs)
+        self._user_on_slab_end = self._kwargs.pop("on_slab_end", None)
+        now = self._clock()
+        self._active = list(range(self.total_slices))
+        self._lost = []
+        self._beats = {s: now for s in self._active}
+        self._last_change_t = None
+        self._pending = None          # ("shrink"|"regrow", slice_id)
+        self._reset_windows()
+        self.supervisor = None
+        self.events = []              # applied changes, oldest first
+        self._update_gauges()
+
+    # -- membership state --------------------------------------------------
+    @property
+    def width(self):
+        """The current ``dcn_dp`` degree (= number of active slices)."""
+        return len(self._active)
+
+    @property
+    def active_slices(self):
+        return tuple(self._active)
+
+    @property
+    def lost_slices(self):
+        return tuple(self._lost)
+
+    def _reset_windows(self):
+        self._stale_hist = {s: deque(maxlen=self.window)
+                            for s in self._active}
+        self._fresh_hist = {s: deque(maxlen=self.window)
+                            for s in self._lost}
+
+    def _update_gauges(self):
+        _M_SLICES.set(len(self._active), labels=("active",))
+        _M_SLICES.set(len(self._lost), labels=("lost",))
+
+    # -- liveness ----------------------------------------------------------
+    def beat(self, slice_id, now=None):
+        """Record a heartbeat from ``slice_id``. Returns False when the
+        beat was dropped (the ``train.slice_heartbeat`` chaos point
+        raised — a dead slice); a ``delay=`` injection stalls HERE, so
+        the beat lands late exactly as a straggling slice's would."""
+        try:
+            maybe_fail("train.slice_heartbeat", slice=slice_id)
+        except FaultInjected:
+            return False
+        # timestamp taken AFTER the chaos point: injected delay makes
+        # the beat late, not just slow to return
+        self._beats[slice_id] = self._clock() if now is None else now
+        return True
+
+    def tick(self, now=None):
+        """One control-loop observation: append each slice's staleness
+        to its hysteresis window and — outside the cooldown, one change
+        at a time — request a drain-aware shrink (active slice stale
+        for a FULL window) or regrow (lost slice fresh for a full
+        window). Returns the requested ``(action, slice_id)`` or None.
+        Pumped automatically at every slab boundary while
+        :meth:`run_slabs` is active."""
+        now = self._clock() if now is None else now
+        cut = now - self.heartbeat_timeout_s
+        for s in self._active:
+            self._stale_hist[s].append(
+                self._beats.get(s, float("-inf")) < cut)
+        for s in self._lost:
+            self._fresh_hist[s].append(
+                self._beats.get(s, float("-inf")) >= cut)
+        if self._pending is not None:
+            return None               # a change is already draining
+        if self._last_change_t is not None and \
+                now - self._last_change_t < self.cooldown_s:
+            return None
+        # shrink outranks regrow: correctness (a dead slice stalls every
+        # cross-slice collective) before capacity
+        if len(self._active) > self.min_slices:
+            for s in list(self._active):
+                h = self._stale_hist[s]
+                if len(h) == h.maxlen and all(h):
+                    return self._request("shrink", s)
+        if len(self._active) < self.total_slices:
+            for s in list(self._lost):
+                h = self._fresh_hist[s]
+                if len(h) == h.maxlen and all(h):
+                    return self._request("regrow", s)
+        return None
+
+    def _request(self, action, slice_id):
+        self._pending = (action, slice_id)
+        reason = SHRINK_REASON if action == "shrink" else REGROW_REASON
+        # drain, don't kill: the inner supervisor exits at the next slab
+        # boundary through its bounded-deadline fast checkpoint
+        _preempt.request_preemption(reason)
+        return (action, slice_id)
+
+    def _stalest_active(self):
+        return min(self._active,
+                   key=lambda s: self._beats.get(s, float("-inf")))
+
+    # -- the supervised multi-width loop -----------------------------------
+    def _on_slab_end(self, slab_idx, step, last_fetches):
+        if self._user_on_slab_end is not None:
+            self._user_on_slab_end(slab_idx, step, last_fetches)
+        self.tick()
+
+    def _make_supervisor(self, width):
+        # fresh unique-name generator per build: the rebuilt program's
+        # variables must carry the SAME names the checkpoint was
+        # written under, or restore reports them missing
+        from ..framework import unique_name
+        with unique_name.guard():
+            parts = self.build(width)
+        sup = _WidthStampedSupervisor(
+            parts["executor"], parts["program"], self.checkpoint_dir,
+            startup_program=parts.get("startup_program"),
+            scope=parts.get("scope"), dcn_dp=width,
+            on_slab_end=self._on_slab_end, **self._kwargs)
+        state = sup.resume()
+        if state is not None:
+            validate_restored_widths(sup.scope, sup._plain_program,
+                                     width)
+        self.supervisor = sup
+        return sup
+
+    def _apply_pending(self):
+        action, s = self._pending
+        self._pending = None
+        event = "slice_lost" if action == "shrink" else "slice_rejoined"
+        t0 = time.perf_counter()
+        if action == "shrink":
+            self._active.remove(s)
+            self._lost.append(s)
+        else:
+            self._lost.remove(s)
+            self._active.append(s)
+            self._active.sort()
+        self._reset_windows()
+        width = len(self._active)
+        self._make_supervisor(width)
+        dt = time.perf_counter() - t0
+        # recovery attribution on the registry-global counters: a
+        # never-started ledger has no wall clock of its own, so the
+        # charge can't double-count against the inner supervisor's
+        # per-run books — but train_time_seconds_total{category=
+        # "recovery"} (what train_report gates on) sees every second
+        GoodputLedger().add("recovery", dt)
+        self._last_change_t = self._clock()
+        rec = {"event": event, "slice": int(s), "dcn_dp": width,
+               "recovery_s": dt}
+        self.events.append(rec)
+        _M_SLICE_EVENTS.inc(labels=(event,))
+        self._update_gauges()
+        _flightrec().record(event, slice=int(s), dcn_dp=width,
+                            recovery_s=round(dt, 6))
+        print(f"[slices] {event}: slice {s} -> dcn_dp={width} "
+              f"(recovery {dt * 1e3:.0f}ms; active "
+              f"{list(self._active)}, lost {list(self._lost)})")
+
+    def run_slabs(self, slabs, fetch_list=None, collect_fetches=False):
+        """Run the slab list to completion across membership changes:
+        each drain exit restores from the slab-boundary checkpoint into
+        the rebuilt width and continues at the global cursor — no batch
+        dropped, none double-trained. Returns the final segment's
+        result dict extended with ``dcn_dp`` (final width) and
+        ``slice_events`` (every membership change applied, with its
+        recovery seconds)."""
+        slabs = list(slabs)
+        if self.supervisor is None:
+            self._make_supervisor(self.width)
+        while True:
+            if self._pending is not None:
+                # a change requested between runs (or carried out of a
+                # failed segment) applies before dispatching more work
+                if _preempt.preemption_reason() in (SHRINK_REASON,
+                                                    REGROW_REASON):
+                    _preempt.clear_preemption()
+                self._apply_pending()
+            try:
+                result = self.supervisor.run_slabs(
+                    slabs, fetch_list=fetch_list,
+                    collect_fetches=collect_fetches)
+            except PreemptedError as exc:
+                if exc.reason in (SHRINK_REASON, REGROW_REASON) \
+                        and self._pending is not None:
+                    _preempt.clear_preemption()
+                    continue          # loop head applies the change
+                raise                 # a REAL preemption (signal/user)
+            except (RestartBudgetExceeded, FaultInjected) as exc:
+                # the inner restart loop absorbs transient faults; a
+                # budget blown on the cross-slice collective means a
+                # slice is persistently unreachable — shrink it away
+                if "train.allreduce_dcn" in str(exc) \
+                        and len(self._active) > self.min_slices:
+                    victim = self._stalest_active()
+                    self._pending = ("shrink", victim)
+                    continue
+                raise
+            result["dcn_dp"] = self.width
+            result["slice_events"] = list(self.events)
+            return result

@@ -1,0 +1,489 @@
+"""Multi-slice elastic training: hierarchical DCN data-parallelism +
+slice-loss remediation (train/slices.py, ops hier_allreduce, the
+hier_grad_sync pass, and the comms-ledger decomposition gate).
+
+Runs on the 8-virtual-CPU-device mesh from conftest: a 2-slice
+``mesh(dcn_dp=2, dp=4)`` exercises the real shard_map lowering, and the
+SliceSupervisor drills use an injected fake clock so heartbeat
+hysteresis elapses deterministically.
+"""
+import json
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, train
+from paddle_tpu.framework import unique_name
+from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, partition_spec
+from paddle_tpu.parallel.compiler import CompiledProgram
+from paddle_tpu.resilience import HierarchicalCommsError, SliceWidthError
+from paddle_tpu.train.slices import SliceSupervisor, validate_restored_widths
+
+FEAT = 4
+LOSS = "mean_0.tmp_0"
+
+
+@contextmanager
+def _flags(**kv):
+    from paddle_tpu import flags as F
+    old = {k: F.flag(k) for k in kv}
+    F.set_flags({f"FLAGS_{k}": v for k, v in kv.items()})
+    try:
+        yield
+    finally:
+        F.set_flags({f"FLAGS_{k}": v for k, v in old.items()})
+
+
+def _build(width=2, dp=4, seed=7):
+    """Deterministically-named (unique_name.guard) tiny MLP + SGD over a
+    dcn_dp x dp mesh; width=1 collapses the dcn axis away."""
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[-1, FEAT], dtype="float32")
+            y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+            h = layers.fc(x, size=8, act="relu")
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(h, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        mesh = make_mesh(MeshConfig(dcn_dp=width, dp=dp))
+        compiled = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+    assert loss.name == LOSS
+    return {"main": main, "startup": startup, "compiled": compiled,
+            "mesh": mesh}
+
+
+def _slabs(n=4, k=2, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(k, batch, FEAT).astype(np.float32),
+             "y": rng.randn(k, batch, 1).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _weights(scope):
+    names = sorted(n for n in scope.keys()
+                   if n.endswith((".w_0", ".b_0")))
+    return {n: np.asarray(scope.find_var(n)) for n in names}
+
+
+_ab_cache = {}
+
+
+def _run_variant(hier):
+    """One 4-step run on the dcn_dp=2 x dp=4 mesh with hierarchical sync
+    on/off; returns (losses, weights, merged CommLedger). Cached — the
+    A/B pair compiles once for the whole module."""
+    if hier in _ab_cache:
+        return _ab_cache[hier]
+    from paddle_tpu.observability import sharding as shobs
+    from paddle_tpu.observability.comms import CommLedger
+    with _flags(dcn_hierarchical=hier, comms_ledger=True,
+                shard_audit=True, comms_dcn_axes="dcn_dp"):
+        shobs.recent_observations(clear=True)
+        parts = _build()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        slab = _slabs(n=1, k=4)[0]
+        with fluid.scope_guard(scope):
+            exe.run(parts["startup"])
+            out = exe.run_steps(parts["compiled"], feed=slab,
+                                fetch_list=[LOSS])
+            w = _weights(scope)
+        colls = []
+        for rec in shobs.recent_observations(clear=True).values():
+            if rec.get("ledger") is not None:
+                colls.extend(rec["ledger"].collectives)
+    _ab_cache[hier] = (np.asarray(out[0]).ravel(), w, CommLedger(colls))
+    return _ab_cache[hier]
+
+
+# ---------------------------------------------------------------------------
+# the hier_grad_sync pass + lowering
+
+
+def test_pass_inserts_hier_allreduce_and_rewires():
+    parts = _build()
+    block = parts["compiled"].program.global_block()
+    hier = [op for op in block.ops if op.type == "hier_allreduce"]
+    # one per parameter gradient: 2 fc layers x (w, b)
+    assert len(hier) == 4
+    for op in hier:
+        assert op.attrs["inner_axis"] == "dp"
+        assert op.attrs["outer_axis"] == "dcn_dp"
+        assert op.attrs["mean"] is True
+    # every optimizer op consumes the SYNCED gradient, not the raw one
+    synced = {op.output("Out")[0] for op in hier}
+    for op in block.ops:
+        if op.type == "sgd":
+            g, = op.input("Grad")
+            assert g in synced, (op.type, g)
+
+
+def test_pass_is_idempotent():
+    from paddle_tpu.framework.passes import apply_passes
+    parts = _build()
+    prog = parts["compiled"].program
+    n = len(prog.global_block().ops)
+    apply_passes(prog, ["hier_grad_sync"])
+    assert len(prog.global_block().ops) == n
+
+
+def test_no_dcn_mesh_no_hier_ops():
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[-1, FEAT], dtype="float32")
+            y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(x, 1), y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        mesh = make_mesh(MeshConfig(dp=4))
+        compiled = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh)
+    assert not any(op.type == "hier_allreduce"
+                   for op in compiled.program.global_block().ops)
+
+
+def test_batch_pspec_joint_over_dcn_and_dp():
+    mesh = make_mesh(MeshConfig(dcn_dp=2, dp=4))
+    spec = partition_spec(mesh, (("dcn_dp", "dp"),), (16, FEAT))
+    assert tuple(spec)[0] == ("dcn_dp", "dp")
+
+
+# ---------------------------------------------------------------------------
+# hierarchical vs flat A/B: numerics + ledger decomposition
+
+
+def test_hier_matches_flat_allclose():
+    loss_h, w_h, _ = _run_variant(True)
+    loss_f, w_f, _ = _run_variant(False)
+    assert np.allclose(loss_h, loss_f, rtol=1e-5, atol=1e-6)
+    assert sorted(w_h) == sorted(w_f)
+    for n in w_h:
+        assert np.allclose(w_h[n], w_f[n], rtol=1e-5, atol=1e-6), n
+
+
+def test_ledger_axis_purity_and_per_fabric_split():
+    _, _, led = _run_variant(True)
+    kinds = {k for (k, a) in led.rows}
+    assert {"reduce-scatter", "all-gather", "all-reduce"} <= kinds
+    for (kind, axis), row in led.rows.items():
+        parts = axis.split("+")
+        if "dcn_dp" in parts:
+            # DCN-priced traffic rides the dcn_dp axis ALONE
+            assert axis == "dcn_dp", (kind, axis)
+            assert kind == "all-reduce"
+        if kind in ("reduce-scatter", "all-gather"):
+            # the in-slice halves stay on ICI
+            assert axis == "dp", (kind, axis)
+    by_axis = led.totals()["by_axis"]
+    # the cross-slice payload was scattered by dp first: DCN carries a
+    # small fraction of what the in-slice fabric does
+    assert by_axis["dcn_dp"] < by_axis["dp"]
+    # and strictly beats what the flat all-reduce moves over DCN
+    _, _, led_flat = _run_variant(False)
+    flat_dcn = sum(v for a, v in led_flat.totals()["by_axis"].items()
+                   if "dcn_dp" in a.split("+"))
+    assert by_axis["dcn_dp"] < flat_dcn
+
+
+def test_assert_hier_decomposition_accepts_hier_ledger():
+    from paddle_tpu.observability.comms import assert_hier_decomposition
+    _, _, led = _run_variant(True)
+    mesh = make_mesh(MeshConfig(dcn_dp=2, dp=4))
+    out = assert_hier_decomposition(led, mesh, dcn_axes=("dcn_dp",))
+    assert out is led
+
+
+def test_assert_hier_decomposition_rejects_flat_ledger():
+    from paddle_tpu.observability.comms import assert_hier_decomposition
+    _, _, led = _run_variant(False)
+    mesh = make_mesh(MeshConfig(dcn_dp=2, dp=4))
+    with pytest.raises(HierarchicalCommsError) as ei:
+        assert_hier_decomposition(led, mesh, dcn_axes=("dcn_dp",))
+    assert "non-DCN axes" in str(ei.value)
+    assert ei.value.violations
+
+
+def test_assert_hier_decomposition_rejects_missing_sync():
+    from paddle_tpu.observability.comms import (CommLedger,
+                                                assert_hier_decomposition)
+    led = CommLedger([{"kind": "all-reduce", "axis": "dp",
+                       "payload_bytes": 1024, "wire_bytes": 1536,
+                       "group_size": 4}])
+    mesh = make_mesh(MeshConfig(dcn_dp=2, dp=4))
+    with pytest.raises(HierarchicalCommsError) as ei:
+        assert_hier_decomposition(led, mesh, dcn_axes=("dcn_dp",))
+    assert "hier_grad_sync" in str(ei.value)
+
+
+def test_unknown_dcn_axis_records_flight_event():
+    from paddle_tpu.observability.recorder import flight_recorder
+    rec = flight_recorder()
+    rec.clear()
+    with _flags(comms_dcn_axes="dcn_dp,bogus_axis", shard_audit=True,
+                comms_ledger=True):
+        parts = _build()          # fresh program -> fresh compile + audit
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(parts["startup"])
+            exe.run_steps(parts["compiled"], feed=_slabs(n=1)[0],
+                          fetch_list=[LOSS])
+    evs = [e for e in rec.snapshot()
+           if e["kind"] == "comms_dcn_axis_unknown"]
+    assert evs and "bogus_axis" in evs[-1]["axes"]
+    assert "dcn_dp" not in evs[-1]["axes"]
+
+
+def test_single_step_run_on_dcn_mesh_warns_flat_path():
+    """Executor.run (single-step) lowers flat-GSPMD: hier_allreduce
+    collapses to identity and the grad sync comes back as one
+    all-reduce@dcn_dp+dp. With FLAGS_dcn_hierarchical on that's a
+    silently-flat DCN profile, so the compile-miss path must flight-record
+    it — once per executable, not per step; run_steps stays quiet."""
+    from paddle_tpu.observability.recorder import flight_recorder
+    rec = flight_recorder()
+    rec.clear()
+    parts = _build()
+    slab = _slabs(n=1)[0]
+    step = {"x": slab["x"][0], "y": slab["y"][0]}
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(parts["startup"])
+        for _ in range(3):
+            exe.run(parts["compiled"], feed=step, fetch_list=[LOSS])
+    evs = [e for e in rec.snapshot()
+           if e["kind"] == "hier_single_step_flat"]
+    assert len(evs) == 1, evs
+    assert "run_steps" in evs[0]["hint"]
+    rec.clear()
+    parts = _build(seed=11)      # fresh program -> fresh compile
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(parts["startup"])
+        exe.run_steps(parts["compiled"], feed=slab, fetch_list=[LOSS])
+    assert not [e for e in rec.snapshot()
+                if e["kind"] == "hier_single_step_flat"]
+
+
+# ---------------------------------------------------------------------------
+# SliceSupervisor: heartbeat hysteresis, shrink/regrow, chaos
+
+
+# the bitwise test needs the elastic run's FINAL scope; SliceSupervisor
+# rebuilds executor/scope on every membership change, so the build
+# callback parks the most recent one here
+_last_scope = [None]
+
+
+def _slice_build(width):
+    parts = _build(width)
+    _last_scope[0] = fluid.Scope()
+    return {"executor": fluid.Executor(), "program": parts["compiled"],
+            "startup_program": parts["startup"], "scope": _last_scope[0]}
+
+
+def _drill(tmp_path, n_slabs, beat1_when, cooldown_s=0.0, **kw):
+    """Run a SliceSupervisor drill with a fake clock advancing 1s per
+    slab; slice 0 always beats, slice 1 beats when beat1_when(slab_idx).
+    Returns (result, widths-per-slab, per-slab losses)."""
+    t = [0.0]
+    sup_box = []
+    widths, losses = [], []
+
+    def on_slab_end(slab_idx, step, fetches):
+        t[0] += 1.0
+        widths.append(sup_box[0].width)
+        losses.append(np.asarray(fetches[0]))
+        sup_box[0].beat(0, now=t[0])
+        if beat1_when(slab_idx):
+            sup_box[0].beat(1, now=t[0])
+
+    sup = SliceSupervisor(_slice_build, str(tmp_path), slices=2,
+                          heartbeat_timeout_s=1.5, window=2,
+                          cooldown_s=cooldown_s, clock=lambda: t[0],
+                          steps_per_run=2, checkpoint_every_n_slabs=1,
+                          on_slab_end=on_slab_end, **kw)
+    sup_box.append(sup)
+    res = sup.run_slabs(_slabs(n=n_slabs), fetch_list=[LOSS])
+    return res, widths, losses
+
+
+def test_slice_loss_shrinks_width(tmp_path):
+    res, widths, _ = _drill(tmp_path, 8, lambda i: i < 2)
+    assert res["dcn_dp"] == 1
+    assert [e["event"] for e in res["slice_events"]] == ["slice_lost"]
+    ev = res["slice_events"][0]
+    assert ev["slice"] == 1 and ev["dcn_dp"] == 1
+    assert ev["recovery_s"] > 0
+    assert res["slabs"] == 8 and res["restarts"] == 0
+    # hysteresis: slice 1's last beat lands at t=1 (slab_idx is 1-based
+    # — it only beats while slab_idx < 2); the staleness window fills at
+    # the 4th slab boundary, so the drain-preempt shrinks width for the
+    # 5th slab onward — never mid-slab
+    assert widths == [2] * 4 + [1] * 4
+
+
+def test_slice_recovery_regrows_width(tmp_path):
+    res, widths, _ = _drill(tmp_path, 10, lambda i: i < 2 or i >= 6)
+    assert res["dcn_dp"] == 2
+    assert [e["event"] for e in res["slice_events"]] == \
+        ["slice_lost", "slice_rejoined"]
+    assert res["slice_events"][1]["dcn_dp"] == 2
+    assert res["slabs"] == 10
+    assert widths[0] == 2 and 1 in widths and widths[-1] == 2
+
+
+def test_cooldown_blocks_immediate_regrow(tmp_path):
+    # with a long cooldown the lost slice stays out even though its
+    # heartbeats return fresh for a full window
+    res, widths, _ = _drill(tmp_path, 10, lambda i: i < 2 or i >= 6,
+                            cooldown_s=1000.0)
+    assert res["dcn_dp"] == 1
+    assert [e["event"] for e in res["slice_events"]] == ["slice_lost"]
+
+
+def test_min_slices_floor_blocks_shrink(tmp_path):
+    res, widths, _ = _drill(tmp_path, 6, lambda i: False, min_slices=2)
+    assert res["dcn_dp"] == 2 and res["slice_events"] == []
+
+
+def test_shrink_resume_bitwise_vs_never_failed_narrow(tmp_path):
+    """The acceptance drill: a mid-run slice loss resumes at dcn_dp=1
+    bitwise-identical to a control that checkpoints a healthy wide run
+    at the same boundary and restores it under a plain never-failed
+    narrow supervisor. (A from-scratch narrow run is NOT the yardstick:
+    hierarchical and flat reductions differ in the last ulp.)"""
+    slabs = _slabs(n=8)
+    res, widths, losses = _drill(tmp_path / "elastic", 8,
+                                 lambda i: i < 2)
+    assert res["dcn_dp"] == 1
+    n_pre = sum(1 for w in widths if w == 2)
+    assert 0 < n_pre < 8
+    elastic_w = _weights(_last_scope[0])
+
+    # control leg 1: plain wide supervisor over the same first n_pre
+    # slabs, preempted (healthily) at the same boundary
+    ck = str(tmp_path / "control")
+    parts = _slice_build(2)
+
+    def preempt_cb(slab_idx, step, fetches):
+        if slab_idx == n_pre:        # slab_idx is 1-based
+            train.request_preemption("drill")
+
+    sup_w = train.TrainingSupervisor(
+        parts["executor"], parts["program"], ck,
+        startup_program=parts["startup_program"], scope=parts["scope"],
+        steps_per_run=2, checkpoint_every_n_slabs=1,
+        on_slab_end=preempt_cb)
+    with pytest.raises(train.PreemptedError):
+        sup_w.run_slabs(slabs, fetch_list=[LOSS])
+    train.clear_preemption()
+
+    # control leg 2: restore the width-2 checkpoint at width 1 under a
+    # plain TrainingSupervisor and finish the run
+    narrow = _slice_build(1)
+    ctl_losses = []
+    sup_n = train.TrainingSupervisor(
+        narrow["executor"], narrow["program"], ck,
+        startup_program=narrow["startup_program"],
+        scope=narrow["scope"], steps_per_run=2,
+        checkpoint_every_n_slabs=1,
+        on_slab_end=lambda i, s, f: ctl_losses.append(np.asarray(f[0])))
+    assert sup_n.resume() is not None
+    sup_n.run_slabs(slabs, fetch_list=[LOSS])
+    ctl_w = _weights(narrow["scope"])
+
+    assert sorted(elastic_w) == sorted(ctl_w)
+    for n in elastic_w:
+        assert np.array_equal(elastic_w[n], ctl_w[n]), n
+    post = losses[n_pre:]
+    assert len(post) == len(ctl_losses)
+    for a, b in zip(post, ctl_losses):
+        assert np.array_equal(a, b)
+
+
+def test_restored_width_mismatch_raises_typed():
+    parts = _build(width=2)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(parts["startup"])
+        name = next(n for n in scope.keys() if n.endswith(".w_0"))
+        good = np.asarray(scope.find_var(name))
+        scope.set(name, np.zeros(
+            (good.shape[0] + 1,) + good.shape[1:], dtype=good.dtype))
+        with pytest.raises(SliceWidthError) as ei:
+            validate_restored_widths(scope, parts["main"], width=2)
+    assert ei.value.var == name
+    assert "dcn_dp" in str(ei.value)
+
+
+def test_checkpoints_stamp_dcn_width(tmp_path):
+    res, _, _ = _drill(tmp_path, 8, lambda i: i < 2)
+    assert res["dcn_dp"] == 1
+    states = []
+    for p in sorted(tmp_path.rglob(train.TRAIN_STATE_FILE)):
+        with open(p) as f:
+            states.append(json.load(f))
+    assert states and all("dcn_dp" in st for st in states)
+    assert {st["dcn_dp"] for st in states} <= {1, 2}
+    assert 1 in {st["dcn_dp"] for st in states}
+
+
+def test_heartbeat_chaos_drops_and_delays_beats(fault_points):
+    sup = SliceSupervisor(_slice_build, "/tmp/unused-msb", slices=2,
+                          heartbeat_timeout_s=1.5, window=2)
+    with fault_points.fault_injection("train.slice_heartbeat",
+                                      exc=fault_points.FaultInjected,
+                                      times=1):
+        assert sup.beat(0) is False      # dead slice: beat dropped
+    assert sup.beat(0) is True
+    import time as _t
+    before = _t.monotonic()
+    with fault_points.chaos(["train.slice_heartbeat"], delay=0.05):
+        assert sup.beat(1) is True       # straggler: beat lands late
+    assert sup._beats[1] >= before + 0.05
+
+
+def test_dcn_collective_fault_triggers_shrink(tmp_path, fault_points):
+    """A persistently failing cross-slice collective is a lost slice:
+    the inner restart budget drains, and the supervisor remediates by
+    shrinking to dcn_dp=1 instead of dying."""
+    with fault_points.fault_injection("train.allreduce_dcn",
+                                      exc=ConnectionError, times=-1):
+        sup = SliceSupervisor(_slice_build, str(tmp_path), slices=2,
+                              steps_per_run=2, checkpoint_every_n_slabs=1,
+                              restart_budget=1)
+        res = sup.run_slabs(_slabs(n=3), fetch_list=[LOSS])
+    assert res["dcn_dp"] == 1
+    assert [e["event"] for e in res["slice_events"]] == ["slice_lost"]
+    assert res["slabs"] == 3
+
+
+def test_transient_dcn_fault_absorbed_by_restart(tmp_path, fault_points):
+    with fault_points.fault_injection("train.allreduce_dcn",
+                                      exc=ConnectionError, times=1):
+        sup = SliceSupervisor(_slice_build, str(tmp_path), slices=2,
+                              steps_per_run=2, checkpoint_every_n_slabs=1,
+                              restart_budget=3)
+        res = sup.run_slabs(_slabs(n=3), fetch_list=[LOSS])
+    assert res["dcn_dp"] == 2            # no shrink: one retry absorbed it
+    assert res["slice_events"] == []
+    assert res["restarts"] >= 1
+
+
+def test_recovery_attributed_to_goodput_ledger(tmp_path):
+    from paddle_tpu.observability import render_metrics
+    res, _, _ = _drill(tmp_path, 8, lambda i: i < 2)
+    text = render_metrics()
+    assert 'train_slice_events_total{event="slice_lost"}' in text
+    assert 'train_slices_count{state="active"} 1' in text
+    recov = [ln for ln in text.splitlines()
+             if ln.startswith("train_time_seconds_total")
+             and 'category="recovery"' in ln]
+    assert recov and float(recov[0].rsplit(" ", 1)[1]) > 0

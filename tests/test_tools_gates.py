@@ -434,3 +434,139 @@ def test_timeline_conversion_end_to_end():
         assert any(n.startswith("run/program") for n in names), names
         for e in events:
             assert e["dur"] > 0 and e["ts"] >= 0
+
+
+_RECOVERY_DRILL = r"""
+import os, sys, time, tempfile
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+from paddle_tpu.parallel.compiler import CompiledProgram
+from paddle_tpu.train.slices import SliceSupervisor
+
+
+def build(width):
+    if width == 1:
+        time.sleep(2.0)    # a slow slice rebuild: recovery-heavy run
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.data(name="x", shape=[-1, 4], dtype="float32")
+        y = fluid.data(name="y", shape=[-1, 1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    mesh = make_mesh(MeshConfig(dcn_dp=width, dp=4))
+    compiled = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, mesh=mesh)
+    return {"executor": fluid.Executor(), "program": compiled,
+            "startup_program": startup, "scope": fluid.Scope()}
+
+
+t = [0.0]
+box = []
+
+
+def cb(i, step, fetches):
+    t[0] += 1.0
+    box[0].beat(0, now=t[0])
+    if i < 2:
+        box[0].beat(1, now=t[0])
+
+
+rng = np.random.RandomState(0)
+slabs = [{"x": rng.randn(2, 16, 4).astype(np.float32),
+          "y": rng.randn(2, 16, 1).astype(np.float32)} for _ in range(8)]
+sup = SliceSupervisor(build, tempfile.mkdtemp(), slices=2,
+                      heartbeat_timeout_s=1.5, window=2, cooldown_s=0.0,
+                      clock=lambda: t[0], steps_per_run=2,
+                      checkpoint_every_n_slabs=1, on_slab_end=cb)
+box.append(sup)
+res = sup.run_slabs(slabs)
+assert res["dcn_dp"] == 1 and res["slice_events"], res
+from paddle_tpu.observability import render_metrics
+with open(sys.argv[1], "w") as f:
+    f.write(render_metrics())
+"""
+
+
+def test_train_report_goodput_floor_on_recovery_heavy_run(tmp_path):
+    """tools/train_report.py --assert-goodput-floor as the multi-slice
+    CI gate: a REAL slice-loss drill (subprocess, 8 virtual devices,
+    deliberately slow rebuild) dumps its registry metrics; the report
+    renders the recovery category, passes a sane floor, and exits 1
+    naming ``recovery`` as the worst non-compute category when the
+    floor is set above what a shrink-burdened run can deliver."""
+    script = str(tmp_path / "drill.py")
+    dump = str(tmp_path / "slices.prom")
+    with open(script, "w") as f:
+        f.write(_RECOVERY_DRILL)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, script, dump],
+                       capture_output=True, text=True, cwd=REPO, env=env,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    with open(dump) as f:
+        text = f.read()
+    recov = [ln for ln in text.splitlines()
+             if ln.startswith("train_time_seconds_total")
+             and 'category="recovery"' in ln]
+    assert recov and float(recov[0].rsplit(" ", 1)[1]) >= 2.0
+    assert 'train_slice_events_total{event="slice_lost"}' in text
+    assert 'train_slices_count{state="lost"}' in text
+    ok = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "train_report.py"),
+         "--from", dump, "--assert-goodput-floor", "0.01"],
+        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "recovery" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "train_report.py"),
+         "--from", dump, "--assert-goodput-floor", "0.999"],
+        capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 1, bad.stdout + bad.stderr[-2000:]
+    assert "GOODPUT-FLOOR VIOLATION" in bad.stderr
+    assert "recovery" in bad.stderr   # names the worst category
+
+
+def test_bench_compare_multislice_dcn_keys(tmp_path):
+    """tools/bench_compare.py over the MULTICHIP record's new
+    ``meshes.dcn_dp_dp`` keys: cross-slice (DCN) wire bytes are
+    lower-is-better; a record whose dcn_dp traffic balloons back to
+    flat-all-reduce volume fails the gate by name."""
+    import bench_compare
+
+    def record(dcn_wire, total):
+        return {"ok": True, "n_devices": 8, "meshes": {"dcn_dp_dp": {
+            "loss": 1.85,
+            "ledger": {"totals": {"count": 14, "payload_bytes": total,
+                                  "wire_bytes": total,
+                                  "by_axis": {"dp": total - dcn_wire,
+                                              "dcn_dp": dcn_wire}}}}}}
+
+    p_old = str(tmp_path / "old.json")
+    p_ok = str(tmp_path / "ok.json")
+    p_bad = str(tmp_path / "bad.json")
+    with open(p_old, "w") as f:
+        json.dump(record(588, 4080), f)
+    with open(p_ok, "w") as f:
+        json.dump(record(590, 4100), f)
+    with open(p_bad, "w") as f:
+        # hier decomposition silently lost: DCN carries flat volume
+        json.dump(record(4116, 4116), f)
+    keys = ["--key=-meshes.dcn_dp_dp.ledger.totals.by_axis.dcn_dp",
+            "--key", "meshes.dcn_dp_dp.loss"]
+    assert bench_compare.main(
+        [p_old, p_ok, *keys, "--max-regress-pct", "10"]) == 0
+    assert bench_compare.main(
+        [p_old, p_bad, *keys, "--max-regress-pct", "10"]) == 1
+    regs, _ = bench_compare.compare(
+        record(588, 4080), record(4116, 4116),
+        ["-meshes.dcn_dp_dp.ledger.totals.by_axis.dcn_dp"], 10.0)
+    assert regs and "dcn_dp" in regs[0]
