@@ -746,12 +746,167 @@ def bench_train_loop():
     }
 
 
+_TP_SCALING_PROBE = r"""
+import json, os, sys, time
+if int(sys.argv[1]) > 1:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=%d" % int(sys.argv[1]))
+import numpy as np
+import jax
+import paddle_tpu as fluid
+from paddle_tpu.models import gpt
+from paddle_tpu.models.generation import GPTGenerator
+
+cfg = gpt.GPTConfig(vocab_size=2048, hidden_size=256, num_layers=6,
+                    num_heads=8, ffn_size=1024, max_position=128,
+                    dropout=0.0)
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    gpt.gpt_logits(cfg)
+exe = fluid.Executor()
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+rng = np.random.default_rng(0)
+prompt = [rng.integers(1, cfg.vocab_size, 32).astype(np.int32)]
+new_tokens = 24
+rows = {}
+ref = None
+for tp in (1, int(sys.argv[1])):
+    gen = GPTGenerator(cfg, scope, max_len=96, bucket_min=8, tp=tp)
+    out = gen.generate(prompt, max_new_tokens=new_tokens, paged=True)
+    if ref is None:
+        ref = out[0]
+    else:
+        assert np.array_equal(out[0], ref), \
+            "tp greedy decode diverged from single-chip"
+    reps = 2
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        gen.generate(prompt, max_new_tokens=new_tokens, paged=True)
+    dt = (time.perf_counter() - t0) / reps
+    rows[str(tp)] = {"tokens_per_sec": round(new_tokens / dt, 2),
+                     "ms_per_token": round(dt / new_tokens * 1e3, 3)}
+rows["greedy_parity"] = True
+rows["compile_gate"] = "clean"          # TPCompileGateError would raise
+if str(int(sys.argv[1])) in rows and "1" in rows:
+    rows["speedup_vs_1"] = round(
+        rows[str(int(sys.argv[1]))]["tokens_per_sec"]
+        / rows["1"]["tokens_per_sec"], 2)
+print(json.dumps(rows))
+"""
+
+
+def _bench_serving_tp(tp=2):
+    """Tensor-parallel paged-decode scaling rows, measured in a
+    subprocess (the forced host device count must land before jax
+    initializes; on a real pod the tp axis maps onto actual chips and
+    the forced-count branch is skipped). The tp executables compile
+    through the sharding-audit + comms-ledger gate — a silent GSPMD
+    replication fails the row instead of shipping a fake speedup."""
+    import subprocess
+    import sys
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(_TP_SCALING_PROBE)
+        script = f.name
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, script, str(tp)],
+                         capture_output=True, text=True, cwd=repo,
+                         env=env, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(f"tp scaling probe failed "
+                           f"rc={out.returncode}: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _bench_prefix_prefill():
+    """Cached-prefix prefill latency: the same prompt admitted twice
+    through the chunked-prefill engine path — the repeat adopts the
+    pool's cached prefix blocks and replays ONE token instead of
+    re-prefilling, so its wall should be near zero. Reported per
+    kv dtype row: cold/warm ms, the reused-token count and the pool's
+    prefix-cache hit counters."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.generation import GPTGenerator
+    from paddle_tpu.serving.batching import GenerationRequest
+    from paddle_tpu.serving.engine import GenerationEngine
+
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "gpu", "axon"):
+        cfg = gpt.GPTConfig.base()
+        prompt_len = 512
+    else:
+        cfg = gpt.GPTConfig(vocab_size=2048, hidden_size=256,
+                            num_layers=6, num_heads=8, ffn_size=1024,
+                            max_position=1024, dropout=0.0)
+        prompt_len = 256
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        gpt.gpt_logits(cfg)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    gen = GPTGenerator(cfg, scope, max_len=prompt_len + 32,
+                       bucket_min=8)
+    rng = np.random.default_rng(0)
+    warm_prompt = rng.integers(1, cfg.vocab_size,
+                               prompt_len).astype(np.int32)
+    prompt = rng.integers(1, cfg.vocab_size, prompt_len).astype(np.int32)
+    engine = GenerationEngine(gen, slots=2, paged=True,
+                              prefix_cache=True,
+                              pool_name="bench_prefix")
+
+    def prefill_once(slot, p):
+        req = GenerationRequest(p, max_new_tokens=4)
+        t0 = time.perf_counter()
+        st = engine.start_prefill(req, slot)
+        while not engine.prefill_chunk(st):
+            pass
+        engine.finish_prefill(st)
+        return (time.perf_counter() - t0) * 1e3, st["reused"]
+
+    # compile warmup on a DIFFERENT prompt of the same bucket, run
+    # TWICE: the first compiles the cold full-prefill chunk executable,
+    # the repeat (a full-exact prefix hit) compiles the 1-token replay
+    # chunk — so the timed runs below pay prefill, not XLA compilation
+    prefill_once(0, warm_prompt)
+    prefill_once(0, warm_prompt)
+    engine.release_slot(0)
+    cold_ms, _ = prefill_once(0, prompt)
+    warm_ms, reused = prefill_once(1, prompt)
+    stats = engine.pool.stats()
+    engine.release_slot(0)
+    engine.release_slot(1)
+    return {
+        "prompt_tokens": prompt_len,
+        "cold_ms": round(cold_ms, 2),
+        "warm_ms": round(warm_ms, 2),
+        "warm_over_cold": round(warm_ms / cold_ms, 4),
+        "reused_tokens": int(reused),
+        "prefix_entries": stats["prefix_entries"],
+        "evictable_blocks_after_release": engine.pool.cached_blocks(),
+        "leaked_blocks": engine.pool.blocks_in_use(),
+    }
+
+
 def bench_serving():
     """Serving runtime through the wire protocol: 8 concurrent clients,
     request batch sizes {1, 8, 32} (the BENCHMARKS.md serving entry).
     Reports requests/s, samples/s, request p50/p99 (enqueue->reply) and
     the observed mean device-batch size per request size. A fresh server
-    per request size keeps the stage histograms per-config."""
+    per request size keeps the stage histograms per-config. Pod-scale
+    generation rows ride along: tensor-parallel paged-decode tokens/s
+    scaling (subprocess-forced 2-device mesh on CPU, real chips on an
+    accelerator) and the cached-prefix prefill cold/warm A/B."""
     import tempfile
     import threading
     import paddle_tpu as fluid
@@ -813,6 +968,10 @@ def bench_serving():
         "unit": "samples/sec",
         "vs_baseline": None,          # no published anchor for this path
         "request_batches": per_batch,
+        "generation": {
+            "tp_scaling": _bench_serving_tp(),
+            "prefix_prefill": _bench_prefix_prefill(),
+        },
     }
 
 
@@ -1782,9 +1941,11 @@ def bench_fleet():
     chaos kill — one of three replicas dies mid-generation and the
     p99 inter-token latency (request wall / tokens, the no-streaming
     proxy) is measured THROUGH the kill: typed errors only, traced
-    failover, zero leaked KV blocks fleet-wide. Accelerators run
-    GPT-base; CPU the tiny config (same fleet machinery, sized so the
-    smoke run finishes fast)."""
+    failover, zero leaked KV blocks fleet-wide; (d) prefix-affinity
+    routing — a repeated shared prompt routes back to the replica whose
+    pool block-cached it (router cache-hit ratio, zero leaks with the
+    prefix cache on). Accelerators run GPT-base; CPU the tiny config
+    (same fleet machinery, sized so the smoke run finishes fast)."""
     import threading
     import jax
     import paddle_tpu as fluid
@@ -1990,6 +2151,58 @@ def bench_fleet():
         for r in reps:
             r.stop()
 
+    # (d) prefix-affinity routing: two replicas with the block-granular
+    # prefix cache on; a repeated shared prompt must route back to the
+    # replica whose pool already holds its blocks (router cache-hit
+    # ratio), with zero leaked KV blocks fleet-wide afterwards
+    from paddle_tpu.flags import flag as _flag, set_flags as _set_flags
+    prev_prefix = bool(_flag("kv_prefix_cache"))
+    _set_flags({"FLAGS_kv_prefix_cache": True})
+    reps = [mksrv(f"fleet_aff{i}") for i in range(2)]
+    router = fleet.Router([r.endpoint for r in reps],
+                          probe_interval_s=0.05).start()
+    try:
+        warm(reps)
+        shared = rng.integers(1, cfg.vocab_size,
+                              prompt_len).astype(np.int32)
+        uniques = [rng.integers(1, cfg.vocab_size,
+                                prompt_len).astype(np.int32)
+                   for _ in range(2)]
+        with serving.Client(router.endpoint) as c:
+            ref = c.generate(shared, max_new_tokens=new_tokens)
+            for _ in range(3):
+                out = c.generate(shared, max_new_tokens=new_tokens)
+                assert np.array_equal(out, ref), \
+                    "cached-prefix repeat diverged from the cold run"
+            for u in uniques:
+                c.generate(u, max_new_tokens=new_tokens)
+        st = router.stats()
+        hits, misses = st["router_prefix_hits"], \
+            st["router_prefix_misses"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and any(
+                r.gen_engine.pool.blocks_in_use() for r in reps):
+            time.sleep(0.05)
+        leaked = sum(r.gen_engine.pool.blocks_in_use() for r in reps)
+        assert leaked == 0, "leaked KV blocks with the prefix cache on"
+        assert hits >= 3, (hits, misses)
+        pool_stats = [r.gen_engine.pool.stats() for r in reps]
+        prefix_affinity = {
+            "router_prefix_hits": hits,
+            "router_prefix_misses": misses,
+            "cache_hit_ratio": round(hits / max(hits + misses, 1), 4),
+            "evictable_blocks": sum(r.gen_engine.pool.cached_blocks()
+                                    for r in reps),
+            "prefix_entries": sum(s["prefix_entries"]
+                                  for s in pool_stats),
+            "leaked_kv_blocks": leaked,
+        }
+    finally:
+        router.stop()
+        for r in reps:
+            r.stop()
+        _set_flags({"FLAGS_kv_prefix_cache": prev_prefix})
+
     return {
         "metric": "fleet_3_replica_aggregate_tokens_per_sec",
         "value": scaling["3"]["tokens_per_sec"],
@@ -2001,6 +2214,7 @@ def bench_fleet():
         "scaling": scaling,
         "disaggregated": disagg,
         "chaos_kill": chaos_kill,
+        "prefix_affinity": prefix_affinity,
     }
 
 

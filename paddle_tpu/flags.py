@@ -213,6 +213,25 @@ _DEFS = {
     # pool HBM-equivalent to the dense bank it replaces
     # (slots * ceil(max_len/block_size) + 1)
     "kv_pool_blocks": (0, int, None),
+    # -- pod-scale serving (tp generation, chunked prefill, prefix
+    # cache) --
+    # tensor-parallel generation: compile prefill/decode/logits
+    # executables under a tp=N mesh (Megatron column/row split via
+    # gpt.apply_tp_sharding; pool block arrays sharded on the head
+    # axis), gated at compile time by the sharding audit + a
+    # comms-ledger wire-byte budget. 0/1 = single-chip (the parity
+    # baseline)
+    "serving_tp": (0, int, None),
+    # chunked prefill (Orca/Sarathi continuous scheduling): admission
+    # prefill proceeds in slices of at most this many tokens,
+    # interleaved with decode steps so a long prompt never stalls the
+    # decode bank's token cadence. 0 = monolithic prefill
+    "prefill_chunk_tokens": (0, int, None),
+    # block-granular prefix caching: completed prompts deposit their KV
+    # blocks into a refcounted hash-keyed index; a new prompt sharing a
+    # prefix adopts those blocks (copy-on-write on divergence) and only
+    # prefills the tail. Cold entries evict LRU under pool pressure
+    "kv_prefix_cache": (False, bool, None),
     # -- overload control (resilience.RetryBudget, serving brownout,
     # fleet autoscaler) --
     # process-global retry budget: every initial request deposits this

@@ -243,6 +243,11 @@ def paged_attention(q, k_pool, v_pool, block_tables, pos, k_scale=None,
                          "v_scale for a quantized pool (or neither)")
     if k_pool.dtype == jnp.int8 and k_scale is None:
         raise ValueError("int8 KV pool needs k_scale/v_scale arrays")
+    if impl is None and q.shape[2] != 1:
+        # the Pallas kernel decodes one query per row; chunked prefill
+        # (S>1 queries over the paged pool) reads via the gather
+        # composite, which masks key j against pos[b]+i per query i
+        impl = "xla"
     impl = impl or _auto_impl()
     if impl == "xla":
         return _xla_paged_attention(q, k_pool, v_pool, block_tables, pos,

@@ -795,17 +795,22 @@ def kv_cached_attention(q, k_cache, v_cache, pos, scale=0.0, name=None):
     return out
 
 
-def paged_kv_cache_write(cache, kv, tables, pos, scale=None, name=None):
-    """Append one decode token's ``kv`` [B, H, 1, D] into the
-    block-paged pool ``cache`` [num_blocks, H, block_size, D] at each
-    row's own ``pos`` [B] int32, routed through the per-row block
-    ``tables`` [B, nblk] int32. For an int8 pool pass its ``scale``
+def paged_kv_cache_write(cache, kv, tables, pos, scale=None, limit=None,
+                         name=None):
+    """Append S new ``kv`` vectors [B, H, S, D] into the block-paged
+    pool ``cache`` [num_blocks, H, block_size, D] at each row's own
+    ``pos`` [B] int32, routed through the per-row block ``tables``
+    [B, nblk] int32. Optional ``limit`` [B] int32 marks how many of the
+    S vectors are real per row (chunked prefill's ragged tail; the rest
+    route to the trash block). For an int8 pool pass its ``scale``
     array [num_blocks, H, block_size]; the op quantizes and returns
     ``(updated_pool, updated_scale)``, else just the updated pool."""
     helper = LayerHelper("paged_kv_cache_write", name=name)
     out = helper.create_variable_for_type_inference(dtype=cache.dtype)
     ins = {"Cache": [cache], "KV": [kv], "Tables": [tables],
            "Pos": [pos]}
+    if limit is not None:
+        ins["Limit"] = [limit]
     outs = {"Out": [out]}
     out_scale = None
     if scale is not None:
@@ -827,12 +832,13 @@ def paged_kv_cache_write(cache, kv, tables, pos, scale=None, name=None):
 
 def paged_attention(q, k_cache, v_cache, tables, pos, k_scale=None,
                     v_scale=None, scale=0.0, impl=None, name=None):
-    """Decode attention of one query per row (``q`` [B, H, 1, D]) over
-    the block-paged KV pool ([num_blocks, H, block_size, D], int8 pools
-    with their [num_blocks, H, block_size] scales), gathered through the
-    per-row block ``tables`` and masked by per-row ``pos`` counters —
-    the paged analogue of :func:`kv_cached_attention`. Fused Pallas
-    gather+attend on TPU; ``jnp.take`` reference elsewhere."""
+    """Decode attention of S queries per row (``q`` [B, H, S, D] —
+    S=1 decode, S>1 chunked prefill) over the block-paged KV pool
+    ([num_blocks, H, block_size, D], int8 pools with their
+    [num_blocks, H, block_size] scales), gathered through the per-row
+    block ``tables`` and masked by per-row ``pos`` counters — the paged
+    analogue of :func:`kv_cached_attention`. Fused Pallas gather+attend
+    on TPU for S=1; ``jnp.take`` reference elsewhere and for S>1."""
     helper = LayerHelper("paged_attention", name=name)
     out = helper.create_variable_for_type_inference(dtype=q.dtype)
     ins = {"Q": [q], "K": [k_cache], "V": [v_cache],

@@ -37,6 +37,17 @@ from . import gpt
 _PROG_BUILD_LOCK = threading.Lock()
 
 
+class TPCompileGateError(RuntimeError):
+    """A tensor-parallel generation executable failed its compile-time
+    gate: the sharding audit found a replicated large parameter (GSPMD
+    silently undid the tp annotation — every chip would hold and
+    compute the whole tensor, so tokens/s would NOT scale), or the
+    collective ledger priced the executable's per-step wire bytes past
+    the analytic budget (an inserted reshard is moving cache-sized
+    tensors every token). Failing the COMPILE is the point: a silently
+    replicated serving fleet burns N chips for 1 chip's throughput."""
+
+
 def length_bucket(n, lo=1):
     """Smallest power-of-two >= n (>= lo): bounded padding waste and a
     bounded universe of compiled prefill shapes — the serving batcher's
@@ -99,7 +110,7 @@ class GPTGenerator:
     """
 
     def __init__(self, cfg, scope=None, *, max_len=None, bucket_min=None,
-                 cache=None, stats=None):
+                 cache=None, stats=None, tp=None):
         from ..framework.core import Program, program_guard
         from ..framework.executor import global_scope
 
@@ -114,6 +125,8 @@ class GPTGenerator:
             cache = ExecutableCache()
         self.cache = cache
         self.stats = stats
+        self.tp = int(flag("serving_tp") if tp is None else tp)
+        self.mesh = self._init_tp_mesh() if self.tp > 1 else None
 
         builders = {
             "prefill": lambda: gpt.gpt_prefill(cfg, self.max_len),
@@ -129,6 +142,7 @@ class GPTGenerator:
                 main, startup = Program(), Program()
                 with program_guard(main, startup):
                     outs = build()
+                self._annotate_tp(kind, main)
                 self._progs[kind] = (main, outs)
         self._fns = {}      # kind -> (jitted, device_state)
         self._params = {}   # param name -> device array, shared by kinds
@@ -143,6 +157,107 @@ class GPTGenerator:
         # freezing the gauges for a still-cached executable
         from ..utils.lru import LRUCache
         self._exec_costs = LRUCache(max_entries=256)
+
+    # -- tensor-parallel generation ---------------------------------------
+    def _init_tp_mesh(self):
+        """Build (and install as ambient) the tp mesh every generation
+        executable compiles under — the SAME Megatron column/row scheme
+        training uses (gpt.apply_tp_sharding), so a trained tp
+        checkpoint serves without resharding."""
+        import jax
+        from ..parallel.mesh import MeshConfig, make_mesh, set_mesh
+        ndev = len(jax.devices())
+        if self.tp > ndev:
+            raise ValueError(
+                f"FLAGS_serving_tp={self.tp} exceeds the {ndev} visible "
+                f"device(s)")
+        if self.cfg.num_heads % self.tp:
+            raise ValueError(
+                f"serving_tp={self.tp} must divide num_heads="
+                f"{self.cfg.num_heads} (the KV pool shards on the head "
+                f"axis)")
+        mesh = make_mesh(MeshConfig(tp=self.tp))
+        set_mesh(mesh)
+        return mesh
+
+    def _annotate_tp(self, kind, main):
+        """Annotate a freshly built program's parameters with the tp
+        PartitionSpecs (no-op single-chip, and for the parameterless
+        sampler programs)."""
+        if self.mesh is not None and not kind.startswith("sample"):
+            gpt.apply_tp_sharding(main, self.cfg)
+
+    def apply_pool_sharding(self, pool):
+        """Shard a :class:`serving.kvpool.KVBlockPool`'s device arrays
+        on the head axis of the tp mesh (dim 1 of the
+        ``[num_blocks, H, block_size, D]`` block arrays — the axis
+        ``apply_tp_sharding`` already splits qkv over, so the decode
+        step's cache append/read never crosses chips). No-op without a
+        mesh."""
+        if self.mesh is None:
+            return pool
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..serving.kvpool import pool_feed_names
+        val = NamedSharding(self.mesh, P(None, "tp", None, None))
+        sc = NamedSharding(self.mesh, P(None, "tp", None))
+        pool.array_sharding = {
+            n: (sc if ("pks" in n or "pvs" in n) else val)
+            for n in pool_feed_names(pool.num_layers, pool.quantized)}
+        return pool
+
+    def _tp_wire_budget(self, feed):
+        """Generous analytic per-invocation wire-byte ceiling for a tp
+        generation executable: the Megatron scheme moves ~2 activation
+        all-reduces per layer plus the embedding/logits pair — budget
+        8x that. Cache-sized traffic (a GSPMD reshard gathering the
+        block pool every step) overshoots this by orders of magnitude,
+        which is exactly the regression the gate exists to catch."""
+        cfg = self.cfg
+        t = feed.get("tokens")
+        if t is not None:
+            ntok = int(np.prod(np.shape(t)))
+            rows = int(np.shape(t)[0])
+        elif feed.get("token") is not None:
+            ntok = rows = int(np.shape(feed["token"])[0])
+        else:
+            ntok = rows = 1
+        analytic = (2 * cfg.num_layers + 2) * ntok * cfg.hidden_size * 4 \
+            + 2 * rows * cfg.vocab_size * 4
+        return 8 * analytic
+
+    def _tp_compile_gate(self, kind, compiled, feed):
+        """The compile-time gate of tp generation (sampler programs are
+        parameterless and skip it): the PR-14 sharding audit must find
+        NO replicated large parameter, and the collective ledger's
+        wire-byte total must stay under the analytic budget. Raises
+        :class:`TPCompileGateError` — tokens/s that silently does not
+        scale is a bug, not a degraded mode."""
+        if self.mesh is None or kind.startswith("sample"):
+            return
+        from ..observability.comms import CommLedger
+        from ..observability.sharding import audit_executable
+        main = self._ensure_prog(kind)[0]
+        report = audit_executable(
+            compiled, self.mesh, program=main, feed_names=tuple(feed),
+            threshold_mb=float(flag("shard_audit_replicated_mb")))
+        bad = report.by_code("replicated-large-param")
+        if bad:
+            worst = max(bad, key=lambda f: f.nbytes)
+            raise TPCompileGateError(
+                f"tp={self.tp} generation executable {kind!r} has "
+                f"{len(bad)} replicated large parameter(s) — worst "
+                f"{worst.var} at {worst.nbytes / 2**20:.1f} MiB: "
+                f"{worst.message}")
+        ledger = CommLedger.from_compiled(compiled, self.mesh)
+        wire = int(ledger.totals()["wire_bytes"])
+        budget = self._tp_wire_budget(feed)
+        if wire > budget:
+            raise TPCompileGateError(
+                f"tp={self.tp} generation executable {kind!r} moves "
+                f"{wire} wire bytes per step, over the analytic budget "
+                f"of {budget} — an inserted reshard is shipping "
+                f"cache-scale tensors every token")
 
     # -- compilation ------------------------------------------------------
     def _fetch_names(self, outs):
@@ -163,7 +278,8 @@ class GPTGenerator:
         entry = self._progs.get(kind)
         if entry is not None:
             return entry
-        if not kind.startswith("decode_paged_"):
+        if not (kind.startswith("decode_paged_")
+                or kind.startswith("prefill_chunk_")):
             raise KeyError(f"unknown generation program kind {kind!r}")
         from ..framework.core import Program, program_guard
         kv_dtype = kind.rsplit("_", 1)[1]
@@ -173,8 +289,13 @@ class GPTGenerator:
                 return entry
             main, startup = Program(), Program()
             with program_guard(main, startup):
-                outs = gpt.gpt_decode_step_paged(self.cfg,
-                                                 kv_dtype=kv_dtype)
+                if kind.startswith("decode_paged_"):
+                    outs = gpt.gpt_decode_step_paged(self.cfg,
+                                                     kv_dtype=kv_dtype)
+                else:
+                    outs = gpt.gpt_prefill_chunk_paged(self.cfg,
+                                                       kv_dtype=kv_dtype)
+            self._annotate_tp(kind, main)
             self._progs[kind] = (main, outs)
         return self._progs[kind]
 
@@ -205,6 +326,7 @@ class GPTGenerator:
         # state dict (prefill/decode/logits read the same weights — a
         # per-kind device_put would hold N identical copies in HBM)
         state = {}
+        gblock = main.global_block()
         for n in state_in:
             a = self._params.get(n)
             if a is None:
@@ -214,7 +336,17 @@ class GPTGenerator:
                         f"generation parameter {n!r} is not in the "
                         f"scope — run the startup program or load "
                         f"trained params first")
-                a = jax.device_put(np.asarray(v))
+                if self.mesh is not None:
+                    # placed per the program's tp annotation — each
+                    # chip holds only its shard (qkv columns, ffn
+                    # rows/cols, vocab rows), which is the whole HBM
+                    # and tokens/s win of tp serving
+                    from ..parallel.mesh import sharding_for
+                    a = jax.device_put(np.asarray(v),
+                                       sharding_for(self.mesh,
+                                                    gblock.vars.get(n)))
+                else:
+                    a = jax.device_put(np.asarray(v))
                 self._params[n] = a
             state[n] = a
         self._fns[kind] = (jitted, state)
@@ -262,6 +394,16 @@ class GPTGenerator:
         caches = {n: a for n, a in feed.items() if n.startswith("cache_")}
         rest = {n: a for n, a in feed.items()
                 if not n.startswith("cache_")}
+        if self.mesh is not None:
+            # commit the host-side feeds (tokens, positions, tables)
+            # and the RNG key replicated on the tp mesh so AOT lowering
+            # sees ONE consistent device set next to the sharded
+            # params/pool arrays
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+            rest = {n: jax.device_put(a, rep) for n, a in rest.items()}
+            key = jax.device_put(key, rep)
         compiled = self.cache.get(sig)
         if compiled is None:
             t0 = time.perf_counter()
@@ -284,6 +426,7 @@ class GPTGenerator:
                           program=self._ensure_prog(kind)[0],
                           feed_names=tuple(feed), cost=cost,
                           tag=f"generate_{kind}")
+            self._tp_compile_gate(kind, compiled, feed)
             if self.stats:
                 self.stats.bump("compiles")
                 self.stats.hist["compile"].observe(dt)
@@ -340,6 +483,34 @@ class GPTGenerator:
         try:
             fetches, key = self._invoke(f"decode_paged_{pool.dtype}",
                                         "decode", feed, key)
+        except Exception:
+            pool.drop_device()
+            raise
+        return adopt_decode_fetches(pool, fetches), key
+
+    def _run_prefill_chunk(self, tokens, pos_ids, start_pos, limit,
+                           last_idx, pool, key, rows=None):
+        """One chunk of incremental paged prefill: ingest up to C
+        prompt tokens per row straight into the block pool (donated, in
+        place), attending each query over everything its row already
+        wrote. ``rows`` selects which pool slots' block tables line up
+        with the token rows (None = every slot, in slot order). Logits
+        are only meaningful for rows whose LAST real token is in this
+        chunk (per ``last_idx``) — callers sample only then. On any
+        failure the donated pool arrays are presumed lost, same as the
+        decode step."""
+        from ..serving.kvpool import adopt_decode_fetches
+        feed = dict(pool.arrays())
+        feed["tokens"] = np.asarray(tokens, np.int32)
+        feed["pos_ids"] = np.asarray(pos_ids, np.int32)
+        feed["start_pos"] = np.asarray(start_pos, np.int32)
+        feed["limit"] = np.asarray(limit, np.int32)
+        feed["last_idx"] = np.asarray(last_idx, np.int32)
+        tables = pool.tables if rows is None else pool.tables[list(rows)]
+        feed["block_tables"] = np.ascontiguousarray(tables)
+        try:
+            fetches, key = self._invoke(f"prefill_chunk_{pool.dtype}",
+                                        "prefill", feed, key)
         except Exception:
             pool.drop_device()
             raise
@@ -506,6 +677,7 @@ class GPTGenerator:
                 d_head=cfg.hidden_size // cfg.num_heads,
                 max_seq_len=self.max_len, dtype=kv_dtype,
                 name="offline")
+            self.apply_pool_sharding(pool)
             self._paged_pools[pool_key] = pool
         try:
             for r in range(B):

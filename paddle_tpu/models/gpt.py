@@ -87,8 +87,10 @@ def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
       tables (serving/kvpool.py owns the allocator), appended via
       ``paged_kv_cache_write`` and read by the fused
       ``paged_attention`` kernel. Quantized (int8) pools carry
-      ``k_scale``/``v_scale`` arrays; returns
-      ``(x, new_pk, new_pv[, new_ks, new_vs])``.
+      ``k_scale``/``v_scale`` arrays; an optional ``limit`` [B] int32
+      marks how many of the S tokens are real per row (chunked
+      prefill's ragged tail — past-limit k/v route to the trash
+      block). Returns ``(x, new_pk, new_pv[, new_ks, new_vs])``.
     """
     h = cfg.hidden_size
     n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
@@ -109,17 +111,18 @@ def decoder_layer(cfg, x, idx, is_test, kv_cache=None, pos=None):
         ctx = layers.nn.flash_attention(q, k, v, causal=True)
     elif paged:
         tables = kv_cache["tables"]
+        limit = kv_cache.get("limit")
         k_sc, v_sc = kv_cache.get("k_scale"), kv_cache.get("v_scale")
         if k_sc is not None:
             new_k, new_ks = layers.nn.paged_kv_cache_write(
-                kv_cache["k"], k, tables, pos, scale=k_sc)
+                kv_cache["k"], k, tables, pos, scale=k_sc, limit=limit)
             new_v, new_vs = layers.nn.paged_kv_cache_write(
-                kv_cache["v"], v, tables, pos, scale=v_sc)
+                kv_cache["v"], v, tables, pos, scale=v_sc, limit=limit)
         else:
             new_k = layers.nn.paged_kv_cache_write(
-                kv_cache["k"], k, tables, pos)
+                kv_cache["k"], k, tables, pos, limit=limit)
             new_v = layers.nn.paged_kv_cache_write(
-                kv_cache["v"], v, tables, pos)
+                kv_cache["v"], v, tables, pos, limit=limit)
         ctx = layers.nn.paged_attention(q, new_k, new_v, tables, pos,
                                         k_scale=new_ks, v_scale=new_vs)
     else:
@@ -346,6 +349,84 @@ def gpt_decode_step_paged(cfg, kv_dtype="fp32", batch_size=-1):
         pv_out.append(npv)
     zero = T.fill_constant_batch_size_like(token, [-1], "int32", 0)
     logits = _tied_next_logits(cfg, x, zero)             # S=1: gather at 0
+    from ..serving.kvpool import pool_feed_names
+    cache_names = pool_feed_names(cfg.num_layers, quantized)
+    by_name = {}
+    for i in range(cfg.num_layers):
+        by_name[f"cache_pk_{i}"] = pk_out[i]
+        by_name[f"cache_pv_{i}"] = pv_out[i]
+        if quantized:
+            by_name[f"cache_pks_{i}"] = ks_out[i]
+            by_name[f"cache_pvs_{i}"] = vs_out[i]
+    return {"feed_names": feed_names, "logits": logits,
+            "cache_names": cache_names,
+            "cache_vars": [by_name[n] for n in cache_names]}
+
+
+def gpt_prefill_chunk_paged(cfg, kv_dtype="fp32", batch_size=-1,
+                            chunk_len=-1):
+    """ONE chunk of an incremental PAGED prefill (Orca/Sarathi
+    continuous scheduling): ingest up to C prompt tokens per row
+    directly into the shared block pool, attending each fresh query
+    over everything the row has already written (earlier chunks +
+    earlier tokens of this chunk). Repeated over a prompt's chunks this
+    is the paged analogue of :func:`gpt_prefill`; sized to a decode
+    step it interleaves with the decode bank so a long prompt never
+    stalls token cadence.
+
+    Feeds: tokens [B, C] int32 (zero-padded past each row's limit),
+    pos_ids [B, C] int32 (absolute positions, clipped for padding),
+    start_pos [B] int32 (absolute position of each row's FIRST chunk
+    token), limit [B] int32 (real tokens in this chunk; past-limit k/v
+    route to the trash block), last_idx [B] int32 (chunk index of the
+    last real token — logits are only meaningful on a prompt's final
+    chunk), block_tables [B, nblk] int32, then the pools. Fetches:
+    logits [B, V], then the updated pools in
+    ``serving.kvpool.pool_feed_names`` order (``cache_names``)."""
+    quantized = kv_dtype == "int8"
+    cache_dt = {"fp32": "float32", "bf16": "bfloat16",
+                "int8": "int8"}[kv_dtype]
+    tokens = T.data("tokens", [batch_size, chunk_len], dtype="int32")
+    pos_ids = T.data("pos_ids", [batch_size, chunk_len], dtype="int32")
+    start_pos = T.data("start_pos", [batch_size], dtype="int32")
+    limit = T.data("limit", [batch_size], dtype="int32")
+    last_idx = T.data("last_idx", [batch_size], dtype="int32")
+    tables = T.data("block_tables", [batch_size, -1], dtype="int32")
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pemb = layers.embedding(pos_ids, size=[cfg.max_position,
+                                           cfg.hidden_size],
+                            param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pemb)
+    feed_names = ["tokens", "pos_ids", "start_pos", "limit", "last_idx",
+                  "block_tables"]
+    pk_out, pv_out, ks_out, vs_out = [], [], [], []
+    for i in range(cfg.num_layers):
+        pk = T.data(f"cache_pk_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        pv = T.data(f"cache_pv_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        feed_names += [f"cache_pk_{i}", f"cache_pv_{i}"]
+        kv_cache = {"k": pk, "v": pv, "mode": "paged", "tables": tables,
+                    "limit": limit}
+        if quantized:
+            pks = T.data(f"cache_pks_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            pvs = T.data(f"cache_pvs_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            feed_names += [f"cache_pks_{i}", f"cache_pvs_{i}"]
+            kv_cache["k_scale"], kv_cache["v_scale"] = pks, pvs
+            x, npk, npv, nks, nvs = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=start_pos)
+            ks_out.append(nks)
+            vs_out.append(nvs)
+        else:
+            x, npk, npv = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=start_pos)
+        pk_out.append(npk)
+        pv_out.append(npv)
+    logits = _tied_next_logits(cfg, x, last_idx)
     from ..serving.kvpool import pool_feed_names
     cache_names = pool_feed_names(cfg.num_layers, quantized)
     by_name = {}

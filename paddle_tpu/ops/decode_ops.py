@@ -82,17 +82,23 @@ def kv_cached_attention(ctx, ins, attrs):
 
 @register_op("paged_kv_cache_write", grad=False, infer_shape=False)
 def paged_kv_cache_write(ctx, ins, attrs):
-    """Append one decode token's k/v into a BLOCK-PAGED pool at each
-    row's own position. Cache [N, H, bs, D] (the shared pool), KV
-    [B, H, 1, D], Tables [B, nblk] int32 (per-row block table), Pos [B]
-    int32 -> Out: pool with row b's vector written at
-    ``(Tables[b, Pos[b]//bs], :, Pos[b]%bs)``. With an int8 pool the op
-    quantizes (kernels/paged_attention.quantize_kv) and the optional
-    Scale input [N, H, bs] is updated too (second output OutScale).
+    """Append S new k/v vectors into a BLOCK-PAGED pool at each row's
+    own position. Cache [N, H, bs, D] (the shared pool), KV
+    [B, H, S, D], Tables [B, nblk] int32 (per-row block table), Pos [B]
+    int32 -> Out: pool with row b's vector i written at
+    ``(Tables[b, (Pos[b]+i)//bs], :, (Pos[b]+i)%bs)``. The optional
+    Limit input [B] int32 marks how many of the S vectors are REAL per
+    row (chunked prefill's ragged tail): positions at/past the limit
+    are routed to the reserved trash block 0 instead. With an int8 pool
+    the op quantizes (kernels/paged_attention.quantize_kv) and the
+    optional Scale input [N, H, bs] is updated too (second output
+    OutScale).
 
-    One scatter covers the batch: slots own disjoint blocks, so the
+    One scatter covers the batch: slots own disjoint blocks and COW
+    guarantees a written block has refcount 1, so the valid
     (block, offset) pairs are unique; rows whose table entry is the
-    trash block (free serving slots) write garbage nobody reads.
+    trash block (free serving slots / past-limit padding) write garbage
+    nobody reads.
     """
     from ..kernels.paged_attention import quantize_kv
 
@@ -102,19 +108,50 @@ def paged_kv_cache_write(ctx, ins, attrs):
     pos = x_of(ins, "Pos").astype(jnp.int32)
     bs = pool.shape[2]
     B = kv.shape[0]
+    S = kv.shape[2]
+    limit = ins.get("Limit")
 
-    block_ids = tables[jnp.arange(B), pos // bs]        # [B]
-    offs = pos % bs                                     # [B]
-    vec = kv[:, :, 0, :]                                # [B, H, D]
     outs = {}
+    if S == 1 and not limit:
+        # single-token decode fast path (bitwise-identical to the
+        # original op)
+        block_ids = tables[jnp.arange(B), pos // bs]        # [B]
+        offs = pos % bs                                     # [B]
+        vec = kv[:, :, 0, :]                                # [B, H, D]
+        if pool.dtype == jnp.int8:
+            q, sc = quantize_kv(vec)
+            outs["Out"] = pool.at[block_ids, :, offs, :].set(q)
+            scale = x_of(ins, "Scale")
+            outs["OutScale"] = scale.at[block_ids, :, offs].set(sc)
+        else:
+            outs["Out"] = pool.at[block_ids, :, offs, :].set(
+                vec.astype(pool.dtype))
+        return outs
+
+    # multi-token path: per-(row, token) absolute positions, invalid
+    # (past-limit) entries routed to the trash block. Clip keeps the
+    # table gather in-bounds for padded rows whose pos+S would run past
+    # the row's table; those entries are invalid by construction.
+    steps = jnp.arange(S, dtype=jnp.int32)
+    qpos = pos[:, None] + steps[None, :]                    # [B, S]
+    if limit:
+        valid = steps[None, :] < limit[0].astype(jnp.int32)[:, None]
+    else:
+        valid = jnp.ones((B, S), dtype=bool)
+    safe = jnp.clip(qpos, 0, tables.shape[1] * bs - 1)
+    blk = jnp.take_along_axis(tables, safe // bs, axis=1)   # [B, S]
+    block_ids = jnp.where(valid, blk, 0).reshape(-1)        # [B*S]
+    offs = (safe % bs).reshape(-1)                          # [B*S]
+    vals = kv.transpose(0, 2, 1, 3).reshape(B * S, kv.shape[1],
+                                            kv.shape[3])
     if pool.dtype == jnp.int8:
-        q, sc = quantize_kv(vec)
+        q, sc = quantize_kv(vals)
         outs["Out"] = pool.at[block_ids, :, offs, :].set(q)
         scale = x_of(ins, "Scale")
         outs["OutScale"] = scale.at[block_ids, :, offs].set(sc)
     else:
         outs["Out"] = pool.at[block_ids, :, offs, :].set(
-            vec.astype(pool.dtype))
+            vals.astype(pool.dtype))
     return outs
 
 

@@ -584,6 +584,10 @@ class DecodeBatcher:
         self._admitting = 0     # popped from the queue, not yet in a slot
         self._admitting_reqs = []
         self._steps_since_sweep = 0             # paged-pool leak sweep
+        # chunked-prefill states (engine.start_prefill dicts): rows
+        # whose prompt is being ingested one chunk per decode round —
+        # they hold a slot but are not yet in _active
+        self._prefilling = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -599,8 +603,10 @@ class DecodeBatcher:
     def inflight(self):
         """Rows being decoded PLUS requests mid-admission (popped from
         the queue but not yet in a slot — prefill compile can hold them
-        there for seconds; drain() polls this to zero)."""
-        return len(self._active) + self._admitting
+        there for seconds; drain() polls this to zero) PLUS rows mid
+        chunked-prefill (slot held, prompt still ingesting)."""
+        return len(self._active) + self._admitting \
+            + len(self._prefilling)
 
     def stop(self, timeout=5):
         self._stop.set()
@@ -622,6 +628,13 @@ class DecodeBatcher:
             if release is not None:
                 release(slot)
         self._active.clear()
+        for st in self._prefilling:
+            if not st["req"].done():
+                st["req"].set_error(ServerShutdownError(
+                    "server stopped while the request was prefilling"))
+            if release is not None:
+                release(st["slot"])
+        self._prefilling = []
 
     def restart(self, reason="supervisor restart"):
         """Replace a dead/hung loop thread: depose the old thread (epoch
@@ -636,7 +649,13 @@ class DecodeBatcher:
                 req.set_error(err)
                 if self.stats:
                     self.stats.bump("requests_failed")
+        for st in self._prefilling:
+            if not st["req"].done():
+                st["req"].set_error(err)
+                if self.stats:
+                    self.stats.bump("requests_failed")
         self._active.clear()
+        self._prefilling = []
         self._free = list(range(self.slots))
         self._admitting = 0
         self.engine.reset()
@@ -719,6 +738,23 @@ class DecodeBatcher:
                     f"exceeded after {waited:.1f}ms with "
                     f"{len(req.out_tokens)} tokens generated",
                     deadline_ms=req.deadline_ms, waited_ms=waited))
+        still = []
+        for st in self._prefilling:
+            req = st["req"]
+            if not (req.done() or req.expired(now)):
+                still.append(st)
+                continue
+            if not req.done():
+                waited = (now - req.t_enqueue) * 1e3
+                if self.stats:
+                    self.stats.bump("shed_deadline")
+                req.set_error(DeadlineExceededError(
+                    f"deadline of {req.deadline_ms:.1f}ms exceeded "
+                    f"after {waited:.1f}ms mid chunked prefill",
+                    deadline_ms=req.deadline_ms, waited_ms=waited))
+            self.engine.release_slot(st["slot"])
+            self._free.append(st["slot"])
+        self._prefilling[:] = still
 
     # -- admission --------------------------------------------------------
     def _admit(self, epoch=None):
@@ -806,6 +842,27 @@ class DecodeBatcher:
         # imports
         fresh = [r for r in take if getattr(r, "kv", None) is None]
         imported = [r for r in take if getattr(r, "kv", None) is not None]
+        inc = getattr(self.engine, "incremental_prefill_enabled", None)
+        if fresh and inc is not None and inc():
+            # chunked-prefill admission (Orca/Sarathi): each prompt
+            # claims a slot now but ingests one chunk per decode round,
+            # interleaved with the bank's steps — a 2048-token prompt
+            # no longer freezes every active row's token cadence for a
+            # monolithic prefill
+            for req in fresh:
+                slot = self._free.pop()
+                try:
+                    st = self.engine.start_prefill(req, slot)
+                except Exception as exc:  # noqa: BLE001 — typed
+                    self._free.append(slot)
+                    if not req.done():
+                        req.set_error(exc)
+                    if self.stats:
+                        self.stats.bump("requests_failed")
+                    continue
+                req.slot = slot
+                self._prefilling.append(st)
+            fresh = []
         admit_imported = getattr(self.engine, "admit_imported", None)
         if imported and admit_imported is None:
             for req in imported:
@@ -877,6 +934,61 @@ class DecodeBatcher:
                     "the request's prefill was discarded"))
                 if self.stats:
                     self.stats.bump("requests_failed")
+
+    def _advance_prefill(self, epoch):
+        """Advance the OLDEST chunked prefill by one chunk this decode
+        round (round-robin via the list's pop/append) — prompt
+        ingestion shares the loop with decode steps instead of stalling
+        them. A finished prompt samples its first token and joins the
+        decode bank exactly as a monolithic admit would (export_kv rows
+        deliver their KV payload instead)."""
+        if not self._prefilling:
+            return
+        st = self._prefilling.pop(0)
+        req, slot = st["req"], st["slot"]
+        if req.done():                  # abandoned mid-prefill
+            self.engine.release_slot(slot)
+            self._free.append(slot)
+            return
+        try:
+            done = self.engine.prefill_chunk(st)
+            tok = self.engine.finish_prefill(st) if done else None
+        except Exception as exc:  # noqa: BLE001 — reach the client
+            if self._epoch != epoch:
+                return       # deposed: restart() owns the row state
+            self.engine.release_slot(slot)
+            self._free.append(slot)
+            if not req.done():
+                req.set_error(exc)
+            if isinstance(exc, ServerOverloadedError):
+                # pool pressure mid-prefill: typed shed, same
+                # bookkeeping as the admission-time shed
+                if self.stats:
+                    self.stats.bump("shed_overload")
+                return
+            self.consecutive_failures += 1
+            if self.stats:
+                self.stats.bump("engine_failures")
+                self.stats.bump("requests_failed")
+            self._fail_active_if_bank_lost(exc)
+            return
+        if self._epoch != epoch:
+            return
+        if not done:
+            self._prefilling.append(st)
+            return
+        if self.stats:
+            self.stats.bump("generate_requests")
+        if getattr(req, "export_kv", False):
+            self._finish_export(req, slot, int(tok))
+            return
+        req.slot = slot
+        self._active[slot] = req
+        self._pos[slot] = req.prompt.size
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._tok[slot] = tok
+        self._deliver_token(req, int(tok))
 
     def _finish_export(self, req, slot, tok):
         """Deliver a prefill-only request (disaggregated split): the
@@ -956,8 +1068,9 @@ class DecodeBatcher:
                 sw = self._swap
                 if sw is not None:
                     # a pending swap stops admission so the bank drains;
-                    # in-flight rows keep decoding on the old weights
-                    if not self._active:
+                    # in-flight rows (decoding OR mid chunked-prefill)
+                    # keep running on the old weights
+                    if not self._active and not self._prefilling:
                         sw.apply()
                         with self._swap_lock:
                             if self._swap is sw:
@@ -965,9 +1078,12 @@ class DecodeBatcher:
                         continue
                 else:
                     self._admit(epoch)
-                if not self._active:
+                if not self._active and not self._prefilling:
                     continue
                 self._check_deadlines(time.monotonic())
+                self._advance_prefill(epoch)
+                if self._epoch != epoch:
+                    return
                 if not self._active:
                     continue
                 # paged pool: allocation-on-append for the live rows;
@@ -1052,7 +1168,8 @@ class DecodeBatcher:
                     self._steps_since_sweep = 0
                     sweep = getattr(self.engine, "reclaim_leaks", None)
                     if sweep is not None:
-                        sweep(list(self._active))
+                        sweep(list(self._active)
+                              + [st["slot"] for st in self._prefilling])
         finally:
             # rows still mid-generation when the loop exits (stop() or
             # a crash) must fail fast, not leave their clients waiting.
@@ -1069,6 +1186,14 @@ class DecodeBatcher:
                     if release is not None:
                         release(slot)
                 self._active.clear()
+                for st in self._prefilling:
+                    if not st["req"].done():
+                        st["req"].set_error(ServerShutdownError(
+                            "server stopped while the request was "
+                            "prefilling"))
+                    if release is not None:
+                        release(st["slot"])
+                self._prefilling = []
                 with self._swap_lock:
                     sw, self._swap = self._swap, None
                 if sw is not None:

@@ -442,7 +442,8 @@ class GenerationEngine:
 
     def __init__(self, generator, *, slots=None, stats=None, seed=0,
                  paged=None, kv_dtype=None, kv_block_size=None,
-                 kv_pool_blocks=None, pool_name="serving"):
+                 kv_pool_blocks=None, pool_name="serving",
+                 prefix_cache=None):
         import jax
         self.gen = generator
         self.slots = int(slots or flag("decode_slots"))
@@ -453,7 +454,9 @@ class GenerationEngine:
         # slots * max_len. None/False keeps the dense bank (the parity
         # baseline). ``pool_name`` labels the pool's kvpool_* gauge
         # series — fleet replicas sharing one process must not clobber
-        # each other's occupancy.
+        # each other's occupancy. ``prefix_cache`` (None ->
+        # FLAGS_kv_prefix_cache) turns on block-granular prompt-prefix
+        # reuse across requests.
         self.paged = bool(flag("kv_paged") if paged is None else paged)
         self.pool = None
         if self.paged:
@@ -465,7 +468,12 @@ class GenerationEngine:
                 d_head=cfg.hidden_size // cfg.num_heads,
                 max_seq_len=generator.max_len,
                 block_size=kv_block_size, num_blocks=kv_pool_blocks,
-                dtype=kv_dtype, name=pool_name)
+                dtype=kv_dtype, name=pool_name,
+                prefix_cache=prefix_cache)
+            if getattr(generator, "mesh", None) is not None:
+                # tensor-parallel serving: the pool's block arrays live
+                # sharded on the head axis of the generator's tp mesh
+                generator.apply_pool_sharding(self.pool)
         # a generator WITHOUT its own sink adopts the server's (stage
         # histograms land in server.stats()), and a sink a PREVIOUS
         # engine bound is rebound to the live server (else a reused
@@ -587,6 +595,11 @@ class GenerationEngine:
         for slot, p in active_pos.items():
             try:
                 self.pool.ensure(slot, int(p))
+                if self.pool.prefix_enabled:
+                    # COW barrier: the block this token lands in may be
+                    # co-owned by the prefix cache (or another slot
+                    # that adopted it) — duplicate before writing
+                    self.pool.prepare_write(slot, int(p), int(p) + 1)
             except Exception as exc:  # noqa: BLE001 — per-row shed
                 shed[slot] = exc
         return shed
@@ -670,12 +683,117 @@ class GenerationEngine:
                 raise
         else:
             self._insert(row_caches, list(slot_ids))
+        if self.pool is not None and self.pool.prefix_enabled:
+            # deposit the freshly prefilled prompt blocks into the
+            # prefix index (refcounted co-ownership — they outlive the
+            # slot's EOS until evicted LRU); later requests sharing the
+            # prompt prefix adopt them instead of recomputing
+            for req, slot in zip(requests, slot_ids):
+                self.pool.prefix_insert(req.prompt, slot)
         out = np.asarray(toks)[:n]
         t1 = time.perf_counter()
         for req in requests:
             if getattr(req, "trace", None) is not None:
                 _trace.record_child("serving/prefill", t0, t1, req.trace)
         return out
+
+    # -- chunked (incremental) prefill ------------------------------------
+    def incremental_prefill_enabled(self):
+        """Chunked prompt ingestion (Orca/Sarathi-style): on when the
+        paged pool exists AND either ``FLAGS_prefill_chunk_tokens``
+        bounds the per-round prompt slice (long prompts stop stalling
+        the decode bank's token cadence) or the prefix cache is on (the
+        incremental path is what turns a cached-prefix hit into skipped
+        prefill compute)."""
+        return self.pool is not None and (
+            int(flag("prefill_chunk_tokens")) > 0
+            or self.pool.prefix_enabled)
+
+    def start_prefill(self, req, slot):
+        """Begin incremental prefill of ``req`` into ``slot``: reclaim
+        the stale holder, adopt the longest cached prompt prefix (block
+        references only — no compute), and return the prefill state the
+        batcher advances one :meth:`prefill_chunk` per decode round. A
+        FULL exact-prompt hit still replays the final token as a
+        1-token chunk (COWing the shared tail block): that chunk's
+        logits ARE the first-token distribution, so a repeat prompt
+        pays one token of prefill instead of the whole prompt."""
+        self._ensure_caches()
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        L = int(prompt.size)
+        self.pool.free_slot(slot)       # stale holder (if any)
+        reused = 0
+        if self.pool.prefix_enabled:
+            m = self.pool.match_prefix(prompt)
+            if m is not None:
+                self.pool.adopt_prefix(slot, m)
+                reused = int(m["tokens"])
+        return {"req": req, "slot": int(slot), "prompt": prompt,
+                "next": min(reused, L - 1), "reused": reused,
+                "chunk": int(flag("prefill_chunk_tokens")),
+                "first_logits": None, "t0": time.perf_counter()}
+
+    def prefill_chunk(self, state):
+        """Ingest ONE chunk of ``state``'s prompt into its slot's
+        blocks (at most the chunk budget; everything left when only the
+        prefix cache turned the incremental path on). Typed pool
+        pressure (alloc/COW) raises BEFORE any device call — the slot's
+        accounting is intact and the batcher sheds just this row; a
+        failure of the chunk executable itself loses the donated pool
+        arrays, so the slot is released and ``bank_lost`` set, exactly
+        like a failed monolithic scatter. Returns True when the prompt
+        is fully ingested (sample via :meth:`finish_prefill`)."""
+        slot, prompt = state["slot"], state["prompt"]
+        L = int(prompt.size)
+        s = int(state["next"])
+        take = min(state["chunk"] or (L - s), L - s)
+        # fixed chunk width under a budget, bucketed width otherwise —
+        # either way a bounded universe of compiled chunk shapes
+        C = state["chunk"] or min(
+            next_bucket(take, min_bucket=self.gen.bucket_min),
+            self.max_len)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :take] = prompt[s:s + take]
+        pos_ids = np.clip(np.arange(s, s + C, dtype=np.int32),
+                          0, L - 1)[None, :]
+        self.pool.alloc(slot, s + take)
+        if self.pool.prefix_enabled:
+            self.pool.prepare_write(slot, s, s + take)
+        try:
+            logits, self._key = self.gen._run_prefill_chunk(
+                toks, pos_ids, np.array([s], np.int32),
+                np.array([take], np.int32),
+                np.array([take - 1], np.int32), self.pool, self._key,
+                rows=[slot])
+        except Exception:
+            # the donated device pool is lost; this row's blocks go
+            # back, the batcher fails the other active rows via
+            # bank_lost
+            self.pool.free_slot(slot)
+            self.bank_lost = True
+            raise
+        state["next"] = s + take
+        if state["next"] >= L:
+            state["first_logits"] = np.asarray(logits)[:1]
+            return True
+        return False
+
+    def finish_prefill(self, state):
+        """Sample the first token from the final chunk's logits, deposit
+        the now-complete prompt blocks into the prefix index, and return
+        the token (int). The per-request analogue of :meth:`admit`'s
+        tail."""
+        req, slot = state["req"], state["slot"]
+        temp = np.array([req.temperature], np.float32)
+        topk = np.array([req.top_k], np.int32)
+        toks, self._key = self.gen._run_sample(
+            state["first_logits"], temp, topk, self._key)
+        if self.pool.prefix_enabled:
+            self.pool.prefix_insert(state["prompt"], slot)
+        if getattr(req, "trace", None) is not None:
+            _trace.record_child("serving/prefill_chunked", state["t0"],
+                                time.perf_counter(), req.trace)
+        return int(np.asarray(toks)[0])
 
     # -- disaggregated prefill/decode (KV-block migration) ----------------
     def export_slot(self, slot):

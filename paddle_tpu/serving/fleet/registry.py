@@ -139,6 +139,8 @@ class Replica:
             "decode_queue_depth": h.get("decode_queue_depth", 0),
             "decode_active_rows": h.get("decode_active_rows", 0),
             "kvpool_occupancy": h.get("kvpool_occupancy", 0.0),
+            "kvpool_evictable_blocks": h.get("kvpool_evictable_blocks",
+                                             0),
             "slo_breached": h.get("slo_breached", 0),
             "brownout_level": h.get("brownout_level", 0),
             "queue_capacity": h.get("queue_capacity", 0),
@@ -228,10 +230,21 @@ class ReplicaRegistry:
             return {ep: r.snapshot() for ep, r in self._reps.items()}
 
     # -- dispatch support -------------------------------------------------
-    def pick(self, roles, exclude=()):
+    # how much extra load_score the affinity hint may tolerate over
+    # the least-loaded candidate before it yields: a warm prefix saves
+    # ONE prefill, so it beats a marginally shorter queue but must
+    # never pin a hot-prompt stream onto a congested replica while the
+    # rest of the fleet idles
+    PREFER_SLACK = 4.0
+
+    def pick(self, roles, exclude=(), prefer=None):
         """The least-loaded in-rotation replica whose role is in
         ``roles`` (endpoints in ``exclude`` skipped); None when the
-        rotation is empty."""
+        rotation is empty. ``prefer`` (the router's cache-affinity
+        hint) wins over the load-score scan only while its load stays
+        within ``PREFER_SLACK`` of the best candidate — a hint, never
+        a constraint: an affine replica that is excluded, out of
+        rotation, wrong-role or clearly more loaded falls through."""
         exclude = set(exclude)
         with self._lock:
             cands = [r for r in self._reps.values()
@@ -239,8 +252,15 @@ class ReplicaRegistry:
                      and r.dispatchable()]
             if not cands:
                 return None
-            return min(cands, key=lambda r: (r.load_score(),
+            best = min(cands, key=lambda r: (r.load_score(),
                                              r.endpoint))
+            if prefer is not None:
+                r = self._reps.get(str(prefer))
+                if r is not None and r in cands and \
+                        r.load_score() <= best.load_score() \
+                        + self.PREFER_SLACK:
+                    return r
+            return best
 
     def checkout(self, rep):
         with self._lock:

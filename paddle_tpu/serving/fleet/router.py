@@ -54,7 +54,7 @@ from ...observability.recorder import flight_recorder as _flightrec
 from ...resilience import default_retry_budget, maybe_fail
 from ..batching import (DeadlineExceededError, ServerOverloadedError,
                         priority_rank, remaining_budget_ms)
-from ..kvpool import KVBlockPool
+from ..kvpool import KVBlockPool, prompt_prefix_key
 from ..server import _ETYPES, _error_reply
 from .registry import ReplicaRegistry
 
@@ -87,12 +87,28 @@ _FLEET_SCRAPE_FAILS = default_registry().counter(
     "router_fleet_scrape_failures_total",
     "replica metric scrapes that failed during fleet-wide aggregation",
     labels=("router",), max_series=8)
+_PREFIX_HITS = default_registry().counter(
+    "router_prefix_hits_total",
+    "routed generates dispatched to the replica whose KV pool cached "
+    "this prompt's prefix (cache-affinity hit)",
+    labels=("router",), max_series=8)
+_PREFIX_MISSES = default_registry().counter(
+    "router_prefix_misses_total",
+    "routed generates whose affine replica was unknown or out of "
+    "rotation — dispatched by load score instead",
+    labels=("router",), max_series=8)
 
 _COUNTERS = ("dispatches", "failovers", "hedges", "hedge_wins",
              "dedup_hits", "kv_migrations", "kv_migrated_bytes",
              "rolling_reloads", "no_replica_refusals",
              "fleet_scrape_failures", "hedges_suppressed",
-             "failovers_suppressed", "deadline_expired_in_router")
+             "failovers_suppressed", "deadline_expired_in_router",
+             "prefix_hits", "prefix_misses")
+
+# prompt tokens hashed into the affinity key: enough to separate real
+# prompt families, short enough that shared system-prompt prefixes
+# (the case block-granular caching wins on) collide INTO affinity
+_PREFIX_AFFINITY_WINDOW = 32
 
 # flight-recorder event kinds the fleet emits (Router.stats surfaces
 # their in-ring counts; the debug_dump wire op returns the events)
@@ -270,6 +286,14 @@ class Router:
         self._rids = OrderedDict()
         self._rids_lock = threading.Lock()
         self._rid_cap = 2048
+        # prefix-affinity map: prompt-prefix content hash -> the
+        # replica that last served (and so block-cached) that prefix.
+        # LRU-capped; stale entries cost one miss, never a wrong answer
+        # (the preferred replica still has to be in rotation, and a
+        # cold pool just re-prefills)
+        self._affinity = OrderedDict()
+        self._affinity_lock = threading.Lock()
+        self._affinity_cap = 4096
         self._c = {k: 0 for k in _COUNTERS}
         self._c_lock = threading.Lock()
 
@@ -297,6 +321,11 @@ class Router:
 
     def remove_replica(self, endpoint):
         self._drop_pool(endpoint)
+        with self._affinity_lock:
+            stale = [k for k, ep in self._affinity.items()
+                     if ep == endpoint]
+            for k in stale:
+                del self._affinity[k]
         return self.registry.remove(endpoint)
 
     def start(self, serve_network=True):
@@ -385,6 +414,7 @@ class Router:
             "replicas": self.registry.snapshot(),
             "replicas_healthy": self.registry.healthy_count(),
             "rid_table": len(self._rids),
+            "affinity_table": len(self._affinity),
             "fleet_events": {k: rec_counts.get(k, 0)
                              for k in FLEET_EVENT_KINDS},
         }
@@ -515,9 +545,47 @@ class Router:
             return reply
         raise AssertionError("unreachable")
 
+    # -- prefix affinity --------------------------------------------------
+    def _affinity_key(self, tokens):
+        """The fleet-wide prefix address of a prompt: the same content
+        hash the replica pools key their block index by, over the first
+        ``_PREFIX_AFFINITY_WINDOW`` tokens. None (affinity disabled)
+        while ``FLAGS_kv_prefix_cache`` is off — with no replica-side
+        cache a sticky route buys nothing and only fights the
+        load-score balancer."""
+        if not flag("kv_prefix_cache"):
+            return None
+        try:
+            a = np.asarray(tokens, np.int32).ravel()
+        except (TypeError, ValueError):
+            return None
+        if a.size == 0:
+            return None
+        return prompt_prefix_key(a, min(a.size,
+                                        _PREFIX_AFFINITY_WINDOW))
+
+    def _affinity_lookup(self, key):
+        if key is None:
+            return None
+        with self._affinity_lock:
+            ep = self._affinity.get(key)
+            if ep is not None:
+                self._affinity.move_to_end(key)
+            return ep
+
+    def _affinity_record(self, key, endpoint):
+        if key is None or endpoint is None:
+            return
+        with self._affinity_lock:
+            self._affinity[key] = endpoint
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self._affinity_cap:
+                self._affinity.popitem(last=False)
+
     # -- dispatch ---------------------------------------------------------
     def _dispatch(self, msg, roles, timeout, entry=None,
-                  role_label="both", exclude=(), budget=None):
+                  role_label="both", exclude=(), budget=None,
+                  prefer=None):
         """Dispatch ``msg`` to the least-loaded replica of ``roles``;
         fail over (same rid) on transport death or a typed
         Overloaded/Shutdown refusal, up to
@@ -531,7 +599,12 @@ class Router:
         expiry without touching a replica. Failover attempts past the
         first withdraw from the process retry budget — when the fleet
         is saturated the rotation walk itself must not multiply load
-        (typed Overloaded shed instead)."""
+        (typed Overloaded shed instead).
+
+        ``prefer`` (cache-affinity) is an endpoint to try FIRST when it
+        is in rotation — a hint, never a constraint: an out-of-rotation
+        or refusing affine replica falls back to the load-score pick on
+        the very next attempt."""
         tried = set(exclude)
         last_refusal = None
         for attempt in range(self._dispatch_retries + 1):
@@ -550,7 +623,8 @@ class Router:
                         f"forwarded", deadline_ms=float(budget[0]))), \
                         None
                 msg["deadline_ms"] = rem
-            rep = self.registry.pick(roles, exclude=tried)
+            rep = self.registry.pick(roles, exclude=tried,
+                                     prefer=prefer)
             if rep is None:
                 break
             if attempt > 0 and not default_retry_budget().try_acquire(
@@ -603,7 +677,7 @@ class Router:
             f"retry")), None
 
     def _dispatch_hedged(self, msg, roles, timeout, entry,
-                         role_label="both", budget=None):
+                         role_label="both", budget=None, prefer=None):
         """Race the primary dispatch against a delayed twin on ANOTHER
         replica (``FLAGS_router_hedge_ms``; 0 = plain dispatch). First
         ok reply wins; the loser is cancelled by rid on every other
@@ -620,7 +694,8 @@ class Router:
             delay_s = 0.0
         if delay_s <= 0:
             return self._dispatch(msg, roles, timeout, entry=entry,
-                                  role_label=role_label, budget=budget)
+                                  role_label=role_label, budget=budget,
+                                  prefer=prefer)
         # "ok" holds the first ok reply (the winner); "last" the most
         # recent non-ok one, so a leg that comes back with a typed
         # refusal BEFORE the hedge delay still yields a reply instead
@@ -633,11 +708,15 @@ class Router:
                 # each leg owns its COPY: _dispatch rewrites the
                 # remaining-deadline field per attempt, and a shared
                 # dict would let one leg's rewrite race the other
-                # leg's frame serialization
+                # leg's frame serialization (the affinity hint rides
+                # only the primary leg — a hedge twin on the SAME
+                # replica would be no hedge at all)
                 r, ep = self._dispatch(dict(msg), roles, timeout,
                                        entry=entry,
                                        role_label=role_label,
-                                       exclude=exclude, budget=budget)
+                                       exclude=exclude, budget=budget,
+                                       prefer=prefer
+                                       if tag == "primary" else None)
             except Exception as exc:  # noqa: BLE001 — the leg MUST
                 # report in: a dying thread that never bumps "done"
                 # (WireError, injected fault, ...) would strand the
@@ -753,13 +832,36 @@ class Router:
                 fwd = dict(msg)
                 if downstream_trace is not None:
                     fwd["trace"] = downstream_trace
-                reply, _ep = self._dispatch_hedged(
+                akey = self._affinity_key(tokens)
+                prefer = self._affinity_lookup(akey)
+                reply, ep = self._dispatch_hedged(
                     fwd, ("both",), hop_timeout, entry,
-                    role_label="both", budget=hop_budget)
+                    role_label="both", budget=hop_budget,
+                    prefer=prefer)
+                self._note_affinity(akey, prefer, ep,
+                                    bool(reply.get("ok")))
                 return reply
             return self._route_disaggregated(msg, entry, hop_timeout,
                                              downstream_trace,
                                              hop_budget)
+
+    def _note_affinity(self, key, prefer, landed, ok):
+        """Affinity accounting after a routed prefill landed: a HIT is
+        the dispatch actually reaching the affine replica (whose pool
+        then answers the prefix out of cached blocks); everything else
+        — unknown prefix, affine replica out of rotation or refusing —
+        is a MISS that falls back to load-score dispatch, and the
+        winning replica becomes the prefix's new home."""
+        if key is None:
+            return
+        if prefer is not None and landed == prefer:
+            _PREFIX_HITS.inc(labels=(self.name,))
+            self._bump("prefix_hits")
+        else:
+            _PREFIX_MISSES.inc(labels=(self.name,))
+            self._bump("prefix_misses")
+        if ok and landed is not None:
+            self._affinity_record(key, landed)
 
     def _route_disaggregated(self, msg, entry, hop_timeout, trace,
                              hop_budget):
@@ -781,10 +883,17 @@ class Router:
             pmsg["priority"] = msg["priority"]
         if trace is not None:
             pmsg["trace"] = trace
+        # cache affinity binds the PREFILL hop: that is the hop whose
+        # pool holds (or rebuilds) the prompt's prefix blocks — the
+        # decode hop imports its KV over the wire either way
+        akey = self._affinity_key(msg["tokens"])
+        prefer = self._affinity_lookup(akey)
         reply, src = self._dispatch_hedged(pmsg, ("prefill", "both"),
                                            hop_timeout, entry,
                                            role_label="prefill",
-                                           budget=hop_budget)
+                                           budget=hop_budget,
+                                           prefer=prefer)
+        self._note_affinity(akey, prefer, src, bool(reply.get("ok")))
         if not reply.get("ok"):
             return reply
         kv = reply["kv"]

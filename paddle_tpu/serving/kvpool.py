@@ -29,8 +29,10 @@ blocks and scattered through the table in one donated call), and the
 paged decode programs' feed dict. The fused read path is
 ``kernels/paged_attention.py``.
 """
+import hashlib
 import math
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -102,6 +104,37 @@ _IMPORTED = default_registry().counter(
     "kvpool_blocks_imported_total",
     "migrated KV blocks deserialized into the pool",
     labels=("pool",), max_series=64)
+_PREFIX_ENTRIES = default_registry().gauge(
+    "kvpool_prefix_entries_count",
+    "prompt-prefix cache entries currently indexed",
+    labels=("pool",), max_series=64)
+_PREFIX_BLOCKS = default_registry().gauge(
+    "kvpool_prefix_cached_blocks_count",
+    "KV blocks held ONLY by the prefix cache (evictable under "
+    "pressure; not counted as slot load)",
+    labels=("pool",), max_series=64)
+_PREFIX_HITS = default_registry().counter(
+    "kvpool_prefix_hits_total",
+    "prompt admissions that adopted cached prefix blocks",
+    labels=("pool",), max_series=64)
+_PREFIX_MISSES = default_registry().counter(
+    "kvpool_prefix_misses_total",
+    "prompt admissions that found no cached prefix",
+    labels=("pool",), max_series=64)
+_PREFIX_TOKENS_REUSED = default_registry().counter(
+    "kvpool_prefix_tokens_reused_total",
+    "prompt tokens whose prefill was skipped by adopting cached "
+    "prefix blocks",
+    labels=("pool",), max_series=64)
+_PREFIX_EVICTIONS = default_registry().counter(
+    "kvpool_prefix_evictions_total",
+    "prefix-cache entries evicted LRU under pool pressure",
+    labels=("pool",), max_series=64)
+_PREFIX_COW = default_registry().counter(
+    "kvpool_prefix_cow_copies_total",
+    "shared KV blocks copy-on-write duplicated before a divergent "
+    "write",
+    labels=("pool",), max_series=64)
 
 _DTYPES = ("fp32", "bf16", "int8")
 _ELEM_BYTES = {"fp32": 4, "bf16": 2, "int8": 1}
@@ -129,6 +162,19 @@ def pool_feed_names(num_layers, quantized):
         names += [f"cache_pks_{i}" for i in range(num_layers)] \
             + [f"cache_pvs_{i}" for i in range(num_layers)]
     return names
+
+
+def prompt_prefix_key(tokens, length=None):
+    """Content hash of the first ``length`` tokens of a prompt (the
+    whole prompt when ``length`` is None) — the ONE prefix key the
+    pool's block index and the router's affinity map share, so 'the
+    replica that cached this prefix' is a well-defined address
+    fleet-wide. int32 token bytes hashed, so the key is independent of
+    list/array input type."""
+    a = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    if length is not None:
+        a = a[:int(length)]
+    return hashlib.blake2b(a.tobytes(), digest_size=16).hexdigest()
 
 
 def decode_feed(pool, token, pos):
@@ -171,7 +217,7 @@ class KVBlockPool:
 
     def __init__(self, *, slots, num_layers, num_heads, d_head,
                  max_seq_len, block_size=None, num_blocks=None,
-                 dtype=None, name="serving"):
+                 dtype=None, name="serving", prefix_cache=None):
         self.slots = int(slots)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
@@ -205,9 +251,24 @@ class KVBlockPool:
         self._slot_tokens = {}         # slot -> tokens accounted
         self.tables = np.zeros((self.slots, self.blocks_per_row),
                                np.int32)
+        # refcounted sharing (prefix cache / COW): every handed-out
+        # block carries a refcount; a block returns to the free list
+        # only when its LAST owner (slot table entry or prefix-cache
+        # entry) releases it
+        self._refs = {}                # block -> total owners
+        self._cache_ref = {}           # block -> prefix-entry owners
+        # hash(prompt prefix) -> {"blocks", "tokens", "hits"}; insertion
+        # order IS the LRU order (move_to_end on hit, popitem(False)
+        # under pressure)
+        self._prefix = OrderedDict()
+        self.prefix_enabled = bool(flag("kv_prefix_cache")
+                                   if prefix_cache is None
+                                   else prefix_cache)
+        self.array_sharding = None     # NamedSharding under a tp mesh
         self._arrays = None            # lazy device pool
         self._scatter_fn = None
         self._import_fn = None         # migration scatter (import_slot)
+        self._copy_fn = None           # COW block duplication
         self._update_gauges()
 
     # -- sizing helpers ---------------------------------------------------
@@ -262,6 +323,8 @@ class KVBlockPool:
         need = self.blocks_for_tokens(ntokens)
         pending = sum(self.blocks_for_tokens(t) for t in pending_tokens)
         with self._lock:
+            if need + pending > len(self._free):
+                self._evict_cold_locked(need + pending)
             free = len(self._free)
         if need + pending > free:
             _ALLOC_FAIL.inc(labels=(self.name,))
@@ -293,10 +356,14 @@ class KVBlockPool:
                     self._slot_tokens.get(slot, 0), int(ntokens))
                 return 0
             if add > len(self._free):
+                self._evict_cold_locked(add)
+            if add > len(self._free):
                 free_now = len(self._free)
             else:
                 for j in range(have, need):
-                    self.tables[slot, j] = self._free.pop()
+                    b = self._free.pop()
+                    self._refs[b] = 1
+                    self.tables[slot, j] = b
                 self._slot_nblocks[slot] = need
                 self._slot_tokens[slot] = max(
                     self._slot_tokens.get(slot, 0), int(ntokens))
@@ -322,24 +389,54 @@ class KVBlockPool:
         return self.alloc(slot, int(pos) + 1)
 
     def free_slot(self, slot):
-        """Return every block ``slot`` holds (EOS / deadline / cancel /
-        error — the continuous-batching reclaim). Idempotent; returns
-        the number of blocks freed."""
+        """Release every block ``slot`` holds (EOS / deadline / cancel /
+        error — the continuous-batching reclaim). A refcounted block
+        (shared with the prefix cache or another slot) only returns to
+        the free list when its LAST owner releases it. Idempotent;
+        returns the number of blocks physically freed."""
         slot = int(slot)
         with self._lock:
             n = self._slot_nblocks.pop(slot, 0)
             self._slot_tokens.pop(slot, None)
-            for j in range(n):
-                self._free.append(int(self.tables[slot, j]))
+            freed = self._release_blocks_locked(
+                int(self.tables[slot, j]) for j in range(n))
             self.tables[slot, :] = 0
             self._update_gauges_locked()
-        if n:
-            _FREED.inc(n, labels=(self.name,))
-        return n
+        if freed:
+            _FREED.inc(freed, labels=(self.name,))
+        return freed
+
+    def _release_blocks_locked(self, block_ids):
+        """Drop one reference per block; append to the free list at
+        refcount 0. Returns blocks physically freed."""
+        freed = 0
+        for b in block_ids:
+            left = self._refs.get(b, 1) - 1
+            if left <= 0:
+                self._refs.pop(b, None)
+                self._free.append(b)
+                freed += 1
+            else:
+                self._refs[b] = left
+        return freed
 
     def blocks_in_use(self):
+        """Blocks allocated to live slots. Blocks held ONLY by the
+        prefix cache are working capital, not load — they report under
+        :meth:`cached_blocks` / ``kvpool_prefix_cached_blocks_count``
+        and evict LRU under pressure."""
         with self._lock:
-            return self.capacity_blocks - len(self._free)
+            return self.capacity_blocks - len(self._free) \
+                - self._cached_only_locked()
+
+    def cached_blocks(self):
+        """Blocks held only by the prefix cache (evictable)."""
+        with self._lock:
+            return self._cached_only_locked()
+
+    def _cached_only_locked(self):
+        return sum(1 for b, c in self._cache_ref.items()
+                   if c > 0 and self._refs.get(b, 0) == c)
 
     def holders(self):
         """{slot: blocks_held} for every slot holding blocks."""
@@ -354,15 +451,19 @@ class KVBlockPool:
         Returns blocks reclaimed."""
         live = set(int(s) for s in live_slots)
         with self._lock:
-            leaked = [s for s, n in self._slot_nblocks.items()
+            leaked = [(s, n) for s, n in self._slot_nblocks.items()
                       if s not in live and n > 0]
         total = 0
-        for slot in leaked:
+        for slot, held in leaked:
             n = self.free_slot(slot)
             total += n
             _LEAKED.inc(n, labels=(self.name,))
+            # shared = table entries whose blocks stayed alive under a
+            # remaining reference (prefix cache / another slot) — the
+            # sweep released the leaking slot's claim either way
             _flightrec().record("kv_block_leak", pool=self.name,
-                                slot=slot, blocks=n)
+                                slot=slot, blocks=n,
+                                shared=held - n)
         return total
 
     # -- device arrays ----------------------------------------------------
@@ -386,6 +487,14 @@ class KVBlockPool:
                     # dequantizes 0 * 1.0 instead of hitting a 0-scale
                     arrs[f"cache_pks_{i}"] = jnp.ones(sshape, jnp.float32)
                     arrs[f"cache_pvs_{i}"] = jnp.ones(sshape, jnp.float32)
+            if self.array_sharding is not None:
+                # tp-mesh placement: blocks sharded on the head axis
+                # (dim 1), matching gpt.apply_tp_sharding's qkv split —
+                # each chip holds its own heads' cache bytes. Scale
+                # pools share the same head-axis split.
+                import jax
+                arrs = {n: jax.device_put(a, self.array_sharding[n])
+                        for n, a in arrs.items()}
             self._arrays = arrs
         return self._arrays
 
@@ -409,11 +518,223 @@ class KVBlockPool:
             self._free = list(range(self.num_blocks - 1, 0, -1))
             self._slot_nblocks.clear()
             self._slot_tokens.clear()
+            self._refs.clear()
+            self._cache_ref.clear()
+            self._prefix.clear()
             self.tables[:] = 0
             self._arrays = None
             self._update_gauges_locked()
         if freed:
             _FREED.inc(freed, labels=(self.name,))
+
+    # -- block-granular prefix cache (refcounted sharing + COW) -----------
+    # A completed prompt's blocks are deposited into a hash-keyed index
+    # (exact length AND block-aligned length, so both a full repeat and
+    # a longer prompt sharing whole blocks can hit). A hit adopts the
+    # cached blocks by reference — the adopting slot only prefills the
+    # tail. Any write into a block with >1 owner is preceded by a
+    # copy-on-write duplication (prepare_write), so cached content is
+    # immutable while shared and per-prompt outputs stay bitwise
+    # correct after divergence.
+
+    def match_prefix(self, prompt):
+        """Longest cached prefix of ``prompt``: the exact prompt first
+        (full-repeat fast path), then block-aligned lengths descending.
+        Returns ``{"key", "tokens", "blocks"}`` or None. A hit
+        refreshes the entry's LRU position."""
+        if not self.prefix_enabled:
+            return None
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        L = int(toks.size)
+        if L < 1:
+            return None
+        bs = self.block_size
+        lengths = [L] + [n for n in range((L // bs) * bs, 0, -bs)
+                         if n != L]
+        with self._lock:
+            for n in lengths:
+                key = prompt_prefix_key(toks, n)
+                e = self._prefix.get(key)
+                if e is None or e["tokens"] != n:
+                    continue
+                self._prefix.move_to_end(key)
+                e["hits"] += 1
+                _PREFIX_HITS.inc(labels=(self.name,))
+                return {"key": key, "tokens": n,
+                        "blocks": list(e["blocks"])}
+        _PREFIX_MISSES.inc(labels=(self.name,))
+        return None
+
+    def adopt_prefix(self, slot, match):
+        """Attach a :meth:`match_prefix` hit's blocks to ``slot`` by
+        reference (refcount +1 per block; the slot must hold nothing).
+        The adopter owes a :meth:`prepare_write` before any write into
+        the adopted range — COW duplicates on first divergence."""
+        slot = int(slot)
+        blocks = [int(b) for b in match["blocks"]]
+        tokens = int(match["tokens"])
+        with self._lock:
+            if self._slot_nblocks.get(slot, 0):
+                raise ValueError(
+                    f"KV pool {self.name!r} slot {slot} already holds "
+                    f"blocks — free it before adopting a cached prefix")
+            for j, b in enumerate(blocks):
+                self.tables[slot, j] = b
+                self._refs[b] = self._refs.get(b, 0) + 1
+            self._slot_nblocks[slot] = len(blocks)
+            self._slot_tokens[slot] = tokens
+            self._update_gauges_locked()
+        _PREFIX_TOKENS_REUSED.inc(tokens, labels=(self.name,))
+        return len(blocks)
+
+    def prefix_insert(self, prompt, slot):
+        """Deposit ``slot``'s freshly prefilled prompt blocks into the
+        prefix index (refcount +1 per block — the cache co-owns them,
+        so they survive the slot's EOS until evicted LRU). Inserts the
+        exact-length entry and, when distinct, the block-aligned one.
+        No-op per entry already indexed. Returns entries inserted."""
+        if not self.prefix_enabled:
+            return 0
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        L = int(toks.size)
+        slot = int(slot)
+        if L < 1:
+            return 0
+        bs = self.block_size
+        lengths = [L]
+        aligned = (L // bs) * bs
+        if aligned and aligned != L:
+            lengths.append(aligned)
+        inserted = 0
+        with self._lock:
+            held = self._slot_nblocks.get(slot, 0)
+            for n in lengths:
+                nb = _ceil_div(n, bs)
+                if nb < 1 or nb > held:
+                    continue
+                key = prompt_prefix_key(toks, n)
+                if key in self._prefix:
+                    self._prefix.move_to_end(key)
+                    continue
+                blocks = [int(self.tables[slot, j]) for j in range(nb)]
+                if 0 in blocks:
+                    continue
+                for b in blocks:
+                    self._refs[b] = self._refs.get(b, 0) + 1
+                    self._cache_ref[b] = self._cache_ref.get(b, 0) + 1
+                self._prefix[key] = {"blocks": blocks, "tokens": n,
+                                     "hits": 0}
+                inserted += 1
+            if inserted:
+                self._update_gauges_locked()
+        return inserted
+
+    def prepare_write(self, slot, start_pos, end_pos):
+        """Copy-on-write barrier: make every block covering cache
+        positions ``[start_pos, end_pos)`` of ``slot`` exclusively
+        owned before a write lands there. Shared blocks are duplicated
+        into fresh ones (one donated jitted device copy for the batch
+        of them) and the slot's table re-pointed; the cache/other-slot
+        owners keep the originals. Raises :class:`KVPoolExhaustedError`
+        (after LRU eviction of cold prefixes) when no block can be
+        found for a copy — with the slot's table unchanged. Returns
+        blocks duplicated."""
+        slot = int(slot)
+        start, end = int(start_pos), int(end_pos)
+        if end <= start:
+            return 0
+        bs = self.block_size
+        j0, j1 = start // bs, _ceil_div(end, bs)
+        copies = []
+        with self._lock:
+            def shared():
+                out = []
+                for j in range(j0, j1):
+                    b = int(self.tables[slot, j])
+                    if b != 0 and self._refs.get(b, 1) > 1:
+                        out.append(j)
+                return out
+            js = shared()
+            if len(js) > len(self._free):
+                # eviction can also UNSHARE a block (the cache drops
+                # its reference), so re-scan after
+                self._evict_cold_locked(len(js))
+                js = shared()
+            if len(js) > len(self._free):
+                free_now = len(self._free)
+            else:
+                free_now = None
+                for j in js:
+                    b = int(self.tables[slot, j])
+                    nb = self._free.pop()
+                    self._refs[b] -= 1
+                    self._refs[nb] = 1
+                    self.tables[slot, j] = nb
+                    copies.append((b, nb))
+                if copies:
+                    self._update_gauges_locked()
+        if free_now is not None:
+            _ALLOC_FAIL.inc(labels=(self.name,))
+            _flightrec().record(
+                "kv_pool_exhausted", pool=self.name, slot=slot,
+                needed_blocks=len(js), free_blocks=free_now,
+                capacity_blocks=self.capacity_blocks)
+            raise KVPoolExhaustedError(
+                f"KV pool {self.name!r} cannot copy-on-write {len(js)} "
+                f"shared block(s) for slot {slot}: {free_now} free of "
+                f"{self.capacity_blocks}",
+                needed=len(js), free=free_now,
+                capacity=self.capacity_blocks)
+        if not copies:
+            return 0
+        _PREFIX_COW.inc(len(copies), labels=(self.name,))
+        self._copy_blocks([s for s, _ in copies],
+                          [d for _, d in copies])
+        return len(copies)
+
+    def _evict_cold_locked(self, need):
+        """Evict LRU prefix entries until at least ``need`` blocks are
+        free (or the index is empty). Cold cached prefixes are working
+        capital, not load — LRU eviction here is what keeps affinity
+        routing from pinning a replica's pool full of them."""
+        evicted = 0
+        while self._prefix and len(self._free) < need:
+            key, e = self._prefix.popitem(last=False)
+            for b in e["blocks"]:
+                c = self._cache_ref.get(b, 0) - 1
+                if c <= 0:
+                    self._cache_ref.pop(b, None)
+                else:
+                    self._cache_ref[b] = c
+            freed = self._release_blocks_locked(e["blocks"])
+            if freed:
+                _FREED.inc(freed, labels=(self.name,))
+            _PREFIX_EVICTIONS.inc(labels=(self.name,))
+            _flightrec().record(
+                "kv_prefix_evicted", pool=self.name, tokens=e["tokens"],
+                blocks=len(e["blocks"]), freed=freed, hits=e["hits"])
+            evicted += 1
+        return evicted
+
+    def _copy_blocks(self, src_ids, dst_ids):
+        """Device-side block duplication (COW): one donated jitted call
+        copies every pool array's ``src`` rows into ``dst``. On failure
+        the donated arrays must be presumed lost (drop_device
+        semantics) — the caller's bank-lost path applies."""
+        import jax
+        import jax.numpy as jnp
+        if self._copy_fn is None:
+            def cp(pool, src, dst):
+                return {n: a.at[dst].set(a[src])
+                        for n, a in pool.items()}
+            self._copy_fn = jax.jit(cp, donate_argnums=(0,))
+        try:
+            self._arrays = self._copy_fn(
+                self.arrays(), jnp.asarray(src_ids, jnp.int32),
+                jnp.asarray(dst_ids, jnp.int32))
+        except Exception:
+            self._arrays = None
+            raise
 
     # -- prefill scatter --------------------------------------------------
     def scatter_prefill(self, slot_ids, row_caches, bucket_len):
@@ -663,13 +984,19 @@ class KVBlockPool:
     # -- reporting --------------------------------------------------------
     def _update_gauges_locked(self):
         lab = (self.name,)
-        in_use = self.capacity_blocks - len(self._free)
+        cached = self._cached_only_locked()
+        in_use = self.capacity_blocks - len(self._free) - cached
         _BLOCKS_IN_USE.set(in_use, labels=lab)
         _CAPACITY.set(self.capacity_blocks, labels=lab)
+        # occupancy counts SLOT load only: blocks held just by the
+        # prefix cache are evictable working capital, and the router's
+        # load score must not shun the replica that cached the most
         _OCCUPANCY.set(in_use / self.capacity_blocks
                        if self.capacity_blocks else 0.0, labels=lab)
         _SAVED.set(self.slots * self.dense_slot_bytes()
-                   - in_use * self.block_bytes(), labels=lab)
+                   - (in_use + cached) * self.block_bytes(), labels=lab)
+        _PREFIX_ENTRIES.set(len(self._prefix), labels=lab)
+        _PREFIX_BLOCKS.set(cached, labels=lab)
 
     def _update_gauges(self):
         with self._lock:
@@ -679,10 +1006,12 @@ class KVBlockPool:
         """Occupancy / fragmentation snapshot (plain ints/floats — wire
         safe, merged into ``server.stats()`` under ``kvpool_*``)."""
         with self._lock:
-            in_use = self.capacity_blocks - len(self._free)
+            cached = self._cached_only_locked()
+            in_use = self.capacity_blocks - len(self._free) - cached
             tokens = sum(self._slot_tokens.values())
             slots_held = sum(1 for n in self._slot_nblocks.values()
                              if n > 0)
+            prefix_entries = len(self._prefix)
         cap_tokens = in_use * self.block_size
         return {
             "blocks": self.num_blocks,
@@ -699,10 +1028,16 @@ class KVBlockPool:
             if cap_tokens else 0.0,
             "tokens_held": tokens,
             "slots_holding_blocks": slots_held,
-            "bytes_in_use": in_use * self.block_bytes(),
+            # prefix cache: entries indexed and blocks held ONLY by the
+            # cache — evictable on demand, so the router's load scoring
+            # discounts them (satellite: cold prefixes must not read as
+            # load)
+            "prefix_entries": prefix_entries,
+            "evictable_blocks": cached,
+            "bytes_in_use": (in_use + cached) * self.block_bytes(),
             "bytes_capacity": self.capacity_blocks * self.block_bytes(),
             "saved_vs_dense_bytes": self.slots * self.dense_slot_bytes()
-            - in_use * self.block_bytes(),
+            - (in_use + cached) * self.block_bytes(),
         }
 
 

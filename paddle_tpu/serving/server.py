@@ -566,8 +566,14 @@ class InferenceServer:
                 # kvpool occupancy next to the queue depths, one cheap
                 # probe instead of a full stats()/metrics scrape
                 cap = pool.capacity_blocks
+                # blocks_in_use excludes cache-only blocks: a pool full
+                # of EVICTABLE prefix blocks reads as empty to the
+                # dispatch score (those blocks are reclaimable capacity
+                # that doubles as cache value), with the evictable
+                # count alongside for the affinity-aware observer
                 h["kvpool_occupancy"] = round(
                     pool.blocks_in_use() / cap, 4) if cap else 0.0
+                h["kvpool_evictable_blocks"] = pool.cached_blocks()
         return h
 
     def reload_weights(self, path, timeout=120.0):

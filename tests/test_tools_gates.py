@@ -570,3 +570,104 @@ def test_bench_compare_multislice_dcn_keys(tmp_path):
         record(588, 4080), record(4116, 4116),
         ["-meshes.dcn_dp_dp.ledger.totals.by_axis.dcn_dp"], 10.0)
     assert regs and "dcn_dp" in regs[0]
+
+
+def _save_tools_gpt_serving(tmp, kind, sharded):
+    """Save a tiny-GPT serving executable (bucketed prefill or paged
+    decode step) for the shard_report gate, with or without the
+    generation stack's tp annotations (models.gpt.apply_tp_sharding —
+    dist_attr survives save_inference_model serialization)."""
+    from paddle_tpu.models import gpt
+    cfg = gpt.GPTConfig.tiny()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if kind == "prefill":
+            d = gpt.gpt_prefill(cfg, max_len=48)
+        else:
+            d = gpt.gpt_decode_step_paged(cfg)
+        if sharded:
+            gpt.apply_tp_sharding(main, cfg)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(tmp, d["feed_names"],
+                                      [d["logits"]], exe,
+                                      main_program=main)
+    return tmp
+
+
+def test_shard_report_gate_serving_executables(tmp_path):
+    """The pod-serving executables run through the SAME replicated-
+    param CI gate as training programs: tp-annotated gpt_prefill AND
+    gpt_decode_step_paged audit clean under the GPT tp mesh; the same
+    decode step without annotations exits 1 naming word_embedding (the
+    largest replicated matrix) — so a serving PR cannot silently ship
+    a replicated model."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # 0.01 MiB: LN scales / output biases (legitimately replicated,
+    # <=128 B) pass; tiny word_embedding (16 KiB) does not
+    mesh = ["--mesh", "tp=2", "--threshold-mb", "0.01", "--batch", "2",
+            "--assert-no-replicated-params"]
+    for kind in ("prefill", "decode"):
+        path = _save_tools_gpt_serving(str(tmp_path / kind), kind, True)
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "shard_report.py"),
+             path, *mesh],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=300)
+        assert r.returncode == 0, \
+            kind + ": " + r.stdout + r.stderr[-2000:]
+        assert "OK: no replicated-large-param findings" in r.stdout
+    bad = _save_tools_gpt_serving(str(tmp_path / "bad"), "decode",
+                                  False)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shard_report.py"), bad,
+         "--json", *mesh],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr[-2000:]
+    assert "REPLICATED-PARAM VIOLATION" in r.stderr
+    doc = json.loads(r.stdout)
+    assert "word_embedding" in doc["finding"], doc["finding"]
+
+
+def test_bench_compare_serving_podscale_keys(tmp_path):
+    """tools/bench_compare.py over the pod-serving rows: tp tokens/s
+    and the fleet cache-hit ratio are higher-is-better, cached-prefix
+    warm latency is lower-is-better; a record that silently loses the
+    prefix cache (warm == cold, hit ratio 0) fails the gate by name."""
+    import bench_compare
+
+    def record(tps2, warm_ms, ratio):
+        return {"configs": {
+            "serving": {"generation": {
+                "tp_scaling": {"2": {"tokens_per_sec": tps2},
+                               "greedy_parity": True},
+                "prefix_prefill": {"cold_ms": 42.0, "warm_ms": warm_ms,
+                                   "leaked_blocks": 0}}},
+            "fleet": {"prefix_affinity": {"cache_hit_ratio": ratio,
+                                          "leaked_kv_blocks": 0}}}}
+
+    p_old = str(tmp_path / "old.json")
+    p_ok = str(tmp_path / "ok.json")
+    p_bad = str(tmp_path / "bad.json")
+    with open(p_old, "w") as f:
+        json.dump(record(310.0, 11.0, 0.5), f)
+    with open(p_ok, "w") as f:
+        json.dump(record(305.0, 10.5, 0.52), f)
+    with open(p_bad, "w") as f:
+        # cache silently lost: warm prefill pays the cold price again
+        json.dump(record(300.0, 42.0, 0.0), f)
+    keys = ["--key",
+            "configs.serving.generation.tp_scaling.2.tokens_per_sec",
+            "--key=-configs.serving.generation.prefix_prefill.warm_ms",
+            "--key", "configs.fleet.prefix_affinity.cache_hit_ratio"]
+    assert bench_compare.main(
+        [p_old, p_ok, *keys, "--max-regress-pct", "10"]) == 0
+    assert bench_compare.main(
+        [p_old, p_bad, *keys, "--max-regress-pct", "10"]) == 1
+    regs, _ = bench_compare.compare(
+        record(310.0, 11.0, 0.5), record(300.0, 42.0, 0.0),
+        ["-configs.serving.generation.prefix_prefill.warm_ms",
+         "configs.fleet.prefix_affinity.cache_hit_ratio"], 10.0)
+    assert len(regs) == 2
+    assert any("warm_ms" in r for r in regs)
