@@ -194,6 +194,16 @@ _DEFS = {
     # one compiled decode executable; finished rows free their slot for
     # the next admitted request (continuous batching)
     "decode_slots": (8, int, None),
+    # speculative decoding (Leviathan 2022 / Chen 2023): draft depth K —
+    # a drafter proposes up to K tokens per row per step, one verify
+    # pass scores all K+1 positions, rejection sampling keeps the
+    # model-agreed prefix. 0 = off (the parity baseline); greedy output
+    # is bitwise-identical either way
+    "decode_spec_k": (0, int, None),
+    # default drafter: "ngram" (free prompt-lookup self-drafting) or
+    # "model" (1-layer draft GPT sharing the generator's parameter
+    # snapshot)
+    "decode_spec_mode": ("ngram", str, None),
     # -- paged KV cache (serving/kvpool, kernels/paged_attention) --
     # opt-in block-paged decode memory: KV caches live in a shared
     # block pool with per-slot block tables (vLLM/PagedAttention)

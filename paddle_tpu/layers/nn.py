@@ -887,6 +887,35 @@ def sample_tokens(logits, temperature, top_k=None, seed=0, name=None):
     return out
 
 
+def spec_accept(logits, draft, temperature, num_draft, top_k=None,
+                seed=0, name=None):
+    """Speculative-decoding acceptance over a verified span: ``logits``
+    [B, S, V] (the verify step's per-position distributions), ``draft``
+    [B, K] int32 proposals (K = S-1), per-row ``temperature`` [B] /
+    optional ``top_k`` [B] sampling config (matching
+    :func:`sample_tokens` exactly), ``num_draft`` [B] int32 real draft
+    counts. Returns ``(tokens [B, S] int32, accepted [B] int32)`` —
+    row b emits ``tokens[b, :accepted[b] + 1]``. Greedy rows are
+    bitwise-identical to sequential decode; stochastic rows preserve
+    the sampler's output distribution via rejection sampling."""
+    helper = LayerHelper("spec_accept", name=name)
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    acc = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"X": [logits], "Draft": [draft],
+           "Temperature": [temperature], "NumDraft": [num_draft]}
+    if top_k is not None:
+        ins["TopK"] = [top_k]
+    helper.append_op(
+        type="spec_accept", inputs=ins,
+        outputs={"Out": [out], "Accepted": [acc]},
+        attrs={"seed": int(seed)}, infer_shape=False)
+    out.shape = tuple(logits.shape[:2] or ())
+    out.dtype = "int32"
+    acc.shape = tuple(logits.shape[:1] or ())
+    acc.dtype = "int32"
+    return out, acc
+
+
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
                 name=None):
     """One beam expansion step (reference layers/rnn.py beam_search ->

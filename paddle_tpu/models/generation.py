@@ -91,6 +91,122 @@ def _greedy_program_outs():
     return {"feed_names": ["logits"], "tokens": toks}
 
 
+def _spec_accept_program_outs():
+    """Acceptance program for speculative decoding: one ``spec_accept``
+    op over the verify step's span logits (see ops/decode_ops.py for
+    the rejection-sampling semantics)."""
+    from .. import layers
+    from ..layers import tensor as T
+    logits = T.data("logits", [-1, -1, -1], dtype="float32")
+    draft = T.data("draft", [-1, -1], dtype="int32")
+    temperature = T.data("temperature", [-1], dtype="float32")
+    top_k = T.data("top_k", [-1], dtype="int32")
+    num_draft = T.data("num_draft", [-1], dtype="int32")
+    toks, acc = layers.nn.spec_accept(logits, draft, temperature,
+                                      num_draft, top_k=top_k)
+    return {"feed_names": ["logits", "draft", "temperature", "top_k",
+                           "num_draft"],
+            "tokens": toks, "accepted": acc}
+
+
+# -- drafters ----------------------------------------------------------
+#
+# A drafter proposes up to k continuation tokens for one row's context;
+# the verify step scores them all in one pass and rejection sampling
+# keeps whatever prefix the model agrees with. The protocol is one
+# method — draft(ctx_tokens, k) -> 1-D int array of <= k proposals —
+# so anything from a table lookup to a full small LM plugs in.
+
+class NgramDrafter:
+    """Self-drafting n-gram / prompt-lookup drafter (the LLMA /
+    prompt-lookup-decoding idiom): find the most recent PRIOR
+    occurrence of the context's trailing n-gram and propose the tokens
+    that followed it. Free — no model, no device work — and highly
+    effective exactly when decode output echoes its context
+    (summarization, code edits, retrieval), which is also when decode
+    is most bandwidth-starved."""
+
+    def __init__(self, max_ngram=3):
+        self.max_ngram = int(max_ngram)
+
+    def draft(self, ctx, k):
+        ctx = np.asarray(ctx, np.int32).ravel()
+        n = int(ctx.size)
+        k = int(k)
+        if k <= 0 or n < 2:
+            return np.zeros((0,), np.int32)
+        for ng in range(min(self.max_ngram, n - 1), 0, -1):
+            pat = ctx[n - ng:]
+            # windows strictly before the trailing n-gram itself
+            wins = np.lib.stride_tricks.sliding_window_view(
+                ctx[:n - 1], ng)[:n - ng]
+            hits = np.flatnonzero(np.all(wins == pat, axis=1))
+            if hits.size:
+                # most recent occurrence with a FULL k-token
+                # continuation, else most recent outright: a cycling
+                # context's nearest hit sits one period back, which
+                # would clip every draft to the cycle length
+                full = hits[hits + ng + k <= n]
+                i = int(full[-1]) if full.size else int(hits[-1])
+                cont = ctx[i + ng:i + ng + k]
+                if 0 < cont.size < k:
+                    # the continuation ran off the end of the context
+                    # (the hit sits inside the trailing cycle): extend
+                    # it periodically — a wrong guess merely gets
+                    # rejected, a right one doubles the run length
+                    cont = np.resize(cont, k)
+                if cont.size:
+                    return cont.astype(np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class ModelDrafter:
+    """Draft-model drafter: greedy continuations from a (small) wrapped
+    :class:`GPTGenerator`. :meth:`from_generator` builds the standard
+    shared-snapshot configuration — a truncated-depth copy of the
+    target config over the SAME parameter scope, so the draft model
+    reuses the generator's embeddings and first decoder layers without
+    a second checkpoint."""
+
+    def __init__(self, draft_gen):
+        self.gen = draft_gen
+
+    @classmethod
+    def from_generator(cls, gen, num_layers=1):
+        import copy
+        cfg = copy.copy(gen.cfg)
+        cfg.num_layers = max(1, min(int(num_layers), gen.cfg.num_layers))
+        return cls(GPTGenerator(cfg, gen.scope, max_len=gen.max_len,
+                                bucket_min=gen.bucket_min))
+
+    def draft(self, ctx, k):
+        ctx = np.asarray(ctx, np.int32).ravel()
+        k = int(k)
+        lim = self.gen.max_len - k
+        if k <= 0 or lim < 1:
+            return np.zeros((0,), np.int32)
+        out = self.gen.generate([ctx[-lim:]], max_new_tokens=k,
+                                temperature=0.0)
+        return np.asarray(out[0], np.int32)
+
+
+def make_drafter(mode=None, generator=None):
+    """Drafter for ``FLAGS_decode_spec_mode``: ``"ngram"`` (default) is
+    the free prompt-lookup drafter; ``"model"`` wraps a 1-layer draft
+    GPT sharing ``generator``'s parameter snapshot."""
+    mode = mode or flag("decode_spec_mode") or "ngram"
+    if mode == "ngram":
+        return NgramDrafter()
+    if mode == "model":
+        if generator is None:
+            raise ValueError(
+                "decode_spec_mode='model' needs the target generator "
+                "to share parameters with")
+        return ModelDrafter.from_generator(generator)
+    raise ValueError(
+        f"unknown decode_spec_mode {mode!r} — 'ngram' or 'model'")
+
+
 class GPTGenerator:
     """Compiled prefill + decode-step + sampler over a parameter scope.
 
@@ -183,8 +299,9 @@ class GPTGenerator:
     def _annotate_tp(self, kind, main):
         """Annotate a freshly built program's parameters with the tp
         PartitionSpecs (no-op single-chip, and for the parameterless
-        sampler programs)."""
-        if self.mesh is not None and not kind.startswith("sample"):
+        sampler/acceptance programs)."""
+        if self.mesh is not None and not kind.startswith("sample") \
+                and kind != "spec_accept":
             gpt.apply_tp_sharding(main, self.cfg)
 
     def apply_pool_sharding(self, pool):
@@ -261,6 +378,8 @@ class GPTGenerator:
 
     # -- compilation ------------------------------------------------------
     def _fetch_names(self, outs):
+        if "accepted" in outs:              # spec_accept: tokens + count
+            return [outs["tokens"].name, outs["accepted"].name]
         if "tokens" in outs:
             return [outs["tokens"].name]
         if "cache_vars" in outs:            # paged decode: pool arrays
@@ -279,17 +398,26 @@ class GPTGenerator:
         if entry is not None:
             return entry
         if not (kind.startswith("decode_paged_")
-                or kind.startswith("prefill_chunk_")):
+                or kind.startswith("prefill_chunk_")
+                or kind.startswith("verify_paged_")
+                or kind in ("verify", "spec_accept")):
             raise KeyError(f"unknown generation program kind {kind!r}")
         from ..framework.core import Program, program_guard
-        kv_dtype = kind.rsplit("_", 1)[1]
+        kv_dtype = kind.rsplit("_", 1)[-1]
         with _PROG_BUILD_LOCK:
             entry = self._progs.get(kind)
             if entry is not None:     # lost the build race to a peer
                 return entry
             main, startup = Program(), Program()
             with program_guard(main, startup):
-                if kind.startswith("decode_paged_"):
+                if kind == "verify":
+                    outs = gpt.gpt_verify_step(self.cfg, self.max_len)
+                elif kind == "spec_accept":
+                    outs = _spec_accept_program_outs()
+                elif kind.startswith("verify_paged_"):
+                    outs = gpt.gpt_verify_step_paged(self.cfg,
+                                                     kv_dtype=kv_dtype)
+                elif kind.startswith("decode_paged_"):
                     outs = gpt.gpt_decode_step_paged(self.cfg,
                                                      kv_dtype=kv_dtype)
                 else:
@@ -516,6 +644,55 @@ class GPTGenerator:
             raise
         return adopt_decode_fetches(pool, fetches), key
 
+    def _run_verify(self, tokens, pos, pos_ids, caches, key):
+        """One speculative verify step over the DENSE per-slot caches:
+        score all S = K+1 fed positions in one pass. Same donated-cache
+        discipline as the decode step."""
+        feed = dict(caches)
+        feed["tokens"] = np.asarray(tokens, np.int32)
+        feed["pos"] = np.asarray(pos, np.int32)
+        feed["pos_ids"] = np.asarray(pos_ids, np.int32)
+        fetches, key = self._invoke("verify", "decode", feed, key)
+        logits, caches = self._unpack_caches(fetches)
+        return logits, caches, key
+
+    def _run_verify_paged(self, tokens, pos_ids, start_pos, limit, pool,
+                          key, rows=None):
+        """One speculative verify step over the block-paged pool:
+        prefill-style attention through the same block-table gather,
+        per-row ``limit`` = real span (k_b drafts + 1; past-limit
+        writes route to the trash block). Returns span logits
+        [B, S, V]; the updated pool arrays are adopted in place. On any
+        failure the donated pool arrays are presumed lost."""
+        from ..serving.kvpool import adopt_decode_fetches
+        feed = dict(pool.arrays())
+        feed["tokens"] = np.asarray(tokens, np.int32)
+        feed["pos_ids"] = np.asarray(pos_ids, np.int32)
+        feed["start_pos"] = np.asarray(start_pos, np.int32)
+        feed["limit"] = np.asarray(limit, np.int32)
+        tables = pool.tables if rows is None else pool.tables[list(rows)]
+        feed["block_tables"] = np.ascontiguousarray(tables)
+        try:
+            fetches, key = self._invoke(f"verify_paged_{pool.dtype}",
+                                        "decode", feed, key)
+        except Exception:
+            pool.drop_device()
+            raise
+        return adopt_decode_fetches(pool, fetches), key
+
+    def _run_spec_accept(self, logits, draft, temperature, top_k,
+                         num_draft, key):
+        """Rejection-sampling acceptance over a verified span: returns
+        ``(tokens [B, S], accepted [B], key)`` — row b emits
+        ``tokens[b, :accepted[b] + 1]``."""
+        feed = {"logits": logits,
+                "draft": np.asarray(draft, np.int32),
+                "temperature": np.asarray(temperature, np.float32),
+                "top_k": np.asarray(top_k, np.int32),
+                "num_draft": np.asarray(num_draft, np.int32)}
+        fetches, key = self._invoke("spec_accept", "sample", feed, key)
+        return fetches[0], fetches[1], key
+
     def _run_logits(self, tokens, pos_ids, last_pos, key):
         feed = {"tokens": tokens, "pos_ids": pos_ids, "last_pos": last_pos}
         fetches, key = self._invoke("logits", "prefill", feed, key)
@@ -599,7 +776,8 @@ class GPTGenerator:
 
     def generate(self, prompts, max_new_tokens=32, temperature=0.0,
                  top_k=0, eos_id=None, seed=None, key=None, paged=None,
-                 kv_dtype=None):
+                 kv_dtype=None, spec_k=None, spec_mode=None,
+                 drafter=None):
         """KV-cached generation: one bucketed prefill, then one compiled
         decode step per token. ``prompts`` is a list of 1-D int token
         arrays (ragged lengths fine — rows are right-padded to the
@@ -612,9 +790,27 @@ class GPTGenerator:
         instead of the dense ``[B, H, max_len, D]`` bank — same prefill,
         same sampler, same RNG chain, greedy output token-for-token
         identical. ``kv_dtype`` (None -> ``FLAGS_kv_cache_dtype``)
-        selects the paged pool's element type (fp32/bf16/int8)."""
+        selects the paged pool's element type (fp32/bf16/int8).
+
+        ``spec_k`` (None -> ``FLAGS_decode_spec_k``; 0 disables) turns
+        on speculative decoding: a drafter proposes up to K tokens per
+        row per step, one verify pass scores all K+1 positions, and
+        rejection sampling keeps the model-agreed prefix — greedy
+        output is BITWISE identical to the non-speculative path, and
+        stochastic output preserves the sampler's distribution exactly.
+        ``spec_mode`` (None -> ``FLAGS_decode_spec_mode``) picks the
+        default drafter ('ngram' prompt-lookup / 'model' shared-weight
+        draft GPT); ``drafter`` overrides it with any object exposing
+        ``draft(ctx_tokens, k)``."""
         if paged is None:
             paged = bool(flag("kv_paged"))
+        if spec_k is None:
+            spec_k = int(flag("decode_spec_k"))
+        if int(spec_k) > 0:
+            return self._generate_spec(
+                prompts, max_new_tokens, temperature, top_k, eos_id,
+                seed, key, paged, kv_dtype, int(spec_k), spec_mode,
+                drafter)
         if paged:
             return self._generate_paged(
                 prompts, max_new_tokens, temperature, top_k, eos_id,
@@ -722,6 +918,154 @@ class GPTGenerator:
             for r in range(bb):
                 pool.free_slot(r)
             pool.drop_device()
+
+    def _generate_spec(self, prompts, max_new_tokens, temperature,
+                       top_k, eos_id, seed, key, paged, kv_dtype,
+                       spec_k, spec_mode, drafter):
+        """The speculative decode loop behind ``generate(spec_k=K)``,
+        dense and paged: draft up to K tokens per row host-side, verify
+        all K+1 positions in ONE model pass (the whole win — a verify
+        pass costs about one decode step, both bandwidth-bound), keep
+        the accepted prefix plus the correction/bonus token via
+        rejection sampling. Per-row draft counts are capped to the
+        row's remaining budget; the dense path falls back to plain
+        decode steps near the cache end (its fixed-span write cannot
+        be trash-routed the way the paged ``limit`` input can)."""
+        from ..serving.kvpool import KVBlockPool
+        prompts, lens, key = self._prep(prompts, max_new_tokens, seed,
+                                        key)
+        if drafter is None:
+            drafter = make_drafter(spec_mode, generator=self)
+        B = len(prompts)
+        tokens, pos_ids, last = self._pack_prompts(prompts)
+        bb, s = tokens.shape
+        cfg = self.cfg
+        pool = None
+        if paged:
+            kv_dtype = kv_dtype or flag("kv_cache_dtype")
+            pool_key = (bb, kv_dtype, int(flag("kv_block_size")))
+            pool = self._paged_pools.get(pool_key)
+            if pool is None:
+                pool = KVBlockPool(
+                    slots=bb, num_layers=cfg.num_layers,
+                    num_heads=cfg.num_heads,
+                    d_head=cfg.hidden_size // cfg.num_heads,
+                    max_seq_len=self.max_len, dtype=kv_dtype,
+                    name="offline")
+                self.apply_pool_sharding(pool)
+                self._paged_pools[pool_key] = pool
+        try:
+            caches = None
+            if paged:
+                for r in range(B):
+                    pool.alloc(r, lens[r])
+                logits, row_caches, key = self._run_prefill(
+                    tokens, pos_ids, last, key)
+                pool.scatter_prefill(list(range(B)), row_caches, s)
+            else:
+                logits, caches, key = self._run_prefill(
+                    tokens, pos_ids, last, key)
+
+            temp = np.full((bb,), float(temperature), np.float32)
+            topk = np.full((bb,), int(top_k), np.int32)
+            tok, key = self._run_sample(logits, temp, topk, key)
+            tok_h = np.asarray(tok).astype(np.int32)
+
+            outs = [[] for _ in range(B)]
+            done = np.zeros(B, bool)
+            pos = np.zeros((bb,), np.int32)
+            pos[:B] = np.asarray(lens, np.int32)
+            self._emit(tok_h, outs, done, eos_id, max_new_tokens)
+
+            S = spec_k + 1
+            while not done.all():
+                # host-side drafting, capped to each row's remaining
+                # budget (drafting past it is pure wasted verify work)
+                draft = np.zeros((bb, spec_k), np.int32)
+                nd = np.zeros((bb,), np.int32)
+                for r in range(B):
+                    if done[r]:
+                        continue
+                    kr = min(spec_k, max_new_tokens - len(outs[r]) - 1)
+                    if kr <= 0:
+                        continue
+                    ctx = np.concatenate(
+                        [prompts[r], np.asarray(outs[r], np.int32)])
+                    d = np.asarray(drafter.draft(ctx, kr),
+                                   np.int32).ravel()[:kr]
+                    nd[r] = d.size
+                    draft[r, :d.size] = d
+                if not paged and int(pos[:B][~done].max()) + S \
+                        > self.max_len:
+                    # dense tail: the fixed-span cache write would
+                    # clamp into valid entries — plain steps finish the
+                    # last few tokens (greedy stays bitwise: same
+                    # argmax, key-independent)
+                    logits, caches, key = self._run_decode(
+                        tok_h, pos, caches, key)
+                    tok, key = self._run_sample(logits, temp, topk, key)
+                    tok_h = np.asarray(tok).astype(np.int32)
+                    pos[:B] = np.where(done, pos[:B], pos[:B] + 1)
+                    self._emit(tok_h, outs, done, eos_id,
+                               max_new_tokens)
+                    if self.stats:
+                        self.stats.bump("decode_steps")
+                    continue
+                feed_toks = np.zeros((bb, S), np.int32)
+                feed_toks[:, 0] = tok_h
+                feed_toks[:, 1:] = draft
+                span_pos = np.clip(
+                    pos[:, None] + np.arange(S, dtype=np.int32)[None, :],
+                    0, cfg.max_position - 1)
+                if paged:
+                    limit = np.zeros((bb,), np.int32)
+                    for r in range(B):
+                        if not done[r]:
+                            limit[r] = int(nd[r]) + 1
+                            pool.alloc(r, int(pos[r]) + int(nd[r]) + 1)
+                    logits, key = self._run_verify_paged(
+                        feed_toks, span_pos, pos, limit, pool, key)
+                else:
+                    logits, caches, key = self._run_verify(
+                        feed_toks, pos, span_pos, caches, key)
+                out_toks, acc, key = self._run_spec_accept(
+                    logits, draft, temp, topk, nd, key)
+                out_h = np.asarray(out_toks)
+                acc_h = np.asarray(acc)
+                for r in range(B):
+                    if done[r]:
+                        continue
+                    a = int(acc_h[r])
+                    for j in range(a + 1):
+                        if done[r]:
+                            break
+                        t = int(out_h[r, j])
+                        if eos_id is not None and t == int(eos_id):
+                            done[r] = True
+                            break
+                        outs[r].append(t)
+                        if len(outs[r]) >= max_new_tokens:
+                            done[r] = True
+                    pos[r] += a + 1
+                    tok_h[r] = out_h[r, a]
+                if self.stats:
+                    self.stats.bump("decode_steps")
+                    self.stats.bump("spec_steps")
+                    self.stats.bump("spec_drafted", int(nd.sum()))
+                    self.stats.bump("spec_accepted",
+                                    int(acc_h[:B].sum()))
+                    self.stats.bump(
+                        "spec_rejected",
+                        int(((acc_h[:B] < nd[:B]) & (nd[:B] > 0)).sum()))
+            if self.stats:
+                self.stats.bump("tokens_generated",
+                                int(sum(len(o) for o in outs)))
+            return [np.asarray(o, np.int32) for o in outs]
+        finally:
+            if pool is not None:
+                for r in range(bb):
+                    pool.free_slot(r)
+                pool.drop_device()
 
     def generate_naive(self, prompts, max_new_tokens=32, temperature=0.0,
                        top_k=0, eos_id=None, seed=None, key=None):

@@ -37,7 +37,10 @@ class GPTConfig:
 
     @classmethod
     def tiny(cls):
-        return cls(vocab_size=128, hidden_size=32, num_layers=2,
+        # 1 layer: the test suite compiles this config hundreds of
+        # times and XLA compile time scales with depth; nothing the
+        # tiny tests assert needs a second identical decoder layer
+        return cls(vocab_size=128, hidden_size=32, num_layers=1,
                    num_heads=2, ffn_size=64, max_position=64,
                    dropout=0.0)
 
@@ -199,6 +202,16 @@ def _tied_next_logits(cfg, x, last_pos):
     h = layers.nn.row_gather(x, last_pos)                    # [B, H]
     word_emb = x.block.program.global_block().var("word_embedding")
     return layers.matmul(h, word_emb, transpose_y=True)      # [B, V]
+
+
+def _tied_span_logits(cfg, x):
+    """final-LN hidden [B, S, H] -> next-token logits [B, S, V] at
+    EVERY position (the verify step scores all K+1 speculative
+    positions in one pass; jnp.matmul broadcasts the 3-D hidden
+    against the tied 2-D head)."""
+    x = _ln(cfg, x, "final_ln")
+    word_emb = x.block.program.global_block().var("word_embedding")
+    return layers.matmul(x, word_emb, transpose_y=True)      # [B, S, V]
 
 
 def gpt_logits(cfg, batch_size=-1, seq_len=-1):
@@ -427,6 +440,124 @@ def gpt_prefill_chunk_paged(cfg, kv_dtype="fp32", batch_size=-1,
         pk_out.append(npk)
         pv_out.append(npv)
     logits = _tied_next_logits(cfg, x, last_idx)
+    from ..serving.kvpool import pool_feed_names
+    cache_names = pool_feed_names(cfg.num_layers, quantized)
+    by_name = {}
+    for i in range(cfg.num_layers):
+        by_name[f"cache_pk_{i}"] = pk_out[i]
+        by_name[f"cache_pv_{i}"] = pv_out[i]
+        if quantized:
+            by_name[f"cache_pks_{i}"] = ks_out[i]
+            by_name[f"cache_pvs_{i}"] = vs_out[i]
+    return {"feed_names": feed_names, "logits": logits,
+            "cache_names": cache_names,
+            "cache_vars": [by_name[n] for n in cache_names]}
+
+
+def gpt_verify_step(cfg, max_len, batch_size=-1, span_len=-1):
+    """ONE speculative VERIFY step over the dense per-slot caches:
+    score S = K+1 positions per row (the current token plus K draft
+    tokens) in a single pass — the k/v of every fed token are appended
+    at ``pos[b]..pos[b]+S-1`` via the same dynamic_update_slice write
+    as :func:`gpt_decode_step`, and each query i attends keys
+    ``<= pos[b]+i`` (prefill-style causal masking over the cache), so
+    ``logits[:, i]`` is exactly what a sequential decode step would
+    emit after accepting the first i fed tokens. Rejected positions
+    leave garbage k/v beyond the accepted point; the caller re-writes
+    them on the next step before any mask admits them.
+
+    Feeds: tokens [B, S] int32, pos [B] int32 (write start = each
+    row's current position), pos_ids [B, S] int32 (absolute positions,
+    host-clipped to max_position), cache_k_<i>/cache_v_<i>
+    [B, H, max_len, D]. Fetches: logits [B, S, V] + updated caches."""
+    tokens = T.data("tokens", [batch_size, span_len], dtype="int32")
+    pos = T.data("pos", [batch_size], dtype="int32")
+    pos_ids = T.data("pos_ids", [batch_size, span_len], dtype="int32")
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pemb = layers.embedding(pos_ids, size=[cfg.max_position,
+                                           cfg.hidden_size],
+                            param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pemb)                     # [B, S, H]
+    feed_names = ["tokens", "pos", "pos_ids"]
+    cache_k, cache_v = [], []
+    for i in range(cfg.num_layers):
+        ck_in = T.data(f"cache_k_{i}",
+                       [batch_size, n_head, max_len, d_head])
+        cv_in = T.data(f"cache_v_{i}",
+                       [batch_size, n_head, max_len, d_head])
+        feed_names += [f"cache_k_{i}", f"cache_v_{i}"]
+        x, ck, cv = decoder_layer(
+            cfg, x, i, True,
+            kv_cache={"k": ck_in, "v": cv_in, "mode": "decode"}, pos=pos)
+        cache_k.append(ck)
+        cache_v.append(cv)
+    logits = _tied_span_logits(cfg, x)                   # [B, S, V]
+    return {"feed_names": feed_names, "logits": logits,
+            "cache_k": cache_k, "cache_v": cache_v}
+
+
+def gpt_verify_step_paged(cfg, kv_dtype="fp32", batch_size=-1,
+                          span_len=-1):
+    """ONE speculative VERIFY step over the shared block pool: the
+    paged analogue of :func:`gpt_verify_step`, built exactly like a
+    chunked-prefill pass (:func:`gpt_prefill_chunk_paged` — same
+    block-table gather, same per-row position masks, same trash-block
+    routing for past-``limit`` padding) except that logits come back
+    for EVERY position, not just the row's last real one. ``limit``
+    [B] carries each row's real span (k_b drafts + 1), so rows may
+    speculate at different depths inside one executable; a row's
+    padding positions write to the trash block and its logits there
+    are ignored host-side.
+
+    Feeds: tokens [B, S] int32, pos_ids [B, S] int32, start_pos [B]
+    int32, limit [B] int32, block_tables [B, nblk] int32, then the
+    pools. Fetches: logits [B, S, V], then the updated pools in
+    ``serving.kvpool.pool_feed_names`` order (``cache_names``)."""
+    quantized = kv_dtype == "int8"
+    cache_dt = {"fp32": "float32", "bf16": "bfloat16",
+                "int8": "int8"}[kv_dtype]
+    tokens = T.data("tokens", [batch_size, span_len], dtype="int32")
+    pos_ids = T.data("pos_ids", [batch_size, span_len], dtype="int32")
+    start_pos = T.data("start_pos", [batch_size], dtype="int32")
+    limit = T.data("limit", [batch_size], dtype="int32")
+    tables = T.data("block_tables", [batch_size, -1], dtype="int32")
+    n_head, d_head = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.hidden_size],
+                           param_attr=_param(cfg, "word_embedding"))
+    pemb = layers.embedding(pos_ids, size=[cfg.max_position,
+                                           cfg.hidden_size],
+                            param_attr=_param(cfg, "pos_embedding"))
+    x = M.elementwise_add(emb, pemb)
+    feed_names = ["tokens", "pos_ids", "start_pos", "limit",
+                  "block_tables"]
+    pk_out, pv_out, ks_out, vs_out = [], [], [], []
+    for i in range(cfg.num_layers):
+        pk = T.data(f"cache_pk_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        pv = T.data(f"cache_pv_{i}", [-1, n_head, -1, d_head],
+                    dtype=cache_dt)
+        feed_names += [f"cache_pk_{i}", f"cache_pv_{i}"]
+        kv_cache = {"k": pk, "v": pv, "mode": "paged", "tables": tables,
+                    "limit": limit}
+        if quantized:
+            pks = T.data(f"cache_pks_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            pvs = T.data(f"cache_pvs_{i}", [-1, n_head, -1],
+                         dtype="float32")
+            feed_names += [f"cache_pks_{i}", f"cache_pvs_{i}"]
+            kv_cache["k_scale"], kv_cache["v_scale"] = pks, pvs
+            x, npk, npv, nks, nvs = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=start_pos)
+            ks_out.append(nks)
+            vs_out.append(nvs)
+        else:
+            x, npk, npv = decoder_layer(
+                cfg, x, i, True, kv_cache=kv_cache, pos=start_pos)
+        pk_out.append(npk)
+        pv_out.append(npv)
+    logits = _tied_span_logits(cfg, x)                   # [B, S, V]
     from ..serving.kvpool import pool_feed_names
     cache_names = pool_feed_names(cfg.num_layers, quantized)
     by_name = {}

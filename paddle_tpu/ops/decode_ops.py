@@ -228,3 +228,104 @@ def sample_tokens(ctx, ins, attrs):
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(
         jnp.int32)
     return {"Out": jnp.where(temp <= 0.0, greedy, sampled)}
+
+
+@register_op("spec_accept", grad=False, needs_rng=True,
+             infer_shape=False)
+def spec_accept(ctx, ins, attrs):
+    """Speculative-decoding acceptance (Leviathan 2022 / Chen 2023
+    rejection sampling, specialized to a POINT-MASS draft distribution
+    — the n-gram drafter proposes tokens, not distributions, so
+    q = delta(d_i) and the accept probability min(1, p/q) reduces to
+    p(d_i); the residual on rejection is p with d_i removed,
+    renormalized). One call scores a whole verified span per row:
+
+    - Logits [B, S, V] float32: the verify pass's span logits —
+      position i is the model's next-token distribution AFTER the
+      current token and drafts d_1..d_i.
+    - Draft [B, K] int32 (K = S-1): the proposed tokens.
+    - Temperature [B] float32 / optional TopK [B] int32: the exact
+      per-row sampling config of ``sample_tokens`` — p is the same
+      temperature-scaled, top-k-masked softmax, so a row that accepts
+      nothing emits one token from exactly the distribution a plain
+      decode step would have used.
+    - NumDraft [B] int32: each row's real draft count (<= K); rows at
+      0 degrade to a plain single-token step inside the same call.
+
+    Greedy rows (t <= 0) accept d_i while it matches argmax and emit
+    argmax tokens throughout — BITWISE what sequential greedy decode
+    would produce. Stochastic rows accept d_i with probability
+    p_i(d_i) (one uniform draw per position) and sample the
+    correction/bonus from the residual (rejection) or from p_K
+    (full acceptance) — the output distribution is exactly the
+    non-speculative sampler's.
+
+    Out [B, S] int32: position j holds the token emitted for sequence
+    position pos+j+1, valid for j <= Accepted[b] (a+1 tokens per row);
+    Accepted [B] int32: leading draft tokens accepted (0..NumDraft).
+    """
+    logits = x_of(ins).astype(jnp.float32)
+    draft = x_of(ins, "Draft").astype(jnp.int32)
+    temp = x_of(ins, "Temperature").astype(jnp.float32)
+    topk = ins.get("TopK")
+    num_draft = x_of(ins, "NumDraft").astype(jnp.int32)
+    key = ctx.op_key(attrs)
+    u_key, cat_key = jax.random.split(key)
+    B, S, V = logits.shape
+    K = S - 1
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None, None]
+    if topk:
+        k = jnp.clip(topk[0].astype(jnp.int32), 1, V)            # [B]
+        sorted_desc = -jnp.sort(-logits, axis=-1)                # [B,S,V]
+        thresh = jnp.take_along_axis(
+            sorted_desc, (k - 1)[:, None, None], axis=-1)        # [B,S,1]
+        allowed = (topk[0].astype(jnp.int32) <= 0)[:, None, None] | \
+            (logits >= thresh)
+        scaled = jnp.where(allowed, scaled, _NEG_INF)
+
+    # per-position acceptance: greedy compares against argmax,
+    # stochastic draws one uniform per position against p_i(d_i)
+    p = jax.nn.softmax(scaled[:, :K, :], axis=-1)                # [B,K,V]
+    p_draft = jnp.take_along_axis(p, draft[:, :, None],
+                                  axis=-1)[:, :, 0]              # [B, K]
+    u = jax.random.uniform(u_key, (B, K))
+    is_greedy = temp <= 0.0                                      # [B]
+    accept = jnp.where(is_greedy[:, None],
+                       draft == greedy_tok[:, :K],
+                       u < p_draft)
+    steps = jnp.arange(K, dtype=jnp.int32)[None, :]
+    accept = accept & (steps < num_draft[:, None])
+    # leading run of accepts (a rejection stops everything after it)
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                axis=1).astype(jnp.int32)                        # [B]
+
+    # correction/bonus from position a: on rejection (a < num_draft)
+    # the rejected draft token is removed from the support (point-mass
+    # residual); on full acceptance p_a = p_K is the bonus distribution
+    row_scaled = jnp.take_along_axis(
+        scaled, a[:, None, None], axis=1)[:, 0, :]               # [B, V]
+    d_at_a = jnp.take_along_axis(
+        draft, jnp.clip(a, 0, max(K - 1, 0))[:, None],
+        axis=1)[:, 0] if K > 0 else jnp.zeros((B,), jnp.int32)
+    rejected = a < num_draft
+    excl = (jnp.arange(V, dtype=jnp.int32)[None, :]
+            == d_at_a[:, None]) & rejected[:, None]
+    corr_sample = jax.random.categorical(
+        cat_key, jnp.where(excl, _NEG_INF, row_scaled),
+        axis=-1).astype(jnp.int32)
+    corr_greedy = jnp.take_along_axis(greedy_tok, a[:, None],
+                                      axis=1)[:, 0]
+    corr = jnp.where(is_greedy, corr_greedy, corr_sample)        # [B]
+
+    # emitted tokens: accepted drafts then the correction (greedy rows
+    # emit argmax everywhere — identical to the accepted drafts on the
+    # accepted prefix); past-correction slots repeat it, ignored
+    # host-side
+    padded_draft = jnp.concatenate(
+        [draft, jnp.zeros((B, 1), jnp.int32)], axis=1)           # [B, S]
+    emit_steps = jnp.arange(S, dtype=jnp.int32)[None, :]
+    out = jnp.where(emit_steps < a[:, None], padded_draft,
+                    corr[:, None])
+    return {"Out": out, "Accepted": a}

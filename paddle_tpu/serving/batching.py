@@ -27,11 +27,12 @@ doomed micro-batch (``serving_expired_in_queue_total``).
 """
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
 from .metrics import (record_class_shed, record_class_done,
-                      record_expired_in_queue)
+                      record_expired_in_queue, record_spec_accept_ratio)
 from ..observability import tracing as _trace
 from ..observability.recorder import flight_recorder as _flightrec
 from ..resilience import (CircuitBreaker, CircuitOpenError, WatchdogTimeout,
@@ -556,15 +557,33 @@ class DecodeBatcher:
     (position counter, current token, sampling config, done) lives
     here; the device-side slot caches live in the GenerationEngine."""
 
-    def __init__(self, queue, engine, stats=None, watchdog_s=None):
+    def __init__(self, queue, engine, stats=None, watchdog_s=None,
+                 spec_k=None, drafter=None, brownout=None):
+        from ..flags import flag
         if watchdog_s is None:
-            from ..flags import flag
             watchdog_s = flag("serving_loop_watchdog_s")
         self.queue = queue
         self.engine = engine
         self.slots = engine.slots
         self.stats = stats
         self.watchdog_s = float(watchdog_s)
+        # speculative decoding (FLAGS_decode_spec_k > 0, paged pool
+        # only): between steps each live row proposes up to spec_k
+        # draft tokens (drafter; FLAGS_decode_spec_mode picks the
+        # default) verified in ONE span pass through the pool —
+        # rejection sampling keeps the output distribution exact. The
+        # draft depth is a LOAD knob: a windowed acceptance rate adapts
+        # it globally (low acceptance = wasted verify compute) and the
+        # brownout ladder shrinks it per-row for degraded classes
+        # before their admission degrades.
+        if spec_k is None:
+            spec_k = flag("decode_spec_k")
+        self.spec_k = int(spec_k) \
+            if getattr(engine, "pool", None) is not None else 0
+        self._drafter = drafter         # lazy: make_drafter on first use
+        self.brownout = brownout
+        self._accept_window = deque(maxlen=64)   # (accepted, proposed)
+        self._spec_scope = f"decode-{id(self) & 0xffffff:x}"
         self._stop = threading.Event()
         self._thread = None
         self._free = list(range(self.slots))
@@ -607,6 +626,21 @@ class DecodeBatcher:
         chunked-prefill (slot held, prompt still ingesting)."""
         return len(self._active) + self._admitting \
             + len(self._prefilling)
+
+    def spec_snapshot(self):
+        """Speculative-decoding state for health()/dashboards: the
+        configured depth, the window-adapted effective depth, and the
+        windowed acceptance rate (None until any drafting happened)."""
+        win = list(self._accept_window)
+        proposed = sum(p for _, p in win)
+        return {
+            "spec_k": self.spec_k,
+            "spec_k_effective": (self._adaptive_spec_k(self.spec_k)
+                                 if self.spec_k > 0 else 0),
+            "spec_accept_ratio": (
+                round(sum(a for a, _ in win) / proposed, 4)
+                if proposed else None),
+        }
 
     def stop(self, timeout=5):
         self._stop.set()
@@ -713,6 +747,102 @@ class DecodeBatcher:
             self._finish(req)
             return False
         return True
+
+    # -- speculative decoding ---------------------------------------------
+    def _get_drafter(self):
+        if self._drafter is None:
+            from ..models.generation import make_drafter
+            self._drafter = make_drafter(generator=self.engine.gen)
+        return self._drafter
+
+    def _adaptive_spec_k(self, k):
+        """Effective draft depth from the windowed acceptance rate —
+        the speculative analogue of the client's observed-p99 hedge
+        delay: a measured signal replaces the configured constant once
+        there is enough of it. Low acceptance means most of the verify
+        span is wasted compute, so the depth backs off (never below 1:
+        the window must keep refilling to observe recovery)."""
+        proposed = sum(p for _, p in self._accept_window)
+        if proposed < 32:
+            return k            # not enough signal yet: trust the flag
+        rate = sum(a for a, _ in self._accept_window) / proposed
+        if rate >= 0.5:
+            return k
+        if rate >= 0.25:
+            return max(k // 2, 1)
+        return 1
+
+    def _propose_drafts(self, k):
+        """Draft proposals for every live row: np int32
+        ``(drafts [slots, k], num_draft [slots])``. Per-row depth =
+        the window-adapted global depth, shrunk by the brownout ladder
+        for degraded priority classes, capped to the row's remaining
+        token budget minus one (the verify step always emits at least
+        one real token)."""
+        drafts = np.zeros((self.slots, k), np.int32)
+        nd = np.zeros((self.slots,), np.int32)
+        k_eff = self._adaptive_spec_k(k)
+        for slot, req in self._active.items():
+            kr = k_eff
+            if self.brownout is not None:
+                kr = self.brownout.draft_depth(
+                    priority_rank(req.priority), kr)
+            kr = min(int(kr),
+                     int(req.max_new_tokens) - len(req.out_tokens) - 1)
+            if kr <= 0:
+                continue
+            ctx = np.concatenate([
+                np.asarray(req.prompt, np.int32).reshape(-1),
+                np.asarray(req.out_tokens, np.int32)])
+            d = np.asarray(self._get_drafter().draft(ctx, kr),
+                           np.int32).reshape(-1)[:kr]
+            if d.size:
+                drafts[slot, :d.size] = d
+                nd[slot] = d.size
+        return drafts, nd
+
+    def _deliver_spec(self, out, acc, nd):
+        """Deliver one verify step's emitted runs: row ``slot`` takes
+        ``acc[slot]`` accepted drafts plus the correction/bonus token,
+        stopping early on EOS/budget (later tokens of the run are
+        dropped — their KV is garbage past the row's new position and
+        is overwritten before it is ever attended). Updates the
+        acceptance window, gauge, counters and flight events."""
+        accepted = proposed = rejected = 0
+        for slot in list(self._active):
+            req = self._active[slot]
+            if req.done():      # abandoned by its waiter
+                self._finish(req)
+                continue
+            a, n = int(acc[slot]), int(nd[slot])
+            accepted += a
+            proposed += n
+            if a < n:
+                rejected += 1
+                _flightrec().record("spec_rejected", slot=slot,
+                                    proposed=n, accepted=a)
+            alive = True
+            for j in range(a + 1):
+                alive = self._deliver_token(req, int(out[slot, j]))
+                if not alive:
+                    break
+            if alive:
+                self._pos[slot] += a + 1
+                self._tok[slot] = int(out[slot, a])
+        if self.stats:
+            self.stats.bump("spec_steps")
+            if proposed:
+                self.stats.bump("spec_drafted", proposed)
+            if accepted:
+                self.stats.bump("spec_accepted", accepted)
+            if rejected:
+                self.stats.bump("spec_rejected", rejected)
+        self._accept_window.append((accepted, proposed))
+        win_p = sum(p for _, p in self._accept_window)
+        if win_p:
+            record_spec_accept_ratio(
+                self._spec_scope,
+                sum(a for a, _ in self._accept_window) / win_p)
 
     def _fail_active_if_bank_lost(self, exc):
         """After an engine failure, a donated-call loss of the slot bank
@@ -1090,10 +1220,21 @@ class DecodeBatcher:
                 # rows the pool cannot grow are shed TYPED while the
                 # rest of the bank keeps decoding (their freed blocks
                 # unblock the next step's growth)
+                # speculative rows draft BEFORE the allocation pass so
+                # the whole verify span [pos, pos + nd + 1) is covered
+                # by blocks (and COW-duplicated when shared) up front
+                drafts = nd = None
+                if self.spec_k > 0 and self._active:
+                    drafts, nd = self._propose_drafts(self.spec_k)
                 prep = getattr(self.engine, "prepare_step", None)
                 if prep is not None:
+                    widths = None
+                    if nd is not None:
+                        widths = {slot: int(nd[slot]) + 1
+                                  for slot in self._active}
                     shed = prep({slot: int(self._pos[slot])
-                                 for slot in self._active})
+                                 for slot in self._active},
+                                widths=widths)
                     for slot, exc in shed.items():
                         req = self._active.get(slot)
                         if req is None:
@@ -1119,9 +1260,17 @@ class DecodeBatcher:
                           if r.trace is not None]
                 t_step0 = time.perf_counter()
                 try:
-                    toks = self.engine.step(self._tok, self._pos,
-                                            self._temp, self._topk,
-                                            budget=self.watchdog_s or None)
+                    if drafts is not None:
+                        live_mask = np.zeros((self.slots,), bool)
+                        live_mask[list(self._active)] = True
+                        out, acc = self.engine.spec_step(
+                            self._tok, self._pos, self._temp,
+                            self._topk, drafts, nd, live_mask,
+                            budget=self.watchdog_s or None)
+                    else:
+                        toks = self.engine.step(
+                            self._tok, self._pos, self._temp,
+                            self._topk, budget=self.watchdog_s or None)
                 except Exception as exc:  # noqa: BLE001
                     if self._epoch != epoch:
                         return       # deposed mid-step: restart() owns
@@ -1152,14 +1301,17 @@ class DecodeBatcher:
                     self.stats.hist["token"].observe(
                         time.perf_counter() - t_step0)
                     self.stats.observe_decode_step(live, self.slots)
-                for slot in list(self._active):
-                    req = self._active[slot]
-                    if req.done():      # abandoned by its waiter
-                        self._finish(req)
-                        continue
-                    self._pos[slot] += 1
-                    self._tok[slot] = toks[slot]
-                    self._deliver_token(req, int(toks[slot]))
+                if drafts is not None:
+                    self._deliver_spec(out, acc, nd)
+                else:
+                    for slot in list(self._active):
+                        req = self._active[slot]
+                        if req.done():      # abandoned by its waiter
+                            self._finish(req)
+                            continue
+                        self._pos[slot] += 1
+                        self._tok[slot] = toks[slot]
+                        self._deliver_token(req, int(toks[slot]))
                 # periodic paged-pool leak sweep: blocks held by slots
                 # no longer active are a bug — reclaim + flight-record
                 # them instead of bleeding capacity

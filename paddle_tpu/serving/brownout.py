@@ -150,6 +150,23 @@ class BrownoutController:
         depth_cap = max(queue_depth // 2, 1) if queue_depth else None
         return False, capped, depth_cap
 
+    def draft_depth(self, rank, k):
+        """Speculative draft depth for a row of priority ``rank`` at the
+        current level. Drafting is OPTIONAL work — extra verify compute
+        spent betting on acceptance — so the ladder shrinks it for the
+        same classes whose admission it degrades, before touching their
+        admission at the next rung: at level 1 ``batch`` rows draft at
+        half depth and ``best_effort`` rows stop drafting; at level 2
+        ``batch`` stops too. Interactive rows keep their full ``k`` at
+        every level (they degrade LAST, same as admission)."""
+        k = int(k)
+        lvl = self.level()
+        if lvl <= 0 or rank <= 0 or k <= 0:
+            return k
+        if rank >= 2 or lvl >= 2:
+            return 0
+        return max(k // 2, 1)
+
     def snapshot(self):
         with self._lock:
             return {"level": self._level, "enabled": self.enabled,

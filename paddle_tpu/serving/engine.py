@@ -582,24 +582,30 @@ class GenerationEngine:
         if self.pool is not None:
             self.pool.free_slot(slot)
 
-    def prepare_step(self, active_pos):
+    def prepare_step(self, active_pos, widths=None):
         """Allocation-on-append before a decode step: grow each live
         row's blocks to cover the slot its next token writes
-        (``active_pos`` maps slot -> position). Returns ``{slot: exc}``
-        for rows the pool could not grow — the batcher sheds exactly
-        those rows (typed) while the rest of the bank keeps decoding.
-        Dense mode returns ``{}``."""
+        (``active_pos`` maps slot -> position). ``widths`` (slot ->
+        token count, default 1 everywhere) covers a speculative verify
+        span instead: the row writes ``[pos, pos + width)`` in one
+        step, so allocation AND the COW barrier extend over the whole
+        span — a shared prefix block must be duplicated BEFORE the
+        speculative write lands, even for draft positions that may be
+        rejected. Returns ``{slot: exc}`` for rows the pool could not
+        grow — the batcher sheds exactly those rows (typed) while the
+        rest of the bank keeps decoding. Dense mode returns ``{}``."""
         if self.pool is None:
             return {}
         shed = {}
         for slot, p in active_pos.items():
+            w = max(int(widths.get(slot, 1)) if widths else 1, 1)
             try:
-                self.pool.ensure(slot, int(p))
+                self.pool.ensure(slot, int(p) + w - 1)
                 if self.pool.prefix_enabled:
-                    # COW barrier: the block this token lands in may be
+                    # COW barrier: any block this span lands in may be
                     # co-owned by the prefix cache (or another slot
                     # that adopted it) — duplicate before writing
-                    self.pool.prepare_write(slot, int(p), int(p) + 1)
+                    self.pool.prepare_write(slot, int(p), int(p) + w)
             except Exception as exc:  # noqa: BLE001 — per-row shed
                 shed[slot] = exc
         return shed
@@ -913,3 +919,68 @@ class GenerationEngine:
             logits, np.ascontiguousarray(temperature, dtype=np.float32),
             np.ascontiguousarray(top_k, dtype=np.int32), self._key)
         return np.asarray(toks)
+
+    def spec_step(self, tokens, pos, temperature, top_k, drafts,
+                  num_draft, live, budget=None):
+        """One speculative verify + accept step over the whole slot
+        bank (paged pool only — the dense bank's fixed-span cache write
+        clamps near the row end, so the batcher never routes it here).
+
+        ``drafts`` is np int32 [slots, K] (drafter proposals per row),
+        ``num_draft`` np int32 [slots] counts the real drafts per row
+        (0 = the row takes a plain 1-token step through the same
+        verify executable), ``live`` marks occupied slots — free rows
+        get ``limit`` 0 so every one of their span writes routes to the
+        pool's trash block. Returns ``(out [slots, K+1], accepted
+        [slots])``: row ``s`` emits ``out[s, :accepted[s] + 1]`` tokens
+        (accepted drafts, then the correction/bonus token), all drawn
+        from the target distribution by rejection sampling.
+
+        Same watchdog discipline as :meth:`step`: the worker only
+        computes; pool adoption and key assignment happen on this
+        thread after it returns."""
+        if self.pool is None:
+            raise ValueError(
+                "speculative decoding requires the paged KV pool "
+                "(FLAGS_kv_paged / paged=True) — the dense bank has no "
+                "trash-routed multi-token write")
+        maybe_fail("serving.decode_step")
+        self._ensure_caches()
+        tok = np.ascontiguousarray(tokens, dtype=np.int32)
+        posc = np.ascontiguousarray(pos, dtype=np.int32)
+        drafts = np.ascontiguousarray(drafts, dtype=np.int32)
+        nd = np.ascontiguousarray(num_draft, dtype=np.int32)
+        S = drafts.shape[1] + 1
+        cfg = self.gen.cfg
+        feed = dict(self.pool.arrays())
+        feed["tokens"] = np.concatenate([tok[:, None], drafts], axis=1)
+        feed["pos_ids"] = np.clip(
+            posc[:, None] + np.arange(S, dtype=np.int32)[None, :],
+            0, cfg.max_position - 1)
+        feed["start_pos"] = posc
+        feed["limit"] = np.where(np.asarray(live, bool), nd + 1,
+                                 0).astype(np.int32)
+        feed["block_tables"] = np.ascontiguousarray(self.pool.tables)
+        kind = f"verify_paged_{self.pool.dtype}"
+        key = self._key
+
+        def _verify():
+            return self.gen._invoke(kind, "decode", feed, key)
+
+        try:
+            if budget:
+                fetches, new_key = run_with_watchdog(
+                    _verify, budget, what="serving spec verify step")
+            else:
+                fetches, new_key = _verify()
+        except Exception:
+            self._drop_bank()  # pool arrays were donated in
+            raise
+        from .kvpool import adopt_decode_fetches
+        logits = adopt_decode_fetches(self.pool, fetches)
+        self._key = new_key
+        out, acc, self._key = self.gen._run_spec_accept(
+            logits, drafts,
+            np.ascontiguousarray(temperature, dtype=np.float32),
+            np.ascontiguousarray(top_k, dtype=np.int32), nd, self._key)
+        return np.asarray(out), np.asarray(acc)

@@ -127,6 +127,18 @@ _EXPIRED_IN_QUEUE = default_registry().counter(
     "waiting (failed typed instead of dequeuing into a doomed batch)")
 
 
+_SPEC_ACCEPT = default_registry().gauge(
+    "serving_spec_accept_ratio",
+    "windowed draft-token acceptance rate of the speculative decode "
+    "loop (accepted / proposed over the recent window), by decode-loop "
+    "scope — the signal that drives adaptive per-request draft depth",
+    labels=("scope",), max_series=256)
+
+
+def record_spec_accept_ratio(scope, ratio):
+    _SPEC_ACCEPT.set(float(ratio), labels=(str(scope),))
+
+
 def record_class_shed(priority):
     _CLASS_SHED.inc(labels=(str(priority),))
 
@@ -203,6 +215,11 @@ _COUNTER_KEYS = (
     "weight_reloads",       # successful reload_weights swaps
     "hedge_dedup_hits",     # hedged twins joined in flight
     "requests_cancelled",   # cancel op (hedge losers)
+    # -- speculative decoding (paged verify + rejection sampling) --
+    "spec_steps",           # verify steps taken (vs plain decode_steps)
+    "spec_drafted",         # draft tokens proposed across all rows
+    "spec_accepted",        # draft tokens accepted by verification
+    "spec_rejected",        # verify runs with >= 1 rejected draft
 )
 
 
@@ -320,6 +337,9 @@ class ServingStats:
         out["decode_occupancy"] = round(
             c["decode_rows"] / c["decode_slot_rows"], 4) \
             if c["decode_slot_rows"] else 0.0
+        out["spec_accept_ratio"] = round(
+            c["spec_accepted"] / c["spec_drafted"], 4) \
+            if c["spec_drafted"] else 0.0
         for s, h in self.hist.items():
             snap = h.snapshot()
             for k, v in snap.items():

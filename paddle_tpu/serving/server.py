@@ -207,6 +207,10 @@ class InferenceServer:
             lambda: (len(self.slo_monitor.breached())
                      if self.slo_monitor is not None else 0),
             scope=f"server-{id(self) & 0xffffff:x}")
+        if self.decode_batcher is not None:
+            # the ladder is also the speculative-decoding load knob:
+            # the batcher shrinks degraded classes' draft depth per row
+            self.decode_batcher.brownout = self.brownout
         self.host = host
         self.port = int(port)
         self._key = auth_key if auth_key is not None else default_key()
@@ -560,6 +564,10 @@ class InferenceServer:
         if self.gen_queue is not None:
             h["decode_queue_depth"] = len(self.gen_queue)
             h["decode_active_rows"] = self.decode_batcher.inflight()
+            if self.decode_batcher.spec_k > 0:
+                # the speculative load knob's observable state: depth +
+                # windowed acceptance next to the load signals
+                h.update(self.decode_batcher.spec_snapshot())
             pool = self.gen_engine.pool
             if pool is not None:
                 # the router's least-loaded dispatch reads this: live

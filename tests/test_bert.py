@@ -4,8 +4,10 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.models import bert
+import pytest
 
 
+@pytest.mark.slow
 def test_bert_tiny_trains():
     main = fluid.Program()
     startup = fluid.Program()

@@ -6,6 +6,7 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import dygraph
 from paddle_tpu.models import bert, bert_dygraph
+import pytest
 
 
 def _args(feed):
@@ -14,6 +15,7 @@ def _args(feed):
              "mask_pos", "mask_label", "labels")]
 
 
+@pytest.mark.slow
 def test_eager_trains():
     cfg = bert.BertConfig.tiny()
     feed = bert.random_batch(cfg, 4, 16, 3)
@@ -32,6 +34,7 @@ def test_eager_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_jit_step_trains_and_matches_param_count():
     cfg = bert.BertConfig.tiny()
     feed = bert.random_batch(cfg, 4, 16, 3)

@@ -8,6 +8,7 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers
 import paddle_tpu.layers.tensor as T
+import pytest
 
 
 def _fit(main, startup, feed, loss, steps=30):
@@ -194,6 +195,7 @@ def test_fit_a_line():
     assert ls[-1] < 0.1 * ls[0], (ls[0], ls[-1])
 
 
+@pytest.mark.slow
 def test_recognize_digits_conv():
     """reference book/test_recognize_digits.py conv variant: two
     simple_img_conv_pool blocks (fluid.nets) over the mnist reader."""
@@ -227,6 +229,7 @@ def test_recognize_digits_conv():
     assert np.mean(accs[-10:]) > 0.5, np.mean(accs[-10:])
 
 
+@pytest.mark.slow
 def test_image_classification_vgg():
     """reference book/test_image_classification.py vgg path:
     img_conv_group blocks over the cifar reader."""
@@ -260,6 +263,7 @@ def test_image_classification_vgg():
     assert ls[-1] < 0.8 * np.mean(ls[:3]), (np.mean(ls[:3]), ls[-1])
 
 
+@pytest.mark.slow
 def test_label_semantic_roles():
     """reference book/test_label_semantic_roles.py shape: embedding ->
     GRU -> linear_chain_crf over token tags; crf cost drops. (conll05 is
@@ -292,6 +296,7 @@ def test_label_semantic_roles():
     assert ls[-1] < 0.5 * ls[0], (ls[0], ls[-1])
 
 
+@pytest.mark.slow
 def test_rnn_encoder_decoder():
     """reference book/test_rnn_encoder_decoder.py: GRU encoder -> GRU
     decoder with teacher forcing; token CE drops (full seq2seq beam

@@ -133,6 +133,7 @@ def test_contrib_wrapper_smoke():
     assert np.asarray(outs[3]).shape == (2, 3, 5)
 
 
+@pytest.mark.slow
 def test_basic_gru_and_lstm_train():
     B, T, D, H = 4, 5, 6, 8
     xv = RNG.standard_normal((B, T, D)).astype(np.float32)

@@ -12,6 +12,7 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -62,6 +63,7 @@ def test_data_generator_roundtrip_through_dataset():
                                    [0.1, 0.2, 0.3], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_global_shuffle_moves_samples_across_processes():
     """2 subprocesses + shared spool dir: after global_shuffle each
     process holds a mix of BOTH input shards (real redistribution, not a

@@ -313,6 +313,7 @@ def test_token_level_deadline_frees_slot(tiny_gen):
 # bench smoke
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_bench_decode_smoke():
     """bench.py --config decode CPU smoke: completes, reports tokens/s
     for seq {128, 256}, and the KV path beats full recompute by the

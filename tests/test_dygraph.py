@@ -268,6 +268,7 @@ def test_inplace_mutation_does_not_corrupt_earlier_vjp():
         np.testing.assert_allclose(x.gradient(), [7.0])  # 2*3 + 1
 
 
+@pytest.mark.slow
 def test_lstm_gru_cells_train():
     """Dygraph LSTMCell/GRUCell: one-step cells unroll over time and
     train (reference dygraph/nn.py LSTMCell/GRUUnit pattern)."""

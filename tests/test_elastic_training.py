@@ -632,6 +632,7 @@ def test_fleet_load_checkpoint_typed_on_incomplete(tmp_path):
             fleet_obj.load_checkpoint(exe, path)
 
 
+@pytest.mark.slow
 def test_bench_train_chaos_smoke():
     """bench.py --config train_chaos CPU smoke: reports checkpoint
     overhead and the preempt/resume/recovery latencies."""

@@ -171,6 +171,7 @@ def test_static_graph_op_and_gradients():
                                    rtol=2e-4, atol=2e-4, err_msg=name)
 
 
+@pytest.mark.slow
 def test_bert_flagship_with_flash_attention():
     """The flagship encoder trains with attn_mechanism='flash' (XLA
     composite on CPU — same op the TPU bench runs with the Pallas path)."""

@@ -5,6 +5,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.models import gpt
+import pytest
 
 
 def test_gpt_trains_and_loss_scale():
@@ -54,6 +55,7 @@ def test_gpt_causality():
                                rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_tp_matches_single_device():
     """Megatron-style tp over the decoder: per-step losses identical to
     the unsharded run (same parity bar as test_sharding's BERT case)."""

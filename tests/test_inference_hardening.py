@@ -11,6 +11,7 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+import pytest
 
 RNG = np.random.default_rng(31)
 
@@ -91,6 +92,7 @@ def test_aot_prepare_warms_cache(tmp_path):
     assert warm_time < cold_time * 0.5, (cold_time, warm_time)
 
 
+@pytest.mark.slow
 def test_model_zoo_regression(tmp_path):
     """Model-zoo harness over several saved book-style models: reload,
     check output deltas vs the save-time outputs, enforce a latency

@@ -198,6 +198,7 @@ def test_load_missing_raises(tmp_path):
                                 main_program=main)
 
 
+@pytest.mark.slow
 def test_sharded_save_restore_resume(tmp_path):
     """Checkpoint a tp-sharded training run (scope holds mesh-sharded jax
     Arrays), restore into a fresh scope, keep training under the mesh —

@@ -5,8 +5,10 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
+import pytest
 
 
+@pytest.mark.slow
 def test_layer_surface_batch2_builds_and_runs():
     
     rng = np.random.default_rng(0)

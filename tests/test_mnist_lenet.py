@@ -4,6 +4,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.models.lenet import build_lenet_train
+import pytest
 
 
 def _synthetic_mnist(n, seed=0):
@@ -17,6 +18,7 @@ def _synthetic_mnist(n, seed=0):
     return imgs, labels
 
 
+@pytest.mark.slow
 def test_lenet_trains():
     main, startup, feeds, fetches = build_lenet_train(lr=0.01,
                                                       optimizer="adam")

@@ -5,8 +5,10 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import dygraph, layers
 from paddle_tpu.models import resnet, widedeep, transformer
+import pytest
 
 
+@pytest.mark.slow
 def test_resnet18_tiny_trains():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -95,6 +97,7 @@ def test_widedeep_sharded_tables():
         assert w.sharding.shard_shape(w.shape)[0] == w.shape[0] // 4
 
 
+@pytest.mark.slow
 def test_dygraph_transformer_tiny_trains():
     with dygraph.guard():
         model = transformer.Transformer(

@@ -139,10 +139,11 @@ def test_serving_stats_snapshot_keys_unchanged():
         "tokens_generated", "decode_steps", "decode_rows",
         "decode_slot_rows", "engine_failures", "watchdog_timeouts",
         "loop_restarts", "weight_reloads", "hedge_dedup_hits",
-        "requests_cancelled", "kv_exports", "kv_imports"}
+        "requests_cancelled", "kv_exports", "kv_imports",
+        "spec_steps", "spec_drafted", "spec_accepted", "spec_rejected"}
     derived = {"uptime_s", "throughput_rps", "mean_batch_size",
                "batch_occupancy", "tokens_per_s", "decode_occupancy",
-               "queue_depth"}
+               "queue_depth", "spec_accept_ratio"}
     stage_keys = {f"{s}_{k}" for s in ServingStats.STAGES
                   for k in ("count", "mean_ms", "p50_ms", "p99_ms",
                             "max_ms")}
@@ -615,6 +616,7 @@ def test_metrics_wire_op_and_trace_propagation(tmp_path):
     profiler.reset_profiler()
 
 
+@pytest.mark.slow
 def test_generate_trace_covers_prefill_and_decode():
     """One traced generation yields prefill + per-token decode spans
     under the same trace id (the decode slot bank threads the

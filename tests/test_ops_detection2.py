@@ -10,6 +10,7 @@ import numpy as np
 import paddle_tpu as fluid  # noqa: F401  (registers ops)
 
 from op_test import make_op_test as _t
+import pytest
 
 RNG = np.random.default_rng(44)
 BBOX_CLIP = np.log(1000.0 / 16.0)
@@ -225,6 +226,7 @@ def _np_generate_proposals(scores, deltas, im_info, anchors, variances,
             np.array(counts, np.int32))
 
 
+@pytest.mark.slow
 def test_generate_proposals():
     N, A, H, W = 2, 3, 4, 4
     scores = RNG.random((N, A, H, W)).astype(np.float32)

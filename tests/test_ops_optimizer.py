@@ -3,6 +3,7 @@ tests/unittests/test_adam_op.py, test_momentum_op.py, test_sgd_op.py)."""
 import numpy as np
 
 from op_test import OpTest
+import pytest
 
 RNG = np.random.default_rng(5)
 
@@ -167,6 +168,7 @@ def test_lamb():
     t.check_output(rtol=1e-3, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_optimizer_classes_converge():
     """Every optimizer class drives a tiny quadratic to lower loss
     (install_check-style)."""

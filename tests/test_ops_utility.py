@@ -8,6 +8,7 @@ test_beam_search_decode_op.py, test_tensor_array_to_tensor.py)."""
 import numpy as np
 
 from op_test import make_op_test as _t
+import pytest
 
 RNG = np.random.default_rng(33)
 
@@ -293,6 +294,7 @@ def _np_chunk_eval(inf, lab, lens, num_types, scheme, excluded=()):
     return p, r, f1, n_inf, n_lab, n_cor
 
 
+@pytest.mark.slow
 def test_chunk_eval():
     for scheme, num_types in (("IOB", 3), ("IOE", 3), ("IOBES", 2),
                               ("plain", 4)):

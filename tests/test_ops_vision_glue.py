@@ -123,6 +123,7 @@ def test_affine_grid_identity():
     t.check_grad(["Theta"], "Output", max_relative_error=0.01)
 
 
+@pytest.mark.slow
 def test_deformable_conv_zero_offset_matches_conv():
     """With zero offsets and unit mask, deformable conv == plain conv."""
     B, Cin, Cout, H, W, k = 1, 2, 3, 5, 5, 3
@@ -163,6 +164,7 @@ def test_deformable_conv_integer_offset_shifts():
        {"Output": ref}).check_output(atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_psroi_pool():
     out_c, ph, pw = 2, 2, 2
     B, H, W = 1, 4, 4
@@ -197,6 +199,7 @@ def test_prroi_pool_constant_region():
     t.check_grad(["X"], "Out", max_relative_error=0.02)
 
 
+@pytest.mark.slow
 def test_yolov3_loss_finite_and_differentiable():
     B, cls, Hc = 2, 3, 4
     anchors = [10, 13, 16, 30, 33, 23]

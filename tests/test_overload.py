@@ -335,6 +335,7 @@ def test_router_failover_respects_retry_budget():
         router.stop()
 
 
+@pytest.mark.slow
 def test_hedge_volume_respects_budget_under_saturation(tiny_gpt,
                                                        fault_points):
     """Satellite regression for the retry-storm path: under sustained
@@ -643,6 +644,7 @@ def _p99(lats, prio):
     return float(np.percentile(np.asarray(xs), 99)) if xs else None
 
 
+@pytest.mark.slow
 def test_overload_3x_budgets_brownout_acceptance(tiny_gpt):
     """The acceptance scenario: 3x offered load with chaos jitter,
     budgets + brownout + priority admission on. Gates: interactive p99
@@ -718,6 +720,7 @@ def test_overload_3x_budgets_brownout_acceptance(tiny_gpt):
             srv.stop()
 
 
+@pytest.mark.slow
 def test_overload_priority_protects_interactive_fast(tiny_gpt):
     """Tier-1-sized slice of the acceptance scenario: one replica at
     ~3x its slot capacity — interactive requests (deadline-carrying,

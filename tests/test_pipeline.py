@@ -6,6 +6,7 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+import pytest
 
 B, D = 16, 8
 S, M = 2, 4
@@ -75,6 +76,7 @@ def test_pipeline_pp_matches_sequential():
     assert seq[-1] < seq[0], seq  # and it actually trains
 
 
+@pytest.mark.slow
 def test_pipeline_with_dp_axis():
     """pp x dp mesh: batch sharded over dp inside the rotation."""
     mesh = make_mesh(MeshConfig(pp=S, dp=2))

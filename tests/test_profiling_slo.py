@@ -388,6 +388,7 @@ def test_slo_chaos_delay_breach_recovery_single_server():
         server.stop()
 
 
+@pytest.mark.slow
 def test_slo_breach_shifts_router_dispatch_and_recovers():
     """Acceptance (fleet half): an injected slow handler on ONE replica
     breaches its p99 rule; the router's probed ``slo_breached`` state

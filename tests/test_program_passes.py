@@ -14,6 +14,8 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.framework import passes
+import pytest
+
 from paddle_tpu.framework.passes import (Pass, UnknownPassError,
                                          apply_passes, get_pass)
 
@@ -314,6 +316,7 @@ def test_bucket_byte_cap_splits_buckets():
     assert total == 6                # nothing lost, nothing duplicated
 
 
+@pytest.mark.slow
 def test_fused_optimizer_bitwise_parity_all_types():
     """Acceptance gate: fused updates match per-param updates BITWISE —
     params and fetched losses over K=8 steps, guard off and on."""
@@ -330,6 +333,7 @@ def test_fused_optimizer_bitwise_parity_all_types():
             _assert_snapshots_equal(s0, s1)
 
 
+@pytest.mark.slow
 def test_flag_zero_reproduces_unoptimized_lowering():
     """FLAGS_program_passes=0 must restore today's behavior bitwise —
     including the RNG stream (dropout on)."""
@@ -344,6 +348,7 @@ def test_flag_zero_reproduces_unoptimized_lowering():
     _assert_snapshots_equal(s_off, s_on)
 
 
+@pytest.mark.slow
 def test_run_steps_composes_with_passes():
     """The pipeline must compose with the fused K-step scan lowering:
     run_steps with passes on == sequential run() with passes off,
@@ -506,6 +511,7 @@ def test_pass_profiler_events():
     assert any(n.startswith("compile/program_") for n in names), names
 
 
+@pytest.mark.slow
 def test_bench_passes_smoke():
     """bench.py --config passes: the A/B (passes on/off) record reports
     lowered-op-count and trace+compile reductions on a BERT-shaped

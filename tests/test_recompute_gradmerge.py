@@ -10,6 +10,7 @@ import jax
 import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.framework.lowering import analyze_block_io, build_block_fn
+import pytest
 
 
 def _deep_mlp(use_recompute, every=2, n_layers=6, seed=1):
@@ -51,6 +52,7 @@ def _stablehlo(main, loss, feed, scope):
                              jax.random.PRNGKey(0)).as_text()
 
 
+@pytest.mark.slow
 def test_recompute_exact_loss_parity():
     feed = _feed()
     traces = {}
@@ -66,6 +68,7 @@ def test_recompute_exact_loss_parity():
     np.testing.assert_allclose(traces[True], traces[False], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_recompute_reemits_segments_behind_barrier():
     """The backward must read RE-computed activations: the emitted module
     contains the duplicated forward matmuls pinned behind

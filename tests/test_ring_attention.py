@@ -2,6 +2,7 @@
 attention, sharded-vs-unsharded parity, and gradient flow (north-star
 long-context capability, SURVEY §5.7)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -129,6 +130,7 @@ def test_long_sequence_trains_through_ring():
     assert losses[-1] < 0.5 * losses[0], losses[::8]
 
 
+@pytest.mark.slow
 def test_bert_flagship_with_ring_attention():
     """The flagship encoder runs with attn_mechanism='ring' on a dp x sp
     mesh and trains."""
@@ -234,6 +236,7 @@ def test_head_broadcast_causal_mask_both_mechanisms():
                                    atol=1e-5, err_msg=f"{mech} sharded")
 
 
+@pytest.mark.slow
 def test_native_causal_flag_both_mechanisms():
     """causal=True masks from block indices (the ring materializes no
     [S,S] mask and skips fully-dead blocks): output AND grads match the

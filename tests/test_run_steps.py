@@ -18,6 +18,7 @@ from paddle_tpu.framework.executor import RNG_STATE_NAME
 from paddle_tpu.parallel.compiler import CompiledProgram
 from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
 from paddle_tpu.resilience import NonFiniteError
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -173,6 +174,7 @@ def test_check_nan_inf_raises_naming_fused_step():
             assert "fused step 2/5" in str(e)
 
 
+@pytest.mark.slow
 def test_skip_nonfinite_rollback_mid_slab():
     """NaN injected mid-slab: the in-graph lax.cond rollback must leave
     exactly the same params/RNG as the host-side per-step skip path, and
@@ -400,6 +402,7 @@ def test_profiler_step_time_histogram():
     assert profiler.step_time_histogram()["count"] == 0
 
 
+@pytest.mark.slow
 def test_bench_train_loop_smoke():
     """bench.py --config train_loop CPU smoke path: completes quickly and
     reports the K=1 vs fused-K steps/sec table."""

@@ -5,6 +5,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.models import seq2seq
+import pytest
 
 V, E, H = 12, 16, 64
 T_SRC, T_TGT, B = 5, 6, 16
@@ -19,6 +20,7 @@ def _batch(rng):
     return src, tgt_in, tgt_out
 
 
+@pytest.mark.slow
 def test_seq2seq_copy_task_and_beam_decode():
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 8

@@ -159,6 +159,7 @@ def test_kv_import_validates_geometry_and_capacity():
     assert tiny.blocks_in_use() == 0 and tiny.holders() == {}
 
 
+@pytest.mark.slow
 def test_disaggregated_split_matches_colocated_bitwise(tiny_gpt):
     """Tentpole acceptance: prefill on replica A, KV blocks over the
     wire into replica B's pool, greedy decode there — token-for-token
@@ -209,6 +210,7 @@ def test_prefill_requires_paged_pool(tiny_gpt):
 
 # ------------------------------------------------------- router tier
 
+@pytest.mark.slow
 def test_router_routes_generate_and_scrapes_telemetry(tiny_gpt):
     """A Client pointed at the router cannot tell it from a replica;
     dispatch telemetry (probed health incl. kvpool occupancy) shows up
@@ -244,6 +246,7 @@ def test_router_routes_generate_and_scrapes_telemetry(tiny_gpt):
             r.stop()
 
 
+@pytest.mark.slow
 def test_router_disaggregated_two_hop_parity(tiny_gpt):
     """Routed two-hop generate (prefill replica -> KV migration ->
     decode replica) matches the colocated greedy output bitwise;
@@ -493,6 +496,7 @@ def test_two_hop_trace_timeline(tiny_gpt):
 
 # ------------------------------------------------------- chaos kill
 
+@pytest.mark.slow
 def test_fleet_chaos_kill_replica_mid_generation(tiny_gpt):
     """Acceptance: kill one of three replicas while generations are in
     flight. Every request either completes or fails TYPED; the router

@@ -8,6 +8,7 @@ from paddle_tpu.models import bert
 from paddle_tpu.parallel.mesh import (make_mesh, MeshConfig, partition_spec,
                                       sharding_for)
 from paddle_tpu.parallel.compiler import CompiledProgram
+import pytest
 
 
 def _build(cfg, batch, seq, sp_shard=False, tp_shard=False):
@@ -21,6 +22,7 @@ def _build(cfg, batch, seq, sp_shard=False, tp_shard=False):
     return main, startup, out
 
 
+@pytest.mark.slow
 def test_dp_tp_sp_train_step():
     mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=2))
     cfg = bert.BertConfig.tiny()
@@ -40,6 +42,7 @@ def test_dp_tp_sp_train_step():
     assert losses[2] < losses[0]
 
 
+@pytest.mark.slow
 def test_tp_param_actually_sharded():
     mesh = make_mesh(MeshConfig(dp=4, tp=2))
     cfg = bert.BertConfig.tiny()
@@ -63,6 +66,7 @@ def test_tp_param_actually_sharded():
         assert m.sharding.shard_shape(m.shape)[1] == m.shape[1] // 2
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device():
     """Same program, same data: mesh run must match single-device run."""
     cfg = bert.BertConfig.tiny()
@@ -96,6 +100,7 @@ def test_partition_spec_sanitation():
     assert partition_spec(mesh, ("dp",), (4, 6)) == P("dp", None)
 
 
+@pytest.mark.slow
 def test_tp_matches_single_device():
     """Megatron-style tp sharding must be numerically identical to the
     single-device run, per training step (the strong parity check the
@@ -121,6 +126,7 @@ def test_tp_matches_single_device():
     np.testing.assert_allclose(results[0], results[1], rtol=3e-4)
 
 
+@pytest.mark.slow
 def test_sp_matches_single_device():
     """sp activation sharding: same per-step losses as unsharded."""
     cfg = bert.BertConfig.tiny()

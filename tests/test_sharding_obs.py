@@ -372,6 +372,7 @@ def test_flags_off_records_nothing():
     assert shobs.recent_observations() == {}
 
 
+@pytest.mark.slow
 def test_gpt_dp_mesh_flag_off_bitwise_parity():
     """The audit only READS the compiled artifact: a GPT dp-mesh train
     step with the flags on is bitwise the flags-off step (losses and a

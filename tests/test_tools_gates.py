@@ -9,6 +9,7 @@ import sys
 import tempfile
 
 import paddle_tpu as fluid
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(REPO, "tools")
@@ -161,6 +162,7 @@ def _save_tools_mlp(tmp):
     return tmp
 
 
+@pytest.mark.slow
 def test_profile_program_gate(tmp_path):
     """tools/profile_program.py gates in tier-1: exit 0 on a clean
     program (per-op + memory report), exit 1 with a NAMED finding when
@@ -216,6 +218,7 @@ def _save_tools_mlp_sharded(tmp):
     return tmp
 
 
+@pytest.mark.slow
 def test_shard_report_gate(tmp_path):
     """tools/shard_report.py gates in tier-1: exit 0 (audit clean) on a
     tp-sharded program, exit 1 NAMING the replicated param on the same
@@ -494,6 +497,7 @@ with open(sys.argv[1], "w") as f:
 """
 
 
+@pytest.mark.slow
 def test_train_report_goodput_floor_on_recovery_heavy_run(tmp_path):
     """tools/train_report.py --assert-goodput-floor as the multi-slice
     CI gate: a REAL slice-loss drill (subprocess, 8 virtual devices,
@@ -596,6 +600,7 @@ def _save_tools_gpt_serving(tmp, kind, sharded):
     return tmp
 
 
+@pytest.mark.slow
 def test_shard_report_gate_serving_executables(tmp_path):
     """The pod-serving executables run through the SAME replicated-
     param CI gate as training programs: tp-annotated gpt_prefill AND
@@ -671,3 +676,44 @@ def test_bench_compare_serving_podscale_keys(tmp_path):
          "configs.fleet.prefix_affinity.cache_hit_ratio"], 10.0)
     assert len(regs) == 2
     assert any("warm_ms" in r for r in regs)
+
+
+def test_bench_compare_speculative_keys(tmp_path):
+    """tools/bench_compare.py over the speculative-decoding rows: the
+    best-K tokens/s, the batch-1 speedup over the plain paged kernel
+    and the draft acceptance rate are all higher-is-better; a record
+    where drafting silently stopped paying (speedup ~1x, acceptance 0)
+    fails the gate by name."""
+    import bench_compare
+
+    def record(tps8, speedup, accept):
+        return {"speculative": {
+            "0": {"tokens_per_sec": 900.0},
+            "8": {"tokens_per_sec": tps8, "acceptance_rate": accept},
+            "speedup_vs_paged_at_batch1": speedup}}
+
+    p_old = str(tmp_path / "old.json")
+    p_ok = str(tmp_path / "ok.json")
+    p_bad = str(tmp_path / "bad.json")
+    with open(p_old, "w") as f:
+        json.dump(record(2400.0, 2.6, 0.97), f)
+    with open(p_ok, "w") as f:
+        json.dump(record(2300.0, 2.5, 0.95), f)
+    with open(p_bad, "w") as f:
+        # the drafter stopped proposing: every verify pass pays the
+        # span cost for zero accepted tokens
+        json.dump(record(880.0, 0.98, 0.0), f)
+    keys = ["--key", "speculative.8.tokens_per_sec",
+            "--key", "speculative.speedup_vs_paged_at_batch1",
+            "--key", "speculative.8.acceptance_rate"]
+    assert bench_compare.main(
+        [p_old, p_ok, *keys, "--max-regress-pct", "10"]) == 0
+    assert bench_compare.main(
+        [p_old, p_bad, *keys, "--max-regress-pct", "10"]) == 1
+    regs, _ = bench_compare.compare(
+        record(2400.0, 2.6, 0.97), record(880.0, 0.98, 0.0),
+        ["speculative.8.tokens_per_sec",
+         "speculative.speedup_vs_paged_at_batch1",
+         "speculative.8.acceptance_rate"], 10.0)
+    assert len(regs) == 3
+    assert any("speedup_vs_paged_at_batch1" in r for r in regs)

@@ -11,6 +11,7 @@ import threading
 import time
 
 import numpy as np
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -66,6 +67,7 @@ def _run_cluster(trainers, sync_mode=True, steps=5, lr=0.1,
     return results, ps_res
 
 
+@pytest.mark.slow
 def test_pserver_sync_matches_local():
     """1 trainer, sync PS: per-step losses equal the local run (identical
     init, data, and SGD updates — just applied on the server)."""
@@ -77,6 +79,7 @@ def test_pserver_sync_matches_local():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pserver_sync_two_trainers():
     """2 trainers, same data: both see identical losses (they pull the
     same global params each round), and the loss decreases."""
@@ -86,6 +89,7 @@ def test_pserver_sync_two_trainers():
     assert a[-1] < a[0], a
 
 
+@pytest.mark.slow
 def test_pserver_async_trains():
     """Async (Hogwild) mode: no barriers, updates on arrival; training
     still converges."""
@@ -167,6 +171,7 @@ def test_geo_sgd_and_sparse_table():
     cli.stop_servers([ep])
 
 
+@pytest.mark.slow
 def test_widedeep_through_transpiler_sync_and_async():
     """The BASELINE config-4 'Done' criterion: Wide&Deep trains through
     the DistributeTranspiler API in BOTH modes with localhost subprocess
